@@ -1,0 +1,43 @@
+"""h2o3_tpu — a TPU-native distributed ML platform with the capabilities of H2O-3.
+
+This is a from-scratch JAX/XLA/Pallas rebuild of the H2O-3 architecture
+(reference fork: chatebhagwat/h2o-3, upstream h2oai/h2o-3), NOT a port:
+
+- H2O's distributed compressed columnar ``water.fvec.Frame`` [UNVERIFIED
+  upstream path, see SURVEY.md §0] becomes a row-sharded ``jax.Array`` frame
+  living in TPU HBM (:mod:`h2o3_tpu.frame`).
+- H2O's ``water.MRTask`` map-reduce fabric becomes ``shard_map`` + XLA
+  collectives over the ICI mesh (:mod:`h2o3_tpu.parallel`).
+- The algorithm suite (GLM IRLS Gram, GBM/DRF histogram trees, MLP, KMeans,
+  PCA, ...) compiles to XLA; the histogram inner loop has a Pallas kernel
+  (:mod:`h2o3_tpu.ops`).
+- The DKV (``water.DKV``) becomes a host-side object registry
+  (:mod:`h2o3_tpu.cluster`), the REST API (``water.api.RequestServer``) a
+  stdlib HTTP server (:mod:`h2o3_tpu.api`), and the Python client surface
+  (``h2o.init / h2o.import_file / h2o.estimators``) is mirrored at top level.
+
+The package directory is ``h2o3_tpu`` (the project name "h2o-3_tpu" is not a
+valid Python identifier).
+"""
+
+__version__ = "0.1.0"
+
+from h2o3_tpu.cluster.cloud import init, cluster_info, shutdown
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
+from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
+
+__all__ = [
+    "init",
+    "cluster_info",
+    "shutdown",
+    "Frame",
+    "import_file",
+    "upload_file",
+    "parse_setup",
+    "get_frame",
+    "get_model",
+    "ls",
+    "remove",
+    "remove_all",
+]

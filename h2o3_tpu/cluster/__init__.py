@@ -1,0 +1,4 @@
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.cluster.job import Job
+
+__all__ = ["DKV", "Job"]

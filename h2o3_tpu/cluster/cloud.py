@@ -1,0 +1,81 @@
+"""Cloud lifecycle — successor of ``water.H2O`` main / ``water.Paxos`` cloud
+formation / ``HeartBeatThread`` [UNVERIFIED upstream paths, SURVEY.md §0].
+
+H2O boots a JVM per node, gossips membership, and locks the cloud at the
+first job. The TPU-native cloud is the JAX runtime itself:
+
+- single host: ``init()`` just builds the device mesh;
+- multi-host: ``init(coordinator=...)`` calls ``jax.distributed.initialize``
+  — the JAX coordination service replaces Paxos + heartbeats (it performs
+  liveness detection and fail-stop, matching H2O's no-elastic-recovery
+  semantics, SURVEY.md §5.3).
+
+``cluster_info()`` is the ``GET /3/Cloud`` analog.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+from h2o3_tpu.parallel import mesh as _mesh
+from h2o3_tpu.utils.log import Log
+
+_started_at: float | None = None
+
+
+def init(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    mesh=None,
+    log_level: str = "INFO",
+) -> dict:
+    """Bring up (or attach to) the cloud and build the row mesh.
+
+    Mirrors ``h2o.init()``: idempotent, returns cluster status. For
+    multi-host pods pass the coordinator address (maps to
+    ``jax.distributed.initialize``, the Paxos/flatfile successor).
+    """
+    global _started_at
+    Log.set_level(log_level)
+    if coordinator is not None and not jax.distributed.is_initialized():
+        # Must run before any backend use (jax.devices() etc.).
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    if mesh is not None:
+        _mesh.set_mesh(mesh)
+    m = _mesh.get_mesh()
+    if _started_at is None:
+        _started_at = time.time()
+        Log.info(
+            f"h2o3_tpu cloud up: {len(jax.devices())} device(s) "
+            f"({jax.devices()[0].platform}), {jax.process_count()} process(es), "
+            f"mesh axes {dict(m.shape)}"
+        )
+    return cluster_info()
+
+
+def cluster_info() -> dict:
+    m = _mesh.get_mesh()
+    return {
+        "version": "h2o3_tpu",
+        "cloud_healthy": True,
+        "cloud_size": len(jax.devices()),
+        "processes": jax.process_count(),
+        "platform": jax.devices()[0].platform,
+        "mesh": dict(m.shape),
+        "uptime_ms": int((time.time() - _started_at) * 1e3) if _started_at else 0,
+    }
+
+
+def shutdown() -> None:
+    """Drop all state (the process keeps running; devices are managed by JAX)."""
+    from h2o3_tpu.cluster.registry import DKV
+
+    DKV.remove_all()

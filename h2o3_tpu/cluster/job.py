@@ -1,0 +1,106 @@
+"""Async job control — successor of ``water.Job`` [UNVERIFIED upstream path].
+
+H2O's ``Job<T>`` is cancellable async work with 0..1 progress polled over
+REST (SURVEY.md §2.1). Device compute here is synchronous XLA programs, so a
+Job wraps the *host-side driver loop* (tree iterations, IRLS iterations,
+AutoML steps) in a thread; cancellation stays cooperative, checked between
+iterations — the same granularity H2O uses (between tree levels).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.utils.log import Log
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class Job:
+    PENDING, RUNNING, DONE, FAILED, CANCELLED = (
+        "PENDING",
+        "RUNNING",
+        "DONE",
+        "FAILED",
+        "CANCELLED",
+    )
+
+    def __init__(self, work: Callable[["Job"], Any], description: str = "job"):
+        self.key = DKV.make_key("job")
+        self.description = description
+        self.status = Job.PENDING
+        self.progress = 0.0
+        self.exception: str | None = None
+        self.result: Any = None
+        self.start_time: float | None = None
+        self.end_time: float | None = None
+        self._work = work
+        self._cancel_requested = threading.Event()
+        self._thread: threading.Thread | None = None
+        DKV.put(self.key, self)
+
+    # -- driver-side API (the work callable calls these) --
+    def update(self, progress: float) -> None:
+        self.progress = min(1.0, max(self.progress, float(progress)))
+        if self._cancel_requested.is_set():
+            raise JobCancelled(self.key)
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._cancel_requested.is_set()
+
+    # -- client-side API --
+    def start(self) -> "Job":
+        def run() -> None:
+            self.status = Job.RUNNING
+            self.start_time = time.time()
+            try:
+                self.result = self._work(self)
+                self.progress = 1.0
+                self.status = Job.DONE
+            except JobCancelled:
+                self.status = Job.CANCELLED
+            except Exception:
+                self.exception = traceback.format_exc()
+                self.status = Job.FAILED
+                Log.err(f"Job {self.key} failed:\n{self.exception}")
+            finally:
+                self.end_time = time.time()
+
+        self._thread = threading.Thread(target=run, name=self.key, daemon=True)
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    def join(self, timeout: float | None = None) -> Any:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.status == Job.FAILED:
+            raise RuntimeError(f"Job {self.key} failed:\n{self.exception}")
+        if self.status == Job.CANCELLED:
+            raise JobCancelled(self.key)
+        return self.result
+
+    def run_sync(self) -> Any:
+        """Run inline on the calling thread (used by tests and local API)."""
+        self.start()
+        return self.join()
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "description": self.description,
+            "status": self.status,
+            "progress": self.progress,
+            "exception": self.exception,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+        }

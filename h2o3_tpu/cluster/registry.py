@@ -1,0 +1,131 @@
+"""Object registry — successor of H2O's DKV (``water.DKV`` / ``water.Key`` /
+``water.Lockable`` [UNVERIFIED upstream paths, SURVEY.md §0]).
+
+H2O's DKV is a cluster-wide hash map with consistent-hash home nodes and
+cache invalidation, because model/frame state lives scattered across JVM
+heaps. In the TPU rebuild the *data plane* (columns) already lives in device
+HBM as sharded ``jax.Array``s managed by the JAX runtime; only the *control
+plane* needs a key→object map, and a coordinator-side dict with RW locks is
+the idiomatic replacement. Keys keep H2O's string-key surface so the REST
+layer and clients feel identical.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import uuid
+from typing import Any, Iterable
+
+
+class _RWLock:
+    """Reader-writer lock — successor of ``water.Lockable`` semantics."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    def acquire_read(self) -> None:
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self) -> None:
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+
+    def release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
+class DKV:
+    """Process-wide key→value store for Frames, Models, Jobs, Grids."""
+
+    _store: dict[str, Any] = {}
+    _locks: dict[str, _RWLock] = {}
+    _mutex = threading.Lock()
+
+    @classmethod
+    def make_key(cls, prefix: str = "obj") -> str:
+        return f"{prefix}_{uuid.uuid4().hex[:12]}"
+
+    @classmethod
+    def put(cls, key: str, value: Any) -> str:
+        with cls._mutex:
+            cls._store[key] = value
+            cls._locks.setdefault(key, _RWLock())
+        return key
+
+    @classmethod
+    def get(cls, key: str, default: Any = None) -> Any:
+        with cls._mutex:
+            return cls._store.get(key, default)
+
+    @classmethod
+    def remove(cls, key: str) -> None:
+        with cls._mutex:
+            cls._store.pop(key, None)
+            cls._locks.pop(key, None)
+
+    @classmethod
+    def remove_all(cls) -> None:
+        with cls._mutex:
+            cls._store.clear()
+            cls._locks.clear()
+
+    @classmethod
+    def keys(cls, pattern: str = "*") -> list[str]:
+        with cls._mutex:
+            return sorted(k for k in cls._store if fnmatch.fnmatch(k, pattern))
+
+    @classmethod
+    def values_of_type(cls, typ: type) -> Iterable[Any]:
+        with cls._mutex:
+            return [v for v in cls._store.values() if isinstance(v, typ)]
+
+    @classmethod
+    def lock(cls, key: str) -> _RWLock:
+        with cls._mutex:
+            return cls._locks.setdefault(key, _RWLock())
+
+
+# --- convenience surface mirrored into the top-level package (h2o.ls etc.) ---
+
+def get_frame(key: str):
+    from h2o3_tpu.frame.frame import Frame
+
+    v = DKV.get(key)
+    return v if isinstance(v, Frame) else None
+
+
+def get_model(key: str):
+    try:
+        from h2o3_tpu.models.model_base import Model
+    except ImportError:  # models package not built yet
+        return None
+    v = DKV.get(key)
+    return v if isinstance(v, Model) else None
+
+
+def ls() -> list[str]:
+    return DKV.keys()
+
+
+def remove(key: str) -> None:
+    DKV.remove(key)
+
+
+def remove_all() -> None:
+    DKV.remove_all()

@@ -1,0 +1,4 @@
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
+
+__all__ = ["Frame", "Vec", "import_file", "upload_file", "parse_setup"]

@@ -1,0 +1,419 @@
+"""Distributed columnar Frame — successor of ``water.fvec.Frame`` / ``Vec`` /
+``Chunk`` [UNVERIFIED upstream paths, SURVEY.md §0].
+
+Design mapping (SURVEY.md §7 step 1):
+
+- H2O ``Vec`` = one distributed column split into compressed ``Chunk``s homed
+  across nodes → here one ``jax.Array`` sharded along the ``"rows"`` mesh
+  axis. Chunk *alignment* (chunk *i* of every Vec on the same node) becomes
+  *identical sharding* of every column — row-local compute by construction.
+- H2O's chunk-compression zoo (``C1SChunk``…) existed to fit heaps and
+  starve no core; on TPU the equivalents are narrow dtypes: numerics are
+  ``float32`` (``bfloat16`` inside matmul kernels), categoricals ``int32``
+  codes, booleans ``bool``. Binned tree features use ``uint8``/``int32``
+  (:mod:`h2o3_tpu.models.tree.binning`), which is where C1Chunk-style 1-byte
+  compression actually pays on device.
+- Missing values: ``NaN`` for numerics, code ``-1`` for categoricals — H2O
+  uses NA sentinels per chunk type.
+- Rows are padded to a multiple of (shards × 8); padding is ``NaN``/``-1`` so
+  NA-aware reductions ignore it, and :meth:`Frame.row_mask` gives an explicit
+  validity mask for kernels that need one.
+- String columns stay host-side (numpy object arrays) — SURVEY.md §7 "keep
+  string ops host-side, don't chase CStrChunk on device".
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Iterable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.parallel.mesh import pad_to_shards, row_sharding, shard_rows
+
+NUM, CAT, STR, TIME = "real", "enum", "string", "time"
+INT = "int"  # integral-valued numeric; stored like NUM but reported as int
+
+
+class Vec:
+    """One column. Device-resident for num/cat/time; host-resident for str.
+
+    TIME columns additionally keep an exact float64 epoch-millisecond copy on
+    the host (``_host``): the device array is float32 (fine for model math,
+    like H2O treating time as numeric), but f32 quantizes epoch-ms to ~2-minute
+    steps, so materialization/round-trips use the exact copy.
+    """
+
+    def __init__(
+        self,
+        data,
+        kind: str,
+        name: str = "",
+        domain: tuple[str, ...] | None = None,
+        nrow: int | None = None,
+        host_exact: np.ndarray | None = None,
+    ):
+        self.kind = kind
+        self.name = name
+        self.domain = tuple(domain) if domain is not None else None
+        if kind == STR:
+            self._host = np.asarray(data, dtype=object)
+            self.data = None
+            self.nrow = len(self._host) if nrow is None else nrow
+        else:
+            self._host = host_exact
+            self.data = data  # padded, sharded jax array
+            assert nrow is not None
+            self.nrow = nrow
+        self._stats: dict | None = None
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, kind: str, name: str = "", domain=None) -> "Vec":
+        n = len(arr)
+        if kind == STR:
+            return Vec(arr, STR, name=name, nrow=n)
+        npad = pad_to_shards(n)
+        if kind == CAT:
+            buf = np.full(npad, -1, dtype=np.int32)
+            buf[:n] = np.asarray(arr, dtype=np.int32)
+            return Vec(shard_rows(buf), kind, name=name, domain=domain, nrow=n)
+        exact = None
+        if kind == TIME:
+            exact = np.asarray(arr, dtype=np.float64)
+        buf = np.full(npad, np.nan, dtype=np.float32)
+        buf[:n] = np.asarray(arr, dtype=np.float32)
+        return Vec(
+            shard_rows(buf), kind, name=name, domain=domain, nrow=n, host_exact=exact
+        )
+
+    # -- basics --------------------------------------------------------------
+    @property
+    def npad(self) -> int:
+        return len(self._host) if self.data is None else self.data.shape[0]
+
+    def is_numeric(self) -> bool:
+        return self.kind in (NUM, INT, TIME)
+
+    def is_categorical(self) -> bool:
+        return self.kind == CAT
+
+    def to_numpy(self) -> np.ndarray:
+        """Unpadded host copy. Cat → codes; use :meth:`levels` for strings."""
+        if self.kind == STR:
+            return self._host
+        if self.kind == TIME and self._host is not None:
+            return self._host
+        return np.asarray(jax.device_get(self.data))[: self.nrow]
+
+    def levels(self) -> list[str]:
+        return list(self.domain) if self.domain else []
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain else -1
+
+    # -- rollup stats (successor of Vec rollups: mean/sigma/min/max/naCnt) ---
+    def stats(self) -> dict:
+        if self._stats is not None:
+            return self._stats
+        if self.kind == STR:
+            nas = int(sum(1 for v in self._host if v is None))
+            self._stats = {"naCnt": nas}
+            return self._stats
+        if self.kind == CAT:
+            counts = _cat_counts(self.data, max(1, self.cardinality))
+            counts = np.asarray(counts)
+            nas = self.nrow - int(counts.sum())
+            self._stats = {"naCnt": nas, "levelCounts": counts}
+            return self._stats
+        # Two-pass moments: f32 tree-reduce for a provisional mean, then
+        # centered accumulation — keeps mean/sigma accurate at H2O row scales
+        # without float64 (which TPUs emulate slowly). Count is exact int32.
+        s = _num_stats(self.data)
+        cnt = int(s["cnt"])
+        mean0 = float(s["sum"]) / cnt if cnt else float("nan")
+        c = _centered_stats(self.data, mean0)
+        mean = mean0 + (float(c["dsum"]) / cnt if cnt else 0.0)
+        var = (
+            (float(c["dssq"]) - float(c["dsum"]) ** 2 / cnt) / cnt
+            if cnt
+            else float("nan")
+        )
+        self._stats = {
+            "naCnt": self.nrow - cnt,
+            "mean": mean,
+            "sigma": math.sqrt(max(0.0, var) * (cnt / max(1.0, cnt - 1))),
+            "min": float(s["min"]),
+            "max": float(s["max"]),
+        }
+        return self._stats
+
+    def mean(self) -> float:
+        return self.stats()["mean"]
+
+    def sigma(self) -> float:
+        return self.stats()["sigma"]
+
+    def min(self) -> float:
+        return self.stats()["min"]
+
+    def max(self) -> float:
+        return self.stats()["max"]
+
+    def na_count(self) -> int:
+        return self.stats()["naCnt"]
+
+
+@jax.jit
+def _num_stats(col):
+    ok = ~jnp.isnan(col)
+    x = jnp.where(ok, col, 0.0)
+    return {
+        "cnt": ok.sum(dtype=jnp.int32),
+        "sum": x.sum(dtype=jnp.float32),
+        "min": jnp.where(ok, col, jnp.inf).min(),
+        "max": jnp.where(ok, col, -jnp.inf).max(),
+    }
+
+
+@jax.jit
+def _centered_stats(col, mean0):
+    ok = ~jnp.isnan(col)
+    d = jnp.where(ok, col - mean0, 0.0)
+    return {"dsum": d.sum(dtype=jnp.float32), "dssq": (d * d).sum(dtype=jnp.float32)}
+
+
+@partial(jax.jit, static_argnums=1)
+def _cat_counts(codes, card):
+    ok = codes >= 0
+    return jnp.zeros(card, jnp.int32).at[jnp.where(ok, codes, 0)].add(
+        ok.astype(jnp.int32)
+    )
+
+
+class Frame:
+    """Named list of aligned Vecs — the ``water.fvec.Frame`` successor."""
+
+    def __init__(
+        self,
+        vecs: Sequence[Vec] | None = None,
+        names: Sequence[str] | None = None,
+        key: str | None = None,
+        register: bool | None = None,
+    ):
+        """``register=None`` registers in the DKV only when an explicit key is
+        given — internal temporaries (column selections, splits) stay
+        unregistered so device memory can be garbage-collected; user-facing
+        entry points (parse/upload) pass ``register=True``.
+        """
+        vecs = list(vecs or [])
+        if names is None:
+            names = [v.name or f"C{i + 1}" for i, v in enumerate(vecs)]
+        assert len(names) == len(vecs)
+        nrows = {v.nrow for v in vecs}
+        assert len(nrows) <= 1, f"misaligned vecs: {nrows}"
+        self._vecs: list[Vec] = vecs
+        self._names: list[str] = [str(n) for n in names]
+        for v, n in zip(self._vecs, self._names):
+            v.name = n
+        if register is None:
+            register = key is not None
+        self.key = key or DKV.make_key("frame")
+        if register:
+            DKV.put(self.key, self)
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_pandas(
+        df: pd.DataFrame,
+        destination_frame: str | None = None,
+        column_types: Mapping[str, str] | None = None,
+        register: bool | None = None,
+    ) -> "Frame":
+        from h2o3_tpu.frame.parse import dataframe_to_vecs
+
+        vecs = dataframe_to_vecs(df, column_types or {})
+        return Frame(vecs, list(df.columns), key=destination_frame, register=register)
+
+    @staticmethod
+    def from_arrays(
+        cols: Mapping[str, np.ndarray],
+        column_types: Mapping[str, str] | None = None,
+        key: str | None = None,
+    ) -> "Frame":
+        return Frame.from_pandas(
+            pd.DataFrame({k: np.asarray(v) for k, v in cols.items()}),
+            destination_frame=key,
+            column_types=column_types,
+        )
+
+    # -- shape & metadata ----------------------------------------------------
+    @property
+    def nrow(self) -> int:
+        return self._vecs[0].nrow if self._vecs else 0
+
+    @property
+    def npad(self) -> int:
+        return self._vecs[0].npad if self._vecs else 0
+
+    @property
+    def ncol(self) -> int:
+        return len(self._vecs)
+
+    @property
+    def names(self) -> list[str]:
+        return list(self._names)
+
+    @property
+    def types(self) -> dict[str, str]:
+        return {n: v.kind for n, v in zip(self._names, self._vecs)}
+
+    def vec(self, col: int | str) -> Vec:
+        return self._vecs[self._index(col)]
+
+    def _index(self, col: int | str) -> int:
+        if isinstance(col, str):
+            return self._names.index(col)
+        return int(col)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    def __repr__(self) -> str:
+        return f"<Frame {self.key} {self.nrow}x{self.ncol} {self._names[:8]}>"
+
+    # -- selection -----------------------------------------------------------
+    def __getitem__(self, sel) -> "Frame":
+        if isinstance(sel, (str, int)):
+            sel = [sel]
+        if isinstance(sel, (list, tuple)) and all(
+            isinstance(s, (str, int)) for s in sel
+        ):
+            idx = [self._index(s) for s in sel]
+            return Frame(
+                [self._vecs[i] for i in idx], [self._names[i] for i in idx]
+            )
+        raise TypeError(f"unsupported selection {sel!r}")
+
+    def drop(self, cols: str | Sequence[str]) -> "Frame":
+        if isinstance(cols, str):
+            cols = [cols]
+        keep = [n for n in self._names if n not in set(cols)]
+        return self[keep]
+
+    def cbind(self, other: "Frame") -> "Frame":
+        assert other.nrow == self.nrow
+        return Frame(self._vecs + other._vecs, self._names + other._names)
+
+    def rbind(self, other: "Frame") -> "Frame":
+        """Row-append preserving kinds and unioning categorical domains
+        (H2O unifies domains on rbind [UNVERIFIED])."""
+        assert self._names == other._names, "rbind: column names differ"
+        vecs = []
+        for va, vb in zip(self._vecs, other._vecs):
+            assert va.kind == vb.kind, f"rbind: kind mismatch on {va.name}"
+            if va.kind == STR:
+                vecs.append(Vec(np.concatenate([va._host, vb._host]), STR, name=va.name))
+            elif va.kind == CAT:
+                dom = list(va.domain or ())
+                lut = {d: i for i, d in enumerate(dom)}
+                remap = np.empty(len(vb.domain or ()) + 1, dtype=np.int32)
+                remap[-1] = -1
+                for j, d in enumerate(vb.domain or ()):
+                    remap[j] = lut.setdefault(d, len(lut))
+                    if remap[j] == len(dom):
+                        dom.append(d)
+                codes = np.concatenate([va.to_numpy(), remap[vb.to_numpy()]])
+                vecs.append(Vec.from_numpy(codes, CAT, name=va.name, domain=dom))
+            else:
+                vals = np.concatenate([va.to_numpy(), vb.to_numpy()])
+                vecs.append(Vec.from_numpy(vals, va.kind, name=va.name))
+        return Frame(vecs, self._names)
+
+    # -- row mask ------------------------------------------------------------
+    def row_mask(self):
+        """float32 {0,1} validity mask over padded rows, row-sharded."""
+        return _iota_mask(self.npad, self.nrow)
+
+    # -- materialization -----------------------------------------------------
+    def to_pandas(self) -> pd.DataFrame:
+        out = {}
+        for n, v in zip(self._names, self._vecs):
+            if v.kind == STR:
+                out[n] = v._host
+            elif v.kind == CAT:
+                codes = v.to_numpy()
+                dom = np.asarray(v.domain, dtype=object)
+                col = np.full(len(codes), None, dtype=object)
+                ok = codes >= 0
+                col[ok] = dom[codes[ok]]
+                out[n] = col
+            else:
+                out[n] = v.to_numpy().astype(np.float64)
+        return pd.DataFrame(out, columns=self._names)
+
+    def head(self, n: int = 10) -> pd.DataFrame:
+        return self.to_pandas().head(n)
+
+    def tail(self, n: int = 10) -> pd.DataFrame:
+        return self.to_pandas().tail(n)
+
+    def describe(self) -> pd.DataFrame:
+        rows = []
+        for n, v in zip(self._names, self._vecs):
+            s = v.stats()
+            rows.append(
+                {
+                    "column": n,
+                    "type": v.kind,
+                    "missing": s.get("naCnt", 0),
+                    "mean": s.get("mean"),
+                    "sigma": s.get("sigma"),
+                    "min": s.get("min"),
+                    "max": s.get("max"),
+                    "cardinality": v.cardinality if v.kind == CAT else None,
+                }
+            )
+        return pd.DataFrame(rows)
+
+    # -- munging (Rapids successors live in frame/ops.py; these are core) ----
+    def subset_rows(self, rows: np.ndarray, key: str | None = None) -> "Frame":
+        """New frame from a boolean mask or index array over rows.
+
+        Domains, kinds, and TIME precision are preserved exactly (no pandas
+        round-trip) — H2O likewise keeps the parent Vec domain on slices.
+        """
+        rows = np.asarray(rows)
+        if rows.dtype == bool:
+            rows = np.flatnonzero(rows)
+        vecs = []
+        for v in self._vecs:
+            if v.kind == STR:
+                vecs.append(Vec(v._host[rows], STR, name=v.name))
+            else:
+                vals = v.to_numpy()[rows]
+                vecs.append(Vec.from_numpy(vals, v.kind, name=v.name, domain=v.domain))
+        return Frame(vecs, self._names, key=key)
+
+    def split_frame(self, ratios: Sequence[float], seed: int = 1234) -> list["Frame"]:
+        """Random row split — successor of ``h2o.split_frame`` (Rapids h2o.runif)."""
+        rng = np.random.default_rng(seed)
+        u = rng.random(self.nrow)
+        edges = np.cumsum(list(ratios))
+        assert edges[-1] <= 1.0 + 1e-9
+        out = []
+        lo = 0.0
+        for e in list(edges) + ([1.0] if edges[-1] < 1.0 - 1e-9 else []):
+            out.append(self.subset_rows((u >= lo) & (u < e)))
+            lo = e
+        return out
+
+
+def _iota_mask(npad: int, nrow: int):
+    return shard_rows((np.arange(npad) < nrow).astype(np.float32))

@@ -1,0 +1,200 @@
+"""Ingest — successor of ``water.parser.ParseDataset`` / ``ParseSetup`` /
+``CsvParser`` [UNVERIFIED upstream paths, SURVEY.md §0].
+
+H2O's distributed parse maps ``CsvParser.parseChunk`` over file-block chunks
+and unifies categorical domains in a second cluster pass (SURVEY.md §3.2).
+The TPU-native shape of that work (SURVEY.md §7 step 3) is host-side columnar
+ingest — pandas/pyarrow do vectorized tokenization — followed by type
+inference, global categorical interning (single-process: one pass), and
+``device_put`` of each column's padded buffer with the row sharding. The
+three-call REST surface (ImportFiles → ParseSetup → Parse) is preserved by
+:func:`parse_setup` + :func:`parse` for API parity.
+
+Formats: CSV (+gz), Parquet, ORC, Feather/Arrow, SVMLight; XLS via pandas
+when openpyxl is present.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.frame.frame import CAT, INT, NUM, STR, TIME, Frame, Vec
+from h2o3_tpu.utils.log import Log
+
+# H2O parses low-cardinality strings as enums and high-cardinality ones as
+# strings; this mirrors that heuristic (upstream constant lives in the parser
+# setup logic [UNVERIFIED]).
+_MAX_CAT_FRACTION = 0.95
+_MAX_CAT_LEVELS = 10_000_000
+
+
+def _read_any(
+    path: str,
+    sep: str | None = None,
+    header: int | None = 0,
+    nrows: int | None = None,
+) -> pd.DataFrame:
+    ext = os.path.splitext(path.removesuffix(".gz"))[1].lower()
+    if ext in (".parquet", ".pq"):
+        return pd.read_parquet(path)
+    if ext == ".orc":
+        return pd.read_orc(path)
+    if ext in (".feather", ".arrow"):
+        return pd.read_feather(path)
+    if ext in (".xls", ".xlsx"):
+        return pd.read_excel(path, nrows=nrows)
+    if ext == ".svm" or ext == ".svmlight":
+        from sklearn.datasets import load_svmlight_file
+
+        X, y = load_svmlight_file(path)
+        df = pd.DataFrame(X.toarray(), columns=[f"C{i + 1}" for i in range(X.shape[1])])
+        df.insert(0, "target", y)
+        return df
+    # CSV / TSV / txt (+ .gz transparently via pandas)
+    return pd.read_csv(
+        path, sep=sep or _sniff_sep(path), header=header, engine="c", nrows=nrows
+    )
+
+
+def _sniff_sep(path: str) -> str:
+    """Separator guessing on the first lines — ParseSetup's sep sniffing."""
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", errors="replace") as f:
+        head = [line for _, line in zip(range(5), f)]
+    if not head:
+        return ","
+    best, best_score = ",", -1
+    for cand in (",", "\t", ";", "|"):
+        counts = [line.count(cand) for line in head]
+        score = min(counts) if min(counts) == max(counts) else 0
+        if score > best_score:
+            best, best_score = cand, score
+    return best
+
+
+def infer_kind(s: pd.Series) -> str:
+    """Column type inference — ParseSetup's type-sniffing successor."""
+    if pd.api.types.is_bool_dtype(s):
+        return CAT
+    if pd.api.types.is_datetime64_any_dtype(s):
+        return TIME
+    if isinstance(s.dtype, pd.CategoricalDtype):
+        return CAT
+    if pd.api.types.is_integer_dtype(s):
+        return INT
+    if pd.api.types.is_float_dtype(s):
+        return NUM
+    # object/string column: enum unless near-unique
+    nz = s.dropna()
+    if len(nz) == 0:
+        return NUM
+    # numeric-looking strings parse as numeric (CsvParser type coercion)
+    coerced = pd.to_numeric(nz, errors="coerce")
+    if coerced.notna().all():
+        return NUM
+    nuniq = nz.nunique()
+    if nuniq > _MAX_CAT_LEVELS or (len(nz) > 100 and nuniq > _MAX_CAT_FRACTION * len(nz)):
+        return STR
+    return CAT
+
+
+def _series_to_vec(s: pd.Series, kind: str, name: str) -> Vec:
+    if kind == STR:
+        vals = s.astype(object).where(s.notna(), None).to_numpy()
+        return Vec(vals, STR, name=name)
+    if kind == CAT:
+        if isinstance(s.dtype, pd.CategoricalDtype):
+            cat = s.cat
+            domain = [str(c) for c in cat.categories]
+            codes = cat.codes.to_numpy().astype(np.int32)
+        else:
+            astr = s.astype(object).where(s.notna(), None)
+            # H2O interns categorical levels in sorted order [UNVERIFIED]
+            levels = sorted({str(v) for v in astr.dropna()})
+            lut = {v: i for i, v in enumerate(levels)}
+            codes = np.array(
+                [lut[str(v)] if v is not None else -1 for v in astr], dtype=np.int32
+            )
+            domain = levels
+        return Vec.from_numpy(codes, CAT, name=name, domain=domain)
+    if kind == TIME:
+        vals = pd.to_datetime(s).astype("int64").to_numpy().astype(np.float64) / 1e6
+        vals = np.where(s.isna().to_numpy(), np.nan, vals)
+        return Vec.from_numpy(vals, TIME, name=name)
+    vals = pd.to_numeric(s, errors="coerce").to_numpy(dtype=np.float64)
+    return Vec.from_numpy(vals, INT if kind == INT else NUM, name=name)
+
+
+def dataframe_to_vecs(df: pd.DataFrame, column_types: Mapping[str, str]) -> list[Vec]:
+    vecs = []
+    for name in df.columns:
+        kind = column_types.get(str(name)) or infer_kind(df[name])
+        if kind in ("numeric", "float", "double"):
+            kind = NUM
+        if kind in ("factor", "categorical"):
+            kind = CAT
+        vecs.append(_series_to_vec(df[name], kind, str(name)))
+    return vecs
+
+
+def parse_setup(path: str, sep: str | None = None) -> dict:
+    """Sniff a file — the ``POST /3/ParseSetup`` successor. Returns an
+    editable setup dict accepted by :func:`parse`."""
+    ext = os.path.splitext(path.removesuffix(".gz"))[1].lower()
+    if sep is None and ext not in (".parquet", ".pq", ".orc", ".feather", ".arrow", ".xls", ".xlsx", ".svm", ".svmlight"):
+        sep = _sniff_sep(path)
+    head = _read_any(path, sep=sep, nrows=10_000)
+    return {
+        "source_frames": [path],
+        "separator": sep or ",",
+        "column_names": [str(c) for c in head.columns],
+        "column_types": {str(c): infer_kind(head[c]) for c in head.columns},
+        "rows_sniffed": len(head),
+    }
+
+
+def parse(setup: dict, destination_frame: str | None = None) -> Frame:
+    """Materialize a frame from a setup dict — the ``POST /3/Parse`` successor."""
+    paths = setup["source_frames"]
+    dfs = [_read_any(p, sep=setup.get("separator")) for p in paths]
+    df = pd.concat(dfs, ignore_index=True) if len(dfs) > 1 else dfs[0]
+    fr = Frame.from_pandas(
+        df,
+        destination_frame=destination_frame,
+        column_types=setup.get("column_types"),
+        register=True,
+    )
+    Log.info(f"Parsed {fr.nrow} rows x {fr.ncol} cols into {fr.key}")
+    return fr
+
+
+def import_file(
+    path: str,
+    destination_frame: str | None = None,
+    col_types: Mapping[str, str] | None = None,
+    sep: str | None = None,
+) -> Frame:
+    """``h2o.import_file`` successor: sniff + parse in one call."""
+    setup = parse_setup(path, sep=sep)
+    if col_types:
+        setup["column_types"].update(col_types)
+    return parse(setup, destination_frame=destination_frame)
+
+
+def upload_file(
+    data: "str | pd.DataFrame | Mapping[str, Sequence]",
+    destination_frame: str | None = None,
+    col_types: Mapping[str, str] | None = None,
+) -> Frame:
+    """``h2o.upload_file`` successor; also accepts in-memory tabular data
+    (the ``h2o.H2OFrame(python_obj)`` path)."""
+    if isinstance(data, str):
+        return import_file(data, destination_frame, col_types)
+    df = data if isinstance(data, pd.DataFrame) else pd.DataFrame(data)
+    return Frame.from_pandas(df, destination_frame, col_types or {}, register=True)

@@ -1,0 +1,24 @@
+from h2o3_tpu.parallel.mesh import (
+    ROWS_AXIS,
+    get_mesh,
+    set_mesh,
+    row_sharding,
+    replicated_sharding,
+    n_shards,
+    shard_rows,
+    pad_to_shards,
+)
+from h2o3_tpu.parallel.mrtask import map_reduce, map_only
+
+__all__ = [
+    "ROWS_AXIS",
+    "get_mesh",
+    "set_mesh",
+    "row_sharding",
+    "replicated_sharding",
+    "n_shards",
+    "shard_rows",
+    "pad_to_shards",
+    "map_reduce",
+    "map_only",
+]

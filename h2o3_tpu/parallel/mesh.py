@@ -1,0 +1,69 @@
+"""Device mesh management — the cluster-topology successor of H2O's cloud.
+
+H2O forms a static cloud of JVM nodes (``water.H2O.CLOUD`` / ``water.Paxos``
+[UNVERIFIED upstream paths, SURVEY.md §0]) and homes chunk *i* of every Vec on
+a fixed node. Here the "cloud" is a 1-D ``jax.sharding.Mesh`` over all
+addressable devices with a single ``"rows"`` axis: every column of a Frame is
+sharded the same way along rows, which reproduces H2O's aligned chunk layout
+(row-local compute) by construction. Like the H2O cloud, the mesh is static
+once created.
+
+Multi-host (the H2O multi-node analog) rides the same mesh: ``jax.distributed``
+initializes the coordination service and ``jax.devices()`` spans hosts; XLA
+collectives ride ICI within a slice and DCN across slices. Nothing in the
+algorithm layer knows about hosts — exactly as H2O algorithms never touch
+``water.RPC`` directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS_AXIS = "rows"
+
+_mesh: Mesh | None = None
+
+
+def set_mesh(mesh: Mesh | None) -> None:
+    global _mesh
+    _mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    """The process-wide mesh, created lazily over all devices."""
+    global _mesh
+    if _mesh is None:
+        devices = np.array(jax.devices())
+        _mesh = Mesh(devices, (ROWS_AXIS,))
+    return _mesh
+
+
+def n_shards() -> int:
+    return get_mesh().shape[ROWS_AXIS]
+
+
+def row_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    """Sharding for a row-partitioned column (1-D or leading-row N-D array)."""
+    return NamedSharding(mesh or get_mesh(), P(ROWS_AXIS))
+
+
+def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), P())
+
+
+def pad_to_shards(n: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
+    """Padded row count: a multiple of (shards * multiple) ≥ n.
+
+    The per-shard row count is kept a multiple of 8 (f32 sublane tile) so
+    device layouts stay tiling-friendly.
+    """
+    m = (mesh or get_mesh()).shape[ROWS_AXIS]
+    block = m * multiple
+    return max(block, ((n + block - 1) // block) * block)
+
+
+def shard_rows(arr, mesh: Mesh | None = None):
+    """Place a host array onto the mesh, sharded along the leading axis."""
+    return jax.device_put(arr, row_sharding(mesh))

@@ -1,0 +1,4 @@
+from h2o3_tpu.utils.log import Log
+from h2o3_tpu.utils.timer import Timer
+
+__all__ = ["Log", "Timer"]

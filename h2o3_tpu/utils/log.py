@@ -1,0 +1,82 @@
+"""Leveled logging, successor of ``water.util.Log`` [UNVERIFIED upstream path].
+
+H2O keeps per-node rolling log files fetchable over REST; here a single
+process hosts the coordinator, so we wrap :mod:`logging` with H2O's level
+names and keep an in-memory ring buffer that the REST layer can serve
+(``GET /3/Logs``-equivalent).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+
+_LEVELS = {
+    "FATAL": logging.CRITICAL,
+    "ERRR": logging.ERROR,
+    "WARN": logging.WARNING,
+    "INFO": logging.INFO,
+    "DEBUG": logging.DEBUG,
+    "TRACE": logging.DEBUG,
+}
+
+
+class _RingHandler(logging.Handler):
+    def __init__(self, capacity: int = 4096):
+        super().__init__()
+        self.buffer: collections.deque[str] = collections.deque(maxlen=capacity)
+        self._lock2 = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        with self._lock2:
+            self.buffer.append(self.format(record))
+
+
+class Log:
+    _logger = logging.getLogger("h2o3_tpu")
+    _ring = _RingHandler()
+    _configured = False
+
+    @classmethod
+    def _ensure(cls) -> None:
+        if cls._configured:
+            return
+        fmt = logging.Formatter("%(asctime)s %(levelname)-5s %(message)s")
+        cls._ring.setFormatter(fmt)
+        cls._logger.addHandler(cls._ring)
+        handler = logging.StreamHandler()
+        handler.setFormatter(fmt)
+        cls._logger.addHandler(handler)
+        cls._logger.setLevel(logging.INFO)
+        cls._configured = True
+
+    @classmethod
+    def set_level(cls, level: str) -> None:
+        cls._ensure()
+        cls._logger.setLevel(_LEVELS.get(level.upper(), logging.INFO))
+
+    @classmethod
+    def info(cls, *msg) -> None:
+        cls._ensure()
+        cls._logger.info(" ".join(str(m) for m in msg))
+
+    @classmethod
+    def warn(cls, *msg) -> None:
+        cls._ensure()
+        cls._logger.warning(" ".join(str(m) for m in msg))
+
+    @classmethod
+    def err(cls, *msg) -> None:
+        cls._ensure()
+        cls._logger.error(" ".join(str(m) for m in msg))
+
+    @classmethod
+    def debug(cls, *msg) -> None:
+        cls._ensure()
+        cls._logger.debug(" ".join(str(m) for m in msg))
+
+    @classmethod
+    def tail(cls, n: int = 100) -> list[str]:
+        cls._ensure()
+        return list(cls._ring.buffer)[-n:]

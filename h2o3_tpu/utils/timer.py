@@ -1,0 +1,20 @@
+"""Microtimer, successor of ``water.util.Timer`` [UNVERIFIED upstream path]."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.start = time.perf_counter()
+
+    def time_ms(self) -> float:
+        return (time.perf_counter() - self.start) * 1e3
+
+    def time_s(self) -> float:
+        return time.perf_counter() - self.start
+
+    def __str__(self) -> str:
+        ms = self.time_ms()
+        return f"{ms:.1f} ms" if ms < 1e3 else f"{ms / 1e3:.2f} s"

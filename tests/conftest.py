@@ -1,0 +1,35 @@
+"""Test harness — replicates H2O's "real stack, local topology" strategy
+(SURVEY.md §4): H2O tests boot a real in-process (or N-local-JVM) cloud; here
+we boot a real 8-device sharded mesh on CPU so multi-chip semantics run in CI
+without TPUs. No mocks anywhere below this line.
+"""
+
+import os
+
+# Must be set before the jax backend initializes (sitecustomize may already
+# have imported jax, but backend init is lazy — this still lands in time).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def cloud():
+    import h2o3_tpu
+
+    info = h2o3_tpu.init()
+    assert info["cloud_size"] == 8
+    yield info
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,41 @@
+"""Compute-fabric tests — modeled on upstream ``water/MRTaskTest.java``
+scenarios [UNVERIFIED upstream path]: associative map/reduce over the row
+shards must match a host-side reference."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.parallel import map_only, map_reduce
+
+
+def test_map_reduce_sum():
+    x = np.arange(8000, dtype=np.float32)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x}))
+    out = map_reduce(lambda c: {"s": jnp.nansum(c), "n": (~jnp.isnan(c)).sum()}, fr.vec("x").data)
+    assert float(out["s"]) == x.sum()
+    assert int(out["n"]) == 8000
+
+
+def test_map_reduce_multi_column_gram():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=4096).astype(np.float32)
+    b = rng.normal(size=4096).astype(np.float32)
+    fr = Frame.from_pandas(pd.DataFrame({"a": a, "b": b}))
+
+    def gram(ca, cb):
+        X = jnp.stack([jnp.nan_to_num(ca), jnp.nan_to_num(cb)], axis=1)
+        return X.T @ X
+
+    out = np.asarray(map_reduce(gram, fr.vec("a").data, fr.vec("b").data))
+    X = np.stack([a, b], axis=1)
+    np.testing.assert_allclose(out, X.T @ X, rtol=2e-3)
+
+
+def test_map_only_preserves_sharding():
+    x = np.arange(2048, dtype=np.float32)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x}))
+    y = map_only(lambda c: c * 2.0 + 1.0, fr.vec("x").data)
+    np.testing.assert_allclose(np.asarray(y)[:2048], x * 2 + 1)
+    assert len(y.sharding.device_set) == 8
