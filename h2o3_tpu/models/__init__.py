@@ -1,0 +1,8 @@
+"""Algorithm layer — successor of ``hex.*`` (h2o-algos) [UNVERIFIED upstream
+paths, SURVEY.md §2.2]. Every algorithm is a ModelBuilder producing a Model,
+expressed against the sharded Frame + map-reduce fabric only."""
+
+from h2o3_tpu.models.model_base import Model, ModelBuilder
+from h2o3_tpu.models.datainfo import DataInfo
+
+__all__ = ["Model", "ModelBuilder", "DataInfo"]

@@ -1,0 +1,162 @@
+"""Design-matrix view — successor of ``hex.DataInfo`` [UNVERIFIED upstream
+path, SURVEY.md §2.2].
+
+H2O's DataInfo gives GLM/DL/KMeans/PCA a canonical numeric view of a Frame:
+categoricals expanded to indicator blocks, numerics standardized, missing
+values imputed or skipped. Here the view is materialized as one row-sharded
+``(npad, p)`` float32 device matrix — dense one-hot is MXU-friendly and XLA
+fuses the expansion into downstream matmuls. Train-time statistics (means,
+sigmas, domains) are captured so the identical transform applies to
+validation/test frames (the ``adaptTestForTrain`` contract).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import CAT, Frame, Vec
+from h2o3_tpu.parallel.mesh import row_sharding
+
+MEAN_IMPUTATION = "mean_imputation"
+SKIP = "skip"
+
+
+@dataclass
+class ColumnSpec:
+    name: str
+    kind: str  # "num" | "cat"
+    mean: float = 0.0
+    sigma: float = 1.0
+    domain: tuple[str, ...] = ()
+    offset: int = 0  # first column index in the expanded matrix
+    width: int = 1
+
+
+@dataclass
+class DataInfo:
+    """Fitted design-matrix spec. Build with :meth:`fit`, apply with
+    :meth:`transform`."""
+
+    columns: list[ColumnSpec] = field(default_factory=list)
+    standardize: bool = True
+    use_all_factor_levels: bool = True
+    missing_handling: str = MEAN_IMPUTATION
+    add_intercept: bool = False
+    ncols_expanded: int = 0
+
+    @staticmethod
+    def fit(
+        frame: Frame,
+        x: list[str],
+        standardize: bool = True,
+        use_all_factor_levels: bool = True,
+        missing_handling: str = MEAN_IMPUTATION,
+        add_intercept: bool = False,
+    ) -> "DataInfo":
+        di = DataInfo(
+            standardize=standardize,
+            use_all_factor_levels=use_all_factor_levels,
+            missing_handling=missing_handling,
+            add_intercept=add_intercept,
+        )
+        off = 0
+        # H2O orders the expanded matrix categoricals-first, then numerics
+        # [UNVERIFIED]; we keep the user's column order for readability of
+        # coefficient names — the math is order-invariant.
+        for name in x:
+            v = frame.vec(name)
+            if v.is_categorical():
+                k = v.cardinality
+                width = k if use_all_factor_levels else max(1, k - 1)
+                di.columns.append(
+                    ColumnSpec(name, "cat", domain=v.domain or (), offset=off, width=width)
+                )
+                off += width
+            else:
+                s = v.stats()
+                sigma = s["sigma"] if standardize else 1.0
+                if not np.isfinite(sigma) or sigma == 0.0:
+                    sigma = 1.0
+                di.columns.append(
+                    ColumnSpec(
+                        name,
+                        "num",
+                        mean=s["mean"] if np.isfinite(s["mean"]) else 0.0,
+                        sigma=sigma,
+                        offset=off,
+                    )
+                )
+                off += 1
+        di.ncols_expanded = off + (1 if add_intercept else 0)
+        return di
+
+    # -- expanded-column names (for coefficient tables) ----------------------
+    def coef_names(self) -> list[str]:
+        names = []
+        for c in self.columns:
+            if c.kind == "cat":
+                lo = 0 if self.use_all_factor_levels else 1
+                names += [f"{c.name}.{d}" for d in c.domain[lo : lo + c.width]]
+            else:
+                names.append(c.name)
+        if self.add_intercept:
+            names.append("Intercept")
+        return names
+
+    def transform(self, frame: Frame):
+        """Build the (npad, p) float32 design matrix on device, plus a row
+        validity mask folding in padding and (if skip-handling) NA rows."""
+        cols = []
+        valid = frame.row_mask()
+        for c in self.columns:
+            v = frame.vec(c.name)
+            if c.kind == "cat":
+                codes = _adapt_codes(v, c.domain)
+                if self.missing_handling == SKIP:
+                    valid = valid * (codes >= 0).astype(jnp.float32)
+                cols.append(_expand_cat(codes, len(c.domain), c.width, self.use_all_factor_levels))
+            else:
+                data = v.data
+                isna = jnp.isnan(data)
+                if self.missing_handling == SKIP:
+                    valid = valid * (~isna).astype(jnp.float32)
+                x = jnp.where(isna, c.mean, data)
+                if self.standardize:
+                    x = (x - c.mean) / c.sigma
+                elif self.missing_handling == SKIP:
+                    x = jnp.where(isna, 0.0, x)
+                cols.append(x[:, None])
+        if self.add_intercept:
+            cols.append(jnp.ones((frame.npad, 1), jnp.float32))
+        X = jnp.concatenate(cols, axis=1)
+        X = jax.device_put(X, row_sharding())
+        # zero out invalid rows so they contribute nothing to reductions
+        X = X * valid[:, None]
+        return X, valid
+
+
+def _adapt_codes(v: Vec, train_domain: tuple[str, ...]):
+    """Remap a categorical Vec's codes onto the training domain — the
+    ``CategoricalWrappedVec`` / ``adaptTestForTrain`` successor. Unseen
+    levels map to NA (-1), matching H2O's default warning path."""
+    if v.domain == train_domain:
+        return v.data
+    lut = {d: i for i, d in enumerate(train_domain)}
+    remap = np.full(len(v.domain or ()) + 1, -1, dtype=np.int32)
+    for j, d in enumerate(v.domain or ()):
+        remap[j] = lut.get(d, -1)
+    remap_dev = jnp.asarray(remap)
+    return jnp.where(v.data >= 0, remap_dev[jnp.clip(v.data, 0)], -1)
+
+
+def _expand_cat(codes, card: int, width: int, use_all: bool):
+    """Dense indicator block; NA (-1) rows get all-zeros (mode-free encoding,
+    mirroring H2O's missing-as-zero-row for expanded categoricals)."""
+    base = 0 if use_all else 1
+    shifted = codes - base
+    onehot = (shifted[:, None] == jnp.arange(width)[None, :]).astype(jnp.float32)
+    return onehot

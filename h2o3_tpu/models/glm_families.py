@@ -1,0 +1,202 @@
+"""GLM family/link zoo — successor of ``hex.glm.GLMModel.GLMParameters``
+family/link math (``GLMTask``'s per-row link/variance evaluations)
+[UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+Each family provides device-side: linkinv, link derivative (dmu/deta),
+variance(mu), deviance(y, mu, w), and an initial-mu heuristic. All functions
+are jax-traceable and close over static hyperparameters (tweedie powers,
+negative-binomial theta).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax.numpy as jnp
+
+_EPS = 1e-10
+
+
+def _clip01(x):
+    return jnp.clip(x, _EPS, 1.0 - _EPS)
+
+
+@dataclass(frozen=True)
+class Link:
+    name: str
+    inv: Callable  # eta -> mu
+    dinv: Callable  # eta -> dmu/deta
+    fwd: Callable  # mu -> eta
+
+
+LINKS = {
+    "identity": Link("identity", lambda e: e, lambda e: jnp.ones_like(e), lambda m: m),
+    "log": Link("log", jnp.exp, jnp.exp, lambda m: jnp.log(jnp.maximum(m, _EPS))),
+    "logit": Link(
+        "logit",
+        lambda e: _clip01(jax_sigmoid(e)),
+        lambda e: jnp.maximum(jax_sigmoid(e) * (1 - jax_sigmoid(e)), _EPS),
+        lambda m: jnp.log(_clip01(m) / (1 - _clip01(m))),
+    ),
+    "inverse": Link(
+        "inverse",
+        lambda e: 1.0 / jnp.where(jnp.abs(e) < _EPS, _EPS, e),
+        lambda e: -1.0 / jnp.square(jnp.where(jnp.abs(e) < _EPS, _EPS, e)),
+        lambda m: 1.0 / jnp.where(jnp.abs(m) < _EPS, _EPS, m),
+    ),
+}
+
+
+def jax_sigmoid(e):
+    return 1.0 / (1.0 + jnp.exp(-e))
+
+
+def tweedie_link(link_power: float) -> Link:
+    if link_power == 0:
+        return LINKS["log"]
+    lp = float(link_power)
+    return Link(
+        f"tweedie_{lp}",
+        lambda e: jnp.maximum(e, _EPS) ** (1.0 / lp),
+        lambda e: (1.0 / lp) * jnp.maximum(e, _EPS) ** (1.0 / lp - 1.0),
+        lambda m: jnp.maximum(m, _EPS) ** lp,
+    )
+
+
+@dataclass(frozen=True)
+class Family:
+    name: str
+    link: Link
+    variance: Callable  # mu -> var
+    deviance: Callable  # (y, mu, w) -> scalar
+    init_mu: Callable  # (y, w) -> mu0 array
+    dispersion_fixed: bool  # True => dispersion 1 (binomial/poisson)
+
+
+def _dev_gaussian(y, mu, w):
+    return jnp.sum(w * (y - mu) ** 2)
+
+
+def _dev_binomial(y, mu, w):
+    mu = _clip01(mu)
+    return -2.0 * jnp.sum(w * (y * jnp.log(mu) + (1 - y) * jnp.log(1 - mu)))
+
+
+def _dev_poisson(y, mu, w):
+    mu = jnp.maximum(mu, _EPS)
+    t = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, _EPS) / mu), 0.0)
+    return 2.0 * jnp.sum(w * (t - (y - mu)))
+
+
+def _dev_gamma(y, mu, w):
+    mu = jnp.maximum(mu, _EPS)
+    ys = jnp.maximum(y, _EPS)
+    return 2.0 * jnp.sum(w * (-jnp.log(ys / mu) + (ys - mu) / mu))
+
+
+def _dev_tweedie(p: float):
+    def dev(y, mu, w):
+        mu = jnp.maximum(mu, _EPS)
+        ys = jnp.maximum(y, 0.0)
+        if p == 1.0:
+            return _dev_poisson(y, mu, w)
+        if p == 2.0:
+            return _dev_gamma(y, mu, w)
+        t1 = jnp.where(
+            ys > 0, ys ** (2.0 - p) / ((1.0 - p) * (2.0 - p)), 0.0
+        )
+        t2 = ys * mu ** (1.0 - p) / (1.0 - p)
+        t3 = mu ** (2.0 - p) / (2.0 - p)
+        return 2.0 * jnp.sum(w * (t1 - t2 + t3))
+
+    return dev
+
+
+def _dev_negbinomial(theta: float):
+    def dev(y, mu, w):
+        mu = jnp.maximum(mu, _EPS)
+        ys = jnp.maximum(y, 0.0)
+        it = 1.0 / theta
+        t1 = jnp.where(ys > 0, ys * jnp.log(jnp.maximum(ys, _EPS) / mu), 0.0)
+        t2 = (ys + it) * jnp.log((ys + it) / (mu + it))
+        return 2.0 * jnp.sum(w * (t1 - t2))
+
+    return dev
+
+
+def get_family(
+    name: str,
+    link: str = "family_default",
+    tweedie_variance_power: float = 1.5,
+    tweedie_link_power: float = 0.0,
+    theta: float = 1e-5,
+) -> Family:
+    name = name.lower()
+    defaults = {
+        "gaussian": "identity",
+        "binomial": "logit",
+        "quasibinomial": "logit",
+        "fractionalbinomial": "logit",
+        "poisson": "log",
+        "gamma": "inverse",
+        "tweedie": "tweedie",
+        "negativebinomial": "log",
+    }
+    lname = defaults[name] if link in ("family_default", None) else link.lower()
+    if name == "tweedie" or lname == "tweedie":
+        lk = tweedie_link(tweedie_link_power)
+    else:
+        lk = LINKS[lname]
+
+    wmean = lambda y, w: jnp.sum(w * y) / jnp.maximum(jnp.sum(w), _EPS)
+    if name == "gaussian":
+        return Family(name, lk, lambda m: jnp.ones_like(m), _dev_gaussian, wmean, False)
+    if name in ("binomial", "quasibinomial", "fractionalbinomial"):
+        return Family(
+            name,
+            lk,
+            lambda m: jnp.maximum(_clip01(m) * (1 - _clip01(m)), _EPS),
+            _dev_binomial,
+            lambda y, w: jnp.clip(wmean(y, w), 0.01, 0.99) * jnp.ones_like(y),
+            name == "binomial",
+        )
+    if name == "poisson":
+        return Family(
+            name,
+            lk,
+            lambda m: jnp.maximum(m, _EPS),
+            _dev_poisson,
+            lambda y, w: jnp.maximum(wmean(y, w), 0.1) * jnp.ones_like(y),
+            True,
+        )
+    if name == "gamma":
+        return Family(
+            name,
+            lk,
+            lambda m: jnp.maximum(m, _EPS) ** 2,
+            _dev_gamma,
+            lambda y, w: jnp.maximum(wmean(y, w), _EPS) * jnp.ones_like(y),
+            False,
+        )
+    if name == "tweedie":
+        p = float(tweedie_variance_power)
+        return Family(
+            name,
+            lk,
+            lambda m: jnp.maximum(m, _EPS) ** p,
+            _dev_tweedie(p),
+            lambda y, w: jnp.maximum(wmean(y, w), 0.1) * jnp.ones_like(y),
+            False,
+        )
+    if name == "negativebinomial":
+        th = float(theta)
+        return Family(
+            name,
+            lk,
+            lambda m: jnp.maximum(m, _EPS) + th * jnp.maximum(m, _EPS) ** 2,
+            _dev_negbinomial(th),
+            lambda y, w: jnp.maximum(wmean(y, w), 0.1) * jnp.ones_like(y),
+            False,
+        )
+    raise ValueError(f"unknown family {name}")

@@ -1,0 +1,301 @@
+"""Model metrics — successor of the ``hex.ModelMetrics*`` hierarchy
+(``ModelMetricsRegression/Binomial/Multinomial/Clustering``; AUC machinery in
+``hex.AUC2``) [UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+Scoring passes run on device; the metric *summaries* here are computed
+host-side in float64 on the pulled-down prediction column(s) — exactness
+matters more than FLOPs for a one-shot O(n) summary, and it keeps AUC
+bit-stable for the MOJO-parity regression net (SURVEY.md §4).
+
+H2O's AUC2 builds 400 threshold bins; we compute the exact rank-statistic AUC
+and a 400-point threshold table for the max-F1/confusion surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EPS = 1e-15
+
+
+class ModelMetrics:
+    def __init__(self, kind: str, values: dict, domain=None):
+        self.kind = kind
+        self._v = dict(values)
+        self.domain = domain
+
+    def __getattr__(self, item):
+        v = self.__dict__.get("_v", {})
+        if item in v:
+            return v[item]
+        raise AttributeError(item)
+
+    def to_dict(self) -> dict:
+        out = {"kind": self.kind}
+        for k, v in self._v.items():
+            out[k] = v.tolist() if isinstance(v, np.ndarray) else v
+        return out
+
+    def __repr__(self):
+        keys = [
+            k
+            for k in (
+                "rmse",
+                "mae",
+                "r2",
+                "mean_residual_deviance",
+                "auc",
+                "pr_auc",
+                "logloss",
+                "mean_per_class_error",
+                "gini",
+            )
+            if k in self._v
+        ]
+        body = ", ".join(f"{k}={self._v[k]:.6g}" for k in keys)
+        return f"<ModelMetrics{self.kind.capitalize()} {body}>"
+
+
+# --------------------------------------------------------------------------
+# regression
+
+
+def regression_metrics(
+    actual: np.ndarray,
+    pred: np.ndarray,
+    weights: np.ndarray | None = None,
+    distribution: str = "gaussian",
+) -> ModelMetrics:
+    a = np.asarray(actual, np.float64)
+    p = np.asarray(pred, np.float64)
+    w = np.ones_like(a) if weights is None else np.asarray(weights, np.float64)
+    ok = ~np.isnan(a) & ~np.isnan(p) & (w > 0)
+    a, p, w = a[ok], p[ok], w[ok]
+    sw = w.sum()
+    err = a - p
+    mse = float((w * err**2).sum() / sw)
+    mae = float((w * np.abs(err)).sum() / sw)
+    mean_a = (w * a).sum() / sw
+    ss_tot = float((w * (a - mean_a) ** 2).sum() / sw)
+    rmsle = float("nan")
+    if (a > -1).all() and (p > -1).all():
+        rmsle = float(
+            np.sqrt((w * (np.log1p(a) - np.log1p(p)) ** 2).sum() / sw)
+        )
+    dev = _mean_deviance(a, p, w, distribution)
+    return ModelMetrics(
+        "regression",
+        {
+            "mse": mse,
+            "rmse": float(np.sqrt(mse)),
+            "mae": mae,
+            "rmsle": rmsle,
+            "r2": float(1.0 - mse / ss_tot) if ss_tot > 0 else float("nan"),
+            "mean_residual_deviance": dev,
+            "nobs": int(ok.sum()),
+        },
+    )
+
+
+def _mean_deviance(a, p, w, distribution: str) -> float:
+    sw = w.sum()
+    if distribution == "poisson":
+        p = np.maximum(p, _EPS)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = np.where(a > 0, a * np.log(a / p), 0.0)
+        return float((2 * w * (t - (a - p))).sum() / sw)
+    if distribution == "gamma":
+        p = np.maximum(p, _EPS)
+        a_ = np.maximum(a, _EPS)
+        return float((2 * w * (-np.log(a_ / p) + (a_ - p) / p)).sum() / sw)
+    if distribution == "laplace":
+        return float((w * np.abs(a - p)).sum() / sw)
+    return float((w * (a - p) ** 2).sum() / sw)  # gaussian & default
+
+
+# --------------------------------------------------------------------------
+# binomial
+
+
+def binomial_metrics(
+    actual: np.ndarray,
+    prob: np.ndarray,
+    weights: np.ndarray | None = None,
+    domain: tuple[str, str] = ("0", "1"),
+) -> ModelMetrics:
+    """``actual`` is {0,1} int; ``prob`` is P(class 1)."""
+    y = np.asarray(actual, np.float64)
+    p = np.clip(np.asarray(prob, np.float64), _EPS, 1 - _EPS)
+    w = np.ones_like(y) if weights is None else np.asarray(weights, np.float64)
+    ok = ~np.isnan(y) & ~np.isnan(p) & (w > 0)
+    y, p, w = y[ok], p[ok], w[ok]
+    sw = w.sum()
+
+    logloss = float(-(w * (y * np.log(p) + (1 - y) * np.log(1 - p))).sum() / sw)
+    mse = float((w * (y - p) ** 2).sum() / sw)
+    auc = _weighted_auc(y, p, w)
+    pr_auc = _pr_auc(y, p, w)
+
+    # threshold table (the AUC2 criterion surface)
+    thresholds = np.unique(np.quantile(p, np.linspace(0, 1, 400)))
+    table = _threshold_table(y, p, w, thresholds)
+    f1 = table["f1"]
+    best = int(np.nanargmax(f1))
+    best_thr = float(thresholds[best])
+    cm = _confusion(y, p, w, best_thr)
+
+    mx = {
+        f"max_{name}": {
+            "threshold": float(thresholds[int(np.nanargmax(table[name]))]),
+            "value": float(np.nanmax(table[name])),
+        }
+        for name in ("f1", "f2", "f0point5", "accuracy", "precision", "recall", "specificity", "mcc", "min_per_class_accuracy", "mean_per_class_accuracy")
+    }
+
+    return ModelMetrics(
+        "binomial",
+        {
+            "auc": auc,
+            "pr_auc": pr_auc,
+            "gini": 2 * auc - 1,
+            "logloss": logloss,
+            "mse": mse,
+            "rmse": float(np.sqrt(mse)),
+            "mean_per_class_error": float(
+                1.0 - mx["max_mean_per_class_accuracy"]["value"]
+            ),
+            "default_threshold": best_thr,
+            "confusion_matrix": cm,
+            "max_criteria": mx,
+            "nobs": int(ok.sum()),
+        },
+        domain=domain,
+    )
+
+
+def _weighted_auc(y, p, w) -> float:
+    order = np.argsort(p, kind="mergesort")
+    y, p, w = y[order], p[order], w[order]
+    wpos = w * (y == 1)
+    wneg = w * (y == 0)
+    tot_pos, tot_neg = wpos.sum(), wneg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return float("nan")
+    # rank-sum with tie handling: group equal scores
+    cum_neg = np.cumsum(wneg)
+    # for ties, positives at a tied score see half the tied negatives
+    _, idx, inv = np.unique(p, return_index=True, return_inverse=True)
+    grp_neg = np.add.reduceat(wneg, idx)
+    below = np.concatenate([[0.0], np.cumsum(grp_neg)[:-1]])
+    frac = below[inv] + 0.5 * grp_neg[inv]
+    return float((wpos * frac).sum() / (tot_pos * tot_neg))
+
+
+def _pr_auc(y, p, w) -> float:
+    order = np.argsort(-p, kind="mergesort")
+    y, w = y[order], w[order]
+    tp = np.cumsum(w * (y == 1))
+    fp = np.cumsum(w * (y == 0))
+    tot_pos = tp[-1]
+    if tot_pos == 0:
+        return float("nan")
+    precision = tp / np.maximum(tp + fp, _EPS)
+    recall = tp / tot_pos
+    return float(np.trapezoid(precision, recall))
+
+
+def _threshold_table(y, p, w, thresholds):
+    pred = p[None, :] >= thresholds[:, None]  # (T, n)
+    wpos = (w * (y == 1))[None, :]
+    wneg = (w * (y == 0))[None, :]
+    tp = (pred * wpos).sum(1)
+    fp = (pred * wneg).sum(1)
+    fn = wpos.sum() - tp
+    tn = wneg.sum() - fp
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = tp / (tp + fp)
+        recall = tp / (tp + fn)
+        specificity = tn / (tn + fp)
+        accuracy = (tp + tn) / (tp + fp + fn + tn)
+        f1 = 2 * precision * recall / (precision + recall)
+        f2 = 5 * precision * recall / (4 * precision + recall)
+        f05 = 1.25 * precision * recall / (0.25 * precision + recall)
+        mcc = (tp * tn - fp * fn) / np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        min_pca = np.minimum(recall, specificity)
+        mean_pca = 0.5 * (recall + specificity)
+    return {
+        "f1": f1,
+        "f2": f2,
+        "f0point5": f05,
+        "accuracy": accuracy,
+        "precision": precision,
+        "recall": recall,
+        "specificity": specificity,
+        "mcc": np.abs(mcc),
+        "min_per_class_accuracy": min_pca,
+        "mean_per_class_accuracy": mean_pca,
+    }
+
+
+def _confusion(y, p, w, thr) -> list[list[float]]:
+    pred = (p >= thr).astype(np.float64)
+    tp = float((w * ((y == 1) & (pred == 1))).sum())
+    fp = float((w * ((y == 0) & (pred == 1))).sum())
+    fn = float((w * ((y == 1) & (pred == 0))).sum())
+    tn = float((w * ((y == 0) & (pred == 0))).sum())
+    return [[tn, fp], [fn, tp]]
+
+
+# --------------------------------------------------------------------------
+# multinomial
+
+
+def multinomial_metrics(
+    actual: np.ndarray,
+    probs: np.ndarray,
+    weights: np.ndarray | None = None,
+    domain: tuple[str, ...] = (),
+) -> ModelMetrics:
+    """``actual`` int class ids; ``probs`` (n, K)."""
+    y = np.asarray(actual)
+    P = np.clip(np.asarray(probs, np.float64), _EPS, 1.0)
+    w = np.ones(len(y), np.float64) if weights is None else np.asarray(weights, np.float64)
+    ok = (y >= 0) & (w > 0) & ~np.isnan(P).any(axis=1)
+    y, P, w = y[ok], P[ok], w[ok]
+    sw = w.sum()
+    K = P.shape[1]
+
+    logloss = float(-(w * np.log(P[np.arange(len(y)), y])).sum() / sw)
+    pred = P.argmax(axis=1)
+    err = float((w * (pred != y)).sum() / sw)
+
+    cm = np.zeros((K, K))
+    np.add.at(cm, (y, pred), w)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_class_err = 1.0 - np.diag(cm) / cm.sum(axis=1)
+    mean_pce = float(np.nanmean(per_class_err))
+
+    # top-k hit ratios (h2o reports up to 10)
+    order = np.argsort(-P, axis=1)
+    ranks = np.argmax(order == y[:, None], axis=1)
+    topk = [float((w * (ranks <= k)).sum() / sw) for k in range(min(10, K))]
+
+    onehot = np.zeros_like(P)
+    onehot[np.arange(len(y)), y] = 1.0
+    mse = float((w[:, None] * (onehot - P) ** 2).sum() / (sw))
+
+    return ModelMetrics(
+        "multinomial",
+        {
+            "logloss": logloss,
+            "classification_error": err,
+            "mean_per_class_error": mean_pce,
+            "per_class_error": per_class_err,
+            "confusion_matrix": cm,
+            "hit_ratios": topk,
+            "mse": mse,
+            "rmse": float(np.sqrt(mse)),
+            "nobs": int(ok.sum()),
+        },
+        domain=domain,
+    )

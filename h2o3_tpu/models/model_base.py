@@ -1,0 +1,351 @@
+"""Model/ModelBuilder framework — successor of ``hex.ModelBuilder`` /
+``hex.Model`` / ``hex.ScoreKeeper`` [UNVERIFIED upstream paths, SURVEY.md
+§2.2].
+
+Responsibilities mirrored from H2O:
+- parameter validation and train/validation frame adaptation,
+- response handling (enum → classification, numeric → regression),
+- the cross-validation driver (N fold models as sub-jobs, holdout
+  predictions aggregated for Stacked Ensembles, CV metrics),
+- early stopping via a ScoreKeeper ring,
+- ``Model.predict`` (the ``BigScore`` successor: a batched device scoring
+  pass writing a new Frame) and ``model_performance``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import CAT, Frame, Vec
+from h2o3_tpu.models import metrics as MM
+from h2o3_tpu.utils.log import Log
+from h2o3_tpu.utils.timer import Timer
+
+
+@dataclass
+class CommonParams:
+    training_frame: Any = None
+    validation_frame: Any = None
+    response_column: str | None = None
+    ignored_columns: Sequence[str] = field(default_factory=tuple)
+    weights_column: str | None = None
+    offset_column: str | None = None
+    nfolds: int = 0
+    fold_assignment: str = "modulo"  # modulo | random
+    keep_cross_validation_predictions: bool = False
+    seed: int = -1
+    max_runtime_secs: float = 0.0
+    stopping_rounds: int = 0
+    stopping_metric: str = "AUTO"
+    stopping_tolerance: float = 1e-3
+
+
+class ScoreKeeper:
+    """Early-stopping ring — successor of ``hex.ScoreKeeper``. H2O stops when
+    the moving average of the last k scores stops improving on the best of
+    the earlier window by more than the relative tolerance."""
+
+    def __init__(self, rounds: int, tolerance: float, larger_is_better: bool):
+        self.rounds = rounds
+        self.tol = tolerance
+        self.larger = larger_is_better
+        self.history: list[float] = []
+
+    def record(self, value: float) -> None:
+        self.history.append(float(value))
+
+    def should_stop(self) -> bool:
+        k = self.rounds
+        if k <= 0 or len(self.history) < 2 * k:
+            return False
+        h = np.array(self.history, dtype=np.float64)
+        recent = h[-k:].mean()
+        ref = h[:-k]
+        best_ref = ref.max() if self.larger else ref.min()
+        if self.larger:
+            return recent <= best_ref * (1 + self.tol) - (0 if best_ref >= 0 else 2 * best_ref * self.tol)
+        return recent >= best_ref * (1 - self.tol) + (0 if best_ref >= 0 else -2 * best_ref * self.tol)
+
+
+def stopping_metric_direction(metric: str, classification: bool, nclasses: int) -> tuple[str, bool]:
+    """Resolve AUTO and return (metric_name, larger_is_better)."""
+    m = metric.lower()
+    if m == "auto":
+        m = ("logloss" if classification else "deviance")
+    larger = m in ("auc", "pr_auc", "accuracy", "f1", "r2", "lift_top_group")
+    return m, larger
+
+
+class Model:
+    """A trained model. Subclasses implement ``_predict_raw``."""
+
+    algo = "base"
+
+    def __init__(self, key: str, params, output: dict):
+        self.key = key
+        self.params = params
+        self.output = output  # names/domains/varimp/... (the Model._output analog)
+        self.training_metrics: MM.ModelMetrics | None = None
+        self.validation_metrics: MM.ModelMetrics | None = None
+        self.cross_validation_metrics: MM.ModelMetrics | None = None
+        self.cv_predictions: np.ndarray | None = None  # holdout preds (for SE)
+        self.cv_models: list["Model"] = []
+        self.scoring_history: list[dict] = []
+        self.run_time_ms: int = 0
+        DKV.put(key, self)
+
+    # -- to be provided by subclasses ---------------------------------------
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        """Regression: (n,) predictions. Classification: (n, K) class probs."""
+        raise NotImplementedError
+
+    # -- public surface ------------------------------------------------------
+    @property
+    def is_classifier(self) -> bool:
+        return self.output.get("response_domain") is not None
+
+    @property
+    def nclasses(self) -> int:
+        d = self.output.get("response_domain")
+        return len(d) if d else 1
+
+    def predict(self, frame: Frame) -> Frame:
+        """``model.predict`` — returns a Frame with ``predict`` (+ per-class
+        probability columns for classifiers), matching the H2O layout."""
+        raw = self._predict_raw(frame)
+        if not self.is_classifier:
+            return Frame([Vec.from_numpy(np.asarray(raw), "real")], ["predict"])
+        domain = self.output["response_domain"]
+        probs = np.asarray(raw)
+        if probs.ndim == 1:
+            probs = np.stack([1 - probs, probs], axis=1)
+        if self.nclasses == 2:
+            # H2O uses max-F1 threshold for the binary label, not argmax
+            thr = 0.5
+            if self.training_metrics is not None:
+                thr = self.training_metrics._v.get("default_threshold", 0.5)
+            labels = (probs[:, 1] >= thr).astype(np.int32)
+        else:
+            labels = probs.argmax(axis=1).astype(np.int32)
+        vecs = [Vec.from_numpy(labels, CAT, domain=domain)]
+        names = ["predict"]
+        for k, d in enumerate(domain):
+            vecs.append(Vec.from_numpy(probs[:, k], "real"))
+            names.append(str(d))
+        return Frame(vecs, names)
+
+    def model_performance(self, test_data: Frame | None = None) -> MM.ModelMetrics:
+        if test_data is None:
+            return self.training_metrics
+        return self._score_metrics(test_data)
+
+    def _response_and_weights(self, frame: Frame):
+        y_name = self.params.response_column
+        yv = frame.vec(y_name)
+        y = yv.to_numpy()
+        if self.is_classifier and yv.is_categorical():
+            y = _remap_response(yv, self.output["response_domain"])
+        w = None
+        if self.params.weights_column:
+            w = frame.vec(self.params.weights_column).to_numpy()
+        return y, w
+
+    def _score_metrics(self, frame: Frame) -> MM.ModelMetrics:
+        raw = np.asarray(self._predict_raw(frame))
+        y, w = self._response_and_weights(frame)
+        return _make_metrics(self, raw, y, w)
+
+    def _distribution_for_metrics(self) -> str:
+        return getattr(self.params, "distribution", "gaussian") or "gaussian"
+
+    # -- persistence hooks (export layer fills these in) ---------------------
+    def summary(self) -> dict:
+        return {
+            "algo": self.algo,
+            "key": self.key,
+            "classification": self.is_classifier,
+            "nclasses": self.nclasses,
+            "training_metrics": self.training_metrics.to_dict()
+            if self.training_metrics
+            else None,
+            "validation_metrics": self.validation_metrics.to_dict()
+            if self.validation_metrics
+            else None,
+            "run_time_ms": self.run_time_ms,
+        }
+
+
+def _remap_response(yv: Vec, domain) -> np.ndarray:
+    if yv.domain == tuple(domain):
+        return yv.to_numpy()
+    lut = {d: i for i, d in enumerate(domain)}
+    remap = np.full(len(yv.domain or ()) + 1, -1, dtype=np.int32)
+    for j, d in enumerate(yv.domain or ()):
+        remap[j] = lut.get(d, -1)
+    codes = yv.to_numpy()
+    return np.where(codes >= 0, remap[np.clip(codes, 0, None)], -1)
+
+
+def _make_metrics(model: Model, raw: np.ndarray, y: np.ndarray, w) -> MM.ModelMetrics:
+    if not model.is_classifier:
+        return MM.regression_metrics(y, raw, w, model._distribution_for_metrics())
+    domain = model.output["response_domain"]
+    if raw.ndim == 1 or raw.shape[1] == 1:
+        raw = raw.reshape(-1)
+        return MM.binomial_metrics(y, raw, w, domain=domain)
+    if raw.shape[1] == 2:
+        return MM.binomial_metrics(y, raw[:, 1], w, domain=domain)
+    return MM.multinomial_metrics(y.astype(np.int64), raw, w, domain=domain)
+
+
+class ModelBuilder:
+    """Base builder. Subclasses set ``algo`` / ``PARAMS_CLS`` and implement
+    ``_build(job, train, valid) -> Model``."""
+
+    algo = "base"
+    PARAMS_CLS = CommonParams
+    SUPPORTS_CLASSIFICATION = True
+    SUPPORTS_REGRESSION = True
+
+    def __init__(self, **kwargs):
+        import dataclasses
+
+        valid_names = {f.name for f in dataclasses.fields(self.PARAMS_CLS)}
+        unknown = set(kwargs) - valid_names
+        if unknown:
+            raise ValueError(f"{self.algo}: unknown parameter(s) {sorted(unknown)}")
+        self.params = self.PARAMS_CLS(**kwargs)
+        self.model: Model | None = None
+        self._x: list[str] = []
+
+    # -- feature selection (ignored_columns / x handling) --------------------
+    def _features(self, frame: Frame, y: str | None) -> list[str]:
+        drop = set(self.params.ignored_columns or ())
+        if y:
+            drop.add(y)
+        for extra in (self.params.weights_column, self.params.offset_column, getattr(self.params, "fold_column", None)):
+            if extra:
+                drop.add(extra)
+        feats = [n for n in frame.names if n not in drop and frame.vec(n).kind != "string"]
+        return feats
+
+    def train(
+        self,
+        x: Sequence[str] | None = None,
+        y: str | None = None,
+        training_frame: Frame | None = None,
+        validation_frame: Frame | None = None,
+        **kwargs,
+    ) -> Model:
+        p = self.params
+        if training_frame is not None:
+            p.training_frame = training_frame
+        if validation_frame is not None:
+            p.validation_frame = validation_frame
+        if y is not None:
+            p.response_column = y
+        train = _resolve_frame(p.training_frame)
+        valid = _resolve_frame(p.validation_frame) if p.validation_frame is not None else None
+        assert train is not None, "training_frame is required"
+        if x is not None:
+            self._x = [train.names[c] if isinstance(c, int) else str(c) for c in x]
+        else:
+            self._x = self._features(train, p.response_column)
+
+        job = Job(lambda j: self._drive(j, train, valid), f"{self.algo} build")
+        job.run_sync()
+        return self.model
+
+    # -- the Job body --------------------------------------------------------
+    def _drive(self, job: Job, train: Frame, valid: Frame | None):
+        p = self.params
+        t = Timer()
+        self._validate(train, valid)
+        model = self._build(job, train, valid)
+        model.run_time_ms = int(t.time_ms())
+        self.model = model
+        # cross-validation driver (after main model, like modern H2O order)
+        if p.nfolds and p.nfolds > 1:
+            self._cross_validate(job, train)
+        Log.info(f"{self.algo} model {model.key} built in {t}")
+        return model
+
+    def _validate(self, train: Frame, valid: Frame | None) -> None:
+        p = self.params
+        if p.response_column is not None:
+            assert p.response_column in train, f"response {p.response_column!r} not in frame"
+            yv = train.vec(p.response_column)
+            if yv.is_categorical() and not self.SUPPORTS_CLASSIFICATION:
+                raise ValueError(f"{self.algo} does not support classification")
+            if not yv.is_categorical() and not self.SUPPORTS_REGRESSION and self.algo != "glm":
+                raise ValueError(f"{self.algo} does not support regression")
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        raise NotImplementedError
+
+    # -- CV driver (successor of ModelBuilder.computeCrossValidation) --------
+    def _cross_validate(self, job: Job, train: Frame) -> None:
+        p = self.params
+        n = train.nrow
+        nfolds = int(p.nfolds)
+        seed = p.seed if p.seed and p.seed > 0 else 12345
+        if getattr(p, "fold_column", None):
+            fold = train.vec(p.fold_column).to_numpy().astype(np.int64)
+            folds = sorted(set(fold.tolist()))
+        elif p.fold_assignment == "random":
+            rng = np.random.default_rng(seed)
+            fold = rng.integers(0, nfolds, size=n)
+            folds = list(range(nfolds))
+        else:  # modulo (default, deterministic like h2o AUTO for small data)
+            fold = np.arange(n) % nfolds
+            folds = list(range(nfolds))
+
+        main = self.model
+        holdout: np.ndarray | None = None
+        fold_metrics = []
+        for fi, f in enumerate(folds):
+            te_mask = fold == f
+            tr_fr = train.subset_rows(~te_mask)
+            te_fr = train.subset_rows(te_mask)
+            sub = type(self)(**_params_dict(p, drop_cv=True))
+            sub.params.response_column = p.response_column
+            m = sub.train(x=self._x, y=p.response_column, training_frame=tr_fr)
+            m_raw = np.asarray(m._predict_raw(te_fr))
+            if holdout is None:
+                holdout = np.zeros((n,) + m_raw.shape[1:], dtype=np.float64)
+            holdout[te_mask] = m_raw
+            y_te, w_te = m._response_and_weights(te_fr)
+            fold_metrics.append(_make_metrics(m, m_raw, y_te, w_te))
+            main.cv_models.append(m)
+            job.update(0.9 + 0.1 * (fi + 1) / len(folds))
+
+        y_all, w_all = main._response_and_weights(train)
+        main.cross_validation_metrics = _make_metrics(main, holdout, y_all, w_all)
+        if p.keep_cross_validation_predictions:
+            main.cv_predictions = holdout
+
+
+def _params_dict(p, drop_cv: bool) -> dict:
+    import dataclasses
+
+    d = {f.name: getattr(p, f.name) for f in dataclasses.fields(p)}
+    d.pop("training_frame", None)
+    d.pop("validation_frame", None)
+    if drop_cv:
+        d["nfolds"] = 0
+        d["keep_cross_validation_predictions"] = False
+    return d
+
+
+def _resolve_frame(fr) -> Frame | None:
+    if fr is None or isinstance(fr, Frame):
+        return fr
+    got = DKV.get(str(fr))
+    assert isinstance(got, Frame), f"no frame under key {fr!r}"
+    return got

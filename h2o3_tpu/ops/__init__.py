@@ -1,0 +1,3 @@
+"""Device kernels: Gram accumulation, histogram builds (XLA and Pallas
+paths), segment reductions. The hot-loop successors of ``hex.gram.Gram`` and
+``hex.tree.ScoreBuildHistogram`` [UNVERIFIED upstream paths]."""

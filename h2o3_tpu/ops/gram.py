@@ -1,0 +1,87 @@
+"""Weighted Gram accumulation — successor of ``hex.gram.Gram`` [UNVERIFIED
+upstream path, SURVEY.md §2.2].
+
+H2O accumulates X'WX with a per-chunk outer-product MRTask and a pairwise
+reduce, then Cholesky-solves on one node. Here the accumulation is a single
+einsum over the row-sharded design matrix: XLA tiles it onto the MXU and
+inserts the cross-chip ``psum`` automatically (the MRTask reduce). float32
+with HIGHEST precision keeps the normal equations accurate; the (p,p) solve
+happens host-side in float64 — same split as H2O (distributed accumulate,
+local solve).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.linalg
+
+_P = jax.lax.Precision.HIGHEST
+
+
+@jax.jit
+def weighted_gram(X, w, z):
+    """Return (G, b) = (XᵀWX, XᵀWz) for diagonal W, plus the weight sum."""
+    Xw = X * w[:, None]
+    G = jnp.einsum("np,nq->pq", Xw, X, precision=_P)
+    b = jnp.einsum("np,n->p", Xw, z, precision=_P)
+    return G, b, w.sum(dtype=jnp.float32)
+
+
+def solve_cholesky(G: np.ndarray, b: np.ndarray, ridge: float = 0.0) -> np.ndarray:
+    """Host-side SPD solve with jitter escalation (Gram.Cholesky successor)."""
+    G = np.asarray(G, np.float64)
+    b = np.asarray(b, np.float64)
+    p = G.shape[0]
+    jitter = 0.0
+    for _ in range(6):
+        try:
+            c, low = scipy.linalg.cho_factor(
+                G + (ridge + jitter) * np.eye(p), lower=True
+            )
+            return scipy.linalg.cho_solve((c, low), b)
+        except np.linalg.LinAlgError:
+            jitter = max(1e-10, jitter * 10 or 1e-10)
+    return np.linalg.lstsq(G + ridge * np.eye(p), b, rcond=None)[0]
+
+
+def admm_elastic_net(
+    G: np.ndarray,
+    b: np.ndarray,
+    l1: float,
+    l2: float,
+    intercept_idx: int | None,
+    rho: float | None = None,
+    iters: int = 500,
+    tol: float = 1e-6,
+    non_negative: bool = False,
+) -> np.ndarray:
+    """ADMM LASSO/elastic-net on the Gram — successor of
+    ``hex.optimization.ADMM`` [UNVERIFIED]: minimize ½βᵀGβ − bᵀβ + l2/2‖β‖² +
+    l1‖β‖₁ (intercept unpenalized)."""
+    G = np.asarray(G, np.float64)
+    b = np.asarray(b, np.float64)
+    p = G.shape[0]
+    if rho is None:
+        rho = max(1e-3, np.mean(np.diag(G)))
+    A = G + (l2 + rho) * np.eye(p)
+    c, low = scipy.linalg.cho_factor(A, lower=True)
+    x = np.zeros(p)
+    z = np.zeros(p)
+    u = np.zeros(p)
+    thr = np.full(p, l1 / rho)
+    if intercept_idx is not None:
+        thr[intercept_idx] = 0.0
+    for _ in range(iters):
+        x = scipy.linalg.cho_solve((c, low), b + rho * (z - u))
+        z_old = z
+        v = x + u
+        z = np.sign(v) * np.maximum(np.abs(v) - thr, 0.0)
+        if non_negative:
+            neg = np.arange(p) != (intercept_idx if intercept_idx is not None else -1)
+            z = np.where(neg & (z < 0), 0.0, z)
+        u = u + x - z
+        if np.max(np.abs(z - z_old)) < tol and np.max(np.abs(x - z)) < tol:
+            break
+    return z
