@@ -1,0 +1,81 @@
+"""North-star benchmark: GBM trees/sec on a Higgs-like binary task (BASELINE
+config #2, scaled to single-chip memory).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: h2o-3's CPU GBM builds ~0.5-1.5 trees/sec at depth 6-10 on 1M-row
+Higgs-class data on a multicore x86 node (external szilard/GBM-perf context,
+BASELINE.md — the reference repo publishes no numbers and the mount was
+empty). We use 1.0 trees/sec as the 1M-row single-node reference point;
+vs_baseline = measured/1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pandas as pd
+
+N_ROWS = 1_000_000
+N_COLS = 28  # Higgs feature count
+N_TREES = 20
+DEPTH = 6
+BASELINE_TREES_PER_SEC = 1.0
+
+
+def make_data(n=N_ROWS, c=N_COLS, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    eta = (
+        1.5 * X[:, 0]
+        - X[:, 1]
+        + 0.8 * X[:, 2] * X[:, 3]
+        + np.sin(2 * X[:, 4])
+        + 0.5 * X[:, 5] ** 2
+        - 1.0
+    )
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-eta))).astype(np.int32)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(c)])
+    df["label"] = np.where(y == 1, "s", "b")
+    return df
+
+
+def main() -> None:
+    import h2o3_tpu
+    from h2o3_tpu.models.tree import GBM
+
+    h2o3_tpu.init(log_level="WARN")
+    df = make_data()
+    fr = h2o3_tpu.upload_file(df)
+
+    kw = dict(
+        max_depth=DEPTH,
+        learn_rate=0.1,
+        min_rows=10.0,
+        score_tree_interval=1000,
+        seed=42,
+    )
+    # warmup: compile all level shapes
+    GBM(ntrees=2, **kw).train(y="label", training_frame=fr)
+
+    t0 = time.time()
+    m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
+    dt = time.time() - t0
+    tps = N_TREES / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}, AUC={m.training_metrics.auc:.4f})",
+                "value": round(tps, 3),
+                "unit": "trees/sec/chip",
+                "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,17 @@ def init(
     """
     global _started_at
     Log.set_level(log_level)
+    # Persistent XLA compilation cache (SURVEY.md §7: compile-latency
+    # amortization across the many small jit programs of AutoML/tree loops).
+    cache_dir = os.environ.get("H2O3_TPU_COMPILE_CACHE")
+    if cache_dir is None:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        cache_dir = os.path.join(pkg_root, ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # cache is an optimization, never fatal — but say so
+        Log.warn(f"compilation cache disabled: {e}")
     if coordinator is not None and not jax.distributed.is_initialized():
         # Must run before any backend use (jax.devices() etc.).
         jax.distributed.initialize(

@@ -5,4 +5,32 @@ expressed against the sharded Frame + map-reduce fabric only."""
 from h2o3_tpu.models.model_base import Model, ModelBuilder
 from h2o3_tpu.models.datainfo import DataInfo
 
-__all__ = ["Model", "ModelBuilder", "DataInfo"]
+
+_LAZY = {
+    "GLM": ("h2o3_tpu.models.glm", "GLM"),
+    "GBM": ("h2o3_tpu.models.tree.gbm", "GBM"),
+    "DRF": ("h2o3_tpu.models.tree.drf", "DRF"),
+    "XRT": ("h2o3_tpu.models.tree.drf", "XRT"),
+    "KMeans": ("h2o3_tpu.models.kmeans", "KMeans"),
+    "PCA": ("h2o3_tpu.models.pca", "PCA"),
+    "SVD": ("h2o3_tpu.models.pca", "SVD"),
+    "NaiveBayes": ("h2o3_tpu.models.naive_bayes", "NaiveBayes"),
+    "IsolationForest": ("h2o3_tpu.models.isolation_forest", "IsolationForest"),
+    "DeepLearning": ("h2o3_tpu.models.deeplearning", "DeepLearning"),
+}
+
+__all__ = ["Model", "ModelBuilder", "DataInfo", *_LAZY]
+
+
+def __getattr__(name):
+    # lazy algo imports so `import h2o3_tpu` stays light
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

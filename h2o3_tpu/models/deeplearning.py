@@ -1,0 +1,234 @@
+"""DeepLearning — successor of ``hex.deeplearning.DeepLearning`` /
+``DeepLearningModel`` / ``Neurons`` [UNVERIFIED upstream paths, SURVEY.md
+§2.2].
+
+H2O trains a fully-connected MLP with **Hogwild!** lock-free async SGD
+within a node plus periodic cross-node model averaging. The north star
+(BASELINE.json) explicitly licenses replacing that with synchronous
+data-parallel SGD: here each epoch is ONE compiled ``lax.scan`` over
+minibatches of the row-sharded design matrix — flax MLP forward/backward on
+the MXU, ADADELTA (h2o's adaptive_rate default) or momentum SGD from optax.
+Parameter parity: hidden/activation (+dropout variants), input_dropout,
+l1/l2, adaptive-rate rho/epsilon, rate/rate_decay, standardize, early
+stopping. Deviation noted: ``mini_batch_size`` defaults to 32 (h2o's
+online default of 1 serializes the MXU for no accuracy gain).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.datainfo import DataInfo
+from h2o3_tpu.models.model_base import (
+    CommonParams,
+    Model,
+    ModelBuilder,
+    ScoreKeeper,
+)
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class DeepLearningParams(CommonParams):
+    hidden: Sequence[int] = field(default_factory=lambda: (200, 200))
+    epochs: float = 10.0
+    activation: str = "Rectifier"
+    input_dropout_ratio: float = 0.0
+    hidden_dropout_ratios: Sequence[float] | None = None
+    l1: float = 0.0
+    l2: float = 0.0
+    adaptive_rate: bool = True
+    rho: float = 0.99
+    epsilon: float = 1e-8
+    rate: float = 0.005
+    rate_decay: float = 1.0
+    momentum_start: float = 0.0
+    mini_batch_size: int = 32
+    standardize: bool = True
+    loss: str = "Automatic"
+    reproducible: bool = True  # sync SGD is deterministic by construction
+
+
+class _MLP(nn.Module):
+    hidden: tuple
+    n_out: int
+    activation: str
+    dropout: tuple
+    input_dropout: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = {
+            "rectifier": nn.relu,
+            "rectifierwithdropout": nn.relu,
+            "tanh": nn.tanh,
+            "tanhwithdropout": nn.tanh,
+            "maxout": nn.relu,  # maxout approximated [deviation noted]
+        }[self.activation.lower()]
+        if self.input_dropout > 0:
+            x = nn.Dropout(self.input_dropout, deterministic=not train)(x)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h)(x)
+            x = act(x)
+            if self.dropout[i] > 0:
+                x = nn.Dropout(self.dropout[i], deterministic=not train)(x)
+        return nn.Dense(self.n_out)(x)
+
+
+class DeepLearningModel(Model):
+    algo = "deeplearning"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        di: DataInfo = self.output["datainfo"]
+        X, _ = di.transform(frame)
+        logits = self.output["apply_fn"](self.output["params"], X)
+        if self.is_classifier:
+            return np.asarray(jax.nn.softmax(logits, axis=1))[: frame.nrow]
+        return np.asarray(logits[:, 0])[: frame.nrow]
+
+
+class DeepLearning(ModelBuilder):
+    algo = "deeplearning"
+    PARAMS_CLS = DeepLearningParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: DeepLearningParams = self.params
+        yv = train.vec(p.response_column)
+        classification = yv.is_categorical()
+        K = yv.cardinality if classification else 1
+        n_out = max(K, 1) if classification else 1
+
+        di = DataInfo.fit(train, self._x, standardize=p.standardize)
+        X, wmask = di.transform(train)
+        w = wmask
+        if p.weights_column:
+            w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
+        y_np = yv.to_numpy().astype(np.float64)
+        ybuf = np.zeros(train.npad, np.float32)
+        ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
+        okresp = np.ones(train.npad, np.float32)
+        okresp[: train.nrow] = (
+            (y_np >= 0) if classification else ~np.isnan(y_np)
+        ).astype(np.float32)
+        w = jnp.asarray(np.asarray(w) * okresp)
+        y = jnp.asarray(ybuf)
+
+        dropout = tuple(
+            p.hidden_dropout_ratios
+            or ((0.5,) * len(p.hidden) if "dropout" in p.activation.lower() else (0.0,) * len(p.hidden))
+        )
+        mlp = _MLP(
+            hidden=tuple(int(h) for h in p.hidden),
+            n_out=n_out,
+            activation=p.activation,
+            dropout=dropout,
+            input_dropout=p.input_dropout_ratio,
+        )
+        seed = abs(p.seed) if p.seed and p.seed > 0 else 99
+        key = jax.random.PRNGKey(seed)
+        key, init_key = jax.random.split(key)
+        params = mlp.init(init_key, jnp.zeros((1, di.ncols_expanded)), train=False)
+
+        if p.adaptive_rate:
+            tx = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
+        else:
+            tx = optax.sgd(
+                optax.exponential_decay(p.rate, 1000, p.rate_decay),
+                momentum=p.momentum_start or None,
+            )
+        opt_state = tx.init(params)
+
+        batch = int(p.mini_batch_size)
+        npad = train.npad
+        nbatch = max(1, train.nrow // batch)
+
+        l1, l2 = float(p.l1), float(p.l2)
+        use_ce = classification
+
+        @jax.jit
+        def epoch(params, opt_state, Xp, yp, wp, dkey):
+            def loss_fn(prm, xb, yb, wb, kb):
+                logits = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
+                if use_ce:
+                    ll = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, yb.astype(jnp.int32)
+                    )
+                else:
+                    ll = (logits[:, 0] - yb) ** 2
+                loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
+                if l2:
+                    loss += l2 * 0.5 * sum(
+                        jnp.sum(q**2) for q in jax.tree.leaves(prm)
+                    )
+                if l1:
+                    loss += l1 * sum(
+                        jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm)
+                    )
+                return loss
+
+            def step(carry, i):
+                prm, ost, k = carry
+                k, bk = jax.random.split(k)
+                start = i * batch
+                xb = jax.lax.dynamic_slice(Xp, (start, 0), (batch, Xp.shape[1]))
+                yb = jax.lax.dynamic_slice(yp, (start,), (batch,))
+                wb = jax.lax.dynamic_slice(wp, (start,), (batch,))
+                loss, g = jax.value_and_grad(loss_fn)(prm, xb, yb, wb, bk)
+                upd, ost = tx.update(g, ost, prm)
+                prm = optax.apply_updates(prm, upd)
+                return (prm, ost, k), loss
+
+            (params, opt_state, _), losses = jax.lax.scan(
+                step, (params, opt_state, dkey), jnp.arange(nbatch)
+            )
+            return params, opt_state, losses.mean()
+
+        apply_fn = jax.jit(lambda prm, xx: mlp.apply(prm, xx, train=False))
+
+        # epoch-level stopping tracks the (always smaller-is-better) training
+        # loss; the resolved stopping_metric drives final scoring only
+        keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, False)
+        rng = np.random.default_rng(seed)
+        history = []
+        n_epochs = max(1, int(np.ceil(p.epochs)))
+        for e in range(n_epochs):
+            perm = np.zeros(npad, np.int64)
+            perm[: train.nrow] = rng.permutation(train.nrow)
+            perm_j = jnp.asarray(perm)
+            Xp = X[perm_j]
+            yp = y[perm_j]
+            wp = w[perm_j]
+            key, dkey = jax.random.split(key)
+            params, opt_state, mean_loss = epoch(params, opt_state, Xp, yp, wp, dkey)
+            history.append({"epoch": e + 1, "loss": float(mean_loss)})
+            keeper.record(float(mean_loss))
+            job.update(0.05 + 0.9 * (e + 1) / n_epochs)
+            if keeper.should_stop() or job.stop_requested:
+                Log.info(f"DeepLearning early stop at epoch {e + 1}")
+                break
+
+        out = {
+            "datainfo": di,
+            "params": params,
+            "apply_fn": apply_fn,
+            "names": list(self._x),
+            "hidden": list(p.hidden),
+            "response_domain": tuple(yv.domain) if classification else None,
+        }
+        model = DeepLearningModel(DKV.make_key("dl"), p, out)
+        model.scoring_history = history
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

@@ -1,0 +1,182 @@
+"""Isolation Forest — successor of ``hex.tree.isofor.IsolationForest``
+[UNVERIFIED upstream path, SURVEY.md §2.2].
+
+Trees are grown on tiny row subsamples (default 256) with uniform-random
+(feature, threshold) splits — that construction is inherently host-scale, so
+it runs in numpy; SCORING the full frame (the actual data-scale work: path
+lengths of every row through every tree) is a vectorized device walk over
+stacked per-level split arrays, the BigScore analog.
+
+Score = 2^(−E[h(x)]/c(n)) with the standard c(n) normalizer; output matches
+h2o's (predict=anomaly score, mean_length).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.models.metrics import ModelMetrics
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+
+@dataclass
+class IsolationForestParams(CommonParams):
+    ntrees: int = 50
+    sample_size: int = 256
+    max_depth: int = 8
+    mtries: int = -1
+
+
+def _c(n: float) -> float:
+    if n <= 1:
+        return 0.0
+    return 2.0 * (np.log(n - 1) + 0.5772156649) - 2.0 * (n - 1) / n
+
+
+@partial(jax.jit, static_argnames=("n_levels",))
+def _path_lengths(X, feat, thr, leaf_len, n_levels: int):
+    """Walk all rows through one tree's stacked level arrays.
+
+    feat/thr: (n_levels, max_nodes); leaf nodes have feat = -1 and
+    leaf_len the partial path length at that node.
+    """
+    n = X.shape[0]
+    nid = jnp.zeros(n, jnp.int32)
+    done = jnp.zeros(n, bool)
+    length = jnp.zeros(n, jnp.float32)
+
+    def body(d, carry):
+        nid, done, length = carry
+        f = feat[d][nid]
+        t = thr[d][nid]
+        ll = leaf_len[d][nid]
+        is_leaf = f < 0
+        newly = is_leaf & ~done
+        length = jnp.where(newly, ll, length)
+        done = done | is_leaf
+        x = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1).squeeze(1)
+        go_left = jnp.where(jnp.isnan(x), True, x < t)
+        nid = jnp.where(done, nid, 2 * nid + jnp.where(go_left, 0, 1))
+        return nid, done, length
+
+    nid, done, length = jax.lax.fori_loop(0, n_levels, body, (nid, done, length))
+    return length
+
+
+class IsolationForestModel(Model):
+    algo = "isolationforest"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X = _feature_matrix(frame, self.output["names"])
+        total = jnp.zeros(X.shape[0], jnp.float32)
+        for feat, thr, ll in self.output["trees"]:
+            total = total + _path_lengths(
+                X, jnp.asarray(feat), jnp.asarray(thr), jnp.asarray(ll), feat.shape[0]
+            )
+        mean_len = np.asarray(total)[: frame.nrow] / len(self.output["trees"])
+        cn = _c(self.params.sample_size)
+        score = np.power(2.0, -mean_len / max(cn, 1e-9))
+        return np.stack([score, mean_len], axis=1)
+
+    def predict(self, frame: Frame) -> Frame:
+        s = self._predict_raw(frame)
+        return Frame(
+            [Vec.from_numpy(s[:, 0], "real"), Vec.from_numpy(s[:, 1], "real")],
+            ["predict", "mean_length"],
+        )
+
+
+def _feature_matrix(frame: Frame, names) -> "jnp.ndarray":
+    cols = []
+    for n in names:
+        v = frame.vec(n)
+        cols.append(
+            v.data.astype(jnp.float32) if v.is_categorical() else v.data
+        )
+    return jnp.stack(cols, axis=1)
+
+
+class IsolationForest(ModelBuilder):
+    algo = "isolationforest"
+    PARAMS_CLS = IsolationForestParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def train(self, x=None, training_frame=None, **kw):
+        return super().train(x=x, y=None, training_frame=training_frame, **kw)
+
+    def _validate(self, train, valid):
+        pass
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: IsolationForestParams = self.params
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 1)
+        names = self._x
+        Xn = np.asarray(_feature_matrix(train, names))[: train.nrow]
+        n, C = Xn.shape
+        sample = min(p.sample_size, n)
+        depth = min(p.max_depth, max(1, int(np.ceil(np.log2(max(sample, 2))))))
+        mtries = C if p.mtries in (-1, 0) else min(p.mtries, C)
+
+        trees = []
+        for m in range(p.ntrees):
+            idx = rng.choice(n, sample, replace=False)
+            trees.append(self._grow(Xn[idx], depth, rng, mtries))
+            job.update(0.9 * (m + 1) / p.ntrees)
+
+        out = {"trees": trees, "names": list(names), "response_domain": None}
+        model = IsolationForestModel(DKV.make_key("isofor"), p, out)
+        raw = model._predict_raw(train)
+        model.training_metrics = ModelMetrics(
+            "anomaly",
+            {
+                "mean_score": float(raw[:, 0].mean()),
+                "mean_length": float(raw[:, 1].mean()),
+            },
+        )
+        return model
+
+    def _grow(self, S: np.ndarray, depth: int, rng, mtries: int):
+        """Grow one random tree on sample S; emit stacked level arrays in
+        full binary indexing (small: 2^depth ≤ 256 nodes)."""
+        n_levels = depth + 1
+        max_nodes = 1 << depth
+        feat = np.full((n_levels, max_nodes), -1, np.int32)
+        thr = np.zeros((n_levels, max_nodes), np.float32)
+        leaf_len = np.zeros((n_levels, max_nodes), np.float32)
+        C = S.shape[1]
+
+        node_rows: dict[tuple[int, int], np.ndarray] = {(0, 0): np.arange(len(S))}
+        for d in range(n_levels):
+            next_rows = {}
+            for (dd, i), rows in list(node_rows.items()):
+                if dd != d:
+                    continue
+                sub = S[rows]
+                uniq_ok = False
+                if d < depth and len(rows) > 1:
+                    cand = rng.choice(C, size=min(mtries, C), replace=False)
+                    for f in cand:
+                        col = sub[:, f]
+                        col = col[~np.isnan(col)]
+                        if len(col) and col.min() < col.max():
+                            t = rng.uniform(col.min(), col.max())
+                            feat[d, i] = f
+                            thr[d, i] = t
+                            go = np.where(np.isnan(sub[:, f]), True, sub[:, f] < t)
+                            next_rows[(d + 1, 2 * i)] = rows[go]
+                            next_rows[(d + 1, 2 * i + 1)] = rows[~go]
+                            uniq_ok = True
+                            break
+                if not uniq_ok:
+                    feat[d, i] = -1
+                    leaf_len[d, i] = d + _c(float(len(rows)))
+            node_rows.update(next_rows)
+        return feat, thr, leaf_len

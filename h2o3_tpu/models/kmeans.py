@@ -1,0 +1,173 @@
+"""KMeans — successor of ``hex.kmeans.KMeans`` (Lloyd + k-means‖ init,
+constrained variant excluded) [UNVERIFIED upstream path, SURVEY.md §2.2].
+
+Each Lloyd iteration is one fused device program over the row-sharded design
+matrix: distance matrix (n,k) on the MXU, hard assignment, centroid partial
+sums via one-hot matmul (no scatter), psum across the mesh implicit in the
+sharded einsum. H2O's per-iteration MRTask maps exactly onto this.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.models.datainfo import DataInfo
+from h2o3_tpu.models.metrics import ModelMetrics
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+@dataclass
+class KMeansParams(CommonParams):
+    k: int = 2
+    max_iterations: int = 10
+    init: str = "Furthest"  # Furthest | PlusPlus | Random
+    standardize: bool = True
+    estimate_k: bool = False
+
+
+@partial(jax.jit, static_argnames=())
+def _lloyd_step(X, w, centers):
+    """One Lloyd iteration: assignment + weighted centroid sums + SSE."""
+    d2 = (
+        jnp.sum(X * X, axis=1, keepdims=True)
+        - 2.0 * jnp.einsum("np,kp->nk", X, centers, precision=_HI)
+        + jnp.sum(centers * centers, axis=1)[None, :]
+    )
+    assign = jnp.argmin(d2, axis=1)
+    mind2 = jnp.maximum(jnp.min(d2, axis=1), 0.0)
+    oh = (assign[:, None] == jnp.arange(centers.shape[0])[None, :]).astype(
+        jnp.float32
+    ) * w[:, None]
+    sums = jnp.einsum("nk,np->kp", oh, X, precision=_HI)
+    counts = oh.sum(axis=0)
+    sse = jnp.sum(w * mind2)
+    within = jnp.einsum("nk,n->k", oh, mind2, precision=_HI)
+    return assign, sums, counts, sse, within
+
+
+class KMeansModel(Model):
+    algo = "kmeans"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        di: DataInfo = self.output["datainfo"]
+        X, valid = di.transform(frame)
+        centers = jnp.asarray(self.output["centers_std"], jnp.float32)
+        assign, *_ = _lloyd_step(X, valid, centers)
+        return np.asarray(assign)[: frame.nrow]
+
+    def predict(self, frame: Frame) -> Frame:
+        assign = self._predict_raw(frame)
+        return Frame([Vec.from_numpy(assign.astype(np.float64), "int")], ["predict"])
+
+    @property
+    def centers(self) -> np.ndarray:
+        return self.output["centers"]
+
+
+class KMeans(ModelBuilder):
+    algo = "kmeans"
+    PARAMS_CLS = KMeansParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def train(self, x=None, training_frame=None, **kw):
+        return super().train(x=x, y=None, training_frame=training_frame, **kw)
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: KMeansParams = self.params
+        di = DataInfo.fit(train, self._x, standardize=p.standardize)
+        X, w = di.transform(train)
+        k = int(p.k)
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 1)
+
+        Xn = np.asarray(X)
+        wn = np.asarray(w)
+        rows = np.flatnonzero(wn > 0)
+        centers = self._init_centers(Xn, rows, k, p.init, rng)
+
+        sse_prev = np.inf
+        centers_j = jnp.asarray(centers, jnp.float32)
+        for it in range(max(1, p.max_iterations)):
+            assign, sums, counts, sse, within = _lloyd_step(X, w, centers_j)
+            counts_n = np.asarray(counts)
+            sums_n = np.asarray(sums)
+            new_centers = np.where(
+                counts_n[:, None] > 0, sums_n / np.maximum(counts_n[:, None], 1e-30),
+                np.asarray(centers_j),
+            )
+            # dead cluster re-seed (h2o re-initializes empty clusters)
+            for ki in np.flatnonzero(counts_n == 0):
+                new_centers[ki] = Xn[rng.choice(rows)]
+            centers_j = jnp.asarray(new_centers, jnp.float32)
+            sse_now = float(sse)
+            job.update(0.1 + 0.8 * (it + 1) / p.max_iterations)
+            if abs(sse_prev - sse_now) / max(sse_now, 1e-30) < 1e-6:
+                break
+            sse_prev = sse_now
+
+        assign, sums, counts, sse, within = _lloyd_step(X, w, centers_j)
+        centers_std = np.asarray(centers_j)
+        # destandardize for reporting
+        centers_orig = centers_std.copy()
+        col_i = 0
+        for c in di.columns:
+            if c.kind == "num":
+                centers_orig[:, c.offset] = centers_std[:, c.offset] * c.sigma + c.mean
+        tot_within = float(jnp.sum(within))
+        gm = np.average(centers_std, axis=0, weights=np.maximum(np.asarray(counts), 1e-9))
+        between = float(
+            np.sum(np.asarray(counts) * np.sum((centers_std - gm) ** 2, axis=1))
+        )
+
+        out = {
+            "datainfo": di,
+            "centers_std": centers_std,
+            "centers": centers_orig,
+            "names": list(self._x),
+            "k": k,
+            "size": np.asarray(counts).tolist(),
+            "response_domain": None,
+        }
+        model = KMeansModel(DKV.make_key("kmeans"), p, out)
+        model.training_metrics = ModelMetrics(
+            "clustering",
+            {
+                "tot_withinss": tot_within,
+                "betweenss": between,
+                "totss": tot_within + between,
+                "within_cluster_sum_of_squares": np.asarray(within).tolist(),
+                "cluster_sizes": np.asarray(counts).tolist(),
+            },
+        )
+        return model
+
+    def _init_centers(self, Xn, rows, k, method, rng) -> np.ndarray:
+        method = (method or "Furthest").lower()
+        first = Xn[rng.choice(rows)]
+        centers = [first]
+        if method == "random":
+            return Xn[rng.choice(rows, size=k, replace=False)]
+        # Furthest (h2o default) and PlusPlus share the distance recursion
+        sample = Xn[rows] if len(rows) <= 100_000 else Xn[rng.choice(rows, 100_000, replace=False)]
+        d2 = np.sum((sample - first) ** 2, axis=1)
+        for _ in range(1, k):
+            if method == "plusplus":
+                probs = d2 / max(d2.sum(), 1e-30)
+                nxt = sample[rng.choice(len(sample), p=probs)]
+            else:
+                nxt = sample[int(np.argmax(d2))]
+            centers.append(nxt)
+            d2 = np.minimum(d2, np.sum((sample - nxt) ** 2, axis=1))
+        return np.stack(centers)
+
+    def _validate(self, train, valid):
+        pass

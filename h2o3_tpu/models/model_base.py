@@ -77,7 +77,11 @@ def stopping_metric_direction(metric: str, classification: bool, nclasses: int) 
     """Resolve AUTO and return (metric_name, larger_is_better)."""
     m = metric.lower()
     if m == "auto":
-        m = ("logloss" if classification else "deviance")
+        # AUTO: logloss for classification, deviance for regression (h2o);
+        # rmse orders identically to gaussian deviance and is always present
+        m = "logloss" if classification else "rmse"
+    elif m == "deviance":
+        m = "logloss" if classification else "mean_residual_deviance"
     larger = m in ("auc", "pr_auc", "accuracy", "f1", "r2", "lift_top_group")
     return m, larger
 

@@ -1,0 +1,121 @@
+"""Naive Bayes — successor of ``hex.naivebayes.NaiveBayes`` [UNVERIFIED
+upstream path, SURVEY.md §2.2].
+
+Sufficient statistics (per-class priors, per-class numeric mean/var,
+per-class categorical level counts) are one fused device pass: class one-hot
+matmuls against the design columns — the NB MRTask recast as MXU work.
+Laplace smoothing and min_sdev/eps handling follow the h2o parameters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import CAT, Frame
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+@dataclass
+class NaiveBayesParams(CommonParams):
+    laplace: float = 0.0
+    min_sdev: float = 0.001
+    eps_sdev: float = 0.0
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        out = self.output
+        n = frame.nrow
+        K = self.nclasses
+        logp = np.tile(np.log(out["priors"])[None, :], (n, 1))
+        for name, stats in out["num_stats"].items():
+            x = frame.vec(name).to_numpy().astype(np.float64)
+            mu, sd = stats["mean"], stats["sdev"]  # (K,)
+            ok = ~np.isnan(x)
+            ll = -0.5 * ((x[:, None] - mu[None, :]) / sd[None, :]) ** 2 - np.log(
+                sd[None, :] * np.sqrt(2 * np.pi)
+            )
+            logp += np.where(ok[:, None], ll, 0.0)
+        for name, tab in out["cat_stats"].items():
+            v = frame.vec(name)
+            from h2o3_tpu.models.datainfo import _adapt_codes
+
+            codes = np.asarray(_adapt_codes(v, tab["domain"]))[:n]
+            probs = tab["cond"]  # (levels, K)
+            ok = codes >= 0
+            ll = np.log(np.maximum(probs[np.clip(codes, 0, None)], 1e-30))
+            logp += np.where(ok[:, None], ll, 0.0)
+        logp -= logp.max(axis=1, keepdims=True)
+        P = np.exp(logp)
+        return P / P.sum(axis=1, keepdims=True)
+
+
+class NaiveBayes(ModelBuilder):
+    algo = "naivebayes"
+    PARAMS_CLS = NaiveBayesParams
+    SUPPORTS_REGRESSION = False
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: NaiveBayesParams = self.params
+        yv = train.vec(p.response_column)
+        assert yv.is_categorical(), "naivebayes requires an enum response"
+        K = yv.cardinality
+        npad = train.npad
+
+        y = yv.data
+        w = train.row_mask() * (y >= 0)
+        Y1h = ((y[:, None] == jnp.arange(K)[None, :]) * w[:, None]).astype(jnp.float32)
+        class_w = np.asarray(Y1h.sum(axis=0), np.float64)  # (K,)
+        priors = class_w / class_w.sum()
+
+        num_stats, cat_stats = {}, {}
+        for name in self._x:
+            v = train.vec(name)
+            if v.is_categorical():
+                L = v.cardinality
+                codes = v.data
+                oh = ((codes[:, None] == jnp.arange(L)[None, :])).astype(jnp.float32)
+                counts = np.asarray(
+                    jnp.einsum("nl,nk->lk", oh, Y1h, precision=_HI), np.float64
+                )
+                cond = (counts + p.laplace) / (
+                    class_w[None, :] + p.laplace * L
+                )
+                cat_stats[name] = {"domain": v.domain, "counts": counts, "cond": cond}
+            else:
+                x = jnp.nan_to_num(v.data)
+                ok = (~jnp.isnan(v.data)).astype(jnp.float32)
+                Wk = np.asarray(jnp.einsum("n,nk->k", ok, Y1h, precision=_HI), np.float64)
+                Sk = np.asarray(jnp.einsum("n,nk->k", x * ok, Y1h, precision=_HI), np.float64)
+                S2k = np.asarray(
+                    jnp.einsum("n,nk->k", x * x * ok, Y1h, precision=_HI), np.float64
+                )
+                mu = Sk / np.maximum(Wk, 1e-30)
+                var = S2k / np.maximum(Wk, 1e-30) - mu**2
+                sd = np.sqrt(np.maximum(var * Wk / np.maximum(Wk - 1, 1.0), 0.0))
+                sd = np.maximum(sd, p.min_sdev) + p.eps_sdev
+                num_stats[name] = {"mean": mu, "sdev": sd}
+            job.update(0.9 * (len(num_stats) + len(cat_stats)) / len(self._x))
+
+        out = {
+            "priors": priors,
+            "num_stats": num_stats,
+            "cat_stats": cat_stats,
+            "names": list(self._x),
+            "response_domain": tuple(yv.domain),
+        }
+        model = NaiveBayesModel(DKV.make_key("naivebayes"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

@@ -1,0 +1,182 @@
+"""PCA / SVD — successors of ``hex.pca.PCA`` and ``hex.svd.SVD`` [UNVERIFIED
+upstream paths, SURVEY.md §2.2].
+
+PCA (GramSVD method, h2o's default): one distributed Gram pass XᵀX on the
+MXU (the ``hex.gram.Gram`` MRTask successor), then a host-side (p,p) eigen
+decomposition — identical compute split to H2O (distributed accumulate,
+local solve). SVD offers the randomized power-iteration method for tall
+matrices (h2o's "Randomized" svd_method), all device matmuls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.models.datainfo import DataInfo
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+@dataclass
+class PCAParams(CommonParams):
+    k: int = 1
+    transform: str = "STANDARDIZE"  # NONE | DEMEAN | DESCALE | STANDARDIZE
+    pca_method: str = "GramSVD"
+    use_all_factor_levels: bool = False
+
+
+class PCAModel(Model):
+    algo = "pca"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        di: DataInfo = self.output["datainfo"]
+        X, _ = di.transform(frame)
+        V = jnp.asarray(self.output["eigenvectors"], jnp.float32)
+        scores = jnp.einsum("np,pk->nk", X, V, precision=_HI)
+        return np.asarray(scores)[: frame.nrow]
+
+    def predict(self, frame: Frame) -> Frame:
+        s = self._predict_raw(frame)
+        vecs = [Vec.from_numpy(s[:, i], "real") for i in range(s.shape[1])]
+        return Frame(vecs, [f"PC{i + 1}" for i in range(s.shape[1])])
+
+
+class PCA(ModelBuilder):
+    algo = "pca"
+    PARAMS_CLS = PCAParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def train(self, x=None, training_frame=None, **kw):
+        return super().train(x=x, y=None, training_frame=training_frame, **kw)
+
+    def _validate(self, train, valid):
+        pass
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: PCAParams = self.params
+        t = p.transform.upper()
+        di = DataInfo.fit(
+            train,
+            self._x,
+            standardize=(t == "STANDARDIZE"),
+            use_all_factor_levels=p.use_all_factor_levels,
+        )
+        if t in ("NONE", "DESCALE"):
+            for c in di.columns:
+                if c.kind == "num":
+                    c.mean = 0.0
+        if t == "DESCALE":
+            di.standardize = True
+        X, w = di.transform(train)
+        nobs = float(np.asarray(w.sum()))
+
+        G = np.asarray(
+            jnp.einsum("np,nq->pq", X, X, precision=_HI), np.float64
+        )
+        if t in ("DEMEAN", "STANDARDIZE"):
+            pass  # columns already centered by DataInfo
+        eigvals, eigvecs = np.linalg.eigh(G / max(nobs - 1, 1.0))
+        order = np.argsort(-eigvals)
+        eigvals = np.maximum(eigvals[order], 0.0)
+        eigvecs = eigvecs[:, order]
+        k = min(int(p.k), len(eigvals))
+
+        std_dev = np.sqrt(eigvals[:k])
+        prop = eigvals[:k] / max(eigvals.sum(), 1e-30)
+        out = {
+            "datainfo": di,
+            "eigenvectors": eigvecs[:, :k],
+            "eigenvalues": eigvals[:k],
+            "std_deviation": std_dev,
+            "proportion_of_variance": prop,
+            "cumulative_proportion": np.cumsum(prop),
+            "coef_names": di.coef_names(),
+            "names": list(self._x),
+            "response_domain": None,
+        }
+        model = PCAModel(DKV.make_key("pca"), p, out)
+        from h2o3_tpu.models.metrics import ModelMetrics
+
+        model.training_metrics = ModelMetrics(
+            "pca", {"std_deviation": std_dev.tolist(), "k": k}
+        )
+        return model
+
+
+@dataclass
+class SVDParams(CommonParams):
+    nv: int = 1
+    transform: str = "NONE"
+    svd_method: str = "Randomized"  # GramSVD | Power | Randomized
+    max_iterations: int = 4
+
+
+class SVDModel(Model):
+    algo = "svd"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        di: DataInfo = self.output["datainfo"]
+        X, _ = di.transform(frame)
+        V = jnp.asarray(self.output["v"], jnp.float32)
+        return np.asarray(jnp.einsum("np,pk->nk", X, V, precision=_HI))[: frame.nrow]
+
+
+class SVD(ModelBuilder):
+    algo = "svd"
+    PARAMS_CLS = SVDParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def train(self, x=None, training_frame=None, **kw):
+        return super().train(x=x, y=None, training_frame=training_frame, **kw)
+
+    def _validate(self, train, valid):
+        pass
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: SVDParams = self.params
+        di = DataInfo.fit(
+            train, self._x, standardize=(p.transform.upper() == "STANDARDIZE")
+        )
+        X, w = di.transform(train)
+        P = X.shape[1]
+        nv = min(int(p.nv), P)
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 1)
+
+        if p.svd_method.lower() == "gramsvd" or P <= 64:
+            G = np.asarray(jnp.einsum("np,nq->pq", X, X, precision=_HI), np.float64)
+            evals, evecs = np.linalg.eigh(G)
+            order = np.argsort(-evals)
+            V = evecs[:, order[:nv]]
+            d = np.sqrt(np.maximum(evals[order[:nv]], 0.0))
+        else:
+            # randomized subspace iteration: all heavy matmuls on device
+            Q = jnp.asarray(rng.normal(size=(P, nv + 4)).astype(np.float32))
+            for _ in range(max(1, p.max_iterations)):
+                Y = jnp.einsum("np,pk->nk", X, Q, precision=_HI)
+                Z = jnp.einsum("np,nk->pk", X, Y, precision=_HI)
+                Q, _ = jnp.linalg.qr(Z)
+            B = np.asarray(jnp.einsum("np,pk->nk", X, Q, precision=_HI))
+            _, s, Vt = np.linalg.svd(B, full_matrices=False)
+            V = (np.asarray(Q) @ Vt.T)[:, :nv]
+            d = s[:nv]
+
+        out = {
+            "datainfo": di,
+            "v": V,
+            "d": d,
+            "names": list(self._x),
+            "response_domain": None,
+        }
+        model = SVDModel(DKV.make_key("svd"), p, out)
+        from h2o3_tpu.models.metrics import ModelMetrics
+
+        model.training_metrics = ModelMetrics("svd", {"d": d.tolist()})
+        return model

@@ -1,0 +1,4 @@
+from h2o3_tpu.models.tree.gbm import GBM
+from h2o3_tpu.models.tree.drf import DRF
+
+__all__ = ["GBM", "DRF"]

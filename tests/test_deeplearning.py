@@ -1,0 +1,67 @@
+"""DeepLearning tests — upstream ``hex/deeplearning`` scenario style
+[UNVERIFIED upstream path]; sync-SGD successor of the Hogwild trainer."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.deeplearning import DeepLearning
+
+
+def test_dl_classification_learns_xor():
+    rng = np.random.default_rng(0)
+    n = 4000
+    X = rng.uniform(-1, 1, size=(n, 2))
+    y = (X[:, 0] * X[:, 1] > 0).astype(int)
+    df = pd.DataFrame(X, columns=["a", "b"])
+    df["y"] = np.where(y == 1, "pos", "neg")
+    fr = Frame.from_pandas(df)
+    m = DeepLearning(
+        hidden=(32, 32), epochs=60, mini_batch_size=256, seed=1
+    ).train(y="y", training_frame=fr)
+    assert m.training_metrics.auc > 0.95  # XOR is not linearly separable
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "neg", "pos"]
+
+
+def test_dl_regression():
+    rng = np.random.default_rng(1)
+    n = 3000
+    X = rng.normal(size=(n, 3))
+    y = X[:, 0] ** 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    df = pd.DataFrame(X, columns=list("abc"))
+    df["y"] = y
+    fr = Frame.from_pandas(df)
+    m = DeepLearning(hidden=(64, 64), epochs=40, mini_batch_size=256, seed=2).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.r2 > 0.8
+
+
+def test_dl_reproducible():
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame(
+        {"a": rng.normal(size=500), "y": rng.normal(size=500)}
+    )
+    fr = Frame.from_pandas(df)
+    kw = dict(hidden=(8,), epochs=3, mini_batch_size=64, seed=7)
+    m1 = DeepLearning(**kw).train(y="y", training_frame=fr)
+    m2 = DeepLearning(**kw).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(
+        m1._predict_raw(fr), m2._predict_raw(fr), rtol=1e-6
+    )
+
+
+def test_dl_multiclass_and_l2():
+    rng = np.random.default_rng(3)
+    n = 3000
+    X = rng.normal(size=(n, 2))
+    y = (np.arctan2(X[:, 1], X[:, 0]) // (2 * np.pi / 3 + 1e-9) + 1).astype(int)
+    df = pd.DataFrame(X, columns=["a", "b"])
+    df["y"] = np.array(["c0", "c1", "c2"])[np.clip(y, 0, 2)]
+    fr = Frame.from_pandas(df)
+    m = DeepLearning(hidden=(32,), epochs=30, mini_batch_size=256, l2=1e-5, seed=4).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.classification_error < 0.2

@@ -1,0 +1,253 @@
+"""GBM/DRF tests — modeled on upstream ``hex/tree/gbm/GBMTest.java`` scenario
+style [UNVERIFIED upstream path]: accuracy pinned against sklearn references,
+structural invariants on the recorded trees."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.tree import DRF, GBM
+
+
+def _friedman(n=3000, seed=0, noise=0.3):
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, 5))
+    y = (
+        10 * np.sin(np.pi * X[:, 0] * X[:, 1])
+        + 20 * (X[:, 2] - 0.5) ** 2
+        + 10 * X[:, 3]
+        + 5 * X[:, 4]
+        + noise * rng.normal(size=n)
+    )
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(5)])
+    df["y"] = y
+    return df
+
+
+def _binary_df(n=4000, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    eta = X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = np.where(y == 1, "Y", "N")
+    return df, y
+
+
+def test_gbm_stump_finds_optimal_split():
+    # single depth-1 tree on perfectly separable step data
+    x = np.linspace(0, 1, 1000)
+    y = np.where(x < 0.5, 1.0, 3.0)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y}))
+    m = GBM(ntrees=1, max_depth=1, learn_rate=1.0, min_rows=1.0).train(
+        y="y", training_frame=fr
+    )
+    pred = m.predict(fr).vec("predict").to_numpy()
+    # histogram trees can be off by one bin (~n/nbins rows) at the boundary
+    assert np.mean(np.abs(pred - y) > 0.5) < 0.03  # rows on the wrong side
+    assert pred[:450] == pytest.approx(1.0, abs=0.05)
+    assert pred[550:] == pytest.approx(3.0, abs=0.05)
+
+
+def test_gbm_regression_beats_baseline_and_tracks_sklearn():
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    df = _friedman()
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=30, max_depth=4, learn_rate=0.2, min_rows=5.0, score_tree_interval=100).train(
+        y="y", training_frame=fr
+    )
+    r2 = m.training_metrics.r2
+    sk = GradientBoostingRegressor(
+        n_estimators=30, max_depth=4, learning_rate=0.2
+    ).fit(df.drop(columns="y"), df["y"])
+    from sklearn.metrics import r2_score
+
+    sk_r2 = r2_score(df["y"], sk.predict(df.drop(columns="y")))
+    assert r2 > 0.9
+    assert r2 > sk_r2 - 0.05  # within striking distance of sklearn exact-split GBM
+
+
+def test_gbm_binomial_auc():
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+
+    df, ybin = _binary_df()
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=30, max_depth=3, learn_rate=0.2, score_tree_interval=100).train(
+        y="y", training_frame=fr
+    )
+    auc = m.training_metrics.auc
+    sk = GradientBoostingClassifier(n_estimators=30, max_depth=3, learning_rate=0.2).fit(
+        df[list("abcd")], ybin
+    )
+    sk_auc = roc_auc_score(ybin, sk.predict_proba(df[list("abcd")])[:, 1])
+    assert auc > 0.85
+    assert auc > sk_auc - 0.03
+    # prediction frame layout
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "N", "Y"]
+    p = pred.vec("Y").to_numpy()
+    assert 0 <= p.min() and p.max() <= 1
+
+
+def test_gbm_multinomial():
+    rng = np.random.default_rng(3)
+    n = 3000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.5).astype(int) + (X[:, 2] > 0.8).astype(int)
+    df = pd.DataFrame(X, columns=list("abc"))
+    df["y"] = np.array(["lo", "mid", "hi"])[y]
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=15, max_depth=3, learn_rate=0.3, score_tree_interval=100).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.classification_error < 0.1
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "hi", "lo", "mid"]
+
+
+def test_gbm_categorical_feature():
+    rng = np.random.default_rng(4)
+    n = 3000
+    g = rng.choice(list("pqrs"), n)
+    eff = {"p": 0.0, "q": 5.0, "r": -3.0, "s": 1.0}
+    y = np.array([eff[v] for v in g]) + 0.1 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"g": g, "y": y}))
+    m = GBM(ntrees=5, max_depth=2, learn_rate=0.8, min_rows=5.0).train(
+        y="y", training_frame=fr
+    )
+    pred = m.predict(fr).vec("predict").to_numpy()
+    for v, e in eff.items():
+        sel = g == v
+        assert pred[sel].mean() == pytest.approx(e, abs=0.2)
+
+
+def test_gbm_handles_missing_values():
+    rng = np.random.default_rng(5)
+    n = 2000
+    x = rng.normal(size=n)
+    y = np.where(np.isnan(x := np.where(rng.random(n) < 0.2, np.nan, x)), 5.0, 2 * x)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y}))
+    m = GBM(ntrees=10, max_depth=3, learn_rate=0.5, min_rows=5.0).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.r2 > 0.95  # NA direction must be learned
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.isnan(pred).sum() == 0
+
+
+def test_gbm_poisson():
+    rng = np.random.default_rng(6)
+    n = 3000
+    x = rng.normal(size=n)
+    y = rng.poisson(np.exp(0.3 + 0.7 * x)).astype(float)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y}))
+    m = GBM(ntrees=20, max_depth=3, distribution="poisson", score_tree_interval=100).train(
+        y="y", training_frame=fr
+    )
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert (pred > 0).all()  # log link keeps predictions positive
+    assert m.training_metrics.mean_residual_deviance < 1.5
+
+
+def test_gbm_early_stopping():
+    df = _friedman(n=2000, noise=2.0)
+    fr = Frame.from_pandas(df)
+    tr, va = fr.split_frame([0.7], seed=3)
+    m = GBM(
+        ntrees=200,
+        max_depth=3,
+        learn_rate=0.5,
+        stopping_rounds=2,
+        stopping_tolerance=1e-3,
+        score_tree_interval=5,
+    ).train(y="y", training_frame=tr, validation_frame=va)
+    assert m.output["ntrees_actual"] < 200
+    # scoring history carries both training and validation series
+    assert "validation_rmse" in m.scoring_history[0]
+
+
+def test_gbm_varimp_ranks_informative_feature():
+    rng = np.random.default_rng(7)
+    n = 2000
+    df = pd.DataFrame(
+        {
+            "signal": rng.normal(size=n),
+            "noise1": rng.normal(size=n),
+            "noise2": rng.normal(size=n),
+        }
+    )
+    df["y"] = 3 * df["signal"] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=10, max_depth=3).train(y="y", training_frame=fr)
+    vi = m.varimp()
+    assert vi[0]["variable"] == "signal"
+    assert vi[0]["percentage"] > 0.9
+
+
+def test_gbm_sampling_reproducible():
+    df = _friedman(n=1500)
+    fr = Frame.from_pandas(df)
+    kw = dict(ntrees=10, max_depth=3, sample_rate=0.7, col_sample_rate=0.8, seed=42)
+    m1 = GBM(**kw).train(y="y", training_frame=fr)
+    m2 = GBM(**kw).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(
+        m1.predict(fr).vec("predict").to_numpy(),
+        m2.predict(fr).vec("predict").to_numpy(),
+        rtol=1e-6,
+    )
+
+
+def test_drf_classification():
+    df, ybin = _binary_df(n=3000)
+    fr = Frame.from_pandas(df)
+    m = DRF(ntrees=20, max_depth=10, score_tree_interval=100, seed=1).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.auc > 0.9  # in-bag training AUC is optimistic; sanity bound
+    pred = m.predict(fr)
+    p1 = pred.vec("Y").to_numpy()
+    assert 0 <= p1.min() and p1.max() <= 1
+
+
+def test_drf_regression():
+    df = _friedman(n=2500)
+    fr = Frame.from_pandas(df)
+    m = DRF(ntrees=25, max_depth=12, score_tree_interval=100, seed=2).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.r2 > 0.85
+
+
+def test_drf_multinomial():
+    rng = np.random.default_rng(9)
+    n = 2500
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    df = pd.DataFrame(X, columns=list("abc"))
+    df["y"] = np.array(["A", "B", "C"])[y]
+    fr = Frame.from_pandas(df)
+    m = DRF(ntrees=15, max_depth=8, score_tree_interval=100, seed=3).train(
+        y="y", training_frame=fr
+    )
+    assert m.training_metrics.classification_error < 0.15
+    P = m._predict_raw(fr)
+    np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_gbm_predict_on_new_frame_with_unseen_level():
+    rng = np.random.default_rng(10)
+    n = 1000
+    g = rng.choice(["a", "b"], n)
+    y = np.where(g == "a", 1.0, 2.0) + 0.01 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"g": g, "y": y}))
+    m = GBM(ntrees=3, max_depth=1, learn_rate=1.0, min_rows=1.0).train(
+        y="y", training_frame=fr
+    )
+    test = Frame.from_pandas(pd.DataFrame({"g": ["a", "b", "zz"], "y": [0.0, 0.0, 0.0]}))
+    pred = m.predict(test).vec("predict").to_numpy()
+    assert pred[0] == pytest.approx(1.0, abs=0.05)
+    assert pred[1] == pytest.approx(2.0, abs=0.05)
+    assert np.isfinite(pred[2])  # unseen level routes through the NA path
