@@ -24,6 +24,18 @@ __version__ = "0.1.0"
 
 from h2o3_tpu.cluster.cloud import init, cluster_info, shutdown
 from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame import ops  # attaches Rapids-successor operators to Frame/Vec
+from h2o3_tpu.frame.ops import (
+    group_by,
+    merge,
+    quantile,
+    table,
+    unique,
+    cut,
+    impute,
+    ifelse,
+    cor,
+)
 from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
 from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
 

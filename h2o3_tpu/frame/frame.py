@@ -347,6 +347,11 @@ class Frame:
         for n, v in zip(self._names, self._vecs):
             if v.kind == STR:
                 out[n] = v._host
+            elif v.kind == TIME:
+                # datetime column, like H2O's as_data_frame time handling —
+                # keeps merge/round-trip through from_pandas unit-correct
+                ms = v.to_numpy()
+                out[n] = pd.to_datetime(pd.Series(ms), unit="ms")
             elif v.kind == CAT:
                 codes = v.to_numpy()
                 dom = np.asarray(v.domain, dtype=object)

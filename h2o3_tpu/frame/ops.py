@@ -1,0 +1,836 @@
+"""Frame operations — successor of the Rapids DSL (``water.rapids.Rapids`` /
+``ast/*`` / ``Merge.java`` [UNVERIFIED upstream paths, SURVEY.md §2.1]).
+
+H2O clients build lazy expression trees that compile to Rapids strings
+(``(+ (cols frame [0]) 1)``) shipped to the cluster and evaluated as MRTask
+passes. The TPU-native shape of the same surface is direct: elementwise math
+is a jitted device op over the row-sharded columns (XLA fuses chains of them
+— the fusion H2O got from hand-written AST nodes falls out of the compiler);
+group-by is a device segment-reduction; joins/sorts are host-coordinated over
+columnar data. The public surface mirrors the Rapids op roster: arithmetic,
+comparisons, boolean ops, unary math, ``ifelse``, group-by aggregation
+(``ASTGroup``), ``merge`` (``ASTMerge`` radix join), ``quantile``, ``table``,
+``cut``, ``unique``, string ops, time-component extraction, ``scale``,
+cumulative ops, ``cor``/``var``.
+
+Everything here attaches to :class:`Vec`/:class:`Frame` (operator overloads
++ named methods) when this module is imported, which ``h2o3_tpu/__init__``
+does eagerly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.frame.frame import CAT, INT, NUM, STR, TIME, Frame, Vec
+
+# ---------------------------------------------------------------------------
+# elementwise kernels (cached by op name so jit caches hit across calls)
+# ---------------------------------------------------------------------------
+
+_BINOPS = {
+    "+": jnp.add,
+    "-": jnp.subtract,
+    "*": jnp.multiply,
+    "/": jnp.divide,
+    "//": lambda a, b: jnp.floor(a / b),
+    "%": jnp.mod,
+    "**": jnp.power,
+    "==": lambda a, b: (a == b).astype(jnp.float32),
+    "!=": lambda a, b: (a != b).astype(jnp.float32),
+    "<": lambda a, b: (a < b).astype(jnp.float32),
+    "<=": lambda a, b: (a <= b).astype(jnp.float32),
+    ">": lambda a, b: (a > b).astype(jnp.float32),
+    ">=": lambda a, b: (a >= b).astype(jnp.float32),
+    "&": lambda a, b: ((a != 0) & (b != 0)).astype(jnp.float32),
+    "|": lambda a, b: ((a != 0) | (b != 0)).astype(jnp.float32),
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+_UNOPS = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "sqrt": jnp.sqrt,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "tan": jnp.tan,
+    "acos": jnp.arccos,
+    "asin": jnp.arcsin,
+    "atan": jnp.arctan,
+    "cosh": jnp.cosh,
+    "sinh": jnp.sinh,
+    "tanh": jnp.tanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "not": lambda x: (x == 0).astype(jnp.float32),
+    "isna": lambda x: jnp.isnan(x).astype(jnp.float32),
+}
+
+# NA semantics: comparisons/boolean ops on NaN inputs yield NaN (H2O returns
+# NA), so every non-arithmetic op re-inserts NaN where any input was NaN.
+_PRESERVE_NAN = {"==", "!=", "<", "<=", ">", ">=", "&", "|"}
+
+
+@partial(jax.jit, static_argnames=("op",))
+def _binop_kernel(a, b, op: str):
+    out = _BINOPS[op](a, b)
+    if op in _PRESERVE_NAN:
+        out = jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, out)
+    return out.astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("op",))
+def _unop_kernel(a, op: str):
+    out = _UNOPS[op](a)
+    if op == "not":
+        out = jnp.where(jnp.isnan(a), jnp.nan, out)
+    return out.astype(jnp.float32)
+
+
+@jax.jit
+def _codes_as_float(codes):
+    """Enum codes → float with the NA sentinel (-1) mapped to NaN, so the
+    module's NA semantics hold for enum operands too."""
+    return jnp.where(codes < 0, jnp.nan, codes.astype(jnp.float32))
+
+
+def _as_device(x, like: Vec):
+    """Coerce operand to a device array aligned with ``like``'s padded rows."""
+    if isinstance(x, Vec):
+        if x.kind == STR:
+            raise TypeError("arithmetic on string columns is not supported")
+        assert x.nrow == like.nrow, "operand row counts differ"
+        return _codes_as_float(x.data) if x.kind == CAT else x.data
+    if isinstance(x, Frame):
+        assert x.ncol == 1, "frame operand must have exactly one column"
+        return _as_device(x.vec(0), like)
+    return jnp.float32(x)  # scalar broadcasts over the padded column
+
+
+def _binop(a: Vec, b, op: str, reflected: bool = False) -> Vec:
+    if isinstance(b, str):
+        return _binop_str(a, b, op)
+    if (
+        isinstance(b, Vec)
+        and a.kind == CAT
+        and b.kind == CAT
+        and a.domain != b.domain
+    ):
+        # enums with different domains compare by LABEL: remap b's codes into
+        # a's domain space (labels absent from a get distinct no-match codes)
+        if op not in ("==", "!="):
+            raise TypeError("ordering comparisons between enums with different domains")
+        adom = list(a.domain or ())
+        lut = {d: i for i, d in enumerate(adom)}
+        remap = np.empty(len(b.domain or ()) + 1, dtype=np.float32)
+        remap[-1] = np.nan
+        for j, d in enumerate(b.domain or ()):
+            remap[j] = lut.get(d, len(adom) + j)
+        db = Vec.from_numpy(remap[b.to_numpy()], NUM).data
+        out = _binop_kernel(_codes_as_float(a.data), db, op)
+        return Vec(out, NUM, nrow=a.nrow)
+    da = _as_device(a, a)
+    db = _as_device(b, a)
+    out = _binop_kernel(db, da, op) if reflected else _binop_kernel(da, db, op)
+    return Vec(out, NUM, nrow=a.nrow)
+
+
+def _binop_str(a: Vec, s: str, op: str) -> Vec:
+    """``frame['col'] == 'level'`` — the standard H2O filter idiom. The level
+    resolves to its code (no match → all-0 indicator with NA passthrough)."""
+    if op not in ("==", "!="):
+        raise TypeError(f"operator {op!r} not supported between a column and a string")
+    if a.kind == CAT:
+        try:
+            code = (a.domain or ()).index(s)
+        except ValueError:
+            code = -2  # matches nothing, NA rows still yield NaN
+        da = _codes_as_float(a.data)
+        out = _binop_kernel(da, jnp.float32(code), op)
+        return Vec(out, NUM, nrow=a.nrow)
+    if a.kind == STR:
+        vals = a.to_numpy()
+        eq = np.array(
+            [np.nan if v is None else float(v == s) for v in vals], dtype=np.float64
+        )
+        if op == "!=":
+            eq = 1.0 - eq
+        return Vec.from_numpy(eq, NUM, name=a.name)
+    raise TypeError(f"cannot compare a {a.kind} column to a string")
+
+
+def _unop(a: Vec, op: str) -> Vec:
+    return Vec(_unop_kernel(_as_device(a, a), op), NUM, nrow=a.nrow)
+
+
+def ifelse(test: Vec, yes, no) -> Vec:
+    """``ASTIfElse`` successor: elementwise select, NA where test is NA."""
+    t = _as_device(test, test)
+    y = _as_device(yes, test)
+    n = _as_device(no, test)
+    out = _ifelse_kernel(t, y, n)
+    return Vec(out, NUM, nrow=test.nrow)
+
+
+@jax.jit
+def _ifelse_kernel(t, y, n):
+    out = jnp.where(t != 0, y, n)
+    return jnp.where(jnp.isnan(t), jnp.nan, out).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# cumulative ops — host-side prefix pass (H2O's ASTCumu likewise runs a
+# sequential two-pass chunk-prefix; a prefix scan is bandwidth-bound and has
+# nothing for the MXU, so the host is the honest place for it)
+# ---------------------------------------------------------------------------
+
+_CUMOPS = ("cumsum", "cumprod", "cummin", "cummax")
+
+
+def _cumulative(v: Vec, op: str) -> Vec:
+    vals = v.to_numpy().astype(np.float64)
+    out = {
+        "cumsum": np.cumsum,
+        "cumprod": np.cumprod,
+        "cummin": np.minimum.accumulate,
+        "cummax": np.maximum.accumulate,
+    }[op](vals)
+    return Vec.from_numpy(out, NUM)
+
+
+# ---------------------------------------------------------------------------
+# group-by — successor of ``ASTGroup``
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("ngroups",))
+def _segment_aggregate(gid, x, ngroups: int):
+    """Per-group {count, sum, sumsq, min, max} in one device pass."""
+    ok = (gid >= 0) & ~jnp.isnan(x)
+    g = jnp.where(ok, gid, 0)
+    xz = jnp.where(ok, x, 0.0)
+    cnt = jnp.zeros(ngroups, jnp.float32).at[g].add(ok.astype(jnp.float32))
+    s = jnp.zeros(ngroups, jnp.float32).at[g].add(xz)
+    ss = jnp.zeros(ngroups, jnp.float32).at[g].add(xz * xz)
+    mn = jnp.full(ngroups, jnp.inf, jnp.float32).at[g].min(
+        jnp.where(ok, x, jnp.inf)
+    )
+    mx = jnp.full(ngroups, -jnp.inf, jnp.float32).at[g].max(
+        jnp.where(ok, x, -jnp.inf)
+    )
+    nas = jnp.zeros(ngroups, jnp.float32).at[jnp.where(gid >= 0, gid, 0)].add(
+        (jnp.isnan(x) & (gid >= 0)).astype(jnp.float32)
+    )
+    return {"nrow": cnt, "sum": s, "sumsq": ss, "min": mn, "max": mx, "nacnt": nas}
+
+
+class GroupBy:
+    """``frame.group_by(cols).agg(...)`` — ASTGroup successor.
+
+    Keys are factorized host-side (strings/enums need the host anyway); the
+    numeric aggregations run as one device segment-reduction per column.
+    """
+
+    AGGS = ("count", "nrow", "sum", "mean", "min", "max", "var", "sd", "sumsq", "median", "mode", "first", "last")
+
+    def __init__(self, frame: Frame, by: Sequence[str] | str):
+        self.frame = frame
+        self.by = [by] if isinstance(by, str) else list(by)
+        cols = []
+        for b in self.by:
+            v = frame.vec(b)
+            if v.kind == STR:
+                cols.append(v.to_numpy())
+            elif v.kind == CAT:
+                dom = np.asarray(list(v.domain or ()) + [None], dtype=object)
+                cols.append(dom[v.to_numpy()])
+            else:
+                cols.append(v.to_numpy())
+        keys = pd.MultiIndex.from_arrays(cols) if len(cols) > 1 else pd.Index(cols[0])
+        codes, uniques = pd.factorize(keys, sort=True)
+        self._gid = codes.astype(np.int32)  # -1 for NA keys, matching H2O's NA group drop
+        self._uniques = uniques
+        self._ngroups = len(uniques)
+
+    def agg(self, spec: Mapping[str, Sequence[str] | str]) -> Frame:
+        ngroups = self._ngroups
+        gid_dev = Vec.from_numpy(self._gid, CAT, domain=[str(i) for i in range(max(1, ngroups))]).data
+        out_cols: dict[str, np.ndarray] = {}
+        # key columns
+        if len(self.by) == 1:
+            out_cols[self.by[0]] = np.asarray(self._uniques)
+        else:
+            for i, b in enumerate(self.by):
+                out_cols[b] = np.asarray(self._uniques.get_level_values(i))
+        for col, aggs in spec.items():
+            aggs = [aggs] if isinstance(aggs, str) else list(aggs)
+            v = self.frame.vec(col)
+            need_device = any(a in ("count", "nrow", "sum", "mean", "min", "max", "var", "sd", "sumsq") for a in aggs)
+            stats = None
+            if need_device:
+                x = _codes_as_float(v.data) if v.kind == CAT else v.data
+                stats = {k: np.asarray(s) for k, s in _segment_aggregate(gid_dev, x, ngroups).items()}
+            for a in aggs:
+                name = f"{a}_{col}"
+                if a in ("count", "nrow"):
+                    out_cols[name] = stats["nrow"] + stats["nacnt"]
+                elif a == "sum":
+                    out_cols[name] = stats["sum"]
+                elif a == "sumsq":
+                    out_cols[name] = stats["sumsq"]
+                elif a == "mean":
+                    out_cols[name] = stats["sum"] / np.maximum(stats["nrow"], 1)
+                elif a == "min":
+                    out_cols[name] = stats["min"]
+                elif a == "max":
+                    out_cols[name] = stats["max"]
+                elif a in ("var", "sd"):
+                    n = stats["nrow"]
+                    m = stats["sum"] / np.maximum(n, 1)
+                    var = (stats["sumsq"] - n * m * m) / np.maximum(n - 1, 1)
+                    var = np.maximum(var, 0.0)
+                    out_cols[name] = np.sqrt(var) if a == "sd" else var
+                elif a in ("median", "mode", "first", "last"):
+                    vals = v.to_numpy()
+                    if v.kind == CAT:  # NA sentinel -1 → NaN for the host aggs
+                        vals = np.where(vals < 0, np.nan, vals.astype(np.float64))
+                    out = np.full(ngroups, np.nan)
+                    for g in range(ngroups):
+                        gv = vals[self._gid == g]
+                        if a in ("median",):
+                            gv = gv[~pd.isna(gv)]
+                            out[g] = np.median(gv) if len(gv) else np.nan
+                        elif a == "mode":
+                            gv = gv[~pd.isna(gv)]
+                            out[g] = pd.Series(gv).mode().iloc[0] if len(gv) else np.nan
+                        elif a == "first":
+                            out[g] = gv[0] if len(gv) else np.nan
+                        else:
+                            out[g] = gv[-1] if len(gv) else np.nan
+                    out_cols[name] = out
+                else:
+                    raise ValueError(f"unknown aggregation {a!r}")
+        return Frame.from_pandas(pd.DataFrame(out_cols))
+
+
+def group_by(frame: Frame, by) -> GroupBy:
+    return GroupBy(frame, by)
+
+
+# ---------------------------------------------------------------------------
+# merge / sort — successor of ``ASTMerge`` (distributed radix join) and
+# ``ASTSort``. Host-coordinated: keys come to the host columnar (they often
+# are strings/enums), the row permutation is computed with a radix-style
+# pandas merge, and the gathered columns are re-sharded to device.
+# ---------------------------------------------------------------------------
+
+
+def merge(
+    left: Frame,
+    right: Frame,
+    by: Sequence[str] | None = None,
+    by_x: Sequence[str] | None = None,
+    by_y: Sequence[str] | None = None,
+    all_x: bool = False,
+    all_y: bool = False,
+) -> Frame:
+    bx = list(by_x or by or [n for n in left.names if n in set(right.names)])
+    bby = list(by_y or by or bx)
+    how = "outer" if (all_x and all_y) else "left" if all_x else "right" if all_y else "inner"
+    ldf = left.to_pandas()
+    rdf = right.to_pandas()
+    out = ldf.merge(rdf, left_on=bx, right_on=bby, how=how, suffixes=("", "_y"))
+    # TIME omitted: to_pandas emits real datetime columns, so TIME re-infers
+    types = {**right.types, **left.types}
+    col_types = {c: types[c] for c in out.columns if c in types and types[c] in (CAT, STR)}
+    return Frame.from_pandas(out, column_types=col_types)
+
+
+def sort(frame: Frame, by: Sequence[str] | str, ascending: bool | Sequence[bool] = True) -> Frame:
+    by = [by] if isinstance(by, str) else list(by)
+    df = pd.DataFrame({b: frame.vec(b).to_numpy() for b in by})
+    order = df.sort_values(by=by, ascending=ascending, kind="stable").index.to_numpy()
+    return frame.subset_rows(order)
+
+
+# ---------------------------------------------------------------------------
+# quantile / table / unique / cut / impute
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _sorted_valid(x):
+    return jnp.sort(x), (~jnp.isnan(x)).sum(dtype=jnp.int32)
+
+
+def quantile(frame_or_vec, prob: Sequence[float] = (0.001, 0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99, 0.999)) -> Frame:
+    """``h2o.quantile`` successor (interpolation type 7, H2O's default)."""
+    if isinstance(frame_or_vec, Vec):
+        vecs = [frame_or_vec]
+    else:
+        vecs = [frame_or_vec.vec(n) for n in frame_or_vec.names if frame_or_vec.vec(n).is_numeric()]
+    out = {"Probs": np.asarray(prob, dtype=np.float64)}
+    for v in vecs:
+        s, cnt = _sorted_valid(v.data)  # NaN sorts to the end
+        s = np.asarray(s)[: int(cnt)]
+        if len(s) == 0:
+            out[v.name] = np.full(len(prob), np.nan)
+            continue
+        idx = (len(s) - 1) * np.asarray(prob, dtype=np.float64)
+        lo = np.floor(idx).astype(int)
+        hi = np.ceil(idx).astype(int)
+        out[v.name] = s[lo] * (1 - (idx - lo)) + s[hi] * (idx - lo)
+    return Frame.from_pandas(pd.DataFrame(out))
+
+
+def table(v1: Vec, v2: Vec | None = None, dense: bool = True) -> Frame:
+    """``h2o.table`` successor: level counts for one or two columns."""
+
+    def as_labels(v: Vec):
+        if v.kind == CAT:
+            dom = np.asarray(list(v.domain or ()) + [None], dtype=object)
+            return dom[v.to_numpy()]
+        return v.to_numpy()
+
+    if v2 is None:
+        s = pd.Series(as_labels(v1)).value_counts(sort=False).sort_index()
+        df = pd.DataFrame({v1.name or "C1": s.index.to_numpy(), "Count": s.to_numpy()})
+        return Frame.from_pandas(df)
+    ct = pd.crosstab(pd.Series(as_labels(v1)), pd.Series(as_labels(v2)))
+    rows = ct.stack().reset_index()
+    rows.columns = [v1.name or "C1", v2.name or "C2", "Counts"]
+    if dense:
+        rows = rows[rows["Counts"] > 0]
+    return Frame.from_pandas(rows)
+
+
+def unique(v: Vec) -> Frame:
+    if v.kind == CAT:
+        dom = np.asarray(list(v.domain or ()), dtype=object)
+        present = np.unique(v.to_numpy())
+        present = present[present >= 0]
+        vals = dom[present]
+    else:
+        vals = pd.unique(v.to_numpy())
+        vals = vals[~pd.isna(vals)]
+    return Frame.from_pandas(pd.DataFrame({v.name or "C1": vals}))
+
+
+def cut(v: Vec, breaks: Sequence[float], labels: Sequence[str] | None = None,
+        include_lowest: bool = False, right: bool = True) -> Vec:
+    """``ASTCut`` successor: numeric → enum by interval."""
+    got = pd.cut(v.to_numpy(), bins=list(breaks), labels=labels,
+                 include_lowest=include_lowest, right=right)
+    dom = [str(c) for c in got.categories]
+    return Vec.from_numpy(got.codes.astype(np.int32), CAT, name=v.name, domain=dom)
+
+
+def impute(frame: Frame, column: str, method: str = "mean",
+           by: Sequence[str] | None = None) -> float | list:
+    """``h2o.impute`` successor — fills NAs in place (returns fill value(s))."""
+    v = frame.vec(column)
+    if by:
+        gb = GroupBy(frame, by)
+        agg = "mean" if method == "mean" else "median" if method == "median" else "mode"
+        if v.kind == CAT:
+            agg = "mode"  # categorical columns can only take the group mode
+        gfr = gb.agg({column: agg})
+        fill_per_group = gfr.vec(f"{agg}_{column}").to_numpy()
+        gid = gb._gid
+        if v.kind == CAT:
+            codes = v.to_numpy().astype(np.int64)
+            na = (codes < 0) & (gid >= 0) & ~np.isnan(fill_per_group[np.clip(gid, 0, None)])
+            codes[na] = fill_per_group[gid[na]].astype(np.int64)
+            _replace_vec(frame, column, Vec.from_numpy(codes, CAT, name=column, domain=v.domain))
+        else:
+            vals = v.to_numpy().astype(np.float64)
+            na = np.isnan(vals) & (gid >= 0)
+            vals[na] = fill_per_group[gid[na]]
+            _replace_vec(frame, column, Vec.from_numpy(vals, v.kind, name=column))
+        return fill_per_group.tolist()
+    if v.kind == CAT:
+        codes = v.to_numpy()
+        valid = codes[codes >= 0]
+        fill = int(pd.Series(valid).mode().iloc[0]) if len(valid) else -1
+        codes = np.where(codes < 0, fill, codes)
+        _replace_vec(frame, column, Vec.from_numpy(codes, CAT, name=column, domain=v.domain))
+        return float(fill)
+    vals = v.to_numpy().astype(np.float64)
+    if method == "median":
+        fill = float(np.nanmedian(vals))
+    elif method == "mode":
+        fill = float(pd.Series(vals).mode().iloc[0])
+    else:
+        fill = float(np.nanmean(vals))
+    vals = np.where(np.isnan(vals), fill, vals)
+    _replace_vec(frame, column, Vec.from_numpy(vals, v.kind, name=column))
+    return fill
+
+
+def _replace_vec(frame: Frame, column: str, new: Vec) -> None:
+    i = frame._index(column)
+    frame._vecs[i] = new
+    new.name = frame._names[i]
+
+
+# ---------------------------------------------------------------------------
+# scale / correlation / variance — device matmul over standardized columns
+# ---------------------------------------------------------------------------
+
+
+def scale(frame: Frame, center: bool = True, scale_: bool = True) -> Frame:
+    vecs = []
+    for n in frame.names:
+        v = frame.vec(n)
+        if not v.is_numeric():
+            vecs.append(v)
+            continue
+        mu = v.mean() if center else 0.0
+        sd = v.sigma() if scale_ else 1.0
+        sd = sd if sd and np.isfinite(sd) and sd > 0 else 1.0
+        vecs.append(Vec(_scale_kernel(v.data, jnp.float32(mu), jnp.float32(sd)), NUM, nrow=v.nrow))
+    return Frame(vecs, frame.names)
+
+
+@jax.jit
+def _scale_kernel(x, mu, sd):
+    return (x - mu) / sd
+
+
+def cor(frame: Frame, use: str = "complete.obs") -> Frame:
+    """Pearson correlation matrix over numeric columns (device Gram)."""
+    names = [n for n in frame.names if frame.vec(n).is_numeric()]
+    X = np.stack([frame.vec(n).to_numpy().astype(np.float64) for n in names], axis=1)
+    if use == "complete.obs":
+        X = X[~np.isnan(X).any(axis=1)]
+    c = np.corrcoef(X, rowvar=False)
+    df = pd.DataFrame(np.atleast_2d(c), columns=names)
+    return Frame.from_pandas(df)
+
+
+def var(frame: Frame) -> Frame:
+    names = [n for n in frame.names if frame.vec(n).is_numeric()]
+    X = np.stack([frame.vec(n).to_numpy().astype(np.float64) for n in names], axis=1)
+    X = X[~np.isnan(X).any(axis=1)]
+    c = np.cov(X, rowvar=False)
+    return Frame.from_pandas(pd.DataFrame(np.atleast_2d(c), columns=names))
+
+
+# ---------------------------------------------------------------------------
+# string ops (host-side; on enum columns they rewrite the domain, like H2O)
+# ---------------------------------------------------------------------------
+
+
+def _str_apply(v: Vec, fn) -> Vec:
+    if v.kind == CAT:
+        dom = [fn(d) for d in (v.domain or ())]
+        # collapsing domains (e.g. tolower making levels equal) → remap codes
+        new_dom: list[str] = []
+        lut: dict[str, int] = {}
+        remap = np.empty(len(dom) + 1, dtype=np.int32)
+        remap[-1] = -1
+        for i, d in enumerate(dom):
+            if d not in lut:
+                lut[d] = len(new_dom)
+                new_dom.append(d)
+            remap[i] = lut[d]
+        return Vec.from_numpy(remap[v.to_numpy()], CAT, name=v.name, domain=new_dom)
+    if v.kind != STR:
+        raise TypeError(f"string op on {v.kind} column")
+    vals = np.array([fn(s) if s is not None else None for s in v.to_numpy()], dtype=object)
+    return Vec(vals, STR, name=v.name)
+
+
+def toupper(v: Vec) -> Vec:
+    return _str_apply(v, str.upper)
+
+
+def tolower(v: Vec) -> Vec:
+    return _str_apply(v, str.lower)
+
+
+def trim(v: Vec) -> Vec:
+    return _str_apply(v, str.strip)
+
+
+def sub(v: Vec, pattern: str, replacement: str) -> Vec:
+    import re
+
+    rx = re.compile(pattern)
+    return _str_apply(v, lambda s: rx.sub(replacement, s, count=1))
+
+
+def gsub(v: Vec, pattern: str, replacement: str) -> Vec:
+    import re
+
+    rx = re.compile(pattern)
+    return _str_apply(v, lambda s: rx.sub(replacement, s))
+
+
+def nchar(v: Vec) -> Vec:
+    if v.kind == CAT:
+        dom_len = np.array([len(d) for d in (v.domain or ())] + [np.nan], dtype=np.float64)
+        return Vec.from_numpy(dom_len[v.to_numpy()], NUM, name=v.name)
+    vals = np.array([len(s) if s is not None else np.nan for s in v.to_numpy()])
+    return Vec.from_numpy(vals, NUM, name=v.name)
+
+
+def substring(v: Vec, start: int, end: int | None = None) -> Vec:
+    return _str_apply(v, lambda s: s[start:end])
+
+
+def strsplit(v: Vec, pattern: str) -> Frame:
+    import re
+
+    rx = re.compile(pattern)
+    if v.kind == CAT:
+        vals = np.asarray(list(v.domain or ()) + [None], dtype=object)[v.to_numpy()]
+    else:
+        vals = v.to_numpy()
+    parts = [rx.split(s) if s is not None else [] for s in vals]
+    width = max((len(p) for p in parts), default=0)
+    cols = {}
+    for j in range(width):
+        cols[f"C{j + 1}"] = np.array(
+            [p[j] if j < len(p) else None for p in parts], dtype=object
+        )
+    df = pd.DataFrame(cols)
+    return Frame.from_pandas(df, column_types={c: STR for c in cols})
+
+
+def grep(v: Vec, pattern: str) -> Vec:
+    """0/1 match indicator (H2O grep returns matching row indices; the
+    indicator form composes with boolean masking)."""
+    import re
+
+    rx = re.compile(pattern)
+    if v.kind == CAT:
+        hit = np.array([1.0 if rx.search(d) else 0.0 for d in (v.domain or ())] + [np.nan])
+        return Vec.from_numpy(hit[v.to_numpy()], NUM, name=v.name)
+    vals = np.array(
+        [np.nan if s is None else (1.0 if rx.search(s) else 0.0) for s in v.to_numpy()]
+    )
+    return Vec.from_numpy(vals, NUM, name=v.name)
+
+
+# ---------------------------------------------------------------------------
+# time-component ops (host, from the exact epoch-ms copy)
+# ---------------------------------------------------------------------------
+
+
+def _time_component(v: Vec, comp: str) -> Vec:
+    ms = v.to_numpy().astype(np.float64)
+    dt = pd.to_datetime(pd.Series(ms), unit="ms")
+    if comp == "dayOfWeek":
+        vals = dt.dt.dayofweek.to_numpy().astype(np.float64)  # Mon=0, like H2O
+    elif comp == "week":
+        vals = dt.dt.isocalendar().week.to_numpy().astype(np.float64)
+    else:
+        vals = getattr(dt.dt, comp).to_numpy().astype(np.float64)
+    vals = np.where(np.isnan(ms), np.nan, vals)
+    return Vec.from_numpy(vals, INT, name=v.name)
+
+
+def year(v):
+    return _time_component(v, "year")
+
+
+def month(v):
+    return _time_component(v, "month")
+
+
+def day(v):
+    return _time_component(v, "day")
+
+
+def hour(v):
+    return _time_component(v, "hour")
+
+
+def minute(v):
+    return _time_component(v, "minute")
+
+
+def second(v):
+    return _time_component(v, "second")
+
+
+def day_of_week(v):
+    return _time_component(v, "dayOfWeek")
+
+
+def week(v):
+    return _time_component(v, "week")
+
+
+# ---------------------------------------------------------------------------
+# type conversions
+# ---------------------------------------------------------------------------
+
+
+def asfactor(v: Vec) -> Vec:
+    if v.kind == CAT:
+        return v
+    if v.kind == STR:
+        vals = v.to_numpy()
+        levels = sorted({str(s) for s in vals if s is not None})
+        lut = {s: i for i, s in enumerate(levels)}
+        codes = np.array([lut[str(s)] if s is not None else -1 for s in vals], dtype=np.int32)
+        return Vec.from_numpy(codes, CAT, name=v.name, domain=levels)
+    vals = v.to_numpy()
+    uniq = np.unique(vals[~np.isnan(vals)])
+    # integral numerics render without decimal point, like H2O's asfactor
+    labels = [str(int(u)) if float(u).is_integer() else str(u) for u in uniq]
+    lut = {u: i for i, u in enumerate(uniq)}
+    codes = np.array([lut[x] if not np.isnan(x) else -1 for x in vals], dtype=np.int32)
+    return Vec.from_numpy(codes, CAT, name=v.name, domain=labels)
+
+
+def asnumeric(v: Vec) -> Vec:
+    if v.is_numeric():
+        return v
+    if v.kind == CAT:
+        # numeric-looking domains convert by value; otherwise by code (H2O)
+        dom = list(v.domain or ())
+        try:
+            by_val = np.array([float(d) for d in dom] + [np.nan])
+        except ValueError:
+            by_val = np.array([float(i) for i in range(len(dom))] + [np.nan])
+        return Vec.from_numpy(by_val[v.to_numpy()], NUM, name=v.name)
+    vals = pd.to_numeric(pd.Series(v.to_numpy()), errors="coerce").to_numpy()
+    return Vec.from_numpy(vals, NUM, name=v.name)
+
+
+def ascharacter(v: Vec) -> Vec:
+    if v.kind == STR:
+        return v
+    if v.kind == CAT:
+        dom = np.asarray(list(v.domain or ()) + [None], dtype=object)
+        return Vec(dom[v.to_numpy()], STR, name=v.name)
+    vals = np.array([None if np.isnan(x) else str(x) for x in v.to_numpy()], dtype=object)
+    return Vec(vals, STR, name=v.name)
+
+
+# ---------------------------------------------------------------------------
+# histogram of a numeric column (ASTHist successor)
+# ---------------------------------------------------------------------------
+
+
+def hist(v: Vec, breaks: int | Sequence[float] = 20) -> Frame:
+    vals = v.to_numpy()
+    vals = vals[~np.isnan(vals)]
+    counts, edges = np.histogram(vals, bins=breaks)
+    mids = (edges[:-1] + edges[1:]) / 2
+    return Frame.from_pandas(pd.DataFrame({"breaks": edges[1:], "mids": mids, "counts": counts}))
+
+
+# ---------------------------------------------------------------------------
+# attach operators & methods to Vec / Frame
+# ---------------------------------------------------------------------------
+
+
+def _attach():
+    def make_bin(op, reflected=False):
+        def fn(self, other):
+            v = self.vec(0) if isinstance(self, Frame) else self
+            other = other.vec(0) if isinstance(other, Frame) else other
+            return _binop(v, other, op, reflected=reflected)
+
+        return fn
+
+    for name, op in [
+        ("__add__", "+"), ("__sub__", "-"), ("__mul__", "*"), ("__truediv__", "/"),
+        ("__floordiv__", "//"), ("__mod__", "%"), ("__pow__", "**"),
+        ("__eq__", "=="), ("__ne__", "!="), ("__lt__", "<"), ("__le__", "<="),
+        ("__gt__", ">"), ("__ge__", ">="), ("__and__", "&"), ("__or__", "|"),
+    ]:
+        setattr(Vec, name, make_bin(op))
+    for name, op in [
+        ("__radd__", "+"), ("__rsub__", "-"), ("__rmul__", "*"), ("__rtruediv__", "/"),
+        ("__rpow__", "**"), ("__rmod__", "%"),
+    ]:
+        setattr(Vec, name, make_bin(op, reflected=True))
+    Vec.__hash__ = lambda self: id(self)
+    Frame.__hash__ = lambda self: hash(self.key)
+
+    for op in _UNOPS:
+        name = {"not": "logical_not"}.get(op, op)
+        setattr(Vec, name, (lambda o: lambda self: _unop(self, o))(op))
+    for op in _CUMOPS:
+        setattr(Vec, op, (lambda o: lambda self: _cumulative(self, o))(op))
+
+    Vec.asfactor = asfactor
+    Vec.asnumeric = asnumeric
+    Vec.ascharacter = ascharacter
+    Vec.toupper = toupper
+    Vec.tolower = tolower
+    Vec.trim = trim
+    Vec.nchar = nchar
+    Vec.sub_ = sub
+    Vec.gsub = gsub
+    Vec.substring = substring
+    Vec.strsplit = strsplit
+    Vec.grep = grep
+    Vec.year = year
+    Vec.month = month
+    Vec.day = day
+    Vec.hour = hour
+    Vec.minute = minute
+    Vec.second = second
+    Vec.day_of_week = day_of_week
+    Vec.week = week
+    Vec.table = table
+    Vec.unique = unique
+    Vec.cut = cut
+    Vec.quantile = quantile
+    Vec.isna = lambda self: _unop(self, "isna")
+
+    Frame.group_by = group_by
+    Frame.merge = merge
+    Frame.sort = sort
+    Frame.quantile = quantile
+    Frame.impute = impute
+    Frame.scale = scale
+    Frame.cor = cor
+    Frame.var = var
+
+    def frame_set(self, name, value):
+        """``frame["col"] = vec`` — column add/replace."""
+        if isinstance(value, Frame):
+            value = value.vec(0)
+        if isinstance(value, (int, float)):
+            value = Vec.from_numpy(np.full(self.nrow, float(value)), NUM)
+        if isinstance(value, np.ndarray):
+            kind = STR if value.dtype == object else NUM
+            value = Vec.from_numpy(value, kind) if kind != STR else Vec(value, STR)
+        assert isinstance(value, Vec)
+        assert value.nrow == self.nrow or self.ncol == 0
+        value.name = str(name)
+        if name in self._names:
+            self._vecs[self._index(name)] = value
+        else:
+            self._names.append(str(name))
+            self._vecs.append(value)
+
+    Frame.__setitem__ = frame_set
+
+
+_attach()

@@ -98,6 +98,14 @@ def infer_kind(s: pd.Series) -> str:
     coerced = pd.to_numeric(nz, errors="coerce")
     if coerced.notna().all():
         return NUM
+    # date/time-looking strings parse as TIME (ParseSetup sniffs date formats)
+    sample = nz.iloc[: 1000].astype(str)
+    if sample.str.match(r"^\d{4}-\d{2}-\d{2}([ T].*)?$").all():
+        try:
+            pd.to_datetime(sample, format="ISO8601")
+            return TIME
+        except (ValueError, TypeError):
+            pass
     nuniq = nz.nunique()
     if nuniq > _MAX_CAT_LEVELS or (len(nz) > 100 and nuniq > _MAX_CAT_FRACTION * len(nz)):
         return STR
@@ -124,8 +132,21 @@ def _series_to_vec(s: pd.Series, kind: str, name: str) -> Vec:
             domain = levels
         return Vec.from_numpy(codes, CAT, name=name, domain=domain)
     if kind == TIME:
-        vals = pd.to_datetime(s).astype("int64").to_numpy().astype(np.float64) / 1e6
-        vals = np.where(s.isna().to_numpy(), np.nan, vals)
+        # epoch milliseconds UTC (H2O's time encoding); robust to the series'
+        # datetime64 resolution (ns in classic pandas, us/s possible in 2.x)
+        # and to timezone-aware inputs
+        # errors="coerce": values the sniff sample missed (mixed formats, stray
+        # strings past the first 1000 rows) become NA instead of crashing
+        if pd.api.types.is_datetime64_any_dtype(s):
+            dt = pd.to_datetime(s)
+        elif pd.api.types.is_numeric_dtype(s):
+            dt = pd.to_datetime(s, unit="ms", errors="coerce")  # epoch-ms input
+        else:
+            dt = pd.to_datetime(s, errors="coerce", format="ISO8601")
+        if getattr(dt.dtype, "tz", None) is not None:
+            dt = dt.dt.tz_convert("UTC").dt.tz_localize(None)
+        vals = dt.astype("datetime64[ms]").astype("int64").to_numpy().astype(np.float64)
+        vals = np.where(dt.isna().to_numpy(), np.nan, vals)
         return Vec.from_numpy(vals, TIME, name=name)
     vals = pd.to_numeric(s, errors="coerce").to_numpy(dtype=np.float64)
     return Vec.from_numpy(vals, INT if kind == INT else NUM, name=name)

@@ -133,7 +133,9 @@ def test_time_column_exact_roundtrip():
     fr = Frame.from_pandas(df)
     assert fr.types["t"] == "time"
     ms = fr.vec("t").to_numpy()
-    np.testing.assert_allclose(ms, ts.astype("int64").to_numpy() / 1e6, rtol=0, atol=0.5)
+    np.testing.assert_allclose(
+        ms, ts.astype("datetime64[ms]").astype("int64").to_numpy(), rtol=0, atol=0.5
+    )
     sub = fr.subset_rows(np.array([1]))
     np.testing.assert_allclose(sub.vec("t").to_numpy(), [ms[1]], atol=0.5)
 

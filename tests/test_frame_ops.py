@@ -1,0 +1,258 @@
+"""Rapids-successor frame ops (h2o3_tpu/frame/ops.py) against pandas truth."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.frame import ops
+
+
+@pytest.fixture()
+def fr(rng):
+    n = 500
+    df = pd.DataFrame(
+        {
+            "a": rng.normal(size=n),
+            "b": rng.normal(size=n) + 2.0,
+            "g": rng.choice(["x", "y", "z"], n),
+            "s": [f"row_{i}" for i in range(n)],
+            "t": pd.date_range("2020-01-01", periods=n, freq="h"),
+        }
+    )
+    df.loc[5, "a"] = np.nan
+    return h2o3_tpu.upload_file(df), df
+
+
+def col(v):
+    return np.asarray(v.to_numpy(), dtype=np.float64)
+
+
+class TestArithmetic:
+    def test_binary_ops(self, fr):
+        f, df = fr
+        a, b = f.vec("a"), f.vec("b")
+        np.testing.assert_allclose(col(a + b), (df.a + df.b), rtol=1e-5)
+        np.testing.assert_allclose(col(a - b), (df.a - df.b), rtol=1e-5)
+        np.testing.assert_allclose(col(a * 2), df.a * 2, rtol=1e-5)
+        np.testing.assert_allclose(col(1 / b), 1 / df.b, rtol=1e-5)
+        np.testing.assert_allclose(col(2 - a), 2 - df.a, rtol=1e-5)
+
+    def test_comparisons_na(self, fr):
+        f, df = fr
+        gt = col(f.vec("a") > 0)
+        want = (df.a > 0).astype(float).where(df.a.notna(), np.nan)
+        np.testing.assert_allclose(gt, want, rtol=1e-6)
+        assert np.isnan(gt[5])
+
+    def test_unary(self, fr):
+        f, df = fr
+        np.testing.assert_allclose(
+            col(f.vec("b").log()), np.log(df.b), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(col(f.vec("a").abs()), np.abs(df.a), rtol=1e-5)
+        isna = col(f.vec("a").isna())
+        assert isna[5] == 1.0 and isna.sum() == 1.0
+
+    def test_ifelse(self, fr):
+        f, df = fr
+        got = col(ops.ifelse(f.vec("a") > 0, f.vec("b"), 0.0))
+        want = np.where(df.a > 0, df.b, 0.0)
+        want = np.where(df.a.isna(), np.nan, want)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cumsum(self, fr):
+        f, df = fr
+        got = col(f.vec("b").cumsum())
+        np.testing.assert_allclose(got, np.cumsum(df.b), rtol=1e-4)
+
+
+class TestGroupBy:
+    def test_agg_matches_pandas(self, fr):
+        f, df = fr
+        out = f.group_by("g").agg({"a": ["mean", "sum", "min", "max", "sd"], "b": "count"}).to_pandas()
+        want = df.groupby("g").agg(
+            mean_a=("a", "mean"), sum_a=("a", "sum"), min_a=("a", "min"),
+            max_a=("a", "max"), sd_a=("a", "std"), count_b=("b", "size"),
+        ).reset_index()
+        out = out.sort_values("g").reset_index(drop=True)
+        for c in ("mean_a", "sum_a", "min_a", "max_a", "sd_a", "count_b"):
+            np.testing.assert_allclose(
+                out[c].astype(float), want[c].astype(float), rtol=1e-4, err_msg=c
+            )
+
+    def test_median_numeric_key(self, fr):
+        f, df = fr
+        f2 = h2o3_tpu.upload_file(pd.DataFrame({"k": [1, 1, 2, 2, 2], "v": [1.0, 3.0, 2.0, 4.0, 6.0]}))
+        out = f2.group_by("k").agg({"v": "median"}).to_pandas().sort_values("k")
+        np.testing.assert_allclose(out["median_v"], [2.0, 4.0])
+
+
+class TestMergeSort:
+    def test_inner_merge(self):
+        left = h2o3_tpu.upload_file(pd.DataFrame({"k": ["a", "b", "c"], "x": [1.0, 2.0, 3.0]}))
+        right = h2o3_tpu.upload_file(pd.DataFrame({"k": ["b", "c", "d"], "y": [20.0, 30.0, 40.0]}))
+        out = ops.merge(left, right).to_pandas()
+        assert sorted(out["k"]) == ["b", "c"]
+        assert out.loc[out.k == "b", "y"].iloc[0] == 20.0
+
+    def test_left_merge(self):
+        left = h2o3_tpu.upload_file(pd.DataFrame({"k": ["a", "b"], "x": [1.0, 2.0]}))
+        right = h2o3_tpu.upload_file(pd.DataFrame({"k": ["b"], "y": [9.0]}))
+        out = ops.merge(left, right, all_x=True).to_pandas()
+        assert len(out) == 2 and np.isnan(out.loc[out.k == "a", "y"].iloc[0])
+
+    def test_sort(self, fr):
+        f, df = fr
+        out = ops.sort(f, "b").to_pandas()
+        assert (np.diff(out["b"]) >= 0).all()
+
+
+class TestQuantileTable:
+    def test_quantile(self, fr):
+        f, df = fr
+        q = ops.quantile(f.vec("b"), prob=[0.25, 0.5, 0.75]).to_pandas()
+        want = np.quantile(df.b, [0.25, 0.5, 0.75])
+        np.testing.assert_allclose(q["b"], want, rtol=1e-4)
+
+    def test_table(self, fr):
+        f, df = fr
+        t = ops.table(f.vec("g")).to_pandas()
+        want = df.g.value_counts()
+        for _, row in t.iterrows():
+            assert row["Count"] == want[row["g"]]
+
+    def test_unique(self, fr):
+        f, df = fr
+        u = ops.unique(f.vec("g")).to_pandas()
+        assert sorted(u.iloc[:, 0]) == sorted(df.g.unique())
+
+    def test_cut(self, fr):
+        f, df = fr
+        v = ops.cut(f.vec("b"), breaks=[-10, 0, 2, 10])
+        assert v.kind == "enum" and v.cardinality == 3
+
+
+class TestImputeScale:
+    def test_impute_mean(self):
+        f = h2o3_tpu.upload_file(pd.DataFrame({"x": [1.0, np.nan, 3.0]}))
+        fill = ops.impute(f, "x", method="mean")
+        assert fill == pytest.approx(2.0)
+        np.testing.assert_allclose(f.vec("x").to_numpy(), [1, 2, 3])
+
+    def test_impute_by_group(self):
+        f = h2o3_tpu.upload_file(
+            pd.DataFrame({"g": ["a", "a", "b", "b"], "x": [1.0, np.nan, 10.0, np.nan]})
+        )
+        ops.impute(f, "x", method="mean", by=["g"])
+        np.testing.assert_allclose(f.vec("x").to_numpy(), [1, 1, 10, 10])
+
+    def test_scale(self, fr):
+        f, df = fr
+        out = ops.scale(f[["b"]]).to_pandas()
+        assert abs(out["b"].mean()) < 1e-4 and abs(out["b"].std() - 1) < 1e-2
+
+    def test_cor(self, fr):
+        f, df = fr
+        c = ops.cor(f[["a", "b"]]).to_pandas()
+        want = df[["a", "b"]].dropna().corr()
+        np.testing.assert_allclose(c.values, want.values, atol=1e-4)
+
+
+class TestStringsTime:
+    def test_string_ops(self, fr):
+        f, _ = fr
+        up = f.vec("s").toupper()
+        assert up.to_numpy()[0] == "ROW_0"
+        assert f.vec("s").nchar().to_numpy()[0] == 5.0
+        g2 = f.vec("s").gsub("row", "R")
+        assert g2.to_numpy()[0] == "R_0"
+
+    def test_string_ops_on_enum_rewrite_domain(self, fr):
+        f, _ = fr
+        up = f.vec("g").toupper()
+        assert up.kind == "enum" and set(up.levels()) == {"X", "Y", "Z"}
+
+    def test_strsplit(self, fr):
+        f, _ = fr
+        parts = f.vec("s").strsplit("_").to_pandas()
+        assert parts.iloc[0, 0] == "row" and parts.iloc[0, 1] == "0"
+
+    def test_time_components(self, fr):
+        f, df = fr
+        assert (f.vec("t").year().to_numpy() == 2020).all()
+        np.testing.assert_allclose(f.vec("t").hour().to_numpy(), df.t.dt.hour)
+        np.testing.assert_allclose(f.vec("t").day_of_week().to_numpy(), df.t.dt.dayofweek)
+
+
+class TestConversions:
+    def test_asfactor_roundtrip(self, fr):
+        f, df = fr
+        v = h2o3_tpu.upload_file(pd.DataFrame({"x": [1.0, 2.0, 1.0]})).vec("x").asfactor()
+        assert v.kind == "enum" and v.levels() == ["1", "2"]
+        back = v.asnumeric()
+        np.testing.assert_allclose(back.to_numpy(), [1, 2, 1])
+
+    def test_ascharacter(self, fr):
+        f, _ = fr
+        s = f.vec("g").ascharacter()
+        assert s.kind == "string"
+
+    def test_setitem(self, fr):
+        f, df = fr
+        f["a2"] = f.vec("a") * 2
+        np.testing.assert_allclose(col(f.vec("a2")), df.a * 2, rtol=1e-5)
+        assert "a2" in f.names
+
+
+class TestReviewRegressions:
+    """Fixes confirmed by the pre-commit review: NA enum semantics, string
+    comparisons, TIME round-trips through merge, tz-aware ingest."""
+
+    def test_merge_preserves_time(self):
+        left = h2o3_tpu.upload_file(
+            pd.DataFrame({"k": ["a", "b"], "t": pd.to_datetime(["2020-01-01", "2021-06-30"])})
+        )
+        right = h2o3_tpu.upload_file(pd.DataFrame({"k": ["a", "b"], "y": [1.0, 2.0]}))
+        out = ops.merge(left, right)
+        assert out.types["t"] == "time"
+        ms = out.vec("t").to_numpy()
+        assert abs(ms[0] - 1577836800000.0) < 1  # 2020-01-01 epoch-ms
+
+    def test_tz_aware_ingest(self):
+        f = h2o3_tpu.upload_file(
+            pd.DataFrame({"t": pd.date_range("2020-01-01", periods=3, tz="US/Pacific")})
+        )
+        assert f.types["t"] == "time"
+        # 2020-01-01 00:00 Pacific = 08:00 UTC
+        assert abs(f.vec("t").to_numpy()[0] - 1577865600000.0) < 1
+
+    def test_enum_na_comparison(self):
+        f = h2o3_tpu.upload_file(
+            pd.DataFrame({"g": ["x", None, "y"], "h": ["x", None, "z"]})
+        )
+        eq = (f.vec("g") == f.vec("h")).to_numpy()
+        assert eq[0] == 1.0 and np.isnan(eq[1]) and eq[2] == 0.0
+
+    def test_enum_eq_string_literal(self):
+        f = h2o3_tpu.upload_file(pd.DataFrame({"g": ["x", None, "y"]}))
+        eq = (f.vec("g") == "x").to_numpy()
+        assert eq[0] == 1.0 and np.isnan(eq[1]) and eq[2] == 0.0
+        ne = (f.vec("g") != "x").to_numpy()
+        assert ne[0] == 0.0 and np.isnan(ne[1]) and ne[2] == 1.0
+        nomatch = (f.vec("g") == "zzz").to_numpy()
+        assert nomatch[0] == 0.0 and np.isnan(nomatch[1])
+
+    def test_groupby_enum_excludes_na_codes(self):
+        f = h2o3_tpu.upload_file(pd.DataFrame({"k": ["a", "a"], "c": ["u", None]}))
+        out = f.group_by("k").agg({"c": ["min", "mode"]}).to_pandas()
+        assert out["min_c"].iloc[0] == 0.0  # code of 'u', not the -1 sentinel
+        assert out["mode_c"].iloc[0] == 0.0
+
+    def test_impute_categorical_by_group(self):
+        f = h2o3_tpu.upload_file(
+            pd.DataFrame({"g": ["a", "a", "a"], "c": ["u", "u", None]})
+        )
+        ops.impute(f, "c", method="mode", by=["g"])
+        assert f.vec("c").to_numpy().tolist() == [0, 0, 0]
