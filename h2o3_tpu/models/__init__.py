@@ -17,6 +17,9 @@ _LAZY = {
     "NaiveBayes": ("h2o3_tpu.models.naive_bayes", "NaiveBayes"),
     "IsolationForest": ("h2o3_tpu.models.isolation_forest", "IsolationForest"),
     "DeepLearning": ("h2o3_tpu.models.deeplearning", "DeepLearning"),
+    "GridSearch": ("h2o3_tpu.models.grid", "GridSearch"),
+    "Grid": ("h2o3_tpu.models.grid", "Grid"),
+    "StackedEnsemble": ("h2o3_tpu.models.ensemble", "StackedEnsemble"),
 }
 
 __all__ = ["Model", "ModelBuilder", "DataInfo", *_LAZY]

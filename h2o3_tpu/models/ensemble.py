@@ -1,0 +1,227 @@
+"""Stacked Ensembles — successor of ``hex.ensemble.StackedEnsemble`` /
+``hex.ensemble.Metalearner*`` [UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+H2O's SE trains a metalearner (default: GLM with non-negative coefficients)
+on the *cross-validation holdout predictions* of the base models, which must
+have been built with identical nfolds/fold assignment and
+``keep_cross_validation_predictions=True``. Scoring = run every base model,
+assemble their prediction columns into the level-one frame, score the
+metalearner on it. The same contract is kept here; the level-one frame is a
+plain device matrix (base-model count is small, so this is host-cheap).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.models.model_base import (
+    CommonParams,
+    Model,
+    ModelBuilder,
+    _make_metrics,
+)
+
+
+@dataclass
+class StackedEnsembleParams(CommonParams):
+    base_models: Sequence[Any] = field(default_factory=tuple)  # Model | key
+    metalearner_algorithm: str = "AUTO"  # AUTO->glm | glm | gbm | drf | deeplearning
+    metalearner_params: dict = field(default_factory=dict)
+    metalearner_nfolds: int = 0
+
+
+def _shape_prediction_columns(raw: np.ndarray, is_classifier: bool) -> np.ndarray:
+    """One base model's level-one contribution: per binomial model P(c1);
+    per multinomial model K prob columns; per regression model 1 column."""
+    raw = np.asarray(raw, dtype=np.float64)
+    if raw.ndim == 1:
+        return raw[:, None]
+    if raw.shape[1] == 2 and is_classifier:
+        return raw[:, 1:2]
+    return raw
+
+
+def _level_one_matrix(models: list[Model], frame: Frame) -> np.ndarray:
+    return np.concatenate(
+        [_shape_prediction_columns(m._predict_raw(frame), m.is_classifier) for m in models],
+        axis=1,
+    )
+
+
+def _level_one_cv_matrix(models: list[Model]) -> np.ndarray:
+    cols = []
+    for m in models:
+        cv = m.cv_predictions
+        assert cv is not None, (
+            f"base model {m.key} lacks CV holdout predictions; train with "
+            "nfolds>1 and keep_cross_validation_predictions=True"
+        )
+        cols.append(_shape_prediction_columns(cv, m.is_classifier))
+    return np.concatenate(cols, axis=1)
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def __init__(self, key, params, output, base_models, metalearner):
+        super().__init__(key, params, output)
+        self.base_models = base_models
+        self.metalearner = metalearner
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        L = _level_one_matrix(self.base_models, frame)
+        lframe = _matrix_frame(L)
+        return self.metalearner._predict_raw(lframe)
+
+
+def _matrix_frame(L: np.ndarray, y: np.ndarray | None = None, domain=None) -> Frame:
+    vecs = [Vec.from_numpy(L[:, j], "real") for j in range(L.shape[1])]
+    names = [f"bm_{j}" for j in range(L.shape[1])]
+    if y is not None:
+        if domain is not None:
+            vecs.append(Vec.from_numpy(y.astype(np.int32), "enum", domain=tuple(domain)))
+        else:
+            vecs.append(Vec.from_numpy(y, "real"))
+        names.append("y")
+    return Frame(vecs, names)
+
+
+class StackedEnsemble(ModelBuilder):
+    algo = "stackedensemble"
+    PARAMS_CLS = StackedEnsembleParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: StackedEnsembleParams = self.params
+        models: list[Model] = []
+        for bm in p.base_models:
+            m = bm if isinstance(bm, Model) else DKV.get(str(bm))
+            assert isinstance(m, Model), f"base model {bm!r} not found"
+            models.append(m)
+        assert models, "stackedensemble requires base_models"
+        ref = models[0]
+        if p.response_column is None:
+            p.response_column = ref.params.response_column
+        classification = ref.is_classifier
+        domain = ref.output.get("response_domain")
+
+        L = _level_one_cv_matrix(models)
+        y, w = ref._response_and_weights(train)
+        lframe = _matrix_frame(L, y, domain if classification else None)
+        job.update(0.3)
+
+        meta = self._make_metalearner(classification, len(domain) if domain else 1)
+        meta_model = meta.train(y="y", training_frame=lframe)
+        job.update(0.9)
+
+        model = StackedEnsembleModel(
+            DKV.make_key("stackedensemble"),
+            p,
+            {
+                "response_domain": tuple(domain) if domain else None,
+                "base_model_keys": [m.key for m in models],
+                "metalearner_key": meta_model.key,
+            },
+            models,
+            meta_model,
+        )
+        raw = model._predict_raw(train)
+        model.training_metrics = _make_metrics(model, np.asarray(raw), y, w)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        # CV-holdout metrics of the ensemble: metalearner's own training view
+        model.cross_validation_metrics = _make_metrics(
+            model, np.asarray(meta_model._predict_raw(lframe)), y, w
+        )
+        return model
+
+    def _make_metalearner(self, classification: bool, nclasses: int) -> ModelBuilder:
+        p: StackedEnsembleParams = self.params
+        algo = p.metalearner_algorithm.lower()
+        extra = dict(p.metalearner_params)
+        extra.setdefault("seed", p.seed)
+        if p.metalearner_nfolds:
+            extra["nfolds"] = p.metalearner_nfolds
+        if algo in ("auto", "glm"):
+            from h2o3_tpu.models.glm import GLM
+
+            family = (
+                "binomial"
+                if classification and nclasses == 2
+                else "multinomial"
+                if classification
+                else "gaussian"
+            )
+            # H2O AUTO metalearner = non-negative GLM without standardization
+            extra.setdefault("non_negative", algo == "auto")
+            extra.setdefault("family", family)
+            return GLM(**extra)
+        if algo == "gbm":
+            from h2o3_tpu.models.tree.gbm import GBM
+
+            return GBM(**extra)
+        if algo == "drf":
+            from h2o3_tpu.models.tree.drf import DRF
+
+            return DRF(**extra)
+        if algo == "deeplearning":
+            from h2o3_tpu.models.deeplearning import DeepLearning
+
+            return DeepLearning(**extra)
+        raise ValueError(f"unknown metalearner_algorithm {p.metalearner_algorithm!r}")
+
+    def _validate(self, train: Frame, valid: Frame | None) -> None:
+        """Alignment checks the level-one stacking silently depends on:
+        every base model must have been cross-validated on *this* training
+        frame (same rows, same response, same fold plan) for its holdout
+        predictions to line up row-for-row with ``train``."""
+        p: StackedEnsembleParams = self.params
+        models = [bm if isinstance(bm, Model) else DKV.get(str(bm)) for bm in p.base_models]
+        assert models and all(isinstance(m, Model) for m in models), (
+            "stackedensemble requires base_models trained in this session"
+        )
+        ref = models[0]
+        if p.response_column and p.response_column != ref.params.response_column:
+            raise ValueError(
+                f"response_column {p.response_column!r} differs from base models' "
+                f"{ref.params.response_column!r}"
+            )
+        ref_fold = (
+            ref.params.nfolds,
+            ref.params.fold_assignment,
+            getattr(ref.params, "fold_column", None),
+        )
+        for m in models:
+            cv = m.cv_predictions
+            if cv is None:
+                raise ValueError(
+                    f"base model {m.key}: train with nfolds>1 and "
+                    "keep_cross_validation_predictions=True"
+                )
+            if len(cv) != train.nrow:
+                raise ValueError(
+                    f"base model {m.key}: CV predictions cover {len(cv)} rows but "
+                    f"training_frame has {train.nrow} — base models must be "
+                    "cross-validated on the same frame"
+                )
+            if m.params.response_column != ref.params.response_column:
+                raise ValueError("base models disagree on response_column")
+            fold = (
+                m.params.nfolds,
+                m.params.fold_assignment,
+                getattr(m.params, "fold_column", None),
+            )
+            if fold != ref_fold:
+                raise ValueError(
+                    f"base model {m.key}: fold plan {fold} differs from {ref_fold}; "
+                    "all base models need identical nfolds/fold_assignment/fold_column"
+                )
+            if m.params.fold_assignment == "random" and m.params.seed != ref.params.seed:
+                raise ValueError(
+                    "random fold_assignment requires identical seeds across base models"
+                )

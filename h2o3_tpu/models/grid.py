@@ -1,0 +1,201 @@
+"""Hyperparameter grid search — successor of ``hex.grid.GridSearch`` /
+``hex.grid.HyperSpaceWalker`` [UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+H2O walks a hyperparameter space over any ModelBuilder with either a
+Cartesian walker or a seeded RandomDiscrete walker bounded by
+``max_models`` / ``max_runtime_secs``, builds the models as (optionally
+parallel) sub-jobs, and stores them on a ``Grid`` object sorted by a metric.
+The same contract is kept here; model builds are driven sequentially on the
+host (the device is the shared resource; H2O's ``parallelism`` option
+multiplexed CPU cores, here XLA programs already saturate the chip).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Sequence, Type
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.models.model_base import (
+    Model,
+    ModelBuilder,
+    ScoreKeeper,
+    stopping_metric_direction,
+)
+from h2o3_tpu.utils.log import Log
+
+
+class SearchCriteria:
+    """``hyper_space_search_criteria`` analog: strategy + budgets."""
+
+    def __init__(
+        self,
+        strategy: str = "Cartesian",
+        max_models: int = 0,
+        max_runtime_secs: float = 0.0,
+        seed: int = -1,
+        stopping_rounds: int = 0,
+        stopping_metric: str = "AUTO",
+        stopping_tolerance: float = 1e-3,
+    ):
+        s = strategy.lower()
+        assert s in ("cartesian", "randomdiscrete"), strategy
+        self.strategy = "Cartesian" if s == "cartesian" else "RandomDiscrete"
+        self.max_models = int(max_models)
+        self.max_runtime_secs = float(max_runtime_secs)
+        self.seed = seed
+        self.stopping_rounds = stopping_rounds
+        self.stopping_metric = stopping_metric
+        self.stopping_tolerance = stopping_tolerance
+
+
+class Grid:
+    """A trained grid: the models plus their hyperparameter assignments."""
+
+    def __init__(self, key: str, builder_cls: Type[ModelBuilder], hyper_names: list[str]):
+        self.key = key
+        self.builder_cls = builder_cls
+        self.hyper_names = hyper_names
+        self.models: list[Model] = []
+        self.hyper_values: list[dict] = []
+        self.failures: list[tuple[dict, str]] = []
+        DKV.put(key, self)
+
+    @property
+    def model_ids(self) -> list[str]:
+        return [m.key for m in self.models]
+
+    def sorted_metric_table(self, metric: str | None = None, decreasing: bool | None = None):
+        """Rank (hyper-values, model key, metric) rows — the ``get_grid`` view."""
+        if not self.models:
+            return []
+        m0 = self.models[0]
+        name, larger = stopping_metric_direction(
+            metric or "AUTO", m0.is_classifier, m0.nclasses
+        )
+        if decreasing is None:
+            decreasing = larger
+        rows = []
+        for m, hv in zip(self.models, self.hyper_values):
+            mm = m.cross_validation_metrics or m.validation_metrics or m.training_metrics
+            val = mm.value(name) if mm is not None else float("nan")
+            rows.append({**hv, "model_id": m.key, name: val})
+        rows.sort(key=lambda r: (np.isnan(r[name]), -r[name] if decreasing else r[name]))
+        return rows
+
+    def best_model(self, metric: str | None = None) -> Model | None:
+        tab = self.sorted_metric_table(metric)
+        return DKV.get(tab[0]["model_id"]) if tab else None
+
+
+def _space_size(hyper_params: dict[str, Sequence]) -> int:
+    total = 1
+    for v in hyper_params.values():
+        total *= len(v)
+    return total
+
+
+def _walk(hyper_params: dict[str, Sequence], criteria: SearchCriteria):
+    names = list(hyper_params)
+    combos = [list(hyper_params[n]) for n in names]
+    if criteria.strategy == "Cartesian":
+        for values in itertools.product(*combos):
+            yield dict(zip(names, values))
+        return
+    # RandomDiscrete: uniform sampling without replacement over the product
+    # space, matching H2O's seeded walker (hex.grid.HyperSpaceWalker
+    # RandomDiscreteValueWalker [UNVERIFIED]). Lazy rejection sampling keeps
+    # memory bounded by the number of *consumed* combos, never the space size
+    # (which can be astronomically large); seed<=0 means time-seeded, like
+    # H2O's seed=-1 contract.
+    sizes = [len(c) for c in combos]
+    total = _space_size(hyper_params)
+    rng = np.random.default_rng(criteria.seed if criteria.seed and criteria.seed > 0 else None)
+    seen: set[tuple] = set()
+    while len(seen) < total:
+        idx = tuple(int(rng.integers(sz)) for sz in sizes)
+        if idx in seen:
+            continue
+        seen.add(idx)
+        yield {n: cand[i] for n, cand, i in zip(names, combos, idx)}
+
+
+class GridSearch:
+    """``H2OGridSearch`` successor.
+
+    >>> gs = GridSearch(GBM, {"max_depth": [3, 5], "learn_rate": [0.1, 0.3]})
+    >>> grid = gs.train(x=feats, y="label", training_frame=fr)
+    """
+
+    def __init__(
+        self,
+        builder_cls: Type[ModelBuilder],
+        hyper_params: dict[str, Sequence],
+        search_criteria: dict | SearchCriteria | None = None,
+        grid_id: str | None = None,
+        **base_params,
+    ):
+        if isinstance(search_criteria, dict):
+            search_criteria = SearchCriteria(**search_criteria)
+        self.criteria = search_criteria or SearchCriteria()
+        self.builder_cls = builder_cls
+        self.hyper_params = dict(hyper_params)
+        self.base_params = base_params
+        self.grid = Grid(
+            grid_id or DKV.make_key("grid"), builder_cls, list(hyper_params)
+        )
+        self.job: Job | None = None
+
+    def train(self, x=None, y=None, training_frame=None, validation_frame=None, **kw) -> Grid:
+        self.job = Job(
+            lambda j: self._drive(j, x, y, training_frame, validation_frame, kw),
+            f"grid {self.grid.key} over {self.builder_cls.algo}",
+        )
+        self.job.run_sync()
+        return self.grid
+
+    def _drive(self, job: Job, x, y, training_frame, validation_frame, kw) -> Grid:
+        c = self.criteria
+        t0 = time.time()
+        n_planned = _space_size(self.hyper_params)
+        if c.max_models:
+            n_planned = min(n_planned, c.max_models)
+        walker = itertools.islice(
+            _walk(self.hyper_params, c), c.max_models if c.max_models else None
+        )
+        # grid-level early stopping on the leaderboard metric sequence,
+        # via the same ScoreKeeper the per-model driver uses
+        keeper: ScoreKeeper | None = None
+        metric_name: str | None = None
+        for i, hv in enumerate(walker):
+            if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
+                Log.info(f"grid {self.grid.key}: max_runtime_secs reached after {i} models")
+                break
+            try:
+                builder = self.builder_cls(**{**self.base_params, **hv})
+                m = builder.train(
+                    x=x, y=y, training_frame=training_frame,
+                    validation_frame=validation_frame, **kw,
+                )
+                self.grid.models.append(m)
+                self.grid.hyper_values.append(dict(hv))
+                if c.stopping_rounds:
+                    if keeper is None:
+                        metric_name, larger = stopping_metric_direction(
+                            c.stopping_metric, m.is_classifier, m.nclasses
+                        )
+                        keeper = ScoreKeeper(c.stopping_rounds, c.stopping_tolerance, larger)
+                    mm = m.cross_validation_metrics or m.validation_metrics or m.training_metrics
+                    keeper.record(mm.value(metric_name))
+                    if keeper.should_stop():
+                        Log.info(f"grid {self.grid.key}: early stop after {i + 1} models")
+                        break
+            except Exception as e:  # a failing combo must not kill the grid (h2o keeps failures)
+                self.grid.failures.append((dict(hv), repr(e)))
+                Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
+            job.update((i + 1) / max(1, n_planned))
+        return self.grid

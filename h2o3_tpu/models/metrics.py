@@ -30,6 +30,17 @@ class ModelMetrics:
             return v[item]
         raise AttributeError(item)
 
+    def value(self, name: str) -> float:
+        """Look up a scalar criterion by name (nan if absent) — the lookup
+        used by grid ranking / early stopping / leaderboards."""
+        v = self._v.get(name)
+        if v is None and name == "mean_residual_deviance":
+            v = self._v.get("mse")
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return float("nan")
+
     def to_dict(self) -> dict:
         out = {"kind": self.kind}
         for k, v in self._v.items():

@@ -1,0 +1,98 @@
+"""Grid search + Stacked Ensemble tests — modeled on upstream
+``hex/grid`` and ``hex/ensemble`` test scenarios [UNVERIFIED upstream
+paths, SURVEY.md §4]."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM, GLM, DRF, GridSearch, StackedEnsemble
+
+
+def _binary_df(n=2500, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    eta = X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = np.where(y == 1, "Y", "N")
+    return df
+
+
+def test_cartesian_grid_covers_product_and_ranks():
+    fr = Frame.from_pandas(_binary_df())
+    gs = GridSearch(
+        GBM,
+        {"max_depth": [2, 4], "learn_rate": [0.1, 0.3]},
+        ntrees=10,
+        seed=42,
+    )
+    grid = gs.train(y="y", training_frame=fr)
+    assert len(grid.models) == 4
+    tab = grid.sorted_metric_table("auc")
+    assert len(tab) == 4
+    aucs = [r["auc"] for r in tab]
+    assert aucs == sorted(aucs, reverse=True)
+    best = grid.best_model("auc")
+    assert best.training_metrics.value("auc") == pytest.approx(max(aucs))
+
+
+def test_random_grid_respects_max_models_and_seed():
+    fr = Frame.from_pandas(_binary_df(n=1200))
+    crit = {"strategy": "RandomDiscrete", "max_models": 3, "seed": 99}
+    gs1 = GridSearch(GBM, {"max_depth": [2, 3, 4], "learn_rate": [0.05, 0.1, 0.3]},
+                     search_criteria=crit, ntrees=5, seed=1)
+    g1 = gs1.train(y="y", training_frame=fr)
+    gs2 = GridSearch(GBM, {"max_depth": [2, 3, 4], "learn_rate": [0.05, 0.1, 0.3]},
+                     search_criteria=crit, ntrees=5, seed=1)
+    g2 = gs2.train(y="y", training_frame=fr)
+    assert len(g1.models) == 3
+    assert g1.hyper_values == g2.hyper_values  # seeded walker is deterministic
+
+
+def test_grid_keeps_failures_without_dying():
+    fr = Frame.from_pandas(_binary_df(n=800))
+    gs = GridSearch(GBM, {"max_depth": [2, -5]}, ntrees=3, seed=1)
+    grid = gs.train(y="y", training_frame=fr)
+    assert len(grid.models) == 1
+    assert len(grid.failures) == 1
+
+
+def test_stacked_ensemble_beats_or_matches_base_models():
+    fr = Frame.from_pandas(_binary_df(n=3000, seed=11))
+    common = dict(nfolds=3, keep_cross_validation_predictions=True, seed=5)
+    gbm = GBM(ntrees=20, max_depth=3, **common).train(y="y", training_frame=fr)
+    drf = DRF(ntrees=20, max_depth=6, **common).train(y="y", training_frame=fr)
+    glm = GLM(family="binomial", **common).train(y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[gbm, drf.key, glm]).train(
+        y="y", training_frame=fr
+    )
+    se_auc = se.training_metrics.value("auc")
+    base_best = max(
+        m.cross_validation_metrics.value("auc") for m in (gbm, drf, glm)
+    )
+    assert se_auc > 0.5
+    # SE on the level-one frame should at least be in the ballpark of the best base
+    assert se_auc >= base_best - 0.02
+    # predict surface: label + 2 prob columns
+    pred = se.predict(fr)
+    assert pred.names == ["predict", "N", "Y"]
+    p = pred.vec("Y").to_numpy()
+    assert np.all((p >= 0) & (p <= 1))
+
+
+def test_stacked_ensemble_regression():
+    rng = np.random.default_rng(3)
+    X = rng.random((2000, 4))
+    y = 3 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=2000)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = y
+    fr = Frame.from_pandas(df)
+    common = dict(nfolds=3, keep_cross_validation_predictions=True, seed=5)
+    gbm = GBM(ntrees=25, max_depth=3, **common).train(y="y", training_frame=fr)
+    glm = GLM(family="gaussian", **common).train(y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[gbm, glm]).train(y="y", training_frame=fr)
+    assert not se.is_classifier
+    r2 = se.training_metrics.value("r2")
+    assert r2 > 0.8
