@@ -1,0 +1,6 @@
+"""AutoML orchestration — successor of ``ai.h2o.automl`` (h2o-automl)
+[UNVERIFIED upstream paths, SURVEY.md §2.3, §3.5]."""
+
+from h2o3_tpu.automl.automl import AutoML, Leaderboard
+
+__all__ = ["AutoML", "Leaderboard"]

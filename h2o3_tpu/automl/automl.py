@@ -1,0 +1,323 @@
+"""AutoML — successor of ``ai.h2o.automl.AutoML`` / ``Leaderboard`` /
+``modeling/*Steps`` [UNVERIFIED upstream paths, SURVEY.md §2.3, §3.5].
+
+H2O AutoML plans a budgeted sequence of modeling steps — preset GBMs, a GBM
+grid, GLM, DRF + XRT (extremely randomized trees), DeepLearning grids, then
+two Stacked Ensembles ("BestOfFamily" and "All") — every model cross-validated
+so the ensembles can stack the holdout predictions, ranked on a leaderboard
+by a task-appropriate metric, with an events log of what ran when.
+
+The step tables below mirror H2O's default model parameter presets
+(``modeling/GBMStepsProvider`` etc. [UNVERIFIED]) at reduced counts tuned for
+chip-sized budgets; the orchestration itself is pure host-side Python over
+the same ModelBuilder/Grid/SE jobs a user would drive by hand — the TPU never
+idles on orchestration, which is exactly how H2O keeps its cluster busy from
+a single driver node.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import Model, stopping_metric_direction
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class AutoMLSpec:
+    max_models: int = 0                # 0 = unbounded (use max_runtime_secs)
+    max_runtime_secs: float = 3600.0
+    max_runtime_secs_per_model: float = 0.0
+    nfolds: int = 5
+    seed: int = -1
+    stopping_metric: str = "AUTO"
+    stopping_rounds: int = 3
+    stopping_tolerance: float = 1e-3
+    sort_metric: str = "AUTO"
+    include_algos: Sequence[str] | None = None
+    exclude_algos: Sequence[str] | None = None
+    balance_classes: bool = False
+    keep_cross_validation_predictions: bool = True
+    project_name: str = ""
+
+
+class Leaderboard:
+    """Ranked model table — successor of ``ai.h2o.automl.Leaderboard``.
+
+    When a ``leaderboard_frame`` is supplied, models are ranked on metrics
+    scored against it (H2O semantics); otherwise on CV > validation >
+    training metrics, in that order of preference."""
+
+    def __init__(self, sort_metric: str, larger_is_better: bool, leaderboard_frame=None):
+        self.sort_metric = sort_metric
+        self.larger = larger_is_better
+        self.leaderboard_frame = leaderboard_frame
+        self.models: list[Model] = []
+        self._lb_metrics: dict[str, Any] = {}  # model key -> metrics on lb frame
+
+    def add(self, *models: Model) -> None:
+        for m in models:
+            if m is not None:
+                self.models.append(m)
+        self.models.sort(key=self._key)
+
+    def _key(self, m: Model):
+        v = self._metric_of(m)
+        return (np.isnan(v), -v if self.larger else v)
+
+    def _metrics_for(self, m: Model):
+        if self.leaderboard_frame is not None:
+            if m.key not in self._lb_metrics:
+                self._lb_metrics[m.key] = m._score_metrics(self.leaderboard_frame)
+            return self._lb_metrics[m.key]
+        return m.cross_validation_metrics or m.validation_metrics or m.training_metrics
+
+    def _metric_of(self, m: Model) -> float:
+        mm = self._metrics_for(m)
+        return mm.value(self.sort_metric) if mm else float("nan")
+
+    @property
+    def leader(self) -> Model | None:
+        return self.models[0] if self.models else None
+
+    def as_table(self) -> list[dict]:
+        rows = []
+        for m in self.models:
+            mm = self._metrics_for(m)
+            row = {"model_id": m.key, "algo": m.algo, self.sort_metric: self._metric_of(m)}
+            if mm is not None:
+                for extra in ("auc", "logloss", "rmse", "mse", "mean_per_class_error", "mean_residual_deviance"):
+                    if extra != self.sort_metric and not np.isnan(mm.value(extra)):
+                        row[extra] = mm.value(extra)
+            rows.append(row)
+        return rows
+
+    def __repr__(self):
+        lines = [f"Leaderboard (sorted by {self.sort_metric}):"]
+        for r in self.as_table():
+            lines.append("  " + "  ".join(f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}" for k, v in r.items()))
+        return "\n".join(lines)
+
+
+@dataclass
+class _Step:
+    name: str
+    kind: str          # "model" | "grid" | "ensemble"
+    algo: str
+    params: dict = field(default_factory=dict)
+    hyper: dict = field(default_factory=dict)
+    weight: int = 10   # relative budget share (H2O step weights)
+
+
+def _default_plan() -> list[_Step]:
+    """The default modeling plan, mirroring H2O's step order:
+    preset GBMs → GLM → DRF → XRT → GBM grid → DL grid → ensembles."""
+    return [
+        _Step("def_gbm_1", "model", "gbm", dict(ntrees=50, max_depth=6, learn_rate=0.1, sample_rate=0.8, col_sample_rate=0.8)),
+        _Step("def_gbm_2", "model", "gbm", dict(ntrees=50, max_depth=3, learn_rate=0.1, sample_rate=0.9, col_sample_rate=1.0)),
+        _Step("def_gbm_3", "model", "gbm", dict(ntrees=50, max_depth=9, learn_rate=0.1, sample_rate=0.7, col_sample_rate=0.6)),
+        _Step("def_glm", "model", "glm", dict()),
+        _Step("def_drf", "model", "drf", dict(ntrees=50)),
+        _Step("def_xrt", "model", "xrt", dict(ntrees=50)),
+        _Step(
+            "grid_gbm", "grid", "gbm",
+            dict(ntrees=50),
+            hyper={
+                "max_depth": [3, 5, 7],
+                "learn_rate": [0.05, 0.1, 0.3],
+                "sample_rate": [0.6, 0.8, 1.0],
+            },
+            weight=60,
+        ),
+        _Step(
+            "grid_dl", "grid", "deeplearning",
+            dict(epochs=20),
+            hyper={
+                "hidden": [[32, 32], [64], [128, 64]],
+                "input_dropout_ratio": [0.0, 0.1],
+            },
+            weight=30,
+        ),
+        _Step("se_best_of_family", "ensemble", "stackedensemble", dict(flavor="best_of_family")),
+        _Step("se_all", "ensemble", "stackedensemble", dict(flavor="all")),
+    ]
+
+
+class AutoML:
+    """``H2OAutoML`` successor.
+
+    >>> aml = AutoML(max_models=8, seed=1)
+    >>> aml.train(y="label", training_frame=fr)
+    >>> aml.leaderboard.leader
+    """
+
+    def __init__(self, **kwargs):
+        self.spec = AutoMLSpec(**kwargs)
+        self.key = DKV.make_key("automl")
+        self.leaderboard: Leaderboard | None = None
+        self.event_log: list[dict] = []
+        self.job: Job | None = None
+        self._t0 = 0.0
+        DKV.put(self.key, self)
+
+    # -- public ----------------------------------------------------------
+    def train(self, x=None, y=None, training_frame=None, validation_frame=None,
+              leaderboard_frame=None) -> Model | None:
+        self.job = Job(
+            lambda j: self._drive(j, x, y, training_frame, validation_frame, leaderboard_frame),
+            f"AutoML {self.spec.project_name or self.key}",
+        )
+        self.job.run_sync()
+        return self.leader
+
+    @property
+    def leader(self) -> Model | None:
+        return self.leaderboard.leader if self.leaderboard else None
+
+    # -- internals -------------------------------------------------------
+    def _log(self, stage: str, message: str) -> None:
+        self.event_log.append({"ts": time.time(), "stage": stage, "message": message})
+        Log.info(f"AutoML[{self.key}] {stage}: {message}")
+
+    def _remaining(self) -> float:
+        if not self.spec.max_runtime_secs:
+            return float("inf")
+        return self.spec.max_runtime_secs - (time.time() - self._t0)
+
+    def _algo_allowed(self, algo: str) -> bool:
+        inc, exc = self.spec.include_algos, self.spec.exclude_algos
+        canon = {"gbm": "GBM", "glm": "GLM", "drf": "DRF", "xrt": "XRT",
+                 "deeplearning": "DeepLearning", "stackedensemble": "StackedEnsemble"}[algo]
+        if inc is not None:
+            return canon in inc
+        if exc is not None:
+            return canon not in exc
+        return True
+
+    def _builder_cls(self, algo: str):
+        from h2o3_tpu import models as M
+
+        return {"gbm": M.GBM, "glm": M.GLM, "drf": M.DRF, "xrt": M.XRT,
+                "deeplearning": M.DeepLearning}[algo]
+
+    def _builder(self, algo: str, params: dict):
+        return self._builder_cls(algo)(**params)
+
+    def _common(self) -> dict:
+        # seed passes through verbatim: seed<=0 keeps each builder's own
+        # "unseeded = random" contract, seed>0 makes the whole run reproducible
+        s = self.spec
+        out = dict(
+            nfolds=s.nfolds,
+            keep_cross_validation_predictions=True,
+            seed=s.seed,
+        )
+        if s.max_runtime_secs_per_model:
+            out["max_runtime_secs"] = s.max_runtime_secs_per_model
+        return out
+
+    def _drive(self, job: Job, x, y, training_frame, validation_frame, leaderboard_frame):
+        s = self.spec
+        self._t0 = time.time()
+        train = training_frame if isinstance(training_frame, Frame) else DKV.get(str(training_frame))
+        assert isinstance(train, Frame), "training_frame required"
+        yv = train.vec(y)
+        classification = yv.is_categorical()
+        nclasses = len(yv.domain) if classification else 1
+        sort_metric, larger = stopping_metric_direction(
+            s.sort_metric if s.sort_metric.lower() != "auto"
+            else ("auc" if (classification and nclasses == 2) else "AUTO"),
+            classification, nclasses,
+        )
+        lb_frame = None
+        if leaderboard_frame is not None:
+            lb_frame = leaderboard_frame if isinstance(leaderboard_frame, Frame) else DKV.get(str(leaderboard_frame))
+        self.leaderboard = Leaderboard(sort_metric, larger, leaderboard_frame=lb_frame)
+        self._log("init", f"AutoML build started: {'classification' if classification else 'regression'}, sort_metric={sort_metric}")
+
+        plan = [st for st in _default_plan() if self._algo_allowed(st.algo)]
+        n_models_built = 0
+        family_best: dict[str, Model] = {}
+        total_w = sum(st.weight for st in plan) or 1
+        done_w = 0
+
+        for st in plan:
+            if self._remaining() <= 0:
+                self._log("budget", "max_runtime_secs exhausted; stopping plan")
+                break
+            if s.max_models and n_models_built >= s.max_models and st.kind != "ensemble":
+                done_w += st.weight
+                job.update(done_w / total_w)
+                continue
+            try:
+                if st.kind == "model":
+                    m = self._builder(st.algo, {**st.params, **self._common()}).train(
+                        x=x, y=y, training_frame=train, validation_frame=validation_frame
+                    )
+                    self.leaderboard.add(m)
+                    n_models_built += 1
+                    self._update_family_best(family_best, m)
+                    self._log("model", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
+                elif st.kind == "grid":
+                    from h2o3_tpu.models.grid import GridSearch, SearchCriteria
+
+                    budget = self._remaining()
+                    n_left = (s.max_models - n_models_built) if s.max_models else 0
+                    crit = SearchCriteria(
+                        strategy="RandomDiscrete",
+                        max_models=max(1, n_left) if s.max_models else 0,
+                        max_runtime_secs=budget * st.weight / max(1, total_w - done_w) if np.isfinite(budget) else 0.0,
+                        seed=s.seed,
+                        stopping_rounds=s.stopping_rounds,
+                        stopping_metric=s.stopping_metric,
+                        stopping_tolerance=s.stopping_tolerance,
+                    )
+                    gs = GridSearch(self._builder_cls(st.algo), st.hyper,
+                                    search_criteria=crit,
+                                    **{**st.params, **self._common()})
+                    grid = gs.train(x=x, y=y, training_frame=train,
+                                    validation_frame=validation_frame)
+                    self.leaderboard.add(*grid.models)
+                    n_models_built += len(grid.models)
+                    for m in grid.models:
+                        self._update_family_best(family_best, m)
+                    self._log("grid", f"{st.name} built {len(grid.models)} models")
+                elif st.kind == "ensemble":
+                    m = self._build_ensemble(st, family_best, y, train, validation_frame)
+                    if m is not None:
+                        self.leaderboard.add(m)
+                        self._log("ensemble", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
+            except Exception as e:
+                self._log("error", f"{st.name} failed: {e!r}")
+            done_w += st.weight
+            job.update(done_w / total_w)
+
+        self._log("done", f"AutoML ended: {len(self.leaderboard.models)} models on leaderboard")
+        return self.leaderboard
+
+    def _update_family_best(self, family_best: dict[str, Model], m: Model) -> None:
+        cur = family_best.get(m.algo)
+        if cur is None or self.leaderboard._key(m) < self.leaderboard._key(cur):
+            family_best[m.algo] = m
+
+    def _build_ensemble(self, st: _Step, family_best: dict[str, Model], y, train, valid):
+        from h2o3_tpu.models.ensemble import StackedEnsemble
+
+        if st.params.get("flavor") == "best_of_family":
+            base = list(family_best.values())
+        else:
+            base = [m for m in self.leaderboard.models if m.algo != "stackedensemble"]
+        base = [m for m in base if m.cv_predictions is not None]
+        if len(base) < 2:
+            self._log("ensemble", f"{st.name} skipped (<2 stackable base models)")
+            return None
+        return StackedEnsemble(base_models=base, seed=self.spec.seed).train(
+            y=y, training_frame=train, validation_frame=valid
+        )

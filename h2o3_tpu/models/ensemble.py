@@ -33,7 +33,10 @@ class StackedEnsembleParams(CommonParams):
     base_models: Sequence[Any] = field(default_factory=tuple)  # Model | key
     metalearner_algorithm: str = "AUTO"  # AUTO->glm | glm | gbm | drf | deeplearning
     metalearner_params: dict = field(default_factory=dict)
-    metalearner_nfolds: int = 0
+    # The metalearner is cross-validated by default (H2O default is 0): its
+    # holdout predictions are the only honest estimate of ensemble
+    # generalization for leaderboard ranking (see _build).
+    metalearner_nfolds: int = 5
 
 
 def _shape_prediction_columns(raw: np.ndarray, is_classifier: bool) -> np.ndarray:
@@ -80,7 +83,12 @@ class StackedEnsembleModel(Model):
         return self.metalearner._predict_raw(lframe)
 
 
-def _matrix_frame(L: np.ndarray, y: np.ndarray | None = None, domain=None) -> Frame:
+def _matrix_frame(
+    L: np.ndarray,
+    y: np.ndarray | None = None,
+    domain=None,
+    weights: np.ndarray | None = None,
+) -> Frame:
     vecs = [Vec.from_numpy(L[:, j], "real") for j in range(L.shape[1])]
     names = [f"bm_{j}" for j in range(L.shape[1])]
     if y is not None:
@@ -89,6 +97,9 @@ def _matrix_frame(L: np.ndarray, y: np.ndarray | None = None, domain=None) -> Fr
         else:
             vecs.append(Vec.from_numpy(y, "real"))
         names.append("y")
+    if weights is not None:
+        vecs.append(Vec.from_numpy(weights, "real"))
+        names.append("__se_weights")
     return Frame(vecs, names)
 
 
@@ -98,12 +109,7 @@ class StackedEnsemble(ModelBuilder):
 
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: StackedEnsembleParams = self.params
-        models: list[Model] = []
-        for bm in p.base_models:
-            m = bm if isinstance(bm, Model) else DKV.get(str(bm))
-            assert isinstance(m, Model), f"base model {bm!r} not found"
-            models.append(m)
-        assert models, "stackedensemble requires base_models"
+        models = self._resolved_base  # resolved + checked in _validate
         ref = models[0]
         if p.response_column is None:
             p.response_column = ref.params.response_column
@@ -112,7 +118,8 @@ class StackedEnsemble(ModelBuilder):
 
         L = _level_one_cv_matrix(models)
         y, w = ref._response_and_weights(train)
-        lframe = _matrix_frame(L, y, domain if classification else None)
+        self._meta_weights = w is not None
+        lframe = _matrix_frame(L, y, domain if classification else None, weights=w)
         job.update(0.3)
 
         meta = self._make_metalearner(classification, len(domain) if domain else 1)
@@ -134,10 +141,14 @@ class StackedEnsemble(ModelBuilder):
         model.training_metrics = _make_metrics(model, np.asarray(raw), y, w)
         if valid is not None:
             model.validation_metrics = model._score_metrics(valid)
-        # CV-holdout metrics of the ensemble: metalearner's own training view
-        model.cross_validation_metrics = _make_metrics(
-            model, np.asarray(meta_model._predict_raw(lframe)), y, w
-        )
+        # Honest CV metrics: the metalearner is itself cross-validated on the
+        # level-one frame, so its holdout predictions estimate the ensemble's
+        # generalization (the metalearner training view would be optimistic
+        # resubstitution error and over-rank the SE on leaderboards).
+        if meta_model.cv_predictions is not None:
+            model.cross_validation_metrics = _make_metrics(
+                model, np.asarray(meta_model.cv_predictions), y, w
+            )
         return model
 
     def _make_metalearner(self, classification: bool, nclasses: int) -> ModelBuilder:
@@ -147,6 +158,9 @@ class StackedEnsemble(ModelBuilder):
         extra.setdefault("seed", p.seed)
         if p.metalearner_nfolds:
             extra["nfolds"] = p.metalearner_nfolds
+            extra["keep_cross_validation_predictions"] = True
+        if self._meta_weights:
+            extra["weights_column"] = "__se_weights"
         if algo in ("auto", "glm"):
             from h2o3_tpu.models.glm import GLM
 
@@ -182,9 +196,9 @@ class StackedEnsemble(ModelBuilder):
         predictions to line up row-for-row with ``train``."""
         p: StackedEnsembleParams = self.params
         models = [bm if isinstance(bm, Model) else DKV.get(str(bm)) for bm in p.base_models]
-        assert models and all(isinstance(m, Model) for m in models), (
-            "stackedensemble requires base_models trained in this session"
-        )
+        if not models or not all(isinstance(m, Model) for m in models):
+            raise ValueError("stackedensemble requires base_models trained in this session")
+        self._resolved_base = models
         ref = models[0]
         if p.response_column and p.response_column != ref.params.response_column:
             raise ValueError(

@@ -164,14 +164,14 @@ class GridSearch:
         n_planned = _space_size(self.hyper_params)
         if c.max_models:
             n_planned = min(n_planned, c.max_models)
-        walker = itertools.islice(
-            _walk(self.hyper_params, c), c.max_models if c.max_models else None
-        )
         # grid-level early stopping on the leaderboard metric sequence,
         # via the same ScoreKeeper the per-model driver uses
         keeper: ScoreKeeper | None = None
         metric_name: str | None = None
-        for i, hv in enumerate(walker):
+        for i, hv in enumerate(_walk(self.hyper_params, c)):
+            # max_models bounds models BUILT (failures don't consume budget)
+            if c.max_models and len(self.grid.models) >= c.max_models:
+                break
             if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
                 Log.info(f"grid {self.grid.key}: max_runtime_secs reached after {i} models")
                 break
@@ -197,5 +197,5 @@ class GridSearch:
             except Exception as e:  # a failing combo must not kill the grid (h2o keeps failures)
                 self.grid.failures.append((dict(hv), repr(e)))
                 Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
-            job.update((i + 1) / max(1, n_planned))
+            job.update(min(1.0, (i + 1) / max(1, n_planned)))
         return self.grid

@@ -1,0 +1,69 @@
+"""AutoML tests — modeled on upstream ``h2o-py/tests/testdir_algos/automl``
+pyunit scenarios [UNVERIFIED upstream path, SURVEY.md §4]."""
+
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.automl import AutoML
+from h2o3_tpu.frame.frame import Frame
+
+
+def _binary_frame(n=1500, seed=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    eta = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2]
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = np.where(y == 1, "yes", "no")
+    return Frame.from_pandas(df)
+
+
+def test_automl_builds_leaderboard_with_ensembles():
+    fr = _binary_frame()
+    aml = AutoML(
+        max_models=4,
+        nfolds=3,
+        seed=7,
+        max_runtime_secs=600.0,
+        exclude_algos=["DeepLearning"],
+    )
+    leader = aml.train(y="y", training_frame=fr)
+    lb = aml.leaderboard
+    assert leader is not None
+    assert len(lb.models) >= 4
+    # ensembles run even after max_models is hit
+    algos = {m.algo for m in lb.models}
+    assert "stackedensemble" in algos
+    # leaderboard is sorted on AUC descending
+    aucs = [r["auc"] for r in lb.as_table()]
+    assert aucs == sorted(aucs, reverse=True)
+    assert aucs[0] > 0.75
+    # every non-SE model was cross-validated for stacking
+    assert all(
+        m.cv_predictions is not None for m in lb.models if m.algo != "stackedensemble"
+    )
+    # events log recorded the plan execution
+    stages = {e["stage"] for e in aml.event_log}
+    assert {"init", "model", "done"} <= stages
+
+
+def test_automl_regression_and_exclusions():
+    rng = np.random.default_rng(4)
+    X = rng.random((1200, 3))
+    df = pd.DataFrame(X, columns=list("abc"))
+    df["y"] = 2 * X[:, 0] + np.sin(5 * X[:, 1]) + 0.05 * rng.normal(size=1200)
+    fr = Frame.from_pandas(df)
+    aml = AutoML(
+        max_models=3,
+        nfolds=3,
+        seed=7,
+        include_algos=["GBM", "GLM"],
+        max_runtime_secs=400.0,
+    )
+    aml.train(y="y", training_frame=fr)
+    algos = {m.algo for m in aml.leaderboard.models}
+    assert algos <= {"gbm", "glm", "stackedensemble"}
+    assert "drf" not in algos
+    # regression leaderboard sorted ascending on deviance
+    vals = [aml.leaderboard._metric_of(m) for m in aml.leaderboard.models]
+    assert vals == sorted(vals)
