@@ -39,6 +39,41 @@ from h2o3_tpu.frame.ops import (
 from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
 from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
 
+
+def save_model(model, path: str, force: bool = True) -> str:
+    """Binary model save (h2o.save_model successor)."""
+    from h2o3_tpu.persist import save_model as _sm
+
+    return _sm(model, path, force=force)
+
+
+def load_model(path: str):
+    """Binary model load (h2o.load_model successor)."""
+    from h2o3_tpu.persist import load_model as _lm
+
+    return _lm(path)
+
+
+def import_mojo(path: str):
+    """Load a portable scoring artifact for offline scoring (genmodel)."""
+    from h2o3_tpu.genmodel import MojoModel
+
+    return MojoModel.load(path)
+
+
+def start_server(ip: str = "127.0.0.1", port: int = 54321):
+    """Start the REST server (water.api.RequestServer successor)."""
+    from h2o3_tpu.api.server import start_server as _ss
+
+    return _ss(ip, port)
+
+
+def connect(url: str = "http://127.0.0.1:54321", **kw):
+    """Connect to a remote coordinator over REST (h2o.connect successor)."""
+    from h2o3_tpu.client import connect as _c
+
+    return _c(url, **kw)
+
 __all__ = [
     "init",
     "cluster_info",
@@ -52,4 +87,9 @@ __all__ = [
     "ls",
     "remove",
     "remove_all",
+    "start_server",
+    "connect",
+    "save_model",
+    "load_model",
+    "import_mojo",
 ]

@@ -1,0 +1,6 @@
+"""REST API — successor of ``water.api.RequestServer`` / ``*Handler`` /
+``schemas3`` [UNVERIFIED upstream paths, SURVEY.md §2.1 L6]."""
+
+from h2o3_tpu.api.server import H2OServer, start_server
+
+__all__ = ["H2OServer", "start_server"]

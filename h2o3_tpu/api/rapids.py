@@ -1,0 +1,466 @@
+"""Rapids expression language — successor of ``water.rapids.Rapids`` /
+``Session`` / ``Env`` / the ``ast/**`` node classes [UNVERIFIED upstream
+paths, SURVEY.md §2.1].
+
+H2O clients never run frame ops locally: ``H2OFrame`` builds a lazy
+expression tree that is shipped as a Lisp-ish string to ``POST /99/Rapids``
+(e.g. ``(tmp= k (cols_py frame_1 'age'))``) and evaluated server-side
+against DKV frames. This evaluator keeps that wire contract; every AST op
+dispatches to the device-backed ops in :mod:`h2o3_tpu.frame.ops` — the AST
+layer adds no compute of its own, exactly like upstream (AST nodes call
+MRTasks; here they call shard_map ops).
+
+Grammar: ``(op arg ...)``, numbers, ``'str'``/``"str"``, number lists
+``[1 2 3]``, string lists ``['a' 'b']``, bare symbols = DKV keys (frames) or
+special consts (TRUE/FALSE/NaN). ``(tmp= key expr)`` names a result.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame import ops as OPS
+from h2o3_tpu.frame.frame import Frame, Vec
+
+
+class RapidsError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# tokenizer / parser
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<lparen>\() | (?P<rparen>\)) |
+        (?P<lbrack>\[) | (?P<rbrack>\]) |
+        (?P<string>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*") |
+        (?P<number>-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?) |
+        (?P<symbol>[^\s()\[\]]+)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(src: str):
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if not m:
+            raise RapidsError(f"bad token at {src[pos:pos + 20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group(m.lastgroup)
+        yield kind, text
+    yield "eof", ""
+
+
+class _Sym(str):
+    """A bare symbol (op name or DKV key)."""
+
+
+def _parse(tokens) -> Any:
+    kind, text = next(tokens)
+    if kind == "lparen":
+        out = []
+        while True:
+            item = _parse_peekable(tokens)
+            if item is _RPAREN:
+                return out
+            out.append(item)
+    if kind == "lbrack":
+        out = []
+        while True:
+            item = _parse_peekable(tokens)
+            if item is _RBRACK:
+                return np.array(out, dtype=object)
+            out.append(item)
+    if kind == "string":
+        return text[1:-1].replace("\\'", "'").replace('\\"', '"')
+    if kind == "number":
+        v = float(text)
+        return int(v) if v.is_integer() and "e" not in text.lower() and "." not in text else v
+    if kind == "symbol":
+        return _Sym(text)
+    raise RapidsError(f"unexpected {kind}")
+
+
+_RPAREN = object()
+_RBRACK = object()
+
+
+def _parse_peekable(tokens):
+    kind, text = next(tokens)
+    if kind == "rparen":
+        return _RPAREN
+    if kind == "rbrack":
+        return _RBRACK
+    if kind == "lparen":
+        out = []
+        while True:
+            item = _parse_peekable(tokens)
+            if item is _RPAREN:
+                return out
+            out.append(item)
+    if kind == "lbrack":
+        out = []
+        while True:
+            item = _parse_peekable(tokens)
+            if item is _RBRACK:
+                return np.array(out, dtype=object)
+            out.append(item)
+    if kind == "string":
+        return text[1:-1].replace("\\'", "'").replace('\\"', '"')
+    if kind == "number":
+        v = float(text)
+        return int(v) if v.is_integer() and "e" not in text.lower() and "." not in text else v
+    if kind == "symbol":
+        return _Sym(text)
+    if kind == "eof":
+        raise RapidsError("unexpected end of expression")
+    raise RapidsError(f"unexpected {kind}")
+
+
+def parse(src: str) -> Any:
+    return _parse(_tokenize(src))
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+
+
+def _as_frame(x) -> Frame:
+    if isinstance(x, Frame):
+        return x
+    if isinstance(x, Vec):
+        return Frame([x], [x.name or "C1"])
+    raise RapidsError(f"expected a frame, got {type(x).__name__}")
+
+
+def _as_vec(x) -> Vec:
+    if isinstance(x, Vec):
+        return x
+    if isinstance(x, Frame):
+        if x.ncol != 1:
+            raise RapidsError(f"expected 1 column, frame has {x.ncol}")
+        return x.vec(0)
+    raise RapidsError(f"expected a column, got {type(x).__name__}")
+
+
+_BINOPS = {
+    "+": "__add__", "-": "__sub__", "*": "__mul__", "/": "__truediv__",
+    "%": "__mod__", "^": "__pow__", "intDiv": "__floordiv__",
+    "<": "__lt__", "<=": "__le__", ">": "__gt__", ">=": "__ge__",
+    "==": "__eq__", "!=": "__ne__", "&": "__and__", "|": "__or__",
+}
+_UNOPS = {
+    "abs": "abs", "exp": "exp", "log": "log", "log10": "log10",
+    "sqrt": "sqrt", "floor": "floor", "ceiling": "ceil", "trunc": "trunc",
+    "cos": "cos", "sin": "sin", "tan": "tan", "not": "not", "!": "not",
+    "sign": "sign", "log2": "log2", "log1p": "log1p",
+}
+_AGGS = ("sum", "mean", "min", "max", "sd", "var", "median", "prod")
+
+
+class Session:
+    """Rapids session — temp-key lifetime tracking (``water.rapids.Session``)."""
+
+    def __init__(self, session_id: str = "default"):
+        self.session_id = session_id
+        self.temps: set[str] = set()
+
+
+_SESSIONS: dict[str, Session] = {}
+
+
+def _env_lookup(sym: _Sym):
+    v = DKV.get(str(sym))
+    if v is not None:
+        return v
+    consts = {"TRUE": 1.0, "FALSE": 0.0, "NA": float("nan"), "NaN": float("nan"),
+              "null": None, "()": None}
+    if sym in consts:
+        return consts[sym]
+    raise RapidsError(f"unknown identifier {sym!r}")
+
+
+def _eval(node, sess: Session):
+    if isinstance(node, _Sym):
+        return _env_lookup(node)
+    if isinstance(node, (int, float, str)):
+        return node
+    if isinstance(node, np.ndarray):
+        return np.array([_eval(x, sess) for x in node], dtype=object)
+    if isinstance(node, list):
+        if not node:
+            return None
+        head = node[0]
+        if not isinstance(head, _Sym):
+            raise RapidsError(f"operator position must be a symbol, got {head!r}")
+        return _apply(str(head), node[1:], sess)
+    raise RapidsError(f"cannot eval {node!r}")
+
+
+def _num_list(x) -> list:
+    if isinstance(x, np.ndarray):
+        return [float(v) for v in x]
+    return [float(x)]
+
+
+def _sel_list(x):
+    """Column/row selector: number, number list, string, string list."""
+    if isinstance(x, np.ndarray):
+        return list(x)
+    return [x]
+
+
+def _apply(op: str, raw_args: list, sess: Session):
+    # special forms first (unevaluated args)
+    if op in ("tmp=", "rapids_tmp="):
+        key = str(raw_args[0])
+        val = _eval(raw_args[1], sess)
+        if isinstance(val, (Frame, Vec)):
+            fr = _as_frame(val)
+            DKV.remove(fr.key)  # re-home under the client-chosen key
+            fr.key = key
+            DKV.put(key, fr)
+            sess.temps.add(key)
+            return fr
+        DKV.put(key, val)
+        return val
+    if op == "rm":
+        for a in raw_args:
+            DKV.remove(str(a))
+        return None
+    if op == "GB":
+        # special form: agg names are bare symbols (mean/sum/nrow/...), not
+        # identifiers — (GB frame [by...] agg col na  agg col na ...)
+        fr = _as_frame(_eval(raw_args[0], sess))
+        by = [fr.names[int(c)] if isinstance(c, (int, float)) else str(c)
+              for c in _sel_list(raw_args[1])]
+        rest = raw_args[2:]
+        spec: dict[str, list[str]] = {}
+        for i in range(0, len(rest), 3):
+            agg = str(rest[i])
+            col = rest[i + 1]
+            col = fr.names[int(col)] if isinstance(col, (int, float)) else str(col)
+            spec.setdefault(col, []).append({"nrow": "count"}.get(agg, agg))
+        return OPS.group_by(fr, by).agg(spec)
+
+    args = [_eval(a, sess) for a in raw_args]
+
+    # -- arithmetic / comparison ------------------------------------------
+    if op in _BINOPS:
+        a, b = args
+        if isinstance(a, Frame) and a.ncol == 1:
+            a = a.vec(0)
+        if isinstance(b, Frame) and b.ncol == 1:
+            b = b.vec(0)
+        if isinstance(a, Vec):
+            return getattr(a, _BINOPS[op])(b)
+        if isinstance(b, Vec):  # scalar OP vec
+            refl = {"+": "__radd__", "-": "__rsub__", "*": "__rmul__",
+                    "/": "__rtruediv__", "^": "__rpow__", "%": "__rmod__"}
+            if op in refl:
+                return getattr(b, refl[op])(a)
+            flip = {"<": "__gt__", "<=": "__ge__", ">": "__lt__", ">=": "__le__",
+                    "==": "__eq__", "!=": "__ne__", "&": "__and__", "|": "__or__"}
+            return getattr(b, flip[op])(a)
+        return _scalar_binop(op, a, b)
+    if op in _UNOPS:
+        (a,) = args
+        if isinstance(a, (Frame, Vec)):
+            return OPS._unop(_as_vec(a), _UNOPS[op])
+        return float(getattr(np, {"not": "logical_not"}.get(_UNOPS[op], _UNOPS[op]))(a))
+
+    # -- aggregates --------------------------------------------------------
+    if op in _AGGS:
+        return _np_agg(op, _as_vec(args[0]))
+    if op in ("nrow", "ncol"):
+        fr = _as_frame(args[0])
+        return fr.nrow if op == "nrow" else fr.ncol
+    if op == "colnames":
+        return np.array(_as_frame(args[0]).names, dtype=object)
+    if op == "levels":
+        v = _as_vec(args[0])
+        return np.array(list(v.domain or ()), dtype=object)
+
+    # -- slicing / mutation ------------------------------------------------
+    if op in ("cols", "cols_py"):
+        fr = _as_frame(args[0])
+        return fr[_normalize_cols(fr, _sel_list(args[1]))]
+    if op in ("rows",):
+        fr = _as_frame(args[0])
+        sel = args[1]
+        if isinstance(sel, (Frame, Vec)):
+            mask = _as_vec(sel).to_numpy().astype(bool)
+            return fr.subset_rows(mask)
+        if isinstance(sel, np.ndarray):
+            idx = np.array([int(v) for v in sel])
+            mask = np.zeros(fr.nrow, bool)
+            mask[idx] = True
+            return fr.subset_rows(mask)
+        raise RapidsError("rows selector must be a mask column or index list")
+    if op == ":=":  # (:= frame newval col rows)
+        fr = _as_frame(args[0])
+        val = args[1]
+        cols = _normalize_cols(fr, _sel_list(args[2]))
+        for c in cols:
+            OPS._replace_vec(fr, fr.names[c] if isinstance(c, int) else c, _as_vec(val))
+        return fr
+    if op == "append":  # (append frame vec 'name')
+        fr = _as_frame(args[0])
+        fr[str(args[2])] = _as_vec(args[1])
+        return fr
+    if op == "cbind":
+        frames = [_as_frame(a) for a in args]
+        base = frames[0]
+        out = Frame([base.vec(i) for i in range(base.ncol)], list(base.names))
+        for f in frames[1:]:
+            for n in f.names:
+                out[n] = f.vec(n)
+        return out
+    if op == "rbind":
+        import pandas as pd
+
+        dfs = [_as_frame(a).to_pandas() for a in args]
+        return Frame.from_pandas(pd.concat(dfs, ignore_index=True))
+
+    # -- frame ops ---------------------------------------------------------
+    if op == "merge":
+        left, right = _as_frame(args[0]), _as_frame(args[1])
+        all_left = bool(args[2]) if len(args) > 2 else False
+        all_right = bool(args[3]) if len(args) > 3 else False
+        how = ("outer" if all_left and all_right
+               else "left" if all_left else "right" if all_right else "inner")
+        return OPS.merge(left, right, how=how)
+    if op == "sort":
+        fr = _as_frame(args[0])
+        cols = _normalize_cols(fr, _sel_list(args[1]))
+        names = [fr.names[c] for c in cols]
+        asc = [bool(b) for b in _sel_list(args[2])] if len(args) > 2 else True
+        return OPS.sort(fr, names, ascending=asc)
+    if op == "unique":
+        return OPS.unique(_as_vec(args[0]))
+    if op == "table":
+        v2 = _as_vec(args[1]) if len(args) > 1 and isinstance(args[1], (Frame, Vec)) else None
+        return OPS.table(_as_vec(args[0]), v2)
+    if op == "quantile":
+        fr = _as_frame(args[0])
+        probs = _num_list(args[1]) if len(args) > 1 else None
+        return OPS.quantile(fr, probs) if probs else OPS.quantile(fr)
+    if op == "ifelse":
+        return OPS.ifelse(_as_vec(args[0]), _maybe_vec(args[1]), _maybe_vec(args[2]))
+    if op == "is.na":
+        return _as_vec(args[0]).isna()
+    if op == "h2o.impute":
+        fr = _as_frame(args[0])
+        col = args[1]
+        col = fr.names[int(col)] if isinstance(col, (int, float)) else str(col)
+        return OPS.impute(fr, col, method=str(args[2]) if len(args) > 2 else "mean")
+    if op == "h2o.runif":
+        fr = _as_frame(args[0])
+        seed = int(args[1]) if len(args) > 1 and args[1] is not None else -1
+        rng = np.random.default_rng(seed if seed > 0 else None)
+        return Vec.from_numpy(rng.random(fr.nrow), "real")
+    if op in ("asfactor", "as.factor"):
+        return OPS.asfactor(_as_vec(args[0]))
+    if op in ("asnumeric", "as.numeric"):
+        return OPS.asnumeric(_as_vec(args[0]))
+    if op in ("ascharacter", "as.character"):
+        return OPS.ascharacter(_as_vec(args[0]))
+    if op == "hist":
+        return OPS.hist(_as_vec(args[0]), int(args[1]) if len(args) > 1 else 20)
+    if op == "cor":
+        return OPS.cor(_as_frame(args[0]))
+    if op == "scale":
+        return OPS.scale(_as_frame(args[0]),
+                         center=bool(args[1]) if len(args) > 1 else True,
+                         scale_=bool(args[2]) if len(args) > 2 else True)
+
+    # -- string / time -----------------------------------------------------
+    str_ops = {"toupper": OPS.toupper, "tolower": OPS.tolower, "trim": OPS.trim,
+               "nchar": OPS.nchar, "strsplit": OPS.strsplit, "grep": OPS.grep}
+    if op in str_ops:
+        v = _as_vec(args[0])
+        return str_ops[op](v, *[str(a) for a in args[1:]]) if args[1:] else str_ops[op](v)
+    if op in ("sub", "gsub"):
+        # rapids arg order: (sub pattern replacement frame)
+        pat, repl, v = str(args[0]), str(args[1]), _as_vec(args[2])
+        return (OPS.sub if op == "sub" else OPS.gsub)(v, pat, repl)
+    if op == "substring":
+        v = _as_vec(args[0])
+        return OPS.substring(v, int(args[1]), int(args[2]) if len(args) > 2 else None)
+    time_ops = {"year": OPS.year, "month": OPS.month, "day": OPS.day,
+                "hour": OPS.hour, "minute": OPS.minute, "second": OPS.second,
+                "dayOfWeek": OPS.day_of_week, "week": OPS.week}
+    if op in time_ops:
+        return time_ops[op](_as_vec(args[0]))
+
+    raise RapidsError(f"unknown rapids op {op!r}")
+
+
+def _maybe_vec(x):
+    return _as_vec(x) if isinstance(x, Frame) else x
+
+
+def _np_agg(op: str, v: Vec) -> float:
+    x = v.to_numpy().astype(np.float64)
+    x = x[~np.isnan(x)]
+    fn = {"sum": np.sum, "mean": np.mean, "min": np.min, "max": np.max,
+          "sd": lambda a: np.std(a, ddof=1), "var": lambda a: np.var(a, ddof=1),
+          "median": np.median, "prod": np.prod}[op]
+    return float(fn(x)) if len(x) else float("nan")
+
+
+def _scalar_binop(op: str, a, b):
+    import operator
+
+    fn = {"+": operator.add, "-": operator.sub, "*": operator.mul,
+          "/": operator.truediv, "%": operator.mod, "^": operator.pow,
+          "intDiv": operator.floordiv,
+          "<": operator.lt, "<=": operator.le, ">": operator.gt,
+          ">=": operator.ge, "==": operator.eq, "!=": operator.ne,
+          "&": lambda x, y: bool(x) and bool(y),
+          "|": lambda x, y: bool(x) or bool(y)}[op]
+    out = fn(a, b)
+    return float(out) if isinstance(out, bool) else out
+
+
+def _normalize_cols(fr: Frame, sel: list) -> list[int]:
+    out = []
+    for s in sel:
+        if isinstance(s, (int, float)):
+            out.append(int(s))
+        else:
+            if str(s) not in fr.names:
+                raise RapidsError(f"no column {s!r}")
+            out.append(fr.names.index(str(s)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public entry (the /99/Rapids handler body)
+
+
+def rapids_eval(ast: str, session: str | None = None) -> dict:
+    """Evaluate a Rapids string; returns the wire-shaped result dict."""
+    sess = _SESSIONS.setdefault(session or "default", Session(session or "default"))
+    result = _eval(parse(ast), sess)
+    if isinstance(result, (Frame, Vec)):
+        fr = _as_frame(result)
+        key = getattr(fr, "key", None) or DKV.make_key("rapids")
+        fr.key = key
+        DKV.put(key, fr)  # results are always client-fetchable by key
+        return {"key": {"name": key}, "num_rows": fr.nrow, "num_cols": fr.ncol}
+    if result is None:
+        return {"string": ""}
+    if isinstance(result, str):
+        return {"string": result}
+    if isinstance(result, np.ndarray):
+        return {"string": str(result.tolist())}
+    return {"scalar": float(result)}

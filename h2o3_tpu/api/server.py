@@ -1,0 +1,554 @@
+"""REST server — successor of ``water.api.RequestServer`` (route table),
+``water.api.*Handler`` (endpoint logic) and the ``schemas3`` JSON mapping
+[UNVERIFIED upstream paths, SURVEY.md §2.1, §3].
+
+H2O serves a versioned HTTP surface (`/3/...`, `/99/...`) from every node via
+Jetty; clients (Python/R/Flow) are pure REST consumers. Here the control
+plane is one coordinator process, so a stdlib ThreadingHTTPServer is the
+idiomatic replacement (fastapi/uvicorn are not in the image — and the
+request volume is control-plane only; data never moves over REST except
+file upload/download).
+
+Routes follow H2O's v3 names and JSON shapes closely enough that a client
+written against H2O's wire format finds the same fields
+(`__meta.schema_type`, `frames[]`, `models[]`, `job.status`...), without
+chasing exact schema-class parity (the reflective Schema/TypeMap machinery
+is JVM-specific; a dict is the Python-native schema).
+
+Long work (model builds, parses) runs as Jobs in threads; handlers return a
+job key immediately and ``/3/Jobs/{key}`` polls — H2O's exact contract.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils.log import Log
+
+_ALGOS = ("gbm", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
+          "naivebayes", "isolationforest", "stackedensemble")
+
+
+def _builder_cls(algo: str):
+    from h2o3_tpu import models as M
+
+    return {
+        "gbm": M.GBM, "glm": M.GLM, "drf": M.DRF, "xrt": M.XRT,
+        "deeplearning": M.DeepLearning, "kmeans": M.KMeans, "pca": M.PCA,
+        "svd": M.SVD, "naivebayes": M.NaiveBayes,
+        "isolationforest": M.IsolationForest,
+        "stackedensemble": M.StackedEnsemble,
+    }[algo]
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        v = float(o)
+        return v if np.isfinite(v) else None
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, float) and not np.isfinite(o):
+        return None
+    return str(o)
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+# ---------------------------------------------------------------------------
+# endpoint logic ("Handlers")
+
+
+def _frame_schema(fr: Frame, key: str) -> dict:
+    cols = []
+    for name in fr.names:
+        v = fr.vec(name)
+        st = v.stats() if hasattr(v, "stats") else {}
+        cols.append({
+            "label": name,
+            "type": {"real": "real", "int": "int", "enum": "enum",
+                     "string": "string", "time": "time"}.get(v.kind, v.kind),
+            "domain": list(v.domain) if v.domain else None,
+            "missing_count": int(st.get("naCnt", 0)) if st else 0,
+            "mean": st.get("mean"), "sigma": st.get("sigma"),
+            "min": st.get("min"), "max": st.get("max"),
+        })
+    return {
+        "__meta": {"schema_type": "Frame"},
+        "frame_id": {"name": key},
+        "rows": fr.nrow, "columns": cols, "column_count": fr.ncol,
+    }
+
+
+def _model_schema(m) -> dict:
+    return {
+        "__meta": {"schema_type": "Model"},
+        "model_id": {"name": m.key},
+        "algo": m.algo,
+        "response_column_name": m.params.response_column,
+        "output": {
+            "model_category": (
+                "Binomial" if m.is_classifier and m.nclasses == 2
+                else "Multinomial" if m.is_classifier
+                else "Regression"
+            ),
+            "training_metrics": m.training_metrics.to_dict() if m.training_metrics else None,
+            "validation_metrics": m.validation_metrics.to_dict() if m.validation_metrics else None,
+            "cross_validation_metrics": m.cross_validation_metrics.to_dict()
+            if m.cross_validation_metrics else None,
+            "variable_importances": m.varimp() if hasattr(m, "varimp") else None,
+        },
+        "run_time_ms": m.run_time_ms,
+    }
+
+
+class Endpoints:
+    """One method per route; the RequestServer below dispatches here."""
+
+    # -- cloud / misc -----------------------------------------------------
+    def cloud(self, params):
+        from h2o3_tpu.cluster.cloud import cluster_info
+
+        info = cluster_info()
+        return {
+            "__meta": {"schema_type": "Cloud"},
+            "version": info.get("version", "0.1.0"),
+            "cloud_name": info.get("cloud_name", "h2o3_tpu"),
+            "cloud_size": info.get("cloud_size", 1),
+            "cloud_healthy": True,
+            "nodes": [{"h2o": f"device_{i}", "healthy": True}
+                      for i in range(info.get("cloud_size", 1))],
+        }
+
+    def ping(self, params):
+        return {"__meta": {"schema_type": "Ping"}, "ok": True}
+
+    def about(self, params):
+        from h2o3_tpu import __version__
+
+        return {"__meta": {"schema_type": "About"},
+                "entries": [{"name": "Build version", "value": __version__},
+                            {"name": "Backend", "value": "jax/XLA TPU"}]}
+
+    # -- ingest -----------------------------------------------------------
+    def import_files(self, params):
+        path = params.get("path")
+        if not path:
+            raise ApiError(400, "path is required")
+        return {"__meta": {"schema_type": "ImportFiles"},
+                "files": [path], "destination_frames": [path], "fails": [], "dels": []}
+
+    def parse_setup(self, params):
+        from h2o3_tpu.frame.parse import parse_setup
+
+        srcs = params.get("source_frames")
+        if isinstance(srcs, str):
+            srcs = json.loads(srcs) if srcs.startswith("[") else [srcs]
+        setup = parse_setup(srcs[0])
+        return {"__meta": {"schema_type": "ParseSetup"},
+                "source_frames": srcs, **setup}
+
+    def parse(self, params):
+        from h2o3_tpu.frame.parse import parse
+
+        srcs = params.get("source_frames")
+        if isinstance(srcs, str):
+            srcs = json.loads(srcs) if srcs.startswith("[") else [srcs]
+        dest = params.get("destination_frame")
+        setup = {"source_frames": srcs}
+        for k in ("separator", "column_types", "column_names"):
+            if params.get(k) is not None:
+                setup[k] = params[k] if not isinstance(params[k], str) or not params[k].startswith(("[", "{")) else json.loads(params[k])
+        job = Job(lambda j: parse(setup, destination_frame=dest), f"Parse {srcs[0]}")
+        job.start()
+        return {"__meta": {"schema_type": "Parse"}, "job": _job_schema(job),
+                "destination_frame": {"name": dest or srcs[0]}}
+
+    # -- frames -----------------------------------------------------------
+    def frames_list(self, params):
+        out = []
+        for k in DKV.keys():
+            v = DKV.get(k)
+            if isinstance(v, Frame):
+                out.append({"frame_id": {"name": k}, "rows": v.nrow, "column_count": v.ncol})
+        return {"__meta": {"schema_type": "Frames"}, "frames": out}
+
+    def frame_get(self, params, key):
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise ApiError(404, f"Frame {key} not found")
+        return {"__meta": {"schema_type": "Frames"}, "frames": [_frame_schema(fr, key)]}
+
+    def frame_summary(self, params, key):
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise ApiError(404, f"Frame {key} not found")
+        return {"__meta": {"schema_type": "FrameSummary"},
+                "frames": [_frame_schema(fr, key)],
+                "summary": json.loads(fr.describe().to_json())}
+
+    def frame_delete(self, params, key):
+        DKV.remove(key)
+        return {"__meta": {"schema_type": "Frames"}, "frames": []}
+
+    # -- jobs -------------------------------------------------------------
+    def jobs_list(self, params):
+        jobs = [j for j in DKV.values_of_type(Job)]
+        return {"__meta": {"schema_type": "Jobs"}, "jobs": [_job_schema(j) for j in jobs]}
+
+    def job_get(self, params, key):
+        j = DKV.get(key)
+        if not isinstance(j, Job):
+            raise ApiError(404, f"Job {key} not found")
+        return {"__meta": {"schema_type": "Jobs"}, "jobs": [_job_schema(j)]}
+
+    def job_cancel(self, params, key):
+        j = DKV.get(key)
+        if not isinstance(j, Job):
+            raise ApiError(404, f"Job {key} not found")
+        j.cancel()
+        return {"__meta": {"schema_type": "Jobs"}, "jobs": [_job_schema(j)]}
+
+    # -- model builders ---------------------------------------------------
+    def model_builders(self, params):
+        return {"__meta": {"schema_type": "ModelBuilders"},
+                "model_builders": {a: {"algo": a, "visibility": "Stable"} for a in _ALGOS}}
+
+    def build_model(self, params, algo):
+        if algo not in _ALGOS:
+            raise ApiError(404, f"unknown algo {algo!r}")
+        cls = _builder_cls(algo)
+        import dataclasses
+
+        valid = {f.name for f in dataclasses.fields(cls.PARAMS_CLS)}
+        kwargs = {}
+        x = y = train_key = valid_key = None
+        for k, v in params.items():
+            if k in ("training_frame", "validation_frame"):
+                name = v["name"] if isinstance(v, dict) else str(v)
+                if k == "training_frame":
+                    train_key = name
+                else:
+                    valid_key = name
+            elif k == "response_column":
+                y = v
+            elif k in ("x", "ignored_columns") and v is not None:
+                vv = json.loads(v) if isinstance(v, str) and v.startswith("[") else v
+                if k == "x":
+                    x = vv
+                else:
+                    kwargs["ignored_columns"] = tuple(vv)
+            elif k == "model_id":
+                continue  # keys are server-assigned
+            elif k in valid:
+                kwargs[k] = _coerce_param(cls.PARAMS_CLS, k, v)
+        if train_key is None:
+            raise ApiError(400, "training_frame is required")
+        builder = cls(**kwargs)
+        job = Job(
+            lambda j: builder.train(
+                x=x, y=y, training_frame=train_key, validation_frame=valid_key
+            ),
+            f"{algo} build",
+        )
+        job.start()
+        return {"__meta": {"schema_type": "ModelBuilder"},
+                "job": _job_schema(job), "algo": algo,
+                "messages": [], "error_count": 0}
+
+    # -- models -----------------------------------------------------------
+    def models_list(self, params):
+        from h2o3_tpu.models.model_base import Model
+
+        ms = list(DKV.values_of_type(Model))
+        return {"__meta": {"schema_type": "Models"},
+                "models": [{"model_id": {"name": m.key}, "algo": m.algo} for m in ms]}
+
+    def model_get(self, params, key):
+        m = _get_model(key)
+        return {"__meta": {"schema_type": "Models"}, "models": [_model_schema(m)]}
+
+    def model_delete(self, params, key):
+        DKV.remove(key)
+        return {"__meta": {"schema_type": "Models"}, "models": []}
+
+    # -- predictions ------------------------------------------------------
+    def predict(self, params, model_key, frame_key):
+        m = _get_model(model_key)
+        fr = DKV.get(frame_key)
+        if not isinstance(fr, Frame):
+            raise ApiError(404, f"Frame {frame_key} not found")
+        dest = params.get("predictions_frame") or DKV.make_key("prediction")
+        pred = m.predict(fr)
+        DKV.put(dest, pred)
+        return {"__meta": {"schema_type": "Predictions"},
+                "predictions_frame": {"name": dest},
+                "model_metrics": []}
+
+    def model_metrics(self, params, model_key, frame_key):
+        m = _get_model(model_key)
+        fr = DKV.get(frame_key)
+        if not isinstance(fr, Frame):
+            raise ApiError(404, f"Frame {frame_key} not found")
+        mm = m.model_performance(fr)
+        return {"__meta": {"schema_type": "ModelMetrics"},
+                "model_metrics": [mm.to_dict()]}
+
+    # -- automl -----------------------------------------------------------
+    def automl_build(self, params):
+        from h2o3_tpu.automl import AutoML
+
+        spec = params.get("build_control", {})
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        input_spec = params.get("input_spec", {})
+        if isinstance(input_spec, str):
+            input_spec = json.loads(input_spec)
+        build_models = params.get("build_models", {})
+        if isinstance(build_models, str):
+            build_models = json.loads(build_models)
+
+        kwargs = {}
+        sc = spec.get("stopping_criteria", {})
+        for src, dst in (("max_models", "max_models"),
+                         ("max_runtime_secs", "max_runtime_secs"),
+                         ("seed", "seed")):
+            if sc.get(src) is not None:
+                kwargs[dst] = sc[src]
+        if spec.get("nfolds") is not None:
+            kwargs["nfolds"] = spec["nfolds"]
+        if spec.get("project_name"):
+            kwargs["project_name"] = spec["project_name"]
+        for src in ("include_algos", "exclude_algos"):
+            if build_models.get(src):
+                kwargs[src] = build_models[src]
+
+        train_key = (input_spec.get("training_frame") or {})
+        train_key = train_key.get("name") if isinstance(train_key, dict) else train_key
+        y = (input_spec.get("response_column") or {})
+        y = y.get("column_name") if isinstance(y, dict) else y
+        if not train_key or not y:
+            raise ApiError(400, "input_spec.training_frame and response_column required")
+
+        aml = AutoML(**kwargs)
+        job = Job(lambda j: aml.train(y=y, training_frame=train_key), "AutoML build")
+        job.start()
+        return {"__meta": {"schema_type": "AutoMLBuilder"},
+                "job": _job_schema(job),
+                "automl_id": {"name": aml.key}}
+
+    def automl_get(self, params, key):
+        aml = DKV.get(key)
+        if aml is None or not hasattr(aml, "leaderboard"):
+            raise ApiError(404, f"AutoML {key} not found")
+        lb = aml.leaderboard
+        return {"__meta": {"schema_type": "AutoML"},
+                "automl_id": {"name": aml.key},
+                "leaderboard_table": lb.as_table() if lb else [],
+                "leader": {"name": lb.leader.key} if lb and lb.leader else None,
+                "event_log": aml.event_log}
+
+    # -- rapids (frame expression eval) -----------------------------------
+    def rapids(self, params):
+        from h2o3_tpu.api.rapids import rapids_eval
+
+        ast = params.get("ast")
+        if not ast:
+            raise ApiError(400, "ast is required")
+        result = rapids_eval(ast, session=params.get("session_id"))
+        return {"__meta": {"schema_type": "Rapids"}, **result}
+
+
+def _get_model(key):
+    from h2o3_tpu.models.model_base import Model
+
+    m = DKV.get(key)
+    if not isinstance(m, Model):
+        raise ApiError(404, f"Model {key} not found")
+    return m
+
+
+def _job_schema(j: Job) -> dict:
+    return {
+        "key": {"name": j.key},
+        "description": j.description,
+        "status": j.status,
+        "progress": j.progress,
+        "exception": j.exception,
+        "dest": {"name": getattr(getattr(j, "result", None), "key", "")} if j.result is not None else None,
+    }
+
+
+def _coerce_param(params_cls, name: str, v):
+    """Coerce wire strings to the dataclass field's type (H2O's Schema
+    fill-from-parms step)."""
+    import dataclasses
+    import typing
+
+    if not isinstance(v, str):
+        return v
+    fld = {f.name: f for f in dataclasses.fields(params_cls)}[name]
+    t = fld.type
+    if v.startswith(("[", "{")):
+        return json.loads(v)
+    base = str(t)
+    if "bool" in base:
+        return v.lower() in ("1", "true", "yes")
+    if "int" in base:
+        try:
+            return int(v)
+        except ValueError:
+            return float(v)
+    if "float" in base:
+        return float(v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# the RequestServer: route table + HTTP plumbing
+
+_EP = Endpoints()
+
+# (method, regex) -> endpoint; group captures become positional args
+_ROUTES: list[tuple[str, re.Pattern, object]] = [
+    ("GET", r"/3/Cloud", _EP.cloud),
+    ("GET", r"/3/Ping", _EP.ping),
+    ("GET", r"/3/About", _EP.about),
+    ("GET", r"/3/ImportFiles", _EP.import_files),
+    ("POST", r"/3/ImportFiles", _EP.import_files),
+    ("POST", r"/3/ParseSetup", _EP.parse_setup),
+    ("POST", r"/3/Parse", _EP.parse),
+    ("GET", r"/3/Frames", _EP.frames_list),
+    ("GET", r"/3/Frames/([^/]+)/summary", _EP.frame_summary),
+    ("GET", r"/3/Frames/([^/]+)", _EP.frame_get),
+    ("DELETE", r"/3/Frames/([^/]+)", _EP.frame_delete),
+    ("GET", r"/3/Jobs", _EP.jobs_list),
+    ("GET", r"/3/Jobs/([^/]+)", _EP.job_get),
+    ("POST", r"/3/Jobs/([^/]+)/cancel", _EP.job_cancel),
+    ("GET", r"/3/ModelBuilders", _EP.model_builders),
+    ("POST", r"/3/ModelBuilders/([^/]+)", _EP.build_model),
+    ("GET", r"/3/Models", _EP.models_list),
+    ("GET", r"/3/Models/([^/]+)", _EP.model_get),
+    ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),
+    ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
+    ("POST", r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", _EP.model_metrics),
+    ("POST", r"/99/Rapids", _EP.rapids),
+    ("POST", r"/99/AutoMLBuilder", _EP.automl_build),
+    ("GET", r"/99/AutoML/([^/]+)", _EP.automl_get),
+]
+_COMPILED = [(m, re.compile("^" + p + "/?$"), h) for m, p, h in _ROUTES]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "h2o3_tpu"
+
+    def log_message(self, fmt, *args):  # route HTTP logs into our logger
+        Log.debug(f"REST {self.address_string()} {fmt % args}")
+
+    def _params(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        params = {k: v[0] if len(v) == 1 else v
+                  for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            body = self.rfile.read(length)
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                params.update(json.loads(body))
+            else:  # h2o clients POST form-encoded
+                params.update({k: v[0] if len(v) == 1 else v
+                               for k, v in urllib.parse.parse_qs(body.decode()).items()})
+        return params
+
+    def _dispatch(self, method: str):
+        path = urllib.parse.urlparse(self.path).path
+        for m, pat, handler in _COMPILED:
+            if m != method:
+                continue
+            match = pat.match(path)
+            if match:
+                try:
+                    params = self._params()
+                    args = [urllib.parse.unquote(g) for g in match.groups()]
+                    out = handler(params, *args)
+                    self._reply(200, out)
+                except ApiError as e:
+                    self._reply(e.status, {"__meta": {"schema_type": "Error"},
+                                           "error_url": path, "msg": str(e),
+                                           "http_status": e.status})
+                except Exception as e:  # noqa: BLE001 — REST boundary
+                    Log.err(f"REST {method} {path} failed: {e!r}")
+                    self._reply(500, {"__meta": {"schema_type": "Error"},
+                                      "error_url": path, "msg": repr(e),
+                                      "http_status": 500})
+                return
+        self._reply(404, {"__meta": {"schema_type": "Error"},
+                          "msg": f"no route {method} {path}", "http_status": 404})
+
+    def _reply(self, status: int, payload: dict):
+        data = json.dumps(payload, default=_json_default).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+class H2OServer:
+    """The RequestServer successor: owns the HTTP listener thread."""
+
+    def __init__(self, ip: str = "127.0.0.1", port: int = 54321):
+        self.httpd = ThreadingHTTPServer((ip, port), _Handler)
+        self.ip, self.port = self.httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.ip}:{self.port}"
+
+    def start(self) -> "H2OServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="h2o3-rest", daemon=True
+        )
+        self._thread.start()
+        Log.info(f"REST server up at {self.url}")
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+_SERVER: H2OServer | None = None
+
+
+def start_server(ip: str = "127.0.0.1", port: int = 54321) -> H2OServer:
+    """Start (or return) the process-wide REST server. port=0 picks a free
+    port — handy for tests running in parallel."""
+    global _SERVER
+    if _SERVER is None:
+        _SERVER = H2OServer(ip, port).start()
+    return _SERVER

@@ -1,0 +1,164 @@
+"""REST client — successor of ``h2o-py``'s ``backend/connection.py`` +
+the thin REST flows in ``h2o/h2o.py`` [UNVERIFIED upstream paths, SURVEY.md
+§2.3]. The native in-process API (``h2o3_tpu.init/import_file/models``) is
+the primary surface; this client provides the same flows against a REMOTE
+coordinator over the wire protocol, proving the REST layer end-to-end and
+giving multi-process deployments the H2O client feel.
+
+>>> conn = connect("http://host:54321")
+>>> fr = conn.import_file("/data/train.csv")
+>>> model = conn.train("gbm", y="label", training_frame=fr, ntrees=50)
+>>> pred_key = conn.predict(model["model_id"]["name"], fr)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import urllib.request
+from typing import Any
+
+
+class H2OClientError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(f"HTTP {status}: {msg}")
+        self.status = status
+
+
+class H2OConnection:
+    def __init__(self, url: str, timeout: float = 600.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        cloud = self.get("/3/Cloud")
+        if not cloud.get("cloud_healthy"):
+            raise H2OClientError(503, "cloud is not healthy")
+        self.cloud = cloud
+
+    # -- wire helpers -----------------------------------------------------
+    def _request(self, method: str, path: str, payload: dict | None, as_json: bool):
+        url = self.url + path
+        data = None
+        headers = {}
+        if payload is not None:
+            if as_json:
+                data = json.dumps(payload).encode()
+                headers["Content-Type"] = "application/json"
+            else:
+                data = urllib.parse.urlencode(
+                    {k: json.dumps(v) if isinstance(v, (list, dict)) else v
+                     for k, v in payload.items() if v is not None}
+                ).encode()
+        req = urllib.request.Request(url, data=data, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+                msg = body.get("msg", str(e))
+            except Exception:
+                msg = str(e)
+            raise H2OClientError(e.code, msg) from None
+
+    def get(self, path: str) -> dict:
+        return self._request("GET", path, None, False)
+
+    def post(self, path: str, payload: dict | None = None, as_json: bool = False) -> dict:
+        return self._request("POST", path, payload, as_json)
+
+    def delete(self, path: str) -> dict:
+        return self._request("DELETE", path, None, False)
+
+    # -- job polling (the h2o-py H2OJob.poll contract) --------------------
+    def wait_job(self, job_key: str, poll_interval: float = 0.3) -> dict:
+        t0 = time.time()
+        while True:
+            j = self.get(f"/3/Jobs/{job_key}")["jobs"][0]
+            if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+                if j["status"] == "FAILED":
+                    raise H2OClientError(500, j.get("exception") or "job failed")
+                return j
+            if time.time() - t0 > self.timeout:
+                raise H2OClientError(408, f"job {job_key} timed out")
+            time.sleep(poll_interval)
+
+    # -- flows ------------------------------------------------------------
+    def import_file(self, path: str, destination_frame: str | None = None) -> str:
+        """Returns the frame key (sniff + parse, the h2o.import_file flow)."""
+        self.post("/3/ImportFiles", {"path": path})
+        setup = self.post("/3/ParseSetup", {"source_frames": path})
+        resp = self.post("/3/Parse", {
+            "source_frames": path,
+            "destination_frame": destination_frame,
+            "separator": setup.get("separator"),
+        })
+        self.wait_job(resp["job"]["key"]["name"])
+        return destination_frame or path
+
+    def frame(self, key: str) -> dict:
+        return self.get(f"/3/Frames/{urllib.parse.quote(key, safe='')}")["frames"][0]
+
+    def train(self, algo: str, y: str | None = None, training_frame: str | Any = None,
+              validation_frame: str | Any = None, x=None, **params) -> dict:
+        """Build a model synchronously; returns the model schema dict."""
+        body = dict(params)
+        body["training_frame"] = _key_of(training_frame)
+        if validation_frame is not None:
+            body["validation_frame"] = _key_of(validation_frame)
+        if y is not None:
+            body["response_column"] = y
+        if x is not None:
+            body["x"] = list(x)
+        resp = self.post(f"/3/ModelBuilders/{algo}", body)
+        job = self.wait_job(resp["job"]["key"]["name"])
+        return self.get(f"/3/Models/{job['dest']['name']}")["models"][0]
+
+    def predict(self, model_key: str, frame: str | Any) -> str:
+        """Returns the predictions frame key."""
+        out = self.post(
+            f"/3/Predictions/models/{model_key}/frames/{_key_of(frame)}", {}
+        )
+        return out["predictions_frame"]["name"]
+
+    def model_performance(self, model_key: str, frame: str | Any) -> dict:
+        out = self.post(
+            f"/3/ModelMetrics/models/{model_key}/frames/{_key_of(frame)}", {}
+        )
+        return out["model_metrics"][0]
+
+    def rapids(self, ast: str) -> dict:
+        return self.post("/99/Rapids", {"ast": ast})
+
+    def automl(self, y: str, training_frame: str | Any, max_models: int = 0,
+               max_runtime_secs: float = 0.0, nfolds: int = 5, seed: int = -1,
+               include_algos=None, exclude_algos=None) -> dict:
+        spec = {
+            "build_control": {
+                "stopping_criteria": {"max_models": max_models,
+                                      "max_runtime_secs": max_runtime_secs,
+                                      "seed": seed},
+                "nfolds": nfolds,
+            },
+            "input_spec": {"training_frame": {"name": _key_of(training_frame)},
+                           "response_column": {"column_name": y}},
+            "build_models": {},
+        }
+        if include_algos:
+            spec["build_models"]["include_algos"] = list(include_algos)
+        if exclude_algos:
+            spec["build_models"]["exclude_algos"] = list(exclude_algos)
+        resp = self.post("/99/AutoMLBuilder", spec, as_json=True)
+        self.wait_job(resp["job"]["key"]["name"])
+        return self.get(f"/99/AutoML/{resp['automl_id']['name']}")
+
+
+def _key_of(frame) -> str:
+    if frame is None:
+        raise ValueError("frame required")
+    return getattr(frame, "key", None) or str(frame)
+
+
+def connect(url: str = "http://127.0.0.1:54321", **kw) -> H2OConnection:
+    """``h2o.connect`` successor."""
+    return H2OConnection(url, **kw)

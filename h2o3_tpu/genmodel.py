@@ -1,0 +1,294 @@
+"""Standalone offline scorer — successor of ``h2o-genmodel``
+(``hex.genmodel.MojoModel`` + ``easy.EasyPredictModelWrapper``) [UNVERIFIED
+upstream paths, SURVEY.md §2.3].
+
+Pure numpy, NO jax / NO cluster: load a ``.zip`` artifact written by
+:func:`h2o3_tpu.models.export.export_mojo` and score rows in any Python
+process. Row-wise parity with in-cluster ``model.predict`` is asserted by
+the export tests (H2O's MOJO-parity regression net, SURVEY.md §4).
+
+>>> m = MojoModel.load("gbm.zip")
+>>> m.predict({"age": 31, "sex": "F"})           # one row (EasyPredict style)
+>>> m.predict(pandas_dataframe)                  # batch
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Mapping
+
+import numpy as np
+
+
+class MojoModel:
+    def __init__(self, meta: dict, arrays: Mapping[str, np.ndarray]):
+        self.meta = meta
+        self.arrays = dict(arrays)
+
+    # -- loading ----------------------------------------------------------
+    @staticmethod
+    def load(path: str) -> "MojoModel":
+        with zipfile.ZipFile(path) as z:
+            meta = json.loads(z.read("model.json"))
+            npz = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
+            arrays = {k: npz[k] for k in npz.files}
+        cls = {
+            "gbm": _TreeMojo, "drf": _TreeMojo, "xrt": _TreeMojo,
+            "glm": _GlmMojo, "deeplearning": _DeepLearningMojo,
+            "kmeans": _KMeansMojo,
+        }[meta["algo"]]
+        return cls(meta, arrays)
+
+    # -- common surface ---------------------------------------------------
+    @property
+    def algo(self) -> str:
+        return self.meta["algo"]
+
+    @property
+    def domain(self):
+        return self.meta.get("response_domain")
+
+    def _rows_to_table(self, data) -> dict[str, np.ndarray]:
+        """dict row / list-of-dicts / DataFrame → column arrays."""
+        if hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
+            return {c: data[c].to_numpy() for c in data.columns}
+        if isinstance(data, Mapping):
+            return {k: np.asarray([v]) for k, v in data.items()}
+        if isinstance(data, (list, tuple)) and data and isinstance(data[0], Mapping):
+            keys = data[0].keys()
+            return {k: np.asarray([row.get(k) for row in data]) for k in keys}
+        raise TypeError(f"cannot score {type(data).__name__}")
+
+    def predict(self, data) -> dict[str, np.ndarray]:
+        """Returns {"predict": labels-or-values, <class>: prob...} — the
+        EasyPredictModelWrapper row API, vectorized."""
+        table = self._rows_to_table(data)
+        raw = self.score_raw(table)
+        dom = self.domain
+        if dom is None:
+            return {"predict": raw if raw.ndim == 1 else raw[:, 0]}
+        labels = np.asarray(dom, dtype=object)[raw.argmax(axis=1)]
+        out = {"predict": labels}
+        for k, d in enumerate(dom):
+            out[str(d)] = raw[:, k]
+        return out
+
+    def score_raw(self, table: dict[str, np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# shared numeric helpers
+
+
+def _col_numeric(table, name, n) -> np.ndarray:
+    if name not in table:
+        return np.full(n, np.nan)
+    x = table[name]
+    out = np.full(len(x), np.nan)
+    for i, v in enumerate(x):
+        try:
+            if v is not None and v == v:  # not NaN
+                out[i] = float(v)
+        except (TypeError, ValueError):
+            pass
+    return out
+
+
+def _col_codes(table, name, domain, n) -> np.ndarray:
+    """Categorical → train-domain codes; unseen/missing → -1."""
+    if name not in table:
+        return np.full(n, -1, np.int64)
+    lut = {d: i for i, d in enumerate(domain)}
+    x = table[name]
+    return np.asarray([lut.get(v if isinstance(v, str) else str(v), -1)
+                       if v is not None and v == v else -1 for v in x], np.int64)
+
+
+def _n_rows(table: dict) -> int:
+    return len(next(iter(table.values())))
+
+
+def _softmax(z):
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# tree models
+
+
+class _TreeMojo(MojoModel):
+    """Replays the recorded level arrays — CompressedTree.score0 successor."""
+
+    def _bin_features(self, table) -> np.ndarray:
+        names = self.meta["names"]
+        n = _n_rows(table)
+        is_cat = self.arrays["bin_is_cat"]
+        nbins = self.arrays["bin_nbins"]
+        edges = self.arrays["bin_edges"]
+        doms = self.meta["bin_domains"]
+        cols = []
+        for ci, name in enumerate(names):
+            if is_cat[ci]:
+                codes = _col_codes(table, name, doms[ci] or (), n)
+                b = np.clip(codes + 1, 0, int(nbins[ci]))
+            else:
+                x = _col_numeric(table, name, n)
+                e = edges[ci][: max(int(nbins[ci]) - 1, 0)]
+                b = np.searchsorted(e, x, side="left") + 1
+                b[np.isnan(x)] = 0
+            cols.append(b.astype(np.int64))
+        return np.stack(cols, axis=1)
+
+    def _walk_tree(self, bins: np.ndarray, ti: int, ki: int, n_levels: int) -> np.ndarray:
+        n = bins.shape[0]
+        nid = np.zeros(n, np.int64)
+        preds = np.zeros(n, np.float64)
+        a = self.arrays
+        for li in range(n_levels):
+            pre = f"t{ti}_k{ki}_l{li}_"
+            split_col = a[pre + "split_col"]
+            split_bin = a[pre + "split_bin"]
+            is_cat = a[pre + "is_cat"]
+            cat_mask = a[pre + "cat_mask"]
+            na_left = a[pre + "na_left"]
+            leaf_now = a[pre + "leaf_now"]
+            leaf_val = a[pre + "leaf_val"].astype(np.float64)
+            child_base = a[pre + "child_base"]
+
+            active = nid >= 0
+            node = np.where(active, nid, 0)
+            col = split_col[node]
+            b = bins[np.arange(n), col]
+            go_left = np.where(
+                b == 0, na_left[node],
+                np.where(is_cat[node], cat_mask[node, b], b <= split_bin[node]),
+            )
+            child = child_base[node] + np.where(go_left, 0, 1)
+            retired = leaf_now[node]
+            preds += np.where(active & retired, leaf_val[node], 0.0)
+            nid = np.where(active, np.where(retired, -1, child), -1)
+        return preds
+
+    def score_raw(self, table) -> np.ndarray:
+        bins = self._bin_features(table)
+        K = self.meta["n_tree_classes"]
+        shapes = self.meta["tree_levels"]
+        n = bins.shape[0]
+        F = np.zeros((n, K), np.float64)
+        for ti, class_levels in enumerate(shapes):
+            for ki in range(K):
+                F[:, ki] += self._walk_tree(bins, ti, ki, class_levels[ki])
+
+        if self.algo in ("drf", "xrt"):
+            avg = F / max(self.meta["ntrees_actual"], 1)
+            if self.domain is None:
+                return avg[:, 0]
+            if len(self.domain) == 2:
+                p1 = np.clip(avg[:, 0], 0.0, 1.0)
+                return np.stack([1 - p1, p1], axis=1)
+            P = np.clip(avg, 1e-9, None)
+            return P / P.sum(axis=1, keepdims=True)
+
+        # gbm
+        dist = self.meta["distribution"]
+        init_f = self.meta["init_f"]
+        if dist == "multinomial":
+            return _softmax(F + np.asarray(init_f)[None, :])
+        f = F[:, 0] + (init_f if np.isscalar(init_f) else init_f)
+        if dist == "bernoulli":
+            mu = 1.0 / (1.0 + np.exp(-f))
+            return np.stack([1 - mu, mu], axis=1)
+        if dist in ("poisson", "gamma", "tweedie"):
+            return np.exp(f)
+        return f
+
+
+# ---------------------------------------------------------------------------
+# GLM / DL / KMeans — design-matrix models
+
+
+def _design_matrix(meta_di: dict, table) -> np.ndarray:
+    n = _n_rows(table)
+    cols = []
+    for c in meta_di["columns"]:
+        if c["kind"] == "cat":
+            codes = _col_codes(table, c["name"], c["domain"], n)
+            base = 0 if meta_di["use_all_factor_levels"] else 1
+            onehot = ((codes - base)[:, None] == np.arange(c["width"])[None, :]).astype(np.float64)
+            cols.append(onehot)
+        else:
+            x = _col_numeric(table, c["name"], n)
+            x = np.where(np.isnan(x), c["mean"], x)
+            if meta_di["standardize"]:
+                x = (x - c["mean"]) / c["sigma"]
+            cols.append(x[:, None])
+    if meta_di["add_intercept"]:
+        cols.append(np.ones((n, 1)))
+    return np.concatenate(cols, axis=1)
+
+
+class _GlmMojo(MojoModel):
+    def score_raw(self, table) -> np.ndarray:
+        X = _design_matrix(self.meta["datainfo"], table)
+        if "beta_multinomial_std" in self.arrays:
+            return _softmax(X @ self.arrays["beta_multinomial_std"].T.astype(np.float64))
+        eta = X @ self.arrays["beta_std"].astype(np.float64)
+        fam = self.meta["family"]
+        link = self.meta.get("link", "family_default")
+        mu = _link_inverse(fam, link, eta, self.meta.get("tweedie_link_power", 1.0))
+        if self.domain is not None:
+            return np.stack([1 - mu, mu], axis=1)
+        return mu
+
+
+def _link_inverse(family: str, link: str, eta, tweedie_link_power: float):
+    if link == "family_default":
+        link = {"gaussian": "identity", "binomial": "logit",
+                "fractionalbinomial": "logit", "quasibinomial": "logit",
+                "poisson": "log", "gamma": "inverse", "negativebinomial": "log",
+                "tweedie": "tweedie"}.get(family, "identity")
+    if link == "identity":
+        return eta
+    if link == "logit":
+        return 1.0 / (1.0 + np.exp(-eta))
+    if link == "log":
+        return np.exp(eta)
+    if link == "inverse":
+        return 1.0 / np.where(np.abs(eta) < 1e-12, 1e-12, eta)
+    if link == "tweedie":
+        p = tweedie_link_power
+        return np.power(np.maximum(eta, 1e-12), 1.0 / p) if p != 0 else np.exp(eta)
+    raise ValueError(f"unknown link {link!r}")
+
+
+class _DeepLearningMojo(MojoModel):
+    def score_raw(self, table) -> np.ndarray:
+        X = _design_matrix(self.meta["datainfo"], table)
+        act_name = self.meta["activation"].lower()
+        act = np.tanh if "tanh" in act_name else (lambda z: np.maximum(z, 0.0))
+        h = X
+        L = self.meta["n_layers"]
+        for i in range(L):
+            h = h @ self.arrays[f"W{i}"].astype(np.float64) + self.arrays[f"b{i}"].astype(np.float64)
+            if i < L - 1:
+                h = act(h)
+        if self.domain is not None:
+            return _softmax(h)
+        return h[:, 0]
+
+
+class _KMeansMojo(MojoModel):
+    def score_raw(self, table) -> np.ndarray:
+        X = _design_matrix(self.meta["datainfo"], table)
+        centers = self.arrays["centers_std"].astype(np.float64)
+        d2 = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(axis=2)
+        return d2.argmin(axis=1).astype(np.float64)
+
+    def predict(self, data):
+        table = self._rows_to_table(data)
+        return {"cluster": self.score_raw(table).astype(np.int64)}

@@ -1,0 +1,149 @@
+"""Portable model export — successor of the MOJO writer side
+(``hex.genmodel`` producers, ``/3/Models/{id}/mojo``) [UNVERIFIED upstream
+paths, SURVEY.md §2.3 §5.4].
+
+Format ("tmojo", .zip):
+- ``model.json`` — algo, version, scoring metadata (domains, links,
+  distributions, DataInfo standardization spec) — everything small.
+- ``arrays.npz`` — the numeric payload (tree level arrays, GLM betas, DL
+  weight matrices, KMeans centers, bin edges).
+
+The artifact is scored WITHOUT a cluster and WITHOUT jax by
+:mod:`h2o3_tpu.genmodel` (pure numpy) — the EasyPredictModelWrapper
+successor — and parity with in-cluster ``model.predict`` is the numerical
+regression net, exactly H2O's MOJO-parity test strategy (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as np
+
+from h2o3_tpu.models.model_base import Model
+
+FORMAT_VERSION = "1.0"
+
+
+def _datainfo_meta(di) -> dict:
+    return {
+        "standardize": di.standardize,
+        "use_all_factor_levels": di.use_all_factor_levels,
+        "missing_handling": di.missing_handling,
+        "add_intercept": di.add_intercept,
+        "ncols_expanded": di.ncols_expanded,
+        "columns": [
+            {"name": c.name, "kind": c.kind, "mean": float(c.mean),
+             "sigma": float(c.sigma), "domain": list(c.domain),
+             "offset": c.offset, "width": c.width}
+            for c in di.columns
+        ],
+    }
+
+
+def _export_trees(model, meta, arrays) -> None:
+    out = model.output
+    spec = out["bin_spec"]
+    meta["distribution"] = out.get("distribution")
+    meta["init_f"] = np.asarray(out["init_f"]).tolist() if "init_f" in out else None
+    meta["n_tree_classes"] = out.get("n_tree_classes", 1)
+    meta["ntrees_actual"] = out["ntrees_actual"]
+    meta["names"] = out["names"]
+    meta["bin_domains"] = [list(d) if d else None for d in (spec.domains or [])]
+    meta["offset_column"] = getattr(model.params, "offset_column", None)
+    arrays["bin_is_cat"] = np.asarray(spec.is_cat)
+    arrays["bin_nbins"] = np.asarray(spec.nbins)
+    arrays["bin_edges"] = np.asarray(spec.edges)
+    tree_shapes = []
+    for ti, group in enumerate(out["trees"]):
+        class_levels = []
+        for ki, tree in enumerate(group):
+            host = tree.to_host()
+            class_levels.append(len(host.levels))
+            for li, lv in enumerate(host.levels):
+                pre = f"t{ti}_k{ki}_l{li}_"
+                arrays[pre + "split_col"] = lv.split_col
+                arrays[pre + "split_bin"] = lv.split_bin
+                arrays[pre + "is_cat"] = lv.is_cat
+                arrays[pre + "cat_mask"] = lv.cat_mask
+                arrays[pre + "na_left"] = lv.na_left
+                arrays[pre + "leaf_now"] = lv.leaf_now
+                arrays[pre + "leaf_val"] = lv.leaf_val
+                arrays[pre + "child_base"] = lv.child_base
+        tree_shapes.append(class_levels)
+    meta["tree_levels"] = tree_shapes
+
+
+def _export_glm(model, meta, arrays) -> None:
+    out = model.output
+    meta["family"] = out["family"]
+    meta["link"] = out.get("link", "family_default")
+    meta["datainfo"] = _datainfo_meta(out["datainfo"])
+    meta["coef_names"] = out["coef_names"]
+    if out.get("multinomial"):
+        arrays["beta_multinomial_std"] = np.asarray(out["beta_multinomial_std"])
+    else:
+        arrays["beta_std"] = np.asarray(out["beta_std"])
+    meta["tweedie_link_power"] = getattr(model.params, "tweedie_link_power", 1.0)
+
+
+def _export_deeplearning(model, meta, arrays) -> None:
+    out = model.output
+    meta["datainfo"] = _datainfo_meta(out["datainfo"])
+    meta["activation"] = model.params.activation
+    params = out["params"]["params"] if "params" in out["params"] else out["params"]
+    layers = sorted(params.keys(), key=lambda k: int(k.split("_")[-1]))
+    meta["n_layers"] = len(layers)
+    for i, name in enumerate(layers):
+        arrays[f"W{i}"] = np.asarray(params[name]["kernel"])
+        arrays[f"b{i}"] = np.asarray(params[name]["bias"])
+
+
+def _export_kmeans(model, meta, arrays) -> None:
+    out = model.output
+    meta["datainfo"] = _datainfo_meta(out["datainfo"])
+    arrays["centers_std"] = np.asarray(out["centers_std"])
+
+
+_EXPORTERS = {
+    "gbm": _export_trees,
+    "drf": _export_trees,
+    "xrt": _export_trees,
+    "glm": _export_glm,
+    "deeplearning": _export_deeplearning,
+    "kmeans": _export_kmeans,
+}
+
+
+def export_mojo(model: Model, path: str) -> str:
+    """Write the portable artifact; returns the path."""
+    if model.algo not in _EXPORTERS:
+        raise ValueError(f"mojo export not supported for {model.algo!r}")
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "algo": model.algo,
+        "model_key": model.key,
+        "response_column": model.params.response_column,
+        "response_domain": list(model.output["response_domain"])
+        if model.output.get("response_domain") else None,
+    }
+    arrays: dict[str, np.ndarray] = {}
+    _EXPORTERS[model.algo](model, meta, arrays)
+
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.json", json.dumps(meta))
+        z.writestr("arrays.npz", buf.getvalue())
+    return path
+
+
+# attach to Model (h2o's model.download_mojo surface)
+def _download_mojo(self: Model, path: str) -> str:
+    return export_mojo(self, path)
+
+
+Model.download_mojo = _download_mojo
+Model.save_mojo = _download_mojo

@@ -23,7 +23,7 @@ from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import ScoreKeeper, stopping_metric_direction
 from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
-from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams, _accumulate_varimp
+from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams
 from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
 from h2o3_tpu.models import metrics as MM
 from h2o3_tpu.models.model_base import ModelBuilder
@@ -98,7 +98,6 @@ class DRF(ModelBuilder):
         y = jnp.asarray(ybuf)
         wn, yn = np.asarray(w), np.asarray(y)
 
-        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 5678)
         rngkey = jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 5678)
 
         n_out = K if K > 1 else 1
@@ -113,7 +112,7 @@ class DRF(ModelBuilder):
         )
         keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, larger)
         trees: list[list[Tree]] = []
-        varimp = np.zeros(C, np.float64)
+        varimp_dev = jnp.zeros(C, jnp.float32)
         history: list[dict] = []
 
         bins_v = yv_np = wv_np = Fv = None
@@ -137,8 +136,9 @@ class DRF(ModelBuilder):
             mask = jax.random.bernoulli(sk, p.sample_rate, (npad,)).astype(jnp.float32)
             w_tree = w * mask
             group = []
+            tree_key = jax.random.fold_in(rngkey, m)
             for k in range(n_out):
-                tree, fk = build_tree(
+                tree, fk, varimp_dev = build_tree(
                     bins,
                     w_tree,
                     targets[k],
@@ -150,12 +150,12 @@ class DRF(ModelBuilder):
                     min_split_improvement=p.min_split_improvement,
                     learn_rate=1.0,
                     preds=F[k],
+                    key=jax.random.fold_in(tree_key, k),
+                    varimp=varimp_dev,
                     col_sample_rate=col_rate,
-                    rng=rng,
                 )
                 group.append(tree)
                 F[k] = fk
-                _accumulate_varimp(varimp, tree)
             trees.append(group)
 
             if Fv is not None:
@@ -186,7 +186,7 @@ class DRF(ModelBuilder):
             "trees": trees,
             "n_tree_classes": n_out,
             "names": list(self._x),
-            "varimp": varimp,
+            "varimp": np.asarray(varimp_dev).astype(np.float64),
             "response_domain": tuple(yv.domain) if classification else None,
             "ntrees_actual": len(trees),
         }

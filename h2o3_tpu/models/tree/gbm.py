@@ -162,13 +162,12 @@ class GBM(ModelBuilder):
         if p.offset_column:
             offset = jnp.nan_to_num(train.vec(p.offset_column).data)
 
-        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 1234)
         rngkey = jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 1234)
 
         wn = np.asarray(w)
         yn = np.asarray(y)
         trees: list[list[Tree]] = []
-        varimp = np.zeros(len(self._x), np.float64)
+        varimp_dev = jnp.zeros(len(self._x), jnp.float32)
         history: list[dict] = []
 
         metric_name, larger = stopping_metric_direction(
@@ -228,18 +227,14 @@ class GBM(ModelBuilder):
                 w_tree = w * mask
             else:
                 w_tree = w
-            cols_enabled = None
-            if p.col_sample_rate_per_tree < 1.0:
-                cols_enabled = rng.random(len(self._x)) < p.col_sample_rate_per_tree
-                if not cols_enabled.any():
-                    cols_enabled[rng.integers(len(self._x))] = True
+            tree_key = jax.random.fold_in(rngkey, m)
 
             group: list[Tree] = []
             if dist == "multinomial":
                 T, H = multinomial_grad_hess(F, Y1h, w_tree, K)
                 newF = []
                 for k in range(K):
-                    tree, fk = build_tree(
+                    tree, fk, varimp_dev = build_tree(
                         bins,
                         w_tree,
                         T[:, k],
@@ -251,18 +246,18 @@ class GBM(ModelBuilder):
                         min_split_improvement=p.min_split_improvement,
                         learn_rate=lr,
                         preds=F[:, k],
+                        key=jax.random.fold_in(tree_key, k),
+                        varimp=varimp_dev,
                         col_sample_rate=p.col_sample_rate,
-                        cols_enabled=cols_enabled,
-                        rng=rng,
+                        col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                         max_abs_leaf=p.max_abs_leafnode_pred,
                     )
                     group.append(tree)
                     newF.append(fk)
-                    _accumulate_varimp(varimp, tree)
                 F = jnp.stack(newF, axis=1)
             else:
                 t, h = grad_hess(dist, F, y, w_tree, aux)
-                tree, F = build_tree(
+                tree, F, varimp_dev = build_tree(
                     bins,
                     w_tree,
                     t,
@@ -274,13 +269,13 @@ class GBM(ModelBuilder):
                     min_split_improvement=p.min_split_improvement,
                     learn_rate=lr,
                     preds=F,
+                    key=tree_key,
+                    varimp=varimp_dev,
                     col_sample_rate=p.col_sample_rate,
-                    cols_enabled=cols_enabled,
-                    rng=rng,
+                    col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                     max_abs_leaf=p.max_abs_leafnode_pred,
                 )
                 group.append(tree)
-                _accumulate_varimp(varimp, tree)
             trees.append(group)
             lr *= p.learn_rate_annealing
 
@@ -315,7 +310,7 @@ class GBM(ModelBuilder):
             "distribution": dist,
             "init_f": f0,
             "names": list(self._x),
-            "varimp": varimp,
+            "varimp": np.asarray(varimp_dev).astype(np.float64),
             "response_domain": tuple(yv.domain) if classification else None,
             "ntrees_actual": len(trees),
         }
@@ -325,14 +320,6 @@ class GBM(ModelBuilder):
         if valid is not None:
             model.validation_metrics = model._score_metrics(valid)
         return model
-
-
-def _accumulate_varimp(varimp: np.ndarray, tree: Tree) -> None:
-    """H2O varimp: per-split squared-error improvement summed per column."""
-    for lv in tree.levels:
-        split = ~lv.leaf_now
-        if split.any() and lv.gain is not None:
-            np.add.at(varimp, lv.split_col[split], lv.gain[split].astype(np.float64))
 
 
 def _train_metric(dist, F, yn, wn, nrow, metric_name, K) -> float:

@@ -2,22 +2,30 @@
 / ``DTree`` (``UndecidedNode``/``DecidedNode``, ``findBestSplitPoint``) /
 ``ScoreBuildHistogram2`` [UNVERIFIED upstream paths, SURVEY.md §2.2 §3.3].
 
-Per level (SURVEY §3.3 call stack, TPU-native form):
-1. ``build_histograms`` — the ScoreBuildHistogram pass: scatter {w,wy,wy²,wh}
-   into (node,col,bin) cells per row shard, psum across the mesh.
-2. ``find_best_splits`` — DTree.findBestSplitPoint vectorized over all
-   (node, col) pairs on device: SE-reduction gain scan over bin prefixes,
-   NA-direction both ways (DHistogram's NA trick), categorical bins sorted
-   by mean response (DHistogram's categorical bin-sort).
-3. Host: decide split-vs-leaf per node (min_rows / min_split_improvement /
-   depth), assign compacted child ids (active-leaf frontier, NOT full 2^d
-   indexing — this is how depth-20 DRF stays bounded).
-4. ``_partition_update`` — the DecidedNode re-labeling: rows map to child
-   nids; rows landing in finalized leaves add the leaf value to the running
+Per level (SURVEY §3.3 call stack, TPU-native form), ALL fused into ONE
+compiled device program (`_level_step`):
+1. histogram pass — the ScoreBuildHistogram successor: {w,wy,wy²,wh} into
+   (node,col,bin) cells per row shard, psum across the mesh
+   (:mod:`h2o3_tpu.ops.histogram`).
+2. split scan — DTree.findBestSplitPoint vectorized over all (node, col)
+   pairs: SE-reduction gain over bin prefixes, NA-direction both ways
+   (DHistogram's NA trick), categorical bins in mean-sorted order
+   (DHistogram's categorical bin-sort).
+3. leaf decision + child id assignment (compacted via device cumsum — the
+   active-leaf frontier, NOT full 2^d indexing, so depth-20 DRF stays
+   bounded by ``node_cap``).
+4. partition update — the DecidedNode re-labeling: rows map to child nids;
+   rows landing in finalized leaves add the leaf value to the running
    prediction and retire with nid=-1.
+5. variable-importance scatter (per-split gain by column).
 
-Trees are recorded per level as compact arrays; prediction replays the same
-partition walk on a prebinned test matrix (CompressedTree.score0 successor).
+Device-residency is the design point: the driving host loop only *dispatches*
+one program per level and never blocks on device→host transfers (on a
+networked TPU a single transfer costs ~100ms — the former per-level host
+round-trips dominated build time ~30:1 over compute). Recorded per-level
+arrays stay on device; prediction replays them without ever touching host.
+The only syncs are an occasional early-exit poll for deep trees and the
+final scoring pulls.
 """
 
 from __future__ import annotations
@@ -33,10 +41,9 @@ _NEG = -1e30
 
 
 # ---------------------------------------------------------------------------
-# split finding
+# split finding (pure function, traced inside the level step)
 
 
-@partial(jax.jit, static_argnames=())
 def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
     """Best split per node from hist (N, C, B, 4). Returns per-node arrays.
 
@@ -120,35 +127,6 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
         [bc_na_left[:, None], cat_left], axis=1
     )  # (N, B): bin0 = NA direction
 
-    # child stats for the chosen split (needed for leaf values of children)
-    def chosen_child_stats():
-        # numeric
-        Ln = jnp.take_along_axis(
-            left_n, num_best_t[:, :, None, None].repeat(4, 3), 2
-        ).squeeze(2)  # (N, C, 4)
-        Rn = jnp.take_along_axis(
-            right_n, num_best_t[:, :, None, None].repeat(4, 3), 2
-        ).squeeze(2)
-        # categorical
-        Lc = jnp.take_along_axis(
-            s_left, cat_best_k[:, :, None, None].repeat(4, 3), 2
-        ).squeeze(2)
-        Rc = jnp.take_along_axis(
-            s_right, cat_best_k[:, :, None, None].repeat(4, 3), 2
-        ).squeeze(2)
-        L = jnp.where(is_cat[None, :, None], Lc, Ln)
-        R = jnp.where(is_cat[None, :, None], Rc, Rn)
-        nac = na
-        na_left_c = jnp.where(bc_is_cat, take(cat_na_left), take(num_na_left))
-        Lb = jnp.take_along_axis(L, best_col[:, None, None].repeat(4, 2), 1).squeeze(1)
-        Rb = jnp.take_along_axis(R, best_col[:, None, None].repeat(4, 2), 1).squeeze(1)
-        nab = jnp.take_along_axis(nac, best_col[:, None, None].repeat(4, 2), 1).squeeze(1)
-        Lb = Lb + jnp.where(na_left_c[:, None], nab, 0.0)
-        Rb = Rb + jnp.where(na_left_c[:, None], 0.0, nab)
-        return Lb, Rb
-
-    Lstats, Rstats = chosen_child_stats()
-
     node_w = total[:, 0, 0]
     node_wy = total[:, 0, 1]
     node_wh = total[:, 0, 3]
@@ -162,8 +140,6 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
         "split_bin": split_bin,
         "na_left": bc_na_left,
         "cat_mask": cat_mask,
-        "left_stats": Lstats,
-        "right_stats": Rstats,
         "node_w": node_w,
         "node_wy": node_wy,
         "node_wh": node_wh,
@@ -172,6 +148,7 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
 
 # ---------------------------------------------------------------------------
 # partition update (DecidedNode re-labeling + leaf retirement)
+# — also the prediction-replay op, so it keeps its own jit wrapper.
 
 
 @jax.jit
@@ -195,20 +172,116 @@ def _partition_update(
 
 
 # ---------------------------------------------------------------------------
-# recorded tree (for prediction replay)
+# the fused level step
+
+
+def _level_step_fn(
+    bins_u8, nid, preds, varimp, w, wy, wy2, wh, key, cols_enabled, is_cat,
+    min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+    *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
+):
+    """One whole tree level on device. Returns (nid, preds, varimp, record).
+
+    Empty/padding nodes need no masking anywhere: their histograms are all
+    zero, so every candidate split fails the min_rows check and they retire
+    as zero-valued leaves that no row is assigned to.
+    """
+    from h2o3_tpu.ops.histogram import histogram_in_jit
+
+    C = bins_u8.shape[1]
+    hist = histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_pad, n_bins)
+
+    if force_leaf:
+        tot = hist[:, 0, :, :].sum(axis=1)  # (n_pad, 4); col 0 ≡ any col
+        node_w, node_wy, node_wh = tot[:, 0], tot[:, 1], tot[:, 3]
+        ok = jnp.zeros(n_pad, bool)
+        gain = jnp.zeros(n_pad, jnp.float32)
+        split_col = jnp.zeros(n_pad, jnp.int32)
+        split_bin = jnp.zeros(n_pad, jnp.int32)
+        is_cat_n = jnp.zeros(n_pad, bool)
+        cat_mask = jnp.zeros((n_pad, n_bins), bool)
+        na_left = jnp.zeros(n_pad, bool)
+    else:
+        # per-(node,col) sampling mask (H2O col_sample_rate per split).
+        # Fallback when a node draws no columns: use all (rare; H2O instead
+        # redraws one uniformly — indistinguishable in expectation at our
+        # histogram granularity).
+        col_mask = jnp.broadcast_to(cols_enabled[None, :], (n_pad, C))
+        keep = jax.random.uniform(key, (n_pad, C)) < col_sample_rate
+        keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
+        col_mask = col_mask * keep
+        sp = _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement)
+        ok = sp["ok"]
+        # frontier cap: children must fit n_pad_next; later nodes go leaf
+        fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
+        ok = ok & fits
+        gain = jnp.where(ok, jnp.maximum(sp["gain"], 0.0), 0.0)
+        node_w, node_wy, node_wh = sp["node_w"], sp["node_wy"], sp["node_wh"]
+        split_col, split_bin = sp["col"], sp["split_bin"]
+        is_cat_n, cat_mask, na_left = sp["is_cat"], sp["cat_mask"], sp["na_left"]
+
+    leaf_now = ~ok
+    leaf_val = jnp.where(node_wh > 0, node_wy / jnp.maximum(node_wh, 1e-30), 0.0)
+    leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
+    leaf_val = jnp.where(leaf_now, leaf_val, 0.0).astype(jnp.float32)
+
+    cs = jnp.cumsum(ok.astype(jnp.int32))
+    child_base = jnp.where(ok, 2 * (cs - 1), 0).astype(jnp.int32)
+    n_split = cs[-1] if n_pad else jnp.int32(0)
+
+    varimp = varimp.at[split_col].add(jnp.where(ok, gain, 0.0).astype(varimp.dtype))
+
+    nid, preds = _partition_update(
+        bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
+        na_left, leaf_now, leaf_val, child_base,
+    )
+    record = {
+        "split_col": split_col.astype(jnp.int32),
+        "split_bin": split_bin.astype(jnp.int32),
+        "is_cat": is_cat_n,
+        "cat_mask": cat_mask,
+        "na_left": na_left,
+        "leaf_now": leaf_now,
+        "leaf_val": leaf_val,
+        "child_base": child_base,
+        "gain": gain,
+    }
+    return nid, preds, varimp, n_split, record
+
+
+_STEP_CACHE: dict = {}
+
+
+def _level_step(n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool):
+    key = (n_pad, n_pad_next, n_bins, force_leaf, jax.default_backend())
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(
+            partial(
+                _level_step_fn,
+                n_pad=n_pad, n_pad_next=n_pad_next,
+                n_bins=n_bins, force_leaf=force_leaf,
+            )
+        )
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# recorded tree (for prediction replay; fields are DEVICE arrays)
 
 
 @dataclass
 class TreeLevel:
-    split_col: np.ndarray
-    split_bin: np.ndarray
-    is_cat: np.ndarray
-    cat_mask: np.ndarray
-    na_left: np.ndarray
-    leaf_now: np.ndarray
-    leaf_val: np.ndarray
-    child_base: np.ndarray
-    gain: np.ndarray | None = None  # per-node split gain (varimp source)
+    split_col: jnp.ndarray
+    split_bin: jnp.ndarray
+    is_cat: jnp.ndarray
+    cat_mask: jnp.ndarray
+    na_left: jnp.ndarray
+    leaf_now: jnp.ndarray
+    leaf_val: jnp.ndarray
+    child_base: jnp.ndarray
+    gain: jnp.ndarray | None = None  # per-node split gain (varimp source)
 
 
 @dataclass
@@ -217,7 +290,7 @@ class Tree:
 
     @property
     def n_leaves(self) -> int:
-        return int(sum(l.leaf_now.sum() for l in self.levels))
+        return int(sum(int(jnp.sum(l.leaf_now)) for l in self.levels))
 
     @property
     def depth(self) -> int:
@@ -227,19 +300,21 @@ class Tree:
         """Accumulate this tree's contribution into preds (device walk)."""
         for lv in self.levels:
             nid, preds = _partition_update(
-                bins_u8,
-                nid,
-                preds,
-                jnp.asarray(lv.split_col),
-                jnp.asarray(lv.split_bin),
-                jnp.asarray(lv.is_cat),
-                jnp.asarray(lv.cat_mask),
-                jnp.asarray(lv.na_left),
-                jnp.asarray(lv.leaf_now),
-                jnp.asarray(lv.leaf_val),
-                jnp.asarray(lv.child_base),
+                bins_u8, nid, preds,
+                lv.split_col, lv.split_bin, lv.is_cat, lv.cat_mask,
+                lv.na_left, lv.leaf_now, lv.leaf_val, lv.child_base,
             )
         return nid, preds
+
+    def to_host(self) -> "Tree":
+        """Pull every level to numpy (for export/inspection paths)."""
+        out = Tree()
+        fields = ("split_col", "split_bin", "is_cat", "cat_mask", "na_left",
+                  "leaf_now", "leaf_val", "child_base", "gain")
+        pulled = jax.device_get([[getattr(lv, f) for f in fields] for lv in self.levels])
+        for vals in pulled:
+            out.levels.append(TreeLevel(*[np.asarray(v) for v in vals]))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -253,132 +328,73 @@ def build_tree(
     h,
     *,
     n_bins: int,
-    is_cat_cols: np.ndarray,
+    is_cat_cols,
     max_depth: int,
     min_rows: float,
     min_split_improvement: float,
     learn_rate: float,
     preds,
+    key,
+    varimp,
     col_sample_rate: float = 1.0,
-    cols_enabled: np.ndarray | None = None,
-    rng: np.random.Generator | None = None,
+    col_sample_rate_per_tree: float = 1.0,
+    cols_enabled=None,
     max_abs_leaf: float = np.inf,
-) -> tuple[Tree, "jnp.ndarray"]:
-    """Build one tree; mutates the running prediction vector via leaf adds.
+    node_cap: int = 2048,
+):
+    """Build one tree without any host↔device traffic in the level loop.
 
     Inputs are row-sharded device arrays: ``bins_u8`` (npad,C), per-row
     weight ``w`` (0 = out of this tree), target ``t`` (residual), hessian
-    ``h``. Returns the recorded Tree and the updated preds.
-    """
-    from h2o3_tpu.ops.histogram import build_histograms
+    ``h``; ``key`` a jax PRNG key (column sampling), ``varimp`` a device (C,)
+    accumulator. Returns ``(Tree, preds, varimp)`` — all device-resident.
 
+    ALL rows walk the tree (sampled-out rows contribute nothing to hists via
+    w=0, but must still receive leaf predictions — GBM's next-iteration
+    gradients depend on F for every row).
+    """
     C = bins_u8.shape[1]
-    is_cat_dev = jnp.asarray(is_cat_cols)
+    is_cat_dev = jnp.asarray(np.asarray(is_cat_cols, bool))
     wy = w * t
     wy2 = w * t * t
     wh = jnp.where(w > 0, h, 0.0)  # sampled-out rows carry no hessian either
-    # ALL rows walk the tree (sampled-out rows contribute nothing to hists
-    # via w=0, but must still receive leaf predictions — GBM's next-iteration
-    # gradients depend on F for every row).
+    if cols_enabled is not None:
+        cols_enabled_dev = jnp.asarray(np.asarray(cols_enabled, np.float32))
+    elif col_sample_rate_per_tree < 1.0:
+        # per-tree column subsample drawn on device (no host rng → no upload)
+        keep = jax.random.uniform(jax.random.fold_in(key, 1 << 30), (C,)) < col_sample_rate_per_tree
+        keep = jnp.where(keep.any(), keep, True)
+        cols_enabled_dev = keep.astype(jnp.float32)
+    else:
+        cols_enabled_dev = jnp.ones(C, jnp.float32)
+
     nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
     tree = Tree()
-    n_active = 1
 
     for depth in range(max_depth + 1):
-        n_pad = max(1, 1 << (n_active - 1).bit_length())
-        hist = build_histograms(bins_u8, nid, w, wy, wy2, wh, n_pad, n_bins)
-
-        force_leaf_all = depth == max_depth
-        if force_leaf_all:
-            sp = None
-            node_w = np.asarray(hist.sum(axis=(1, 2))[:, 0] / max(C, 1))
-            # hist sums each col over full node; per-col totals identical — take col 0
-            tot = np.asarray(hist[:, 0, :, :].sum(axis=1))
-            node_w = tot[:, 0]
-            node_wy = tot[:, 1]
-            node_wh = tot[:, 3]
-            ok = np.zeros(n_pad, bool)
-        else:
-            col_mask = np.ones((n_pad, C), np.float32)
-            if cols_enabled is not None:
-                col_mask *= cols_enabled[None, :].astype(np.float32)
-            if col_sample_rate < 1.0 and rng is not None:
-                keep = rng.random((n_pad, C)) < col_sample_rate
-                # guarantee at least one column per node
-                keep[np.arange(n_pad), rng.integers(0, C, n_pad)] = True
-                col_mask *= keep
-            sp = _split_scan(
-                hist,
-                is_cat_dev,
-                jnp.asarray(col_mask),
-                jnp.float32(min_rows),
-                jnp.float32(min_split_improvement),
-            )
-            sp = {k: np.asarray(v) for k, v in sp.items()}
-            ok = np.asarray(sp["ok"], bool).copy()
-            ok[n_active:] = False
-            node_w = sp["node_w"]
-            node_wy = sp["node_wy"]
-            node_wh = sp["node_wh"]
-
-        # leaf decision: no valid split, or empty node
-        leaf_now = ~ok
-        leaf_now[node_w <= 0] = True  # empty padding nodes: place as leaf w/ 0 val
-        leaf_val = np.where(
-            node_wh > 0, node_wy / np.maximum(node_wh, 1e-30), 0.0
+        n_pad = min(1 << depth, node_cap)
+        n_pad_next = min(2 * n_pad, node_cap)
+        force_leaf = depth == max_depth
+        step = _level_step(n_pad, n_pad_next, n_bins, force_leaf)
+        lkey = jax.random.fold_in(key, depth)
+        nid, preds, varimp, n_split, rec = step(
+            bins_u8, nid, preds, varimp, w, wy, wy2, wh, lkey, cols_enabled_dev,
+            is_cat_dev,
+            jnp.float32(min_rows), jnp.float32(min_split_improvement),
+            jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+            jnp.float32(col_sample_rate),
         )
-        leaf_val = np.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
-        leaf_val = np.where(leaf_now, leaf_val, 0.0).astype(np.float32)
-
-        splitting = ~leaf_now
-        n_split = int(splitting.sum())
-        child_base = np.full(n_pad, 0, np.int32)
-        child_base[splitting] = 2 * np.arange(n_split, dtype=np.int32)
-
-        if sp is None:
-            lv = TreeLevel(
-                split_col=np.zeros(n_pad, np.int32),
-                split_bin=np.zeros(n_pad, np.int32),
-                is_cat=np.zeros(n_pad, bool),
-                cat_mask=np.zeros((n_pad, n_bins), bool),
-                na_left=np.zeros(n_pad, bool),
-                leaf_now=leaf_now,
-                leaf_val=leaf_val,
-                child_base=child_base,
-                gain=np.zeros(n_pad, np.float32),
-            )
-        else:
-            lv = TreeLevel(
-                split_col=sp["col"].astype(np.int32),
-                split_bin=sp["split_bin"].astype(np.int32),
-                is_cat=sp["is_cat"].astype(bool),
-                cat_mask=sp["cat_mask"].astype(bool),
-                na_left=sp["na_left"].astype(bool),
-                leaf_now=leaf_now,
-                leaf_val=leaf_val,
-                child_base=child_base,
-                gain=np.where(~leaf_now, np.maximum(sp["gain"], 0.0), 0.0).astype(
-                    np.float32
-                ),
-            )
-        tree.levels.append(lv)
-
-        nid, preds = _partition_update(
-            bins_u8,
-            nid,
-            preds,
-            jnp.asarray(lv.split_col),
-            jnp.asarray(lv.split_bin),
-            jnp.asarray(lv.is_cat),
-            jnp.asarray(lv.cat_mask),
-            jnp.asarray(lv.na_left),
-            jnp.asarray(lv.leaf_now),
-            jnp.asarray(lv.leaf_val),
-            jnp.asarray(lv.child_base),
-        )
-
-        n_active = 2 * n_split
-        if n_active == 0:
+        tree.levels.append(TreeLevel(**rec))
+        if force_leaf:
+            break
+        # Early-exit polling trades a blocking device→host pull against
+        # dispatching useless empty levels. On a local CPU mesh the pull is
+        # ~free, poll every level; on a (possibly networked) TPU a pull costs
+        # ~100ms RTT, so only poll occasionally past GBM-typical depths.
+        if jax.default_backend() == "cpu":
+            if int(n_split) == 0:
+                break
+        elif depth >= 8 and depth % 4 == 0 and int(n_split) == 0:
             break
 
-    return tree, preds
+    return tree, preds, varimp

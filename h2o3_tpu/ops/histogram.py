@@ -14,16 +14,23 @@ reduce across row shards. Mapping:
 
 Two device implementations, auto-selected by backend:
 - scatter path (CPU mesh): one `.at[].add` scatter per column (vmapped) —
-  fast on CPU, pathological on TPU (XLA serializes scatters).
+  fast on CPU, pathological on TPU (XLA serializes scatters; measured ~1.3s
+  per 1M×20-col pass at 256 nodes vs ~0.1s for the matmul path).
 - **matmul path (TPU)**: the histogram is recast as MXU work. Per row chunk,
   build ``A_s = onehot(nid) * stat_s`` (chunk, N) and the 0/1 col-bin
   indicator ``E`` (chunk, C·B); then ``hist_s = A_sᵀ @ E`` — a dense matmul
   the systolic array eats, no scatter at all. Rows are processed in
   ``lax.scan`` chunks so the (chunk, C·B) indicator transient stays ~100MB.
   Inactive rows (nid<0) match no one-hot column and vanish automatically.
+  Inputs stay float32 (bf16 would quantize the gradient stats the split
+  gains are computed from); XLA runs f32 dots as multi-pass bf16 on the MXU.
   This is the ScoreBuildHistogram→TPU redesign the north star asks for; a
   Pallas kernel that fuses the indicator construction into the dot is the
   planned next step.
+
+``histogram_in_jit`` is the primary entry: a pure traced function usable
+inside a larger jitted program (the tree level step), so histogram + split
+scan + partition fuse into one compiled launch with zero host round-trips.
 """
 
 from __future__ import annotations
@@ -39,9 +46,8 @@ from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
 STATS = 4  # w, wy, wy2, wh
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
-def _hist_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
-    """Device-local histogram: (C, n_nodes*n_bins, 4).
+def _hist_scatter_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
+    """Device-local scatter histogram: (C, n_nodes*n_bins, 4).
 
     Rows with nid < 0 (finalized leaves / padding) contribute via w=0.
     """
@@ -68,7 +74,6 @@ def _hist_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
 _ROW_CHUNK = 8192  # rows per matmul chunk: (chunk, C*B) transient ≤ ~120MB
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
 def _hist_matmul_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
     """MXU histogram for one shard: returns (C, n_nodes*n_bins, 4)."""
     n, C = bins_u8.shape
@@ -115,34 +120,32 @@ def _hist_matmul_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
     return jnp.transpose(h, (1, 0, 2, 3)).reshape(C, n_nodes * n_bins, STATS)
 
 
-def build_histograms(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, mesh=None):
-    """Full cross-device histogram: (n_nodes, C, n_bins, 4)."""
+def histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, mesh=None):
+    """Cross-device histogram, traceable inside a jitted program.
+
+    Returns (n_nodes, C, n_bins, 4), replicated across the mesh.
+    """
     mesh = mesh or get_mesh()
-    use_matmul = jax.default_backend() != "cpu"
-    key = ("hist", n_nodes, n_bins, mesh, use_matmul)
-    fn = _HIST_CACHE.get(key)
-    if fn is None:
-        local = _hist_matmul_local if use_matmul else _hist_local
+    local = _hist_scatter_local if jax.default_backend() == "cpu" else _hist_matmul_local
 
-        def body(b, n, w_, wy_, wy2_, wh_):
-            h = local(b, n, w_, wy_, wy2_, wh_, n_nodes, n_bins)
-            return jax.lax.psum(h, ROWS_AXIS)
+    def body(b, n, w_, wy_, wy2_, wh_):
+        h = local(b, n, w_, wy_, wy2_, wh_, n_nodes, n_bins)
+        return jax.lax.psum(h, ROWS_AXIS)
 
-        fn = jax.jit(
-            jax.shard_map(
-                body,
-                mesh=mesh,
-                in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
-                out_specs=P(),
-                check_vma=False,
-            )
-        )
-        _HIST_CACHE[key] = fn
-    h = fn(bins_u8, nid, w, wy, wy2, wh)  # (C, n_nodes*n_bins, 4)
+    h = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ROWS_AXIS),) * 6,
+        out_specs=P(),
+        check_vma=False,
+    )(bins_u8, nid, w, wy, wy2, wh)  # (C, n_nodes*n_bins, 4)
     C = h.shape[0]
     return jnp.transpose(
         h.reshape(C, n_nodes, n_bins, STATS), (1, 0, 2, 3)
     )  # (n_nodes, C, n_bins, 4)
 
 
-_HIST_CACHE: dict = {}
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def build_histograms(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
+    """Standalone jitted histogram (kept for tests / direct use)."""
+    return histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins)

@@ -1,0 +1,55 @@
+"""REST client tests — the h2o-py connection-flow successor driven against
+a real in-process server (SURVEY.md §4 'real stack, local topology')."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.api.server import start_server
+from h2o3_tpu.client import H2OClientError, connect
+
+
+@pytest.fixture(scope="module")
+def conn():
+    server = start_server(port=0)
+    return connect(server.url)
+
+
+def test_connect_and_cluster(conn):
+    assert conn.cloud["cloud_size"] >= 1
+
+
+def test_full_flow_over_client(conn, tmp_path):
+    rng = np.random.default_rng(8)
+    n = 600
+    df = pd.DataFrame({
+        "a": rng.normal(size=n), "b": rng.normal(size=n),
+        "y": np.where(rng.normal(size=n) + 0.8 * rng.normal(size=n) > 0, "up", "down"),
+    })
+    p = tmp_path / "train.csv"
+    df.to_csv(p, index=False)
+
+    key = conn.import_file(str(p), destination_frame="client_train")
+    fr = conn.frame(key)
+    assert fr["rows"] == n
+
+    model = conn.train("gbm", y="y", training_frame=key, ntrees=5, max_depth=3)
+    assert model["algo"] == "gbm"
+    auc = model["output"]["training_metrics"]["auc"]
+    assert 0.4 <= auc <= 1.0
+
+    pred_key = conn.predict(model["model_id"]["name"], key)
+    pfr = conn.frame(pred_key)
+    assert pfr["rows"] == n
+
+    mm = conn.model_performance(model["model_id"]["name"], key)
+    assert mm["auc"] == pytest.approx(auc, abs=1e-9)
+
+    out = conn.rapids(f"(mean (cols_py {key} 'a'))")
+    assert out["scalar"] == pytest.approx(float(df["a"].mean()), rel=1e-5)
+
+
+def test_client_error_surface(conn):
+    with pytest.raises(H2OClientError) as ei:
+        conn.frame("no_such_frame")
+    assert ei.value.status == 404

@@ -1,0 +1,134 @@
+"""MOJO-export parity + binary save/load tests — the MOJO/POJO parity
+regression net of upstream (``pyunit_*mojo*``; SURVEY.md §4): train → export
+→ score offline with the numpy genmodel → assert row-wise equality with the
+in-cluster predictions."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.models.export  # noqa: F401 — attaches Model.download_mojo
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.genmodel import MojoModel
+from h2o3_tpu.models import DRF, GBM, GLM, DeepLearning, KMeans
+
+
+def _df(n=1500, seed=0, classification=True):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "num1": rng.normal(size=n),
+        "num2": rng.random(n) * 10,
+        "cat1": rng.choice(["a", "b", "c"], n),
+    })
+    df.loc[rng.choice(n, 50, replace=False), "num1"] = np.nan
+    eta = df["num1"].fillna(0) + (df["cat1"] == "a") * 2 - 0.3 * df["num2"]
+    if classification:
+        df["y"] = np.where(eta + rng.normal(size=n) > 0, "pos", "neg")
+    else:
+        df["y"] = eta + 0.1 * rng.normal(size=n)
+    return df
+
+
+def _parity(model, df, tmp_path, prob_col, tol=1e-5):
+    fr = Frame.from_pandas(df)
+    path = str(tmp_path / f"{model.algo}.zip")
+    model.download_mojo(path)
+    mojo = MojoModel.load(path)
+
+    incluster = model.predict(fr)
+    offline = mojo.predict(df.drop(columns=["y"]))
+    if prob_col is not None:
+        a = incluster.vec(prob_col).to_numpy()
+        b = offline[prob_col]
+    else:
+        a = incluster.vec("predict").to_numpy()
+        b = offline["predict"]
+    np.testing.assert_allclose(
+        np.asarray(a, np.float64), np.asarray(b, np.float64), atol=tol, rtol=0
+    )
+    return mojo
+
+
+def test_gbm_mojo_parity(tmp_path):
+    df = _df()
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=10, max_depth=4, seed=3).train(y="y", training_frame=fr)
+    _parity(m, df, tmp_path, "pos")
+
+
+def test_gbm_regression_mojo_parity(tmp_path):
+    df = _df(classification=False)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=10, max_depth=3, seed=3, distribution="gaussian").train(
+        y="y", training_frame=fr
+    )
+    _parity(m, df, tmp_path, None, tol=1e-4)
+
+
+def test_drf_mojo_parity(tmp_path):
+    df = _df(seed=4)
+    fr = Frame.from_pandas(df)
+    m = DRF(ntrees=10, max_depth=6, seed=3).train(y="y", training_frame=fr)
+    _parity(m, df, tmp_path, "pos")
+
+
+def test_glm_mojo_parity(tmp_path):
+    df = _df(seed=5)
+    fr = Frame.from_pandas(df)
+    m = GLM(family="binomial", lambda_=1e-4).train(y="y", training_frame=fr)
+    _parity(m, df, tmp_path, "pos")
+
+
+def test_deeplearning_mojo_parity(tmp_path):
+    df = _df(seed=6)
+    fr = Frame.from_pandas(df)
+    m = DeepLearning(hidden=[16], epochs=3, seed=3).train(y="y", training_frame=fr)
+    _parity(m, df, tmp_path, "pos", tol=1e-3)
+
+
+def test_kmeans_mojo_clusters(tmp_path):
+    df = _df(seed=7).drop(columns=["y"])
+    fr = Frame.from_pandas(df)
+    m = KMeans(k=3, seed=3).train(training_frame=fr)
+    path = str(tmp_path / "kmeans.zip")
+    m.download_mojo(path)
+    mojo = MojoModel.load(path)
+    offline = mojo.predict(df)["cluster"]
+    incluster = m.predict(fr).vec(0).to_numpy()
+    assert (offline == incluster).mean() > 0.99
+
+
+def test_single_row_easypredict(tmp_path):
+    df = _df(seed=8)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=5, max_depth=3, seed=3).train(y="y", training_frame=fr)
+    path = str(tmp_path / "m.zip")
+    m.download_mojo(path)
+    mojo = MojoModel.load(path)
+    row = {"num1": 0.5, "num2": 3.0, "cat1": "a"}
+    out = mojo.predict(row)
+    assert out["predict"][0] in ("pos", "neg")
+    assert out["pos"][0] + out["neg"][0] == pytest.approx(1.0, abs=1e-6)
+    # unseen categorical level routes like NA, not a crash
+    out2 = mojo.predict({"num1": 0.5, "num2": 3.0, "cat1": "ZZZ"})
+    assert out2["pos"][0] >= 0.0
+
+
+@pytest.mark.parametrize("builder,kw", [
+    (GBM, dict(ntrees=5, max_depth=3, seed=2)),
+    (GLM, dict(family="binomial", lambda_=1e-4)),
+    (DeepLearning, dict(hidden=[8], epochs=2, seed=2)),
+])
+def test_binary_save_load_roundtrip(tmp_path, builder, kw):
+    df = _df(seed=9)
+    fr = Frame.from_pandas(df)
+    m = builder(**kw).train(y="y", training_frame=fr)
+    before = m.predict(fr).vec("pos").to_numpy()
+    p = h2o3_tpu.save_model(m, str(tmp_path) + "/")
+    h2o3_tpu.remove(m.key)
+    m2 = h2o3_tpu.load_model(p)
+    assert m2.key == m.key
+    assert h2o3_tpu.get_model(m.key) is m2
+    after = m2.predict(fr).vec("pos").to_numpy()
+    np.testing.assert_allclose(before, after, atol=1e-6)

@@ -1,0 +1,164 @@
+"""REST API + Rapids tests — successor of upstream REST/pyunit coverage
+(``water.api`` handler tests, Rapids pyunits) [UNVERIFIED upstream paths,
+SURVEY.md §4]. A real server on a real port, driven by urllib — no mocks,
+matching H2O's "real stack, local topology" strategy."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.api.server import start_server
+from h2o3_tpu.frame.frame import Frame
+
+
+@pytest.fixture(scope="module")
+def server():
+    return start_server(port=0)
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.url + path) as r:
+        return json.loads(r.read())
+
+
+def _post(server, path, payload=None, as_json=False):
+    if as_json:
+        data = json.dumps(payload or {}).encode()
+        req = urllib.request.Request(
+            server.url + path, data=data,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+    else:
+        data = urllib.parse.urlencode(payload or {}).encode()
+        req = urllib.request.Request(server.url + path, data=data, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _wait_job(server, job_key, timeout=120.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        j = _get(server, f"/3/Jobs/{job_key}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+            return j
+        time.sleep(0.2)
+    raise TimeoutError(job_key)
+
+
+def _upload_frame(n=800, seed=0, key="rest_train"):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "y": np.where(rng.random(n) < 0.5, "dog", "cat"),
+    })
+    return Frame.from_pandas(df, destination_frame=key)
+
+
+def test_cloud_and_ping(server):
+    c = _get(server, "/3/Cloud")
+    assert c["cloud_healthy"] and c["cloud_size"] >= 1
+    assert _get(server, "/3/Ping")["ok"]
+
+
+def test_parse_roundtrip(server, tmp_path):
+    df = pd.DataFrame({"x": [1.0, 2.0, np.nan], "s": ["a", "b", "a"]})
+    p = tmp_path / "t.csv"
+    df.to_csv(p, index=False)
+    setup = _post(server, "/3/ParseSetup", {"source_frames": str(p)})
+    assert setup["source_frames"] == [str(p)]
+    resp = _post(server, "/3/Parse", {"source_frames": str(p), "destination_frame": "rest_parsed"})
+    _wait_job(server, resp["job"]["key"]["name"])
+    fr = _get(server, "/3/Frames/rest_parsed")["frames"][0]
+    assert fr["rows"] == 3
+    assert fr["column_count"] == 2
+    types = {c["label"]: c["type"] for c in fr["columns"]}
+    assert types["s"] == "enum"
+    nas = {c["label"]: c["missing_count"] for c in fr["columns"]}
+    assert nas["x"] == 1
+
+
+def test_model_build_predict_over_rest(server):
+    _upload_frame(key="rest_train")
+    resp = _post(server, "/3/ModelBuilders/glm", {
+        "training_frame": "rest_train", "response_column": "y",
+        "family": "binomial", "lambda_": 1e-4,
+    })
+    job = _wait_job(server, resp["job"]["key"]["name"])
+    assert job["status"] == "DONE", job
+    model_key = job["dest"]["name"]
+    m = _get(server, f"/3/Models/{model_key}")["models"][0]
+    assert m["algo"] == "glm"
+    assert m["output"]["model_category"] == "Binomial"
+    assert m["output"]["training_metrics"]["auc"] > 0.3
+
+    pred = _post(server, f"/3/Predictions/models/{model_key}/frames/rest_train", {})
+    pkey = pred["predictions_frame"]["name"]
+    pfr = _get(server, f"/3/Frames/{pkey}")["frames"][0]
+    assert pfr["rows"] == 800
+    labels = [c["label"] for c in pfr["columns"]]
+    assert labels == ["predict", "cat", "dog"]
+
+    mm = _post(server, f"/3/ModelMetrics/models/{model_key}/frames/rest_train", {})
+    assert 0.0 <= mm["model_metrics"][0]["auc"] <= 1.0
+
+
+def test_model_builders_listing_and_errors(server):
+    mb = _get(server, "/3/ModelBuilders")
+    assert "gbm" in mb["model_builders"]
+    # unknown algo -> 404 with H2O-style error body
+    try:
+        _post(server, "/3/ModelBuilders/nope", {"training_frame": "x"})
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        assert body["http_status"] == 404
+
+
+def test_automl_over_rest(server):
+    _upload_frame(n=600, seed=3, key="rest_aml")
+    resp = _post(server, "/99/AutoMLBuilder", {
+        "build_control": {"stopping_criteria": {"max_models": 2, "seed": 1},
+                          "nfolds": 3, "project_name": "t"},
+        "input_spec": {"training_frame": {"name": "rest_aml"},
+                       "response_column": {"column_name": "y"}},
+        "build_models": {"include_algos": ["GLM", "StackedEnsemble"]},
+    }, as_json=True)
+    job = _wait_job(server, resp["job"]["key"]["name"], timeout=300)
+    assert job["status"] == "DONE", job
+    aml = _get(server, f"/99/AutoML/{resp['automl_id']['name']}")
+    assert len(aml["leaderboard_table"]) >= 1
+    assert aml["leader"] is not None
+
+
+def test_rapids_eval(server):
+    fr = _upload_frame(n=100, seed=5, key="rapids_fr")
+    # scalar: mean of column a
+    out = _post(server, "/99/Rapids", {"ast": "(mean (cols_py rapids_fr 'a'))"})
+    expect = float(np.nanmean(fr.vec("a").to_numpy()))
+    assert out["scalar"] == pytest.approx(expect, rel=1e-5)
+    # frame op: new derived column, assigned to a temp key
+    out = _post(server, "/99/Rapids",
+                {"ast": "(tmp= rap_tmp (* (cols_py rapids_fr 'a') 2))"})
+    assert out["key"]["name"] == "rap_tmp"
+    doubled = h2o3_tpu.get_frame("rap_tmp").vec(0).to_numpy()
+    np.testing.assert_allclose(doubled, fr.vec("a").to_numpy() * 2, rtol=1e-6)
+    # group-by through rapids
+    out = _post(server, "/99/Rapids",
+                {"ast": "(GB rapids_fr ['y'] mean 'a' 'all')"})
+    g = h2o3_tpu.get_frame(out["key"]["name"])
+    assert g.nrow == 2 and "mean_a" in g.names
+
+
+def test_rapids_parse_errors_are_4xx(server):
+    try:
+        _post(server, "/99/Rapids", {"ast": "(nosuchop 1 2)"})
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
