@@ -137,8 +137,11 @@ class _TreeMojo(MojoModel):
                 codes = _col_codes(table, name, doms[ci] or (), n)
                 b = np.clip(codes + 1, 0, int(nbins[ci]))
             else:
-                x = _col_numeric(table, name, n)
-                e = edges[ci][: max(int(nbins[ci]) - 1, 0)]
+                # Bin in float32 with float32 edges — bit-identical to the
+                # device path (binning.bin_frame searchsorts f32), so bin
+                # codes match exactly even for edge-adjacent values.
+                x = _col_numeric(table, name, n).astype(np.float32)
+                e = edges[ci][: max(int(nbins[ci]) - 1, 0)].astype(np.float32)
                 b = np.searchsorted(e, x, side="left") + 1
                 b[np.isnan(x)] = 0
             cols.append(b.astype(np.int64))

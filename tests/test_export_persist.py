@@ -50,6 +50,26 @@ def _parity(model, df, tmp_path, prob_col, tol=1e-5):
     return mojo
 
 
+def test_bin_code_equality_device_vs_mojo(tmp_path):
+    """Device prebinning and the offline scorer must produce IDENTICAL bin
+    codes (atol=0) — the root cause of two rounds of parity failures was an
+    f32/f64 searchsorted mismatch between the two paths."""
+    df = _df(seed=11, classification=False)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=2, max_depth=3, seed=3, distribution="gaussian").train(
+        y="y", training_frame=fr
+    )
+    path = str(tmp_path / "bins.zip")
+    m.download_mojo(path)
+    mojo = MojoModel.load(path)
+
+    from h2o3_tpu.models.tree.binning import bin_frame
+
+    dev = np.asarray(bin_frame(m.output["bin_spec"], fr))[: fr.nrow]
+    off = mojo._bin_features(mojo._rows_to_table(df.drop(columns=["y"])))
+    np.testing.assert_array_equal(dev.astype(np.int64), off)
+
+
 def test_gbm_mojo_parity(tmp_path):
     df = _df()
     fr = Frame.from_pandas(df)
