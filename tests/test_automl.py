@@ -2,6 +2,7 @@
 pyunit scenarios [UNVERIFIED upstream path, SURVEY.md §4]."""
 
 import numpy as np
+import pytest
 import pandas as pd
 
 from h2o3_tpu.automl import AutoML
@@ -18,6 +19,7 @@ def _binary_frame(n=1500, seed=2):
     return Frame.from_pandas(df)
 
 
+@pytest.mark.slow
 def test_automl_builds_leaderboard_with_ensembles():
     fr = _binary_frame()
     aml = AutoML(
@@ -47,6 +49,7 @@ def test_automl_builds_leaderboard_with_ensembles():
     assert {"init", "model", "done"} <= stages
 
 
+@pytest.mark.slow
 def test_automl_regression_and_exclusions():
     rng = np.random.default_rng(4)
     X = rng.random((1200, 3))

@@ -200,6 +200,7 @@ def test_gbm_sampling_reproducible():
     )
 
 
+@pytest.mark.slow
 def test_drf_classification():
     df, ybin = _binary_df(n=3000)
     fr = Frame.from_pandas(df)
@@ -212,6 +213,7 @@ def test_drf_classification():
     assert 0 <= p1.min() and p1.max() <= 1
 
 
+@pytest.mark.slow
 def test_drf_regression():
     df = _friedman(n=2500)
     fr = Frame.from_pandas(df)
