@@ -2,13 +2,16 @@
 (``ModelMetricsRegression/Binomial/Multinomial/Clustering``; AUC machinery in
 ``hex.AUC2``) [UNVERIFIED upstream paths, SURVEY.md §2.2].
 
-Scoring passes run on device; the metric *summaries* here are computed
-host-side in float64 on the pulled-down prediction column(s) — exactness
-matters more than FLOPs for a one-shot O(n) summary, and it keeps AUC
-bit-stable for the MOJO-parity regression net (SURVEY.md §4).
+Two computation paths behind the same entry points:
 
-H2O's AUC2 builds 400 threshold bins; we compute the exact rank-statistic AUC
-and a 400-point threshold table for the max-F1/confusion surface.
+- **host (CPU mesh / numpy inputs)**: exact float64 summaries on the pulled
+  prediction column(s) — exact rank-statistic AUC, 400-point threshold table.
+- **device (accelerator + jax-array inputs)**: device→host bandwidth over a
+  tunneled TPU is ~10 MB/s, so pulling a 1M-row prediction column costs
+  seconds. Instead the O(n) sufficient statistics are reduced ON DEVICE
+  (weighted sums + a 1024-bucket score histogram — exactly H2O ``AUC2``'s
+  400-bin design, finer) and only KBs come down; the criterion surface is
+  assembled from buckets on host.
 """
 
 from __future__ import annotations
@@ -16,6 +19,20 @@ from __future__ import annotations
 import numpy as np
 
 _EPS = 1e-15
+_NBUCKETS = 1024
+
+
+def _on_device(*arrays) -> bool:
+    """True when we should take the device-stats path: an accelerator
+    backend and at least one jax array among the inputs."""
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return False
+        return any(isinstance(a, jax.Array) for a in arrays)
+    except Exception:
+        return False
 
 
 class ModelMetrics:
@@ -77,6 +94,8 @@ def regression_metrics(
     weights: np.ndarray | None = None,
     distribution: str = "gaussian",
 ) -> ModelMetrics:
+    if _on_device(actual, pred):
+        return _regression_metrics_device(actual, pred, weights, distribution)
     a = np.asarray(actual, np.float64)
     p = np.asarray(pred, np.float64)
     w = np.ones_like(a) if weights is None else np.asarray(weights, np.float64)
@@ -135,6 +154,8 @@ def binomial_metrics(
     domain: tuple[str, str] = ("0", "1"),
 ) -> ModelMetrics:
     """``actual`` is {0,1} int; ``prob`` is P(class 1)."""
+    if _on_device(actual, prob):
+        return _binomial_metrics_device(actual, prob, weights, domain)
     y = np.asarray(actual, np.float64)
     p = np.clip(np.asarray(prob, np.float64), _EPS, 1 - _EPS)
     w = np.ones_like(y) if weights is None else np.asarray(weights, np.float64)
@@ -268,6 +289,8 @@ def multinomial_metrics(
     domain: tuple[str, ...] = (),
 ) -> ModelMetrics:
     """``actual`` int class ids; ``probs`` (n, K)."""
+    if _on_device(actual, probs):
+        return _multinomial_metrics_device(actual, probs, weights, domain)
     y = np.asarray(actual)
     P = np.clip(np.asarray(probs, np.float64), _EPS, 1.0)
     w = np.ones(len(y), np.float64) if weights is None else np.asarray(weights, np.float64)
@@ -307,6 +330,315 @@ def multinomial_metrics(
             "mse": mse,
             "rmse": float(np.sqrt(mse)),
             "nobs": int(ok.sum()),
+        },
+        domain=domain,
+    )
+
+
+# --------------------------------------------------------------------------
+# device-stats path (accelerator backends; see module docstring)
+
+
+def _to_dev(x, dtype=None):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(x) if not hasattr(x, "devices") else x, dtype)
+
+
+def _bucket_hist(b, stats):
+    """(n,) int32 buckets + (n, S) stats → (NBUCKETS, S) via chunked one-hot
+    matmuls (scatter-add is pathological on TPU; this is MXU work)."""
+    import jax
+    import jax.numpy as jnp
+
+    n, S = stats.shape
+    chunk = 8192
+    nchunks = -(-n // chunk)
+    pad = nchunks * chunk - n
+    if pad:
+        b = jnp.pad(b, (0, pad))
+        stats = jnp.pad(stats, ((0, pad), (0, 0)))
+    b_c = b.reshape(nchunks, chunk)
+    s_c = stats.reshape(nchunks, chunk, S)
+    iota = jnp.arange(_NBUCKETS, dtype=jnp.int32)
+
+    def body(acc, xs):
+        bb, ss = xs
+        oh = (bb[:, None] == iota[None, :]).astype(jnp.float32)
+        return acc + jax.lax.dot_general(
+            ss, oh, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ), None
+
+    acc0 = jnp.zeros((S, _NBUCKETS), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (b_c, s_c))
+    return acc.T  # (NBUCKETS, S)
+
+
+def _binom_device_stats():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stats(y, p, w):
+        ok = (~jnp.isnan(y)) & (~jnp.isnan(p)) & (w > 0)
+        wok = jnp.where(ok, w, 0.0).astype(jnp.float32)
+        # zero masked values BEFORE arithmetic: 0 * NaN = NaN would poison
+        # the weighted sums the ok-mask is meant to exclude
+        y = jnp.where(ok, y, 0.0)
+        p = jnp.where(ok, p, 0.5)
+        pc = jnp.clip(p, _EPS, 1 - _EPS)
+        ypos = y == 1
+        logloss_sum = -(wok * jnp.where(ypos, jnp.log(pc), jnp.log1p(-pc))).sum()
+        mse_sum = (wok * (y - pc) ** 2).sum()
+        sw = wok.sum()
+        nobs = ok.sum()
+        b = jnp.clip((pc * _NBUCKETS).astype(jnp.int32), 0, _NBUCKETS - 1)
+        table = _bucket_hist(
+            b, jnp.stack([wok * ypos, wok * (~ypos)], axis=1)
+        )  # (B, 2): wpos, wneg
+        return logloss_sum, mse_sum, sw, nobs, table
+
+    return stats
+
+
+_BINOM_STATS = None
+
+
+def _binomial_metrics_device(actual, prob, weights, domain) -> ModelMetrics:
+    global _BINOM_STATS
+    if _BINOM_STATS is None:
+        _BINOM_STATS = _binom_device_stats()
+    import jax.numpy as jnp
+
+    y = _to_dev(actual, jnp.float32)
+    p = _to_dev(prob, jnp.float32)
+    w = jnp.ones_like(p) if weights is None else _to_dev(weights, jnp.float32)
+    ll_s, mse_s, sw_, nobs_, table = (
+        np.asarray(v, np.float64) for v in _BINOM_STATS(y, p, w)
+    )
+    sw = float(sw_)
+    logloss = float(ll_s) / sw
+    mse = float(mse_s) / sw
+    wpos_b, wneg_b = table[:, 0], table[:, 1]
+    tot_pos, tot_neg = wpos_b.sum(), wneg_b.sum()
+
+    # AUC with the bucket-as-tie-group rank statistic (H2O AUC2 semantics)
+    below_neg = np.concatenate([[0.0], np.cumsum(wneg_b)[:-1]])
+    auc = (
+        float((wpos_b * (below_neg + 0.5 * wneg_b)).sum() / (tot_pos * tot_neg))
+        if tot_pos > 0 and tot_neg > 0
+        else float("nan")
+    )
+
+    # threshold surface from bucket cumulatives: thr_b = b / NBUCKETS,
+    # predicted-positive = buckets >= b
+    tp = np.cumsum(wpos_b[::-1])[::-1]
+    fp = np.cumsum(wneg_b[::-1])[::-1]
+    fn = tot_pos - tp
+    tn = tot_neg - fp
+    thresholds = np.arange(_NBUCKETS) / _NBUCKETS
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = tp / (tp + fp)
+        recall = tp / np.maximum(tot_pos, _EPS)
+        specificity = tn / np.maximum(tot_neg, _EPS)
+        accuracy = (tp + tn) / sw
+        f1 = 2 * precision * recall / (precision + recall)
+        f2 = 5 * precision * recall / (4 * precision + recall)
+        f05 = 1.25 * precision * recall / (0.25 * precision + recall)
+        mcc = (tp * tn - fp * fn) / np.sqrt(
+            (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)
+        )
+        min_pca = np.minimum(recall, specificity)
+        mean_pca = 0.5 * (recall + specificity)
+    tbl = {
+        "f1": f1, "f2": f2, "f0point5": f05, "accuracy": accuracy,
+        "precision": precision, "recall": recall, "specificity": specificity,
+        "mcc": np.abs(mcc), "min_per_class_accuracy": min_pca,
+        "mean_per_class_accuracy": mean_pca,
+    }
+    # PR-AUC over descending-threshold sweep
+    order = np.argsort(-thresholds, kind="mergesort")
+    pr = precision[order]
+    rc = recall[order]
+    okm = ~np.isnan(pr)
+    pr_auc = float(np.trapezoid(pr[okm], rc[okm])) if okm.any() else float("nan")
+
+    mx = {}
+    for name, vals in tbl.items():
+        if np.all(np.isnan(vals)):
+            mx[f"max_{name}"] = {"threshold": 0.5, "value": float("nan")}
+        else:
+            i = int(np.nanargmax(vals))
+            mx[f"max_{name}"] = {
+                "threshold": float(thresholds[i]),
+                "value": float(vals[i]),
+            }
+    bi = (
+        int(np.nanargmax(tbl["f1"])) if not np.all(np.isnan(tbl["f1"])) else 0
+    )
+    best_thr = float(thresholds[bi])
+    cm = [[float(tn[bi]), float(fp[bi])], [float(fn[bi]), float(tp[bi])]]
+
+    return ModelMetrics(
+        "binomial",
+        {
+            "auc": auc,
+            "pr_auc": pr_auc,
+            "gini": 2 * auc - 1,
+            "logloss": logloss,
+            "mse": mse,
+            "rmse": float(np.sqrt(mse)),
+            "mean_per_class_error": float(
+                1.0 - mx["max_mean_per_class_accuracy"]["value"]
+            ),
+            "default_threshold": best_thr,
+            "confusion_matrix": cm,
+            "max_criteria": mx,
+            "nobs": int(nobs_),
+        },
+        domain=domain,
+    )
+
+
+_REG_STATS = None
+
+
+def _regression_metrics_device(actual, pred, weights, distribution) -> ModelMetrics:
+    global _REG_STATS
+    import jax
+    import jax.numpy as jnp
+
+    if _REG_STATS is None:
+
+        @jax.jit
+        def stats(a, p, w):
+            ok = (~jnp.isnan(a)) & (~jnp.isnan(p)) & (w > 0)
+            wok = jnp.where(ok, w, 0.0).astype(jnp.float32)
+            a0 = jnp.where(ok, a, 0.0)
+            p0 = jnp.where(ok, p, 0.0)
+            sw = wok.sum()
+            err = a0 - p0
+            mse_s = (wok * err**2).sum()
+            mae_s = (wok * jnp.abs(err)).sum()
+            sa = (wok * a0).sum()
+            saa = (wok * a0 * a0).sum()
+            loggable = jnp.all(jnp.where(ok, (a0 > -1) & (p0 > -1), True))
+            le = jnp.log1p(jnp.maximum(a0, -1 + 1e-12)) - jnp.log1p(
+                jnp.maximum(p0, -1 + 1e-12)
+            )
+            rmsle_s = (wok * le * le).sum()
+            # deviances
+            pe = jnp.maximum(p0, _EPS)
+            ae = jnp.maximum(a0, _EPS)
+            pois = (
+                2
+                * wok
+                * (jnp.where(a0 > 0, a0 * jnp.log(ae / pe), 0.0) - (a0 - p0))
+            ).sum()
+            gam = (2 * wok * (-jnp.log(ae / pe) + (ae - pe) / pe)).sum()
+            return sw, mse_s, mae_s, sa, saa, loggable, rmsle_s, pois, gam, ok.sum()
+
+        _REG_STATS = stats
+
+    a = _to_dev(actual, jnp.float32)
+    p = _to_dev(pred, jnp.float32)
+    w = jnp.ones_like(a) if weights is None else _to_dev(weights, jnp.float32)
+    sw, mse_s, mae_s, sa, saa, loggable, rmsle_s, pois, gam, nobs = (
+        np.asarray(v, np.float64) for v in _REG_STATS(a, p, w)
+    )
+    sw = float(sw)
+    mse = float(mse_s) / sw
+    mae = float(mae_s) / sw
+    mean_a = float(sa) / sw
+    ss_tot = float(saa) / sw - mean_a**2
+    rmsle = float(np.sqrt(float(rmsle_s) / sw)) if bool(loggable) else float("nan")
+    if distribution == "poisson":
+        dev = float(pois) / sw
+    elif distribution == "gamma":
+        dev = float(gam) / sw
+    elif distribution == "laplace":
+        dev = mae
+    else:
+        dev = mse
+    return ModelMetrics(
+        "regression",
+        {
+            "mse": mse,
+            "rmse": float(np.sqrt(mse)),
+            "mae": mae,
+            "rmsle": rmsle,
+            "r2": float(1.0 - mse / ss_tot) if ss_tot > 0 else float("nan"),
+            "mean_residual_deviance": dev,
+            "nobs": int(nobs),
+        },
+    )
+
+
+_MULTI_STATS = {}
+
+
+def _multinomial_metrics_device(actual, probs, weights, domain) -> ModelMetrics:
+    import jax
+    import jax.numpy as jnp
+
+    P = _to_dev(probs, jnp.float32)
+    K = int(P.shape[1])
+    if K not in _MULTI_STATS:
+
+        @jax.jit
+        def stats(y, P, w):
+            ok = (y >= 0) & (w > 0) & (~jnp.isnan(P).any(axis=1))
+            wok = jnp.where(ok, w, 0.0).astype(jnp.float32)
+            ysafe = jnp.clip(y, 0, K - 1).astype(jnp.int32)
+            # zero masked rows before arithmetic (0 * NaN = NaN)
+            P = jnp.where(ok[:, None], P, 1.0 / K)
+            Pc = jnp.clip(P, _EPS, 1.0)
+            p_true = jnp.take_along_axis(Pc, ysafe[:, None], axis=1)[:, 0]
+            ll_s = -(wok * jnp.log(p_true)).sum()
+            pred = jnp.argmax(Pc, axis=1)
+            err_s = (wok * (pred != ysafe)).sum()
+            oh_y = (ysafe[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+            oh_p = (pred[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+            cm = jax.lax.dot_general(
+                oh_y * wok[:, None], oh_p, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # rank of the true class (count of strictly-greater probs)
+            rank = (Pc > p_true[:, None]).sum(axis=1)
+            rank_hist = _bucket_hist(
+                jnp.clip(rank, 0, _NBUCKETS - 1).astype(jnp.int32), wok[:, None]
+            )[:, 0]
+            mse_s = (wok[:, None] * (oh_y - Pc) ** 2).sum()
+            return ll_s, err_s, cm, rank_hist, mse_s, wok.sum(), ok.sum()
+
+        _MULTI_STATS[K] = stats
+
+    y = _to_dev(actual, jnp.int32)
+    w = (
+        jnp.ones(P.shape[0], jnp.float32)
+        if weights is None
+        else _to_dev(weights, jnp.float32)
+    )
+    ll_s, err_s, cm, rank_hist, mse_s, sw_, nobs = (
+        np.asarray(v, np.float64) for v in _MULTI_STATS[K](y, P, w)
+    )
+    sw = float(sw_)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_class_err = 1.0 - np.diag(cm) / cm.sum(axis=1)
+    topk = list(np.cumsum(rank_hist[: min(10, K)]) / sw)
+    mse = float(mse_s) / sw
+    return ModelMetrics(
+        "multinomial",
+        {
+            "logloss": float(ll_s) / sw,
+            "classification_error": float(err_s) / sw,
+            "mean_per_class_error": float(np.nanmean(per_class_err)),
+            "per_class_error": per_class_err,
+            "confusion_matrix": cm,
+            "hit_ratios": [float(t) for t in topk],
+            "mse": mse,
+            "rmse": float(np.sqrt(mse)),
+            "nobs": int(nobs),
         },
         domain=domain,
     )

@@ -44,15 +44,19 @@ class DRFModel(SharedTreeModel):
     algo = "drf"
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
-        raw = self._replay_all(frame)  # sum of per-tree leaf means
+        return np.asarray(self._predict_raw_dev(frame))
+
+    def _predict_raw_dev(self, frame: Frame):
+        # sum of per-tree leaf means, averaged
+        raw = self._replay_all_dev(frame)[: frame.nrow]
         ntrees = max(self.output["ntrees_actual"], 1)
         avg = raw / ntrees
         if not self.is_classifier:
             return avg
         if self.nclasses == 2:
-            p1 = np.clip(avg, 0.0, 1.0)
-            return np.stack([1 - p1, p1], axis=1)
-        P = np.clip(avg, 1e-9, None)
+            p1 = jnp.clip(avg, 0.0, 1.0)
+            return jnp.stack([1 - p1, p1], axis=1)
+        P = jnp.clip(avg, 1e-9, None)
         return P / P.sum(axis=1, keepdims=True)
 
 
@@ -96,7 +100,7 @@ class DRF(ModelBuilder):
         ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
         w = jnp.asarray(w_np)
         y = jnp.asarray(ybuf)
-        wn, yn = np.asarray(w), np.asarray(y)
+        wn, yn = w_np, ybuf  # host copies already exist — never pull from device
 
         rngkey = jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 5678)
 
@@ -129,7 +133,72 @@ class DRF(ModelBuilder):
             wv_np = np.ones(valid.nrow, np.float32)
             Fv = [jnp.zeros(bins_v.shape[0], jnp.float32) for _ in range(n_out)]
 
-        for m in range(p.ntrees):
+        # Chunk-scanned path (see gbm.py / build_trees_scanned): one device
+        # dispatch per scoring interval per class. The bootstrap row mask is
+        # keyed by the shared row_key so all K class-trees of iteration m
+        # draw the SAME bootstrap (H2O semantics), while column/level
+        # randomness differs per class.
+        use_scan = jax.default_backend() != "cpu"
+        if use_scan:
+            from h2o3_tpu.models.tree.shared_tree import (
+                build_trees_scanned,
+                replay_batch,
+                scan_chunk_cap,
+                trees_from_stacked,
+            )
+
+            cap = scan_chunk_cap(p.max_depth, n_bins)
+            interval = max(1, p.score_tree_interval)
+            m_done = 0
+            while m_done < p.ntrees and not job.stop_requested:
+                chunk = min(interval, cap, p.ntrees - m_done)
+                chunk_trees: list[list[Tree]] = [[] for _ in range(chunk)]
+                for k in range(n_out):
+                    F[k], varimp_dev, stacked = build_trees_scanned(
+                        bins, w, targets[k], F[k], varimp_dev,
+                        jax.random.fold_in(rngkey, 7919 + k), chunk,
+                        row_key=rngkey,
+                        tree_offset=m_done,
+                        grad_fn=lambda F_, y_, w_: (y_, w_),  # leaf = node mean
+                        grad_key=("drf",),
+                        sample_rate=p.sample_rate,
+                        n_bins=n_bins,
+                        is_cat_cols=spec.is_cat,
+                        max_depth=p.max_depth,
+                        min_rows=p.min_rows,
+                        min_split_improvement=p.min_split_improvement,
+                        learn_rates=np.ones(chunk, np.float32),
+                        max_abs_leaf=float("inf"),
+                        col_sample_rate=col_rate,
+                        col_sample_rate_per_tree=1.0,
+                    )
+                    for ti, tr in enumerate(trees_from_stacked(stacked, chunk)):
+                        chunk_trees[ti].append(tr)
+                    if Fv is not None:
+                        Fv[k] = replay_batch(bins_v, stacked, Fv[k])
+                trees.extend(chunk_trees)
+                m_done += chunk
+
+                mval = self._train_metric(
+                    F, yn, wn, train.nrow, m_done, K, classification, metric_name
+                )
+                entry = {"ntrees": m_done, f"training_{metric_name}": mval}
+                stop_val = mval
+                if Fv is not None:
+                    vval = self._train_metric(
+                        Fv, yv_np, wv_np, valid.nrow, m_done, K, classification,
+                        metric_name,
+                    )
+                    entry[f"validation_{metric_name}"] = vval
+                    stop_val = vval
+                history.append(entry)
+                keeper.record(stop_val)
+                if keeper.should_stop():
+                    Log.info(f"DRF early stop at {m_done} trees")
+                    break
+                job.update(0.05 + 0.9 * m_done / p.ntrees)
+
+        for m in range(0 if not use_scan else p.ntrees, p.ntrees):
             if job.stop_requested:
                 break
             rngkey, sk = jax.random.split(rngkey)
@@ -192,23 +261,38 @@ class DRF(ModelBuilder):
         }
         model = DRFModel(DKV.make_key("drf"), p, out)
         model.scoring_history = history
-        model.training_metrics = model._score_metrics(train)
+        nt = max(len(trees), 1)
+        dom = out["response_domain"]
+        model.training_metrics = self._metrics_from_F(
+            F, yn, wn, train.nrow, nt, K, classification, domain=dom
+        )
         if valid is not None:
-            model.validation_metrics = model._score_metrics(valid)
+            model.validation_metrics = self._metrics_from_F(
+                Fv, yv_np, wv_np, valid.nrow, nt, K, classification, domain=dom
+            )
         return model
 
-    def _train_metric(self, F, yn, wn, nrow, ntrees, K, classification, metric_name) -> float:
-        avg = [np.asarray(f)[:nrow] / ntrees for f in F]
+    def _metrics_from_F(self, F, yn, wn, nrow, ntrees, K, classification, domain=None):
+        """Full ModelMetrics from the running per-class sums (no replay)."""
+        dev = jax.default_backend() != "cpu"
+        avg = [(f[:nrow] if dev else np.asarray(f)[:nrow]) / ntrees for f in F]
+        xp = jnp if dev else np
         if K > 1:
-            P = np.stack(avg, axis=1)
-            P = np.clip(P, 1e-9, None)
-            P /= P.sum(axis=1, keepdims=True)
-            m = MM.multinomial_metrics(yn[:nrow].astype(np.int64), P, wn[:nrow])
-        elif classification:
-            p1 = np.clip(avg[0], 0.0, 1.0)
-            m = MM.binomial_metrics(yn[:nrow], p1, wn[:nrow])
-        else:
-            m = MM.regression_metrics(yn[:nrow], avg[0], wn[:nrow])
+            P = xp.stack(avg, axis=1)
+            P = xp.clip(P, 1e-9, None)
+            P = P / P.sum(axis=1, keepdims=True)
+            return MM.multinomial_metrics(
+                yn[:nrow].astype(np.int64), P, wn[:nrow], domain=domain or ()
+            )
+        if classification:
+            p1 = xp.clip(avg[0], 0.0, 1.0)
+            return MM.binomial_metrics(
+                yn[:nrow], p1, wn[:nrow], domain=domain or ("0", "1")
+            )
+        return MM.regression_metrics(yn[:nrow], avg[0], wn[:nrow])
+
+    def _train_metric(self, F, yn, wn, nrow, ntrees, K, classification, metric_name) -> float:
+        m = self._metrics_from_F(F, yn, wn, nrow, ntrees, K, classification)
         v = m._v.get(metric_name)
         if v is None:
             v = m._v.get("logloss" if classification else "rmse")

@@ -44,10 +44,15 @@ _NEG = -1e30
 # split finding (pure function, traced inside the level step)
 
 
-def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
+def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols=()):
     """Best split per node from hist (N, C, B, 4). Returns per-node arrays.
 
     Stats axis: 0=w, 1=wy, 2=wy2, 3=wh. Bin 0 is the NA bin.
+
+    ``cat_cols`` is the STATIC tuple of categorical column indices: the
+    mean-sorted categorical branch (two argsorts over (N, C, B-1) — by far
+    the most expensive part of this scan on TPU) runs only on that column
+    subset, and disappears entirely for all-numeric frames.
     """
     N, C, B, _ = hist.shape
     total = hist.sum(axis=2)  # (N, C, 4)
@@ -60,18 +65,18 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
 
     parent_se = se(total[:, 0:1, :]).squeeze(1)  # same for every col: (N,)
 
-    # ---- numeric: prefix split over natural bin order ----
-    cum = jnp.cumsum(data, axis=2)  # (N, C, B-1, 4)
-    tot_nonna = cum[:, :, -1:, :]
-    left_n = cum[:, :, :-1, :]  # split after data-bin t: left = bins 1..t+1
-    right_n = tot_nonna - left_n
-
     def gain_with_na(L, R):
         gl = se(L)
         gr = se(R)
         ok = (L[..., 0] >= min_rows) & (R[..., 0] >= min_rows)
         g = parent_se[:, None, None] - gl - gr
         return jnp.where(ok, g, _NEG)
+
+    # ---- numeric: prefix split over natural bin order ----
+    cum = jnp.cumsum(data, axis=2)  # (N, C, B-1, 4)
+    tot_nonna = cum[:, :, -1:, :]
+    left_n = cum[:, :, :-1, :]  # split after data-bin t: left = bins 1..t+1
+    right_n = tot_nonna - left_n
 
     g_naleft = gain_with_na(left_n + na[:, :, None, :], right_n)
     g_naright = gain_with_na(left_n, right_n + na[:, :, None, :])
@@ -83,49 +88,69 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement):
         >= jnp.take_along_axis(g_naright, num_best_t[:, :, None], 2).squeeze(2)
     )
 
-    # ---- categorical: prefix split in mean-sorted bin order ----
-    w_bins = data[..., 0]
-    mean = jnp.where(w_bins > 0, data[..., 1] / jnp.maximum(w_bins, 1e-30), jnp.inf)
-    order = jnp.argsort(mean, axis=2)  # (N, C, B-1) empty bins (inf) last
-    sdata = jnp.take_along_axis(data, order[..., None], axis=2)
-    scum = jnp.cumsum(sdata, axis=2)
-    s_tot = scum[:, :, -1:, :]
-    s_left = scum[:, :, :-1, :]
-    s_right = s_tot - s_left
-    gc_naleft = gain_with_na(s_left + na[:, :, None, :], s_right)
-    gc_naright = gain_with_na(s_left, s_right + na[:, :, None, :])
-    g_cat = jnp.maximum(gc_naleft, gc_naright)
-    cat_best_k = jnp.argmax(g_cat, axis=2)  # (N, C) prefix length-1
-    cat_best_gain = jnp.take_along_axis(g_cat, cat_best_k[:, :, None], 2).squeeze(2)
-    cat_na_left = (
-        jnp.take_along_axis(gc_naleft, cat_best_k[:, :, None], 2).squeeze(2)
-        >= jnp.take_along_axis(gc_naright, cat_best_k[:, :, None], 2).squeeze(2)
-    )
+    if cat_cols:
+        # ---- categorical: prefix split in mean-sorted bin order, on the
+        # categorical column subset only ----
+        cat_idx = jnp.asarray(np.asarray(cat_cols, np.int32))
+        Cc = len(cat_cols)
+        data_c = data[:, cat_idx, :, :]  # (N, Cc, B-1, 4)
+        na_c = na[:, cat_idx, :]
+        w_bins = data_c[..., 0]
+        mean = jnp.where(w_bins > 0, data_c[..., 1] / jnp.maximum(w_bins, 1e-30), jnp.inf)
+        order = jnp.argsort(mean, axis=2)  # (N, Cc, B-1) empty bins (inf) last
+        sdata = jnp.take_along_axis(data_c, order[..., None], axis=2)
+        scum = jnp.cumsum(sdata, axis=2)
+        s_tot = scum[:, :, -1:, :]
+        s_left = scum[:, :, :-1, :]
+        s_right = s_tot - s_left
+        gc_naleft = gain_with_na(s_left + na_c[:, :, None, :], s_right)
+        gc_naright = gain_with_na(s_left, s_right + na_c[:, :, None, :])
+        g_cat = jnp.maximum(gc_naleft, gc_naright)
+        cat_best_k = jnp.argmax(g_cat, axis=2)  # (N, Cc) prefix length-1
+        cat_best_gain_c = jnp.take_along_axis(g_cat, cat_best_k[:, :, None], 2).squeeze(2)
+        cat_na_left_c = (
+            jnp.take_along_axis(gc_naleft, cat_best_k[:, :, None], 2).squeeze(2)
+            >= jnp.take_along_axis(gc_naright, cat_best_k[:, :, None], 2).squeeze(2)
+        )
+        # scatter subset results back to full column axis
+        cat_best_gain = jnp.full((N, C), _NEG, hist.dtype).at[:, cat_idx].set(cat_best_gain_c)
+        col_gain = jnp.where(is_cat[None, :], cat_best_gain, num_best_gain)
+    else:
+        col_gain = num_best_gain
 
-    # ---- choose per column kind, then best column per node ----
-    col_gain = jnp.where(is_cat[None, :], cat_best_gain, num_best_gain)
+    # ---- choose best column per node ----
     col_gain = jnp.where(col_mask > 0, col_gain, _NEG)
     best_col = jnp.argmax(col_gain, axis=1)  # (N,)
     best_gain = jnp.take_along_axis(col_gain, best_col[:, None], 1).squeeze(1)
 
     take = lambda a: jnp.take_along_axis(a, best_col[:, None], 1).squeeze(1)
-    bc_is_cat = is_cat[best_col]
     bc_t = take(num_best_t)
-    bc_k = take(cat_best_k)
-    bc_na_left = jnp.where(bc_is_cat, take(cat_na_left), take(num_na_left))
-
     # split_bin: numeric → left iff 1 <= bin <= t+1
     split_bin = bc_t + 1
 
-    # cat membership mask over ALL B bins (bin 0 NA handled separately):
-    # rank of data-bin j (order position) <= k  → left
-    ranks = jnp.argsort(order, axis=2)  # (N, C, B-1) rank of each data bin
-    idx = jnp.broadcast_to(best_col[:, None, None], (ranks.shape[0], 1, ranks.shape[2]))
-    best_ranks = jnp.take_along_axis(ranks, idx, axis=1).squeeze(1)  # (N, B-1)
-    cat_left = best_ranks <= bc_k[:, None]  # (N, B-1) for data bins 1..B-1
-    cat_mask = jnp.concatenate(
-        [bc_na_left[:, None], cat_left], axis=1
-    )  # (N, B): bin0 = NA direction
+    if cat_cols:
+        # position of each full col in the cat subset (0 for non-cat; gated
+        # by bc_is_cat downstream so the garbage value is never used)
+        pos_of_col = np.zeros(C, np.int32)
+        pos_of_col[list(cat_cols)] = np.arange(Cc, dtype=np.int32)
+        bc_is_cat = is_cat[best_col]
+        best_pos = jnp.asarray(pos_of_col)[best_col]  # (N,)
+        take_c = lambda a: jnp.take_along_axis(a, best_pos[:, None], 1).squeeze(1)
+        bc_k = take_c(cat_best_k)
+        bc_na_left = jnp.where(bc_is_cat, take_c(cat_na_left_c), take(num_na_left))
+        # cat membership mask over ALL B bins (bin 0 NA handled separately):
+        # rank of data-bin j (order position) <= k  → left
+        ranks = jnp.argsort(order, axis=2)  # (N, Cc, B-1) rank of each data bin
+        idx = jnp.broadcast_to(best_pos[:, None, None], (N, 1, ranks.shape[2]))
+        best_ranks = jnp.take_along_axis(ranks, idx, axis=1).squeeze(1)  # (N, B-1)
+        cat_left = best_ranks <= bc_k[:, None]  # (N, B-1) for data bins 1..B-1
+        cat_mask = jnp.concatenate(
+            [bc_na_left[:, None], cat_left], axis=1
+        )  # (N, B): bin0 = NA direction
+    else:
+        bc_is_cat = jnp.zeros(N, bool)
+        bc_na_left = take(num_na_left)
+        cat_mask = jnp.zeros((N, B), bool)
 
     node_w = total[:, 0, 0]
     node_wy = total[:, 0, 1]
@@ -179,6 +204,7 @@ def _level_step_fn(
     bins_u8, nid, preds, varimp, w, wy, wy2, wh, key, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
+    cat_cols: tuple = (),
 ):
     """One whole tree level on device. Returns (nid, preds, varimp, record).
 
@@ -210,7 +236,9 @@ def _level_step_fn(
         keep = jax.random.uniform(key, (n_pad, C)) < col_sample_rate
         keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
         col_mask = col_mask * keep
-        sp = _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement)
+        sp = _split_scan(
+            hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols
+        )
         ok = sp["ok"]
         # frontier cap: children must fit n_pad_next; later nodes go leaf
         fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
@@ -252,19 +280,242 @@ def _level_step_fn(
 _STEP_CACHE: dict = {}
 
 
-def _level_step(n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool):
-    key = (n_pad, n_pad_next, n_bins, force_leaf, jax.default_backend())
+def _level_step(
+    n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool, cat_cols: tuple = ()
+):
+    key = (n_pad, n_pad_next, n_bins, force_leaf, cat_cols, jax.default_backend())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(
             partial(
                 _level_step_fn,
                 n_pad=n_pad, n_pad_next=n_pad_next,
-                n_bins=n_bins, force_leaf=force_leaf,
+                n_bins=n_bins, force_leaf=force_leaf, cat_cols=cat_cols,
             )
         )
         _STEP_CACHE[key] = fn
     return fn
+
+
+def _tree_program(
+    max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple
+):
+    """One jitted program building a WHOLE tree (all levels unrolled).
+
+    On a networked TPU every dispatch costs tens of ms of tunnel latency;
+    per-level dispatch made the host gap the single largest per-tree cost
+    (BENCH_r03 breakdown: 2.0 s/tree host vs 2.3 s device). One dispatch per
+    tree removes it. Levels still have level-specific node counts (the
+    frontier cap) — the unrolled program embeds each level's shapes.
+    """
+    key = ("tree", max_depth, n_bins, node_cap, cat_cols, jax.default_backend())
+    fn = _STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def whole_tree(
+        bins_u8, preds, varimp, w, wy, wy2, wh, key_, cols_enabled, is_cat,
+        min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+    ):
+        nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+        records = []
+        for depth in range(max_depth + 1):
+            n_pad = min(1 << depth, node_cap)
+            n_pad_next = min(2 * n_pad, node_cap)
+            force_leaf = depth == max_depth
+            lkey = jax.random.fold_in(key_, depth)
+            nid, preds, varimp, _, rec = _level_step_fn(
+                bins_u8, nid, preds, varimp, w, wy, wy2, wh, lkey,
+                cols_enabled, is_cat,
+                min_rows, min_split_improvement, learn_rate, max_abs_leaf,
+                col_sample_rate,
+                n_pad=n_pad, n_pad_next=n_pad_next, n_bins=n_bins,
+                force_leaf=force_leaf, cat_cols=cat_cols,
+            )
+            records.append(rec)
+        return nid, preds, varimp, tuple(records)
+
+    fn = jax.jit(whole_tree)
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def build_trees_scanned(
+    bins_u8,
+    w,
+    y,
+    preds,
+    varimp,
+    base_key,
+    n_trees: int,
+    *,
+    row_key=None,
+    tree_offset: int = 0,
+    grad_fn,
+    grad_key,
+    sample_rate: float,
+    n_bins: int,
+    is_cat_cols,
+    max_depth: int,
+    min_rows: float,
+    min_split_improvement: float,
+    learn_rates,
+    max_abs_leaf: float,
+    col_sample_rate: float,
+    col_sample_rate_per_tree: float,
+    node_cap: int = 2048,
+):
+    """Build ``n_trees`` trees in ONE device dispatch (lax.scan over trees).
+
+    On the tunneled TPU every dispatch costs ~66 ms once any device→host
+    transfer has happened (see bench breakdown r03); per-tree dispatch made
+    host latency the dominant cost. This scans whole scoring intervals.
+
+    ``grad_fn(F, y, w_tree) -> (t, h)`` supplies per-tree pseudo-residuals
+    and hessians (distribution-specific, traced); ``grad_key`` is a hashable
+    cache token identifying it. ``learn_rates`` is a host array of length
+    ``n_trees`` (annealing). ``row_key`` (defaults to ``base_key``) seeds the
+    per-tree row bootstrap separately so DRF's K class-trees can share one
+    bootstrap while drawing distinct column/level randomness. ``tree_offset``
+    is the global index of the chunk's first tree, keeping per-tree key
+    folds stable across chunk boundaries. Returns ``(preds, varimp,
+    stacked)`` where ``stacked`` is a tuple over levels of record dicts with
+    a leading ``n_trees`` axis — convert with :func:`trees_from_stacked`.
+    """
+    C = bins_u8.shape[1]
+    is_cat_np = np.asarray(is_cat_cols, bool)
+    cat_cols = tuple(int(i) for i in np.nonzero(is_cat_np)[0])
+    is_cat_dev = jnp.asarray(is_cat_np)
+
+    # the float rates are baked into the traced closure, so they MUST be part
+    # of the cache key (a boolean would silently reuse another model's rates)
+    key = (
+        "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key,
+        float(sample_rate), float(col_sample_rate_per_tree),
+        jax.default_backend(),
+    )
+    prog = _STEP_CACHE.get(key)
+    if prog is None:
+
+        def whole_chunk(
+            bins_u8, w, y, preds, varimp, base_key, row_key_, offset, lrs, is_cat,
+            min_rows_, msi_, max_abs_leaf_, col_rate_,
+        ):
+            def body(carry, per_tree):
+                F, vi = carry
+                i, lr = per_tree
+                m = i + offset
+                tkey = jax.random.fold_in(base_key, m)
+                if sample_rate < 1.0:
+                    mask = jax.random.bernoulli(
+                        jax.random.fold_in(jax.random.fold_in(row_key_, m), 1 << 29),
+                        sample_rate,
+                        w.shape,
+                    )
+                    w_tree = w * mask.astype(w.dtype)
+                else:
+                    w_tree = w
+                t, h = grad_fn(F, y, w_tree)
+                wy = w_tree * t
+                wy2 = wy * t
+                wh = jnp.where(w_tree > 0, h, 0.0)
+                if col_sample_rate_per_tree < 1.0:
+                    keep = (
+                        jax.random.uniform(jax.random.fold_in(tkey, 1 << 30), (C,))
+                        < col_sample_rate_per_tree
+                    )
+                    keep = jnp.where(keep.any(), keep, True)
+                    cols_enabled = keep.astype(jnp.float32)
+                else:
+                    cols_enabled = jnp.ones(C, jnp.float32)
+
+                nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+                recs = []
+                for depth in range(max_depth + 1):
+                    n_pad = min(1 << depth, node_cap)
+                    n_pad_next = min(2 * n_pad, node_cap)
+                    nid, F, vi, _, rec = _level_step_fn(
+                        bins_u8, nid, F, vi, w_tree, wy, wy2, wh,
+                        jax.random.fold_in(tkey, depth), cols_enabled, is_cat,
+                        min_rows_, msi_, lr, max_abs_leaf_, col_rate_,
+                        n_pad=n_pad, n_pad_next=n_pad_next, n_bins=n_bins,
+                        force_leaf=depth == max_depth, cat_cols=cat_cols,
+                    )
+                    recs.append(rec)
+                return (F, vi), tuple(recs)
+
+            (preds, varimp), stacked = jax.lax.scan(
+                body, (preds, varimp), (jnp.arange(n_trees), lrs)
+            )
+            return preds, varimp, stacked
+
+        prog = jax.jit(whole_chunk)
+        _STEP_CACHE[key] = prog
+
+    lrs = jnp.asarray(np.asarray(learn_rates, np.float32))
+    return prog(
+        bins_u8, w, y, preds, varimp, base_key,
+        base_key if row_key is None else row_key,
+        jnp.int32(tree_offset), lrs, is_cat_dev,
+        jnp.float32(min_rows), jnp.float32(min_split_improvement),
+        jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate),
+    )
+
+
+def scan_chunk_cap(
+    max_depth: int, n_bins: int, node_cap: int = 2048, budget_bytes: int = 256 << 20
+) -> int:
+    """Max trees per scanned dispatch so stacked records fit the budget
+    (cat_mask (T, N, B) dominates; deep DRF trees are ~6 MB each)."""
+    per_tree = 0
+    for depth in range(max_depth + 1):
+        n = min(1 << depth, node_cap)
+        per_tree += n * (n_bins + 40)
+    return max(1, int(budget_bytes // max(per_tree, 1)))
+
+
+def trees_from_stacked(stacked, n_trees: int) -> list["Tree"]:
+    """ONE device→host transfer for a whole chunk → numpy-backed Trees."""
+    host = jax.device_get(stacked)
+    out = []
+    for ti in range(n_trees):
+        tree = Tree()
+        for lvl in host:
+            tree.levels.append(
+                TreeLevel(**{k: np.asarray(v[ti]) for k, v in lvl.items()})
+            )
+        out.append(tree)
+    return out
+
+
+def replay_batch(bins_u8, stacked, preds):
+    """Replay a whole stacked chunk of trees in ONE dispatch.
+
+    ``stacked`` is the (device or host) tuple-over-levels of record dicts
+    with leading tree axis, as returned by :func:`build_trees_scanned`.
+    """
+    n_levels = len(stacked)
+    key = ("replay", n_levels, jax.default_backend())
+    prog = _STEP_CACHE.get(key)
+    if prog is None:
+
+        def run(bins_u8, stacked, preds):
+            def body(preds, tree_recs):
+                nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+                for rec in tree_recs:
+                    nid, preds = _partition_update(
+                        bins_u8, nid, preds, rec["split_col"], rec["split_bin"],
+                        rec["is_cat"], rec["cat_mask"], rec["na_left"],
+                        rec["leaf_now"], rec["leaf_val"], rec["child_base"],
+                    )
+                return preds, None
+
+            preds, _ = jax.lax.scan(body, preds, stacked)
+            return preds
+
+        prog = jax.jit(run)
+        _STEP_CACHE[key] = prog
+    return prog(bins_u8, stacked, preds)
 
 
 # ---------------------------------------------------------------------------
@@ -368,14 +619,34 @@ def build_tree(
     else:
         cols_enabled_dev = jnp.ones(C, jnp.float32)
 
-    nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+    cat_cols = tuple(int(i) for i in np.nonzero(np.asarray(is_cat_cols, bool))[0])
     tree = Tree()
 
+    # On accelerators, build the WHOLE tree in one dispatch (tunnel-latency
+    # amortization; no early-exit polling is possible, acceptable up to
+    # moderate depth). On CPU — and for very deep trees, where an unrolled
+    # program would compile for minutes and dead-level dispatch is cheap —
+    # keep the per-level loop with early-exit polling.
+    fused = jax.default_backend() != "cpu" and max_depth <= 12
+    if fused:
+        prog = _tree_program(max_depth, n_bins, node_cap, cat_cols)
+        _, preds, varimp, records = prog(
+            bins_u8, preds, varimp, w, wy, wy2, wh, key, cols_enabled_dev,
+            is_cat_dev,
+            jnp.float32(min_rows), jnp.float32(min_split_improvement),
+            jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+            jnp.float32(col_sample_rate),
+        )
+        for rec in records:
+            tree.levels.append(TreeLevel(**rec))
+        return tree, preds, varimp
+
+    nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
     for depth in range(max_depth + 1):
         n_pad = min(1 << depth, node_cap)
         n_pad_next = min(2 * n_pad, node_cap)
         force_leaf = depth == max_depth
-        step = _level_step(n_pad, n_pad_next, n_bins, force_leaf)
+        step = _level_step(n_pad, n_pad_next, n_bins, force_leaf, cat_cols)
         lkey = jax.random.fold_in(key, depth)
         nid, preds, varimp, n_split, rec = step(
             bins_u8, nid, preds, varimp, w, wy, wy2, wh, lkey, cols_enabled_dev,
@@ -389,8 +660,7 @@ def build_tree(
             break
         # Early-exit polling trades a blocking device→host pull against
         # dispatching useless empty levels. On a local CPU mesh the pull is
-        # ~free, poll every level; on a (possibly networked) TPU a pull costs
-        # ~100ms RTT, so only poll occasionally past GBM-typical depths.
+        # ~free, poll every level; past GBM-typical depths poll sparsely.
         if jax.default_backend() == "cpu":
             if int(n_split) == 0:
                 break

@@ -1,0 +1,167 @@
+"""Pallas TPU histogram kernel — the ``gpu_hist`` successor proper
+(SURVEY.md §2.4: the bundled XGBoost CUDA histogram builder is the one native
+component the rebuild must replace with a TPU kernel).
+
+Why the plain-XLA matmul path (``histogram._hist_matmul_local``) is slow: it
+materializes a (row_chunk, C·B) one-hot indicator — ~235 MB at C=28, B=256 —
+which cannot live in VMEM, so every chunk round-trips the indicator through
+HBM and the pass is bandwidth-crippled (~1-3% MFU measured, BENCH_r02).
+
+This kernel never materializes that transient:
+
+- grid = (node_tiles, col_tiles, row_chunks), row-fastest, so the output
+  block for one (node_tile, col_tile) stays resident in VMEM while every row
+  chunk accumulates into it;
+- per step, the (R, CT·B) indicator tile and the (R, NT·4) stat-scaled
+  node-one-hot are built in VMEM by iota-compare (VPU) and immediately
+  contracted on the MXU — one f32 dot per step, all 4 stats fused into the
+  M dimension;
+- rows with nid outside the tile (or nid = -1: retired/padding) match no
+  one-hot column and contribute zero, so node tiling and row padding need no
+  masking anywhere.
+
+Output layout matches the other local paths: (C, n_nodes·n_bins, 4) per
+shard; the caller (``histogram.histogram_in_jit``) psums across the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_TILE = 512  # rows per grid step
+COL_TILE = 8  # feature columns per grid step
+NODE_TILE = 64  # tree nodes per grid step (4·NT = 256 M-rows on the MXU)
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, *, nt, ct, bpad):
+    i_nt = pl.program_id(0)
+    i_r = pl.program_id(2)
+
+    r = bins_ref.shape[1]  # bins block is (1, R, CT)
+    # Everything is built directly in 2D with lane-iota arithmetic: Mosaic
+    # cannot relayout (R, k, m) → (R, k·m) for small trailing dims.
+
+    # stat-scaled node one-hot, nodes of this tile only: (R, NT·4) with
+    # column j ↦ (node = j//4, stat = j%4)
+    node_base = i_nt * nt
+    node_j = node_base + jax.lax.broadcasted_iota(jnp.int32, (r, nt * 4), 1) // 4
+    nid_match = (nid_ref[:] == node_j).astype(jnp.float32)  # (R,1) broadcasts
+    stat_tile = jnp.tile(stats_ref[:], (1, nt))  # (R, NT·4): [s0..s3]×NT
+    a = nid_match * stat_tile
+
+    # (R, CT·Bpad) 0/1 bin indicator, lane j ↦ (bin = j//CT, col = j%CT) —
+    # the tile-order jnp.tile lays out [c0..c(CT-1)] × Bpad blocks. The column
+    # tile arrives via the BlockSpec from the (n_ct, npad, CT) layout
+    # (lane-dim dynamic slices at non-128 offsets are not expressible
+    # in-kernel, and a (R, CT) block would violate the lane-divisibility rule).
+    bins_ct = bins_ref[0].astype(jnp.int32)  # (R, CT)
+    colrep = jnp.tile(bins_ct, (1, bpad))  # (R, CT·Bpad)
+    bin_j = jax.lax.broadcasted_iota(jnp.int32, (r, ct * bpad), 1) // ct
+    e = (colrep == bin_j).astype(jnp.bfloat16)  # 0/1: exact in bf16
+
+    # Manual 2-term bf16 split of the stats operand (~16 mantissa bits, ≈
+    # Precision.HIGH, which Mosaic doesn't support): the indicator operand is
+    # exact in bf16, so only `a` needs decomposing — 2 MXU passes instead of
+    # HIGHEST's 6. Single-pass bf16 measurably corrupts split gains (2e-3).
+    a_hi = a.astype(jnp.bfloat16)
+    a_lo = (a - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    dims = (((0,), (0,)), ((), ()))
+    contrib = jax.lax.dot_general(
+        a_hi, e, dims, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        a_lo, e, dims, preferred_element_type=jnp.float32
+    )  # (NT·4, CT·Bpad)
+
+    @pl.when(i_r == 0)
+    def _():
+        out_ref[:] = contrib
+
+    @pl.when(i_r > 0)
+    def _():
+        out_ref[:] = out_ref[:] + contrib
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_nodes", "n_bins", "interpret")
+)
+def hist_pallas_local(
+    bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, interpret: bool = False
+):
+    """Shard-local Pallas histogram: returns (C, n_nodes*n_bins, 4) float32.
+
+    Drop-in replacement for ``_hist_matmul_local`` / ``_hist_scatter_local``.
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI).
+    """
+    n, c = bins_u8.shape
+    nt = min(NODE_TILE, n_nodes)
+    ct = min(COL_TILE, c)
+    # pad bins axis so the lane dimension CT·Bpad is a multiple of 128
+    bpad = _cdiv(n_bins, 16) * 16
+    while (ct * bpad) % 128:
+        bpad += 16
+    n_nt = _cdiv(n_nodes, nt)
+    n_ct = _cdiv(c, ct)
+    cpad = n_ct * ct
+    n_r = max(_cdiv(n, ROW_TILE), 1)
+    npad = n_r * ROW_TILE
+
+    if npad != n:
+        bins_u8 = jnp.pad(bins_u8, ((0, npad - n), (0, 0)))
+        nid = jnp.pad(nid, (0, npad - n), constant_values=-1)
+        w = jnp.pad(w, (0, npad - n))
+        wy = jnp.pad(wy, (0, npad - n))
+        wy2 = jnp.pad(wy2, (0, npad - n))
+        wh = jnp.pad(wh, (0, npad - n))
+    if cpad != c:
+        bins_u8 = jnp.pad(bins_u8, ((0, 0), (0, cpad - c)))
+    # (npad, cpad) → (n_ct, npad, CT): each grid step's column tile is the
+    # (full) last dim of its block, satisfying Mosaic's lane-divisibility rule
+    bins3 = jnp.transpose(bins_u8.reshape(npad, n_ct, ct), (1, 0, 2))
+    stats = jnp.stack([w, wy, wy2, wh], axis=1)  # (npad, 4)
+    nid2 = nid.reshape(npad, 1)
+
+    kernel = functools.partial(_hist_kernel, nt=nt, ct=ct, bpad=bpad)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_nt, n_ct, n_r),
+        in_specs=[
+            pl.BlockSpec(
+                (1, ROW_TILE, ct),
+                lambda nt_, ct_, r_: (ct_, r_, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (ROW_TILE, 1), lambda nt_, ct_, r_: (r_, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (ROW_TILE, 4), lambda nt_, ct_, r_: (r_, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (nt * 4, ct * bpad), lambda nt_, ct_, r_: (nt_, ct_), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_nt * nt * 4, cpad * bpad), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=int(2 * npad * (nt * 4) * cpad * bpad),
+            bytes_accessed=int(
+                npad * cpad + npad * (4 + 1) * 4 + n_nt * nt * 4 * cpad * bpad * 4
+            ),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(bins3, nid2, stats)
+
+    # unscramble: out rows = node·4+stat, lanes = ct-tile-major [bin//CT, col%CT]
+    h5 = out.reshape(n_nt * nt, 4, n_ct, bpad, ct)
+    h5 = jnp.transpose(h5, (2, 4, 0, 3, 1))  # (n_ct, ct, Npad, Bpad, 4)
+    h = h5.reshape(cpad, n_nt * nt, bpad, 4)[:c, :n_nodes, :n_bins, :]
+    return h.reshape(c, n_nodes * n_bins, 4)

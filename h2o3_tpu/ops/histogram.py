@@ -71,6 +71,28 @@ def _hist_scatter_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int)
     return jax.vmap(one_col, in_axes=1)(bins_u8)  # (C, n_nodes*n_bins, 4)
 
 
+def _select_local():
+    """Backend-appropriate shard-local histogram implementation.
+
+    CPU: scatter-add (fast there, pathological on TPU). TPU: the Pallas
+    kernel (hist_pallas.py) unless ``H2O3_TPU_HIST=matmul`` forces the plain
+    XLA fallback.
+    """
+    import os
+
+    if jax.default_backend() == "cpu":
+        return _hist_scatter_local
+    if os.environ.get("H2O3_TPU_HIST") == "matmul":
+        return _hist_matmul_local
+
+    def pallas_local(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins):
+        from h2o3_tpu.ops.hist_pallas import hist_pallas_local
+
+        return hist_pallas_local(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins)
+
+    return pallas_local
+
+
 _ROW_CHUNK = 8192  # rows per matmul chunk: (chunk, C*B) transient ≤ ~120MB
 
 
@@ -126,7 +148,7 @@ def histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, me
     Returns (n_nodes, C, n_bins, 4), replicated across the mesh.
     """
     mesh = mesh or get_mesh()
-    local = _hist_scatter_local if jax.default_backend() == "cpu" else _hist_matmul_local
+    local = _select_local()
 
     def body(b, n, w_, wy_, wy2_, wh_):
         h = local(b, n, w_, wy_, wy2_, wh_, n_nodes, n_bins)
