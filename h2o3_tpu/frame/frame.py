@@ -393,17 +393,66 @@ class Frame:
 
         Domains, kinds, and TIME precision are preserved exactly (no pandas
         round-trip) — H2O likewise keeps the parent Vec domain on slices.
+        Numeric/categorical columns are gathered ON DEVICE in one fused
+        program (the former per-column to_numpy pulled every column to host).
         """
         rows = np.asarray(rows)
         if rows.dtype == bool:
             rows = np.flatnonzero(rows)
-        vecs = []
-        for v in self._vecs:
+        # python-style negative indexing (numpy fancy-index semantics);
+        # gather_rows itself reserves negatives for NA rows (joins)
+        rows = np.where(rows < 0, rows + self.nrow, rows)
+        return self.gather_rows(rows, key=key)
+
+    def gather_rows(
+        self, rows: np.ndarray, valid: np.ndarray | None = None, key: str | None = None
+    ) -> "Frame":
+        """Device row gather: output row i = input row ``rows[i]``; rows where
+        ``valid`` is False (or ``rows < 0``) come out as NA. The workhorse of
+        subset/sort/merge."""
+        rows = np.asarray(rows)
+        m = len(rows)
+        if valid is None:
+            valid = rows >= 0
+        valid = np.asarray(valid, bool)
+        idx_np = np.where(valid, rows, 0).astype(np.int64)
+        npad_new = pad_to_shards(m)
+        idx_pad = np.zeros(npad_new, np.int64)
+        idx_pad[:m] = idx_np
+        bad = np.ones(npad_new, bool)
+        bad[:m] = ~valid
+
+        dev_ids = [i for i, v in enumerate(self._vecs) if v.kind != STR]
+        kinds = tuple(self._vecs[i].kind for i in dev_ids)
+        gathered = ()
+        if dev_ids:
+            prog = _gather_program(kinds)
+            gathered = prog(
+                tuple(self._vecs[i].data for i in dev_ids),
+                jnp.asarray(idx_pad),
+                jnp.asarray(bad),
+            )
+            gathered = jax.device_put(gathered, row_sharding())
+
+        vecs: list[Vec] = []
+        gi = 0
+        for i, v in enumerate(self._vecs):
             if v.kind == STR:
-                vecs.append(Vec(v._host[rows], STR, name=v.name))
-            else:
-                vals = v.to_numpy()[rows]
-                vecs.append(Vec.from_numpy(vals, v.kind, name=v.name, domain=v.domain))
+                out = np.full(m, None, dtype=object)
+                out[valid] = v._host[idx_np[valid]]
+                vecs.append(Vec(out, STR, name=v.name))
+                continue
+            exact = None
+            if v._host is not None:  # TIME exactness preserved host-side
+                exact = np.full(m, np.nan, np.float64)
+                exact[valid] = v._host[idx_np[valid]]
+            vecs.append(
+                Vec(
+                    gathered[gi], v.kind, name=v.name, domain=v.domain,
+                    nrow=m, host_exact=exact,
+                )
+            )
+            gi += 1
         return Frame(vecs, self._names, key=key)
 
     def split_frame(self, ratios: Sequence[float], seed: int = 1234) -> list["Frame"]:
@@ -422,3 +471,30 @@ class Frame:
 
 def _iota_mask(npad: int, nrow: int):
     return shard_rows((np.arange(npad) < nrow).astype(np.float32))
+
+
+_GATHER_CACHE: dict = {}
+
+
+def _gather_program(kinds: tuple):
+    """Fused one-dispatch row gather for all non-string columns."""
+    import jax as _jax
+
+    key = (kinds, _jax.default_backend())
+    prog = _GATHER_CACHE.get(key)
+    if prog is None:
+
+        def run(datas, idx, bad):
+            outs = []
+            for d, k in zip(datas, kinds):
+                g = jnp.take(d, idx, axis=0)
+                if k == CAT:
+                    g = jnp.where(bad, -1, g)
+                else:
+                    g = jnp.where(bad, jnp.nan, g)
+                outs.append(g)
+            return tuple(outs)
+
+        prog = _jax.jit(run)
+        _GATHER_CACHE[key] = prog
+    return prog

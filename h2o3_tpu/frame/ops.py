@@ -337,9 +337,11 @@ def group_by(frame: Frame, by) -> GroupBy:
 
 # ---------------------------------------------------------------------------
 # merge / sort — successor of ``ASTMerge`` (distributed radix join) and
-# ``ASTSort``. Host-coordinated: keys come to the host columnar (they often
-# are strings/enums), the row permutation is computed with a radix-style
-# pandas merge, and the gathered columns are re-sharded to device.
+# ``ASTSort``. Host-coordinated on the KEY COLUMNS ONLY: the join/sort
+# permutation is computed from the pulled key columns (often strings/enums),
+# then every payload column is gathered ON DEVICE in one fused program
+# (``Frame.gather_rows``) — the former implementation round-tripped both
+# whole frames through pandas.
 # ---------------------------------------------------------------------------
 
 
@@ -355,20 +357,86 @@ def merge(
     bx = list(by_x or by or [n for n in left.names if n in set(right.names)])
     bby = list(by_y or by or bx)
     how = "outer" if (all_x and all_y) else "left" if all_x else "right" if all_y else "inner"
-    ldf = left.to_pandas()
-    rdf = right.to_pandas()
-    out = ldf.merge(rdf, left_on=bx, right_on=bby, how=how, suffixes=("", "_y"))
-    # TIME omitted: to_pandas emits real datetime columns, so TIME re-infers
-    types = {**right.types, **left.types}
-    col_types = {c: types[c] for c in out.columns if c in types and types[c] in (CAT, STR)}
-    return Frame.from_pandas(out, column_types=col_types)
+
+    def _key_col(v):
+        x = v.to_numpy()
+        if v.kind == CAT:  # join on LABELS — codes are frame-local
+            dom = np.asarray(list(v.domain or ()) + [None], dtype=object)
+            return dom[np.where(x >= 0, x, len(dom) - 1).astype(np.int64)]
+        return x
+
+    lk = pd.DataFrame({c: _key_col(left.vec(c)) for c in bx})
+    rk = pd.DataFrame({c: _key_col(right.vec(c)) for c in bby})
+    lk["__li__"] = np.arange(left.nrow, dtype=np.int64)
+    rk["__ri__"] = np.arange(right.nrow, dtype=np.int64)
+    j = lk.merge(rk, left_on=bx, right_on=bby, how=how, suffixes=("", "__rk"))
+    li = j["__li__"].to_numpy()
+    ri = j["__ri__"].to_numpy()
+    lvalid = ~pd.isna(li)
+    rvalid = ~pd.isna(ri)
+    li = np.where(lvalid, li, -1).astype(np.int64)
+    ri = np.where(rvalid, ri, -1).astype(np.int64)
+
+    lg = left.gather_rows(li)
+    rcols = [n for n in right.names if n not in set(bby)]
+    rg = right[rcols].gather_rows(ri) if rcols else None
+
+    # join keys: take from whichever side has them (left wins; right-only
+    # rows of an outer/right join fill from the right key columns)
+    out_vecs, out_names = [], []
+    for i, n in enumerate(lg.names):
+        v = lg.vec(n)
+        if n in set(bx) and (~lvalid).any():
+            rkey = right.vec(bby[bx.index(n)]) if bby[bx.index(n)] in right else None
+            if rkey is not None:
+                patched = right[[bby[bx.index(n)]]].gather_rows(ri).vec(0)
+                v = _coalesce_vec(v, patched, lvalid)
+        out_vecs.append(v)
+        out_names.append(n)
+    if rg is not None:
+        taken = set(out_names)
+        for n in rg.names:
+            out_vecs.append(rg.vec(n))
+            out_names.append(n + "_y" if n in taken else n)
+    return Frame(out_vecs, out_names)
+
+
+def _coalesce_vec(a, b, use_a: np.ndarray):
+    """a where use_a else b — for filling join keys of right-only rows."""
+    import jax
+
+    from h2o3_tpu.frame.frame import CAT, STR, Vec
+    from h2o3_tpu.parallel.mesh import row_sharding
+
+    if a.kind == STR:
+        out = a._host.copy()
+        out[~use_a] = b._host[~use_a]
+        return Vec(out, STR, name=a.name)
+    if a.kind == CAT and tuple(a.domain or ()) != tuple(b.domain or ()):
+        # differing enum domains: rebuild over the union (host; key columns
+        # of outer joins only — payload columns never coalesce)
+        av, bv = a.to_numpy(), b.to_numpy()
+        dom = list(a.domain or ()) + [d for d in (b.domain or ()) if d not in set(a.domain or ())]
+        lut_b = {d: i for i, d in enumerate(dom)}
+        bmap = np.array([lut_b[d] for d in (b.domain or ())], np.int64)
+        codes = np.where(
+            use_a, av, np.where(bv >= 0, bmap[np.clip(bv, 0, None).astype(np.int64)], -1)
+        )
+        return Vec.from_numpy(codes.astype(np.int64), CAT, name=a.name, domain=tuple(dom))
+    npad = a.data.shape[0]
+    mask = np.zeros(npad, bool)
+    mask[: len(use_a)] = use_a
+    data = jax.device_put(
+        jnp.where(jnp.asarray(mask), a.data, b.data), row_sharding()
+    )
+    return Vec(data, a.kind, name=a.name, domain=a.domain, nrow=a.nrow)
 
 
 def sort(frame: Frame, by: Sequence[str] | str, ascending: bool | Sequence[bool] = True) -> Frame:
     by = [by] if isinstance(by, str) else list(by)
     df = pd.DataFrame({b: frame.vec(b).to_numpy() for b in by})
     order = df.sort_values(by=by, ascending=ascending, kind="stable").index.to_numpy()
-    return frame.subset_rows(order)
+    return frame.gather_rows(order)
 
 
 # ---------------------------------------------------------------------------

@@ -311,28 +311,59 @@ class ModelBuilder:
             folds = list(range(nfolds))
 
         main = self.model
+        # Folds are WEIGHT MASKS over the one padded sharded frame — every
+        # fold model trains and predicts on identical shapes, so the compiled
+        # programs from fold 1 are reused verbatim by folds 2..k and nothing
+        # is re-uploaded (former subset_rows CV re-uploaded and re-compiled
+        # per fold). Holdout rows carry weight 0: they contribute nothing to
+        # histograms/Gram/SGD, metrics, or leaf values. (Quantile bin edges
+        # still see holdout FEATURE values — a label-free approximation.)
+        user_w = None
+        if getattr(p, "weights_column", None):
+            user_w = np.nan_to_num(train.vec(p.weights_column).to_numpy())
+        y_all, w_all = None, None
         holdout: np.ndarray | None = None
         fold_metrics = []
         for fi, f in enumerate(folds):
             te_mask = fold == f
-            tr_fr = train.subset_rows(~te_mask)
-            te_fr = train.subset_rows(te_mask)
+            w_np = (~te_mask).astype(np.float32)
+            if user_w is not None:
+                w_np = w_np * user_w.astype(np.float32)
+            fr_f = _with_cv_weights(train, w_np)
             sub = type(self)(**_params_dict(p, drop_cv=True))
             sub.params.response_column = p.response_column
-            m = sub.train(x=self._x, y=p.response_column, training_frame=tr_fr)
-            m_raw = np.asarray(m._predict_raw(te_fr))
+            sub.params.weights_column = _CV_WEIGHTS
+            m = sub.train(x=self._x, y=p.response_column, training_frame=fr_f)
+            m_raw = np.asarray(m._predict_raw(train))  # full frame: fold-invariant shapes
             if holdout is None:
                 holdout = np.zeros((n,) + m_raw.shape[1:], dtype=np.float64)
-            holdout[te_mask] = m_raw
-            y_te, w_te = m._response_and_weights(te_fr)
-            fold_metrics.append(_make_metrics(m, m_raw, y_te, w_te))
+            holdout[te_mask] = m_raw[te_mask]
+            if y_all is None:
+                y_all, w_all = main._response_and_weights(train)
+            w_arr = w_all if w_all is not None else np.ones(n)
+            fold_metrics.append(
+                _make_metrics(m, m_raw[te_mask], y_all[te_mask], np.asarray(w_arr)[te_mask])
+            )
             main.cv_models.append(m)
             job.update(0.9 + 0.1 * (fi + 1) / len(folds))
 
-        y_all, w_all = main._response_and_weights(train)
         main.cross_validation_metrics = _make_metrics(main, holdout, y_all, w_all)
         if p.keep_cross_validation_predictions:
             main.cv_predictions = holdout
+
+
+_CV_WEIGHTS = "__cv_weights__"
+
+
+def _with_cv_weights(train: Frame, w_np: np.ndarray) -> Frame:
+    """A frame SHARING every vec of ``train`` plus the fold-weight column —
+    no data movement beyond the single weight upload."""
+    from h2o3_tpu.frame.frame import Vec
+
+    wv = Vec.from_numpy(w_np, "num", _CV_WEIGHTS)
+    names = [n for n in train.names if n != _CV_WEIGHTS]
+    vecs = [train.vec(nm) for nm in names]
+    return Frame(vecs + [wv], names + [_CV_WEIGHTS])
 
 
 def _params_dict(p, drop_cv: bool) -> dict:

@@ -138,7 +138,9 @@ class DRF(ModelBuilder):
         # keyed by the shared row_key so all K class-trees of iteration m
         # draw the SAME bootstrap (H2O semantics), while column/level
         # randomness differs per class.
-        use_scan = jax.default_backend() != "cpu"
+        # Same depth guard as build_tree's fused path: an unrolled program
+        # past ~12 levels (node_cap histograms each) compiles for minutes.
+        use_scan = jax.default_backend() != "cpu" and p.max_depth <= 12
         if use_scan:
             from h2o3_tpu.models.tree.shared_tree import (
                 build_trees_scanned,

@@ -101,12 +101,21 @@ class SharedTreeModel(Model):
                 t = group[k]
                 by_depth[len(t.levels)].append(t)
             for depth, ts in by_depth.items():
+                # ONE transfer for the whole group if levels are device-backed
+                # (per-field np.asarray would be thousands of ~66 ms pulls)
+                vals = jax.device_get(
+                    [
+                        [
+                            [getattr(t.levels[li], f) for f in self._REPLAY_FIELDS]
+                            for li in range(depth)
+                        ]
+                        for t in ts
+                    ]
+                )
                 stacked = tuple(
                     {
-                        f: np.stack(
-                            [np.asarray(getattr(t.levels[li], f)) for t in ts]
-                        )
-                        for f in self._REPLAY_FIELDS
+                        f: np.stack([vals[ti][li][fi] for ti in range(len(ts))])
+                        for fi, f in enumerate(self._REPLAY_FIELDS)
                     }
                     for li in range(depth)
                 )

@@ -256,3 +256,31 @@ class TestReviewRegressions:
         )
         ops.impute(f, "c", method="mode", by=["g"])
         assert f.vec("c").to_numpy().tolist() == [0, 0, 0]
+
+
+def test_merge_and_sort_avoid_full_frame_host_roundtrip(monkeypatch):
+    """merge/sort must compute permutations from KEY columns only and gather
+    payload on device — to_pandas on the inputs is the former slow path."""
+    n = 4000
+    rng = np.random.default_rng(0)
+    left = h2o3_tpu.upload_file(pd.DataFrame({
+        "k": rng.integers(0, 500, n), "x": rng.normal(size=n),
+        "c": rng.choice(["u", "v"], n)}))
+    right = h2o3_tpu.upload_file(pd.DataFrame({
+        "k": rng.integers(0, 500, n), "y": rng.normal(size=n)}))
+
+    def boom(self):
+        raise AssertionError("to_pandas called during merge/sort")
+
+    monkeypatch.setattr(Frame, "to_pandas", boom)
+    out = ops.merge(left, right, by=["k"])
+    srt = ops.sort(left, "k")
+    monkeypatch.undo()
+
+    # correctness vs pandas reference
+    ldf = pd.DataFrame({"k": left.vec("k").to_numpy(), "x": left.vec("x").to_numpy()})
+    rdf = pd.DataFrame({"k": right.vec("k").to_numpy(), "y": right.vec("y").to_numpy()})
+    ref = ldf.merge(rdf, on="k", how="inner")
+    assert out.nrow == len(ref)
+    assert abs(float(np.nansum(out.vec("y").to_numpy())) - float(ref["y"].sum())) < 1e-3
+    assert (np.diff(srt.vec("k").to_numpy()) >= 0).all()

