@@ -40,6 +40,13 @@ from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
 from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
 
 
+def export_file(frame, path: str, force: bool = False, format: str | None = None) -> str:
+    """Frame → CSV/Parquet on disk (h2o.export_file successor)."""
+    from h2o3_tpu.persist import export_file as _ef
+
+    return _ef(frame, path, force=force, format=format)
+
+
 def save_model(model, path: str, force: bool = True) -> str:
     """Binary model save (h2o.save_model successor)."""
     from h2o3_tpu.persist import save_model as _sm
@@ -90,6 +97,7 @@ __all__ = [
     "start_server",
     "connect",
     "save_model",
+    "export_file",
     "load_model",
     "import_mojo",
 ]

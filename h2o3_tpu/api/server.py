@@ -205,6 +205,20 @@ class Endpoints:
         DKV.remove(key)
         return {"__meta": {"schema_type": "Frames"}, "frames": []}
 
+    def frame_export(self, params, key):
+        """``/3/Frames/{id}/export`` — CSV/Parquet to a server-side path."""
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise ApiError(404, f"Frame {key} not found")
+        path = params.get("path")
+        if not path:
+            raise ApiError(400, "path parameter is required")
+        force = str(params.get("force", "false")).lower() in ("1", "true")
+        from h2o3_tpu.persist import export_file
+
+        export_file(fr, path, force=force, format=params.get("format"))
+        return {"__meta": {"schema_type": "Frames"}, "path": path}
+
     # -- jobs -------------------------------------------------------------
     def jobs_list(self, params):
         jobs = [j for j in DKV.values_of_type(Job)]
@@ -433,6 +447,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/3/ParseSetup", _EP.parse_setup),
     ("POST", r"/3/Parse", _EP.parse),
     ("GET", r"/3/Frames", _EP.frames_list),
+    ("POST", r"/3/Frames/([^/]+)/export", _EP.frame_export),
     ("GET", r"/3/Frames/([^/]+)/summary", _EP.frame_summary),
     ("GET", r"/3/Frames/([^/]+)", _EP.frame_get),
     ("DELETE", r"/3/Frames/([^/]+)", _EP.frame_delete),

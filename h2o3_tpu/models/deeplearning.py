@@ -140,6 +140,23 @@ class DeepLearning(ModelBuilder):
         key, init_key = jax.random.split(key)
         params = mlp.init(init_key, jnp.zeros((1, di.ncols_expanded)), train=False)
 
+        from h2o3_tpu.models.model_base import check_checkpoint_compat, resolve_checkpoint
+
+        prior = resolve_checkpoint(p.checkpoint)
+        start_epochs = 0
+        if prior is not None:
+            check_checkpoint_compat(
+                prior, self, ("hidden", "activation", "standardize", "adaptive_rate")
+            )
+            if prior.output["datainfo"].ncols_expanded != di.ncols_expanded:
+                raise ValueError("checkpoint design-matrix width differs")
+            start_epochs = int(prior.output.get("epochs_trained", 0))
+            if p.epochs <= start_epochs:
+                raise ValueError(
+                    f"checkpoint continuation needs epochs > {start_epochs}"
+                )
+            params = prior.output["params"]
+
         if p.adaptive_rate:
             tx = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
         else:
@@ -202,7 +219,11 @@ class DeepLearning(ModelBuilder):
         rng = np.random.default_rng(seed)
         history = []
         n_epochs = max(1, int(np.ceil(p.epochs)))
-        for e in range(n_epochs):
+        for _ in range(start_epochs):  # continuation: keep the epoch RNG
+            rng.permutation(train.nrow)  # stream aligned with an
+            key, _ = jax.random.split(key)  # uninterrupted run
+        epochs_done = start_epochs
+        for e in range(start_epochs, n_epochs):
             perm = np.zeros(npad, np.int64)
             perm[: train.nrow] = rng.permutation(train.nrow)
             perm_j = jnp.asarray(perm)
@@ -211,6 +232,7 @@ class DeepLearning(ModelBuilder):
             wp = w[perm_j]
             key, dkey = jax.random.split(key)
             params, opt_state, mean_loss = epoch(params, opt_state, Xp, yp, wp, dkey)
+            epochs_done = e + 1
             history.append({"epoch": e + 1, "loss": float(mean_loss)})
             keeper.record(float(mean_loss))
             job.update(0.05 + 0.9 * (e + 1) / n_epochs)
@@ -224,6 +246,7 @@ class DeepLearning(ModelBuilder):
             "apply_fn": apply_fn,
             "names": list(self._x),
             "hidden": list(p.hidden),
+            "epochs_trained": epochs_done,
             "response_domain": tuple(yv.domain) if classification else None,
         }
         model = DeepLearningModel(DKV.make_key("dl"), p, out)

@@ -164,6 +164,15 @@ class GridSearch:
         n_planned = _space_size(self.hyper_params)
         if c.max_models:
             n_planned = min(n_planned, c.max_models)
+        # checkpoint-dir recovery (hex.grid.GridSearch export_checkpoints_dir
+        # [UNVERIFIED]): a manifest alongside the saved models lets a re-run
+        # of the same grid_id skip (and reload) already-built combos
+        ckdir = self.base_params.get("export_checkpoints_dir")
+        done: dict[str, str] = {}
+        fingerprint = None
+        if ckdir:
+            fingerprint = _grid_fingerprint(self.base_params, x, y, training_frame)
+            done = _read_manifest(ckdir, self.grid.key, fingerprint)
         # grid-level early stopping on the leaderboard metric sequence,
         # via the same ScoreKeeper the per-model driver uses
         keeper: ScoreKeeper | None = None
@@ -175,6 +184,27 @@ class GridSearch:
             if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
                 Log.info(f"grid {self.grid.key}: max_runtime_secs reached after {i} models")
                 break
+            hv_key = _hv_key(hv)
+            if hv_key in done:
+                m = _load_checkpointed(ckdir, done[hv_key])
+                if m is not None:
+                    Log.info(f"grid {self.grid.key}: combo {hv} recovered from checkpoint dir")
+                    self.grid.models.append(m)
+                    self.grid.hyper_values.append({k: _canon(v) for k, v in hv.items()})
+                    # recovered models feed the stopping keeper and progress
+                    # exactly as freshly-built ones would
+                    if c.stopping_rounds:
+                        if keeper is None:
+                            metric_name, larger = stopping_metric_direction(
+                                c.stopping_metric, m.is_classifier, m.nclasses
+                            )
+                            keeper = ScoreKeeper(c.stopping_rounds, c.stopping_tolerance, larger)
+                        mm = m.cross_validation_metrics or m.validation_metrics or m.training_metrics
+                        keeper.record(mm.value(metric_name))
+                        if keeper.should_stop():
+                            break
+                    job.update(min(1.0, (i + 1) / max(1, n_planned)))
+                    continue
             try:
                 builder = self.builder_cls(**{**self.base_params, **hv})
                 m = builder.train(
@@ -183,6 +213,9 @@ class GridSearch:
                 )
                 self.grid.models.append(m)
                 self.grid.hyper_values.append(dict(hv))
+                if ckdir:
+                    done[hv_key] = m.key
+                    _write_manifest(ckdir, self.grid, done, fingerprint)
                 if c.stopping_rounds:
                     if keeper is None:
                         metric_name, larger = stopping_metric_direction(
@@ -199,3 +232,136 @@ class GridSearch:
                 Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
             job.update(min(1.0, (i + 1) / max(1, n_planned)))
         return self.grid
+
+
+# ---------------------------------------------------------------------------
+# grid checkpointing (export_checkpoints_dir + manifest recovery)
+
+
+def _canon(v):
+    """numpy scalars → python so manifest keys are type-stable across runs."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, (list, tuple)):
+        return [_canon(x) for x in v]
+    return v
+
+
+def _hv_key(hv: dict) -> str:
+    import json
+
+    return json.dumps({k: _canon(v) for k, v in hv.items()}, sort_keys=True)
+
+
+def _grid_fingerprint(base_params: dict, x, y, training_frame) -> str:
+    """Invalidates checkpoint recovery when anything but hyper values changed."""
+    import hashlib
+    import json
+
+    fr_key = getattr(training_frame, "key", str(training_frame))
+    payload = json.dumps(
+        {"base": {k: _canon(v) for k, v in sorted(base_params.items())
+                  if k != "export_checkpoints_dir"},
+         "x": list(x) if x else None, "y": y, "frame": fr_key},
+        sort_keys=True, default=str,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _manifest_path(ckdir: str, grid_key: str) -> str:
+    import os
+
+    return os.path.join(ckdir, f"{grid_key}.grid.json")
+
+
+def _read_manifest(ckdir: str, grid_key: str, fingerprint: str | None = None) -> dict[str, str]:
+    import json
+    import os
+
+    path = _manifest_path(ckdir, grid_key)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        payload = json.load(f)
+    if fingerprint is not None and payload.get("fingerprint") not in (None, fingerprint):
+        Log.warn(
+            f"grid {grid_key}: checkpoint dir was built with different base "
+            "params / data — ignoring it and rebuilding"
+        )
+        return {}
+    return dict(payload.get("built", {}))
+
+
+def _write_manifest(ckdir: str, grid: Grid, done: dict[str, str], fingerprint: str | None = None) -> None:
+    import json
+    import os
+
+    os.makedirs(ckdir, exist_ok=True)
+    payload = {
+        "grid_id": grid.key,
+        "algo": grid.builder_cls.algo,
+        "hyper_names": grid.hyper_names,
+        "fingerprint": fingerprint,
+        "built": done,
+        "failures": [list(f) for f in grid.failures],
+    }
+    with open(_manifest_path(ckdir, grid.key), "w") as f:
+        json.dump(payload, f)
+
+
+def _load_checkpointed(ckdir: str, model_key: str):
+    import os
+
+    from h2o3_tpu.persist import load_model
+
+    got = DKV.get(model_key)
+    if isinstance(got, Model):
+        return got
+    path = os.path.join(ckdir, model_key)
+    if os.path.exists(path):
+        return load_model(path)
+    return None
+
+
+def load_grid(ckdir: str, grid_id: str | None = None) -> Grid:
+    """Rebuild a Grid from its checkpoint dir (H2O grid recovery)."""
+    import glob
+    import json
+    import os
+
+    if grid_id is None:
+        manifests = glob.glob(os.path.join(ckdir, "*.grid.json"))
+        if not manifests:
+            raise FileNotFoundError(f"no grid manifest under {ckdir}")
+        path = manifests[0]
+    else:
+        path = _manifest_path(ckdir, grid_id)
+    with open(path) as f:
+        payload = json.load(f)
+
+    import importlib
+
+    algo = payload["algo"]
+    reg = {
+        b.algo: b
+        for b in _all_builders(importlib.import_module("h2o3_tpu.models"))
+    }
+    grid = Grid(payload["grid_id"], reg[algo], list(payload["hyper_names"]))
+    for hv_key, model_key in payload["built"].items():
+        m = _load_checkpointed(ckdir, model_key)
+        if m is not None:
+            grid.models.append(m)
+            grid.hyper_values.append(json.loads(hv_key))
+    grid.failures = [tuple(f) for f in payload.get("failures", [])]
+    return grid
+
+
+def _all_builders(mod):
+    for name in dir(mod):
+        obj = getattr(mod, name)
+        if isinstance(obj, type) and issubclass(obj, ModelBuilder) and getattr(obj, "algo", None):
+            yield obj

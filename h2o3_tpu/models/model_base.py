@@ -44,6 +44,11 @@ class CommonParams:
     stopping_rounds: int = 0
     stopping_metric: str = "AUTO"
     stopping_tolerance: float = 1e-3
+    # key (or Model) of a previous model to CONTINUE training from — more
+    # trees for GBM/DRF, more epochs for DeepLearning (ref upstream
+    # hex/ModelBuilder checkpoint plumbing, SURVEY.md §5.4)
+    checkpoint: Any = None
+    export_checkpoints_dir: str | None = None
 
 
 class ScoreKeeper:
@@ -216,6 +221,9 @@ class ModelBuilder:
     PARAMS_CLS = CommonParams
     SUPPORTS_CLASSIFICATION = True
     SUPPORTS_REGRESSION = True
+    # builders that honor weights_column can use weight-mask CV folds;
+    # the rest fall back to physical row subsetting
+    SUPPORTS_WEIGHTS = True
 
     def __init__(self, **kwargs):
         import dataclasses
@@ -271,12 +279,22 @@ class ModelBuilder:
         p = self.params
         t = Timer()
         self._validate(train, valid)
+        if getattr(p, "checkpoint", None) is not None and p.nfolds and p.nfolds > 1:
+            raise ValueError("checkpoint cannot be combined with cross-validation")
         model = self._build(job, train, valid)
         model.run_time_ms = int(t.time_ms())
         self.model = model
         # cross-validation driver (after main model, like modern H2O order)
         if p.nfolds and p.nfolds > 1:
             self._cross_validate(job, train)
+        if getattr(p, "export_checkpoints_dir", None):
+            # H2O semantics: every finished model auto-saves to the dir
+            import os
+
+            from h2o3_tpu.persist import save_model
+
+            os.makedirs(p.export_checkpoints_dir, exist_ok=True)
+            save_model(model, p.export_checkpoints_dir, force=True)
         Log.info(f"{self.algo} model {model.key} built in {t}")
         return model
 
@@ -326,13 +344,16 @@ class ModelBuilder:
         fold_metrics = []
         for fi, f in enumerate(folds):
             te_mask = fold == f
-            w_np = (~te_mask).astype(np.float32)
-            if user_w is not None:
-                w_np = w_np * user_w.astype(np.float32)
-            fr_f = _with_cv_weights(train, w_np)
             sub = type(self)(**_params_dict(p, drop_cv=True))
             sub.params.response_column = p.response_column
-            sub.params.weights_column = _CV_WEIGHTS
+            if self.SUPPORTS_WEIGHTS:
+                w_np = (~te_mask).astype(np.float32)
+                if user_w is not None:
+                    w_np = w_np * user_w.astype(np.float32)
+                fr_f = _with_cv_weights(train, w_np)
+                sub.params.weights_column = _CV_WEIGHTS
+            else:  # weights-unaware builder: physically remove holdout rows
+                fr_f = train.subset_rows(~te_mask)
             m = sub.train(x=self._x, y=p.response_column, training_frame=fr_f)
             m_raw = np.asarray(m._predict_raw(train))  # full frame: fold-invariant shapes
             if holdout is None:
@@ -350,6 +371,35 @@ class ModelBuilder:
         main.cross_validation_metrics = _make_metrics(main, holdout, y_all, w_all)
         if p.keep_cross_validation_predictions:
             main.cv_predictions = holdout
+
+
+def resolve_checkpoint(cp) -> "Model | None":
+    """Checkpoint param → prior Model (key lookup or pass-through)."""
+    if cp is None:
+        return None
+    if isinstance(cp, Model):
+        return cp
+    got = DKV.get(str(cp))
+    if not isinstance(got, Model):
+        raise ValueError(f"checkpoint {cp!r} is not a model in the DKV")
+    return got
+
+
+def check_checkpoint_compat(prior: "Model", builder: "ModelBuilder", frozen: Sequence[str]) -> None:
+    """H2O-style checkpoint restrictions: same algo, same feature set, and
+    the structural hyperparameters unchanged (only budget params may grow)."""
+    if prior.algo != builder.algo:
+        raise ValueError(
+            f"checkpoint algo {prior.algo!r} does not match builder {builder.algo!r}"
+        )
+    if list(prior.output.get("names", [])) != list(builder._x):
+        raise ValueError("checkpoint was trained on a different feature set")
+    for f in frozen:
+        a, b = getattr(prior.params, f, None), getattr(builder.params, f, None)
+        if a != b:
+            raise ValueError(
+                f"checkpoint requires {f} unchanged (was {a!r}, now {b!r})"
+            )
 
 
 _CV_WEIGHTS = "__cv_weights__"
@@ -375,6 +425,11 @@ def _params_dict(p, drop_cv: bool) -> dict:
     if drop_cv:
         d["nfolds"] = 0
         d["keep_cross_validation_predictions"] = False
+        # fold models must NOT inherit continuation or auto-save: a checkpoint
+        # was trained on all rows (holdout leakage), and export dirs would be
+        # overwritten by every fold
+        d["checkpoint"] = None
+        d["export_checkpoints_dir"] = None
     return d
 
 

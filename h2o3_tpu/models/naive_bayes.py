@@ -63,6 +63,7 @@ class NaiveBayesModel(Model):
 class NaiveBayes(ModelBuilder):
     algo = "naivebayes"
     PARAMS_CLS = NaiveBayesParams
+    SUPPORTS_WEIGHTS = False  # builder ignores weights_column
     SUPPORTS_REGRESSION = False
 
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:

@@ -75,7 +75,21 @@ class DRF(ModelBuilder):
         K = yv.cardinality if classification and yv.cardinality > 2 else 1
         binary = classification and K == 1
 
-        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        from h2o3_tpu.models.model_base import check_checkpoint_compat, resolve_checkpoint
+
+        prior = resolve_checkpoint(p.checkpoint)
+        if prior is not None:
+            check_checkpoint_compat(
+                prior, self,
+                ("max_depth", "nbins", "min_rows", "mtries", "sample_rate"),
+            )
+            if p.ntrees <= prior.output["ntrees_actual"]:
+                raise ValueError(
+                    f"checkpoint continuation needs ntrees > {prior.output['ntrees_actual']}"
+                )
+            spec = prior.output["bin_spec"]
+        else:
+            spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
         npad = train.npad
@@ -133,6 +147,22 @@ class DRF(ModelBuilder):
             wv_np = np.ones(valid.nrow, np.float32)
             Fv = [jnp.zeros(bins_v.shape[0], jnp.float32) for _ in range(n_out)]
 
+        start_trees = 0
+        if prior is not None:
+            raw = prior._replay_all_dev(train)  # (npad,) or (npad, K) leaf-sum
+            F = [raw[:, k] for k in range(K)] if n_out > 1 else [raw]
+            trees.extend([list(g) for g in prior.output["trees"]])
+            varimp_dev = jnp.asarray(np.asarray(prior.output["varimp"], np.float32))
+            start_trees = prior.output["ntrees_actual"]
+            if Fv is not None:
+                rawv = prior._replay_all_dev(valid)
+                Fv = [rawv[:, k] for k in range(K)] if n_out > 1 else [rawv]
+            if jax.default_backend() == "cpu" or p.max_depth > 12:
+                # only the per-tree loop consumes the split chain; the
+                # scanned path keys by global tree id off the pristine key
+                for _ in range(start_trees):
+                    rngkey, _ = jax.random.split(rngkey)
+
         # Chunk-scanned path (see gbm.py / build_trees_scanned): one device
         # dispatch per scoring interval per class. The bootstrap row mask is
         # keyed by the shared row_key so all K class-trees of iteration m
@@ -151,7 +181,7 @@ class DRF(ModelBuilder):
 
             cap = scan_chunk_cap(p.max_depth, n_bins)
             interval = max(1, p.score_tree_interval)
-            m_done = 0
+            m_done = start_trees
             while m_done < p.ntrees and not job.stop_requested:
                 chunk = min(interval, cap, p.ntrees - m_done)
                 chunk_trees: list[list[Tree]] = [[] for _ in range(chunk)]
@@ -200,7 +230,7 @@ class DRF(ModelBuilder):
                     break
                 job.update(0.05 + 0.9 * m_done / p.ntrees)
 
-        for m in range(0 if not use_scan else p.ntrees, p.ntrees):
+        for m in range(start_trees if not use_scan else p.ntrees, p.ntrees):
             if job.stop_requested:
                 break
             rngkey, sk = jax.random.split(rngkey)

@@ -201,7 +201,23 @@ class GBM(ModelBuilder):
         classification = dist in ("bernoulli", "multinomial")
         K = yv.cardinality if dist == "multinomial" else 1
 
-        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        from h2o3_tpu.models.model_base import check_checkpoint_compat, resolve_checkpoint
+
+        prior = resolve_checkpoint(p.checkpoint)
+        if prior is not None:
+            check_checkpoint_compat(
+                prior, self,
+                ("max_depth", "nbins", "min_rows", "distribution", "learn_rate",
+                 "sample_rate", "col_sample_rate", "col_sample_rate_per_tree"),
+            )
+            if p.ntrees <= prior.output["ntrees_actual"]:
+                raise ValueError(
+                    f"checkpoint continuation needs ntrees > {prior.output['ntrees_actual']}"
+                )
+            # identical binning is what makes prior trees replayable here
+            spec = prior.output["bin_spec"]
+        else:
+            spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
         npad = train.npad
@@ -265,10 +281,10 @@ class GBM(ModelBuilder):
                 offset_v = jnp.nan_to_num(valid.vec(p.offset_column).data)
 
         if dist == "multinomial":
-            prior = np.array(
+            prior_p = np.array(
                 [max((wn * (yn == k)).sum() / max(wn.sum(), 1e-30), 1e-9) for k in range(K)]
             )
-            f0 = np.log(prior).astype(np.float32)
+            f0 = np.log(prior_p).astype(np.float32)
             F = jnp.tile(jnp.asarray(f0)[None, :], (npad, 1)) + offset[:, None]
             Y1h = (y[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
             Fv = (
@@ -285,7 +301,38 @@ class GBM(ModelBuilder):
                 else None
             )
 
-        lr = p.learn_rate
+        start_trees = 0
+        if prior is not None:
+            # continue exactly where the prior model stopped: its init score,
+            # its trees replayed into F (identical bin spec), its varimp
+            f0 = prior.output["init_f"]
+            raw = prior._replay_all_dev(train)
+            if dist == "multinomial":
+                F = jnp.asarray(np.asarray(f0))[None, :] + offset[:, None] + raw
+            else:
+                F = jnp.full(npad, np.float32(f0)) + offset + raw
+            trees.extend([list(g) for g in prior.output["trees"]])
+            varimp_dev = jnp.asarray(np.asarray(prior.output["varimp"], np.float32))
+            start_trees = prior.output["ntrees_actual"]
+            if Fv is not None:
+                rawv = prior._replay_all_dev(valid)
+                if dist == "multinomial":
+                    Fv = [
+                        jnp.full(bins_v.shape[0], f0[k], jnp.float32) + offset_v + rawv[:, k]
+                        for k in range(K)
+                    ]
+                else:
+                    Fv = [jnp.full(bins_v.shape[0], np.float32(f0)) + offset_v + rawv]
+            if p.sample_rate < 1.0 and (
+                dist == "multinomial" or jax.default_backend() == "cpu"
+            ):
+                # advance the per-tree loop's split chain so continuation
+                # equals an uninterrupted run; the scanned path keys by the
+                # global tree id off the PRISTINE key and must not advance
+                for _ in range(start_trees):
+                    rngkey, _ = jax.random.split(rngkey)
+
+        lr = p.learn_rate * (p.learn_rate_annealing**start_trees)
 
         # Chunk-scanned path: build a whole scoring interval of trees in ONE
         # device dispatch (see build_trees_scanned — on the tunneled TPU,
@@ -303,7 +350,7 @@ class GBM(ModelBuilder):
 
             cap = scan_chunk_cap(p.max_depth, n_bins)
             interval = max(1, p.score_tree_interval)
-            m_done = 0
+            m_done = start_trees
             while m_done < p.ntrees and not job.stop_requested:
                 chunk = min(interval, cap, p.ntrees - m_done)
                 lrs = lr * (p.learn_rate_annealing ** np.arange(chunk))
@@ -347,7 +394,7 @@ class GBM(ModelBuilder):
                     break
                 job.update(0.05 + 0.9 * m_done / p.ntrees)
 
-        for m in range(0 if not use_scan else p.ntrees, p.ntrees):
+        for m in range(start_trees if not use_scan else p.ntrees, p.ntrees):
             if job.stop_requested:
                 break
             # row sampling (per tree)

@@ -205,3 +205,22 @@ def load_model(path: str) -> Model:
     DKV.put(model.key, model)
     Log.info(f"loaded model {model.key} from {p}")
     return model
+
+
+def export_file(frame, path: str, force: bool = False, format: str | None = None) -> str:
+    """``h2o.export_file`` successor — frame → CSV/Parquet through the
+    Persist scheme dispatch (ref upstream water/api FramesHandler export +
+    Persist SPI [UNVERIFIED], SURVEY.md §5.4)."""
+    backend, p = _backend_for(path)
+    if isinstance(backend, PersistFS) and os.path.exists(p) and not force:
+        raise FileExistsError(p)
+    fmt = (format or "").lower() or ("parquet" if p.endswith((".parquet", ".pq")) else "csv")
+    df = frame.to_pandas()
+    with backend.open_write(p) as f:
+        if fmt == "parquet":
+            df.to_parquet(f, index=False)
+        elif fmt == "csv":
+            df.to_csv(f, index=False)
+        else:
+            raise ValueError(f"unsupported export format {fmt!r}")
+    return p

@@ -1,0 +1,144 @@
+"""Checkpoint / continuation tests — SURVEY.md §5.4: GBM/DRF continue with
+more trees, DL with more epochs, grids recover from export_checkpoints_dir,
+frames export. The kill-and-resume contract: an interrupted-then-continued
+run must reproduce the uninterrupted run's final metrics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import DRF, GBM, DeepLearning
+from h2o3_tpu.models.grid import GridSearch, load_grid
+
+
+def _df(n=2500, seed=3):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    eta = df["a"] * 1.5 + (df["c"] == "x") * 2 - df["b"]
+    df["y"] = np.where(eta + rng.normal(size=n) > 0, "p", "n")
+    return df
+
+
+def test_gbm_checkpoint_resume_identical_to_uninterrupted():
+    df = _df()
+    fr = Frame.from_pandas(df)
+    kw = dict(max_depth=3, seed=11, learn_rate=0.2, score_tree_interval=100)
+
+    full = GBM(ntrees=10, **kw).train(y="y", training_frame=fr)
+    part = GBM(ntrees=4, **kw).train(y="y", training_frame=fr)
+    resumed = GBM(ntrees=10, checkpoint=part.key, **kw).train(y="y", training_frame=fr)
+
+    assert resumed.output["ntrees_actual"] == 10
+    np.testing.assert_allclose(
+        resumed.training_metrics.logloss, full.training_metrics.logloss, atol=1e-6
+    )
+    # predictions agree row-wise, not just in aggregate
+    pa = full.predict(fr).vec("p").to_numpy()
+    pb = resumed.predict(fr).vec("p").to_numpy()
+    np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+
+def test_gbm_checkpoint_with_sampling_resumes_exactly():
+    df = _df(seed=5)
+    fr = Frame.from_pandas(df)
+    kw = dict(max_depth=3, seed=17, sample_rate=0.7, score_tree_interval=100)
+    full = GBM(ntrees=8, **kw).train(y="y", training_frame=fr)
+    part = GBM(ntrees=3, **kw).train(y="y", training_frame=fr)
+    resumed = GBM(ntrees=8, checkpoint=part.key, **kw).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(
+        resumed.training_metrics.logloss, full.training_metrics.logloss, atol=1e-6
+    )
+
+
+def test_drf_checkpoint_adds_trees():
+    df = _df(seed=7)
+    fr = Frame.from_pandas(df)
+    kw = dict(max_depth=6, seed=9, score_tree_interval=100)
+    part = DRF(ntrees=3, **kw).train(y="y", training_frame=fr)
+    resumed = DRF(ntrees=7, checkpoint=part.key, **kw).train(y="y", training_frame=fr)
+    assert resumed.output["ntrees_actual"] == 7
+    full = DRF(ntrees=7, **kw).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(
+        resumed.training_metrics.auc, full.training_metrics.auc, atol=1e-6
+    )
+
+
+def test_checkpoint_validation_rejects_changed_params():
+    df = _df(seed=8)
+    fr = Frame.from_pandas(df)
+    part = GBM(ntrees=3, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    with pytest.raises(Exception, match="max_depth"):
+        GBM(ntrees=6, max_depth=5, seed=1, checkpoint=part.key).train(
+            y="y", training_frame=fr
+        )
+    with pytest.raises(Exception, match="ntrees"):
+        GBM(ntrees=2, max_depth=3, seed=1, checkpoint=part.key).train(
+            y="y", training_frame=fr
+        )
+
+
+def test_deeplearning_checkpoint_continues_epochs():
+    df = _df(seed=9)
+    fr = Frame.from_pandas(df)
+    kw = dict(hidden=[8], seed=4, mini_batch_size=64)
+    part = DeepLearning(epochs=2, **kw).train(y="y", training_frame=fr)
+    resumed = DeepLearning(epochs=5, checkpoint=part.key, **kw).train(
+        y="y", training_frame=fr
+    )
+    assert resumed.output["epochs_trained"] == 5
+    assert len(resumed.scoring_history) == 3  # only the 3 new epochs ran
+    assert resumed.training_metrics.logloss <= part.training_metrics.logloss + 0.05
+
+
+def test_grid_checkpoint_dir_resume(tmp_path):
+    df = _df(seed=10)
+    fr = Frame.from_pandas(df)
+    ckdir = str(tmp_path / "grid_ck")
+
+    gs1 = GridSearch(
+        GBM, {"max_depth": [2, 3]}, grid_id="g_ck", seed=2, ntrees=3,
+        export_checkpoints_dir=ckdir,
+    )
+    g1 = gs1.train(y="y", training_frame=fr)
+    assert len(g1.models) == 2
+
+    # wipe the in-memory registry, rebuild the same grid: everything recovers
+    built_keys = [m.key for m in g1.models]
+    for k in built_keys:
+        h2o3_tpu.remove(k)
+    gs2 = GridSearch(
+        GBM, {"max_depth": [2, 3]}, grid_id="g_ck", seed=2, ntrees=3,
+        export_checkpoints_dir=ckdir,
+    )
+    g2 = gs2.train(y="y", training_frame=fr)
+    assert sorted(m.key for m in g2.models) == sorted(built_keys)
+
+    # cold reload via load_grid
+    for k in built_keys:
+        h2o3_tpu.remove(k)
+    g3 = load_grid(ckdir, "g_ck")
+    assert len(g3.models) == 2
+    assert g3.best_model() is not None
+
+
+def test_frame_export_roundtrip(tmp_path):
+    df = _df(seed=12)
+    fr = Frame.from_pandas(df)
+    csv = str(tmp_path / "out.csv")
+    pq = str(tmp_path / "out.parquet")
+    h2o3_tpu.export_file(fr, csv)
+    h2o3_tpu.export_file(fr, pq)
+    back = pd.read_csv(csv)
+    assert len(back) == fr.nrow and list(back.columns) == fr.names
+    backp = pd.read_parquet(pq)
+    np.testing.assert_allclose(
+        backp["a"].to_numpy(), fr.vec("a").to_numpy(), atol=1e-6
+    )
+    with pytest.raises(FileExistsError):
+        h2o3_tpu.export_file(fr, csv)
