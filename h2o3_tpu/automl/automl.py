@@ -46,6 +46,9 @@ class AutoMLSpec:
     balance_classes: bool = False
     keep_cross_validation_predictions: bool = True
     project_name: str = ""
+    # ["target_encoding"] enables TE preprocessing of categorical features
+    # (ai.h2o.automl preprocessing=["target_encoding"] analog)
+    preprocessing: Sequence[str] | None = None
 
 
 class Leaderboard:
@@ -242,6 +245,39 @@ class AutoML:
         self.leaderboard = Leaderboard(sort_metric, larger, leaderboard_frame=lb_frame)
         self._log("init", f"AutoML build started: {'classification' if classification else 'regression'}, sort_metric={sort_metric}")
 
+        # optional target-encoding preprocessing: fit a KFold encoder on the
+        # training frame (holdout-safe) and train every step on the frame
+        # with appended _te columns (SURVEY.md §2.3 TE row)
+        self._te = None
+        if s.preprocessing and "target_encoding" in [str(q).lower() for q in s.preprocessing]:
+            from h2o3_tpu.models.target_encoding import TargetEncoder
+
+            cat_cols = [
+                n for n in train.names
+                if train.vec(n).is_categorical() and n != y
+            ]
+            if classification and nclasses > 2:
+                self._log("preprocessing",
+                          "target_encoding skipped: multiclass targets unsupported")
+                cat_cols = []
+            if cat_cols:
+                te = TargetEncoder(
+                    holdout_type="kfold", nfolds=max(s.nfolds, 2), blending=True,
+                    seed=abs(s.seed) if s.seed and s.seed > 0 else 1,
+                )
+                te.fit(train, y, cat_cols)
+                train = te.transform(train, as_training=True)
+                if validation_frame is not None:
+                    vf = validation_frame if isinstance(validation_frame, Frame) else DKV.get(str(validation_frame))
+                    validation_frame = te.transform(vf)
+                if lb_frame is not None:
+                    lb_frame = te.transform(lb_frame)
+                    self.leaderboard.leaderboard_frame = lb_frame
+                self._te = te
+                if x is not None:
+                    x = list(x) + [c + "_te" for c in cat_cols if c in (x or [])]
+                self._log("preprocessing", f"target encoding applied to {cat_cols}")
+
         plan = [st for st in _default_plan() if self._algo_allowed(st.algo)]
         n_models_built = 0
         family_best: dict[str, Model] = {}
@@ -261,6 +297,8 @@ class AutoML:
                     m = self._builder(st.algo, {**st.params, **self._common()}).train(
                         x=x, y=y, training_frame=train, validation_frame=validation_frame
                     )
+                    if self._te is not None:
+                        m.preprocessors.append(self._te)
                     self.leaderboard.add(m)
                     n_models_built += 1
                     self._update_family_best(family_best, m)

@@ -20,6 +20,14 @@ _LAZY = {
     "GridSearch": ("h2o3_tpu.models.grid", "GridSearch"),
     "Grid": ("h2o3_tpu.models.grid", "Grid"),
     "StackedEnsemble": ("h2o3_tpu.models.ensemble", "StackedEnsemble"),
+    "IsotonicRegression": ("h2o3_tpu.models.isotonic", "IsotonicRegression"),
+    "DT": ("h2o3_tpu.models.decision_tree", "DT"),
+    "AdaBoost": ("h2o3_tpu.models.adaboost", "AdaBoost"),
+    "ExtendedIsolationForest": ("h2o3_tpu.models.extended_isolation_forest", "ExtendedIsolationForest"),
+    "TargetEncoder": ("h2o3_tpu.models.target_encoding", "TargetEncoder"),
+    "GLRM": ("h2o3_tpu.models.glrm", "GLRM"),
+    "CoxPH": ("h2o3_tpu.models.coxph", "CoxPH"),
+    "Word2Vec": ("h2o3_tpu.models.word2vec", "Word2Vec"),
 }
 
 __all__ = ["Model", "ModelBuilder", "DataInfo", *_LAZY]

@@ -1,0 +1,135 @@
+"""AdaBoost — successor of ``hex.adaboost.AdaBoost`` [UNVERIFIED upstream
+path, SURVEY.md §2.2].
+
+Discrete AdaBoost (SAMME, binary) with shallow histogram trees as the weak
+learners. Each iteration fits a weighted regression tree on the ±1 response
+(leaf = weighted mean), takes sign(leaf) as the weak hypothesis, computes
+alpha from the weighted error, and reweights. The recorded leaf values are
+REWRITTEN to alpha·sign(leaf) at build time, so the final strong score
+F(x) = Σ alpha_m h_m(x) replays through the standard batched tree walk in
+one dispatch — no per-tree scoring pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import ModelBuilder
+from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams
+from h2o3_tpu.models.tree.shared_tree import build_tree
+
+
+@dataclass
+class AdaBoostParams(SharedTreeParams):
+    nlearners: int = 50
+    weak_learner: str = "DT"  # upstream offers DRF/GBM/GLM weak learners too
+    learn_rate: float = 0.5  # shrinkage on alpha (h2o's learn_rate)
+    max_depth: int = 1  # stumps by default
+    min_rows: float = 10.0
+
+
+class AdaBoostModel(SharedTreeModel):
+    algo = "adaboost"
+
+    def _predict_raw_dev(self, frame: Frame):
+        F = self._replay_all_dev(frame)[: frame.nrow]  # Σ alpha·h
+        p1 = 1.0 / (1.0 + jnp.exp(-2.0 * F))  # logistic link on the margin
+        return jnp.stack([1 - p1, p1], axis=1)
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        return np.asarray(self._predict_raw_dev(frame))
+
+
+class AdaBoost(ModelBuilder):
+    algo = "adaboost"
+    PARAMS_CLS = AdaBoostParams
+    SUPPORTS_REGRESSION = False
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None):
+        p: AdaBoostParams = self.params
+        yv = train.vec(p.response_column)
+        if not yv.is_categorical() or yv.cardinality != 2:
+            raise ValueError("AdaBoost is a binary classifier")
+
+        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        bins = bin_frame(spec, train)
+        npad = train.npad
+
+        y_np = yv.to_numpy().astype(np.int64)
+        valid_row = np.zeros(npad, np.float32)
+        valid_row[: train.nrow] = (y_np >= 0).astype(np.float32)
+        ypm_np = np.zeros(npad, np.float32)
+        ypm_np[: train.nrow] = np.where(y_np == 1, 1.0, -1.0)
+        ypm = jnp.asarray(ypm_np)
+        base_w = valid_row.copy()
+        if p.weights_column:
+            base_w[: train.nrow] *= np.nan_to_num(
+                train.vec(p.weights_column).to_numpy()
+            ).astype(np.float32)
+        w = jnp.asarray(base_w)
+        # normalize to MEAN 1 (sum = n): split finding compares weighted node
+        # counts against min_rows, so weights must stay O(1) per row
+        n_eff = jnp.maximum((w > 0).sum().astype(jnp.float32), 1.0)
+        w = w * n_eff / jnp.maximum(w.sum(), 1e-30)
+
+        key = jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 31)
+        trees = []
+        alphas = []
+        varimp = jnp.zeros(len(self._x), jnp.float32)
+        eps = 1e-10
+
+        for m in range(p.nlearners):
+            if job.stop_requested:
+                break
+            tree, fk, varimp = build_tree(
+                bins, w, ypm, w,  # leaf = weighted mean of ±1 in [-1, 1]
+                n_bins=spec.max_bins,
+                is_cat_cols=spec.is_cat,
+                max_depth=p.max_depth,
+                min_rows=p.min_rows,
+                min_split_improvement=p.min_split_improvement,
+                learn_rate=1.0,
+                preds=jnp.zeros(npad, jnp.float32),
+                key=jax.random.fold_in(key, m),
+                varimp=varimp,
+            )
+            h = jnp.sign(fk)  # weak hypothesis in {-1, 0, +1}
+            err = float(jnp.sum(w * (h != ypm)) / jnp.maximum(jnp.sum(w), 1e-30))
+            err = min(max(err, eps), 1 - eps)
+            alpha = p.learn_rate * 0.5 * np.log((1 - err) / err)
+            if err >= 0.5:  # no better than chance: stop (standard AdaBoost)
+                break
+            # reweight and renormalize (to sum = n, keeping weights O(1))
+            w = w * jnp.exp(-alpha * ypm * h)
+            w = w * n_eff / jnp.maximum(w.sum(), 1e-30)
+            # bake alpha·sign into the recorded leaves → standard replay
+            host = tree.to_host()
+            for lv in host.levels:
+                lv.leaf_val = (alpha * np.sign(lv.leaf_val)).astype(np.float32)
+            trees.append([host])
+            alphas.append(float(alpha))
+            job.update(0.05 + 0.9 * (m + 1) / p.nlearners)
+
+        out = {
+            "bin_spec": spec,
+            "trees": trees,
+            "n_tree_classes": 1,
+            "alphas": alphas,
+            "names": list(self._x),
+            "varimp": np.asarray(varimp).astype(np.float64),
+            "response_domain": tuple(yv.domain),
+            "ntrees_actual": len(trees),
+        }
+        model = AdaBoostModel(DKV.make_key("adaboost"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

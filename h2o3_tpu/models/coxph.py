@@ -1,0 +1,202 @@
+"""Cox proportional hazards — successor of ``hex.coxph.CoxPH`` [UNVERIFIED
+upstream path, SURVEY.md §2.2].
+
+Newton–Raphson on the partial log-likelihood with Breslow or Efron tie
+handling (Efron is H2O's default). The heavy per-iteration quantities —
+risk-set sums of exp(Xβ), x·exp(Xβ), and xxᵀ·exp(Xβ) over rows sorted by
+stop time — are reverse cumulative sums over the sorted design matrix, one
+jitted device program per iteration; the p×p Newton solve runs on host in
+float64 (p is small). Rows sort once at setup.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.metrics import ModelMetrics
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+
+@dataclass
+class CoxPHParams(CommonParams):
+    start_column: str | None = None
+    stop_column: str | None = None  # defaults to the response column
+    ties: str = "efron"  # efron | breslow
+    max_iterations: int = 20
+    tolerance: float = 1e-8
+
+
+class CoxPHModel(Model):
+    algo = "coxph"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        """Linear predictor (log partial hazard), centered like H2O/R."""
+        beta = self.output["coefficients"]
+        means = self.output["x_means"]
+        X = np.stack(
+            [frame.vec(c).to_numpy().astype(np.float64) for c in self.output["names"]],
+            axis=1,
+        )
+        return (np.nan_to_num(X) - means[None, :]) @ beta
+
+    def concordance(self) -> float:
+        return self.training_metrics.value("concordance")
+
+
+class CoxPH(ModelBuilder):
+    algo = "coxph"
+    PARAMS_CLS = CoxPHParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None):
+        p: CoxPHParams = self.params
+        # call shape: response_column = event indicator 0/1,
+        # stop_column = time (the common (time, event) pair)
+        assert p.stop_column, "CoxPH needs stop_column (the time column)"
+        times = train.vec(p.stop_column).to_numpy().astype(np.float64)
+        ev_v = train.vec(p.response_column)
+        event = ev_v.to_numpy().astype(np.float64)
+        if ev_v.is_categorical():
+            event = (event == 1).astype(np.float64)
+        cols = [c for c in self._x if c not in (p.stop_column, p.response_column)]
+        X = np.stack([train.vec(c).to_numpy().astype(np.float64) for c in cols], axis=1)
+
+        ok = ~np.isnan(times) & ~np.isnan(event) & ~np.isnan(X).any(axis=1)
+        times, event, X = times[ok], event[ok], X[ok]
+        x_means = X.mean(axis=0)
+        Xc = X - x_means[None, :]
+
+        # sort by DESCENDING time so risk sets are prefix sums
+        order = np.argsort(-times, kind="mergesort")
+        times, event, Xc = times[order], event[order], Xc[order]
+        n, d = Xc.shape
+
+        # tie groups (equal event times)
+        _, grp_start = np.unique(-times, return_index=True)
+        grp_id = np.zeros(n, np.int64)
+        grp_id[grp_start] = 1
+        grp_id = np.cumsum(grp_id) - 1
+
+        Xd = jnp.asarray(Xc)
+        ev = jnp.asarray(event)
+        gid = jnp.asarray(grp_id)
+        n_grp = int(grp_id.max()) + 1
+        efron = p.ties.lower() == "efron"
+
+        @jax.jit
+        def ll_grad_hess(beta):
+            eta = Xd @ beta
+            r = jnp.exp(eta)
+            # prefix sums over descending time = risk-set sums at each row
+            S0 = jnp.cumsum(r)
+            S1 = jnp.cumsum(Xd * r[:, None], axis=0)
+            S2 = jnp.cumsum(r[:, None, None] * (Xd[:, :, None] * Xd[:, None, :]), axis=0)
+            # per-group risk-set values = value at the group's LAST row
+            glast = jax.ops.segment_max(jnp.arange(n), gid, n_grp)
+            s0 = S0[glast]
+            s1 = S1[glast]
+            s2 = S2[glast]
+            # per-group event sums
+            dsum = jax.ops.segment_sum(ev, gid, n_grp)
+            zsum = jax.ops.segment_sum(Xd * ev[:, None], gid, n_grp)
+            esum0 = jax.ops.segment_sum(r * ev, gid, n_grp)
+            esum1 = jax.ops.segment_sum(Xd * (r * ev)[:, None], gid, n_grp)
+            esum2 = jax.ops.segment_sum(
+                (r * ev)[:, None, None] * (Xd[:, :, None] * Xd[:, None, :]), gid, n_grp
+            )
+            ll_ev = jax.ops.segment_sum(eta * ev, gid, n_grp)
+
+            MAXD = 32  # Efron correction unrolled over within-group event rank
+
+            def group_terms(args):
+                s0g, s1g, s2g, dg, e0, e1, e2, llg = args
+                ll = llg
+                g = jnp.zeros(d)
+                H = jnp.zeros((d, d))
+                for l in range(MAXD):
+                    active = l < dg
+                    frac = jnp.where(dg > 0, l / jnp.maximum(dg, 1.0), 0.0) if efron else 0.0
+                    phi0 = s0g - frac * e0
+                    phi1 = s1g - frac * e1
+                    phi2 = s2g - frac * e2
+                    phi0 = jnp.maximum(phi0, 1e-300)
+                    ll = ll - jnp.where(active, jnp.log(phi0), 0.0)
+                    g = g - jnp.where(active, phi1 / phi0, 0.0)
+                    H = H - jnp.where(
+                        active,
+                        phi2 / phi0 - jnp.outer(phi1, phi1) / (phi0**2),
+                        0.0,
+                    )
+                return ll, g, H
+
+            lls, gs, Hs = jax.vmap(group_terms)(
+                (s0, s1, s2, dsum, esum0, esum1, esum2, ll_ev)
+            )
+            grad = zsum.sum(axis=0) + gs.sum(axis=0)
+            return lls.sum(), grad, Hs.sum(axis=0)
+
+        beta = jnp.zeros(d)
+        ll_prev = -np.inf
+        iters = 0
+        for it in range(p.max_iterations):
+            ll, grad, H = ll_grad_hess(beta)
+            ll = float(ll)
+            Hn = np.asarray(H, np.float64)
+            gn = np.asarray(grad, np.float64)
+            try:
+                delta = np.linalg.solve(Hn - 1e-9 * np.eye(d), -gn)
+            except np.linalg.LinAlgError:
+                break
+            beta = beta + jnp.asarray(delta)
+            iters = it + 1
+            job.update(0.05 + 0.85 * (it + 1) / p.max_iterations)
+            if abs(ll - ll_prev) < p.tolerance * (abs(ll) + 1e-9):
+                break
+            ll_prev = ll
+
+        beta_np = np.asarray(beta, np.float64)
+        out = {
+            "coefficients": beta_np,
+            "coef_names": cols,
+            "names": cols,
+            "x_means": x_means,
+            "loglik": float(ll),
+            "n": int(n),
+            "n_events": int(event.sum()),
+            "response_domain": None,
+        }
+        model = CoxPHModel(DKV.make_key("coxph"), p, out)
+        # concordance (Harrell's C) on the training data
+        eta = Xc @ beta_np
+        conc = _concordance(times, event, eta)
+        model.training_metrics = ModelMetrics(
+            "coxph",
+            {"loglik": float(ll), "concordance": conc, "iterations": iters,
+             "n": int(n), "n_events": int(event.sum())},
+        )
+        return model
+
+
+def _concordance(times, event, eta) -> float:
+    """Harrell's C on (possibly subsampled) pairs — O(n²) capped at 3k rows."""
+    n = len(times)
+    if n > 3000:
+        idx = np.random.default_rng(0).choice(n, 3000, replace=False)
+        times, event, eta = times[idx], event[idx], eta[idx]
+        n = 3000
+    conc = ties = total = 0.0
+    for i in range(n):
+        if event[i] != 1:
+            continue
+        cmp = times > times[i]
+        total += cmp.sum()
+        conc += (eta[cmp] < eta[i]).sum()
+        ties += (eta[cmp] == eta[i]).sum()
+    return float((conc + 0.5 * ties) / total) if total > 0 else float("nan")

@@ -1,0 +1,100 @@
+"""Single decision tree (DT) — successor of ``hex.tree.dt.DT`` [UNVERIFIED
+upstream path, SURVEY.md §2.2].
+
+One CART-style tree on the shared level-wise histogram engine (leaf value =
+weighted node mean of the 0/1 response or the numeric target). H2O's DT is
+binary-classification only; regression is supported here as a superset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import ModelBuilder
+from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams
+from h2o3_tpu.models.tree.shared_tree import build_tree
+
+
+@dataclass
+class DTParams(SharedTreeParams):
+    max_depth: int = 10
+    min_rows: float = 10.0
+
+
+class DTModel(SharedTreeModel):
+    algo = "dt"
+
+    def _predict_raw_dev(self, frame: Frame):
+        raw = self._replay_all_dev(frame)[: frame.nrow]  # leaf means
+        if not self.is_classifier:
+            return raw
+        p1 = jnp.clip(raw, 0.0, 1.0)
+        return jnp.stack([1 - p1, p1], axis=1)
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        return np.asarray(self._predict_raw_dev(frame))
+
+
+class DT(ModelBuilder):
+    algo = "dt"
+    PARAMS_CLS = DTParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None):
+        p: DTParams = self.params
+        yv = train.vec(p.response_column)
+        classification = yv.is_categorical()
+        if classification and yv.cardinality > 2:
+            raise ValueError("DT supports binary classification only (H2O parity)")
+
+        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        bins = bin_frame(spec, train)
+        npad = train.npad
+
+        y_np = yv.to_numpy().astype(np.float64)
+        w_np = np.zeros(npad, np.float32)
+        w_np[: train.nrow] = 1.0
+        if p.weights_column:
+            w_np[: train.nrow] *= np.nan_to_num(
+                train.vec(p.weights_column).to_numpy()
+            ).astype(np.float32)
+        w_np[: train.nrow] *= (y_np >= 0) if classification else ~np.isnan(y_np)
+        ybuf = np.zeros(npad, np.float32)
+        ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
+        w = jnp.asarray(w_np)
+        y = jnp.asarray(ybuf)
+
+        tree, F, varimp = build_tree(
+            bins, w, y, w,  # hessian = weight → leaf = weighted node mean
+            n_bins=spec.max_bins,
+            is_cat_cols=spec.is_cat,
+            max_depth=p.max_depth,
+            min_rows=p.min_rows,
+            min_split_improvement=p.min_split_improvement,
+            learn_rate=1.0,
+            preds=jnp.zeros(npad, jnp.float32),
+            key=jax.random.PRNGKey(abs(p.seed) if p.seed and p.seed > 0 else 42),
+            varimp=jnp.zeros(len(self._x), jnp.float32),
+        )
+
+        out = {
+            "bin_spec": spec,
+            "trees": [[tree]],
+            "n_tree_classes": 1,
+            "names": list(self._x),
+            "varimp": np.asarray(varimp).astype(np.float64),
+            "response_domain": tuple(yv.domain) if classification else None,
+            "ntrees_actual": 1,
+        }
+        model = DTModel(DKV.make_key("dt"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

@@ -165,6 +165,11 @@ class DeepLearning(ModelBuilder):
                 momentum=p.momentum_start or None,
             )
         opt_state = tx.init(params)
+        if prior is not None and prior.output.get("opt_state") is not None:
+            # carry the optimizer accumulators (adadelta rho-averages /
+            # momentum + schedule counter) so continuation matches an
+            # uninterrupted run, like GBM carries F and the split chain
+            opt_state = prior.output["opt_state"]
 
         batch = int(p.mini_batch_size)
         npad = train.npad
@@ -247,6 +252,7 @@ class DeepLearning(ModelBuilder):
             "names": list(self._x),
             "hidden": list(p.hidden),
             "epochs_trained": epochs_done,
+            "opt_state": opt_state,
             "response_domain": tuple(yv.domain) if classification else None,
         }
         model = DeepLearningModel(DKV.make_key("dl"), p, out)

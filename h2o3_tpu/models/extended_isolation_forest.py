@@ -1,0 +1,202 @@
+"""Extended Isolation Forest — successor of ``hex.isoforextended``
+[UNVERIFIED upstream path, SURVEY.md §2.2].
+
+EIF (Hariri et al.) replaces IF's axis-parallel cuts with random oblique
+hyperplanes: a node splits on x·n < d with a random normal n (``extension_
+level`` + 1 nonzero components) and intercept d drawn inside the node's
+bounding box. Like the IF builder, trees grow on tiny row subsamples
+(host-scale numpy); scoring the full frame walks all rows through stacked
+per-level (normal, intercept) arrays on device — projections are row-wise
+dots, MXU-friendly. NAs are mean-imputed for projection (deviation noted:
+upstream EIF rejects NA rows).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.models.metrics import ModelMetrics
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+
+@dataclass
+class ExtendedIsolationForestParams(CommonParams):
+    ntrees: int = 100
+    sample_size: int = 256
+    extension_level: int = -1  # -1 → fully extended (C-1)
+
+
+def _c(n: float) -> float:
+    if n <= 1:
+        return 0.0
+    return 2.0 * (np.log(n - 1) + 0.5772156649) - 2.0 * (n - 1) / n
+
+
+def _grow(X: np.ndarray, depth: int, max_depth: int, ext: int, rng) -> dict:
+    n, C = X.shape
+    if depth >= max_depth or n <= 1:
+        return {"leaf": True, "len": depth + _c(n)}
+    normal = rng.normal(size=C)
+    if ext < C - 1:  # zero out all but ext+1 components
+        off = rng.choice(C, C - (ext + 1), replace=False)
+        normal[off] = 0.0
+    proj = X @ normal
+    lo, hi = proj.min(), proj.max()
+    if hi <= lo:
+        return {"leaf": True, "len": depth + _c(n)}
+    d = rng.uniform(lo, hi)
+    left = proj < d
+    return {
+        "leaf": False,
+        "normal": normal,
+        "d": d,
+        "l": _grow(X[left], depth + 1, max_depth, ext, rng),
+        "r": _grow(X[~left], depth + 1, max_depth, ext, rng),
+    }
+
+
+def _stack_tree(root: dict, C: int, max_depth: int):
+    """Level arrays: normals (L, maxnodes, C), intercepts, leaf flags/lens."""
+    levels = []
+    frontier = [root]
+    for d in range(max_depth + 1):
+        width = 1 << d
+        normals = np.zeros((width, C), np.float32)
+        ds = np.zeros(width, np.float32)
+        is_leaf = np.ones(width, bool)
+        lens = np.zeros(width, np.float32)
+        nxt = [None] * (2 * width)
+        for i, node in enumerate(frontier):
+            if node is None:
+                continue
+            if node["leaf"]:
+                lens[i] = node["len"]
+            else:
+                is_leaf[i] = False
+                normals[i] = node["normal"]
+                ds[i] = node["d"]
+                nxt[2 * i] = node["l"]
+                nxt[2 * i + 1] = node["r"]
+        levels.append((normals, ds, is_leaf, lens))
+        frontier = nxt
+        if all(x is None for x in frontier):
+            break
+    return levels
+
+
+@partial(jax.jit, static_argnames=("n_levels",))
+def _eif_paths(X, normals, ds, is_leaf, lens, n_levels: int):
+    """Path length of every row through one stacked tree."""
+    n = X.shape[0]
+    nid = jnp.zeros(n, jnp.int32)
+    done = jnp.zeros(n, bool)
+    length = jnp.zeros(n, jnp.float32)
+    for d in range(n_levels):
+        leaf_here = is_leaf[d][nid]
+        length = jnp.where(~done & leaf_here, lens[d][nid], length)
+        done = done | leaf_here
+        nrm = normals[d][nid]  # (n, C) gather
+        proj = jnp.sum(X * nrm, axis=1)
+        go_left = proj < ds[d][nid]
+        nid = jnp.where(done, nid, 2 * nid + jnp.where(go_left, 0, 1))
+    return length
+
+
+class ExtendedIsolationForestModel(Model):
+    algo = "extendedisolationforest"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        cols = self.output["names"]
+        means = self.output["col_means"]
+        X_np = np.stack(
+            [
+                np.where(
+                    np.isnan(frame.vec(c).to_numpy().astype(np.float64)),
+                    means[i],
+                    frame.vec(c).to_numpy().astype(np.float64),
+                )
+                for i, c in enumerate(cols)
+            ],
+            axis=1,
+        ).astype(np.float32)
+        X = jnp.asarray(X_np)
+        total = jnp.zeros(X.shape[0], jnp.float32)
+        for levels in self.output["stacked_trees"]:
+            normals = tuple(jnp.asarray(lv[0]) for lv in levels)
+            ds = tuple(jnp.asarray(lv[1]) for lv in levels)
+            is_leaf = tuple(jnp.asarray(lv[2]) for lv in levels)
+            lens = tuple(jnp.asarray(lv[3]) for lv in levels)
+            total = total + _eif_paths(X, normals, ds, is_leaf, lens, len(levels))
+        mean_len = np.asarray(total) / max(len(self.output["stacked_trees"]), 1)
+        score = 2.0 ** (-mean_len / max(_c(self.output["sample_size"]), 1e-9))
+        return np.stack([score, mean_len], axis=1)
+
+    def predict(self, frame: Frame) -> Frame:
+        raw = self._predict_raw(frame)
+        return Frame(
+            [Vec.from_numpy(raw[:, 0], "real"), Vec.from_numpy(raw[:, 1], "real")],
+            ["anomaly_score", "mean_length"],
+        )
+
+
+class ExtendedIsolationForest(ModelBuilder):
+    algo = "extendedisolationforest"
+    PARAMS_CLS = ExtendedIsolationForestParams
+    SUPPORTS_CLASSIFICATION = False
+    SUPPORTS_REGRESSION = False
+
+    def train(self, x=None, training_frame=None, **kw):
+        return super().train(x=x, y=None, training_frame=training_frame, **kw)
+
+    def _features(self, train: Frame, response: str | None):
+        return [n for n in train.names if train.vec(n).is_numeric()]
+
+    def _validate(self, train: Frame, valid: Frame | None) -> None:
+        pass  # unsupervised
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None):
+        p: ExtendedIsolationForestParams = self.params
+        cols = self._x
+        assert cols, "EIF needs at least one numeric column"
+        C = len(cols)
+        ext = C - 1 if p.extension_level in (-1,) else min(p.extension_level, C - 1)
+
+        Xall = np.stack(
+            [train.vec(c).to_numpy().astype(np.float64) for c in cols], axis=1
+        )
+        means = np.nanmean(Xall, axis=0)
+        Xall = np.where(np.isnan(Xall), means[None, :], Xall)
+
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else 77)
+        psi = min(p.sample_size, train.nrow)
+        max_depth = int(np.ceil(np.log2(max(psi, 2))))
+        stacked = []
+        for t in range(p.ntrees):
+            idx = rng.choice(train.nrow, psi, replace=False)
+            root = _grow(Xall[idx], 0, max_depth, ext, rng)
+            stacked.append(_stack_tree(root, C, max_depth))
+            job.update(0.05 + 0.85 * (t + 1) / p.ntrees)
+
+        out = {
+            "names": list(cols),
+            "col_means": means,
+            "stacked_trees": stacked,
+            "sample_size": psi,
+            "response_domain": None,
+        }
+        model = ExtendedIsolationForestModel(DKV.make_key("eif"), p, out)
+        raw = model._predict_raw(train)[: train.nrow]
+        model.training_metrics = ModelMetrics(
+            "anomaly",
+            {"mean_score": float(raw[:, 0].mean()), "mean_length": float(raw[:, 1].mean()),
+             "nobs": train.nrow},
+        )
+        return model

@@ -307,7 +307,7 @@ def _write_manifest(ckdir: str, grid: Grid, done: dict[str, str], fingerprint: s
         "hyper_names": grid.hyper_names,
         "fingerprint": fingerprint,
         "built": done,
-        "failures": [list(f) for f in grid.failures],
+        "failures": [[{k: _canon(v) for k, v in hv.items()}, msg] for hv, msg in grid.failures],
     }
     with open(_manifest_path(ckdir, grid.key), "w") as f:
         json.dump(payload, f)

@@ -1,0 +1,105 @@
+"""Isotonic regression — successor of ``hex.isotonic.IsotonicRegression``
+[UNVERIFIED upstream path, SURVEY.md §2.2].
+
+Weighted pool-adjacent-violators on the single feature (PAV is inherently
+sequential — an O(n) host pass after one device sort-key pull); prediction
+is linear interpolation between fitted thresholds with H2O's ``clip``
+out-of-bounds policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+from h2o3_tpu.models import metrics as MM
+
+
+@dataclass
+class IsotonicRegressionParams(CommonParams):
+    out_of_bounds: str = "clip"  # clip | na
+
+
+def _pav(y: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Weighted pool-adjacent-violators: isotonic fit of y (sorted by x)."""
+    n = len(y)
+    fitted = y.astype(np.float64).copy()
+    weight = w.astype(np.float64).copy()
+    # block-merge stack: (start, value, weight)
+    starts = np.zeros(n, np.int64)
+    vals = np.zeros(n, np.float64)
+    wts = np.zeros(n, np.float64)
+    top = -1
+    for i in range(n):
+        top += 1
+        starts[top], vals[top], wts[top] = i, fitted[i], weight[i]
+        while top > 0 and vals[top - 1] > vals[top]:
+            wsum = wts[top - 1] + wts[top]
+            vals[top - 1] = (vals[top - 1] * wts[top - 1] + vals[top] * wts[top]) / wsum
+            wts[top - 1] = wsum
+            top -= 1
+    out = np.empty(n, np.float64)
+    for b in range(top + 1):
+        end = starts[b + 1] if b < top else n
+        out[starts[b] : end] = vals[b]
+    return out
+
+
+class IsotonicRegressionModel(Model):
+    algo = "isotonicregression"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        x = frame.vec(self.output["feature"]).to_numpy().astype(np.float64)
+        tx = self.output["thresholds_x"]
+        ty = self.output["thresholds_y"]
+        out = np.interp(x, tx, ty)
+        if self.params.out_of_bounds == "na":
+            out[(x < tx[0]) | (x > tx[-1])] = np.nan
+        out[np.isnan(x)] = np.nan
+        return out
+
+
+class IsotonicRegression(ModelBuilder):
+    algo = "isotonicregression"
+    PARAMS_CLS = IsotonicRegressionParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p = self.params
+        assert len(self._x) == 1, "isotonic regression takes exactly one feature"
+        feat = self._x[0]
+        x = train.vec(feat).to_numpy().astype(np.float64)
+        y = train.vec(p.response_column).to_numpy().astype(np.float64)
+        w = np.ones_like(y)
+        if p.weights_column:
+            w = np.nan_to_num(train.vec(p.weights_column).to_numpy()).astype(np.float64)
+        ok = ~np.isnan(x) & ~np.isnan(y) & (w > 0)
+        x, y, w = x[ok], y[ok], w[ok]
+        order = np.argsort(x, kind="mergesort")
+        x, y, w = x[order], y[order], w[order]
+        # pool ties in x first (H2O's secondary aggregation)
+        ux, inv = np.unique(x, return_inverse=True)
+        wsum = np.bincount(inv, weights=w)
+        ysum = np.bincount(inv, weights=w * y)
+        ymean = ysum / np.maximum(wsum, 1e-300)
+        fitted = _pav(ymean, wsum)
+        # keep only breakpoints (H2O stores thresholds)
+        keep = np.ones(len(ux), bool)
+        keep[1:-1] = (fitted[1:-1] != fitted[:-2]) | (fitted[1:-1] != fitted[2:])
+        out = {
+            "feature": feat,
+            "thresholds_x": ux[keep],
+            "thresholds_y": fitted[keep],
+            "names": [feat],
+            "response_domain": None,
+        }
+        model = IsotonicRegressionModel(DKV.make_key("isotonic"), p, out)
+        pred = model._predict_raw(train)
+        yy, ww = model._response_and_weights(train)
+        model.training_metrics = MM.regression_metrics(yy, pred, ww)
+        return model

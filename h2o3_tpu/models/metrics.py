@@ -172,17 +172,22 @@ def binomial_metrics(
     thresholds = np.unique(np.quantile(p, np.linspace(0, 1, 400)))
     table = _threshold_table(y, p, w, thresholds)
     f1 = table["f1"]
-    best = int(np.nanargmax(f1))
+    best = int(np.nanargmax(f1)) if not np.all(np.isnan(f1)) else 0
     best_thr = float(thresholds[best])
     cm = _confusion(y, p, w, best_thr)
 
-    mx = {
-        f"max_{name}": {
-            "threshold": float(thresholds[int(np.nanargmax(table[name]))]),
-            "value": float(np.nanmax(table[name])),
-        }
-        for name in ("f1", "f2", "f0point5", "accuracy", "precision", "recall", "specificity", "mcc", "min_per_class_accuracy", "mean_per_class_accuracy")
-    }
+    mx = {}
+    for name in ("f1", "f2", "f0point5", "accuracy", "precision", "recall",
+                 "specificity", "mcc", "min_per_class_accuracy",
+                 "mean_per_class_accuracy"):
+        vals = table[name]
+        if np.all(np.isnan(vals)):  # degenerate (e.g. constant predictions)
+            mx[f"max_{name}"] = {"threshold": 0.5, "value": float("nan")}
+        else:
+            mx[f"max_{name}"] = {
+                "threshold": float(thresholds[int(np.nanargmax(vals))]),
+                "value": float(np.nanmax(vals)),
+            }
 
     return ModelMetrics(
         "binomial",

@@ -107,6 +107,9 @@ class Model:
         self.cv_models: list["Model"] = []
         self.scoring_history: list[dict] = []
         self.run_time_ms: int = 0
+        # fitted feature transformers (e.g. AutoML target encoding) applied
+        # to incoming frames before scoring; transforms must be idempotent
+        self.preprocessors: list = []
         DKV.put(key, self)
 
     # -- to be provided by subclasses ---------------------------------------
@@ -124,9 +127,15 @@ class Model:
         d = self.output.get("response_domain")
         return len(d) if d else 1
 
+    def _apply_preprocessors(self, frame: Frame) -> Frame:
+        for pre in self.preprocessors:
+            frame = pre.transform(frame)
+        return frame
+
     def predict(self, frame: Frame) -> Frame:
         """``model.predict`` — returns a Frame with ``predict`` (+ per-class
         probability columns for classifiers), matching the H2O layout."""
+        frame = self._apply_preprocessors(frame)
         raw = self._predict_raw(frame)
         if not self.is_classifier:
             return Frame([Vec.from_numpy(np.asarray(raw), "real")], ["predict"])
@@ -166,6 +175,7 @@ class Model:
         return y, w
 
     def _score_metrics(self, frame: Frame) -> MM.ModelMetrics:
+        frame = self._apply_preprocessors(frame)
         raw = np.asarray(self._predict_raw(frame))
         y, w = self._response_and_weights(frame)
         return _make_metrics(self, raw, y, w)

@@ -1,0 +1,144 @@
+"""Target encoding — successor of ``ai.h2o.targetencoding.TargetEncoder*``
+[UNVERIFIED upstream paths, SURVEY.md §2.3].
+
+Supervised categorical encoding with H2O's three holdout strategies:
+``none`` (global per-level means), ``loo`` (leave-one-out: each row's own
+target excluded from its level mean), ``kfold`` (per-fold out-of-fold
+means), plus the blending formula lambda = 1/(1+exp(-(n-k)/f)) mixing the
+level mean toward the global prior, and optional gaussian noise.
+
+Level statistics are tiny (per-level sums); the group sums come off a host
+pass over the pulled code/target columns — O(n) once, like H2O's single
+MRTask pass — and the encoded column is rebuilt as a device Vec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import CAT, Frame, Vec
+
+
+@dataclass
+class TargetEncoderParams:
+    holdout_type: str = "none"  # none | loo | kfold
+    blending: bool = False
+    inflection_point: float = 10.0  # k in lambda = 1/(1+exp(-(n-k)/f))
+    smoothing: float = 20.0  # f
+    noise: float = 0.0
+    fold_column: str | None = None
+    nfolds: int = 5
+    seed: int = -1
+    columns: Sequence[str] = field(default_factory=tuple)
+
+
+class TargetEncoder:
+    """fit/transform pair mirroring the h2o-py TargetEncoder surface."""
+
+    def __init__(self, **kw):
+        self.params = TargetEncoderParams(**kw)
+        self._stats: dict[str, tuple[np.ndarray, np.ndarray, tuple]] = {}
+        self._prior: float = 0.0
+        self._y: str | None = None
+
+    # -- fit ----------------------------------------------------------------
+    def fit(self, frame: Frame, y: str, columns: Sequence[str] | None = None):
+        p = self.params
+        cols = list(columns or p.columns) or [
+            n for n in frame.names if frame.vec(n).is_categorical() and n != y
+        ]
+        yv = frame.vec(y)
+        t = yv.to_numpy().astype(np.float64)
+        if yv.is_categorical():
+            if yv.cardinality != 2:
+                raise ValueError("target encoding supports numeric or binary targets")
+            t = (t == 1).astype(np.float64)
+        ok = ~np.isnan(t) & (t >= 0)
+        self._prior = float(t[ok].mean()) if ok.any() else 0.0
+        self._y = y
+        self._stats = {}
+        for c in cols:
+            v = frame.vec(c)
+            if not v.is_categorical():
+                continue
+            codes = v.to_numpy().astype(np.int64)
+            card = v.cardinality
+            use = ok & (codes >= 0)
+            cnt = np.bincount(codes[use], minlength=card).astype(np.float64)
+            ssum = np.bincount(codes[use], weights=t[use], minlength=card)
+            self._stats[c] = (cnt, ssum, tuple(v.domain or ()))
+        return self
+
+    # -- transform ----------------------------------------------------------
+    def transform(self, frame: Frame, as_training: bool = False) -> Frame:
+        """Append ``<col>_te`` columns. ``as_training=True`` applies the
+        holdout strategy (loo/kfold need the frame's own target/folds);
+        test-time transform always uses the full fitted means."""
+        p = self.params
+        rng = np.random.default_rng(abs(p.seed) if p.seed and p.seed > 0 else None)
+        n = frame.nrow
+
+        t = fold = None
+        if as_training and p.holdout_type in ("loo", "kfold"):
+            yv = frame.vec(self._y)
+            t = yv.to_numpy().astype(np.float64)
+            if yv.is_categorical():
+                t = (t == 1).astype(np.float64)
+            if p.holdout_type == "kfold":
+                if p.fold_column:
+                    fold = frame.vec(p.fold_column).to_numpy().astype(np.int64)
+                else:
+                    fold = np.arange(n) % p.nfolds
+
+        new_vecs, new_names = list(frame._vecs), list(frame.names)
+        for c, (cnt, ssum, dom) in self._stats.items():
+            if c not in frame or f"{c}_te" in frame:  # idempotent re-apply
+                continue
+            v = frame.vec(c)
+            codes = v.to_numpy().astype(np.int64)
+            # remap to fit-time domain when the frame's domain differs
+            if tuple(v.domain or ()) != dom:
+                lut = {d: i for i, d in enumerate(dom)}
+                remap = np.array(
+                    [lut.get(d, -1) for d in (v.domain or ())], np.int64
+                )
+                codes = np.where(codes >= 0, remap[np.clip(codes, 0, None)], -1)
+            enc = np.full(n, self._prior)
+            seen = codes >= 0
+            cs = np.clip(codes, 0, None)
+            if as_training and p.holdout_type == "loo" and t is not None:
+                own = np.where(~np.isnan(t), t, 0.0)
+                cnt_i = cnt[cs] - 1.0
+                sum_i = ssum[cs] - own
+                mean = np.where(cnt_i > 0, sum_i / np.maximum(cnt_i, 1e-300), self._prior)
+                nlev = cnt_i
+            elif as_training and p.holdout_type == "kfold" and t is not None:
+                # out-of-fold level stats = full stats − this fold's stats
+                mean = np.full(n, self._prior)
+                nlev = np.zeros(n)
+                for f in np.unique(fold):
+                    infold = fold == f
+                    use = infold & seen & ~np.isnan(t)
+                    card = len(cnt)
+                    cf = np.bincount(cs[use], minlength=card).astype(np.float64)
+                    sf = np.bincount(cs[use], weights=t[use], minlength=card)
+                    oof_cnt = cnt - cf
+                    oof_sum = ssum - sf
+                    m = np.where(oof_cnt > 0, oof_sum / np.maximum(oof_cnt, 1e-300), self._prior)
+                    mean[infold] = m[cs[infold]]
+                    nlev[infold] = oof_cnt[cs[infold]]
+            else:
+                mean = np.where(cnt[cs] > 0, ssum[cs] / np.maximum(cnt[cs], 1e-300), self._prior)
+                nlev = cnt[cs]
+            if p.blending:
+                lam = 1.0 / (1.0 + np.exp(-(nlev - p.inflection_point) / max(p.smoothing, 1e-9)))
+                mean = lam * mean + (1 - lam) * self._prior
+            enc[seen] = mean[seen]
+            if as_training and p.noise > 0:
+                enc = enc + rng.uniform(-p.noise, p.noise, n)
+            new_vecs.append(Vec.from_numpy(enc, "real", name=f"{c}_te"))
+            new_names.append(f"{c}_te")
+        return Frame(new_vecs, new_names)

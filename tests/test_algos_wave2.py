@@ -1,0 +1,273 @@
+"""Algorithm wave 2 — Isotonic, DT, AdaBoost, ExtendedIsolationForest
+(SURVEY.md §2.2 rows C25/C32), accuracy pinned against sklearn where a
+counterpart exists."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import DT, AdaBoost, ExtendedIsolationForest, IsotonicRegression
+
+
+def test_isotonic_matches_sklearn():
+    from sklearn.isotonic import IsotonicRegression as SkIso
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    x = rng.uniform(0, 10, n)
+    y = np.log1p(x) + rng.normal(0, 0.3, n)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y}))
+    m = IsotonicRegression().train(x=["x"], y="y", training_frame=fr)
+    ours = m.predict(fr).vec("predict").to_numpy()
+    sk = SkIso(out_of_bounds="clip").fit(x, y).predict(x)
+    np.testing.assert_allclose(ours, sk, atol=1e-6)
+    assert m.training_metrics.rmse < 0.35
+
+
+def test_isotonic_weighted_and_na():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 5, 500)
+    y = x + rng.normal(0, 0.1, 500)
+    w = rng.uniform(0.5, 2.0, 500)
+    x[:5] = np.nan
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y, "w": w}))
+    m = IsotonicRegression(weights_column="w").train(x=["x"], y="y", training_frame=fr)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.isnan(pred[:5]).all()
+    assert np.all(np.diff(m.output["thresholds_y"]) >= -1e-12)
+
+
+def _binary(n=3000, seed=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    eta = X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = np.where(y == 1, "Y", "N")
+    return df, y
+
+
+def test_dt_tracks_sklearn_tree():
+    from sklearn.metrics import roc_auc_score
+    from sklearn.tree import DecisionTreeClassifier
+
+    df, y = _binary()
+    fr = Frame.from_pandas(df)
+    m = DT(max_depth=5, min_rows=10).train(y="y", training_frame=fr)
+    p1 = m.predict(fr).vec("Y").to_numpy()
+    ours = roc_auc_score(y, p1)
+    sk = roc_auc_score(
+        y,
+        DecisionTreeClassifier(max_depth=5, min_samples_leaf=10)
+        .fit(df[list("abcd")], y)
+        .predict_proba(df[list("abcd")])[:, 1],
+    )
+    assert ours > 0.85 and ours > sk - 0.05
+
+
+def test_dt_regression():
+    rng = np.random.default_rng(3)
+    n = 2000
+    df = pd.DataFrame({"a": rng.uniform(-2, 2, n), "b": rng.normal(size=n)})
+    df["y"] = np.where(df["a"] > 0, 3.0, -1.0) + 0.1 * rng.normal(size=n)
+    fr = Frame.from_pandas(df)
+    m = DT(max_depth=3).train(y="y", training_frame=fr)
+    assert m.training_metrics.r2 > 0.9
+
+
+def test_dt_rejects_multiclass():
+    rng = np.random.default_rng(4)
+    df = pd.DataFrame({"a": rng.normal(size=100), "y": rng.choice(list("rgb"), 100)})
+    with pytest.raises(Exception, match="binary"):
+        DT().train(y="y", training_frame=Frame.from_pandas(df))
+
+
+def test_adaboost_beats_stump_and_tracks_sklearn():
+    from sklearn.ensemble import AdaBoostClassifier
+    from sklearn.metrics import roc_auc_score
+
+    df, y = _binary(seed=5)
+    fr = Frame.from_pandas(df)
+    m = AdaBoost(nlearners=40, seed=3).train(y="y", training_frame=fr)
+    p1 = m.predict(fr).vec("Y").to_numpy()
+    ours = roc_auc_score(y, p1)
+    stump = DT(max_depth=1).train(y="y", training_frame=fr)
+    stump_auc = roc_auc_score(y, stump.predict(fr).vec("Y").to_numpy())
+    sk = roc_auc_score(
+        y,
+        AdaBoostClassifier(n_estimators=40, random_state=0)
+        .fit(df[list("abcd")], y)
+        .predict_proba(df[list("abcd")])[:, 1],
+    )
+    assert ours > stump_auc + 0.05  # boosting must beat its weak learner
+    assert ours > sk - 0.05
+    assert len(m.output["alphas"]) == m.output["ntrees_actual"]
+
+
+def test_extended_isolation_forest_flags_outliers():
+    rng = np.random.default_rng(6)
+    inliers = rng.normal(0, 1, size=(1000, 3))
+    outliers = rng.normal(0, 1, size=(20, 3)) + 8.0
+    X = np.vstack([inliers, outliers])
+    fr = Frame.from_pandas(pd.DataFrame(X, columns=["a", "b", "c"]))
+    m = ExtendedIsolationForest(ntrees=60, sample_size=128, seed=9).train(
+        training_frame=fr
+    )
+    scores = m.predict(fr).vec("anomaly_score").to_numpy()
+    # outliers (last 20 rows) must rank clearly above inliers
+    cutoff = np.quantile(scores[:1000], 0.95)
+    assert (scores[1000:] > cutoff).mean() > 0.9
+    assert m.training_metrics.mean_score > 0
+
+
+def test_eif_extension_level_zero_is_axis_parallel():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 3))
+    fr = Frame.from_pandas(pd.DataFrame(X, columns=["a", "b", "c"]))
+    m = ExtendedIsolationForest(ntrees=10, sample_size=64, extension_level=0, seed=1).train(
+        training_frame=fr
+    )
+    for levels in m.output["stacked_trees"]:
+        for normals, _, is_leaf, _ in levels:
+            nz = (normals != 0).sum(axis=1)
+            assert np.all(nz[~is_leaf] == 1)  # exactly one feature per split
+
+
+# ---------------------------------------------------------------------------
+# wave 2b: TargetEncoder, GLRM, CoxPH, Word2Vec
+
+
+def test_target_encoder_means_blending_loo():
+    from h2o3_tpu.models import TargetEncoder
+
+    rng = np.random.default_rng(8)
+    n = 2000
+    lev = rng.choice(["a", "b", "c"], n, p=[0.5, 0.3, 0.2])
+    y = (rng.random(n) < np.select([lev == "a", lev == "b"], [0.8, 0.4], 0.1)).astype(int)
+    df = pd.DataFrame({"g": lev, "y": np.where(y == 1, "T", "F")})
+    fr = Frame.from_pandas(df)
+
+    te = TargetEncoder(holdout_type="none").fit(fr, "y", ["g"])
+    out = te.transform(fr)
+    enc = out.vec("g_te").to_numpy()
+    for L in ("a", "b", "c"):
+        m = enc[lev == L]
+        assert np.allclose(m, m[0])
+        assert abs(m[0] - y[lev == L].mean()) < 1e-6
+
+    # LOO excludes the row's own target
+    te2 = TargetEncoder(holdout_type="loo").fit(fr, "y", ["g"])
+    enc2 = te2.transform(fr, as_training=True).vec("g_te").to_numpy()
+    i = int(np.flatnonzero(lev == "a")[0])
+    na, sa = (lev == "a").sum(), y[lev == "a"].sum()
+    expect = (sa - y[i]) / (na - 1)
+    assert abs(enc2[i] - expect) < 1e-6
+
+    # blending pulls sparse levels toward the prior
+    te3 = TargetEncoder(holdout_type="none", blending=True, inflection_point=5000).fit(fr, "y", ["g"])
+    enc3 = te3.transform(fr).vec("g_te").to_numpy()
+    prior = y.mean()
+    assert np.all(np.abs(enc3 - prior) < np.abs(enc - prior) + 1e-12)
+
+    # kfold transform works and differs from the global means
+    te4 = TargetEncoder(holdout_type="kfold", nfolds=4).fit(fr, "y", ["g"])
+    enc4 = te4.transform(fr, as_training=True).vec("g_te").to_numpy()
+    assert np.isfinite(enc4).all() and not np.allclose(enc4, enc)
+
+
+def test_glrm_recovers_low_rank_structure():
+    from h2o3_tpu.models import GLRM
+
+    rng = np.random.default_rng(9)
+    n, d, k = 1000, 8, 3
+    U = rng.normal(size=(n, k))
+    W = rng.normal(size=(k, d))
+    A = U @ W + 0.01 * rng.normal(size=(n, d))
+    A[rng.random(A.shape) < 0.1] = np.nan  # 10% missing
+    fr = Frame.from_pandas(pd.DataFrame(A, columns=[f"c{i}" for i in range(d)]))
+    m = GLRM(k=k, max_iterations=200, transform="DEMEAN", seed=2).train(training_frame=fr)
+    objs = [h["objective"] for h in m.scoring_history]
+    assert objs[-1] < objs[0] * 0.1  # objective collapsed
+    rec = m.reconstruct(fr)
+    Ahat = np.stack([rec.vec(i).to_numpy() for i in range(d)], axis=1)
+    ok = ~np.isnan(A)
+    rel = np.sqrt(np.nanmean((Ahat[:1000] - A) ** 2)) / np.nanstd(A)
+    assert rel < 0.2
+
+
+def test_glrm_nonneg_regularization():
+    from h2o3_tpu.models import GLRM
+
+    rng = np.random.default_rng(10)
+    A = np.abs(rng.normal(size=(300, 5)))
+    fr = Frame.from_pandas(pd.DataFrame(A, columns=[f"c{i}" for i in range(5)]))
+    m = GLRM(k=2, regularization_x="NonNegative", regularization_y="NonNegative",
+             transform="NONE", max_iterations=100, seed=3, init="Random").train(training_frame=fr)
+    assert (m.output["archetypes"] >= 0).all()
+    assert (m.output["x_factor"] >= 0).all()
+
+
+def test_coxph_recovers_coefficients():
+    from h2o3_tpu.models import CoxPH
+
+    rng = np.random.default_rng(11)
+    n = 4000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    beta_true = np.array([0.8, -0.5])
+    lam = 0.1 * np.exp(x1 * beta_true[0] + x2 * beta_true[1])
+    t = rng.exponential(1.0 / lam)
+    cens = rng.exponential(1.0 / 0.05, n)
+    time = np.minimum(t, cens)
+    event = (t <= cens).astype(int)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "time": time, "event": event})
+    fr = Frame.from_pandas(df)
+    m = CoxPH(stop_column="time").train(x=["x1", "x2"], y="event", training_frame=fr)
+    beta = m.output["coefficients"]
+    np.testing.assert_allclose(beta, beta_true, atol=0.1)
+    assert m.training_metrics.value("concordance") > 0.65
+    # breslow ties variant also converges nearby
+    mb = CoxPH(stop_column="time", ties="breslow").train(x=["x1", "x2"], y="event", training_frame=fr)
+    np.testing.assert_allclose(mb.output["coefficients"], beta_true, atol=0.12)
+
+
+def test_word2vec_embeds_cooccurring_words_close():
+    from h2o3_tpu.models import Word2Vec
+
+    rng = np.random.default_rng(12)
+    # two topic clusters; words within a topic co-occur
+    topics = [["cat", "dog", "pet", "fur"], ["car", "road", "wheel", "engine"]]
+    rows = []
+    for _ in range(800):
+        t = topics[rng.integers(2)]
+        rows.extend(rng.choice(t, 6).tolist())
+        rows.append(None)  # sentence break
+    fr = Frame.from_pandas(pd.DataFrame({"words": rows}), column_types={"words": "string"})
+    m = Word2Vec(vec_size=16, epochs=8, min_word_freq=5, window_size=3, seed=5,
+                 sent_sample_rate=0.0).train(training_frame=fr)
+    syn = m.find_synonyms("cat", 3)
+    assert set(syn) <= {"dog", "pet", "fur"}, syn
+    tv = m.transform(fr[["words"]])
+    assert tv.ncol == 16
+
+
+@pytest.mark.slow
+def test_automl_with_target_encoding_preprocessing():
+    from h2o3_tpu.automl.automl import AutoML
+
+    rng = np.random.default_rng(14)
+    n = 1500
+    lev = rng.choice([f"L{i}" for i in range(12)], n)
+    strength = {f"L{i}": i / 11 for i in range(12)}
+    y = (rng.random(n) < np.vectorize(strength.get)(lev)).astype(int)
+    df = pd.DataFrame({"g": lev, "x": rng.normal(size=n),
+                       "y": np.where(y == 1, "T", "F")})
+    fr = Frame.from_pandas(df)
+    aml = AutoML(max_models=2, nfolds=0, seed=3, preprocessing=["target_encoding"],
+                 include_algos=["GBM"], max_runtime_secs=300)
+    aml.train(y="y", training_frame=fr)
+    lb = aml.leaderboard.as_table()
+    assert len(lb) >= 1
+    best = aml.leader
+    assert "g_te" in best.output["names"]
