@@ -70,6 +70,8 @@ class DRF(ModelBuilder):
 
     def _build(self, job: Job, train: Frame, valid: Frame | None):
         p: DRFParams = self.params
+        if p.ntrees < 1 or p.max_depth < 1:
+            raise ValueError("ntrees and max_depth must be >= 1")
         yv = train.vec(p.response_column)
         classification = yv.is_categorical()
         K = yv.cardinality if classification and yv.cardinality > 2 else 1

@@ -194,6 +194,8 @@ class GBM(ModelBuilder):
 
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: GBMParams = self.params
+        if p.ntrees < 1 or p.max_depth < 1:
+            raise ValueError("ntrees and max_depth must be >= 1")
         yv = train.vec(p.response_column)
         dist, aux = resolve_distribution(
             p.distribution, yv, p.quantile_alpha, p.tweedie_power, p.huber_alpha
