@@ -159,6 +159,19 @@ class SharedTreeModel(Model):
     def varimp(self):
         return self._varimp_table()
 
+    def predict_contributions(self, frame: Frame) -> Frame:
+        """Per-feature SHAP contributions + BiasTerm (hex.tree.TreeSHAP
+        successor); Σ row = raw margin."""
+        from h2o3_tpu.models.tree.shap import predict_contributions
+
+        return predict_contributions(self, frame)
+
+    def tree_view(self, tree_number: int = 0, tree_class: int = 0) -> dict:
+        """Node-table dump of one tree (hex.tree.TreeHandler successor)."""
+        from h2o3_tpu.models.tree.shap import tree_view
+
+        return tree_view(self, tree_number, tree_class)
+
 
 class GBMModel(SharedTreeModel):
     algo = "gbm"

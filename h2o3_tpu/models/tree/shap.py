@@ -1,0 +1,246 @@
+"""TreeSHAP + tree inspection — successors of ``hex.tree.TreeSHAP*`` and
+``hex.tree.TreeHandler`` [UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+``predict_contributions`` implements the exact TreeSHAP recursion (Lundberg
+et al., Algorithm 2) over the recorded level arrays: per-node covers come
+from the ``node_w`` histogram totals recorded during training, and split
+decisions are evaluated in BIN space (the same uint8 codes the trees were
+built on), so contributions are exactly consistent with prediction replay.
+The local-accuracy identity Σ contributions + bias = raw margin holds to
+float tolerance, matching the upstream contract.
+
+``tree_view`` is the TreeHandler analog: a node-table dump of one tree
+(ids, features, thresholds/level-sets, NA direction, leaf predictions).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame, Vec
+
+
+class _Node:
+    __slots__ = ("feature", "thr_bin", "is_cat", "cat_mask", "na_left",
+                 "left", "right", "value", "cover", "is_leaf")
+
+
+def _tree_nodes(tree) -> list[_Node]:
+    """Flatten level arrays into an explicit node list (root = 0)."""
+    host = tree.to_host()
+    nodes: list[_Node] = []
+    # frontier ids per level → node-list indices
+    prev_ids: list[int] = []
+    for li, lv in enumerate(host.levels):
+        width = len(lv.split_col)
+        cur_ids = []
+        for i in range(width):
+            nd = _Node()
+            nd.is_leaf = bool(lv.leaf_now[i])
+            nd.value = float(lv.leaf_val[i])
+            nd.cover = float(lv.node_w[i]) if lv.node_w is not None else 0.0
+            nd.feature = int(lv.split_col[i])
+            nd.thr_bin = int(lv.split_bin[i])
+            nd.is_cat = bool(lv.is_cat[i])
+            nd.cat_mask = np.asarray(lv.cat_mask[i])
+            nd.na_left = bool(lv.na_left[i])
+            nd.left = nd.right = -1
+            cur_ids.append(len(nodes))
+            nodes.append(nd)
+        if li > 0:
+            plv = host.levels[li - 1]
+            for pi, pid in enumerate(prev_ids):
+                if not nodes[pid].is_leaf:
+                    base = int(plv.child_base[pi])
+                    nodes[pid].left = cur_ids[base]
+                    nodes[pid].right = cur_ids[base + 1]
+        prev_ids = cur_ids
+    # prune: nodes with cover 0 that are leaves with value 0 are padding, but
+    # they are unreachable from the root walk, so no pruning is needed.
+    return nodes
+
+
+def _goes_left(nd: _Node, b: int) -> bool:
+    if b == 0:
+        return nd.na_left
+    if nd.is_cat:
+        return bool(nd.cat_mask[b])
+    return b <= nd.thr_bin
+
+
+def _shap_one_tree(nodes: list[_Node], bins_row: np.ndarray, phi: np.ndarray):
+    """Exact TreeSHAP (Lundberg Alg. 2) for one row over one tree."""
+
+    # unique-path arrays: feature index d, fraction zero z, fraction one o, weight w
+    def recurse(j, m, pd, pz, po, pw, pi1, pz1, po1):
+        # m: path length; arrays copied per call (trees are shallow)
+        pd = pd + [pi1]
+        pz = pz + [pz1]
+        po = po + [po1]
+        pw = pw + [1.0 if m == 0 else 0.0]
+        for i in range(m - 1, -1, -1):
+            pw[i + 1] += po1 * pw[i] * (i + 1) / (m + 1)
+            pw[i] = pz1 * pw[i] * (m - i) / (m + 1)
+
+        nd = nodes[j]
+        if nd.is_leaf:
+            for i in range(1, m + 1):
+                wsum = _unwound_sum(pd, pz, po, pw, m, i)
+                phi[pd[i]] += wsum * (po[i] - pz[i]) * nd.value
+            return
+        b = int(bins_row[nd.feature])
+        hot, cold = (nd.left, nd.right) if _goes_left(nd, b) else (nd.right, nd.left)
+        hot_cover = nodes[hot].cover
+        cold_cover = nodes[cold].cover
+        parent_cover = nd.cover if nd.cover > 0 else hot_cover + cold_cover
+        iz, io = 1.0, 1.0
+        k = _path_index(pd, nd.feature, m)
+        if k >= 0:  # feature already on the path: unwind it first
+            iz, io = pz[k], po[k]
+            pd, pz, po, pw, m2 = _unwind(pd, pz, po, pw, m, k)
+            m = m2
+        denom = parent_cover if parent_cover > 0 else 1.0
+        recurse(hot, m + 1, pd, pz, po, pw, nd.feature, iz * hot_cover / denom, io)
+        recurse(cold, m + 1, pd, pz, po, pw, nd.feature, iz * cold_cover / denom, 0.0)
+
+    recurse(0, 0, [], [], [], [], -1, 1.0, 1.0)
+
+
+def _path_index(pd, feature, m):
+    for i in range(1, m + 1):
+        if pd[i] == feature:
+            return i
+    return -1
+
+
+def _unwind(pd, pz, po, pw, m, i):
+    pd, pz, po, pw = list(pd), list(pz), list(po), list(pw)
+    n = pw[m]
+    for j in range(m - 1, -1, -1):
+        if po[i] != 0:
+            t = pw[j]
+            pw[j] = n * (m + 1) / ((j + 1) * po[i])
+            n = t - pw[j] * pz[i] * (m - j) / (m + 1)
+        else:
+            pw[j] = pw[j] * (m + 1) / (pz[i] * (m - j)) if pz[i] * (m - j) != 0 else pw[j]
+    for j in range(i, m):
+        pd[j] = pd[j + 1]
+        pz[j] = pz[j + 1]
+        po[j] = po[j + 1]
+    return pd[:m], pz[:m], po[:m], pw[:m], m - 1
+
+
+def _unwound_sum(pd, pz, po, pw, m, i):
+    total = 0.0
+    n = pw[m]
+    if po[i] != 0:
+        for j in range(m - 1, -1, -1):
+            tmp = n / ((j + 1) * po[i]) * (m + 1)
+            total += tmp
+            n = pw[j] - tmp * pz[i] * (m - j) / (m + 1)
+    else:
+        for j in range(m - 1, -1, -1):
+            if pz[i] * (m - j) != 0:
+                total += pw[j] * (m + 1) / (pz[i] * (m - j))
+    return total
+
+
+def predict_contributions(model, frame: Frame) -> Frame:
+    """Per-feature SHAP contributions on the margin scale + BiasTerm.
+
+    Local accuracy: row-sum of the output equals the raw margin (before the
+    link) that prediction replay produces. Supported for regression and
+    binomial GBM/DRF (H2O's predict_contributions contract).
+    """
+    from h2o3_tpu.models.tree.binning import bin_frame
+
+    out = model.output
+    if out.get("n_tree_classes", 1) > 1:
+        raise ValueError("predict_contributions supports regression/binomial models only")
+    spec = out["bin_spec"]
+    bins = np.asarray(bin_frame(spec, frame))[: frame.nrow]
+    names = out["names"]
+    C = len(names)
+    n = frame.nrow
+
+    phi = np.zeros((n, C + 1))  # + BiasTerm
+    bias = 0.0
+    for group in out["trees"]:
+        nodes = _tree_nodes(group[0])
+        root_cover = nodes[0].cover or 1.0
+        # E[tree] under the cover distribution = bias contribution
+        exp_val = _expected_value(nodes, 0)
+        bias += exp_val
+        for r in range(n):
+            row_phi = np.zeros(C + 1)
+            _shap_one_tree(nodes, bins[r], row_phi[:C])
+            phi[r, :C] += row_phi[:C]
+    if model.algo == "gbm":
+        bias += float(np.asarray(out["init_f"]))
+    ntrees = max(out["ntrees_actual"], 1)
+    if model.algo in ("drf", "xrt"):
+        phi[:, :C] /= ntrees
+        bias /= ntrees
+    phi[:, C] = bias
+    return Frame(
+        [Vec.from_numpy(phi[:, j], "real") for j in range(C + 1)],
+        list(names) + ["BiasTerm"],
+    )
+
+
+def _expected_value(nodes: list[_Node], j: int) -> float:
+    nd = nodes[j]
+    if nd.is_leaf:
+        return nd.value
+    lc, rc = nodes[nd.left].cover, nodes[nd.right].cover
+    tot = lc + rc
+    if tot <= 0:
+        return nd.value
+    return (lc * _expected_value(nodes, nd.left) + rc * _expected_value(nodes, nd.right)) / tot
+
+
+def tree_view(model, tree_number: int = 0, tree_class: int = 0) -> dict:
+    """TreeHandler-style node table for one tree: parallel arrays keyed by
+    node id (root 0, breadth-first)."""
+    out = model.output
+    tree = out["trees"][tree_number][tree_class]
+    nodes = _tree_nodes(tree)
+    names = out["names"]
+    spec = out["bin_spec"]
+    rows = {
+        "node_id": [], "left_child": [], "right_child": [], "feature": [],
+        "threshold": [], "na_direction": [], "prediction": [], "cover": [],
+        "is_leaf": [], "levels": [],
+    }
+    for i, nd in enumerate(nodes):
+        # unreachable padding nodes (zero cover, no parent) still appear in
+        # the level arrays; include only nodes reachable from the root
+        rows["node_id"].append(i)
+        rows["left_child"].append(nd.left)
+        rows["right_child"].append(nd.right)
+        rows["is_leaf"].append(nd.is_leaf)
+        rows["prediction"].append(nd.value if nd.is_leaf else None)
+        rows["cover"].append(nd.cover)
+        if nd.is_leaf:
+            rows["feature"].append(None)
+            rows["threshold"].append(None)
+            rows["na_direction"].append(None)
+            rows["levels"].append(None)
+            continue
+        rows["feature"].append(names[nd.feature])
+        rows["na_direction"].append("LEFT" if nd.na_left else "RIGHT")
+        if nd.is_cat:
+            dom = (spec.domains[nd.feature] or ()) if spec.domains else ()
+            left_levels = [
+                dom[b - 1] for b in range(1, len(nd.cat_mask))
+                if nd.cat_mask[b] and b - 1 < len(dom)
+            ]
+            rows["threshold"].append(None)
+            rows["levels"].append(left_levels)
+        else:
+            e = spec.edges[nd.feature]
+            t = nd.thr_bin - 1  # left iff bin <= thr_bin; edge index
+            thr = float(e[t]) if 0 <= t < len(e) and np.isfinite(e[t]) else float("inf")
+            rows["threshold"].append(thr)
+            rows["levels"].append(None)
+    return rows

@@ -264,6 +264,7 @@ def _level_step_fn(
         na_left, leaf_now, leaf_val, child_base,
     )
     record = {
+        "node_w": node_w.astype(jnp.float32),
         "split_col": split_col.astype(jnp.int32),
         "split_bin": split_bin.astype(jnp.int32),
         "is_cat": is_cat_n,
@@ -533,6 +534,7 @@ class TreeLevel:
     leaf_val: jnp.ndarray
     child_base: jnp.ndarray
     gain: jnp.ndarray | None = None  # per-node split gain (varimp source)
+    node_w: jnp.ndarray | None = None  # per-node weighted cover (TreeSHAP)
 
 
 @dataclass
@@ -561,7 +563,7 @@ class Tree:
         """Pull every level to numpy (for export/inspection paths)."""
         out = Tree()
         fields = ("split_col", "split_bin", "is_cat", "cat_mask", "na_left",
-                  "leaf_now", "leaf_val", "child_base", "gain")
+                  "leaf_now", "leaf_val", "child_base", "gain", "node_w")
         pulled = jax.device_get([[getattr(lv, f) for f in fields] for lv in self.levels])
         for vals in pulled:
             out.levels.append(TreeLevel(*[np.asarray(v) for v in vals]))

@@ -271,3 +271,64 @@ def test_automl_with_target_encoding_preprocessing():
     assert len(lb) >= 1
     best = aml.leader
     assert "g_te" in best.output["names"]
+
+
+# ---------------------------------------------------------------------------
+# TreeSHAP + tree inspection
+
+
+def test_shap_local_accuracy_gbm():
+    """Σ contributions + bias == raw margin (the TreeSHAP contract)."""
+    from h2o3_tpu.models import GBM
+
+    df, y = _binary(n=600, seed=21)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=5, max_depth=3, seed=4).train(y="y", training_frame=fr)
+    contrib = m.predict_contributions(fr)
+    mat = np.stack([contrib.vec(i).to_numpy() for i in range(contrib.ncol)], axis=1)
+    total = mat.sum(axis=1)
+    # raw margin = logit of predicted p1
+    p1 = m.predict(fr).vec("Y").to_numpy().astype(np.float64)
+    margin = np.log(p1 / (1 - p1))
+    np.testing.assert_allclose(total, margin, atol=1e-4)
+    assert contrib.names[-1] == "BiasTerm"
+
+
+def test_shap_stump_closed_form():
+    """Depth-1 stump: phi_j = f(x) − E[f] on the split feature, 0 elsewhere."""
+    from h2o3_tpu.models import GBM
+
+    rng = np.random.default_rng(22)
+    n = 1000
+    df = pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n)})
+    df["y"] = np.where(df["a"] > 0, 2.0, -1.0)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=1, max_depth=1, learn_rate=1.0, distribution="gaussian",
+            seed=1).train(y="y", training_frame=fr)
+    contrib = m.predict_contributions(fr)
+    cb = contrib.vec("b").to_numpy()
+    np.testing.assert_allclose(cb, 0.0, atol=1e-6)
+    pred = m.predict(fr).vec("predict").to_numpy().astype(np.float64)
+    ca = contrib.vec("a").to_numpy()
+    bias = contrib.vec("BiasTerm").to_numpy()
+    np.testing.assert_allclose(ca + bias, pred, atol=1e-4)
+    assert np.allclose(bias, bias[0])  # constant bias = E[f]
+
+
+def test_shap_drf_and_tree_view():
+    from h2o3_tpu.models import DRF
+
+    df, y = _binary(n=500, seed=23)
+    fr = Frame.from_pandas(df)
+    m = DRF(ntrees=4, max_depth=4, seed=5).train(y="y", training_frame=fr)
+    contrib = m.predict_contributions(fr)
+    mat = np.stack([contrib.vec(i).to_numpy() for i in range(contrib.ncol)], axis=1)
+    raw = m._replay_all(fr) / m.output["ntrees_actual"]
+    np.testing.assert_allclose(mat.sum(axis=1), raw, atol=1e-4)
+
+    tv = m.tree_view(0)
+    assert tv["node_id"][0] == 0 and not tv["is_leaf"][0]
+    internal = [i for i, lf in enumerate(tv["is_leaf"]) if not lf and tv["cover"][i] > 0]
+    for i in internal:
+        assert tv["feature"][i] in ("a", "b", "c", "d")
+        assert tv["left_child"][i] >= 0 and tv["right_child"][i] >= 0
