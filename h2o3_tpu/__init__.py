@@ -40,6 +40,13 @@ from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
 from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
 
 
+def profiler(logdir: str):
+    """jax.profiler.trace context manager (the /3/Profiler successor)."""
+    from h2o3_tpu.utils.telemetry import profiler as _p
+
+    return _p(logdir)
+
+
 def export_file(frame, path: str, force: bool = False, format: str | None = None) -> str:
     """Frame → CSV/Parquet on disk (h2o.export_file successor)."""
     from h2o3_tpu.persist import export_file as _ef
@@ -98,6 +105,7 @@ __all__ = [
     "connect",
     "save_model",
     "export_file",
+    "profiler",
     "load_model",
     "import_mojo",
 ]

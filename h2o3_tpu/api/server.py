@@ -246,6 +246,23 @@ class Endpoints:
         if algo not in _ALGOS:
             raise ApiError(404, f"unknown algo {algo!r}")
         cls = _builder_cls(algo)
+        kwargs, x, y, train_key, valid_key = self._parse_build_params(cls, params)
+        if train_key is None:
+            raise ApiError(400, "training_frame is required")
+        builder = cls(**kwargs)
+        job = Job(
+            lambda j: builder.train(
+                x=x, y=y, training_frame=train_key, validation_frame=valid_key
+            ),
+            f"{algo} build",
+        )
+        job.start()
+        return {"__meta": {"schema_type": "ModelBuilder"},
+                "job": _job_schema(job), "algo": algo,
+                "messages": [], "error_count": 0}
+
+    def _parse_build_params(self, cls, params):
+        """Shared param parsing for model and grid builds."""
         import dataclasses
 
         valid = {f.name for f in dataclasses.fields(cls.PARAMS_CLS)}
@@ -270,19 +287,103 @@ class Endpoints:
                 continue  # keys are server-assigned
             elif k in valid:
                 kwargs[k] = _coerce_param(cls.PARAMS_CLS, k, v)
+        return kwargs, x, y, train_key, valid_key
+
+    # -- grids (hex.grid.GridSearch REST surface, /99/Grid*) ---------------
+    def grid_build(self, params, algo):
+        if algo not in _ALGOS:
+            raise ApiError(404, f"unknown algo {algo!r}")
+        cls = _builder_cls(algo)
+        hyper = params.get("hyper_parameters")
+        if hyper is None:
+            raise ApiError(400, "hyper_parameters is required")
+        if isinstance(hyper, str):
+            hyper = json.loads(hyper)
+        criteria = params.get("search_criteria")
+        if isinstance(criteria, str):
+            criteria = json.loads(criteria)
+        grid_id = params.get("grid_id")
+        base = {
+            k: v for k, v in params.items()
+            if k not in ("hyper_parameters", "search_criteria", "grid_id")
+        }
+        kwargs, x, y, train_key, valid_key = self._parse_build_params(cls, base)
         if train_key is None:
             raise ApiError(400, "training_frame is required")
-        builder = cls(**kwargs)
+
+        from h2o3_tpu.models.grid import GridSearch
+
+        gs = GridSearch(cls, hyper, search_criteria=criteria, grid_id=grid_id, **kwargs)
         job = Job(
-            lambda j: builder.train(
-                x=x, y=y, training_frame=train_key, validation_frame=valid_key
-            ),
-            f"{algo} build",
+            lambda j: gs._drive(j, x, y, DKV.get(train_key),
+                                DKV.get(valid_key) if valid_key else None, {}),
+            f"grid over {algo}",
         )
+        gs.job = job
         job.start()
-        return {"__meta": {"schema_type": "ModelBuilder"},
-                "job": _job_schema(job), "algo": algo,
-                "messages": [], "error_count": 0}
+        return {"__meta": {"schema_type": "GridSearchV99"},
+                "job": _job_schema(job), "grid_id": {"name": gs.grid.key}}
+
+    def grids_list(self, params):
+        from h2o3_tpu.models.grid import Grid
+
+        gs = list(DKV.values_of_type(Grid))
+        return {"__meta": {"schema_type": "Grids"},
+                "grids": [{"grid_id": {"name": g.key},
+                           "model_count": len(g.models)} for g in gs]}
+
+    def grid_get(self, params, key):
+        from h2o3_tpu.models.grid import Grid
+
+        g = DKV.get(key)
+        if not isinstance(g, Grid):
+            raise ApiError(404, f"Grid {key} not found")
+        tab = g.sorted_metric_table(params.get("sort_by"))
+        # model_ids sorted to MATCH the metric table (H2O's Grid schema
+        # orders them together; [0] must be the leader)
+        ordered = [r["model_id"] for r in tab] or g.model_ids
+        return {"__meta": {"schema_type": "Grids"},
+                "grids": [{
+                    "grid_id": {"name": g.key},
+                    "hyper_names": g.hyper_names,
+                    "model_ids": [{"name": k} for k in ordered],
+                    "summary_table": tab,
+                    "failure_details": [msg for _, msg in g.failures],
+                }]}
+
+    # -- timeline (water.TimeLine /3/Timeline successor) --------------------
+    def timeline(self, params):
+        from h2o3_tpu.utils import telemetry
+
+        return {"__meta": {"schema_type": "TimelineV3"},
+                **telemetry.timeline(int(params.get("n", 200)))}
+
+    # -- logs (water.util.Log REST surface) --------------------------------
+    def logs_get(self, params, node, name):
+        lines = list(Log._ring.buffer)
+        tail = int(params.get("tail", 1000))
+        kept = lines[-tail:] if tail > 0 else []
+        return {"__meta": {"schema_type": "LogsV3"},
+                "log": "\n".join(kept), "name": name, "node": node}
+
+    # -- mojo download (GET /3/Models/{id}/mojo) ----------------------------
+    def model_mojo(self, params, key):
+        import os as _os
+        import tempfile
+
+        m = _get_model(key)
+        import h2o3_tpu.models.export as _exp
+
+        with tempfile.NamedTemporaryFile(suffix=".zip", delete=False) as f:
+            path = f.name
+        try:
+            _exp.export_mojo(m, path)
+            with open(path, "rb") as f:
+                data = f.read()
+        finally:
+            _os.unlink(path)
+        return {"__binary__": data, "content_type": "application/zip",
+                "filename": f"{key}.zip"}
 
     # -- models -----------------------------------------------------------
     def models_list(self, params):
@@ -456,7 +557,13 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/3/Jobs/([^/]+)/cancel", _EP.job_cancel),
     ("GET", r"/3/ModelBuilders", _EP.model_builders),
     ("POST", r"/3/ModelBuilders/([^/]+)", _EP.build_model),
+    ("POST", r"/99/Grid/([^/]+)", _EP.grid_build),
+    ("GET", r"/99/Grids", _EP.grids_list),
+    ("GET", r"/99/Grids/([^/]+)", _EP.grid_get),
+    ("GET", r"/3/Logs/nodes/([^/]+)/files/([^/]+)", _EP.logs_get),
+    ("GET", r"/3/Timeline", _EP.timeline),
     ("GET", r"/3/Models", _EP.models_list),
+    ("GET", r"/3/Models/([^/]+)/mojo", _EP.model_mojo),
     ("GET", r"/3/Models/([^/]+)", _EP.model_get),
     ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
@@ -491,6 +598,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str):
         path = urllib.parse.urlparse(self.path).path
+        if method == "POST" and path.rstrip("/") == "/3/PostFile":
+            # raw-body file upload (h2o.upload_file to a remote coordinator)
+            try:
+                self._post_file()
+            except Exception as e:  # noqa: BLE001 — REST boundary
+                self._reply(500, {"__meta": {"schema_type": "Error"},
+                                  "msg": repr(e), "http_status": 500})
+            return
         for m, pat, handler in _COMPILED:
             if m != method:
                 continue
@@ -500,7 +615,10 @@ class _Handler(BaseHTTPRequestHandler):
                     params = self._params()
                     args = [urllib.parse.unquote(g) for g in match.groups()]
                     out = handler(params, *args)
-                    self._reply(200, out)
+                    if isinstance(out, dict) and "__binary__" in out:
+                        self._reply_binary(out)
+                    else:
+                        self._reply(200, out)
                 except ApiError as e:
                     self._reply(e.status, {"__meta": {"schema_type": "Error"},
                                            "error_url": path, "msg": str(e),
@@ -518,6 +636,40 @@ class _Handler(BaseHTTPRequestHandler):
         data = json.dumps(payload, default=_json_default).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _post_file(self):
+        import tempfile
+
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        suffix = q.get("filename", "upload.csv")
+        suffix = "." + suffix.rsplit(".", 1)[-1] if "." in suffix else ".csv"
+        with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as f:
+            f.write(body)
+            path = f.name
+        from h2o3_tpu.frame.parse import import_file
+
+        fr = import_file(path, destination_frame=q.get("destination_frame"))
+        import os as _os
+
+        _os.unlink(path)
+        self._reply(200, {"__meta": {"schema_type": "PostFile"},
+                          "destination_frame": fr.key,
+                          "total_bytes": length})
+
+    def _reply_binary(self, out: dict):
+        data = out["__binary__"]
+        self.send_response(200)
+        self.send_header("Content-Type", out.get("content_type", "application/octet-stream"))
+        if out.get("filename"):
+            self.send_header(
+                "Content-Disposition", f'attachment; filename="{out["filename"]}"'
+            )
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -555,6 +707,10 @@ class H2OServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # a stopped server must not keep serving as the process singleton
+        global _SERVER
+        if _SERVER is self:
+            _SERVER = None
 
 
 _SERVER: H2OServer | None = None

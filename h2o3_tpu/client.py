@@ -127,6 +127,58 @@ class H2OConnection:
         )
         return out["model_metrics"][0]
 
+    def _raw_post(self, path: str, body: bytes) -> dict:
+        req = urllib.request.Request(
+            self.url + path, data=body,
+            headers={"Content-Type": "application/octet-stream"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def upload_file(self, path: str, destination_frame: str | None = None) -> str:
+        """Raw-body upload to a remote coordinator (POST /3/PostFile)."""
+        import os
+
+        qd = {"filename": os.path.basename(path)}
+        if destination_frame:
+            qd["destination_frame"] = destination_frame
+        q = "?" + urllib.parse.urlencode(qd)
+        with open(path, "rb") as f:
+            body = f.read()
+        out = self._raw_post(f"/3/PostFile{q}", body)
+        return out["destination_frame"]
+
+    def grid(self, algo: str, hyper_parameters: dict, y: str | None = None,
+             training_frame=None, search_criteria: dict | None = None, **params) -> dict:
+        """Run a grid search remotely (POST /99/Grid/{algo}); returns the
+        sorted grid view."""
+        import json as _json
+
+        payload = {**params, "hyper_parameters": _json.dumps(hyper_parameters)}
+        if search_criteria:
+            payload["search_criteria"] = _json.dumps(search_criteria)
+        if y is not None:
+            payload["response_column"] = y
+        if training_frame is not None:
+            payload["training_frame"] = _key_of(training_frame)
+        out = self.post(f"/99/Grid/{algo}", payload)
+        self.wait_job(out["job"]["key"]["name"])
+        return self.get(f"/99/Grids/{out['grid_id']['name']}")["grids"][0]
+
+    def download_mojo(self, model_key: str, path: str) -> str:
+        """GET /3/Models/{id}/mojo → local file."""
+        import urllib.request
+
+        req = urllib.request.Request(f"{self.url}/3/Models/{model_key}/mojo")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            data = r.read()
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def logs(self, tail: int = 200) -> str:
+        return self.get(f"/3/Logs/nodes/0/files/default?tail={tail}")["log"]
+
     def rapids(self, ast: str) -> dict:
         return self.post("/99/Rapids", {"ast": ast})
 

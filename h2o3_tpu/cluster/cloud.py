@@ -59,6 +59,9 @@ def init(
             num_processes=num_processes,
             process_id=process_id,
         )
+    from h2o3_tpu.utils import telemetry
+
+    telemetry.install()
     if mesh is not None:
         _mesh.set_mesh(mesh)
     m = _mesh.get_mesh()

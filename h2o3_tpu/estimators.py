@@ -1,0 +1,151 @@
+"""h2o-py-style estimator classes — successor of ``h2o-py/h2o/estimators/*``
+(generated per-algo classes) [UNVERIFIED upstream paths, SURVEY.md §2.3].
+
+Upstream generates one estimator class per algorithm from the live REST
+schemas (the h2o-bindings codegen); here the same thing falls out of the
+params dataclasses directly: every estimator accepts its PARAMS_CLS fields
+as constructor kwargs, ``train()`` fits and turns the estimator into a
+model proxy (metric getters, predict, varimp, MOJO download all delegate),
+so an ``h2o-py`` script like
+
+    m = H2OGradientBoostingEstimator(ntrees=50, max_depth=5)
+    m.train(x=feats, y="label", training_frame=fr)
+    m.auc(); m.predict(test); m.download_mojo("/tmp")
+
+runs against this framework unmodified (module path aside).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from h2o3_tpu import models as _models
+import h2o3_tpu.models.export  # noqa: F401 — attaches Model.download_mojo
+
+
+class _EstimatorBase:
+    """Builder + trained-model proxy, the h2o-py estimator contract."""
+
+    _BUILDER: str = ""
+
+    def __init__(self, model_id: str | None = None, **kwargs):
+        cls = getattr(_models, self._BUILDER)
+        valid = {f.name for f in dataclasses.fields(cls.PARAMS_CLS)}
+        unknown = set(kwargs) - valid
+        if unknown:
+            raise TypeError(
+                f"{type(self).__name__}: unknown parameters {sorted(unknown)}"
+            )
+        self._kwargs = kwargs
+        self._model_id = model_id
+        self.model = None
+
+    # -- training -----------------------------------------------------------
+    def train(self, x=None, y=None, training_frame=None, validation_frame=None, **kw):
+        cls = getattr(_models, self._BUILDER)
+        builder = cls(**self._kwargs)
+        self.model = builder.train(
+            x=x, y=y, training_frame=training_frame,
+            validation_frame=validation_frame, **kw,
+        )
+        return self
+
+    # -- model proxy ---------------------------------------------------------
+    @property
+    def model_id(self) -> str | None:
+        return self.model.key if self.model is not None else self._model_id
+
+    def _m(self):
+        if self.model is None:
+            raise ValueError("estimator is not trained yet — call train()")
+        return self.model
+
+    def predict(self, test_data):
+        return self._m().predict(test_data)
+
+    def model_performance(self, test_data=None):
+        return self._m().model_performance(test_data)
+
+    def _metric(self, name: str, valid: bool = False, xval: bool = False) -> float:
+        m = self._m()
+        mm = (
+            m.cross_validation_metrics if xval
+            else m.validation_metrics if valid
+            else m.training_metrics
+        )
+        return mm.value(name) if mm is not None else float("nan")
+
+    def auc(self, valid=False, xval=False):
+        return self._metric("auc", valid, xval)
+
+    def logloss(self, valid=False, xval=False):
+        return self._metric("logloss", valid, xval)
+
+    def rmse(self, valid=False, xval=False):
+        return self._metric("rmse", valid, xval)
+
+    def mse(self, valid=False, xval=False):
+        return self._metric("mse", valid, xval)
+
+    def mae(self, valid=False, xval=False):
+        return self._metric("mae", valid, xval)
+
+    def r2(self, valid=False, xval=False):
+        return self._metric("r2", valid, xval)
+
+    def varimp(self, use_pandas: bool = False):
+        vi = self._m().varimp() if hasattr(self._m(), "varimp") else None
+        if use_pandas and vi is not None:
+            import pandas as pd
+
+            return pd.DataFrame(vi)
+        return vi
+
+    def download_mojo(self, path: str = ".") -> str:
+        import os
+
+        p = path
+        if os.path.isdir(p):
+            p = os.path.join(p, f"{self._m().key}.zip")
+        return self._m().download_mojo(p)
+
+    def save_mojo(self, path: str = ".") -> str:
+        return self.download_mojo(path)
+
+    def __getattr__(self, item) -> Any:
+        # anything else (scoring_history, output, cv_models, ...) delegates
+        # to the trained model
+        model = self.__dict__.get("model")
+        if model is not None and hasattr(model, item):
+            return getattr(model, item)
+        raise AttributeError(item)
+
+
+def _make(name: str, builder: str):
+    est = type(name, (_EstimatorBase,), {"_BUILDER": builder, "__doc__":
+        f"h2o-py style estimator for the {builder} builder."})
+    globals()[name] = est
+    return name
+
+
+__all__ = [
+    _make("H2OGradientBoostingEstimator", "GBM"),
+    _make("H2ORandomForestEstimator", "DRF"),
+    _make("H2OXGBoostEstimator", "GBM"),  # hist engine IS the xgboost successor
+    _make("H2OGeneralizedLinearEstimator", "GLM"),
+    _make("H2ODeepLearningEstimator", "DeepLearning"),
+    _make("H2OKMeansEstimator", "KMeans"),
+    _make("H2OPrincipalComponentAnalysisEstimator", "PCA"),
+    _make("H2OSingularValueDecompositionEstimator", "SVD"),
+    _make("H2ONaiveBayesEstimator", "NaiveBayes"),
+    _make("H2OIsolationForestEstimator", "IsolationForest"),
+    _make("H2OExtendedIsolationForestEstimator", "ExtendedIsolationForest"),
+    _make("H2OGeneralizedLowRankEstimator", "GLRM"),
+    _make("H2OCoxProportionalHazardsEstimator", "CoxPH"),
+    _make("H2OIsotonicRegressionEstimator", "IsotonicRegression"),
+    _make("H2OAdaBoostEstimator", "AdaBoost"),
+    _make("H2ODecisionTreeEstimator", "DT"),
+    _make("H2OWord2vecEstimator", "Word2Vec"),
+    _make("H2OStackedEnsembleEstimator", "StackedEnsemble"),
+]

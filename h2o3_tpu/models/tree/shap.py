@@ -167,7 +167,6 @@ def predict_contributions(model, frame: Frame) -> Frame:
     bias = 0.0
     for group in out["trees"]:
         nodes = _tree_nodes(group[0])
-        root_cover = nodes[0].cover or 1.0
         # E[tree] under the cover distribution = bias contribution
         exp_val = _expected_value(nodes, 0)
         bias += exp_val
@@ -207,14 +206,24 @@ def tree_view(model, tree_number: int = 0, tree_class: int = 0) -> dict:
     nodes = _tree_nodes(tree)
     names = out["names"]
     spec = out["bin_spec"]
+    # breadth-first reachability from the root: level arrays are padded to
+    # 2^depth slots and phantom nodes must not appear in the table
+    reachable = set()
+    stack = [0] if nodes else []
+    while stack:
+        i = stack.pop()
+        reachable.add(i)
+        nd = nodes[i]
+        if not nd.is_leaf:
+            stack.extend([nd.left, nd.right])
     rows = {
         "node_id": [], "left_child": [], "right_child": [], "feature": [],
         "threshold": [], "na_direction": [], "prediction": [], "cover": [],
         "is_leaf": [], "levels": [],
     }
     for i, nd in enumerate(nodes):
-        # unreachable padding nodes (zero cover, no parent) still appear in
-        # the level arrays; include only nodes reachable from the root
+        if i not in reachable:
+            continue
         rows["node_id"].append(i)
         rows["left_child"].append(nd.left)
         rows["right_child"].append(nd.right)

@@ -562,8 +562,9 @@ class Tree:
     def to_host(self) -> "Tree":
         """Pull every level to numpy (for export/inspection paths)."""
         out = Tree()
-        fields = ("split_col", "split_bin", "is_cat", "cat_mask", "na_left",
-                  "leaf_now", "leaf_val", "child_base", "gain", "node_w")
+        import dataclasses as _dc
+
+        fields = tuple(f.name for f in _dc.fields(TreeLevel))
         pulled = jax.device_get([[getattr(lv, f) for f in fields] for lv in self.levels])
         for vals in pulled:
             out.levels.append(TreeLevel(*[np.asarray(v) for v in vals]))

@@ -88,10 +88,13 @@ def _pull_tree_output(out: dict) -> dict:
     out = dict(out)
     if "trees" in out:
         # collect every device array across the forest, fetch once
+        import dataclasses as _dc
+
         from h2o3_tpu.models.tree.shared_tree import Tree, TreeLevel
 
-        fields = ("split_col", "split_bin", "is_cat", "cat_mask", "na_left",
-                  "leaf_now", "leaf_val", "child_base", "gain")
+        # derive from the dataclass so new record fields (node_w burned us
+        # once: silently-zero TreeSHAP covers after reload) can't be dropped
+        fields = tuple(f.name for f in _dc.fields(TreeLevel))
         flat = [
             [[getattr(lv, f) for f in fields] for lv in tree.levels]
             for group in out["trees"] for tree in group

@@ -1,0 +1,81 @@
+"""Tracing / profiling — successor of ``water.TimeLine`` / ``/3/Timeline``
+and the ``/3/Profiler`` stack sampler [UNVERIFIED upstream paths, SURVEY.md
+§5.1].
+
+On TPU, XLA compile time IS the dominant hidden cost (AutoML builds many
+small programs), so the timeline's first-class events are compilations:
+``install()`` hooks jax's compile logging into a ring buffer. ``profiler``
+wraps ``jax.profiler.trace`` (xplane dumps viewable in TensorBoard/XProf) —
+the JProfile/stack-sampling analog for a compiled runtime.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+
+_EVENTS: collections.deque = collections.deque(maxlen=4096)
+_LOCK = threading.Lock()
+_INSTALLED = False
+
+
+def record(kind: str, msg: str) -> None:
+    with _LOCK:
+        _EVENTS.append({"ts": time.time(), "kind": kind, "msg": msg})
+
+
+def events(n: int = 200) -> list[dict]:
+    with _LOCK:
+        return list(_EVENTS)[-n:]
+
+
+class _CompileHandler(logging.Handler):
+    def emit(self, rec: logging.LogRecord) -> None:
+        m = rec.getMessage()
+        if "compil" in m.lower():
+            record("compile", m)
+
+
+def install() -> None:
+    """Capture XLA compile events into the timeline (idempotent)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_log_compiles", True)
+    except Exception:
+        return
+    h = _CompileHandler()
+    h.setLevel(logging.DEBUG)
+    for name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
+        lg = logging.getLogger(name)
+        lg.addHandler(h)
+        if lg.level > logging.DEBUG or lg.level == logging.NOTSET:
+            lg.setLevel(logging.DEBUG)
+    _INSTALLED = True
+    record("telemetry", "compile-event capture installed")
+
+
+@contextlib.contextmanager
+def profiler(logdir: str):
+    """``jax.profiler.trace`` wrapper — xplane dumps for TensorBoard/XProf."""
+    import jax
+
+    record("profiler", f"trace started → {logdir}")
+    with jax.profiler.trace(logdir):
+        yield
+    record("profiler", f"trace written → {logdir}")
+
+
+def timeline(n: int = 200) -> dict:
+    """The GET /3/Timeline payload."""
+    evs = events(n)
+    return {
+        "events": evs,
+        "compile_count": sum(1 for e in _EVENTS if e["kind"] == "compile"),
+    }

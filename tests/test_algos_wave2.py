@@ -332,3 +332,23 @@ def test_shap_drf_and_tree_view():
     for i in internal:
         assert tv["feature"][i] in ("a", "b", "c", "d")
         assert tv["left_child"][i] >= 0 and tv["right_child"][i] >= 0
+
+
+def test_shap_survives_save_load(tmp_path):
+    """node_w (TreeSHAP covers) must round-trip binary save/load."""
+    import h2o3_tpu
+    from h2o3_tpu.models import GBM
+
+    df, y = _binary(n=400, seed=30)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=3, max_depth=3, seed=2).train(y="y", training_frame=fr)
+    before = m.predict_contributions(fr)
+    bmat = np.stack([before.vec(i).to_numpy() for i in range(before.ncol)], 1)
+    p = h2o3_tpu.save_model(m, str(tmp_path) + "/")
+    h2o3_tpu.remove(m.key)
+    m2 = h2o3_tpu.load_model(p)
+    after = m2.predict_contributions(fr)
+    amat = np.stack([after.vec(i).to_numpy() for i in range(after.ncol)], 1)
+    np.testing.assert_allclose(bmat, amat, atol=1e-6)
+    tv = m2.tree_view(0)
+    assert all(c > 0 for i, c in enumerate(tv["cover"]) if not tv["is_leaf"][i])

@@ -6,6 +6,7 @@ import pandas as pd
 import pytest
 
 from h2o3_tpu.api.server import start_server
+from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.client import H2OClientError, connect
 
 
@@ -53,3 +54,79 @@ def test_client_error_surface(conn):
     with pytest.raises(H2OClientError) as ei:
         conn.frame("no_such_frame")
     assert ei.value.status == 404
+
+
+def _mkdf(n, c, seed=6):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({f"f{i}": rng.normal(size=n) for i in range(c)})
+    eta = df["f0"] * 2 - df["f1"] + 0.5 * df["f2"]
+    df["y"] = np.where(eta + rng.normal(size=n) > 0, "P", "N")
+    return df
+
+
+def test_estimator_surface_h2o_py_style(tmp_path):
+    """An h2o-py-shaped script runs unmodified (module path aside)."""
+    from h2o3_tpu.estimators import (
+        H2OGeneralizedLinearEstimator,
+        H2OGradientBoostingEstimator,
+    )
+
+    df = _mkdf(2000, 3)
+    fr = Frame.from_pandas(df)
+    m = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=1)
+    m.train(x=[c for c in df.columns if c != "y"], y="y", training_frame=fr)
+    assert m.auc() > 0.8
+    assert m.model_id.startswith("gbm")
+    pred = m.predict(fr)
+    assert "predict" in pred.names
+    p = m.download_mojo(str(tmp_path))
+    assert p.endswith(".zip")
+    vi = m.varimp(use_pandas=True)
+    assert "variable" in vi.columns
+
+    g = H2OGeneralizedLinearEstimator(family="binomial", lambda_=1e-4)
+    g.train(y="y", training_frame=fr)
+    assert 0 < g.logloss() < 1
+
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError, match="unknown parameters"):
+        H2OGradientBoostingEstimator(no_such_param=1)
+
+
+def test_rest_grids_logs_mojo_upload(tmp_path):
+    """The new REST surface: /99/Grid, /3/Models/{id}/mojo, /3/Logs,
+    /3/PostFile — driven through the thin client against a live server."""
+    import h2o3_tpu
+
+    srv = h2o3_tpu.start_server(port=0)
+    try:
+        conn = h2o3_tpu.connect(srv.url)
+
+        df = _mkdf(1200, 3)
+        csv = str(tmp_path / "up.csv")
+        df.to_csv(csv, index=False)
+        key = conn.upload_file(csv, destination_frame="uploaded_fr")
+        assert key == "uploaded_fr"
+        assert conn.frame(key)["rows"] == 1200
+
+        grid = conn.grid(
+            "gbm", {"max_depth": [2, 3]}, y="y", training_frame=key,
+            ntrees=3, seed=1,
+        )
+        assert len(grid["model_ids"]) == 2
+        assert grid["summary_table"][0]["model_id"]
+
+        best = grid["model_ids"][0]["name"]
+        mojo = str(tmp_path / "dl.zip")
+        conn.download_mojo(best, mojo)
+        from h2o3_tpu.genmodel import MojoModel
+
+        mm = MojoModel.load(mojo)
+        out = mm.predict(df.drop(columns=["y"]).head(5))
+        assert len(out["predict"]) == 5
+
+        log = conn.logs(tail=50)
+        assert "gbm" in log or "grid" in log
+    finally:
+        srv.stop()

@@ -1,0 +1,100 @@
+"""Multi-host + observability smoke (SURVEY.md §4 CI strategy row, §5.1/§5.8).
+
+The 2-process jax.distributed test backs the multi-host claim in
+cluster/cloud.py: two OS processes form a cloud through the coordination
+service (the Paxos successor) and run a psum across both processes' devices.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed_psum(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    prog = textwrap.dedent(f"""
+        import os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        pid = int(sys.argv[1])
+        import h2o3_tpu
+        info = h2o3_tpu.init(coordinator="127.0.0.1:{port}", num_processes=2,
+                             process_id=pid)
+        assert info["processes"] == 2, info
+        assert info["cloud_size"] == 4, info  # 2 procs x 2 local cpu devices
+
+        # a psum over the GLOBAL mesh — the MRTask.reduce successor crossing
+        # the process boundary. The global array is assembled from each
+        # process's addressable shards of one logical numpy array.
+        from jax.sharding import NamedSharding
+        mesh = Mesh(np.array(jax.devices()).reshape(4), ("rows",))
+        sharding = NamedSharding(mesh, P("rows"))
+        np_global = np.arange(8.0)
+        x = jax.make_array_from_callback((8,), sharding, lambda idx: np_global[idx])
+        def body(x):
+            return jax.lax.psum(jnp.sum(x), "rows")
+        out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("rows"),
+                                    out_specs=P()))(x)
+        total = float(np.asarray(jax.device_get(out.addressable_shards[0].data)))
+        assert total == 28.0, total  # sum(0..7)
+        print(f"proc {{pid}} OK total={{total}}")
+    """)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", prog, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} OK total=28.0" in out
+
+
+def test_timeline_records_compiles():
+    import jax.numpy as jnp
+    import jax
+
+    import h2o3_tpu
+    from h2o3_tpu.utils import telemetry
+
+    h2o3_tpu.init()
+    # force a fresh compile with a unique shape
+    jax.jit(lambda x: x * 3 + 1)(jnp.ones(173)).block_until_ready()
+    tl = telemetry.timeline()
+    assert tl["compile_count"] >= 1
+    assert any(e["kind"] == "compile" for e in tl["events"])
+
+
+def test_profiler_writes_trace(tmp_path):
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+
+    h2o3_tpu.init()
+    logdir = str(tmp_path / "prof")
+    with h2o3_tpu.profiler(logdir):
+        (jnp.ones(64) * 2).block_until_ready()
+    import glob
+
+    assert glob.glob(logdir + "/**/*.xplane.pb", recursive=True)
