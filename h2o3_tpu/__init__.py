@@ -9,8 +9,9 @@ This is a from-scratch JAX/XLA/Pallas rebuild of the H2O-3 architecture
 - H2O's ``water.MRTask`` map-reduce fabric becomes ``shard_map`` + XLA
   collectives over the ICI mesh (:mod:`h2o3_tpu.parallel`).
 - The algorithm suite (GLM IRLS Gram, GBM/DRF histogram trees, MLP, KMeans,
-  PCA, ...) compiles to XLA; the histogram inner loop is recast as MXU
-  matmuls on TPU and scatter-adds on CPU (:mod:`h2o3_tpu.ops`).
+  PCA, ...) compiles to XLA; the histogram inner loop runs as a Pallas TPU
+  kernel (:mod:`h2o3_tpu.ops.hist_pallas` — VMEM one-hot tiles contracted on
+  the MXU), with scatter-add on CPU meshes.
 - The DKV (``water.DKV``) becomes a host-side object registry
   (:mod:`h2o3_tpu.cluster`), the REST API (``water.api.RequestServer``) a
   stdlib HTTP server (:mod:`h2o3_tpu.api`), and the Python client surface

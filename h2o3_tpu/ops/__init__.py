@@ -1,4 +1,4 @@
 """Device kernels: Gram accumulation, histogram builds (scatter-add on CPU,
-MXU-matmul formulation on TPU), segment reductions. The hot-loop successors
+MXU-matmul + Pallas kernels on TPU), segment reductions. The hot-loop successors
 of ``hex.gram.Gram`` and ``hex.tree.ScoreBuildHistogram`` [UNVERIFIED
 upstream paths]."""
