@@ -69,11 +69,13 @@ def load_model(path: str):
     return _lm(path)
 
 
-def import_mojo(path: str):
-    """Load a portable scoring artifact for offline scoring (genmodel)."""
-    from h2o3_tpu.genmodel import MojoModel
+def import_mojo(path: str, model_id: str | None = None):
+    """Re-import a portable artifact as a LIVE server-side model (the
+    hex.generic successor); it lands in the DKV and predicts like any model.
+    For cluster-free offline scoring use :class:`h2o3_tpu.genmodel.MojoModel`."""
+    from h2o3_tpu.models.generic import import_mojo_model
 
-    return MojoModel.load(path)
+    return import_mojo_model(path, model_id)
 
 
 def start_server(ip: str = "127.0.0.1", port: int = 54321):

@@ -69,7 +69,12 @@ class MojoModel:
         dom = self.domain
         if dom is None:
             return {"predict": raw if raw.ndim == 1 else raw[:, 0]}
-        labels = np.asarray(dom, dtype=object)[raw.argmax(axis=1)]
+        if len(dom) == 2 and self.meta.get("default_threshold") is not None:
+            # H2O labels binary predictions at the max-F1 threshold, not argmax
+            idx = (raw[:, 1] >= float(self.meta["default_threshold"])).astype(int)
+        else:
+            idx = raw.argmax(axis=1)
+        labels = np.asarray(dom, dtype=object)[idx]
         out = {"predict": labels}
         for k, d in enumerate(dom):
             out[str(d)] = raw[:, k]

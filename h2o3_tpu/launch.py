@@ -1,0 +1,58 @@
+"""Multi-host launcher — the ``h2odriver`` / ``h2o-k8s`` successor
+[UNVERIFIED upstream paths, SURVEY.md §2.3].
+
+H2O launches one JVM per Hadoop/k8s node and gossips a cloud; here each
+host runs one process of a ``jax.distributed`` pod and the coordination
+service forms the cloud (cluster/cloud.py). On k8s, point every pod at the
+rank-0 pod's headless-service DNS name:
+
+    python -m h2o3_tpu.launch --coordinator pod-0.svc:1234 \
+        --num-processes 4 --process-id $POD_INDEX --port 54321
+
+Process 0 additionally serves the REST coordinator (any process can, but
+one suffices — clients talk to one coordinator like H2O clients talk to any
+cloud member).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="h2o3_tpu.launch")
+    ap.add_argument("--coordinator", required=True,
+                    help="rank-0 address host:port (the -flatfile successor)")
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--ip", default="0.0.0.0",
+                    help="REST bind address for process 0 (default: all "
+                         "interfaces — other pods must reach it)")
+    ap.add_argument("--port", type=int, default=54321,
+                    help="REST port served by process 0")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    import h2o3_tpu
+
+    info = h2o3_tpu.init(
+        coordinator=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        log_level=args.log_level,
+    )
+    from h2o3_tpu.utils.log import Log
+
+    Log.info(f"process {args.process_id}/{args.num_processes} joined: {info}")
+    if args.process_id == 0:
+        h2o3_tpu.start_server(ip=args.ip, port=args.port)
+    try:
+        while True:  # serve until killed (fail-stop, like an H2O node)
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ _LAZY = {
     "GLRM": ("h2o3_tpu.models.glrm", "GLRM"),
     "CoxPH": ("h2o3_tpu.models.coxph", "CoxPH"),
     "Word2Vec": ("h2o3_tpu.models.word2vec", "Word2Vec"),
+    "GenericModel": ("h2o3_tpu.models.generic", "GenericModel"),
 }
 
 __all__ = ["Model", "ModelBuilder", "DataInfo", *_LAZY]

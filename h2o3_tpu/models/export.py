@@ -121,10 +121,14 @@ def export_mojo(model: Model, path: str) -> str:
     """Write the portable artifact; returns the path."""
     if model.algo not in _EXPORTERS:
         raise ValueError(f"mojo export not supported for {model.algo!r}")
+    thr = None
+    if model.training_metrics is not None:
+        thr = model.training_metrics._v.get("default_threshold")
     meta = {
         "format_version": FORMAT_VERSION,
         "algo": model.algo,
         "model_key": model.key,
+        "default_threshold": thr,
         "response_column": model.params.response_column,
         "response_domain": list(model.output["response_domain"])
         if model.output.get("response_domain") else None,

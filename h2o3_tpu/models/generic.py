@@ -1,0 +1,56 @@
+"""Generic model — successor of ``hex.generic.GenericModel`` [UNVERIFIED
+upstream path, SURVEY.md §2.2]: re-import a portable scoring artifact
+(tmojo zip) as a LIVE server-side model. Scoring-only, like upstream — the
+wrapped numpy scorer handles score0; predict returns the standard H2O
+prediction frame layout and the model participates in the DKV/REST surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.genmodel import MojoModel
+from h2o3_tpu.models.model_base import Model
+
+
+class GenericModelParams:
+    response_column = None
+    weights_column = None
+    offset_column = None
+
+
+class GenericModel(Model):
+    algo = "generic"
+
+    def __init__(self, key: str, mojo: MojoModel):
+        self._mojo = mojo
+        out = {
+            "names": mojo.meta.get("names", []),
+            "response_domain": tuple(mojo.domain) if mojo.domain else None,
+            "source_algo": mojo.algo,
+        }
+        params = GenericModelParams()
+        params.response_column = mojo.meta.get("response_column")
+        super().__init__(key, params, out)
+        thr = mojo.meta.get("default_threshold")
+        if thr is not None:
+            from h2o3_tpu.models.metrics import ModelMetrics
+
+            # carry the original max-F1 threshold so predict labels match
+            self.training_metrics = ModelMetrics(
+                "generic", {"default_threshold": float(thr)}
+            )
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        # to_pandas decodes enum codes to labels, which the offline scorer
+        # maps through its own fitted domains
+        table = self._mojo._rows_to_table(frame.to_pandas())
+        return np.asarray(self._mojo.score_raw(table))
+
+
+def import_mojo_model(path: str, model_id: str | None = None) -> GenericModel:
+    """``h2o.import_mojo`` (server-side Generic) successor."""
+    mojo = MojoModel.load(path)
+    return GenericModel(model_id or DKV.make_key("generic"), mojo)

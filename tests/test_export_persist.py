@@ -152,3 +152,24 @@ def test_binary_save_load_roundtrip(tmp_path, builder, kw):
     assert h2o3_tpu.get_model(m.key) is m2
     after = m2.predict(fr).vec("pos").to_numpy()
     np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+def test_generic_model_reimport_scores_live(tmp_path):
+    """hex.generic successor: a tmojo zip re-imported as a live model
+    predicts identically to the original in-cluster model."""
+    df = _df(seed=14)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=5, max_depth=3, seed=3).train(y="y", training_frame=fr)
+    path = str(tmp_path / "g.zip")
+    m.download_mojo(path)
+
+    g = h2o3_tpu.import_mojo(path, model_id="generic_test")
+    assert h2o3_tpu.get_model("generic_test") is g
+    pa, pb = m.predict(fr), g.predict(fr)
+    np.testing.assert_allclose(
+        pa.vec("pos").to_numpy(), pb.vec("pos").to_numpy(), atol=1e-5
+    )
+    la = pa.vec("predict").to_numpy()
+    lb = pb.vec("predict").to_numpy()
+    assert (la == lb).mean() > 0.999  # labels use the carried F1 threshold
+    assert g.output["source_algo"] == "gbm"
