@@ -1,0 +1,262 @@
+"""Flow UI successor — the notebook-style web console upstream ships as
+``h2o-web``/Flow [UNVERIFIED upstream paths, SURVEY.md §2.3].
+
+One self-contained page (no build step, no external assets — the coordinator
+may be air-gapped) served at ``/`` and ``/flow``: browse frames / models /
+jobs / grids, import + parse files, launch model builds and AutoML, inspect
+metrics and variable importances, score a model on a frame — every action a
+plain ``fetch`` against the public REST routes, so the page doubles as live
+API documentation.
+"""
+
+FLOW_HTML = r"""<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>h2o3-tpu Flow</title>
+<style>
+  :root { --bg:#101418; --panel:#1a2026; --edge:#2c353d; --fg:#dfe7ee;
+          --dim:#8b98a5; --acc:#ffd54a; --good:#7bd88f; --bad:#ff6e6e; }
+  * { box-sizing:border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:14px/1.5 -apple-system, "Segoe UI", Roboto, sans-serif; }
+  header { display:flex; align-items:center; gap:14px; padding:10px 18px;
+           background:var(--panel); border-bottom:1px solid var(--edge); }
+  header h1 { font-size:16px; margin:0; color:var(--acc); }
+  header .cloud { color:var(--dim); font-size:12px; }
+  nav { display:flex; gap:4px; padding:8px 14px 0; }
+  nav button { background:none; border:1px solid var(--edge);
+               border-bottom:none; border-radius:6px 6px 0 0; color:var(--dim);
+               padding:6px 14px; cursor:pointer; font-size:13px; }
+  nav button.on { color:var(--fg); background:var(--panel); }
+  main { padding:14px 18px; }
+  section { display:none; } section.on { display:block; }
+  table { border-collapse:collapse; width:100%; margin:8px 0; }
+  th, td { text-align:left; padding:5px 10px; border-bottom:1px solid var(--edge);
+           font-size:13px; }
+  th { color:var(--dim); font-weight:600; }
+  tr:hover td { background:#20272e; }
+  .panel { background:var(--panel); border:1px solid var(--edge);
+           border-radius:8px; padding:12px 14px; margin-bottom:12px; }
+  input, select, textarea { background:#0d1114; color:var(--fg);
+      border:1px solid var(--edge); border-radius:5px; padding:6px 8px;
+      font-size:13px; }
+  textarea { width:100%; font-family:ui-monospace, monospace; }
+  button.act { background:var(--acc); color:#101418; border:none;
+      border-radius:5px; padding:6px 14px; cursor:pointer; font-weight:600; }
+  .muted { color:var(--dim); } .ok { color:var(--good); } .err { color:var(--bad); }
+  pre { background:#0d1114; border:1px solid var(--edge); border-radius:6px;
+        padding:10px; overflow:auto; font-size:12px; }
+  .row { display:flex; gap:10px; flex-wrap:wrap; align-items:center; }
+  progress { accent-color: var(--acc); }
+</style>
+</head>
+<body>
+<header>
+  <h1>h2o3-tpu Flow</h1>
+  <span class="cloud" id="cloud">connecting…</span>
+</header>
+<nav id="tabs"></nav>
+<main id="main"></main>
+<script>
+const $$ = (h) => { const d = document.createElement('div'); d.innerHTML = h; return d.firstElementChild; };
+const api = async (method, path, body) => {
+  const opt = { method, headers: {} };
+  if (body) { opt.body = JSON.stringify(body); opt.headers['Content-Type'] = 'application/json'; }
+  const r = await fetch(path, opt);
+  const j = await r.json();
+  if (!r.ok) throw new Error(j.msg || r.statusText);
+  return j;
+};
+const fmt = (v) => typeof v === 'number' ? (Number.isInteger(v) ? v : v.toPrecision(5)) : v;
+
+const TABS = ['Frames', 'Models', 'Jobs', 'Build', 'AutoML', 'Rapids'];
+const tabs = document.getElementById('tabs'), main = document.getElementById('main');
+const sections = {};
+for (const t of TABS) {
+  const b = $$(`<button>${t}</button>`);
+  b.onclick = () => show(t);
+  tabs.appendChild(b);
+  sections[t] = $$('<section></section>');
+  main.appendChild(sections[t]);
+}
+function show(t) {
+  [...tabs.children].forEach((b, i) => b.classList.toggle('on', TABS[i] === t));
+  for (const k of TABS) sections[k].classList.toggle('on', k === t);
+  render[t]();
+}
+
+const render = {
+  async Frames() {
+    const s = sections.Frames;
+    s.innerHTML = `<div class="panel"><div class="row">
+        <input id="imp" size="50" placeholder="/path/to/file.csv">
+        <button class="act" onclick="importFile()">Import + parse</button>
+        <span id="impmsg" class="muted"></span></div></div>
+      <div id="frlist" class="muted">loading…</div>`;
+    try {
+      const j = await api('GET', '/3/Frames');
+      const rows = (j.frames || []).map(f =>
+        `<tr><td>${f.frame_id.name || f.frame_id}</td><td>${f.rows}</td>
+         <td>${f.columns ? f.columns.length || f.column_count || '' : ''}</td>
+         <td><button onclick="frameSummary('${f.frame_id.name || f.frame_id}')">summary</button></td></tr>`);
+      s.querySelector('#frlist').innerHTML =
+        `<table><tr><th>key</th><th>rows</th><th>cols</th><th></th></tr>${rows.join('')}</table>
+         <pre id="frdetail" style="display:none"></pre>`;
+    } catch (e) { s.querySelector('#frlist').innerHTML = `<span class="err">${e}</span>`; }
+  },
+  async Models() {
+    const s = sections.Models;
+    s.innerHTML = `<div id="mlist" class="muted">loading…</div>`;
+    try {
+      const j = await api('GET', '/3/Models');
+      const rows = (j.models || []).map(m =>
+        `<tr><td>${m.model_id.name || m.model_id}</td><td>${m.algo}</td>
+         <td><button onclick="modelDetail('${m.model_id.name || m.model_id}')">inspect</button>
+         <a href="/3/Models/${m.model_id.name || m.model_id}/mojo"><button>mojo</button></a></td></tr>`);
+      s.querySelector('#mlist').innerHTML =
+        `<table><tr><th>key</th><th>algo</th><th></th></tr>${rows.join('')}</table>
+         <div class="panel row"><b>Predict:</b>
+           <input id="pm" placeholder="model key"><input id="pf" placeholder="frame key">
+           <button class="act" onclick="predict()">score</button>
+           <span id="pmsg" class="muted"></span></div>
+         <pre id="mdetail" style="display:none"></pre>`;
+    } catch (e) { s.querySelector('#mlist').innerHTML = `<span class="err">${e}</span>`; }
+  },
+  async Jobs() {
+    const s = sections.Jobs;
+    s.innerHTML = `<div id="jlist" class="muted">loading…</div>`;
+    try {
+      const j = await api('GET', '/3/Jobs');
+      const rows = (j.jobs || []).map(jb =>
+        `<tr><td>${jb.key.name || jb.key}</td><td>${jb.description || ''}</td>
+         <td>${jb.status}</td><td><progress value="${jb.progress}" max="1"></progress></td></tr>`);
+      s.querySelector('#jlist').innerHTML =
+        `<table><tr><th>job</th><th>description</th><th>status</th><th>progress</th></tr>${rows.join('')}</table>`;
+    } catch (e) { s.querySelector('#jlist').innerHTML = `<span class="err">${e}</span>`; }
+  },
+  async Build() {
+    const s = sections.Build;
+    if (s.dataset.ready) return;
+    s.dataset.ready = 1;
+    let algos = [];
+    try { algos = Object.keys((await api('GET', '/3/ModelBuilders')).model_builders); } catch (e) {}
+    s.innerHTML = `<div class="panel">
+      <div class="row"><b>Algorithm:</b>
+        <select id="balgo">${algos.map(a => `<option>${a}</option>`).join('')}</select>
+        <b>Training frame:</b> <input id="bframe" placeholder="frame key">
+        <b>Response:</b> <input id="by" size="12" placeholder="y"></div>
+      <p class="muted">Extra parameters (JSON) — exactly what the REST schema takes:</p>
+      <textarea id="bparams" rows="4">{"ntrees": 50}</textarea>
+      <p><button class="act" onclick="buildModel()">Build</button>
+      <span id="bmsg" class="muted"></span></p></div>`;
+  },
+  async AutoML() {
+    const s = sections.AutoML;
+    if (s.dataset.ready) return;
+    s.dataset.ready = 1;
+    s.innerHTML = `<div class="panel">
+      <div class="row"><b>Training frame:</b> <input id="aframe">
+        <b>Response:</b> <input id="ay" size="12">
+        <b>max_models:</b> <input id="amax" size="5" value="8"></div>
+      <p><button class="act" onclick="runAutoML()">Run AutoML</button>
+      <span id="amsg" class="muted"></span></p>
+      <pre id="aboard" style="display:none"></pre></div>`;
+  },
+  async Rapids() {
+    const s = sections.Rapids;
+    if (s.dataset.ready) return;
+    s.dataset.ready = 1;
+    s.innerHTML = `<div class="panel">
+      <p class="muted">Rapids expression (the /99/Rapids wire grammar):</p>
+      <div class="row"><input id="rast" size="70"
+        placeholder='(tmp= new_fr (cols_py frame_key [0 1]))'>
+      <button class="act" onclick="runRapids()">Eval</button></div>
+      <pre id="rout" style="display:none"></pre></div>`;
+  },
+};
+
+window.importFile = async () => {
+  const el = document.getElementById('impmsg');
+  try {
+    el.textContent = 'importing…';
+    const path = document.getElementById('imp').value;
+    const setup = await api('POST', '/3/ParseSetup', { source_frames: [path] });
+    await api('POST', '/3/Parse', setup);
+    el.innerHTML = '<span class="ok">parsed ✓</span>';
+    render.Frames();
+  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+};
+window.frameSummary = async (k) => {
+  const pre = document.getElementById('frdetail');
+  pre.style.display = 'block';
+  pre.textContent = JSON.stringify(await api('GET', `/3/Frames/${k}/summary`), null, 2);
+};
+window.modelDetail = async (k) => {
+  const pre = document.getElementById('mdetail');
+  pre.style.display = 'block';
+  pre.textContent = JSON.stringify(await api('GET', `/3/Models/${k}`), null, 2);
+};
+window.predict = async () => {
+  const el = document.getElementById('pmsg');
+  try {
+    const m = document.getElementById('pm').value, f = document.getElementById('pf').value;
+    const j = await api('POST', `/3/Predictions/models/${m}/frames/${f}`, {});
+    el.innerHTML = `<span class="ok">→ ${j.predictions_frame.name || j.predictions_frame}</span>`;
+  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+};
+window.buildModel = async () => {
+  const el = document.getElementById('bmsg');
+  try {
+    el.textContent = 'building…';
+    const body = JSON.parse(document.getElementById('bparams').value || '{}');
+    body.training_frame = document.getElementById('bframe').value;
+    body.response_column = document.getElementById('by').value;
+    const algo = document.getElementById('balgo').value;
+    const j = await api('POST', `/3/ModelBuilders/${algo}`, body);
+    el.innerHTML = `<span class="ok">job ${j.job.key.name || j.job.key} started</span>`;
+    show('Jobs');
+  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+};
+window.runAutoML = async () => {
+  const el = document.getElementById('amsg');
+  try {
+    el.textContent = 'running…';
+    const j = await api('POST', '/99/AutoMLBuilder', {
+      training_frame: document.getElementById('aframe').value,
+      response_column: document.getElementById('ay').value,
+      max_models: parseInt(document.getElementById('amax').value || '8'),
+    });
+    const id = j.automl_id || (j.job && (j.job.key.name || j.job.key));
+    el.innerHTML = `<span class="ok">started ${id}</span>`;
+    const pre = document.getElementById('aboard');
+    pre.style.display = 'block';
+    const poll = async () => {
+      const a = await api('GET', `/99/AutoML/${id}`);
+      pre.textContent = JSON.stringify(a.leaderboard || a, null, 2);
+      if (!a.done) setTimeout(poll, 3000);
+    };
+    poll();
+  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+};
+window.runRapids = async () => {
+  const pre = document.getElementById('rout');
+  pre.style.display = 'block';
+  try {
+    const j = await api('POST', '/99/Rapids', { ast: document.getElementById('rast').value });
+    pre.textContent = JSON.stringify(j, null, 2);
+  } catch (e) { pre.textContent = String(e); }
+};
+
+(async () => {
+  try {
+    const c = await api('GET', '/3/Cloud');
+    document.getElementById('cloud').textContent =
+      `${c.cloud_name || 'cloud'} — ${c.cloud_size} device(s), healthy=${c.cloud_healthy}`;
+  } catch (e) { document.getElementById('cloud').textContent = 'cloud unreachable'; }
+  show('Frames');
+})();
+</script>
+</body>
+</html>
+"""

@@ -35,7 +35,11 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.utils.log import Log
 
 _ALGOS = ("gbm", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
-          "naivebayes", "isolationforest", "stackedensemble")
+          "naivebayes", "isolationforest", "stackedensemble",
+          "isotonicregression", "decisiontree", "adaboost",
+          "extendedisolationforest", "targetencoder", "glrm", "coxph",
+          "word2vec", "rulefit", "upliftdrf", "gam", "modelselection",
+          "anovaglm", "aggregator", "infogram", "psvm")
 
 
 def _builder_cls(algo: str):
@@ -47,6 +51,14 @@ def _builder_cls(algo: str):
         "svd": M.SVD, "naivebayes": M.NaiveBayes,
         "isolationforest": M.IsolationForest,
         "stackedensemble": M.StackedEnsemble,
+        "isotonicregression": M.IsotonicRegression,
+        "decisiontree": M.DT, "adaboost": M.AdaBoost,
+        "extendedisolationforest": M.ExtendedIsolationForest,
+        "targetencoder": M.TargetEncoder, "glrm": M.GLRM, "coxph": M.CoxPH,
+        "word2vec": M.Word2Vec, "rulefit": M.RuleFit,
+        "upliftdrf": M.UpliftDRF, "gam": M.GAM,
+        "modelselection": M.ModelSelection, "anovaglm": M.ANOVAGLM,
+        "aggregator": M.Aggregator, "infogram": M.Infogram, "psvm": M.PSVM,
     }[algo]
 
 
@@ -119,6 +131,12 @@ def _model_schema(m) -> dict:
 class Endpoints:
     """One method per route; the RequestServer below dispatches here."""
 
+    # -- Flow UI (GET / and /flow) ------------------------------------------
+    def flow_page(self, params):
+        from h2o3_tpu.api.flow import FLOW_HTML
+
+        return {"__binary__": FLOW_HTML.encode(), "content_type": "text/html"}
+
     # -- cloud / misc -----------------------------------------------------
     def cloud(self, params):
         from h2o3_tpu.cluster.cloud import cluster_info
@@ -169,6 +187,12 @@ class Endpoints:
         if isinstance(srcs, str):
             srcs = json.loads(srcs) if srcs.startswith("[") else [srcs]
         dest = params.get("destination_frame")
+        if not dest:
+            # h2o derives the key from the file name (foo.csv -> foo.hex)
+            import os as _os
+
+            base = _os.path.basename(str(srcs[0]))
+            dest = base.rsplit(".", 1)[0] + ".hex"
         setup = {"source_frames": srcs}
         for k in ("separator", "column_types", "column_names"):
             if params.get(k) is not None:
@@ -176,7 +200,7 @@ class Endpoints:
         job = Job(lambda j: parse(setup, destination_frame=dest), f"Parse {srcs[0]}")
         job.start()
         return {"__meta": {"schema_type": "Parse"}, "job": _job_schema(job),
-                "destination_frame": {"name": dest or srcs[0]}}
+                "destination_frame": {"name": dest}}
 
     # -- frames -----------------------------------------------------------
     def frames_list(self, params):
@@ -303,9 +327,12 @@ class Endpoints:
         if isinstance(criteria, str):
             criteria = json.loads(criteria)
         grid_id = params.get("grid_id")
+        par = params.get("parallelism")
+        parallelism = int(par) if par not in (None, "") else 1
         base = {
             k: v for k, v in params.items()
-            if k not in ("hyper_parameters", "search_criteria", "grid_id")
+            if k not in ("hyper_parameters", "search_criteria", "grid_id",
+                         "parallelism")
         }
         kwargs, x, y, train_key, valid_key = self._parse_build_params(cls, base)
         if train_key is None:
@@ -313,7 +340,8 @@ class Endpoints:
 
         from h2o3_tpu.models.grid import GridSearch
 
-        gs = GridSearch(cls, hyper, search_criteria=criteria, grid_id=grid_id, **kwargs)
+        gs = GridSearch(cls, hyper, search_criteria=criteria, grid_id=grid_id,
+                        parallelism=parallelism, **kwargs)
         job = Job(
             lambda j: gs._drive(j, x, y, DKV.get(train_key),
                                 DKV.get(valid_key) if valid_key else None, {}),
@@ -540,6 +568,8 @@ _EP = Endpoints()
 
 # (method, regex) -> endpoint; group captures become positional args
 _ROUTES: list[tuple[str, re.Pattern, object]] = [
+    ("GET", r"", _EP.flow_page),
+    ("GET", r"/flow(?:/index\.html)?", _EP.flow_page),
     ("GET", r"/3/Cloud", _EP.cloud),
     ("GET", r"/3/Ping", _EP.ping),
     ("GET", r"/3/About", _EP.about),

@@ -148,4 +148,13 @@ __all__ = [
     _make("H2ODecisionTreeEstimator", "DT"),
     _make("H2OWord2vecEstimator", "Word2Vec"),
     _make("H2OStackedEnsembleEstimator", "StackedEnsemble"),
+    _make("H2OTargetEncoderEstimator", "TargetEncoder"),
+    _make("H2ORuleFitEstimator", "RuleFit"),
+    _make("H2OUpliftRandomForestEstimator", "UpliftDRF"),
+    _make("H2OGeneralizedAdditiveEstimator", "GAM"),
+    _make("H2OModelSelectionEstimator", "ModelSelection"),
+    _make("H2OANOVAGLMEstimator", "ANOVAGLM"),
+    _make("H2OAggregatorEstimator", "Aggregator"),
+    _make("H2OInfogramEstimator", "Infogram"),
+    _make("H2OSupportVectorMachineEstimator", "PSVM"),
 ]

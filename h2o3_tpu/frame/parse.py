@@ -180,9 +180,146 @@ def parse_setup(path: str, sep: str | None = None) -> dict:
     }
 
 
+_STREAM_THRESHOLD_BYTES = 256 * 1024 * 1024
+_STREAM_CHUNK_ROWS = 1_000_000
+
+
+def _is_csv_like(path: str) -> bool:
+    ext = os.path.splitext(path.removesuffix(".gz"))[1].lower()
+    return ext not in (
+        ".parquet", ".pq", ".orc", ".feather", ".arrow", ".xls", ".xlsx",
+        ".svm", ".svmlight",
+    )
+
+
+def parse_stream(
+    paths: Sequence[str],
+    column_types: Mapping[str, str],
+    sep: str | None = None,
+    destination_frame: str | None = None,
+    chunk_rows: int = _STREAM_CHUNK_ROWS,
+) -> Frame:
+    """Chunked CSV ingest — the distributed-parse successor for files that
+    should not be tokenized in one piece (upstream maps ``parseChunk`` over
+    file blocks and unifies categorical domains in a second pass; here the
+    chunked reader bounds tokenizer memory, categorical levels intern
+    incrementally per chunk, and the cross-chunk code remap at the end is the
+    single-process image of that second pass).
+    """
+    col_order: list[str] | None = None
+    kinds: dict[str, str] = {}
+    num_parts: dict[str, list[np.ndarray]] = {}
+    cat_parts: dict[str, list[np.ndarray]] = {}
+    str_parts: dict[str, list[np.ndarray]] = {}
+    domains: dict[str, dict[str, int]] = {}
+    # column types are fixed by the setup sniff (or the first chunk) — count
+    # values later chunks silently coerce to NA so the drift is at least loud
+    coerce_losses: dict[str, int] = {}
+
+    for path in paths:
+        reader = pd.read_csv(
+            path, sep=sep or _sniff_sep(path), engine="c", chunksize=chunk_rows
+        )
+        for chunk in reader:
+            if col_order is None:
+                col_order = [str(c) for c in chunk.columns]
+                for c in col_order:
+                    k = column_types.get(c) or infer_kind(chunk[c])
+                    if k in ("numeric", "float", "double"):
+                        k = NUM
+                    if k in ("factor", "categorical"):
+                        k = CAT
+                    kinds[c] = k
+            for c in col_order:
+                s = chunk[c]
+                k = kinds[c]
+                if k == CAT:
+                    # C-speed interning: factorize the chunk, then remap the
+                    # (small) chunk-local domain into the global LUT
+                    local_codes, local_levels = pd.factorize(
+                        s.astype(str).where(s.notna(), None)
+                    )
+                    lut = domains.setdefault(c, {})
+                    remap = np.empty(len(local_levels) + 1, np.int32)
+                    for li, lv in enumerate(local_levels):
+                        remap[li] = lut.setdefault(str(lv), len(lut))
+                    remap[-1] = -1  # factorize encodes NA as -1
+                    cat_parts.setdefault(c, []).append(
+                        remap[local_codes.astype(np.int64)]
+                    )
+                elif k == STR:
+                    str_parts.setdefault(c, []).append(
+                        s.astype(object).where(s.notna(), None).to_numpy()
+                    )
+                elif k == TIME:
+                    dt = pd.to_datetime(s, errors="coerce", format="mixed", utc=True)
+                    dt = dt.dt.tz_localize(None)
+                    vals = (
+                        dt.astype("datetime64[ms]").astype("int64").to_numpy()
+                        .astype(np.float64)
+                    )
+                    vals = np.where(dt.isna().to_numpy(), np.nan, vals)
+                    num_parts.setdefault(c, []).append(vals)
+                else:
+                    vals = pd.to_numeric(s, errors="coerce").to_numpy(np.float64)
+                    lost = int((np.isnan(vals) & s.notna().to_numpy()).sum())
+                    if lost:
+                        coerce_losses[c] = coerce_losses.get(c, 0) + lost
+                    num_parts.setdefault(c, []).append(vals)
+
+    assert col_order is not None, "empty parse input"
+    for c, lost in coerce_losses.items():
+        Log.warn(
+            f"stream parse: column {c!r} (typed {kinds[c]} from the sniff) had "
+            f"{lost} non-numeric value(s) in later chunks coerced to NA — "
+            "pass column_types to override the sniffed type"
+        )
+    vecs: list[Vec] = []
+    for c in col_order:
+        k = kinds[c]
+        if k == CAT:
+            codes = np.concatenate(cat_parts[c])
+            # H2O interns levels in sorted order; remap insertion-order codes
+            levels_ins = list(domains[c])
+            order = sorted(range(len(levels_ins)), key=lambda i: levels_ins[i])
+            remap = np.empty(len(levels_ins) + 1, np.int32)
+            for new_i, old_i in enumerate(order):
+                remap[old_i] = new_i
+            remap[-1] = -1  # NA slot
+            codes = remap[codes]  # -1 indexes the NA slot
+            vecs.append(
+                Vec.from_numpy(codes, CAT, name=c,
+                               domain=[levels_ins[i] for i in order])
+            )
+        elif k == STR:
+            vecs.append(Vec(np.concatenate(str_parts[c]), STR, name=c))
+        else:
+            vals = np.concatenate(num_parts[c])
+            vecs.append(Vec.from_numpy(vals, INT if k == INT else NUM, name=c))
+    fr = Frame(vecs, col_order, key=destination_frame, register=True)
+    Log.info(f"Stream-parsed {fr.nrow} rows x {fr.ncol} cols into {fr.key}")
+    return fr
+
+
 def parse(setup: dict, destination_frame: str | None = None) -> Frame:
-    """Materialize a frame from a setup dict — the ``POST /3/Parse`` successor."""
+    """Materialize a frame from a setup dict — the ``POST /3/Parse`` successor.
+
+    Large CSV sources (or ``setup["stream"]=True``) take the chunked
+    streaming path; everything else reads eagerly.
+    """
     paths = setup["source_frames"]
+    want_stream = bool(setup.get("stream"))
+    if not want_stream and all(_is_csv_like(p) for p in paths):
+        try:
+            total = sum(os.path.getsize(p) for p in paths)
+            want_stream = total > _STREAM_THRESHOLD_BYTES
+        except OSError:
+            pass
+    if want_stream and all(_is_csv_like(p) for p in paths):
+        return parse_stream(
+            paths, setup.get("column_types") or {},
+            sep=setup.get("separator"), destination_frame=destination_frame,
+        )
     dfs = [_read_any(p, sep=setup.get("separator")) for p in paths]
     df = pd.concat(dfs, ignore_index=True) if len(dfs) > 1 else dfs[0]
     fr = Frame.from_pandas(

@@ -29,6 +29,14 @@ _LAZY = {
     "CoxPH": ("h2o3_tpu.models.coxph", "CoxPH"),
     "Word2Vec": ("h2o3_tpu.models.word2vec", "Word2Vec"),
     "GenericModel": ("h2o3_tpu.models.generic", "GenericModel"),
+    "RuleFit": ("h2o3_tpu.models.rulefit", "RuleFit"),
+    "UpliftDRF": ("h2o3_tpu.models.uplift", "UpliftDRF"),
+    "GAM": ("h2o3_tpu.models.gam", "GAM"),
+    "ModelSelection": ("h2o3_tpu.models.model_selection", "ModelSelection"),
+    "ANOVAGLM": ("h2o3_tpu.models.anovaglm", "ANOVAGLM"),
+    "Aggregator": ("h2o3_tpu.models.aggregator", "Aggregator"),
+    "Infogram": ("h2o3_tpu.models.infogram", "Infogram"),
+    "PSVM": ("h2o3_tpu.models.psvm", "PSVM"),
 }
 
 __all__ = ["Model", "ModelBuilder", "DataInfo", *_LAZY]

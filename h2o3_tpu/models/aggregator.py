@@ -1,0 +1,238 @@
+"""Aggregator — successor of ``hex.aggregator.Aggregator`` [UNVERIFIED
+upstream path, SURVEY.md §2.2]: reduce a frame to ~``target_num_exemplars``
+representative rows with member counts, preserving data topology better than
+uniform sampling.
+
+Same scheme as upstream (radius-based single-pass agglomeration with radius
+escalation), re-shaped for the device: rows stream in chunks; each chunk's
+distances to the current exemplar set are ONE (chunk, E) matmul-powered
+pairwise-distance program on the MXU; rows farther than the radius from
+every exemplar spawn new exemplars (greedy within the chunk, host-side on
+the small candidate subset). When the exemplar count overshoots
+``target * (1 + rel_tol)``, the radius scales up and the exemplar set is
+re-aggregated against itself (upstream's shrink step).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+
+@dataclass
+class AggregatorParams(CommonParams):
+    target_num_exemplars: int = 5000
+    rel_tol_num_exemplars: float = 0.5
+    transform: str = "NORMALIZE"  # NONE | STANDARDIZE | NORMALIZE
+    categorical_encoding: str = "AUTO"  # one-hot on the distance space
+
+
+@jax.jit
+def _dists_prog(X_chunk, E, e_valid):
+    d = (
+        jnp.sum(X_chunk * X_chunk, axis=1)[:, None]
+        - 2.0 * X_chunk @ E.T
+        + jnp.sum(E * E, axis=1)[None, :]
+    )
+    d = jnp.where(e_valid[None, :], d, jnp.inf)
+    return jnp.min(d, axis=1), jnp.argmin(d, axis=1)
+
+
+def _pow2(v: int) -> int:
+    return 1 << max(int(np.ceil(np.log2(max(v, 1)))), 0)
+
+
+def _chunk_dists(Xc: np.ndarray, E: np.ndarray):
+    """Min distance + argmin exemplar per row, shape-bucketed to powers of
+    two so the jitted program compiles O(log) times, not once per call."""
+    nr, ne = len(Xc), len(E)
+    nrp, nep = _pow2(nr), _pow2(ne)
+    Xp = np.zeros((nrp, Xc.shape[1]), np.float32)
+    Xp[:nr] = Xc
+    Ep = np.zeros((nep, E.shape[1]), np.float32)
+    Ep[:ne] = E
+    valid = np.zeros(nep, bool)
+    valid[:ne] = True
+    dmin, amin = _dists_prog(
+        jnp.asarray(Xp), jnp.asarray(Ep), jnp.asarray(valid)
+    )
+    return np.asarray(dmin)[:nr], np.asarray(amin)[:nr]
+
+
+class AggregatorModel(Model):
+    algo = "aggregator"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        raise NotImplementedError("aggregator is a data-prep model")
+
+    @property
+    def aggregated_frame(self) -> Frame:
+        return self.output["aggregated_frame"]
+
+    def _score_metrics(self, frame: Frame):
+        from h2o3_tpu.models.metrics import ModelMetrics
+
+        return ModelMetrics(
+            "aggregator",
+            {"num_exemplars": float(self.output["num_exemplars"]),
+             "nobs": float(self.output["nobs"])},
+        )
+
+
+class Aggregator(ModelBuilder):
+    algo = "aggregator"
+    PARAMS_CLS = AggregatorParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: AggregatorParams = self.params
+        feats = self._x
+        # numeric design space: transformed numerics + one-hot categoricals
+        cols = []
+        for n in feats:
+            v = train.vec(n)
+            x = v.to_numpy()
+            if v.is_categorical():
+                codes = x.astype(np.int64)
+                k = v.cardinality
+                oh = np.zeros((len(codes), k), np.float32)
+                ok = codes >= 0
+                oh[np.arange(len(codes))[ok], codes[ok]] = 1.0
+                cols.append(oh)
+            else:
+                x = x.astype(np.float64)
+                med = np.nanmean(x)
+                x = np.where(np.isnan(x), med, x)
+                t = p.transform.upper()
+                if t == "STANDARDIZE":
+                    s = np.nanstd(x) or 1.0
+                    x = (x - np.nanmean(x)) / s
+                elif t == "NORMALIZE":
+                    lo, hi = np.nanmin(x), np.nanmax(x)
+                    x = (x - lo) / ((hi - lo) or 1.0)
+                cols.append(x.astype(np.float32)[:, None])
+        X = np.concatenate(cols, axis=1)
+        n, d = X.shape
+
+        target = max(1, p.target_num_exemplars)
+        hi_cap = target * (1.0 + p.rel_tol_num_exemplars)
+        radius = 1e-3 * d  # squared-distance radius, scaled by dimensionality
+
+        exemplars = X[:1].copy()
+        counts = np.ones(1, np.int64)
+        members = np.zeros(n, np.int64)
+        rng = np.random.default_rng(abs(p.seed) or 19)
+        chunk = 8192
+        i = 1
+        while i < n:
+            Xc = X[i : i + chunk]
+            idx_c = np.arange(i, min(i + chunk, n))
+            # rows of this chunk not yet assigned to an exemplar
+            todo = np.arange(len(Xc))
+            while len(todo):
+                dmin, amin = _chunk_dists(Xc[todo], exemplars)
+                within = dmin <= radius
+                hit = todo[within]
+                members[idx_c[hit]] = amin[within]
+                np.add.at(counts, amin[within], 1)
+                todo = todo[~within]
+                if not len(todo):
+                    break
+                budget = int(hi_cap) - len(counts)
+                if budget <= 0:
+                    # over budget: widen the radius and re-merge exemplars
+                    radius *= 2.0
+                    exemplars, counts, members = _reaggregate(
+                        exemplars, counts, members, radius
+                    )
+                    continue
+                # batched spawn: greedy maximin over a sample of the
+                # uncovered rows (host math on a <=128² block), then the
+                # device pass above reassigns the rest against them
+                cand = todo[rng.permutation(len(todo))[: min(128, budget, len(todo))]]
+                picked: list[int] = []
+                for j in cand:
+                    x = Xc[j]
+                    if picked:
+                        d = np.sum((Xc[picked] - x) ** 2, axis=1)
+                        if d.min() <= radius:
+                            continue
+                    picked.append(int(j))
+                new_ex = Xc[picked]
+                base = len(counts)
+                exemplars = np.vstack([exemplars, new_ex])
+                counts = np.concatenate([counts, np.zeros(len(picked), np.int64)])
+                members[idx_c[picked]] = base + np.arange(len(picked))
+                counts[base:] += 1
+                todo = np.setdiff1d(todo, np.asarray(picked, np.int64), assume_unique=False)
+            i += chunk
+            job.update(0.05 + 0.85 * i / n)
+
+        # final budget enforcement
+        while len(counts) > hi_cap:
+            radius *= 2.0
+            exemplars, counts, members = _reaggregate(exemplars, counts, members, radius)
+
+        counts_np = np.asarray(counts, np.int64)
+        agg_cols: dict[str, np.ndarray] = {}
+        # exemplar rows in ORIGINAL column space: take the first member row
+        uniq, first_idx = np.unique(members, return_index=True)
+        first_member = np.zeros(len(counts_np), np.int64)
+        first_member[uniq] = first_idx
+        for name in train.names:
+            v = train.vec(name)
+            raw = v.to_numpy()
+            vals = raw[first_member]
+            if v.is_categorical():
+                dom = v.domain or ()
+                agg_cols[name] = np.asarray(
+                    [dom[int(c)] if c >= 0 else None for c in vals], object
+                )
+            else:
+                agg_cols[name] = vals
+        agg_cols["counts"] = counts_np
+        agg = Frame.from_arrays(agg_cols)
+
+        out = {
+            "aggregated_frame": agg,
+            "num_exemplars": len(counts_np),
+            "nobs": n,
+            "mapping": members,
+            "radius": radius,
+            "names": list(feats),
+        }
+        model = AggregatorModel(DKV.make_key("aggregator"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        return model
+
+
+def _reaggregate(exemplars, counts, members, radius):
+    """Merge exemplars closer than radius (greedy, count-weighted)."""
+    E = len(exemplars)
+    order = np.argsort(-counts)  # biggest exemplars absorb first
+    new_idx = np.full(E, -1, np.int64)
+    kept: list[int] = []
+    for ei in order:
+        x = exemplars[ei]
+        if kept:
+            K = exemplars[kept]
+            d = np.sum((K - x) ** 2, axis=1)
+            h = np.argmin(d)
+            if d[h] <= radius:
+                new_idx[ei] = h
+                continue
+        new_idx[ei] = len(kept)
+        kept.append(ei)
+    new_ex = exemplars[kept]
+    new_counts = np.zeros(len(kept), np.int64)
+    for ei in range(E):
+        new_counts[new_idx[ei]] += counts[ei]
+    new_members = new_idx[members]
+    return new_ex, new_counts, new_members

@@ -1,0 +1,262 @@
+"""ANOVA GLM — successor of ``hex.anovaglm.ANOVAGLM`` [UNVERIFIED upstream
+path, SURVEY.md §2.2]: type-III ANOVA decomposition of a GLM.
+
+For predictors {A, B, ...} the builder forms main-effect and interaction
+terms up to ``highest_interaction_term`` (effect/sum-to-zero coding for
+categoricals, standardized numerics — the coding that makes type-III SS
+well-defined), fits the full GLM, then refits with each term deleted.
+
+TPU design (gaussian): ONE device pass accumulates the full weighted Gram
+over the expanded design; the full and every term-deleted model are then
+sub-Gram Cholesky solves host-side in float64 — no per-term device work
+(same sweep-operator economics as models/model_selection.py). Binomial
+refits per term via IRLS on the shared Gram pass.
+
+Reported per term: df, SS (or deviance delta), MS, F (or chi2), p-value.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+from h2o3_tpu.ops.gram import solve_cholesky, weighted_gram
+from h2o3_tpu.parallel.mesh import row_sharding
+
+
+@dataclass
+class ANOVAGLMParams(CommonParams):
+    family: str = "AUTO"
+    highest_interaction_term: int = 0  # 0 -> number of predictors
+    lambda_: float = 0.0
+    standardize: bool = True
+
+
+def _effect_code(codes: np.ndarray, k: int) -> np.ndarray:
+    """Sum-to-zero coding: k levels -> k-1 columns; last level = -1 row."""
+    n = len(codes)
+    out = np.zeros((n, max(k - 1, 1)), np.float32)
+    if k <= 1:
+        return out
+    for j in range(k - 1):
+        out[:, j] = (codes == j).astype(np.float32)
+    out[codes == k - 1, :] = -1.0
+    out[codes < 0, :] = 0.0  # NA rows contribute nothing
+    return out
+
+
+class ANOVAGLMModel(Model):
+    algo = "anovaglm"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X = _design(frame, self.output["term_plan"])[: frame.nrow]
+        eta = X @ self.output["beta_full"]
+        if self.output["family"] == "binomial":
+            mu = 1.0 / (1.0 + np.exp(-eta))
+            return np.stack([1 - mu, mu], axis=1)
+        return eta
+
+    def anova_table(self) -> list[dict]:
+        return self.output["anova_table"]
+
+    def _distribution_for_metrics(self) -> str:
+        return "gaussian"
+
+
+def _design(frame: Frame, plan: dict) -> np.ndarray:
+    """Build the effect-coded design matrix (host f64) from a fitted plan."""
+    base: dict[str, np.ndarray] = {}
+    for name, info in plan["bases"].items():
+        v = frame.vec(name)
+        if info["kind"] == "cat":
+            codes = np.full(frame.nrow, -1, np.int64)
+            raw = v.to_numpy()
+            dom_map = {d: i for i, d in enumerate(info["domain"])}
+            vdom = v.domain or ()
+            for i, c in enumerate(raw.astype(np.int64)):
+                if 0 <= c < len(vdom):
+                    codes[i] = dom_map.get(vdom[c], -1)
+            base[name] = _effect_code(codes, len(info["domain"]))
+        else:
+            x = v.to_numpy().astype(np.float64)
+            x = np.where(np.isnan(x), info["mean"], x)
+            base[name] = ((x - info["mean"]) / info["sigma"])[:, None]
+    cols = []
+    for term in plan["terms"]:
+        mats = [base[n] for n in term]
+        M = mats[0]
+        for m2 in mats[1:]:
+            M = (M[:, :, None] * m2[:, None, :]).reshape(len(M), -1)
+        cols.append(M)
+    cols.append(np.ones((frame.nrow, 1)))  # intercept last
+    return np.concatenate(cols, axis=1)
+
+
+class ANOVAGLM(ModelBuilder):
+    algo = "anovaglm"
+    PARAMS_CLS = ANOVAGLMParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        from scipy import stats as sps
+
+        p: ANOVAGLMParams = self.params
+        yv = train.vec(p.response_column)
+        family = p.family.lower()
+        if family == "auto":
+            family = "binomial" if yv.is_categorical() else "gaussian"
+        if family not in ("gaussian", "binomial"):
+            raise ValueError("anovaglm supports gaussian and binomial")
+
+        preds = list(self._x)
+        order = p.highest_interaction_term or len(preds)
+        order = min(order, len(preds))
+        terms: list[tuple[str, ...]] = []
+        for r in range(1, order + 1):
+            terms.extend(itertools.combinations(preds, r))
+
+        bases: dict[str, dict] = {}
+        for n in preds:
+            v = train.vec(n)
+            if v.is_categorical():
+                bases[n] = {"kind": "cat", "domain": list(v.domain or ())}
+            else:
+                x = v.to_numpy().astype(np.float64)
+                mean = float(np.nanmean(x))
+                sigma = float(np.nanstd(x)) or 1.0
+                if not p.standardize:
+                    mean, sigma = 0.0, 1.0
+                bases[n] = {"kind": "num", "mean": mean, "sigma": sigma}
+        plan = {"bases": bases, "terms": terms}
+
+        Xh = _design(train, plan)  # (n, P) host f64
+        nrow, P = Xh.shape
+        # term -> column block
+        blocks: list[tuple[tuple[str, ...], list[int]]] = []
+        off = 0
+        for term in terms:
+            w_ = 1
+            for n in term:
+                info = bases[n]
+                w_ *= (len(info["domain"]) - 1) if info["kind"] == "cat" else 1
+                w_ = max(w_, 1)
+            blocks.append((term, list(range(off, off + w_))))
+            off += w_
+        icpt = P - 1
+
+        y_np = yv.to_numpy().astype(np.float64)
+        if yv.is_categorical():
+            y_np[y_np < 0] = np.nan
+        w_np = np.ones(nrow, np.float64)
+        if p.weights_column:
+            w_np *= np.nan_to_num(train.vec(p.weights_column).to_numpy())
+        w_np *= ~np.isnan(y_np)
+        y_clean = np.nan_to_num(y_np, nan=0.0)
+
+        # pad + ship to device once; the Gram is the only heavy compute
+        npad = train.npad
+        Xp = np.zeros((npad, P), np.float32)
+        Xp[:nrow] = Xh
+        wp = np.zeros(npad, np.float32)
+        wp[:nrow] = w_np
+        yp = np.zeros(npad, np.float32)
+        yp[:nrow] = y_clean
+        import jax
+
+        Xd = jax.device_put(jnp.asarray(Xp), row_sharding())
+
+        if family == "gaussian":
+            G_d, b_d, sw_d = weighted_gram(Xd, jnp.asarray(wp), jnp.asarray(yp))
+            G = np.asarray(G_d, np.float64)
+            b = np.asarray(b_d, np.float64)
+            sw = float(np.asarray(sw_d))
+            yty = float(np.sum(w_np * y_clean * y_clean))
+
+            def rss_of(cols: list[int]) -> tuple[float, np.ndarray]:
+                Gs = G[np.ix_(cols, cols)]
+                bs = b[cols]
+                beta = solve_cholesky(Gs, bs, ridge=p.lambda_)
+                return max(yty - beta @ bs, 0.0), beta
+
+            full_cols = list(range(P))
+            rss_full, beta_f = rss_of(full_cols)
+            df_resid = max(sw - P, 1.0)
+            mse = rss_full / df_resid
+            table = []
+            for term, cols in blocks:
+                keep = [c for c in full_cols if c not in cols]
+                rss_red, _ = rss_of(keep)
+                ss = max(rss_red - rss_full, 0.0)
+                df = len(cols)
+                F = (ss / df) / max(mse, 1e-300)
+                pv = float(sps.f.sf(F, df, df_resid))
+                table.append(
+                    {"term": ":".join(term), "df": df, "ss": ss,
+                     "ms": ss / df, "f": F, "p_value": pv}
+                )
+            table.append(
+                {"term": "Residual", "df": int(df_resid), "ss": rss_full,
+                 "ms": mse, "f": float("nan"), "p_value": float("nan")}
+            )
+            beta_full = beta_f
+        else:
+            # binomial: IRLS on the shipped design; deviance tests per term
+            def fit_cols(cols: list[int]):
+                beta = np.zeros(len(cols), np.float64)
+                Xc = Xh[:, cols]
+                for _ in range(25):
+                    eta = Xc @ beta
+                    mu = 1.0 / (1.0 + np.exp(-eta))
+                    mu = np.clip(mu, 1e-10, 1 - 1e-10)
+                    W = w_np * mu * (1 - mu)
+                    z = eta + (y_clean - mu) / (mu * (1 - mu))
+                    G = (Xc * W[:, None]).T @ Xc
+                    bb = (Xc * W[:, None]).T @ z
+                    new = solve_cholesky(G, bb, ridge=p.lambda_ + 1e-10)
+                    if np.max(np.abs(new - beta)) < 1e-8:
+                        beta = new
+                        break
+                    beta = new
+                eta = Xc @ beta
+                mu = np.clip(1.0 / (1.0 + np.exp(-eta)), 1e-12, 1 - 1e-12)
+                dev = -2.0 * float(
+                    np.sum(w_np * (y_clean * np.log(mu) + (1 - y_clean) * np.log(1 - mu)))
+                )
+                return dev, beta
+
+            full_cols = list(range(P))
+            dev_full, beta_f = fit_cols(full_cols)
+            table = []
+            for term, cols in blocks:
+                keep = [c for c in full_cols if c not in cols]
+                dev_red, _ = fit_cols(keep)
+                delta = max(dev_red - dev_full, 0.0)
+                df = len(cols)
+                pv = float(sps.chi2.sf(delta, df))
+                table.append(
+                    {"term": ":".join(term), "df": df, "ss": delta,
+                     "ms": delta / df, "f": delta, "p_value": pv}
+                )
+            beta_full = np.zeros(P, np.float64)
+            beta_full[full_cols] = beta_f
+
+        job.update(0.95)
+        out = {
+            "term_plan": plan,
+            "anova_table": table,
+            "beta_full": beta_full,
+            "family": family,
+            "names": preds,
+            "response_domain": tuple(yv.domain) if yv.is_categorical() else None,
+        }
+        model = ANOVAGLMModel(DKV.make_key("anovaglm"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

@@ -1,0 +1,296 @@
+"""GAM — successor of ``hex.gam.GAM`` / ``GamSplines`` [UNVERIFIED upstream
+paths, SURVEY.md §2.2]: generalized additive models with cubic regression
+splines.
+
+Per ``gam_column``: quantile knots, Wood-style cardinal natural cubic spline
+basis (function values at knots are the coefficients; the curvature penalty
+is S = DᵀB⁻¹D), sum-to-zero centering via the Z null-space transform for
+identifiability — the same construction H2O inherits from mgcv.
+
+TPU design: basis expansion happens host-side once (it is O(n·k) float math,
+k ~ 10), the expanded design [linear | splines | intercept] ships to the
+device row-sharded, and each IRLS step is ONE fused Gram pass on the MXU
+(ops/gram.weighted_gram). The penalized solve (G + λ·blockdiag(S̃)) happens
+host-side in float64, mirroring the GLM split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.glm_families import get_family
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+from h2o3_tpu.ops.gram import solve_cholesky, weighted_gram
+from h2o3_tpu.parallel.mesh import row_sharding
+
+
+@dataclass
+class GAMParams(CommonParams):
+    family: str = "AUTO"
+    gam_columns: list = field(default_factory=list)
+    num_knots: list = field(default_factory=list)  # per gam col; default 10
+    scale: list = field(default_factory=list)  # smoothing lambda per gam col
+    bs: list = field(default_factory=list)  # basis type per col; 0 = cr (only)
+    lambda_: float = 0.0  # ridge on the parametric part
+    standardize: bool = True
+    intercept: bool = True
+    max_iterations: int = 50
+    beta_epsilon: float = 1e-6
+    keep_gam_cols: bool = False
+
+
+def _cr_penalty(knots: np.ndarray):
+    """Return (F, S): second-derivative map (k,k) and penalty DᵀB⁻¹D (k,k)."""
+    k = len(knots)
+    h = np.diff(knots)
+    D = np.zeros((k - 2, k))
+    B = np.zeros((k - 2, k - 2))
+    for i in range(k - 2):
+        D[i, i] = 1.0 / h[i]
+        D[i, i + 1] = -1.0 / h[i] - 1.0 / h[i + 1]
+        D[i, i + 2] = 1.0 / h[i + 1]
+        B[i, i] = (h[i] + h[i + 1]) / 3.0
+        if i + 1 < k - 2:
+            B[i, i + 1] = B[i + 1, i] = h[i + 1] / 6.0
+    Binv = np.linalg.inv(B)
+    F = np.zeros((k, k))
+    F[1:-1] = Binv @ D  # natural spline: zero curvature at the boundary knots
+    S = D.T @ Binv @ D
+    return F, S
+
+
+def _cr_basis(x: np.ndarray, knots: np.ndarray, F: np.ndarray) -> np.ndarray:
+    """Evaluate the cardinal CR basis at x -> (n, k). Clamped at the range."""
+    k = len(knots)
+    xc = np.clip(x, knots[0], knots[-1])
+    j = np.clip(np.searchsorted(knots, xc, side="right") - 1, 0, k - 2)
+    h = knots[j + 1] - knots[j]
+    am = (knots[j + 1] - xc) / h
+    ap = (xc - knots[j]) / h
+    cm = ((knots[j + 1] - xc) ** 3 / h - h * (knots[j + 1] - xc)) / 6.0
+    cp = ((xc - knots[j]) ** 3 / h - h * (xc - knots[j])) / 6.0
+    n = len(x)
+    X = np.zeros((n, k))
+    rows = np.arange(n)
+    X[rows, j] += am
+    X[rows, j + 1] += ap
+    X += cm[:, None] * F[j] + cp[:, None] * F[j + 1]
+    return X
+
+
+def _center_transform(X: np.ndarray):
+    """Z with columns spanning {v : 1ᵀXv = 0} — mgcv's centering constraint."""
+    c = X.sum(axis=0, keepdims=True)  # (1, k)
+    # householder-style: QR of cᵀ, Z = last k-1 columns of Q
+    q, _ = np.linalg.qr(c.T, mode="complete")
+    return q[:, 1:]  # (k, k-1)
+
+
+class GAMModel(Model):
+    algo = "gam"
+
+    def _expand(self, frame: Frame) -> np.ndarray:
+        o = self.output
+        cols = []
+        for n in o["linear_names"]:
+            x = frame.vec(n).to_numpy().astype(np.float64)
+            info = o["linear_info"][n]
+            x = np.where(np.isnan(x), info["mean"], x)
+            cols.append(((x - info["mean"]) / info["sigma"])[:, None])
+        for g in o["gam_terms"]:
+            x = frame.vec(g["name"]).to_numpy().astype(np.float64)
+            x = np.where(np.isnan(x), g["impute"], x)
+            Xb = _cr_basis(x, g["knots"], g["F"]) @ g["Z"]
+            cols.append(Xb)
+        cols.append(np.ones((frame.nrow, 1)))
+        return np.concatenate(cols, axis=1)
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X = self._expand(frame)
+        eta = X @ self.output["beta"]
+        fam = self.output["family_obj"]
+        mu = np.asarray(fam.link.inv(jnp.asarray(eta)))
+        if self.is_classifier:
+            return np.stack([1 - mu, mu], axis=1)
+        return mu
+
+    @property
+    def coef(self) -> dict:
+        return dict(zip(self.output["coef_names"], self.output["beta"]))
+
+    def _distribution_for_metrics(self) -> str:
+        fam = self.output["family"]
+        return {"poisson": "poisson", "gamma": "gamma"}.get(fam, "gaussian")
+
+
+class GAM(ModelBuilder):
+    algo = "gam"
+    PARAMS_CLS = GAMParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: GAMParams = self.params
+        if not p.gam_columns:
+            raise ValueError("gam requires gam_columns")
+        yv = train.vec(p.response_column)
+        family = p.family.lower()
+        if family == "auto":
+            family = "binomial" if yv.is_categorical() else "gaussian"
+        fam = get_family(family)
+
+        gam_cols = [
+            c[0] if isinstance(c, (list, tuple)) else c for c in p.gam_columns
+        ]
+        linear_names = [
+            n for n in self._x
+            if n not in gam_cols and train.vec(n).is_numeric()
+        ]
+
+        # linear (parametric) part, standardized
+        linear_info: dict[str, dict] = {}
+        cols = []
+        for n in linear_names:
+            x = train.vec(n).to_numpy().astype(np.float64)
+            mean = float(np.nanmean(x)) if p.standardize else 0.0
+            sigma = (float(np.nanstd(x)) or 1.0) if p.standardize else 1.0
+            linear_info[n] = {"mean": mean, "sigma": sigma}
+            x = np.where(np.isnan(x), mean if p.standardize else 0.0, x)
+            cols.append(((x - mean) / sigma)[:, None])
+
+        # spline blocks
+        gam_terms: list[dict] = []
+        blocks: list[tuple[int, int]] = []  # (offset, width) of each spline
+        off = sum(c.shape[1] for c in cols)
+        penalties: list[tuple[np.ndarray, float]] = []
+        for gi, name in enumerate(gam_cols):
+            v = train.vec(name)
+            if not v.is_numeric():
+                raise ValueError(f"gam column {name!r} must be numeric")
+            x = v.to_numpy().astype(np.float64)
+            impute = float(np.nanmean(x))
+            x = np.where(np.isnan(x), impute, x)
+            nk = int(p.num_knots[gi]) if gi < len(p.num_knots) else 10
+            nk = max(3, nk)
+            qs = np.linspace(0, 1, nk)
+            knots = np.unique(np.quantile(x, qs))
+            if len(knots) < 3:
+                raise ValueError(f"gam column {name!r} has too few distinct values")
+            F, S = _cr_penalty(knots)
+            Xb = _cr_basis(x, knots, F)
+            Z = _center_transform(Xb)
+            Xc = Xb @ Z
+            Sc = Z.T @ S @ Z
+            lam = float(p.scale[gi]) if gi < len(p.scale) else 1.0
+            gam_terms.append(
+                {"name": name, "knots": knots, "F": F, "Z": Z, "impute": impute,
+                 "scale": lam}
+            )
+            blocks.append((off, Xc.shape[1]))
+            penalties.append((Sc, lam))
+            cols.append(Xc)
+            off += Xc.shape[1]
+        cols.append(np.ones((train.nrow, 1)))
+        Xh = np.concatenate(cols, axis=1)
+        nrow, P = Xh.shape
+
+        # penalty matrix over the full design
+        Pen = np.zeros((P, P))
+        for (o_, w_), (Sc, lam) in zip(blocks, penalties):
+            Pen[o_ : o_ + w_, o_ : o_ + w_] = lam * Sc
+        if p.lambda_:
+            for i in range(P - 1):  # ridge on everything but the intercept
+                Pen[i, i] += p.lambda_
+
+        y_np = yv.to_numpy().astype(np.float64)
+        if yv.is_categorical():
+            y_np[y_np < 0] = np.nan
+        w_np = np.ones(nrow, np.float64)
+        if p.weights_column:
+            w_np *= np.nan_to_num(train.vec(p.weights_column).to_numpy())
+        w_np *= ~np.isnan(y_np)
+        y_clean = np.nan_to_num(y_np, nan=0.0)
+
+        npad = train.npad
+        Xp = np.zeros((npad, P), np.float32)
+        Xp[:nrow] = Xh
+        Xd = jax.device_put(jnp.asarray(Xp), row_sharding())
+        wp = np.zeros(npad, np.float32)
+        wp[:nrow] = w_np
+        yp = np.zeros(npad, np.float32)
+        yp[:nrow] = y_clean
+        wd, yd = jnp.asarray(wp), jnp.asarray(yp)
+
+        # penalized IRLS: device Gram pass + host f64 penalized solve
+        beta = np.zeros(P, np.float64)
+        if p.intercept:
+            mu0 = float(np.sum(w_np * y_clean) / max(np.sum(w_np), 1e-10))
+            if family == "binomial":
+                mu0 = min(max(mu0, 1e-4), 1 - 1e-4)
+            beta[-1] = float(np.asarray(fam.link.fwd(jnp.asarray(mu0))))
+
+        max_iter = p.max_iterations if p.max_iterations > 0 else 50
+        dev = np.inf
+        for it in range(max_iter):
+            G_d, b_d, dev_d = _gam_irls_pass(
+                Xd, yd, wd, jnp.asarray(beta, jnp.float32), family
+            )
+            G = np.asarray(G_d, np.float64)
+            b = np.asarray(b_d, np.float64)
+            new = solve_cholesky(G + Pen, b)
+            delta = np.max(np.abs(new - beta))
+            beta = new
+            dev = float(dev_d)
+            job.update(0.1 + 0.8 * (it + 1) / max_iter)
+            if delta < p.beta_epsilon:
+                break
+
+        coef_names = (
+            list(linear_names)
+            + [
+                f"{g['name']}_cr_{i}"
+                for g, (o_, w_) in zip(gam_terms, blocks)
+                for i in range(w_)
+            ]
+            + ["Intercept"]
+        )
+        out = {
+            "beta": beta,
+            "coef_names": coef_names,
+            "linear_names": linear_names,
+            "linear_info": linear_info,
+            "gam_terms": gam_terms,
+            "family": family,
+            "family_obj": fam,
+            "deviance": dev,
+            "names": list(self._x),
+            "response_domain": tuple(yv.domain) if yv.is_categorical() else None,
+        }
+        model = GAMModel(DKV.make_key("gam"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("family_key",))
+def _gam_irls_pass(X, y, w, beta, family_key):
+    fam = get_family(family_key)
+    eta = jnp.einsum("np,p->n", X, beta, precision=jax.lax.Precision.HIGHEST)
+    mu = fam.link.inv(eta)
+    d = fam.link.dinv(eta)
+    d = jnp.where(d == 0, 1e-10, jnp.sign(d) * jnp.maximum(jnp.abs(d), 1e-10))
+    var = fam.variance(mu)
+    z = eta + (y - mu) / d
+    W = w * d * d / var
+    G, b, sw = weighted_gram(X, W, z)
+    dev = fam.deviance(y, mu, w)
+    return G, b, dev

@@ -137,6 +137,7 @@ class GridSearch:
         hyper_params: dict[str, Sequence],
         search_criteria: dict | SearchCriteria | None = None,
         grid_id: str | None = None,
+        parallelism: int = 1,
         **base_params,
     ):
         if isinstance(search_criteria, dict):
@@ -145,6 +146,7 @@ class GridSearch:
         self.builder_cls = builder_cls
         self.hyper_params = dict(hyper_params)
         self.base_params = base_params
+        self.parallelism = max(1, int(parallelism))
         self.grid = Grid(
             grid_id or DKV.make_key("grid"), builder_cls, list(hyper_params)
         )
@@ -159,6 +161,8 @@ class GridSearch:
         return self.grid
 
     def _drive(self, job: Job, x, y, training_frame, validation_frame, kw) -> Grid:
+        if self.parallelism > 1:
+            return self._drive_parallel(job, x, y, training_frame, validation_frame, kw)
         c = self.criteria
         t0 = time.time()
         n_planned = _space_size(self.hyper_params)
@@ -231,6 +235,112 @@ class GridSearch:
                 self.grid.failures.append((dict(hv), repr(e)))
                 Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
             job.update(min(1.0, (i + 1) / max(1, n_planned)))
+        return self.grid
+
+    # -- parallel walker (H2O GridSearch `parallelism` > 1) ------------------
+    def _drive_parallel(self, job: Job, x, y, training_frame, validation_frame, kw) -> Grid:
+        """Build up to ``parallelism`` combos concurrently.
+
+        Threads overlap the host-side parts of different builds (Gram solves,
+        pandas transforms, metric math) while XLA serializes their device
+        programs — the useful concurrency on a single shared chip, and the
+        direct analog of H2O's parallel model builds on one cluster.
+        Manifest writes and the stopping keeper are lock-protected; results
+        land in completion order (like upstream's parallel walker).
+        """
+        import threading
+        from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+        c = self.criteria
+        t0 = time.time()
+        n_planned = _space_size(self.hyper_params)
+        if c.max_models:
+            n_planned = min(n_planned, c.max_models)
+        ckdir = self.base_params.get("export_checkpoints_dir")
+        done: dict[str, str] = {}
+        fingerprint = None
+        if ckdir:
+            fingerprint = _grid_fingerprint(self.base_params, x, y, training_frame)
+            done = _read_manifest(ckdir, self.grid.key, fingerprint)
+        lock = threading.Lock()
+        stop_flag = threading.Event()
+        keeper_box: list = [None, None]  # keeper, metric_name
+
+        def record_model(m: Model, hv: dict, hv_key: str) -> None:
+            with lock:
+                self.grid.models.append(m)
+                self.grid.hyper_values.append({k: _canon(v) for k, v in hv.items()})
+                if ckdir:
+                    done[hv_key] = m.key
+                    _write_manifest(ckdir, self.grid, done, fingerprint)
+                if c.stopping_rounds:
+                    if keeper_box[0] is None:
+                        name, larger = stopping_metric_direction(
+                            c.stopping_metric, m.is_classifier, m.nclasses
+                        )
+                        keeper_box[0] = ScoreKeeper(
+                            c.stopping_rounds, c.stopping_tolerance, larger
+                        )
+                        keeper_box[1] = name
+                    mm = (m.cross_validation_metrics or m.validation_metrics
+                          or m.training_metrics)
+                    keeper_box[0].record(mm.value(keeper_box[1]))
+                    if keeper_box[0].should_stop():
+                        stop_flag.set()
+                job.update(min(1.0, len(self.grid.models) / max(1, n_planned)))
+
+        def build_one(hv: dict, hv_key: str) -> None:
+            try:
+                builder = self.builder_cls(**{**self.base_params, **hv})
+                m = builder.train(
+                    x=x, y=y, training_frame=training_frame,
+                    validation_frame=validation_frame, **kw,
+                )
+                record_model(m, hv, hv_key)
+            except Exception as e:
+                with lock:
+                    self.grid.failures.append((dict(hv), repr(e)))
+                Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
+
+        walker = _walk(self.hyper_params, c)
+        pending: set = set()
+        with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+            exhausted = False
+            while not exhausted or pending:
+                while not exhausted and len(pending) < self.parallelism:
+                    if stop_flag.is_set():
+                        exhausted = True
+                        break
+                    with lock:
+                        built = len(self.grid.models)
+                    if c.max_models and built >= c.max_models:
+                        exhausted = True
+                        break
+                    if c.max_models and built + len(pending) >= c.max_models:
+                        break  # wait for in-flight builds before deciding
+                    if c.max_runtime_secs and time.time() - t0 > c.max_runtime_secs:
+                        Log.info(f"grid {self.grid.key}: max_runtime_secs reached")
+                        exhausted = True
+                        break
+                    try:
+                        hv = next(walker)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    hv_key = _hv_key(hv)
+                    if hv_key in done:
+                        m = _load_checkpointed(ckdir, done[hv_key])
+                        if m is not None:
+                            record_model(m, hv, hv_key)
+                            continue
+                    pending.add(pool.submit(build_one, hv, hv_key))
+                if not pending:
+                    if exhausted:
+                        break
+                    continue
+                fin, pending = wait(pending, return_when=FIRST_COMPLETED)
+                if stop_flag.is_set() and not c.max_models:
+                    exhausted = True
         return self.grid
 
 

@@ -1,0 +1,188 @@
+"""InfoGram — successor of ``hex.Infogram.Infogram`` [UNVERIFIED upstream
+path, SURVEY.md §2.2]: the information diagram for admissible machine
+learning (Lee et al.).
+
+Core infogram (no protected columns): per feature, x = *total information*
+(predictive strength of the feature alone) and y = *net information*
+(conditional strength given all other features — drop-one performance
+delta), both normalized to [0, 1]. Fair infogram (``protected_columns``
+set): x = *relevance* (strength the feature adds beyond the protected set)
+and y = *safety* (one minus how well the feature predicts the protected
+attributes — a proxy for I(x_i; protected), a documented deviation from
+upstream's CMI estimator, which the empty reference mount left unverifiable).
+
+Admissible features clear both ``safety_index_threshold`` and
+``total_information_threshold``. All probe models are small GBMs on the
+shared tree engine, so the whole diagram is a sequence of device builds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+
+
+@dataclass
+class InfogramParams(CommonParams):
+    protected_columns: list = field(default_factory=list)
+    safety_index_threshold: float = 0.1
+    relevance_index_threshold: float = 0.1
+    total_information_threshold: float = 0.1
+    net_information_threshold: float = 0.1
+    ntrees: int = 20
+    max_depth: int = 5
+    top_n_features: int = 50
+
+
+def _strength(frame: Frame, y: str, xcols: list[str], classification: bool,
+              ntrees: int, max_depth: int, seed: int) -> float:
+    """Predictive strength of xcols for y: 1 - loss/null_loss in [0, 1]."""
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    if not xcols:
+        return 0.0
+    m = GBM(ntrees=ntrees, max_depth=max_depth, seed=seed).train(
+        y=y, x=xcols, training_frame=frame
+    )
+    mm = m.training_metrics
+    if classification:
+        ll = mm.value("logloss")
+        yv = frame.vec(y)
+        yn = yv.to_numpy()
+        yn = yn[yn >= 0] if yv.is_categorical() else yn
+        # null logloss from the class base rates
+        _, cnt = np.unique(yn.astype(np.int64), return_counts=True)
+        pr = cnt / cnt.sum()
+        null = -float(np.sum(pr * np.log(np.clip(pr, 1e-15, 1))))
+        return float(np.clip(1.0 - ll / max(null, 1e-12), 0.0, 1.0))
+    mse = mm.value("mse")
+    yn = frame.vec(y).to_numpy()
+    null = float(np.nanvar(yn))
+    return float(np.clip(1.0 - mse / max(null, 1e-12), 0.0, 1.0))
+
+
+class InfogramModel(Model):
+    algo = "infogram"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        raise NotImplementedError("infogram is a diagnostic model")
+
+    def get_admissible_features(self) -> list[str]:
+        return list(self.output["admissible_features"])
+
+    def get_admissible_score_frame(self) -> list[dict]:
+        return self.output["score_table"]
+
+    def _score_metrics(self, frame: Frame):
+        from h2o3_tpu.models.metrics import ModelMetrics
+
+        return ModelMetrics(
+            "infogram",
+            {"n_admissible": float(len(self.output["admissible_features"]))},
+        )
+
+
+class Infogram(ModelBuilder):
+    algo = "infogram"
+    PARAMS_CLS = InfogramParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: InfogramParams = self.params
+        yv = train.vec(p.response_column)
+        classification = yv.is_categorical()
+        seed = abs(p.seed) or 13
+        protected = list(p.protected_columns or [])
+        feats = [n for n in self._x if n not in protected]
+        if len(feats) > p.top_n_features:
+            feats = feats[: p.top_n_features]
+        kw = dict(classification=classification, ntrees=p.ntrees,
+                  max_depth=p.max_depth)
+
+        table: list[dict] = []
+        if not protected:
+            # CORE: total info (solo strength), net info (drop-one delta)
+            full = _strength(train, p.response_column, feats, seed=seed, **kw)
+            solo: dict[str, float] = {}
+            drop: dict[str, float] = {}
+            for fi, f in enumerate(feats):
+                solo[f] = _strength(
+                    train, p.response_column, [f], seed=seed + 1 + fi, **kw
+                )
+                rest = [g for g in feats if g != f]
+                drop[f] = max(full - _strength(
+                    train, p.response_column, rest, seed=seed + 101 + fi, **kw
+                ), 0.0)
+                job.update(0.05 + 0.85 * (fi + 1) / len(feats))
+            smax = max(solo.values()) or 1.0
+            dmax = max(drop.values()) or 1.0
+            for f in feats:
+                ti = solo[f] / smax
+                ni = drop[f] / dmax
+                adm = (
+                    ti >= p.total_information_threshold
+                    and ni >= p.net_information_threshold
+                )
+                table.append(
+                    {"column": f, "total_information": ti,
+                     "net_information": ni, "admissible": adm}
+                )
+            xkey, ykey = "total_information", "net_information"
+        else:
+            # FAIR: relevance (gain beyond protected), safety (1 - protected
+            # predictability from the feature)
+            base = _strength(train, p.response_column, protected, seed=seed, **kw)
+            rel: dict[str, float] = {}
+            unsafe: dict[str, float] = {}
+            for fi, f in enumerate(feats):
+                rel[f] = max(
+                    _strength(
+                        train, p.response_column, protected + [f],
+                        seed=seed + 1 + fi, **kw
+                    ) - base,
+                    0.0,
+                )
+                s = 0.0
+                for pj, pc in enumerate(protected):
+                    pv = train.vec(pc)
+                    s = max(
+                        s,
+                        _strength(
+                            train, pc, [f], classification=pv.is_categorical(),
+                            ntrees=p.ntrees, max_depth=p.max_depth,
+                            seed=seed + 201 + fi * 7 + pj,
+                        ),
+                    )
+                unsafe[f] = s
+                job.update(0.05 + 0.85 * (fi + 1) / len(feats))
+            rmax = max(rel.values()) or 1.0
+            umax = max(unsafe.values()) or 1.0
+            for f in feats:
+                rv = rel[f] / rmax
+                sf = 1.0 - unsafe[f] / umax
+                adm = (
+                    rv >= p.relevance_index_threshold
+                    and sf >= p.safety_index_threshold
+                )
+                table.append(
+                    {"column": f, "relevance_index": rv, "safety_index": sf,
+                     "admissible": adm}
+                )
+            xkey, ykey = "relevance_index", "safety_index"
+
+        table.sort(key=lambda r: -(r[xkey] + r[ykey]))
+        out = {
+            "score_table": table,
+            "admissible_features": [r["column"] for r in table if r["admissible"]],
+            "x_axis": xkey,
+            "y_axis": ykey,
+            "names": feats,
+        }
+        model = InfogramModel(DKV.make_key("infogram"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        return model

@@ -1,0 +1,308 @@
+"""ModelSelection — successor of ``hex.modelselection.ModelSelection``
+[UNVERIFIED upstream path, SURVEY.md §2.2]: best-subset GLM search with
+modes ``allsubsets``, ``maxr``, ``maxrsweep``, ``forward``, ``backward``.
+
+TPU design: for gaussian family the search never refits on device — ONE
+fused pass accumulates the full weighted Gram XᵀWX / XᵀWy / yᵀWy over the
+row-sharded design matrix (the MXU does the heavy lifting once), then every
+candidate subset is evaluated host-side in float64 by a sub-Gram Cholesky
+(the ``maxrsweep`` sweep-operator idea: subset RSS falls out of the normal
+equations without touching the data again). Non-gaussian families fall back
+to per-candidate IRLS fits via the GLM builder.
+
+Outputs mirror the upstream model: per-size best predictor subsets, their
+R² (``best_r2_values``), coefficients per size, and (backward mode)
+per-step p-value eliminations.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.datainfo import MEAN_IMPUTATION, DataInfo
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+from h2o3_tpu.ops.gram import weighted_gram
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class ModelSelectionParams(CommonParams):
+    mode: str = "maxr"  # allsubsets | maxr | maxrsweep | forward | backward
+    family: str = "AUTO"
+    max_predictor_number: int = 1
+    min_predictor_number: int = 1
+    intercept: bool = True
+    standardize: bool = True
+    p_values_threshold: float = 0.0
+    missing_values_handling: str = MEAN_IMPUTATION
+
+
+class ModelSelectionModel(Model):
+    algo = "modelselection"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        # score with the largest selected subset's model
+        if self.output["family"] == "binomial":
+            return self.output["final_glm"]._predict_raw(frame)
+        di: DataInfo = self.output["datainfo"]
+        X, _ = di.transform(frame)  # standardized expansion
+        beta = self.output["beta_std_final"]
+        return np.asarray(X, np.float64)[: frame.nrow] @ beta
+
+    def _distribution_for_metrics(self) -> str:
+        return "gaussian"
+
+    # upstream accessor names
+    def get_best_r2_values(self) -> list[float]:
+        return list(self.output["best_r2_values"])
+
+    def get_best_model_predictors(self) -> list[list[str]]:
+        return [list(s) for s in self.output["best_predictor_subsets"]]
+
+    def coef(self, size: int | None = None) -> dict:
+        per = self.output["coef_per_size"]
+        return dict(per[-1] if size is None else per[size - 1])
+
+
+def _subset_r2(G, b, yty, sw, ysum, cols, icpt_idx):
+    """R² of the gaussian submodel on predictor-group columns ``cols``."""
+    idx = list(cols)
+    if icpt_idx is not None:
+        idx = idx + [icpt_idx]
+    Gs = G[np.ix_(idx, idx)]
+    bs = b[idx]
+    try:
+        beta = np.linalg.solve(Gs + 1e-10 * np.eye(len(idx)), bs)
+    except np.linalg.LinAlgError:
+        beta = np.linalg.lstsq(Gs, bs, rcond=None)[0]
+    rss = max(yty - beta @ bs, 0.0)
+    tss = max(yty - (ysum * ysum) / max(sw, 1e-30), 1e-30)
+    return 1.0 - rss / tss, beta, idx
+
+
+class ModelSelection(ModelBuilder):
+    algo = "modelselection"
+    PARAMS_CLS = ModelSelectionParams
+    SUPPORTS_CLASSIFICATION = True
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: ModelSelectionParams = self.params
+        yv = train.vec(p.response_column)
+        family = p.family.lower()
+        if family == "auto":
+            family = "binomial" if yv.is_categorical() else "gaussian"
+        if family not in ("gaussian", "binomial"):
+            raise ValueError("modelselection supports gaussian and binomial")
+
+        di = DataInfo.fit(
+            train, self._x,
+            standardize=p.standardize,
+            use_all_factor_levels=False,
+            missing_handling=p.missing_values_handling,
+            add_intercept=p.intercept,
+        )
+        X, valid_mask = di.transform(train)
+        w = valid_mask
+        if p.weights_column:
+            w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
+        y_np = yv.to_numpy().astype(np.float64)
+        ybuf = np.zeros(train.npad, np.float32)
+        ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
+        w = w * jnp.asarray(
+            np.pad(~np.isnan(y_np), (0, train.npad - train.nrow)).astype(np.float32)
+        )
+        y = jnp.asarray(ybuf)
+
+        # predictor-group -> expanded-column mapping (a categorical predictor
+        # owns its whole one-hot block; selection is per PREDICTOR, like H2O)
+        groups: dict[str, list[int]] = {}
+        for c in di.columns:
+            groups.setdefault(c.name, []).extend(
+                range(c.offset, c.offset + c.width)
+            )
+        pred_names = [n for n in self._x if n in groups]
+        icpt_idx = di.ncols_expanded - 1 if p.intercept else None
+
+        kmax = min(max(p.max_predictor_number, 1), len(pred_names))
+        kmin = min(max(p.min_predictor_number, 1), kmax)
+
+        if family == "gaussian":
+            G_d, b_d, sw_d = weighted_gram(X, w, y)
+            G = np.asarray(G_d, np.float64)
+            b = np.asarray(b_d, np.float64)
+            sw = float(np.asarray(sw_d))
+            yty = float(np.asarray(jnp.sum(w * y * y)))
+            ysum = float(np.asarray(jnp.sum(w * y)))
+
+            def score(subset: tuple[str, ...]):
+                cols = [c for n in subset for c in groups[n]]
+                r2, beta, idx = _subset_r2(G, b, yty, sw, ysum, cols, icpt_idx)
+                return r2, (beta, idx)
+        else:
+
+            def score(subset: tuple[str, ...]):
+                from h2o3_tpu.models.glm import GLM
+
+                m = GLM(
+                    family=family, lambda_=0.0, standardize=p.standardize,
+                    intercept=p.intercept,
+                    weights_column=p.weights_column,
+                ).train(y=p.response_column, x=list(subset), training_frame=train)
+                r2 = 1.0 - m.output["residual_deviance"] / max(
+                    m.output["null_deviance"], 1e-30
+                )
+                return r2, m
+
+        mode = p.mode.lower()
+        best_subsets: list[tuple[str, ...]] = []
+        best_r2: list[float] = []
+        best_fit: list = []
+
+        if mode in ("allsubsets", "maxr", "maxrsweep"):
+            for k in range(1, kmax + 1):
+                if mode == "allsubsets":
+                    cands = itertools.combinations(pred_names, k)
+                    top = max(
+                        ((score(s), s) for s in cands), key=lambda t: t[0][0]
+                    )
+                    (r2, fit), sub = top
+                else:
+                    # maxr: grow the best (k-1)-subset by the best addition,
+                    # then sequential-replacement sweeps until no swap helps
+                    base = list(best_subsets[-1]) if best_subsets else []
+                    avail = [n for n in pred_names if n not in base]
+                    (r2, fit), add = max(
+                        ((score(tuple(base + [a])), a) for a in avail),
+                        key=lambda t: t[0][0],
+                    )
+                    sub = base + [add]
+                    improved = True
+                    while improved:
+                        improved = False
+                        for i in range(len(sub)):
+                            rest = [n for n in pred_names if n not in sub]
+                            for r in rest:
+                                trial = sub[:i] + [r] + sub[i + 1 :]
+                                (tr2, tfit) = score(tuple(trial))
+                                if tr2 > r2 + 1e-12:
+                                    r2, fit, sub = tr2, tfit, trial
+                                    improved = True
+                    sub = tuple(sub)
+                best_subsets.append(tuple(sub))
+                best_r2.append(float(r2))
+                best_fit.append(fit)
+                job.update(0.1 + 0.8 * k / kmax)
+        elif mode == "forward":
+            cur: list[str] = []
+            for k in range(1, kmax + 1):
+                avail = [n for n in pred_names if n not in cur]
+                (r2, fit), add = max(
+                    ((score(tuple(cur + [a])), a) for a in avail),
+                    key=lambda t: t[0][0],
+                )
+                cur.append(add)
+                best_subsets.append(tuple(cur))
+                best_r2.append(float(r2))
+                best_fit.append(fit)
+                job.update(0.1 + 0.8 * k / kmax)
+        elif mode == "backward":
+            cur = list(pred_names)
+            steps: list[dict] = []
+            while len(cur) > kmin:
+                # drop the predictor with the worst (highest) p-value
+                from h2o3_tpu.models.glm import GLM
+
+                m = GLM(
+                    family=family, lambda_=0.0, standardize=p.standardize,
+                    intercept=p.intercept, compute_p_values=True,
+                    weights_column=p.weights_column,
+                ).train(y=p.response_column, x=cur, training_frame=train)
+                names = m.output["coef_names"]
+                pv = m.output["p_values"]
+                zv = np.abs(m.output["z_values"])
+                per_pred = {}
+                for n in cur:
+                    idxs = [
+                        i for i, cn in enumerate(names)
+                        if cn == n or cn.startswith(n + ".")
+                    ]
+                    # highest p wins; |z| breaks ties once p underflows
+                    per_pred[n] = (
+                        min((pv[i] for i in idxs), default=1.0),
+                        -max((zv[i] for i in idxs), default=0.0),
+                    )
+                worst = max(per_pred, key=per_pred.get)
+                worst_p = per_pred[worst][0]
+                if p.p_values_threshold > 0 and worst_p <= p.p_values_threshold:
+                    break
+                steps.append(
+                    {"removed": worst, "p_value": float(worst_p),
+                     "size": len(cur)}
+                )
+                cur.remove(worst)
+                r2, fit = score(tuple(cur))
+                best_subsets.append(tuple(cur))
+                best_r2.append(float(r2))
+                best_fit.append(fit)
+                job.update(0.1 + 0.8 * (len(pred_names) - len(cur)) / max(
+                    len(pred_names) - kmin, 1
+                ))
+            best_subsets.reverse()
+            best_r2.reverse()
+            best_fit.reverse()
+        else:
+            raise ValueError(f"unknown mode {p.mode!r}")
+
+        # per-size coefficient dicts (original scale)
+        coef_names = di.coef_names()
+        coef_per_size: list[dict] = []
+        beta_std_final = np.zeros(di.ncols_expanded, np.float64)
+        final_glm = None
+        for fit in best_fit:
+            if family == "gaussian":
+                beta_std, idx = fit
+                beta_full = np.zeros(di.ncols_expanded, np.float64)
+                beta_full[idx] = beta_std
+                beta_std_final = beta_full
+                beta_orig = beta_full.copy()
+                if p.standardize:
+                    shift = 0.0
+                    for c in di.columns:
+                        if c.kind == "num":
+                            beta_orig[c.offset] = beta_full[c.offset] / c.sigma
+                            shift += beta_full[c.offset] * c.mean / c.sigma
+                    if p.intercept:
+                        beta_orig[-1] = beta_full[-1] - shift
+                coef_per_size.append(
+                    {coef_names[i]: float(beta_orig[i])
+                     for i in range(len(coef_names)) if beta_orig[i] != 0.0
+                     or (p.intercept and i == len(coef_names) - 1)}
+                )
+            else:
+                coef_per_size.append(dict(fit.coef))
+                final_glm = fit
+
+        out = {
+            "beta_std_final": beta_std_final,
+            "final_glm": final_glm,
+            "datainfo": di,
+            "family": family,
+            "best_predictor_subsets": best_subsets,
+            "best_r2_values": best_r2,
+            "coef_per_size": coef_per_size,
+            "mode": mode,
+            "names": list(self._x),
+            "response_domain": tuple(yv.domain) if yv.is_categorical() else None,
+        }
+        model = ModelSelectionModel(DKV.make_key("modelselection"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

@@ -1,0 +1,398 @@
+"""RuleFit — successor of ``hex.rulefit.RuleFit`` / ``RuleFitModel``
+[UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+Friedman-Popescu RuleFit (2008): (1) grow a depth-limited tree ensemble,
+(2) turn every root->node path into a binary rule, (3) fit a sparse linear
+model (LASSO GLM) over the rule indicators plus (optionally) the winsorised
+linear terms.
+
+TPU design: rules are *bin-mask conjunctions* over the shared uint8 binned
+design matrix (models/tree/binning.py) — one (L, B) boolean mask table per
+rule, evaluated on device as gather+all, so rule evaluation is a handful of
+fused programs rather than per-rule host loops. The sparse fit reuses the
+GLM builder (alpha=1 elastic net, ADMM); lambda is chosen on an internal
+80/20 holdout by deviance, mirroring H2O's default-glm selection intent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+from h2o3_tpu.models.tree.binning import BinSpec, bin_frame
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class RuleFitParams(CommonParams):
+    algorithm: str = "AUTO"  # AUTO -> DRF (h2o default)
+    min_rule_length: int = 3
+    max_rule_length: int = 3
+    max_num_rules: int = -1  # -1 -> derived cap (h2o: based on ntrees)
+    model_type: str = "rules_and_linear"  # rules_and_linear | rules | linear
+    rule_generation_ntrees: int = 50
+    distribution: str = "AUTO"
+    lambda_: float | None = None  # explicit LASSO lambda (skips holdout pick)
+    remove_duplicates: bool = True
+
+
+class _Rule:
+    """Conjunction of per-column bin-mask conditions."""
+
+    __slots__ = ("cols", "masks", "support", "name", "text")
+
+    def __init__(self, cols: list[int], masks: list[np.ndarray]):
+        self.cols = cols
+        self.masks = masks  # each (B,) bool over bin codes
+        self.support = 0.0
+        self.name = ""
+        self.text = ""
+
+    def key(self) -> tuple:
+        items = sorted(zip(self.cols, [m.tobytes() for m in self.masks]))
+        return tuple(items)
+
+
+def _node_condition_masks(nd, B: int):
+    """Left/right bin-code masks for one split node (code 0 = NA)."""
+    left = np.zeros(B, bool)
+    if nd.is_cat:
+        cm = np.asarray(nd.cat_mask).astype(bool)
+        left[: min(B, len(cm))] = cm[:B]
+        left[0] = nd.na_left
+    else:
+        left[1 : nd.thr_bin + 1] = True
+        left[0] = nd.na_left
+    right = ~left
+    return left, right
+
+
+def _extract_rules(trees, B: int, max_len: int) -> list[_Rule]:
+    """Every root->node path (depth>=1) in every tree becomes a rule.
+
+    Conditions on the same column along a path AND together into one mask.
+    """
+    from h2o3_tpu.models.tree.shap import _tree_nodes
+
+    rules: list[_Rule] = []
+
+    for tree in trees:
+        nodes = _tree_nodes(tree)
+        if not nodes:
+            continue
+
+        def walk(j: int, conds: dict[int, np.ndarray], depth: int):
+            nd = nodes[j]
+            if conds:
+                cols = sorted(conds)
+                rules.append(_Rule(cols, [conds[c].copy() for c in cols]))
+            if nd.is_leaf or nd.left < 0 or depth >= max_len:
+                return
+            lmask, rmask = _node_condition_masks(nd, B)
+            for child, m in ((nd.left, lmask), (nd.right, rmask)):
+                nc = dict(conds)
+                nc[nd.feature] = (nc[nd.feature] & m) if nd.feature in nc else m
+                walk(child, nc, depth + 1)
+
+        walk(0, {}, 0)
+    return rules
+
+
+_EVAL_PROG: dict = {}
+
+
+def _eval_rules(bins, cols, masks, valid):
+    """Device rule evaluation: (n, Rchunk) float32 membership matrix.
+
+    bins (n, C) uint8; cols (R, L) int32; masks (R, L, B) bool; valid (R, L).
+    """
+    key = (cols.shape, masks.shape[-1], jax.default_backend())
+    prog = _EVAL_PROG.get(key)
+    if prog is None:
+
+        def run(bins, cols, masks, valid):
+            def per_rule(colr, maskr, validr):
+                codes = bins[:, colr].astype(jnp.int32)  # (n, L)
+                hit = jnp.take_along_axis(maskr.T, codes, axis=0)  # (n, L)
+                sat = jnp.where(validr[None, :], hit, True)
+                return sat.all(axis=1)
+
+            out = jax.vmap(per_rule)(cols, masks, valid)  # (R, n)
+            return out.T.astype(jnp.float32)
+
+        prog = jax.jit(run)
+        _EVAL_PROG[key] = prog
+    return prog(bins, cols, masks, valid)
+
+
+def _rule_text(rule: _Rule, spec: BinSpec) -> str:
+    parts = []
+    for col, mask in zip(rule.cols, rule.masks):
+        name = spec.names[col]
+        if spec.is_cat[col]:
+            dom = spec.domains[col] if spec.domains else None
+            lvls = [
+                str(dom[b - 1]) if dom and b - 1 < len(dom) else str(b - 1)
+                for b in range(1, len(mask))
+                if mask[b]
+            ]
+            parts.append(f"{name} in {{{', '.join(lvls)}}}")
+        else:
+            nb = int(spec.nbins[col])
+            e = spec.edges[col]
+            data_bins = np.where(mask[1 : nb + 1])[0] + 1  # codes with mask set
+            if len(data_bins) == 0:
+                parts.append(f"{name} is NA")
+                continue
+            lo_b, hi_b = int(data_bins.min()), int(data_bins.max())
+            seg = []
+            if lo_b > 1:
+                seg.append(f"{name} > {e[lo_b - 2]:.6g}")
+            if hi_b < nb:
+                seg.append(f"{name} <= {e[hi_b - 1]:.6g}")
+            if not seg:
+                seg.append(f"{name} any")
+            parts.append(" & ".join(seg))
+    return " & ".join(parts)
+
+
+class RuleFitModel(Model):
+    algo = "rulefit"
+
+    def _rule_frame(self, frame: Frame) -> Frame:
+        o = self.output
+        cols: dict[str, np.ndarray] = {}
+        for n in o["linear_names"]:
+            cols[f"linear.{n}"] = frame.vec(n).to_numpy()
+        if o["rule_names"]:
+            bins = bin_frame(o["bin_spec"], frame)
+            R = np.asarray(
+                _eval_rules(
+                    bins,
+                    jnp.asarray(o["rule_cols"]),
+                    jnp.asarray(o["rule_masks"]),
+                    jnp.asarray(o["rule_valid"]),
+                )
+            )[: frame.nrow]
+            for ri, n in enumerate(o["rule_names"]):
+                cols[n] = R[:, ri]
+        return Frame.from_arrays(cols)
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        return self.output["glm_model"]._predict_raw(self._rule_frame(frame))
+
+    def rule_importance(self) -> list[dict]:
+        return self.output["rule_importance"]
+
+    def _distribution_for_metrics(self) -> str:
+        return "gaussian"
+
+
+class RuleFit(ModelBuilder):
+    algo = "rulefit"
+    PARAMS_CLS = RuleFitParams
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        from h2o3_tpu.models.glm import GLM
+        from h2o3_tpu.models.tree.drf import DRF
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        p: RuleFitParams = self.params
+        if p.min_rule_length > p.max_rule_length:
+            raise ValueError("min_rule_length must be <= max_rule_length")
+        yv = train.vec(p.response_column)
+        classification = yv.is_categorical()
+        family = "binomial" if classification and yv.cardinality <= 2 else (
+            "multinomial" if classification else "gaussian"
+        )
+        if family == "multinomial":
+            raise ValueError("rulefit supports regression and binomial only")
+
+        rule_names: list[str] = []
+        rules: list[_Rule] = []
+        spec = None
+        if p.model_type in ("rules_and_linear", "rules"):
+            depths = list(range(p.min_rule_length, p.max_rule_length + 1))
+            per_depth = max(1, p.rule_generation_ntrees // len(depths))
+            algo = p.algorithm.upper()
+            if algo == "AUTO":
+                algo = "DRF"
+            for di, depth in enumerate(depths):
+                cls = DRF if algo == "DRF" else GBM
+                kw = dict(
+                    ntrees=per_depth,
+                    max_depth=depth,
+                    seed=(abs(p.seed) or 1) + di,
+                    response_column=p.response_column,
+                    ignored_columns=p.ignored_columns,
+                )
+                if cls is DRF:
+                    kw["sample_rate"] = 0.5
+                ens = cls(**kw).train(
+                    y=p.response_column, training_frame=train, x=self._x
+                )
+                spec = ens.output["bin_spec"]
+                B = spec.max_bins
+                for group in ens.output["trees"]:
+                    for tree in group:
+                        rules.extend(_extract_rules([tree], B, depth))
+                job.update(0.05 + 0.4 * (di + 1) / len(depths))
+
+            if p.remove_duplicates:
+                seen: dict[tuple, _Rule] = {}
+                for r in rules:
+                    seen.setdefault(r.key(), r)
+                rules = list(seen.values())
+
+            cap = p.max_num_rules if p.max_num_rules > 0 else 1500
+            if len(rules) > cap:
+                rules = rules[:cap]
+
+        # evaluate rule matrix on the training frame
+        cols_np: dict[str, np.ndarray] = {}
+        linear_names: list[str] = []
+        if p.model_type in ("rules_and_linear", "linear"):
+            for n in self._x:
+                v = train.vec(n)
+                if v.is_numeric():
+                    linear_names.append(n)
+                    cols_np[f"linear.{n}"] = v.to_numpy()
+
+        rule_cols = rule_masks = rule_valid = None
+        if rules:
+            L = max(len(r.cols) for r in rules)
+            B = spec.max_bins
+            Rn = len(rules)
+            rule_cols = np.zeros((Rn, L), np.int32)
+            rule_masks = np.zeros((Rn, L, B), bool)
+            rule_valid = np.zeros((Rn, L), bool)
+            for ri, r in enumerate(rules):
+                for li, (c, m) in enumerate(zip(r.cols, r.masks)):
+                    rule_cols[ri, li] = c
+                    rule_masks[ri, li] = m[:B]
+                    rule_valid[ri, li] = True
+            bins = bin_frame(spec, train)
+            chunks = []
+            for s in range(0, Rn, 512):
+                chunks.append(
+                    np.asarray(
+                        _eval_rules(
+                            bins,
+                            jnp.asarray(rule_cols[s : s + 512]),
+                            jnp.asarray(rule_masks[s : s + 512]),
+                            jnp.asarray(rule_valid[s : s + 512]),
+                        )
+                    )[: train.nrow]
+                )
+            Rmat = np.concatenate(chunks, axis=1)
+            support = Rmat.mean(axis=0)
+            # drop degenerate rules (all-0 / all-1)
+            keep = (support > 1e-6) & (support < 1 - 1e-6)
+            rules = [r for r, k in zip(rules, keep) if k]
+            Rmat = Rmat[:, keep]
+            rule_cols, rule_masks, rule_valid = (
+                rule_cols[keep], rule_masks[keep], rule_valid[keep],
+            )
+            for ri, r in enumerate(rules):
+                r.support = float(Rmat[:, ri].mean())
+                r.name = f"rule_{ri}"
+                r.text = _rule_text(r, spec)
+                rule_names.append(r.name)
+                cols_np[r.name] = Rmat[:, ri]
+        job.update(0.55)
+
+        # response + weights into the GLM frame
+        y_np = yv.to_numpy()
+        ydf = y_np
+        ctypes = {}
+        if classification:
+            dom = yv.domain
+            ydf = np.asarray(
+                [dom[int(c)] if c >= 0 else None for c in y_np.astype(np.int64)],
+                object,
+            )
+            ctypes["__y"] = "enum"
+        cols_np["__y"] = ydf
+        if p.weights_column:
+            cols_np["__w"] = train.vec(p.weights_column).to_numpy()
+        import pandas as pd
+
+        glm_frame = Frame.from_pandas(pd.DataFrame(cols_np), column_types=ctypes)
+
+        glm_kw = dict(
+            family=family,
+            alpha=1.0,
+            standardize=True,
+            weights_column="__w" if p.weights_column else None,
+        )
+        feat = [c for c in glm_frame.names if c not in ("__y", "__w")]
+
+        if p.lambda_ is not None:
+            lam = float(p.lambda_)
+        else:
+            # pick lambda on an internal 80/20 holdout by deviance
+            tr, ho = glm_frame.split_frame([0.8], seed=abs(p.seed) or 99)
+            probe = GLM(**glm_kw).train(y="__y", x=feat, training_frame=tr)
+            lmax = probe.output["lambda_max"]
+            cand = np.geomspace(lmax, lmax * 1e-3, 8)
+            best_lam, best_dev = float(cand[-1]), np.inf
+            for lam_c in cand:
+                m = GLM(lambda_=float(lam_c), **glm_kw).train(
+                    y="__y", x=feat, training_frame=tr, validation_frame=ho
+                )
+                dev = m.validation_metrics.value(
+                    "logloss" if classification else "mse"
+                )
+                if dev < best_dev - 1e-12:
+                    best_dev, best_lam = dev, float(lam_c)
+            lam = best_lam
+            Log.info(f"rulefit: selected lambda={lam:.6g} (holdout)")
+        job.update(0.8)
+
+        glm_model = GLM(lambda_=lam, **glm_kw).train(
+            y="__y", x=feat, training_frame=glm_frame
+        )
+
+        coefs = glm_model.coef
+        imp = []
+        for r in rules:
+            c = coefs.get(r.name, 0.0)
+            if abs(c) > 1e-12:
+                imp.append(
+                    {"variable": r.name, "coefficient": float(c),
+                     "support": r.support, "rule": r.text}
+                )
+        for n in linear_names:
+            c = coefs.get(f"linear.{n}", 0.0)
+            if abs(c) > 1e-12:
+                imp.append(
+                    {"variable": f"linear.{n}", "coefficient": float(c),
+                     "support": 1.0, "rule": f"linear({n})"}
+                )
+        imp.sort(key=lambda d: -abs(d["coefficient"]))
+
+        out = {
+            "bin_spec": spec,
+            "rule_cols": rule_cols,
+            "rule_masks": rule_masks,
+            "rule_valid": rule_valid,
+            "rule_names": rule_names,
+            "linear_names": linear_names,
+            "glm_model": glm_model,
+            "rule_importance": imp,
+            "lambda": lam,
+            "names": list(self._x),
+            "response_domain": tuple(yv.domain) if classification else None,
+        }
+        model = RuleFitModel(DKV.make_key("rulefit"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

@@ -57,7 +57,113 @@ class PersistFS(PersistBackend):
         return open(path, "wb")
 
 
+class _UploadOnClose(io.BytesIO):
+    """Write buffer that publishes atomically on clean close.
+
+    A with-block that raises marks the buffer aborted, so NO partial object
+    is ever published; close() is idempotent like every other file object.
+    """
+
+    def __init__(self, publish):
+        super().__init__()
+        self._publish = publish
+        self._done = False
+        self._aborted = False
+
+    def close(self) -> None:
+        if not self._done and not self.closed:
+            self._done = True
+            if not self._aborted:
+                self._publish(self.getvalue())
+        super().close()
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._aborted = True
+        self.close()
+
+
+class PersistS3(PersistBackend):
+    """``s3://bucket/key`` via boto3 (gated: clean error when absent)."""
+
+    def __init__(self):
+        import boto3  # raises ImportError when the SDK is not in the image
+
+        self._s3 = boto3.client("s3")
+
+    def _split(self, uri: str) -> tuple[str, str]:
+        p = urllib.parse.urlparse(uri)
+        return p.netloc, p.path.lstrip("/")
+
+    def open_read(self, path: str) -> BinaryIO:
+        bucket, key = self._split(path)
+        body = self._s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+        return io.BytesIO(body)
+
+    def open_write(self, path: str) -> BinaryIO:
+        bucket, key = self._split(path)
+        return _UploadOnClose(
+            lambda data: self._s3.put_object(Bucket=bucket, Key=key, Body=data)
+        )
+
+
+class PersistGS(PersistBackend):
+    """``gs://bucket/key`` via google-cloud-storage (gated)."""
+
+    def __init__(self):
+        from google.cloud import storage
+
+        self._client = storage.Client()
+
+    def _blob(self, uri: str):
+        p = urllib.parse.urlparse(uri)
+        return self._client.bucket(p.netloc).blob(p.path.lstrip("/"))
+
+    def open_read(self, path: str) -> BinaryIO:
+        return io.BytesIO(self._blob(path).download_as_bytes())
+
+    def open_write(self, path: str) -> BinaryIO:
+        blob = self._blob(path)
+        return _UploadOnClose(lambda data: blob.upload_from_string(data))
+
+
+class PersistHDFS(PersistBackend):
+    """``hdfs://namenode/path`` via pyarrow's HadoopFileSystem (gated)."""
+
+    def __init__(self):
+        from pyarrow import fs
+
+        self._fs_mod = fs
+        self._conns: dict[tuple[str, int], object] = {}
+
+    def _fs_path(self, uri: str):
+        p = urllib.parse.urlparse(uri)
+        host = p.hostname or "default"
+        port = p.port or 8020
+        conn = self._conns.get((host, port))
+        if conn is None:
+            conn = self._fs_mod.HadoopFileSystem(host, port)
+            self._conns[(host, port)] = conn
+        return conn, p.path
+
+    def open_read(self, path: str) -> BinaryIO:
+        f, pth = self._fs_path(path)
+        return f.open_input_stream(pth)
+
+    def open_write(self, path: str) -> BinaryIO:
+        f, pth = self._fs_path(path)
+        return f.open_output_stream(pth)
+
+
 _BACKENDS: dict[str, PersistBackend] = {"file": PersistFS(), "": PersistFS()}
+
+# cloud schemes construct lazily on first touch: the SDK import happens then,
+# and a missing SDK surfaces as a clear registration error, not at import
+_LAZY_BACKENDS: dict[str, type] = {
+    "s3": PersistS3,
+    "gs": PersistGS,
+    "hdfs": PersistHDFS,
+}
 
 
 def register_backend(scheme: str, backend: PersistBackend) -> None:
@@ -68,6 +174,16 @@ def _backend_for(uri: str) -> tuple[PersistBackend, str]:
     parsed = urllib.parse.urlparse(uri)
     scheme = parsed.scheme if len(parsed.scheme) > 1 else ""  # windows-drive safe
     b = _BACKENDS.get(scheme)
+    if b is None and scheme in _LAZY_BACKENDS:
+        try:
+            b = _LAZY_BACKENDS[scheme]()
+        except ImportError as e:
+            raise ValueError(
+                f"persist scheme {scheme!r} needs its SDK ({e.name}) which is "
+                "not installed in this image; register a backend with "
+                "h2o3_tpu.persist.register_backend"
+            ) from e
+        _BACKENDS[scheme] = b
     if b is None:
         raise ValueError(
             f"no persist backend for scheme {scheme!r} "

@@ -1,0 +1,135 @@
+"""sklearn-compatible wrappers — successor of ``h2o-py/h2o/sklearn/*``
+[UNVERIFIED upstream paths, SURVEY.md §2.3].
+
+Every estimator gains a ``...Classifier`` / ``...Regressor`` face with the
+sklearn contract: ``fit(X, y)`` / ``predict(X)`` / ``predict_proba(X)`` /
+``score`` / ``get_params`` / ``set_params``, accepting numpy arrays or
+pandas DataFrames. Frames are built internally; the response is cast to
+enum for classifiers. Compatible with sklearn model_selection utilities
+(``cross_val_score``, ``GridSearchCV``) via ``sklearn.base`` duck typing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from h2o3_tpu.frame.frame import Frame
+
+_RESP = "__sk_response__"
+
+
+def _to_df(X) -> pd.DataFrame:
+    if isinstance(X, pd.DataFrame):
+        return X.reset_index(drop=True)
+    X = np.asarray(X)
+    return pd.DataFrame(X, columns=[f"x{i}" for i in range(X.shape[1])])
+
+
+class _SkBase:
+    _BUILDER = ""
+    _CLASSIFIER = False
+
+    def __init__(self, **params):
+        self._params = params
+        self._model = None
+        self._classes: np.ndarray | None = None
+
+    # -- sklearn plumbing ----------------------------------------------------
+    def get_params(self, deep: bool = True) -> dict:
+        return dict(self._params)
+
+    def set_params(self, **params) -> "Any":
+        self._params.update(params)
+        return self
+
+    # -- the contract --------------------------------------------------------
+    def fit(self, X, y, sample_weight=None):
+        from h2o3_tpu import models as M
+
+        df = _to_df(X).copy()
+        y = np.asarray(y)
+        ctypes = {}
+        if self._CLASSIFIER:
+            self._classes = np.unique(y)
+            df[_RESP] = y.astype(str)
+            ctypes[_RESP] = "enum"
+        else:
+            df[_RESP] = y.astype(np.float64)
+        kw = dict(self._params)
+        if sample_weight is not None:
+            df["__sk_w__"] = np.asarray(sample_weight, np.float64)
+            kw["weights_column"] = "__sk_w__"
+        fr = Frame.from_pandas(df, column_types=ctypes)
+        feats = [c for c in fr.names if c not in (_RESP, "__sk_w__")]
+        builder = getattr(M, self._BUILDER)(**kw)
+        self._model = builder.train(x=feats, y=_RESP, training_frame=fr)
+        return self
+
+    def _scored(self, X) -> Frame:
+        if self._model is None:
+            raise RuntimeError("estimator is not fitted")
+        return self._model.predict(Frame.from_pandas(_to_df(X)))
+
+    def predict(self, X) -> np.ndarray:
+        out = self._scored(X)
+        pred = out.vec("predict").to_numpy()
+        if self._CLASSIFIER:
+            dom = out.vec("predict").domain or [str(c) for c in self._classes]
+            labels = np.asarray([dom[int(c)] for c in pred.astype(np.int64)])
+            # map back to the original dtype of y
+            lut = {str(c): c for c in self._classes}
+            return np.asarray([lut.get(l, l) for l in labels])
+        return pred
+
+    def predict_proba(self, X) -> np.ndarray:
+        if not self._CLASSIFIER:
+            raise AttributeError("predict_proba is classification-only")
+        out = self._scored(X)
+        cols = [n for n in out.names if n != "predict"]
+        return np.stack([out.vec(c).to_numpy() for c in cols], axis=1)
+
+    def score(self, X, y, sample_weight=None) -> float:
+        y = np.asarray(y)
+        if self._CLASSIFIER:
+            return float(np.mean(self.predict(X) == y))
+        pred = self.predict(X)
+        ssr = float(np.sum((y - pred) ** 2))
+        sst = float(np.sum((y - y.mean()) ** 2))
+        return 1.0 - ssr / max(sst, 1e-300)
+
+    @property
+    def classes_(self) -> np.ndarray:
+        if self._classes is None:
+            raise AttributeError("classes_")
+        return self._classes
+
+    @property
+    def model(self):
+        return self._model
+
+
+def _mk(name: str, builder: str, classifier: bool) -> str:
+    cls = type(
+        name, (_SkBase,),
+        {"_BUILDER": builder, "_CLASSIFIER": classifier,
+         "__doc__": f"sklearn-style wrapper over the {builder} builder."},
+    )
+    globals()[name] = cls
+    return name
+
+
+__all__ = [
+    _mk("H2OGradientBoostingClassifier", "GBM", True),
+    _mk("H2OGradientBoostingRegressor", "GBM", False),
+    _mk("H2ORandomForestClassifier", "DRF", True),
+    _mk("H2ORandomForestRegressor", "DRF", False),
+    _mk("H2OGeneralizedLinearClassifier", "GLM", True),
+    _mk("H2OGeneralizedLinearRegressor", "GLM", False),
+    _mk("H2ODeepLearningClassifier", "DeepLearning", True),
+    _mk("H2ODeepLearningRegressor", "DeepLearning", False),
+    _mk("H2ONaiveBayesClassifier", "NaiveBayes", True),
+    _mk("H2OSupportVectorMachineClassifier", "PSVM", True),
+]

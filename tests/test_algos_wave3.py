@@ -1,0 +1,393 @@
+"""Algorithm wave 3 — RuleFit, UpliftDRF, GAM, ModelSelection, ANOVA-GLM,
+Aggregator, Infogram, PSVM (SURVEY.md §2.2 rows C28/C32), pinned against
+sklearn / analytic references where a counterpart exists."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import (
+    ANOVAGLM,
+    GAM,
+    PSVM,
+    Aggregator,
+    Infogram,
+    ModelSelection,
+    RuleFit,
+    UpliftDRF,
+)
+
+
+# ---------------------------------------------------------------------------
+# ModelSelection
+
+
+def _lin_frame(n=2000, seed=1):
+    rng = np.random.default_rng(seed)
+    x0, x1, x2 = rng.normal(size=(3, n))
+    cat = rng.choice(list("abc"), size=n)
+    ce = {"a": 0.0, "b": 1.0, "c": -1.0}
+    y = 2 * x0 - 1.5 * x1 + np.vectorize(ce.get)(cat) + 0.1 * rng.normal(size=n)
+    df = pd.DataFrame({"x0": x0, "x1": x1, "x2": x2, "cat": cat, "y": y})
+    return Frame.from_pandas(df), df
+
+
+def test_modelselection_maxr_picks_true_predictors():
+    fr, _ = _lin_frame()
+    m = ModelSelection(mode="maxr", max_predictor_number=3).train(
+        y="y", training_frame=fr
+    )
+    subs = m.get_best_model_predictors()
+    assert subs[0] == ["x0"]
+    assert set(subs[1]) == {"x0", "x1"}
+    assert set(subs[2]) == {"x0", "x1", "cat"}  # noise col x2 excluded
+    r2 = m.get_best_r2_values()
+    assert all(b >= a - 1e-9 for a, b in zip(r2, r2[1:]))  # monotone in size
+    assert r2[2] > 0.99
+
+
+def test_modelselection_allsubsets_agrees_with_maxr():
+    fr, _ = _lin_frame()
+    a = ModelSelection(mode="allsubsets", max_predictor_number=2).train(
+        y="y", training_frame=fr
+    )
+    b = ModelSelection(mode="maxr", max_predictor_number=2).train(
+        y="y", training_frame=fr
+    )
+    assert [set(s) for s in a.get_best_model_predictors()] == [
+        set(s) for s in b.get_best_model_predictors()
+    ]
+    np.testing.assert_allclose(
+        a.get_best_r2_values(), b.get_best_r2_values(), rtol=1e-9
+    )
+
+
+def test_modelselection_forward_backward():
+    fr, _ = _lin_frame()
+    f = ModelSelection(mode="forward", max_predictor_number=4).train(
+        y="y", training_frame=fr
+    )
+    assert f.get_best_model_predictors()[0] == ["x0"]
+    b = ModelSelection(mode="backward", min_predictor_number=2).train(
+        y="y", training_frame=fr
+    )
+    # x2 (pure noise) must be eliminated first -> absent from the size-3 set
+    assert "x2" not in b.get_best_model_predictors()[-1]
+
+
+def test_modelselection_r2_matches_numpy_ols():
+    fr, df = _lin_frame()
+    m = ModelSelection(mode="allsubsets", max_predictor_number=1).train(
+        y="y", x=["x0", "x1", "x2"], training_frame=fr
+    )
+    # best single predictor is x0; compare R2 to a direct OLS fit
+    X = np.stack([df["x0"], np.ones(len(df))], axis=1)
+    beta, *_ = np.linalg.lstsq(X, df["y"], rcond=None)
+    resid = df["y"] - X @ beta
+    r2_np = 1 - np.sum(resid**2) / np.sum((df["y"] - df["y"].mean()) ** 2)
+    assert abs(m.get_best_r2_values()[0] - r2_np) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# ANOVA GLM
+
+
+def test_anovaglm_flags_true_effects():
+    fr, _ = _lin_frame()
+    m = ANOVAGLM(highest_interaction_term=2).train(
+        y="y", x=["x0", "cat", "x2"], training_frame=fr
+    )
+    tab = {r["term"]: r for r in m.anova_table()}
+    assert tab["x0"]["p_value"] < 1e-10
+    assert tab["cat"]["p_value"] < 1e-10
+    assert tab["x2"]["p_value"] > 0.01  # pure noise
+    assert tab["x0:x2"]["p_value"] > 0.01  # no interaction in truth
+    # SS decomposition sanity: every SS nonnegative, residual df plausible
+    assert all(r["ss"] >= 0 for r in m.anova_table())
+
+
+def test_anovaglm_gaussian_f_matches_direct_computation():
+    rng = np.random.default_rng(7)
+    n = 500
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    y = a + 0.5 * b + rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"a": a, "b": b, "y": y}))
+    m = ANOVAGLM(highest_interaction_term=1, standardize=False).train(
+        y="y", x=["a", "b"], training_frame=fr
+    )
+    # direct type-III F for 'a': RSS(b) - RSS(a,b)
+    X_full = np.stack([a, b, np.ones(n)], axis=1)
+    X_red = np.stack([b, np.ones(n)], axis=1)
+    rss = lambda X: np.sum(
+        (y - X @ np.linalg.lstsq(X, y, rcond=None)[0]) ** 2
+    )
+    ss_a = rss(X_red) - rss(X_full)
+    tab = {r["term"]: r for r in m.anova_table()}
+    np.testing.assert_allclose(tab["a"]["ss"], ss_a, rtol=1e-3)
+
+
+def test_anovaglm_binomial():
+    rng = np.random.default_rng(9)
+    n = 1500
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    eta = 1.5 * a
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame({"a": a, "b": b, "y": [str(v) for v in y]})
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    m = ANOVAGLM(highest_interaction_term=1).train(
+        y="y", x=["a", "b"], training_frame=fr
+    )
+    tab = {r["term"]: r for r in m.anova_table()}
+    assert tab["a"]["p_value"] < 1e-8
+    assert tab["b"]["p_value"] > 0.01
+
+
+# ---------------------------------------------------------------------------
+# GAM
+
+
+def test_gam_beats_linear_on_nonlinear_signal():
+    rng = np.random.default_rng(3)
+    n = 2500
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    y = np.sin(2 * x0) + 0.5 * x1 + 0.1 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"x0": x0, "x1": x1, "y": y}))
+    g = GAM(gam_columns=["x0"]).train(y="y", training_frame=fr)
+    from h2o3_tpu.models import GLM
+
+    lin = GLM(lambda_=0.0).train(y="y", training_frame=fr)
+    r2_gam = g.training_metrics.value("r2")
+    r2_lin = lin.training_metrics.value("r2")
+    assert r2_gam > 0.95
+    assert r2_gam > r2_lin + 0.2  # the spline must capture sin(2x)
+
+
+def test_gam_predict_consistency_and_smoothing():
+    rng = np.random.default_rng(4)
+    n = 1500
+    x = rng.uniform(-2, 2, n)
+    y = x**2 + 0.1 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y}))
+    g = GAM(gam_columns=["x"], num_knots=[8]).train(y="y", training_frame=fr)
+    p1 = g.predict(fr).vec("predict").to_numpy()
+    p2 = g.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2)  # deterministic scoring
+    # very strong smoothing must flatten the fit
+    g2 = GAM(gam_columns=["x"], num_knots=[8], scale=[1e9]).train(
+        y="y", training_frame=fr
+    )
+    assert g2.training_metrics.value("r2") < g.training_metrics.value("r2")
+
+
+def test_gam_binomial():
+    rng = np.random.default_rng(5)
+    n = 2500
+    x = rng.normal(size=n)
+    eta = np.sin(2 * x) * 2
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame({"x": x, "y": [str(v) for v in y]})
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    g = GAM(gam_columns=["x"], family="binomial").train(y="y", training_frame=fr)
+    assert g.training_metrics.value("auc") > 0.75
+
+
+# ---------------------------------------------------------------------------
+# RuleFit
+
+
+def test_rulefit_recovers_rules():
+    rng = np.random.default_rng(0)
+    n = 4000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (
+        ((X[:, 0] > 0.3) & (X[:, 1] < 0.5)).astype(float) * 2.0
+        + (X[:, 2] > 0) * 1.0
+        + 0.1 * rng.normal(size=n)
+    )
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(5)])
+    df["y"] = y
+    fr = Frame.from_pandas(df)
+    m = RuleFit(
+        rule_generation_ntrees=20, min_rule_length=2, max_rule_length=3, seed=42
+    ).train(y="y", training_frame=fr)
+    assert m.training_metrics.value("r2") > 0.9
+    imp = m.rule_importance()
+    assert len(imp) > 0
+    top = imp[0]["rule"]
+    assert "x0" in top and "x1" in top  # the generating interaction
+    # scoring a fresh frame round-trips through rule evaluation
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] ** 2 > 0.9
+
+
+def test_rulefit_binomial_and_linear_only():
+    rng = np.random.default_rng(2)
+    n = 2500
+    X = rng.normal(size=(n, 4))
+    eta = 2 * ((X[:, 0] > 0) & (X[:, 1] > 0)) - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-2 * eta))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = np.where(y == 1, "Y", "N")
+    fr = Frame.from_pandas(df)
+    m = RuleFit(rule_generation_ntrees=16, seed=3).train(y="y", training_frame=fr)
+    assert m.training_metrics.value("auc") > 0.75
+    lin = RuleFit(model_type="linear", seed=3).train(y="y", training_frame=fr)
+    assert all(r["variable"].startswith("linear.") for r in lin.rule_importance())
+
+
+# ---------------------------------------------------------------------------
+# UpliftDRF
+
+
+def _uplift_frame(n=6000, seed=3):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    treat = rng.integers(0, 2, n)
+    p = 0.3 + 0.3 * treat * (x0 > 0)
+    y = (rng.random(n) < p).astype(int)
+    df = pd.DataFrame(
+        {"x0": x0, "x1": x1,
+         "treatment": np.where(treat, "treatment", "control"),
+         "y": y.astype(str)}
+    )
+    return (
+        Frame.from_pandas(df, column_types={"y": "enum", "treatment": "enum"}),
+        x0,
+    )
+
+
+@pytest.mark.parametrize("metric", ["KL", "Euclidean", "ChiSquared"])
+def test_upliftdrf_recovers_heterogeneous_effect(metric):
+    fr, x0 = _uplift_frame()
+    m = UpliftDRF(
+        ntrees=16, max_depth=4, treatment_column="treatment",
+        uplift_metric=metric, seed=11,
+    ).train(y="y", training_frame=fr)
+    u = m._predict_raw(fr)
+    assert u[x0 > 0].mean() > 0.2  # true uplift 0.3
+    assert u[x0 <= 0].mean() < 0.1  # true uplift 0
+    mm = m.training_metrics
+    assert mm.value("qini") > 0  # better than random targeting
+    assert 0.1 < mm.value("ate") < 0.2  # overall ATE ~ 0.15
+
+
+def test_upliftdrf_validation_errors():
+    fr, _ = _uplift_frame(n=500)
+    with pytest.raises(Exception, match="2-level factor"):
+        UpliftDRF(treatment_column="x0").train(y="y", training_frame=fr)
+    with pytest.raises(Exception, match="uplift_metric"):
+        UpliftDRF(treatment_column="treatment", uplift_metric="bogus").train(
+            y="y", training_frame=fr
+        )
+
+
+# ---------------------------------------------------------------------------
+# Aggregator
+
+
+def test_aggregator_reduces_with_exact_count_conservation():
+    rng = np.random.default_rng(8)
+    n = 20000
+    df = pd.DataFrame(
+        {"a": rng.normal(size=n), "b": rng.normal(size=n),
+         "c": rng.choice(list("xyz"), n)}
+    )
+    fr = Frame.from_pandas(df)
+    m = Aggregator(target_num_exemplars=500).train(training_frame=fr)
+    agg = m.aggregated_frame
+    counts = agg.vec("counts").to_numpy()
+    assert int(counts.sum()) == n  # every row accounted for
+    ne = m.output["num_exemplars"]
+    assert ne <= 500 * 1.5 + 1
+    assert ne >= 10
+    assert agg.nrow == ne
+    # mapping covers all rows and points at real exemplars
+    mapping = m.output["mapping"]
+    assert mapping.shape == (n,)
+    assert mapping.min() >= 0 and mapping.max() < ne
+
+
+# ---------------------------------------------------------------------------
+# Infogram
+
+
+def test_infogram_core_ranks_signal_over_noise():
+    rng = np.random.default_rng(6)
+    n = 1500
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)  # noise
+    y = 2 * x0 + x1 + 0.05 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"x0": x0, "x1": x1, "x2": x2, "y": y}))
+    m = Infogram(ntrees=10, max_depth=3).train(y="y", training_frame=fr)
+    tab = {r["column"]: r for r in m.get_admissible_score_frame()}
+    assert tab["x0"]["total_information"] > tab["x2"]["total_information"]
+    assert tab["x0"]["net_information"] > tab["x2"]["net_information"]
+    assert "x0" in m.get_admissible_features()
+    assert "x2" not in m.get_admissible_features()
+
+
+def test_infogram_fair_flags_proxy():
+    rng = np.random.default_rng(10)
+    n = 1500
+    protected = rng.normal(size=n)
+    proxy = protected + 0.1 * rng.normal(size=n)  # near-copy of protected
+    clean = rng.normal(size=n)
+    y = protected + clean + 0.1 * rng.normal(size=n)
+    df = pd.DataFrame({"prot": protected, "proxy": proxy, "clean": clean, "y": y})
+    fr = Frame.from_pandas(df)
+    m = Infogram(
+        protected_columns=["prot"], ntrees=10, max_depth=3
+    ).train(y="y", training_frame=fr)
+    tab = {r["column"]: r for r in m.get_admissible_score_frame()}
+    assert tab["clean"]["safety_index"] > tab["proxy"]["safety_index"]
+    assert "clean" in m.get_admissible_features()
+    assert "proxy" not in m.get_admissible_features()
+
+
+# ---------------------------------------------------------------------------
+# PSVM
+
+
+def test_psvm_nonlinear_boundary():
+    rng = np.random.default_rng(5)
+    n = 3000
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    yc = ((x0**2 + x1**2) < 1.2).astype(int)  # circle: linearly inseparable
+    df = pd.DataFrame({"x0": x0, "x1": x1, "y": [str(v) for v in yc]})
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    m = PSVM(hyper_param=1.0, seed=7).train(y="y", training_frame=fr)
+    assert m.training_metrics.value("auc") > 0.97
+    assert 0 < m.output["svs_count"] < n
+    # decisions reproduce on re-scoring
+    d1 = m._decision(fr)
+    d2 = m._decision(fr)
+    np.testing.assert_allclose(d1, d2)
+
+
+def test_psvm_tracks_sklearn_svc():
+    from sklearn.metrics import roc_auc_score
+    from sklearn.svm import SVC
+
+    rng = np.random.default_rng(12)
+    n = 1500
+    X = rng.normal(size=(n, 3))
+    yc = ((X[:, 0] * X[:, 1] + X[:, 2]) > 0).astype(int)
+    df = pd.DataFrame(X, columns=["a", "b", "c"])
+    df["y"] = [str(v) for v in yc]
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    m = PSVM(hyper_param=1.0, seed=2, max_iterations=300).train(
+        y="y", training_frame=fr
+    )
+    ours = roc_auc_score(yc, m._decision(fr))
+    Xs = (X - X.mean(0)) / X.std(0)
+    sk = roc_auc_score(
+        yc, SVC(C=1.0, gamma=1.0 / 3).fit(Xs, yc).decision_function(Xs)
+    )
+    assert ours > sk - 0.05  # within 5 AUC points of exact kernel SVC
