@@ -98,7 +98,7 @@ const render = {
       const j = await api('GET', '/3/Frames');
       const rows = (j.frames || []).map(f =>
         `<tr><td>${f.frame_id.name || f.frame_id}</td><td>${f.rows}</td>
-         <td>${f.columns ? f.columns.length || f.column_count || '' : ''}</td>
+         <td>${f.column_count ?? ''}</td>
          <td><button onclick="frameSummary('${f.frame_id.name || f.frame_id}')">summary</button></td></tr>`);
       s.querySelector('#frlist').innerHTML =
         `<table><tr><th>key</th><th>rows</th><th>cols</th><th></th></tr>${rows.join('')}</table>
@@ -223,18 +223,25 @@ window.runAutoML = async () => {
   try {
     el.textContent = 'running…';
     const j = await api('POST', '/99/AutoMLBuilder', {
-      training_frame: document.getElementById('aframe').value,
-      response_column: document.getElementById('ay').value,
-      max_models: parseInt(document.getElementById('amax').value || '8'),
+      build_control: { stopping_criteria: {
+        max_models: parseInt(document.getElementById('amax').value || '8') } },
+      input_spec: {
+        training_frame: { name: document.getElementById('aframe').value },
+        response_column: { column_name: document.getElementById('ay').value } },
+      build_models: {},
     });
-    const id = j.automl_id || (j.job && (j.job.key.name || j.job.key));
+    const id = j.automl_id.name || j.automl_id;
+    const jobKey = j.job.key.name || j.job.key;
     el.innerHTML = `<span class="ok">started ${id}</span>`;
     const pre = document.getElementById('aboard');
     pre.style.display = 'block';
     const poll = async () => {
       const a = await api('GET', `/99/AutoML/${id}`);
-      pre.textContent = JSON.stringify(a.leaderboard || a, null, 2);
-      if (!a.done) setTimeout(poll, 3000);
+      pre.textContent = JSON.stringify(a.leaderboard_table || a, null, 2);
+      const jb = await api('GET', `/3/Jobs/${jobKey}`);
+      const st = (jb.jobs ? jb.jobs[0] : jb).status;
+      if (st !== 'DONE' && st !== 'FAILED') setTimeout(poll, 3000);
+      else el.innerHTML = `<span class="${st === 'DONE' ? 'ok' : 'err'}">${st}</span>`;
     };
     poll();
   } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }

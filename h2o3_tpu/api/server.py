@@ -229,6 +229,18 @@ class Endpoints:
         DKV.remove(key)
         return {"__meta": {"schema_type": "Frames"}, "frames": []}
 
+    def download_dataset(self, params):
+        """``/3/DownloadDataset?frame_id=…`` — frame rows as CSV (the route
+        h2o clients use to materialize frames locally)."""
+        key = params.get("frame_id")
+        key = key["name"] if isinstance(key, dict) else key
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise ApiError(404, f"Frame {key} not found")
+        csv = fr.to_pandas().to_csv(index=False)
+        return {"__binary__": csv.encode(), "content_type": "text/csv",
+                "filename": f"{key}.csv"}
+
     def frame_export(self, params, key):
         """``/3/Frames/{id}/export`` — CSV/Parquet to a server-side path."""
         fr = DKV.get(key)
@@ -578,6 +590,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/3/ParseSetup", _EP.parse_setup),
     ("POST", r"/3/Parse", _EP.parse),
     ("GET", r"/3/Frames", _EP.frames_list),
+    ("GET", r"/3/DownloadDataset", _EP.download_dataset),
     ("POST", r"/3/Frames/([^/]+)/export", _EP.frame_export),
     ("GET", r"/3/Frames/([^/]+)/summary", _EP.frame_summary),
     ("GET", r"/3/Frames/([^/]+)", _EP.frame_get),

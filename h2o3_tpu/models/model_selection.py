@@ -68,7 +68,15 @@ class ModelSelectionModel(Model):
 
     def coef(self, size: int | None = None) -> dict:
         per = self.output["coef_per_size"]
-        return dict(per[-1] if size is None else per[size - 1])
+        if size is None:
+            return dict(per[-1])
+        sizes = [len(s) for s in self.output["best_predictor_subsets"]]
+        try:
+            return dict(per[sizes.index(size)])
+        except ValueError:
+            raise ValueError(
+                f"no model of size {size}; available sizes: {sizes}"
+            ) from None
 
 
 def _subset_r2(G, b, yty, sw, ysum, cols, icpt_idx):
@@ -131,7 +139,12 @@ class ModelSelection(ModelBuilder):
         icpt_idx = di.ncols_expanded - 1 if p.intercept else None
 
         kmax = min(max(p.max_predictor_number, 1), len(pred_names))
-        kmin = min(max(p.min_predictor_number, 1), kmax)
+        # backward walks DOWN from the full set; max_predictor_number (which
+        # defaults to 1 for the growing modes) must not clamp its floor
+        if p.mode.lower() == "backward":
+            kmin = min(max(p.min_predictor_number, 1), len(pred_names))
+        else:
+            kmin = min(max(p.min_predictor_number, 1), kmax)
 
         if family == "gaussian":
             G_d, b_d, sw_d = weighted_gram(X, w, y)

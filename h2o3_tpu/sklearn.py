@@ -66,6 +66,13 @@ class _SkBase:
         feats = [c for c in fr.names if c not in (_RESP, "__sk_w__")]
         builder = getattr(M, self._BUILDER)(**kw)
         self._model = builder.train(x=feats, y=_RESP, training_frame=fr)
+        if self._CLASSIFIER:
+            # align classes_ with the model's (lexicographic) enum domain so
+            # predict_proba columns and classes_ agree even for numeric labels
+            dom = self._model.output.get("response_domain")
+            if dom:
+                lut = {str(c): c for c in self._classes}
+                self._classes = np.asarray([lut[d] for d in dom])
         return self
 
     def _scored(self, X) -> Frame:

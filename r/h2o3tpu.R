@@ -1,0 +1,212 @@
+# h2o3tpu — R client for the h2o3-tpu coordinator.
+#
+# Successor of the ``h2o-r`` package [UNVERIFIED upstream paths, SURVEY.md
+# §2.3]: the same verb surface (h2o.init / h2o.importFile / h2o.gbm /
+# h2o.predict / h2o.performance / h2o.automl / h2o.ls) speaking the same
+# REST routes, in one dependency-light file. Transport is the system
+# ``curl`` binary (no RCurl/httr); JSON via the ``jsonlite`` package.
+#
+# Usage:
+#   source("h2o3tpu.R")
+#   h2o.init("http://localhost:54321")
+#   fr <- h2o.importFile("/data/train.csv")
+#   m  <- h2o.gbm(y = "label", training_frame = fr, ntrees = 50)
+#   h2o.performance(m)
+#   p  <- h2o.predict(m, fr)
+
+.h2o3 <- new.env(parent = emptyenv())
+
+.h2o.json <- function(x) jsonlite::toJSON(x, auto_unbox = TRUE, null = "null")
+
+.h2o.req <- function(method, path, body = NULL) {
+  stopifnot(!is.null(.h2o3$url))
+  url <- paste0(.h2o3$url, path)
+  args <- c("-sS", "-X", method, url)
+  if (!is.null(body)) {
+    args <- c(args, "-H", "Content-Type: application/json",
+              "--data-binary", as.character(.h2o.json(body)))
+  }
+  out <- suppressWarnings(system2("curl", shQuote(args), stdout = TRUE))
+  txt <- paste(out, collapse = "\n")
+  if (!nzchar(txt)) stop("empty response from ", url)
+  res <- jsonlite::fromJSON(txt, simplifyVector = FALSE)
+  if (!is.null(res$http_status) && res$http_status >= 400) {
+    stop("H2O3 error ", res$http_status, ": ", res$msg)
+  }
+  res
+}
+
+.h2o.key <- function(x) {
+  if (is.list(x) && !is.null(x$name)) x$name else x
+}
+
+.h2o.wait_job <- function(job, poll = 0.5) {
+  key <- .h2o.key(job$key)
+  repeat {
+    j <- .h2o.req("GET", paste0("/3/Jobs/", key))
+    jj <- if (!is.null(j$jobs)) j$jobs[[1]] else j
+    if (jj$status %in% c("DONE", "FAILED", "CANCELLED")) {
+      if (jj$status == "FAILED") stop("job ", key, " failed: ", jj$exception)
+      return(invisible(jj))
+    }
+    Sys.sleep(poll)
+  }
+}
+
+# -- connection ---------------------------------------------------------------
+
+h2o.init <- function(url = "http://localhost:54321") {
+  .h2o3$url <- sub("/+$", "", url)
+  cloud <- .h2o.req("GET", "/3/Cloud")
+  message("Connected to ", cloud$cloud_name, " (", cloud$cloud_size,
+          " device(s), version ", cloud$version, ")")
+  invisible(cloud)
+}
+
+h2o.clusterInfo <- function() .h2o.req("GET", "/3/Cloud")
+
+# -- frames -------------------------------------------------------------------
+
+h2o.importFile <- function(path, destination_frame = NULL) {
+  setup <- .h2o.req("POST", "/3/ParseSetup", list(source_frames = list(path)))
+  body <- setup
+  if (!is.null(destination_frame)) body$destination_frame <- destination_frame
+  parsed <- .h2o.req("POST", "/3/Parse", body)
+  .h2o.wait_job(parsed$job)
+  structure(list(frame_id = .h2o.key(parsed$destination_frame)),
+            class = "H2O3Frame")
+}
+
+h2o.getFrame <- function(id) {
+  .h2o.req("GET", paste0("/3/Frames/", id))
+}
+
+h2o.ls <- function() {
+  frames <- .h2o.req("GET", "/3/Frames")$frames
+  models <- .h2o.req("GET", "/3/Models")$models
+  keys <- c(vapply(frames, function(f) .h2o.key(f$frame_id), ""),
+            vapply(models, function(m) .h2o.key(m$model_id), ""))
+  data.frame(key = keys, stringsAsFactors = FALSE)
+}
+
+h2o.describe <- function(frame) {
+  .h2o.req("GET", paste0("/3/Frames/", .h2o.key(frame$frame_id), "/summary"))
+}
+
+h2o.exportFile <- function(frame, path, force = FALSE) {
+  .h2o.req("POST", paste0("/3/Frames/", .h2o.key(frame$frame_id), "/export"),
+           list(path = path, force = force))
+}
+
+h2o.rm <- function(key) {
+  key <- if (inherits(key, "H2O3Frame")) .h2o.key(key$frame_id) else key
+  invisible(.h2o.req("DELETE", paste0("/3/Frames/", key)))
+}
+
+# -- model builders -----------------------------------------------------------
+
+.h2o.train <- function(algo, y = NULL, x = NULL, training_frame,
+                       validation_frame = NULL, ...) {
+  body <- list(training_frame = .h2o.key(training_frame$frame_id), ...)
+  if (!is.null(y)) body$response_column <- y
+  if (!is.null(x)) body$x <- as.list(x)
+  if (!is.null(validation_frame)) {
+    body$validation_frame <- .h2o.key(validation_frame$frame_id)
+  }
+  res <- .h2o.req("POST", paste0("/3/ModelBuilders/", algo), body)
+  jj <- .h2o.wait_job(res$job)
+  mid <- .h2o.key(jj$dest)  # /3/Jobs reports the model key once DONE
+  if (is.null(mid) || !nzchar(mid)) {
+    models <- .h2o.req("GET", "/3/Models")$models
+    mid <- .h2o.key(models[[length(models)]]$model_id)
+  }
+  structure(list(model_id = mid, algo = algo), class = "H2O3Model")
+}
+
+h2o.gbm <- function(...) .h2o.train("gbm", ...)
+h2o.randomForest <- function(...) .h2o.train("drf", ...)
+h2o.glm <- function(...) .h2o.train("glm", ...)
+h2o.deeplearning <- function(...) .h2o.train("deeplearning", ...)
+h2o.kmeans <- function(...) .h2o.train("kmeans", ...)
+h2o.prcomp <- function(...) .h2o.train("pca", ...)
+h2o.naiveBayes <- function(...) .h2o.train("naivebayes", ...)
+h2o.isolationForest <- function(...) .h2o.train("isolationforest", ...)
+h2o.gam <- function(...) .h2o.train("gam", ...)
+h2o.rulefit <- function(...) .h2o.train("rulefit", ...)
+h2o.upliftRandomForest <- function(...) .h2o.train("upliftdrf", ...)
+h2o.coxph <- function(...) .h2o.train("coxph", ...)
+h2o.psvm <- function(...) .h2o.train("psvm", ...)
+h2o.modelSelection <- function(...) .h2o.train("modelselection", ...)
+h2o.anovaglm <- function(...) .h2o.train("anovaglm", ...)
+h2o.aggregator <- function(...) .h2o.train("aggregator", ...)
+h2o.infogram <- function(...) .h2o.train("infogram", ...)
+h2o.targetencoder <- function(...) .h2o.train("targetencoder", ...)
+h2o.isotonicregression <- function(...) .h2o.train("isotonicregression", ...)
+
+# -- scoring / inspection -----------------------------------------------------
+
+h2o.getModel <- function(id) {
+  res <- .h2o.req("GET", paste0("/3/Models/", id))
+  res$models[[1]]
+}
+
+h2o.predict <- function(model, frame) {
+  res <- .h2o.req("POST", paste0("/3/Predictions/models/", model$model_id,
+                                 "/frames/", .h2o.key(frame$frame_id)), list())
+  structure(list(frame_id = .h2o.key(res$predictions_frame)),
+            class = "H2O3Frame")
+}
+
+h2o.performance <- function(model, frame = NULL) {
+  m <- h2o.getModel(model$model_id)
+  if (is.null(frame)) return(m$output$training_metrics)
+  res <- .h2o.req("POST", paste0("/3/ModelMetrics/models/", model$model_id,
+                                 "/frames/", .h2o.key(frame$frame_id)), list())
+  res$model_metrics
+}
+
+h2o.varimp <- function(model) h2o.getModel(model$model_id)$output$variable_importances
+
+h2o.auc <- function(perf) perf$auc
+h2o.rmse <- function(perf) perf$rmse
+h2o.logloss <- function(perf) perf$logloss
+
+h2o.download_mojo <- function(model, path = ".") {
+  url <- paste0(.h2o3$url, "/3/Models/", model$model_id, "/mojo")
+  dest <- file.path(path, paste0(model$model_id, ".zip"))
+  system2("curl", shQuote(c("-sS", "-o", dest, url)))
+  dest
+}
+
+# -- grids + automl -----------------------------------------------------------
+
+h2o.grid <- function(algo, hyper_params, training_frame, y = NULL, x = NULL,
+                     search_criteria = NULL, parallelism = 1, ...) {
+  body <- list(hyper_parameters = hyper_params,
+               training_frame = .h2o.key(training_frame$frame_id),
+               parallelism = parallelism, ...)
+  if (!is.null(y)) body$response_column <- y
+  if (!is.null(x)) body$x <- as.list(x)
+  if (!is.null(search_criteria)) body$search_criteria <- search_criteria
+  res <- .h2o.req("POST", paste0("/99/Grid/", algo), body)
+  .h2o.wait_job(res$job)
+  .h2o.req("GET", paste0("/99/Grids/", .h2o.key(res$grid_id)))
+}
+
+h2o.automl <- function(y, training_frame, max_models = 10, nfolds = NULL, ...) {
+  build_control <- list(stopping_criteria = list(max_models = max_models))
+  if (!is.null(nfolds)) build_control$nfolds <- nfolds
+  body <- list(
+    build_control = build_control,
+    input_spec = list(
+      training_frame = list(name = .h2o.key(training_frame$frame_id)),
+      response_column = list(column_name = y)),
+    build_models = list(...))
+  res <- .h2o.req("POST", "/99/AutoMLBuilder", body)
+  if (!is.null(res$job)) .h2o.wait_job(res$job)
+  .h2o.req("GET", paste0("/99/AutoML/", .h2o.key(res$automl_id)))
+}
+
+# -- rapids (frame expressions) ----------------------------------------------
+
+h2o.rapids <- function(ast) .h2o.req("POST", "/99/Rapids", list(ast = ast))

@@ -391,3 +391,28 @@ def test_psvm_tracks_sklearn_svc():
         yc, SVC(C=1.0, gamma=1.0 / 3).fit(Xs, yc).decision_function(Xs)
     )
     assert ours > sk - 0.05  # within 5 AUC points of exact kernel SVC
+
+
+def test_gam_no_intercept():
+    rng = np.random.default_rng(21)
+    n = 1200
+    x = rng.normal(size=n)
+    y = np.sin(x) * 2 + 0.05 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "y": y}))
+    g = GAM(gam_columns=["x"], intercept=False).train(y="y", training_frame=fr)
+    assert "Intercept" not in g.output["coef_names"]
+    assert len(g.output["coef_names"]) == len(g.output["beta"])
+    assert g.training_metrics.value("r2") > 0.9  # centered signal still fits
+
+
+def test_modelselection_coef_size_lookup_backward():
+    fr, _ = _lin_frame()
+    m = ModelSelection(mode="backward", min_predictor_number=2).train(
+        y="y", training_frame=fr
+    )
+    sizes = [len(s) for s in m.get_best_model_predictors()]
+    assert min(sizes) == 2  # no size-1 model exists in this run
+    c = m.coef(size=min(sizes))
+    assert isinstance(c, dict) and c
+    with pytest.raises(ValueError, match="available sizes"):
+        m.coef(size=1)

@@ -1,0 +1,83 @@
+"""Lazy client-side H2OFrame (expr.py successor) against a live server."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.client import H2OConnection
+from h2o3_tpu.client_frame import H2OFrame
+from h2o3_tpu.frame.frame import Frame
+
+
+@pytest.fixture(scope="module")
+def server():
+    from h2o3_tpu.api.server import H2OServer
+
+    srv = H2OServer(port=54381)
+    srv.start()
+    yield H2OConnection("http://127.0.0.1:54381")
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def data(server):
+    rng = np.random.default_rng(0)
+    n = 2000
+    df = pd.DataFrame(
+        {"age": rng.integers(18, 80, n).astype(float),
+         "income": rng.normal(50, 12, n),
+         "grp": rng.choice(["a", "b"], n)}
+    )
+    Frame.from_pandas(df, destination_frame="lazy_src", register=True)
+    return df
+
+
+def test_lazy_is_lazy_then_evaluates(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    expr = (fr["income"] + 10) / 2
+    assert expr._key is None  # nothing sent yet
+    got = expr.mean()
+    want = float((data["income"] + 10).mean() / 2)
+    assert abs(got - want) < 1e-4
+
+
+def test_lazy_filter_rows(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    old = fr[fr["age"] > 50]
+    n_old, ncol = old.shape
+    assert n_old == int((data["age"] > 50).sum())
+    assert ncol == 3
+    m = old["income"].mean()
+    want = float(data.loc[data["age"] > 50, "income"].mean())
+    assert abs(m - want) < 1e-3
+
+
+def test_lazy_to_pandas_roundtrip(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    sub = fr[["age", "income"]]
+    pdf = sub.to_pandas()
+    assert list(pdf.columns) == ["age", "income"]
+    assert len(pdf) == len(data)
+    np.testing.assert_allclose(
+        np.sort(pdf["age"]), np.sort(data["age"]), rtol=1e-6
+    )
+
+
+def test_lazy_group_by(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    agg = fr.group_by("grp", income="mean").to_pandas()
+    want = data.groupby("grp")["income"].mean()
+    got = dict(zip(agg.iloc[:, 0], agg.iloc[:, 1]))
+    for g in ("a", "b"):
+        assert abs(got[g] - want[g]) < 1e-3
+
+
+def test_lazy_ifelse_and_reuse(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    flag = (fr["age"] > 50).ifelse(1.0, 0.0)
+    s = flag.sum()
+    assert s == int((data["age"] > 50).sum())
+    # refresh() materializes once; later ops reference the temp key
+    flag.refresh()
+    assert flag._key is not None
+    assert flag.sum() == s

@@ -153,3 +153,21 @@ def test_persist_custom_backend_roundtrip():
     p1 = m.predict(fr).vec("predict").to_numpy()
     p2 = m2.predict(fr).vec("predict").to_numpy()
     np.testing.assert_allclose(p1, p2)
+
+
+def test_sklearn_proba_aligns_with_classes_for_numeric_labels():
+    from sklearn.metrics import log_loss
+
+    from h2o3_tpu.sklearn import H2OGradientBoostingClassifier
+
+    rng = np.random.default_rng(9)
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    y = np.where(X[:, 0] > 0, 10, 2)  # lexicographic order '10' < '2'
+    m = H2OGradientBoostingClassifier(ntrees=10, max_depth=3, seed=1).fit(X, y)
+    assert list(m.classes_) == [10, 2]  # domain order, not numeric order
+    proba = m.predict_proba(X)
+    # column i must be P(classes_[i]): the class-10 column is high when x0>0
+    i10 = list(m.classes_).index(10)
+    assert proba[X[:, 0] > 1.0, i10].mean() > 0.9
+    assert log_loss(y, proba, labels=list(m.classes_)) < 0.3
