@@ -67,6 +67,10 @@ def _emit(payload: dict) -> None:
 
 
 def _emit_error(stage: str, exc: BaseException) -> None:
+    # format_exc only when an exception is actually active (the watchdog
+    # constructs its TimeoutError without raising, where format_exc would
+    # emit the useless "NoneType: None")
+    tb = traceback.format_exc(limit=20) if sys.exc_info()[0] is not None else ""
     _emit(
         {
             "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH})",
@@ -74,30 +78,57 @@ def _emit_error(stage: str, exc: BaseException) -> None:
             "unit": "trees/sec/chip",
             "vs_baseline": 0.0,
             "error": f"{stage}: {exc!r}",
-            "traceback": traceback.format_exc(limit=20),
+            "traceback": tb,
         }
     )
 
 
+INIT_WATCHDOG_S = 420.0  # backend init can HANG (dead tunnel), not just fail
+
+
 def _init_with_retry():
-    """Backend bring-up with bounded retry — TPU runtime boot can flake."""
+    """Backend bring-up with bounded retry — TPU runtime boot can flake.
+
+    A watchdog covers the hang mode (a wedged tunnel blocks inside
+    ``jax.devices()`` forever, which no exception-retry can catch): if init
+    hasn't completed within INIT_WATCHDOG_S, the error JSON is emitted and
+    the process exits hard, so the driver always gets parseable output.
+    """
+    import os
+    import threading
+
     import h2o3_tpu
 
-    last = None
-    for attempt in range(INIT_RETRIES):
-        try:
-            info = h2o3_tpu.init(log_level="WARN")
-            # force a real device round-trip so a half-up backend fails HERE
-            import jax
-            import jax.numpy as jnp
+    def _die():
+        _emit_error("init", TimeoutError(
+            f"backend init hung > {INIT_WATCHDOG_S:.0f}s (tunnel down?)"
+        ))
+        sys.stdout.flush()
+        os._exit(2)
 
-            jnp.zeros(8).block_until_ready()
-            return info
-        except Exception as e:  # noqa: BLE001 — any backend error retries
-            last = e
-            if attempt < INIT_RETRIES - 1:
-                time.sleep(INIT_RETRY_SLEEP_S * (attempt + 1))
-    raise RuntimeError(f"backend init failed after {INIT_RETRIES} attempts") from last
+    watchdog = threading.Timer(INIT_WATCHDOG_S, _die)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        last = None
+        for attempt in range(INIT_RETRIES):
+            try:
+                info = h2o3_tpu.init(log_level="WARN")
+                # force a real device round-trip so a half-up backend fails HERE
+                import jax
+                import jax.numpy as jnp
+
+                jnp.zeros(8).block_until_ready()
+                return info
+            except Exception as e:  # noqa: BLE001 — any backend error retries
+                last = e
+                if attempt < INIT_RETRIES - 1:
+                    time.sleep(INIT_RETRY_SLEEP_S * (attempt + 1))
+        raise RuntimeError(
+            f"backend init failed after {INIT_RETRIES} attempts"
+        ) from last
+    finally:
+        watchdog.cancel()
 
 
 def _phase_breakdown(fr, n_trees: int, total_s: float) -> tuple[dict, float]:

@@ -49,6 +49,10 @@ class AutoMLSpec:
     # ["target_encoding"] enables TE preprocessing of categorical features
     # (ai.h2o.automl preprocessing=["target_encoding"] analog)
     preprocessing: Sequence[str] | None = None
+    # > 0 enables the exploitation phase (h2o's exploitation_ratio): the
+    # incumbent best GBM is refined with annealed learn-rate + more trees,
+    # and the refinement build is capped at ratio * max_runtime_secs
+    exploitation_ratio: float = 0.0
 
 
 class Leaderboard:
@@ -148,6 +152,7 @@ def _default_plan() -> list[_Step]:
             },
             weight=30,
         ),
+        _Step("exploit_gbm_lr_annealing", "exploit", "gbm", weight=10),
         _Step("se_best_of_family", "ensemble", "stackedensemble", dict(flavor="best_of_family")),
         _Step("se_all", "ensemble", "stackedensemble", dict(flavor="all")),
     ]
@@ -212,6 +217,36 @@ class AutoML:
 
     def _builder(self, algo: str, params: dict):
         return self._builder_cls(algo)(**params)
+
+    def _exploit_gbm(self, family_best, x, y, train, validation_frame):
+        """Exploitation: retrain the incumbent best GBM with halved
+        learn_rate and doubled trees (upstream's lr_annealing refinement)."""
+        best = family_best.get("gbm")
+        if best is None:
+            return None
+        s = self.spec
+        # the exploitation budget IS the ratio share of the total budget,
+        # additionally capped by whatever remains of the run
+        budget = 0.0
+        if s.max_runtime_secs:
+            budget = min(
+                s.max_runtime_secs * s.exploitation_ratio,
+                max(self._remaining(), 1.0),
+            )
+        p = best.params
+        m = self._builder("gbm", {
+            **self._common(),
+            "ntrees": max(p.ntrees * 2, p.ntrees + 50),
+            "max_depth": p.max_depth,
+            "learn_rate": max(p.learn_rate * 0.5, 1e-3),
+            "sample_rate": p.sample_rate,
+            "col_sample_rate": p.col_sample_rate,
+            "max_runtime_secs": budget,
+        }).train(x=x, y=y, training_frame=train,
+                 validation_frame=validation_frame)
+        if self._te is not None:
+            m.preprocessors.append(self._te)
+        return m
 
     def _common(self) -> dict:
         # seed passes through verbatim: seed<=0 keeps each builder's own
@@ -288,7 +323,10 @@ class AutoML:
             if self._remaining() <= 0:
                 self._log("budget", "max_runtime_secs exhausted; stopping plan")
                 break
-            if s.max_models and n_models_built >= s.max_models and st.kind != "ensemble":
+            # ensembles and exploitation never count against max_models
+            # (upstream: SEs are always attempted; exploitation is gated on
+            # its own budget ratio)
+            if s.max_models and n_models_built >= s.max_models and st.kind not in ("ensemble", "exploit"):
                 done_w += st.weight
                 job.update(done_w / total_w)
                 continue
@@ -327,6 +365,16 @@ class AutoML:
                     for m in grid.models:
                         self._update_family_best(family_best, m)
                     self._log("grid", f"{st.name} built {len(grid.models)} models")
+                elif st.kind == "exploit":
+                    if s.exploitation_ratio <= 0:
+                        pass  # disabled by default, like upstream
+                    else:
+                        m = self._exploit_gbm(family_best, x, y, train, validation_frame)
+                        if m is not None:
+                            self.leaderboard.add(m)
+                            n_models_built += 1
+                            self._update_family_best(family_best, m)
+                            self._log("exploit", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
                 elif st.kind == "ensemble":
                     m = self._build_ensemble(st, family_best, y, train, validation_frame)
                     if m is not None:

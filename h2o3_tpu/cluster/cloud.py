@@ -77,13 +77,32 @@ def init(
 
 def cluster_info() -> dict:
     m = _mesh.get_mesh()
+    # per-device health (the /3/Cloud node-table analog): a device that
+    # errors on the stats probe reports unhealthy instead of killing the route
+    nodes = []
+    healthy = True
+    # only addressable devices are probed: remote hosts' devices reject
+    # memory_stats and must not mark a healthy multi-host cloud unhealthy
+    for d in jax.local_devices():
+        node = {"id": d.id, "platform": d.platform,
+                "process": getattr(d, "process_index", 0), "healthy": True}
+        try:
+            stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+            if stats:
+                node["mem_in_use"] = stats.get("bytes_in_use")
+                node["mem_limit"] = stats.get("bytes_limit")
+        except Exception:  # noqa: BLE001 — health probe must not throw
+            node["healthy"] = False
+            healthy = False
+        nodes.append(node)
     return {
         "version": "h2o3_tpu",
-        "cloud_healthy": True,
+        "cloud_healthy": healthy,
         "cloud_size": len(jax.devices()),
         "processes": jax.process_count(),
         "platform": jax.devices()[0].platform,
         "mesh": dict(m.shape),
+        "nodes": nodes,
         "uptime_ms": int((time.time() - _started_at) * 1e3) if _started_at else 0,
     }
 

@@ -43,6 +43,10 @@ class Job:
         self._work = work
         self._cancel_requested = threading.Event()
         self._thread: threading.Thread | None = None
+        # soft deadline (epoch secs): work loops poll stop_requested and
+        # truncate GRACEFULLY (partial model kept) — unlike cancel(), which
+        # aborts via the JobCancelled raise in update()
+        self.soft_deadline: float | None = None
         DKV.put(self.key, self)
 
     # -- driver-side API (the work callable calls these) --
@@ -53,7 +57,9 @@ class Job:
 
     @property
     def stop_requested(self) -> bool:
-        return self._cancel_requested.is_set()
+        if self._cancel_requested.is_set():
+            return True
+        return self.soft_deadline is not None and time.time() > self.soft_deadline
 
     # -- client-side API --
     def start(self) -> "Job":

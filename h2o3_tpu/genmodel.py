@@ -136,6 +136,9 @@ class _TreeMojo(MojoModel):
         nbins = self.arrays["bin_nbins"]
         edges = self.arrays["bin_edges"]
         doms = self.meta["bin_domains"]
+        from h2o3_tpu import native
+
+        use_native = native.enabled()
         cols = []
         for ci, name in enumerate(names):
             if is_cat[ci]:
@@ -147,10 +150,30 @@ class _TreeMojo(MojoModel):
                 # codes match exactly even for edge-adjacent values.
                 x = _col_numeric(table, name, n).astype(np.float32)
                 e = edges[ci][: max(int(nbins[ci]) - 1, 0)].astype(np.float32)
-                b = np.searchsorted(e, x, side="left") + 1
-                b[np.isnan(x)] = 0
+                if use_native:
+                    from h2o3_tpu import native
+
+                    b = native.bin_numeric(x, e)
+                else:
+                    b = np.searchsorted(e, x, side="left") + 1
+                    b[np.isnan(x)] = 0
             cols.append(b.astype(np.int64))
         return np.stack(cols, axis=1)
+
+    def _forest_sums(self, bins, n: int, K: int, shapes) -> np.ndarray:
+        """(n, K) leaf sums over the forest — native C++ walk when the
+        library builds (row-major, per-row early exit), numpy level replay
+        otherwise. Both accumulate f32 leaves into f64 in the same order, so
+        results are bit-identical (the parity tests pin this)."""
+        from h2o3_tpu import native
+
+        if native.enabled():
+            return native.score_forest(self, bins)
+        F = np.zeros((n, K), np.float64)
+        for ti, class_levels in enumerate(shapes):
+            for ki in range(K):
+                F[:, ki] += self._walk_tree(bins, ti, ki, class_levels[ki])
+        return F
 
     def _walk_tree(self, bins: np.ndarray, ti: int, ki: int, n_levels: int) -> np.ndarray:
         n = bins.shape[0]
@@ -187,10 +210,7 @@ class _TreeMojo(MojoModel):
         K = self.meta["n_tree_classes"]
         shapes = self.meta["tree_levels"]
         n = bins.shape[0]
-        F = np.zeros((n, K), np.float64)
-        for ti, class_levels in enumerate(shapes):
-            for ki in range(K):
-                F[:, ki] += self._walk_tree(bins, ti, ki, class_levels[ki])
+        F = self._forest_sums(bins, n, K, shapes)
 
         if self.algo in ("drf", "xrt"):
             avg = F / max(self.meta["ntrees_actual"], 1)

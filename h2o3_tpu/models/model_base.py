@@ -288,6 +288,12 @@ class ModelBuilder:
     def _drive(self, job: Job, train: Frame, valid: Frame | None):
         p = self.params
         t = Timer()
+        if getattr(p, "max_runtime_secs", 0.0):
+            # soft budget: iterative builders poll job.stop_requested and
+            # keep the partial model (h2o's per-model max_runtime contract)
+            import time as _time
+
+            job.soft_deadline = _time.time() + float(p.max_runtime_secs)
         self._validate(train, valid)
         if getattr(p, "checkpoint", None) is not None and p.nfolds and p.nfolds > 1:
             raise ValueError("checkpoint cannot be combined with cross-validation")

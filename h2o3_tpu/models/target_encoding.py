@@ -38,11 +38,28 @@ class TargetEncoderParams:
 class TargetEncoder:
     """fit/transform pair mirroring the h2o-py TargetEncoder surface."""
 
+    algo = "targetencoder"
+    PARAMS_CLS = TargetEncoderParams
+
     def __init__(self, **kw):
         self.params = TargetEncoderParams(**kw)
         self._stats: dict[str, tuple[np.ndarray, np.ndarray, tuple]] = {}
         self._prior: float = 0.0
         self._y: str | None = None
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **kw):
+        """ModelBuilder-shaped entry so the REST surface and estimator
+        classes can drive TE like any other algo (h2o exposes targetencoder
+        as a regular builder)."""
+        from h2o3_tpu.cluster.registry import DKV
+        from h2o3_tpu.models.model_base import _resolve_frame
+
+        fr = _resolve_frame(training_frame)
+        self.fit(fr, y=y, columns=list(x) if x else None)
+        self.key = DKV.make_key("targetencoder")
+        DKV.put(self.key, self)
+        return self
 
     # -- fit ----------------------------------------------------------------
     def fit(self, frame: Frame, y: str, columns: Sequence[str] | None = None):

@@ -1,0 +1,97 @@
+// Native tmojo scoring runtime — successor of the h2o-genmodel scoring
+// core (`hex.genmodel.easy.EasyPredictModelWrapper` / `CompressedTree.score0`)
+// [UNVERIFIED upstream paths, SURVEY.md §2.3]: the offline, cluster-free,
+// jax-free tree-forest scorer, in C++ for deployment surfaces where the
+// Python/numpy replay (h2o3_tpu/genmodel.py) is too slow or unavailable.
+//
+// Design: the Python loader (h2o3_tpu/native.py) flattens the tmojo level
+// arrays into contiguous buffers once; this library walks trees row-major
+// with per-row early exit — each row touches only the nodes on its own
+// root->leaf path, unlike the level-synchronous numpy replay that streams
+// every level array over all rows. Plain C ABI so ctypes can bind it with
+// no build-time Python dependency.
+//
+// Layout contract (all buffers little-endian, C-contiguous):
+//   bins        (n_rows, n_cols) uint8 — bin codes, 0 = NA
+//   For every (tree t, class k), levels are consecutive entries in the
+//   global level table:  tk_level_start[t*K+k] .. +tk_level_count[t*K+k].
+//   Level L's nodes live at node offset lvl_node_off[L] in the node arrays;
+//   cat_mask is (node, B) flattened.
+//
+// Build: g++ -O3 -shared -fPIC [-fopenmp] tmojo_score.cpp -o libtmojo.so
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Score the whole forest: out (n_rows, K) += sum over trees of leaf values.
+void tmojo_score_forest(
+    const uint8_t* bins, int64_t n_rows, int64_t n_cols,
+    int64_t n_trees, int64_t K,
+    const int64_t* tk_level_start,   // (n_trees*K)
+    const int64_t* tk_level_count,   // (n_trees*K)
+    const int64_t* lvl_node_off,     // (total_levels)
+    const int32_t* split_col,
+    const int32_t* split_bin,
+    const uint8_t* is_cat,
+    const uint8_t* cat_mask, int64_t B,
+    const uint8_t* na_left,
+    const uint8_t* leaf_now,
+    const float* leaf_val,
+    const int32_t* child_base,
+    double* out)                      // (n_rows, K), caller-zeroed
+{
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const uint8_t* row = bins + r * n_cols;
+        double* orow = out + r * K;
+        for (int64_t t = 0; t < n_trees; ++t) {
+            for (int64_t k = 0; k < K; ++k) {
+                const int64_t lv0 = tk_level_start[t * K + k];
+                const int64_t nlv = tk_level_count[t * K + k];
+                int64_t nid = 0;
+                for (int64_t l = 0; l < nlv; ++l) {
+                    const int64_t off = lvl_node_off[lv0 + l] + nid;
+                    if (leaf_now[off]) {
+                        orow[k] += (double)leaf_val[off];
+                        break;
+                    }
+                    const uint8_t b = row[split_col[off]];
+                    bool left;
+                    if (b == 0) {
+                        left = na_left[off] != 0;
+                    } else if (is_cat[off]) {
+                        left = cat_mask[off * B + b] != 0;
+                    } else {
+                        left = (int32_t)b <= split_bin[off];
+                    }
+                    nid = (int64_t)child_base[off] + (left ? 0 : 1);
+                }
+            }
+        }
+    }
+}
+
+// Bin numeric features exactly like the device path: float32 values against
+// float32 right-open edges (searchsorted side="left"), code 0 for NaN.
+void tmojo_bin_numeric(
+    const float* x, int64_t n, const float* edges, int64_t n_edges,
+    uint8_t* out)
+{
+    for (int64_t i = 0; i < n; ++i) {
+        const float v = x[i];
+        if (v != v) { out[i] = 0; continue; }  // NaN
+        // branchless-ish binary search: first edge >= v
+        int64_t lo = 0, hi = n_edges;
+        while (lo < hi) {
+            const int64_t mid = (lo + hi) >> 1;
+            if (edges[mid] < v) lo = mid + 1; else hi = mid;
+        }
+        out[i] = (uint8_t)(lo + 1);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Bindings codegen — successor of the ``h2o-bindings`` generator
+[UNVERIFIED upstream paths, SURVEY.md §2.3]: upstream generates the per-algo
+Python/R estimator classes from the live REST schemas; here the params
+dataclasses ARE the schema source, and this tool renders them into a
+standalone, dependency-explicit estimators module (one class per algo, every
+parameter an explicit keyword argument with its default and type in the
+signature — greppable and IDE-completable, unlike the runtime-generated
+classes in h2o3_tpu/estimators.py which stay the import-light default).
+
+Usage:  python tools/gen_bindings.py [out.py]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+HEADER = '''"""GENERATED FILE — do not edit. Regenerate with tools/gen_bindings.py.
+
+Explicit per-algorithm estimator classes rendered from the builder params
+dataclasses (the codegen analog of upstream's h2o-bindings output).
+"""
+
+from h2o3_tpu.estimators import _EstimatorBase
+
+
+'''
+
+ALGOS = [
+    ("H2OGradientBoostingEstimator", "GBM"),
+    ("H2ORandomForestEstimator", "DRF"),
+    ("H2OXRTEstimator", "XRT"),
+    ("H2OGeneralizedLinearEstimator", "GLM"),
+    ("H2ODeepLearningEstimator", "DeepLearning"),
+    ("H2OKMeansEstimator", "KMeans"),
+    ("H2OPrincipalComponentAnalysisEstimator", "PCA"),
+    ("H2OSingularValueDecompositionEstimator", "SVD"),
+    ("H2ONaiveBayesEstimator", "NaiveBayes"),
+    ("H2OIsolationForestEstimator", "IsolationForest"),
+    ("H2OExtendedIsolationForestEstimator", "ExtendedIsolationForest"),
+    ("H2OGeneralizedLowRankEstimator", "GLRM"),
+    ("H2OCoxProportionalHazardsEstimator", "CoxPH"),
+    ("H2OIsotonicRegressionEstimator", "IsotonicRegression"),
+    ("H2OAdaBoostEstimator", "AdaBoost"),
+    ("H2ODecisionTreeEstimator", "DT"),
+    ("H2OWord2vecEstimator", "Word2Vec"),
+    ("H2OStackedEnsembleEstimator", "StackedEnsemble"),
+    ("H2OTargetEncoderEstimator", "TargetEncoder"),
+    ("H2ORuleFitEstimator", "RuleFit"),
+    ("H2OUpliftRandomForestEstimator", "UpliftDRF"),
+    ("H2OGeneralizedAdditiveEstimator", "GAM"),
+    ("H2OModelSelectionEstimator", "ModelSelection"),
+    ("H2OANOVAGLMEstimator", "ANOVAGLM"),
+    ("H2OAggregatorEstimator", "Aggregator"),
+    ("H2OInfogramEstimator", "Infogram"),
+    ("H2OSupportVectorMachineEstimator", "PSVM"),
+]
+
+
+def _val_repr(v) -> str:
+    if isinstance(v, float):
+        if v != v:
+            return 'float("nan")'
+        if v in (float("inf"), float("-inf")):
+            return f'float("{"" if v > 0 else "-"}inf")'
+    return repr(v)
+
+
+def _default_repr(f: dataclasses.Field) -> str:
+    if f.default is not dataclasses.MISSING:
+        return _val_repr(f.default)
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        return _val_repr(f.default_factory())
+    return "None"
+
+
+def render() -> str:
+    from h2o3_tpu import models as M
+
+    out = [HEADER]
+    for cls_name, builder in ALGOS:
+        params_cls = getattr(M, builder).PARAMS_CLS
+        fields = [f for f in dataclasses.fields(params_cls)
+                  if f.name not in ("training_frame", "validation_frame")]
+        sig_lines = [f"        {f.name}={_default_repr(f)}," for f in fields]
+        kw_lines = [f"            {f.name}={f.name}," for f in fields]
+        doc_lines = [
+            f"    {f.name}: {getattr(f.type, '__name__', f.type)}"
+            f" (default {_default_repr(f)})"
+            for f in fields
+        ]
+        out.append(
+            f"class {cls_name}(_EstimatorBase):\n"
+            f'    """{builder} estimator (generated).\n\n'
+            "    Parameters\n    ----------\n"
+            + "\n".join(doc_lines)
+            + '\n    """\n\n'
+            f'    _BUILDER = "{builder}"\n\n'
+            "    def __init__(\n        self,\n        model_id=None,\n"
+            + "\n".join(sig_lines)
+            + "\n    ):\n"
+            "        kw = dict(\n"
+            + "\n".join(kw_lines)
+            + "\n        )\n"
+            "        defaults = {\n"
+            + "\n".join(
+                f"            {f.name!r}: {_default_repr(f)}," for f in fields
+            )
+            + "\n        }\n"
+            "        kw = {k: v for k, v in kw.items() if v != defaults[k]}\n"
+            "        super().__init__(model_id=model_id, **kw)\n\n"
+        )
+    out.append(
+        "__all__ = [\n"
+        + "\n".join(f"    {n!r}," for n, _ in ALGOS)
+        + "\n]\n"
+    )
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    dest = sys.argv[1] if len(sys.argv) > 1 else "h2o3_tpu/estimators_gen.py"
+    code = render()
+    with open(dest, "w") as f:
+        f.write(code)
+    print(f"wrote {dest} ({len(code.splitlines())} lines, {len(ALGOS)} classes)")
