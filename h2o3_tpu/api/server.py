@@ -39,7 +39,7 @@ _ALGOS = ("gbm", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
           "isotonicregression", "decisiontree", "adaboost",
           "extendedisolationforest", "targetencoder", "glrm", "coxph",
           "word2vec", "rulefit", "upliftdrf", "gam", "modelselection",
-          "anovaglm", "aggregator", "infogram", "psvm")
+          "anovaglm", "aggregator", "infogram", "psvm", "hglm")
 
 
 def _builder_cls(algo: str):
@@ -59,6 +59,7 @@ def _builder_cls(algo: str):
         "upliftdrf": M.UpliftDRF, "gam": M.GAM,
         "modelselection": M.ModelSelection, "anovaglm": M.ANOVAGLM,
         "aggregator": M.Aggregator, "infogram": M.Infogram, "psvm": M.PSVM,
+        "hglm": M.HGLM,
     }[algo]
 
 

@@ -225,25 +225,26 @@ class AutoML:
         if best is None:
             return None
         s = self.spec
-        # the exploitation budget IS the ratio share of the total budget,
-        # additionally capped by whatever remains of the run
-        budget = 0.0
-        if s.max_runtime_secs:
-            budget = min(
-                s.max_runtime_secs * s.exploitation_ratio,
-                max(self._remaining(), 1.0),
-            )
         p = best.params
-        m = self._builder("gbm", {
+        kw = {
             **self._common(),
             "ntrees": max(p.ntrees * 2, p.ntrees + 50),
             "max_depth": p.max_depth,
             "learn_rate": max(p.learn_rate * 0.5, 1e-3),
             "sample_rate": p.sample_rate,
             "col_sample_rate": p.col_sample_rate,
-            "max_runtime_secs": budget,
-        }).train(x=x, y=y, training_frame=train,
-                 validation_frame=validation_frame)
+        }
+        # the exploitation budget IS the ratio share of the total budget,
+        # additionally capped by whatever remains of the run; with no total
+        # budget the per-model cap from _common() stays in force
+        if s.max_runtime_secs:
+            kw["max_runtime_secs"] = min(
+                s.max_runtime_secs * s.exploitation_ratio,
+                max(self._remaining(), 1.0),
+            )
+        m = self._builder("gbm", kw).train(
+            x=x, y=y, training_frame=train, validation_frame=validation_frame
+        )
         if self._te is not None:
             m.preprocessors.append(self._te)
         return m

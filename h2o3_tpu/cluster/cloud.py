@@ -31,25 +31,45 @@ def init(
     num_processes: int | None = None,
     process_id: int | None = None,
     mesh=None,
-    log_level: str = "INFO",
+    log_level: str | None = None,
 ) -> dict:
     """Bring up (or attach to) the cloud and build the row mesh.
 
     Mirrors ``h2o.init()``: idempotent, returns cluster status. For
     multi-host pods pass the coordinator address (maps to
     ``jax.distributed.initialize``, the Paxos/flatfile successor).
+    ``log_level`` defaults from the H2O3_TPU_LOG_LEVEL knob (config.py).
     """
     global _started_at
-    Log.set_level(log_level)
+    from h2o3_tpu import config
+
+    Log.set_level(log_level or config.get("H2O3_TPU_LOG_LEVEL"))
     # Persistent XLA compilation cache (SURVEY.md §7: compile-latency
     # amortization across the many small jit programs of AutoML/tree loops).
-    cache_dir = os.environ.get("H2O3_TPU_COMPILE_CACHE")
-    if cache_dir is None:
+    # ACCELERATOR BACKENDS ONLY: XLA:CPU cache entries are AOT-compiled with
+    # the builder machine's exact CPU features; loading them on a host with
+    # a different feature set is a documented SIGILL/segfault hazard (the
+    # cpu_aot_loader "machine type mismatch" error), observed crashing the
+    # test suite inside cache (de)serialization. CPU compiles are fast
+    # enough to skip caching entirely.
+    cache_dir = config.get("H2O3_TPU_COMPILE_CACHE")
+    if not cache_dir:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         cache_dir = os.path.join(pkg_root, ".jax_cache")
     try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # decide from the DECLARED platform, not jax.default_backend() —
+        # touching the backend here would break the later
+        # jax.distributed.initialize() (must run before any backend init).
+        # Only an explicit cpu declaration disables the cache (auto-detected
+        # accelerators keep it; our test/driver cpu runs always declare).
+        plat = (os.environ.get("JAX_PLATFORMS") or str(
+            jax.config.jax_platforms or "")).lower()
+        if plat == "cpu":
+            Log.debug("compile cache skipped on XLA:CPU (AOT feature-"
+                      "mismatch SIGILL hazard)")
+        else:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception as e:  # cache is an optimization, never fatal — but say so
         Log.warn(f"compilation cache disabled: {e}")
     if coordinator is not None and not jax.distributed.is_initialized():

@@ -1,0 +1,52 @@
+"""Unified runtime configuration — successor of the upstream flag/config
+tree (``H2O.OptArgs`` launcher args + system properties) [UNVERIFIED
+upstream paths, SURVEY.md §5.6].
+
+One place defines every environment knob, its default, and its doc; every
+subsystem reads through :func:`get` so ``python -c "import h2o3_tpu.config as
+c; print(c.describe())"`` is the single source of truth for operators.
+
+Knobs (env var → meaning):
+- ``H2O3_TPU_NATIVE``        "0" disables the C++ scoring runtime (native.py)
+- ``H2O3_TPU_HIST``          "matmul" forces the XLA matmul histogram over Pallas
+- ``H2O3_TPU_STREAM_BYTES``  CSV size threshold that flips parse to streaming
+- ``H2O3_TPU_PORT``          default REST port
+- ``H2O3_TPU_LOG_LEVEL``     default log level for init()
+"""
+
+from __future__ import annotations
+
+import os
+
+_KNOBS: dict[str, tuple[str, str]] = {
+    # name -> (default, doc)
+    "H2O3_TPU_NATIVE": ("1", "C++ scoring runtime on (1) / off (0)"),
+    "H2O3_TPU_HIST": ("", "histogram impl override: '' auto, 'matmul' forces XLA"),
+    "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
+                              "CSV bytes above which parse streams in chunks"),
+    "H2O3_TPU_PORT": ("54321", "default REST port"),
+    "H2O3_TPU_LOG_LEVEL": ("INFO", "default log level"),
+    "H2O3_TPU_COMPILE_CACHE": ("", "XLA compile-cache dir ('' = <pkg>/.jax_cache)"),
+}
+
+
+def get(name: str) -> str:
+    default, _ = _KNOBS[name]
+    return os.environ.get(name, default)
+
+
+def get_int(name: str) -> int:
+    return int(get(name))
+
+
+def get_bool(name: str) -> bool:
+    return get(name) not in ("0", "false", "False", "")
+
+
+def describe() -> str:
+    lines = ["h2o3_tpu runtime configuration:"]
+    for name, (default, doc) in _KNOBS.items():
+        cur = os.environ.get(name)
+        mark = f"{cur!r} (env)" if cur is not None else f"{default!r} (default)"
+        lines.append(f"  {name:24s} = {mark:24s} — {doc}")
+    return "\n".join(lines)

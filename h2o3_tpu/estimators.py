@@ -157,4 +157,5 @@ __all__ = [
     _make("H2OAggregatorEstimator", "Aggregator"),
     _make("H2OInfogramEstimator", "Infogram"),
     _make("H2OSupportVectorMachineEstimator", "PSVM"),
+    _make("H2OHGLMEstimator", "HGLM"),
 ]

@@ -2907,6 +2907,107 @@ class H2OSupportVectorMachineEstimator(_EstimatorBase):
         super().__init__(model_id=model_id, **kw)
 
 
+class H2OHGLMEstimator(_EstimatorBase):
+    """HGLM estimator (generated).
+
+    Parameters
+    ----------
+    response_column: str | None (default None)
+    ignored_columns: Sequence[str] (default ())
+    weights_column: str | None (default None)
+    offset_column: str | None (default None)
+    nfolds: int (default 0)
+    fold_assignment: str (default 'modulo')
+    keep_cross_validation_predictions: bool (default False)
+    seed: int (default -1)
+    max_runtime_secs: float (default 0.0)
+    stopping_rounds: int (default 0)
+    stopping_metric: str (default 'AUTO')
+    stopping_tolerance: float (default 0.001)
+    checkpoint: Any (default None)
+    export_checkpoints_dir: str | None (default None)
+    random_columns: list (default [])
+    method: str (default 'EM')
+    max_iterations: int (default 100)
+    em_epsilon: float (default 1e-06)
+    standardize: bool (default False)
+    intercept: bool (default True)
+    """
+
+    _BUILDER = "HGLM"
+
+    def __init__(
+        self,
+        model_id=None,
+        response_column=None,
+        ignored_columns=(),
+        weights_column=None,
+        offset_column=None,
+        nfolds=0,
+        fold_assignment='modulo',
+        keep_cross_validation_predictions=False,
+        seed=-1,
+        max_runtime_secs=0.0,
+        stopping_rounds=0,
+        stopping_metric='AUTO',
+        stopping_tolerance=0.001,
+        checkpoint=None,
+        export_checkpoints_dir=None,
+        random_columns=[],
+        method='EM',
+        max_iterations=100,
+        em_epsilon=1e-06,
+        standardize=False,
+        intercept=True,
+    ):
+        kw = dict(
+            response_column=response_column,
+            ignored_columns=ignored_columns,
+            weights_column=weights_column,
+            offset_column=offset_column,
+            nfolds=nfolds,
+            fold_assignment=fold_assignment,
+            keep_cross_validation_predictions=keep_cross_validation_predictions,
+            seed=seed,
+            max_runtime_secs=max_runtime_secs,
+            stopping_rounds=stopping_rounds,
+            stopping_metric=stopping_metric,
+            stopping_tolerance=stopping_tolerance,
+            checkpoint=checkpoint,
+            export_checkpoints_dir=export_checkpoints_dir,
+            random_columns=random_columns,
+            method=method,
+            max_iterations=max_iterations,
+            em_epsilon=em_epsilon,
+            standardize=standardize,
+            intercept=intercept,
+        )
+        defaults = {
+            'response_column': None,
+            'ignored_columns': (),
+            'weights_column': None,
+            'offset_column': None,
+            'nfolds': 0,
+            'fold_assignment': 'modulo',
+            'keep_cross_validation_predictions': False,
+            'seed': -1,
+            'max_runtime_secs': 0.0,
+            'stopping_rounds': 0,
+            'stopping_metric': 'AUTO',
+            'stopping_tolerance': 0.001,
+            'checkpoint': None,
+            'export_checkpoints_dir': None,
+            'random_columns': [],
+            'method': 'EM',
+            'max_iterations': 100,
+            'em_epsilon': 1e-06,
+            'standardize': False,
+            'intercept': True,
+        }
+        kw = {k: v for k, v in kw.items() if v != defaults[k]}
+        super().__init__(model_id=model_id, **kw)
+
+
 __all__ = [
     'H2OGradientBoostingEstimator',
     'H2ORandomForestEstimator',
@@ -2935,4 +3036,5 @@ __all__ = [
     'H2OAggregatorEstimator',
     'H2OInfogramEstimator',
     'H2OSupportVectorMachineEstimator',
+    'H2OHGLMEstimator',
 ]

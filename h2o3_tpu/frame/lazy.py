@@ -1,0 +1,234 @@
+"""Vec flavors — successor of the upstream Vec zoo (``FileVec`` lazy
+file-backed columns, ``CategoricalWrappedVec`` domain-remap views)
+[UNVERIFIED upstream paths, SURVEY.md §2.1].
+
+Upstream keeps cold columns on disk and materializes chunks on demand, and
+wraps categorical vecs in remap views instead of rewriting codes. The TPU
+analogs:
+
+- :class:`LazyVec` — a column whose HBM materialization is deferred to
+  first ``.data`` touch: the loader (a column read of the source file) runs
+  once, pads, shards, caches. A wide file imported with ``lazy=True`` only
+  ships the columns a model actually uses to the device — HBM is the scarce
+  resource the upstream FileVec design protects on the JVM heap.
+- :class:`WrappedCatVec` — a categorical remap view: shares the base vec's
+  device codes and applies the (tiny) old→new code LUT lazily as one device
+  gather on first touch, instead of rewriting the column eagerly.
+
+Construction: ``h2o3_tpu.import_file(path, lazy=True)`` (CSV/Parquet).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import CAT, STR, TIME, Frame, Vec
+from h2o3_tpu.parallel.mesh import pad_to_shards
+
+
+class LazyVec(Vec):
+    """File-backed column; device materialization deferred to first touch."""
+
+    def __init__(self, loader: Callable[[], np.ndarray], kind: str,
+                 name: str, nrow: int, domain=None):
+        # deliberately NOT calling Vec.__init__: `data` is a property here
+        self.kind = kind
+        self.name = name
+        self.domain = tuple(domain) if domain is not None else None
+        self.nrow = nrow
+        self._loader = loader
+        self._vec: Vec | None = None
+        self._stats = None
+
+    def _materialize(self) -> Vec:
+        if self._vec is None:
+            arr = self._loader()
+            assert len(arr) == self.nrow, (
+                f"lazy column {self.name!r}: loader returned {len(arr)} rows, "
+                f"expected {self.nrow}"
+            )
+            if self.kind == CAT and self.domain is None:
+                # intern now (sorted order, like the eager parser)
+                vals = np.asarray(arr, dtype=object)
+                levels = sorted({str(v) for v in vals if v is not None
+                                 and v == v})
+                lut = {v: i for i, v in enumerate(levels)}
+                codes = np.asarray(
+                    [lut.get(str(v), -1) if v is not None and v == v else -1
+                     for v in vals], np.int32,
+                )
+                self.domain = tuple(levels)
+                arr = codes
+            self._vec = Vec.from_numpy(
+                np.asarray(arr), self.kind, name=self.name, domain=self.domain
+            )
+            self._stats = None
+            self._loader = None  # release the closure (may pin file handles)
+        return self._vec
+
+    # -- deferred surfaces ---------------------------------------------------
+    @property
+    def data(self):
+        return self._materialize().data
+
+    @data.setter
+    def data(self, v) -> None:  # some internal paths assign; force through
+        self._materialize().data = v
+
+    @property
+    def _host(self):
+        return self._materialize()._host
+
+    @_host.setter
+    def _host(self, v) -> None:
+        self._materialize()._host = v
+
+    @property
+    def npad(self) -> int:
+        return pad_to_shards(self.nrow)
+
+    @property
+    def cardinality(self) -> int:
+        if self.kind == CAT and self.domain is None:
+            self._materialize()
+        return len(self.domain) if self.domain else -1
+
+    def levels(self) -> list[str]:
+        if self.kind == CAT and self.domain is None:
+            self._materialize()
+        return list(self.domain) if self.domain else []
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._vec is not None
+
+    def stats(self) -> dict:
+        self._materialize()
+        return super().stats()
+
+
+class WrappedCatVec(Vec):
+    """Domain-remap view over a categorical base vec (no eager rewrite)."""
+
+    def __init__(self, base: Vec, new_domain, old_to_new: np.ndarray):
+        assert base.is_categorical()
+        self.kind = CAT
+        self.name = base.name
+        self.domain = tuple(new_domain)
+        self.nrow = base.nrow
+        self._base = base
+        self._lut = np.asarray(old_to_new, np.int32)  # old code -> new code
+        self._data = None
+        self._stats = None
+
+    @property
+    def data(self):
+        if self._data is None:
+            import jax.numpy as jnp
+
+            lut = jnp.asarray(np.append(self._lut, -1))  # -1 slot for NA
+            self._data = lut[self._base.data]  # one device gather
+        return self._data
+
+    @data.setter
+    def data(self, v) -> None:
+        self._data = v
+
+    @property
+    def _host(self):
+        return None
+
+    @_host.setter
+    def _host(self, v) -> None:
+        pass
+
+    @property
+    def npad(self) -> int:
+        return self._base.npad
+
+
+def wrap_domain(base: Vec, new_domain) -> WrappedCatVec:
+    """Remap a categorical vec onto ``new_domain`` as a lazy view (the
+    CategoricalWrappedVec use case: aligning a test frame's levels to a
+    train-time domain without rewriting the column)."""
+    new_domain = list(new_domain)
+    idx = {d: i for i, d in enumerate(new_domain)}
+    old = list(base.domain or ())
+    lut = np.asarray([idx.get(d, -1) for d in old], np.int32)
+    return WrappedCatVec(base, new_domain, lut)
+
+
+def import_file_lazy(
+    path: str,
+    destination_frame: str | None = None,
+    col_types=None,
+    sep: str | None = None,
+) -> Frame:
+    """``h2o.import_file(..., lazy=True)``: columns load on first touch."""
+    import pandas as pd
+
+    from h2o3_tpu.frame.parse import _read_any, infer_kind, parse_setup
+
+    ext = path.removesuffix(".gz").rsplit(".", 1)[-1].lower()
+    setup = parse_setup(path, sep=sep)
+    types = dict(setup["column_types"])
+    if col_types:
+        types.update(col_types)
+    names = setup["column_names"]
+
+    # one cheap row-count pass (no tokenization of field contents)
+    if ext in ("parquet", "pq"):
+        import pyarrow.parquet as pq
+
+        nrow = pq.ParquetFile(path).metadata.num_rows
+
+        def make_loader(col: str, kind: str):
+            def load():
+                s = pd.read_parquet(path, columns=[col])[col]
+                return _series_values(s, kind)
+
+            return load
+    else:
+        # count rows the way pandas will parse them (quoted newlines, blank
+        # trailing lines): tokenize once materializing only the first column
+        nrow = len(
+            pd.read_csv(path, sep=setup.get("separator"),
+                        usecols=[names[0]], engine="c")
+        )
+
+        def make_loader(col: str, kind: str):
+            def load():
+                # usecols: the tokenizer still scans the file but only ONE
+                # column's values are materialized (memory stays bounded)
+                s = pd.read_csv(
+                    path, sep=setup.get("separator"), usecols=[col],
+                    engine="c",
+                )[col]
+                return _series_values(s, kind)
+
+            return load
+
+    vecs = []
+    for name in names:
+        kind = types.get(name, "real")
+        kind = {"numeric": "real", "float": "real", "double": "real",
+                "factor": "enum", "categorical": "enum"}.get(kind, kind)
+        vecs.append(LazyVec(make_loader(name, kind), kind, name, nrow))
+    return Frame(vecs, list(names), key=destination_frame, register=True)
+
+
+def _series_values(s, kind: str) -> np.ndarray:
+    import pandas as pd
+
+    if kind == STR:
+        return s.astype(object).where(s.notna(), None).to_numpy()
+    if kind == CAT:
+        return s.astype(object).where(s.notna(), None).to_numpy()
+    if kind == TIME:
+        dt = pd.to_datetime(s, errors="coerce", format="mixed", utc=True)
+        dt = dt.dt.tz_localize(None)
+        vals = dt.astype("datetime64[ms]").astype("int64").to_numpy().astype(np.float64)
+        return np.where(dt.isna().to_numpy(), np.nan, vals)
+    return pd.to_numeric(s, errors="coerce").to_numpy(np.float64)

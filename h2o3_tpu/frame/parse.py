@@ -180,8 +180,7 @@ def parse_setup(path: str, sep: str | None = None) -> dict:
     }
 
 
-_STREAM_THRESHOLD_BYTES = 256 * 1024 * 1024
-_STREAM_CHUNK_ROWS = 1_000_000
+_STREAM_CHUNK_ROWS = 1_000_000  # size threshold lives in config (H2O3_TPU_STREAM_BYTES)
 
 
 def _is_csv_like(path: str) -> bool:
@@ -310,9 +309,11 @@ def parse(setup: dict, destination_frame: str | None = None) -> Frame:
     paths = setup["source_frames"]
     want_stream = bool(setup.get("stream"))
     if not want_stream and all(_is_csv_like(p) for p in paths):
+        from h2o3_tpu import config
+
         try:
             total = sum(os.path.getsize(p) for p in paths)
-            want_stream = total > _STREAM_THRESHOLD_BYTES
+            want_stream = total > config.get_int("H2O3_TPU_STREAM_BYTES")
         except OSError:
             pass
     if want_stream and all(_is_csv_like(p) for p in paths):
@@ -337,8 +338,20 @@ def import_file(
     destination_frame: str | None = None,
     col_types: Mapping[str, str] | None = None,
     sep: str | None = None,
+    lazy: bool = False,
 ) -> Frame:
-    """``h2o.import_file`` successor: sniff + parse in one call."""
+    """``h2o.import_file`` successor: sniff + parse in one call.
+
+    ``lazy=True`` defers each column's device materialization to first
+    touch (the FileVec successor — see frame/lazy.py).
+    """
+    if lazy:
+        from h2o3_tpu.frame.lazy import import_file_lazy
+
+        return import_file_lazy(
+            path, destination_frame=destination_frame, col_types=col_types,
+            sep=sep,
+        )
     setup = parse_setup(path, sep=sep)
     if col_types:
         setup["column_types"].update(col_types)

@@ -22,6 +22,19 @@ from typing import Any, Mapping
 import numpy as np
 
 
+def _native_mod():
+    """The C++ runtime if importable and enabled, else None.
+
+    Failure-tolerant so this file also runs STANDALONE (the POJO-style
+    single-file export embeds it outside the h2o3_tpu package)."""
+    try:
+        from h2o3_tpu import native
+
+        return native if native.enabled() else None
+    except Exception:  # noqa: BLE001 — standalone mode has no package
+        return None
+
+
 class MojoModel:
     def __init__(self, meta: dict, arrays: Mapping[str, np.ndarray]):
         self.meta = meta
@@ -55,7 +68,14 @@ class MojoModel:
         if hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
             return {c: data[c].to_numpy() for c in data.columns}
         if isinstance(data, Mapping):
-            return {k: np.asarray([v]) for k, v in data.items()}
+            vals = list(data.values())
+            scalars = all(
+                np.ndim(v) == 0 or isinstance(v, (str, bytes)) or v is None
+                for v in vals
+            )
+            if scalars:  # one row, EasyPredict style
+                return {k: np.asarray([v]) for k, v in data.items()}
+            return {k: np.asarray(v) for k, v in data.items()}  # column table
         if isinstance(data, (list, tuple)) and data and isinstance(data[0], Mapping):
             keys = data[0].keys()
             return {k: np.asarray([row.get(k) for row in data]) for k in keys}
@@ -136,9 +156,7 @@ class _TreeMojo(MojoModel):
         nbins = self.arrays["bin_nbins"]
         edges = self.arrays["bin_edges"]
         doms = self.meta["bin_domains"]
-        from h2o3_tpu import native
-
-        use_native = native.enabled()
+        nat = _native_mod()
         cols = []
         for ci, name in enumerate(names):
             if is_cat[ci]:
@@ -150,10 +168,8 @@ class _TreeMojo(MojoModel):
                 # codes match exactly even for edge-adjacent values.
                 x = _col_numeric(table, name, n).astype(np.float32)
                 e = edges[ci][: max(int(nbins[ci]) - 1, 0)].astype(np.float32)
-                if use_native:
-                    from h2o3_tpu import native
-
-                    b = native.bin_numeric(x, e)
+                if nat is not None:
+                    b = nat.bin_numeric(x, e)
                 else:
                     b = np.searchsorted(e, x, side="left") + 1
                     b[np.isnan(x)] = 0
@@ -165,10 +181,9 @@ class _TreeMojo(MojoModel):
         library builds (row-major, per-row early exit), numpy level replay
         otherwise. Both accumulate f32 leaves into f64 in the same order, so
         results are bit-identical (the parity tests pin this)."""
-        from h2o3_tpu import native
-
-        if native.enabled():
-            return native.score_forest(self, bins)
+        nat = _native_mod()
+        if nat is not None:
+            return nat.score_forest(self, bins)
         F = np.zeros((n, K), np.float64)
         for ti, class_levels in enumerate(shapes):
             for ki in range(K):

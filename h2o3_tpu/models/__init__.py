@@ -37,6 +37,7 @@ _LAZY = {
     "Aggregator": ("h2o3_tpu.models.aggregator", "Aggregator"),
     "Infogram": ("h2o3_tpu.models.infogram", "Infogram"),
     "PSVM": ("h2o3_tpu.models.psvm", "PSVM"),
+    "HGLM": ("h2o3_tpu.models.hglm", "HGLM"),
 }
 
 __all__ = ["Model", "ModelBuilder", "DataInfo", *_LAZY]

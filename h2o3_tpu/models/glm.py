@@ -87,6 +87,19 @@ def _irls_pass(X, y, w, offset, beta, family_key, fam_args):
 
 
 @partial(jax.jit, static_argnames=("family_key", "fam_args"))
+def _glm_dev_grad(X, y, w, offset, beta, family_key, fam_args):
+    """Full-batch deviance + gradient in one fused pass (L-BFGS objective)."""
+    fam = get_family(family_key, *fam_args)
+
+    def dev(b):
+        eta = jnp.einsum("np,p->n", X, b, precision=_HI) + offset
+        mu = fam.link.inv(eta)
+        return fam.deviance(y, mu, w)
+
+    return jax.value_and_grad(dev)(beta)
+
+
+@partial(jax.jit, static_argnames=("family_key", "fam_args"))
 def _deviance_pass(X, y, w, offset, beta, family_key, fam_args):
     fam = get_family(family_key, *fam_args)
     eta = jnp.einsum("np,p->n", X, beta, precision=_HI) + offset
@@ -116,6 +129,40 @@ def _softmax_probs(X, Beta):
 
 
 # ---------------------------------------------------------------------------
+# ordinal (proportional odds): P(y<=j) = sigmoid(theta_j - x.beta).
+# One fused device program computes NLL + gradient; the (tiny) parameter
+# vector is driven by host L-BFGS — the GLM "L_BFGS" solver reuses the same
+# loss-plus-grad-on-device / optimize-on-host split.
+
+
+@partial(jax.jit, static_argnames=("K",))
+def _ordinal_nll_grad(X, y, w, beta, raw_cuts, K):
+    """NLL and grad for proportional odds with ordered cuts.
+
+    Cuts parameterized as theta_1 = raw_1, theta_j = theta_{j-1} +
+    exp(raw_j) so ordering is unconstrained in raw space.
+    """
+    def nll(params):
+        b = params[: X.shape[1]]
+        raw = params[X.shape[1] :]
+        theta = jnp.cumsum(
+            jnp.concatenate([raw[:1], jnp.exp(raw[1:])])
+        )  # (K-1,) ordered
+        eta = jnp.einsum("np,p->n", X, b, precision=_HI)
+        # P(y<=j) for j=0..K-2 ; clip for the log
+        cum = jax.nn.sigmoid(theta[None, :] - eta[:, None])  # (n, K-1)
+        lo = jnp.concatenate([jnp.zeros((X.shape[0], 1)), cum], axis=1)
+        hi = jnp.concatenate([cum, jnp.ones((X.shape[0], 1))], axis=1)
+        pk = jnp.clip(hi - lo, 1e-12, 1.0)  # (n, K)
+        yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
+        ll = jnp.take_along_axis(jnp.log(pk), yi[:, None], axis=1)[:, 0]
+        return -jnp.sum(w * ll)
+
+    val, g = jax.value_and_grad(nll)(jnp.concatenate([beta, raw_cuts]))
+    return val, g
+
+
+# ---------------------------------------------------------------------------
 
 
 class GLMModel(Model):
@@ -124,6 +171,14 @@ class GLMModel(Model):
     def _predict_raw(self, frame: Frame) -> np.ndarray:
         di: DataInfo = self.output["datainfo"]
         X, valid = di.transform(frame)
+        if self.output.get("ordinal"):
+            beta = np.asarray(self.output["beta_std"], np.float64)
+            theta = np.asarray(self.output["theta"], np.float64)
+            eta = np.asarray(X, np.float64)[: frame.nrow] @ beta
+            cum = 1.0 / (1.0 + np.exp(-(theta[None, :] - eta[:, None])))
+            lo = np.concatenate([np.zeros((len(eta), 1)), cum], axis=1)
+            hi = np.concatenate([cum, np.ones((len(eta), 1))], axis=1)
+            return np.clip(hi - lo, 1e-12, 1.0)
         if self.output.get("multinomial"):
             Beta = jnp.asarray(self.output["beta_multinomial_std"], jnp.float32)
             probs = np.asarray(_softmax_probs(X, Beta))[: frame.nrow]
@@ -174,7 +229,10 @@ class GLM(ModelBuilder):
                 family = "binomial" if yv.cardinality <= 2 else "multinomial"
             else:
                 family = "gaussian"
-        classification = family in ("binomial", "multinomial") and yv.is_categorical()
+        classification = (
+            family in ("binomial", "multinomial", "ordinal")
+            and yv.is_categorical()
+        )
 
         di = DataInfo.fit(
             train,
@@ -182,7 +240,8 @@ class GLM(ModelBuilder):
             standardize=p.standardize,
             use_all_factor_levels=False,
             missing_handling=p.missing_values_handling,
-            add_intercept=p.intercept,
+            # ordinal: the K-1 ordered cuts ARE the intercepts
+            add_intercept=p.intercept and family != "ordinal",
         )
         X, valid_mask = di.transform(train)
         w = valid_mask
@@ -206,6 +265,10 @@ class GLM(ModelBuilder):
 
         if family == "multinomial":
             out = self._fit_multinomial(job, X, y, w, di, yv, p, nobs)
+        elif family == "ordinal":
+            out = self._fit_ordinal(job, X, y, w, di, yv, p)
+        elif p.solver.upper().replace("-", "_") in ("L_BFGS", "LBFGS"):
+            out = self._fit_lbfgs(job, X, y, w, offset, di, p, family, nobs)
         else:
             out = self._fit_irls(job, X, y, w, offset, di, p, family, nobs)
 
@@ -324,24 +387,35 @@ class GLM(ModelBuilder):
             out.update(self._p_values(X, y, w, offset, beta, family, fam_args, di, p, nobs))
         return out
 
-    def _coef_output(self, beta_std, di: DataInfo, p: GLMParams) -> dict:
-        """Destandardize coefficients back to the original scale."""
+    def _coef_output(self, beta_std, di: DataInfo, p: GLMParams,
+                     has_intercept: bool | None = None) -> dict:
+        """Destandardize coefficients back to the original scale.
+
+        ``has_intercept`` overrides ``p.intercept`` for fits whose design has
+        no intercept column regardless of the param (ordinal: the cuts are
+        the intercepts) — otherwise the shift correction would clobber the
+        LAST feature's coefficient. The accumulated shift is returned so
+        such fits can fold it into their own intercept-like parameters.
+        """
+        if has_intercept is None:
+            has_intercept = p.intercept
         names = di.coef_names()
         beta_std = np.asarray(beta_std, np.float64)
         beta_orig = beta_std.copy()
+        shift = 0.0
         if p.standardize:
-            shift = 0.0
             for c in di.columns:
                 if c.kind == "num":
                     beta_orig[c.offset] = beta_std[c.offset] / c.sigma
                     shift += beta_std[c.offset] * c.mean / c.sigma
-            if p.intercept:
+            if has_intercept:
                 beta_orig[-1] = beta_std[-1] - shift
         return {
             "coef_names": names,
             "beta_std": beta_std,
             "beta_std_report": beta_std,
             "beta_orig": beta_orig,
+            "destandardize_shift": shift,
         }
 
     def _p_values(self, X, y, w, offset, beta, family, fam_args, di, p, nobs) -> dict:
@@ -367,6 +441,134 @@ class GLM(ModelBuilder):
         else:
             pv = 2 * sps.t.sf(np.abs(z), df=max(nobs - P, 1.0))
         return {"std_errs": se, "z_values": z, "p_values": pv, "dispersion": dispersion}
+
+    # -- ordinal (proportional odds) ----------------------------------------
+    def _fit_ordinal(self, job, X, y, w, di, yv, p: GLMParams):
+        from scipy import optimize as spo
+
+        if p.offset_column:
+            raise ValueError("ordinal does not support offset_column")
+        if p.compute_p_values:
+            raise ValueError("compute_p_values requires solver=IRLSM")
+        if p.lambda_ is not None and float(np.atleast_1d(np.asarray(p.lambda_))[0]) > 0:
+            Log.warn("ordinal fits unpenalized; lambda_ is ignored")
+        K = yv.cardinality
+        if K < 2:
+            raise ValueError("ordinal needs a categorical response with >=2 levels")
+        P = di.ncols_expanded
+        # init: zero betas; first cut below zero, the rest unit-spaced
+        # (the exp parameterization keeps them ordered during optimization)
+        raw0 = np.zeros(K - 1)
+        raw0[0] = -1.0
+        x0 = np.concatenate([np.zeros(P), raw0])
+
+        def fun(params):
+            val, g = _ordinal_nll_grad(
+                X, y, w, jnp.asarray(params[:P], jnp.float32),
+                jnp.asarray(params[P:], jnp.float32), K,
+            )
+            return float(val), np.asarray(g, np.float64)
+
+        res = spo.minimize(
+            fun, x0, jac=True, method="L-BFGS-B",
+            options={"maxiter": p.max_iterations if p.max_iterations > 0 else 200},
+        )
+        beta = res.x[:P]
+        raw = res.x[P:]
+        theta = np.cumsum(np.concatenate([raw[:1], np.exp(raw[1:])]))
+        out = self._coef_output(beta, di, p, has_intercept=False)
+        out.update(
+            family="ordinal",
+            family_obj=get_family("binomial"),
+            ordinal=True,
+            theta=theta,  # standardized scale — what _predict_raw consumes
+            # original-scale cuts: eta_std = eta_orig_lin - shift, so the
+            # same cumulative probabilities come from theta + shift
+            theta_orig=theta + out["destandardize_shift"],
+            residual_deviance=2.0 * float(res.fun),
+            null_deviance=float("nan"),
+            multinomial=False,
+        )
+        job.update(0.9)
+        return out
+
+    # -- L-BFGS solver (hex/optimization/L_BFGS successor): the device
+    # computes the full-batch objective+gradient in one fused pass; the
+    # low-memory quasi-Newton direction update runs host-side in scipy.
+    def _fit_lbfgs(self, job, X, y, w, offset, di, p: GLMParams, family, nobs):
+        from scipy import optimize as spo
+
+        fam_args = (
+            p.link,
+            float(p.tweedie_variance_power or 1.5),
+            float(p.tweedie_link_power),
+            float(p.theta),
+        )
+        if p.compute_p_values:
+            raise ValueError("compute_p_values requires solver=IRLSM")
+        fam = get_family(family, *fam_args)
+        P = di.ncols_expanded
+        icpt = P - 1 if p.intercept else None
+        alpha = 0.5 if p.alpha is None else float(p.alpha)
+        if p.lambda_ is not None:
+            lam = float(np.atleast_1d(np.asarray(p.lambda_))[0])
+        else:
+            # same lambda_max/1e3 light-shrinkage default as the IRLSM path,
+            # so switching solver does not silently change regularization
+            beta0 = np.zeros(P, np.float64)
+            if p.intercept:
+                mu0 = float(np.asarray(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-10)))
+                if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+                    mu0 = min(max(mu0, 1e-4), 1 - 1e-4)
+                beta0[icpt] = float(np.asarray(fam.link.fwd(jnp.asarray(mu0))))
+            G0, b0, _ = _irls_pass(
+                X, y, w, offset, jnp.asarray(beta0, jnp.float32), family, fam_args
+            )
+            g0 = np.asarray(b0, np.float64) - np.asarray(G0, np.float64) @ beta0
+            g0_pen = np.delete(g0, icpt) if icpt is not None else g0
+            lam = float(
+                np.max(np.abs(g0_pen)) / max(alpha, 1e-3) / max(nobs, 1.0)
+            ) / 1e3
+        if alpha * lam > 0:
+            Log.warn("GLM L_BFGS ignores the L1 part of elastic net "
+                     "(upstream behavior); use IRLSM for exact L1")
+        l2 = lam * (1 - alpha) * nobs
+
+        def fun(b):
+            val, g = _glm_dev_grad(
+                X, y, w, offset, jnp.asarray(b, jnp.float32), family, fam_args
+            )
+            b64 = np.asarray(b, np.float64)
+            g64 = np.asarray(g, np.float64)
+            pen = b64.copy()
+            if icpt is not None:
+                pen[icpt] = 0.0
+            return float(val) + l2 * float(pen @ pen), g64 + 2.0 * l2 * pen
+
+        b0 = np.zeros(P)
+        res = spo.minimize(
+            fun, b0, jac=True, method="L-BFGS-B",
+            options={"maxiter": p.max_iterations if p.max_iterations > 0 else 200},
+        )
+        beta = res.x
+        dev = float(
+            _deviance_pass(
+                X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
+            )
+        )
+        mu0 = jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-10)
+        null = float(
+            fam.deviance(y, jnp.broadcast_to(mu0, y.shape), w)
+        )
+        out = self._coef_output(beta, di, p)
+        out.update(
+            family=family, family_obj=fam,
+            null_deviance=null, residual_deviance=dev,
+            lambda_best=lam, lambda_max=float("nan"), alpha=alpha,
+            regularization_path=[], multinomial=False, solver="L_BFGS",
+        )
+        job.update(0.9)
+        return out
 
     # -- multinomial ---------------------------------------------------------
     def _fit_multinomial(self, job, X, y, w, di, yv, p: GLMParams, nobs):

@@ -1,0 +1,206 @@
+"""HGLM — successor of ``hex.hglm.HGLM`` (hierarchical / mixed-effect GLM)
+[UNVERIFIED upstream path, SURVEY.md §2.2]: gaussian response with random
+intercepts per level of the ``random_columns`` factors.
+
+Model: y = Xβ + Σ_j Z_j u_j + e,  u_j ~ N(0, σ²_{u_j} I),  e ~ N(0, σ²_e I).
+
+TPU design: the combined design W = [X | onehot(Z_1) | …] lives row-sharded
+on device; ONE fused Gram pass (ops/gram.weighted_gram) yields the entire
+mixed-model-equation coefficient matrix WᵀW and right-hand side Wᵀy — the
+MXU does all O(n) work. The EM-REML loop then iterates host-side in float64
+on the (p+q)×(p+q) system (Henderson's MME; Searle/Mrode EM updates):
+
+    σ²_{u_j} ← (û_jᵀû_j + σ²_e·tr(C_jj)) / q_j
+    σ²_e     ← (yᵀy − β̂ᵀXᵀy − ûᵀZᵀy) / (n − p)
+
+No per-iteration device work at all — variance-component iteration is free
+once the Gram exists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.datainfo import DataInfo
+from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
+from h2o3_tpu.ops.gram import weighted_gram
+from h2o3_tpu.parallel.mesh import row_sharding
+from h2o3_tpu.utils.log import Log
+
+
+@dataclass
+class HGLMParams(CommonParams):
+    random_columns: list = field(default_factory=list)
+    method: str = "EM"
+    max_iterations: int = 100
+    em_epsilon: float = 1e-6
+    standardize: bool = False
+    intercept: bool = True
+
+
+class HGLMModel(Model):
+    algo = "hglm"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        o = self.output
+        di: DataInfo = o["datainfo"]
+        X, _ = di.transform(frame)
+        eta = np.asarray(X, np.float64)[: frame.nrow] @ o["beta"]
+        # add BLUPs for known levels (unseen levels get 0 — the prior mean):
+        # one vectorized frame-code -> u gather per random column
+        for rc, (dom, u) in o["random_effects"].items():
+            v = frame.vec(rc)
+            lut = {d: i for i, d in enumerate(dom)}
+            vdom = list(v.domain or ())
+            # frame code -> u value (0.0 for NA / unseen levels), -1 slot last
+            code_u = np.zeros(len(vdom) + 1, np.float64)
+            for ci, d in enumerate(vdom):
+                gi = lut.get(d)
+                if gi is not None:
+                    code_u[ci] = u[gi]
+            codes = v.to_numpy().astype(np.int64)
+            codes = np.where((codes < 0) | (codes >= len(vdom)), len(vdom), codes)
+            eta += code_u[codes]
+        return eta
+
+    @property
+    def coef(self) -> dict:
+        return dict(zip(self.output["coef_names"], self.output["beta"]))
+
+    def coefs_random(self, column: str) -> dict:
+        dom, u = self.output["random_effects"][column]
+        return dict(zip(dom, u))
+
+    def _distribution_for_metrics(self) -> str:
+        return "gaussian"
+
+
+class HGLM(ModelBuilder):
+    algo = "hglm"
+    PARAMS_CLS = HGLMParams
+    SUPPORTS_CLASSIFICATION = False
+
+    def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        p: HGLMParams = self.params
+        if not p.random_columns:
+            raise ValueError("hglm requires random_columns")
+        if p.max_iterations < 1:
+            raise ValueError("max_iterations must be >= 1")
+        yv = train.vec(p.response_column)
+        if yv.is_categorical():
+            raise ValueError("hglm supports gaussian (numeric) responses")
+
+        fixed = [n for n in self._x if n not in p.random_columns]
+        di = DataInfo.fit(
+            train, fixed, standardize=p.standardize,
+            use_all_factor_levels=False, add_intercept=p.intercept,
+        )
+        X, valid_mask = di.transform(train)
+        P = di.ncols_expanded
+        nrow = train.nrow
+        npad = train.npad
+
+        # one-hot random-effect blocks appended on device
+        blocks: list[tuple[str, list, int]] = []  # (col, domain, q)
+        parts = [X]
+        for rc in p.random_columns:
+            v = train.vec(rc)
+            if not v.is_categorical():
+                raise ValueError(f"random column {rc!r} must be categorical")
+            q = v.cardinality
+            codes = v.data  # device int codes, -1 for NA
+            oh = (codes[:, None] == jnp.arange(q)[None, :]).astype(jnp.float32)
+            parts.append(oh)
+            blocks.append((rc, list(v.domain or ()), q))
+        W = jax.device_put(jnp.concatenate(parts, axis=1), row_sharding())
+
+        y_np = yv.to_numpy().astype(np.float64)
+        w_np = np.asarray(valid_mask)[:npad].astype(np.float64).copy()
+        w_np[:nrow] *= ~np.isnan(y_np)
+        if p.weights_column:
+            w_np[:nrow] *= np.nan_to_num(train.vec(p.weights_column).to_numpy())
+        ybuf = np.zeros(npad, np.float32)
+        ybuf[:nrow] = np.nan_to_num(y_np, nan=0.0)
+        y = jnp.asarray(ybuf)
+        w = jnp.asarray(w_np.astype(np.float32))
+
+        G_d, b_d, sw_d = weighted_gram(W, w, y)
+        M0 = np.asarray(G_d, np.float64)  # (p+q, p+q) = WᵀWW
+        rhs = np.asarray(b_d, np.float64)
+        n_eff = float(np.asarray(sw_d))
+        yty = float(np.asarray(jnp.sum(w * y * y)))
+        job.update(0.3)
+
+        qs = [q for _, _, q in blocks]
+        Q = sum(qs)
+        sig_e = max(yty / max(n_eff, 1.0), 1e-8)
+        sig_u = [sig_e / 2.0] * len(qs)
+
+        beta = np.zeros(P)
+        us: list[np.ndarray] = [np.zeros(q) for q in qs]
+        ll_prev = np.inf
+        for it in range(p.max_iterations):
+            M = M0.copy()
+            off = P
+            for j, q in enumerate(qs):
+                k = sig_e / max(sig_u[j], 1e-12)
+                M[off : off + q, off : off + q] += k * np.eye(q)
+                off += q
+            try:
+                C = np.linalg.inv(M + 1e-10 * np.eye(len(M)))
+            except np.linalg.LinAlgError:
+                C = np.linalg.pinv(M)
+            sol = C @ rhs
+            beta = sol[:P]
+            off = P
+            new_sig_u = []
+            for j, q in enumerate(qs):
+                u = sol[off : off + q]
+                us[j] = u
+                C_jj = C[off : off + q, off : off + q]
+                new_sig_u.append(
+                    max((u @ u + sig_e * np.trace(C_jj)) / q, 1e-10)
+                )
+                off += q
+            # REML residual update: yᵀy − solᵀ·rhs = eᵀy
+            sse = max(yty - sol @ rhs, 1e-12)
+            new_sig_e = sse / max(n_eff - P, 1.0)
+            delta = abs(new_sig_e - sig_e) / max(sig_e, 1e-12) + sum(
+                abs(a - b_) / max(b_, 1e-12) for a, b_ in zip(new_sig_u, sig_u)
+            )
+            sig_e, sig_u = new_sig_e, new_sig_u
+            job.update(0.3 + 0.6 * (it + 1) / p.max_iterations)
+            if delta < p.em_epsilon:
+                break
+        Log.info(
+            f"hglm: converged in {it + 1} EM iters; sigma_e^2={sig_e:.5g}, "
+            f"sigma_u^2={[round(s, 5) for s in sig_u]}"
+        )
+
+        random_effects = {}
+        for (rc, dom, q), u in zip(blocks, us):
+            random_effects[rc] = (dom, u)
+
+        out = {
+            "datainfo": di,
+            "beta": beta,
+            "coef_names": di.coef_names(),
+            "random_effects": random_effects,
+            "sigma_e2": float(sig_e),
+            "sigma_u2": {rc: float(s) for (rc, _, _), s in zip(blocks, sig_u)},
+            "em_iterations": it + 1,
+            "names": list(self._x),
+            "response_domain": None,
+        }
+        model = HGLMModel(DKV.make_key("hglm"), p, out)
+        model.training_metrics = model._score_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._score_metrics(valid)
+        return model

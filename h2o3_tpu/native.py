@@ -86,7 +86,9 @@ def available() -> bool:
 
 def enabled() -> bool:
     """Native path on: not opted out via H2O3_TPU_NATIVE=0 AND buildable."""
-    if os.environ.get("H2O3_TPU_NATIVE", "1") == "0":
+    from h2o3_tpu import config
+
+    if not config.get_bool("H2O3_TPU_NATIVE"):
         return False
     return available()
 

@@ -78,11 +78,11 @@ def _select_local():
     kernel (hist_pallas.py) unless ``H2O3_TPU_HIST=matmul`` forces the plain
     XLA fallback.
     """
-    import os
+    from h2o3_tpu import config
 
     if jax.default_backend() == "cpu":
         return _hist_scatter_local
-    if os.environ.get("H2O3_TPU_HIST") == "matmul":
+    if config.get("H2O3_TPU_HIST") == "matmul":
         return _hist_matmul_local
 
     def pallas_local(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins):

@@ -173,3 +173,36 @@ def test_generic_model_reimport_scores_live(tmp_path):
     lb = pb.vec("predict").to_numpy()
     assert (la == lb).mean() > 0.999  # labels use the carried F1 threshold
     assert g.output["source_algo"] == "gbm"
+
+
+def test_pojo_standalone_scoring(tmp_path):
+    """POJO-successor: a single generated .py scores with numpy only, in a
+    bare subprocess with no h2o3_tpu/jax on the path."""
+    import os
+    import subprocess
+    import sys
+
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.models.export import export_pojo
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(5)])
+    df["y"] = np.where(X[:, 0] * 2 + X[:, 1] ** 2 > 1, "Y", "N")
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=10, max_depth=4, seed=1).train(y="y", training_frame=fr)
+    pojo = os.path.join(str(tmp_path), "model.py")
+    export_pojo(m, pojo)
+    csv = os.path.join(str(tmp_path), "rows.csv")
+    df.drop(columns="y").to_csv(csv, index=False)
+    r = subprocess.run(
+        [sys.executable, pojo, csv], capture_output=True, text=True,
+        env={"PATH": os.environ["PATH"]}, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    import io as _io
+
+    out = pd.read_csv(_io.StringIO(r.stdout))
+    ours = m.predict(fr).vec("Y").to_numpy()
+    np.testing.assert_allclose(out["Y"].to_numpy(), ours, atol=1e-5)

@@ -156,3 +156,73 @@ def test_big_column_count_exact():
     fr = Frame.from_pandas(pd.DataFrame({"x": np.ones(n, dtype=np.float32)}))
     assert fr.vec("x").na_count() == 0
     assert fr.vec("x").mean() == pytest.approx(1.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Vec flavors (FileVec / CategoricalWrappedVec successors)
+
+
+def test_lazy_import_defers_materialization(tmp_path):
+    import os
+
+    import pandas as pd
+
+    import h2o3_tpu
+    from h2o3_tpu.frame.lazy import LazyVec
+
+    rng = np.random.default_rng(0)
+    n = 4000
+    df = pd.DataFrame(
+        {"a": rng.normal(size=n), "b": rng.normal(size=n),
+         "c": rng.choice(["x", "y"], n), "unused": rng.normal(size=n)}
+    )
+    p = os.path.join(str(tmp_path), "wide.csv")
+    df.to_csv(p, index=False)
+    fr = h2o3_tpu.import_file(p, lazy=True)
+    assert all(isinstance(fr.vec(nm), LazyVec) for nm in fr.names)
+    assert not any(fr.vec(nm).is_materialized for nm in fr.names)
+    # touching one column materializes ONLY that column
+    a = fr.vec("a").to_numpy()
+    np.testing.assert_allclose(a, df["a"], rtol=1e-6)
+    assert fr.vec("a").is_materialized
+    assert not fr.vec("unused").is_materialized
+    # categorical domain resolves on demand
+    assert fr.vec("c").levels() == ["x", "y"]
+    assert fr.vec("c").is_materialized
+
+
+def test_lazy_frame_trains_a_model(tmp_path):
+    import os
+
+    import pandas as pd
+
+    import h2o3_tpu
+    from h2o3_tpu.models import GLM
+
+    rng = np.random.default_rng(1)
+    n = 2000
+    df = pd.DataFrame({"x": rng.normal(size=n), "junk": rng.normal(size=n)})
+    df["y"] = 3 * df["x"] + 0.1 * rng.normal(size=n)
+    p = os.path.join(str(tmp_path), "lz.csv")
+    df.to_csv(p, index=False)
+    fr = h2o3_tpu.import_file(p, lazy=True)
+    m = GLM(lambda_=0.0).train(y="y", x=["x"], training_frame=fr)
+    assert abs(m.coef["x"] - 3.0) < 0.05
+    assert not fr.vec("junk").is_materialized  # untouched column stayed cold
+
+
+def test_wrapped_cat_vec_remaps_domain():
+    import pandas as pd
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.frame.lazy import wrap_domain
+
+    df = pd.DataFrame({"c": ["b", "a", "c", "a", None]})
+    fr = Frame.from_pandas(df, column_types={"c": "enum"})
+    base = fr.vec("c")
+    assert list(base.domain) == ["a", "b", "c"]
+    w = wrap_domain(base, ["c", "b", "a", "zzz"])
+    codes = np.asarray(w.data)[: w.nrow]
+    # b->1, a->2, c->0, NA stays -1
+    np.testing.assert_array_equal(codes, [1, 2, 0, 2, -1])
+    assert w.cardinality == 4

@@ -180,3 +180,140 @@ def test_validation_frame_and_predict():
     assert pred.nrow == te.nrow
     perf = m.model_performance(te)
     assert perf.rmse == pytest.approx(m.validation_metrics.rmse, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ordinal family + L_BFGS solver (round 3)
+
+
+def test_glm_ordinal_recovers_proportional_odds():
+    from scipy import optimize as spo
+
+    rng = np.random.default_rng(2)
+    n = 4000
+    x0, x1 = rng.normal(size=(2, n))
+    eta = 1.5 * x0 - x1
+    lat = eta + rng.logistic(size=n)
+    yo = np.digitize(lat, [-1.0, 0.5])  # classes 0 < 1 < 2
+    df = pd.DataFrame({"x0": x0, "x1": x1, "y": yo.astype(str)})
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    m = GLM(family="ordinal", standardize=False).train(y="y", training_frame=fr)
+    beta = np.array([m.coef["x0"], m.coef["x1"]])
+    theta = np.asarray(m.output["theta"])
+    # independent numpy/scipy fit of the same likelihood
+    X = np.stack([x0, x1], axis=1)
+
+    def nll(params):
+        b, t1, dt = params[:2], params[2], params[3]
+        th = np.array([t1, t1 + np.exp(dt)])
+        e = X @ b
+        cum = 1 / (1 + np.exp(-(th[None, :] - e[:, None])))
+        pk = np.diff(
+            np.concatenate(
+                [np.zeros((n, 1)), cum, np.ones((n, 1))], axis=1
+            ), axis=1,
+        )
+        return -np.log(np.clip(pk[np.arange(n), yo], 1e-12, 1)).sum()
+
+    ref = spo.minimize(nll, np.zeros(4), method="Nelder-Mead",
+                       options={"maxiter": 4000, "fatol": 1e-10})
+    rb = ref.x[:2]
+    rt = np.array([ref.x[2], ref.x[2] + np.exp(ref.x[3])])
+    np.testing.assert_allclose(beta, rb, atol=0.05)
+    np.testing.assert_allclose(theta, rt, atol=0.05)
+    # parameters near the generating truth
+    np.testing.assert_allclose(beta, [1.5, -1.0], atol=0.15)
+    np.testing.assert_allclose(theta, [-1.0, 0.5], atol=0.15)
+    # predicted class probs are proper
+    P = m._predict_raw(fr)
+    np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_glm_lbfgs_matches_irlsm():
+    rng = np.random.default_rng(5)
+    n = 3000
+    x0, x1 = rng.normal(size=(2, n))
+    eta = 1.2 * x0 - 0.7 * x1 + 0.3
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    fr = Frame.from_pandas(
+        pd.DataFrame({"x0": x0, "x1": x1, "y": y.astype(str)}),
+        column_types={"y": "enum"},
+    )
+    a = GLM(family="binomial", lambda_=0.0).train(y="y", training_frame=fr)
+    b = GLM(family="binomial", lambda_=0.0, solver="L_BFGS").train(
+        y="y", training_frame=fr
+    )
+    for k in a.coef:
+        np.testing.assert_allclose(a.coef[k], b.coef[k], atol=2e-3)
+    # poisson too (different link/deviance path through the same objective)
+    lam = np.exp(0.5 * x0)
+    yp = rng.poisson(lam)
+    frp = Frame.from_pandas(pd.DataFrame({"x0": x0, "y": yp.astype(float)}))
+    c = GLM(family="poisson", lambda_=0.0).train(y="y", training_frame=frp)
+    d = GLM(family="poisson", lambda_=0.0, solver="L_BFGS").train(
+        y="y", training_frame=frp
+    )
+    np.testing.assert_allclose(c.coef["x0"], d.coef["x0"], atol=2e-3)
+
+
+def test_hglm_recovers_variance_components():
+    from h2o3_tpu.models import HGLM
+
+    rng = np.random.default_rng(7)
+    n, q = 8000, 40
+    grp = rng.integers(0, q, n)
+    u_true = rng.normal(0, 1.5, q)  # sigma_u^2 = 2.25
+    x = rng.normal(size=n)
+    y = 2.0 + 3.0 * x + u_true[grp] + rng.normal(0, 1.0, n)  # sigma_e^2 = 1
+    df = pd.DataFrame({"x": x, "g": [f"g{i:02d}" for i in grp], "y": y})
+    fr = Frame.from_pandas(df, column_types={"g": "enum"})
+    m = HGLM(random_columns=["g"]).train(y="y", x=["x", "g"], training_frame=fr)
+    assert abs(m.coef["x"] - 3.0) < 0.05
+    assert abs(m.coef["Intercept"] - 2.0) < 0.6  # absorbs group mean shift
+    assert abs(m.output["sigma_e2"] - 1.0) < 0.1
+    assert abs(m.output["sigma_u2"]["g"] - 2.25) < 0.8
+    blups = m.coefs_random("g")
+    corr = np.corrcoef([blups[f"g{i:02d}"] for i in range(q)], u_true)[0, 1]
+    assert corr > 0.99  # BLUPs track the true random effects
+    # shrinkage: BLUP variance below raw group-mean variance
+    assert np.var(list(blups.values())) < np.var(u_true) * 1.5
+    # scoring uses the BLUPs: r2 well above the fixed-effect-only fit
+    assert m.training_metrics.value("r2") > 0.9
+
+
+def test_hglm_validation():
+    from h2o3_tpu.models import HGLM
+
+    rng = np.random.default_rng(8)
+    df = pd.DataFrame({"x": rng.normal(size=100), "y": rng.normal(size=100)})
+    fr = Frame.from_pandas(df)
+    with pytest.raises(Exception, match="random_columns"):
+        HGLM().train(y="y", training_frame=fr)
+    with pytest.raises(Exception, match="categorical"):
+        HGLM(random_columns=["x"]).train(y="y", training_frame=fr)
+
+
+def test_glm_ordinal_standardized_coefs_consistent():
+    # standardize=True must yield the same class probabilities and the same
+    # ORIGINAL-scale slopes as standardize=False (review: the intercept
+    # destandardization used to clobber the last coefficient)
+    rng = np.random.default_rng(3)
+    n = 3000
+    x0 = rng.normal(2.0, 3.0, n)  # non-trivial mean/sigma
+    x1 = rng.normal(-1.0, 0.5, n)
+    lat = 0.8 * x0 + 1.1 * x1 + rng.logistic(size=n)
+    yo = np.digitize(lat, [0.0, 2.5])
+    df = pd.DataFrame({"x0": x0, "x1": x1, "y": yo.astype(str)})
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    ms = GLM(family="ordinal", standardize=True).train(y="y", training_frame=fr)
+    mu = GLM(family="ordinal", standardize=False).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(
+        [ms.coef["x0"], ms.coef["x1"]], [mu.coef["x0"], mu.coef["x1"]],
+        atol=0.03,
+    )
+    np.testing.assert_allclose(
+        ms.output["theta_orig"], mu.output["theta"], atol=0.08
+    )
+    Ps = ms._predict_raw(fr)
+    Pu = mu._predict_raw(fr)
+    np.testing.assert_allclose(Ps, Pu, atol=0.02)

@@ -219,6 +219,7 @@ def test_native_scorer_bit_identical_to_numpy():
     os.unlink(p)
 
 
+@pytest.mark.slow
 def test_automl_exploitation_step():
     from h2o3_tpu.automl.automl import AutoML
 

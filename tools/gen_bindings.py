@@ -55,6 +55,7 @@ ALGOS = [
     ("H2OAggregatorEstimator", "Aggregator"),
     ("H2OInfogramEstimator", "Infogram"),
     ("H2OSupportVectorMachineEstimator", "PSVM"),
+    ("H2OHGLMEstimator", "HGLM"),
 ]
 
 
