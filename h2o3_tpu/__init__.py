@@ -82,21 +82,17 @@ def start_server(ip: str = "127.0.0.1", port: int | None = None):
     """Start the REST server (water.api.RequestServer successor).
 
     Default port comes from the H2O3_TPU_PORT knob (config.py)."""
-    from h2o3_tpu import config
     from h2o3_tpu.api.server import start_server as _ss
 
-    return _ss(ip, port if port is not None else config.get_int("H2O3_TPU_PORT"))
+    return _ss(ip, port)
 
 
 def connect(url: str | None = None, **kw):
     """Connect to a remote coordinator over REST (h2o.connect successor).
 
     Default URL tracks the same H2O3_TPU_PORT knob start_server uses."""
-    from h2o3_tpu import config
     from h2o3_tpu.client import connect as _c
 
-    if url is None:
-        url = f"http://127.0.0.1:{config.get_int('H2O3_TPU_PORT')}"
     return _c(url, **kw)
 
 __all__ = [

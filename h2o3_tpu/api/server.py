@@ -760,10 +760,15 @@ class H2OServer:
 _SERVER: H2OServer | None = None
 
 
-def start_server(ip: str = "127.0.0.1", port: int = 54321) -> H2OServer:
+def start_server(ip: str = "127.0.0.1", port: int | None = None) -> H2OServer:
     """Start (or return) the process-wide REST server. port=0 picks a free
-    port — handy for tests running in parallel."""
+    port — handy for tests running in parallel. Default port comes from the
+    H2O3_TPU_PORT knob (config.py)."""
     global _SERVER
     if _SERVER is None:
+        if port is None:
+            from h2o3_tpu import config
+
+            port = config.get_int("H2O3_TPU_PORT")
         _SERVER = H2OServer(ip, port).start()
     return _SERVER

@@ -230,6 +230,10 @@ def _key_of(frame) -> str:
     return getattr(frame, "key", None) or str(frame)
 
 
-def connect(url: str = "http://127.0.0.1:54321", **kw) -> H2OConnection:
-    """``h2o.connect`` successor."""
+def connect(url: str | None = None, **kw) -> H2OConnection:
+    """``h2o.connect`` successor. Default URL tracks H2O3_TPU_PORT."""
+    if url is None:
+        from h2o3_tpu import config
+
+        url = f"http://127.0.0.1:{config.get_int('H2O3_TPU_PORT')}"
     return H2OConnection(url, **kw)

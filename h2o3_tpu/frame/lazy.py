@@ -193,12 +193,20 @@ def import_file_lazy(
     else:
         # count rows the way pandas will parse them (quoted newlines, blank
         # trailing lines): tokenize once materializing only the first column
-        nrow = len(
-            pd.read_csv(path, sep=setup.get("separator"),
-                        usecols=[names[0]], engine="c")
-        )
+        # — and KEEP those values to seed the first column's loader, so the
+        # counting scan is not wasted I/O
+        first_series = pd.read_csv(
+            path, sep=setup.get("separator"), usecols=[names[0]], engine="c"
+        )[names[0]]
+        nrow = len(first_series)
 
         def make_loader(col: str, kind: str):
+            if col == names[0]:
+                def load_first():
+                    return _series_values(first_series, kind)
+
+                return load_first
+
             def load():
                 # usecols: the tokenizer still scans the file but only ONE
                 # column's values are materialized (memory stays bounded)

@@ -280,6 +280,13 @@ class _GlmMojo(MojoModel):
         X = _design_matrix(self.meta["datainfo"], table)
         if "beta_multinomial_std" in self.arrays:
             return _softmax(X @ self.arrays["beta_multinomial_std"].T.astype(np.float64))
+        if "theta" in self.arrays:  # ordinal: proportional-odds cumulatives
+            eta = X @ self.arrays["beta_std"].astype(np.float64)
+            theta = self.arrays["theta"].astype(np.float64)
+            cum = 1.0 / (1.0 + np.exp(-(theta[None, :] - eta[:, None])))
+            lo = np.concatenate([np.zeros((len(eta), 1)), cum], axis=1)
+            hi = np.concatenate([cum, np.ones((len(eta), 1))], axis=1)
+            return np.clip(hi - lo, 1e-12, 1.0)
         eta = X @ self.arrays["beta_std"].astype(np.float64)
         fam = self.meta["family"]
         link = self.meta.get("link", "family_default")

@@ -29,8 +29,12 @@ def main(argv=None) -> None:
     ap.add_argument("--ip", default="0.0.0.0",
                     help="REST bind address for process 0 (default: all "
                          "interfaces — other pods must reach it)")
-    ap.add_argument("--port", type=int, default=54321,
-                    help="REST port served by process 0")
+    from h2o3_tpu import config
+
+    ap.add_argument("--port", type=int,
+                    default=config.get_int("H2O3_TPU_PORT"),
+                    help="REST port served by process 0 "
+                         "(default: H2O3_TPU_PORT knob)")
     ap.add_argument("--log-level", default="INFO")
     args = ap.parse_args(argv)
 

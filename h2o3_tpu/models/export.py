@@ -84,6 +84,9 @@ def _export_glm(model, meta, arrays) -> None:
     meta["coef_names"] = out["coef_names"]
     if out.get("multinomial"):
         arrays["beta_multinomial_std"] = np.asarray(out["beta_multinomial_std"])
+    elif out.get("ordinal"):
+        arrays["beta_std"] = np.asarray(out["beta_std"])
+        arrays["theta"] = np.asarray(out["theta"])  # ordered cuts (std scale)
     else:
         arrays["beta_std"] = np.asarray(out["beta_std"])
     meta["tweedie_link_power"] = getattr(model.params, "tweedie_link_power", 1.0)

@@ -506,6 +506,8 @@ class GLM(ModelBuilder):
         )
         if p.compute_p_values:
             raise ValueError("compute_p_values requires solver=IRLSM")
+        if p.lambda_search:
+            raise ValueError("lambda_search requires solver=IRLSM")
         fam = get_family(family, *fam_args)
         P = di.ncols_expanded
         icpt = P - 1 if p.intercept else None

@@ -206,3 +206,25 @@ def test_pojo_standalone_scoring(tmp_path):
     out = pd.read_csv(_io.StringIO(r.stdout))
     ours = m.predict(fr).vec("Y").to_numpy()
     np.testing.assert_allclose(out["Y"].to_numpy(), ours, atol=1e-5)
+
+
+def test_ordinal_glm_mojo_parity():
+    from h2o3_tpu.genmodel import MojoModel
+    from h2o3_tpu.models import GLM
+    from h2o3_tpu.models.export import export_mojo
+
+    rng = np.random.default_rng(6)
+    n = 2000
+    x0 = rng.normal(1.0, 2.0, n)
+    x1 = rng.normal(size=n)
+    yo = np.digitize(0.9 * x0 - x1 + rng.logistic(size=n), [0.0, 2.0])
+    df = pd.DataFrame({"x0": x0, "x1": x1, "y": yo.astype(str)})
+    fr = Frame.from_pandas(df, column_types={"y": "enum"})
+    m = GLM(family="ordinal").train(y="y", training_frame=fr)
+    p = str(tmp_like := __import__("tempfile").mktemp(suffix=".zip"))
+    export_mojo(m, p)
+    mojo = MojoModel.load(p)
+    offline = mojo.score_raw(mojo._rows_to_table(df.drop(columns="y")))
+    live = m._predict_raw(fr)
+    np.testing.assert_allclose(offline, live, atol=1e-5)
+    assert offline.shape == (n, 3)

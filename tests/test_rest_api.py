@@ -162,3 +162,35 @@ def test_rapids_parse_errors_are_4xx(server):
         assert False
     except urllib.error.HTTPError as e:
         assert e.code == 500
+
+
+def test_wave3_algos_build_over_rest(server):
+    """List-valued params (gam_columns, random_columns) coerce correctly
+    through the REST schema layer for the round-3 builders."""
+    rng = np.random.default_rng(11)
+    n = 1200
+    x = rng.normal(size=n)
+    g = rng.choice(["a", "b", "c"], n)
+    Frame.from_pandas(
+        pd.DataFrame({"x": x, "g": g,
+                      "y": np.sin(2 * x) + rng.normal(0, 0.1, n)}),
+        column_types={"g": "enum"}, destination_frame="w3fr", register=True,
+    )
+    cases = [
+        ("gam", {"gam_columns": ["x"]}),
+        ("rulefit", {"rule_generation_ntrees": 6}),
+        ("hglm", {"random_columns": ["g"]}),
+        ("modelselection", {"mode": "forward", "max_predictor_number": 2}),
+    ]
+    for algo, extra in cases:
+        res = _post(server, f"/3/ModelBuilders/{algo}",
+                    {"training_frame": "w3fr", "response_column": "y", **extra},
+                    as_json=True)
+        jj = _wait_job(server, res["job"]["key"]["name"])
+        assert jj["status"] == "DONE", f"{algo}: {jj.get('exception')}"
+    # the flow page serves and lists the new builders
+    with urllib.request.urlopen(server.url + "/") as r:
+        assert b"h2o3-tpu Flow" in r.read()
+    mb = _get(server, "/3/ModelBuilders")["model_builders"]
+    for algo, _ in cases:
+        assert algo in mb
