@@ -192,16 +192,19 @@ def import_file_lazy(
             return load
     else:
         # count rows the way pandas will parse them (quoted newlines, blank
-        # trailing lines): tokenize once materializing only the first column
-        # — and KEEP those values to seed the first column's loader, so the
-        # counting scan is not wasted I/O
+        # trailing lines): tokenize once materializing only the first column.
+        # Numeric first columns are cheap (8 B/row) — keep them to seed the
+        # loader so the scan isn't wasted; object/string columns could pin
+        # GBs for a column nobody may touch, so those are discarded.
         first_series = pd.read_csv(
             path, sep=setup.get("separator"), usecols=[names[0]], engine="c"
         )[names[0]]
         nrow = len(first_series)
+        if not pd.api.types.is_numeric_dtype(first_series):
+            first_series = None
 
         def make_loader(col: str, kind: str):
-            if col == names[0]:
+            if col == names[0] and first_series is not None:
                 def load_first():
                     return _series_values(first_series, kind)
 

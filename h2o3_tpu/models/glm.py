@@ -450,6 +450,8 @@ class GLM(ModelBuilder):
             raise ValueError("ordinal does not support offset_column")
         if p.compute_p_values:
             raise ValueError("compute_p_values requires solver=IRLSM")
+        if p.lambda_search:
+            raise ValueError("lambda_search is not supported for ordinal")
         if p.lambda_ is not None and float(np.atleast_1d(np.asarray(p.lambda_))[0]) > 0:
             Log.warn("ordinal fits unpenalized; lambda_ is ignored")
         K = yv.cardinality

@@ -208,7 +208,7 @@ def test_pojo_standalone_scoring(tmp_path):
     np.testing.assert_allclose(out["Y"].to_numpy(), ours, atol=1e-5)
 
 
-def test_ordinal_glm_mojo_parity():
+def test_ordinal_glm_mojo_parity(tmp_path):
     from h2o3_tpu.genmodel import MojoModel
     from h2o3_tpu.models import GLM
     from h2o3_tpu.models.export import export_mojo
@@ -221,7 +221,7 @@ def test_ordinal_glm_mojo_parity():
     df = pd.DataFrame({"x0": x0, "x1": x1, "y": yo.astype(str)})
     fr = Frame.from_pandas(df, column_types={"y": "enum"})
     m = GLM(family="ordinal").train(y="y", training_frame=fr)
-    p = str(tmp_like := __import__("tempfile").mktemp(suffix=".zip"))
+    p = str(tmp_path / "ordinal.zip")
     export_mojo(m, p)
     mojo = MojoModel.load(p)
     offline = mojo.score_raw(mojo._rows_to_table(df.drop(columns="y")))

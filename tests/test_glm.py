@@ -317,3 +317,29 @@ def test_glm_ordinal_standardized_coefs_consistent():
     Ps = ms._predict_raw(fr)
     Pu = mu._predict_raw(fr)
     np.testing.assert_allclose(Ps, Pu, atol=0.02)
+
+
+def test_hglm_two_random_columns():
+    from h2o3_tpu.models import HGLM
+
+    rng = np.random.default_rng(9)
+    n = 6000
+    g1 = rng.integers(0, 25, n)
+    g2 = rng.integers(0, 8, n)
+    u1 = rng.normal(0, 1.0, 25)
+    u2 = rng.normal(0, 2.0, 8)
+    x = rng.normal(size=n)
+    y = 1.0 + 2.0 * x + u1[g1] + u2[g2] + rng.normal(0, 0.7, n)
+    df = pd.DataFrame({"x": x, "g1": [f"a{i}" for i in g1],
+                       "g2": [f"b{i}" for i in g2], "y": y})
+    fr = Frame.from_pandas(df, column_types={"g1": "enum", "g2": "enum"})
+    m = HGLM(random_columns=["g1", "g2"]).train(
+        y="y", x=["x", "g1", "g2"], training_frame=fr
+    )
+    assert abs(m.coef["x"] - 2.0) < 0.05
+    s = m.output["sigma_u2"]
+    assert 0.5 < s["g1"] < 2.0  # true 1.0
+    assert 1.5 < s["g2"] < 12.0  # true 4.0, only 8 levels -> wide
+    assert abs(m.output["sigma_e2"] - 0.49) < 0.1
+    c1 = np.corrcoef([m.coefs_random("g1")[f"a{i}"] for i in range(25)], u1)[0, 1]
+    assert c1 > 0.99
