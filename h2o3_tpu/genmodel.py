@@ -98,7 +98,33 @@ class MojoModel:
         out = {"predict": labels}
         for k, d in enumerate(dom):
             out[str(d)] = raw[:, k]
+        cal = self._calibration()
+        if cal is not None and raw.shape[1] == 2:
+            p1 = np.clip(np.asarray(raw[:, 1], np.float64), 1e-12, 1 - 1e-12)
+            if cal["method"] == "PlattScaling":
+                eta = np.clip(
+                    cal["a"] * np.log(p1 / (1 - p1)) + cal["b"], -30.0, 30.0
+                )
+                cp1 = 1.0 / (1.0 + np.exp(-eta))
+            else:
+                cp1 = np.clip(
+                    np.interp(p1, cal["thresholds_x"], cal["thresholds_y"]),
+                    0.0, 1.0,
+                )
+            out["cal_p0"] = 1.0 - cp1
+            out["cal_p1"] = cp1
         return out
+
+    def _calibration(self) -> dict | None:
+        method = self.meta.get("calibration_method")
+        if method is None:
+            return None
+        if method == "PlattScaling":
+            a, b = self.meta["calibration_platt"]
+            return {"method": method, "a": a, "b": b}
+        return {"method": method,
+                "thresholds_x": self.arrays["cal_thresholds_x"],
+                "thresholds_y": self.arrays["cal_thresholds_y"]}
 
     def score_raw(self, table: dict[str, np.ndarray]) -> np.ndarray:
         raise NotImplementedError

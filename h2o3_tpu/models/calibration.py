@@ -1,0 +1,140 @@
+"""Probability calibration for binary classifiers — successor of the
+``calibrate_model`` / ``calibration_frame`` / ``calibration_method`` params
+on upstream tree models (Platt scaling + isotonic, ``CalibrationHelper``)
+[UNVERIFIED upstream paths, SURVEY.md §2.2].
+
+Fit happens once on the holdout calibration frame's predictions (host
+float64 — the data is one column); scoring applies the tiny calibrator to
+the predicted p1 and appends ``cal_p0``/``cal_p1`` columns, matching the
+upstream predict-frame layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.utils.log import Log
+
+
+def _logit(p: np.ndarray) -> np.ndarray:
+    p = np.clip(p, 1e-12, 1 - 1e-12)
+    return np.log(p / (1 - p))
+
+
+def fit_platt(p1: np.ndarray, y: np.ndarray, w: np.ndarray) -> dict:
+    """Platt scaling: logistic regression of y on logit(p1) (a, b).
+
+    Robustified the way Platt (1999) prescribes: smoothed targets
+    t+ = (N+ + 1)/(N+ + 2), t- = 1/(N- + 2) (prevents the separable-score
+    blowup an overconfident model produces), standardized feature, a tiny
+    ridge, and damped Newton steps.
+    """
+    f_raw = _logit(np.asarray(p1, np.float64))
+    y = np.asarray(y, np.float64)
+    n_pos = float(np.sum(w * (y > 0.5)))
+    n_neg = float(np.sum(w * (y <= 0.5)))
+    t = np.where(y > 0.5, (n_pos + 1.0) / (n_pos + 2.0), 1.0 / (n_neg + 2.0))
+    mu_f = float(np.average(f_raw, weights=np.maximum(w, 1e-12)))
+    sd_f = float(np.sqrt(np.average((f_raw - mu_f) ** 2,
+                                    weights=np.maximum(w, 1e-12)))) or 1.0
+    f = (f_raw - mu_f) / sd_f
+    a, b = 1.0, 0.0
+    ridge = 1e-6
+    for _ in range(100):
+        eta = np.clip(a * f + b, -30.0, 30.0)
+        mu = np.clip(1.0 / (1.0 + np.exp(-eta)), 1e-10, 1 - 1e-10)
+        W = w * mu * (1 - mu) + 1e-12
+        z = eta + (t - mu) / (mu * (1 - mu) + 1e-12)
+        s_ff = float(np.sum(W * f * f)) + ridge
+        s_f = float(np.sum(W * f))
+        s_1 = float(np.sum(W)) + ridge
+        r_f = float(np.sum(W * f * z)) + ridge * a
+        r_1 = float(np.sum(W * z)) + ridge * b
+        det = s_ff * s_1 - s_f * s_f
+        if abs(det) < 1e-30:
+            break
+        a_new = (r_f * s_1 - r_1 * s_f) / det
+        b_new = (s_ff * r_1 - s_f * r_f) / det
+        da, db = a_new - a, b_new - b
+        step = min(1.0, 4.0 / max(abs(da), abs(db), 1e-12))  # damp big jumps
+        a += step * da
+        b += step * db
+        if abs(da) + abs(db) < 1e-10:
+            break
+    # unstandardize: eta = a*(f_raw - mu_f)/sd_f + b
+    return {"method": "PlattScaling",
+            "a": float(a / sd_f), "b": float(b - a * mu_f / sd_f)}
+
+
+def fit_isotonic(p1: np.ndarray, y: np.ndarray, w: np.ndarray) -> dict:
+    """Isotonic calibration: PAV of y against p1."""
+    from h2o3_tpu.models.isotonic import _pav
+
+    order = np.argsort(p1, kind="stable")
+    ys = np.asarray(y, np.float64)[order]
+    ws = np.asarray(w, np.float64)[order]
+    fitted = _pav(ys, ws)
+    return {
+        "method": "IsotonicRegression",
+        "thresholds_x": np.asarray(p1, np.float64)[order],
+        "thresholds_y": fitted,
+    }
+
+
+def apply_calibration(cal: dict, p1: np.ndarray) -> np.ndarray:
+    p1 = np.asarray(p1, np.float64)
+    if cal["method"] == "PlattScaling":
+        eta = np.clip(cal["a"] * _logit(p1) + cal["b"], -30.0, 30.0)
+        return 1.0 / (1.0 + np.exp(-eta))
+    x = cal["thresholds_x"]
+    yv = cal["thresholds_y"]
+    return np.clip(np.interp(p1, x, yv), 0.0, 1.0)
+
+
+def validate_calibration_params(p, yv) -> None:
+    """Early param check (called from ModelBuilder._validate, BEFORE the
+    expensive build): misconfiguration must not cost a full training run."""
+    if not getattr(p, "calibrate_model", False):
+        return
+    from h2o3_tpu.models.model_base import _resolve_frame
+
+    if _resolve_frame(p.calibration_frame) is None:
+        raise ValueError("calibrate_model requires calibration_frame")
+    if not (yv.is_categorical() and yv.cardinality == 2):
+        raise ValueError("calibrate_model supports binary classification only")
+
+
+def maybe_fit_calibration(builder, model) -> None:
+    """Shared tail for tree builders: honor calibrate_model params."""
+    p = builder.params
+    if not getattr(p, "calibrate_model", False):
+        return
+    from h2o3_tpu.models.model_base import _remap_response, _resolve_frame
+
+    if not model.is_classifier or model.nclasses != 2:
+        raise ValueError("calibrate_model supports binary classification only")
+    frame = _resolve_frame(p.calibration_frame)
+    if frame is None:
+        raise ValueError("calibrate_model requires calibration_frame")
+    frame = model._apply_preprocessors(frame)  # e.g. TE, like predict()
+    raw = model._predict_raw(frame)
+    p1 = np.asarray(raw)[:, 1]
+    yv = frame.vec(p.response_column)
+    if yv.is_categorical():
+        y = _remap_response(yv, model.output["response_domain"]).astype(np.float64)
+    else:
+        y = yv.to_numpy().astype(np.float64)  # numeric 0/1 column
+    ok = ~np.isnan(y) & (y >= 0)
+    w = np.ones(frame.nrow)
+    if p.weights_column and p.weights_column in frame:
+        w = np.nan_to_num(frame.vec(p.weights_column).to_numpy())
+    method = (p.calibration_method or "AUTO").lower().replace("_", "")
+    if method in ("auto", "plattscaling", "platt"):
+        cal = fit_platt(p1[ok], y[ok], w[ok])
+    elif method in ("isotonicregression", "isotonic"):
+        cal = fit_isotonic(p1[ok], y[ok], w[ok])
+    else:
+        raise ValueError(f"unknown calibration_method {p.calibration_method!r}")
+    model.output["calibration"] = cal
+    Log.info(f"{model.algo}: fitted {cal['method']} calibration on "
+             f"{int(ok.sum())} holdout rows")

@@ -56,6 +56,14 @@ def _export_trees(model, meta, arrays) -> None:
     arrays["bin_is_cat"] = np.asarray(spec.is_cat)
     arrays["bin_nbins"] = np.asarray(spec.nbins)
     arrays["bin_edges"] = np.asarray(spec.edges)
+    cal = out.get("calibration")
+    if cal is not None:
+        meta["calibration_method"] = cal["method"]
+        if cal["method"] == "PlattScaling":
+            meta["calibration_platt"] = [cal["a"], cal["b"]]
+        else:
+            arrays["cal_thresholds_x"] = np.asarray(cal["thresholds_x"])
+            arrays["cal_thresholds_y"] = np.asarray(cal["thresholds_y"])
     tree_shapes = []
     for ti, group in enumerate(out["trees"]):
         class_levels = []

@@ -156,6 +156,15 @@ class Model:
         for k, d in enumerate(domain):
             vecs.append(Vec.from_numpy(probs[:, k], "real"))
             names.append(str(d))
+        cal = self.output.get("calibration")
+        if cal is not None and probs.shape[1] == 2:
+            from h2o3_tpu.models.calibration import apply_calibration
+
+            cp1 = apply_calibration(cal, probs[:, 1])
+            vecs.append(Vec.from_numpy(1.0 - cp1, "real"))
+            names.append("cal_p0")
+            vecs.append(Vec.from_numpy(cp1, "real"))
+            names.append("cal_p1")
         return Frame(vecs, names)
 
     def model_performance(self, test_data: Frame | None = None) -> MM.ModelMetrics:
@@ -319,6 +328,11 @@ class ModelBuilder:
         if p.response_column is not None:
             assert p.response_column in train, f"response {p.response_column!r} not in frame"
             yv = train.vec(p.response_column)
+            if getattr(p, "calibrate_model", False):
+                # reject misconfiguration BEFORE the expensive build
+                from h2o3_tpu.models.calibration import validate_calibration_params
+
+                validate_calibration_params(p, yv)
             if yv.is_categorical() and not self.SUPPORTS_CLASSIFICATION:
                 raise ValueError(f"{self.algo} does not support classification")
             if not yv.is_categorical() and not self.SUPPORTS_REGRESSION and self.algo != "glm":
@@ -446,6 +460,10 @@ def _params_dict(p, drop_cv: bool) -> dict:
         # overwritten by every fold
         d["checkpoint"] = None
         d["export_checkpoints_dir"] = None
+        # fold models' predict frames are never consumed — refitting the
+        # calibrator per fold would be pure waste
+        if "calibrate_model" in d:
+            d["calibrate_model"] = False
     return d
 
 

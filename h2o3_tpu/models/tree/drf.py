@@ -304,6 +304,9 @@ class DRF(ModelBuilder):
             model.validation_metrics = self._metrics_from_F(
                 Fv, yv_np, wv_np, valid.nrow, nt, K, classification, domain=dom
             )
+        from h2o3_tpu.models.calibration import maybe_fit_calibration
+
+        maybe_fit_calibration(self, model)
         return model
 
     def _metrics_from_F(self, F, yn, wn, nrow, ntrees, K, classification, domain=None):

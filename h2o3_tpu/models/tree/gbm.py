@@ -11,6 +11,7 @@ steps from the same histogram stats, shrunk by ``learn_rate``.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +50,12 @@ class SharedTreeParams(CommonParams):
     sample_rate: float = 1.0
     col_sample_rate_per_tree: float = 1.0
     score_tree_interval: int = 5
+    # probability calibration (upstream calibrate_model/calibration_frame on
+    # tree models): fits Platt scaling or isotonic regression on a holdout
+    # frame's predictions; predict() then appends cal_p0/cal_p1 columns
     calibrate_model: bool = False
+    calibration_frame: Any = None
+    calibration_method: str = "AUTO"  # AUTO -> PlattScaling | IsotonicRegression
 
 
 @dataclass
@@ -517,6 +523,9 @@ class GBM(ModelBuilder):
             model.validation_metrics = _metrics_from_F(
                 dist, Fv_s, yv_np, wv_np, valid.nrow, domain=dom
             )
+        from h2o3_tpu.models.calibration import maybe_fit_calibration
+
+        maybe_fit_calibration(self, model)
         return model
 
 

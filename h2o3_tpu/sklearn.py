@@ -95,7 +95,9 @@ class _SkBase:
         if not self._CLASSIFIER:
             raise AttributeError("predict_proba is classification-only")
         out = self._scored(X)
-        cols = [n for n in out.names if n != "predict"]
+        # per-class probability columns only (cal_p0/cal_p1 are extras)
+        cols = [n for n in out.names
+                if n != "predict" and not n.startswith("cal_p")]
         return np.stack([out.vec(c).to_numpy() for c in cols], axis=1)
 
     def score(self, X, y, sample_weight=None) -> float:

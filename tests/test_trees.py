@@ -318,3 +318,74 @@ def test_scanned_chunk_builder_matches_loop_quality():
 
     yv = np.asarray(y01)[: fr.nrow]
     assert roc_auc_score(yv, p1) > 0.8
+
+
+def test_calibrate_model_platt_and_isotonic():
+    """calibrate_model/calibration_frame: cal_p columns appear and
+    materially fix an overconfident (overfit) GBM's probabilities."""
+    from sklearn.metrics import log_loss
+
+    rng = np.random.default_rng(2)
+    n = 6000
+    X = rng.normal(size=(n, 5))
+    eta = 0.8 * X[:, 0] - 0.5 * X[:, 1]
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcde"))
+    df["y"] = np.where(y == 1, "Y", "N")
+    tr = Frame.from_pandas(df.iloc[:1500].reset_index(drop=True))
+    cal = Frame.from_pandas(df.iloc[1500:3000].reset_index(drop=True))
+    te = df.iloc[3000:].reset_index(drop=True)
+    tef = Frame.from_pandas(te)
+    yte = (te["y"] == "Y").astype(int)
+
+    # deliberately overfit: probabilities pushed toward 0/1
+    kw = dict(ntrees=150, max_depth=6, learn_rate=0.3, seed=1)
+    raw = GBM(**kw).train(y="y", training_frame=tr).predict(tef).vec("Y").to_numpy()
+    m = GBM(**kw, calibrate_model=True, calibration_frame=cal).train(
+        y="y", training_frame=tr
+    )
+    out = m.predict(tef)
+    assert out.names[-2:] == ["cal_p0", "cal_p1"]
+    cp1 = out.vec("cal_p1").to_numpy()
+    cp0 = out.vec("cal_p0").to_numpy()
+    np.testing.assert_allclose(cp0 + cp1, 1.0, atol=1e-9)
+    assert m.output["calibration"]["a"] < 0.8  # shrinks overconfident scores
+    ll_raw = log_loss(yte, np.clip(raw, 1e-9, 1 - 1e-9))
+    ll_cal = log_loss(yte, np.clip(cp1, 1e-9, 1 - 1e-9))
+    assert ll_cal < ll_raw - 0.1  # material improvement
+
+    iso = GBM(**kw, calibrate_model=True, calibration_frame=cal,
+              calibration_method="IsotonicRegression").train(
+        y="y", training_frame=tr
+    ).predict(tef).vec("cal_p1").to_numpy()
+    assert log_loss(yte, np.clip(iso, 1e-9, 1 - 1e-9)) < ll_raw - 0.1
+
+    with pytest.raises(Exception, match="calibration_frame"):
+        GBM(**kw, calibrate_model=True).train(y="y", training_frame=tr)
+
+
+def test_calibration_survives_mojo_export(tmp_path):
+    import os
+
+    from h2o3_tpu.genmodel import MojoModel
+    from h2o3_tpu.models.export import export_mojo
+
+    rng = np.random.default_rng(4)
+    n = 3000
+    X = rng.normal(size=(n, 4))
+    y = (rng.random(n) < 1 / (1 + np.exp(-X[:, 0]))).astype(int)
+    df = pd.DataFrame(X, columns=list("abcd"))
+    df["y"] = np.where(y == 1, "Y", "N")
+    tr = Frame.from_pandas(df.iloc[:1000].reset_index(drop=True))
+    cal = Frame.from_pandas(df.iloc[1000:2000].reset_index(drop=True))
+    te = df.iloc[2000:].reset_index(drop=True)
+    m = GBM(ntrees=40, max_depth=5, learn_rate=0.3, seed=2,
+            calibrate_model=True, calibration_frame=cal).train(
+        y="y", training_frame=tr
+    )
+    p = os.path.join(str(tmp_path), "calm.zip")
+    export_mojo(m, p)
+    off = MojoModel.load(p).predict(te.drop(columns="y"))
+    assert "cal_p1" in off
+    live = m.predict(Frame.from_pandas(te)).vec("cal_p1").to_numpy()
+    np.testing.assert_allclose(off["cal_p1"], live, atol=1e-6)
