@@ -36,6 +36,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
     calibrate_model: bool (default False)
+    calibration_frame: Any (default None)
+    calibration_method: str (default 'AUTO')
     learn_rate: float (default 0.1)
     learn_rate_annealing: float (default 1.0)
     distribution: str (default 'AUTO')
@@ -74,6 +76,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method='AUTO',
         learn_rate=0.1,
         learn_rate_annealing=1.0,
         distribution='AUTO',
@@ -107,6 +111,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
             calibrate_model=calibrate_model,
+            calibration_frame=calibration_frame,
+            calibration_method=calibration_method,
             learn_rate=learn_rate,
             learn_rate_annealing=learn_rate_annealing,
             distribution=distribution,
@@ -140,6 +146,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
             'calibrate_model': False,
+            'calibration_frame': None,
+            'calibration_method': 'AUTO',
             'learn_rate': 0.1,
             'learn_rate_annealing': 1.0,
             'distribution': 'AUTO',
@@ -181,6 +189,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
     calibrate_model: bool (default False)
+    calibration_frame: Any (default None)
+    calibration_method: str (default 'AUTO')
     mtries: int (default -1)
     binomial_double_trees: bool (default False)
     """
@@ -213,6 +223,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method='AUTO',
         mtries=-1,
         binomial_double_trees=False,
     ):
@@ -240,6 +252,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
             calibrate_model=calibrate_model,
+            calibration_frame=calibration_frame,
+            calibration_method=calibration_method,
             mtries=mtries,
             binomial_double_trees=binomial_double_trees,
         )
@@ -267,6 +281,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
             'calibrate_model': False,
+            'calibration_frame': None,
+            'calibration_method': 'AUTO',
             'mtries': -1,
             'binomial_double_trees': False,
         }
@@ -302,6 +318,8 @@ class H2OXRTEstimator(_EstimatorBase):
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
     calibrate_model: bool (default False)
+    calibration_frame: Any (default None)
+    calibration_method: str (default 'AUTO')
     mtries: int (default -1)
     binomial_double_trees: bool (default False)
     """
@@ -334,6 +352,8 @@ class H2OXRTEstimator(_EstimatorBase):
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method='AUTO',
         mtries=-1,
         binomial_double_trees=False,
     ):
@@ -361,6 +381,8 @@ class H2OXRTEstimator(_EstimatorBase):
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
             calibrate_model=calibrate_model,
+            calibration_frame=calibration_frame,
+            calibration_method=calibration_method,
             mtries=mtries,
             binomial_double_trees=binomial_double_trees,
         )
@@ -388,6 +410,8 @@ class H2OXRTEstimator(_EstimatorBase):
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
             'calibrate_model': False,
+            'calibration_frame': None,
+            'calibration_method': 'AUTO',
             'mtries': -1,
             'binomial_double_trees': False,
         }
@@ -433,6 +457,8 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
     missing_values_handling: str (default 'mean_imputation')
     compute_p_values: bool (default False)
     non_negative: bool (default False)
+    interactions: Any (default None)
+    interaction_pairs: Any (default None)
     """
 
     _BUILDER = "GLM"
@@ -473,6 +499,8 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
         missing_values_handling='mean_imputation',
         compute_p_values=False,
         non_negative=False,
+        interactions=None,
+        interaction_pairs=None,
     ):
         kw = dict(
             response_column=response_column,
@@ -508,6 +536,8 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
             missing_values_handling=missing_values_handling,
             compute_p_values=compute_p_values,
             non_negative=non_negative,
+            interactions=interactions,
+            interaction_pairs=interaction_pairs,
         )
         defaults = {
             'response_column': None,
@@ -543,6 +573,8 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
             'missing_values_handling': 'mean_imputation',
             'compute_p_values': False,
             'non_negative': False,
+            'interactions': None,
+            'interaction_pairs': None,
         }
         kw = {k: v for k, v in kw.items() if v != defaults[k]}
         super().__init__(model_id=model_id, **kw)
@@ -1578,6 +1610,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
     calibrate_model: bool (default False)
+    calibration_frame: Any (default None)
+    calibration_method: str (default 'AUTO')
     nlearners: int (default 50)
     weak_learner: str (default 'DT')
     learn_rate: float (default 0.5)
@@ -1611,6 +1645,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method='AUTO',
         nlearners=50,
         weak_learner='DT',
         learn_rate=0.5,
@@ -1639,6 +1675,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
             calibrate_model=calibrate_model,
+            calibration_frame=calibration_frame,
+            calibration_method=calibration_method,
             nlearners=nlearners,
             weak_learner=weak_learner,
             learn_rate=learn_rate,
@@ -1667,6 +1705,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
             'calibrate_model': False,
+            'calibration_frame': None,
+            'calibration_method': 'AUTO',
             'nlearners': 50,
             'weak_learner': 'DT',
             'learn_rate': 0.5,
@@ -1703,6 +1743,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
     calibrate_model: bool (default False)
+    calibration_frame: Any (default None)
+    calibration_method: str (default 'AUTO')
     """
 
     _BUILDER = "DT"
@@ -1733,6 +1775,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method='AUTO',
     ):
         kw = dict(
             response_column=response_column,
@@ -1758,6 +1802,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
             calibrate_model=calibrate_model,
+            calibration_frame=calibration_frame,
+            calibration_method=calibration_method,
         )
         defaults = {
             'response_column': None,
@@ -1783,6 +1829,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
             'calibrate_model': False,
+            'calibration_frame': None,
+            'calibration_method': 'AUTO',
         }
         kw = {k: v for k, v in kw.items() if v != defaults[k]}
         super().__init__(model_id=model_id, **kw)

@@ -285,6 +285,29 @@ def _design_matrix(meta_di: dict, table) -> np.ndarray:
     n = _n_rows(table)
     cols = []
     for c in meta_di["columns"]:
+        if c.get("pair"):
+            a, b = c["pair"]
+            # TRAINING means of the pair sources (exported with the spec),
+            # matching the live transform exactly
+            ma, mb = c.get("pair_means") or (0.0, 0.0)
+            if c["kind"] == "num":  # numeric product, standardized like num
+                xa = _col_numeric(table, a, n)
+                xb = _col_numeric(table, b, n)
+                xa = np.where(np.isnan(xa), ma, xa)
+                xb = np.where(np.isnan(xb), mb, xb)
+                x = xa * xb
+                if meta_di["standardize"]:
+                    x = (x - c["mean"]) / c["sigma"]
+                cols.append(x[:, None])
+            else:  # onehot(cat) * raw numeric
+                codes = _col_codes(table, a, c["domain"], n)
+                base = 0 if meta_di["use_all_factor_levels"] else 1
+                onehot = ((codes - base)[:, None]
+                          == np.arange(c["width"])[None, :]).astype(np.float64)
+                xb = _col_numeric(table, b, n)
+                xb = np.where(np.isnan(xb), mb, xb)
+                cols.append(onehot * xb[:, None])
+            continue
         if c["kind"] == "cat":
             codes = _col_codes(table, c["name"], c["domain"], n)
             base = 0 if meta_di["use_all_factor_levels"] else 1

@@ -34,6 +34,13 @@ class ColumnSpec:
     domain: tuple[str, ...] = ()
     offset: int = 0  # first column index in the expanded matrix
     width: int = 1
+    # interaction column (upstream `interactions`/`interaction_pairs`):
+    # ("a", "b") source pair; kind "num" = numeric product (standardized like
+    # any numeric), kind "cat" = onehot(cat) * raw numeric per level.
+    # pair_means = TRAINING means of the numeric sources (NA imputation must
+    # not depend on the scoring batch)
+    pair: tuple[str, str] | None = None
+    pair_means: tuple[float, float] | None = None
 
 
 @dataclass
@@ -56,6 +63,7 @@ class DataInfo:
         use_all_factor_levels: bool = True,
         missing_handling: str = MEAN_IMPUTATION,
         add_intercept: bool = False,
+        interaction_pairs: list[tuple[str, str]] | None = None,
     ) -> "DataInfo":
         di = DataInfo(
             standardize=standardize,
@@ -91,6 +99,45 @@ class DataInfo:
                     )
                 )
                 off += 1
+        for a, b in interaction_pairs or ():
+            va, vb = frame.vec(a), frame.vec(b)
+            if va.is_categorical() and vb.is_categorical():
+                raise ValueError(
+                    f"cat x cat interaction {a}:{b} is not supported "
+                    "(numeric x numeric and categorical x numeric are)"
+                )
+            if va.is_categorical() or vb.is_categorical():
+                cv, nv = (va, vb) if va.is_categorical() else (vb, va)
+                k = cv.cardinality
+                width = k if use_all_factor_levels else max(1, k - 1)
+                di.columns.append(
+                    ColumnSpec(f"{cv.name}:{nv.name}", "cat",
+                               domain=cv.domain or (), offset=off,
+                               width=width, pair=(cv.name, nv.name),
+                               pair_means=(0.0, float(nv.mean())))
+                )
+                off += width
+            else:
+                # product stats on device (one tiny reduction) so the
+                # interaction standardizes like any other numeric column
+                ma, mb = float(va.mean()), float(vb.mean())
+                xa = jnp.nan_to_num(va.data, nan=ma)
+                xb = jnp.nan_to_num(vb.data, nan=mb)
+                prod = xa * xb
+                mask = frame.row_mask()
+                sw = jnp.maximum(mask.sum(), 1.0)
+                mean = float(jnp.sum(prod * mask) / sw)
+                sigma = float(
+                    jnp.sqrt(jnp.sum(mask * (prod - mean) ** 2) / sw)
+                ) if standardize else 1.0
+                if not np.isfinite(sigma) or sigma == 0.0:
+                    sigma = 1.0
+                di.columns.append(
+                    ColumnSpec(f"{a}:{b}", "num",
+                               mean=mean if standardize else 0.0, sigma=sigma,
+                               offset=off, pair=(a, b), pair_means=(ma, mb))
+                )
+                off += 1
         di.ncols_expanded = off + (1 if add_intercept else 0)
         return di
 
@@ -100,7 +147,13 @@ class DataInfo:
         for c in self.columns:
             if c.kind == "cat":
                 lo = 0 if self.use_all_factor_levels else 1
-                names += [f"{c.name}.{d}" for d in c.domain[lo : lo + c.width]]
+                if c.pair is not None:  # cat x num interaction block
+                    names += [
+                        f"{c.pair[0]}.{d}:{c.pair[1]}"
+                        for d in c.domain[lo : lo + c.width]
+                    ]
+                else:
+                    names += [f"{c.name}.{d}" for d in c.domain[lo : lo + c.width]]
             else:
                 names.append(c.name)
         if self.add_intercept:
@@ -113,6 +166,10 @@ class DataInfo:
         cols = []
         valid = frame.row_mask()
         for c in self.columns:
+            if c.pair is not None:
+                col, valid = self._transform_interaction(frame, c, valid)
+                cols.append(col)
+                continue
             v = frame.vec(c.name)
             if c.kind == "cat":
                 codes = _adapt_codes(v, c.domain)
@@ -137,6 +194,34 @@ class DataInfo:
         # zero out invalid rows so they contribute nothing to reductions
         X = X * valid[:, None]
         return X, valid
+
+    def _transform_interaction(self, frame: Frame, c: ColumnSpec, valid):
+        """Interaction block: numeric product or onehot(cat) * numeric.
+
+        NA imputation uses the TRAINING means (c.pair_means) — never the
+        scoring batch's — and missing_handling=SKIP invalidates rows with
+        missing sources exactly like the base columns do.
+        """
+        if c.kind == "num":
+            va, vb = frame.vec(c.pair[0]), frame.vec(c.pair[1])
+            ma, mb = c.pair_means or (0.0, 0.0)
+            na = jnp.isnan(va.data) | jnp.isnan(vb.data)
+            if self.missing_handling == SKIP:
+                valid = valid * (~na).astype(jnp.float32)
+            xa = jnp.nan_to_num(va.data, nan=ma)
+            xb = jnp.nan_to_num(vb.data, nan=mb)
+            x = xa * xb
+            if self.standardize:
+                x = (x - c.mean) / c.sigma
+            return x[:, None], valid
+        cv, nv = frame.vec(c.pair[0]), frame.vec(c.pair[1])
+        codes = _adapt_codes(cv, c.domain)
+        if self.missing_handling == SKIP:
+            valid = valid * (codes >= 0).astype(jnp.float32)
+            valid = valid * (~jnp.isnan(nv.data)).astype(jnp.float32)
+        oh = _expand_cat(codes, len(c.domain), c.width, self.use_all_factor_levels)
+        x = jnp.nan_to_num(nv.data, nan=(c.pair_means or (0.0, 0.0))[1])
+        return oh * x[:, None], valid
 
 
 def _adapt_codes(v: Vec, train_domain: tuple[str, ...]):

@@ -37,7 +37,9 @@ def _datainfo_meta(di) -> dict:
         "columns": [
             {"name": c.name, "kind": c.kind, "mean": float(c.mean),
              "sigma": float(c.sigma), "domain": list(c.domain),
-             "offset": c.offset, "width": c.width}
+             "offset": c.offset, "width": c.width,
+             "pair": list(c.pair) if c.pair else None,
+             "pair_means": list(c.pair_means) if c.pair_means else None}
             for c in di.columns
         ],
     }

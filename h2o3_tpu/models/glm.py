@@ -64,6 +64,10 @@ class GLMParams(CommonParams):
     missing_values_handling: str = MEAN_IMPUTATION
     compute_p_values: bool = False
     non_negative: bool = False
+    # upstream `interactions` (all pairwise among the listed columns) and
+    # `interaction_pairs` (explicit pairs); num x num and cat x num supported
+    interactions: Any = None
+    interaction_pairs: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +238,13 @@ class GLM(ModelBuilder):
             and yv.is_categorical()
         )
 
+        pairs: list[tuple[str, str]] = []
+        if p.interactions:
+            import itertools as _it
+
+            pairs += list(_it.combinations([str(c) for c in p.interactions], 2))
+        if p.interaction_pairs:
+            pairs += [(str(a), str(b)) for a, b in p.interaction_pairs]
         di = DataInfo.fit(
             train,
             self._x,
@@ -242,6 +253,7 @@ class GLM(ModelBuilder):
             missing_handling=p.missing_values_handling,
             # ordinal: the K-1 ordered cuts ARE the intercepts
             add_intercept=p.intercept and family != "ordinal",
+            interaction_pairs=pairs or None,
         )
         X, valid_mask = di.transform(train)
         w = valid_mask

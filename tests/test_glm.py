@@ -343,3 +343,44 @@ def test_hglm_two_random_columns():
     assert abs(m.output["sigma_e2"] - 0.49) < 0.1
     c1 = np.corrcoef([m.coefs_random("g1")[f"a{i}"] for i in range(25)], u1)[0, 1]
     assert c1 > 0.99
+
+
+def test_glm_interactions_recover_products(tmp_path):
+    import os
+
+    from h2o3_tpu.genmodel import MojoModel
+    from h2o3_tpu.models.export import export_mojo
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    x1, x2 = rng.normal(size=(2, n))
+    g = rng.choice(["a", "b"], n)
+    slope = np.where(g == "a", 1.0, -2.0)
+    y = 0.5 * x1 + 3.0 * x1 * x2 + slope * x2 + 0.1 * rng.normal(size=n)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "g": g, "y": y})
+    fr = Frame.from_pandas(df)
+    m0 = GLM(lambda_=0.0).train(y="y", x=["x1", "x2", "g"], training_frame=fr)
+    m1 = GLM(lambda_=0.0, interaction_pairs=[("x1", "x2"), ("g", "x2")]).train(
+        y="y", x=["x1", "x2", "g"], training_frame=fr
+    )
+    assert m0.training_metrics.value("r2") < 0.2  # additive model can't fit
+    assert m1.training_metrics.value("r2") > 0.99
+    c = m1.coef
+    assert abs(c["x1:x2"] - 3.0) < 0.05  # product coefficient recovered
+    assert abs(c["g.b:x2"] - (-3.0)) < 0.05  # slope delta b vs baseline a
+    # export round-trips the interaction design
+    p = os.path.join(str(tmp_path), "inter.zip")
+    export_mojo(m1, p)
+    off = MojoModel.load(p).predict(df.drop(columns="y"))["predict"]
+    live = m1.predict(fr).vec("predict").to_numpy()
+    np.testing.assert_allclose(off, live, atol=1e-4)
+    # `interactions` list form = all pairwise
+    m2 = GLM(lambda_=0.0, interactions=["x1", "x2"]).train(
+        y="y", x=["x1", "x2"], training_frame=fr
+    )
+    assert "x1:x2" in m2.coef
+    # cat x cat rejected clearly
+    with pytest.raises(Exception, match="cat x cat"):
+        GLM(interaction_pairs=[("g", "g")]).train(
+            y="y", x=["x1", "g"], training_frame=fr
+        )
