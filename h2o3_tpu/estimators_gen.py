@@ -46,6 +46,7 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
     quantile_alpha: float (default 0.5)
     tweedie_power: float (default 1.5)
     huber_alpha: float (default 0.9)
+    monotone_constraints: Any (default None)
     """
 
     _BUILDER = "GBM"
@@ -86,6 +87,7 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
         quantile_alpha=0.5,
         tweedie_power=1.5,
         huber_alpha=0.9,
+        monotone_constraints=None,
     ):
         kw = dict(
             response_column=response_column,
@@ -121,6 +123,7 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             quantile_alpha=quantile_alpha,
             tweedie_power=tweedie_power,
             huber_alpha=huber_alpha,
+            monotone_constraints=monotone_constraints,
         )
         defaults = {
             'response_column': None,
@@ -156,6 +159,7 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             'quantile_alpha': 0.5,
             'tweedie_power': 1.5,
             'huber_alpha': 0.9,
+            'monotone_constraints': None,
         }
         kw = {k: v for k, v in kw.items() if v != defaults[k]}
         super().__init__(model_id=model_id, **kw)

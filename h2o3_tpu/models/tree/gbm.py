@@ -68,6 +68,9 @@ class GBMParams(SharedTreeParams):
     quantile_alpha: float = 0.5
     tweedie_power: float = 1.5
     huber_alpha: float = 0.9
+    # {col: +1|-1} monotone direction constraints (numeric features only;
+    # enforced via split rejection + child-bound propagation, like upstream)
+    monotone_constraints: Any = None
 
 
 class SharedTreeModel(Model):
@@ -360,7 +363,32 @@ class GBM(ModelBuilder):
         # dispatch latency dominates once any D2H transfer has happened).
         # CPU keeps the per-tree loop (cheap dispatch, early-exit polling,
         # and the behavior the pinned tests were written against).
-        use_scan = dist != "multinomial" and jax.default_backend() != "cpu"
+        mono_vec = None
+        if p.monotone_constraints:
+            if dist not in ("gaussian", "bernoulli", "tweedie", "quantile"):
+                raise ValueError(
+                    "monotone_constraints supports gaussian/bernoulli/"
+                    "tweedie/quantile distributions"
+                )
+            mono_vec = np.zeros(len(self._x), np.int32)
+            for cname, d in dict(p.monotone_constraints).items():
+                if int(d) == 0:  # upstream accepts 0 = unconstrained
+                    continue
+                if cname not in self._x:
+                    raise ValueError(f"monotone constraint on unknown column {cname!r}")
+                ci = self._x.index(cname)
+                if spec.is_cat[ci]:
+                    raise ValueError(
+                        f"monotone constraint on categorical column {cname!r}"
+                    )
+                if int(d) not in (-1, 1):
+                    raise ValueError("monotone directions must be -1, 0 or 1")
+                mono_vec[ci] = int(d)
+            if not mono_vec.any():
+                mono_vec = None
+
+        use_scan = (dist != "multinomial" and jax.default_backend() != "cpu"
+                    and mono_vec is None)
         if use_scan:
             from h2o3_tpu.models.tree.shared_tree import (
                 build_trees_scanned,
@@ -472,6 +500,7 @@ class GBM(ModelBuilder):
                     col_sample_rate=p.col_sample_rate,
                     col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                     max_abs_leaf=p.max_abs_leafnode_pred,
+                    monotone=mono_vec,
                 )
                 group.append(tree)
             trees.append(group)

@@ -44,7 +44,8 @@ _NEG = -1e30
 # split finding (pure function, traced inside the level step)
 
 
-def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols=()):
+def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols=(),
+                mono=None, node_lo=None, node_hi=None):
     """Best split per node from hist (N, C, B, 4). Returns per-node arrays.
 
     Stats axis: 0=w, 1=wy, 2=wy2, 3=wh. Bin 0 is the NA bin.
@@ -53,6 +54,13 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
     mean-sorted categorical branch (two argsorts over (N, C, B-1) — by far
     the most expensive part of this scan on TPU) runs only on that column
     subset, and disappears entirely for all-numeric frames.
+
+    ``mono`` (optional, (C,) int {-1,0,1}) activates monotone-constraint
+    feasibility: numeric candidates whose bound-clamped child Newton values
+    violate the direction are masked BEFORE the column argmax (so a feasible
+    categorical or other-numeric split wins on merit), and the result gains
+    ``mid``/``mono_col`` for child-bound propagation. The unconstrained path
+    is untouched (this branch doesn't trace when mono is None).
     """
     N, C, B, _ = hist.shape
     total = hist.sum(axis=2)  # (N, C, 4)
@@ -80,6 +88,18 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
 
     g_naleft = gain_with_na(left_n + na[:, :, None, :], right_n)
     g_naright = gain_with_na(left_n, right_n + na[:, :, None, :])
+    if mono is not None:
+
+        def child_val(s):  # Newton child value wy/wh, clamped to node bounds
+            v = jnp.where(s[..., 3] > 0, s[..., 1] / jnp.maximum(s[..., 3], 1e-30), 0.0)
+            return jnp.clip(v, node_lo[:, None, None], node_hi[:, None, None])
+
+        m = mono[None, :, None]
+        na_b = na[:, :, None, :]
+        ok_nl = (m == 0) | (m * (child_val(right_n) - child_val(left_n + na_b)) >= 0)
+        ok_nr = (m == 0) | (m * (child_val(right_n + na_b) - child_val(left_n)) >= 0)
+        g_naleft = jnp.where(ok_nl, g_naleft, _NEG)
+        g_naright = jnp.where(ok_nr, g_naright, _NEG)
     g_num = jnp.maximum(g_naleft, g_naright)  # (N, C, B-2)
     num_best_t = jnp.argmax(g_num, axis=2)  # (N, C)
     num_best_gain = jnp.take_along_axis(g_num, num_best_t[:, :, None], 2).squeeze(2)
@@ -157,7 +177,7 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
     node_wh = total[:, 0, 3]
     ok_split = best_gain >= min_split_improvement
 
-    return {
+    out = {
         "gain": best_gain,
         "ok": ok_split,
         "col": best_col,
@@ -169,6 +189,30 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
         "node_wy": node_wy,
         "node_wh": node_wh,
     }
+    if mono is not None:
+        # chosen split's clamped child values -> mid for bound propagation
+        # (categorical winners carry mono_col 0, so their mid is never used)
+        t_idx = bc_t
+        gidx = best_col[:, None, None, None]
+        gather = lambda arr: jnp.take_along_axis(
+            jnp.take_along_axis(arr, gidx, 1).squeeze(1),
+            t_idx[:, None, None], 1,
+        ).squeeze(1)  # (N, 4)
+        na_best = jnp.take_along_axis(na, best_col[:, None, None], 1).squeeze(1)
+        nl = bc_na_left[:, None]
+        Lst = gather(left_n) + jnp.where(nl, na_best, 0.0)
+        Rst = gather(right_n) + jnp.where(~nl, na_best, 0.0)
+        vL = jnp.clip(
+            jnp.where(Lst[:, 3] > 0, Lst[:, 1] / jnp.maximum(Lst[:, 3], 1e-30), 0.0),
+            node_lo, node_hi,
+        )
+        vR = jnp.clip(
+            jnp.where(Rst[:, 3] > 0, Rst[:, 1] / jnp.maximum(Rst[:, 3], 1e-30), 0.0),
+            node_lo, node_hi,
+        )
+        out["mid"] = 0.5 * (vL + vR)
+        out["mono_col"] = jnp.where(bc_is_cat, 0, mono[best_col])
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -276,6 +320,114 @@ def _level_step_fn(
         "gain": gain,
     }
     return nid, preds, varimp, n_split, record
+
+
+# ---------------------------------------------------------------------------
+# monotone-constraint variant of the level step (GBM monotone_constraints).
+# Kept separate so the unconstrained hot path compiles byte-identical; used
+# only via build_tree's per-level loop when constraints are present.
+
+
+def _level_step_mono_fn(
+    bins_u8, nid, preds, varimp, w, wy, wy2, wh, key, cols_enabled, is_cat,
+    min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+    mono, node_lo, node_hi,
+    *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
+    cat_cols: tuple = (),
+):
+    """Monotone variant of _level_step_fn: leaf values clamp to the node's
+    [lo, hi] bounds; children of a constrained split get tightened bounds."""
+    from h2o3_tpu.ops.histogram import histogram_in_jit
+
+    C = bins_u8.shape[1]
+    hist = histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_pad, n_bins)
+
+    if force_leaf:
+        tot = hist[:, 0, :, :].sum(axis=1)
+        node_w, node_wy, node_wh = tot[:, 0], tot[:, 1], tot[:, 3]
+        ok = jnp.zeros(n_pad, bool)
+        gain = jnp.zeros(n_pad, jnp.float32)
+        split_col = jnp.zeros(n_pad, jnp.int32)
+        split_bin = jnp.zeros(n_pad, jnp.int32)
+        is_cat_n = jnp.zeros(n_pad, bool)
+        cat_mask = jnp.zeros((n_pad, n_bins), bool)
+        na_left = jnp.zeros(n_pad, bool)
+        mid = jnp.zeros(n_pad, jnp.float32)
+        mono_col = jnp.zeros(n_pad, jnp.int32)
+    else:
+        col_mask = jnp.broadcast_to(cols_enabled[None, :], (n_pad, C))
+        keep = jax.random.uniform(key, (n_pad, C)) < col_sample_rate
+        keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
+        col_mask = col_mask * keep
+        sp = _split_scan(
+            hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols,
+            mono=mono, node_lo=node_lo, node_hi=node_hi,
+        )
+        ok = sp["ok"]
+        fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
+        ok = ok & fits
+        gain = jnp.where(ok, jnp.maximum(sp["gain"], 0.0), 0.0)
+        node_w, node_wy, node_wh = sp["node_w"], sp["node_wy"], sp["node_wh"]
+        split_col, split_bin = sp["col"], sp["split_bin"]
+        is_cat_n, cat_mask, na_left = sp["is_cat"], sp["cat_mask"], sp["na_left"]
+        mid, mono_col = sp["mid"], sp["mono_col"]
+
+    leaf_now = ~ok
+    leaf_val = jnp.where(node_wh > 0, node_wy / jnp.maximum(node_wh, 1e-30), 0.0)
+    leaf_val = jnp.clip(leaf_val, node_lo, node_hi)  # monotone bound clamp
+    leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
+    leaf_val = jnp.where(leaf_now, leaf_val, 0.0).astype(jnp.float32)
+
+    cs = jnp.cumsum(ok.astype(jnp.int32))
+    child_base = jnp.where(ok, 2 * (cs - 1), 0).astype(jnp.int32)
+    varimp = varimp.at[split_col].add(jnp.where(ok, gain, 0.0).astype(varimp.dtype))
+
+    # child bounds scatter: left child at child_base, right at child_base+1
+    new_lo = jnp.full(n_pad_next, -jnp.inf, jnp.float32)
+    new_hi = jnp.full(n_pad_next, jnp.inf, jnp.float32)
+    inc = mono_col > 0
+    dec = mono_col < 0
+    l_lo = jnp.where(dec, mid, node_lo)
+    l_hi = jnp.where(inc, mid, node_hi)
+    r_lo = jnp.where(inc, mid, node_lo)
+    r_hi = jnp.where(dec, mid, node_hi)
+    li = jnp.where(ok, child_base, n_pad_next)  # OOB drop for leaves
+    ri = jnp.where(ok, child_base + 1, n_pad_next)
+    new_lo = new_lo.at[li].set(l_lo, mode="drop")
+    new_lo = new_lo.at[ri].set(r_lo, mode="drop")
+    new_hi = new_hi.at[li].set(l_hi, mode="drop")
+    new_hi = new_hi.at[ri].set(r_hi, mode="drop")
+
+    nid, preds = _partition_update(
+        bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
+        na_left, leaf_now, leaf_val, child_base,
+    )
+    record = {
+        "node_w": node_w.astype(jnp.float32),
+        "split_col": split_col.astype(jnp.int32),
+        "split_bin": split_bin.astype(jnp.int32),
+        "is_cat": is_cat_n, "cat_mask": cat_mask, "na_left": na_left,
+        "leaf_now": leaf_now, "leaf_val": leaf_val, "child_base": child_base,
+        "gain": gain,
+    }
+    n_split = cs[-1] if n_pad and not force_leaf else jnp.int32(0)
+    return nid, preds, varimp, n_split, record, new_lo, new_hi
+
+
+def _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols=()):
+    key = ("mono", n_pad, n_pad_next, n_bins, force_leaf, cat_cols,
+           jax.default_backend())
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(
+            partial(
+                _level_step_mono_fn,
+                n_pad=n_pad, n_pad_next=n_pad_next, n_bins=n_bins,
+                force_leaf=force_leaf, cat_cols=cat_cols,
+            )
+        )
+        _STEP_CACHE[key] = fn
+    return fn
 
 
 _STEP_CACHE: dict = {}
@@ -595,6 +747,7 @@ def build_tree(
     cols_enabled=None,
     max_abs_leaf: float = np.inf,
     node_cap: int = 2048,
+    monotone=None,  # (C,) int {-1,0,1} per-column constraint directions
 ):
     """Build one tree without any host↔device traffic in the level loop.
 
@@ -624,6 +777,35 @@ def build_tree(
 
     cat_cols = tuple(int(i) for i in np.nonzero(np.asarray(is_cat_cols, bool))[0])
     tree = Tree()
+
+    # Monotone constraints carry per-node [lo, hi] bound state level to
+    # level — a separate per-level loop (constrained builds trade the fused
+    # dispatch for correctness; the default path is untouched).
+    if monotone is not None and np.any(np.asarray(monotone) != 0):
+        mono_dev = jnp.asarray(np.asarray(monotone, np.int32))
+        nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+        node_lo = jnp.full(1, -jnp.inf, jnp.float32)
+        node_hi = jnp.full(1, jnp.inf, jnp.float32)
+        for depth in range(max_depth + 1):
+            n_pad = min(1 << depth, node_cap)
+            n_pad_next = min(2 * n_pad, node_cap)
+            force_leaf = depth == max_depth
+            step = _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols)
+            lkey = jax.random.fold_in(key, depth)
+            nid, preds, varimp, n_split, rec, node_lo, node_hi = step(
+                bins_u8, nid, preds, varimp, w, wy, wy2, wh, lkey,
+                cols_enabled_dev, is_cat_dev,
+                jnp.float32(min_rows), jnp.float32(min_split_improvement),
+                jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+                jnp.float32(col_sample_rate),
+                mono_dev, node_lo, node_hi,
+            )
+            tree.levels.append(TreeLevel(**rec))
+            if force_leaf:
+                break
+            if jax.default_backend() == "cpu" and int(n_split) == 0:
+                break
+        return tree, preds, varimp
 
     # On accelerators, build the WHOLE tree in one dispatch (tunnel-latency
     # amortization; no early-exit polling is possible, acceptable up to

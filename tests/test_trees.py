@@ -389,3 +389,58 @@ def test_calibration_survives_mojo_export(tmp_path):
     assert "cal_p1" in off
     live = m.predict(Frame.from_pandas(te)).vec("cal_p1").to_numpy()
     np.testing.assert_allclose(off["cal_p1"], live, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_monotone_constraints_enforced():
+    """monotone_constraints: per-tree split rejection + bound propagation
+    makes predictions monotone in the constrained feature at any slice."""
+    rng = np.random.default_rng(1)
+    n = 5000
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    y = x + 0.8 * np.sin(3 * x) + 0.5 * z + 0.2 * rng.normal(size=n)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x, "z": z, "y": y}))
+    kw = dict(ntrees=40, max_depth=4, learn_rate=0.2, seed=1)
+    m0 = GBM(**kw).train(y="y", training_frame=fr)
+    m1 = GBM(**kw, monotone_constraints={"x": 1}).train(y="y", training_frame=fr)
+    xs = np.linspace(-3, 3, 300)
+    for zv in (-1.0, 0.0, 1.5):
+        gf = Frame.from_pandas(pd.DataFrame({"x": xs, "z": np.full(300, zv)}))
+        p0 = m0.predict(gf).vec("predict").to_numpy()
+        p1 = m1.predict(gf).vec("predict").to_numpy()
+        if zv == 0.0:
+            assert (np.diff(p0) < -1e-9).sum() > 0  # wiggles without it
+        assert (np.diff(p1) < -1e-9).sum() == 0  # monotone with it
+    # quality stays close
+    assert m1.training_metrics.value("r2") > m0.training_metrics.value("r2") - 0.05
+    # decreasing constraint on -y
+    fr2 = Frame.from_pandas(pd.DataFrame({"x": x, "z": z, "y": -y}))
+    m2 = GBM(**kw, monotone_constraints={"x": -1}).train(y="y", training_frame=fr2)
+    gf = Frame.from_pandas(pd.DataFrame({"x": xs, "z": np.zeros(300)}))
+    p2 = m2.predict(gf).vec("predict").to_numpy()
+    assert (np.diff(p2) > 1e-9).sum() == 0  # non-increasing
+
+    # binary margin monotonicity (bernoulli)
+    yb = (rng.random(n) < 1 / (1 + np.exp(-(x + np.sin(2 * x))))).astype(int)
+    frb = Frame.from_pandas(pd.DataFrame(
+        {"x": x, "z": z, "y": np.where(yb == 1, "Y", "N")}))
+    mb = GBM(ntrees=30, max_depth=3, learn_rate=0.3, seed=2,
+             monotone_constraints={"x": 1}).train(y="y", training_frame=frb)
+    pb = mb.predict(Frame.from_pandas(
+        pd.DataFrame({"x": xs, "z": np.zeros(300)}))).vec("Y").to_numpy()
+    assert (np.diff(pb) < -1e-9).sum() == 0
+
+    # validation errors
+    with pytest.raises(Exception, match="categorical|unknown"):
+        g = rng.choice(["a", "b"], n)
+        frc = Frame.from_pandas(pd.DataFrame(
+            {"x": x, "g": g, "y": y}))
+        GBM(ntrees=5, monotone_constraints={"g": 1}).train(
+            y="y", training_frame=frc
+        )
+    with pytest.raises(Exception, match="distributions"):
+        GBM(ntrees=5, distribution="poisson",
+            monotone_constraints={"x": 1}).train(
+            y="y", training_frame=Frame.from_pandas(
+                pd.DataFrame({"x": x, "y": np.abs(y)})))
