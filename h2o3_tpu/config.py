@@ -9,6 +9,8 @@ c; print(c.describe())"`` is the single source of truth for operators.
 Knobs (env var → meaning):
 - ``H2O3_TPU_NATIVE``        "0" disables the C++ scoring runtime (native.py)
 - ``H2O3_TPU_HIST``          "matmul" forces the XLA matmul histogram over Pallas
+- ``H2O3_TPU_HIST_SUBTRACT`` "0" disables sibling-subtraction in the fused
+                             tree builder (direct per-node histograms)
 - ``H2O3_TPU_STREAM_BYTES``  CSV size threshold that flips parse to streaming
 - ``H2O3_TPU_PORT``          default REST port
 - ``H2O3_TPU_LOG_LEVEL``     default log level for init()
@@ -22,6 +24,9 @@ _KNOBS: dict[str, tuple[str, str]] = {
     # name -> (default, doc)
     "H2O3_TPU_NATIVE": ("1", "C++ scoring runtime on (1) / off (0)"),
     "H2O3_TPU_HIST": ("", "histogram impl override: '' auto, 'matmul' forces XLA"),
+    "H2O3_TPU_HIST_SUBTRACT": (
+        "1", "fused tree builder: build lighter child's histogram, derive "
+        "sibling by parent subtraction (0 = direct per-node histograms)"),
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),

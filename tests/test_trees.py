@@ -320,6 +320,88 @@ def test_scanned_chunk_builder_matches_loop_quality():
     assert roc_auc_score(yv, p1) > 0.8
 
 
+def test_hist_subtraction_matches_direct(monkeypatch):
+    """The fused builder's sibling-subtraction scheme (build the lighter
+    child's histogram, derive the other as parent − built; terminal level
+    from recorded split stats) must reproduce the direct per-node-histogram
+    scheme: same splits, same leaf structure, near-identical predictions."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+    from h2o3_tpu.models.tree.distributions import grad_hess, init_score
+    from h2o3_tpu.models.tree.shared_tree import (
+        build_trees_scanned,
+        trees_from_stacked,
+    )
+
+    rng = np.random.default_rng(11)
+    n = 4000
+    df = pd.DataFrame(
+        {
+            "a": rng.normal(size=n),
+            "b": rng.normal(size=n),
+            "cat": rng.choice(list("uvwxyz"), size=n),
+            "c": rng.normal(size=n),
+        }
+    )
+    df.loc[rng.random(n) < 0.05, "a"] = np.nan  # exercise the NA bin
+    eta = 2 * df["a"].fillna(0) + (df["cat"].isin(["u", "v"])) * 1.5 - df["c"]
+    yarr = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(np.float32)
+    df["y"] = yarr
+
+    fr = Frame.from_pandas(df)
+    cols = ["a", "b", "cat", "c"]
+    spec = fit_bins(fr, cols)
+    bins = bin_frame(spec, fr)
+    npad = bins.shape[0]
+    ybuf = np.zeros(npad, np.float32)
+    ybuf[: fr.nrow] = yarr
+    y01 = jnp.asarray(ybuf)
+    w = jnp.asarray((np.arange(npad) < fr.nrow).astype(np.float32))
+    f0 = init_score("bernoulli", yarr, np.ones(fr.nrow), 0.0)
+
+    def run():
+        F = jnp.full(npad, f0, jnp.float32)
+        varimp = jnp.zeros(len(cols), jnp.float32)
+        F2, vi, stacked = build_trees_scanned(
+            bins, w, y01, F, varimp, jax.random.PRNGKey(7), 4,
+            grad_fn=lambda F_, y_, w_: grad_hess("bernoulli", F_, y_, w_, 0.0),
+            grad_key=("test", "bernoulli"),
+            sample_rate=0.9,
+            n_bins=spec.max_bins,
+            is_cat_cols=spec.is_cat,
+            max_depth=4,
+            min_rows=5.0,
+            min_split_improvement=1e-5,
+            learn_rates=np.full(4, 0.2, np.float32),
+            max_abs_leaf=float("inf"),
+            col_sample_rate=1.0,
+            col_sample_rate_per_tree=1.0,
+        )
+        return np.asarray(F2), np.asarray(vi), trees_from_stacked(stacked, 4)
+
+    monkeypatch.setenv("H2O3_TPU_HIST_SUBTRACT", "1")
+    F_sub, vi_sub, trees_sub = run()
+    monkeypatch.setenv("H2O3_TPU_HIST_SUBTRACT", "0")
+    F_dir, vi_dir, trees_dir = run()
+
+    np.testing.assert_allclose(F_sub, F_dir, rtol=0, atol=2e-4)
+    np.testing.assert_allclose(vi_sub, vi_dir, rtol=1e-3, atol=1e-3)
+    for ts, td in zip(trees_sub, trees_dir):
+        for ls, ld in zip(ts.levels, td.levels):
+            np.testing.assert_array_equal(
+                np.asarray(ls.split_col), np.asarray(ld.split_col)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ls.leaf_now), np.asarray(ld.leaf_now)
+            )
+            np.testing.assert_allclose(
+                np.asarray(ls.leaf_val), np.asarray(ld.leaf_val),
+                rtol=0, atol=2e-5,
+            )
+
+
 def test_calibrate_model_platt_and_isotonic():
     """calibrate_model/calibration_frame: cal_p columns appear and
     materially fix an overconfident (overfit) GBM's probabilities."""
