@@ -262,12 +262,18 @@ def _partition_update(
 def _finish_level(
     bins_u8, nid, preds, varimp, ok, gain, node_w, node_wy, node_wh,
     split_col, split_bin, is_cat_n, cat_mask, na_left,
-    learn_rate, max_abs_leaf, n_pad,
+    learn_rate, max_abs_leaf, n_pad, node_lo=None, node_hi=None,
 ):
     """Shared tail of every level: leaf decision, child-id assignment,
-    varimp scatter, partition update, and the replayable record."""
+    varimp scatter, partition update, and the replayable record.
+
+    ``node_lo``/``node_hi`` (monotone-constraint bound state) clamp leaf
+    values when given; None leaves the unconstrained trace byte-identical.
+    """
     leaf_now = ~ok
     leaf_val = jnp.where(node_wh > 0, node_wy / jnp.maximum(node_wh, 1e-30), 0.0)
+    if node_lo is not None:
+        leaf_val = jnp.clip(leaf_val, node_lo, node_hi)  # monotone bound clamp
     leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
     leaf_val = jnp.where(leaf_now, leaf_val, 0.0).astype(jnp.float32)
 
@@ -429,6 +435,10 @@ def _fused_levels(
     """
     from h2o3_tpu.ops.histogram import histogram_in_jit
 
+    # pair bookkeeping (children 2i/2i+1 share pair slot i) needs an even
+    # frontier; round an odd node_cap down rather than trace-crash on the
+    # stack/reshape interleave
+    node_cap = max(2, node_cap - (node_cap % 2))
     nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
     recs = []
     parent_hist = None
@@ -549,15 +559,12 @@ def _level_step_mono_fn(
         is_cat_n, cat_mask, na_left = sp["is_cat"], sp["cat_mask"], sp["na_left"]
         mid, mono_col = sp["mid"], sp["mono_col"]
 
-    leaf_now = ~ok
-    leaf_val = jnp.where(node_wh > 0, node_wy / jnp.maximum(node_wh, 1e-30), 0.0)
-    leaf_val = jnp.clip(leaf_val, node_lo, node_hi)  # monotone bound clamp
-    leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
-    leaf_val = jnp.where(leaf_now, leaf_val, 0.0).astype(jnp.float32)
-
-    cs = jnp.cumsum(ok.astype(jnp.int32))
-    child_base = jnp.where(ok, 2 * (cs - 1), 0).astype(jnp.int32)
-    varimp = varimp.at[split_col].add(jnp.where(ok, gain, 0.0).astype(varimp.dtype))
+    nid, preds, varimp, n_split, record, cs = _finish_level(
+        bins_u8, nid, preds, varimp, ok, gain, node_w, node_wy, node_wh,
+        split_col, split_bin, is_cat_n, cat_mask, na_left,
+        learn_rate, max_abs_leaf, n_pad, node_lo=node_lo, node_hi=node_hi,
+    )
+    child_base = record["child_base"]
 
     # child bounds scatter: left child at child_base, right at child_base+1
     new_lo = jnp.full(n_pad_next, -jnp.inf, jnp.float32)
@@ -574,20 +581,6 @@ def _level_step_mono_fn(
     new_lo = new_lo.at[ri].set(r_lo, mode="drop")
     new_hi = new_hi.at[li].set(l_hi, mode="drop")
     new_hi = new_hi.at[ri].set(r_hi, mode="drop")
-
-    nid, preds = _partition_update(
-        bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
-        na_left, leaf_now, leaf_val, child_base,
-    )
-    record = {
-        "node_w": node_w.astype(jnp.float32),
-        "split_col": split_col.astype(jnp.int32),
-        "split_bin": split_bin.astype(jnp.int32),
-        "is_cat": is_cat_n, "cat_mask": cat_mask, "na_left": na_left,
-        "leaf_now": leaf_now, "leaf_val": leaf_val, "child_base": child_base,
-        "gain": gain,
-    }
-    n_split = cs[-1] if n_pad and not force_leaf else jnp.int32(0)
     return nid, preds, varimp, n_split, record, new_lo, new_hi
 
 

@@ -1,0 +1,103 @@
+"""Regression net for the Pallas TPU histogram kernel (ops/hist_pallas.py) —
+the gpu_hist-successor the project is named for. Runs the kernel in the
+Pallas interpreter (CPU CI) against the exact scatter reference over an
+adversarial shape grid: tile boundaries, NA bin occupancy, categorical
+codes, ragged row counts, retired rows, and the 2-term bf16 split's
+accuracy bound."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.ops.hist_pallas import NODE_TILE, ROW_TILE, hist_pallas_local
+from h2o3_tpu.ops.histogram import _hist_scatter_local
+
+
+def _make_case(n, c, n_nodes, n_bins, seed, na_frac=0.1, retired_frac=0.1,
+               zero_w_frac=0.1):
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(1, n_bins, size=(n, c)).astype(np.uint8)
+    bins[rng.random((n, c)) < na_frac] = 0  # NA bin 0 occupied
+    nid = rng.integers(0, n_nodes, size=n).astype(np.int32)
+    nid[rng.random(n) < retired_frac] = -1  # retired rows
+    w = rng.random(n).astype(np.float32)
+    w[rng.random(n) < zero_w_frac] = 0.0  # sampled-out rows
+    t = rng.normal(size=n).astype(np.float32)
+    wy = w * t
+    wy2 = wy * t
+    wh = w * rng.random(n).astype(np.float32)
+    return (jnp.asarray(bins), jnp.asarray(nid), jnp.asarray(w),
+            jnp.asarray(wy), jnp.asarray(wy2), jnp.asarray(wh))
+
+
+CASES = [
+    # (n_rows, n_cols, n_nodes, n_bins) — each probes a distinct boundary
+    pytest.param(1000, 4, 8, 256, id="rows-not-row-tile-multiple"),
+    pytest.param(ROW_TILE, 3, 1, 256, id="single-node-exact-tile"),
+    pytest.param(700, 5, NODE_TILE + 16, 256, id="nodes-over-node-tile"),
+    pytest.param(1300, 11, 8, 64, id="cols-over-col-tile-small-bins"),
+    pytest.param(257, 2, 4, 17, id="odd-bins-lane-padding"),
+]
+
+
+@pytest.mark.parametrize("n,c,n_nodes,n_bins", CASES)
+def test_pallas_matches_scatter(n, c, n_nodes, n_bins):
+    args = _make_case(n, c, n_nodes, n_bins, seed=n + c)
+    got = hist_pallas_local(*args, n_nodes, n_bins, interpret=True)
+    ref = jax.jit(
+        _hist_scatter_local, static_argnums=(6, 7)
+    )(*args, n_nodes, n_bins)
+    assert got.shape == (c, n_nodes * n_bins, 4)
+    # bf16 2-term split: ~16 mantissa bits on the stats operand; the
+    # contraction then accumulates in f32. Bound the relative error by the
+    # per-(node,col) mass actually present (measured ~1.5e-5; single-pass
+    # bf16 — the regression this guards — is ~2e-3).
+    scale = np.maximum(np.abs(np.asarray(ref)), 1.0)
+    err = np.abs(np.asarray(got) - np.asarray(ref)) / scale
+    assert err.max() < 5e-5, f"max rel err {err.max():.2e}"
+
+
+def test_pallas_f64_accuracy_bound():
+    """The kernel's result tracks a float64 scatter reference to ≤5e-5 rel
+    (measured ~1.5e-5) — the accuracy envelope of the 2-term bf16 MXU
+    split."""
+    args = _make_case(4096, 6, 32, 256, seed=9)
+    got = np.asarray(hist_pallas_local(*args, 32, 256, interpret=True))
+    bins, nid, w, wy, wy2, wh = (np.asarray(a) for a in args)
+    ref = np.zeros((6, 32 * 256, 4), np.float64)
+    stats = np.stack([w, wy, wy2, wh], axis=1).astype(np.float64)
+    active = nid >= 0
+    for col in range(6):
+        idx = nid[active] * 256 + bins[active, col]
+        np.add.at(ref[col], idx, stats[active])
+    scale = np.maximum(np.abs(ref), 1.0)
+    err = np.abs(got - ref) / scale
+    assert err.max() < 5e-5, f"max rel err vs f64 {err.max():.2e}"
+
+
+def test_pallas_retired_and_zero_weight_rows_contribute_nothing():
+    args = list(_make_case(800, 3, 4, 64, seed=3, retired_frac=0.0))
+    # retire every row -> histogram must be exactly zero
+    args[1] = jnp.full(800, -1, jnp.int32)
+    got = hist_pallas_local(*args, 4, 64, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+
+def test_pallas_categorical_codes_roundtrip():
+    """Categorical bins are plain codes 1..K; every (node, code) cell mass
+    must land exactly where the scatter reference puts it."""
+    rng = np.random.default_rng(4)
+    n, k = 1536, 7  # 7 levels -> bins 1..7
+    bins = rng.integers(1, k + 1, size=(n, 1)).astype(np.uint8)
+    nid = rng.integers(0, 3, size=n).astype(np.int32)
+    w = np.ones(n, np.float32)
+    z = np.zeros(n, np.float32)
+    args = (jnp.asarray(bins), jnp.asarray(nid), jnp.asarray(w),
+            jnp.asarray(w), jnp.asarray(z), jnp.asarray(w))
+    got = hist_pallas_local(*args, 3, k + 1, interpret=True)
+    ref = jax.jit(_hist_scatter_local, static_argnums=(6, 7))(*args, 3, k + 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+    # every row accounted for: total w mass equals n
+    assert abs(float(np.asarray(got)[0, :, 0].sum()) - n) < 1e-3
