@@ -77,12 +77,31 @@ def test_pallas_f64_accuracy_bound():
     assert err.max() < 5e-5, f"max rel err vs f64 {err.max():.2e}"
 
 
-def test_pallas_retired_and_zero_weight_rows_contribute_nothing():
+def test_pallas_retired_rows_contribute_nothing():
     args = list(_make_case(800, 3, 4, 64, seed=3, retired_frac=0.0))
     # retire every row -> histogram must be exactly zero
     args[1] = jnp.full(800, -1, jnp.int32)
     got = hist_pallas_local(*args, 4, 64, interpret=True)
     np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+
+def test_pallas_zero_stat_rows_contribute_nothing():
+    """Sampled-out rows keep a valid nid but carry all-zero stats (the
+    builder zeroes w/wy/wy²/wh); their cells must match a reference built
+    with those rows removed entirely."""
+    args = list(
+        _make_case(800, 3, 4, 64, seed=3, retired_frac=0.0, zero_w_frac=0.0)
+    )
+    mask = np.zeros(800, bool)
+    mask[::5] = True
+    for i in range(2, 6):
+        a = np.asarray(args[i]).copy()
+        a[mask] = 0.0
+        args[i] = jnp.asarray(a)
+    got = hist_pallas_local(*args, 4, 64, interpret=True)
+    kept = [jnp.asarray(np.asarray(a)[~mask]) for a in args]
+    ref = jax.jit(_hist_scatter_local, static_argnums=(6, 7))(*kept, 4, 64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
 
 
 def test_pallas_categorical_codes_roundtrip():
