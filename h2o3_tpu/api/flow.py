@@ -69,6 +69,15 @@ const api = async (method, path, body) => {
   return j;
 };
 const fmt = (v) => typeof v === 'number' ? (Number.isInteger(v) ? v : v.toPrecision(5)) : v;
+// Server-controlled strings (keys, algo names, errors) are NOT trusted HTML:
+// esc() for interpolation into markup/attributes, setMsg() for status lines.
+const esc = (v) => String(v ?? '').replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const setMsg = (el, cls, text) => {
+  const sp = document.createElement('span');
+  sp.className = cls; sp.textContent = String(text);
+  el.replaceChildren(sp);
+};
 
 const TABS = ['Frames', 'Models', 'Jobs', 'Build', 'AutoML', 'Rapids'];
 const tabs = document.getElementById('tabs'), main = document.getElementById('main');
@@ -97,13 +106,14 @@ const render = {
     try {
       const j = await api('GET', '/3/Frames');
       const rows = (j.frames || []).map(f =>
-        `<tr><td>${f.frame_id.name || f.frame_id}</td><td>${f.rows}</td>
-         <td>${f.column_count ?? ''}</td>
-         <td><button onclick="frameSummary('${f.frame_id.name || f.frame_id}')">summary</button></td></tr>`);
+        `<tr><td>${esc(f.frame_id.name || f.frame_id)}</td><td>${esc(f.rows)}</td>
+         <td>${esc(f.column_count ?? '')}</td>
+         <td><button data-k="${esc(f.frame_id.name || f.frame_id)}"
+              onclick="frameSummary(this.dataset.k)">summary</button></td></tr>`);
       s.querySelector('#frlist').innerHTML =
         `<table><tr><th>key</th><th>rows</th><th>cols</th><th></th></tr>${rows.join('')}</table>
          <pre id="frdetail" style="display:none"></pre>`;
-    } catch (e) { s.querySelector('#frlist').innerHTML = `<span class="err">${e}</span>`; }
+    } catch (e) { setMsg(s.querySelector('#frlist'), 'err', e); }
   },
   async Models() {
     const s = sections.Models;
@@ -111,9 +121,10 @@ const render = {
     try {
       const j = await api('GET', '/3/Models');
       const rows = (j.models || []).map(m =>
-        `<tr><td>${m.model_id.name || m.model_id}</td><td>${m.algo}</td>
-         <td><button onclick="modelDetail('${m.model_id.name || m.model_id}')">inspect</button>
-         <a href="/3/Models/${m.model_id.name || m.model_id}/mojo"><button>mojo</button></a></td></tr>`);
+        `<tr><td>${esc(m.model_id.name || m.model_id)}</td><td>${esc(m.algo)}</td>
+         <td><button data-k="${esc(m.model_id.name || m.model_id)}"
+              onclick="modelDetail(this.dataset.k)">inspect</button>
+         <a href="/3/Models/${esc(encodeURIComponent(m.model_id.name || m.model_id))}/mojo"><button>mojo</button></a></td></tr>`);
       s.querySelector('#mlist').innerHTML =
         `<table><tr><th>key</th><th>algo</th><th></th></tr>${rows.join('')}</table>
          <div class="panel row"><b>Predict:</b>
@@ -121,7 +132,7 @@ const render = {
            <button class="act" onclick="predict()">score</button>
            <span id="pmsg" class="muted"></span></div>
          <pre id="mdetail" style="display:none"></pre>`;
-    } catch (e) { s.querySelector('#mlist').innerHTML = `<span class="err">${e}</span>`; }
+    } catch (e) { setMsg(s.querySelector('#mlist'), 'err', e); }
   },
   async Jobs() {
     const s = sections.Jobs;
@@ -129,11 +140,11 @@ const render = {
     try {
       const j = await api('GET', '/3/Jobs');
       const rows = (j.jobs || []).map(jb =>
-        `<tr><td>${jb.key.name || jb.key}</td><td>${jb.description || ''}</td>
-         <td>${jb.status}</td><td><progress value="${jb.progress}" max="1"></progress></td></tr>`);
+        `<tr><td>${esc(jb.key.name || jb.key)}</td><td>${esc(jb.description || '')}</td>
+         <td>${esc(jb.status)}</td><td><progress value="${Number(jb.progress) || 0}" max="1"></progress></td></tr>`);
       s.querySelector('#jlist').innerHTML =
         `<table><tr><th>job</th><th>description</th><th>status</th><th>progress</th></tr>${rows.join('')}</table>`;
-    } catch (e) { s.querySelector('#jlist').innerHTML = `<span class="err">${e}</span>`; }
+    } catch (e) { setMsg(s.querySelector('#jlist'), 'err', e); }
   },
   async Build() {
     const s = sections.Build;
@@ -143,7 +154,7 @@ const render = {
     try { algos = Object.keys((await api('GET', '/3/ModelBuilders')).model_builders); } catch (e) {}
     s.innerHTML = `<div class="panel">
       <div class="row"><b>Algorithm:</b>
-        <select id="balgo">${algos.map(a => `<option>${a}</option>`).join('')}</select>
+        <select id="balgo">${algos.map(a => `<option>${esc(a)}</option>`).join('')}</select>
         <b>Training frame:</b> <input id="bframe" placeholder="frame key">
         <b>Response:</b> <input id="by" size="12" placeholder="y"></div>
       <p class="muted">Extra parameters (JSON) — exactly what the REST schema takes:</p>
@@ -183,27 +194,30 @@ window.importFile = async () => {
     const path = document.getElementById('imp').value;
     const setup = await api('POST', '/3/ParseSetup', { source_frames: [path] });
     await api('POST', '/3/Parse', setup);
-    el.innerHTML = '<span class="ok">parsed ✓</span>';
+    setMsg(el, 'ok', 'parsed ✓');
     render.Frames();
-  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+  } catch (e) { setMsg(el, 'err', e); }
 };
 window.frameSummary = async (k) => {
   const pre = document.getElementById('frdetail');
   pre.style.display = 'block';
-  pre.textContent = JSON.stringify(await api('GET', `/3/Frames/${k}/summary`), null, 2);
+  pre.textContent = JSON.stringify(
+    await api('GET', `/3/Frames/${encodeURIComponent(k)}/summary`), null, 2);
 };
 window.modelDetail = async (k) => {
   const pre = document.getElementById('mdetail');
   pre.style.display = 'block';
-  pre.textContent = JSON.stringify(await api('GET', `/3/Models/${k}`), null, 2);
+  pre.textContent = JSON.stringify(
+    await api('GET', `/3/Models/${encodeURIComponent(k)}`), null, 2);
 };
 window.predict = async () => {
   const el = document.getElementById('pmsg');
   try {
     const m = document.getElementById('pm').value, f = document.getElementById('pf').value;
-    const j = await api('POST', `/3/Predictions/models/${m}/frames/${f}`, {});
-    el.innerHTML = `<span class="ok">→ ${j.predictions_frame.name || j.predictions_frame}</span>`;
-  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+    const j = await api('POST',
+      `/3/Predictions/models/${encodeURIComponent(m)}/frames/${encodeURIComponent(f)}`, {});
+    setMsg(el, 'ok', `→ ${j.predictions_frame.name || j.predictions_frame}`);
+  } catch (e) { setMsg(el, 'err', e); }
 };
 window.buildModel = async () => {
   const el = document.getElementById('bmsg');
@@ -213,10 +227,10 @@ window.buildModel = async () => {
     body.training_frame = document.getElementById('bframe').value;
     body.response_column = document.getElementById('by').value;
     const algo = document.getElementById('balgo').value;
-    const j = await api('POST', `/3/ModelBuilders/${algo}`, body);
-    el.innerHTML = `<span class="ok">job ${j.job.key.name || j.job.key} started</span>`;
+    const j = await api('POST', `/3/ModelBuilders/${encodeURIComponent(algo)}`, body);
+    setMsg(el, 'ok', `job ${j.job.key.name || j.job.key} started`);
     show('Jobs');
-  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+  } catch (e) { setMsg(el, 'err', e); }
 };
 window.runAutoML = async () => {
   const el = document.getElementById('amsg');
@@ -232,19 +246,19 @@ window.runAutoML = async () => {
     });
     const id = j.automl_id.name || j.automl_id;
     const jobKey = j.job.key.name || j.job.key;
-    el.innerHTML = `<span class="ok">started ${id}</span>`;
+    setMsg(el, 'ok', `started ${id}`);
     const pre = document.getElementById('aboard');
     pre.style.display = 'block';
     const poll = async () => {
-      const a = await api('GET', `/99/AutoML/${id}`);
+      const a = await api('GET', `/99/AutoML/${encodeURIComponent(id)}`);
       pre.textContent = JSON.stringify(a.leaderboard_table || a, null, 2);
-      const jb = await api('GET', `/3/Jobs/${jobKey}`);
+      const jb = await api('GET', `/3/Jobs/${encodeURIComponent(jobKey)}`);
       const st = (jb.jobs ? jb.jobs[0] : jb).status;
       if (st !== 'DONE' && st !== 'FAILED') setTimeout(poll, 3000);
-      else el.innerHTML = `<span class="${st === 'DONE' ? 'ok' : 'err'}">${st}</span>`;
+      else setMsg(el, st === 'DONE' ? 'ok' : 'err', st);
     };
     poll();
-  } catch (e) { el.innerHTML = `<span class="err">${e}</span>`; }
+  } catch (e) { setMsg(el, 'err', e); }
 };
 window.runRapids = async () => {
   const pre = document.getElementById('rout');

@@ -143,14 +143,24 @@ class Endpoints:
         from h2o3_tpu.cluster.cloud import cluster_info
 
         info = cluster_info()
+        # surface the REAL per-device probe (cluster_info walks local devices
+        # and marks any that fail the memory-stats probe unhealthy) — a fake
+        # always-True here would hide a dead device from operators
+        # node table covers the LOCALLY probed devices (multi-host peers
+        # can't be memory-probed from here; cloud_size still counts all) —
+        # an empty probe list stays empty rather than faking a healthy node
+        nodes = [
+            {"h2o": f"device_{n.get('id', i)}", "healthy": bool(n.get("healthy", True)),
+             **({"mem_in_use": n["mem_in_use"]} if n.get("mem_in_use") is not None else {})}
+            for i, n in enumerate(info.get("nodes", []))
+        ]
         return {
             "__meta": {"schema_type": "Cloud"},
             "version": info.get("version", "0.1.0"),
             "cloud_name": info.get("cloud_name", "h2o3_tpu"),
             "cloud_size": info.get("cloud_size", 1),
-            "cloud_healthy": True,
-            "nodes": [{"h2o": f"device_{i}", "healthy": True}
-                      for i in range(info.get("cloud_size", 1))],
+            "cloud_healthy": bool(info.get("cloud_healthy", True)),
+            "nodes": nodes,
         }
 
     def ping(self, params):
@@ -640,7 +650,73 @@ class _Handler(BaseHTTPRequestHandler):
                                for k, v in urllib.parse.parse_qs(body.decode()).items()})
         return params
 
+    def _blocked_cross_origin(self, method: str) -> bool:
+        """CSRF / DNS-rebinding guard for state-changing requests.
+
+        The API is unauthenticated (like upstream's default), so a malicious
+        page in an operator's browser could otherwise drive the coordinator:
+        no-preflight form POSTs (CSRF) or a rebound DNS name (the browser
+        sends the attacker's hostname in Host). Policy for non-GET requests
+        that carry browser markers (Origin / Referer / Sec-Fetch-* — fetch()
+        cannot strip these forbidden headers, and rebound-page requests
+        always carry them):
+        - Host must be an IP literal, localhost, this machine's hostname, or
+          listed in H2O3_TPU_ALLOWED_HOSTS ("*" disables the guard);
+        - a present Origin header must match the Host (same-origin).
+        Requests WITHOUT browser markers (python/R/curl clients — including
+        ones reaching the coordinator via a DNS name) pass untouched; a
+        browser-based Flow session behind a DNS name needs the hostname in
+        H2O3_TPU_ALLOWED_HOSTS.
+        """
+        if method == "GET":
+            return False
+        browserish = any(
+            self.headers.get(h)
+            for h in ("Origin", "Referer", "Sec-Fetch-Site", "Sec-Fetch-Mode")
+        )
+        if not browserish:
+            return False
+        from h2o3_tpu import config
+
+        allowed = config.get("H2O3_TPU_ALLOWED_HOSTS")
+        if allowed.strip() == "*":
+            return False
+        host_hdr = (self.headers.get("Host") or "").strip()
+        hostname = urllib.parse.urlsplit(f"//{host_hdr}").hostname or ""
+        ok_host = False
+        if hostname:
+            import ipaddress
+            import socket
+
+            try:
+                ipaddress.ip_address(hostname)
+                ok_host = True
+            except ValueError:
+                extra = {h.strip().lower() for h in allowed.split(",") if h.strip()}
+                ok_host = hostname.lower() in (
+                    {"localhost", socket.gethostname().lower()} | extra
+                )
+        origin = (self.headers.get("Origin") or "").strip()
+        ok_origin = True
+        if origin and origin.lower() != "null":
+            ok_origin = urllib.parse.urlsplit(origin).netloc.lower() == host_hdr.lower()
+        elif origin:  # Origin: null (sandboxed iframe / file://) — untrusted
+            ok_origin = False
+        if ok_host and ok_origin:
+            return False
+        self._reply(403, {
+            "__meta": {"schema_type": "Error"},
+            "msg": (
+                f"cross-origin request rejected (Host={host_hdr!r}, "
+                f"Origin={origin!r}); set H2O3_TPU_ALLOWED_HOSTS to allow"
+            ),
+            "http_status": 403,
+        })
+        return True
+
     def _dispatch(self, method: str):
+        if self._blocked_cross_origin(method):
+            return
         path = urllib.parse.urlparse(self.path).path
         if method == "POST" and path.rstrip("/") == "/3/PostFile":
             # raw-body file upload (h2o.upload_file to a remote coordinator)

@@ -13,6 +13,8 @@ Knobs (env var → meaning):
                              tree builder (direct per-node histograms)
 - ``H2O3_TPU_STREAM_BYTES``  CSV size threshold that flips parse to streaming
 - ``H2O3_TPU_PORT``          default REST port
+- ``H2O3_TPU_ALLOWED_HOSTS`` extra Hosts allowed for state-changing REST
+                             requests ('*' disables the CSRF guard)
 - ``H2O3_TPU_LOG_LEVEL``     default log level for init()
 """
 
@@ -30,6 +32,9 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),
+    "H2O3_TPU_ALLOWED_HOSTS": (
+        "", "extra Host header names accepted for state-changing REST "
+        "requests (comma list; '*' disables the CSRF/rebinding guard)"),
     "H2O3_TPU_LOG_LEVEL": ("INFO", "default log level"),
     "H2O3_TPU_COMPILE_CACHE": ("", "XLA compile-cache dir ('' = <pkg>/.jax_cache)"),
 }

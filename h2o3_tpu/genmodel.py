@@ -287,6 +287,20 @@ def _design_matrix(meta_di: dict, table) -> np.ndarray:
     for c in meta_di["columns"]:
         if c.get("pair"):
             a, b = c["pair"]
+            if c.get("pair_domains"):
+                # cat x cat combined factor: remap each source onto ITS
+                # training domain, then combined code = a*|domain_b| + b
+                # (mirrors DataInfo._transform_interaction)
+                da, db = c["pair_domains"]
+                ca = _col_codes(table, a, da, n)
+                cb = _col_codes(table, b, db, n)
+                codes = np.where((ca >= 0) & (cb >= 0), ca * len(db) + cb, -1)
+                base = 0 if meta_di["use_all_factor_levels"] else 1
+                onehot = (
+                    (codes - base)[:, None] == np.arange(c["width"])[None, :]
+                ).astype(np.float64)
+                cols.append(onehot)
+                continue
             # TRAINING means of the pair sources (exported with the spec),
             # matching the live transform exactly
             ma, mb = c.get("pair_means") or (0.0, 0.0)

@@ -74,9 +74,17 @@ def fit_isotonic(p1: np.ndarray, y: np.ndarray, w: np.ndarray) -> dict:
     ys = np.asarray(y, np.float64)[order]
     ws = np.asarray(w, np.float64)[order]
     fitted = _pav(ys, ws)
+    xs = np.asarray(p1, np.float64)[order]
+    # Collapse to PAV block boundaries before storing: interior points of a
+    # constant-y run contribute nothing to np.interp, but would bloat the
+    # model output / MOJO with O(n) thresholds on big calibration frames.
+    from h2o3_tpu.models.isotonic import pav_block_knots
+
+    keep = pav_block_knots(fitted)
+    xs, fitted = xs[keep], fitted[keep]
     return {
         "method": "IsotonicRegression",
-        "thresholds_x": np.asarray(p1, np.float64)[order],
+        "thresholds_x": xs,
         "thresholds_y": fitted,
     }
 

@@ -41,6 +41,10 @@ class ColumnSpec:
     # not depend on the scoring batch)
     pair: tuple[str, str] | None = None
     pair_means: tuple[float, float] | None = None
+    # cat x cat combined-factor interaction (upstream enum-by-enum): the
+    # TRAINING domains of both sources, kept so scoring frames remap each
+    # source before forming combined code a*|domain_b| + b
+    pair_domains: tuple[tuple[str, ...], tuple[str, ...]] | None = None
 
 
 @dataclass
@@ -102,10 +106,20 @@ class DataInfo:
         for a, b in interaction_pairs or ():
             va, vb = frame.vec(a), frame.vec(b)
             if va.is_categorical() and vb.is_categorical():
-                raise ValueError(
-                    f"cat x cat interaction {a}:{b} is not supported "
-                    "(numeric x numeric and categorical x numeric are)"
+                # combined-factor column (upstream enum-by-enum interaction):
+                # one level per (level_a, level_b) cross pair
+                da = tuple(va.domain or ())
+                db = tuple(vb.domain or ())
+                dom = tuple(f"{x}_{y}" for x in da for y in db)
+                k = len(dom)
+                width = k if use_all_factor_levels else max(1, k - 1)
+                di.columns.append(
+                    ColumnSpec(f"{a}:{b}", "cat", domain=dom, offset=off,
+                               width=width, pair=(a, b),
+                               pair_domains=(da, db))
                 )
+                off += width
+                continue
             if va.is_categorical() or vb.is_categorical():
                 cv, nv = (va, vb) if va.is_categorical() else (vb, va)
                 k = cv.cardinality
@@ -147,7 +161,9 @@ class DataInfo:
         for c in self.columns:
             if c.kind == "cat":
                 lo = 0 if self.use_all_factor_levels else 1
-                if c.pair is not None:  # cat x num interaction block
+                if c.pair_domains is not None:  # cat x cat combined factor
+                    names += [f"{c.name}.{d}" for d in c.domain[lo : lo + c.width]]
+                elif c.pair is not None:  # cat x num interaction block
                     names += [
                         f"{c.pair[0]}.{d}:{c.pair[1]}"
                         for d in c.domain[lo : lo + c.width]
@@ -202,6 +218,18 @@ class DataInfo:
         scoring batch's — and missing_handling=SKIP invalidates rows with
         missing sources exactly like the base columns do.
         """
+        if c.pair_domains is not None:  # cat x cat combined factor
+            va, vb = frame.vec(c.pair[0]), frame.vec(c.pair[1])
+            da, db = c.pair_domains
+            ca = _adapt_codes(va, da)
+            cb = _adapt_codes(vb, db)
+            codes = jnp.where((ca >= 0) & (cb >= 0), ca * len(db) + cb, -1)
+            if self.missing_handling == SKIP:
+                valid = valid * (codes >= 0).astype(jnp.float32)
+            oh = _expand_cat(
+                codes, len(c.domain), c.width, self.use_all_factor_levels
+            )
+            return oh, valid
         if c.kind == "num":
             va, vb = frame.vec(c.pair[0]), frame.vec(c.pair[1])
             ma, mb = c.pair_means or (0.0, 0.0)

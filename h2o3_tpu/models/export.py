@@ -39,7 +39,9 @@ def _datainfo_meta(di) -> dict:
              "sigma": float(c.sigma), "domain": list(c.domain),
              "offset": c.offset, "width": c.width,
              "pair": list(c.pair) if c.pair else None,
-             "pair_means": list(c.pair_means) if c.pair_means else None}
+             "pair_means": list(c.pair_means) if c.pair_means else None,
+             "pair_domains": [list(d) for d in c.pair_domains]
+             if c.pair_domains else None}
             for c in di.columns
         ],
     }

@@ -546,8 +546,18 @@ class GLM(ModelBuilder):
                 np.max(np.abs(g0_pen)) / max(alpha, 1e-3) / max(nobs, 1.0)
             ) / 1e3
         if alpha * lam > 0:
+            if p.alpha is not None:
+                # the user EXPLICITLY asked for L1 under a solver that cannot
+                # honor it — refuse rather than silently fit a different model
+                # (mirrors the compute_p_values/lambda_search rejections);
+                # lam may be the auto default, but alpha>0 was their choice
+                raise ValueError(
+                    "solver=L_BFGS does not support the L1 part of elastic "
+                    "net; use solver=IRLSM for alpha>0 with lambda>0, or set "
+                    "alpha=0 for pure ridge under L_BFGS"
+                )
             Log.warn("GLM L_BFGS ignores the L1 part of elastic net "
-                     "(upstream behavior); use IRLSM for exact L1")
+                     "(default alpha=0.5); use IRLSM for exact L1")
         l2 = lam * (1 - alpha) * nobs
 
         def fun(b):

@@ -50,6 +50,16 @@ def _pav(y: np.ndarray, w: np.ndarray) -> np.ndarray:
     return out
 
 
+def pav_block_knots(fitted: np.ndarray) -> np.ndarray:
+    """Mask keeping only PAV block-boundary knots (first/last of each
+    constant run): np.interp over the kept knots is identical, and the
+    stored threshold arrays stay O(blocks) instead of O(n)."""
+    keep = np.ones(len(fitted), bool)
+    if len(fitted) > 2:
+        keep[1:-1] = (fitted[1:-1] != fitted[:-2]) | (fitted[1:-1] != fitted[2:])
+    return keep
+
+
 class IsotonicRegressionModel(Model):
     algo = "isotonicregression"
 
@@ -89,8 +99,7 @@ class IsotonicRegression(ModelBuilder):
         ymean = ysum / np.maximum(wsum, 1e-300)
         fitted = _pav(ymean, wsum)
         # keep only breakpoints (H2O stores thresholds)
-        keep = np.ones(len(ux), bool)
-        keep[1:-1] = (fitted[1:-1] != fitted[:-2]) | (fitted[1:-1] != fitted[2:])
+        keep = pav_block_knots(fitted)
         out = {
             "feature": feat,
             "thresholds_x": ux[keep],

@@ -108,8 +108,10 @@ class PSVMModel(Model):
         return np.asarray(Phi @ w + self.output["b"])[: frame.nrow]
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
-        d = self._decision(frame)
-        p1 = 1.0 / (1.0 + np.exp(-2.0 * d))  # margin squash (metrics only)
+        # margin squash (metrics only); clip so exp can't overflow on wide
+        # margins (p saturates at ~1e-27 anyway)
+        d = np.clip(self._decision(frame), -30.0, 30.0)
+        p1 = 1.0 / (1.0 + np.exp(-2.0 * d))
         return np.stack([1 - p1, p1], axis=1)
 
     def _distribution_for_metrics(self) -> str:

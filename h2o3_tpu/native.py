@@ -39,14 +39,26 @@ _F64P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 def _build() -> str | None:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
+    # Compile to a process-unique temp name and publish atomically: the
+    # threading lock above only covers THIS process, but parallel pytest
+    # workers (or two servers) race on the shared .so path — a reader must
+    # never CDLL a half-written file.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     for flags in (["-fopenmp"], []):  # openmp when the toolchain has it
-        cmd = ["g++", "-O3", "-shared", "-fPIC", *flags, _SRC, "-o", _SO]
+        cmd = ["g++", "-O3", "-shared", "-fPIC", *flags, _SRC, "-o", tmp]
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
+                os.replace(tmp, _SO)
                 return _SO
         except (OSError, subprocess.TimeoutExpired):
-            return None
+            break
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
     return None
 
 
@@ -62,7 +74,14 @@ def get_lib() -> ctypes.CDLL | None:
         if so is None:
             _BUILD_FAILED = True
             return None
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            # e.g. a concurrent process replaced the file mid-load, or a
+            # stale/corrupt artifact — degrade to the numpy path like any
+            # other build failure rather than crash enabled()/available()
+            _BUILD_FAILED = True
+            return None
         lib.tmojo_score_forest.restype = None
         lib.tmojo_score_forest.argtypes = [
             _U8P, ctypes.c_int64, ctypes.c_int64,          # bins, n, C

@@ -379,8 +379,46 @@ def test_glm_interactions_recover_products(tmp_path):
         y="y", x=["x1", "x2"], training_frame=fr
     )
     assert "x1:x2" in m2.coef
-    # cat x cat rejected clearly
-    with pytest.raises(Exception, match="cat x cat"):
-        GLM(interaction_pairs=[("g", "g")]).train(
-            y="y", x=["x1", "g"], training_frame=fr
+    # cat x cat: combined-factor interaction (upstream enum-by-enum)
+    g2 = rng.choice(["u", "v"], n)
+    bump = np.where((g == "a") & (g2 == "u"), 2.5, 0.0)
+    y3 = 0.5 * x1 + bump + 0.1 * rng.normal(size=n)
+    df3 = pd.DataFrame({"x1": x1, "g": g, "g2": g2, "y": y3})
+    fr3 = Frame.from_pandas(df3)
+    # tiny ridge: with main effects present the cross indicators are exactly
+    # collinear (a_v+b_v == g2.v), so lambda=0 would leave beta non-unique
+    # and the live-vs-offline comparison numerically fragile
+    m3 = GLM(lambda_=1e-4, alpha=0.0, interaction_pairs=[("g", "g2")]).train(
+        y="y", x=["x1", "g", "g2"], training_frame=fr3
+    )
+    assert m3.training_metrics.value("r2") > 0.95
+    assert any(k.startswith("g:g2.") for k in m3.coef)
+    # scoring a fresh frame exercises the combined-code remap path
+    pred = m3.predict(fr3).vec("predict").to_numpy()[:n]
+    assert float(np.sqrt(np.mean((pred - y3) ** 2))) < 0.2
+    # MOJO export must carry the combined-factor spec (offline == live)
+    p3 = os.path.join(str(tmp_path), "catcat.zip")
+    export_mojo(m3, p3)
+    off3 = MojoModel.load(p3).predict(df3.drop(columns="y"))["predict"]
+    np.testing.assert_allclose(off3, pred, atol=1e-4)
+
+
+def test_glm_lbfgs_rejects_explicit_l1():
+    rng = np.random.default_rng(7)
+    n = 500
+    x0 = rng.normal(size=n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-x0))).astype(int)
+    fr = Frame.from_pandas(
+        pd.DataFrame({"x0": x0, "y": y.astype(str)}), column_types={"y": "enum"}
+    )
+    # explicit alpha>0 with explicit lambda>0: refuse (the model the user
+    # asked for cannot be fit by this solver)
+    with pytest.raises(Exception, match="L1 part"):
+        GLM(family="binomial", solver="L_BFGS", alpha=0.5, lambda_=0.1).train(
+            y="y", training_frame=fr
         )
+    # pure ridge under L_BFGS stays fine
+    m = GLM(family="binomial", solver="L_BFGS", alpha=0.0, lambda_=0.1).train(
+        y="y", training_frame=fr
+    )
+    assert np.isfinite(m.training_metrics.logloss)
