@@ -784,17 +784,58 @@ def scan_chunk_cap(
     return max(1, int(budget_bytes // max(per_tree, 1)))
 
 
+# Record fields in pack order. The whole stacked chunk flattens into ONE
+# uint8 buffer = ONE device→host transfer: a naive device_get(stacked) pulls
+# ~70 leaves, and on the tunneled TPU each leaf is its own ~66 ms round-trip,
+# which made record download cost more than building the trees (BENCH r4
+# profile: 6.7 s of an 8.3 s 20-tree train). f32/i32 fields are bitcast to 4
+# uint8 lanes (exact, any magnitude); bools ship as 1 byte each, so the
+# payload stays byte-sized for cat_mask — the dominant field.
+_PACK_I32 = ("split_col", "split_bin", "child_base")
+_PACK_BOOL = ("is_cat", "na_left", "leaf_now", "cat_mask")
+_PACK_F32 = ("node_w", "leaf_val", "gain")
+_PACK_FIELDS = _PACK_F32 + _PACK_I32 + _PACK_BOOL
+
+
+@jax.jit
+def _pack_stacked(stacked):
+    parts = []
+    for lvl in stacked:
+        assert set(lvl) == set(_PACK_FIELDS), sorted(set(lvl) ^ set(_PACK_FIELDS))
+        T = lvl["node_w"].shape[0]
+        for k in _PACK_FIELDS:
+            v = lvl[k]
+            if k in _PACK_BOOL:
+                parts.append(v.astype(jnp.uint8).reshape(T, -1))
+            else:
+                parts.append(jax.lax.bitcast_convert_type(v, jnp.uint8).reshape(T, -1))
+    return jnp.concatenate(parts, axis=1)
+
+
 def trees_from_stacked(stacked, n_trees: int) -> list["Tree"]:
     """ONE device→host transfer for a whole chunk → numpy-backed Trees."""
-    host = jax.device_get(stacked)
-    out = []
-    for ti in range(n_trees):
-        tree = Tree()
-        for lvl in host:
-            tree.levels.append(
-                TreeLevel(**{k: np.asarray(v[ti]) for k, v in lvl.items()})
-            )
-        out.append(tree)
+    packed = np.asarray(jax.device_get(_pack_stacked(stacked)))  # (T, X) u8
+    out = [Tree() for _ in range(n_trees)]
+    off = 0
+    for lvl in stacked:
+        fields = {}
+        for k in _PACK_FIELDS:
+            shape = lvl[k].shape[1:]  # per-tree shape
+            size = int(np.prod(shape)) if shape else 1
+            nbytes = size if k in _PACK_BOOL else size * 4
+            # contiguous per-field copy: the view below then holds only this
+            # field's bytes, not the whole chunk buffer
+            raw = np.ascontiguousarray(packed[:, off : off + nbytes])
+            if k in _PACK_BOOL:
+                v = raw.view(np.bool_).reshape(n_trees, *shape)
+            elif k in _PACK_I32:
+                v = raw.view(np.int32).reshape(n_trees, *shape)
+            else:
+                v = raw.view(np.float32).reshape(n_trees, *shape)
+            fields[k] = v
+            off += nbytes
+        for ti in range(n_trees):
+            out[ti].levels.append(TreeLevel(**{k: v[ti] for k, v in fields.items()}))
     return out
 
 
