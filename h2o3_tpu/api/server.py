@@ -34,7 +34,7 @@ from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.utils.log import Log
 
-_ALGOS = ("gbm", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
+_ALGOS = ("gbm", "xgboost", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
           "naivebayes", "isolationforest", "stackedensemble",
           "isotonicregression", "decisiontree", "adaboost",
           "extendedisolationforest", "targetencoder", "glrm", "coxph",
@@ -46,7 +46,7 @@ def _builder_cls(algo: str):
     from h2o3_tpu import models as M
 
     return {
-        "gbm": M.GBM, "glm": M.GLM, "drf": M.DRF, "xrt": M.XRT,
+        "gbm": M.GBM, "xgboost": M.XGBoost, "glm": M.GLM, "drf": M.DRF, "xrt": M.XRT,
         "deeplearning": M.DeepLearning, "kmeans": M.KMeans, "pca": M.PCA,
         "svd": M.SVD, "naivebayes": M.NaiveBayes,
         "isolationforest": M.IsolationForest,
@@ -313,6 +313,9 @@ class Endpoints:
         import dataclasses
 
         valid = {f.name for f in dataclasses.fields(cls.PARAMS_CLS)}
+        # builder-declared param aliases (e.g. XGBoost's eta -> learn_rate)
+        # resolve to their canonical field before coercion
+        aliases = dict(getattr(cls, "PARAM_ALIASES", {}) or {})
         kwargs = {}
         x = y = train_key = valid_key = None
         for k, v in params.items():
@@ -332,8 +335,11 @@ class Endpoints:
                     kwargs["ignored_columns"] = tuple(vv)
             elif k == "model_id":
                 continue  # keys are server-assigned
-            elif k in valid:
-                kwargs[k] = _coerce_param(cls.PARAMS_CLS, k, v)
+            elif k in valid or k in aliases:
+                # aliases keep their name (the builder translates and owns
+                # conflict/semantics, e.g. max_delta_step's 0=unlimited);
+                # coercion borrows the canonical field's type
+                kwargs[k] = _coerce_param(cls.PARAMS_CLS, aliases.get(k, k), v)
         return kwargs, x, y, train_key, valid_key
 
     # -- grids (hex.grid.GridSearch REST surface, /99/Grid*) ---------------

@@ -32,6 +32,7 @@ class _EstimatorBase:
     def __init__(self, model_id: str | None = None, **kwargs):
         cls = getattr(_models, self._BUILDER)
         valid = {f.name for f in dataclasses.fields(cls.PARAMS_CLS)}
+        valid |= set(getattr(cls, "PARAM_ALIASES", ()))  # e.g. xgboost's eta
         unknown = set(kwargs) - valid
         if unknown:
             raise TypeError(
@@ -132,7 +133,7 @@ def _make(name: str, builder: str):
 __all__ = [
     _make("H2OGradientBoostingEstimator", "GBM"),
     _make("H2ORandomForestEstimator", "DRF"),
-    _make("H2OXGBoostEstimator", "GBM"),  # hist engine IS the xgboost successor
+    _make("H2OXGBoostEstimator", "XGBoost"),  # xgboost param surface on the hist engine
     _make("H2OGeneralizedLinearEstimator", "GLM"),
     _make("H2ODeepLearningEstimator", "DeepLearning"),
     _make("H2OKMeansEstimator", "KMeans"),

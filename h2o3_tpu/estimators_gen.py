@@ -165,6 +165,191 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
         super().__init__(model_id=model_id, **kw)
 
 
+class H2OXGBoostEstimator(_EstimatorBase):
+    """XGBoost estimator (generated).
+
+    Parameters
+    ----------
+    response_column: str | None (default None)
+    ignored_columns: Sequence[str] (default ())
+    weights_column: str | None (default None)
+    offset_column: str | None (default None)
+    nfolds: int (default 0)
+    fold_assignment: str (default 'modulo')
+    keep_cross_validation_predictions: bool (default False)
+    seed: int (default -1)
+    max_runtime_secs: float (default 0.0)
+    stopping_rounds: int (default 0)
+    stopping_metric: str (default 'AUTO')
+    stopping_tolerance: float (default 0.001)
+    checkpoint: Any (default None)
+    export_checkpoints_dir: str | None (default None)
+    ntrees: int (default 50)
+    max_depth: int (default 6)
+    min_rows: float (default 1.0)
+    nbins: int (default 255)
+    min_split_improvement: float (default 0.0)
+    sample_rate: float (default 1.0)
+    col_sample_rate_per_tree: float (default 1.0)
+    score_tree_interval: int (default 5)
+    calibrate_model: bool (default False)
+    calibration_frame: Any (default None)
+    calibration_method: str (default 'AUTO')
+    learn_rate: float (default 0.3)
+    learn_rate_annealing: float (default 1.0)
+    distribution: str (default 'AUTO')
+    col_sample_rate: float (default 1.0)
+    max_abs_leafnode_pred: float (default float("inf"))
+    quantile_alpha: float (default 0.5)
+    tweedie_power: float (default 1.5)
+    huber_alpha: float (default 0.9)
+    monotone_constraints: Any (default None)
+    reg_lambda: float (default 1.0)
+    reg_alpha: float (default 0.0)
+    tree_method: str (default 'auto')
+    grow_policy: str (default 'depthwise')
+    booster: str (default 'gbtree')
+    scale_pos_weight: float (default 1.0)
+    dmatrix_type: str (default 'auto')
+    """
+
+    _BUILDER = "XGBoost"
+
+    def __init__(
+        self,
+        model_id=None,
+        response_column=None,
+        ignored_columns=(),
+        weights_column=None,
+        offset_column=None,
+        nfolds=0,
+        fold_assignment='modulo',
+        keep_cross_validation_predictions=False,
+        seed=-1,
+        max_runtime_secs=0.0,
+        stopping_rounds=0,
+        stopping_metric='AUTO',
+        stopping_tolerance=0.001,
+        checkpoint=None,
+        export_checkpoints_dir=None,
+        ntrees=50,
+        max_depth=6,
+        min_rows=1.0,
+        nbins=255,
+        min_split_improvement=0.0,
+        sample_rate=1.0,
+        col_sample_rate_per_tree=1.0,
+        score_tree_interval=5,
+        calibrate_model=False,
+        calibration_frame=None,
+        calibration_method='AUTO',
+        learn_rate=0.3,
+        learn_rate_annealing=1.0,
+        distribution='AUTO',
+        col_sample_rate=1.0,
+        max_abs_leafnode_pred=float("inf"),
+        quantile_alpha=0.5,
+        tweedie_power=1.5,
+        huber_alpha=0.9,
+        monotone_constraints=None,
+        reg_lambda=1.0,
+        reg_alpha=0.0,
+        tree_method='auto',
+        grow_policy='depthwise',
+        booster='gbtree',
+        scale_pos_weight=1.0,
+        dmatrix_type='auto',
+    ):
+        kw = dict(
+            response_column=response_column,
+            ignored_columns=ignored_columns,
+            weights_column=weights_column,
+            offset_column=offset_column,
+            nfolds=nfolds,
+            fold_assignment=fold_assignment,
+            keep_cross_validation_predictions=keep_cross_validation_predictions,
+            seed=seed,
+            max_runtime_secs=max_runtime_secs,
+            stopping_rounds=stopping_rounds,
+            stopping_metric=stopping_metric,
+            stopping_tolerance=stopping_tolerance,
+            checkpoint=checkpoint,
+            export_checkpoints_dir=export_checkpoints_dir,
+            ntrees=ntrees,
+            max_depth=max_depth,
+            min_rows=min_rows,
+            nbins=nbins,
+            min_split_improvement=min_split_improvement,
+            sample_rate=sample_rate,
+            col_sample_rate_per_tree=col_sample_rate_per_tree,
+            score_tree_interval=score_tree_interval,
+            calibrate_model=calibrate_model,
+            calibration_frame=calibration_frame,
+            calibration_method=calibration_method,
+            learn_rate=learn_rate,
+            learn_rate_annealing=learn_rate_annealing,
+            distribution=distribution,
+            col_sample_rate=col_sample_rate,
+            max_abs_leafnode_pred=max_abs_leafnode_pred,
+            quantile_alpha=quantile_alpha,
+            tweedie_power=tweedie_power,
+            huber_alpha=huber_alpha,
+            monotone_constraints=monotone_constraints,
+            reg_lambda=reg_lambda,
+            reg_alpha=reg_alpha,
+            tree_method=tree_method,
+            grow_policy=grow_policy,
+            booster=booster,
+            scale_pos_weight=scale_pos_weight,
+            dmatrix_type=dmatrix_type,
+        )
+        defaults = {
+            'response_column': None,
+            'ignored_columns': (),
+            'weights_column': None,
+            'offset_column': None,
+            'nfolds': 0,
+            'fold_assignment': 'modulo',
+            'keep_cross_validation_predictions': False,
+            'seed': -1,
+            'max_runtime_secs': 0.0,
+            'stopping_rounds': 0,
+            'stopping_metric': 'AUTO',
+            'stopping_tolerance': 0.001,
+            'checkpoint': None,
+            'export_checkpoints_dir': None,
+            'ntrees': 50,
+            'max_depth': 6,
+            'min_rows': 1.0,
+            'nbins': 255,
+            'min_split_improvement': 0.0,
+            'sample_rate': 1.0,
+            'col_sample_rate_per_tree': 1.0,
+            'score_tree_interval': 5,
+            'calibrate_model': False,
+            'calibration_frame': None,
+            'calibration_method': 'AUTO',
+            'learn_rate': 0.3,
+            'learn_rate_annealing': 1.0,
+            'distribution': 'AUTO',
+            'col_sample_rate': 1.0,
+            'max_abs_leafnode_pred': float("inf"),
+            'quantile_alpha': 0.5,
+            'tweedie_power': 1.5,
+            'huber_alpha': 0.9,
+            'monotone_constraints': None,
+            'reg_lambda': 1.0,
+            'reg_alpha': 0.0,
+            'tree_method': 'auto',
+            'grow_policy': 'depthwise',
+            'booster': 'gbtree',
+            'scale_pos_weight': 1.0,
+            'dmatrix_type': 'auto',
+        }
+        kw = {k: v for k, v in kw.items() if v != defaults[k]}
+        super().__init__(model_id=model_id, **kw)
+
+
 class H2ORandomForestEstimator(_EstimatorBase):
     """DRF estimator (generated).
 
@@ -3062,6 +3247,7 @@ class H2OHGLMEstimator(_EstimatorBase):
 
 __all__ = [
     'H2OGradientBoostingEstimator',
+    'H2OXGBoostEstimator',
     'H2ORandomForestEstimator',
     'H2OXRTEstimator',
     'H2OGeneralizedLinearEstimator',

@@ -48,7 +48,7 @@ class MojoModel:
             npz = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
             arrays = {k: npz[k] for k in npz.files}
         cls = {
-            "gbm": _TreeMojo, "drf": _TreeMojo, "xrt": _TreeMojo,
+            "gbm": _TreeMojo, "xgboost": _TreeMojo, "drf": _TreeMojo, "xrt": _TreeMojo,
             "glm": _GlmMojo, "deeplearning": _DeepLearningMojo,
             "kmeans": _KMeansMojo,
         }[meta["algo"]]

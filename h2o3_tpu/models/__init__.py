@@ -9,6 +9,7 @@ from h2o3_tpu.models.datainfo import DataInfo
 _LAZY = {
     "GLM": ("h2o3_tpu.models.glm", "GLM"),
     "GBM": ("h2o3_tpu.models.tree.gbm", "GBM"),
+    "XGBoost": ("h2o3_tpu.models.tree.xgboost", "XGBoost"),
     "DRF": ("h2o3_tpu.models.tree.drf", "DRF"),
     "XRT": ("h2o3_tpu.models.tree.drf", "XRT"),
     "KMeans": ("h2o3_tpu.models.kmeans", "KMeans"),

@@ -124,6 +124,7 @@ def _export_kmeans(model, meta, arrays) -> None:
 
 _EXPORTERS = {
     "gbm": _export_trees,
+    "xgboost": _export_trees,
     "drf": _export_trees,
     "xrt": _export_trees,
     "glm": _export_glm,

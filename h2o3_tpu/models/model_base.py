@@ -112,6 +112,16 @@ class Model:
         self.preprocessors: list = []
         DKV.put(key, self)
 
+    def download_mojo(self, path: str) -> str:
+        # lazy bootstrap: importing models.export rebinds Model.download_mojo
+        # / save_mojo to the real implementation (the h2o surface), so direct
+        # model users don't depend on estimator-module import order
+        import h2o3_tpu.models.export  # noqa: F401
+
+        return type(self).download_mojo(self, path)
+
+    save_mojo = download_mojo
+
     # -- to be provided by subclasses ---------------------------------------
     def _predict_raw(self, frame: Frame) -> np.ndarray:
         """Regression: (n,) predictions. Classification: (n, K) class probs."""

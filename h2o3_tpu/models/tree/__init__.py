@@ -1,4 +1,5 @@
 from h2o3_tpu.models.tree.gbm import GBM
+from h2o3_tpu.models.tree.xgboost import XGBoost
 from h2o3_tpu.models.tree.drf import DRF
 
-__all__ = ["GBM", "DRF"]
+__all__ = ["GBM", "DRF", "XGBoost"]

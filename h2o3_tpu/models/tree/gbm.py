@@ -213,6 +213,7 @@ class GBMModel(SharedTreeModel):
 class GBM(ModelBuilder):
     algo = "gbm"
     PARAMS_CLS = GBMParams
+    MODEL_CLS = GBMModel
 
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: GBMParams = self.params
@@ -232,7 +233,10 @@ class GBM(ModelBuilder):
             check_checkpoint_compat(
                 prior, self,
                 ("max_depth", "nbins", "min_rows", "distribution", "learn_rate",
-                 "sample_rate", "col_sample_rate", "col_sample_rate_per_tree"),
+                 "sample_rate", "col_sample_rate", "col_sample_rate_per_tree",
+                 # xgboost-surface regime params (absent on plain GBMParams;
+                 # compat check must tolerate missing fields)
+                 "reg_lambda", "reg_alpha", "scale_pos_weight"),
             )
             if p.ntrees <= prior.output["ntrees_actual"]:
                 raise ValueError(
@@ -257,6 +261,15 @@ class GBM(ModelBuilder):
         w_np[: train.nrow] *= ~np.isnan(y_np) if not classification else (y_np >= 0)
         ybuf = np.zeros(npad, np.float32)
         ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
+        # xgboost-surface scale_pos_weight (XGBoostParams only): fold the
+        # positive-class up-weighting into the row weights
+        spw = float(getattr(p, "scale_pos_weight", 1.0))
+        if spw != 1.0:
+            if dist != "bernoulli":
+                raise ValueError("scale_pos_weight requires a binary response")
+            w_np[: train.nrow] *= np.where(
+                ybuf[: train.nrow] == 1.0, spw, 1.0
+            ).astype(np.float32)
         w = jnp.asarray(w_np)
         y = jnp.asarray(ybuf)
 
@@ -418,6 +431,8 @@ class GBM(ModelBuilder):
                     max_abs_leaf=p.max_abs_leafnode_pred,
                     col_sample_rate=p.col_sample_rate,
                     col_sample_rate_per_tree=p.col_sample_rate_per_tree,
+                    reg_lambda=getattr(p, "reg_lambda", 0.0),
+                    reg_alpha=getattr(p, "reg_alpha", 0.0),
                 )
                 lr *= p.learn_rate_annealing ** chunk
                 trees.extend([[t] for t in trees_from_stacked(stacked, chunk)])
@@ -477,6 +492,8 @@ class GBM(ModelBuilder):
                         col_sample_rate=p.col_sample_rate,
                         col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                         max_abs_leaf=p.max_abs_leafnode_pred,
+                        reg_lambda=getattr(p, "reg_lambda", 0.0),
+                        reg_alpha=getattr(p, "reg_alpha", 0.0),
                     )
                     group.append(tree)
                     newF.append(fk)
@@ -501,6 +518,8 @@ class GBM(ModelBuilder):
                     col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                     max_abs_leaf=p.max_abs_leafnode_pred,
                     monotone=mono_vec,
+                    reg_lambda=getattr(p, "reg_lambda", 0.0),
+                    reg_alpha=getattr(p, "reg_alpha", 0.0),
                 )
                 group.append(tree)
             trees.append(group)
@@ -541,7 +560,7 @@ class GBM(ModelBuilder):
             "response_domain": tuple(yv.domain) if classification else None,
             "ntrees_actual": len(trees),
         }
-        model = GBMModel(DKV.make_key("gbm"), p, out)
+        model = self.MODEL_CLS(DKV.make_key(self.algo), p, out)
         model.scoring_history = history
         dom = out["response_domain"]
         model.training_metrics = _metrics_from_F(

@@ -263,15 +263,26 @@ def _finish_level(
     bins_u8, nid, preds, varimp, ok, gain, node_w, node_wy, node_wh,
     split_col, split_bin, is_cat_n, cat_mask, na_left,
     learn_rate, max_abs_leaf, n_pad, node_lo=None, node_hi=None,
+    reg_lambda=None, reg_alpha=None,
 ):
     """Shared tail of every level: leaf decision, child-id assignment,
     varimp scatter, partition update, and the replayable record.
 
     ``node_lo``/``node_hi`` (monotone-constraint bound state) clamp leaf
     values when given; None leaves the unconstrained trace byte-identical.
+
+    ``reg_lambda``/``reg_alpha`` (XGBoost leaf regularization, traced
+    scalars): leaf = soft_threshold(Σwy, α) / (Σwh + λ) — xgboost's
+    w* = −soft(G, α)/(H + λ) with our sign convention (wy ≡ −G, wh ≡ H).
+    None keeps the unregularized trace byte-identical (the H2O GBM path).
     """
     leaf_now = ~ok
-    leaf_val = jnp.where(node_wh > 0, node_wy / jnp.maximum(node_wh, 1e-30), 0.0)
+    if reg_lambda is not None:
+        num = jnp.sign(node_wy) * jnp.maximum(jnp.abs(node_wy) - reg_alpha, 0.0)
+        den = node_wh + reg_lambda
+        leaf_val = jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0)
+    else:
+        leaf_val = jnp.where(node_wh > 0, node_wy / jnp.maximum(node_wh, 1e-30), 0.0)
     if node_lo is not None:
         leaf_val = jnp.clip(leaf_val, node_lo, node_hi)  # monotone bound clamp
     leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf) * learn_rate
@@ -305,6 +316,7 @@ def _finish_level(
 def _level_core(
     hist, bins_u8, nid, preds, varimp, key, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+    leaf_reg=None,
     *, n_pad: int, n_pad_next: int, cat_cols: tuple = (),
 ):
     """Split scan → decisions → partition for one level, given its histogram.
@@ -341,11 +353,12 @@ def _level_core(
     ok = ok & fits
     gain = jnp.where(ok, jnp.maximum(sp["gain"], 0.0), 0.0)
 
+    rl, ra = (None, None) if leaf_reg is None else leaf_reg
     nid, preds, varimp, n_split, record, cs = _finish_level(
         bins_u8, nid, preds, varimp, ok, gain,
         sp["node_w"], sp["node_wy"], sp["node_wh"],
         sp["col"], sp["split_bin"], sp["is_cat"], sp["cat_mask"], sp["na_left"],
-        learn_rate, max_abs_leaf, n_pad,
+        learn_rate, max_abs_leaf, n_pad, reg_lambda=rl, reg_alpha=ra,
     )
 
     half = n_pad_next // 2
@@ -365,16 +378,17 @@ def _level_core(
 
 def _force_leaf_from_stats(
     bins_u8, nid, preds, varimp, node_w, node_wy, node_wh,
-    learn_rate, max_abs_leaf, n_pad, n_bins,
+    learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg=None,
 ):
     """Terminal level: every active node becomes a leaf (no split scan)."""
     ok = jnp.zeros(n_pad, bool)
     zi = jnp.zeros(n_pad, jnp.int32)
+    rl, ra = (None, None) if leaf_reg is None else leaf_reg
     nid, preds, varimp, n_split, record, _ = _finish_level(
         bins_u8, nid, preds, varimp, ok, jnp.zeros(n_pad, jnp.float32),
         node_w, node_wy, node_wh, zi, zi, jnp.zeros(n_pad, bool),
         jnp.zeros((n_pad, n_bins), bool), jnp.zeros(n_pad, bool),
-        learn_rate, max_abs_leaf, n_pad,
+        learn_rate, max_abs_leaf, n_pad, reg_lambda=rl, reg_alpha=ra,
     )
     return nid, preds, varimp, n_split, record
 
@@ -382,6 +396,7 @@ def _force_leaf_from_stats(
 def _level_step_fn(
     bins_u8, nid, preds, varimp, w, wy, wy2, wh, key, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+    leaf_reg=None,
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
     cat_cols: tuple = (),
 ):
@@ -399,12 +414,13 @@ def _level_step_fn(
         tot = hist[:, 0, :, :].sum(axis=1)  # (n_pad, 4); col 0 ≡ any col
         return _force_leaf_from_stats(
             bins_u8, nid, preds, varimp, tot[:, 0], tot[:, 1], tot[:, 3],
-            learn_rate, max_abs_leaf, n_pad, n_bins,
+            learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
         )
     out = _level_core(
         hist, bins_u8, nid, preds, varimp, key, cols_enabled, is_cat,
         min_rows, min_split_improvement, learn_rate, max_abs_leaf,
-        col_sample_rate, n_pad=n_pad, n_pad_next=n_pad_next, cat_cols=cat_cols,
+        col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
+        cat_cols=cat_cols,
     )
     return out[:5]
 
@@ -412,6 +428,7 @@ def _level_step_fn(
 def _fused_levels(
     bins_u8, preds, varimp, w, wy, wy2, wh, tkey, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+    leaf_reg=None,
     *, max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     subtract: bool = True,
 ):
@@ -457,7 +474,7 @@ def _fused_levels(
             nid, preds, varimp, _, rec = _force_leaf_from_stats(
                 bins_u8, nid, preds, varimp,
                 node_stats[:, 0], node_stats[:, 1], node_stats[:, 3],
-                learn_rate, max_abs_leaf, n_pad, n_bins,
+                learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
             )
             recs.append(rec)
             continue
@@ -489,13 +506,13 @@ def _fused_levels(
             tot = hist[:, 0, :, :].sum(axis=1)
             nid, preds, varimp, _, rec = _force_leaf_from_stats(
                 bins_u8, nid, preds, varimp, tot[:, 0], tot[:, 1], tot[:, 3],
-                learn_rate, max_abs_leaf, n_pad, n_bins,
+                learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
             )
         else:
             nid, preds, varimp, _, rec, pair_info = _level_core(
                 hist, bins_u8, nid, preds, varimp, lkey, cols_enabled, is_cat,
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
-                col_sample_rate, n_pad=n_pad, n_pad_next=n_pad_next,
+                col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
                 cat_cols=cat_cols,
             )
             parent_hist = hist
@@ -518,7 +535,7 @@ def _subtract_enabled() -> bool:
 def _level_step_mono_fn(
     bins_u8, nid, preds, varimp, w, wy, wy2, wh, key, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
-    mono, node_lo, node_hi,
+    mono, node_lo, node_hi, leaf_reg=None,
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
     cat_cols: tuple = (),
 ):
@@ -559,10 +576,12 @@ def _level_step_mono_fn(
         is_cat_n, cat_mask, na_left = sp["is_cat"], sp["cat_mask"], sp["na_left"]
         mid, mono_col = sp["mid"], sp["mono_col"]
 
+    rl, ra = (None, None) if leaf_reg is None else leaf_reg
     nid, preds, varimp, n_split, record, cs = _finish_level(
         bins_u8, nid, preds, varimp, ok, gain, node_w, node_wy, node_wh,
         split_col, split_bin, is_cat_n, cat_mask, na_left,
         learn_rate, max_abs_leaf, n_pad, node_lo=node_lo, node_hi=node_hi,
+        reg_lambda=rl, reg_alpha=ra,
     )
     child_base = record["child_base"]
 
@@ -641,11 +660,12 @@ def _tree_program(
     def whole_tree(
         bins_u8, preds, varimp, w, wy, wy2, wh, key_, cols_enabled, is_cat,
         min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
+        leaf_reg=None,
     ):
         nid, preds, varimp, records = _fused_levels(
             bins_u8, preds, varimp, w, wy, wy2, wh, key_, cols_enabled, is_cat,
             min_rows, min_split_improvement, learn_rate, max_abs_leaf,
-            col_sample_rate,
+            col_sample_rate, leaf_reg,
             max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
             cat_cols=cat_cols, subtract=subtract,
         )
@@ -680,6 +700,8 @@ def build_trees_scanned(
     col_sample_rate: float,
     col_sample_rate_per_tree: float,
     node_cap: int = 2048,
+    reg_lambda: float = 0.0,
+    reg_alpha: float = 0.0,
 ):
     """Build ``n_trees`` trees in ONE device dispatch (lax.scan over trees).
 
@@ -716,7 +738,7 @@ def build_trees_scanned(
 
         def whole_chunk(
             bins_u8, w, y, preds, varimp, base_key, row_key_, offset, lrs, is_cat,
-            min_rows_, msi_, max_abs_leaf_, col_rate_,
+            min_rows_, msi_, max_abs_leaf_, col_rate_, leaf_reg_,
         ):
             def body(carry, per_tree):
                 F, vi = carry
@@ -749,6 +771,7 @@ def build_trees_scanned(
                 _, F, vi, recs = _fused_levels(
                     bins_u8, F, vi, w_tree, wy, wy2, wh, tkey, cols_enabled,
                     is_cat, min_rows_, msi_, lr, max_abs_leaf_, col_rate_,
+                    leaf_reg_,
                     max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                     cat_cols=cat_cols, subtract=subtract,
                 )
@@ -763,12 +786,17 @@ def build_trees_scanned(
         _STEP_CACHE[key] = prog
 
     lrs = jnp.asarray(np.asarray(learn_rates, np.float32))
+    leaf_reg = (
+        None
+        if reg_lambda == 0.0 and reg_alpha == 0.0
+        else (jnp.float32(reg_lambda), jnp.float32(reg_alpha))
+    )
     return prog(
         bins_u8, w, y, preds, varimp, base_key,
         base_key if row_key is None else row_key,
         jnp.int32(tree_offset), lrs, is_cat_dev,
         jnp.float32(min_rows), jnp.float32(min_split_improvement),
-        jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate),
+        jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
     )
 
 
@@ -946,6 +974,8 @@ def build_tree(
     max_abs_leaf: float = np.inf,
     node_cap: int = 2048,
     monotone=None,  # (C,) int {-1,0,1} per-column constraint directions
+    reg_lambda: float = 0.0,
+    reg_alpha: float = 0.0,
 ):
     """Build one tree without any host↔device traffic in the level loop.
 
@@ -975,6 +1005,11 @@ def build_tree(
 
     cat_cols = tuple(int(i) for i in np.nonzero(np.asarray(is_cat_cols, bool))[0])
     tree = Tree()
+    leaf_reg = (
+        None
+        if reg_lambda == 0.0 and reg_alpha == 0.0
+        else (jnp.float32(reg_lambda), jnp.float32(reg_alpha))
+    )
 
     # Monotone constraints carry per-node [lo, hi] bound state level to
     # level — a separate per-level loop (constrained builds trade the fused
@@ -996,7 +1031,7 @@ def build_tree(
                 jnp.float32(min_rows), jnp.float32(min_split_improvement),
                 jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
                 jnp.float32(col_sample_rate),
-                mono_dev, node_lo, node_hi,
+                mono_dev, node_lo, node_hi, leaf_reg,
             )
             tree.levels.append(TreeLevel(**rec))
             if force_leaf:
@@ -1018,7 +1053,7 @@ def build_tree(
             is_cat_dev,
             jnp.float32(min_rows), jnp.float32(min_split_improvement),
             jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-            jnp.float32(col_sample_rate),
+            jnp.float32(col_sample_rate), leaf_reg,
         )
         for rec in records:
             tree.levels.append(TreeLevel(**rec))
@@ -1036,7 +1071,7 @@ def build_tree(
             is_cat_dev,
             jnp.float32(min_rows), jnp.float32(min_split_improvement),
             jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-            jnp.float32(col_sample_rate),
+            jnp.float32(col_sample_rate), leaf_reg,
         )
         tree.levels.append(TreeLevel(**rec))
         if force_leaf:

@@ -1,0 +1,135 @@
+"""XGBoost parameter surface mapped onto the TPU histogram tree engine —
+successor of ``h2o-ext-xgboost`` (``hex/tree/xgboost/XGBoost.java``,
+``XGBoostModel.java`` parameter mapping [UNVERIFIED upstream paths,
+SURVEY.md §2.2/§2.4, §7 step 9]).
+
+Upstream bundles the native xgboost library and translates H2O params onto
+it; its ``gpu_hist`` CUDA builder is exactly what our Pallas histogram
+kernel replaces (SURVEY §2.4). Here the translation runs the other
+direction: the xgboost-style surface (``eta``, ``subsample``,
+``colsample_bytree``, ``min_child_weight``, ``max_bin``, ``gamma``,
+``reg_lambda``/``reg_alpha``, ``tree_method=hist``, ``scale_pos_weight``)
+maps onto the SAME engine H2O GBM uses — one histogram tree builder, two
+param dialects, like upstream where both route into SharedTree-shaped code.
+
+Engine-semantic notes (documented deviations):
+- ``tree_method``: only ``hist`` semantics exist (static quantile binning).
+  ``auto``/``hist`` run as-is; ``exact``/``approx`` log a warning and use
+  hist — mirroring upstream's behavior on big data, where H2O XGBoost
+  forces hist.
+- ``reg_lambda``/``reg_alpha`` apply xgboost's leaf-value formula
+  w* = soft_threshold(Σ grad, α) / (Σ hess + λ) (see
+  ``shared_tree._finish_level``); split selection keeps H2O's SE gain —
+  λ/α do not enter the gain scan.
+- ``min_child_weight`` is H2O's ``min_rows`` (upstream H2O XGBoost declares
+  them synonyms): the constraint is on Σ row-weight per child, not Σ hess.
+- ``grow_policy=lossguide``/``max_leaves`` are not supported (depth-wise
+  builder); ``booster`` must be ``gbtree`` (no dart/gblinear).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from h2o3_tpu.models.tree.binning import MAX_BINS
+from h2o3_tpu.models.tree.gbm import GBM, GBMModel, GBMParams
+from h2o3_tpu.utils.log import Log
+
+# xgboost name -> canonical GBMParams field it aliases
+_ALIASES = {
+    "eta": "learn_rate",
+    "subsample": "sample_rate",
+    "colsample_bytree": "col_sample_rate_per_tree",
+    "colsample_bylevel": "col_sample_rate",
+    "min_child_weight": "min_rows",
+    "max_bin": "nbins",
+    "gamma": "min_split_improvement",
+    "max_delta_step": "max_abs_leafnode_pred",  # 0=unlimited special-cased below
+    "n_estimators": "ntrees",
+}
+
+
+@dataclass
+class XGBoostParams(GBMParams):
+    # xgboost defaults where they differ from H2O GBM's
+    ntrees: int = 50
+    max_depth: int = 6
+    learn_rate: float = 0.3  # xgboost eta default
+    min_rows: float = 1.0  # xgboost min_child_weight default
+    min_split_improvement: float = 0.0  # xgboost gamma default
+    reg_lambda: float = 1.0  # xgboost L2 default
+    reg_alpha: float = 0.0
+    tree_method: str = "auto"  # auto|hist|exact|approx (exact/approx -> hist)
+    grow_policy: str = "depthwise"
+    booster: str = "gbtree"
+    scale_pos_weight: float = 1.0  # >0 (xgboost positive-class weight)
+    dmatrix_type: str = "auto"  # accepted for surface parity; dense engine
+
+
+class XGBoostModel(GBMModel):
+    algo = "xgboost"
+
+
+class XGBoost(GBM):
+    """``H2OXGBoostEstimator``-compatible builder on the hist engine."""
+
+    algo = "xgboost"
+    PARAMS_CLS = XGBoostParams
+    MODEL_CLS = XGBoostModel
+    PARAM_ALIASES = _ALIASES  # estimator layer accepts the xgboost names too
+
+    def __init__(self, **kwargs: Any):
+        if "max_delta_step" in kwargs:
+            mds = float(kwargs.pop("max_delta_step"))
+            if mds < 0:
+                raise ValueError("max_delta_step must be >= 0")
+            if mds == 0:  # xgboost convention: 0 means unconstrained
+                pass
+            elif "max_abs_leafnode_pred" in kwargs:
+                raise ValueError(
+                    "'max_delta_step' and 'max_abs_leafnode_pred' are aliases — pass one"
+                )
+            else:
+                kwargs["max_abs_leafnode_pred"] = mds
+        for xgb_name, h2o_name in _ALIASES.items():
+            if xgb_name == "max_delta_step":
+                continue  # handled above
+            if xgb_name in kwargs:
+                if h2o_name in kwargs:
+                    raise ValueError(
+                        f"{xgb_name!r} and {h2o_name!r} are aliases — pass one"
+                    )
+                kwargs[h2o_name] = kwargs.pop(xgb_name)
+        super().__init__(**kwargs)
+        p: XGBoostParams = self.params
+        if p.booster != "gbtree":
+            raise ValueError(
+                f"booster={p.booster!r} is not supported (gbtree only; "
+                "dart/gblinear have no engine here)"
+            )
+        if p.grow_policy not in ("depthwise",):
+            raise ValueError(
+                "grow_policy='lossguide' is not supported (depth-wise builder)"
+            )
+        if p.tree_method not in ("auto", "hist", "exact", "approx"):
+            raise ValueError(f"unknown tree_method {p.tree_method!r}")
+        if p.scale_pos_weight <= 0:
+            raise ValueError("scale_pos_weight must be > 0")
+        if p.tree_method in ("exact", "approx"):
+            Log.warn(
+                f"tree_method={p.tree_method!r} has no exact-split engine; "
+                "using hist (static quantile bins) — upstream H2O XGBoost "
+                "likewise forces hist on large data"
+            )
+        if p.nbins > MAX_BINS:
+            Log.warn(f"max_bin={p.nbins} clamped to engine maximum {MAX_BINS}")
+            p.nbins = MAX_BINS
+        if p.monotone_constraints and (p.reg_lambda or p.reg_alpha):
+            # both paths exist but the mono level loop applies reg to leaf
+            # values only, same as the fused path — nothing to reject; just
+            # make the combination visible in logs for parity debugging
+            Log.info(
+                "XGBoost monotone_constraints with reg_lambda/reg_alpha: "
+                "regularized leaves + constraint clamping"
+            )

@@ -124,6 +124,7 @@ h2o.rm <- function(key) {
 }
 
 h2o.gbm <- function(...) .h2o.train("gbm", ...)
+h2o.xgboost <- function(...) .h2o.train("xgboost", ...)
 h2o.randomForest <- function(...) .h2o.train("drf", ...)
 h2o.glm <- function(...) .h2o.train("glm", ...)
 h2o.deeplearning <- function(...) .h2o.train("deeplearning", ...)

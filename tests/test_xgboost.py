@@ -1,0 +1,175 @@
+"""XGBoost param-surface tests — SURVEY §7 step 9 / §2.4: the hist engine is
+the ``h2o-ext-xgboost`` successor; these pin the translation onto GBM."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.models.tree.gbm import GBM
+from h2o3_tpu.models.tree.xgboost import XGBoost, XGBoostParams
+
+
+@pytest.fixture(scope="module")
+def bin_frame():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(3000, 5)).astype(np.float32)
+    y = X[:, 0] + 0.6 * X[:, 1] ** 2 + rng.normal(size=3000) * 0.4 > 0.4
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(5)])
+    df["label"] = np.where(y, "y", "n")
+    return h2o3_tpu.upload_file(df)
+
+
+def test_alias_translation():
+    b = XGBoost(
+        eta=0.2, subsample=0.8, colsample_bytree=0.7, min_child_weight=3,
+        max_bin=64, gamma=0.01, n_estimators=7, response_column="label",
+    )
+    p = b.params
+    assert p.learn_rate == 0.2
+    assert p.sample_rate == 0.8
+    assert p.col_sample_rate_per_tree == 0.7
+    assert p.min_rows == 3
+    assert p.nbins == 64
+    assert p.min_split_improvement == 0.01
+    assert p.ntrees == 7
+
+
+def test_alias_conflict_rejected():
+    with pytest.raises(ValueError, match="aliases"):
+        XGBoost(eta=0.2, learn_rate=0.3)
+
+
+def test_xgboost_defaults_differ_from_gbm():
+    p = XGBoostParams()
+    assert p.learn_rate == 0.3 and p.max_depth == 6 and p.min_rows == 1.0
+    assert p.reg_lambda == 1.0 and p.reg_alpha == 0.0
+
+
+def test_booster_and_grow_policy_validation():
+    with pytest.raises(ValueError, match="gbtree"):
+        XGBoost(booster="gblinear")
+    with pytest.raises(ValueError, match="lossguide"):
+        XGBoost(grow_policy="lossguide")
+    with pytest.raises(ValueError, match="tree_method"):
+        XGBoost(tree_method="gpu_hist_nope")
+    # exact/approx warn but construct
+    XGBoost(tree_method="exact")
+
+
+def test_max_bin_clamped():
+    b = XGBoost(max_bin=4096)
+    assert b.params.nbins == 255
+
+
+def test_unregularized_xgboost_equals_gbm(bin_frame):
+    """λ=0, α=0 and matched params ⇒ identical trees to GBM (same engine)."""
+    shared = dict(
+        ntrees=5, max_depth=4, min_rows=10.0, seed=11,
+        min_split_improvement=1e-5,
+    )
+    g = GBM(learn_rate=0.3, **shared).train(y="label", training_frame=bin_frame)
+    x = XGBoost(eta=0.3, reg_lambda=0.0, reg_alpha=0.0, **shared).train(
+        y="label", training_frame=bin_frame
+    )
+    pg = g.predict(bin_frame).vec("y").to_numpy()
+    px = x.predict(bin_frame).vec("y").to_numpy()
+    np.testing.assert_allclose(px, pg, rtol=0, atol=0)
+
+
+def test_reg_lambda_shrinks_leaves(bin_frame):
+    kw = dict(ntrees=5, max_depth=4, seed=11, reg_alpha=0.0)
+    m0 = XGBoost(reg_lambda=0.0, **kw).train(y="label", training_frame=bin_frame)
+    m5 = XGBoost(reg_lambda=50.0, **kw).train(y="label", training_frame=bin_frame)
+    p0 = m0.predict(bin_frame).vec("y").to_numpy()
+    p5 = m5.predict(bin_frame).vec("y").to_numpy()
+    # heavier L2 pulls scores toward the prior: less spread
+    assert np.std(p5) < np.std(p0)
+    assert m5.training_metrics.auc > 0.6  # still learns
+
+
+def test_reg_alpha_large_kills_leaves(bin_frame):
+    m = XGBoost(
+        ntrees=3, max_depth=3, seed=11, reg_lambda=0.0, reg_alpha=1e9
+    ).train(y="label", training_frame=bin_frame)
+    p = m.predict(bin_frame).vec("y").to_numpy()
+    # soft-threshold wipes every leaf: predictions collapse to the init score
+    assert float(np.ptp(p)) < 1e-6
+
+
+def test_scale_pos_weight(bin_frame):
+    m1 = XGBoost(ntrees=5, max_depth=3, seed=3).train(
+        y="label", training_frame=bin_frame
+    )
+    m5 = XGBoost(ntrees=5, max_depth=3, seed=3, scale_pos_weight=5.0).train(
+        y="label", training_frame=bin_frame
+    )
+    p1 = m1.predict(bin_frame).vec("y").to_numpy()
+    p5 = m5.predict(bin_frame).vec("y").to_numpy()
+    # up-weighting positives raises predicted positive probability on average
+    assert p5.mean() > p1.mean()
+
+
+def test_estimator_and_rest_surface(bin_frame):
+    from h2o3_tpu.estimators import H2OXGBoostEstimator
+
+    est = H2OXGBoostEstimator(ntrees=3, max_depth=3, eta=0.3, seed=1)
+    est.train(y="label", training_frame=bin_frame)
+    assert est.model.algo == "xgboost"
+    assert est.model_performance().auc > 0.6
+    # REST: algo registered
+    from h2o3_tpu.api.server import _ALGOS
+
+    assert "xgboost" in _ALGOS
+
+
+def test_mojo_parity(bin_frame, tmp_path):
+    m = XGBoost(ntrees=3, max_depth=3, seed=5).train(
+        y="label", training_frame=bin_frame
+    )
+    path = m.download_mojo(str(tmp_path / "xgb.zip"))
+    from h2o3_tpu.genmodel import MojoModel
+
+    scorer = MojoModel.load(path)
+    df = pd.DataFrame(
+        {f"f{i}": np.random.default_rng(0).normal(size=50) for i in range(5)}
+    )
+    server_pred = m.predict(h2o3_tpu.upload_file(df)).vec("y").to_numpy()
+    offline = scorer.predict(df)  # dict[str, np.ndarray]
+    np.testing.assert_allclose(offline["y"], server_pred, atol=1e-5)
+
+
+def test_max_delta_step_zero_means_unlimited():
+    b = XGBoost(max_delta_step=0.0)
+    assert b.params.max_abs_leafnode_pred == float("inf")
+    b = XGBoost(max_delta_step=0.7)
+    assert b.params.max_abs_leafnode_pred == 0.7
+    with pytest.raises(ValueError, match=">= 0"):
+        XGBoost(max_delta_step=-1.0)
+
+
+def test_scale_pos_weight_validation():
+    with pytest.raises(ValueError, match="scale_pos_weight"):
+        XGBoost(scale_pos_weight=0.0)
+
+
+def test_checkpoint_freezes_regularization(bin_frame):
+    m1 = XGBoost(ntrees=3, max_depth=3, seed=2, reg_lambda=1.0).train(
+        y="label", training_frame=bin_frame
+    )
+    with pytest.raises(RuntimeError, match="reg_lambda"):
+        XGBoost(
+            ntrees=6, max_depth=3, seed=2, reg_lambda=100.0, checkpoint=m1
+        ).train(y="label", training_frame=bin_frame)
+
+
+def test_rest_alias_parsing():
+    from h2o3_tpu.api.server import Endpoints
+    from h2o3_tpu.models.tree.xgboost import XGBoost as XGB
+
+    kwargs, x, y, tk, vk = Endpoints._parse_build_params(
+        None, XGB, {"eta": "0.05", "max_bin": "64", "response_column": "label"}
+    )
+    b = XGB(**kwargs)
+    assert b.params.learn_rate == 0.05
+    assert b.params.nbins == 64

@@ -29,6 +29,7 @@ from h2o3_tpu.estimators import _EstimatorBase
 
 ALGOS = [
     ("H2OGradientBoostingEstimator", "GBM"),
+    ("H2OXGBoostEstimator", "XGBoost"),
     ("H2ORandomForestEstimator", "DRF"),
     ("H2OXRTEstimator", "XRT"),
     ("H2OGeneralizedLinearEstimator", "GLM"),
