@@ -336,13 +336,114 @@ def group_by(frame: Frame, by) -> GroupBy:
 
 
 # ---------------------------------------------------------------------------
-# merge / sort — successor of ``ASTMerge`` (distributed radix join) and
-# ``ASTSort``. Host-coordinated on the KEY COLUMNS ONLY: the join/sort
-# permutation is computed from the pulled key columns (often strings/enums),
-# then every payload column is gathered ON DEVICE in one fused program
-# (``Frame.gather_rows``) — the former implementation round-tripped both
-# whole frames through pandas.
+# merge / sort — successor of ``ASTMerge`` (the distributed radix join,
+# ``water/rapids/Merge.java`` [UNVERIFIED]) and ``ASTSort``. DEVICE-SIDE key
+# matching: per-column int64 codes (numerics bitcast after -0/NaN
+# canonicalization; enums remapped onto the union domain so the join is on
+# LABELS), dense tuple group-ids via one lexsort over both sides' keys, then
+# a sort-merge join (stable argsort + searchsorted). The host only expands
+# the per-left-row match counts into (li, ri) index vectors (vectorized
+# np.repeat — O(output rows)), and every payload column is gathered ON
+# DEVICE in one fused program (``Frame.gather_rows``). STR and TIME keys
+# fall back to the host (pandas) path: strings are host-resident anyway and
+# TIME needs the exact f64 host values, not the f32 device copy.
 # ---------------------------------------------------------------------------
+
+
+def _domain_union(dom_a, dom_b):
+    """Union of two enum domains, a-first order (shared by merge keys and
+    join-key coalescing so the two can't drift)."""
+    union = list(dom_a or ())
+    seen = set(union)
+    union += [d for d in (dom_b or ()) if d not in seen]
+    return union
+
+
+def _key_codes_device(v, union_pos: dict | None = None):
+    """(nrow,) int32 device codes for one join/sort key column.
+
+    Equal values get equal codes; NA is its own code (-1 for enums, the
+    canonical-NaN bit pattern for numerics) so NA keys match NA keys, as the
+    former pandas path behaved. int32 on purpose (JAX default x64-disabled
+    mode truncates int64 anyway): group-id space caps at ~2^31 combined
+    rows, beyond per-host frame sizes here. Returns None for kinds that
+    need the host path (STR / TIME)."""
+    if v.kind in (STR, TIME):
+        return None
+    x = v.data[: v.nrow]
+    if v.kind == CAT:
+        if union_pos is None:
+            return x.astype(jnp.int32)
+        lut = np.array(
+            [union_pos[d] for d in (v.domain or ())] or [0], np.int32
+        )
+        return jnp.where(
+            x >= 0, jnp.asarray(lut)[jnp.clip(x, 0, len(lut) - 1)], jnp.int32(-1)
+        )
+    xf = x.astype(jnp.float32)
+    xf = jnp.where(xf == 0, jnp.float32(0.0), xf)  # -0.0 ≡ +0.0
+    xf = jnp.where(jnp.isnan(xf), jnp.float32(np.nan), xf)  # canonical NaN
+    return jax.lax.bitcast_convert_type(xf, jnp.int32)
+
+
+def _tuple_gids(cols_l, cols_r):
+    """Dense group ids for key TUPLES across both sides (device).
+
+    One lexsort over the concatenated (n_l + n_r, K) key matrix; rows with
+    equal tuples get equal ids — the collision-free successor of hashing."""
+    Lk = jnp.stack(cols_l, axis=1)
+    Rk = jnp.stack(cols_r, axis=1)
+    allk = jnp.concatenate([Lk, Rk], axis=0)
+    K = allk.shape[1]
+    order = jnp.lexsort(tuple(allk[:, k] for k in range(K - 1, -1, -1)))
+    skeys = allk[order]
+    bump = jnp.any(skeys[1:] != skeys[:-1], axis=1).astype(jnp.int32)
+    gid_sorted = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(bump)])
+    gid = jnp.zeros(allk.shape[0], jnp.int32).at[order].set(gid_sorted)
+    return gid[: Lk.shape[0]], gid[Lk.shape[0] :]
+
+
+def _join_stats(gl, gr, need_matched: bool):
+    """Device sort-merge join statistics: for each left row the [lo, lo+m)
+    range of matches in right-sorted order, the right permutation, and (only
+    when ``need_matched`` — right/outer joins) the per-right-row matched
+    mask. Stable argsort keeps equal right keys in right-frame order, so
+    WITHIN a match group the output is in right-frame order like pandas;
+    the groups themselves come out left-major (see ``merge``)."""
+    rorder = jnp.argsort(gr, stable=True)
+    rs = gr[rorder]
+    lo = jnp.searchsorted(rs, gl, side="left")
+    hi = jnp.searchsorted(rs, gl, side="right")
+    n_l = gl.shape[0]
+    if not need_matched or n_l == 0:
+        matched_r = jnp.ones(gr.shape[0], bool) if not need_matched else jnp.zeros(gr.shape[0], bool)
+    else:
+        ls = jnp.sort(gl)
+        pos = jnp.searchsorted(ls, gr, side="left")
+        matched_r = (pos < n_l) & (ls[jnp.clip(pos, 0, n_l - 1)] == gr)
+    return lo, hi - lo, rorder, matched_r
+
+
+def _merge_keys_device(left, right, bx, bby):
+    """(li, ri) row-index vectors via the device join, or None if any key
+    column needs the host path."""
+    cols_l, cols_r = [], []
+    for cl, cr in zip(bx, bby):
+        vl, vr = left.vec(cl), right.vec(cr)
+        if vl.kind == CAT or vr.kind == CAT:
+            if not (vl.kind == CAT and vr.kind == CAT):
+                return None  # mixed enum/numeric key: host path decides
+            union = _domain_union(vl.domain, vr.domain)
+            pos = {d: i for i, d in enumerate(union)}
+            kl, kr = _key_codes_device(vl, pos), _key_codes_device(vr, pos)
+        else:
+            kl, kr = _key_codes_device(vl), _key_codes_device(vr)
+        if kl is None or kr is None:
+            return None
+        cols_l.append(kl)
+        cols_r.append(kr)
+    gl, gr = _tuple_gids(cols_l, cols_r)
+    return gl, gr
 
 
 def merge(
@@ -356,26 +457,61 @@ def merge(
 ) -> Frame:
     bx = list(by_x or by or [n for n in left.names if n in set(right.names)])
     bby = list(by_y or by or bx)
-    how = "outer" if (all_x and all_y) else "left" if all_x else "right" if all_y else "inner"
 
-    def _key_col(v):
-        x = v.to_numpy()
-        if v.kind == CAT:  # join on LABELS — codes are frame-local
-            dom = np.asarray(list(v.domain or ()) + [None], dtype=object)
-            return dom[np.where(x >= 0, x, len(dom) - 1).astype(np.int64)]
-        return x
+    dev = _merge_keys_device(left, right, bx, bby)
+    if dev is not None:
+        # Output row order (device path): match groups in LEFT-frame order
+        # (within a group, right-frame order), then — for right/outer joins —
+        # unmatched right rows appended in right-frame order. H2O's own
+        # ASTMerge returns key-sorted rows, so row order is an implementation
+        # contract here, not pandas compatibility; the STR/TIME host
+        # fallback below keeps pandas' native ordering.
+        gl, gr = dev
+        lo_d, m_d, rorder_d, matched_d = _join_stats(gl, gr, need_matched=all_y)
+        lo, m, rorder, matched_r = (
+            np.asarray(lo_d, np.int64),
+            np.asarray(m_d, np.int64),
+            np.asarray(rorder_d, np.int64),
+            np.asarray(matched_d, bool),
+        )
+        nr = right.nrow
+        m_out = np.maximum(m, 1) if all_x else m
+        li = np.repeat(np.arange(left.nrow, dtype=np.int64), m_out)
+        off = np.repeat(np.cumsum(m_out) - m_out, m_out)
+        within = np.arange(len(li), dtype=np.int64) - off
+        has = np.repeat(m > 0, m_out)
+        rpos = np.repeat(lo, m_out) + within
+        ri = np.where(
+            has, rorder[np.minimum(rpos, max(nr - 1, 0))] if nr else -1, -1
+        ).astype(np.int64)
+        if all_y and nr:
+            extra = np.nonzero(~matched_r)[0].astype(np.int64)
+            li = np.concatenate([li, np.full(len(extra), -1, np.int64)])
+            ri = np.concatenate([ri, extra])
+        lvalid = li >= 0
+    else:
+        how = (
+            "outer" if (all_x and all_y) else "left" if all_x else "right" if all_y else "inner"
+        )
 
-    lk = pd.DataFrame({c: _key_col(left.vec(c)) for c in bx})
-    rk = pd.DataFrame({c: _key_col(right.vec(c)) for c in bby})
-    lk["__li__"] = np.arange(left.nrow, dtype=np.int64)
-    rk["__ri__"] = np.arange(right.nrow, dtype=np.int64)
-    j = lk.merge(rk, left_on=bx, right_on=bby, how=how, suffixes=("", "__rk"))
-    li = j["__li__"].to_numpy()
-    ri = j["__ri__"].to_numpy()
-    lvalid = ~pd.isna(li)
-    rvalid = ~pd.isna(ri)
-    li = np.where(lvalid, li, -1).astype(np.int64)
-    ri = np.where(rvalid, ri, -1).astype(np.int64)
+        def _key_col(v):
+            x = v.to_numpy()
+            if v.kind == CAT:  # join on LABELS — codes are frame-local
+                dom = np.asarray(list(v.domain or ()) + [None], dtype=object)
+                return dom[np.where(x >= 0, x, len(dom) - 1).astype(np.int64)]
+            return x
+
+        lk = pd.DataFrame({c: _key_col(left.vec(c)) for c in bx})
+        rk = pd.DataFrame({c: _key_col(right.vec(c)) for c in bby})
+        lk["__li__"] = np.arange(left.nrow, dtype=np.int64)
+        rk["__ri__"] = np.arange(right.nrow, dtype=np.int64)
+        j = lk.merge(rk, left_on=bx, right_on=bby, how=how, suffixes=("", "__rk"))
+        li = j["__li__"].to_numpy()
+        ri = j["__ri__"].to_numpy()
+        lvalid = ~pd.isna(li)
+        rvalid = ~pd.isna(ri)
+        li = np.where(lvalid, li, -1).astype(np.int64)
+        ri = np.where(rvalid, ri, -1).astype(np.int64)
 
     lg = left.gather_rows(li)
     rcols = [n for n in right.names if n not in set(bby)]
@@ -416,7 +552,7 @@ def _coalesce_vec(a, b, use_a: np.ndarray):
         # differing enum domains: rebuild over the union (host; key columns
         # of outer joins only — payload columns never coalesce)
         av, bv = a.to_numpy(), b.to_numpy()
-        dom = list(a.domain or ()) + [d for d in (b.domain or ()) if d not in set(a.domain or ())]
+        dom = _domain_union(a.domain, b.domain)
         lut_b = {d: i for i, d in enumerate(dom)}
         bmap = np.array([lut_b[d] for d in (b.domain or ())], np.int64)
         codes = np.where(
@@ -434,8 +570,24 @@ def _coalesce_vec(a, b, use_a: np.ndarray):
 
 def sort(frame: Frame, by: Sequence[str] | str, ascending: bool | Sequence[bool] = True) -> Frame:
     by = [by] if isinstance(by, str) else list(by)
+    asc = [ascending] * len(by) if isinstance(ascending, bool) else list(ascending)
+    vs = [frame.vec(b) for b in by]
+    if all(v.kind not in (STR, TIME) for v in vs):
+        # device multi-key stable lexsort (numerics sort NaN last either
+        # direction, matching pandas na_position='last'; enums sort by code
+        # with NA (-1) first ascending, exactly the former host behavior)
+        keys = []
+        for v, a in zip(vs, asc):
+            k = v.data[: v.nrow]
+            if v.kind == CAT:
+                k = k.astype(jnp.float32)
+            if not a:
+                k = -k  # NaN stays NaN → still sorts last, like pandas
+            keys.append(k)
+        order = jnp.lexsort(tuple(reversed(keys)))  # np.lexsort: last = primary
+        return frame.gather_rows(np.asarray(order))
     df = pd.DataFrame({b: frame.vec(b).to_numpy() for b in by})
-    order = df.sort_values(by=by, ascending=ascending, kind="stable").index.to_numpy()
+    order = df.sort_values(by=by, ascending=asc, kind="stable").index.to_numpy()
     return frame.gather_rows(order)
 
 

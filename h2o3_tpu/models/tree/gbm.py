@@ -262,15 +262,20 @@ class GBM(ModelBuilder):
         ybuf = np.zeros(npad, np.float32)
         ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
         # xgboost-surface scale_pos_weight (XGBoostParams only): fold the
-        # positive-class up-weighting into the row weights
+        # positive-class up-weighting into the TRAINING row weights only —
+        # xgboost scales grad/hess (≡ row weights in our Newton leaves) but
+        # evaluates metrics unweighted, so the metric weights (wn) must not
+        # carry it
         spw = float(getattr(p, "scale_pos_weight", 1.0))
+        w_train_np = w_np
         if spw != 1.0:
             if dist != "bernoulli":
                 raise ValueError("scale_pos_weight requires a binary response")
-            w_np[: train.nrow] *= np.where(
+            w_train_np = w_np.copy()
+            w_train_np[: train.nrow] *= np.where(
                 ybuf[: train.nrow] == 1.0, spw, 1.0
             ).astype(np.float32)
-        w = jnp.asarray(w_np)
+        w = jnp.asarray(w_train_np)
         y = jnp.asarray(ybuf)
 
         offset = jnp.zeros(npad, jnp.float32)
