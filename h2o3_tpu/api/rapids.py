@@ -218,6 +218,22 @@ def _sel_list(x):
     return [x]
 
 
+def _table_values(x):
+    """Lookup-table VALUES for match/%in%: literal list, or a Frame/Vec —
+    enum vecs yield their LABELS (to_numpy gives frame-local codes, which
+    must never be compared against another column's values)."""
+    if isinstance(x, (Frame, Vec)):
+        v = _as_vec(x)
+        if v.kind == "enum":
+            dom = np.asarray(list(v.domain or ()), dtype=object)
+            codes = v.to_numpy()
+            return [dom[int(c)] if c >= 0 else None for c in codes]
+        if v.kind == "string":
+            return list(v._host)
+        return [float(t) for t in v.to_numpy()]
+    return _sel_list(x)
+
+
 def _apply(op: str, raw_args: list, sess: Session):
     # special forms first (unevaluated args)
     if op in ("tmp=", "rapids_tmp="):
@@ -335,9 +351,7 @@ def _apply(op: str, raw_args: list, sess: Session):
         left, right = _as_frame(args[0]), _as_frame(args[1])
         all_left = bool(args[2]) if len(args) > 2 else False
         all_right = bool(args[3]) if len(args) > 3 else False
-        how = ("outer" if all_left and all_right
-               else "left" if all_left else "right" if all_right else "inner")
-        return OPS.merge(left, right, how=how)
+        return OPS.merge(left, right, all_x=all_left, all_y=all_right)
     if op == "sort":
         fr = _as_frame(args[0])
         cols = _normalize_cols(fr, _sel_list(args[1]))
@@ -346,6 +360,39 @@ def _apply(op: str, raw_args: list, sess: Session):
         return OPS.sort(fr, names, ascending=asc)
     if op == "unique":
         return OPS.unique(_as_vec(args[0]))
+    if op == "match":  # (match vec [table...] nomatch start_index)
+        nomatch = float(args[2]) if len(args) > 2 and args[2] is not None else float("nan")
+        start = int(args[3]) if len(args) > 3 and args[3] is not None else 1
+        return OPS.match(
+            _as_vec(args[0]), _table_values(args[1]), nomatch=nomatch, start_index=start
+        )
+    if op == "%in%":
+        return OPS.is_in(_as_vec(args[0]), _table_values(args[1]))
+    if op == "which":
+        return OPS.which(_as_vec(args[0]))
+    if op == "na.omit":
+        return OPS.na_omit(_as_frame(args[0]))
+    if op == "rank_within_groupby":
+        # (rank_within_groupby frame [group...] [sort...] [asc...] 'name' sorted)
+        fr = _as_frame(args[0])
+        gcols = [fr.names[c] for c in _normalize_cols(fr, _sel_list(args[1]))]
+        scols = [fr.names[c] for c in _normalize_cols(fr, _sel_list(args[2]))]
+        asc = [bool(b) for b in _sel_list(args[3])] if len(args) > 3 else True
+        name = str(args[4]) if len(args) > 4 else "New_Rank_column"
+        ssorted = bool(args[5]) if len(args) > 5 else False
+        return OPS.rank_within_group_by(
+            fr, gcols, scols, ascending=asc, new_col_name=name,
+            sort_cols_sorted=ssorted,
+        )
+    if op == "pivot":  # (pivot frame 'index' 'column' 'value')
+        fr = _as_frame(args[0])
+        nm = lambda c: fr.names[int(c)] if isinstance(c, (int, float)) else str(c)
+        return OPS.pivot(fr, nm(args[1]), nm(args[2]), nm(args[3]))
+    if op == "h2o.random_stratified_split":
+        # (h2o.random_stratified_split y test_frac seed) — upstream arg order
+        frac = float(args[1]) if len(args) > 1 and args[1] is not None else 0.2
+        seed = int(args[2]) if len(args) > 2 and args[2] is not None else -1
+        return OPS.stratified_split(_as_vec(args[0]), test_frac=frac, seed=seed)
     if op == "table":
         v2 = _as_vec(args[1]) if len(args) > 1 and isinstance(args[1], (Frame, Vec)) else None
         return OPS.table(_as_vec(args[0]), v2)

@@ -86,11 +86,28 @@ class ApiError(Exception):
 # endpoint logic ("Handlers")
 
 
+def _spmd_v1_guard(what: str) -> None:
+    """Multi-process clouds replicate parse/build/predict only (spmd.py v1
+    scope); coordinator-local device work on sharded data would deadlock the
+    ranks, so reject it cleanly."""
+    from h2o3_tpu.cluster import spmd
+
+    if spmd.multi_process():
+        raise ApiError(501, f"{what} is not yet supported on a multi-process "
+                            "cloud (spmd v1 replicates Parse/build/predict)")
+
+
 def _frame_schema(fr: Frame, key: str) -> dict:
+    from h2o3_tpu.cluster import spmd
+
     cols = []
     for name in fr.names:
         v = fr.vec(name)
-        st = v.stats() if hasattr(v, "stats") else {}
+        # per-column device stats dispatch device programs; on a multi-process
+        # cloud that is only safe inside replicated execution — serve metadata
+        st = {}
+        if hasattr(v, "stats") and not (spmd.multi_process() and not spmd.in_replicated()):
+            st = v.stats()
         cols.append({
             "label": name,
             "type": {"real": "real", "int": "int", "enum": "enum",
@@ -208,7 +225,10 @@ class Endpoints:
         for k in ("separator", "column_types", "column_names"):
             if params.get(k) is not None:
                 setup[k] = params[k] if not isinstance(params[k], str) or not params[k].startswith(("[", "{")) else json.loads(params[k])
-        job = Job(lambda j: parse(setup, destination_frame=dest), f"Parse {srcs[0]}")
+        from h2o3_tpu.cluster import spmd
+
+        job = Job(lambda j: spmd.run("parse", setup=setup, dest=dest),
+                  f"Parse {srcs[0]}")
         job.start()
         return {"__meta": {"schema_type": "Parse"}, "job": _job_schema(job),
                 "destination_frame": {"name": dest}}
@@ -241,6 +261,7 @@ class Endpoints:
         return {"__meta": {"schema_type": "Frames"}, "frames": []}
 
     def download_dataset(self, params):
+        _spmd_v1_guard("DownloadDataset")
         """``/3/DownloadDataset?frame_id=…`` — frame rows as CSV (the route
         h2o clients use to materialize frames locally)."""
         key = params.get("frame_id")
@@ -253,6 +274,7 @@ class Endpoints:
                 "filename": f"{key}.csv"}
 
     def frame_export(self, params, key):
+        _spmd_v1_guard("Frames export")
         """``/3/Frames/{id}/export`` — CSV/Parquet to a server-side path."""
         fr = DKV.get(key)
         if not isinstance(fr, Frame):
@@ -296,10 +318,14 @@ class Endpoints:
         kwargs, x, y, train_key, valid_key = self._parse_build_params(cls, params)
         if train_key is None:
             raise ApiError(400, "training_frame is required")
-        builder = cls(**kwargs)
+        cls(**kwargs)  # validate params NOW so bad requests fail fast
+        from h2o3_tpu.cluster import spmd
+
+        dest = DKV.make_key(algo)  # coordinator-chosen, carried to followers
         job = Job(
-            lambda j: builder.train(
-                x=x, y=y, training_frame=train_key, validation_frame=valid_key
+            lambda j: spmd.run(
+                "build", algo=algo, kwargs=kwargs, x=x, y=y,
+                train=train_key, valid=valid_key, dest=dest,
             ),
             f"{algo} build",
         )
@@ -465,8 +491,9 @@ class Endpoints:
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {frame_key} not found")
         dest = params.get("predictions_frame") or DKV.make_key("prediction")
-        pred = m.predict(fr)
-        DKV.put(dest, pred)
+        from h2o3_tpu.cluster import spmd
+
+        pred = spmd.run("predict", model_key=model_key, frame_key=frame_key, dest=dest)
         return {"__meta": {"schema_type": "Predictions"},
                 "predictions_frame": {"name": dest},
                 "model_metrics": []}
@@ -536,6 +563,7 @@ class Endpoints:
 
     # -- rapids (frame expression eval) -----------------------------------
     def rapids(self, params):
+        _spmd_v1_guard("Rapids")
         from h2o3_tpu.api.rapids import rapids_eval
 
         ast = params.get("ast")

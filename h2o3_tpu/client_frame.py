@@ -43,7 +43,11 @@ def _render(x: Any) -> str:
         return "TRUE" if x else "FALSE"
     if isinstance(x, (list, tuple)):
         return "[" + " ".join(_render(v) for v in x) + "]"
-    return repr(float(x)) if isinstance(x, float) else repr(x)
+    if isinstance(x, float):
+        import math
+
+        return "NaN" if math.isnan(x) else repr(x)  # bare nan is no symbol
+    return repr(x)
 
 
 class H2OFrame:
@@ -156,6 +160,22 @@ class H2OFrame:
     def unique(self): return self._node("unique")
 
     def table(self): return self._node("table")
+
+    def match(self, table, nomatch=float("nan")):
+        return self._node("match", list(table), nomatch)
+
+    def isin(self, table):
+        return self._node("%in%", list(table))
+
+    def which(self): return self._node("which")
+
+    def na_omit(self): return self._node("na.omit")
+
+    def pivot(self, index: str, column: str, value: str):
+        return self._node("pivot", index, column, value)
+
+    def stratified_split(self, test_frac: float = 0.2, seed: int = -1):
+        return self._node("h2o.random_stratified_split", test_frac, seed)
 
     def sort(self, by, ascending=True):
         cols = [by] if isinstance(by, str) else list(by)

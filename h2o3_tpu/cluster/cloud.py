@@ -130,5 +130,7 @@ def cluster_info() -> dict:
 def shutdown() -> None:
     """Drop all state (the process keeps running; devices are managed by JAX)."""
     from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.cluster import spmd
 
+    spmd.shutdown_followers()  # release any follower_loop ranks first
     DKV.remove_all()

@@ -1,0 +1,160 @@
+"""SPMD command replication — the ``water.DTask``/RPC successor for
+multi-host clouds (SURVEY.md §2.1 RPC/DTask row, §5.8).
+
+Multi-controller JAX requires every process to execute the same device
+program: a jit entered only on the REST coordinator would hang at its first
+cross-process collective. H2O solves the equivalent problem by shipping a
+serialized ``DTask`` to every node (``new RPC<>(node, dtask).call()``
+[UNVERIFIED upstream path]); here the coordinator (process 0) broadcasts a
+pickled ``(command, kwargs)`` through the jax coordination service and every
+process — coordinator included — executes the SAME registered function.
+Determinism of the shared execution (same frames from the same source, same
+seeds, coordinator-chosen DKV keys carried in the command) is what keeps the
+ranks' collective sequences aligned, exactly as H2O relies on every node
+running the same jar.
+
+v1 scope: Parse, model build, predict — the end-to-end REST training path.
+Frame mutations via Rapids and grid/AutoML builds are coordinator-local and
+raise on a multi-process cloud (documented limitation; both reduce to these
+primitives and widen the same way).
+
+The broadcast payload is length-prefixed and padded to a power of two so the
+number of distinct broadcast programs stays O(log max_payload).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+import numpy as np
+
+from h2o3_tpu.utils.log import Log
+
+_LOCK = threading.RLock()  # serializes the coordinator's device-work commands
+# process-global (not thread-local): builders spawn nested Job threads that
+# must inherit the flag; replicated execution is serialized by _LOCK anyway
+_REPLICATED = 0
+
+
+def in_replicated() -> bool:
+    """True while executing a replicated command (every rank in lockstep) —
+    the only context where cross-process collectives are safe."""
+    return _REPLICATED > 0
+
+
+def multi_process() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def _bcast_bytes(payload: bytes | None) -> bytes:
+    """Broadcast a byte string from process 0 to all (collective: every
+    process must call this — followers pass ``None``)."""
+    from jax.experimental import multihost_utils as mh
+
+    n = len(payload) if payload is not None else 0
+    n_arr = mh.broadcast_one_to_all(np.array([n], np.int32))
+    n = int(n_arr[0])
+    cap = 1 << max(10, (n - 1).bit_length())  # pow2 pad bounds compile count
+    buf = np.zeros(cap, np.uint8)
+    if payload is not None:
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    data = mh.broadcast_one_to_all(buf)
+    return bytes(np.asarray(data[:n], np.uint8))
+
+
+# -- command registry --------------------------------------------------------
+
+
+def _exec_parse(setup: dict, dest: str):
+    from h2o3_tpu.frame.parse import parse
+
+    return parse(setup, destination_frame=dest)
+
+
+def _exec_build(algo: str, kwargs: dict, x, y, train, valid, dest: str):
+    from h2o3_tpu.api.server import _builder_cls
+    from h2o3_tpu.cluster.registry import DKV
+
+    model = _builder_cls(algo)(**kwargs).train(
+        x=x, y=y, training_frame=train, validation_frame=valid
+    )
+    # every rank re-keys to the coordinator-chosen key so later commands
+    # (predict, fetch) reference the same object on all ranks
+    DKV.remove(model.key)
+    model.key = dest
+    DKV.put(dest, model)
+    return model
+
+
+def _exec_predict(model_key: str, frame_key: str, dest: str):
+    from h2o3_tpu.cluster.registry import DKV
+
+    model = DKV.get(model_key)
+    fr = DKV.get(frame_key)
+    out = model.predict(fr)
+    DKV.put(dest, out)
+    return out
+
+
+_COMMANDS = {
+    "parse": _exec_parse,
+    "build": _exec_build,
+    "predict": _exec_predict,
+}
+
+_SHUTDOWN = "__shutdown__"
+
+
+def run(cmd: str, **kwargs):
+    """Execute ``cmd`` on every process of the cloud (coordinator API).
+
+    Single-process clouds execute directly; multi-process clouds broadcast
+    first so followers enter the same program. Holding the lock for the whole
+    execution serializes device work — collective order must match on every
+    rank, and concurrent jobs on the coordinator would interleave it."""
+    if not multi_process():
+        return _COMMANDS[cmd](**kwargs)
+    if not is_coordinator():  # pragma: no cover - followers use follower_loop
+        raise RuntimeError("spmd.run is coordinator-only")
+    with _LOCK:
+        _bcast_bytes(pickle.dumps((cmd, kwargs)))
+        global _REPLICATED
+        _REPLICATED += 1
+        try:
+            return _COMMANDS[cmd](**kwargs)
+        finally:
+            _REPLICATED -= 1
+
+
+def shutdown_followers() -> None:
+    if multi_process() and is_coordinator():
+        with _LOCK:
+            _bcast_bytes(pickle.dumps((_SHUTDOWN, {})))
+
+
+def follower_loop() -> None:
+    """Run on every non-coordinator process: execute the coordinator's
+    command stream until shutdown. A failed command is fatal (fail-stop,
+    like an H2O node death — the cloud is not usable past divergence)."""
+    Log.info(f"spmd follower loop up (process {__import__('jax').process_index()})")
+    while True:
+        cmd, kwargs = pickle.loads(_bcast_bytes(None))
+        if cmd == _SHUTDOWN:
+            Log.info("spmd follower shutdown")
+            return
+        Log.info(f"spmd follower executing {cmd}")
+        global _REPLICATED
+        _REPLICATED += 1
+        try:
+            _COMMANDS[cmd](**kwargs)
+        finally:
+            _REPLICATED -= 1

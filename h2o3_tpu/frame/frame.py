@@ -109,7 +109,9 @@ class Vec:
             return self._host
         if self.kind == TIME and self._host is not None:
             return self._host
-        return np.asarray(jax.device_get(self.data))[: self.nrow]
+        from h2o3_tpu.parallel.mesh import pull_to_host
+
+        return np.asarray(pull_to_host(self.data))[: self.nrow]
 
     def levels(self) -> list[str]:
         return list(self.domain) if self.domain else []

@@ -654,6 +654,156 @@ def unique(v: Vec) -> Frame:
     return Frame.from_pandas(pd.DataFrame({v.name or "C1": vals}))
 
 
+def match(v: Vec, table: Sequence, nomatch: float = float("nan"), start_index: int = 1) -> Vec:
+    """``ASTMatch`` successor (R ``match`` / ``%in%``): position of each
+    value in ``table`` (``start_index``-based, H2O default 1), ``nomatch``
+    where absent. Enum vecs match on LABELS."""
+    if v.kind == CAT:
+        pos = {str(t): i for i, t in enumerate(table)}
+        dom_map = np.full(max(len(v.domain or ()), 1), -1, np.int64)
+        for i, d in enumerate(v.domain or ()):
+            if str(d) in pos:
+                dom_map[i] = pos[str(d)]
+        codes = v.to_numpy()
+        hit = np.where(codes >= 0, dom_map[np.clip(codes, 0, None).astype(np.int64)], -1)
+    elif v.kind == STR:
+        pos = {str(t): i for i, t in enumerate(table)}
+        hit = np.array([pos.get(str(s), -1) if s is not None else -1 for s in v._host])
+    else:
+        tbl = jnp.asarray(np.asarray(table, np.float32))
+        x = v.data[: v.nrow]
+        eq = x[:, None] == tbl[None, :]
+        hit = np.asarray(jnp.where(eq.any(axis=1), jnp.argmax(eq, axis=1), -1))
+    out = np.where(hit >= 0, hit + start_index, nomatch).astype(np.float64)
+    return Vec.from_numpy(out, NUM, name=v.name)
+
+
+def is_in(v: Vec, table: Sequence) -> Vec:
+    """R ``%in%``: 1.0 where the value occurs in ``table`` else 0.0."""
+    m = match(v, table, nomatch=0.0, start_index=1).to_numpy()
+    return Vec.from_numpy((m > 0).astype(np.float64), NUM, name=v.name)
+
+
+def which(v: Vec) -> Frame:
+    """``ASTWhich`` successor: 0-based row indices where the vec is true
+    (nonzero and non-NA), as a one-column frame — h2o.which semantics."""
+    x = v.to_numpy()
+    idx = np.flatnonzero(np.nan_to_num(x, nan=0.0) != 0)
+    return Frame.from_pandas(pd.DataFrame({v.name or "which": idx.astype(np.float64)}))
+
+
+def na_omit(frame: Frame) -> Frame:
+    """``ASTNaOmit`` successor: drop every row containing an NA (device
+    mask; payload gathered on device)."""
+    import functools
+
+    masks = []
+    for n in frame.names:
+        v = frame.vec(n)
+        if v.kind == STR:
+            masks.append(jnp.asarray(np.array([s is not None for s in v._host])))
+        elif v.kind == CAT:
+            masks.append(v.data[: v.nrow] >= 0)
+        else:
+            masks.append(~jnp.isnan(v.data[: v.nrow]))
+    ok = np.asarray(functools.reduce(jnp.logical_and, masks))
+    return frame.subset_rows(np.flatnonzero(ok))
+
+
+def rank_within_group_by(
+    frame: Frame,
+    group_by_cols: Sequence[str],
+    sort_cols: Sequence[str],
+    ascending: Sequence[bool] | bool = True,
+    new_col_name: str = "New_Rank_column",
+    sort_cols_sorted: bool = False,
+) -> Frame:
+    """``ASTRankWithinGroupBy`` successor (h2o.rank_within_group_by): dense
+    1-based rank of each row within its group, ordered by ``sort_cols``.
+
+    Device lexsort over (group keys, sort keys); rank = position within the
+    group run. NA sort-key rows keep rank NA like upstream. When
+    ``sort_cols_sorted`` the output rows come back sorted by the group+sort
+    order, else original row order."""
+    gcols = list(group_by_cols)
+    scols = list(sort_cols)
+    asc = [ascending] * len(scols) if isinstance(ascending, bool) else list(ascending)
+    keys = []
+    n_gkeys = len(gcols)
+    for n in gcols:
+        k = _key_codes_device(frame.vec(n))
+        if k is None:
+            raise ValueError(f"rank_within_group_by: unsupported key column {n!r}")
+        keys.append(k)  # int32 — f32 cannot represent bitcast codes exactly
+    na_mask = jnp.zeros(frame.nrow, bool)
+    for n, a in zip(scols, asc):
+        v = frame.vec(n)
+        k = v.data[: v.nrow]
+        if v.kind == CAT:
+            k = k.astype(jnp.float32)
+            na_mask = na_mask | (k < 0)
+        else:
+            na_mask = na_mask | jnp.isnan(k)
+        keys.append(k if a else -k)
+    order = jnp.lexsort(tuple(reversed(keys)))  # last key = primary
+    gsorted = jnp.stack([keys[i] for i in range(n_gkeys)], axis=1)[order]
+    if len(gcols):
+        new_grp = jnp.concatenate(
+            [jnp.ones(1, bool), jnp.any(gsorted[1:] != gsorted[:-1], axis=1)]
+        )
+    else:
+        new_grp = jnp.zeros(frame.nrow, bool).at[0].set(True)
+    pos = jnp.arange(frame.nrow, dtype=jnp.int32)
+    # rank within group = position - position of the group's first row
+    # (running max of group-start positions along the sorted order)
+    grp_start_run = jax.lax.cummax(jnp.where(new_grp, pos, 0))
+    rank_sorted = pos - grp_start_run + 1
+    ranks = jnp.zeros(frame.nrow, jnp.float32).at[order].set(
+        rank_sorted.astype(jnp.float32)
+    )
+    ranks = jnp.where(na_mask, jnp.float32(np.nan), ranks)
+    rank_vec = Vec.from_numpy(np.asarray(ranks, np.float64), NUM, name=new_col_name)
+    out = Frame(
+        [frame.vec(n) for n in frame.names] + [rank_vec],
+        list(frame.names) + [new_col_name],
+    )
+    if sort_cols_sorted:
+        return out.gather_rows(np.asarray(order))
+    return out
+
+
+def pivot(frame: Frame, index: str, column: str, value: str) -> Frame:
+    """``ASTPivot`` successor: long → wide. One output row per ``index``
+    value, one output column per ``column`` enum level, cells = mean of
+    ``value`` over the (index, level) pair (upstream averages duplicates)."""
+    cv = frame.vec(column)
+    if cv.kind != CAT:
+        raise ValueError("pivot: 'column' must be categorical")
+    agg = group_by(frame, [index, column]).agg({value: "mean"})
+    adf = agg.to_pandas()
+    vcol = f"mean_{value}"  # group_by agg naming convention
+    wide = adf.pivot(index=index, columns=column, values=vcol).reset_index()
+    wide.columns = [str(c) for c in wide.columns]
+    return Frame.from_pandas(wide)
+
+
+def stratified_split(y: Vec, test_frac: float = 0.2, seed: int = -1) -> Vec:
+    """``ASTStratifiedSplit`` successor (h2o.stratified_split): enum vec
+    'train'/'test' with ~``test_frac`` of EACH response class in 'test'."""
+    if y.kind != CAT:
+        raise ValueError("stratified_split needs a categorical response")
+    codes = y.to_numpy()
+    rng = np.random.default_rng(seed if seed and seed > 0 else None)
+    out = np.zeros(len(codes), np.int32)  # 0 = train, 1 = test
+    for k in np.unique(codes):
+        idx = np.flatnonzero(codes == k)
+        n_test = int(round(len(idx) * test_frac))
+        take = rng.permutation(len(idx))[:n_test]
+        out[idx[take]] = 1
+    out[codes < 0] = 0  # NA response rows go to train, like upstream
+    return Vec.from_numpy(out, CAT, name="test_train_split", domain=("train", "test"))
+
+
 def cut(v: Vec, breaks: Sequence[float], labels: Sequence[str] | None = None,
         include_lowest: bool = False, right: bool = True) -> Vec:
     """``ASTCut`` successor: numeric → enum by interval."""

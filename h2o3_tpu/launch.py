@@ -51,11 +51,17 @@ def main(argv=None) -> None:
     Log.info(f"process {args.process_id}/{args.num_processes} joined: {info}")
     if args.process_id == 0:
         h2o3_tpu.start_server(ip=args.ip, port=args.port)
-    try:
-        while True:  # serve until killed (fail-stop, like an H2O node)
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        pass
+        try:
+            while True:  # serve until killed (fail-stop, like an H2O node)
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    else:
+        # followers execute the coordinator's replicated command stream (the
+        # DTask successor) — every rank runs the same device programs
+        from h2o3_tpu.cluster.spmd import follower_loop
+
+        follower_loop()
 
 
 if __name__ == "__main__":

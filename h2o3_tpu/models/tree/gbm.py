@@ -587,7 +587,11 @@ def _metrics_from_F(dist, F, yn, wn, nrow, domain=None) -> MM.ModelMetrics:
     trees to re-derive F costs seconds on the tunneled TPU; the training
     loop already holds it. On accelerators the transformed scores stay on
     device (metrics.py reduces sufficient statistics there)."""
-    conv = (lambda x: x) if jax.default_backend() != "cpu" else np.asarray
+    conv = (
+        (lambda x: x)
+        if jax.default_backend() != "cpu" or jax.process_count() > 1
+        else np.asarray
+    )
     if dist == "multinomial":
         P = conv(jax.nn.softmax(F, axis=1))[:nrow]
         return MM.multinomial_metrics(

@@ -65,5 +65,37 @@ def pad_to_shards(n: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
 
 
 def shard_rows(arr, mesh: Mesh | None = None):
-    """Place a host array onto the mesh, sharded along the leading axis."""
-    return jax.device_put(arr, row_sharding(mesh))
+    """Place a host array onto the mesh, sharded along the leading axis.
+
+    On a multi-process cloud the mesh spans non-addressable devices; each
+    process holds the same full host array (SPMD command replication,
+    cluster/spmd.py) and contributes its addressable shards."""
+    sh = row_sharding(mesh)
+    if jax.process_count() > 1:
+        a = np.asarray(arr)
+        return jax.make_array_from_callback(a.shape, sh, lambda idx: a[idx])
+    return jax.device_put(arr, sh)
+
+
+def pull_to_host(x):
+    """Full host value of a (possibly cross-process) device array.
+
+    Fully-addressable arrays device_get directly. Cross-process sharded
+    arrays allgather — a COLLECTIVE: on a multi-process cloud this must run
+    inside replicated execution (every rank calls it at the same point),
+    which the spmd command layer guarantees for build/parse/predict."""
+    if getattr(x, "is_fully_addressable", True):
+        return jax.device_get(x)
+    from h2o3_tpu.cluster import spmd
+
+    if not spmd.in_replicated():
+        # an allgather entered by one rank alone deadlocks the cloud — fail
+        # fast instead (coordinator-only REST paths must stay off sharded
+        # data or go through spmd.run)
+        raise RuntimeError(
+            "host pull of a cross-process array outside replicated "
+            "execution (multi-process cloud): route through spmd.run"
+        )
+    from jax.experimental import multihost_utils as mh
+
+    return np.asarray(mh.process_allgather(x, tiled=True))

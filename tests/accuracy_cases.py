@@ -1,0 +1,135 @@
+"""Fixed-dataset accuracy cases — the ``h2o-test-accuracy/`` successor
+(SURVEY.md §4): each case trains a flagship config on a deterministic seeded
+dataset and reports metrics that are compared against stored expectations in
+``tests/accuracy_expectations.json``.
+
+Unlike the rest of the suite (which pins against sklearn computed at test
+time), these catch *silent metric drift* in our own engine with no runtime
+dependency on sklearn's behavior. Regenerate expectations deliberately with
+``python tools/gen_accuracy_expectations.py`` and review the diff.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def _classif_df(n=5000, c=8, seed=13):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    eta = 1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.6 * X[:, 2] * X[:, 3] + np.sin(X[:, 4])
+    y = rng.random(n) < 1 / (1 + np.exp(-eta))
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(c)])
+    # a categorical + some NAs so the cases exercise domains and NA paths
+    df["cat"] = pd.Categorical(np.where(X[:, 5] > 0.5, "a", np.where(X[:, 5] < -0.5, "b", "c")))
+    df.loc[:: 97, "f0"] = np.nan
+    df["label"] = np.where(y, "yes", "no")
+    return df
+
+
+def _regress_df(n=5000, c=8, seed=29):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    y = 2.0 * X[:, 0] + X[:, 1] ** 2 - 1.5 * X[:, 2] + 0.3 * rng.normal(size=n)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(c)])
+    df["y"] = y.astype(np.float32)
+    return df
+
+
+def run_cases(progress=None) -> dict[str, dict[str, float]]:
+    """Train every case and return {case: {metric: value}}."""
+    import sys
+
+    def _tick(name):
+        if progress:
+            print(f"[accuracy] {name}", file=sys.stderr, flush=True)
+    import h2o3_tpu
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.models.tree.drf import DRF
+    from h2o3_tpu.models.tree.xgboost import XGBoost
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.models.kmeans import KMeans
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    cls_fr = h2o3_tpu.upload_file(_classif_df())
+    reg_fr = h2o3_tpu.upload_file(_regress_df())
+    out: dict[str, dict[str, float]] = {}
+
+    _tick("gbm_binomial")
+    m = GBM(ntrees=20, max_depth=5, learn_rate=0.2, seed=42).train(
+        y="label", training_frame=cls_fr
+    )
+    out["gbm_binomial"] = {
+        "auc": m.training_metrics.auc,
+        "logloss": m.training_metrics.logloss,
+    }
+
+    _tick("gbm_gaussian")
+    m = GBM(ntrees=20, max_depth=5, learn_rate=0.2, seed=42).train(
+        y="y", training_frame=reg_fr
+    )
+    out["gbm_gaussian"] = {
+        "rmse": m.training_metrics.rmse,
+        "mae": m.training_metrics.mae,
+    }
+
+    _tick("xgboost_binomial")
+    m = XGBoost(ntrees=20, max_depth=5, seed=42).train(
+        y="label", training_frame=cls_fr
+    )
+    out["xgboost_binomial"] = {
+        "auc": m.training_metrics.auc,
+        "logloss": m.training_metrics.logloss,
+    }
+
+    _tick("drf_binomial")
+    m = DRF(ntrees=20, max_depth=8, seed=42).train(y="label", training_frame=cls_fr)
+    out["drf_binomial"] = {"auc": m.training_metrics.auc}
+
+    _tick("glm_binomial")
+    m = GLM(family="binomial", lambda_=1e-4, seed=42).train(
+        y="label", training_frame=cls_fr
+    )
+    out["glm_binomial"] = {
+        "auc": m.training_metrics.auc,
+        "logloss": m.training_metrics.logloss,
+    }
+
+    _tick("glm_gaussian")
+    m = GLM(family="gaussian", lambda_=1e-4, seed=42).train(
+        y="y", training_frame=reg_fr
+    )
+    out["glm_gaussian"] = {"rmse": m.training_metrics.rmse}
+
+    _tick("kmeans")
+    m = KMeans(k=5, seed=42, max_iterations=20).train(
+        x=[f"f{i}" for i in range(8)], training_frame=reg_fr
+    )
+    out["kmeans"] = {
+        "tot_withinss": m.output["tot_withinss"],
+        "totss": m.output["totss"],
+    }
+
+    _tick("deeplearning")
+    m = DeepLearning(
+        hidden=[16, 16], epochs=10, seed=42, reproducible=True
+    ).train(y="label", training_frame=cls_fr)
+    out["deeplearning_binomial"] = {"auc": m.training_metrics.auc}
+
+    return {
+        case: {k: float(v) for k, v in metrics.items()}
+        for case, metrics in out.items()
+    }
+
+
+# per-metric absolute tolerances: tight enough to catch drift, loose enough
+# for cross-jaxlib float jitter (f32 reductions reassociate across versions)
+TOLERANCES = {
+    "auc": 2e-3,
+    "logloss": 2e-3,
+    "rmse": 2e-3,
+    "mae": 2e-3,
+    "tot_withinss": 50.0,  # absolute SS on 5000x8 standardized-ish data
+    "totss": 50.0,
+}

@@ -1,0 +1,48 @@
+"""Stored-expectation accuracy regression suite — ``h2o-test-accuracy/``
+successor (SURVEY.md §4): flagship algos on fixed seeded datasets compared
+against checked-in expected metrics, with NO runtime sklearn dependency.
+
+On drift: either a bug crept in (fix it) or an intentional algorithm change
+moved metrics — then regenerate with ``python tools/gen_accuracy_expectations.py``
+and review the JSON diff.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from accuracy_cases import TOLERANCES, run_cases
+
+EXPECT = pathlib.Path(__file__).parent / "accuracy_expectations.json"
+
+
+@pytest.fixture(scope="module")
+def results():
+    return run_cases()
+
+
+def _expected():
+    return json.loads(EXPECT.read_text())
+
+
+def test_expectation_file_exists():
+    assert EXPECT.exists(), "regenerate with tools/gen_accuracy_expectations.py"
+
+
+@pytest.mark.parametrize("case", sorted(_expected()))
+def test_case_matches_expectation(results, case):
+    expected = _expected()[case]
+    assert case in results, f"case {case} no longer produced"
+    for metric, want in expected.items():
+        got = results[case][metric]
+        tol = TOLERANCES[metric]
+        assert got == pytest.approx(want, abs=tol), (
+            f"{case}.{metric}: got {got:.6f}, expected {want:.6f} ±{tol} — "
+            "if intentional, regenerate tests/accuracy_expectations.json"
+        )
+
+
+def test_no_unexpected_cases(results):
+    # a case added to accuracy_cases.py must also be captured in the JSON
+    assert set(results) == set(_expected())

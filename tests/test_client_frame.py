@@ -81,3 +81,15 @@ def test_lazy_ifelse_and_reuse(server, data):
     flag.refresh()
     assert flag._key is not None
     assert flag.sum() == s
+
+
+def test_lazy_match_in_na_omit(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    m = fr["grp"].match(["b", "a"])  # default nomatch=NaN must render
+    got = m.to_pandas().iloc[:, 0]
+    want = data["grp"].map({"b": 1, "a": 2})
+    assert (got.fillna(-1) == want.fillna(-1)).all()
+    flags = fr["grp"].isin(["a"]).to_pandas().iloc[:, 0]
+    assert (flags == (data["grp"] == "a").astype(float)).all()
+    no = fr.na_omit()
+    assert no.to_pandas().shape[0] <= len(data)

@@ -384,3 +384,79 @@ class TestDeviceJoin:
         srtd = ops.sort(fr, "v", ascending=False)
         v = srtd.vec("v").to_numpy()
         assert np.isnan(v[-1]) and v[0] == 4.0  # NaN last even descending
+
+
+class TestRapidsWave4:
+    """match/%in%/which/na.omit/rank_within_groupby/pivot/stratified_split —
+    the round-4 Rapids breadth additions (upstream ast/** classes)."""
+
+    def test_match_and_in(self):
+        df = pd.DataFrame({"g": ["a", "b", "c", "a", None], "v": [1.0, 2, 3, 2, 5]})
+        fr = h2o3_tpu.upload_file(df)
+        m = ops.match(fr.vec("g"), ["b", "a"]).to_numpy()
+        assert m[0] == 2 and m[1] == 1 and m[3] == 2  # 1-based positions
+        assert np.isnan(m[2]) and np.isnan(m[4])
+        i = ops.is_in(fr.vec("v"), [2, 5]).to_numpy()
+        assert i.tolist() == [0, 1, 0, 1, 1]
+
+    def test_which(self):
+        fr = h2o3_tpu.upload_file(pd.DataFrame({"v": [0.0, 1, 0, 2, np.nan, 3]}))
+        w = ops.which(fr.vec("v")).to_pandas().iloc[:, 0].tolist()
+        assert w == [1, 3, 5]
+
+    def test_na_omit(self):
+        df = pd.DataFrame({
+            "a": [1.0, np.nan, 3, 4], "g": ["x", "y", None, "x"], "s": ["p", "q", "r", None]
+        })
+        fr = h2o3_tpu.upload_file(df)
+        out = ops.na_omit(fr)
+        assert out.nrow == 1
+        assert out.vec("a").to_numpy()[0] == 1.0
+
+    def test_rank_within_group_by(self):
+        df = pd.DataFrame({
+            "g": ["a", "a", "b", "b", "a", "b"],
+            "v": [3.0, 1, 2, np.nan, 2, 1],
+        })
+        fr = h2o3_tpu.upload_file(df)
+        out = ops.rank_within_group_by(fr, ["g"], ["v"], new_col_name="rk")
+        rk = out.vec("rk").to_numpy()
+        # group a: v=3->3, v=1->1, v=2->2 ; group b: v=2->2, NaN->NA, v=1->1
+        assert rk[0] == 3 and rk[1] == 1 and rk[4] == 2
+        assert rk[2] == 2 and rk[5] == 1 and np.isnan(rk[3])
+
+    def test_pivot(self):
+        df = pd.DataFrame({
+            "id": [1.0, 1, 2, 2, 1],
+            "k": ["x", "y", "x", "y", "x"],
+            "v": [1.0, 2, 3, 4, 5],
+        })
+        fr = h2o3_tpu.upload_file(df)
+        out = ops.pivot(fr, "id", "k", "v").to_pandas().sort_values("id")
+        assert out[out.id == 1]["x"].iloc[0] == 3.0  # mean(1, 5)
+        assert out[out.id == 2]["y"].iloc[0] == 4.0
+
+    def test_stratified_split(self):
+        rng = np.random.default_rng(0)
+        y = np.where(rng.random(1000) < 0.1, "pos", "neg")
+        fr = h2o3_tpu.upload_file(pd.DataFrame({"y": y}))
+        sp = ops.stratified_split(fr.vec("y"), test_frac=0.25, seed=7)
+        codes = sp.to_numpy()
+        assert tuple(sp.domain) == ("train", "test")
+        for cls in ("pos", "neg"):
+            mask = y == cls
+            frac = (codes[mask] == 1).mean()
+            assert abs(frac - 0.25) < 0.02, cls
+
+    def test_rapids_strings(self):
+        from h2o3_tpu.api.rapids import rapids_eval
+        from h2o3_tpu.cluster.registry import DKV
+
+        df = pd.DataFrame({"g": ["a", "b", "a", "c"], "v": [1.0, 2, 3, 4]})
+        fr = h2o3_tpu.upload_file(df)
+        DKV.put("rw4", fr)
+        out = rapids_eval(f"(tmp= rw4_w (which (%in% (cols rw4 'g') ['a'])))")
+        w = DKV.get("rw4_w").to_pandas().iloc[:, 0].tolist()
+        assert w == [0, 2]
+        out2 = rapids_eval("(tmp= rw4_no (na.omit rw4))")
+        assert DKV.get("rw4_no").nrow == 4

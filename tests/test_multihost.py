@@ -98,3 +98,113 @@ def test_profiler_writes_trace(tmp_path):
     import glob
 
     assert glob.glob(logdir + "/**/*.xplane.pb", recursive=True)
+
+
+def test_launch_rest_train_across_two_processes(tmp_path):
+    """End-to-end multi-host: two launch.py processes form a cloud; a GBM
+    trains THROUGH REST with the spmd command replication executing the same
+    device programs on both ranks (VERDICT r3 item 3 / SURVEY §4 multi-node
+    row). Default tier: tiny shapes, 2 CPU devices per process."""
+    import json
+    import time
+    import urllib.request
+
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(1)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0)
+    df = pd.DataFrame(X, columns=["a", "b", "c"])
+    df["label"] = np.where(y, "p", "n")
+    csv = tmp_path / "mh.csv"
+    df.to_csv(csv, index=False)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rest_port = s.getsockname()[1]
+
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    logs = [open(tmp_path / f"proc{i}.log", "wb") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "h2o3_tpu.launch",
+             "--coordinator", f"127.0.0.1:{coord_port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--ip", "127.0.0.1", "--port", str(rest_port)],
+            stdout=logs[i], stderr=subprocess.STDOUT, cwd=repo, env=env,
+        )
+        for i in range(2)
+    ]
+
+    base = f"http://127.0.0.1:{rest_port}"
+
+    def req(method, path, data=None, timeout=60):
+        import urllib.parse
+
+        body = urllib.parse.urlencode(data).encode() if data else None
+        r = urllib.request.Request(base + path, data=body, method=method)
+        return json.loads(urllib.request.urlopen(r, timeout=timeout).read())
+
+    try:
+        # wait for the coordinator's REST to come up
+        deadline = time.time() + 120
+        cloud = None
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                cloud = req("GET", "/3/Cloud", timeout=5)
+                break
+            except Exception:
+                time.sleep(1.0)
+        assert cloud is not None, "REST coordinator never came up"
+        assert cloud["cloud_size"] == 4  # 2 procs x 2 devices
+
+        req("POST", "/3/ImportFiles", {"path": str(csv)})
+        req("POST", "/3/Parse", {"source_frames": str(csv),
+                                 "destination_frame": "mh"})
+        job = req("POST", "/3/ModelBuilders/gbm",
+                  {"training_frame": "mh", "response_column": "label",
+                   "ntrees": "3", "max_depth": "3", "seed": "7"})
+        jid = (job.get("job") or job)["key"]["name"]
+        deadline = time.time() + 240
+        status = None
+        while time.time() < deadline:
+            j = req("GET", f"/3/Jobs/{jid}")["jobs"][0]
+            status = j["status"]
+            if status in ("DONE", "FAILED", "CANCELLED"):
+                break
+            time.sleep(1.0)
+        assert status == "DONE", f"build ended {status}: {j.get('exception')}"
+        mkey = j["dest"]["name"]
+        mm = req("GET", f"/3/Models/{mkey}")["models"][0]
+        auc = mm["output"]["training_metrics"]["auc"]
+        assert auc > 0.8, auc
+
+        pred = req("POST", f"/3/Predictions/models/{mkey}/frames/mh", {})
+        assert pred["predictions_frame"]["name"]
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
+        for i in range(2):
+            sys.stderr.write(f"--- proc{i} log tail ---\n")
+            tail = (tmp_path / f"proc{i}.log").read_bytes()[-2000:]
+            sys.stderr.write(tail.decode(errors="replace") + "\n")
