@@ -104,9 +104,11 @@ def _frame_schema(fr: Frame, key: str) -> dict:
     for name in fr.names:
         v = fr.vec(name)
         # per-column device stats dispatch device programs; on a multi-process
-        # cloud that is only safe inside replicated execution — serve metadata
+        # cloud a REST thread doing that unreplicated deadlocks the ranks
+        # (and checking in_replicated() here would race a concurrent build
+        # job's flag) — serve metadata only there
         st = {}
-        if hasattr(v, "stats") and not (spmd.multi_process() and not spmd.in_replicated()):
+        if hasattr(v, "stats") and not spmd.multi_process():
             st = v.stats()
         cols.append({
             "label": name,
@@ -225,6 +227,8 @@ class Endpoints:
         for k in ("separator", "column_types", "column_names"):
             if params.get(k) is not None:
                 setup[k] = params[k] if not isinstance(params[k], str) or not params[k].startswith(("[", "{")) else json.loads(params[k])
+        if str(params.get("sharded", "")).lower() in ("1", "true"):
+            setup["sharded"] = True  # per-rank row-range ingest (parse_sharded)
         from h2o3_tpu.cluster import spmd
 
         job = Job(lambda j: spmd.run("parse", setup=setup, dest=dest),
@@ -249,6 +253,7 @@ class Endpoints:
         return {"__meta": {"schema_type": "Frames"}, "frames": [_frame_schema(fr, key)]}
 
     def frame_summary(self, params, key):
+        _spmd_v1_guard("Frame summary")
         fr = DKV.get(key)
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {key} not found")
@@ -370,6 +375,7 @@ class Endpoints:
 
     # -- grids (hex.grid.GridSearch REST surface, /99/Grid*) ---------------
     def grid_build(self, params, algo):
+        _spmd_v1_guard("Grid search")
         if algo not in _ALGOS:
             raise ApiError(404, f"unknown algo {algo!r}")
         cls = _builder_cls(algo)
@@ -509,6 +515,7 @@ class Endpoints:
 
     # -- automl -----------------------------------------------------------
     def automl_build(self, params):
+        _spmd_v1_guard("AutoML")
         from h2o3_tpu.automl import AutoML
 
         spec = params.get("build_control", {})

@@ -43,6 +43,21 @@ def in_replicated() -> bool:
     return _REPLICATED > 0
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def replicated_section():
+    """Mark a region as replicated execution for library users driving their
+    own multi-controller SPMD scripts (every rank must enter it together)."""
+    global _REPLICATED
+    _REPLICATED += 1
+    try:
+        yield
+    finally:
+        _REPLICATED -= 1
+
+
 def multi_process() -> bool:
     import jax
 
@@ -75,8 +90,10 @@ def _bcast_bytes(payload: bytes | None) -> bytes:
 
 
 def _exec_parse(setup: dict, dest: str):
-    from h2o3_tpu.frame.parse import parse
+    from h2o3_tpu.frame.parse import parse, parse_sharded
 
+    if setup.pop("sharded", False):
+        return parse_sharded(setup, destination_frame=dest)
     return parse(setup, destination_frame=dest)
 
 
@@ -143,18 +160,31 @@ def shutdown_followers() -> None:
 
 def follower_loop() -> None:
     """Run on every non-coordinator process: execute the coordinator's
-    command stream until shutdown. A failed command is fatal (fail-stop,
-    like an H2O node death — the cloud is not usable past divergence)."""
+    command stream until shutdown.
+
+    Deterministic command failures (bad path, bad params) raise IDENTICALLY
+    on every rank — the coordinator's Job catches its copy, so the follower
+    must survive too or one bad request would wedge the whole cloud. The
+    exception is logged and the loop continues; genuinely divergent state
+    (one rank fails mid-collective) surfaces as a collective mismatch and
+    remains fail-stop."""
     Log.info(f"spmd follower loop up (process {__import__('jax').process_index()})")
+    global _REPLICATED
     while True:
         cmd, kwargs = pickle.loads(_bcast_bytes(None))
         if cmd == _SHUTDOWN:
             Log.info("spmd follower shutdown")
             return
         Log.info(f"spmd follower executing {cmd}")
-        global _REPLICATED
         _REPLICATED += 1
         try:
             _COMMANDS[cmd](**kwargs)
+        except Exception:
+            import traceback
+
+            Log.err(
+                "spmd follower command failed (coordinator job fails with "
+                f"the same error):\n{traceback.format_exc()}"
+            )
         finally:
             _REPLICATED -= 1

@@ -670,7 +670,10 @@ def match(v: Vec, table: Sequence, nomatch: float = float("nan"), start_index: i
         pos = {str(t): i for i, t in enumerate(table)}
         hit = np.array([pos.get(str(s), -1) if s is not None else -1 for s in v._host])
     else:
-        tbl = jnp.asarray(np.asarray(table, np.float32))
+        # non-numeric table entries can never match a numeric vec: coerce to
+        # NaN (NaN != x for all x) instead of crashing, like R's match
+        tbl_np = pd.to_numeric(pd.Series(list(table)), errors="coerce").to_numpy(np.float32)
+        tbl = jnp.asarray(tbl_np)
         x = v.data[: v.nrow]
         eq = x[:, None] == tbl[None, :]
         hit = np.asarray(jnp.where(eq.any(axis=1), jnp.argmax(eq, axis=1), -1))
@@ -746,12 +749,12 @@ def rank_within_group_by(
             na_mask = na_mask | jnp.isnan(k)
         keys.append(k if a else -k)
     order = jnp.lexsort(tuple(reversed(keys)))  # last key = primary
-    gsorted = jnp.stack([keys[i] for i in range(n_gkeys)], axis=1)[order]
-    if len(gcols):
+    if n_gkeys:
+        gsorted = jnp.stack([keys[i] for i in range(n_gkeys)], axis=1)[order]
         new_grp = jnp.concatenate(
             [jnp.ones(1, bool), jnp.any(gsorted[1:] != gsorted[:-1], axis=1)]
         )
-    else:
+    else:  # no grouping: one global group
         new_grp = jnp.zeros(frame.nrow, bool).at[0].set(True)
     pos = jnp.arange(frame.nrow, dtype=jnp.int32)
     # rank within group = position - position of the group's first row

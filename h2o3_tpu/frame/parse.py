@@ -300,6 +300,164 @@ def parse_stream(
     return fr
 
 
+def parse_sharded(
+    setup: dict, destination_frame: str | None = None
+) -> Frame:
+    """Distributed ingest — the ``MultiFileParseTask`` successor proper
+    (``water/parser/ParseDataset.java`` [UNVERIFIED], SURVEY §2.1): on a
+    multi-process cloud EVERY process parses only ITS OWN row range of the
+    source and contributes its local device shards, so no single host ever
+    materializes the whole table (Higgs-1B cannot pass through one host's
+    pandas). Categorical domains are interned per-rank and unified in a
+    second pass (an allgather of the small per-rank level sets), mirroring
+    upstream's two-pass domain unification.
+
+    v1 scope: one plain CSV path; numeric / enum / int columns (strings are
+    host-resident and would defeat the point; TIME needs exact f64 host
+    copies). Runs fine on a single process too (degenerate 1-range case).
+    Must execute on every rank (spmd command or replicated section).
+    """
+    import pickle
+
+    import jax
+
+    from h2o3_tpu.parallel.mesh import get_mesh, pad_to_shards, row_sharding
+
+    paths = setup["source_frames"]
+    if len(paths) != 1 or not str(paths[0]).endswith(".csv"):
+        raise ValueError("sharded parse v1 handles exactly one plain .csv")
+    path = str(paths[0])
+    P = jax.process_count()
+    r = jax.process_index()
+
+    # row count: one streaming newline scan (O(1) memory, every rank)
+    newlines = 0
+    last = b"\n"
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 22)
+            if not block:
+                break
+            newlines += block.count(b"\n")
+            last = block[-1:]
+    total_lines = newlines + (0 if last == b"\n" else 1)
+    n = max(total_lines - 1, 0)  # minus header
+
+    # identical sniff on every rank (deterministic kinds)
+    sep = setup.get("separator") or _sniff_sep(path)
+    sample = pd.read_csv(path, sep=sep, nrows=1000)
+    col_order = [str(c) for c in sample.columns]
+    ctypes = setup.get("column_types") or {}
+    kinds = {}
+    for c in col_order:
+        k = ctypes.get(c) or infer_kind(sample[c])
+        k = {"numeric": NUM, "float": NUM, "double": NUM,
+             "factor": CAT, "categorical": CAT}.get(k, k)
+        if k in (STR, TIME):
+            raise ValueError(
+                f"sharded parse v1 does not support {k} column {c!r} "
+                "(host-resident / needs exact f64)"
+            )
+        kinds[c] = k
+
+    npad = pad_to_shards(n)
+    from h2o3_tpu.parallel.mesh import get_mesh as _gm
+
+    mesh0 = _gm()
+    flat = list(mesh0.devices.flat)
+    rows_per_dev = npad // len(flat)
+    positions = [i for i, d in enumerate(flat) if d.process_index == r]
+    assert positions == list(range(positions[0], positions[-1] + 1)), (
+        "sharded parse requires process-contiguous mesh devices"
+    )
+    per = len(positions) * rows_per_dev  # this rank's row block
+    lo = min(positions[0] * rows_per_dev, n)
+    hi = min(positions[0] * rows_per_dev + per, n)
+    local = pd.read_csv(
+        path, sep=sep,
+        skiprows=range(1, lo + 1), nrows=max(hi - lo, 0),
+        header=0, names=col_order,
+    )
+
+    # per-rank categorical interning, then the global union pass
+    local_domains: dict[str, list] = {}
+    local_codes: dict[str, np.ndarray] = {}
+    for c in col_order:
+        if kinds[c] == CAT:
+            codes, levels = pd.factorize(
+                local[c].astype(str).where(local[c].notna(), None)
+            )
+            local_domains[c] = [str(v) for v in levels]
+            local_codes[c] = codes.astype(np.int32)
+
+    if P > 1:
+        from jax.experimental import multihost_utils as mh
+
+        raw = pickle.dumps(local_domains)
+        cap = 1 << 20
+        if len(raw) > cap:
+            raise ValueError("sharded parse: categorical domains exceed 1MB")
+        buf = np.zeros(cap + 4, np.uint8)
+        buf[:4] = np.frombuffer(np.int32(len(raw)).tobytes(), np.uint8)
+        buf[4 : 4 + len(raw)] = np.frombuffer(raw, np.uint8)
+        gathered = np.asarray(mh.process_allgather(buf))
+        all_domains = []
+        for row in gathered:
+            ln = int(np.frombuffer(row[:4].tobytes(), np.int32)[0])
+            all_domains.append(pickle.loads(row[4 : 4 + ln].tobytes()))
+    else:
+        all_domains = [local_domains]
+
+    union: dict[str, list] = {}
+    for doms in all_domains:  # rank order → deterministic union on all ranks
+        for c, levels in doms.items():
+            seen = union.setdefault(c, [])
+            have = set(seen)
+            seen.extend(lv for lv in levels if lv not in have)
+    for c in union:
+        union[c] = sorted(union[c])  # H2O interns levels sorted
+
+    mesh = mesh0
+    sh = row_sharding(mesh)
+    local_devs = [flat[i] for i in positions]
+    dev_rows = rows_per_dev
+
+    def _global_from_local(block: np.ndarray, dtype):
+        block = np.asarray(block, dtype)
+        parts = [
+            jax.device_put(block[i * dev_rows : (i + 1) * dev_rows], d)
+            for i, d in enumerate(local_devs)
+        ]
+        return jax.make_array_from_single_device_arrays((npad,), sh, parts)
+
+    vecs: list[Vec] = []
+    for c in col_order:
+        k = kinds[c]
+        if k == CAT:
+            lut = {lv: i for i, lv in enumerate(union[c])}
+            remap = np.array(
+                [lut[lv] for lv in local_domains[c]] or [0], np.int32
+            )
+            codes = np.full(per, -1, np.int32)
+            lc = local_codes[c]
+            codes[: len(lc)] = np.where(lc >= 0, remap[np.clip(lc, 0, None)], -1)
+            data = _global_from_local(codes, np.int32)
+            vecs.append(Vec(data, CAT, name=c, domain=tuple(union[c]), nrow=n))
+        else:
+            vals = np.full(per, np.nan, np.float32)
+            got = pd.to_numeric(local[c], errors="coerce").to_numpy(np.float32)
+            vals[: len(got)] = got
+            data = _global_from_local(vals, np.float32)
+            vecs.append(Vec(data, INT if k == INT else NUM, name=c, nrow=n))
+
+    fr = Frame(vecs, col_order, key=destination_frame, register=True)
+    Log.info(
+        f"Shard-parsed {fr.nrow} rows x {fr.ncol} cols into {fr.key} "
+        f"(rank {r}/{P} read rows [{lo}, {hi}))"
+    )
+    return fr
+
+
 def parse(setup: dict, destination_frame: str | None = None) -> Frame:
     """Materialize a frame from a setup dict — the ``POST /3/Parse`` successor.
 

@@ -22,8 +22,10 @@ def _classif_df(n=5000, c=8, seed=13):
     y = rng.random(n) < 1 / (1 + np.exp(-eta))
     df = pd.DataFrame(X, columns=[f"f{i}" for i in range(c)])
     # a categorical + some NAs so the cases exercise domains and NA paths
-    df["cat"] = pd.Categorical(np.where(X[:, 5] > 0.5, "a", np.where(X[:, 5] < -0.5, "b", "c")))
-    df.loc[:: 97, "f0"] = np.nan
+    df["cat"] = pd.Categorical(
+        np.where(X[:, 5] > 0.5, "a", np.where(X[:, 5] < -0.5, "b", "c"))
+    )
+    df.loc[::97, "f0"] = np.nan
     df["label"] = np.where(y, "yes", "no")
     return df
 
@@ -37,90 +39,105 @@ def _regress_df(n=5000, c=8, seed=29):
     return df
 
 
-def run_cases(progress=None) -> dict[str, dict[str, float]]:
-    """Train every case and return {case: {metric: value}}."""
-    import sys
-
-    def _tick(name):
-        if progress:
-            print(f"[accuracy] {name}", file=sys.stderr, flush=True)
-    import h2o3_tpu
+def _case_gbm_binomial(cls_fr, reg_fr):
     from h2o3_tpu.models.tree.gbm import GBM
-    from h2o3_tpu.models.tree.drf import DRF
-    from h2o3_tpu.models.tree.xgboost import XGBoost
-    from h2o3_tpu.models.glm import GLM
-    from h2o3_tpu.models.kmeans import KMeans
-    from h2o3_tpu.models.deeplearning import DeepLearning
 
-    cls_fr = h2o3_tpu.upload_file(_classif_df())
-    reg_fr = h2o3_tpu.upload_file(_regress_df())
-    out: dict[str, dict[str, float]] = {}
-
-    _tick("gbm_binomial")
     m = GBM(ntrees=20, max_depth=5, learn_rate=0.2, seed=42).train(
         y="label", training_frame=cls_fr
     )
-    out["gbm_binomial"] = {
-        "auc": m.training_metrics.auc,
-        "logloss": m.training_metrics.logloss,
-    }
+    return {"auc": m.training_metrics.auc, "logloss": m.training_metrics.logloss}
 
-    _tick("gbm_gaussian")
+
+def _case_gbm_gaussian(cls_fr, reg_fr):
+    from h2o3_tpu.models.tree.gbm import GBM
+
     m = GBM(ntrees=20, max_depth=5, learn_rate=0.2, seed=42).train(
         y="y", training_frame=reg_fr
     )
-    out["gbm_gaussian"] = {
-        "rmse": m.training_metrics.rmse,
-        "mae": m.training_metrics.mae,
-    }
+    return {"rmse": m.training_metrics.rmse, "mae": m.training_metrics.mae}
 
-    _tick("xgboost_binomial")
+
+def _case_xgboost_binomial(cls_fr, reg_fr):
+    from h2o3_tpu.models.tree.xgboost import XGBoost
+
     m = XGBoost(ntrees=20, max_depth=5, seed=42).train(
         y="label", training_frame=cls_fr
     )
-    out["xgboost_binomial"] = {
-        "auc": m.training_metrics.auc,
-        "logloss": m.training_metrics.logloss,
-    }
+    return {"auc": m.training_metrics.auc, "logloss": m.training_metrics.logloss}
 
-    _tick("drf_binomial")
+
+def _case_drf_binomial(cls_fr, reg_fr):
+    from h2o3_tpu.models.tree.drf import DRF
+
     m = DRF(ntrees=20, max_depth=8, seed=42).train(y="label", training_frame=cls_fr)
-    out["drf_binomial"] = {"auc": m.training_metrics.auc}
+    return {"auc": m.training_metrics.auc}
 
-    _tick("glm_binomial")
+
+def _case_glm_binomial(cls_fr, reg_fr):
+    from h2o3_tpu.models.glm import GLM
+
     m = GLM(family="binomial", lambda_=1e-4, seed=42).train(
         y="label", training_frame=cls_fr
     )
-    out["glm_binomial"] = {
-        "auc": m.training_metrics.auc,
-        "logloss": m.training_metrics.logloss,
-    }
+    return {"auc": m.training_metrics.auc, "logloss": m.training_metrics.logloss}
 
-    _tick("glm_gaussian")
+
+def _case_glm_gaussian(cls_fr, reg_fr):
+    from h2o3_tpu.models.glm import GLM
+
     m = GLM(family="gaussian", lambda_=1e-4, seed=42).train(
         y="y", training_frame=reg_fr
     )
-    out["glm_gaussian"] = {"rmse": m.training_metrics.rmse}
+    return {"rmse": m.training_metrics.rmse}
 
-    _tick("kmeans")
+
+def _case_kmeans(cls_fr, reg_fr):
+    from h2o3_tpu.models.kmeans import KMeans
+
     m = KMeans(k=5, seed=42, max_iterations=20).train(
         x=[f"f{i}" for i in range(8)], training_frame=reg_fr
     )
-    out["kmeans"] = {
-        "tot_withinss": m.output["tot_withinss"],
-        "totss": m.output["totss"],
-    }
+    mm = m.training_metrics
+    return {"tot_withinss": mm._v["tot_withinss"], "totss": mm._v["totss"]}
 
-    _tick("deeplearning")
-    m = DeepLearning(
-        hidden=[16, 16], epochs=10, seed=42, reproducible=True
-    ).train(y="label", training_frame=cls_fr)
-    out["deeplearning_binomial"] = {"auc": m.training_metrics.auc}
 
-    return {
-        case: {k: float(v) for k, v in metrics.items()}
-        for case, metrics in out.items()
-    }
+def _case_deeplearning_binomial(cls_fr, reg_fr):
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    m = DeepLearning(hidden=[16, 16], epochs=10, seed=42, reproducible=True).train(
+        y="label", training_frame=cls_fr
+    )
+    return {"auc": m.training_metrics.auc}
+
+
+_CASES = {
+    "gbm_binomial": _case_gbm_binomial,
+    "gbm_gaussian": _case_gbm_gaussian,
+    "xgboost_binomial": _case_xgboost_binomial,
+    "drf_binomial": _case_drf_binomial,
+    "glm_binomial": _case_glm_binomial,
+    "glm_gaussian": _case_glm_gaussian,
+    "kmeans": _case_kmeans,
+    "deeplearning_binomial": _case_deeplearning_binomial,
+}
+
+
+def run_cases(progress=None, cases=None) -> dict[str, dict[str, float]]:
+    """Train the requested cases (default: all); {case: {metric: value}}."""
+    import sys
+
+    import h2o3_tpu
+
+    cls_fr = h2o3_tpu.upload_file(_classif_df())
+    reg_fr = h2o3_tpu.upload_file(_regress_df())
+    names = list(_CASES) if cases is None else [c for c in _CASES if c in set(cases)]
+    out = {}
+    for name in names:
+        if progress:
+            print(f"[accuracy] {name}", file=sys.stderr, flush=True)
+        metrics = _CASES[name](cls_fr, reg_fr)
+        out[name] = {k: float(v) for k, v in metrics.items()}
+    return out
 
 
 # per-metric absolute tolerances: tight enough to catch drift, loose enough

@@ -28,6 +28,52 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Tier control (SURVEY §4 test-size tiers; VERDICT r3 item 7): the default
+# tier must stay under ~5 minutes so driver/CI timeouts never hit it. The
+# heavyweight scenario/quality tests below run in the slow (nightly-style)
+# tier: `pytest -m "" tests/`. Centralized here, measured from
+# `--durations` on the build box — every family keeps at least one smoke in
+# the default tier (gbm auc, mojo parity, client estimator, DL xor,
+# multihost REST e2e, NA handling all stay).
+_SLOW_BY_NAME = {
+    "test_drf_multinomial",
+    "test_calibrate_model_platt_and_isotonic",
+    "test_rulefit_binomial_and_linear_only",
+    "test_rulefit_recovers_rules",
+    "test_full_flow_over_client",
+    "test_hist_subtraction_matches_direct",
+    "test_stacked_ensemble_beats_or_matches_base_models",
+    "test_stacked_ensemble_regression",
+    "test_wave3_algos_build_over_rest",
+    "test_native_scorer_bit_identical_to_numpy",
+    "test_sklearn_proba_aligns_with_classes_for_numeric_labels",
+    "test_gbm_multinomial",
+    "test_calibration_survives_mojo_export",
+    "test_pojo_standalone_scoring",
+    "test_grid_parallel_respects_max_models",
+    "test_grid_parallelism_matches_sequential",
+    "test_scanned_chunk_builder_matches_loop_quality",
+    "test_gbm_early_stopping",
+    "test_dl_regression",
+    "test_dl_reproducible",
+    "test_bin_code_equality_device_vs_mojo",
+    "test_gbm_sampling_reproducible",
+    "test_gbm_poisson",
+    "test_varimp_and_heatmap",
+    "test_drf_mojo_parity",
+    "test_gbm_varimp_ranks_informative_feature",
+    "test_cartesian_grid_covers_product_and_ranks",
+    "test_drf_checkpoint_adds_trees",
+    "test_gbm_regression_beats_baseline_and_tracks_sklearn",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW_BY_NAME:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session", autouse=True)
 def cloud():
