@@ -36,6 +36,9 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "", "extra Host header names accepted for state-changing REST "
         "requests (comma list; '*' disables the CSRF/rebinding guard)"),
     "H2O3_TPU_LOG_LEVEL": ("INFO", "default log level"),
+    "H2O3_TPU_FUSED_MAX_DEPTH": (
+        "20", "deepest tree the whole-tree fused program is built for; "
+              "beyond it the per-level dispatch loop takes over"),
     "H2O3_TPU_COMPILE_CACHE": ("", "XLA compile-cache dir ('' = <pkg>/.jax_cache)"),
 }
 

@@ -1041,11 +1041,19 @@ def build_tree(
         return tree, preds, varimp
 
     # On accelerators, build the WHOLE tree in one dispatch (tunnel-latency
-    # amortization; no early-exit polling is possible, acceptable up to
-    # moderate depth). On CPU — and for very deep trees, where an unrolled
-    # program would compile for minutes and dead-level dispatch is cheap —
-    # keep the per-level loop with early-exit polling.
-    fused = jax.default_backend() != "cpu" and max_depth <= 12
+    # amortization; no early-exit polling is possible). Depth-20 DRF — the
+    # H2O default regime — stays fused: the frontier is node_cap-bounded, so
+    # deep levels cost MXU tiles, not exponent, and 21 unrolled levels beat
+    # 21 × ~66 ms dispatch gaps per tree through the tunnel. On CPU — and
+    # past the knob, where an unrolled program would compile for minutes and
+    # dead-level dispatch is cheap — keep the per-level loop with early-exit
+    # polling.
+    from h2o3_tpu import config as _config
+
+    fused = (
+        jax.default_backend() != "cpu"
+        and max_depth <= _config.get_int("H2O3_TPU_FUSED_MAX_DEPTH")
+    )
     if fused:
         prog = _tree_program(max_depth, n_bins, node_cap, cat_cols)
         _, preds, varimp, records = prog(
