@@ -302,9 +302,15 @@ def _bench_join_10m() -> dict:
     t0 = time.time()
     out = ops.merge(left, right, by=["k"])
     dt = time.time() - t0
-    return {"left_rows": 10_000_000, "right_rows": 1_000_000,
-            "out_rows": out.nrow, "seconds": round(dt, 3),
-            "rows_per_sec": round(out.nrow / dt, 0)}
+    res = {"left_rows": 10_000_000, "right_rows": 1_000_000,
+           "out_rows": out.nrow, "seconds": round(dt, 3),
+           "rows_per_sec": round(out.nrow / dt, 0)}
+    from h2o3_tpu.cluster.registry import DKV
+
+    for fr in (left, right):  # free HBM before the phase breakdown runs
+        DKV.remove(fr.key)
+    del left, right, out
+    return res
 
 
 def main() -> None:

@@ -159,7 +159,9 @@ class DRF(ModelBuilder):
             if Fv is not None:
                 rawv = prior._replay_all_dev(valid)
                 Fv = [rawv[:, k] for k in range(K)] if n_out > 1 else [rawv]
-            if jax.default_backend() == "cpu" or p.max_depth > 12:
+            from h2o3_tpu.models.tree.shared_tree import use_fused_trees
+
+            if not use_fused_trees(p.max_depth):
                 # only the per-tree loop consumes the split chain; the
                 # scanned path keys by global tree id off the pristine key
                 for _ in range(start_trees):
@@ -170,18 +172,11 @@ class DRF(ModelBuilder):
         # keyed by the shared row_key so all K class-trees of iteration m
         # draw the SAME bootstrap (H2O semantics), while column/level
         # randomness differs per class.
-        # Same depth guard as build_tree's fused path: an unrolled program
-        # past ~12 levels (node_cap histograms each) compiles for minutes.
-        from h2o3_tpu import config as _config
+        # depth policy lives in use_fused_trees (depth-20 DRF — the H2O
+        # default regime — stays on the scanned path, VERDICT r3 weak #7)
+        from h2o3_tpu.models.tree.shared_tree import use_fused_trees
 
-        # depth-20 DRF (the H2O default regime) stays on the scanned path:
-        # node_cap bounds the frontier so deep levels cost tiles, not 2^d,
-        # and per-level dispatch through the tunnel is the regime the fused
-        # builder exists to avoid (VERDICT r3 weak #7)
-        use_scan = (
-            jax.default_backend() != "cpu"
-            and p.max_depth <= _config.get_int("H2O3_TPU_FUSED_MAX_DEPTH")
-        )
+        use_scan = use_fused_trees(p.max_depth)
         if use_scan:
             from h2o3_tpu.models.tree.shared_tree import (
                 build_trees_scanned,

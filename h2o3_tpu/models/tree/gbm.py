@@ -343,39 +343,6 @@ class GBM(ModelBuilder):
                 else None
             )
 
-        start_trees = 0
-        if prior is not None:
-            # continue exactly where the prior model stopped: its init score,
-            # its trees replayed into F (identical bin spec), its varimp
-            f0 = prior.output["init_f"]
-            raw = prior._replay_all_dev(train)
-            if dist == "multinomial":
-                F = jnp.asarray(np.asarray(f0))[None, :] + offset[:, None] + raw
-            else:
-                F = jnp.full(npad, np.float32(f0)) + offset + raw
-            trees.extend([list(g) for g in prior.output["trees"]])
-            varimp_dev = jnp.asarray(np.asarray(prior.output["varimp"], np.float32))
-            start_trees = prior.output["ntrees_actual"]
-            if Fv is not None:
-                rawv = prior._replay_all_dev(valid)
-                if dist == "multinomial":
-                    Fv = [
-                        jnp.full(bins_v.shape[0], f0[k], jnp.float32) + offset_v + rawv[:, k]
-                        for k in range(K)
-                    ]
-                else:
-                    Fv = [jnp.full(bins_v.shape[0], np.float32(f0)) + offset_v + rawv]
-            if p.sample_rate < 1.0 and (
-                dist == "multinomial" or jax.default_backend() == "cpu"
-            ):
-                # advance the per-tree loop's split chain so continuation
-                # equals an uninterrupted run; the scanned path keys by the
-                # global tree id off the PRISTINE key and must not advance
-                for _ in range(start_trees):
-                    rngkey, _ = jax.random.split(rngkey)
-
-        lr = p.learn_rate * (p.learn_rate_annealing**start_trees)
-
         # Chunk-scanned path: build a whole scoring interval of trees in ONE
         # device dispatch (see build_trees_scanned — on the tunneled TPU,
         # dispatch latency dominates once any D2H transfer has happened).
@@ -405,8 +372,42 @@ class GBM(ModelBuilder):
             if not mono_vec.any():
                 mono_vec = None
 
-        use_scan = (dist != "multinomial" and jax.default_backend() != "cpu"
+        from h2o3_tpu.models.tree.shared_tree import use_fused_trees
+
+        use_scan = (dist != "multinomial" and use_fused_trees(p.max_depth)
                     and mono_vec is None)
+
+        start_trees = 0
+        if prior is not None:
+            # continue exactly where the prior model stopped: its init score,
+            # its trees replayed into F (identical bin spec), its varimp
+            f0 = prior.output["init_f"]
+            raw = prior._replay_all_dev(train)
+            if dist == "multinomial":
+                F = jnp.asarray(np.asarray(f0))[None, :] + offset[:, None] + raw
+            else:
+                F = jnp.full(npad, np.float32(f0)) + offset + raw
+            trees.extend([list(g) for g in prior.output["trees"]])
+            varimp_dev = jnp.asarray(np.asarray(prior.output["varimp"], np.float32))
+            start_trees = prior.output["ntrees_actual"]
+            if Fv is not None:
+                rawv = prior._replay_all_dev(valid)
+                if dist == "multinomial":
+                    Fv = [
+                        jnp.full(bins_v.shape[0], f0[k], jnp.float32) + offset_v + rawv[:, k]
+                        for k in range(K)
+                    ]
+                else:
+                    Fv = [jnp.full(bins_v.shape[0], np.float32(f0)) + offset_v + rawv]
+            if p.sample_rate < 1.0 and not use_scan:
+                # advance the per-tree loop's split chain so continuation
+                # equals an uninterrupted run; the scanned path keys by the
+                # global tree id off the PRISTINE key and must not advance
+                for _ in range(start_trees):
+                    rngkey, _ = jax.random.split(rngkey)
+
+        lr = p.learn_rate * (p.learn_rate_annealing**start_trees)
+
         if use_scan:
             from h2o3_tpu.models.tree.shared_tree import (
                 build_trees_scanned,

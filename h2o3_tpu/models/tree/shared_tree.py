@@ -526,6 +526,21 @@ def _subtract_enabled() -> bool:
     return config.get_bool("H2O3_TPU_HIST_SUBTRACT")
 
 
+def use_fused_trees(max_depth: int) -> bool:
+    """Single policy for every fused/scanned-tree selector (build_tree, GBM
+    and DRF scan paths): accelerators up to H2O3_TPU_FUSED_MAX_DEPTH (the
+    node_cap-bounded frontier keeps deep levels at tile cost; one dispatch
+    per tree beats per-level dispatch gaps through the tunnel). CPU — and
+    depths past the knob, where the unrolled program would compile for
+    minutes while dead-level dispatch is cheap — use the per-level loop."""
+    from h2o3_tpu import config
+
+    return (
+        jax.default_backend() != "cpu"
+        and max_depth <= config.get_int("H2O3_TPU_FUSED_MAX_DEPTH")
+    )
+
+
 # ---------------------------------------------------------------------------
 # monotone-constraint variant of the level step (GBM monotone_constraints).
 # Kept separate so the unconstrained hot path compiles byte-identical; used
@@ -1040,20 +1055,7 @@ def build_tree(
                 break
         return tree, preds, varimp
 
-    # On accelerators, build the WHOLE tree in one dispatch (tunnel-latency
-    # amortization; no early-exit polling is possible). Depth-20 DRF — the
-    # H2O default regime — stays fused: the frontier is node_cap-bounded, so
-    # deep levels cost MXU tiles, not exponent, and 21 unrolled levels beat
-    # 21 × ~66 ms dispatch gaps per tree through the tunnel. On CPU — and
-    # past the knob, where an unrolled program would compile for minutes and
-    # dead-level dispatch is cheap — keep the per-level loop with early-exit
-    # polling.
-    from h2o3_tpu import config as _config
-
-    fused = (
-        jax.default_backend() != "cpu"
-        and max_depth <= _config.get_int("H2O3_TPU_FUSED_MAX_DEPTH")
-    )
+    fused = use_fused_trees(max_depth)
     if fused:
         prog = _tree_program(max_depth, n_bins, node_cap, cat_cols)
         _, preds, varimp, records = prog(
