@@ -154,13 +154,18 @@ const render = {
     try { algos = Object.keys((await api('GET', '/3/ModelBuilders')).model_builders); } catch (e) {}
     s.innerHTML = `<div class="panel">
       <div class="row"><b>Algorithm:</b>
-        <select id="balgo">${algos.map(a => `<option>${esc(a)}</option>`).join('')}</select>
+        <select id="balgo" onchange="loadBuildForm()">${algos.map(a => `<option>${esc(a)}</option>`).join('')}</select>
         <b>Training frame:</b> <input id="bframe" placeholder="frame key">
         <b>Response:</b> <input id="by" size="12" placeholder="y"></div>
-      <p class="muted">Extra parameters (JSON) — exactly what the REST schema takes:</p>
-      <textarea id="bparams" rows="4">{"ntrees": 50}</textarea>
+      <p class="muted">Parameters (schema-generated from the live
+        /3/ModelBuilders/{algo} metadata — the Flow "assist" form; values
+        left at their defaults are not sent):</p>
+      <div id="bform" style="max-height:260px;overflow:auto"></div>
+      <p class="muted">Extra parameters (JSON) — merged over the form:</p>
+      <textarea id="bparams" rows="2">{}</textarea>
       <p><button class="act" onclick="buildModel()">Build</button>
       <span id="bmsg" class="muted"></span></p></div>`;
+    loadBuildForm();
   },
   async AutoML() {
     const s = sections.AutoML;
@@ -219,11 +224,33 @@ window.predict = async () => {
     setMsg(el, 'ok', `→ ${j.predictions_frame.name || j.predictions_frame}`);
   } catch (e) { setMsg(el, 'err', e); }
 };
+window.loadBuildForm = async () => {
+  const algo = document.getElementById('balgo').value;
+  const box = document.getElementById('bform');
+  try {
+    const meta = await api('GET', `/3/ModelBuilders/${encodeURIComponent(algo)}`);
+    const ps = (meta.model_builders[algo] || {}).parameters || [];
+    const skip = new Set(['response_column', 'training_frame',
+                          'validation_frame', 'ignored_columns']);
+    box.innerHTML = `<table>${ps.filter(p => !skip.has(p.name)).map(p =>
+      `<tr><td class="muted">${esc(p.name)}</td><td>
+         <input size="14" data-param="${esc(p.name)}"
+           data-default="${esc(p.default_value ?? '')}"
+           value="${esc(p.default_value ?? '')}">
+       </td><td class="muted">${esc(p.type)}</td></tr>`).join('')}</table>`;
+  } catch (e) { setMsg(box, 'err', e); }
+};
 window.buildModel = async () => {
   const el = document.getElementById('bmsg');
   try {
     el.textContent = 'building…';
     const body = JSON.parse(document.getElementById('bparams').value || '{}');
+    for (const inp of document.querySelectorAll('#bform input[data-param]')) {
+      if (inp.value !== inp.dataset.default && inp.value !== '' &&
+          !(inp.dataset.param in body)) {
+        body[inp.dataset.param] = inp.value;
+      }
+    }
     body.training_frame = document.getElementById('bframe').value;
     body.response_column = document.getElementById('by').value;
     const algo = document.getElementById('balgo').value;

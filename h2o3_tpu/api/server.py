@@ -143,6 +143,7 @@ def _model_schema(m) -> dict:
             "cross_validation_metrics": m.cross_validation_metrics.to_dict()
             if m.cross_validation_metrics else None,
             "variable_importances": m.varimp() if hasattr(m, "varimp") else None,
+            "scoring_history": m.scoring_history,
         },
         "run_time_ms": m.run_time_ms,
     }
@@ -316,6 +317,34 @@ class Endpoints:
         return {"__meta": {"schema_type": "ModelBuilders"},
                 "model_builders": {a: {"algo": a, "visibility": "Stable"} for a in _ALGOS}}
 
+    def model_builder_get(self, params, algo):
+        """``GET /3/ModelBuilders/{algo}`` — the parameter schema (upstream
+        returns the reflective Schema metadata here; the params dataclass is
+        our single schema source, SURVEY §5.6). Flow's build forms render
+        from this."""
+        import dataclasses
+
+        if algo not in _ALGOS:
+            raise ApiError(404, f"unknown algo {algo!r}")
+        cls = _builder_cls(algo)
+        fields = []
+        for f in dataclasses.fields(cls.PARAMS_CLS):
+            default = f.default
+            if default is dataclasses.MISSING:  # incl. default_factory fields
+                default = None
+            if isinstance(default, float) and (default != default or default in (float("inf"), float("-inf"))):
+                default = None
+            fields.append({
+                "name": f.name,
+                "type": getattr(f.type, "__name__", str(f.type)),
+                "default_value": default if isinstance(default, (int, float, str, bool, type(None))) else str(default),
+            })
+        aliases = dict(getattr(cls, "PARAM_ALIASES", {}) or {})
+        return {"__meta": {"schema_type": "ModelBuilders"},
+                "model_builders": {algo: {"algo": algo, "visibility": "Stable",
+                                          "parameters": fields,
+                                          "aliases": aliases}}}
+
     def build_model(self, params, algo):
         if algo not in _ALGOS:
             raise ApiError(404, f"unknown algo {algo!r}")
@@ -456,6 +485,28 @@ class Endpoints:
                 "log": "\n".join(kept), "name": name, "node": node}
 
     # -- mojo download (GET /3/Models/{id}/mojo) ----------------------------
+    def model_save_bin(self, params, key):
+        """``POST /99/Models.bin/{model}?dir=`` — binary save (upstream
+        ``water.api.ModelsHandler`` save route)."""
+        from h2o3_tpu.persist import save_model
+
+        m = _get_model(key)
+        d = params.get("dir") or "."
+        path = save_model(m, d, force=str(params.get("force", "1")) != "0")
+        return {"__meta": {"schema_type": "Models"}, "dir": path,
+                "models": [{"model_id": {"name": m.key}}]}
+
+    def model_load_bin(self, params):
+        """``POST /99/Models.bin?dir=`` — binary load."""
+        from h2o3_tpu.persist import load_model
+
+        d = params.get("dir")
+        if not d:
+            raise ApiError(400, "dir is required")
+        m = load_model(d)
+        return {"__meta": {"schema_type": "Models"},
+                "models": [_model_schema(m)]}
+
     def model_mojo(self, params, key):
         import os as _os
         import tempfile
@@ -651,6 +702,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/3/Jobs/([^/]+)", _EP.job_get),
     ("POST", r"/3/Jobs/([^/]+)/cancel", _EP.job_cancel),
     ("GET", r"/3/ModelBuilders", _EP.model_builders),
+    ("GET", r"/3/ModelBuilders/([^/]+)", _EP.model_builder_get),
     ("POST", r"/3/ModelBuilders/([^/]+)", _EP.build_model),
     ("POST", r"/99/Grid/([^/]+)", _EP.grid_build),
     ("GET", r"/99/Grids", _EP.grids_list),
@@ -658,6 +710,8 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/3/Logs/nodes/([^/]+)/files/([^/]+)", _EP.logs_get),
     ("GET", r"/3/Timeline", _EP.timeline),
     ("GET", r"/3/Models", _EP.models_list),
+    ("POST", r"/99/Models\.bin/([^/]+)", _EP.model_save_bin),
+    ("POST", r"/99/Models\.bin", _EP.model_load_bin),
     ("GET", r"/3/Models/([^/]+)/mojo", _EP.model_mojo),
     ("GET", r"/3/Models/([^/]+)", _EP.model_get),
     ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),

@@ -143,6 +143,15 @@ h2o.aggregator <- function(...) .h2o.train("aggregator", ...)
 h2o.infogram <- function(...) .h2o.train("infogram", ...)
 h2o.targetencoder <- function(...) .h2o.train("targetencoder", ...)
 h2o.isotonicregression <- function(...) .h2o.train("isotonicregression", ...)
+h2o.svd <- function(...) .h2o.train("svd", ...)
+h2o.glrm <- function(...) .h2o.train("glrm", ...)
+h2o.extendedIsolationForest <- function(...) .h2o.train("extendedisolationforest", ...)
+h2o.decision_tree <- function(...) .h2o.train("decisiontree", ...)
+h2o.adaBoost <- function(...) .h2o.train("adaboost", ...)
+h2o.word2vec <- function(...) .h2o.train("word2vec", ...)
+h2o.stackedEnsemble <- function(...) .h2o.train("stackedensemble", ...)
+h2o.hglm <- function(...) .h2o.train("hglm", ...)
+h2o.xrt <- function(...) .h2o.train("xrt", ...)
 
 # -- scoring / inspection -----------------------------------------------------
 
@@ -211,3 +220,129 @@ h2o.automl <- function(y, training_frame, max_models = 10, nfolds = NULL, ...) {
 # -- rapids (frame expressions) ----------------------------------------------
 
 h2o.rapids <- function(ast) .h2o.req("POST", "/99/Rapids", list(ast = ast))
+
+# run an AST, bind the result to a fresh key, return a frame handle
+.h2o.rapids_frame <- function(ast) {
+  # never touch the user's global RNG stream (set.seed reproducibility)
+  .h2o3$tmpctr <- if (is.null(.h2o3$tmpctr)) 1L else .h2o3$tmpctr + 1L
+  key <- sprintf("rtmp_%d_%s", .h2o3$tmpctr,
+                 gsub("[^0-9]", "", format(Sys.time(), "%H%M%OS3")))
+  .h2o.req("POST", "/99/Rapids", list(ast = sprintf("(tmp= %s %s)", key, ast)))
+  structure(list(frame_id = key), class = "H2O3Frame")
+}
+
+.h2o.fref <- function(fr) .h2o.key(fr$frame_id)
+
+.h2o.rvec <- function(x) {
+  if (is.character(x)) paste0("[", paste(sprintf("'%s'", x), collapse = " "), "]")
+  else paste0("[", paste(x, collapse = " "), "]")
+}
+
+# -- frame manipulation (ASTMerge/Sort/Group/... successors over Rapids) -----
+
+h2o.merge <- function(x, y, all.x = FALSE, all.y = FALSE) {
+  .h2o.rapids_frame(sprintf("(merge %s %s %s %s)", .h2o.fref(x), .h2o.fref(y),
+                            if (all.x) "TRUE" else "FALSE",
+                            if (all.y) "TRUE" else "FALSE"))
+}
+
+h2o.arrange <- function(fr, by, ascending = TRUE) {
+  asc <- as.integer(rep(ascending, length.out = length(by)))
+  .h2o.rapids_frame(sprintf("(sort %s %s %s)", .h2o.fref(fr), .h2o.rvec(by),
+                            .h2o.rvec(asc)))
+}
+
+h2o.unique <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(unique (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.table <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(table (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.quantile <- function(fr, probs = c(0.25, 0.5, 0.75)) {
+  .h2o.rapids_frame(sprintf("(quantile %s %s)", .h2o.fref(fr), .h2o.rvec(probs)))
+}
+
+h2o.match <- function(fr, col, table, nomatch = NaN) {
+  .h2o.rapids_frame(sprintf("(match (cols %s '%s') %s %s 1)", .h2o.fref(fr),
+                            col, .h2o.rvec(table),
+                            if (is.nan(nomatch)) "NaN" else nomatch))
+}
+
+h2o.which <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(which (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.na_omit <- function(fr) {
+  .h2o.rapids_frame(sprintf("(na.omit %s)", .h2o.fref(fr)))
+}
+
+h2o.rank_within_group_by <- function(fr, group_by_cols, sort_cols,
+                                     ascending = TRUE,
+                                     new_col_name = "New_Rank_column",
+                                     sort_cols_sorted = FALSE) {
+  .h2o.rapids_frame(sprintf(
+    "(rank_within_groupby %s %s %s %s '%s' %s)", .h2o.fref(fr),
+    .h2o.rvec(group_by_cols), .h2o.rvec(sort_cols),
+    .h2o.rvec(as.integer(rep(ascending, length.out = length(sort_cols)))),
+    new_col_name, if (sort_cols_sorted) "TRUE" else "FALSE"))
+}
+
+h2o.pivot <- function(fr, index, column, value) {
+  .h2o.rapids_frame(sprintf("(pivot %s '%s' '%s' '%s')", .h2o.fref(fr),
+                            index, column, value))
+}
+
+h2o.stratified_split <- function(fr, col, test_frac = 0.2, seed = -1) {
+  .h2o.rapids_frame(sprintf("(h2o.random_stratified_split (cols %s '%s') %s %s)",
+                            .h2o.fref(fr), col, test_frac, seed))
+}
+
+h2o.impute <- function(fr, column, method = "mean") {
+  .h2o.req("POST", "/99/Rapids", list(ast = sprintf(
+    "(h2o.impute %s '%s' '%s')", .h2o.fref(fr), column, method)))
+}
+
+# -- frame download / description --------------------------------------------
+
+as.data.frame.H2O3Frame <- function(x, ...) {
+  url <- paste0(.h2o3$url, "/3/DownloadDataset?frame_id=", .h2o.fref(x))
+  tmp <- tempfile(fileext = ".csv")
+  system2("curl", shQuote(c("-sS", "-o", tmp, url)))
+  utils::read.csv(tmp)
+}
+
+h2o.uploadFile <- function(path, destination_frame = NULL) {
+  url <- paste0(.h2o3$url, "/3/PostFile?filename=", basename(path))
+  if (!is.null(destination_frame)) {
+    url <- paste0(url, "&destination_frame=",
+                  utils::URLencode(destination_frame, TRUE))
+  }
+  res <- system2("curl", shQuote(c("-sS", "-X", "POST", "--data-binary",
+                                   paste0("@", path), url)), stdout = TRUE)
+  parsed <- jsonlite::fromJSON(paste(res, collapse = ""))
+  # PostFile already parses server-side and returns the new frame's KEY
+  structure(list(frame_id = .h2o.key(parsed$destination_frame)),
+            class = "H2O3Frame")
+}
+
+# -- model persistence --------------------------------------------------------
+
+h2o.saveModel <- function(model, path = ".") {
+  res <- .h2o.req("POST", paste0("/99/Models.bin/", model$model_id,
+                                 "?dir=", utils::URLencode(path, TRUE)))
+  res$dir
+}
+
+h2o.loadModel <- function(path) {
+  res <- .h2o.req("POST", paste0("/99/Models.bin?dir=",
+                                 utils::URLencode(path, TRUE)))
+  m <- res$models[[1]]
+  structure(list(model_id = .h2o.key(m$model_id), algo = m$algo),
+            class = "H2O3Model")
+}
+
+h2o.confusionMatrix <- function(perf) perf$confusion_matrix
+h2o.scoreHistory <- function(model) h2o.getModel(model$model_id)$output$scoring_history
+h2o.shutdown <- function() invisible(NULL)  # coordinator lifecycle is external

@@ -194,3 +194,49 @@ def test_wave3_algos_build_over_rest(server):
     mb = _get(server, "/3/ModelBuilders")["model_builders"]
     for algo, _ in cases:
         assert algo in mb
+
+
+def test_models_bin_save_load_roundtrip(server, tmp_path):
+    """/99/Models.bin save + load (upstream ModelsHandler binary persistence
+    routes the R client's h2o.saveModel/h2o.loadModel speak)."""
+    import urllib.parse
+    import urllib.request
+
+    base = server.url
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame({
+        "a": rng.normal(size=500), "b": rng.normal(size=500),
+    })
+    df["y"] = np.where(df.a + df.b > 0, "t", "f")
+    p = tmp_path / "mb.csv"
+    df.to_csv(p, index=False)
+
+    def req(method, path, data=None):
+        body = urllib.parse.urlencode(data).encode() if data else None
+        r = urllib.request.Request(base + path, data=body, method=method)
+        return json.loads(urllib.request.urlopen(r, timeout=120).read())
+
+    req("POST", "/3/ImportFiles", {"path": str(p)})
+    pj = req("POST", "/3/Parse", {"source_frames": str(p), "destination_frame": "mbf"})
+    import time as _t
+    pjid = pj["job"]["key"]["name"]
+    while req("GET", f"/3/Jobs/{pjid}")["jobs"][0]["status"] not in ("DONE", "FAILED"):
+        _t.sleep(0.2)
+    job = req("POST", "/3/ModelBuilders/gbm",
+              {"training_frame": "mbf", "response_column": "y",
+               "ntrees": "3", "max_depth": "2", "seed": "1"})
+    jid = (job.get("job") or job)["key"]["name"]
+    while True:
+        j = req("GET", f"/3/Jobs/{jid}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        _t.sleep(0.3)
+    assert j["status"] == "DONE"
+    mkey = j["dest"]["name"]
+    saved = req("POST", f"/99/Models.bin/{mkey}?dir={tmp_path}")
+    assert saved["dir"]
+    # delete then load back
+    req("DELETE", f"/3/Models/{mkey}")
+    loaded = req("POST", f"/99/Models.bin?dir={urllib.parse.quote(saved['dir'])}")
+    m = loaded["models"][0]
+    assert m["output"]["training_metrics"]["auc"] > 0.7
