@@ -486,17 +486,19 @@ class Endpoints:
 
     # -- mojo download (GET /3/Models/{id}/mojo) ----------------------------
     def model_save_bin(self, params, key):
+        _spmd_v1_guard("Models.bin save")
         """``POST /99/Models.bin/{model}?dir=`` — binary save (upstream
         ``water.api.ModelsHandler`` save route)."""
         from h2o3_tpu.persist import save_model
 
         m = _get_model(key)
         d = params.get("dir") or "."
-        path = save_model(m, d, force=str(params.get("force", "1")) != "0")
+        path = save_model(m, d, force=str(params.get("force", "1")).lower() in ("1", "true"))
         return {"__meta": {"schema_type": "Models"}, "dir": path,
                 "models": [{"model_id": {"name": m.key}}]}
 
     def model_load_bin(self, params):
+        _spmd_v1_guard("Models.bin load")
         """``POST /99/Models.bin?dir=`` — binary load."""
         from h2o3_tpu.persist import load_model
 

@@ -36,6 +36,10 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "", "extra Host header names accepted for state-changing REST "
         "requests (comma list; '*' disables the CSRF/rebinding guard)"),
     "H2O3_TPU_LOG_LEVEL": ("INFO", "default log level"),
+    "H2O3_TPU_BIN_ADAPT": (
+        "1", "per-level bin coarsening in the fused tree builder (numeric "
+             "frames): depth>=3 halves data bins per level, floor 63 — "
+             "DHistogram's per-level re-binning analog; 0 disables"),
     "H2O3_TPU_FUSED_MAX_DEPTH": (
         "20", "deepest tree the whole-tree fused program is built for; "
               "beyond it the per-level dispatch loop takes over"),

@@ -79,7 +79,14 @@ def _export_trees(model, meta, arrays) -> None:
                 arrays[pre + "split_col"] = lv.split_col
                 arrays[pre + "split_bin"] = lv.split_bin
                 arrays[pre + "is_cat"] = lv.is_cat
-                arrays[pre + "cat_mask"] = lv.cat_mask
+                # bin-adaptive levels record a narrower cat_mask (unused for
+                # numeric-only adaptivity); pad to the model's bin width so
+                # every offline scorer sees one uniform B
+                cm = np.asarray(lv.cat_mask)
+                full_b = int(spec.max_bins)
+                if cm.shape[1] < full_b:
+                    cm = np.pad(cm, ((0, 0), (0, full_b - cm.shape[1])))
+                arrays[pre + "cat_mask"] = cm
                 arrays[pre + "na_left"] = lv.na_left
                 arrays[pre + "leaf_now"] = lv.leaf_now
                 arrays[pre + "leaf_val"] = lv.leaf_val

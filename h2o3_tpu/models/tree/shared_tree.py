@@ -425,6 +425,63 @@ def _level_step_fn(
     return out[:5]
 
 
+# -- per-level bin adaptivity (DHistogram's per-level re-binning analog) ----
+# Upstream re-derives histogram ranges per level (nbins_top_level halving to
+# nbins); here deep levels coarsen the static quantile bins instead: the
+# dense one-hot histogram's cost is ∝ bin count, and nodes deep in the tree
+# hold few rows, where 63 quantile bins split as well as 254. Recorded
+# splits are converted back to FULL-resolution thresholds (a coarse prefix
+# split is exactly a full-res prefix split), so partition replay, MOJO
+# export and the native scorer are untouched. Numeric-only: coarsening ENUM
+# bins would merge arbitrary categories; frames with categorical features
+# keep full bins at every level.
+
+_BIN_ADAPT_START = 3  # first depth allowed to coarsen
+_BIN_ADAPT_MIN = 63  # never fewer data bins than this
+
+
+def _bin_shifts(max_depth: int, n_bins: int, cat_cols: tuple) -> list[int]:
+    from h2o3_tpu import config
+
+    if cat_cols or not config.get_bool("H2O3_TPU_BIN_ADAPT"):
+        return [0] * (max_depth + 1)
+    D = n_bins - 1  # data bins (bin 0 = NA)
+    out = []
+    for d in range(max_depth + 1):
+        s = max(d - (_BIN_ADAPT_START - 1), 0)
+        while s > 0 and (D >> s) < _BIN_ADAPT_MIN:
+            s -= 1
+        out.append(s)
+    return out
+
+
+def _coarse_nbins(n_bins: int, s: int) -> int:
+    return (-(-(n_bins - 1) // (1 << s))) + 1 if s else n_bins
+
+
+def _coarsen_bins(bins_u8, s: int):
+    if s == 0:
+        return bins_u8
+    b = bins_u8.astype(jnp.int32)
+    return jnp.where(b == 0, 0, ((b - 1) >> s) + 1).astype(jnp.uint8)
+
+
+def _coarsen_hist(hist, ds: int):
+    """Sum adjacent data-bin groups of 2**ds (NA bin passes through)."""
+    if ds == 0:
+        return hist
+    N, C, _, S = hist.shape
+    na = hist[:, :, :1, :]
+    data = hist[:, :, 1:, :]
+    D = data.shape[2]
+    Dc = -(-D // (1 << ds))
+    pad = Dc * (1 << ds) - D
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    data = data.reshape(N, C, Dc, 1 << ds, S).sum(3)
+    return jnp.concatenate([na, data], axis=2)
+
+
 def _fused_levels(
     bins_u8, preds, varimp, w, wy, wy2, wh, tkey, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
@@ -460,11 +517,16 @@ def _fused_levels(
     recs = []
     parent_hist = None
     pair_info = None
+    shifts = _bin_shifts(max_depth, n_bins, cat_cols)
+    prev_shift = 0
     for depth in range(max_depth + 1):
         n_pad = min(1 << depth, node_cap)
         n_pad_next = min(2 * n_pad, node_cap)
         force_leaf = depth == max_depth
         lkey = jax.random.fold_in(tkey, depth)
+        sd = shifts[depth]
+        nb_d = _coarse_nbins(n_bins, sd)
+        bins_d = _coarsen_bins(bins_u8, sd)
 
         if force_leaf and subtract and pair_info is not None:
             # leaf stats straight from the parents' chosen splits
@@ -480,7 +542,7 @@ def _fused_levels(
             continue
 
         if depth == 0 or not subtract:
-            hist = histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_pad, n_bins)
+            hist = histogram_in_jit(bins_d, nid, w, wy, wy2, wh, n_pad, nb_d)
         else:
             half = n_pad // 2
             row_pair = jnp.maximum(nid, 0) >> 1  # pair = nid//2 (child_base even)
@@ -489,11 +551,13 @@ def _fused_levels(
             build_row = (nid >= 0) & (row_left == bl[row_pair])
             nid_build = jnp.where(build_row, row_pair, -1)
             built = histogram_in_jit(
-                bins_u8, nid_build, w, wy, wy2, wh, half, n_bins
-            )  # (half, C, B, 4)
+                bins_d, nid_build, w, wy, wy2, wh, half, nb_d
+            )  # (half, C, Bc, 4)
+            # parent histogram was built at the previous level's (finer)
+            # binning — sum its data-bin groups down to this level's
             psel = jnp.where(
                 pair_info["valid"][:, None, None, None],
-                parent_hist[pair_info["parent_idx"]],
+                _coarsen_hist(parent_hist, sd - prev_shift)[pair_info["parent_idx"]],
                 0.0,
             )
             sib = psel - built
@@ -510,12 +574,19 @@ def _fused_levels(
             )
         else:
             nid, preds, varimp, _, rec, pair_info = _level_core(
-                hist, bins_u8, nid, preds, varimp, lkey, cols_enabled, is_cat,
+                hist, bins_d, nid, preds, varimp, lkey, cols_enabled, is_cat,
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
                 cat_cols=cat_cols,
             )
             parent_hist = hist
+            prev_shift = sd
+            if sd:
+                # a coarse prefix split IS a full-res prefix split: convert
+                # the recorded threshold so replay/export stay full-res.
+                # (partition above already ran on the coarse bins — rows land
+                # identically either way.) cat_mask is unused: numeric-only.
+                rec = dict(rec, split_bin=rec["split_bin"] << sd)
         recs.append(rec)
     return nid, preds, varimp, tuple(recs)
 
@@ -667,6 +738,7 @@ def _tree_program(
     """
     subtract = _subtract_enabled()
     key = ("tree", max_depth, n_bins, node_cap, cat_cols, subtract,
+           tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
            jax.default_backend())
     fn = _STEP_CACHE.get(key)
     if fn is not None:
@@ -745,6 +817,7 @@ def build_trees_scanned(
     # of the cache key (a boolean would silently reuse another model's rates)
     key = (
         "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key,
+        tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
         float(sample_rate), float(col_sample_rate_per_tree), subtract,
         jax.default_backend(),
     )

@@ -307,14 +307,16 @@ h2o.impute <- function(fr, column, method = "mean") {
 # -- frame download / description --------------------------------------------
 
 as.data.frame.H2O3Frame <- function(x, ...) {
-  url <- paste0(.h2o3$url, "/3/DownloadDataset?frame_id=", .h2o.fref(x))
+  url <- paste0(.h2o3$url, "/3/DownloadDataset?frame_id=",
+                utils::URLencode(.h2o.fref(x), TRUE))
   tmp <- tempfile(fileext = ".csv")
   system2("curl", shQuote(c("-sS", "-o", tmp, url)))
   utils::read.csv(tmp)
 }
 
 h2o.uploadFile <- function(path, destination_frame = NULL) {
-  url <- paste0(.h2o3$url, "/3/PostFile?filename=", basename(path))
+  url <- paste0(.h2o3$url, "/3/PostFile?filename=",
+                utils::URLencode(basename(path), TRUE))
   if (!is.null(destination_frame)) {
     url <- paste0(url, "&destination_frame=",
                   utils::URLencode(destination_frame, TRUE))

@@ -120,3 +120,91 @@ def test_pallas_categorical_codes_roundtrip():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
     # every row accounted for: total w mass equals n
     assert abs(float(np.asarray(got)[0, :, 0].sum()) - n) < 1e-3
+
+
+class TestBinAdaptivity:
+    """Per-level bin coarsening (DHistogram re-binning analog) — the
+    coarsened histogram must equal the coarsened full histogram, and the
+    adaptive tree must match the full-bin tree's quality with full-res
+    recorded thresholds."""
+
+    def test_coarsen_hist_matches_hist_of_coarse_bins(self):
+        import jax.numpy as jnp
+
+        from h2o3_tpu.models.tree.shared_tree import (
+            _coarse_nbins, _coarsen_bins, _coarsen_hist,
+        )
+        from h2o3_tpu.ops.histogram import histogram_in_jit
+
+        rng = np.random.default_rng(0)
+        n, c, nb = 4096, 3, 255
+        bins = jnp.asarray(rng.integers(0, nb, (n, c)).astype(np.uint8))
+        nid = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+        w = jnp.ones(n, jnp.float32)
+        wy = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        full = histogram_in_jit(bins, nid, w, wy, wy, w, 4, nb)
+        for s in (1, 2):
+            nb_c = _coarse_nbins(nb, s)
+            direct = histogram_in_jit(
+                _coarsen_bins(bins, s), nid, w, wy, wy, w, 4, nb_c
+            )
+            via = _coarsen_hist(full, s)
+            np.testing.assert_allclose(
+                np.asarray(via), np.asarray(direct), rtol=1e-5, atol=1e-4
+            )
+
+    def test_adaptive_tree_quality_and_full_res_thresholds(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from h2o3_tpu.models.tree import shared_tree as st
+        from h2o3_tpu.models.tree.distributions import grad_hess
+
+        rng = np.random.default_rng(1)
+        n, c = 8192, 6
+        X = rng.normal(size=(n, c)).astype(np.float32)
+        y = (X[:, 0] + 0.6 * X[:, 1] ** 2 + 0.3 * rng.normal(size=n) > 0.4)
+        # quantile-ish binning to 255 data bins
+        bins = np.zeros((n, c), np.uint8)
+        for j in range(c):
+            q = np.quantile(X[:, j], np.linspace(0, 1, 255)[1:-1])
+            bins[:, j] = np.searchsorted(q, X[:, j]) + 1
+        bins_d = jnp.asarray(bins)
+        w = jnp.ones(n, jnp.float32)
+        yy = jnp.asarray(y.astype(np.float32))
+
+        def auc_of(preds):
+            from sklearn.metrics import roc_auc_score
+
+            return roc_auc_score(y, np.asarray(preds))
+
+        def train(adapt):
+            monkeypatch.setenv("H2O3_TPU_BIN_ADAPT", "1" if adapt else "0")
+            st._STEP_CACHE.clear()
+            F, vi, stacked = st.build_trees_scanned(
+                bins_d, w, yy, jnp.zeros(n, jnp.float32),
+                jnp.zeros(c, jnp.float32), jax.random.PRNGKey(0), 10,
+                grad_fn=lambda F_, y_, w_: grad_hess("bernoulli", F_, y_, w_, 0.0),
+                grad_key=("adapt_test", adapt),
+                sample_rate=1.0, n_bins=255, is_cat_cols=np.zeros(c, bool),
+                max_depth=6, min_rows=10.0, min_split_improvement=1e-5,
+                learn_rates=np.full(10, 0.3, np.float32),
+                max_abs_leaf=float("inf"),
+                col_sample_rate=1.0, col_sample_rate_per_tree=1.0,
+            )
+            trees = st.trees_from_stacked(stacked, 10)
+            return np.asarray(F), trees
+
+        try:
+            f_off, _ = train(False)
+            f_on, trees_on = train(True)
+        finally:
+            st._STEP_CACHE.clear()
+        a_off, a_on = auc_of(f_off), auc_of(f_on)
+        assert a_on > a_off - 0.01, (a_on, a_off)
+        # recorded thresholds are FULL-resolution: replaying the adaptive
+        # trees against the full-res bins reproduces the training scores
+        preds = jnp.zeros(n, jnp.float32)
+        for t in trees_on:
+            _, preds = t.replay(bins_d, jnp.zeros(n, jnp.int32), preds)
+        np.testing.assert_allclose(np.asarray(preds), f_on, rtol=1e-5, atol=1e-5)

@@ -575,9 +575,11 @@ def test_fused_whole_tree_deep_matches_per_level(monkeypatch):
         )
         return preds, vi
 
-    # per-level builds every histogram from scratch; the fused program uses
-    # sibling subtraction — equality must hold only when subtraction is OFF
+    # per-level builds every histogram from scratch at full bins; the fused
+    # program uses sibling subtraction and bin adaptivity — equality must
+    # hold exactly when both are OFF
     monkeypatch.setenv("H2O3_TPU_HIST_SUBTRACT", "0")
+    monkeypatch.setenv("H2O3_TPU_BIN_ADAPT", "0")
     st._STEP_CACHE.clear()
     try:
         p1, v1 = run(force_per_level=False)
