@@ -1,7 +1,7 @@
 """AutoML — successor of ``ai.h2o.automl.AutoML`` / ``Leaderboard`` /
 ``modeling/*Steps`` [UNVERIFIED upstream paths, SURVEY.md §2.3, §3.5].
 
-H2O AutoML plans a budgeted sequence of modeling steps — preset GBMs, a GBM
+H2O AutoML plans a budgeted sequence of modeling steps — preset XGBoosts, preset GBMs, a GBM
 grid, GLM, DRF + XRT (extremely randomized trees), DeepLearning grids, then
 two Stacked Ensembles ("BestOfFamily" and "All") — every model cross-validated
 so the ensembles can stack the holdout predictions, ranked on a leaderboard
@@ -125,8 +125,13 @@ class _Step:
 
 def _default_plan() -> list[_Step]:
     """The default modeling plan, mirroring H2O's step order:
-    preset GBMs → GLM → DRF → XRT → GBM grid → DL grid → ensembles."""
+    preset XGBoosts → preset GBMs → GLM → DRF → XRT → GBM grid → DL grid →
+    ensembles (upstream runs its XGBoost defaults FIRST —
+    ``modeling/XGBoostStepsProvider`` [UNVERIFIED])."""
     return [
+        _Step("def_xgb_1", "model", "xgboost", dict(ntrees=50, max_depth=10, min_child_weight=5, sample_rate=0.6, col_sample_rate_per_tree=0.8, reg_lambda=0.8, reg_alpha=0.0)),
+        _Step("def_xgb_2", "model", "xgboost", dict(ntrees=50, max_depth=20, min_child_weight=10, sample_rate=0.6, col_sample_rate_per_tree=0.8, reg_lambda=0.8, reg_alpha=0.0)),
+        _Step("def_xgb_3", "model", "xgboost", dict(ntrees=50, max_depth=5, min_child_weight=3, sample_rate=0.8, col_sample_rate_per_tree=0.8, reg_lambda=1.0, reg_alpha=0.0)),
         _Step("def_gbm_1", "model", "gbm", dict(ntrees=50, max_depth=6, learn_rate=0.1, sample_rate=0.8, col_sample_rate=0.8)),
         _Step("def_gbm_2", "model", "gbm", dict(ntrees=50, max_depth=3, learn_rate=0.1, sample_rate=0.9, col_sample_rate=1.0)),
         _Step("def_gbm_3", "model", "gbm", dict(ntrees=50, max_depth=9, learn_rate=0.1, sample_rate=0.7, col_sample_rate=0.6)),
@@ -201,8 +206,9 @@ class AutoML:
 
     def _algo_allowed(self, algo: str) -> bool:
         inc, exc = self.spec.include_algos, self.spec.exclude_algos
-        canon = {"gbm": "GBM", "glm": "GLM", "drf": "DRF", "xrt": "XRT",
-                 "deeplearning": "DeepLearning", "stackedensemble": "StackedEnsemble"}[algo]
+        canon = {"gbm": "GBM", "xgboost": "XGBoost", "glm": "GLM", "drf": "DRF",
+                 "xrt": "XRT", "deeplearning": "DeepLearning",
+                 "stackedensemble": "StackedEnsemble"}[algo]
         if inc is not None:
             return canon in inc
         if exc is not None:
@@ -212,8 +218,8 @@ class AutoML:
     def _builder_cls(self, algo: str):
         from h2o3_tpu import models as M
 
-        return {"gbm": M.GBM, "glm": M.GLM, "drf": M.DRF, "xrt": M.XRT,
-                "deeplearning": M.DeepLearning}[algo]
+        return {"gbm": M.GBM, "xgboost": M.XGBoost, "glm": M.GLM, "drf": M.DRF,
+                "xrt": M.XRT, "deeplearning": M.DeepLearning}[algo]
 
     def _builder(self, algo: str, params: dict):
         return self._builder_cls(algo)(**params)

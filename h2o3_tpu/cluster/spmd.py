@@ -20,6 +20,14 @@ primitives and widen the same way).
 
 The broadcast payload is length-prefixed and padded to a power of two so the
 number of distinct broadcast programs stays O(log max_payload).
+
+Failure detection (SURVEY §5.3): the jax coordination service's heartbeat
+IS the ``HeartBeatThread`` successor — a dead rank is detected by the
+service, which poisons every other rank's next collective with a fatal
+``PollForError`` (observed in the multihost test logs when a rank is
+killed). The cloud is fail-stop on member death, exactly H2O's semantics
+("a dead member makes the cluster unusable; restart is the recovery path");
+durability comes from model checkpoints, not elasticity.
 """
 
 from __future__ import annotations

@@ -38,6 +38,7 @@ import pytest  # noqa: E402
 # multihost REST e2e, NA handling all stay).
 _SLOW_BY_NAME = {
     "test_drf_multinomial",
+    "test_automl_runs_xgboost_steps_first",
     "test_calibrate_model_platt_and_isotonic",
     "test_rulefit_binomial_and_linear_only",
     "test_rulefit_recovers_rules",

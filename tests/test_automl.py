@@ -70,3 +70,22 @@ def test_automl_regression_and_exclusions():
     # regression leaderboard sorted ascending on deviance
     vals = [aml.leaderboard._metric_of(m) for m in aml.leaderboard.models]
     assert vals == sorted(vals)
+
+
+def test_automl_runs_xgboost_steps_first():
+    """Upstream AutoML's plan opens with its XGBoost defaults; ours mirrors
+    that — with max_models=2 the leaderboard's trained base models are the
+    first two plan steps, i.e. algo == 'xgboost'; excluding XGBoost drops
+    them."""
+    fr = _binary_frame(n=800, seed=5)
+    aml = AutoML(max_models=2, nfolds=0, seed=5,
+                 exclude_algos=["DeepLearning", "StackedEnsemble"])
+    aml.train(y="y", training_frame=fr)
+    algos = [m.algo for m in aml.leaderboard.models]
+    assert algos and all(a == "xgboost" for a in algos), algos
+
+    aml2 = AutoML(max_models=2, nfolds=0, seed=6,
+                  exclude_algos=["XGBoost", "DeepLearning", "StackedEnsemble"])
+    aml2.train(y="y", training_frame=fr)
+    algos2 = {m.algo for m in aml2.leaderboard.models}
+    assert "xgboost" not in algos2 and algos2, algos2

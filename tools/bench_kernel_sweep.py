@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Pallas histogram kernel tile sweep on the REAL TPU (run when the tunnel
+is up): measures hist time per (ROW_TILE, COL_TILE, n_bins, n_nodes) so the
+next kernel iteration picks tiles from data, not guesses.
+
+The kernel's per-step cost is dominated by the VPU indicator build
+(∝ ROWS·CT·Bpad) and the MXU dot (M = 4·nt); below 64 nodes the node count
+barely matters — bin count and tile sizes are the levers.
+
+    python tools/bench_kernel_sweep.py        # prints one JSON line per cfg
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.ops import hist_pallas
+
+    n, c = 1_000_000, 28
+    rng = np.random.default_rng(0)
+    base_bins = rng.integers(0, 255, (n, c)).astype(np.uint8)
+    w = jnp.ones(n, jnp.float32)
+    wy = jnp.asarray(rng.normal(size=n).astype(np.float32))
+
+    results = []
+    for row_tile in (256, 512, 1024, 2048):
+        for col_tile in (4, 8, 14, 28):
+            for n_bins in (255, 127, 63):
+                for n_nodes in (16, 64):
+                    hist_pallas.ROW_TILE = row_tile
+                    hist_pallas.COL_TILE = col_tile
+                    # the jit cache keys on shapes/static args, NOT the
+                    # module constants — drop it so each config re-traces
+                    hist_pallas.hist_pallas_local.clear_cache()
+                    bins = jnp.asarray(
+                        (base_bins % n_bins).astype(np.uint8)
+                    )
+                    nid = jnp.asarray(
+                        rng.integers(0, n_nodes, n).astype(np.int32)
+                    )
+                    try:
+                        fn = lambda: hist_pallas.hist_pallas_local(
+                            bins, nid, w, wy, wy, w, n_nodes, n_bins
+                        )
+                        out = fn()
+                        jax.block_until_ready(out)
+                        t0 = time.perf_counter()
+                        for _ in range(3):
+                            out = fn()
+                        jax.block_until_ready(out)
+                        dt = (time.perf_counter() - t0) / 3
+                        rec = {"row_tile": row_tile, "col_tile": col_tile,
+                               "n_bins": n_bins, "n_nodes": n_nodes,
+                               "hist_s": round(dt, 4)}
+                    except Exception as e:  # noqa: BLE001 — sweep must finish
+                        rec = {"row_tile": row_tile, "col_tile": col_tile,
+                               "n_bins": n_bins, "n_nodes": n_nodes,
+                               "error": repr(e)[:200]}
+                    print(json.dumps(rec), flush=True)
+                    results.append(rec)
+
+    ok = [r for r in results if "hist_s" in r]
+    if ok:
+        best = min(ok, key=lambda r: r["hist_s"])
+        print(json.dumps({"best": best}))
+
+
+if __name__ == "__main__":
+    main()
