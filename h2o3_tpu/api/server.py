@@ -309,6 +309,12 @@ class Endpoints:
         j = DKV.get(key)
         if not isinstance(j, Job):
             raise ApiError(404, f"Job {key} not found")
+        if not getattr(j, "cancellable", True):
+            raise ApiError(
+                400, "this job replicates device work across a multi-process "
+                     "cloud and cannot be cancelled mid-run (aborting one "
+                     "rank's collective sequence would desync the cloud)"
+            )
         j.cancel()
         return {"__meta": {"schema_type": "Jobs"}, "jobs": [_job_schema(j)]}
 
@@ -404,7 +410,6 @@ class Endpoints:
 
     # -- grids (hex.grid.GridSearch REST surface, /99/Grid*) ---------------
     def grid_build(self, params, algo):
-        _spmd_v1_guard("Grid search")
         if algo not in _ALGOS:
             raise ApiError(404, f"unknown algo {algo!r}")
         cls = _builder_cls(algo)
@@ -428,19 +433,38 @@ class Endpoints:
         if train_key is None:
             raise ApiError(400, "training_frame is required")
 
-        from h2o3_tpu.models.grid import GridSearch
+        from h2o3_tpu.cluster import spmd
 
-        gs = GridSearch(cls, hyper, search_criteria=criteria, grid_id=grid_id,
-                        parallelism=parallelism, **kwargs)
+        if not spmd.multi_process():
+            from h2o3_tpu.models.grid import GridSearch
+
+            gs = GridSearch(cls, hyper, search_criteria=criteria,
+                            grid_id=grid_id, parallelism=parallelism, **kwargs)
+            job = Job(
+                lambda j: gs._drive(j, x, y, DKV.get(train_key),
+                                    DKV.get(valid_key) if valid_key else None, {}),
+                f"grid over {algo}",
+            )
+            gs.job = job
+            job.start()
+            return {"__meta": {"schema_type": "GridSearchV99"},
+                    "job": _job_schema(job), "grid_id": {"name": gs.grid.key}}
+        # multi-process: the whole grid runs as ONE replicated command; every
+        # rank's deterministic key sequence (registry.make_key) keeps the
+        # grid's model keys aligned without carrying them individually
+        grid_id = grid_id or DKV.make_key("grid")
         job = Job(
-            lambda j: gs._drive(j, x, y, DKV.get(train_key),
-                                DKV.get(valid_key) if valid_key else None, {}),
+            lambda j: spmd.run(
+                "grid", algo=algo, hyper=hyper, criteria=criteria,
+                grid_id=grid_id, parallelism=parallelism, kwargs=kwargs,
+                x=x, y=y, train=train_key, valid=valid_key,
+            ),
             f"grid over {algo}",
         )
-        gs.job = job
+        job.cancellable = False  # replicated collective sequence (see spmd)
         job.start()
         return {"__meta": {"schema_type": "GridSearchV99"},
-                "job": _job_schema(job), "grid_id": {"name": gs.grid.key}}
+                "job": _job_schema(job), "grid_id": {"name": grid_id}}
 
     def grids_list(self, params):
         from h2o3_tpu.models.grid import Grid
@@ -568,7 +592,6 @@ class Endpoints:
 
     # -- automl -----------------------------------------------------------
     def automl_build(self, params):
-        _spmd_v1_guard("AutoML")
         from h2o3_tpu.automl import AutoML
 
         spec = params.get("build_control", {})
@@ -603,12 +626,27 @@ class Endpoints:
         if not train_key or not y:
             raise ApiError(400, "input_spec.training_frame and response_column required")
 
-        aml = AutoML(**kwargs)
-        job = Job(lambda j: aml.train(y=y, training_frame=train_key), "AutoML build")
+        from h2o3_tpu.cluster import spmd
+
+        if not spmd.multi_process():
+            aml = AutoML(**kwargs)
+            job = Job(lambda j: aml.train(y=y, training_frame=train_key),
+                      "AutoML build")
+            job.start()
+            return {"__meta": {"schema_type": "AutoMLBuilder"},
+                    "job": _job_schema(job),
+                    "automl_id": {"name": aml.key}}
+        dest = DKV.make_key("automl")
+        job = Job(
+            lambda j: spmd.run("automl", kwargs=kwargs, y=y, train=train_key,
+                               dest=dest),
+            "AutoML build",
+        )
+        job.cancellable = False  # replicated collective sequence (see spmd)
         job.start()
         return {"__meta": {"schema_type": "AutoMLBuilder"},
                 "job": _job_schema(job),
-                "automl_id": {"name": aml.key}}
+                "automl_id": {"name": dest}}
 
     def automl_get(self, params, key):
         aml = DKV.get(key)

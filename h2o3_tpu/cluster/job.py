@@ -63,6 +63,12 @@ class Job:
 
     # -- client-side API --
     def start(self) -> "Job":
+        import contextvars
+
+        # nested Jobs inherit the creator's context (e.g. the spmd
+        # replicated-execution flag) — threads don't do this by default
+        ctx = contextvars.copy_context()
+
         def run() -> None:
             self.status = Job.RUNNING
             self.start_time = time.time()
@@ -79,7 +85,9 @@ class Job:
             finally:
                 self.end_time = time.time()
 
-        self._thread = threading.Thread(target=run, name=self.key, daemon=True)
+        self._thread = threading.Thread(
+            target=lambda: ctx.run(run), name=self.key, daemon=True
+        )
         self._thread.start()
         return self
 

@@ -59,6 +59,19 @@ class DKV:
 
     @classmethod
     def make_key(cls, prefix: str = "obj") -> str:
+        # Inside replicated SPMD execution every rank runs the same code in
+        # the same (serialized) order, so a counter yields IDENTICAL keys on
+        # every rank — which is what lets whole grids/AutoML runs replicate
+        # without carrying each model key in the command (cluster/spmd.py).
+        try:
+            from h2o3_tpu.cluster import spmd
+
+            if spmd.multi_process() and spmd.in_replicated():
+                with cls._mutex:
+                    cls._replicated_seq = getattr(cls, "_replicated_seq", 0) + 1
+                    return f"{prefix}_r{cls._replicated_seq:08d}"
+        except Exception:  # pragma: no cover - jax not initialized yet
+            pass
         return f"{prefix}_{uuid.uuid4().hex[:12]}"
 
     @classmethod

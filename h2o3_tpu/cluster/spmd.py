@@ -13,10 +13,15 @@ seeds, coordinator-chosen DKV keys carried in the command) is what keeps the
 ranks' collective sequences aligned, exactly as H2O relies on every node
 running the same jar.
 
-v1 scope: Parse, model build, predict — the end-to-end REST training path.
-Frame mutations via Rapids and grid/AutoML builds are coordinator-local and
-raise on a multi-process cloud (documented limitation; both reduce to these
-primitives and widen the same way).
+Replicated commands: Parse (incl. sharded), model build, predict, grid
+search, AutoML. Grid/AutoML replication rides the deterministic key
+sequence ``DKV.make_key`` switches to inside replicated execution — every
+rank names the grid's/leaderboard's models identically without shipping
+keys. Wall-clock budgets (``max_runtime_secs``) are rejected on
+multi-process clouds: ranks' clocks diverge and would desynchronize the
+collective sequence; use ``max_models``. Rapids frame mutations and
+dataset download/export stay coordinator-local and return 501 (the
+remaining v2 surface).
 
 The broadcast payload is length-prefixed and padded to a power of two so the
 number of distinct broadcast programs stays O(log max_payload).
@@ -32,6 +37,7 @@ durability comes from model checkpoints, not elasticity.
 
 from __future__ import annotations
 
+import contextvars
 import pickle
 import threading
 
@@ -40,15 +46,20 @@ import numpy as np
 from h2o3_tpu.utils.log import Log
 
 _LOCK = threading.RLock()  # serializes the coordinator's device-work commands
-# process-global (not thread-local): builders spawn nested Job threads that
-# must inherit the flag; replicated execution is serialized by _LOCK anyway
-_REPLICATED = 0
+# ContextVar, not a process global: nested Job threads inherit it because
+# Job.start runs the thread inside the creator's copied context (job.py),
+# while unrelated coordinator REST threads see 0 — a process-global flag let
+# a concurrent REST request mint from the replicated key sequence and drift
+# the coordinator's keys ahead of the followers'.
+_REPLICATED_VAR: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "spmd_replicated", default=0
+)
 
 
 def in_replicated() -> bool:
     """True while executing a replicated command (every rank in lockstep) —
     the only context where cross-process collectives are safe."""
-    return _REPLICATED > 0
+    return _REPLICATED_VAR.get() > 0
 
 
 import contextlib
@@ -58,12 +69,11 @@ import contextlib
 def replicated_section():
     """Mark a region as replicated execution for library users driving their
     own multi-controller SPMD scripts (every rank must enter it together)."""
-    global _REPLICATED
-    _REPLICATED += 1
+    token = _REPLICATED_VAR.set(_REPLICATED_VAR.get() + 1)
     try:
         yield
     finally:
-        _REPLICATED -= 1
+        _REPLICATED_VAR.reset(token)
 
 
 def multi_process() -> bool:
@@ -130,10 +140,87 @@ def _exec_predict(model_key: str, frame_key: str, dest: str):
     return out
 
 
+class _JobShim:
+    """Followers have no REST Job; grid/AutoML drivers only need these."""
+
+    stop_requested = False
+    progress = 0.0
+
+    def update(self, p, *a, **k):
+        self.progress = p
+
+
+def _require_deterministic_budget(name: str, max_runtime) -> None:
+    if multi_process() and max_runtime:
+        raise ValueError(
+            f"{name} with max_runtime_secs is not supported on a "
+            "multi-process cloud: wall-clock budgets diverge across ranks "
+            "and desynchronize the replicated collective sequence — use "
+            "max_models (deterministic) instead"
+        )
+
+
+def _exec_grid(algo, hyper, criteria, grid_id, parallelism, kwargs, x, y,
+               train, valid):
+    from h2o3_tpu.api.server import _builder_cls
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.models.grid import GridSearch
+
+    criteria = dict(criteria or {})
+    _require_deterministic_budget("Grid search", kwargs.get("max_runtime_secs")
+                                  or criteria.get("max_runtime_secs"))
+    if multi_process():
+        # threads would interleave device programs differently per rank
+        parallelism = 1
+        if (criteria.get("strategy") == "RandomDiscrete"
+                and criteria.get("seed") in (None, -1)):
+            raise ValueError(
+                "RandomDiscrete grids on a multi-process cloud need an "
+                "explicit search_criteria seed (ranks must draw the same "
+                "combo sequence)"
+            )
+    gs = GridSearch(_builder_cls(algo), hyper, search_criteria=criteria or None,
+                    grid_id=grid_id, parallelism=parallelism, **kwargs)
+    gs._drive(_JobShim(), x, y, DKV.get(train),
+              DKV.get(valid) if valid else None, {})
+    return gs.grid
+
+
+def _exec_automl(kwargs, y, train, dest):
+    from h2o3_tpu.automl import AutoML
+    from h2o3_tpu.cluster.registry import DKV
+
+    _require_deterministic_budget("AutoML", kwargs.get("max_runtime_secs"))
+    if multi_process():
+        # AutoMLSpec defaults max_runtime_secs to 3600 — a wall-clock budget
+        # the ranks' clocks would apply differently; force it off and demand
+        # the deterministic budget + seed instead
+        kwargs = dict(kwargs, max_runtime_secs=0.0, max_runtime_secs_per_model=0.0)
+        if not kwargs.get("max_models"):
+            raise ValueError(
+                "AutoML on a multi-process cloud needs max_models "
+                "(wall-clock budgets diverge across ranks)"
+            )
+        if kwargs.get("seed") in (None, -1):
+            raise ValueError(
+                "AutoML on a multi-process cloud needs an explicit seed "
+                "(its RandomDiscrete grid steps must draw identical combos "
+                "on every rank)"
+            )
+    aml = AutoML(**kwargs)
+    DKV.remove(aml.key)
+    aml.key = dest  # coordinator-chosen, carried in the command
+    DKV.put(dest, aml)  # registered BEFORE the run: clients poll mid-build
+    aml._drive(_JobShim(), None, y, train, None, None)
+    return aml
+
+
 _COMMANDS = {
     "parse": _exec_parse,
     "build": _exec_build,
     "predict": _exec_predict,
+    "grid": _exec_grid,
+    "automl": _exec_automl,
 }
 
 _SHUTDOWN = "__shutdown__"
@@ -152,12 +239,8 @@ def run(cmd: str, **kwargs):
         raise RuntimeError("spmd.run is coordinator-only")
     with _LOCK:
         _bcast_bytes(pickle.dumps((cmd, kwargs)))
-        global _REPLICATED
-        _REPLICATED += 1
-        try:
+        with replicated_section():
             return _COMMANDS[cmd](**kwargs)
-        finally:
-            _REPLICATED -= 1
 
 
 def shutdown_followers() -> None:
@@ -177,16 +260,15 @@ def follower_loop() -> None:
     (one rank fails mid-collective) surfaces as a collective mismatch and
     remains fail-stop."""
     Log.info(f"spmd follower loop up (process {__import__('jax').process_index()})")
-    global _REPLICATED
     while True:
         cmd, kwargs = pickle.loads(_bcast_bytes(None))
         if cmd == _SHUTDOWN:
             Log.info("spmd follower shutdown")
             return
         Log.info(f"spmd follower executing {cmd}")
-        _REPLICATED += 1
         try:
-            _COMMANDS[cmd](**kwargs)
+            with replicated_section():
+                _COMMANDS[cmd](**kwargs)
         except Exception:
             import traceback
 
@@ -194,5 +276,3 @@ def follower_loop() -> None:
                 "spmd follower command failed (coordinator job fails with "
                 f"the same error):\n{traceback.format_exc()}"
             )
-        finally:
-            _REPLICATED -= 1

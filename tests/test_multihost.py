@@ -306,3 +306,111 @@ def test_sharded_parse_two_processes(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert f"proc {i} OK sharded ingest" in out
+
+
+@pytest.mark.slow
+def test_grid_over_rest_across_two_processes(tmp_path):
+    """Grid search replicates as ONE spmd command: the deterministic key
+    sequence keeps every rank's grid-model keys aligned (registry.make_key
+    replicated mode), so /99/Grids and predictions work afterwards."""
+    import json
+    import time
+    import urllib.parse
+    import urllib.request
+
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(4)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    df = pd.DataFrame(X, columns=["a", "b", "c"])
+    df["label"] = np.where(X[:, 0] + 0.5 * X[:, 1] > 0, "p", "n")
+    csv = tmp_path / "grid.csv"
+    df.to_csv(csv, index=False)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rest_port = s.getsockname()[1]
+    env = dict(os.environ, XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    logs = [open(tmp_path / f"gproc{i}.log", "wb") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "h2o3_tpu.launch",
+             "--coordinator", f"127.0.0.1:{coord_port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--ip", "127.0.0.1", "--port", str(rest_port)],
+            stdout=logs[i], stderr=subprocess.STDOUT, cwd=repo, env=env,
+        )
+        for i in range(2)
+    ]
+    base = f"http://127.0.0.1:{rest_port}"
+
+    def req(method, path, data=None, as_json=False, timeout=60):
+        if as_json:
+            body = json.dumps(data).encode()
+            r = urllib.request.Request(base + path, data=body, method=method,
+                                       headers={"Content-Type": "application/json"})
+        else:
+            body = urllib.parse.urlencode(data).encode() if data else None
+            r = urllib.request.Request(base + path, data=body, method=method)
+        return json.loads(urllib.request.urlopen(r, timeout=timeout).read())
+
+    try:
+        deadline = time.time() + 120
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                req("GET", "/3/Ping", timeout=5)
+                up = True
+            except Exception:
+                time.sleep(1.0)
+        assert up, "coordinator REST never came up"
+
+        req("POST", "/3/ImportFiles", {"path": str(csv)})
+        pj = req("POST", "/3/Parse", {"source_frames": str(csv),
+                                      "destination_frame": "gfr"})
+        pjid = pj["job"]["key"]["name"]
+        while req("GET", f"/3/Jobs/{pjid}")["jobs"][0]["status"] not in ("DONE", "FAILED"):
+            time.sleep(0.5)
+
+        g = req("POST", "/99/Grid/gbm", {
+            "training_frame": "gfr", "response_column": "label",
+            "ntrees": 3, "max_depth": 2, "seed": 3,
+            "hyper_parameters": {"learn_rate": [0.1, 0.3]},
+        }, as_json=True)
+        gid = g["grid_id"]["name"]
+        jid = g["job"]["key"]["name"]
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            j = req("GET", f"/3/Jobs/{jid}")["jobs"][0]
+            if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+                break
+            time.sleep(1.0)
+        assert j["status"] == "DONE", j.get("exception")
+        grid = req("GET", f"/99/Grids/{gid}")["grids"][0]
+        ids = [m["name"] for m in grid.get("model_ids", [])]
+        assert len(ids) == 2, grid
+        # the grid's models are predictable cross-rank (keys aligned)
+        pred = req("POST", f"/3/Predictions/models/{ids[0]}/frames/gfr", {})
+        assert pred["predictions_frame"]["name"]
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
+        for i in range(2):
+            sys.stderr.write(f"--- gproc{i} tail ---\n")
+            sys.stderr.write((tmp_path / f"gproc{i}.log").read_bytes()[-1500:]
+                             .decode(errors="replace") + "\n")
