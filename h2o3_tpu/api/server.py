@@ -453,6 +453,11 @@ class Endpoints:
         # rank's deterministic key sequence (registry.make_key) keeps the
         # grid's model keys aligned without carrying them individually
         grid_id = grid_id or DKV.make_key("grid")
+        # placeholder so GET /99/Grids/{id} resolves between this response
+        # and the replicated command constructing the real grid
+        from h2o3_tpu.models.grid import Grid as _Grid
+
+        _Grid(grid_id, cls, sorted(hyper))
         job = Job(
             lambda j: spmd.run(
                 "grid", algo=algo, hyper=hyper, criteria=criteria,
@@ -637,6 +642,11 @@ class Endpoints:
                     "job": _job_schema(job),
                     "automl_id": {"name": aml.key}}
         dest = DKV.make_key("automl")
+        # placeholder for the response→command registration window
+        placeholder = AutoML(**kwargs)
+        DKV.remove(placeholder.key)
+        placeholder.key = dest
+        DKV.put(dest, placeholder)
         job = Job(
             lambda j: spmd.run("automl", kwargs=kwargs, y=y, train=train_key,
                                dest=dest),

@@ -82,6 +82,9 @@ def init(
     from h2o3_tpu.utils import telemetry
 
     telemetry.install()
+    from h2o3_tpu.cluster import spmd
+
+    spmd.mark_multi_process(jax.process_count() > 1)  # hot-path flag (DKV keys)
     if mesh is not None:
         _mesh.set_mesh(mesh)
     m = _mesh.get_mesh()

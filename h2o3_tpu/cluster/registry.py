@@ -63,15 +63,14 @@ class DKV:
         # the same (serialized) order, so a counter yields IDENTICAL keys on
         # every rank — which is what lets whole grids/AutoML runs replicate
         # without carrying each model key in the command (cluster/spmd.py).
-        try:
-            from h2o3_tpu.cluster import spmd
+        # _IS_MULTI is a plain module bool set once at cloud init: no jax
+        # import (or exception swallowing) on this hot path.
+        from h2o3_tpu.cluster import spmd
 
-            if spmd.multi_process() and spmd.in_replicated():
-                with cls._mutex:
-                    cls._replicated_seq = getattr(cls, "_replicated_seq", 0) + 1
-                    return f"{prefix}_r{cls._replicated_seq:08d}"
-        except Exception:  # pragma: no cover - jax not initialized yet
-            pass
+        if spmd._IS_MULTI and spmd.in_replicated():
+            with cls._mutex:
+                cls._replicated_seq = getattr(cls, "_replicated_seq", 0) + 1
+                return f"{prefix}_r{cls._replicated_seq:08d}"
         return f"{prefix}_{uuid.uuid4().hex[:12]}"
 
     @classmethod

@@ -76,7 +76,17 @@ def replicated_section():
         _REPLICATED_VAR.reset(token)
 
 
+_IS_MULTI = False  # set once by cluster.cloud.init; read on hot paths
+
+
+def mark_multi_process(flag: bool) -> None:
+    global _IS_MULTI
+    _IS_MULTI = bool(flag)
+
+
 def multi_process() -> bool:
+    if _IS_MULTI:
+        return True
     import jax
 
     return jax.process_count() > 1
@@ -172,6 +182,12 @@ def _exec_grid(algo, hyper, criteria, grid_id, parallelism, kwargs, x, y,
     if multi_process():
         # threads would interleave device programs differently per rank
         parallelism = 1
+        if kwargs.get("export_checkpoints_dir"):
+            raise ValueError(
+                "export_checkpoints_dir is not supported on a multi-process "
+                "cloud: per-rank manifest recovery/writes desynchronize the "
+                "replicated sequence (and corrupt shared manifests)"
+            )
         if (criteria.get("strategy") == "RandomDiscrete"
                 and criteria.get("seed") in (None, -1)):
             raise ValueError(

@@ -80,8 +80,14 @@ class Vec:
             return Vec(arr, STR, name=name, nrow=n)
         npad = pad_to_shards(n)
         if kind == CAT:
-            buf = np.full(npad, -1, dtype=np.int32)
-            buf[:n] = np.asarray(arr, dtype=np.int32)
+            # narrowest signed int that fits the domain — the chunk-
+            # compression-zoo analog (upstream C1Chunk/C2Chunk/C4Chunk pick
+            # bytes per value; SURVEY §2.1): enum HBM drops 4x for <=127
+            # levels, 2x for <=32767. -1 stays the NA sentinel in every width
+            card = len(domain or ())
+            dt = np.int8 if card <= 127 else np.int16 if card <= 32767 else np.int32
+            buf = np.full(npad, -1, dtype=dt)
+            buf[:n] = np.asarray(arr, dtype=dt)
             return Vec(shard_rows(buf), kind, name=name, domain=domain, nrow=n)
         exact = None
         if kind == TIME:

@@ -435,13 +435,15 @@ def parse_sharded(
         k = kinds[c]
         if k == CAT:
             lut = {lv: i for i, lv in enumerate(union[c])}
-            remap = np.array(
-                [lut[lv] for lv in local_domains[c]] or [0], np.int32
-            )
-            codes = np.full(per, -1, np.int32)
+            # same narrowest-dtype rule as Vec.from_numpy so single- and
+            # multi-process clouds store identical dtypes for the same data
+            card = len(union[c])
+            dt = np.int8 if card <= 127 else np.int16 if card <= 32767 else np.int32
+            remap = np.array([lut[lv] for lv in local_domains[c]] or [0], dt)
+            codes = np.full(per, -1, dt)
             lc = local_codes[c]
             codes[: len(lc)] = np.where(lc >= 0, remap[np.clip(lc, 0, None)], -1)
-            data = _global_from_local(codes, np.int32)
+            data = _global_from_local(codes, dt)
             vecs.append(Vec(data, CAT, name=c, domain=tuple(union[c]), nrow=n))
         else:
             vals = np.full(per, np.nan, np.float32)

@@ -221,8 +221,10 @@ class DataInfo:
         if c.pair_domains is not None:  # cat x cat combined factor
             va, vb = frame.vec(c.pair[0]), frame.vec(c.pair[1])
             da, db = c.pair_domains
-            ca = _adapt_codes(va, da)
-            cb = _adapt_codes(vb, db)
+            # int32 BEFORE the product: enum codes may be stored int8/int16
+            # (narrowest-dtype compression) and ca*len(db)+cb overflows there
+            ca = _adapt_codes(va, da).astype(jnp.int32)
+            cb = _adapt_codes(vb, db).astype(jnp.int32)
             codes = jnp.where((ca >= 0) & (cb >= 0), ca * len(db) + cb, -1)
             if self.missing_handling == SKIP:
                 valid = valid * (codes >= 0).astype(jnp.float32)

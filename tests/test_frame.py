@@ -226,3 +226,20 @@ def test_wrapped_cat_vec_remaps_domain():
     # b->1, a->2, c->0, NA stays -1
     np.testing.assert_array_equal(codes, [1, 2, 0, 2, -1])
     assert w.cardinality == 4
+
+
+def test_enum_codes_use_narrowest_dtype():
+    """Chunk-compression-zoo analog: enum device storage picks the
+    narrowest signed int that fits the domain, NA (-1) preserved."""
+    import pandas as pd
+
+    small = h2o3_tpu.upload_file(pd.DataFrame({"g": ["a", "b", None, "a"]}))
+    v = small.vec("g")
+    assert v.data.dtype == np.int8
+    assert v.to_numpy().tolist() == [0, 1, -1, 0]
+
+    wide = h2o3_tpu.upload_file(
+        pd.DataFrame({"g": [f"lvl{i:04d}" for i in range(300)] * 3})
+    )
+    assert wide.vec("g").data.dtype == np.int16
+    assert wide.vec("g").to_numpy().max() == 299
