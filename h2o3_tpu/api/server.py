@@ -505,6 +505,25 @@ class Endpoints:
         return {"__meta": {"schema_type": "TimelineV3"},
                 **telemetry.timeline(int(params.get("n", 200)))}
 
+    def profiler(self, params):
+        """``GET /3/Profiler`` — stack snapshot of every thread (upstream's
+        JProfile/JStack on-demand sampling, SURVEY §5.1). ``depth`` trims
+        frames per thread like upstream's depth parameter."""
+        import sys
+        import traceback
+
+        depth = int(params.get("depth", 20))
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = []
+        for ident, frame in sys._current_frames().items():
+            entries = traceback.format_stack(frame)[-depth:]
+            stacks.append({
+                "thread": names.get(ident, str(ident)),
+                "stack": [e.rstrip() for e in entries],
+            })
+        return {"__meta": {"schema_type": "ProfilerV3"},
+                "nodes": [{"node_name": "coordinator", "profile": stacks}]}
+
     # -- logs (water.util.Log REST surface) --------------------------------
     def logs_get(self, params, node, name):
         lines = list(Log._ring.buffer)
@@ -759,6 +778,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/99/Grids/([^/]+)", _EP.grid_get),
     ("GET", r"/3/Logs/nodes/([^/]+)/files/([^/]+)", _EP.logs_get),
     ("GET", r"/3/Timeline", _EP.timeline),
+    ("GET", r"/3/Profiler", _EP.profiler),
     ("GET", r"/3/Models", _EP.models_list),
     ("POST", r"/99/Models\.bin/([^/]+)", _EP.model_save_bin),
     ("POST", r"/99/Models\.bin", _EP.model_load_bin),

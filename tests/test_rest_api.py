@@ -240,3 +240,11 @@ def test_models_bin_save_load_roundtrip(server, tmp_path):
     loaded = req("POST", f"/99/Models.bin?dir={urllib.parse.quote(saved['dir'])}")
     m = loaded["models"][0]
     assert m["output"]["training_metrics"]["auc"] > 0.7
+
+
+def test_profiler_route(server):
+    """/3/Profiler returns per-thread stacks (JProfile/JStack successor)."""
+    out = _get(server, "/3/Profiler?depth=5")
+    prof = out["nodes"][0]["profile"]
+    assert any("MainThread" in p["thread"] or p["stack"] for p in prof)
+    assert all(len(p["stack"]) <= 5 for p in prof)
