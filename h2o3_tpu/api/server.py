@@ -512,7 +512,7 @@ class Endpoints:
         import sys
         import traceback
 
-        depth = int(params.get("depth", 20))
+        depth = max(1, int(params.get("depth", 20)))  # -0 slices keep ALL
         names = {t.ident: t.name for t in threading.enumerate()}
         stacks = []
         for ident, frame in sys._current_frames().items():

@@ -246,5 +246,6 @@ def test_profiler_route(server):
     """/3/Profiler returns per-thread stacks (JProfile/JStack successor)."""
     out = _get(server, "/3/Profiler?depth=5")
     prof = out["nodes"][0]["profile"]
-    assert any("MainThread" in p["thread"] or p["stack"] for p in prof)
+    assert any("MainThread" in p["thread"] for p in prof)
+    assert all(p["stack"] for p in prof)
     assert all(len(p["stack"]) <= 5 for p in prof)
