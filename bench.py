@@ -313,6 +313,25 @@ def _bench_join_10m() -> dict:
     return res
 
 
+def _bench_glm_1m(fr) -> dict:
+    """GLM binomial IRLS on the bench frame (BASELINE config #1 analog):
+    Gram + solve per iteration, the hex.glm hot loop."""
+    from h2o3_tpu.models.glm import GLM
+
+    kw = dict(family="binomial", lambda_=1e-4, max_iterations=20, seed=1)
+    GLM(**kw).train(y="label", training_frame=fr)  # compile
+    t0 = time.time()
+    m = GLM(**kw).train(y="label", training_frame=fr)
+    dt = time.time() - t0
+    iters = len(m.scoring_history) or kw["max_iterations"]
+    return {
+        "rows": N_ROWS,
+        "seconds": round(dt, 3),
+        "auc": round(float(m.training_metrics.auc), 4),
+        "iterations": iters,
+    }
+
+
 def main() -> None:
     try:
         _init_with_retry()
@@ -359,6 +378,10 @@ def main() -> None:
             payload["join_10m"] = _bench_join_10m()
         except Exception as e:
             payload["join_10m_error"] = repr(e)
+        try:  # GLM IRLS at 1M rows (BASELINE config #1: Airlines-1M analog)
+            payload["glm_1m"] = _bench_glm_1m(fr)
+        except Exception as e:
+            payload["glm_1m_error"] = repr(e)
         try:
             breakdown, hist_flops = _phase_breakdown(fr, N_TREES, dt)
             payload["breakdown"] = breakdown
