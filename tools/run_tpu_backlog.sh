@@ -1,0 +1,24 @@
+#!/bin/sh
+# TPU measurement backlog — run the moment the axon tunnel is back up.
+# Captures everything round 4 built but could not measure (the tunnel went
+# down ~15:00Z on 2026-07-30 and stayed down):
+#   1. bench.py with bin adaptivity + packed transfers + depth-20 live
+#      (headline + scale_10m + join_10m + glm_1m), artifact committed.
+#   2. adaptivity A/B (H2O3_TPU_BIN_ADAPT=0 control run).
+#   3. Pallas tile sweep (tools/bench_kernel_sweep.py) for the next kernel
+#      iteration.
+set -x
+cd "$(dirname "$0")/.."
+
+stamp=$(date -u +%Y%m%dT%H%M%SZ)
+timeout 1200 python bench.py | tee "BENCH_builder_${stamp}.json"
+
+H2O3_TPU_BIN_ADAPT=0 timeout 1200 python bench.py \
+  | tee "BENCH_builder_${stamp}_noadapt.json"
+
+timeout 2400 python tools/bench_kernel_sweep.py \
+  | tee "KERNEL_SWEEP_${stamp}.jsonl"
+
+git add "BENCH_builder_${stamp}.json" "BENCH_builder_${stamp}_noadapt.json" \
+        "KERNEL_SWEEP_${stamp}.jsonl"
+git commit -m "TPU measurement backlog: bench (adapt on/off) + kernel tile sweep"
