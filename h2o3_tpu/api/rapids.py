@@ -414,6 +414,10 @@ def _apply(op: str, raw_args: list, sess: Session):
         seed = int(args[1]) if len(args) > 1 and args[1] is not None else -1
         rng = np.random.default_rng(seed if seed > 0 else None)
         return Vec.from_numpy(rng.random(fr.nrow), "real")
+    if op == "relevel":  # (relevel vec 'y')
+        return OPS.relevel(_as_vec(args[0]), str(args[1]))
+    if op == "signif":
+        return OPS.signif(_as_vec(args[0]), int(args[1]) if len(args) > 1 else 6)
     if op in ("asfactor", "as.factor"):
         return OPS.asfactor(_as_vec(args[0]))
     if op in ("asnumeric", "as.numeric"):

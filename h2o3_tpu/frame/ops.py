@@ -807,6 +807,31 @@ def stratified_split(y: Vec, test_frac: float = 0.2, seed: int = -1) -> Vec:
     return Vec.from_numpy(out, CAT, name="test_train_split", domain=("train", "test"))
 
 
+def relevel(v: Vec, y: str) -> Vec:
+    """``ASTRelevel`` successor (h2o.relevel): move level ``y`` to the front
+    of the domain (the reference level for GLM one-hot drops)."""
+    if v.kind != CAT:
+        raise ValueError("relevel needs a categorical column")
+    dom = list(v.domain or ())
+    if y not in dom:
+        raise ValueError(f"level {y!r} not in domain")
+    new_dom = [y] + [d for d in dom if d != y]
+    lut = np.array([new_dom.index(d) for d in dom], np.int32)
+    codes = v.to_numpy()
+    remapped = np.where(codes >= 0, lut[np.clip(codes, 0, None).astype(np.int64)], -1)
+    return Vec.from_numpy(remapped, CAT, name=v.name, domain=new_dom)
+
+
+def signif(v: Vec, digits: int = 6) -> Vec:
+    """R ``signif``: round to significant digits (ASTSignif)."""
+    x = v.to_numpy().astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mag = np.where(x != 0, np.floor(np.log10(np.abs(x))), 0.0)
+        factor = np.power(10.0, digits - 1 - mag)
+        out = np.where(np.isfinite(x), np.round(x * factor) / factor, x)
+    return Vec.from_numpy(out, NUM, name=v.name)
+
+
 def cut(v: Vec, breaks: Sequence[float], labels: Sequence[str] | None = None,
         include_lowest: bool = False, right: bool = True) -> Vec:
     """``ASTCut`` successor: numeric → enum by interval."""

@@ -460,3 +460,21 @@ class TestRapidsWave4:
         assert w == [0, 2]
         out2 = rapids_eval("(tmp= rw4_no (na.omit rw4))")
         assert DKV.get("rw4_no").nrow == 4
+
+
+def test_relevel_and_signif():
+    df = pd.DataFrame({"g": ["b", "c", "a", None, "b"], "v": [123456.0, 0.0012349, -9.87654e5, np.nan, 0.0]})
+    fr = h2o3_tpu.upload_file(df)
+    rv = ops.relevel(fr.vec("g"), "c")
+    assert rv.levels()[0] == "c"
+    # values preserved: decode both and compare labels
+    dom_old = fr.vec("g").levels()
+    dom_new = rv.levels()
+    old = [dom_old[int(c)] if c >= 0 else None for c in fr.vec("g").to_numpy()]
+    new = [dom_new[int(c)] if c >= 0 else None for c in rv.to_numpy()]
+    assert old == new
+    sg = ops.signif(fr.vec("v"), 3).to_numpy()
+    np.testing.assert_allclose(sg[0], 123000.0)
+    np.testing.assert_allclose(sg[1], 0.00123)
+    np.testing.assert_allclose(sg[2], -988000.0)
+    assert np.isnan(sg[3]) and sg[4] == 0.0
