@@ -188,7 +188,11 @@ class DRF(ModelBuilder):
             cap = scan_chunk_cap(p.max_depth, n_bins)
             interval = max(1, p.score_tree_interval)
             m_done = start_trees
-            while m_done < p.ntrees and not job.stop_requested:
+            # first chunk always runs (≥1 tree even if max_runtime expired
+            # during setup — upstream keeps a non-empty partial model)
+            while m_done < p.ntrees and (
+                m_done == start_trees or not job.stop_requested
+            ):
                 chunk = min(interval, cap, p.ntrees - m_done)
                 chunk_trees: list[list[Tree]] = [[] for _ in range(chunk)]
                 for k in range(n_out):
@@ -237,8 +241,8 @@ class DRF(ModelBuilder):
                 job.update(0.05 + 0.9 * m_done / p.ntrees)
 
         for m in range(start_trees if not use_scan else p.ntrees, p.ntrees):
-            if job.stop_requested:
-                break
+            if job.stop_requested and m > start_trees:
+                break  # always ≥1 tree (see scan loop comment)
             rngkey, sk = jax.random.split(rngkey)
             mask = jax.random.bernoulli(sk, p.sample_rate, (npad,)).astype(jnp.float32)
             w_tree = w * mask

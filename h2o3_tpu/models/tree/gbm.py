@@ -419,7 +419,12 @@ class GBM(ModelBuilder):
             cap = scan_chunk_cap(p.max_depth, n_bins)
             interval = max(1, p.score_tree_interval)
             m_done = start_trees
-            while m_done < p.ntrees and not job.stop_requested:
+            # first chunk always runs: a max_runtime that expires during
+            # setup/compile must still leave a scoreable 1+-tree model
+            # (upstream keeps a non-empty partial model)
+            while m_done < p.ntrees and (
+                m_done == start_trees or not job.stop_requested
+            ):
                 chunk = min(interval, cap, p.ntrees - m_done)
                 lrs = lr * (p.learn_rate_annealing ** np.arange(chunk))
                 F, varimp_dev, stacked = build_trees_scanned(
@@ -465,8 +470,8 @@ class GBM(ModelBuilder):
                 job.update(0.05 + 0.9 * m_done / p.ntrees)
 
         for m in range(start_trees if not use_scan else p.ntrees, p.ntrees):
-            if job.stop_requested:
-                break
+            if job.stop_requested and m > start_trees:
+                break  # always ≥1 tree (see scan loop comment)
             # row sampling (per tree)
             if p.sample_rate < 1.0:
                 rngkey, sk = jax.random.split(rngkey)
