@@ -31,6 +31,19 @@ class RapidsError(Exception):
     pass
 
 
+def _require_seed_if_replicated(op: str, seed: int) -> None:
+    """Random ops on a multi-process cloud need an explicit seed: each rank
+    evaluates the expression itself (spmd replication), and unseeded draws
+    would give every rank a DIFFERENT frame — silent cross-rank divergence."""
+    from h2o3_tpu.cluster import spmd
+
+    if seed <= 0 and spmd.multi_process():
+        raise RapidsError(
+            f"{op} on a multi-process cloud requires an explicit positive "
+            "seed (every rank must draw identical values)"
+        )
+
+
 # ---------------------------------------------------------------------------
 # tokenizer / parser
 
@@ -392,6 +405,7 @@ def _apply(op: str, raw_args: list, sess: Session):
         # (h2o.random_stratified_split y test_frac seed) — upstream arg order
         frac = float(args[1]) if len(args) > 1 and args[1] is not None else 0.2
         seed = int(args[2]) if len(args) > 2 and args[2] is not None else -1
+        _require_seed_if_replicated("h2o.random_stratified_split", seed)
         return OPS.stratified_split(_as_vec(args[0]), test_frac=frac, seed=seed)
     if op == "table":
         v2 = _as_vec(args[1]) if len(args) > 1 and isinstance(args[1], (Frame, Vec)) else None
@@ -412,6 +426,7 @@ def _apply(op: str, raw_args: list, sess: Session):
     if op == "h2o.runif":
         fr = _as_frame(args[0])
         seed = int(args[1]) if len(args) > 1 and args[1] is not None else -1
+        _require_seed_if_replicated("h2o.runif", seed)
         rng = np.random.default_rng(seed if seed > 0 else None)
         return Vec.from_numpy(rng.random(fr.nrow), "real")
     if op == "relevel":  # (relevel vec 'y')

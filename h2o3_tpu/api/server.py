@@ -86,17 +86,6 @@ class ApiError(Exception):
 # endpoint logic ("Handlers")
 
 
-def _spmd_v1_guard(what: str) -> None:
-    """Multi-process clouds replicate parse/build/predict only (spmd.py v1
-    scope); coordinator-local device work on sharded data would deadlock the
-    ranks, so reject it cleanly."""
-    from h2o3_tpu.cluster import spmd
-
-    if spmd.multi_process():
-        raise ApiError(501, f"{what} is not yet supported on a multi-process "
-                            "cloud (spmd v1 replicates Parse/build/predict)")
-
-
 def _frame_schema(fr: Frame, key: str) -> dict:
     from h2o3_tpu.cluster import spmd
 
@@ -106,9 +95,12 @@ def _frame_schema(fr: Frame, key: str) -> dict:
         # per-column device stats dispatch device programs; on a multi-process
         # cloud a REST thread doing that unreplicated deadlocks the ranks
         # (and checking in_replicated() here would race a concurrent build
-        # job's flag) — serve metadata only there
+        # job's flag) — serve only CACHED stats there (a replicated
+        # frame_summary populates the cache on every rank)
         st = {}
-        if hasattr(v, "stats") and not spmd.multi_process():
+        if hasattr(v, "stats") and (
+            not spmd.multi_process() or getattr(v, "_stats", None) is not None
+        ):
             st = v.stats()
         cols.append({
             "label": name,
@@ -254,34 +246,42 @@ class Endpoints:
         return {"__meta": {"schema_type": "Frames"}, "frames": [_frame_schema(fr, key)]}
 
     def frame_summary(self, params, key):
-        _spmd_v1_guard("Frame summary")
+        from h2o3_tpu.cluster import spmd
+
         fr = DKV.get(key)
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {key} not found")
+        # replicated: every rank computes (and caches) the rollup stats, so
+        # the per-column pulls are collectives entered by all ranks together
+        summary = spmd.run("frame_summary", key=key)
         return {"__meta": {"schema_type": "FrameSummary"},
                 "frames": [_frame_schema(fr, key)],
-                "summary": json.loads(fr.describe().to_json())}
+                "summary": json.loads(summary.to_json())}
 
     def frame_delete(self, params, key):
-        DKV.remove(key)
+        from h2o3_tpu.cluster import spmd
+
+        spmd.run("remove", key=key)  # replicated: every rank's DKV must agree
         return {"__meta": {"schema_type": "Frames"}, "frames": []}
 
     def download_dataset(self, params):
-        _spmd_v1_guard("DownloadDataset")
         """``/3/DownloadDataset?frame_id=…`` — frame rows as CSV (the route
         h2o clients use to materialize frames locally)."""
+        from h2o3_tpu.cluster import spmd
+
         key = params.get("frame_id")
         key = key["name"] if isinstance(key, dict) else key
         fr = DKV.get(key)
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {key} not found")
-        csv = fr.to_pandas().to_csv(index=False)
+        csv = spmd.run("frame_pull", key=key).to_csv(index=False)
         return {"__binary__": csv.encode(), "content_type": "text/csv",
                 "filename": f"{key}.csv"}
 
     def frame_export(self, params, key):
-        _spmd_v1_guard("Frames export")
         """``/3/Frames/{id}/export`` — CSV/Parquet to a server-side path."""
+        from h2o3_tpu.cluster import spmd
+
         fr = DKV.get(key)
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {key} not found")
@@ -289,9 +289,8 @@ class Endpoints:
         if not path:
             raise ApiError(400, "path parameter is required")
         force = str(params.get("force", "false")).lower() in ("1", "true")
-        from h2o3_tpu.persist import export_file
-
-        export_file(fr, path, force=force, format=params.get("format"))
+        spmd.run("frame_export", key=key, path=path, force=force,
+                 format=params.get("format"))
         return {"__meta": {"schema_type": "Frames"}, "path": path}
 
     # -- jobs -------------------------------------------------------------
@@ -534,26 +533,25 @@ class Endpoints:
 
     # -- mojo download (GET /3/Models/{id}/mojo) ----------------------------
     def model_save_bin(self, params, key):
-        _spmd_v1_guard("Models.bin save")
         """``POST /99/Models.bin/{model}?dir=`` — binary save (upstream
         ``water.api.ModelsHandler`` save route)."""
-        from h2o3_tpu.persist import save_model
+        from h2o3_tpu.cluster import spmd
 
         m = _get_model(key)
         d = params.get("dir") or "."
-        path = save_model(m, d, force=str(params.get("force", "1")).lower() in ("1", "true"))
+        path = spmd.run("model_save", key=m.key, dir=d,
+                        force=str(params.get("force", "1")).lower() in ("1", "true"))
         return {"__meta": {"schema_type": "Models"}, "dir": path,
                 "models": [{"model_id": {"name": m.key}}]}
 
     def model_load_bin(self, params):
-        _spmd_v1_guard("Models.bin load")
         """``POST /99/Models.bin?dir=`` — binary load."""
-        from h2o3_tpu.persist import load_model
+        from h2o3_tpu.cluster import spmd
 
         d = params.get("dir")
         if not d:
             raise ApiError(400, "dir is required")
-        m = load_model(d)
+        m = spmd.run("model_load", dir=d)
         return {"__meta": {"schema_type": "Models"},
                 "models": [_model_schema(m)]}
 
@@ -588,7 +586,9 @@ class Endpoints:
         return {"__meta": {"schema_type": "Models"}, "models": [_model_schema(m)]}
 
     def model_delete(self, params, key):
-        DKV.remove(key)
+        from h2o3_tpu.cluster import spmd
+
+        spmd.run("remove", key=key)  # replicated: every rank's DKV must agree
         return {"__meta": {"schema_type": "Models"}, "models": []}
 
     # -- predictions ------------------------------------------------------
@@ -690,13 +690,16 @@ class Endpoints:
 
     # -- rapids (frame expression eval) -----------------------------------
     def rapids(self, params):
-        _spmd_v1_guard("Rapids")
-        from h2o3_tpu.api.rapids import rapids_eval
+        from h2o3_tpu.api.rapids import RapidsError
+        from h2o3_tpu.cluster import spmd
 
         ast = params.get("ast")
         if not ast:
             raise ApiError(400, "ast is required")
-        result = rapids_eval(ast, session=params.get("session_id"))
+        try:
+            result = spmd.run("rapids", ast=ast, session=params.get("session_id"))
+        except RapidsError as e:
+            raise ApiError(400, str(e))
         return {"__meta": {"schema_type": "Rapids"}, **result}
 
 

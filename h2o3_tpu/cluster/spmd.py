@@ -14,14 +14,17 @@ ranks' collective sequences aligned, exactly as H2O relies on every node
 running the same jar.
 
 Replicated commands: Parse (incl. sharded), model build, predict, grid
-search, AutoML. Grid/AutoML replication rides the deterministic key
-sequence ``DKV.make_key`` switches to inside replicated execution — every
-rank names the grid's/leaderboard's models identically without shipping
+search, AutoML, Rapids eval, frame summary/download/export, and binary
+model save/load. Grid/AutoML/Rapids replication rides the deterministic
+key sequence ``DKV.make_key`` switches to inside replicated execution —
+every rank names result frames and models identically without shipping
 keys. Wall-clock budgets (``max_runtime_secs``) are rejected on
 multi-process clouds: ranks' clocks diverge and would desynchronize the
-collective sequence; use ``max_models``. Rapids frame mutations and
-dataset download/export stay coordinator-local and return 501 (the
-remaining v2 surface).
+collective sequence; use ``max_models``. Random Rapids ops (``h2o.runif``,
+stratified split) demand an explicit seed for the same reason. File
+writes (export, model save) pull collectively on every rank but write
+from the coordinator only; file reads (model load) require the path to be
+readable on every rank, the same contract as parse sources.
 
 The broadcast payload is length-prefixed and padded to a power of two so the
 number of distinct broadcast programs stays O(log max_payload).
@@ -231,12 +234,102 @@ def _exec_automl(kwargs, y, train, dest):
     return aml
 
 
+def _exec_rapids(ast: str, session):
+    from h2o3_tpu.api.rapids import rapids_eval
+
+    # every rank evaluates the same expression string against its copy of the
+    # session; result keys come from DKV.make_key's replicated counter, so
+    # ranks agree without shipping keys. Host pulls inside ops (quantile,
+    # stratified_split, merge keys …) become collectives here.
+    return rapids_eval(ast, session=session)
+
+
+def _exec_frame_summary(key: str):
+    from h2o3_tpu.cluster.registry import DKV
+
+    fr = DKV.get(key)
+    if fr is None:
+        raise KeyError(f"Frame {key} not found")
+    # describe() computes + caches per-Vec rollup stats — collective pulls —
+    # on every rank; the route layer shapes the coordinator's copy
+    return fr.describe()
+
+
+def _exec_frame_pull(key: str):
+    from h2o3_tpu.cluster.registry import DKV
+
+    fr = DKV.get(key)
+    if fr is None:
+        raise KeyError(f"Frame {key} not found")
+    return fr.to_pandas()
+
+
+def _exec_frame_export(key: str, path: str, force: bool, format):
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.persist import export_df
+
+    fr = DKV.get(key)
+    if fr is None:
+        raise KeyError(f"Frame {key} not found")
+    df = fr.to_pandas()  # collective pull on every rank …
+    if is_coordinator():  # … but exactly one writer (shared-fs safe)
+        return export_df(df, path, force=force, format=format)
+    return path
+
+
+def _exec_model_save(key: str, dir: str, force: bool):
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.persist import (
+        resolve_model_path,
+        serialize_model,
+        write_model_bytes,
+    )
+
+    model = DKV.get(key)
+    # pulls FIRST on every rank, resolve/exists-check after and coordinator-
+    # only: the exists/force answer depends on the coordinator's filesystem,
+    # so followers cannot evaluate it identically — checking before the
+    # collective pulls would let rank 0 bail while the others enter them.
+    # A force=False collision wastes one pull; the cloud stays in lockstep.
+    data = serialize_model(model)
+    if is_coordinator():
+        backend, p = resolve_model_path(dir, model.key, force)
+        return write_model_bytes(data, backend, p, model.key)
+    return None
+
+
+def _exec_remove(key: str):
+    from h2o3_tpu.cluster.registry import DKV
+
+    # deletes must replicate or the ranks' DKVs diverge: a key deleted on the
+    # coordinator alone would still resolve on followers, so a later rapids
+    # command referencing it fails on rank 0 but RUNS on the others —
+    # advancing their replicated key counters (permanent key skew) or
+    # entering a collective alone (wedged cloud). No collectives inside.
+    DKV.remove(key)
+
+
+def _exec_model_load(dir: str):
+    from h2o3_tpu.persist import load_model
+
+    # the file must be on a path every rank can read (same contract as
+    # parse sources); the model key is stored in the file, so ranks agree
+    return load_model(dir)
+
+
 _COMMANDS = {
     "parse": _exec_parse,
     "build": _exec_build,
     "predict": _exec_predict,
     "grid": _exec_grid,
     "automl": _exec_automl,
+    "rapids": _exec_rapids,
+    "frame_summary": _exec_frame_summary,
+    "frame_pull": _exec_frame_pull,
+    "frame_export": _exec_frame_export,
+    "model_save": _exec_model_save,
+    "model_load": _exec_model_load,
+    "remove": _exec_remove,
 }
 
 _SHUTDOWN = "__shutdown__"

@@ -277,15 +277,12 @@ _REBUILDERS["deeplearning"] = _rebuild_deeplearning
 # save / load
 
 
-def save_model(model: Model, path: str, force: bool = True) -> str:
-    """``h2o.save_model`` successor. ``path`` may be a directory (H2O
-    convention: file named after the model key) or a full file path."""
-    backend, p = _backend_for(path)
-    if os.path.isdir(p) or path.endswith(("/", os.sep)):
-        p = os.path.join(p, model.key)
-    if os.path.exists(p) and not force:
-        raise FileExistsError(p)
+def serialize_model(model: Model) -> bytes:
+    """Model → portable byte string (the device→host pulls happen here).
 
+    Split out of :func:`save_model` so a multi-process cloud can run the
+    pulls — collectives when output arrays span processes — on EVERY rank
+    while only the coordinator writes the file (cluster/spmd.py)."""
     state = dict(model.__dict__)
     out = _pull_tree_output(state.pop("output"))
     for k in _STRIP.get(model.algo, ()):
@@ -298,10 +295,33 @@ def save_model(model: Model, path: str, force: bool = True) -> str:
     buf = io.BytesIO()
     buf.write(FORMAT_MAGIC)
     pickle.dump(payload, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def resolve_model_path(path: str, model_key: str, force: bool = True):
+    """(backend, final_path) for a model save; raises FileExistsError when
+    ``force`` is off and the target exists. Shared by :func:`save_model` and
+    the replicated spmd save command (which writes coordinator-side only)."""
+    backend, p = _backend_for(path)
+    if os.path.isdir(p) or path.endswith(("/", os.sep)):
+        p = os.path.join(p, model_key)
+    if os.path.exists(p) and not force:
+        raise FileExistsError(p)
+    return backend, p
+
+
+def write_model_bytes(data: bytes, backend, p: str, model_key: str) -> str:
     with backend.open_write(p) as f:
-        f.write(buf.getvalue())
-    Log.info(f"saved model {model.key} to {p}")
+        f.write(data)
+    Log.info(f"saved model {model_key} to {p}")
     return p
+
+
+def save_model(model: Model, path: str, force: bool = True) -> str:
+    """``h2o.save_model`` successor. ``path`` may be a directory (H2O
+    convention: file named after the model key) or a full file path."""
+    backend, p = resolve_model_path(path, model.key, force)
+    return write_model_bytes(serialize_model(model), backend, p, model.key)
 
 
 def load_model(path: str) -> Model:
@@ -330,11 +350,17 @@ def export_file(frame, path: str, force: bool = False, format: str | None = None
     """``h2o.export_file`` successor — frame → CSV/Parquet through the
     Persist scheme dispatch (ref upstream water/api FramesHandler export +
     Persist SPI [UNVERIFIED], SURVEY.md §5.4)."""
+    return export_df(frame.to_pandas(), path, force=force, format=format)
+
+
+def export_df(df, path: str, force: bool = False, format: str | None = None) -> str:
+    """Write an already-materialized pandas frame (the host pull — a
+    collective on multi-process clouds — happens in the caller, so every
+    rank can pull while only the coordinator writes; cluster/spmd.py)."""
     backend, p = _backend_for(path)
     if isinstance(backend, PersistFS) and os.path.exists(p) and not force:
         raise FileExistsError(p)
     fmt = (format or "").lower() or ("parquet" if p.endswith((".parquet", ".pq")) else "csv")
-    df = frame.to_pandas()
     with backend.open_write(p) as f:
         if fmt == "parquet":
             df.to_parquet(f, index=False)

@@ -107,6 +107,7 @@ def test_launch_rest_train_across_two_processes(tmp_path):
     row). Default tier: tiny shapes, 2 CPU devices per process."""
     import json
     import time
+    import urllib.error
     import urllib.request
 
     import numpy as np
@@ -194,6 +195,49 @@ def test_launch_rest_train_across_two_processes(tmp_path):
 
         pred = req("POST", f"/3/Predictions/models/{mkey}/frames/mh", {})
         assert pred["predictions_frame"]["name"]
+
+        # -- spmd v3 surfaces on the SAME live cloud (boot is the expensive
+        # part): Rapids eval, frame summary, CSV download, export, and
+        # binary model save + load all replicate across both ranks --------
+        r = req("POST", "/99/Rapids",
+                {"ast": "(tmp= mh_sub (cols_py mh ['a' 'b']))"})
+        assert r["num_cols"] == 2 and r["num_rows"] == 400, r
+        r = req("POST", "/99/Rapids", {"ast": "(mean (cols_py mh 'a'))"})
+        assert "scalar" in r or "key" in r, r
+
+        s = req("GET", "/3/Frames/mh/summary")
+        assert s["summary"], s
+        # the replicated describe cached rollups: plain frame GET now serves
+        # real per-column stats even on the multi-process cloud
+        fg = req("GET", "/3/Frames/mh")["frames"][0]
+        acol = next(c for c in fg["columns"] if c["label"] == "a")
+        assert acol["mean"] is not None
+
+        raw = urllib.request.urlopen(
+            f"{base}/3/DownloadDataset?frame_id=mh", timeout=60).read()
+        assert raw.decode().count("\n") >= 400
+
+        out_csv = tmp_path / "mh_export.csv"
+        req("POST", "/3/Frames/mh/export",
+            {"path": str(out_csv), "force": "true"})
+        assert out_csv.exists() and out_csv.stat().st_size > 1000
+
+        sv = req("POST", f"/99/Models.bin/{mkey}", {"dir": str(tmp_path)})
+        assert sv["dir"], sv
+        lr = req("POST", "/99/Models.bin", {"dir": sv["dir"]})
+        assert lr["models"][0]["model_id"]["name"] == mkey
+        pred2 = req("POST", f"/3/Predictions/models/{mkey}/frames/mh", {})
+        assert pred2["predictions_frame"]["name"]
+
+        # unseeded random ops must be rejected (cross-rank divergence)
+        try:
+            req("POST", "/99/Rapids", {"ast": "(tmp= rnd (h2o.runif mh -1))"})
+            raise AssertionError("unseeded h2o.runif should 4xx on a "
+                                 "multi-process cloud")
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 412), e.code
+        r = req("POST", "/99/Rapids", {"ast": "(tmp= rnd (h2o.runif mh 42))"})
+        assert r["num_rows"] == 400, r
     finally:
         for p in procs:
             p.terminate()

@@ -161,7 +161,7 @@ def test_rapids_parse_errors_are_4xx(server):
         _post(server, "/99/Rapids", {"ast": "(nosuchop 1 2)"})
         assert False
     except urllib.error.HTTPError as e:
-        assert e.code == 500
+        assert e.code == 400
 
 
 def test_wave3_algos_build_over_rest(server):
