@@ -18,6 +18,7 @@ vs_baseline = measured/1.0.
 
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -260,28 +261,60 @@ def _phase_breakdown(fr, n_trees: int, total_s: float) -> tuple[dict, float]:
     return per_tree, hist_flops
 
 
+def _make_data_device(n: int, c: int = N_COLS, seed: int = 0):
+    """Bench frame synthesized ON DEVICE (same generative model as
+    :func:`make_data`): a 10M-row frame is ~1.2 GB — at tunneled-TPU
+    host→device bandwidth the upload alone blew the bench budget, and the
+    metric here is trees/sec, not ingest."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.frame.frame import CAT, NUM, Frame, Vec
+    from h2o3_tpu.parallel.mesh import pad_to_shards, row_sharding
+
+    npad = pad_to_shards(n)
+
+    @functools.partial(jax.jit, out_shardings=row_sharding())
+    def gen(key):
+        kx, ku = jax.random.split(key)
+        X = jax.random.normal(kx, (npad, c), jnp.float32)
+        eta = (1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
+               + jnp.sin(2 * X[:, 4]) + 0.5 * X[:, 5] ** 2 - 1.0)
+        u = jax.random.uniform(ku, (npad,))
+        y = (u < jax.nn.sigmoid(eta)).astype(jnp.int8)
+        pad = jnp.arange(npad) >= n
+        X = jnp.where(pad[:, None], jnp.nan, X)
+        y = jnp.where(pad, -1, y).astype(jnp.int8)
+        return X, y
+
+    X, y = gen(jax.random.PRNGKey(seed))
+    vecs = [Vec(X[:, i], NUM, name=f"f{i}", nrow=n) for i in range(c)]
+    vecs.append(Vec(y, CAT, name="label", nrow=n, domain=("b", "s")))
+    return Frame(vecs, register=True)
+
+
 def _bench_10m() -> dict:
     """GBM at 10M rows single chip (binned uint8 ≈ 280 MB on device)."""
-    import h2o3_tpu
+    from h2o3_tpu.cluster.registry import DKV
     from h2o3_tpu.models.tree import GBM
 
-    df = make_data(n=10_000_000)
-    fr = h2o3_tpu.upload_file(df)
-    kw = dict(max_depth=DEPTH, learn_rate=0.1, min_rows=10.0,
-              score_tree_interval=1000, seed=42)
-    GBM(ntrees=5, **kw).train(y="label", training_frame=fr)  # compile
-    t0 = time.time()
-    m = GBM(ntrees=5, **kw).train(y="label", training_frame=fr)
-    dt = time.time() - t0
-    out = {
-        "rows": 10_000_000,
-        "trees_per_sec": round(5 / dt, 3),
-        "auc": round(float(m.training_metrics.auc), 4),
-    }
-    from h2o3_tpu.cluster.registry import DKV
-
-    DKV.remove(fr.key)
-    return out
+    fr = _make_data_device(10_000_000)
+    try:
+        kw = dict(max_depth=DEPTH, learn_rate=0.1, min_rows=10.0,
+                  score_tree_interval=1000, seed=42)
+        GBM(ntrees=5, **kw).train(y="label", training_frame=fr)  # compile
+        t0 = time.time()
+        m = GBM(ntrees=5, **kw).train(y="label", training_frame=fr)
+        dt = time.time() - t0
+        return {
+            "rows": 10_000_000,
+            "trees_per_sec": round(5 / dt, 3),
+            "auc": round(float(m.training_metrics.auc), 4),
+        }
+    finally:
+        # failure path too: a leaked 10M frame starves every later entry
+        DKV.remove(fr.key)
+        del fr
 
 
 def _bench_join_10m() -> dict:
@@ -289,28 +322,46 @@ def _bench_join_10m() -> dict:
     import h2o3_tpu
     from h2o3_tpu.frame import ops
 
-    rng = np.random.default_rng(1)
-    left = h2o3_tpu.upload_file(
-        pd.DataFrame({"k": rng.integers(0, 1_000_000, 10_000_000),
-                      "x": rng.normal(size=10_000_000).astype(np.float32)})
-    )
-    right = h2o3_tpu.upload_file(
-        pd.DataFrame({"k": np.arange(1_000_000),
-                      "y": rng.normal(size=1_000_000).astype(np.float32)})
-    )
-    out = ops.merge(left, right, by=["k"])  # warm compile
-    t0 = time.time()
-    out = ops.merge(left, right, by=["k"])
-    dt = time.time() - t0
-    res = {"left_rows": 10_000_000, "right_rows": 1_000_000,
-           "out_rows": out.nrow, "seconds": round(dt, 3),
-           "rows_per_sec": round(out.nrow / dt, 0)}
-    from h2o3_tpu.cluster.registry import DKV
+    import jax
+    import jax.numpy as jnp
 
-    for fr in (left, right):  # free HBM before the phase breakdown runs
-        DKV.remove(fr.key)
-    del left, right, out
-    return res
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.frame.frame import NUM, Frame, Vec
+    from h2o3_tpu.parallel.mesh import pad_to_shards, row_sharding
+
+    def _dev_frame(n, key, kmax, with_x):
+        npad = pad_to_shards(n)
+
+        @functools.partial(jax.jit, out_shardings=row_sharding())
+        def gen(k):
+            kk, kx = jax.random.split(k)
+            ks = (jax.random.randint(kk, (npad,), 0, kmax) if with_x
+                  else jnp.arange(npad)).astype(jnp.float32)
+            xs = jax.random.normal(kx, (npad,), jnp.float32)
+            pad = jnp.arange(npad) >= n
+            return (jnp.where(pad, jnp.nan, ks), jnp.where(pad, jnp.nan, xs))
+
+        ks, xs = gen(key)
+        return Frame([Vec(ks, NUM, name="k", nrow=n),
+                      Vec(xs, NUM, name="x" if with_x else "y", nrow=n)],
+                     register=True)
+
+    left = right = out = None
+    try:
+        left = _dev_frame(10_000_000, jax.random.PRNGKey(1), 1_000_000, True)
+        right = _dev_frame(1_000_000, jax.random.PRNGKey(2), 1_000_000, False)
+        out = ops.merge(left, right, by=["k"])  # warm compile
+        t0 = time.time()
+        out = ops.merge(left, right, by=["k"])
+        dt = time.time() - t0
+        return {"left_rows": 10_000_000, "right_rows": 1_000_000,
+                "out_rows": out.nrow, "seconds": round(dt, 3),
+                "rows_per_sec": round(out.nrow / dt, 0)}
+    finally:
+        for fr in (left, right):  # free HBM before the phase breakdown runs
+            if fr is not None:
+                DKV.remove(fr.key)
+        del left, right, out
 
 
 def _bench_glm_1m(fr) -> dict:

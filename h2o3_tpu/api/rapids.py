@@ -174,9 +174,13 @@ _UNOPS = {
     "abs": "abs", "exp": "exp", "log": "log", "log10": "log10",
     "sqrt": "sqrt", "floor": "floor", "ceiling": "ceil", "trunc": "trunc",
     "cos": "cos", "sin": "sin", "tan": "tan", "not": "not", "!": "not",
-    "sign": "sign", "log2": "log2", "log1p": "log1p",
+    "sign": "sign", "log2": "log2", "log1p": "log1p", "expm1": "expm1",
+    "acos": "acos", "asin": "asin", "atan": "atan",
+    "cosh": "cosh", "sinh": "sinh", "tanh": "tanh",
+    "gamma": "gamma", "lgamma": "lgamma", "digamma": "digamma",
 }
-_AGGS = ("sum", "mean", "min", "max", "sd", "var", "median", "prod")
+_AGGS = ("sum", "mean", "min", "max", "sd", "var", "median", "prod",
+         "skewness", "kurtosis", "all", "any", "anyNA")
 
 
 class Session:
@@ -304,7 +308,16 @@ def _apply(op: str, raw_args: list, sess: Session):
         (a,) = args
         if isinstance(a, (Frame, Vec)):
             return OPS._unop(_as_vec(a), _UNOPS[op])
-        return float(getattr(np, {"not": "logical_not"}.get(_UNOPS[op], _UNOPS[op]))(a))
+        name = _UNOPS[op]
+        if name in ("gamma", "lgamma", "digamma"):  # not numpy ufuncs
+            import math
+
+            if name == "digamma":
+                from scipy.special import digamma
+
+                return float(digamma(a))
+            return float(getattr(math, name)(a))
+        return float(getattr(np, {"not": "logical_not"}.get(name, name))(a))
 
     # -- aggregates --------------------------------------------------------
     if op in _AGGS:
@@ -429,6 +442,33 @@ def _apply(op: str, raw_args: list, sess: Session):
         _require_seed_if_replicated("h2o.runif", seed)
         rng = np.random.default_rng(seed if seed > 0 else None)
         return Vec.from_numpy(rng.random(fr.nrow), "real")
+    if op in OPS._CUMOPS:  # (cumsum vec) etc.
+        return OPS._cumulative(_as_vec(args[0]), op)
+    if op == "difflag1":  # (difflag1 vec)
+        return OPS.diff_lag1(_as_vec(args[0]))
+    if op == "h2o.fillna":  # (h2o.fillna frame 'forward' axis maxlen)
+        method = str(args[1]) if len(args) > 1 else "forward"
+        if len(args) > 2 and int(args[2]) != 0:
+            raise RapidsError("h2o.fillna: only axis=0 (within-column) is supported")
+        maxlen = int(args[3]) if len(args) > 3 else 0
+        fr = _as_frame(args[0])
+        out = Frame()
+        for name in fr.names:
+            v = fr.vec(name)
+            out[name] = OPS.fillna(v, method=method, maxlen=maxlen) \
+                if v.is_numeric() else v
+        return out
+    if op == "round":  # (round vec digits) — half-to-even, like R/upstream
+        v, digits = args[0], int(args[1]) if len(args) > 1 else 0
+        if isinstance(v, (Frame, Vec)):
+            scale = 10.0 ** digits
+            return OPS._unop(_as_vec(v) * scale, "round") / scale
+        return float(np.round(v, digits))
+    if op in ("is.factor", "is.numeric", "is.character"):
+        v = _as_vec(args[0])
+        return float({"is.factor": v.is_categorical(),
+                      "is.numeric": v.is_numeric(),
+                      "is.character": v.kind == "string"}[op])
     if op == "relevel":  # (relevel vec 'y')
         return OPS.relevel(_as_vec(args[0]), str(args[1]))
     if op == "signif":
@@ -450,10 +490,17 @@ def _apply(op: str, raw_args: list, sess: Session):
 
     # -- string / time -----------------------------------------------------
     str_ops = {"toupper": OPS.toupper, "tolower": OPS.tolower, "trim": OPS.trim,
-               "nchar": OPS.nchar, "strsplit": OPS.strsplit, "grep": OPS.grep}
+               "nchar": OPS.nchar, "strsplit": OPS.strsplit, "grep": OPS.grep,
+               "lstrip": OPS.lstrip, "rstrip": OPS.rstrip,
+               "entropy": OPS.entropy}
     if op in str_ops:
         v = _as_vec(args[0])
         return str_ops[op](v, *[str(a) for a in args[1:]]) if args[1:] else str_ops[op](v)
+    if op == "countmatches":  # (countmatches vec ['pat' ...])
+        pats = args[1]
+        if isinstance(pats, np.ndarray):
+            pats = [str(p) for p in pats.tolist()]
+        return OPS.countmatches(_as_vec(args[0]), pats)
     if op in ("sub", "gsub"):
         # rapids arg order: (sub pattern replacement frame)
         pat, repl, v = str(args[0]), str(args[1]), _as_vec(args[2])
@@ -475,11 +522,27 @@ def _maybe_vec(x):
 
 
 def _np_agg(op: str, v: Vec) -> float:
+    if op == "anyNA":  # every column kind; rides the cached device rollup
+        return float(v.na_count() > 0)
     x = v.to_numpy().astype(np.float64)
     x = x[~np.isnan(x)]
+    if len(x) == 0 and op in ("all", "any"):
+        return float(op == "all")  # vacuous truth, like Python all([])/any([])
+
+    def _skew(a):
+        m, s = a.mean(), a.std(ddof=0)
+        return ((a - m) ** 3).mean() / s**3 if s else float("nan")
+
+    def _kurt(a):
+        m, s = a.mean(), a.std(ddof=0)
+        return ((a - m) ** 4).mean() / s**4 if s else float("nan")
+
     fn = {"sum": np.sum, "mean": np.mean, "min": np.min, "max": np.max,
           "sd": lambda a: np.std(a, ddof=1), "var": lambda a: np.var(a, ddof=1),
-          "median": np.median, "prod": np.prod}[op]
+          "median": np.median, "prod": np.prod,
+          "skewness": _skew, "kurtosis": _kurt,
+          "all": lambda a: float((a != 0).all()),
+          "any": lambda a: float((a != 0).any())}[op]
     return float(fn(x)) if len(x) else float("nan")
 
 

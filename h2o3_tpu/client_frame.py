@@ -143,6 +143,17 @@ class H2OFrame:
     def abs(self): return self._node("abs")
     def floor(self): return self._node("floor")
     def ceil(self): return self._node("ceiling")
+    def tanh(self): return self._node("tanh")
+    def round(self, digits: int = 0): return self._node("round", digits)
+    def signif(self, digits: int = 6): return self._node("signif", digits)
+    def cumsum(self): return self._node("cumsum")
+    def cumprod(self): return self._node("cumprod")
+    def cummin(self): return self._node("cummin")
+    def cummax(self): return self._node("cummax")
+    def difflag1(self): return self._node("difflag1")
+
+    def fillna(self, method: str = "forward", axis: int = 0, maxlen: int = 0):
+        return self._node("h2o.fillna", method, axis, maxlen)
 
     # -- scalar reductions (eager: they return numbers) ----------------------
     def _reduce(self, op: str) -> float:
@@ -155,6 +166,25 @@ class H2OFrame:
     def max(self): return self._reduce("max")
     def sd(self): return self._reduce("sd")
     def median(self): return self._reduce("median")
+    def skewness(self): return self._reduce("skewness")
+    def kurtosis(self): return self._reduce("kurtosis")
+    def all(self): return bool(self._reduce("all"))
+    def any(self): return bool(self._reduce("any"))
+    def anyna(self): return bool(self._reduce("anyNA"))
+
+    # -- string ops ----------------------------------------------------------
+    def toupper(self): return self._node("toupper")
+    def tolower(self): return self._node("tolower")
+    def trim(self): return self._node("trim")
+    def lstrip(self, chars: str | None = None):
+        return self._node("lstrip", chars) if chars else self._node("lstrip")
+    def rstrip(self, chars: str | None = None):
+        return self._node("rstrip", chars) if chars else self._node("rstrip")
+    def nchar(self): return self._node("nchar")
+    def entropy(self): return self._node("entropy")
+    def countmatches(self, patterns):
+        pats = [patterns] if isinstance(patterns, str) else list(patterns)
+        return self._node("countmatches", pats)
 
     # -- frame verbs ---------------------------------------------------------
     def unique(self): return self._node("unique")

@@ -74,26 +74,30 @@ class Vec:
 
     # -- construction -------------------------------------------------------
     @staticmethod
+    def device_dtype(kind: str, domain=None):
+        """(numpy dtype, NA fill) for a column's device storage — the single
+        source of the chunk-compression ladder (upstream C1/C2/C4Chunk pick
+        bytes per value; SURVEY §2.1): enums take the narrowest signed int
+        that fits the domain (-1 stays the NA sentinel in every width, HBM
+        drops 4x for <=127 levels, 2x for <=32767); everything else is f32
+        with NaN NAs. Shared by :meth:`from_numpy` and the batched upload in
+        frame/parse.py so the two placement routes cannot diverge."""
+        if kind == CAT:
+            card = len(domain or ())
+            dt = np.int8 if card <= 127 else np.int16 if card <= 32767 else np.int32
+            return np.dtype(dt), -1
+        return np.dtype(np.float32), np.nan
+
+    @staticmethod
     def from_numpy(arr: np.ndarray, kind: str, name: str = "", domain=None) -> "Vec":
         n = len(arr)
         if kind == STR:
             return Vec(arr, STR, name=name, nrow=n)
         npad = pad_to_shards(n)
-        if kind == CAT:
-            # narrowest signed int that fits the domain — the chunk-
-            # compression-zoo analog (upstream C1Chunk/C2Chunk/C4Chunk pick
-            # bytes per value; SURVEY §2.1): enum HBM drops 4x for <=127
-            # levels, 2x for <=32767. -1 stays the NA sentinel in every width
-            card = len(domain or ())
-            dt = np.int8 if card <= 127 else np.int16 if card <= 32767 else np.int32
-            buf = np.full(npad, -1, dtype=dt)
-            buf[:n] = np.asarray(arr, dtype=dt)
-            return Vec(shard_rows(buf), kind, name=name, domain=domain, nrow=n)
-        exact = None
-        if kind == TIME:
-            exact = np.asarray(arr, dtype=np.float64)
-        buf = np.full(npad, np.nan, dtype=np.float32)
-        buf[:n] = np.asarray(arr, dtype=np.float32)
+        dt, fill = Vec.device_dtype(kind, domain)
+        exact = np.asarray(arr, dtype=np.float64) if kind == TIME else None
+        buf = np.full(npad, fill, dtype=dt)
+        buf[:n] = np.asarray(arr, dtype=dt)
         return Vec(
             shard_rows(buf), kind, name=name, domain=domain, nrow=n, host_exact=exact
         )

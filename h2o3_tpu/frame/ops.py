@@ -216,6 +216,40 @@ def _cumulative(v: Vec, op: str) -> Vec:
     return Vec.from_numpy(out, NUM)
 
 
+def diff_lag1(v: Vec) -> Vec:
+    """``ASTDiffLag1`` successor: x[i] - x[i-1], NA in row 0."""
+    vals = v.to_numpy().astype(np.float64)
+    return Vec.from_numpy(np.diff(vals, prepend=np.nan), NUM)
+
+
+def fillna(v: Vec, method: str = "forward", maxlen: int = 0) -> Vec:
+    """``h2o.fillna`` successor (axis=0): propagate the last (or next)
+    observed value into NA runs, optionally capped at ``maxlen`` fills.
+
+    Host prefix pass, like the cumulative ops above: a sequential
+    carry has nothing for the MXU and is bandwidth-bound either way."""
+    if method not in ("forward", "backward"):
+        raise ValueError(f"fillna method must be forward/backward, got {method!r}")
+    if not v.is_numeric():
+        raise ValueError(f"fillna supports numeric/time columns, not {v.kind}")
+    vals = v.to_numpy().astype(np.float64)
+    if method == "backward":
+        vals = vals[::-1]
+    idx = np.arange(len(vals))
+    valid = np.where(~np.isnan(vals), idx, -1)
+    last = np.maximum.accumulate(valid)  # index of last non-NA at or before i
+    dist = idx - last
+    ok = last >= 0
+    if maxlen and maxlen > 0:
+        ok &= dist <= maxlen
+    out = np.where(ok, vals[np.maximum(last, 0)], np.nan)
+    if method == "backward":
+        out = out[::-1]
+    # keep the column kind: TIME must stay TIME (from_numpy re-derives the
+    # exact f64 epoch-ms host copy; rebuilding as NUM would quantize ~2 min)
+    return Vec.from_numpy(out, v.kind, name=v.name)
+
+
 # ---------------------------------------------------------------------------
 # group-by — successor of ``ASTGroup``
 # ---------------------------------------------------------------------------
@@ -1012,6 +1046,46 @@ def strsplit(v: Vec, pattern: str) -> Frame:
         )
     df = pd.DataFrame(cols)
     return Frame.from_pandas(df, column_types={c: STR for c in cols})
+
+
+def lstrip(v: Vec, chars: str | None = None) -> Vec:
+    return _str_apply(v, lambda s: s.lstrip(chars))
+
+
+def rstrip(v: Vec, chars: str | None = None) -> Vec:
+    return _str_apply(v, lambda s: s.rstrip(chars))
+
+
+def countmatches(v: Vec, patterns) -> Vec:
+    """``ASTCountMatches`` successor: total occurrences of any of the
+    substring patterns per row (NA rows stay NA)."""
+    pats = [patterns] if isinstance(patterns, str) else list(patterns)
+
+    def count(s: str) -> float:
+        return float(sum(s.count(p) for p in pats))
+
+    if v.kind == CAT:
+        per_level = np.array([count(d) for d in (v.domain or ())] + [np.nan])
+        return Vec.from_numpy(per_level[v.to_numpy()], NUM, name=v.name)
+    vals = np.array([np.nan if s is None else count(s) for s in v.to_numpy()])
+    return Vec.from_numpy(vals, NUM, name=v.name)
+
+
+def entropy(v: Vec) -> Vec:
+    """``ASTEntropy`` successor: per-string Shannon entropy over characters."""
+
+    def ent(s: str) -> float:
+        if not s:
+            return 0.0
+        _, counts = np.unique(list(s), return_counts=True)
+        p = counts / counts.sum()
+        return float(-(p * np.log2(p)).sum())
+
+    if v.kind == CAT:
+        per_level = np.array([ent(d) for d in (v.domain or ())] + [np.nan])
+        return Vec.from_numpy(per_level[v.to_numpy()], NUM, name=v.name)
+    vals = np.array([np.nan if s is None else ent(s) for s in v.to_numpy()])
+    return Vec.from_numpy(vals, NUM, name=v.name)
 
 
 def grep(v: Vec, pattern: str) -> Vec:

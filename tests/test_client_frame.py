@@ -93,3 +93,30 @@ def test_lazy_match_in_na_omit(server, data):
     assert (flags == (data["grp"] == "a").astype(float)).all()
     no = fr.na_omit()
     assert no.to_pandas().shape[0] <= len(data)
+
+
+def test_lazy_round4_breadth(server, data):
+    """Round-4 lazy surface: cum/diff/fillna/round, moment + boolean
+    reductions, string helpers — all ship as Rapids ASTs."""
+    fr = H2OFrame.from_key(server, "lazy_src")
+    inc = fr["income"]
+
+    cs = inc.cumsum().to_pandas().iloc[:, 0].to_numpy()
+    np.testing.assert_allclose(cs[:5], np.cumsum(data["income"])[:5], rtol=1e-5)
+
+    d = inc.difflag1().to_pandas().iloc[:, 0].to_numpy()
+    assert np.isnan(d[0])
+    np.testing.assert_allclose(d[1:4], np.diff(data["income"])[:3], rtol=1e-4)
+
+    r = inc.round(1).to_pandas().iloc[:, 0].to_numpy()
+    np.testing.assert_allclose(r[:5], np.round(data["income"][:5], 1), atol=0.06)
+
+    sk = inc.skewness()
+    x = data["income"].to_numpy()
+    m, s = x.mean(), x.std()
+    assert abs(sk - ((x - m) ** 3).mean() / s**3) < 1e-6
+    assert fr["age"].anyna() is False
+    assert (fr["age"] > 17).all() is True
+
+    up = fr["grp"].toupper().to_pandas().iloc[:, 0].tolist()
+    assert set(up[:10]) <= {"A", "B"}

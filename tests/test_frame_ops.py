@@ -478,3 +478,87 @@ def test_relevel_and_signif():
     np.testing.assert_allclose(sg[1], 0.00123)
     np.testing.assert_allclose(sg[2], -988000.0)
     assert np.isnan(sg[3]) and sg[4] == 0.0
+
+
+def test_cumulative_diff_fillna_rapids():
+    """Round-4 Rapids breadth: cum*, difflag1, h2o.fillna, round with digits
+    (upstream ast ops ASTCumu/ASTDiffLag1/ASTFillNA/ASTRound successors)."""
+    from h2o3_tpu.api.rapids import rapids_eval
+    from h2o3_tpu.cluster.registry import DKV
+
+    x = np.array([2.0, np.nan, 3.0, 1.0, np.nan])
+    fr = h2o3_tpu.upload_file(pd.DataFrame({"x": x}))
+    DKV.put("rc4", fr)
+
+    rapids_eval("(tmp= rc4_cs (cumsum (cols rc4 'x')))")
+    cs = DKV.get("rc4_cs").vec(0).to_numpy()
+    assert cs[0] == 2.0 and np.isnan(cs[1:]).all()  # NaN poisons the tail
+
+    rapids_eval("(tmp= rc4_cm (cummax (cols rc4 'x')))")
+    cm = DKV.get("rc4_cm").vec(0).to_numpy()
+    assert cm[0] == 2.0
+
+    rapids_eval("(tmp= rc4_d (difflag1 (cols rc4 'x')))")
+    d = DKV.get("rc4_d").vec(0).to_numpy()
+    assert np.isnan(d[0]) and np.isnan(d[1]) and np.isnan(d[2]) and d[3] == -2.0
+
+    rapids_eval("(tmp= rc4_f (h2o.fillna rc4 'forward' 0 0))")
+    f = DKV.get("rc4_f").vec(0).to_numpy()
+    np.testing.assert_array_equal(f, [2.0, 2.0, 3.0, 1.0, 1.0])
+
+    rapids_eval("(tmp= rc4_b (h2o.fillna rc4 'backward' 0 1))")
+    b = DKV.get("rc4_b").vec(0).to_numpy()
+    assert b[1] == 3.0 and np.isnan(b[4])  # maxlen=1: trailing NA unreachable
+
+    rapids_eval("(tmp= rc4_r (round (cols rc4 'x') 0))")
+    r = DKV.get("rc4_r").vec(0).to_numpy()
+    assert r[0] == 2.0 and r[3] == 1.0
+
+
+def test_fillna_ops_direct():
+    v = Vec.from_numpy(np.array([np.nan, 1.0, np.nan, np.nan, 5.0]), "real")
+    f = ops.fillna(v, "forward").to_numpy()
+    np.testing.assert_array_equal(f, [np.nan, 1.0, 1.0, 1.0, 5.0])
+    fb = ops.fillna(v, "backward").to_numpy()
+    np.testing.assert_array_equal(fb, [1.0, 1.0, 5.0, 5.0, 5.0])
+    fm = ops.fillna(v, "forward", maxlen=1).to_numpy()
+    assert fm[2] == 1.0 and np.isnan(fm[3])
+    with pytest.raises(ValueError):
+        ops.fillna(v, "sideways")
+
+
+def test_string_extras_and_moment_aggs():
+    from h2o3_tpu.api.rapids import rapids_eval
+    from h2o3_tpu.cluster.registry import DKV
+
+    df = pd.DataFrame({"s": ["  ab  ", "aab", None, "bbb"],
+                       "x": [1.0, 2.0, 3.0, 10.0]})
+    fr = Frame.from_pandas(df, column_types={"s": "string"})
+    DKV.put("rs4", fr)
+
+    ls = ops.lstrip(fr.vec("s")).to_numpy()
+    assert ls[0] == "ab  " and ls[2] is None
+    rs = ops.rstrip(fr.vec("s")).to_numpy()
+    assert rs[0] == "  ab"
+
+    cm = ops.countmatches(fr.vec("s"), ["ab", "b"]).to_numpy()
+    assert cm[1] == 2 and np.isnan(cm[2])  # "aab": one "ab" + one "b"
+    assert cm[3] == 3  # "bbb": three "b"
+
+    en = ops.entropy(fr.vec("s")).to_numpy()
+    assert abs(en[3]) < 1e-12  # "bbb" has zero entropy
+    assert en[1] > 0
+
+    sk = rapids_eval("(skewness (cols rs4 'x'))")["scalar"]
+    x = df["x"].to_numpy()
+    m, s = x.mean(), x.std()
+    assert abs(sk - ((x - m) ** 3).mean() / s**3) < 1e-9
+    assert rapids_eval("(anyNA (cols rs4 'x'))")["scalar"] == 0.0
+    assert rapids_eval("(any (> (cols rs4 'x') 5))")["scalar"] == 1.0
+    assert rapids_eval("(all (> (cols rs4 'x') 5))")["scalar"] == 0.0
+    assert rapids_eval("(is.numeric (cols rs4 'x'))")["scalar"] == 1.0
+    assert rapids_eval("(is.character (cols rs4 's'))")["scalar"] == 1.0
+    # new unop exposure: tanh on device matches numpy
+    rapids_eval("(tmp= rs4_t (tanh (cols rs4 'x')))")
+    np.testing.assert_allclose(DKV.get("rs4_t").vec(0).to_numpy(),
+                               np.tanh(x), rtol=1e-6)
