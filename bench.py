@@ -261,11 +261,25 @@ def _phase_breakdown(fr, n_trees: int, total_s: float) -> tuple[dict, float]:
     return per_tree, hist_flops
 
 
-def _make_data_device(n: int, c: int = N_COLS, seed: int = 0):
-    """Bench frame synthesized ON DEVICE (same generative model as
-    :func:`make_data`): a 10M-row frame is ~1.2 GB — at tunneled-TPU
-    host→device bandwidth the upload alone blew the bench budget, and the
-    metric here is trees/sec, not ingest."""
+def _drop_models(*models) -> None:
+    """Unregister bench models: a registered model pins its training frame
+    through ``params.training_frame``, so DKV.remove(frame) alone does not
+    free HBM for the later entries."""
+    from h2o3_tpu.cluster.registry import DKV
+
+    for m in models:
+        if m is not None:
+            DKV.remove(m.key)
+
+
+def _make_data_device(n: int, c: int = N_COLS, seed: int = 0, labeler=None,
+                      col_prefix: str = "f"):
+    """Bench frame synthesized ON DEVICE: a 10M-row frame is ~1.2 GB — at
+    tunneled-TPU host→device bandwidth the upload alone blew the bench
+    budget, and the metrics here are trees/rows per second, not ingest.
+
+    ``labeler(key, X) -> (int8 codes, domain)`` defaults to the same
+    Bernoulli generative model as :func:`make_data`."""
     import jax
     import jax.numpy as jnp
 
@@ -274,22 +288,29 @@ def _make_data_device(n: int, c: int = N_COLS, seed: int = 0):
 
     npad = pad_to_shards(n)
 
+    def _bernoulli(ku, X):
+        eta = (1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
+               + jnp.sin(2 * X[:, 4]) + 0.5 * X[:, 5] ** 2 - 1.0)
+        u = jax.random.uniform(ku, (X.shape[0],))
+        return (u < jax.nn.sigmoid(eta)).astype(jnp.int8), ("b", "s")
+
+    label_fn = labeler or _bernoulli
+    domain_box = []
+
     @functools.partial(jax.jit, out_shardings=row_sharding())
     def gen(key):
         kx, ku = jax.random.split(key)
         X = jax.random.normal(kx, (npad, c), jnp.float32)
-        eta = (1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
-               + jnp.sin(2 * X[:, 4]) + 0.5 * X[:, 5] ** 2 - 1.0)
-        u = jax.random.uniform(ku, (npad,))
-        y = (u < jax.nn.sigmoid(eta)).astype(jnp.int8)
+        y, domain = label_fn(ku, X)
+        domain_box.append(domain)  # trace-time constant
         pad = jnp.arange(npad) >= n
         X = jnp.where(pad[:, None], jnp.nan, X)
         y = jnp.where(pad, -1, y).astype(jnp.int8)
         return X, y
 
     X, y = gen(jax.random.PRNGKey(seed))
-    vecs = [Vec(X[:, i], NUM, name=f"f{i}", nrow=n) for i in range(c)]
-    vecs.append(Vec(y, CAT, name="label", nrow=n, domain=("b", "s")))
+    vecs = [Vec(X[:, i], NUM, name=f"{col_prefix}{i}", nrow=n) for i in range(c)]
+    vecs.append(Vec(y, CAT, name="label", nrow=n, domain=domain_box[0]))
     return Frame(vecs, register=True)
 
 
@@ -299,10 +320,11 @@ def _bench_10m() -> dict:
     from h2o3_tpu.models.tree import GBM
 
     fr = _make_data_device(10_000_000)
+    m0 = m = None
     try:
         kw = dict(max_depth=DEPTH, learn_rate=0.1, min_rows=10.0,
                   score_tree_interval=1000, seed=42)
-        GBM(ntrees=5, **kw).train(y="label", training_frame=fr)  # compile
+        m0 = GBM(ntrees=5, **kw).train(y="label", training_frame=fr)  # compile
         t0 = time.time()
         m = GBM(ntrees=5, **kw).train(y="label", training_frame=fr)
         dt = time.time() - t0
@@ -313,6 +335,7 @@ def _bench_10m() -> dict:
         }
     finally:
         # failure path too: a leaked 10M frame starves every later entry
+        _drop_models(m0, m)
         DKV.remove(fr.key)
         del fr
 
@@ -362,6 +385,59 @@ def _bench_join_10m() -> dict:
             if fr is not None:
                 DKV.remove(fr.key)
         del left, right, out
+
+
+def _bench_dl(n: int = 100_000, d: int = 784, k: int = 10) -> dict:
+    """Sync-SGD MLP rows/sec (BASELINE config #4: Hogwild→sync-SGD MLP).
+    MNIST-shaped synthetic: 100k x 784 → 10 classes, 2x128 hidden."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    def labeler(kw, X):
+        W = jax.random.normal(kw, (d, k), jnp.float32)
+        return (jnp.argmax(X @ W, axis=1).astype(jnp.int8),
+                tuple(str(i) for i in range(k)))
+
+    fr = _make_data_device(n, c=d, seed=5, labeler=labeler, col_prefix="p")
+    m0 = m = None
+    try:
+        kw = dict(hidden=(128, 128), epochs=1.0, mini_batch_size=256, seed=3)
+        m0 = DeepLearning(**kw).train(y="label", training_frame=fr)  # compile
+        t0 = time.time()
+        m = DeepLearning(**kw).train(y="label", training_frame=fr)
+        dt = time.time() - t0
+        return {"rows": n, "cols": d, "epochs": 1,
+                "rows_per_sec": round(n / dt, 0), "seconds": round(dt, 3)}
+    finally:
+        _drop_models(m0, m)
+        DKV.remove(fr.key)
+        del fr
+
+
+def _bench_automl(fr_small) -> dict:
+    """AutoML wall-clock (BASELINE secondary metric): max_models budget on a
+    50k-row slice of the bench frame."""
+    import math
+
+    from h2o3_tpu.automl import AutoML
+
+    t0 = time.time()
+    aml = AutoML(max_models=3, nfolds=0, seed=11, max_runtime_secs=900.0,
+                 include_algos=["GBM", "GLM"])
+    aml.train(y="label", training_frame=fr_small)
+    dt = time.time() - t0
+    lb = aml.leaderboard
+    out = {"max_models": 3, "seconds": round(dt, 3),
+           "models_built": len(lb.models) if lb else 0}
+    if lb and lb.models:
+        auc = float(lb.as_table()[0].get("auc", float("nan")))
+        if math.isfinite(auc):  # bare NaN would break the one-line JSON
+            out["leader_auc"] = round(auc, 4)
+    _drop_models(*(lb.models if lb else ()))
+    return out
 
 
 def _bench_glm_1m(fr) -> dict:
@@ -433,6 +509,20 @@ def main() -> None:
             payload["glm_1m"] = _bench_glm_1m(fr)
         except Exception as e:
             payload["glm_1m_error"] = repr(e)
+        try:  # sync-SGD MLP (BASELINE config #4)
+            payload["dl_100k"] = _bench_dl()
+        except Exception as e:
+            payload["dl_100k_error"] = repr(e)
+        try:  # AutoML wall-clock (BASELINE secondary metric)
+            from h2o3_tpu.cluster.registry import DKV
+
+            small = h2o3_tpu.upload_file(df.iloc[:50_000])
+            try:
+                payload["automl_50k"] = _bench_automl(small)
+            finally:
+                DKV.remove(small.key)
+        except Exception as e:
+            payload["automl_50k_error"] = repr(e)
         try:
             breakdown, hist_flops = _phase_breakdown(fr, N_TREES, dt)
             payload["breakdown"] = breakdown
