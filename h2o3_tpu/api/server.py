@@ -172,6 +172,9 @@ class Endpoints:
             "cloud_name": info.get("cloud_name", "h2o3_tpu"),
             "cloud_size": info.get("cloud_size", 1),
             "cloud_healthy": bool(info.get("cloud_healthy", True)),
+            # fail-stop latch reason (cluster_info sets it after a dead-member
+            # collective failure) — the diagnostic operators need
+            **({"degraded": info["degraded"]} if info.get("degraded") else {}),
             "nodes": nodes,
         }
 

@@ -74,10 +74,14 @@ def init(
         Log.warn(f"compilation cache disabled: {e}")
     if coordinator is not None and not jax.distributed.is_initialized():
         # Must run before any backend use (jax.devices() etc.).
+        # heartbeat_timeout bounds dead-member detection (SURVEY §5.3): the
+        # coordination service's heartbeat IS the HeartBeatThread successor;
+        # jax's default 100 s is tunable down for tests/latency-sensitive ops
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
+            heartbeat_timeout_seconds=config.get_int("H2O3_TPU_HEARTBEAT_TIMEOUT"),
         )
     from h2o3_tpu.utils import telemetry
 
@@ -96,6 +100,24 @@ def init(
             f"mesh axes {dict(m.shape)}"
         )
     return cluster_info()
+
+
+_degraded: str | None = None
+
+
+def mark_degraded(reason: str) -> None:
+    """Latch the cloud unhealthy (fail-stop semantics, SURVEY §5.3): called
+    when a replicated command dies with a coordination-service failure
+    signature — a dead member makes the cloud unusable; restart is the
+    recovery path, durability comes from checkpoints. `/3/Cloud` surfaces it."""
+    global _degraded
+    if _degraded is None:
+        _degraded = reason
+        Log.err(f"cloud degraded (fail-stop): {reason}")
+
+
+def degraded_reason() -> str | None:
+    return _degraded
 
 
 def cluster_info() -> dict:
@@ -118,9 +140,13 @@ def cluster_info() -> dict:
             node["healthy"] = False
             healthy = False
         nodes.append(node)
+    out_degraded = degraded_reason()
+    if out_degraded is not None:
+        healthy = False
     return {
         "version": "h2o3_tpu",
         "cloud_healthy": healthy,
+        **({"degraded": out_degraded} if out_degraded else {}),
         "cloud_size": len(jax.devices()),
         "processes": jax.process_count(),
         "platform": jax.devices()[0].platform,

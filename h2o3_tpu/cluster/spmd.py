@@ -335,6 +335,35 @@ _COMMANDS = {
 _SHUTDOWN = "__shutdown__"
 
 
+_DEATH_SIGNATURES = (
+    "coordination service", "PollForError", "heartbeat",
+    "tasks are unhealthy", "jax_worker", "DEADLINE_EXCEEDED",
+)
+
+
+def _maybe_mark_dead_member(exc: BaseException) -> None:
+    """A deterministic command error raises identically on every rank and
+    the cloud stays usable; a coordination-service failure (dead member,
+    severed coordinator) poisons every future collective — latch fail-stop
+    so `/3/Cloud` and subsequent jobs report it instead of hanging.
+
+    Only XLA-runtime errors are eligible: a user command failing on its own
+    network IO (unreachable s3 endpoint, dead parse source) raises
+    botocore/OSError types whose reprs can also say "connection" — those are
+    deterministic command failures, not cloud death, and must not brick a
+    healthy cloud behind the one-way latch."""
+    if "xlaruntimeerror" not in type(exc).__name__.lower():
+        import jax
+
+        if not isinstance(exc, jax.errors.JaxRuntimeError):
+            return
+    msg = repr(exc)
+    if any(sig.lower() in msg.lower() for sig in _DEATH_SIGNATURES):
+        from h2o3_tpu.cluster import cloud
+
+        cloud.mark_degraded(f"replicated command failed mid-collective: {msg[:300]}")
+
+
 def run(cmd: str, **kwargs):
     """Execute ``cmd`` on every process of the cloud (coordinator API).
 
@@ -346,10 +375,23 @@ def run(cmd: str, **kwargs):
         return _COMMANDS[cmd](**kwargs)
     if not is_coordinator():  # pragma: no cover - followers use follower_loop
         raise RuntimeError("spmd.run is coordinator-only")
+    from h2o3_tpu.cluster import cloud
+
     with _LOCK:
-        _bcast_bytes(pickle.dumps((cmd, kwargs)))
-        with replicated_section():
-            return _COMMANDS[cmd](**kwargs)
+        # degraded check INSIDE the lock: a job queued on the lock while
+        # another latches the failure must not broadcast into the dead cloud
+        if cloud.degraded_reason() is not None:
+            raise RuntimeError(
+                f"cloud is degraded (fail-stop): {cloud.degraded_reason()} — "
+                "restart the cloud; recover models from checkpoints"
+            )
+        try:
+            _bcast_bytes(pickle.dumps((cmd, kwargs)))
+            with replicated_section():
+                return _COMMANDS[cmd](**kwargs)
+        except Exception as e:
+            _maybe_mark_dead_member(e)
+            raise
 
 
 def shutdown_followers() -> None:
@@ -370,7 +412,11 @@ def follower_loop() -> None:
     remains fail-stop."""
     Log.info(f"spmd follower loop up (process {__import__('jax').process_index()})")
     while True:
-        cmd, kwargs = pickle.loads(_bcast_bytes(None))
+        try:
+            cmd, kwargs = pickle.loads(_bcast_bytes(None))
+        except Exception as e:  # dead coordinator/member: fail-stop the rank
+            _maybe_mark_dead_member(e)
+            raise
         if cmd == _SHUTDOWN:
             Log.info("spmd follower shutdown")
             return
@@ -378,9 +424,10 @@ def follower_loop() -> None:
         try:
             with replicated_section():
                 _COMMANDS[cmd](**kwargs)
-        except Exception:
+        except Exception as e:
             import traceback
 
+            _maybe_mark_dead_member(e)
             Log.err(
                 "spmd follower command failed (coordinator job fails with "
                 f"the same error):\n{traceback.format_exc()}"

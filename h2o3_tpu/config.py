@@ -44,6 +44,9 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "20", "deepest tree the whole-tree fused program is built for; "
               "beyond it the per-level dispatch loop takes over"),
     "H2O3_TPU_COMPILE_CACHE": ("", "XLA compile-cache dir ('' = <pkg>/.jax_cache)"),
+    "H2O3_TPU_HEARTBEAT_TIMEOUT": (
+        "100", "multi-host dead-member detection bound, seconds "
+        "(jax coordination-service heartbeat timeout)"),
 }
 
 

@@ -458,3 +458,102 @@ def test_grid_over_rest_across_two_processes(tmp_path):
             sys.stderr.write(f"--- gproc{i} tail ---\n")
             sys.stderr.write((tmp_path / f"gproc{i}.log").read_bytes()[-1500:]
                              .decode(errors="replace") + "\n")
+
+
+@pytest.mark.slow
+def test_dead_rank_fails_stop(tmp_path):
+    """SURVEY §5.3 failure semantics: killing a member kills the CLOUD within
+    the heartbeat bound — the jax distributed runtime aborts every surviving
+    process when a task stops heartbeating (observed: "Terminating process
+    because the JAX distributed service detected fatal errors"). That is
+    exactly H2O's fail-stop contract (a dead node makes the cluster
+    unusable; restart + checkpoints are the recovery path). The assertion is
+    BOUNDED DEATH, not survival: the coordinator must exit, not hang."""
+    import json
+    import signal
+    import time
+    import urllib.parse
+    import urllib.request
+
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame(rng.normal(size=(300, 3)), columns=["a", "b", "c"])
+    df["label"] = np.where(df["a"] + df["b"] > 0, "p", "n")
+    csv = tmp_path / "dead.csv"
+    df.to_csv(csv, index=False)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rest_port = s.getsockname()[1]
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               JAX_PLATFORMS="cpu",
+               H2O3_TPU_HEARTBEAT_TIMEOUT="10")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    logs = [open(tmp_path / f"dproc{i}.log", "wb") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "h2o3_tpu.launch",
+             "--coordinator", f"127.0.0.1:{coord_port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--ip", "127.0.0.1", "--port", str(rest_port)],
+            stdout=logs[i], stderr=subprocess.STDOUT, cwd=repo, env=env,
+        )
+        for i in range(2)
+    ]
+    base = f"http://127.0.0.1:{rest_port}"
+
+    def req(method, path, data=None, timeout=30):
+        body = urllib.parse.urlencode(data).encode() if data else None
+        r = urllib.request.Request(base + path, data=body, method=method)
+        return json.loads(urllib.request.urlopen(r, timeout=timeout).read())
+
+    try:
+        deadline = time.time() + 120
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                req("GET", "/3/Ping", timeout=5)
+                up = True
+            except Exception:
+                time.sleep(1.0)
+        assert up, "coordinator REST never came up"
+
+        # a healthy cloud first: parse succeeds across both ranks
+        req("POST", "/3/ImportFiles", {"path": str(csv)})
+        req("POST", "/3/Parse", {"source_frames": str(csv),
+                                 "destination_frame": "dfr"})
+        time.sleep(5)
+
+        procs[1].send_signal(signal.SIGKILL)  # kill the follower
+        procs[1].wait(timeout=10)
+
+        # fail-stop, bounded by the 10 s heartbeat (+ polling margin): the
+        # surviving coordinator must DIE, not hang serving a broken cloud
+        deadline = time.time() + 90
+        while time.time() < deadline and procs[0].poll() is None:
+            time.sleep(2.0)
+        assert procs[0].poll() is not None, (
+            "coordinator still alive 90 s after member death — fail-stop "
+            "violated (hung cloud)"
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
+    tail = (tmp_path / "dproc0.log").read_bytes()[-3000:].decode(errors="replace")
+    assert ("unhealthy" in tail or "heartbeat" in tail
+            or "distributed service detected fatal errors" in tail), tail
