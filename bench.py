@@ -9,6 +9,13 @@ phase, ``error`` — present (with value 0.0) only when the backend could not
 be brought up after bounded retries, so a flaky boot still emits parseable
 JSON instead of a crash.
 
+Each entry runs in its OWN subprocess (``python bench.py --phase NAME``):
+a fresh backend per phase means one phase OOMing or crashing the TPU
+runtime cannot starve the entries after it (the 20260731T0101Z artifact
+lost 10M/join/GLM/breakdown to exactly that cascade — a RESOURCE_EXHAUSTED
+in the 10M build poisoned every later allocation in the shared process).
+The parent process never touches jax, so the device is free for each child.
+
 Baseline: h2o-3's CPU GBM builds ~0.5-1.5 trees/sec at depth 6-10 on 1M-row
 Higgs-class data on a multicore x86 node (external szilard/GBM-perf context,
 BASELINE.md — the reference repo publishes no numbers and the mount was
@@ -20,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 import traceback
@@ -27,7 +35,10 @@ import traceback
 import numpy as np
 import pandas as pd
 
-N_ROWS = 1_000_000
+# row-count scale factor (plumbing tests / constrained windows):
+# H2O3_TPU_BENCH_SCALE=0.01 runs every entry at 1% size. Default full size.
+_SCALE = float(os.environ.get("H2O3_TPU_BENCH_SCALE", "1"))
+N_ROWS = max(int(1_000_000 * _SCALE), 10_000)
 N_COLS = 28  # Higgs feature count
 N_TREES = 20
 DEPTH = 6
@@ -319,7 +330,8 @@ def _bench_10m() -> dict:
     from h2o3_tpu.cluster.registry import DKV
     from h2o3_tpu.models.tree import GBM
 
-    fr = _make_data_device(10_000_000)
+    n10 = int(10_000_000 * _SCALE)
+    fr = _make_data_device(n10)
     m0 = m = None
     try:
         kw = dict(max_depth=DEPTH, learn_rate=0.1, min_rows=10.0,
@@ -329,7 +341,7 @@ def _bench_10m() -> dict:
         m = GBM(ntrees=5, **kw).train(y="label", training_frame=fr)
         dt = time.time() - t0
         return {
-            "rows": 10_000_000,
+            "rows": n10,
             "trees_per_sec": round(5 / dt, 3),
             "auc": round(float(m.training_metrics.auc), 4),
         }
@@ -371,13 +383,14 @@ def _bench_join_10m() -> dict:
 
     left = right = out = None
     try:
-        left = _dev_frame(10_000_000, jax.random.PRNGKey(1), 1_000_000, True)
-        right = _dev_frame(1_000_000, jax.random.PRNGKey(2), 1_000_000, False)
+        nl, nr = int(10_000_000 * _SCALE), int(1_000_000 * _SCALE)
+        left = _dev_frame(nl, jax.random.PRNGKey(1), nr, True)
+        right = _dev_frame(nr, jax.random.PRNGKey(2), nr, False)
         out = ops.merge(left, right, by=["k"])  # warm compile
         t0 = time.time()
         out = ops.merge(left, right, by=["k"])
         dt = time.time() - t0
-        return {"left_rows": 10_000_000, "right_rows": 1_000_000,
+        return {"left_rows": nl, "right_rows": nr,
                 "out_rows": out.nrow, "seconds": round(dt, 3),
                 "rows_per_sec": round(out.nrow / dt, 0)}
     finally:
@@ -387,7 +400,7 @@ def _bench_join_10m() -> dict:
         del left, right, out
 
 
-def _bench_dl(n: int = 100_000, d: int = 784, k: int = 10) -> dict:
+def _bench_dl(n: int = max(int(100_000 * _SCALE), 5_000), d: int = 784, k: int = 10) -> dict:
     """Sync-SGD MLP rows/sec (BASELINE config #4: Hogwild→sync-SGD MLP).
     MNIST-shaped synthetic: 100k x 784 → 10 classes, 2x128 hidden."""
     import jax
@@ -459,86 +472,166 @@ def _bench_glm_1m(fr) -> dict:
     }
 
 
-def main() -> None:
+def _phase_headline() -> dict:
+    """1M-row GBM trees/sec — the driver's headline metric — plus the
+    per-phase breakdown and MFU estimate (same process: they share the
+    uploaded frame and the warm compile)."""
+    import jax
+
+    import h2o3_tpu
+    from h2o3_tpu.models.tree import GBM
+
+    df = make_data()
+    fr = h2o3_tpu.upload_file(df)
+
+    kw = dict(
+        max_depth=DEPTH,
+        learn_rate=0.1,
+        min_rows=10.0,
+        score_tree_interval=1000,
+        seed=42,
+    )
+    # warmup: compile the full configuration (the chunk-scanned builder
+    # specializes on chunk length, so warmup must use the same ntrees)
+    GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
+
+    t0 = time.time()
+    m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
+    dt = time.time() - t0
+    tps = N_TREES / dt
+
+    payload = {
+        "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}, AUC={m.training_metrics.auc:.4f})",
+        "value": round(tps, 3),
+        "unit": "trees/sec/chip",
+        "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
+    }
+    try:
+        breakdown, hist_flops = _phase_breakdown(fr, N_TREES, dt)
+        payload["breakdown"] = breakdown
+        kind = jax.devices()[0].device_kind.lower()
+        peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
+        if peak is not None and breakdown["hist_s"] > 0:
+            payload["mfu"] = round(hist_flops / breakdown["hist_s"] / peak, 4)
+        elif peak is None:
+            payload["mfu_peak_unknown"] = kind
+        payload["device_kind"] = jax.devices()[0].device_kind
+    except Exception as e:  # diagnostics must never sink the headline number
+        payload["breakdown_error"] = repr(e)
+    return payload
+
+
+def _phase_glm_1m() -> dict:
+    """GLM IRLS at 1M rows (BASELINE config #1: Airlines-1M analog)."""
+    import h2o3_tpu
+
+    fr = h2o3_tpu.upload_file(make_data())
+    return _bench_glm_1m(fr)
+
+
+def _phase_automl_50k() -> dict:
+    import h2o3_tpu
+
+    small = h2o3_tpu.upload_file(make_data().iloc[: max(int(50_000 * _SCALE), 5_000)])
+    return _bench_automl(small)
+
+
+# name -> (runner, parent-side wall budget seconds). Budgets are generous —
+# each child pays its own backend init (~30 s through the tunnel) + compile.
+_PHASES: dict = {
+    "headline": (_phase_headline, 1500),
+    "scale_10m": (_bench_10m, 900),       # VERDICT r4: evidence beyond 1M
+    "join_10m": (_bench_join_10m, 600),   # ASTMerge successor at scale
+    "glm_1m": (_phase_glm_1m, 600),
+    "dl_100k": (_bench_dl, 600),          # sync-SGD MLP (BASELINE config #4)
+    "automl_50k": (_phase_automl_50k, 900),
+}
+# stop launching new phases past this parent deadline so the driver's own
+# timeout never truncates the output mid-line
+DEADLINE_S = float(os.environ.get("H2O3_TPU_BENCH_DEADLINE_S", 3000))
+
+
+def _child_main(phase: str) -> None:
+    """Run one phase in this (fresh) process; print its JSON dict."""
     try:
         _init_with_retry()
-    except Exception as e:  # emit parseable JSON even on boot failure
-        _emit_error("init", e)
-        sys.exit(0)
+        out = _PHASES[phase][0]()
+    except Exception as e:
+        tb = traceback.format_exc(limit=20)
+        out = {"error": repr(e), "traceback": tb}
+    _emit(out)
+
+
+def _run_phase_subprocess(phase: str, timeout_s: float) -> dict:
+    import subprocess
 
     try:
-        import jax
-
-        import h2o3_tpu
-        from h2o3_tpu.models.tree import GBM
-
-        df = make_data()
-        fr = h2o3_tpu.upload_file(df)
-
-        kw = dict(
-            max_depth=DEPTH,
-            learn_rate=0.1,
-            min_rows=10.0,
-            score_tree_interval=1000,
-            seed=42,
+        proc = subprocess.run(
+            [sys.executable, __file__, "--phase", phase],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
         )
-        # warmup: compile the full configuration (the chunk-scanned builder
-        # specializes on chunk length, so warmup must use the same ntrees)
-        GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
-
-        t0 = time.time()
-        m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
-        dt = time.time() - t0
-        tps = N_TREES / dt
-
-        payload = {
-            "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}, AUC={m.training_metrics.auc:.4f})",
-            "value": round(tps, 3),
-            "unit": "trees/sec/chip",
-            "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
-        }
-        try:  # 10M-row scale point (VERDICT r4 item: evidence beyond 1M)
-            payload["scale_10m"] = _bench_10m()
-        except Exception as e:
-            payload["scale_10m_error"] = repr(e)
-        try:  # device join at 10M rows (ASTMerge successor)
-            payload["join_10m"] = _bench_join_10m()
-        except Exception as e:
-            payload["join_10m_error"] = repr(e)
-        try:  # GLM IRLS at 1M rows (BASELINE config #1: Airlines-1M analog)
-            payload["glm_1m"] = _bench_glm_1m(fr)
-        except Exception as e:
-            payload["glm_1m_error"] = repr(e)
-        try:  # sync-SGD MLP (BASELINE config #4)
-            payload["dl_100k"] = _bench_dl()
-        except Exception as e:
-            payload["dl_100k_error"] = repr(e)
-        try:  # AutoML wall-clock (BASELINE secondary metric)
-            from h2o3_tpu.cluster.registry import DKV
-
-            small = h2o3_tpu.upload_file(df.iloc[:50_000])
-            try:
-                payload["automl_50k"] = _bench_automl(small)
-            finally:
-                DKV.remove(small.key)
-        except Exception as e:
-            payload["automl_50k_error"] = repr(e)
+    except subprocess.TimeoutExpired:
+        return {"error": f"phase timed out after {timeout_s:.0f}s (parent kill)"}
+    for line in reversed(proc.stdout.strip().splitlines()):
         try:
-            breakdown, hist_flops = _phase_breakdown(fr, N_TREES, dt)
-            payload["breakdown"] = breakdown
-            kind = jax.devices()[0].device_kind.lower()
-            peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
-            if peak is not None and breakdown["hist_s"] > 0:
-                payload["mfu"] = round(hist_flops / breakdown["hist_s"] / peak, 4)
-            elif peak is None:
-                payload["mfu_peak_unknown"] = kind
-            payload["device_kind"] = jax.devices()[0].device_kind
-        except Exception as e:  # diagnostics must never sink the headline number
-            payload["breakdown_error"] = repr(e)
-        _emit(payload)
-    except Exception as e:
-        _emit_error("bench", e)
-        sys.exit(0)
+            d = json.loads(line)
+            if isinstance(d, dict):
+                return d
+        except json.JSONDecodeError:
+            continue
+    return {
+        "error": f"no JSON from phase (rc={proc.returncode})",
+        "stderr_tail": proc.stderr[-800:],
+    }
+
+
+def main() -> None:
+    if "--phase" in sys.argv:
+        _child_main(sys.argv[sys.argv.index("--phase") + 1])
+        return
+
+    t_start = time.time()
+    payload: dict = {}
+    init_down = None
+    for phase, (_, budget) in _PHASES.items():
+        if phase != "headline" and time.time() - t_start > DEADLINE_S:
+            payload[f"{phase}_error"] = "skipped: parent deadline reached"
+            continue
+        if init_down is not None:
+            # a wedged tunnel hangs EVERY child's backend init for the full
+            # 420 s watchdog — don't burn it five more times
+            payload[f"{phase}_error"] = f"skipped: {init_down}"
+            continue
+        out = _run_phase_subprocess(phase, budget)
+        if isinstance(out.get("error"), str) and "init" in out["error"] and (
+            "hung" in out["error"] or "failed after" in out["error"]
+        ):
+            init_down = "backend init hung/failed in an earlier phase"
+        err = out.pop("error", None)
+        if phase == "headline":
+            if err is not None:
+                # headline child failed: preserve the driver contract
+                # (metric/value/unit always present and parseable)
+                payload.update(
+                    {
+                        "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH})",
+                        "value": 0.0,
+                        "unit": "trees/sec/chip",
+                        "vs_baseline": 0.0,
+                        "error": err,
+                        "traceback": out.get("traceback", ""),
+                    }
+                )
+            else:
+                payload.update(out)
+        elif err is not None:
+            payload[f"{phase}_error"] = err
+        else:
+            out.pop("traceback", None)
+            payload[phase] = out
+    _emit(payload)
 
 
 if __name__ == "__main__":

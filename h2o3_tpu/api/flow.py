@@ -2,11 +2,13 @@
 ``h2o-web``/Flow [UNVERIFIED upstream paths, SURVEY.md §2.3].
 
 One self-contained page (no build step, no external assets — the coordinator
-may be air-gapped) served at ``/`` and ``/flow``: browse frames / models /
-jobs / grids, import + parse files, launch model builds and AutoML, inspect
-metrics and variable importances, score a model on a frame — every action a
-plain ``fetch`` against the public REST routes, so the page doubles as live
-API documentation.
+may be air-gapped) served at ``/`` and ``/flow``: a notebook of ordered
+runnable cells (markdown / Rapids / model-build / raw REST — the Flow-cell
+model, with save/load through ``/3/NodePersistentStorage/notebook/*`` like
+upstream), plus browse tabs for frames / models / jobs, import + parse,
+schema-generated build forms ("assists"), AutoML, and a Rapids console —
+every action a plain ``fetch`` against the public REST routes, so the page
+doubles as live API documentation.
 """
 
 FLOW_HTML = r"""<!DOCTYPE html>
@@ -79,7 +81,7 @@ const setMsg = (el, cls, text) => {
   el.replaceChildren(sp);
 };
 
-const TABS = ['Frames', 'Models', 'Jobs', 'Build', 'AutoML', 'Rapids'];
+const TABS = ['Notebook', 'Frames', 'Models', 'Jobs', 'Build', 'AutoML', 'Rapids'];
 const tabs = document.getElementById('tabs'), main = document.getElementById('main');
 const sections = {};
 for (const t of TABS) {
@@ -95,7 +97,171 @@ function show(t) {
   render[t]();
 }
 
+// ---- Notebook: ordered runnable cells (the Flow-notebook successor) ----
+// Cell types: md (markdown-lite), rapids (/99/Rapids ast), build (JSON with
+// "algo" -> /3/ModelBuilders/{algo}, waits for the job), rest (one
+// "METHOD /path {json}" line). Flows save/load through the
+// /3/NodePersistentStorage/notebook/{name} routes, like upstream Flow.
+let cells = [{ type: 'md', text: '# Untitled Flow\nAdd cells, run them in order.' }];
+
+const mdRender = (t) => esc(t)
+  .replace(/^### (.*)$/gm, '<b style="font-size:14px">$1</b>')
+  .replace(/^## (.*)$/gm, '<b style="font-size:15px">$1</b>')
+  .replace(/^# (.*)$/gm, '<b style="font-size:17px;color:var(--acc)">$1</b>')
+  .replace(/\*\*([^*]+)\*\*/g, '<b>$1</b>')
+  .replace(/`([^`]+)`/g, '<code>$1</code>')
+  .replace(/\n/g, '<br>');
+
+const waitJob = async (key) => {
+  for (;;) {
+    const j = await api('GET', `/3/Jobs/${encodeURIComponent(key)}`);
+    const jj = j.jobs ? j.jobs[0] : j;
+    if (jj.status === 'DONE') return jj;
+    if (jj.status === 'FAILED' || jj.status === 'CANCELLED')
+      throw new Error(`job ${jj.status}: ${jj.exception || ''}`);
+    await new Promise(r => setTimeout(r, 800));  // PENDING/RUNNING: keep polling
+  }
+};
+
+async function runCell(i) {
+  const c = cells[i];
+  if (c.type === 'md') { c.out = null; drawCells(); return; }
+  c.out = 'running…'; drawCells();
+  try {
+    let out;
+    if (c.type === 'rapids') {
+      out = await api('POST', '/99/Rapids', { ast: c.text });
+    } else if (c.type === 'build') {
+      const body = JSON.parse(c.text);
+      const algo = body.algo; delete body.algo;
+      const j = await api('POST', `/3/ModelBuilders/${encodeURIComponent(algo)}`, body);
+      const done = await waitJob(j.job.key.name || j.job.key);
+      out = done.dest ? await api('GET',
+        `/3/Models/${encodeURIComponent(done.dest.name)}`) : done;
+    } else {  // rest
+      const m = c.text.trim().match(/^(GET|POST|DELETE)\s+(\S+)\s*([\s\S]*)$/);
+      if (!m) throw new Error('cell format: METHOD /path {json?}');
+      out = await api(m[1], m[2], m[3].trim() ? JSON.parse(m[3]) : undefined);
+    }
+    c.out = JSON.stringify(out, null, 2);
+    if (c.out.length > 20000) c.out = c.out.slice(0, 20000) + '\n… (truncated)';
+  } catch (e) { c.out = 'ERROR: ' + e; c.failed = true; }
+  drawCells();
+}
+
+window.nbRunAll = async () => {
+  for (let i = 0; i < cells.length; i++) {
+    cells[i].failed = false;
+    await runCell(i);
+    if (cells[i].failed) break;  // sequential semantics: stop at first error
+  }
+};
+window.nbAdd = (i, type) => { cells.splice(i + 1, 0, { type, text: '' }); drawCells(); };
+window.nbDel = (i) => { cells.splice(i, 1); if (!cells.length) cells = [{ type: 'md', text: '' }]; drawCells(); };
+window.nbMove = (i, d) => {
+  const j = i + d;
+  if (j < 0 || j >= cells.length) return;
+  [cells[i], cells[j]] = [cells[j], cells[i]];
+  drawCells();
+};
+window.nbRun = runCell;
+window.nbEdit = (i, v) => { cells[i].text = v; };
+window.nbType = (i, v) => { cells[i].type = v; cells[i].out = null; drawCells(); };
+
+function drawCells() {
+  const box = document.getElementById('nbcells');
+  if (!box) return;
+  box.replaceChildren(...cells.map((c, i) => {
+    const d = document.createElement('div');
+    d.className = 'panel';
+    d.innerHTML = `
+      <div class="row" style="margin-bottom:6px">
+        <select onchange="nbType(${i}, this.value)">
+          ${['md', 'rapids', 'build', 'rest'].map(t =>
+            `<option ${t === c.type ? 'selected' : ''}>${t}</option>`).join('')}
+        </select>
+        <button class="act" onclick="nbRun(${i})">run</button>
+        <button onclick="nbMove(${i},-1)">↑</button>
+        <button onclick="nbMove(${i},1)">↓</button>
+        <button onclick="nbAdd(${i},'rapids')">+ cell</button>
+        <button onclick="nbDel(${i})">✕</button>
+      </div>`;
+    const ta = document.createElement('textarea');
+    ta.rows = Math.max(2, Math.min(10, c.text.split('\n').length));
+    ta.value = c.text;
+    ta.oninput = () => nbEdit(i, ta.value);
+    d.appendChild(ta);
+    if (c.type === 'md' && c.text) {
+      const md = document.createElement('div');
+      md.innerHTML = mdRender(c.text);  // mdRender escapes first
+      d.appendChild(md);
+    }
+    if (c.out != null) {
+      const pre = document.createElement('pre');
+      pre.textContent = c.out;  // never innerHTML: output echoes server strings
+      d.appendChild(pre);
+    }
+    return d;
+  }));
+}
+
+window.nbSave = async () => {
+  const el = document.getElementById('nbmsg');
+  const name = document.getElementById('nbname').value.trim();
+  if (!name) { setMsg(el, 'err', 'name required'); return; }
+  try {
+    await api('POST', `/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`,
+      { value: JSON.stringify(cells.map(({ type, text }) => ({ type, text }))) });
+    setMsg(el, 'ok', 'saved ✓'); nbRefreshList();
+  } catch (e) { setMsg(el, 'err', e); }
+};
+window.nbLoad = async (name) => {
+  const el = document.getElementById('nbmsg');
+  try {
+    const j = await api('GET', `/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`);
+    cells = JSON.parse(j.value);
+    document.getElementById('nbname').value = name;
+    setMsg(el, 'ok', `loaded ${name}`); drawCells();
+  } catch (e) { setMsg(el, 'err', e); }
+};
+window.nbDelete = async (name) => {
+  try {
+    await api('DELETE', `/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`);
+    nbRefreshList();
+  } catch (e) {}
+};
+window.nbRefreshList = async () => {
+  const box = document.getElementById('nblist');
+  try {
+    const j = await api('GET', '/3/NodePersistentStorage/notebook');
+    box.replaceChildren(...(j.entries || []).map(e => {
+      const sp = document.createElement('span');
+      sp.className = 'row';
+      const load = document.createElement('button');
+      load.textContent = e.name; load.onclick = () => nbLoad(e.name);
+      const del = document.createElement('button');
+      del.textContent = '✕'; del.onclick = () => nbDelete(e.name);
+      sp.append(load, del);
+      return sp;
+    }));
+  } catch (e) { box.textContent = ''; }
+};
+
 const render = {
+  async Notebook() {
+    const s = sections.Notebook;
+    if (!s.dataset.ready) {
+      s.dataset.ready = 1;
+      s.innerHTML = `<div class="panel row">
+          <input id="nbname" placeholder="flow name">
+          <button class="act" onclick="nbSave()">Save</button>
+          <button class="act" onclick="nbRunAll()">Run all</button>
+          <span id="nbmsg" class="muted"></span>
+          <span id="nblist" class="row"></span></div>
+        <div id="nbcells"></div>`;
+    }
+    drawCells(); nbRefreshList();
+  },
   async Frames() {
     const s = sections.Frames;
     s.innerHTML = `<div class="panel"><div class="row">
@@ -302,7 +468,7 @@ window.runRapids = async () => {
     document.getElementById('cloud').textContent =
       `${c.cloud_name || 'cloud'} — ${c.cloud_size} device(s), healthy=${c.cloud_healthy}`;
   } catch (e) { document.getElementById('cloud').textContent = 'cloud unreachable'; }
-  show('Frames');
+  show('Notebook');
 })();
 </script>
 </body>

@@ -691,6 +691,81 @@ class Endpoints:
                 "leader": {"name": lb.leader.key} if lb and lb.leader else None,
                 "event_log": aml.event_log}
 
+    # -- node persistent storage (Flow notebook save/load) -----------------
+    # Successor of ``/3/NodePersistentStorage`` [UNVERIFIED upstream path
+    # water/api/NodePersistentStorageHandler.java, SURVEY.md §2.3]: Flow
+    # stores saved notebooks as named string blobs under a category.
+
+    @staticmethod
+    def _nps_path(category: str, name: str | None = None):
+        import os
+
+        from h2o3_tpu import config
+
+        safe = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._ -]{0,120}$")
+        for part in (category,) + ((name,) if name is not None else ()):
+            if not safe.match(part or ""):
+                raise ApiError(400, f"invalid storage name {part!r}")
+        root = config.get("H2O3_TPU_NPS_DIR") or os.path.join(
+            os.path.expanduser("~"), ".h2o3tpu", "nps"
+        )
+        p = os.path.join(root, category)
+        return os.path.join(p, name) if name is not None else p
+
+    def nps_configured(self, params):
+        return {"__meta": {"schema_type": "NodePersistentStorage"},
+                "configured": True}
+
+    def nps_list(self, params, category):
+        import os
+
+        d = self._nps_path(category)
+        entries = []
+        if os.path.isdir(d):
+            for n in sorted(os.listdir(d)):
+                if n.endswith(".tmp"):  # interrupted atomic-write leftover
+                    continue
+                st = os.stat(os.path.join(d, n))
+                entries.append({"category": category, "name": n,
+                                "size": st.st_size,
+                                "timestamp_millis": int(st.st_mtime * 1000)})
+        return {"__meta": {"schema_type": "NodePersistentStorage"},
+                "category": category, "entries": entries}
+
+    def nps_get(self, params, category, name):
+        import os
+
+        p = self._nps_path(category, name)
+        if not os.path.isfile(p):
+            raise ApiError(404, f"no saved {category}/{name}")
+        with open(p, encoding="utf-8") as f:
+            return {"__meta": {"schema_type": "NodePersistentStorage"},
+                    "category": category, "name": name, "value": f.read()}
+
+    def nps_put(self, params, category, name):
+        import os
+
+        value = params.get("value")
+        if value is None:
+            raise ApiError(400, "value is required")
+        p = self._nps_path(category, name)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(value))
+        os.replace(tmp, p)
+        return {"__meta": {"schema_type": "NodePersistentStorage"},
+                "category": category, "name": name}
+
+    def nps_delete(self, params, category, name):
+        import os
+
+        p = self._nps_path(category, name)
+        if os.path.isfile(p):
+            os.remove(p)
+        return {"__meta": {"schema_type": "NodePersistentStorage"},
+                "category": category, "name": name}
+
     # -- rapids (frame expression eval) -----------------------------------
     def rapids(self, params):
         from h2o3_tpu.api.rapids import RapidsError
@@ -794,6 +869,11 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
     ("POST", r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", _EP.model_metrics),
     ("POST", r"/99/Rapids", _EP.rapids),
+    ("GET", r"/3/NodePersistentStorage/configured", _EP.nps_configured),
+    ("GET", r"/3/NodePersistentStorage/([^/]+)", _EP.nps_list),
+    ("GET", r"/3/NodePersistentStorage/([^/]+)/([^/]+)", _EP.nps_get),
+    ("POST", r"/3/NodePersistentStorage/([^/]+)/([^/]+)", _EP.nps_put),
+    ("DELETE", r"/3/NodePersistentStorage/([^/]+)/([^/]+)", _EP.nps_delete),
     ("POST", r"/99/AutoMLBuilder", _EP.automl_build),
     ("GET", r"/99/AutoML/([^/]+)", _EP.automl_get),
 ]

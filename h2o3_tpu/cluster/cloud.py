@@ -44,6 +44,16 @@ def init(
     from h2o3_tpu import config
 
     Log.set_level(log_level or config.get("H2O3_TPU_LOG_LEVEL"))
+    # Honor an explicit JAX_PLATFORMS=cpu env even when a site hook has
+    # already overridden the jax_platforms CONFIG (observed: the axon
+    # sitecustomize forces "axon,cpu", after which the env var alone is
+    # ignored and any backend touch tries to init the tunnel backend —
+    # which HANGS, not fails, when the tunnel is wedged). Must run before
+    # the first jax.devices()/process_count() call below.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" and str(
+        jax.config.jax_platforms or ""
+    ).lower() != "cpu":
+        jax.config.update("jax_platforms", "cpu")
     # Persistent XLA compilation cache (SURVEY.md §7: compile-latency
     # amortization across the many small jit programs of AutoML/tree loops).
     # ACCELERATOR BACKENDS ONLY: XLA:CPU cache entries are AOT-compiled with

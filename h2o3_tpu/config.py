@@ -37,13 +37,20 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "requests (comma list; '*' disables the CSRF/rebinding guard)"),
     "H2O3_TPU_LOG_LEVEL": ("INFO", "default log level"),
     "H2O3_TPU_BIN_ADAPT": (
-        "1", "per-level bin coarsening in the fused tree builder (numeric "
+        "0", "per-level bin coarsening in the fused tree builder (numeric "
              "frames): depth>=3 halves data bins per level, floor 63 — "
-             "DHistogram's per-level re-binning analog; 0 disables"),
+             "DHistogram's per-level re-binning analog. Off by default: "
+             "measured 5% SLOWER on TPU v5e at 1M x 28 depth 6 (2.42 vs "
+             "2.55 trees/sec, BENCH_builder_20260731T010117Z*) — the extra "
+             "full-matrix coarsen copies outweigh the smaller histograms at "
+             "the subtraction path's already-reduced node counts"),
     "H2O3_TPU_FUSED_MAX_DEPTH": (
         "20", "deepest tree the whole-tree fused program is built for; "
               "beyond it the per-level dispatch loop takes over"),
     "H2O3_TPU_COMPILE_CACHE": ("", "XLA compile-cache dir ('' = <pkg>/.jax_cache)"),
+    "H2O3_TPU_NPS_DIR": (
+        "", "NodePersistentStorage root (saved Flow notebooks; '' = "
+        "~/.h2o3tpu/nps)"),
     "H2O3_TPU_HEARTBEAT_TIMEOUT": (
         "100", "multi-host dead-member detection bound, seconds "
         "(jax coordination-service heartbeat timeout)"),

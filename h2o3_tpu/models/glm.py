@@ -222,6 +222,9 @@ class GLM(ModelBuilder):
 
     algo = "glm"
     PARAMS_CLS = GLMParams
+    # upstream's REST/R param is "lambda" (a Python keyword, hence the
+    # dataclass field lambda_); accept both over REST and the estimators
+    PARAM_ALIASES = {"lambda": "lambda_"}
 
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: GLMParams = self.params

@@ -257,6 +257,16 @@ class ModelBuilder:
     def __init__(self, **kwargs):
         import dataclasses
 
+        # builder-declared param aliases (XGBoost's eta, GLM's upstream
+        # "lambda") resolve to their canonical field name here so every
+        # entry point (REST, estimators, direct construction) accepts both
+        for alias, canon in (getattr(self, "PARAM_ALIASES", None) or {}).items():
+            if alias in kwargs:
+                if canon in kwargs:
+                    raise ValueError(
+                        f"{alias!r} and {canon!r} are aliases — pass one"
+                    )
+                kwargs[canon] = kwargs.pop(alias)
         valid_names = {f.name for f in dataclasses.fields(self.PARAMS_CLS)}
         unknown = set(kwargs) - valid_names
         if unknown:

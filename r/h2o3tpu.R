@@ -348,3 +348,18 @@ h2o.loadModel <- function(path) {
 h2o.confusionMatrix <- function(perf) perf$confusion_matrix
 h2o.scoreHistory <- function(model) h2o.getModel(model$model_id)$output$scoring_history
 h2o.shutdown <- function() invisible(NULL)  # coordinator lifecycle is external
+
+# -- generated explicit-argument estimators -----------------------------------
+# estimators_gen.R (tools/gen_bindings.py output) defines h2o.gbm/h2o.glm/...
+# with every parameter as a named argument; when present next to this file it
+# shadows the minimal `...` wrappers above. Sourcing it is optional — both
+# surfaces speak the same /3/ModelBuilders routes.
+local({
+  f <- tryCatch(sys.frame(1)$ofile, error = function(e) NULL)
+  gen <- if (!is.null(f) && nzchar(f)) {
+    file.path(dirname(f), "estimators_gen.R")
+  } else {
+    "estimators_gen.R"
+  }
+  if (file.exists(gen)) source(gen)
+})

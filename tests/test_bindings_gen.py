@@ -1,0 +1,81 @@
+"""Codegen output stays in sync and structurally sound.
+
+The generated estimator surfaces (Python + R) are checked in, like
+upstream's h2o-bindings output; these tests catch a params-dataclass edit
+that was not followed by a regen, and structural breakage in the R file
+(which no R runtime on CI can parse for us).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _gen():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import gen_bindings
+    finally:
+        sys.path.pop(0)
+    return gen_bindings
+
+
+def test_python_bindings_up_to_date():
+    gb = _gen()
+    assert gb.render() == (REPO / "h2o3_tpu" / "estimators_gen.py").read_text(), (
+        "estimators_gen.py is stale — run: python tools/gen_bindings.py"
+    )
+
+
+def test_r_bindings_up_to_date():
+    gb = _gen()
+    assert gb.render_r() == (REPO / "r" / "estimators_gen.R").read_text(), (
+        "r/estimators_gen.R is stale — run: python tools/gen_bindings.py"
+    )
+
+
+def test_r_bindings_structure():
+    src = (REPO / "r" / "estimators_gen.R").read_text()
+    # every algo function present, one definition each
+    funcs = re.findall(r"^(h2o\.\w+) <- function\(", src, re.M)
+    assert len(funcs) == len(set(funcs)) == 29
+    # balanced delimiters (cheap parse sanity without an R runtime)
+    for o, c in ("()", "{}"):
+        assert src.count(o) == src.count(c), f"unbalanced {o}{c}"
+    # no Python literals leaked through the default renderer
+    assert not re.search(r"= (True|False|None)\b", src)
+    # upstream arg-name parity: GLM exposes `lambda`, not the field name
+    assert "lambda = NULL" in src
+    assert "lambda_" not in src.replace("lambda_search", "").replace(
+        "lambda_min_ratio", ""
+    )
+
+
+def test_glm_lambda_alias_resolves():
+    from h2o3_tpu.models.glm import GLM
+
+    b = GLM(**{"lambda": 0.25, "family": "gaussian"})
+    assert b.params.lambda_ == 0.25
+    with pytest.raises(ValueError, match="alias"):
+        GLM(**{"lambda": 0.1, "lambda_": 0.1})
+
+
+def test_estimator_accepts_lambda_alias():
+    from h2o3_tpu.estimators_gen import H2OGeneralizedLinearEstimator
+
+    # the generated signature uses lambda_ (Python keyword), but the runtime
+    # estimator path accepts the alias too
+    from h2o3_tpu.estimators import _EstimatorBase
+
+    class _E(_EstimatorBase):
+        _BUILDER = "GLM"
+
+    e = _E(**{"lambda": 0.5})
+    assert e._kwargs == {"lambda": 0.5}
+    assert H2OGeneralizedLinearEstimator(lambda_=0.5)._kwargs == {"lambda_": 0.5}

@@ -5,6 +5,7 @@ matching H2O's "real stack, local topology" strategy."""
 
 import json
 import time
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -249,3 +250,55 @@ def test_profiler_route(server):
     assert any("MainThread" in p["thread"] for p in prof)
     assert all(p["stack"] for p in prof)
     assert all(len(p["stack"]) <= 5 for p in prof)
+
+
+class TestNodePersistentStorage:
+    """/3/NodePersistentStorage — the Flow notebook save/load store
+    (upstream water/api/NodePersistentStorageHandler [UNVERIFIED])."""
+
+    def test_roundtrip_list_delete(self, server, monkeypatch, tmp_path):
+        monkeypatch.setenv("H2O3_TPU_NPS_DIR", str(tmp_path))
+        assert _get(server, "/3/NodePersistentStorage/configured")["configured"]
+        flow = json.dumps([{"type": "md", "text": "# hi"}])
+        _post(server, "/3/NodePersistentStorage/notebook/my%20flow",
+              {"value": flow}, as_json=True)
+        got = _get(server, "/3/NodePersistentStorage/notebook/my%20flow")
+        assert got["value"] == flow
+        entries = _get(server, "/3/NodePersistentStorage/notebook")["entries"]
+        assert [e["name"] for e in entries] == ["my flow"]
+        assert entries[0]["size"] == len(flow)
+        req = urllib.request.Request(
+            server.url + "/3/NodePersistentStorage/notebook/my%20flow",
+            method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            json.loads(r.read())
+        assert _get(server, "/3/NodePersistentStorage/notebook")["entries"] == []
+
+    def test_rejects_path_traversal(self, server, monkeypatch, tmp_path):
+        monkeypatch.setenv("H2O3_TPU_NPS_DIR", str(tmp_path))
+        for bad in ("..%2F..%2Fetc", ".hidden", "a%2Fb"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(server, f"/3/NodePersistentStorage/notebook/{bad}",
+                      {"value": "x"}, as_json=True)
+            assert ei.value.code in (400, 404)
+
+    def test_get_missing_is_404(self, server, monkeypatch, tmp_path):
+        monkeypatch.setenv("H2O3_TPU_NPS_DIR", str(tmp_path))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server, "/3/NodePersistentStorage/notebook/nope")
+        assert ei.value.code == 404
+
+
+def test_flow_page_serves_notebook(server):
+    """Flow page smoke: served at / and /flow, carries the notebook cell
+    engine, and its script's bracket nesting is balanced (no JS parser in
+    the image; this catches truncated-template regressions)."""
+    import urllib.request as _rq
+
+    with _rq.urlopen(server.url + "/flow") as r:
+        html = r.read().decode()
+    assert "Notebook" in html and "nbRunAll" in html
+    assert "/3/NodePersistentStorage/notebook/" in html
+    js = html.split("<script>")[1].split("</script>")[0]
+    for o, c in ("()", "{}", "[]"):
+        assert js.count(o) == js.count(c)

@@ -41,8 +41,11 @@ def main() -> None:
                 for n_nodes in (16, 64):
                     hist_pallas.ROW_TILE = row_tile
                     hist_pallas.COL_TILE = col_tile
-                    # the jit cache keys on shapes/static args, NOT the
-                    # module constants — drop it so each config re-traces
+                    # hist_pallas_local is JITTED and its cache keys on
+                    # shapes/static args only — the tile module globals are
+                    # baked in at trace time, so without this clear every
+                    # config after the first would silently re-time the
+                    # first-compiled executable under a wrong label
                     hist_pallas.hist_pallas_local.clear_cache()
                     bins = jnp.asarray(
                         (base_bins % n_bins).astype(np.uint8)

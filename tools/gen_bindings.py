@@ -121,9 +121,165 @@ def render() -> str:
     return "\n".join(out)
 
 
+# ---------------------------------------------------------------------------
+# R emitter — the gen_R.py analog: explicit-argument h2o.* functions (the
+# upstream R package's per-algo surface) rendered from the same dataclasses.
+
+R_HEADER = '''# GENERATED FILE — do not edit. Regenerate with tools/gen_bindings.py.
+#
+# Explicit per-algorithm h2o.* training functions with every parameter as a
+# named argument with its default (the gen_R.py codegen analog, SURVEY.md
+# §2.3 [UNVERIFIED upstream path h2o-bindings/bin/gen_R.py]). Requires
+# h2o3tpu.R to be sourced first (.h2o.req / .h2o.train helpers). Only
+# arguments the caller actually supplies are sent to the server (missing()
+# check), so server-side defaults stay authoritative.
+
+.h2o.train_params <- function(algo, y, x, training_frame, validation_frame,
+                              params) {
+  stopifnot(inherits(training_frame, "H2O3Frame"))
+  # delegate to h2o3tpu.R's .h2o.train so job-wait / model-resolution
+  # logic lives in exactly one place
+  do.call(.h2o.train, c(
+    list(algo, y = y, x = x, training_frame = training_frame,
+         validation_frame = validation_frame),
+    params))
+}
+
+'''
+
+# h2o.* function name per builder (upstream R verb where one exists)
+R_NAMES = {
+    "GBM": "h2o.gbm", "XGBoost": "h2o.xgboost", "DRF": "h2o.randomForest",
+    "XRT": "h2o.xrt", "GLM": "h2o.glm", "DeepLearning": "h2o.deeplearning",
+    "KMeans": "h2o.kmeans", "PCA": "h2o.prcomp", "SVD": "h2o.svd",
+    "NaiveBayes": "h2o.naiveBayes", "IsolationForest": "h2o.isolationForest",
+    "ExtendedIsolationForest": "h2o.extendedIsolationForest",
+    "GLRM": "h2o.glrm", "CoxPH": "h2o.coxph",
+    "IsotonicRegression": "h2o.isotonicregression", "AdaBoost": "h2o.adaBoost",
+    "DT": "h2o.decision_tree", "Word2Vec": "h2o.word2vec",
+    "StackedEnsemble": "h2o.stackedEnsemble",
+    "TargetEncoder": "h2o.targetencoder", "RuleFit": "h2o.rulefit",
+    "UpliftDRF": "h2o.upliftRandomForest", "GAM": "h2o.gam",
+    "ModelSelection": "h2o.modelSelection", "ANOVAGLM": "h2o.anovaglm",
+    "Aggregator": "h2o.aggregator", "Infogram": "h2o.infogram",
+    "PSVM": "h2o.psvm", "HGLM": "h2o.hglm",
+}
+
+# REST algo path per builder (mirrors the server's builder registry names)
+R_ALGOS = {
+    "GBM": "gbm", "XGBoost": "xgboost", "DRF": "drf", "XRT": "xrt",
+    "GLM": "glm", "DeepLearning": "deeplearning", "KMeans": "kmeans",
+    "PCA": "pca", "SVD": "svd", "NaiveBayes": "naivebayes",
+    "IsolationForest": "isolationforest",
+    "ExtendedIsolationForest": "extendedisolationforest", "GLRM": "glrm",
+    "CoxPH": "coxph", "IsotonicRegression": "isotonicregression",
+    "AdaBoost": "adaboost", "DT": "decisiontree", "Word2Vec": "word2vec",
+    "StackedEnsemble": "stackedensemble", "TargetEncoder": "targetencoder",
+    "RuleFit": "rulefit", "UpliftDRF": "upliftdrf", "GAM": "gam",
+    "ModelSelection": "modelselection", "ANOVAGLM": "anovaglm",
+    "Aggregator": "aggregator", "Infogram": "infogram", "PSVM": "psvm",
+    "HGLM": "hglm",
+}
+
+
+def _r_val(v) -> str:
+    """Python default -> R literal."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "Inf"
+        if v == float("-inf"):
+            return "-Inf"
+        return repr(v)
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (tuple, list)):
+        if not v:
+            return "c()"
+        return "c(" + ", ".join(_r_val(x) for x in v) + ")"
+    if isinstance(v, dict):
+        if not v:
+            return "list()"
+        return "list(" + ", ".join(
+            f"{k} = {_r_val(x)}" for k, x in v.items()) + ")"
+    raise TypeError(f"no R literal for {v!r} ({type(v)})")
+
+
+# R argument names where upstream's differs from the dataclass field (the
+# server accepts these as PARAM_ALIASES on the builder)
+R_FIELD_NAMES = {"lambda_": "lambda"}
+
+
+def _r_name(name: str) -> str:
+    """Upstream R argument name, escaped if it collides with R syntax."""
+    name = R_FIELD_NAMES.get(name, name)
+    reserved = {
+        "if", "else", "repeat", "while", "function", "for", "in", "next",
+        "break", "TRUE", "FALSE", "NULL", "Inf", "NaN", "NA",
+    }
+    return f"`{name}`" if name in reserved else name
+
+
+def render_r() -> str:
+    import dataclasses as dc
+
+    from h2o3_tpu import models as M
+
+    out = [R_HEADER]
+    for _, builder in ALGOS:
+        rname = R_NAMES[builder]
+        algo = R_ALGOS[builder]
+        params_cls = getattr(M, builder).PARAMS_CLS
+        fields = [
+            f for f in dc.fields(params_cls)
+            if f.name not in ("training_frame", "validation_frame",
+                              "response_column")
+        ]
+        defaults = {}
+        for f in fields:
+            if f.default is not dc.MISSING:
+                defaults[f.name] = f.default
+            elif f.default_factory is not dc.MISSING:  # type: ignore[misc]
+                defaults[f.name] = f.default_factory()
+            else:
+                defaults[f.name] = None
+        args = [f"{_r_name(f.name)} = {_r_val(defaults[f.name])}"
+                for f in fields]
+        sig = ",\n    ".join(
+            ["y = NULL", "x = NULL", "training_frame", "validation_frame = NULL"]
+            + args
+        )
+        collect = "\n".join(
+            f'  if (!missing({_r_name(f.name)})) p${_r_name(f.name)} <- '
+            f'{_r_name(f.name)}'
+            for f in fields
+        )
+        out.append(
+            f"{rname} <- function(\n    {sig}\n) {{\n"
+            "  p <- list()\n"
+            f"{collect}\n"
+            f'  .h2o.train_params("{algo}", y, x, training_frame, '
+            "validation_frame, p)\n"
+            "}\n\n"
+        )
+    return "".join(out)
+
+
 if __name__ == "__main__":
     dest = sys.argv[1] if len(sys.argv) > 1 else "h2o3_tpu/estimators_gen.py"
     code = render()
     with open(dest, "w") as f:
         f.write(code)
     print(f"wrote {dest} ({len(code.splitlines())} lines, {len(ALGOS)} classes)")
+    r_dest = sys.argv[2] if len(sys.argv) > 2 else "r/estimators_gen.R"
+    r_code = render_r()
+    with open(r_dest, "w") as f:
+        f.write(r_code)
+    print(f"wrote {r_dest} ({len(r_code.splitlines())} lines, {len(R_NAMES)} functions)")

@@ -1,24 +1,29 @@
 #!/bin/sh
 # TPU measurement backlog — run the moment the axon tunnel is back up.
-# Captures everything round 4 built but could not measure (the tunnel went
-# down ~15:00Z on 2026-07-30 and stayed down):
-#   1. bench.py with bin adaptivity + packed transfers + depth-20 live
-#      (headline + scale_10m + join_10m + glm_1m), artifact committed.
-#   2. adaptivity A/B (H2O3_TPU_BIN_ADAPT=0 control run).
+#   0. memory diagnosis of the 10M-row RESOURCE_EXHAUSTED (tpu_mem_analysis)
+#   1. bench.py (subprocess-per-phase; six backend inits — the parent stops
+#      launching phases at H2O3_TPU_BENCH_DEADLINE_S, default 3000 s)
+#   2. adaptivity A/B: default is now OFF (measured 5% slower on v5e,
+#      BENCH_builder_20260731T0101Z*); the control run measures it ON,
+#      headline only.
 #   3. Pallas tile sweep (tools/bench_kernel_sweep.py) for the next kernel
 #      iteration.
 set -x
 cd "$(dirname "$0")/.."
 
 stamp=$(date -u +%Y%m%dT%H%M%SZ)
-timeout 1200 python bench.py | tee "BENCH_builder_${stamp}.json"
 
-H2O3_TPU_BIN_ADAPT=0 timeout 1200 python bench.py \
-  | tee "BENCH_builder_${stamp}_noadapt.json"
+timeout 1800 python tools/tpu_mem_analysis.py --train \
+  | tee "MEMDIAG_${stamp}.txt"
+
+timeout 3600 python bench.py | tee "BENCH_builder_${stamp}.json"
+
+H2O3_TPU_BIN_ADAPT=1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_adapt.json"  # headline only (deadline=1s)
 
 timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
 
-git add "BENCH_builder_${stamp}.json" "BENCH_builder_${stamp}_noadapt.json" \
-        "KERNEL_SWEEP_${stamp}.jsonl"
-git commit -m "TPU measurement backlog: bench (adapt on/off) + kernel tile sweep"
+git add "MEMDIAG_${stamp}.txt" "BENCH_builder_${stamp}.json" \
+        "BENCH_builder_${stamp}_adapt.json" "KERNEL_SWEEP_${stamp}.jsonl"
+git commit -m "TPU measurement backlog: mem diagnosis, bench (adapt A/B), kernel tile sweep"

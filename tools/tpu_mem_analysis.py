@@ -1,0 +1,111 @@
+"""Diagnose the 10M-row GBM RESOURCE_EXHAUSTED on the tunneled TPU.
+
+The 20260731T0101Z bench lost every entry after the headline to an OOM
+cascade that started in the 10M build; an isolated 10M run reproduces it
+even with ~15 GB HBM allocatable (probed) and an estimated ~3 GB working
+set. CPU memory_analysis of the same program shows 13.4 GB temp at 10M —
+but that's the scatter path; the TPU program (Pallas kernel) should be far
+smaller. This tool gets the REAL number from the TPU compiler:
+
+  1. AOT-compile the scanned-tree program for 1M/4M/10M rows on the TPU
+     backend and print XLA's memory_analysis (temp/argument/output bytes).
+  2. If the analysis looks fine, run an actual GBM train at increasing row
+     counts (each in THIS process — run the tool fresh per investigation)
+     to find where execution, as opposed to allocation plan, fails.
+
+Usage (tunnel up): python tools/tpu_mem_analysis.py [--train]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    import h2o3_tpu
+    from h2o3_tpu.models.tree import shared_tree as st
+    from h2o3_tpu.models.tree.distributions import grad_hess
+
+    h2o3_tpu.init(log_level="WARN")
+    print("backend:", jax.default_backend(), jax.devices()[0].device_kind, flush=True)
+
+    C, n_trees, depth, n_bins = 28, 5, 6, 256
+    kw = dict(
+        grad_fn=lambda F_, y_, w_: grad_hess("bernoulli", F_, y_, w_, 0.0),
+        grad_key=("memdiag", "bernoulli"),
+        sample_rate=1.0, n_bins=n_bins, is_cat_cols=np.zeros(C, bool),
+        max_depth=depth, min_rows=10.0, min_split_improvement=1e-5,
+        learn_rates=np.full(n_trees, 0.1, np.float32),
+        max_abs_leaf=float("inf"), col_sample_rate=1.0,
+        col_sample_rate_per_tree=1.0,
+    )
+    t0 = time.time()
+    st.build_trees_scanned(
+        jnp.zeros((512, C), jnp.uint8), jnp.ones(512), jnp.zeros(512),
+        jnp.zeros(512), jnp.zeros(C), jr.PRNGKey(0), n_trees, **kw,
+    )
+    print("warm trace+exec", round(time.time() - t0, 1), "s", flush=True)
+    prog = [v for k, v in st._STEP_CACHE.items() if k[0] == "scan"][-1]
+
+    for n in (1_048_576, 4_194_304, 10_485_760):
+        bins = jax.ShapeDtypeStruct((n, C), jnp.uint8)
+        f32 = jax.ShapeDtypeStruct((n,), jnp.float32)
+        key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        t0 = time.time()
+        try:
+            c = prog.lower(
+                bins, f32, f32, f32,
+                jax.ShapeDtypeStruct((C,), jnp.float32), key, key,
+                jnp.int32(0), jax.ShapeDtypeStruct((n_trees,), jnp.float32),
+                jax.ShapeDtypeStruct((C,), jnp.bool_), jnp.float32(10.0),
+                jnp.float32(1e-5), jnp.float32(np.inf), jnp.float32(1.0),
+                None,
+            ).compile()
+            ma = c.memory_analysis()
+            print(
+                f"rows={n}: temp={ma.temp_size_in_bytes / 2**30:.3f} GB "
+                f"args={ma.argument_size_in_bytes / 2**30:.3f} GB "
+                f"out={ma.output_size_in_bytes / 2**30:.3f} GB "
+                f"(compile {time.time() - t0:.1f} s)",
+                flush=True,
+            )
+        except Exception as e:
+            print(f"rows={n}: compile FAILED: {e!r}"[:500], flush=True)
+
+    if "--train" not in sys.argv:
+        return
+    # execution-level bisect: fresh data per size, freed before the next
+    import bench
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.models.tree import GBM
+
+    for n in (2_000_000, 5_000_000, 10_000_000):
+        fr = bench._make_data_device(n)
+        m = None
+        try:
+            t0 = time.time()
+            m = GBM(ntrees=5, max_depth=depth, learn_rate=0.1, min_rows=10.0,
+                    score_tree_interval=1000, seed=42).train(
+                y="label", training_frame=fr)
+            print(f"train rows={n}: OK {time.time() - t0:.1f} s "
+                  f"auc={float(m.training_metrics.auc):.4f}", flush=True)
+        except Exception as e:
+            print(f"train rows={n}: FAILED {e!r}"[:300], flush=True)
+            break
+        finally:
+            bench._drop_models(m)
+            DKV.remove(fr.key)
+            del fr
+
+
+if __name__ == "__main__":
+    main()
