@@ -601,9 +601,35 @@ class Endpoints:
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {frame_key} not found")
         dest = params.get("predictions_frame") or DKV.make_key("prediction")
+
+        def _flag(name):
+            v = params.get(name)
+            return v if isinstance(v, bool) else str(v).lower() in ("1", "true")
+
+        # upstream predict options (water/api/ModelMetricsHandler PredictV3):
+        # SHAP contributions / terminal-leaf assignment instead of predictions
+        option = ""
+        if _flag("predict_contributions"):
+            option = "contributions"
+        elif _flag("leaf_node_assignment") or _flag("predict_leaf_node_assignment"):
+            option = "leaf_assignment"
+        if option and not hasattr(m, {
+            "contributions": "predict_contributions",
+            "leaf_assignment": "predict_leaf_node_assignment",
+        }[option]):
+            raise ApiError(400, f"{m.algo} does not support {option}")
         from h2o3_tpu.cluster import spmd
 
-        pred = spmd.run("predict", model_key=model_key, frame_key=frame_key, dest=dest)
+        try:
+            pred = spmd.run(
+                "predict", model_key=model_key, frame_key=frame_key, dest=dest,
+                option=option,
+                leaf_type=str(params.get("leaf_node_assignment_type") or "Path"),
+            )
+        except ValueError as e:
+            # user-input errors from the option paths (multinomial
+            # contributions, bad leaf type) are 400s, not server faults
+            raise ApiError(400, str(e))
         return {"__meta": {"schema_type": "Predictions"},
                 "predictions_frame": {"name": dest},
                 "model_metrics": []}

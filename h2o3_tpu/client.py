@@ -115,10 +115,13 @@ class H2OConnection:
         job = self.wait_job(resp["job"]["key"]["name"])
         return self.get(f"/3/Models/{job['dest']['name']}")["models"][0]
 
-    def predict(self, model_key: str, frame: str | Any) -> str:
-        """Returns the predictions frame key."""
+    def predict(self, model_key: str, frame: str | Any, **options) -> str:
+        """Returns the predictions frame key. ``options`` are the upstream
+        predict options (predict_contributions=True,
+        leaf_node_assignment=True, leaf_node_assignment_type="Node_ID")."""
         out = self.post(
-            f"/3/Predictions/models/{model_key}/frames/{_key_of(frame)}", {}
+            f"/3/Predictions/models/{model_key}/frames/{_key_of(frame)}",
+            dict(options),
         )
         return out["predictions_frame"]["name"]
 

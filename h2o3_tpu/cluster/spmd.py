@@ -143,12 +143,18 @@ def _exec_build(algo: str, kwargs: dict, x, y, train, valid, dest: str):
     return model
 
 
-def _exec_predict(model_key: str, frame_key: str, dest: str):
+def _exec_predict(model_key: str, frame_key: str, dest: str, option: str = "",
+                  leaf_type: str = "Path"):
     from h2o3_tpu.cluster.registry import DKV
 
     model = DKV.get(model_key)
     fr = DKV.get(frame_key)
-    out = model.predict(fr)
+    if option == "contributions":
+        out = model.predict_contributions(fr)
+    elif option == "leaf_assignment":
+        out = model.predict_leaf_node_assignment(fr, type=leaf_type)
+    else:
+        out = model.predict(fr)
     DKV.put(dest, out)
     return out
 

@@ -65,6 +65,22 @@ class _EstimatorBase:
     def predict(self, test_data):
         return self._m().predict(test_data)
 
+    def predict_contributions(self, test_data):
+        """Per-feature SHAP contributions + BiasTerm (tree models)."""
+        m = self._m()
+        if not hasattr(m, "predict_contributions"):
+            raise ValueError(f"{m.algo} does not support predict_contributions")
+        return m.predict_contributions(test_data)
+
+    def predict_leaf_node_assignment(self, test_data, type="Path"):
+        """Terminal leaf per (row, tree, class): 'Path' strings or 'Node_ID'."""
+        m = self._m()
+        if not hasattr(m, "predict_leaf_node_assignment"):
+            raise ValueError(
+                f"{m.algo} does not support predict_leaf_node_assignment"
+            )
+        return m.predict_leaf_node_assignment(test_data, type=type)
+
     def model_performance(self, test_data=None):
         return self._m().model_performance(test_data)
 

@@ -134,6 +134,15 @@ class MojoModel:
 # shared numeric helpers
 
 
+def goes_left(b, na_left_n, cat_hit_n, is_cat_n, thr_n):
+    """THE split-decision rule, vectorized over rows (bin 0 = NA): NA rows
+    follow na_left, categorical rows follow the gathered mask hit, numeric
+    rows go left iff bin <= threshold. Single source for every host-side
+    tree walk (offline scorer, leaf-node assignment); mirrors the device
+    rule in shared_tree._partition_update."""
+    return np.where(b == 0, na_left_n, np.where(is_cat_n, cat_hit_n, b <= thr_n))
+
+
 def _col_numeric(table, name, n) -> np.ndarray:
     if name not in table:
         return np.full(n, np.nan)
@@ -236,10 +245,8 @@ class _TreeMojo(MojoModel):
             node = np.where(active, nid, 0)
             col = split_col[node]
             b = bins[np.arange(n), col]
-            go_left = np.where(
-                b == 0, na_left[node],
-                np.where(is_cat[node], cat_mask[node, b], b <= split_bin[node]),
-            )
+            go_left = goes_left(b, na_left[node], cat_mask[node, b],
+                                is_cat[node], split_bin[node])
             child = child_base[node] + np.where(go_left, 0, 1)
             retired = leaf_now[node]
             preds += np.where(active & retired, leaf_val[node], 0.0)

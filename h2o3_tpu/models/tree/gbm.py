@@ -181,6 +181,13 @@ class SharedTreeModel(Model):
 
         return tree_view(self, tree_number, tree_class)
 
+    def predict_leaf_node_assignment(self, frame: Frame, type: str = "Path") -> Frame:
+        """Terminal leaf per (row, tree, class): decision-path strings or
+        node ids (upstream Model.LeafNodeAssignment contract)."""
+        from h2o3_tpu.models.tree.shap import predict_leaf_node_assignment
+
+        return predict_leaf_node_assignment(self, frame, type)
+
 
 class GBMModel(SharedTreeModel):
     algo = "gbm"

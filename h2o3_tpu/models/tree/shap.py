@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from h2o3_tpu.frame.frame import Frame, Vec
+from h2o3_tpu.genmodel import goes_left
 
 
 class _Node:
@@ -185,6 +186,76 @@ def predict_contributions(model, frame: Frame) -> Frame:
         [Vec.from_numpy(phi[:, j], "real") for j in range(C + 1)],
         list(names) + ["BiasTerm"],
     )
+
+
+def predict_leaf_node_assignment(model, frame: Frame, type: str = "Path") -> Frame:
+    """Per-row terminal leaf of every tree — ``predict_leaf_node_assignment``
+    [UNVERIFIED upstream hex/Model.java LeafNodeAssignment]: one column per
+    (tree, class) named ``T{i}.C{k}``, either the root-to-leaf decision
+    string ("LRLL", type="Path") or the node's index in the flattened node
+    list (type="Node_ID"). The walk is vectorized numpy over the flattened
+    node arrays (analysis-scale op; the hot scoring path stays on device).
+    """
+    from h2o3_tpu.models.tree.binning import bin_frame
+
+    if type not in ("Path", "Node_ID"):
+        raise ValueError(f"type must be 'Path' or 'Node_ID', got {type!r}")
+    out = model.output
+    spec = out["bin_spec"]
+    bins = np.asarray(bin_frame(spec, frame))[: frame.nrow]  # (n, C) uint8
+    trees = out["trees"]  # [iteration][class]
+    K = out.get("n_tree_classes", 1)
+    n = frame.nrow
+    rows = np.arange(n)
+
+    vecs = []
+    names = []
+    for ti, group in enumerate(trees):
+        for k in range(K):
+            nodes = _tree_nodes(group[k])
+            feat = np.array([nd.feature for nd in nodes], np.int64)
+            thr = np.array([nd.thr_bin for nd in nodes], np.int64)
+            is_cat = np.array([nd.is_cat for nd in nodes], bool)
+            na_left = np.array([nd.na_left for nd in nodes], bool)
+            left = np.array([nd.left for nd in nodes], np.int64)
+            right = np.array([nd.right for nd in nodes], np.int64)
+            is_leaf = np.array([nd.is_leaf for nd in nodes], bool)
+            # bin-adaptive levels record NARROWER cat_mask than full-bin
+            # levels (numeric-only coarsening; the masks are unused there)
+            # — pad to the widest so the stack is rectangular, same as
+            # export.py does for the tmojo archive
+            W = max(nd.cat_mask.shape[0] for nd in nodes)
+            cat_mask = np.stack([
+                np.pad(nd.cat_mask, (0, W - nd.cat_mask.shape[0]))
+                for nd in nodes
+            ])  # (N, W)
+
+            depth = len(group[k].levels)
+            cur = np.zeros(n, np.int64)
+            steps = np.full((n, max(depth, 1)), "", dtype="<U1")
+            for step in range(depth):
+                at_leaf = is_leaf[cur]
+                if at_leaf.all():
+                    break
+                b = bins[rows, feat[cur]].astype(np.int64)
+                gl = goes_left(b, na_left[cur], cat_mask[cur, b], is_cat[cur],
+                               thr[cur])
+                adv = ~at_leaf
+                steps[adv, step] = np.where(gl[adv], "L", "R")
+                cur = np.where(adv, np.where(gl, left[cur], right[cur]), cur)
+
+            name = f"T{ti + 1}.C{k + 1}"
+            names.append(name)
+            if type == "Node_ID":
+                vecs.append(Vec.from_numpy(cur, "int", name=name))
+            else:
+                paths = np.array(["".join(r) for r in steps], dtype=object)
+                domain = sorted(set(paths))
+                codes = np.searchsorted(domain, paths)
+                vecs.append(
+                    Vec.from_numpy(codes, "enum", name=name, domain=tuple(domain))
+                )
+    return Frame(vecs, names)
 
 
 def _expected_value(nodes: list[_Node], j: int) -> float:

@@ -160,12 +160,7 @@ h2o.getModel <- function(id) {
   res$models[[1]]
 }
 
-h2o.predict <- function(model, frame) {
-  res <- .h2o.req("POST", paste0("/3/Predictions/models/", model$model_id,
-                                 "/frames/", .h2o.key(frame$frame_id)), list())
-  structure(list(frame_id = .h2o.key(res$predictions_frame)),
-            class = "H2O3Frame")
-}
+h2o.predict <- function(model, frame) .h2o.predictions(model, frame)
 
 h2o.performance <- function(model, frame = NULL) {
   m <- h2o.getModel(model$model_id)
@@ -363,3 +358,20 @@ local({
   }
   if (file.exists(gen)) source(gen)
 })
+
+.h2o.predictions <- function(model, frame, options = list()) {
+  res <- .h2o.req("POST", paste0("/3/Predictions/models/", model$model_id,
+                                 "/frames/", .h2o.key(frame$frame_id)),
+                  options)
+  structure(list(frame_id = .h2o.key(res$predictions_frame)),
+            class = "H2O3Frame")
+}
+
+h2o.predict_contributions <- function(model, frame) {
+  .h2o.predictions(model, frame, list(predict_contributions = TRUE))
+}
+
+h2o.predict_leaf_node_assignment <- function(model, frame, type = "Path") {
+  .h2o.predictions(model, frame, list(leaf_node_assignment = TRUE,
+                                      leaf_node_assignment_type = type))
+}

@@ -352,3 +352,72 @@ def test_shap_survives_save_load(tmp_path):
     np.testing.assert_allclose(bmat, amat, atol=1e-6)
     tv = m2.tree_view(0)
     assert all(c > 0 for i, c in enumerate(tv["cover"]) if not tv["is_leaf"][i])
+
+
+def test_leaf_node_assignment_node_id_matches_leaf_values():
+    """Single gaussian tree at learn_rate=1: prediction == init + leaf value
+    at the assigned Node_ID — the leaf assignment must agree with replay."""
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.models.tree.shap import _tree_nodes
+
+    rng = np.random.default_rng(7)
+    n = 500
+    X = rng.normal(size=(n, 3))
+    yv = X[:, 0] * 2 + (X[:, 1] > 0) - X[:, 2] ** 2 + rng.normal(size=n) * 0.1
+    df = pd.DataFrame(X, columns=list("abc"))
+    df["y"] = yv
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=1, max_depth=3, learn_rate=1.0, distribution="gaussian",
+            seed=5).train(y="y", training_frame=fr)
+
+    la = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    assert la.names == ["T1.C1"]
+    nid = la.vec("T1.C1").to_numpy().astype(int)
+    nodes = _tree_nodes(m.output["trees"][0][0])
+    assert all(nodes[j].is_leaf for j in np.unique(nid))
+    leaf_vals = np.array([nodes[j].value for j in nid])
+    pred = m.predict(fr).vec("predict").to_numpy()
+    init = float(np.asarray(m.output["init_f"]))
+    np.testing.assert_allclose(pred, init + leaf_vals, rtol=1e-5, atol=1e-5)
+
+
+def test_leaf_node_assignment_paths_consistent_with_node_ids():
+    from h2o3_tpu.models import GBM
+
+    df, _ = _binary(n=400, seed=3)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=3, max_depth=3, seed=9).train(y="y", training_frame=fr)
+    paths = m.predict_leaf_node_assignment(fr, type="Path")
+    ids = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    assert paths.names == ids.names == ["T1.C1", "T2.C1", "T3.C1"]
+    for c in paths.names:
+        pv = paths.vec(c)
+        s = np.asarray(pv.levels())[pv.to_numpy().astype(int)]
+        assert all(set(p) <= {"L", "R"} for p in s)
+        # same path <-> same node id, bijectively
+        iv = ids.vec(c).to_numpy().astype(int)
+        assert len(set(zip(s, iv))) == len(set(s)) == len(set(iv))
+
+
+def test_leaf_node_assignment_handles_adaptive_ragged_masks():
+    """Bin-adaptive models record NARROWER cat_mask at deep levels
+    (numeric-only coarsening); the leaf walk must pad, not crash, and the
+    masks must not affect numeric decisions."""
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.models.tree.shap import predict_leaf_node_assignment
+
+    df, _ = _binary(n=300, seed=11)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=2, max_depth=4, seed=2).train(y="y", training_frame=fr)
+    ref = predict_leaf_node_assignment(m, fr, type="Node_ID")
+    # simulate adaptivity: truncate deep levels' masks to half width
+    for group in m.output["trees"]:
+        for t in group:
+            for lv in t.levels[3:]:
+                w = np.asarray(lv.cat_mask)
+                lv.cat_mask = w[..., : max(w.shape[-1] // 2, 1)]
+    out = predict_leaf_node_assignment(m, fr, type="Node_ID")
+    for c in ref.names:
+        np.testing.assert_array_equal(
+            ref.vec(c).to_numpy(), out.vec(c).to_numpy()
+        )

@@ -302,3 +302,40 @@ def test_flow_page_serves_notebook(server):
     js = html.split("<script>")[1].split("</script>")[0]
     for o, c in ("()", "{}", "[]"):
         assert js.count(o) == js.count(c)
+
+
+def test_predict_options_over_rest(server):
+    """predict_contributions / leaf_node_assignment predict options
+    (upstream PredictV3 surface) return their special frames."""
+    _upload_frame(n=300, seed=9, key="rest_popt")
+    resp = _post(server, "/3/ModelBuilders/gbm", {
+        "training_frame": "rest_popt", "response_column": "y",
+        "ntrees": 2, "max_depth": 3, "seed": 4,
+    })
+    job = _wait_job(server, resp["job"]["key"]["name"])
+    assert job["status"] == "DONE", job
+    mk = job["dest"]["name"]
+
+    c = _post(server, f"/3/Predictions/models/{mk}/frames/rest_popt",
+              {"predict_contributions": True}, as_json=True)
+    cfr = _get(server, f"/3/Frames/{c['predictions_frame']['name']}")["frames"][0]
+    assert [x["label"] for x in cfr["columns"]] == ["a", "b", "BiasTerm"]
+
+    la = _post(server, f"/3/Predictions/models/{mk}/frames/rest_popt",
+               {"leaf_node_assignment": True}, as_json=True)
+    lfr = _get(server, f"/3/Frames/{la['predictions_frame']['name']}")["frames"][0]
+    assert [x["label"] for x in lfr["columns"]] == ["T1.C1", "T2.C1"]
+
+    # unsupported model (GLM) -> 400
+    resp = _post(server, "/3/ModelBuilders/glm", {
+        "training_frame": "rest_popt", "response_column": "y",
+        "family": "binomial",
+    })
+    job = _wait_job(server, resp["job"]["key"]["name"])
+    glm_key = job["dest"]["name"]
+    try:
+        _post(server, f"/3/Predictions/models/{glm_key}/frames/rest_popt",
+              {"predict_contributions": True}, as_json=True)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert json.loads(e.read())["http_status"] == 400
