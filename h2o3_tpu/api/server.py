@@ -717,6 +717,100 @@ class Endpoints:
                 "leader": {"name": lb.leader.key} if lb and lb.leader else None,
                 "event_log": aml.event_log}
 
+    # -- frame utilities (SplitFrame / CreateFrame handlers) ----------------
+
+    def split_frame(self, params):
+        """``POST /3/SplitFrame`` [UNVERIFIED upstream
+        water/api/SplitFrameHandler]: random row split into ratio parts."""
+        from h2o3_tpu.cluster import spmd
+
+        frame_key = params.get("dataset") or params.get("frame_id")
+        if isinstance(frame_key, dict):
+            frame_key = frame_key.get("name")
+        if not frame_key or not isinstance(DKV.get(frame_key), Frame):
+            raise ApiError(404, f"Frame {frame_key!r} not found")
+        try:
+            ratios = params.get("ratios")
+            if isinstance(ratios, str):
+                ratios = json.loads(ratios)
+            if isinstance(ratios, (int, float)):
+                ratios = [ratios]
+            if not ratios:
+                raise ApiError(400, "ratios is required")
+            ratios = [float(r) for r in ratios]
+            seed = params.get("seed")
+            seed = 1234 if seed in (None, "") else int(seed)
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"bad SplitFrame parameters: {e}")
+        if any(r <= 0 for r in ratios) or sum(ratios) > 1.0 + 1e-9:
+            raise ApiError(400, "ratios must be positive and sum to <= 1")
+        dests = params.get("destination_frames")
+        if isinstance(dests, str):
+            dests = json.loads(dests)
+        n_parts = len(ratios) + (1 if sum(ratios) < 1.0 - 1e-9 else 0)
+        if not dests:
+            dests = [DKV.make_key("split") for _ in range(n_parts)]
+        dests = [d["name"] if isinstance(d, dict) else str(d) for d in dests]
+        if len(dests) != n_parts:
+            raise ApiError(
+                400, f"destination_frames must name all {n_parts} parts "
+                f"(ratios summing < 1 add a remainder part); got {len(dests)}")
+        job = Job(
+            lambda j: spmd.run("split_frame", frame_key=frame_key,
+                               ratios=ratios, dests=dests, seed=seed),
+            "SplitFrame",
+        )
+        job.start()
+        try:
+            job.join()
+        except RuntimeError as e:
+            raise ApiError(400, str(e))
+        return {"__meta": {"schema_type": "SplitFrame"},
+                "job": _job_schema(job),
+                "destination_frames": [{"name": d} for d in dests]}
+
+    def create_frame(self, params):
+        """``POST /3/CreateFrame`` [UNVERIFIED upstream
+        water/api/CreateFrameHandler]: synthetic random frame."""
+        from h2o3_tpu.cluster import spmd
+
+        dest = params.get("dest") or params.get("destination_frame")
+        if isinstance(dest, dict):
+            dest = dest.get("name")
+        dest = dest or DKV.make_key("created_frame")
+        spec = {k: params[k] for k in (
+            "rows", "cols", "seed", "categorical_fraction",
+            "integer_fraction", "binary_fraction", "missing_fraction",
+            "factors", "real_range", "integer_range", "has_response",
+            "response_factors",
+        ) if k in params}
+        try:
+            for k, v in list(spec.items()):
+                if isinstance(v, str):
+                    spec[k] = (json.loads(v.lower())
+                               if v.lower() in ("true", "false") else float(v))
+            if int(spec.get("seed", -1)) < 0:
+                # unseeded: the COORDINATOR draws the seed so every rank of a
+                # multi-process cloud generates identical data (the spmd
+                # replicated-determinism contract)
+                import random
+
+                spec["seed"] = random.randrange(1 << 31)
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"bad CreateFrame parameters: {e}")
+        job = Job(lambda j: spmd.run("create_frame", dest=dest, spec=spec),
+                  "CreateFrame")
+        job.start()
+        try:
+            job.join()
+        except RuntimeError as e:
+            raise ApiError(400, str(e))
+        fr = DKV.get(dest)
+        return {"__meta": {"schema_type": "CreateFrame"},
+                "job": _job_schema(job),
+                "destination_frame": {"name": dest},
+                "rows": fr.nrow, "cols": len(fr.names)}
+
     # -- node persistent storage (Flow notebook save/load) -----------------
     # Successor of ``/3/NodePersistentStorage`` [UNVERIFIED upstream path
     # water/api/NodePersistentStorageHandler.java, SURVEY.md §2.3]: Flow
@@ -895,6 +989,8 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
     ("POST", r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", _EP.model_metrics),
     ("POST", r"/99/Rapids", _EP.rapids),
+    ("POST", r"/3/SplitFrame", _EP.split_frame),
+    ("POST", r"/3/CreateFrame", _EP.create_frame),
     ("GET", r"/3/NodePersistentStorage/configured", _EP.nps_configured),
     ("GET", r"/3/NodePersistentStorage/([^/]+)", _EP.nps_list),
     ("GET", r"/3/NodePersistentStorage/([^/]+)/([^/]+)", _EP.nps_get),

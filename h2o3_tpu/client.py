@@ -125,6 +125,22 @@ class H2OConnection:
         )
         return out["predictions_frame"]["name"]
 
+    def split_frame(self, frame: str | Any, ratios, destination_frames=None,
+                    seed: int = 1234) -> list[str]:
+        """Random row split via /3/SplitFrame; returns the part keys."""
+        body = {"dataset": _key_of(frame), "ratios": list(ratios), "seed": seed}
+        if destination_frames:
+            body["destination_frames"] = list(destination_frames)
+        out = self.post("/3/SplitFrame", body)
+        return [d["name"] for d in out["destination_frames"]]
+
+    def create_frame(self, dest: str | None = None, **spec) -> str:
+        """Synthetic random frame via /3/CreateFrame; returns the key."""
+        body = dict(spec)
+        if dest:
+            body["dest"] = dest
+        return self.post("/3/CreateFrame", body)["destination_frame"]["name"]
+
     def model_performance(self, model_key: str, frame: str | Any) -> dict:
         out = self.post(
             f"/3/ModelMetrics/models/{model_key}/frames/{_key_of(frame)}", {}

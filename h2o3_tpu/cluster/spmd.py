@@ -159,6 +159,88 @@ def _exec_predict(model_key: str, frame_key: str, dest: str, option: str = "",
     return out
 
 
+def _exec_split_frame(frame_key: str, ratios, dests, seed: int):
+    from h2o3_tpu.cluster.registry import DKV
+
+    fr = DKV.get(frame_key)
+    parts = fr.split_frame(list(ratios), seed=int(seed))
+    # the host-side rng mask is seed-deterministic, so every rank computes
+    # identical splits; rename each part onto its coordinator-chosen key
+    out = []
+    for p, d in zip(parts, dests):
+        DKV.remove(p.key)
+        p.key = d
+        DKV.put(d, p)
+        out.append(p)
+    for p in parts[len(dests):]:  # unnamed remainder splits are dropped
+        DKV.remove(p.key)
+    return out
+
+
+def _exec_create_frame(dest: str, spec: dict):
+    """Synthetic frame generator (water/api/CreateFrameHandler successor
+    [UNVERIFIED]): seed-deterministic host generation, identical on every
+    rank."""
+    import numpy as np
+    import pandas as pd
+
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.frame.frame import Frame
+
+    rows = int(spec.get("rows", 10_000))
+    cols = int(spec.get("cols", 10))
+    # the coordinator resolves unseeded requests before broadcasting
+    # (server.create_frame); a residual -1 here must still be deterministic
+    # across ranks, so it maps to a fixed seed rather than OS entropy
+    seed = int(spec.get("seed", -1))
+    rng = np.random.default_rng(1234 if seed < 0 else seed)
+    cat_frac = float(spec.get("categorical_fraction", 0.2))
+    int_frac = float(spec.get("integer_fraction", 0.2))
+    bin_frac = float(spec.get("binary_fraction", 0.1))
+    missing = float(spec.get("missing_fraction", 0.0))
+    factors = int(spec.get("factors", 100))
+    real_range = float(spec.get("real_range", 100.0))
+    int_range = int(spec.get("integer_range", 100))
+
+    n_cat = int(round(cols * cat_frac))
+    n_int = int(round(cols * int_frac))
+    n_bin = int(round(cols * bin_frac))
+    n_real = max(cols - n_cat - n_int - n_bin, 0)
+
+    data = {}
+    i = 0
+    for _ in range(n_real):
+        data[f"C{i + 1}"] = rng.uniform(-real_range, real_range, rows)
+        i += 1
+    for _ in range(n_int):
+        data[f"C{i + 1}"] = rng.integers(-int_range, int_range + 1, rows).astype(np.float64)
+        i += 1
+    for _ in range(n_bin):
+        data[f"C{i + 1}"] = rng.integers(0, 2, rows).astype(np.float64)
+        i += 1
+    for _ in range(n_cat):
+        data[f"C{i + 1}"] = np.array(
+            [f"c{int(v)}.l{int(v)}" for v in rng.integers(0, max(factors, 1), rows)]
+        )
+        i += 1
+    df = pd.DataFrame(data)
+    if missing > 0:
+        mask = rng.random((rows, len(df.columns))) < missing
+        df = df.mask(pd.DataFrame(mask, columns=df.columns))
+    if spec.get("has_response"):
+        rf = int(spec.get("response_factors", 2))
+        if rf <= 1:
+            df.insert(0, "response", rng.uniform(-real_range, real_range, rows))
+        else:
+            df.insert(0, "response", np.array(
+                [f"resp{int(v)}" for v in rng.integers(0, rf, rows)]))
+    fr = Frame.from_pandas(df)
+    DKV.remove(fr.key)
+    fr.key = dest
+    DKV.put(dest, fr)
+    return fr
+
+
 class _JobShim:
     """Followers have no REST Job; grid/AutoML drivers only need these."""
 
@@ -330,6 +412,8 @@ _COMMANDS = {
     "grid": _exec_grid,
     "automl": _exec_automl,
     "rapids": _exec_rapids,
+    "split_frame": _exec_split_frame,
+    "create_frame": _exec_create_frame,
     "frame_summary": _exec_frame_summary,
     "frame_pull": _exec_frame_pull,
     "frame_export": _exec_frame_export,

@@ -211,6 +211,53 @@ class _TreeMojo(MojoModel):
             cols.append(b.astype(np.int64))
         return np.stack(cols, axis=1)
 
+    def leaf_node_assignment(self, table, type: str = "Path") -> dict[str, np.ndarray]:
+        """Terminal leaf per (row, tree, class) — the EasyPredict
+        leafNodeAssignment analog, offline. Returns {column name ->
+        array}: decision-path strings (type="Path") or node ids in the
+        level-flattened numbering the in-cluster
+        ``predict_leaf_node_assignment`` uses (type="Node_ID")."""
+        if type not in ("Path", "Node_ID"):
+            raise ValueError(f"type must be 'Path' or 'Node_ID', got {type!r}")
+        bins = self._bin_features(table)
+        n = bins.shape[0]
+        K = self.meta["n_tree_classes"]
+        rows = np.arange(n)
+        a = self.arrays
+        out: dict[str, np.ndarray] = {}
+        for ti, class_levels in enumerate(self.meta["tree_levels"]):
+            for ki in range(K):
+                n_levels = class_levels[ki]
+                nid = np.zeros(n, np.int64)
+                term = np.zeros(n, np.int64)
+                steps = np.full((n, max(n_levels, 1)), "", dtype="<U1")
+                offset = 0
+                for li in range(n_levels):
+                    pre = f"t{ti}_k{ki}_l{li}_"
+                    split_col = a[pre + "split_col"]
+                    leaf_now = a[pre + "leaf_now"]
+                    active = nid >= 0
+                    node = np.where(active, nid, 0)
+                    retired = leaf_now[node] & active
+                    term = np.where(retired, offset + node, term)
+                    b = bins[rows, split_col[node]]
+                    go_left = goes_left(
+                        b, a[pre + "na_left"][node],
+                        a[pre + "cat_mask"][node, b],
+                        a[pre + "is_cat"][node], a[pre + "split_bin"][node],
+                    )
+                    walking = active & ~retired
+                    steps[walking, li] = np.where(go_left[walking], "L", "R")
+                    child = a[pre + "child_base"][node] + np.where(go_left, 0, 1)
+                    nid = np.where(walking, child, -1)
+                    offset += len(split_col)
+                name = f"T{ti + 1}.C{ki + 1}"
+                if type == "Node_ID":
+                    out[name] = term
+                else:
+                    out[name] = np.array(["".join(r) for r in steps], dtype=object)
+        return out
+
     def _forest_sums(self, bins, n: int, K: int, shapes) -> np.ndarray:
         """(n, K) leaf sums over the forest — native C++ walk when the
         library builds (row-major, per-row early exit), numpy level replay

@@ -344,6 +344,34 @@ h2o.confusionMatrix <- function(perf) perf$confusion_matrix
 h2o.scoreHistory <- function(model) h2o.getModel(model$model_id)$output$scoring_history
 h2o.shutdown <- function() invisible(NULL)  # coordinator lifecycle is external
 
+h2o.splitFrame <- function(frame, ratios = 0.75, destination_frames = NULL,
+                           seed = 1234) {
+  body <- list(dataset = .h2o.fref(frame), ratios = as.list(ratios),
+               seed = seed)
+  if (!is.null(destination_frames)) {
+    body$destination_frames <- as.list(destination_frames)
+  }
+  res <- .h2o.req("POST", "/3/SplitFrame", body)
+  lapply(res$destination_frames, function(d) {
+    structure(list(frame_id = .h2o.key(d)), class = "H2O3Frame")
+  })
+}
+
+h2o.createFrame <- function(rows = 10000, cols = 10, seed = -1,
+                            categorical_fraction = 0.2,
+                            integer_fraction = 0.2, binary_fraction = 0.1,
+                            missing_fraction = 0.0, factors = 100,
+                            has_response = FALSE, response_factors = 2) {
+  res <- .h2o.req("POST", "/3/CreateFrame", list(
+    rows = rows, cols = cols, seed = seed,
+    categorical_fraction = categorical_fraction,
+    integer_fraction = integer_fraction, binary_fraction = binary_fraction,
+    missing_fraction = missing_fraction, factors = factors,
+    has_response = has_response, response_factors = response_factors))
+  structure(list(frame_id = .h2o.key(res$destination_frame)),
+            class = "H2O3Frame")
+}
+
 # -- generated explicit-argument estimators -----------------------------------
 # estimators_gen.R (tools/gen_bindings.py output) defines h2o.gbm/h2o.glm/...
 # with every parameter as a named argument; when present next to this file it

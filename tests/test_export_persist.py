@@ -228,3 +228,26 @@ def test_ordinal_glm_mojo_parity(tmp_path):
     live = m._predict_raw(fr)
     np.testing.assert_allclose(offline, live, atol=1e-5)
     assert offline.shape == (n, 3)
+
+
+def test_mojo_leaf_node_assignment_parity(tmp_path):
+    """Offline scorer's leaf assignment == in-cluster
+    predict_leaf_node_assignment, both types."""
+    df = _df(500, seed=6)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=3, max_depth=3, seed=8).train(y="y", training_frame=fr)
+    path = str(tmp_path / "leafmojo.zip")
+    m.download_mojo(path)
+    mojo = MojoModel.load(path)
+    table = {c: df[c].to_numpy() for c in df.columns if c != "y"}
+
+    ids_cluster = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    paths_cluster = m.predict_leaf_node_assignment(fr, type="Path")
+    ids_mojo = mojo.leaf_node_assignment(table, type="Node_ID")
+    paths_mojo = mojo.leaf_node_assignment(table, type="Path")
+    for c in ids_cluster.names:
+        np.testing.assert_array_equal(
+            ids_cluster.vec(c).to_numpy().astype(int), ids_mojo[c])
+        pv = paths_cluster.vec(c)
+        s = np.asarray(pv.levels())[pv.to_numpy().astype(int)]
+        np.testing.assert_array_equal(s.astype(str), paths_mojo[c].astype(str))

@@ -339,3 +339,52 @@ def test_predict_options_over_rest(server):
         assert False, "expected 400"
     except urllib.error.HTTPError as e:
         assert json.loads(e.read())["http_status"] == 400
+
+
+def test_split_and_create_frame_routes(server):
+    """/3/SplitFrame and /3/CreateFrame (upstream frame-utility handlers)."""
+    _upload_frame(n=1000, seed=13, key="rest_split_src")
+    out = _post(server, "/3/SplitFrame", {
+        "dataset": "rest_split_src", "ratios": [0.75],
+        "destination_frames": ["sf_train", "sf_test"], "seed": 7,
+    }, as_json=True)
+    assert [d["name"] for d in out["destination_frames"]] == ["sf_train", "sf_test"]
+    a = _get(server, "/3/Frames/sf_train")["frames"][0]["rows"]
+    b = _get(server, "/3/Frames/sf_test")["frames"][0]["rows"]
+    assert a + b == 1000 and 650 <= a <= 850
+
+    cf = _post(server, "/3/CreateFrame", {
+        "dest": "cf1", "rows": 500, "cols": 10, "seed": 3,
+        "categorical_fraction": 0.3, "integer_fraction": 0.2,
+        "missing_fraction": 0.05, "factors": 5,
+        "has_response": True, "response_factors": 2,
+    }, as_json=True)
+    assert cf["rows"] == 500 and cf["cols"] == 11  # +response
+    fr = _get(server, "/3/Frames/cf1")["frames"][0]
+    labels = [c["label"] for c in fr["columns"]]
+    assert labels[0] == "response"
+    # ratio errors are 400s
+    try:
+        _post(server, "/3/SplitFrame", {"dataset": "rest_split_src",
+                                        "ratios": [0.9, 0.9]}, as_json=True)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_split_frame_validates_destination_count(server):
+    _upload_frame(n=200, seed=17, key="rest_split_v")
+    for dests in (["a", "b", "c"], ["only_one"]):
+        try:
+            _post(server, "/3/SplitFrame", {
+                "dataset": "rest_split_v", "ratios": [0.75],
+                "destination_frames": dests}, as_json=True)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    # bad scalar params are 400s, not 500s
+    try:
+        _post(server, "/3/CreateFrame", {"rows": "abc"}, as_json=True)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
