@@ -400,6 +400,62 @@ def _bench_join_10m() -> dict:
         del left, right, out
 
 
+def _bench_cat_1m() -> dict:
+    """GBM on a categorical-heavy frame (BASELINE config #3 workload shape:
+    Criteo-style high-cardinality enums + numerics). Exercises the
+    mean-sorted categorical split path and enum code storage at scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.models.tree import GBM
+
+    n = max(int(1_000_000 * _SCALE), 10_000)
+    n_num, n_cat, card = 20, 8, 200
+
+    def labeler(ku, X):
+        eta = 1.2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3] - 0.5
+        u = jax.random.uniform(ku, (X.shape[0],))
+        return (u < jax.nn.sigmoid(eta)).astype(jnp.int8), ("b", "s")
+
+    fr = _make_data_device(n, c=n_num, labeler=labeler)
+    fr2 = m0 = m = None
+    try:
+        # append device-generated enum columns (codes depend on numerics so
+        # the categorical splits carry signal)
+        from h2o3_tpu.frame.frame import CAT, Frame, Vec
+
+        key = jax.random.PRNGKey(9)
+        vecs = [fr.vec(nm) for nm in fr.names]
+        for j in range(n_cat):
+            kj = jax.random.fold_in(key, j)
+            base = fr.vec(f"f{j % n_num}").data
+            noise = jax.random.randint(kj, base.shape, 0, card // 4)
+            codes = (
+                (jnp.abs(jnp.nan_to_num(base)) * 37 + noise) % card
+            ).astype(jnp.int16)
+            vecs.insert(-1, Vec(codes, CAT, name=f"cat{j}", nrow=n,
+                                domain=tuple(f"l{i}" for i in range(card))))
+        fr2 = Frame(vecs, register=True)
+
+        kw = dict(max_depth=DEPTH, learn_rate=0.1, min_rows=10.0,
+                  score_tree_interval=1000, seed=42)
+        m0 = GBM(ntrees=5, **kw).train(y="label", training_frame=fr2)
+        t0 = time.time()
+        m = GBM(ntrees=5, **kw).train(y="label", training_frame=fr2)
+        dt = time.time() - t0
+        return {
+            "rows": n, "num_cols": n_num, "cat_cols": n_cat,
+            "cardinality": card, "trees_per_sec": round(5 / dt, 3),
+            "auc": round(float(m.training_metrics.auc), 4),
+        }
+    finally:
+        _drop_models(m0, m)
+        DKV.remove(fr.key)
+        if fr2 is not None:
+            DKV.remove(fr2.key)
+
+
 def _bench_dl(n: int = max(int(100_000 * _SCALE), 5_000), d: int = 784, k: int = 10) -> dict:
     """Sync-SGD MLP rows/sec (BASELINE config #4: Hogwild→sync-SGD MLP).
     MNIST-shaped synthetic: 100k x 784 → 10 classes, 2x128 hidden."""
@@ -541,6 +597,7 @@ def _phase_automl_50k() -> dict:
 _PHASES: dict = {
     "headline": (_phase_headline, 1500),
     "scale_10m": (_bench_10m, 900),       # VERDICT r4: evidence beyond 1M
+    "cat_1m": (_bench_cat_1m, 900),       # BASELINE config #3 workload shape
     "join_10m": (_bench_join_10m, 600),   # ASTMerge successor at scale
     "glm_1m": (_phase_glm_1m, 600),
     "dl_100k": (_bench_dl, 600),          # sync-SGD MLP (BASELINE config #4)

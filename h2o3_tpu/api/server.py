@@ -558,23 +558,40 @@ class Endpoints:
         return {"__meta": {"schema_type": "Models"},
                 "models": [_model_schema(m)]}
 
-    def model_mojo(self, params, key):
+    @staticmethod
+    def _export_download(model, exporter, suffix: str, content_type: str) -> dict:
+        """Shared artifact-download plumbing for the mojo/pojo routes:
+        export to a temp file, read, clean up; unsupported-algo ValueError
+        maps to 400 in exactly one place."""
         import os as _os
         import tempfile
 
-        m = _get_model(key)
-        import h2o3_tpu.models.export as _exp
-
-        with tempfile.NamedTemporaryFile(suffix=".zip", delete=False) as f:
+        with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as f:
             path = f.name
         try:
-            _exp.export_mojo(m, path)
+            exporter(model, path)
             with open(path, "rb") as f:
                 data = f.read()
+        except ValueError as e:  # unsupported algo for this artifact
+            raise ApiError(400, str(e))
         finally:
             _os.unlink(path)
-        return {"__binary__": data, "content_type": "application/zip",
-                "filename": f"{key}.zip"}
+        return {"__binary__": data, "content_type": content_type,
+                "filename": f"{model.key}{suffix}"}
+
+    def model_mojo(self, params, key):
+        import h2o3_tpu.models.export as _exp
+
+        return self._export_download(
+            _get_model(key), _exp.export_mojo, ".zip", "application/zip")
+
+    def model_pojo(self, params, key):
+        """``GET /3/Models/{id}/pojo`` — the POJO-download analog: one
+        self-contained numpy scoring script (upstream emits one Java class)."""
+        import h2o3_tpu.models.export as _exp
+
+        return self._export_download(
+            _get_model(key), _exp.export_pojo, ".py", "text/x-python")
 
     # -- models -----------------------------------------------------------
     def models_list(self, params):
@@ -984,6 +1001,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/99/Models\.bin/([^/]+)", _EP.model_save_bin),
     ("POST", r"/99/Models\.bin", _EP.model_load_bin),
     ("GET", r"/3/Models/([^/]+)/mojo", _EP.model_mojo),
+    ("GET", r"/3/Models/([^/]+)/pojo", _EP.model_pojo),
     ("GET", r"/3/Models/([^/]+)", _EP.model_get),
     ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),

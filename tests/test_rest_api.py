@@ -388,3 +388,17 @@ def test_split_frame_validates_destination_count(server):
         assert False, "expected 400"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_pojo_download_route(server):
+    """GET /3/Models/{id}/pojo serves the standalone scoring script."""
+    _upload_frame(n=200, seed=21, key="rest_pojo")
+    resp = _post(server, "/3/ModelBuilders/gbm", {
+        "training_frame": "rest_pojo", "response_column": "y",
+        "ntrees": 1, "max_depth": 2, "seed": 1})
+    job = _wait_job(server, resp["job"]["key"]["name"])
+    mk = job["dest"]["name"]
+    with urllib.request.urlopen(server.url + f"/3/Models/{mk}/pojo") as r:
+        body = r.read().decode()
+        assert r.headers.get("Content-Type", "").startswith("text/x-python")
+    assert "MODEL" in body and "numpy" in body

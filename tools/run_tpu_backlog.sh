@@ -1,8 +1,11 @@
 #!/bin/sh
 # TPU measurement backlog — run the moment the axon tunnel is back up.
+# Each step COMMITS its artifact immediately: the last two tunnel windows
+# lasted ~2.5 h and wedged without warning, and an end-of-script commit
+# would lose everything already measured.
 #   0. memory diagnosis of the 10M-row RESOURCE_EXHAUSTED (tpu_mem_analysis)
-#   1. bench.py (subprocess-per-phase; six backend inits — the parent stops
-#      launching phases at H2O3_TPU_BENCH_DEADLINE_S, default 3000 s)
+#   1. bench.py (subprocess-per-phase; the parent stops launching phases at
+#      H2O3_TPU_BENCH_DEADLINE_S, default 3000 s)
 #   2. adaptivity A/B: default is now OFF (measured 5% slower on v5e,
 #      BENCH_builder_20260731T0101Z*); the control run measures it ON,
 #      headline only.
@@ -13,17 +16,23 @@ cd "$(dirname "$0")/.."
 
 stamp=$(date -u +%Y%m%dT%H%M%SZ)
 
+save() {  # save FILE MSG — commit one artifact if it has content
+  if [ -s "$1" ]; then
+    git add "$1" && git commit -m "$2" -- "$1"
+  fi
+}
+
 timeout 1800 python tools/tpu_mem_analysis.py --train \
   | tee "MEMDIAG_${stamp}.txt"
+save "MEMDIAG_${stamp}.txt" "TPU memory diagnosis for the 10M-row OOM"
 
 timeout 3600 python bench.py | tee "BENCH_builder_${stamp}.json"
+save "BENCH_builder_${stamp}.json" "TPU bench artifact (all phases, subprocess-isolated)"
 
 H2O3_TPU_BIN_ADAPT=1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_adapt.json"  # headline only (deadline=1s)
+save "BENCH_builder_${stamp}_adapt.json" "TPU bench adaptivity A/B control (headline only)"
 
 timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
-
-git add "MEMDIAG_${stamp}.txt" "BENCH_builder_${stamp}.json" \
-        "BENCH_builder_${stamp}_adapt.json" "KERNEL_SWEEP_${stamp}.jsonl"
-git commit -m "TPU measurement backlog: mem diagnosis, bench (adapt A/B), kernel tile sweep"
+save "KERNEL_SWEEP_${stamp}.jsonl" "Pallas histogram kernel tile sweep"
