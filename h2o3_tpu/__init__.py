@@ -36,6 +36,7 @@ from h2o3_tpu.frame.ops import (
     impute,
     ifelse,
     cor,
+    interaction,
 )
 from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
 from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
@@ -115,4 +116,5 @@ __all__ = [
     "profiler",
     "load_model",
     "import_mojo",
+    "interaction",
 ]

@@ -736,16 +736,35 @@ class Endpoints:
 
     # -- frame utilities (SplitFrame / CreateFrame handlers) ----------------
 
+    @staticmethod
+    def _resolve_frame_key(params, *names):
+        """Unwrap a frame reference ({'name': k} or str) from the first of
+        ``names`` present; 404 unless it resolves to a registered Frame."""
+        key = None
+        for n in names:
+            key = params.get(n)
+            if key:
+                break
+        if isinstance(key, dict):
+            key = key.get("name")
+        if not key or not isinstance(DKV.get(key), Frame):
+            raise ApiError(404, f"Frame {key!r} not found")
+        return key
+
+    @staticmethod
+    def _resolve_dest(params, default_prefix: str):
+        dest = params.get("dest") or params.get("destination_frame")
+        if isinstance(dest, dict):
+            dest = dest.get("name")
+        return dest or DKV.make_key(default_prefix)
+
+
     def split_frame(self, params):
         """``POST /3/SplitFrame`` [UNVERIFIED upstream
         water/api/SplitFrameHandler]: random row split into ratio parts."""
         from h2o3_tpu.cluster import spmd
 
-        frame_key = params.get("dataset") or params.get("frame_id")
-        if isinstance(frame_key, dict):
-            frame_key = frame_key.get("name")
-        if not frame_key or not isinstance(DKV.get(frame_key), Frame):
-            raise ApiError(404, f"Frame {frame_key!r} not found")
+        frame_key = self._resolve_frame_key(params, "dataset", "frame_id")
         try:
             ratios = params.get("ratios")
             if isinstance(ratios, str):
@@ -791,10 +810,7 @@ class Endpoints:
         water/api/CreateFrameHandler]: synthetic random frame."""
         from h2o3_tpu.cluster import spmd
 
-        dest = params.get("dest") or params.get("destination_frame")
-        if isinstance(dest, dict):
-            dest = dest.get("name")
-        dest = dest or DKV.make_key("created_frame")
+        dest = self._resolve_dest(params, "created_frame")
         spec = {k: params[k] for k in (
             "rows", "cols", "seed", "categorical_fraction",
             "integer_fraction", "binary_fraction", "missing_fraction",
@@ -827,6 +843,47 @@ class Endpoints:
                 "job": _job_schema(job),
                 "destination_frame": {"name": dest},
                 "rows": fr.nrow, "cols": len(fr.names)}
+
+    def interaction(self, params):
+        """``POST /3/Interaction`` [UNVERIFIED upstream
+        water/api/InteractionHandler]: factor-interaction columns."""
+        from h2o3_tpu.cluster import spmd
+
+        frame_key = self._resolve_frame_key(params, "source_frame", "frame_id")
+        try:
+            factors = params.get("factor_columns") or params.get("factors")
+            if isinstance(factors, str):
+                factors = (json.loads(factors) if factors.startswith("[")
+                           else [factors])
+        except ValueError as e:
+            raise ApiError(400, f"bad factor_columns: {e}")
+        if not factors or len(factors) < 2:
+            raise ApiError(400, "factor_columns needs at least two columns")
+        dest = self._resolve_dest(params, "interaction")
+        try:
+            pairwise = str(params.get("pairwise", "false")).lower() in ("1", "true")
+            max_factors = int(params.get("max_factors", 100))
+            min_occurrence = int(params.get("min_occurrence", 1))
+        except (ValueError, TypeError) as e:
+            raise ApiError(400, f"bad Interaction parameters: {e}")
+        job = Job(
+            lambda j: spmd.run(
+                "interaction", frame_key=frame_key, dest=dest,
+                factors=list(factors), pairwise=pairwise,
+                max_factors=max_factors, min_occurrence=min_occurrence,
+            ),
+            "Interaction",
+        )
+        job.start()
+        try:
+            job.join()
+        except RuntimeError as e:
+            raise ApiError(400, str(e))
+        fr = DKV.get(dest)
+        return {"__meta": {"schema_type": "Interaction"},
+                "job": _job_schema(job),
+                "destination_frame": {"name": dest},
+                "cols": len(fr.names)}
 
     # -- node persistent storage (Flow notebook save/load) -----------------
     # Successor of ``/3/NodePersistentStorage`` [UNVERIFIED upstream path
@@ -1009,6 +1066,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/99/Rapids", _EP.rapids),
     ("POST", r"/3/SplitFrame", _EP.split_frame),
     ("POST", r"/3/CreateFrame", _EP.create_frame),
+    ("POST", r"/3/Interaction", _EP.interaction),
     ("GET", r"/3/NodePersistentStorage/configured", _EP.nps_configured),
     ("GET", r"/3/NodePersistentStorage/([^/]+)", _EP.nps_list),
     ("GET", r"/3/NodePersistentStorage/([^/]+)/([^/]+)", _EP.nps_get),

@@ -266,6 +266,14 @@ class AutoML:
         )
         if s.max_runtime_secs_per_model:
             out["max_runtime_secs"] = s.max_runtime_secs_per_model
+        if s.max_runtime_secs:
+            # one model must never blow the WHOLE AutoML budget (upstream
+            # allocates each step a share of the remaining time; observed
+            # here: a depth-20 preset overshooting a 600 s budget to 1127 s,
+            # leaving a 2-model leaderboard). Builders honor max_runtime as
+            # a soft deadline and keep the partial model.
+            rem = max(self._remaining(), 1.0)
+            out["max_runtime_secs"] = min(out.get("max_runtime_secs") or rem, rem)
         return out
 
     def _drive(self, job: Job, x, y, training_frame, validation_frame, leaderboard_frame):

@@ -177,6 +177,19 @@ def _exec_split_frame(frame_key: str, ratios, dests, seed: int):
     return out
 
 
+def _exec_interaction(frame_key: str, dest: str, factors, pairwise: bool,
+                      max_factors: int, min_occurrence: int):
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.frame import ops
+
+    fr = DKV.get(frame_key)
+    return ops.interaction(
+        fr, list(factors), pairwise=bool(pairwise),
+        max_factors=int(max_factors), min_occurrence=int(min_occurrence),
+        destination_frame=dest,
+    )
+
+
 def _exec_create_frame(dest: str, spec: dict):
     """Synthetic frame generator (water/api/CreateFrameHandler successor
     [UNVERIFIED]): seed-deterministic host generation, identical on every
@@ -414,6 +427,7 @@ _COMMANDS = {
     "rapids": _exec_rapids,
     "split_frame": _exec_split_frame,
     "create_frame": _exec_create_frame,
+    "interaction": _exec_interaction,
     "frame_summary": _exec_frame_summary,
     "frame_pull": _exec_frame_pull,
     "frame_export": _exec_frame_export,

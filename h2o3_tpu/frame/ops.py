@@ -1158,6 +1158,85 @@ def week(v):
 # ---------------------------------------------------------------------------
 
 
+def interaction(frame, factors: list[str], pairwise: bool = False,
+                max_factors: int = 100, min_occurrence: int = 1,
+                destination_frame: str | None = None):
+    """Factor-interaction columns — ``h2o.interaction`` / the Interaction
+    handler successor [UNVERIFIED upstream path hex/Interaction.java].
+
+    ``factors`` are categorical column names; one N-way interaction column
+    (or all pairwise ones) is built whose levels are the observed
+    ``a_b`` combinations. The ``max_factors`` most frequent levels are
+    kept (ties by level order); everything else — including levels seen
+    fewer than ``min_occurrence`` times — lumps into a catch-all
+    ``other.values`` level, matching upstream's enforced-cap behavior.
+    """
+    from h2o3_tpu.frame.frame import CAT, Frame
+
+    if len(factors) < 2:
+        raise ValueError("interaction needs at least two factor columns")
+    for f in factors:
+        if not frame.vec(f).is_categorical():
+            raise ValueError(f"interaction column {f!r} is not categorical")
+
+    combos = (
+        [(a, b) for i, a in enumerate(factors) for b in factors[i + 1:]]
+        if pairwise else [tuple(factors)]
+    )
+    # one device->host pull per column, shared across pairwise combos
+    col_codes = {f: frame.vec(f).to_numpy().astype(np.int64) for f in factors}
+    vecs, names = [], []
+    for combo in combos:
+        cards = [len(frame.vec(f).domain) for f in combo]
+        prod = 1
+        for card in cards:
+            prod *= max(card, 1)
+            if prod > (1 << 62):
+                raise ValueError(
+                    "interaction cardinality product overflows the combined "
+                    f"code space ({'x'.join(map(str, cards))})")
+        codes = None
+        for f, card in zip(combo, cards):
+            c = col_codes[f]
+            na = c < 0
+            codes = c.copy() if codes is None else codes * card + c
+            codes = np.where(na | (codes < 0), -1, codes)
+        valid = codes >= 0
+        uniq, counts = np.unique(codes[valid], return_counts=True)
+        keep = uniq[counts >= max(min_occurrence, 1)]
+        kcounts = counts[counts >= max(min_occurrence, 1)]
+        if len(keep) > max(max_factors, 1):
+            order = np.argsort(-kcounts, kind="stable")[: max(max_factors, 1)]
+            keep = keep[np.sort(order)]  # stable level order like upstream
+        doms = [frame.vec(f).domain for f in combo]
+
+        def _label(code: int) -> str:
+            parts = []
+            for card, dom in zip(reversed(cards), reversed(doms)):
+                parts.append(dom[code % card])
+                code //= card
+            return "_".join(reversed(parts))
+
+        levels = [_label(int(u)) for u in keep]
+        # map observed codes -> kept-level index by search over the SORTED
+        # kept codes (dense-LUT-by-code-space would be O(prod cardinalities))
+        catch_all = len(levels)
+        pos = np.searchsorted(keep, codes)
+        pos = np.minimum(pos, max(len(keep) - 1, 0))
+        hit = valid & (len(keep) > 0) & (keep[pos] == codes)
+        mapped = np.where(hit, pos, np.where(valid, catch_all, -1))
+        has_other = bool((valid & ~hit).any())
+        if has_other:
+            levels = levels + ["other.values"]
+        name = "_".join(combo)
+        names.append(name)
+        vecs.append(Vec.from_numpy(mapped.astype(np.int32), CAT, name=name,
+                                   domain=tuple(levels)))
+    if destination_frame:
+        return Frame(vecs, names, key=destination_frame, register=True)
+    return Frame(vecs, names)
+
+
 def asfactor(v: Vec) -> Vec:
     if v.kind == CAT:
         return v

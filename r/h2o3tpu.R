@@ -344,6 +344,18 @@ h2o.confusionMatrix <- function(perf) perf$confusion_matrix
 h2o.scoreHistory <- function(model) h2o.getModel(model$model_id)$output$scoring_history
 h2o.shutdown <- function() invisible(NULL)  # coordinator lifecycle is external
 
+h2o.interaction <- function(frame, factors, pairwise = FALSE,
+                            max_factors = 100, min_occurrence = 1,
+                            destination_frame = NULL) {
+  body <- list(source_frame = .h2o.fref(frame), factor_columns = as.list(factors),
+               pairwise = pairwise, max_factors = max_factors,
+               min_occurrence = min_occurrence)
+  if (!is.null(destination_frame)) body$dest <- destination_frame
+  res <- .h2o.req("POST", "/3/Interaction", body)
+  structure(list(frame_id = .h2o.key(res$destination_frame)),
+            class = "H2O3Frame")
+}
+
 h2o.splitFrame <- function(frame, ratios = 0.75, destination_frames = NULL,
                            seed = 1234) {
   body <- list(dataset = .h2o.fref(frame), ratios = as.list(ratios),

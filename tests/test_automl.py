@@ -26,7 +26,11 @@ def test_automl_builds_leaderboard_with_ensembles():
         max_models=4,
         nfolds=3,
         seed=7,
-        max_runtime_secs=600.0,
+        # generous: the scenario asserts ensembles + leaderboard ordering,
+        # not wall-clock (a loaded 2-core box measured ~19 min for one
+        # depth-20 preset; the per-model remaining-budget cap keeps real
+        # budgets honest and has its own test below)
+        max_runtime_secs=3000.0,
         exclude_algos=["DeepLearning"],
     )
     leader = aml.train(y="y", training_frame=fr)
@@ -89,3 +93,29 @@ def test_automl_runs_xgboost_steps_first():
     aml2.train(y="y", training_frame=fr)
     algos2 = {m.algo for m in aml2.leaderboard.models}
     assert "xgboost" not in algos2 and algos2, algos2
+
+
+def test_automl_budget_caps_each_model():
+    """A single step must not blow the whole wall-clock budget: every
+    builder is launched with max_runtime_secs <= the REMAINING AutoML
+    budget (the upstream time-allocation contract; a depth-20 preset once
+    overshot a 600 s budget to 1127 s)."""
+    fr = _binary_frame(n=800, seed=5)
+    aml = AutoML(max_models=3, nfolds=0, seed=3, max_runtime_secs=40.0,
+                 include_algos=["GBM", "GLM"])
+    launched: list[float] = []
+    orig = AutoML._builder
+
+    def spy(self, algo, params):
+        launched.append(params.get("max_runtime_secs"))
+        return orig(self, algo, params)
+
+    AutoML._builder = spy
+    try:
+        aml.train(y="y", training_frame=fr)
+    finally:
+        AutoML._builder = orig
+    assert launched, "no models launched"
+    assert all(cap is not None and cap <= 40.0 for cap in launched), launched
+    # caps shrink as budget is consumed
+    assert launched[-1] <= launched[0]

@@ -562,3 +562,77 @@ def test_string_extras_and_moment_aggs():
     rapids_eval("(tmp= rs4_t (tanh (cols rs4 'x')))")
     np.testing.assert_allclose(DKV.get("rs4_t").vec(0).to_numpy(),
                                np.tanh(x), rtol=1e-6)
+
+
+class TestInteraction:
+    """h2o.interaction successor (hex/Interaction.java [UNVERIFIED])."""
+
+    def _fr(self):
+        import pandas as pd
+
+        df = pd.DataFrame({
+            "a": ["x", "x", "y", "y", "x", "y", "x", "x"],
+            "b": ["u", "v", "u", "v", "u", "u", None, "u"],
+            "n": [1.0] * 8,
+        })
+        return Frame.from_pandas(df)
+
+    def test_two_way_levels_and_codes(self):
+        fr = self._fr()
+        out = ops.interaction(fr, ["a", "b"])
+        assert out.names == ["a_b"]
+        v = out.vec("a_b")
+        labels = np.asarray(v.levels())
+        codes = v.to_numpy().astype(int)
+        got = [labels[c] if c >= 0 else None for c in codes]
+        assert got == ["x_u", "x_v", "y_u", "y_v", "x_u", "y_u", None, "x_u"]
+
+    def test_max_factors_catch_all_and_min_occurrence(self):
+        fr = self._fr()
+        out = ops.interaction(fr, ["a", "b"], max_factors=1)
+        v = out.vec("a_b")
+        labels = list(v.levels())
+        assert labels == ["x_u", "other.values"]  # x_u is most frequent (3)
+        codes = v.to_numpy().astype(int)
+        assert (codes == 0).sum() == 3 and (codes == 1).sum() == 4
+        out2 = ops.interaction(fr, ["a", "b"], min_occurrence=2)
+        assert list(out2.vec("a_b").levels()) == ["x_u", "y_u", "other.values"]
+
+    def test_pairwise_three_columns(self):
+        import pandas as pd
+
+        df = pd.DataFrame({
+            "a": ["x", "y"] * 4, "b": ["u", "v"] * 4, "c": ["p", "q"] * 4,
+        })
+        fr = Frame.from_pandas(df)
+        out = ops.interaction(fr, ["a", "b", "c"], pairwise=True)
+        assert out.names == ["a_b", "a_c", "b_c"]
+
+    def test_non_categorical_rejected(self):
+        fr = self._fr()
+        with pytest.raises(ValueError, match="not categorical"):
+            ops.interaction(fr, ["a", "n"])
+
+    def test_cardinality_overflow_rejected(self):
+        """Domains whose cardinality product would overflow the int64
+        combined-code space must error, not wrap silently to NA."""
+        import pandas as pd
+
+        fr = Frame.from_pandas(pd.DataFrame({"a": ["x"], "b": ["y"]}))
+
+        class _Dom:  # claims a huge cardinality without materializing it
+            def __init__(self, n): self.n = n
+            def __len__(self): return self.n
+            def __getitem__(self, i): return "L"
+
+        class _FakeVec:
+            def __init__(self, v): self._v = v; self.domain = _Dom(1 << 32)
+            def is_categorical(self): return True
+            def to_numpy(self): return self._v.to_numpy()
+
+        class _FakeFrame:
+            def __init__(self, fr): self._fr = fr
+            def vec(self, n): return _FakeVec(self._fr.vec(n))
+
+        with pytest.raises(ValueError, match="overflows"):
+            ops.interaction(_FakeFrame(fr), ["a", "b"])

@@ -402,3 +402,24 @@ def test_pojo_download_route(server):
         body = r.read().decode()
         assert r.headers.get("Content-Type", "").startswith("text/x-python")
     assert "MODEL" in body and "numpy" in body
+
+
+def test_interaction_route(server):
+    """/3/Interaction builds factor-interaction columns (hex/Interaction)."""
+    rng = np.random.default_rng(23)
+    n = 300
+    df = pd.DataFrame({
+        "c1": rng.choice(["a", "b"], n), "c2": rng.choice(["u", "v", "w"], n),
+        "y": rng.normal(size=n),
+    })
+    fr = h2o3_tpu.upload_file(df)
+    from h2o3_tpu.cluster.registry import DKV
+    DKV.put("rest_inter", DKV.get(fr.key)); fr.key = "rest_inter"
+    out = _post(server, "/3/Interaction", {
+        "source_frame": "rest_inter", "factor_columns": ["c1", "c2"],
+        "dest": "inter1"}, as_json=True)
+    assert out["destination_frame"]["name"] == "inter1"
+    got = _get(server, "/3/Frames/inter1")["frames"][0]
+    assert [c["label"] for c in got["columns"]] == ["c1_c2"]
+    assert got["columns"][0]["type"] == "enum"
+    assert len(got["columns"][0]["domain"]) == 6
