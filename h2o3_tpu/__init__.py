@@ -39,6 +39,7 @@ from h2o3_tpu.frame.ops import (
     interaction,
 )
 from h2o3_tpu.frame.parse import import_file, upload_file, parse_setup
+from h2o3_tpu.models.metrics import make_metrics
 from h2o3_tpu.cluster.registry import get_frame, get_model, ls, remove, remove_all
 
 
@@ -117,4 +118,5 @@ __all__ = [
     "load_model",
     "import_mojo",
     "interaction",
+    "make_metrics",
 ]

@@ -660,6 +660,63 @@ class Endpoints:
         return {"__meta": {"schema_type": "ModelMetrics"},
                 "model_metrics": [mm.to_dict()]}
 
+    def make_metrics(self, params, pred_key, act_key):
+        """``POST /3/ModelMetrics/predictions_frame/{p}/actuals_frame/{a}``
+        [UNVERIFIED upstream water/api/ModelMetricsMaker route]: metrics
+        from raw prediction/actual frames, no model."""
+        from h2o3_tpu.models.metrics import make_metrics
+
+        pred = DKV.get(pred_key)
+        act = DKV.get(act_key)
+        if not isinstance(pred, Frame) or not isinstance(act, Frame):
+            raise ApiError(404, "predictions or actuals frame not found")
+        domain = params.get("domain")
+        try:
+            if isinstance(domain, str) and domain:
+                domain = (json.loads(domain) if domain.startswith("[")
+                          else [domain])
+        except ValueError as e:
+            raise ApiError(400, f"bad domain: {e}")
+        # single-column actuals; a multi-col predictions frame is multinomial
+        act_vec = act.vec(0) if act.ncol == 1 else act.vec(
+            params.get("actuals_column") or act.names[0])
+        pred_in = pred if pred.ncol > 1 else pred.vec(0)
+        try:
+            mm = make_metrics(
+                pred_in, act_vec,
+                domain=tuple(domain) if domain else None,
+                distribution=str(params.get("distribution") or "gaussian"),
+            )
+        except (ValueError, AssertionError) as e:
+            raise ApiError(400, str(e))
+        return {"__meta": {"schema_type": "ModelMetricsMaker"},
+                "model_metrics": [mm.to_dict()]}
+
+    def partial_dependence(self, params):
+        """``POST /3/PartialDependence`` [UNVERIFIED upstream
+        water/api/PartialDependenceHandler]: PD tables for the given
+        columns, computed synchronously (tables returned inline)."""
+        from h2o3_tpu.explain import partial_dependence
+
+        model_key = params.get("model_id") or params.get("model")
+        if isinstance(model_key, dict):
+            model_key = model_key.get("name")
+        m = _get_model(str(model_key))
+        frame_key = self._resolve_frame_key(params, "frame_id", "source_frame")
+        fr = DKV.get(frame_key)
+        cols = params.get("cols") or params.get("col_pairs_2dpdp")
+        if isinstance(cols, str):
+            cols = json.loads(cols) if cols.startswith("[") else [cols]
+        if not cols:
+            raise ApiError(400, "cols is required")
+        try:
+            nbins = int(params.get("nbins", 20))
+            tables = [partial_dependence(m, fr, c, nbins=nbins) for c in cols]
+        except (ValueError, KeyError) as e:
+            raise ApiError(400, f"bad PartialDependence request: {e}")
+        return {"__meta": {"schema_type": "PartialDependence"},
+                "partial_dependence_data": tables, "cols": list(cols)}
+
     # -- automl -----------------------------------------------------------
     def automl_build(self, params):
         from h2o3_tpu.automl import AutoML
@@ -1063,6 +1120,9 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
     ("POST", r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", _EP.model_metrics),
+    ("POST", r"/3/ModelMetrics/predictions_frame/([^/]+)/actuals_frame/([^/]+)",
+     _EP.make_metrics),
+    ("POST", r"/3/PartialDependence", _EP.partial_dependence),
     ("POST", r"/99/Rapids", _EP.rapids),
     ("POST", r"/3/SplitFrame", _EP.split_frame),
     ("POST", r"/3/CreateFrame", _EP.create_frame),

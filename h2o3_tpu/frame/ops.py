@@ -1221,9 +1221,13 @@ def interaction(frame, factors: list[str], pairwise: bool = False,
         # map observed codes -> kept-level index by search over the SORTED
         # kept codes (dense-LUT-by-code-space would be O(prod cardinalities))
         catch_all = len(levels)
-        pos = np.searchsorted(keep, codes)
-        pos = np.minimum(pos, max(len(keep) - 1, 0))
-        hit = valid & (len(keep) > 0) & (keep[pos] == codes)
+        if len(keep):
+            pos = np.searchsorted(keep, codes)
+            pos = np.minimum(pos, len(keep) - 1)
+            hit = valid & (keep[pos] == codes)
+        else:  # nothing survived min_occurrence: all rows -> catch-all
+            pos = np.zeros_like(codes)
+            hit = np.zeros_like(valid)
         mapped = np.where(hit, pos, np.where(valid, catch_all, -1))
         has_other = bool((valid & ~hit).any())
         if has_other:

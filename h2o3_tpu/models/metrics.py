@@ -656,3 +656,68 @@ def _multinomial_metrics_device(actual, probs, weights, domain) -> ModelMetrics:
         },
         domain=domain,
     )
+
+
+def make_metrics(predicted, actuals, weights=None, domain=None,
+                 distribution: str = "gaussian") -> ModelMetrics:
+    """``h2o.make_metrics`` successor [UNVERIFIED upstream
+    water/api/ModelMetricsMaker]: ModelMetrics straight from prediction and
+    actual vectors, no model required.
+
+    ``predicted``: Vec/array of predictions — P(positive) for binomial,
+    (n, K) class probabilities (Frame or array) for multinomial, plain
+    numbers for regression. ``actuals``: numeric Vec/array, or a
+    categorical Vec / string array for classification. ``domain`` forces
+    classification with those labels; otherwise a categorical actuals
+    column decides.
+    """
+    from h2o3_tpu.frame.frame import Frame, Vec
+
+    def _vec_np(x):
+        if isinstance(x, Frame):
+            assert x.ncol == 1, "expected a single-column frame"
+            x = x.vec(0)
+        if isinstance(x, Vec):
+            if x.is_categorical():
+                # hand labels (not raw codes) downstream so a caller-supplied
+                # domain in a different level order still maps correctly
+                codes = x.to_numpy().astype(np.int64)
+                lv = np.asarray(list(x.domain) + [None], dtype=object)
+                return lv[np.where(codes < 0, len(lv) - 1, codes)], tuple(x.domain)
+            return x.to_numpy(), None
+        return np.asarray(x), None
+
+    def _to_codes(y, dom):
+        """labels/codes -> int codes in ``dom`` order; unknown/NA -> -1."""
+        arr = np.asarray(y)
+        if np.issubdtype(arr.dtype, np.number):
+            out = np.asarray(arr, np.float64)
+            out = np.where(np.isnan(out), -1, out)
+            return out.astype(np.int64)
+        lut = {str(d): i for i, d in enumerate(dom)}
+        return np.array([-1 if v is None else lut.get(str(v), -1) for v in arr],
+                        np.int64)
+
+    w = None
+    if weights is not None:
+        w, _ = _vec_np(weights)
+
+    # multinomial: predicted is (n, K) probabilities
+    if isinstance(predicted, Frame) and predicted.ncol > 1:
+        P = np.stack([predicted.vec(i).to_numpy() for i in range(predicted.ncol)], axis=1)
+        y, adom = _vec_np(actuals)
+        dom = tuple(domain) if domain else (adom or tuple(map(str, range(P.shape[1]))))
+        return multinomial_metrics(_to_codes(y, dom), P, w, dom)
+
+    p, _ = _vec_np(predicted)
+    y, adom = _vec_np(actuals)
+    dom = tuple(domain) if domain else adom
+    if dom and len(dom) == 2:
+        yc = _to_codes(y, dom).astype(np.float64)
+        # binomial_metrics filters only NaN; NA/unknown labels (-1) must not
+        # enter the logloss/AUC sums as y=-1
+        yc = np.where(yc < 0, np.nan, yc)
+        return binomial_metrics(yc, np.asarray(p, np.float64), w, dom)
+    if dom and len(dom) > 2:
+        raise ValueError("multinomial make_metrics needs a (n, K) predicted frame")
+    return regression_metrics(y, p, w, distribution)

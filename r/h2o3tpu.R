@@ -344,6 +344,23 @@ h2o.confusionMatrix <- function(perf) perf$confusion_matrix
 h2o.scoreHistory <- function(model) h2o.getModel(model$model_id)$output$scoring_history
 h2o.shutdown <- function() invisible(NULL)  # coordinator lifecycle is external
 
+h2o.make_metrics <- function(predicted, actuals, domain = NULL,
+                             distribution = "gaussian") {
+  body <- list(distribution = distribution)
+  if (!is.null(domain)) body$domain <- as.list(domain)
+  res <- .h2o.req("POST", paste0("/3/ModelMetrics/predictions_frame/",
+                                 .h2o.fref(predicted), "/actuals_frame/",
+                                 .h2o.fref(actuals)), body)
+  res$model_metrics[[1]]
+}
+
+h2o.partialPlot <- function(model, frame, cols, nbins = 20) {
+  res <- .h2o.req("POST", "/3/PartialDependence", list(
+    model_id = model$model_id, frame_id = .h2o.fref(frame),
+    cols = as.list(cols), nbins = nbins))
+  res$partial_dependence_data
+}
+
 h2o.interaction <- function(frame, factors, pairwise = FALSE,
                             max_factors = 100, min_occurrence = 1,
                             destination_frame = NULL) {

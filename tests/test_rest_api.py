@@ -423,3 +423,65 @@ def test_interaction_route(server):
     assert [c["label"] for c in got["columns"]] == ["c1_c2"]
     assert got["columns"][0]["type"] == "enum"
     assert len(got["columns"][0]["domain"]) == 6
+
+
+def test_make_metrics_and_partial_dependence_routes(server):
+    """/3/ModelMetrics/predictions_frame/... (h2o.make_metrics) and
+    /3/PartialDependence."""
+    fr = _upload_frame(n=400, seed=31, key="rest_mm")
+    resp = _post(server, "/3/ModelBuilders/gbm", {
+        "training_frame": "rest_mm", "response_column": "y",
+        "ntrees": 3, "max_depth": 3, "seed": 2})
+    job = _wait_job(server, resp["job"]["key"]["name"])
+    mk = job["dest"]["name"]
+    pred = _post(server, f"/3/Predictions/models/{mk}/frames/rest_mm", {})
+    pk = pred["predictions_frame"]["name"]
+
+    # make_metrics from the dog-probability column vs the actual labels:
+    # must agree with the model's own training AUC
+    r = _post(server, "/99/Rapids",
+              {"ast": f"(tmp= rest_mm_p (cols_py {pk} ['dog']))"}, as_json=True)
+    r = _post(server, "/99/Rapids",
+              {"ast": "(tmp= rest_mm_y (cols_py rest_mm ['y']))"}, as_json=True)
+    mm = _post(server,
+               "/3/ModelMetrics/predictions_frame/rest_mm_p/actuals_frame/rest_mm_y",
+               {"domain": ["cat", "dog"]}, as_json=True)
+    auc = mm["model_metrics"][0]["auc"]
+    m = _get(server, f"/3/Models/{mk}")["models"][0]
+    assert abs(auc - m["output"]["training_metrics"]["auc"]) < 1e-6
+
+    pd_out = _post(server, "/3/PartialDependence", {
+        "model_id": mk, "frame_id": "rest_mm", "cols": ["a"], "nbins": 5,
+    }, as_json=True)
+    t = pd_out["partial_dependence_data"][0]
+    assert t["column"] == "a" and len(t["values"]) == 5
+    assert len(t["mean_response"]) == 5
+
+
+def test_make_metrics_na_and_domain_order():
+    import numpy as np
+
+    import h2o3_tpu
+    from h2o3_tpu.frame.frame import CAT, Frame, Vec
+
+    rng = np.random.default_rng(4)
+    n = 1000
+    y = rng.integers(0, 2, n)
+    p = np.clip(rng.normal(0.4 + 0.2 * y, 0.25, n), 0.001, 0.999)
+    base = h2o3_tpu.make_metrics(p, y.astype(float), domain=("a", "b"))
+
+    # NA actuals (code -1) must be dropped, not folded in as y=-1
+    codes = y.astype(np.int32).copy()
+    codes[:50] = -1
+    va = Vec.from_numpy(codes, CAT, name="y", domain=("a", "b"))
+    mm_na = h2o3_tpu.make_metrics(p, va, domain=("a", "b"))
+    ref = h2o3_tpu.make_metrics(p[50:], y[50:].astype(float), domain=("a", "b"))
+    assert abs(mm_na.auc - ref.auc) < 1e-12
+    assert abs(mm_na.value("logloss") - ref.value("logloss")) < 1e-12
+
+    # a categorical actuals vec whose LEVEL ORDER differs from the given
+    # domain must remap by label, not reuse raw codes
+    flipped = Vec.from_numpy((1 - y).astype(np.int32), CAT, name="y",
+                             domain=("b", "a"))  # same labels, swapped codes
+    mm_fl = h2o3_tpu.make_metrics(p, flipped, domain=("a", "b"))
+    assert abs(mm_fl.auc - base.auc) < 1e-12
