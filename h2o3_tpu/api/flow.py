@@ -265,10 +265,29 @@ const render = {
   async Frames() {
     const s = sections.Frames;
     s.innerHTML = `<div class="panel"><div class="row">
-        <input id="imp" size="50" placeholder="/path/to/file.csv">
+        <input id="imp" size="50" placeholder="/path/to/file.csv" list="implist">
+        <datalist id="implist"></datalist>
         <button class="act" onclick="importFile()">Import + parse</button>
         <span id="impmsg" class="muted"></span></div></div>
       <div id="frlist" class="muted">loading…</div>`;
+    // server-side path completion (the Flow typeahead assist): debounced,
+    // and stale responses (slow glob for an older prefix) are dropped
+    let taTimer = null;
+    s.querySelector('#imp').oninput = (ev) => {
+      clearTimeout(taTimer);
+      const src = ev.target.value;
+      taTimer = setTimeout(async () => {
+        try {
+          const j = await api('GET',
+            `/3/Typeahead/files?src=${encodeURIComponent(src)}`);
+          if (s.querySelector('#imp').value !== src) return;  // stale
+          const dl = s.querySelector('#implist');
+          dl.replaceChildren(...(j.matches || []).map(m => {
+            const o = document.createElement('option'); o.value = m; return o;
+          }));
+        } catch (e) {}
+      }, 200);
+    };
     try {
       const j = await api('GET', '/3/Frames');
       const rows = (j.frames || []).map(f =>

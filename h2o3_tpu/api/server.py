@@ -181,6 +181,54 @@ class Endpoints:
     def ping(self, params):
         return {"__meta": {"schema_type": "Ping"}, "ok": True}
 
+    def typeahead_files(self, params):
+        """``GET /3/Typeahead/files`` [UNVERIFIED upstream
+        water/api/TypeaheadHandler]: server-side path completion for the
+        Flow import box. Only lists directories/files under the requested
+        prefix's parent; no file CONTENT is exposed (same trust level as
+        /3/ImportFiles, which already accepts arbitrary server paths)."""
+        import glob as _glob
+        import os as _os
+
+        src = str(params.get("src") or "")
+        try:
+            limit = max(int(params.get("limit", 20) or 20), 1)
+        except (ValueError, TypeError):
+            raise ApiError(400, "limit must be an integer")
+        matches: list[str] = []
+        if src:
+            pat = _glob.escape(src) + "*"
+            try:
+                for p in sorted(_glob.glob(pat))[:limit]:
+                    matches.append(p + "/" if _os.path.isdir(p) else p)
+            except OSError:
+                pass
+        return {"__meta": {"schema_type": "Typeahead"}, "src": src,
+                "matches": matches}
+
+    def metadata_schemas(self, params):
+        """``GET /3/Metadata/schemas`` [UNVERIFIED upstream
+        water/api/MetadataHandler]: schema listing for API discovery —
+        here the params dataclasses ARE the schemas, so this walks the
+        builder registry (the same source the bindings codegen renders)."""
+        import dataclasses
+
+        schemas = []
+        for algo in _ALGOS:
+            cls = _builder_cls(algo)
+            fields = [
+                {"name": f.name,
+                 "type": getattr(f.type, "__name__", str(f.type))}
+                for f in dataclasses.fields(cls.PARAMS_CLS)
+            ]
+            schemas.append({"name": f"{cls.__name__}ParametersV3",
+                            "algo": algo, "fields": fields})
+        return {"__meta": {"schema_type": "Metadata"}, "schemas": schemas,
+                "routes": [
+                    {"http_method": m, "url_pattern": p}
+                    for m, p, _ in _ROUTES
+                ]}
+
     def about(self, params):
         from h2o3_tpu import __version__
 
@@ -680,7 +728,25 @@ class Endpoints:
         # single-column actuals; a multi-col predictions frame is multinomial
         act_vec = act.vec(0) if act.ncol == 1 else act.vec(
             params.get("actuals_column") or act.names[0])
-        pred_in = pred if pred.ncol > 1 else pred.vec(0)
+        if pred.ncol > 1:
+            # the standard /3/Predictions output carries a categorical
+            # "predict" column ahead of the per-class probabilities — using
+            # its CODES as a probability column would silently corrupt the
+            # metrics, so it is dropped; with a domain, the class-label
+            # columns are picked (binomial: P(positive) = last label)
+            use = [n for n in pred.names if n != "predict"]
+            if domain and all(str(d) in pred.names for d in domain):
+                use = [str(d) for d in domain]
+            if not use:
+                raise ApiError(400, "predictions frame has no probability columns")
+            if len(use) == 1:
+                pred_in = pred.vec(use[0])
+            elif domain and len(domain) == 2:
+                pred_in = pred.vec(str(domain[-1]))  # P(positive class)
+            else:
+                pred_in = Frame([pred.vec(n) for n in use], use, register=False)
+        else:
+            pred_in = pred.vec(0)
         try:
             mm = make_metrics(
                 pred_in, act_vec,
@@ -704,11 +770,16 @@ class Endpoints:
         m = _get_model(str(model_key))
         frame_key = self._resolve_frame_key(params, "frame_id", "source_frame")
         fr = DKV.get(frame_key)
-        cols = params.get("cols") or params.get("col_pairs_2dpdp")
-        if isinstance(cols, str):
-            cols = json.loads(cols) if cols.startswith("[") else [cols]
-        if not cols:
-            raise ApiError(400, "cols is required")
+        if params.get("col_pairs_2dpdp"):
+            raise ApiError(400, "2-D partial dependence is not supported; pass cols")
+        try:
+            cols = params.get("cols")
+            if isinstance(cols, str):
+                cols = json.loads(cols) if cols.startswith("[") else [cols]
+        except ValueError as e:
+            raise ApiError(400, f"bad cols: {e}")
+        if not cols or not all(isinstance(c, str) for c in cols):
+            raise ApiError(400, "cols must be a list of column names")
         try:
             nbins = int(params.get("nbins", 20))
             tables = [partial_dependence(m, fr, c, nbins=nbins) for c in cols]
@@ -1088,6 +1159,8 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/flow(?:/index\.html)?", _EP.flow_page),
     ("GET", r"/3/Cloud", _EP.cloud),
     ("GET", r"/3/Ping", _EP.ping),
+    ("GET", r"/3/Typeahead/files", _EP.typeahead_files),
+    ("GET", r"/3/Metadata/schemas", _EP.metadata_schemas),
     ("GET", r"/3/About", _EP.about),
     ("GET", r"/3/ImportFiles", _EP.import_files),
     ("POST", r"/3/ImportFiles", _EP.import_files),

@@ -702,11 +702,21 @@ def make_metrics(predicted, actuals, weights=None, domain=None,
     if weights is not None:
         w, _ = _vec_np(weights)
 
-    # multinomial: predicted is (n, K) probabilities
+    # multinomial: predicted is (n, K) probabilities — Frame or 2-D array
+    P = None
     if isinstance(predicted, Frame) and predicted.ncol > 1:
         P = np.stack([predicted.vec(i).to_numpy() for i in range(predicted.ncol)], axis=1)
+    elif not isinstance(predicted, (Frame, Vec)):
+        arr = np.asarray(predicted)
+        if arr.ndim == 2 and arr.shape[1] > 1:
+            P = arr
+    if P is not None:
         y, adom = _vec_np(actuals)
         dom = tuple(domain) if domain else (adom or tuple(map(str, range(P.shape[1]))))
+        if len(dom) != P.shape[1]:
+            raise ValueError(
+                f"predicted has {P.shape[1]} probability columns but the "
+                f"domain has {len(dom)} labels")
         return multinomial_metrics(_to_codes(y, dom), P, w, dom)
 
     p, _ = _vec_np(predicted)

@@ -485,3 +485,22 @@ def test_make_metrics_na_and_domain_order():
                              domain=("b", "a"))  # same labels, swapped codes
     mm_fl = h2o3_tpu.make_metrics(p, flipped, domain=("a", "b"))
     assert abs(mm_fl.auc - base.auc) < 1e-12
+
+
+def test_typeahead_and_metadata_routes(server, tmp_path):
+    (tmp_path / "data_a.csv").write_text("x\n1\n")
+    (tmp_path / "data_b.csv").write_text("x\n2\n")
+    (tmp_path / "datadir").mkdir()
+    j = _get(server, "/3/Typeahead/files?src="
+             + urllib.parse.quote(str(tmp_path / "data")))
+    assert j["matches"] == [str(tmp_path / "data_a.csv"),
+                            str(tmp_path / "data_b.csv"),
+                            str(tmp_path / "datadir") + "/"]
+
+    md = _get(server, "/3/Metadata/schemas")
+    names = {s["algo"] for s in md["schemas"]}
+    assert {"gbm", "glm", "deeplearning", "xgboost"} <= names
+    gbm = next(s for s in md["schemas"] if s["algo"] == "gbm")
+    assert any(f["name"] == "ntrees" for f in gbm["fields"])
+    assert any(r["url_pattern"].endswith("ModelBuilders/([^/]+)")
+               for r in md["routes"])
