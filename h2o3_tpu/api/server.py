@@ -678,9 +678,12 @@ class Endpoints:
             option = "contributions"
         elif _flag("leaf_node_assignment") or _flag("predict_leaf_node_assignment"):
             option = "leaf_assignment"
+        elif _flag("reconstruction_error"):
+            option = "reconstruction_error"
         if option and not hasattr(m, {
             "contributions": "predict_contributions",
             "leaf_assignment": "predict_leaf_node_assignment",
+            "reconstruction_error": "anomaly",
         }[option]):
             raise ApiError(400, f"{m.algo} does not support {option}")
         from h2o3_tpu.cluster import spmd
@@ -742,7 +745,10 @@ class Endpoints:
             if len(use) == 1:
                 pred_in = pred.vec(use[0])
             elif domain and len(domain) == 2:
-                pred_in = pred.vec(str(domain[-1]))  # P(positive class)
+                # P(positive class): the domain-named column when the frame
+                # has one, else the LAST probability column (p0/p1 layouts)
+                pos = str(domain[-1])
+                pred_in = pred.vec(pos if pos in pred.names else use[-1])
             else:
                 pred_in = Frame([pred.vec(n) for n in use], use, register=False)
         else:

@@ -153,6 +153,8 @@ def _exec_predict(model_key: str, frame_key: str, dest: str, option: str = "",
         out = model.predict_contributions(fr)
     elif option == "leaf_assignment":
         out = model.predict_leaf_node_assignment(fr, type=leaf_type)
+    elif option == "reconstruction_error":
+        out = model.anomaly(fr)
     else:
         out = model.predict(fr)
     DKV.put(dest, out)

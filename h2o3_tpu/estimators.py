@@ -175,4 +175,20 @@ __all__ = [
     _make("H2OInfogramEstimator", "Infogram"),
     _make("H2OSupportVectorMachineEstimator", "PSVM"),
     _make("H2OHGLMEstimator", "HGLM"),
+    "H2OAutoEncoderEstimator",
 ]
+
+
+class H2OAutoEncoderEstimator(_EstimatorBase):
+    """Upstream's autoencoder estimator: DeepLearning with autoencoder=True
+    forced; train() needs no y. ``anomaly(frame)`` gives per-row
+    reconstruction MSE."""
+
+    _BUILDER = "DeepLearning"
+
+    def __init__(self, model_id=None, **kwargs):
+        kwargs["autoencoder"] = True
+        super().__init__(model_id=model_id, **kwargs)
+
+    def anomaly(self, test_data):
+        return self._m().anomaly(test_data)

@@ -28,7 +28,7 @@ from flax import linen as nn
 
 from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
-from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.frame import Frame, Vec
 from h2o3_tpu.models.datainfo import DataInfo
 from h2o3_tpu.models.model_base import (
     CommonParams,
@@ -58,6 +58,7 @@ class DeepLearningParams(CommonParams):
     standardize: bool = True
     loss: str = "Automatic"
     reproducible: bool = True  # sync SGD is deterministic by construction
+    autoencoder: bool = False  # reconstruct inputs; y is ignored
 
 
 class _MLP(nn.Module):
@@ -86,6 +87,90 @@ class _MLP(nn.Module):
         return nn.Dense(self.n_out)(x)
 
 
+
+
+def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
+                  nrow: int, npad: int, key, start_epochs: int = 0):
+    """The shared sync-SGD epoch driver for both supervised and autoencoder
+    training: permutation shuffling, lax.scan over mini-batches, epoch-loss
+    early stopping, checkpoint RNG alignment. ``loss_fn(prm, xb, yb, wb,
+    kb)`` supplies the per-batch objective (yb is the permuted target slice
+    — unused by the autoencoder loss). Returns (params, opt_state, history,
+    epochs_done)."""
+    batch = min(int(p.mini_batch_size), npad)
+    nbatch = max(1, nrow // batch)
+    # padded permutation slots alias row 0 — a SLOT mask zeroes their weight
+    # so a final partial batch cannot over-count real rows (nrow < batch)
+    slot_mask = jnp.asarray((np.arange(npad) < nrow).astype(np.float32))
+
+    @jax.jit
+    def epoch(params, opt_state, Xp, yp, wp, dkey):
+        def step(carry, i):
+            prm, ost, k = carry
+            k, bk = jax.random.split(k)
+            start = i * batch
+            xb = jax.lax.dynamic_slice(Xp, (start, 0), (batch, Xp.shape[1]))
+            yb = jax.lax.dynamic_slice(yp, (start,), (batch,))
+            wb = jax.lax.dynamic_slice(wp, (start,), (batch,))
+            loss, g = jax.value_and_grad(loss_fn)(prm, xb, yb, wb, bk)
+            upd, ost = tx.update(g, ost, prm)
+            prm = optax.apply_updates(prm, upd)
+            return (prm, ost, k), loss
+
+        (params, opt_state, _), losses = jax.lax.scan(
+            step, (params, opt_state, dkey), jnp.arange(nbatch)
+        )
+        return params, opt_state, losses.mean()
+
+    # epoch-level stopping tracks the (always smaller-is-better) training
+    # loss; the resolved stopping_metric drives final scoring only
+    keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, False)
+    seed = abs(p.seed) if p.seed and p.seed > 0 else 99
+    rng = np.random.default_rng(seed)
+    history = []
+    n_epochs = max(1, int(np.ceil(p.epochs)))
+    for _ in range(start_epochs):  # continuation: keep the epoch RNG
+        rng.permutation(nrow)  # stream aligned with an
+        key, _ = jax.random.split(key)  # uninterrupted run
+    epochs_done = start_epochs
+    for e in range(start_epochs, n_epochs):
+        perm = np.zeros(npad, np.int64)
+        perm[:nrow] = rng.permutation(nrow)
+        perm_j = jnp.asarray(perm)
+        key, dkey = jax.random.split(key)
+        params, opt_state, mean_loss = epoch(
+            params, opt_state, X[perm_j], y[perm_j], w[perm_j] * slot_mask, dkey
+        )
+        epochs_done = e + 1
+        history.append({"epoch": e + 1, "loss": float(mean_loss)})
+        keeper.record(float(mean_loss))
+        job.update(0.05 + 0.9 * (e + 1) / n_epochs)
+        if keeper.should_stop() or job.stop_requested:
+            Log.info(f"DeepLearning early stop at epoch {e + 1}")
+            break
+    return params, opt_state, history, epochs_done
+
+
+def _make_optimizer(p):
+    if p.adaptive_rate:
+        return optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
+    return optax.sgd(
+        optax.exponential_decay(p.rate, 1000, p.rate_decay),
+        momentum=p.momentum_start or None,
+    )
+
+
+def _make_mlp(p, n_out: int) -> _MLP:
+    dropout = tuple(
+        p.hidden_dropout_ratios
+        or ((0.5,) * len(p.hidden) if "dropout" in p.activation.lower()
+            else (0.0,) * len(p.hidden))
+    )
+    return _MLP(hidden=tuple(int(h) for h in p.hidden), n_out=n_out,
+                activation=p.activation, dropout=dropout,
+                input_dropout=p.input_dropout_ratio)
+
+
 class DeepLearningModel(Model):
     algo = "deeplearning"
 
@@ -93,17 +178,147 @@ class DeepLearningModel(Model):
         di: DataInfo = self.output["datainfo"]
         X, _ = di.transform(frame)
         logits = self.output["apply_fn"](self.output["params"], X)
+        if self.output.get("autoencoder"):
+            return np.asarray(logits)[: frame.nrow]  # (n, expanded) recon
         if self.is_classifier:
             return np.asarray(jax.nn.softmax(logits, axis=1))[: frame.nrow]
         return np.asarray(logits[:, 0])[: frame.nrow]
+
+    def predict(self, frame: Frame) -> Frame:
+        if not self.output.get("autoencoder"):
+            return super().predict(frame)
+        # upstream autoencoder predict: one reconstr_* column per expanded
+        # input feature (the standardized design-matrix space)
+        recon = self._predict_raw(frame)
+        names = [f"reconstr_{n}" for n in self.output["expanded_names"]]
+        return Frame(
+            [Vec.from_numpy(recon[:, j], "real") for j in range(recon.shape[1])],
+            names,
+        )
+
+    def _autoencoder_metrics(self, frame: Frame):
+        """ModelMetricsAutoEncoder analog: reconstruction MSE on the
+        standardized design matrix."""
+        from h2o3_tpu.models.metrics import ModelMetrics
+
+        di: DataInfo = self.output["datainfo"]
+        X, wmask = di.transform(frame)
+        recon = self.output["apply_fn"](self.output["params"], X)
+        row_mse = np.asarray(jnp.mean((recon - X) ** 2, axis=1))[: frame.nrow]
+        mask = np.asarray(wmask)[: frame.nrow] > 0
+        mse = float(row_mse[mask].mean()) if mask.any() else float("nan")
+        return ModelMetrics("AutoEncoder", {"mse": mse, "rmse": float(np.sqrt(mse))})
+
+    def model_performance(self, frame: Frame | None = None):
+        if self.output.get("autoencoder"):
+            return (self._autoencoder_metrics(frame) if frame is not None
+                    else self.training_metrics)
+        return super().model_performance(frame)
+
+    def anomaly(self, frame: Frame) -> Frame:
+        """Per-row reconstruction MSE (``h2o.anomaly`` successor): the
+        anomaly score in the standardized feature space."""
+        if not self.output.get("autoencoder"):
+            raise ValueError("anomaly() requires an autoencoder model")
+        di: DataInfo = self.output["datainfo"]
+        X, _ = di.transform(frame)
+        recon = self.output["apply_fn"](self.output["params"], X)
+        mse = np.asarray(jnp.mean((recon - X) ** 2, axis=1))[: frame.nrow]
+        return Frame([Vec.from_numpy(mse, "real")], ["Reconstruction.MSE"])
 
 
 class DeepLearning(ModelBuilder):
     algo = "deeplearning"
     PARAMS_CLS = DeepLearningParams
 
+    def _build_autoencoder(self, job: Job, train: Frame, valid: Frame | None) -> Model:
+        """Autoencoder mode (upstream ``autoencoder=true`` /
+        H2OAutoEncoderEstimator): reconstruct the standardized design
+        matrix; no response. Same sync-SGD driver as the supervised path."""
+        p: DeepLearningParams = self.params
+        di = DataInfo.fit(train, self._x, standardize=p.standardize)
+        X, wmask = di.transform(train)
+        w = wmask
+        if p.weights_column:
+            w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
+        w = jnp.asarray(np.asarray(w))
+
+        D = di.ncols_expanded
+        mlp = _make_mlp(p, n_out=D)
+        seed = abs(p.seed) if p.seed and p.seed > 0 else 99
+        key = jax.random.PRNGKey(seed)
+        key, init_key = jax.random.split(key)
+        params = mlp.init(init_key, jnp.zeros((1, D)), train=False)
+
+        from h2o3_tpu.models.model_base import check_checkpoint_compat, resolve_checkpoint
+
+        prior = resolve_checkpoint(p.checkpoint)
+        start_epochs = 0
+        if prior is not None:
+            check_checkpoint_compat(
+                prior, self,
+                ("hidden", "activation", "standardize", "adaptive_rate",
+                 "autoencoder"),
+            )
+            if prior.output["datainfo"].ncols_expanded != D:
+                raise ValueError("checkpoint design-matrix width differs")
+            start_epochs = int(prior.output.get("epochs_trained", 0))
+            if p.epochs <= start_epochs:
+                raise ValueError(
+                    f"checkpoint continuation needs epochs > {start_epochs}"
+                )
+            params = prior.output["params"]
+
+        tx = _make_optimizer(p)
+        opt_state = tx.init(params)
+        if prior is not None and prior.output.get("opt_state") is not None:
+            opt_state = prior.output["opt_state"]
+
+        l1, l2 = float(p.l1), float(p.l2)
+
+        def loss_fn(prm, xb, yb, wb, kb):  # yb unused: the input IS the target
+            recon = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
+            ll = jnp.mean((recon - xb) ** 2, axis=1)
+            loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
+            if l2:
+                loss += l2 * 0.5 * sum(jnp.sum(q**2) for q in jax.tree.leaves(prm))
+            if l1:
+                loss += l1 * sum(jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm))
+            return loss
+
+        params, opt_state, history, epochs_done = _run_sync_sgd(
+            job, p, loss_fn, tx, params, opt_state,
+            X, jnp.zeros(train.npad, jnp.float32), w,
+            train.nrow, train.npad, key, start_epochs,
+        )
+
+        apply_fn = jax.jit(lambda prm, xx: mlp.apply(prm, xx, train=False))
+        out = {
+            "datainfo": di, "params": params, "apply_fn": apply_fn,
+            "names": list(self._x), "hidden": list(p.hidden),
+            "epochs_trained": epochs_done, "opt_state": opt_state,
+            "response_domain": None, "autoencoder": True,
+            "expanded_names": di.coef_names(),
+        }
+        model = DeepLearningModel(DKV.make_key("dl"), p, out)
+        model.scoring_history = history
+        model.training_metrics = model._autoencoder_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._autoencoder_metrics(valid)
+        return model
+
+    def _validate(self, train: Frame, valid: Frame | None) -> None:
+        p: DeepLearningParams = self.params
+        if p.autoencoder:
+            if p.nfolds and p.nfolds > 1:
+                raise ValueError("autoencoder does not support cross-validation")
+            return  # unsupervised: no response checks
+        super()._validate(train, valid)
+
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: DeepLearningParams = self.params
+        if p.autoencoder:
+            return self._build_autoencoder(job, train, valid)
         yv = train.vec(p.response_column)
         classification = yv.is_categorical()
         K = yv.cardinality if classification else 1
@@ -124,17 +339,7 @@ class DeepLearning(ModelBuilder):
         w = jnp.asarray(np.asarray(w) * okresp)
         y = jnp.asarray(ybuf)
 
-        dropout = tuple(
-            p.hidden_dropout_ratios
-            or ((0.5,) * len(p.hidden) if "dropout" in p.activation.lower() else (0.0,) * len(p.hidden))
-        )
-        mlp = _MLP(
-            hidden=tuple(int(h) for h in p.hidden),
-            n_out=n_out,
-            activation=p.activation,
-            dropout=dropout,
-            input_dropout=p.input_dropout_ratio,
-        )
+        mlp = _make_mlp(p, n_out=n_out)
         seed = abs(p.seed) if p.seed and p.seed > 0 else 99
         key = jax.random.PRNGKey(seed)
         key, init_key = jax.random.split(key)
@@ -157,13 +362,7 @@ class DeepLearning(ModelBuilder):
                 )
             params = prior.output["params"]
 
-        if p.adaptive_rate:
-            tx = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
-        else:
-            tx = optax.sgd(
-                optax.exponential_decay(p.rate, 1000, p.rate_decay),
-                momentum=p.momentum_start or None,
-            )
+        tx = _make_optimizer(p)
         opt_state = tx.init(params)
         if prior is not None and prior.output.get("opt_state") is not None:
             # carry the optimizer accumulators (adadelta rho-averages /
@@ -171,79 +370,33 @@ class DeepLearning(ModelBuilder):
             # uninterrupted run, like GBM carries F and the split chain
             opt_state = prior.output["opt_state"]
 
-        batch = int(p.mini_batch_size)
-        npad = train.npad
-        nbatch = max(1, train.nrow // batch)
-
         l1, l2 = float(p.l1), float(p.l2)
         use_ce = classification
 
-        @jax.jit
-        def epoch(params, opt_state, Xp, yp, wp, dkey):
-            def loss_fn(prm, xb, yb, wb, kb):
-                logits = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
-                if use_ce:
-                    ll = optax.softmax_cross_entropy_with_integer_labels(
-                        logits, yb.astype(jnp.int32)
-                    )
-                else:
-                    ll = (logits[:, 0] - yb) ** 2
-                loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
-                if l2:
-                    loss += l2 * 0.5 * sum(
-                        jnp.sum(q**2) for q in jax.tree.leaves(prm)
-                    )
-                if l1:
-                    loss += l1 * sum(
-                        jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm)
-                    )
-                return loss
+        def loss_fn(prm, xb, yb, wb, kb):
+            logits = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
+            if use_ce:
+                ll = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb.astype(jnp.int32)
+                )
+            else:
+                ll = (logits[:, 0] - yb) ** 2
+            loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
+            if l2:
+                loss += l2 * 0.5 * sum(
+                    jnp.sum(q**2) for q in jax.tree.leaves(prm)
+                )
+            if l1:
+                loss += l1 * sum(
+                    jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm)
+                )
+            return loss
 
-            def step(carry, i):
-                prm, ost, k = carry
-                k, bk = jax.random.split(k)
-                start = i * batch
-                xb = jax.lax.dynamic_slice(Xp, (start, 0), (batch, Xp.shape[1]))
-                yb = jax.lax.dynamic_slice(yp, (start,), (batch,))
-                wb = jax.lax.dynamic_slice(wp, (start,), (batch,))
-                loss, g = jax.value_and_grad(loss_fn)(prm, xb, yb, wb, bk)
-                upd, ost = tx.update(g, ost, prm)
-                prm = optax.apply_updates(prm, upd)
-                return (prm, ost, k), loss
-
-            (params, opt_state, _), losses = jax.lax.scan(
-                step, (params, opt_state, dkey), jnp.arange(nbatch)
-            )
-            return params, opt_state, losses.mean()
-
+        params, opt_state, history, epochs_done = _run_sync_sgd(
+            job, p, loss_fn, tx, params, opt_state, X, y, w,
+            train.nrow, train.npad, key, start_epochs,
+        )
         apply_fn = jax.jit(lambda prm, xx: mlp.apply(prm, xx, train=False))
-
-        # epoch-level stopping tracks the (always smaller-is-better) training
-        # loss; the resolved stopping_metric drives final scoring only
-        keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, False)
-        rng = np.random.default_rng(seed)
-        history = []
-        n_epochs = max(1, int(np.ceil(p.epochs)))
-        for _ in range(start_epochs):  # continuation: keep the epoch RNG
-            rng.permutation(train.nrow)  # stream aligned with an
-            key, _ = jax.random.split(key)  # uninterrupted run
-        epochs_done = start_epochs
-        for e in range(start_epochs, n_epochs):
-            perm = np.zeros(npad, np.int64)
-            perm[: train.nrow] = rng.permutation(train.nrow)
-            perm_j = jnp.asarray(perm)
-            Xp = X[perm_j]
-            yp = y[perm_j]
-            wp = w[perm_j]
-            key, dkey = jax.random.split(key)
-            params, opt_state, mean_loss = epoch(params, opt_state, Xp, yp, wp, dkey)
-            epochs_done = e + 1
-            history.append({"epoch": e + 1, "loss": float(mean_loss)})
-            keeper.record(float(mean_loss))
-            job.update(0.05 + 0.9 * (e + 1) / n_epochs)
-            if keeper.should_stop() or job.stop_requested:
-                Log.info(f"DeepLearning early stop at epoch {e + 1}")
-                break
 
         out = {
             "datainfo": di,

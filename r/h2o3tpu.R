@@ -432,3 +432,7 @@ h2o.predict_leaf_node_assignment <- function(model, frame, type = "Path") {
   .h2o.predictions(model, frame, list(leaf_node_assignment = TRUE,
                                       leaf_node_assignment_type = type))
 }
+
+h2o.anomaly <- function(model, frame) {
+  .h2o.predictions(model, frame, list(reconstruction_error = TRUE))
+}

@@ -65,3 +65,91 @@ def test_dl_multiclass_and_l2():
         y="y", training_frame=fr
     )
     assert m.training_metrics.classification_error < 0.2
+
+
+def test_autoencoder_learns_structure_and_scores_anomalies():
+    """Autoencoder (upstream autoencoder=true / H2OAutoEncoderEstimator):
+    reconstruction improves with training, and rows OFF the training
+    manifold score higher Reconstruction.MSE than rows on it."""
+    from h2o3_tpu.estimators import H2OAutoEncoderEstimator
+
+    rng = np.random.default_rng(8)
+    n = 2000
+    # 2-D latent structure embedded in 6 dims
+    z = rng.normal(size=(n, 2))
+    W = rng.normal(size=(2, 6))
+    X = z @ W + rng.normal(size=(n, 6)) * 0.05
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(6)])
+    fr = Frame.from_pandas(df)
+
+    ae = H2OAutoEncoderEstimator(hidden=(8, 2, 8), epochs=30,
+                                 mini_batch_size=64, seed=4)
+    ae.train(training_frame=fr)
+    mse_trained = ae.mse()
+    assert np.isfinite(mse_trained) and mse_trained < 0.5  # standardized scale
+
+    # anomalies: rows far off the latent plane reconstruct worse
+    X_out = rng.normal(size=(200, 6)) * 3.0
+    df_out = pd.DataFrame(X_out, columns=df.columns)
+    a_in = ae.anomaly(fr).vec("Reconstruction.MSE").to_numpy()
+    a_out = ae.anomaly(Frame.from_pandas(df_out)).vec("Reconstruction.MSE").to_numpy()
+    assert np.median(a_out) > 4 * np.median(a_in)
+
+    # predict() returns the reconstruction columns, upstream layout
+    rec = ae.predict(fr)
+    assert rec.names == [f"reconstr_{c}" for c in ae.model.output["expanded_names"]]
+    assert rec.nrow == n
+
+
+def test_autoencoder_anomaly_over_rest():
+    import json as _json
+    import urllib.request as _rq
+
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.estimators import H2OAutoEncoderEstimator
+
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame(rng.normal(size=(300, 4)), columns=list("abcd"))
+    fr = Frame.from_pandas(df)
+    DKV.put("ae_fr", fr)
+    ae = H2OAutoEncoderEstimator(hidden=(4,), epochs=2, seed=1)
+    ae.train(training_frame=fr)
+    s = start_server(port=0)
+    body = _json.dumps({"reconstruction_error": True}).encode()
+    r = _rq.Request(
+        f"{s.url}/3/Predictions/models/{ae.model_id}/frames/ae_fr",
+        data=body, headers={"Content-Type": "application/json"}, method="POST")
+    out = _json.loads(_rq.urlopen(r).read())
+    key = out["predictions_frame"]["name"]
+    got = _json.loads(_rq.urlopen(f"{s.url}/3/Frames/{key}").read())
+    assert [c["label"] for c in got["frames"][0]["columns"]] == ["Reconstruction.MSE"]
+
+
+def test_autoencoder_checkpoint_and_tiny_frame():
+    """AE checkpoint continuation works like supervised DL, tiny frames
+    (nrow < mini_batch_size) train without over-counting row 0, and
+    model_performance on an AE returns reconstruction metrics instead of
+    crashing on the missing response."""
+    from h2o3_tpu.cluster.registry import DKV
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame(rng.normal(size=(20, 3)), columns=list("abc"))
+    fr = Frame.from_pandas(df)
+    m1 = DeepLearning(autoencoder=True, hidden=(4,), epochs=3, seed=6,
+                      mini_batch_size=32).train(training_frame=fr)
+    assert np.isfinite(m1.training_metrics.mse)
+    perf = m1.model_performance(fr)
+    assert abs(perf.mse - m1.training_metrics.mse) < 1e-9
+
+    m2 = DeepLearning(autoencoder=True, hidden=(4,), epochs=6, seed=6,
+                      mini_batch_size=32, checkpoint=m1.key,
+                      ).train(training_frame=fr)
+    uninterrupted = DeepLearning(autoencoder=True, hidden=(4,), epochs=6,
+                                 seed=6, mini_batch_size=32,
+                                 ).train(training_frame=fr)
+    assert abs(m2.training_metrics.mse - uninterrupted.training_metrics.mse) < 1e-6
+    with pytest.raises(RuntimeError, match="cross-validation"):
+        DeepLearning(autoencoder=True, nfolds=3).train(training_frame=fr)
+    DKV.remove(m1.key); DKV.remove(m2.key)
