@@ -111,6 +111,18 @@ class _EstimatorBase:
     def r2(self, valid=False, xval=False):
         return self._metric("r2", valid, xval)
 
+    def _mm(self, valid=False, xval=False):
+        m = self._m()
+        return (m.cross_validation_metrics if xval
+                else m.validation_metrics if valid else m.training_metrics)
+
+    def gains_lift(self, valid=False, xval=False):
+        mm = self._mm(valid, xval)
+        return mm.gains_lift() if mm is not None else None
+
+    def kolmogorov_smirnov(self, valid=False, xval=False):
+        return self._metric("ks", valid, xval)
+
     def varimp(self, use_pandas: bool = False):
         vi = self._m().varimp() if hasattr(self._m(), "varimp") else None
         if use_pandas and vi is not None:
@@ -192,3 +204,4 @@ class H2OAutoEncoderEstimator(_EstimatorBase):
 
     def anomaly(self, test_data):
         return self._m().anomaly(test_data)
+

@@ -196,16 +196,23 @@ class DeepLearningModel(Model):
             names,
         )
 
-    def _autoencoder_metrics(self, frame: Frame):
+    def _recon_row_mse(self, frame: Frame, X=None, wmask=None):
+        """Per-row reconstruction MSE in the standardized feature space —
+        the ONE formula behind anomaly() and the AutoEncoder metrics.
+        Pass (X, wmask) to reuse an existing design-matrix transform."""
+        di: DataInfo = self.output["datainfo"]
+        if X is None:
+            X, wmask = di.transform(frame)
+        recon = self.output["apply_fn"](self.output["params"], X)
+        row_mse = np.asarray(jnp.mean((recon - X) ** 2, axis=1))[: frame.nrow]
+        return row_mse, np.asarray(wmask)[: frame.nrow] > 0
+
+    def _autoencoder_metrics(self, frame: Frame, X=None, wmask=None):
         """ModelMetricsAutoEncoder analog: reconstruction MSE on the
         standardized design matrix."""
         from h2o3_tpu.models.metrics import ModelMetrics
 
-        di: DataInfo = self.output["datainfo"]
-        X, wmask = di.transform(frame)
-        recon = self.output["apply_fn"](self.output["params"], X)
-        row_mse = np.asarray(jnp.mean((recon - X) ** 2, axis=1))[: frame.nrow]
-        mask = np.asarray(wmask)[: frame.nrow] > 0
+        row_mse, mask = self._recon_row_mse(frame, X, wmask)
         mse = float(row_mse[mask].mean()) if mask.any() else float("nan")
         return ModelMetrics("AutoEncoder", {"mse": mse, "rmse": float(np.sqrt(mse))})
 
@@ -220,10 +227,7 @@ class DeepLearningModel(Model):
         anomaly score in the standardized feature space."""
         if not self.output.get("autoencoder"):
             raise ValueError("anomaly() requires an autoencoder model")
-        di: DataInfo = self.output["datainfo"]
-        X, _ = di.transform(frame)
-        recon = self.output["apply_fn"](self.output["params"], X)
-        mse = np.asarray(jnp.mean((recon - X) ** 2, axis=1))[: frame.nrow]
+        mse, _ = self._recon_row_mse(frame)
         return Frame([Vec.from_numpy(mse, "real")], ["Reconstruction.MSE"])
 
 
@@ -302,7 +306,7 @@ class DeepLearning(ModelBuilder):
         }
         model = DeepLearningModel(DKV.make_key("dl"), p, out)
         model.scoring_history = history
-        model.training_metrics = model._autoencoder_metrics(train)
+        model.training_metrics = model._autoencoder_metrics(train, X, wmask)
         if valid is not None:
             model.validation_metrics = model._autoencoder_metrics(valid)
         return model

@@ -47,6 +47,13 @@ class ModelMetrics:
             return v[item]
         raise AttributeError(item)
 
+    def gains_lift(self):
+        """Gains/lift table rows (binomial metrics only; else None)."""
+        return self._v.get("gains_lift_table")
+
+    def kolmogorov_smirnov(self) -> float:
+        return self.value("ks")
+
     def value(self, name: str) -> float:
         """Look up a scalar criterion by name (nan if absent) — the lookup
         used by grid ranking / early stopping / leaderboards."""
@@ -189,6 +196,18 @@ def binomial_metrics(
                 "value": float(np.nanmax(vals)),
             }
 
+    order = np.argsort(-p, kind="mergesort")
+    ps = p[order]
+    # collapse tied scores to one mass each: KS/gains are defined over
+    # realizable thresholds — per-row cumulatives through a tie group would
+    # make both depend on arbitrary input row order (a constant predictor
+    # must give KS 0, not 1)
+    first = np.concatenate([[0], np.nonzero(np.diff(ps))[0] + 1])
+    gl_rows, ks = _gains_lift(
+        np.add.reduceat((w * y)[order], first),
+        np.add.reduceat((w * (1 - y))[order], first),
+    )
+
     return ModelMetrics(
         "binomial",
         {
@@ -205,9 +224,54 @@ def binomial_metrics(
             "confusion_matrix": cm,
             "max_criteria": mx,
             "nobs": int(ok.sum()),
+            "gains_lift_table": gl_rows,
+            "ks": ks,
         },
         domain=domain,
     )
+
+
+def _gains_lift(wpos_desc, wneg_desc, groups: int = 16):
+    """Gains/lift table + Kolmogorov-Smirnov from positive/negative weight
+    mass ordered by DESCENDING score (per row on host, per score bucket on
+    device) — the ModelMetricsBinomial GainsLift analog [UNVERIFIED
+    upstream hex/GainsLift.java]. Returns (rows, ks)."""
+    wpos = np.asarray(wpos_desc, np.float64)
+    wneg = np.asarray(wneg_desc, np.float64)
+    w = wpos + wneg
+    cum_w = np.cumsum(w)
+    cum_pos = np.cumsum(wpos)
+    cum_neg = np.cumsum(wneg)
+    tot, tot_pos, tot_neg = cum_w[-1], cum_pos[-1], cum_neg[-1]
+    if tot <= 0 or tot_pos <= 0 or tot_neg <= 0:
+        return [], float("nan")
+    ks = float(np.max(np.abs(cum_pos / tot_pos - cum_neg / tot_neg)))
+    overall = tot_pos / tot
+    rows = []
+    prev_i = -1
+    prev_pos = prev_w = 0.0
+    for g in range(1, groups + 1):
+        i = int(np.searchsorted(cum_w, tot * g / groups - 1e-12))
+        i = min(i, len(w) - 1)
+        if i <= prev_i:
+            continue  # degenerate tiny group (ties/few rows): merge forward
+        grp_w = cum_w[i] - prev_w
+        grp_pos = cum_pos[i] - prev_pos
+        rows.append({
+            "group": len(rows) + 1,
+            "cumulative_data_fraction": float(cum_w[i] / tot),
+            "lower_threshold_index": int(i),
+            "response_rate": float(grp_pos / grp_w) if grp_w > 0 else float("nan"),
+            "lift": float((grp_pos / grp_w) / overall) if grp_w > 0 else float("nan"),
+            "cumulative_response_rate": float(cum_pos[i] / cum_w[i]),
+            "cumulative_lift": float((cum_pos[i] / cum_w[i]) / overall),
+            "capture_rate": float(grp_pos / tot_pos),
+            "cumulative_capture_rate": float(cum_pos[i] / tot_pos),
+            "gain": float(100.0 * ((grp_pos / grp_w) / overall - 1.0)) if grp_w > 0 else float("nan"),
+            "cumulative_gain": float(100.0 * ((cum_pos[i] / cum_w[i]) / overall - 1.0)),
+        })
+        prev_i, prev_pos, prev_w = i, cum_pos[i], cum_w[i]
+    return rows, ks
 
 
 def _weighted_auc(y, p, w) -> float:
@@ -489,6 +553,7 @@ def _binomial_metrics_device(actual, prob, weights, domain) -> ModelMetrics:
     )
     best_thr = float(thresholds[bi])
     cm = [[float(tn[bi]), float(fp[bi])], [float(fn[bi]), float(tp[bi])]]
+    gl_rows, ks = _gains_lift(wpos_b[::-1], wneg_b[::-1])
 
     return ModelMetrics(
         "binomial",
@@ -506,6 +571,8 @@ def _binomial_metrics_device(actual, prob, weights, domain) -> ModelMetrics:
             "confusion_matrix": cm,
             "max_criteria": mx,
             "nobs": int(nobs_),
+            "gains_lift_table": gl_rows,
+            "ks": ks,
         },
         domain=domain,
     )

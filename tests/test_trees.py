@@ -588,3 +588,65 @@ def test_fused_whole_tree_deep_matches_per_level(monkeypatch):
         np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
     finally:
         st._STEP_CACHE.clear()  # drop subtract=False programs for later tests
+
+
+def test_gains_lift_and_ks_match_reference():
+    """Gains/lift + KS on both metric paths, pinned against a direct
+    numpy computation and basic invariants."""
+    import numpy as np
+
+    from h2o3_tpu.models.metrics import binomial_metrics
+
+    rng = np.random.default_rng(17)
+    n = 4000
+    y = rng.integers(0, 2, n).astype(np.float64)
+    p = np.clip(rng.normal(0.35 + 0.3 * y, 0.2, n), 0.001, 0.999)
+    mm = binomial_metrics(y, p, domain=("n", "p"))
+    rows = mm.gains_lift()
+    assert rows and len(rows) == 16
+    # cumulative columns are monotone; the final row covers everything
+    ccr = [r["cumulative_capture_rate"] for r in rows]
+    assert all(b >= a - 1e-12 for a, b in zip(ccr, ccr[1:]))
+    assert abs(ccr[-1] - 1.0) < 1e-9
+    assert abs(rows[-1]["cumulative_data_fraction"] - 1.0) < 1e-9
+    assert abs(rows[-1]["cumulative_lift"] - 1.0) < 1e-9
+    # top group must beat baseline on this signal
+    assert rows[0]["lift"] > 1.2
+    # KS == max |TPR - FPR| computed directly
+    order = np.argsort(-p, kind="mergesort")
+    ys = y[order]
+    tpr = np.cumsum(ys) / ys.sum()
+    fpr = np.cumsum(1 - ys) / (1 - ys).sum()
+    assert abs(mm.kolmogorov_smirnov() - np.max(np.abs(tpr - fpr))) < 1e-9
+
+
+def test_gains_lift_device_path_close_to_host():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from h2o3_tpu.models.metrics import binomial_metrics
+
+    rng = np.random.default_rng(3)
+    n = 20000
+    y = rng.integers(0, 2, n).astype(np.float64)
+    p = np.clip(rng.normal(0.35 + 0.3 * y, 0.2, n), 0.001, 0.999)
+    host = binomial_metrics(y, p, domain=("n", "p"))
+    dev = binomial_metrics(jnp.asarray(y, jnp.float32), jnp.asarray(p, jnp.float32),
+                           domain=("n", "p"))
+    assert abs(host.kolmogorov_smirnov() - dev.kolmogorov_smirnov()) < 0.02
+    hr, dr = host.gains_lift(), dev.gains_lift()
+    assert dr and abs(hr[0]["cumulative_lift"] - dr[0]["cumulative_lift"]) < 0.1
+
+
+def test_ks_zero_for_constant_predictor_any_row_order():
+    """Tied scores collapse to one threshold: a constant predictor has
+    KS 0 regardless of input row order (was order-dependent up to 1.0)."""
+    from h2o3_tpu.models.metrics import binomial_metrics
+
+    y_sorted = np.array([1.0] * 50 + [0.0] * 50)
+    p = np.full(100, 0.5)
+    mm1 = binomial_metrics(y_sorted, p, domain=("n", "p"))
+    rng = np.random.default_rng(0)
+    mm2 = binomial_metrics(rng.permutation(y_sorted), p, domain=("n", "p"))
+    assert abs(mm1.kolmogorov_smirnov()) < 1e-12
+    assert abs(mm2.kolmogorov_smirnov()) < 1e-12
