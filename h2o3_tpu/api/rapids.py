@@ -426,7 +426,18 @@ def _apply(op: str, raw_args: list, sess: Session):
     if op == "quantile":
         fr = _as_frame(args[0])
         probs = _num_list(args[1]) if len(args) > 1 else None
-        return OPS.quantile(fr, probs) if probs else OPS.quantile(fr)
+        # upstream grammar: (quantile fr probs interp weights_col?) — the
+        # interpolation arg is accepted and ignored (type 7 only)
+        wv = None
+        if len(args) > 3 and args[3] not in (None, "", "_"):
+            if not isinstance(args[3], str) or args[3] not in fr.names:
+                raise RapidsError(
+                    f"quantile: weights column {args[3]!r} not in frame")
+            wv = fr.vec(args[3])
+            keep = [n for n in fr.names if n != args[3]]
+            fr = Frame([fr.vec(n) for n in keep], keep)  # weights col excluded
+        kw = {"weights": wv} if wv is not None else {}
+        return OPS.quantile(fr, probs, **kw) if probs else OPS.quantile(fr, **kw)
     if op == "ifelse":
         return OPS.ifelse(_as_vec(args[0]), _maybe_vec(args[1]), _maybe_vec(args[2]))
     if op == "is.na":

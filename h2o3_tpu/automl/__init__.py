@@ -3,4 +3,12 @@
 
 from h2o3_tpu.automl.automl import AutoML, Leaderboard
 
-__all__ = ["AutoML", "Leaderboard"]
+
+def get_leaderboard(aml: AutoML, extra_columns=()):
+    """Upstream ``h2o.automl.get_leaderboard`` parity: leaderboard rows with
+    optional extra columns ("training_time_ms" or "ALL")."""
+    lb = aml.leaderboard
+    return lb.as_table(extra_columns=extra_columns) if lb else []
+
+
+__all__ = ["AutoML", "Leaderboard", "get_leaderboard"]

@@ -94,7 +94,12 @@ class Leaderboard:
     def leader(self) -> Model | None:
         return self.models[0] if self.models else None
 
-    def as_table(self) -> list[dict]:
+    def as_table(self, extra_columns=()) -> list[dict]:
+        """Leaderboard rows; ``extra_columns`` accepts upstream's
+        ``get_leaderboard(aml, extra_columns=...)`` names
+        ("training_time_ms", "ALL")."""
+        if extra_columns == "ALL" or "ALL" in tuple(extra_columns or ()):
+            extra_columns = ("training_time_ms",)
         rows = []
         for m in self.models:
             mm = self._metrics_for(m)
@@ -103,6 +108,8 @@ class Leaderboard:
                 for extra in ("auc", "logloss", "rmse", "mse", "mean_per_class_error", "mean_residual_deviance"):
                     if extra != self.sort_metric and not np.isnan(mm.value(extra)):
                         row[extra] = mm.value(extra)
+            if "training_time_ms" in (extra_columns or ()):
+                row["training_time_ms"] = int(getattr(m, "run_time_ms", 0) or 0)
             rows.append(row)
         return rows
 

@@ -805,6 +805,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
     standardize: bool (default True)
     loss: str (default 'Automatic')
     reproducible: bool (default True)
+    autoencoder: bool (default False)
     """
 
     _BUILDER = "DeepLearning"
@@ -843,6 +844,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
         standardize=True,
         loss='Automatic',
         reproducible=True,
+        autoencoder=False,
     ):
         kw = dict(
             response_column=response_column,
@@ -876,6 +878,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
             standardize=standardize,
             loss=loss,
             reproducible=reproducible,
+            autoencoder=autoencoder,
         )
         defaults = {
             'response_column': None,
@@ -909,6 +912,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
             'standardize': True,
             'loss': 'Automatic',
             'reproducible': True,
+            'autoencoder': False,
         }
         kw = {k: v for k, v in kw.items() if v != defaults[k]}
         super().__init__(model_id=model_id, **kw)

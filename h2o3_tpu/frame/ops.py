@@ -635,23 +635,62 @@ def _sorted_valid(x):
     return jnp.sort(x), (~jnp.isnan(x)).sum(dtype=jnp.int32)
 
 
-def quantile(frame_or_vec, prob: Sequence[float] = (0.001, 0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99, 0.999)) -> Frame:
-    """``h2o.quantile`` successor (interpolation type 7, H2O's default)."""
+def quantile(frame_or_vec, prob: Sequence[float] = (0.001, 0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99, 0.999), weights: Vec | None = None) -> Frame:
+    """``h2o.quantile`` successor (interpolation type 7, H2O's default).
+
+    ``weights`` (a numeric Vec aligned with the input) switches to the
+    weighted quantile with OBSERVATION-COUNT semantics, like the
+    weights_column contract everywhere else in the framework: integer
+    weights give exactly the quantiles of the row-replicated sample, and
+    fractional weights interpolate that continuously. Consequently results
+    are intentionally NOT invariant under uniform weight rescaling —
+    halving all weights halves the implied sample size, exactly as
+    de-duplicating rows would. Normalized weights (sum ~1) are degenerate
+    under this reading and trigger a warning."""
     if isinstance(frame_or_vec, Vec):
         vecs = [frame_or_vec]
     else:
         vecs = [frame_or_vec.vec(n) for n in frame_or_vec.names if frame_or_vec.vec(n).is_numeric()]
-    out = {"Probs": np.asarray(prob, dtype=np.float64)}
+    probs = np.asarray(prob, dtype=np.float64)
+    out = {"Probs": probs}
+    wall = None if weights is None else np.asarray(weights.to_numpy(), np.float64)
     for v in vecs:
-        s, cnt = _sorted_valid(v.data)  # NaN sorts to the end
-        s = np.asarray(s)[: int(cnt)]
+        if wall is None:
+            s, cnt = _sorted_valid(v.data)  # NaN sorts to the end
+            s = np.asarray(s)[: int(cnt)]
+        else:
+            x = v.to_numpy().astype(np.float64)
+            ok = ~np.isnan(x) & ~np.isnan(wall) & (wall > 0)
+            order = np.argsort(x[ok], kind="mergesort")
+            s = x[ok][order]
+            sw = wall[ok][order]
         if len(s) == 0:
-            out[v.name] = np.full(len(prob), np.nan)
+            out[v.name] = np.full(len(probs), np.nan)
             continue
-        idx = (len(s) - 1) * np.asarray(prob, dtype=np.float64)
-        lo = np.floor(idx).astype(int)
-        hi = np.ceil(idx).astype(int)
-        out[v.name] = s[lo] * (1 - (idx - lo)) + s[hi] * (idx - lo)
+        if wall is None:
+            idx = (len(s) - 1) * probs
+            lo = np.floor(idx).astype(int)
+            hi = np.minimum(np.ceil(idx).astype(int), len(s) - 1)
+            out[v.name] = s[lo] * (1 - (idx - lo)) + s[hi] * (idx - lo)
+            continue
+        # weighted type-7: the target position t = p*(W-1) on the
+        # REPLICATED scale (element i occupies [left_i, left_i + w_i));
+        # both brackets resolve through the cumulative weights, which makes
+        # integer weights exactly equivalent to physically replicating rows
+        cw = np.cumsum(sw)
+        if cw[-1] < 2.0:
+            from h2o3_tpu.utils.log import Log
+
+            Log.warn(
+                "weighted quantile: total weight < 2 — weights are "
+                "observation counts (replication semantics), not normalized "
+                "fractions; results degenerate toward the minimum")
+        t = probs * max(cw[-1] - 1.0, 0.0)
+        k = np.floor(t)
+        frac = t - k
+        j1 = np.clip(np.searchsorted(cw, k, side="right"), 0, len(s) - 1)
+        j2 = np.clip(np.searchsorted(cw, k + 1.0, side="right"), 0, len(s) - 1)
+        out[v.name] = s[j1] * (1 - frac) + s[j2] * frac
     return Frame.from_pandas(pd.DataFrame(out))
 
 

@@ -420,7 +420,8 @@ h2o.deeplearning <- function(
     mini_batch_size = 32,
     standardize = TRUE,
     loss = "Automatic",
-    reproducible = TRUE
+    reproducible = TRUE,
+    autoencoder = FALSE
 ) {
   p <- list()
   if (!missing(ignored_columns)) p$ignored_columns <- ignored_columns
@@ -453,6 +454,7 @@ h2o.deeplearning <- function(
   if (!missing(standardize)) p$standardize <- standardize
   if (!missing(loss)) p$loss <- loss
   if (!missing(reproducible)) p$reproducible <- reproducible
+  if (!missing(autoencoder)) p$autoencoder <- autoencoder
   .h2o.train_params("deeplearning", y, x, training_frame, validation_frame, p)
 }
 

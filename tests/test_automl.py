@@ -119,3 +119,17 @@ def test_automl_budget_caps_each_model():
     assert all(cap is not None and cap <= 40.0 for cap in launched), launched
     # caps shrink as budget is consumed
     assert launched[-1] <= launched[0]
+
+
+def test_get_leaderboard_extra_columns():
+    from h2o3_tpu.automl import get_leaderboard
+
+    fr = _binary_frame(n=600, seed=9)
+    aml = AutoML(max_models=2, nfolds=0, seed=1, max_runtime_secs=120.0,
+                 include_algos=["GLM", "GBM"])
+    aml.train(y="y", training_frame=fr)
+    rows = get_leaderboard(aml, extra_columns="ALL")
+    assert rows and all("training_time_ms" in r for r in rows)
+    assert all(r["training_time_ms"] >= 0 for r in rows)
+    plain = get_leaderboard(aml)
+    assert all("training_time_ms" not in r for r in plain)

@@ -636,3 +636,27 @@ class TestInteraction:
 
         with pytest.raises(ValueError, match="overflows"):
             ops.interaction(_FakeFrame(fr), ["a", "b"])
+
+
+def test_weighted_quantile_matches_replication_and_unit_weights():
+    """Weighted quantile: all-ones weights == unweighted; integer weights
+    == row replication (the defining property)."""
+    import pandas as pd
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=200)
+    w = rng.integers(1, 5, 200).astype(float)
+    fr = Frame.from_pandas(pd.DataFrame({"x": x}))
+    wv = Frame.from_pandas(pd.DataFrame({"w": w})).vec("w")
+    probs = [0.1, 0.25, 0.5, 0.75, 0.9]
+
+    unw = ops.quantile(fr.vec("x"), probs).vec("x").to_numpy()
+    ones = Frame.from_pandas(pd.DataFrame({"w": np.ones(200)})).vec("w")
+    unit = ops.quantile(fr.vec("x"), probs, weights=ones).vec("x").to_numpy()
+    np.testing.assert_allclose(unit, unw, rtol=1e-12)
+
+    rep = np.repeat(x, w.astype(int))
+    frr = Frame.from_pandas(pd.DataFrame({"x": rep}))
+    expect = ops.quantile(frr.vec("x"), probs).vec("x").to_numpy()
+    got = ops.quantile(fr.vec("x"), probs, weights=wv).vec("x").to_numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-9)

@@ -504,3 +504,29 @@ def test_typeahead_and_metadata_routes(server, tmp_path):
     assert any(f["name"] == "ntrees" for f in gbm["fields"])
     assert any(r["url_pattern"].endswith("ModelBuilders/([^/]+)")
                for r in md["routes"])
+
+
+def test_weighted_quantile_over_rapids(server):
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=300)
+    w = rng.integers(1, 4, 300).astype(float)
+    fr = h2o3_tpu.upload_file(pd.DataFrame({"x": x, "w": w}))
+    from h2o3_tpu.cluster.registry import DKV
+    DKV.put("rq_fr", DKV.get(fr.key)); fr.key = "rq_fr"
+    _post(server, "/99/Rapids",
+          {"ast": "(tmp= rq_out (quantile rq_fr [0.25 0.5] 'interpolate' 'w'))"},
+          as_json=True)
+    got = h2o3_tpu.get_frame("rq_out").vec("x").to_numpy()
+    rep = np.repeat(x, w.astype(int))
+    # frame storage is f32 — compare at that precision
+    np.testing.assert_allclose(got, np.quantile(rep, [0.25, 0.5]), rtol=1e-6)
+    # weights column is excluded from the quantile output columns
+    assert "w" not in h2o3_tpu.get_frame("rq_out").names
+    # misspelled weights column errors instead of silently unweighting
+    try:
+        _post(server, "/99/Rapids",
+              {"ast": "(quantile rq_fr [0.5] 'interpolate' 'nope')"},
+              as_json=True)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
