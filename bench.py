@@ -547,6 +547,12 @@ def _phase_headline() -> dict:
         score_tree_interval=1000,
         seed=42,
     )
+    # bin-count A/B knob for TPU windows: the histogram kernel's indicator
+    # build is ∝ bins, and 127 quantile bins still exceed upstream's
+    # default split resolution (nbins=20)
+    nbins_env = os.environ.get("H2O3_TPU_BENCH_NBINS")
+    if nbins_env:
+        kw["nbins"] = int(nbins_env)
     # warmup: compile the full configuration (the chunk-scanned builder
     # specializes on chunk length, so warmup must use the same ntrees)
     GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
@@ -557,7 +563,9 @@ def _phase_headline() -> dict:
     tps = N_TREES / dt
 
     payload = {
-        "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}, AUC={m.training_metrics.auc:.4f})",
+        "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}"
+                  + (f", nbins={kw['nbins']}" if "nbins" in kw else "")
+                  + f", AUC={m.training_metrics.auc:.4f})",
         "value": round(tps, 3),
         "unit": "trees/sec/chip",
         "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),

@@ -434,6 +434,10 @@ def _apply(op: str, raw_args: list, sess: Session):
                 raise RapidsError(
                     f"quantile: weights column {args[3]!r} not in frame")
             wv = fr.vec(args[3])
+            if not wv.is_numeric():
+                raise RapidsError(
+                    f"quantile: weights column {args[3]!r} must be numeric, "
+                    f"got {wv.kind}")
             keep = [n for n in fr.names if n != args[3]]
             fr = Frame([fr.vec(n) for n in keep], keep)  # weights col excluded
         kw = {"weights": wv} if wv is not None else {}

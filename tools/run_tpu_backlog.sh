@@ -33,6 +33,10 @@ H2O3_TPU_BIN_ADAPT=1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_adapt.json"  # headline only (deadline=1s)
 save "BENCH_builder_${stamp}_adapt.json" "TPU bench adaptivity A/B control (headline only)"
 
+H2O3_TPU_BENCH_NBINS=127 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_nbins127.json"  # global bin-count A/B
+save "BENCH_builder_${stamp}_nbins127.json" "TPU bench 127-bin A/B (headline only)"
+
 timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
 save "KERNEL_SWEEP_${stamp}.jsonl" "Pallas histogram kernel tile sweep"
