@@ -143,7 +143,7 @@ def _init_with_retry():
         watchdog.cancel()
 
 
-def _phase_breakdown(fr, n_trees: int, total_s: float) -> tuple[dict, float]:
+def _phase_breakdown(fr, n_trees: int, total_s: float, nbins: int = 255) -> tuple[dict, float]:
     """Time the histogram / split / partition phases standalone on the bench
     data shapes and estimate histogram-phase MFU.
 
@@ -160,7 +160,7 @@ def _phase_breakdown(fr, n_trees: int, total_s: float) -> tuple[dict, float]:
     from h2o3_tpu.parallel.mesh import row_sharding
 
     cols = [c for c in fr.names if c != "label"]
-    spec = fit_bins(fr, cols)
+    spec = fit_bins(fr, cols, nbins=nbins)  # same bins the headline ran at
     bins_u8 = bin_frame(spec, fr)
     n_pad = bins_u8.shape[0]
     n_bins = spec.max_bins
@@ -552,7 +552,9 @@ def _phase_headline() -> dict:
     # default split resolution (nbins=20)
     nbins_env = os.environ.get("H2O3_TPU_BENCH_NBINS")
     if nbins_env:
-        kw["nbins"] = int(nbins_env)
+        # fit_bins clamps to MAX_BINS=255 silently — clamp HERE too so the
+        # recorded metric label always matches what actually ran
+        kw["nbins"] = max(min(int(nbins_env), 255), 2)
     # warmup: compile the full configuration (the chunk-scanned builder
     # specializes on chunk length, so warmup must use the same ntrees)
     GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
@@ -571,7 +573,8 @@ def _phase_headline() -> dict:
         "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
     }
     try:
-        breakdown, hist_flops = _phase_breakdown(fr, N_TREES, dt)
+        breakdown, hist_flops = _phase_breakdown(
+            fr, N_TREES, dt, nbins=kw.get("nbins", 255))
         payload["breakdown"] = breakdown
         kind = jax.devices()[0].device_kind.lower()
         peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)

@@ -654,13 +654,7 @@ def quantile(frame_or_vec, prob: Sequence[float] = (0.001, 0.01, 0.1, 0.25, 0.33
     probs = np.asarray(prob, dtype=np.float64)
     out = {"Probs": probs}
     wall = None if weights is None else np.asarray(weights.to_numpy(), np.float64)
-    if wall is not None and np.nansum(wall[wall > 0]) < 2.0:
-        from h2o3_tpu.utils.log import Log
-
-        Log.warn(
-            "weighted quantile: total weight < 2 — weights are observation "
-            "counts (replication semantics), not normalized fractions; "
-            "results degenerate toward the minimum")
+    warned = False
     for v in vecs:
         if wall is None:
             s, cnt = _sorted_valid(v.data)  # NaN sorts to the end
@@ -685,6 +679,18 @@ def quantile(frame_or_vec, prob: Sequence[float] = (0.001, 0.01, 0.1, 0.25, 0.33
         # both brackets resolve through the cumulative weights, which makes
         # integer weights exactly equivalent to physically replicating rows
         cw = np.cumsum(sw)
+        if cw[-1] < 2.0 and not warned:
+            # per-COLUMN effective weight (rows where this column is NaN are
+            # dropped, so a mostly-missing column can degenerate even when
+            # the frame's total weight is large); warn once per call
+            warned = True
+            from h2o3_tpu.utils.log import Log
+
+            Log.warn(
+                "weighted quantile: effective total weight < 2 for column "
+                f"{v.name!r} — weights are observation counts (replication "
+                "semantics), not normalized fractions; results degenerate "
+                "toward the minimum")
         t = probs * max(cw[-1] - 1.0, 0.0)
         k = np.floor(t)
         frac = t - k

@@ -238,6 +238,37 @@ def test_launch_rest_train_across_two_processes(tmp_path):
             assert e.code in (400, 412), e.code
         r = req("POST", "/99/Rapids", {"ast": "(tmp= rnd (h2o.runif mh 42))"})
         assert r["num_rows"] == 400, r
+
+        # frame-utility commands replicate on the same live cloud:
+        # SplitFrame (seeded), CreateFrame (coordinator-drawn seed),
+        # Interaction — then a model trains on a replicated product
+        sp = req("POST", "/3/SplitFrame",
+                 {"dataset": "mh", "ratios": "[0.75]",
+                  "destination_frames": '["mh_tr", "mh_te"]', "seed": "5"})
+        tr_rows = req("GET", "/3/Frames/mh_tr")["frames"][0]["rows"]
+        te_rows = req("GET", "/3/Frames/mh_te")["frames"][0]["rows"]
+        assert tr_rows + te_rows == 400, (tr_rows, te_rows)
+        cf = req("POST", "/3/CreateFrame",
+                 {"dest": "mh_cf", "rows": "300", "cols": "4",
+                  "categorical_fraction": "0.5", "factors": "3",
+                  "has_response": "true"})
+        assert cf["rows"] == 300, cf
+        it = req("POST", "/3/Interaction",
+                 {"source_frame": "mh_cf", "factor_columns": '["C3", "C4"]'})
+        ikey = it["destination_frame"]["name"]
+        ifr = req("GET", f"/3/Frames/{ikey}")["frames"][0]
+        assert ifr["columns"][0]["type"] == "enum", ifr
+        job2 = req("POST", "/3/ModelBuilders/gbm",
+                   {"training_frame": "mh_tr", "response_column": "label",
+                    "ntrees": "2", "max_depth": "2", "seed": "3"})
+        jid2 = job2["job"]["key"]["name"]
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            j2 = req("GET", f"/3/Jobs/{jid2}")["jobs"][0]
+            if j2["status"] in ("DONE", "FAILED", "CANCELLED"):
+                break
+            time.sleep(1.0)
+        assert j2["status"] == "DONE", j2.get("exception")
     finally:
         for p in procs:
             p.terminate()
