@@ -552,9 +552,11 @@ def _phase_headline() -> dict:
     # default split resolution (nbins=20)
     nbins_env = os.environ.get("H2O3_TPU_BENCH_NBINS")
     if nbins_env:
-        # fit_bins clamps to MAX_BINS=255 silently — clamp HERE too so the
-        # recorded metric label always matches what actually ran
-        kw["nbins"] = max(min(int(nbins_env), 255), 2)
+        from h2o3_tpu.models.tree.binning import MAX_BINS
+
+        # fit_bins clamps silently — clamp HERE too so the recorded metric
+        # label always matches what actually ran
+        kw["nbins"] = max(min(int(nbins_env), MAX_BINS), 2)
     # warmup: compile the full configuration (the chunk-scanned builder
     # specializes on chunk length, so warmup must use the same ntrees)
     GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
@@ -573,8 +575,10 @@ def _phase_headline() -> dict:
         "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
     }
     try:
+        from h2o3_tpu.models.tree.binning import MAX_BINS
+
         breakdown, hist_flops = _phase_breakdown(
-            fr, N_TREES, dt, nbins=kw.get("nbins", 255))
+            fr, N_TREES, dt, nbins=kw.get("nbins", MAX_BINS))
         payload["breakdown"] = breakdown
         kind = jax.devices()[0].device_kind.lower()
         peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
