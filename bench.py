@@ -550,10 +550,10 @@ def _phase_headline() -> dict:
     # bin-count A/B knob for TPU windows: the histogram kernel's indicator
     # build is ∝ bins, and 127 quantile bins still exceed upstream's
     # default split resolution (nbins=20)
+    from h2o3_tpu.models.tree.binning import MAX_BINS
+
     nbins_env = os.environ.get("H2O3_TPU_BENCH_NBINS")
     if nbins_env:
-        from h2o3_tpu.models.tree.binning import MAX_BINS
-
         # fit_bins clamps silently — clamp HERE too so the recorded metric
         # label always matches what actually ran
         kw["nbins"] = max(min(int(nbins_env), MAX_BINS), 2)
@@ -575,8 +575,6 @@ def _phase_headline() -> dict:
         "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
     }
     try:
-        from h2o3_tpu.models.tree.binning import MAX_BINS
-
         breakdown, hist_flops = _phase_breakdown(
             fr, N_TREES, dt, nbins=kw.get("nbins", MAX_BINS))
         payload["breakdown"] = breakdown

@@ -169,6 +169,21 @@ def _ordinal_nll_grad(X, y, w, beta, raw_cuts, K):
 # ---------------------------------------------------------------------------
 
 
+def _lambda_sequence(p: "GLMParams", lambda_max: float, nobs: float, P: int):
+    """The lambda schedule shared by every solver: explicit values, the
+    lambda_search geometric path, or the light-shrinkage default — one
+    definition so switching solver cannot silently change regularization."""
+    if p.lambda_ is not None:
+        return np.atleast_1d(np.asarray(p.lambda_, np.float64))
+    if p.lambda_search:
+        nl = p.nlambdas if p.nlambdas > 0 else 100
+        ratio = p.lambda_min_ratio if p.lambda_min_ratio > 0 else (
+            1e-4 if nobs > P else 1e-2
+        )
+        return np.geomspace(lambda_max, lambda_max * ratio, nl)
+    return np.array([lambda_max / 1e3])
+
+
 class GLMModel(Model):
     algo = "glm"
 
@@ -328,16 +343,7 @@ class GLM(ModelBuilder):
             g0_pen = g0
         lambda_max = float(np.max(np.abs(g0_pen)) / max(alpha, 1e-3) / max(nobs, 1.0))
 
-        if p.lambda_ is not None:
-            lambdas = np.atleast_1d(np.asarray(p.lambda_, np.float64))
-        elif p.lambda_search:
-            nl = p.nlambdas if p.nlambdas > 0 else 100
-            ratio = p.lambda_min_ratio if p.lambda_min_ratio > 0 else (
-                1e-4 if nobs > P else 1e-2
-            )
-            lambdas = np.geomspace(lambda_max, lambda_max * ratio, nl)
-        else:
-            lambdas = np.array([lambda_max / 1e3])
+        lambdas = _lambda_sequence(p, lambda_max, nobs, P)
 
         best = None
         null_dev = float(dev0)
@@ -523,96 +529,117 @@ class GLM(ModelBuilder):
         )
         if p.compute_p_values:
             raise ValueError("compute_p_values requires solver=IRLSM")
-        if p.lambda_search:
-            raise ValueError("lambda_search requires solver=IRLSM")
         fam = get_family(family, *fam_args)
         P = di.ncols_expanded
         icpt = P - 1 if p.intercept else None
         alpha = 0.5 if p.alpha is None else float(p.alpha)
-        if p.lambda_ is not None:
-            lam = float(np.atleast_1d(np.asarray(p.lambda_))[0])
-        else:
-            # same lambda_max/1e3 light-shrinkage default as the IRLSM path,
-            # so switching solver does not silently change regularization
-            beta0 = np.zeros(P, np.float64)
-            if p.intercept:
-                mu0 = float(np.asarray(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-10)))
-                if family in ("binomial", "quasibinomial", "fractionalbinomial"):
-                    mu0 = min(max(mu0, 1e-4), 1 - 1e-4)
-                beta0[icpt] = float(np.asarray(fam.link.fwd(jnp.asarray(mu0))))
-            G0, b0, _ = _irls_pass(
-                X, y, w, offset, jnp.asarray(beta0, jnp.float32), family, fam_args
-            )
-            g0 = np.asarray(b0, np.float64) - np.asarray(G0, np.float64) @ beta0
-            g0_pen = np.delete(g0, icpt) if icpt is not None else g0
-            lam = float(
-                np.max(np.abs(g0_pen)) / max(alpha, 1e-3) / max(nobs, 1.0)
-            ) / 1e3
-        # objective scale: h2o minimizes (1/N)(deviance/2) + lam*P_alpha(beta)
-        # with P_alpha = alpha*||b||_1 + (1-alpha)/2*||b||^2. On the DEVIANCE
-        # scale (x 2N) that is l2 = lam*(1-alpha)*N on ||b||^2 and
-        # l1 = 2*lam*alpha*N on ||b||_1 — the factor 2 matters: ADMM/IRLSM
-        # applies its penalties on the half-deviance (Gram) scale
-        l2 = lam * (1 - alpha) * nobs
-        l1 = 2.0 * lam * alpha * nobs
-        maxiter = p.max_iterations if p.max_iterations > 0 else 200
 
-        def smooth(b):
+        # null model: intercept (or zero) coefficients; its deviance INCLUDES
+        # the offset (IRLSM uses dev0 from the same pass — a constant-mu null
+        # would inflate dev_ratio and fire the path early-stop at lambda_max
+        # whenever an offset explains most of the response)
+        beta0 = np.zeros(P, np.float64)
+        if p.intercept:
+            mu0 = float(np.asarray(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-10)))
+            if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+                mu0 = min(max(mu0, 1e-4), 1 - 1e-4)
+            beta0[icpt] = float(np.asarray(fam.link.fwd(jnp.asarray(mu0))))
+        nd_v, g_v = _glm_dev_grad(
+            X, y, w, offset, jnp.asarray(beta0, jnp.float32), family, fam_args
+        )
+        null_dev = float(nd_v)
+        g_dev0 = np.asarray(g_v, np.float64)
+        # lambda_max from the null gradient on the HALF-deviance scale
+        # (the IRLSM derivation, without paying its O(N P^2) Gram pass)
+        g_half = g_dev0 / 2.0
+        g_pen = np.delete(g_half, icpt) if icpt is not None else g_half
+        lambda_max = float(np.max(np.abs(g_pen)) / max(alpha, 1e-3) / max(nobs, 1.0))
+        lambdas = _lambda_sequence(p, lambda_max, nobs, P)
+
+        maxiter = p.max_iterations if p.max_iterations > 0 else 200
+        l1_mask = np.ones(P)
+        if icpt is not None:
+            l1_mask[icpt] = 0.0
+
+        def smooth(b, l2):
             """Deviance + L2 part (value, gradient) — device pass."""
             val, g = _glm_dev_grad(
                 X, y, w, offset, jnp.asarray(b, jnp.float32), family, fam_args
             )
             b64 = np.asarray(b, np.float64)
             g64 = np.asarray(g, np.float64)
-            pen = b64.copy()
-            if icpt is not None:
-                pen[icpt] = 0.0
+            pen = b64 * l1_mask
             return float(val) + l2 * float(pen @ pen), g64 + 2.0 * l2 * pen
 
-        if l1 > 0:
-            # exact L1 via the bound-constrained split beta = b+ - b-,
-            # b± >= 0 with penalty l1*Σ(b+ + b-): a smooth box-constrained
-            # problem L-BFGS-B solves natively (the OWL-QN alternative the
-            # upstream L_BFGS+L1 pairing implies, without a custom solver)
-            l1_vec = np.full(P, l1)
-            if icpt is not None:
-                l1_vec[icpt] = 0.0
+        def solve_one(lam, beta_init):
+            """One elastic-net L-BFGS solve, warm-started at beta_init.
 
-            def fun2(z):
-                bp, bn = z[:P], z[P:]
-                val, g = smooth(bp - bn)
-                val += float(l1_vec @ (bp + bn))
-                return val, np.concatenate([g + l1_vec, -g + l1_vec])
+            Objective scale: h2o minimizes (1/N)(deviance/2) + lam*P_alpha
+            with P_alpha = alpha*||b||_1 + (1-alpha)/2*||b||^2. On the
+            DEVIANCE scale (x 2N): l2 = lam*(1-alpha)*N on ||b||^2 and
+            l1 = 2*lam*alpha*N on ||b||_1 — the factor 2 mirrors ADMM's
+            penalties living on the half-deviance (Gram) scale.
+            """
+            l2 = lam * (1 - alpha) * nobs
+            l1 = 2.0 * lam * alpha * nobs
+            if l1 > 0:
+                # exact L1 via the bound-constrained split beta = b+ - b-,
+                # b± >= 0 with penalty l1*Σ(b+ + b-): a smooth box problem
+                # L-BFGS-B solves natively (the OWL-QN alternative without
+                # a custom solver)
+                l1_vec = l1 * l1_mask
 
+                def fun2(z):
+                    bp, bn = z[:P], z[P:]
+                    val, g = smooth(bp - bn, l2)
+                    val += float(l1_vec @ (bp + bn))
+                    return val, np.concatenate([g + l1_vec, -g + l1_vec])
+
+                z0 = np.concatenate([np.maximum(beta_init, 0.0),
+                                     np.maximum(-beta_init, 0.0)])
+                res = spo.minimize(
+                    fun2, z0, jac=True, method="L-BFGS-B",
+                    bounds=[(0.0, None)] * (2 * P),
+                    options={"maxiter": maxiter},
+                )
+                b = res.x[:P] - res.x[P:]
+                # the split leaves tiny +/- residue where the true coef is 0
+                b[np.abs(b) < 1e-10] = 0.0
+                return b
             res = spo.minimize(
-                fun2, np.zeros(2 * P), jac=True, method="L-BFGS-B",
-                bounds=[(0.0, None)] * (2 * P),
-                options={"maxiter": maxiter},
+                lambda bb: smooth(bb, l2), beta_init, jac=True,
+                method="L-BFGS-B", options={"maxiter": maxiter},
             )
-            beta = res.x[:P] - res.x[P:]
-            # the split leaves tiny +/- residue where the true coef is 0
-            beta[np.abs(beta) < 1e-10] = 0.0
-        else:
-            res = spo.minimize(
-                smooth, np.zeros(P), jac=True, method="L-BFGS-B",
-                options={"maxiter": maxiter},
+            return res.x
+
+        best = None
+        path = []
+        beta = beta0.copy()
+        for li, lam_i in enumerate(lambdas):
+            beta = solve_one(float(lam_i), beta)  # warm start down the path
+            dev_i = float(
+                _deviance_pass(
+                    X, y, w, offset, jnp.asarray(beta, jnp.float32), family,
+                    fam_args,
+                )
             )
-            beta = res.x
-        dev = float(
-            _deviance_pass(
-                X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
-            )
-        )
-        mu0 = jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-10)
-        null = float(
-            fam.deviance(y, jnp.broadcast_to(mu0, y.shape), w)
-        )
+            expl = 1 - dev_i / max(null_dev, 1e-30)
+            path.append({"lambda": float(lam_i), "deviance": dev_i,
+                         "dev_ratio": expl})
+            if best is None or dev_i <= best["deviance"]:
+                best = {"lambda": float(lam_i), "beta": beta.copy(),
+                        "deviance": dev_i}
+            job.update(0.05 + 0.8 * (li + 1) / len(lambdas))
+            if p.lambda_search and expl > 0.999:
+                break
+
+        beta = best["beta"]
         out = self._coef_output(beta, di, p)
         out.update(
             family=family, family_obj=fam,
-            null_deviance=null, residual_deviance=dev,
-            lambda_best=lam, lambda_max=float("nan"), alpha=alpha,
-            regularization_path=[], multinomial=False, solver="L_BFGS",
+            null_deviance=null_dev, residual_deviance=best["deviance"],
+            lambda_best=best["lambda"], lambda_max=lambda_max, alpha=alpha,
+            regularization_path=path, multinomial=False, solver="L_BFGS",
         )
         job.update(0.9)
         return out

@@ -440,3 +440,43 @@ def test_lbfgs_elastic_net_matches_irlsm():
     # noise coefficients are driven to (near) zero by the L1 part
     assert np.all(np.abs(c_lb[3:]) < 0.02)
     assert np.all(np.abs(c_lb[:3]) > 0.5)
+
+
+def test_lbfgs_lambda_search_path():
+    """lambda_search now works under L_BFGS: a warm-started geometric path
+    with a regularization_path output and a best-lambda pick."""
+    rng = np.random.default_rng(11)
+    n, k = 1500, 6
+    X = rng.normal(size=(n, k))
+    y = X[:, 0] * 1.5 - X[:, 1] + rng.normal(size=n) * 0.5
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(k)])
+    df["y"] = y
+    fr = Frame.from_pandas(df)
+    m = GLM(solver="L_BFGS", family="gaussian", alpha=0.95,
+            lambda_search=True, nlambdas=20).train(y="y", training_frame=fr)
+    path = m.output["regularization_path"]
+    assert 2 <= len(path) <= 20
+    lams = [r["lambda"] for r in path]
+    assert lams == sorted(lams, reverse=True)  # descending sequence
+    # deviance improves monotonically-ish down the path; best is recorded
+    assert m.output["lambda_best"] == min(
+        path, key=lambda r: r["deviance"])["lambda"]
+    assert float(m.training_metrics.r2) > 0.6
+
+
+def test_lbfgs_lambda_search_with_offset_does_not_early_stop():
+    """The path early-stop uses an OFFSET-AWARE null deviance: an offset
+    explaining most of the response must not terminate the path at
+    lambda_max with a maximally-penalized model."""
+    rng = np.random.default_rng(13)
+    n = 1200
+    off = rng.normal(size=n) * 3.0          # dominant known component
+    x0 = rng.normal(size=n)
+    y = off + 0.8 * x0 + rng.normal(size=n) * 0.3
+    fr = Frame.from_pandas(pd.DataFrame({"x0": x0, "off": off, "y": y}))
+    m = GLM(solver="L_BFGS", family="gaussian", alpha=1.0,
+            lambda_search=True, nlambdas=12, offset_column="off",
+            standardize=False).train(y="y", x=["x0"], training_frame=fr)
+    path = m.output["regularization_path"]
+    assert len(path) > 1, "path stopped at lambda_max (offset-blind null)"
+    assert abs(m.coef["x0"] - 0.8) < 0.1
