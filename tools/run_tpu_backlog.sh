@@ -37,6 +37,10 @@ H2O3_TPU_BENCH_NBINS=127 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.p
   | tee "BENCH_builder_${stamp}_nbins127.json"  # global bin-count A/B
 save "BENCH_builder_${stamp}_nbins127.json" "TPU bench 127-bin A/B (headline only)"
 
+H2O3_TPU_HIST=matmul H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_matmul.json"  # Pallas kernel vs plain-XLA A/B
+save "BENCH_builder_${stamp}_matmul.json" "TPU bench plain-XLA histogram control (headline only)"
+
 timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
 save "KERNEL_SWEEP_${stamp}.jsonl" "Pallas histogram kernel tile sweep"
