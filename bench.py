@@ -731,7 +731,9 @@ def main() -> None:
                         "traceback": out.get("traceback", ""),
                     }
                 )
-                last = _last_builder_artifact()
+                # the child already embedded it on the watchdog path;
+                # recompute only when the failure mode skipped that
+                last = out.get("best_builder_artifact") or _last_builder_artifact()
                 if last is not None:
                     payload["best_builder_artifact"] = last
             else:

@@ -1,0 +1,122 @@
+"""One end-to-end 'airlines demo' scenario — the classic upstream workflow
+(import a messy CSV with dates/enums/NAs, munge, split, train several
+families, compare, export, score offline) run against this framework
+exactly as a migrating H2O user would write it. Upstream analog: the
+airlines pyunit/demo family [UNVERIFIED, SURVEY.md §4]."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+
+
+def _airline_csv(path, n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    dep_time = rng.integers(0, 2400, n)
+    distance = rng.integers(100, 3000, n).astype(float)
+    carrier = rng.choice(["AA", "UA", "DL", "WN", "B6"], n)
+    origin = rng.choice(["SFO", "JFK", "ORD", "ATL", "DEN", "LAX"], n)
+    dow = rng.integers(1, 8, n)
+    date = pd.to_datetime("2008-01-01") + pd.to_timedelta(
+        rng.integers(0, 365, n), unit="D"
+    )
+    # delay depends on carrier, hour, distance — learnable signal
+    eta = (
+        (carrier == "WN") * 0.8
+        + (dep_time / 2400.0) * 1.5
+        - (distance / 3000.0)
+        + (dow >= 6) * 0.4
+        + rng.normal(size=n) * 0.8
+    )
+    delayed = np.where(eta > 0.6, "YES", "NO")
+    df = pd.DataFrame({
+        "Date": date.strftime("%Y-%m-%d"),
+        "DepTime": dep_time.astype(float),
+        "UniqueCarrier": carrier,
+        "Origin": origin,
+        "DayOfWeek": dow.astype(float),
+        "Distance": distance,
+        "IsDepDelayed": delayed,
+    })
+    # realistic mess: missing values in numeric + enum columns
+    df.loc[rng.choice(n, 200, replace=False), "DepTime"] = np.nan
+    df.loc[rng.choice(n, 150, replace=False), "Origin"] = None
+    df.to_csv(path, index=False)
+    return df
+
+
+@pytest.mark.slow
+def test_airline_end_to_end(tmp_path):
+    csv = tmp_path / "allyears_tiny.csv"
+    _airline_csv(csv)
+
+    # -- import + inspect ---------------------------------------------------
+    fr = h2o3_tpu.import_file(str(csv))
+    assert fr.nrow == 4000 and fr.ncol == 7
+    assert fr.vec("UniqueCarrier").is_categorical()
+    assert fr.vec("IsDepDelayed").is_categorical()
+    assert fr.vec("Distance").is_numeric()
+
+    # -- munge: filter + derived column via the ops surface -----------------
+    night = (fr.vec("DepTime") >= 2200) | (fr.vec("DepTime") <= 500)
+    assert 0 < float(np.nansum(night.to_numpy())) < 4000
+
+    # -- split + train three families ---------------------------------------
+    train, test = fr.split_frame([0.8], seed=42)
+    feats = ["DepTime", "UniqueCarrier", "Origin", "DayOfWeek", "Distance"]
+    from h2o3_tpu.estimators import (
+        H2OGeneralizedLinearEstimator,
+        H2OGradientBoostingEstimator,
+        H2ORandomForestEstimator,
+    )
+
+    models = {}
+    gbm = H2OGradientBoostingEstimator(ntrees=20, max_depth=4, seed=1)
+    gbm.train(x=feats, y="IsDepDelayed", training_frame=train,
+              validation_frame=test)
+    models["gbm"] = gbm
+    glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=1e-4)
+    glm.train(x=feats, y="IsDepDelayed", training_frame=train,
+              validation_frame=test)
+    models["glm"] = glm
+    drf = H2ORandomForestEstimator(ntrees=20, max_depth=8, seed=1)
+    drf.train(x=feats, y="IsDepDelayed", training_frame=train,
+              validation_frame=test)
+    models["drf"] = drf
+
+    # every family learns the signal out of sample
+    for name, m in models.items():
+        auc = m.auc(valid=True)
+        assert auc > 0.65, (name, auc)
+    # trees should beat the linear model on this nonlinear signal
+    assert max(models["gbm"].auc(valid=True), models["drf"].auc(valid=True)) \
+        >= models["glm"].auc(valid=True) - 0.02
+
+    # -- varimp names come from the original columns ------------------------
+    vi_cols = {r["variable"].split(".")[0] for r in gbm.varimp()}
+    assert vi_cols <= set(feats)
+
+    # -- predict + threshold metrics on held-out data -----------------------
+    pred = gbm.predict(test)
+    assert pred.names[0] == "predict" and pred.nrow == test.nrow
+    perf = gbm.model_performance(test)
+    assert 0.0 < perf.value("logloss") < 1.0
+    assert perf.gains_lift() and perf.gains_lift()[0]["lift"] > 1.0
+
+    # -- offline scoring round-trip (the deployment contract) ---------------
+    mojo_path = str(tmp_path / "airline_gbm.zip")
+    gbm.download_mojo(mojo_path)
+    from h2o3_tpu.genmodel import MojoModel
+
+    mojo = MojoModel.load(mojo_path)
+    tdf = pd.read_csv(csv).iloc[:500]
+    offline = mojo.predict({c: tdf[c].to_numpy() for c in feats})
+    online = gbm.predict(fr)
+    on_lab = online.vec("predict")
+    on_500 = np.asarray(on_lab.levels())[on_lab.to_numpy().astype(int)[:500]]
+    agree = float(np.mean(offline["predict"][:500] == on_500))
+    assert agree > 0.999, agree
