@@ -207,6 +207,15 @@ class H2OFrame:
     def stratified_split(self, test_frac: float = 0.2, seed: int = -1):
         return self._node("h2o.random_stratified_split", test_frac, seed)
 
+    def split_frame(self, ratios=(0.75,), destination_frames=None,
+                    seed: int = 1234) -> list["H2OFrame"]:
+        """Random row split via /3/SplitFrame (materializes this frame
+        first) — the h2o.split_frame client verb."""
+        keys = self._conn.split_frame(
+            self.frame_id, list(ratios), destination_frames, seed=seed
+        )
+        return [H2OFrame(self._conn, key=k) for k in keys]
+
     def sort(self, by, ascending=True):
         cols = [by] if isinstance(by, str) else list(by)
         asc = [ascending] * len(cols) if isinstance(ascending, bool) else list(ascending)

@@ -4,14 +4,11 @@ families, compare, export, score offline) run against this framework
 exactly as a migrating H2O user would write it. Upstream analog: the
 airlines pyunit/demo family [UNVERIFIED, SURVEY.md §4]."""
 
-import os
-
 import numpy as np
 import pandas as pd
 import pytest
 
 import h2o3_tpu
-from h2o3_tpu.frame.frame import Frame
 
 
 def _airline_csv(path, n=4000, seed=0):

@@ -120,3 +120,14 @@ def test_lazy_round4_breadth(server, data):
 
     up = fr["grp"].toupper().to_pandas().iloc[:, 0].tolist()
     assert set(up[:10]) <= {"A", "B"}
+
+
+def test_client_split_frame(server, data):
+    fr = H2OFrame.from_key(server, "lazy_src")
+    tr, te = fr.split_frame([0.7], seed=9)
+    n_tr, _ = tr.shape
+    n_te, _ = te.shape
+    assert n_tr + n_te == len(data)
+    assert 0.55 * len(data) < n_tr < 0.85 * len(data)
+    # split parts are real server frames usable in further expressions
+    assert abs(tr["income"].mean() - data["income"].mean()) < data["income"].std()
