@@ -31,6 +31,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
     max_depth: int (default 5)
     min_rows: float (default 10.0)
     nbins: int (default 255)
+    nbins_cats: int (default 1024)
+    nbins_top_level: int (default 1024)
     min_split_improvement: float (default 1e-05)
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
@@ -72,6 +74,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
         max_depth=5,
         min_rows=10.0,
         nbins=255,
+        nbins_cats=1024,
+        nbins_top_level=1024,
         min_split_improvement=1e-05,
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
@@ -108,6 +112,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             max_depth=max_depth,
             min_rows=min_rows,
             nbins=nbins,
+            nbins_cats=nbins_cats,
+            nbins_top_level=nbins_top_level,
             min_split_improvement=min_split_improvement,
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
@@ -144,6 +150,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             'max_depth': 5,
             'min_rows': 10.0,
             'nbins': 255,
+            'nbins_cats': 1024,
+            'nbins_top_level': 1024,
             'min_split_improvement': 1e-05,
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
@@ -188,6 +196,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
     max_depth: int (default 6)
     min_rows: float (default 1.0)
     nbins: int (default 255)
+    nbins_cats: int (default 1024)
+    nbins_top_level: int (default 1024)
     min_split_improvement: float (default 0.0)
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
@@ -236,6 +246,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
         max_depth=6,
         min_rows=1.0,
         nbins=255,
+        nbins_cats=1024,
+        nbins_top_level=1024,
         min_split_improvement=0.0,
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
@@ -279,6 +291,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
             max_depth=max_depth,
             min_rows=min_rows,
             nbins=nbins,
+            nbins_cats=nbins_cats,
+            nbins_top_level=nbins_top_level,
             min_split_improvement=min_split_improvement,
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
@@ -322,6 +336,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
             'max_depth': 6,
             'min_rows': 1.0,
             'nbins': 255,
+            'nbins_cats': 1024,
+            'nbins_top_level': 1024,
             'min_split_improvement': 0.0,
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
@@ -373,6 +389,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
     max_depth: int (default 20)
     min_rows: float (default 1.0)
     nbins: int (default 255)
+    nbins_cats: int (default 1024)
+    nbins_top_level: int (default 1024)
     min_split_improvement: float (default 1e-05)
     sample_rate: float (default 0.632)
     col_sample_rate_per_tree: float (default 1.0)
@@ -407,6 +425,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
         max_depth=20,
         min_rows=1.0,
         nbins=255,
+        nbins_cats=1024,
+        nbins_top_level=1024,
         min_split_improvement=1e-05,
         sample_rate=0.632,
         col_sample_rate_per_tree=1.0,
@@ -436,6 +456,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
             max_depth=max_depth,
             min_rows=min_rows,
             nbins=nbins,
+            nbins_cats=nbins_cats,
+            nbins_top_level=nbins_top_level,
             min_split_improvement=min_split_improvement,
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
@@ -465,6 +487,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
             'max_depth': 20,
             'min_rows': 1.0,
             'nbins': 255,
+            'nbins_cats': 1024,
+            'nbins_top_level': 1024,
             'min_split_improvement': 1e-05,
             'sample_rate': 0.632,
             'col_sample_rate_per_tree': 1.0,
@@ -502,6 +526,8 @@ class H2OXRTEstimator(_EstimatorBase):
     max_depth: int (default 20)
     min_rows: float (default 1.0)
     nbins: int (default 255)
+    nbins_cats: int (default 1024)
+    nbins_top_level: int (default 1024)
     min_split_improvement: float (default 1e-05)
     sample_rate: float (default 0.632)
     col_sample_rate_per_tree: float (default 1.0)
@@ -536,6 +562,8 @@ class H2OXRTEstimator(_EstimatorBase):
         max_depth=20,
         min_rows=1.0,
         nbins=255,
+        nbins_cats=1024,
+        nbins_top_level=1024,
         min_split_improvement=1e-05,
         sample_rate=0.632,
         col_sample_rate_per_tree=1.0,
@@ -565,6 +593,8 @@ class H2OXRTEstimator(_EstimatorBase):
             max_depth=max_depth,
             min_rows=min_rows,
             nbins=nbins,
+            nbins_cats=nbins_cats,
+            nbins_top_level=nbins_top_level,
             min_split_improvement=min_split_improvement,
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
@@ -594,6 +624,8 @@ class H2OXRTEstimator(_EstimatorBase):
             'max_depth': 20,
             'min_rows': 1.0,
             'nbins': 255,
+            'nbins_cats': 1024,
+            'nbins_top_level': 1024,
             'min_split_improvement': 1e-05,
             'sample_rate': 0.632,
             'col_sample_rate_per_tree': 1.0,
@@ -1798,6 +1830,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
     max_depth: int (default 1)
     min_rows: float (default 10.0)
     nbins: int (default 255)
+    nbins_cats: int (default 1024)
+    nbins_top_level: int (default 1024)
     min_split_improvement: float (default 1e-05)
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
@@ -1833,6 +1867,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
         max_depth=1,
         min_rows=10.0,
         nbins=255,
+        nbins_cats=1024,
+        nbins_top_level=1024,
         min_split_improvement=1e-05,
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
@@ -1863,6 +1899,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
             max_depth=max_depth,
             min_rows=min_rows,
             nbins=nbins,
+            nbins_cats=nbins_cats,
+            nbins_top_level=nbins_top_level,
             min_split_improvement=min_split_improvement,
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
@@ -1893,6 +1931,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
             'max_depth': 1,
             'min_rows': 10.0,
             'nbins': 255,
+            'nbins_cats': 1024,
+            'nbins_top_level': 1024,
             'min_split_improvement': 1e-05,
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
@@ -1931,6 +1971,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
     max_depth: int (default 10)
     min_rows: float (default 10.0)
     nbins: int (default 255)
+    nbins_cats: int (default 1024)
+    nbins_top_level: int (default 1024)
     min_split_improvement: float (default 1e-05)
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
@@ -1963,6 +2005,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
         max_depth=10,
         min_rows=10.0,
         nbins=255,
+        nbins_cats=1024,
+        nbins_top_level=1024,
         min_split_improvement=1e-05,
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
@@ -1990,6 +2034,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
             max_depth=max_depth,
             min_rows=min_rows,
             nbins=nbins,
+            nbins_cats=nbins_cats,
+            nbins_top_level=nbins_top_level,
             min_split_improvement=min_split_improvement,
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
@@ -2017,6 +2063,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
             'max_depth': 10,
             'min_rows': 10.0,
             'nbins': 255,
+            'nbins_cats': 1024,
+            'nbins_top_level': 1024,
             'min_split_improvement': 1e-05,
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
@@ -2416,6 +2464,7 @@ class H2OUpliftRandomForestEstimator(_EstimatorBase):
     stopping_tolerance: float (default 0.001)
     checkpoint: Any (default None)
     export_checkpoints_dir: str | None (default None)
+    nbins_cats: int (default 1024)
     treatment_column: str (default 'treatment')
     uplift_metric: str (default 'KL')
     ntrees: int (default 50)
@@ -2447,6 +2496,7 @@ class H2OUpliftRandomForestEstimator(_EstimatorBase):
         stopping_tolerance=0.001,
         checkpoint=None,
         export_checkpoints_dir=None,
+        nbins_cats=1024,
         treatment_column='treatment',
         uplift_metric='KL',
         ntrees=50,
@@ -2473,6 +2523,7 @@ class H2OUpliftRandomForestEstimator(_EstimatorBase):
             stopping_tolerance=stopping_tolerance,
             checkpoint=checkpoint,
             export_checkpoints_dir=export_checkpoints_dir,
+            nbins_cats=nbins_cats,
             treatment_column=treatment_column,
             uplift_metric=uplift_metric,
             ntrees=ntrees,
@@ -2499,6 +2550,7 @@ class H2OUpliftRandomForestEstimator(_EstimatorBase):
             'stopping_tolerance': 0.001,
             'checkpoint': None,
             'export_checkpoints_dir': None,
+            'nbins_cats': 1024,
             'treatment_column': 'treatment',
             'uplift_metric': 'KL',
             'ntrees': 50,

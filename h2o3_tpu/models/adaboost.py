@@ -22,7 +22,7 @@ from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import ModelBuilder
-from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+from h2o3_tpu.models.tree.binning import bin_frame, fit_bins, fit_bins_for
 from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams
 from h2o3_tpu.models.tree.shared_tree import build_tree
 
@@ -59,7 +59,7 @@ class AdaBoost(ModelBuilder):
         if not yv.is_categorical() or yv.cardinality != 2:
             raise ValueError("AdaBoost is a binary classifier")
 
-        spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+        spec = fit_bins_for(p, train, self._x)
         bins = bin_frame(spec, train)
         npad = train.npad
 

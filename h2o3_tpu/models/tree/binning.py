@@ -86,7 +86,7 @@ def _device_quantile_edges(frame: Frame, names: list[str], nbins: int, sample: i
     return np.asarray(e), np.asarray(m)
 
 
-def fit_bins(frame: Frame, cols: list[str], nbins: int = MAX_BINS, sample: int = 200_000, seed: int = 7) -> BinSpec:
+def fit_bins(frame: Frame, cols: list[str], nbins: int = MAX_BINS, sample: int = 200_000, seed: int = 7, nbins_cats: int | None = None) -> BinSpec:
     """Compute per-column quantile edges from (a sample of) the data.
 
     CPU: host numpy on pulled columns (the exact path tests pin). TPU: one
@@ -107,7 +107,12 @@ def fit_bins(frame: Frame, cols: list[str], nbins: int = MAX_BINS, sample: int =
         if v.is_categorical():
             is_cat[ci] = True
             cards[ci] = v.cardinality
-            nb[ci] = min(v.cardinality, nbins)
+            # nbins_cats (upstream's categorical cap): levels past the cap
+            # group into the last bin via the binning clip below. Like
+            # upstream, it is INDEPENDENT of the numeric nbins — only the
+            # uint8 code space bounds it
+            cap = MAX_BINS if nbins_cats is None else min(nbins_cats, MAX_BINS)
+            nb[ci] = min(v.cardinality, max(cap, 1))
             domains[ci] = v.domain
         else:
             numeric.append(ci)
@@ -138,6 +143,24 @@ def fit_bins(frame: Frame, cols: list[str], nbins: int = MAX_BINS, sample: int =
             nb[ci] = len(e) + 1
             edges[ci, : len(e)] = e
     return BinSpec(list(cols), is_cat, nb, edges, cards, domains)
+
+
+def fit_bins_for(params, frame: Frame, cols: list[str]) -> BinSpec:
+    """fit_bins driven by a SharedTreeParams-style object — the one place
+    the tree builders derive binning from params (and the one place the
+    nbins_top_level no-op is disclosed at runtime)."""
+    from h2o3_tpu.utils.log import Log
+
+    if getattr(params, "nbins_top_level", 1024) != 1024:
+        Log.warn(
+            "nbins_top_level has no effect: bins are static quantiles fit "
+            "once (upstream re-bins per level); tune nbins / nbins_cats, or "
+            "the H2O3_TPU_BIN_ADAPT env knob for per-level coarsening")
+    return fit_bins(
+        frame, cols, nbins=params.nbins,
+        seed=abs(params.seed) or 7,
+        nbins_cats=getattr(params, "nbins_cats", None),
+    )
 
 
 _BINFRAME_PROG: dict = {}

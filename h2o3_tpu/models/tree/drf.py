@@ -22,7 +22,7 @@ from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import ScoreKeeper, stopping_metric_direction
-from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+from h2o3_tpu.models.tree.binning import bin_frame, fit_bins, fit_bins_for
 from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams
 from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
 from h2o3_tpu.models import metrics as MM
@@ -91,7 +91,7 @@ class DRF(ModelBuilder):
                 )
             spec = prior.output["bin_spec"]
         else:
-            spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+            spec = fit_bins_for(p, train, self._x)
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
         npad = train.npad

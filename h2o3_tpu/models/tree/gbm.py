@@ -28,7 +28,7 @@ from h2o3_tpu.models.model_base import (
     ScoreKeeper,
     stopping_metric_direction,
 )
-from h2o3_tpu.models.tree.binning import MAX_BINS, BinSpec, bin_frame, fit_bins
+from h2o3_tpu.models.tree.binning import MAX_BINS, BinSpec, bin_frame, fit_bins, fit_bins_for
 from h2o3_tpu.models.tree.distributions import (
     grad_hess,
     init_score,
@@ -46,6 +46,15 @@ class SharedTreeParams(CommonParams):
     max_depth: int = 5
     min_rows: float = 10.0
     nbins: int = MAX_BINS  # static quantile bins (h2o re-bins per level at 20)
+    # upstream's categorical-bin cap: domains wider than nbins_cats group
+    # their tail levels into the last bin (ours additionally caps at the
+    # uint8 code space, 254)
+    nbins_cats: int = 1024
+    # accepted for surface parity; upstream starts each tree at
+    # nbins_top_level bins and halves per level down to nbins — the static
+    # quantile design bins ONCE, so this knob has no effect here (the
+    # H2O3_TPU_BIN_ADAPT env var is the per-level coarsening analog)
+    nbins_top_level: int = 1024
     min_split_improvement: float = 1e-5
     sample_rate: float = 1.0
     col_sample_rate_per_tree: float = 1.0
@@ -252,7 +261,7 @@ class GBM(ModelBuilder):
             # identical binning is what makes prior trees replayable here
             spec = prior.output["bin_spec"]
         else:
-            spec = fit_bins(train, self._x, nbins=p.nbins, seed=abs(p.seed) or 7)
+            spec = fit_bins_for(p, train, self._x)
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
         npad = train.npad

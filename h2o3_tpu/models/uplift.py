@@ -29,7 +29,7 @@ from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
-from h2o3_tpu.models.tree.binning import bin_frame, fit_bins
+from h2o3_tpu.models.tree.binning import bin_frame, fit_bins, fit_bins_for
 from h2o3_tpu.models.tree.shared_tree import (
     Tree,
     TreeLevel,
@@ -43,6 +43,7 @@ _NEG = -1e30
 
 @dataclass
 class UpliftDRFParams(CommonParams):
+    nbins_cats: int = 1024  # categorical bin cap (shared tree semantics)
     treatment_column: str = "treatment"
     uplift_metric: str = "KL"  # KL | ChiSquared | Euclidean
     ntrees: int = 50
@@ -348,7 +349,7 @@ class UpliftDRF(ModelBuilder):
             raise ValueError(f"unknown uplift_metric {p.uplift_metric!r}")
 
         feats = [n for n in self._x if n != p.treatment_column]
-        spec = fit_bins(train, feats, nbins=p.nbins, seed=abs(p.seed) or 7)
+        spec = fit_bins_for(p, train, feats)
         bins = bin_frame(spec, train)
         npad = train.npad
         C = len(feats)

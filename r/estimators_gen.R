@@ -40,6 +40,8 @@ h2o.gbm <- function(
     max_depth = 5,
     min_rows = 10.0,
     nbins = 255,
+    nbins_cats = 1024,
+    nbins_top_level = 1024,
     min_split_improvement = 1e-05,
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
@@ -75,6 +77,8 @@ h2o.gbm <- function(
   if (!missing(max_depth)) p$max_depth <- max_depth
   if (!missing(min_rows)) p$min_rows <- min_rows
   if (!missing(nbins)) p$nbins <- nbins
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
+  if (!missing(nbins_top_level)) p$nbins_top_level <- nbins_top_level
   if (!missing(min_split_improvement)) p$min_split_improvement <- min_split_improvement
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
@@ -116,6 +120,8 @@ h2o.xgboost <- function(
     max_depth = 6,
     min_rows = 1.0,
     nbins = 255,
+    nbins_cats = 1024,
+    nbins_top_level = 1024,
     min_split_improvement = 0.0,
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
@@ -158,6 +164,8 @@ h2o.xgboost <- function(
   if (!missing(max_depth)) p$max_depth <- max_depth
   if (!missing(min_rows)) p$min_rows <- min_rows
   if (!missing(nbins)) p$nbins <- nbins
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
+  if (!missing(nbins_top_level)) p$nbins_top_level <- nbins_top_level
   if (!missing(min_split_improvement)) p$min_split_improvement <- min_split_improvement
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
@@ -206,6 +214,8 @@ h2o.randomForest <- function(
     max_depth = 20,
     min_rows = 1.0,
     nbins = 255,
+    nbins_cats = 1024,
+    nbins_top_level = 1024,
     min_split_improvement = 1e-05,
     sample_rate = 0.632,
     col_sample_rate_per_tree = 1.0,
@@ -234,6 +244,8 @@ h2o.randomForest <- function(
   if (!missing(max_depth)) p$max_depth <- max_depth
   if (!missing(min_rows)) p$min_rows <- min_rows
   if (!missing(nbins)) p$nbins <- nbins
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
+  if (!missing(nbins_top_level)) p$nbins_top_level <- nbins_top_level
   if (!missing(min_split_improvement)) p$min_split_improvement <- min_split_improvement
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
@@ -268,6 +280,8 @@ h2o.xrt <- function(
     max_depth = 20,
     min_rows = 1.0,
     nbins = 255,
+    nbins_cats = 1024,
+    nbins_top_level = 1024,
     min_split_improvement = 1e-05,
     sample_rate = 0.632,
     col_sample_rate_per_tree = 1.0,
@@ -296,6 +310,8 @@ h2o.xrt <- function(
   if (!missing(max_depth)) p$max_depth <- max_depth
   if (!missing(min_rows)) p$min_rows <- min_rows
   if (!missing(nbins)) p$nbins <- nbins
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
+  if (!missing(nbins_top_level)) p$nbins_top_level <- nbins_top_level
   if (!missing(min_split_improvement)) p$min_split_improvement <- min_split_improvement
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
@@ -886,6 +902,8 @@ h2o.adaBoost <- function(
     max_depth = 1,
     min_rows = 10.0,
     nbins = 255,
+    nbins_cats = 1024,
+    nbins_top_level = 1024,
     min_split_improvement = 1e-05,
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
@@ -915,6 +933,8 @@ h2o.adaBoost <- function(
   if (!missing(max_depth)) p$max_depth <- max_depth
   if (!missing(min_rows)) p$min_rows <- min_rows
   if (!missing(nbins)) p$nbins <- nbins
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
+  if (!missing(nbins_top_level)) p$nbins_top_level <- nbins_top_level
   if (!missing(min_split_improvement)) p$min_split_improvement <- min_split_improvement
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
@@ -950,6 +970,8 @@ h2o.decision_tree <- function(
     max_depth = 10,
     min_rows = 10.0,
     nbins = 255,
+    nbins_cats = 1024,
+    nbins_top_level = 1024,
     min_split_improvement = 1e-05,
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
@@ -976,6 +998,8 @@ h2o.decision_tree <- function(
   if (!missing(max_depth)) p$max_depth <- max_depth
   if (!missing(min_rows)) p$min_rows <- min_rows
   if (!missing(nbins)) p$nbins <- nbins
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
+  if (!missing(nbins_top_level)) p$nbins_top_level <- nbins_top_level
   if (!missing(min_split_improvement)) p$min_split_improvement <- min_split_improvement
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
@@ -1180,6 +1204,7 @@ h2o.upliftRandomForest <- function(
     stopping_tolerance = 0.001,
     checkpoint = NULL,
     export_checkpoints_dir = NULL,
+    nbins_cats = 1024,
     treatment_column = "treatment",
     uplift_metric = "KL",
     ntrees = 50,
@@ -1205,6 +1230,7 @@ h2o.upliftRandomForest <- function(
   if (!missing(stopping_tolerance)) p$stopping_tolerance <- stopping_tolerance
   if (!missing(checkpoint)) p$checkpoint <- checkpoint
   if (!missing(export_checkpoints_dir)) p$export_checkpoints_dir <- export_checkpoints_dir
+  if (!missing(nbins_cats)) p$nbins_cats <- nbins_cats
   if (!missing(treatment_column)) p$treatment_column <- treatment_column
   if (!missing(uplift_metric)) p$uplift_metric <- uplift_metric
   if (!missing(ntrees)) p$ntrees <- ntrees

@@ -650,3 +650,31 @@ def test_ks_zero_for_constant_predictor_any_row_order():
     mm2 = binomial_metrics(rng.permutation(y_sorted), p, domain=("n", "p"))
     assert abs(mm1.kolmogorov_smirnov()) < 1e-12
     assert abs(mm2.kolmogorov_smirnov()) < 1e-12
+
+
+def test_nbins_cats_groups_tail_levels():
+    """nbins_cats caps categorical bins: levels past the cap share the last
+    bin (upstream's high-cardinality grouping), and the model still trains."""
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.models.tree.binning import fit_bins
+
+    rng = np.random.default_rng(2)
+    n = 2000
+    cat = np.array([f"lvl{i:03d}" for i in rng.integers(0, 50, n)])
+    ybin = np.where((rng.random(n) < 0.3) ^ (cat < "lvl025"), "a", "b")
+    df = pd.DataFrame({"c": cat, "x": rng.normal(size=n), "y": ybin})
+    fr = Frame.from_pandas(df)
+
+    spec = fit_bins(fr, ["c", "x"], nbins_cats=8)
+    ci = spec.names.index("c")
+    assert spec.nbins[ci] == 8  # 50 levels -> 8 bins, tail grouped
+    spec_full = fit_bins(fr, ["c", "x"])
+    assert spec_full.nbins[ci] == 50
+    # upstream semantics: nbins_cats is INDEPENDENT of the numeric nbins —
+    # a low nbins must not silently crush categorical resolution
+    spec_low = fit_bins(fr, ["c", "x"], nbins=20)
+    assert spec_low.nbins[ci] == 50
+
+    m = GBM(ntrees=3, max_depth=3, nbins_cats=8, seed=1).train(
+        y="y", training_frame=fr)
+    assert float(m.training_metrics.auc) > 0.5
