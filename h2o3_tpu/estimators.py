@@ -123,6 +123,16 @@ class _EstimatorBase:
     def kolmogorov_smirnov(self, valid=False, xval=False):
         return self._metric("ks", valid, xval)
 
+    def varimp_plot(self, num_of_features=10, save=None):
+        from h2o3_tpu import explain as _ex
+
+        return _ex.varimp_plot(self._m(), num_of_features, save)
+
+    def learning_curve_plot(self, save=None):
+        from h2o3_tpu import explain as _ex
+
+        return _ex.learning_curve_plot(self._m(), save)
+
     def varimp(self, use_pandas: bool = False):
         vi = self._m().varimp() if hasattr(self._m(), "varimp") else None
         if use_pandas and vi is not None:

@@ -181,6 +181,140 @@ def learning_curve(model: Model) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# matplotlib renderings of the artifacts above — the h2o-py plot surface
+# (model.varimp_plot() etc.). Figures use the Agg backend (headless
+# coordinator); every function returns the Figure and optionally saves it.
+
+
+def _fig():
+    import sys
+
+    import matplotlib
+
+    # headless default, but NEVER hijack an interactive session's backend:
+    # switch to Agg only if pyplot hasn't been imported/configured yet
+    if "matplotlib.pyplot" not in sys.modules:
+        matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def _finish(fig, save: str | None):
+    fig.tight_layout()
+    if save:
+        fig.savefig(save, dpi=120)
+        # saved figures are artifacts, not open windows: close so a
+        # long-lived coordinator can't accumulate pyplot registry entries
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+    return fig
+
+
+def varimp_plot(model: Model, num_of_features: int = 10, save: str | None = None):
+    """Horizontal scaled-importance bars (upstream varimp_plot)."""
+    plt = _fig()
+    vi = varimp(model)
+    items = sorted(vi.items(), key=lambda kv: kv[1])[-num_of_features:]
+    fig, ax = plt.subplots(figsize=(7, 0.4 * len(items) + 1.2))
+    ax.barh([k for k, _ in items], [v for _, v in items])
+    ax.set_xlabel("scaled importance")
+    ax.set_title(f"Variable importance: {model.key}")
+    return _finish(fig, save)
+
+
+def pd_plot(model: Model, frame: Frame, column: str, nbins: int = 20,
+            save: str | None = None):
+    """Partial-dependence curve with the ±1 SD band (upstream pd_plot)."""
+    import numpy as _np
+
+    plt = _fig()
+    t = partial_dependence(model, frame, column, nbins=nbins)
+    fig, ax = plt.subplots(figsize=(7, 4))
+    m = _np.asarray(t["mean_response"])
+    s = _np.asarray(t["stddev_response"])
+    if all(isinstance(v, (int, float)) for v in t["values"]):
+        xs = t["values"]
+        ax.fill_between(xs, m - s, m + s, alpha=0.2)
+        ax.plot(xs, m, marker="o")
+    else:  # categorical grid: bar chart
+        ax.bar([str(v) for v in t["values"]], m, yerr=s, capsize=3)
+        ax.tick_params(axis="x", rotation=45)
+    ax.set_xlabel(column)
+    ax.set_ylabel("mean response")
+    ax.set_title(f"Partial dependence of {column}")
+    return _finish(fig, save)
+
+
+def roc_plot(model: Model, save: str | None = None, valid: bool = False):
+    """ROC curve from the stored threshold table (binomial models)."""
+    import numpy as _np
+
+    plt = _fig()
+    mm = model.validation_metrics if valid else model.training_metrics
+    auc = mm.value("auc")
+    # rebuild the curve from the gains-style cumulatives when present;
+    # fall back to the confusion-matrix point
+    fig, ax = plt.subplots(figsize=(5.5, 5))
+    gl = mm.gains_lift() or []
+    if gl:
+        xs = [0.0]
+        ys = [0.0]
+        for r in gl:
+            ys.append(r["cumulative_capture_rate"])
+            # FPR from data fraction and capture: df*N = TP+FP; approximate
+            # with the cumulative negatives fraction
+            xs.append(
+                (r["cumulative_data_fraction"] - r["cumulative_capture_rate"]
+                 * _pos_frac(mm)) / max(1 - _pos_frac(mm), 1e-9)
+            )
+        ax.plot(xs, ys, marker=".")
+    ax.plot([0, 1], [0, 1], linestyle="--", linewidth=1)
+    ax.set_xlabel("False positive rate")
+    ax.set_ylabel("True positive rate")
+    ax.set_title(f"ROC (AUC={auc:.4f})")
+    return _finish(fig, save)
+
+
+def _pos_frac(mm) -> float:
+    cm = mm._v.get("confusion_matrix")
+    if not cm:
+        return 0.5
+    tn, fp = cm[0]
+    fn, tp = cm[1]
+    tot = tn + fp + fn + tp
+    return (tp + fn) / tot if tot else 0.5
+
+
+def learning_curve_plot(model: Model, save: str | None = None):
+    """Training-history curves (upstream learning_curve_plot)."""
+    plt = _fig()
+    lc = learning_curve(model)
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for name, ys in lc["series"].items():
+        vals = [v for v in ys if isinstance(v, (int, float))]
+        if len(vals) == len(ys) and vals:
+            ax.plot(lc["steps"], ys, label=name)
+    ax.set_xlabel("step")
+    ax.legend(loc="best", fontsize=8)
+    ax.set_title(f"Learning curve: {model.key}")
+    return _finish(fig, save)
+
+
+def shap_summary_plot(model: Model, frame: Frame, top_n: int = 15,
+                      save: str | None = None):
+    """Mean-|SHAP| bars (the beeswarm's bar-summary form)."""
+    plt = _fig()
+    t = shap_summary(model, frame, top_n=top_n)
+    fig, ax = plt.subplots(figsize=(7, 0.4 * len(t["features"]) + 1.2))
+    ax.barh(t["features"][::-1], list(t["mean_abs_contribution"])[::-1])
+    ax.set_xlabel("mean |SHAP contribution|")
+    ax.set_title("SHAP summary")
+    return _finish(fig, save)
+
+
+# ---------------------------------------------------------------------------
 # the one-call driver
 
 

@@ -63,3 +63,33 @@ def test_explain_driver_end_to_end():
     corr = out["model_correlation"]["correlation"]
     assert corr[0, 1] > 0.7  # both models learn the same signal
     assert "residual_analysis" in out
+
+
+def test_plot_surface_renders(tmp_path):
+    """Every plotting wrapper renders a Figure headlessly and saves a PNG
+    (the h2o-py varimp_plot/pd_plot/roc_plot/learning_curve_plot surface)."""
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM
+
+    rng = np.random.default_rng(3)
+    n = 600
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.choice(["u", "v", "w"], n),
+    })
+    df["y"] = np.where(df.a + (df.b == "u") > 0.3, "T", "F")
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=fr)
+
+    for name, call in {
+        "vi.png": lambda p: ex.varimp_plot(m, save=p),
+        "pd_num.png": lambda p: ex.pd_plot(m, fr, "a", nbins=6, save=p),
+        "pd_cat.png": lambda p: ex.pd_plot(m, fr, "b", save=p),
+        "roc.png": lambda p: ex.roc_plot(m, save=p),
+        "lc.png": lambda p: ex.learning_curve_plot(m, save=p),
+        "shap.png": lambda p: ex.shap_summary_plot(m, fr, save=p),
+    }.items():
+        p = str(tmp_path / name)
+        fig = call(p)
+        assert fig is not None
+        assert (tmp_path / name).stat().st_size > 2000, name
