@@ -135,6 +135,7 @@ def _model_schema(m) -> dict:
             "cross_validation_metrics": m.cross_validation_metrics.to_dict()
             if m.cross_validation_metrics else None,
             "variable_importances": m.varimp() if hasattr(m, "varimp") else None,
+            "model_summary": m.model_summary() if hasattr(m, "model_summary") else None,
             "scoring_history": m.scoring_history,
         },
         "run_time_ms": m.run_time_ms,

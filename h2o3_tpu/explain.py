@@ -253,12 +253,17 @@ def roc_plot(model: Model, save: str | None = None, valid: bool = False):
 
     plt = _fig()
     mm = model.validation_metrics if valid else model.training_metrics
+    if mm is None:
+        raise ValueError(
+            "no validation metrics on this model — train with a "
+            "validation_frame or call roc_plot(valid=False)")
     auc = mm.value("auc")
     # rebuild the curve from the gains-style cumulatives when present;
     # fall back to the confusion-matrix point
     fig, ax = plt.subplots(figsize=(5.5, 5))
     gl = mm.gains_lift() or []
     if gl:
+        pf = _pos_frac(mm)
         xs = [0.0]
         ys = [0.0]
         for r in gl:
@@ -266,8 +271,8 @@ def roc_plot(model: Model, save: str | None = None, valid: bool = False):
             # FPR from data fraction and capture: df*N = TP+FP; approximate
             # with the cumulative negatives fraction
             xs.append(
-                (r["cumulative_data_fraction"] - r["cumulative_capture_rate"]
-                 * _pos_frac(mm)) / max(1 - _pos_frac(mm), 1e-9)
+                (r["cumulative_data_fraction"]
+                 - r["cumulative_capture_rate"] * pf) / max(1 - pf, 1e-9)
             )
         ax.plot(xs, ys, marker=".")
     ax.plot([0, 1], [0, 1], linestyle="--", linewidth=1)

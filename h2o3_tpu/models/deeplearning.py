@@ -160,14 +160,20 @@ def _make_optimizer(p):
     )
 
 
-def _make_mlp(p, n_out: int) -> _MLP:
-    dropout = tuple(
+def _resolved_dropout(p, n_hidden: int) -> tuple:
+    """THE dropout-default rule (WithDropout activations default to 0.5) —
+    single source for the network build and the model_summary table."""
+    return tuple(
         p.hidden_dropout_ratios
-        or ((0.5,) * len(p.hidden) if "dropout" in p.activation.lower()
-            else (0.0,) * len(p.hidden))
+        or ((0.5,) * n_hidden if "dropout" in p.activation.lower()
+            else (0.0,) * n_hidden)
     )
+
+
+def _make_mlp(p, n_out: int) -> _MLP:
     return _MLP(hidden=tuple(int(h) for h in p.hidden), n_out=n_out,
-                activation=p.activation, dropout=dropout,
+                activation=p.activation,
+                dropout=_resolved_dropout(p, len(p.hidden)),
                 input_dropout=p.input_dropout_ratio)
 
 
@@ -221,6 +227,25 @@ class DeepLearningModel(Model):
             return (self._autoencoder_metrics(frame) if frame is not None
                     else self.training_metrics)
         return super().model_performance(frame)
+
+    def model_summary(self) -> list[dict]:
+        """Upstream DL model_summary: the layer table."""
+        p = self.params
+        di: DataInfo = self.output["datainfo"]
+        hidden = list(self.output.get("hidden") or p.hidden)
+        n_out = (di.ncols_expanded if self.output.get("autoencoder")
+                 else (self.nclasses if self.is_classifier else 1))
+        dropout = list(_resolved_dropout(p, len(hidden)))
+        rows = [{"layer": 1, "units": di.ncols_expanded, "type": "Input",
+                 "dropout": p.input_dropout_ratio}]
+        for i, h in enumerate(hidden):
+            rows.append({"layer": i + 2, "units": int(h),
+                         "type": p.activation, "dropout": dropout[i],
+                         "l1": p.l1, "l2": p.l2})
+        rows.append({"layer": len(hidden) + 2, "units": int(n_out),
+                     "type": ("Linear" if (self.output.get("autoencoder")
+                              or not self.is_classifier) else "Softmax")})
+        return rows
 
     def anomaly(self, frame: Frame) -> Frame:
         """Per-row reconstruction MSE (``h2o.anomaly`` successor): the

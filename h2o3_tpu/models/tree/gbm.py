@@ -197,6 +197,34 @@ class SharedTreeModel(Model):
 
         return predict_leaf_node_assignment(self, frame, type)
 
+    def model_summary(self) -> dict:
+        """The upstream model_summary table for tree models: tree counts
+        and the depth/leaf distribution over the forest. Computed once and
+        cached (trees are immutable after build; device-backed levels pull
+        one batched transfer per tree via Tree.to_host)."""
+        cached = self.output.get("_model_summary_cache")
+        if cached is not None:
+            return cached
+        trees = self.output.get("trees") or []
+        flat = [t for group in trees for t in group]
+        depths = [t.depth for t in flat]
+        leaves = [t.n_leaves for t in flat]
+        K = self.output.get("n_tree_classes", 1)
+        out = {
+            "number_of_trees": len(trees),
+            "number_of_internal_trees": len(flat),
+            "model_size_in_bytes": None,
+            "min_depth": int(min(depths)) if depths else 0,
+            "max_depth": int(max(depths)) if depths else 0,
+            "mean_depth": float(np.mean(depths)) if depths else 0.0,
+            "min_leaves": int(min(leaves)) if leaves else 0,
+            "max_leaves": int(max(leaves)) if leaves else 0,
+            "mean_leaves": float(np.mean(leaves)) if leaves else 0.0,
+            "n_classes_per_iteration": K,
+        }
+        self.output["_model_summary_cache"] = out
+        return out
+
 
 class GBMModel(SharedTreeModel):
     algo = "gbm"

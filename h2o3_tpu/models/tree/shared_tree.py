@@ -1007,13 +1007,46 @@ class TreeLevel:
 class Tree:
     levels: list[TreeLevel] = field(default_factory=list)
 
+    def real_level_masks(self) -> list[np.ndarray]:
+        """Boolean mask of REAL node slots per level, derived exactly from
+        the split chain: level 0 has one real node; level i+1 has
+        2 * (# real non-leaf nodes at level i) real slots (children are
+        compacted to the front by child_base). Padding slots carry
+        leaf_now=True with zero stats and must not count as leaves."""
+        host = self.to_host() if any(
+            not isinstance(lv.leaf_now, np.ndarray) for lv in self.levels
+        ) else self
+        masks = []
+        n_real = 1
+        for lv in host.levels:
+            width = len(lv.leaf_now)
+            m = np.arange(width) < n_real
+            masks.append(m)
+            n_real = 2 * int(np.sum(~lv.leaf_now & m))
+        return masks
+
     @property
     def n_leaves(self) -> int:
-        return int(sum(int(jnp.sum(l.leaf_now)) for l in self.levels))
+        host = self.to_host() if any(
+            not isinstance(lv.leaf_now, np.ndarray) for lv in self.levels
+        ) else self
+        return int(sum(
+            int(np.sum(lv.leaf_now & m))
+            for lv, m in zip(host.levels, host.real_level_masks())
+        ))
 
     @property
     def depth(self) -> int:
-        return len(self.levels)
+        """Depth of the deepest REAL node (the recorded level count can
+        exceed it when every branch retired early)."""
+        host = self.to_host() if any(
+            not isinstance(lv.leaf_now, np.ndarray) for lv in self.levels
+        ) else self
+        d = 0
+        for li, m in enumerate(host.real_level_masks()):
+            if m.any():
+                d = li
+        return d
 
     def replay(self, bins_u8, nid, preds):
         """Accumulate this tree's contribution into preds (device walk)."""

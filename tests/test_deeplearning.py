@@ -153,3 +153,17 @@ def test_autoencoder_checkpoint_and_tiny_frame():
     with pytest.raises(RuntimeError, match="cross-validation"):
         DeepLearning(autoencoder=True, nfolds=3).train(training_frame=fr)
     DKV.remove(m1.key); DKV.remove(m2.key)
+
+
+def test_dl_model_summary_layer_table():
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({"a": rng.normal(size=300), "b": rng.normal(size=300)})
+    df["y"] = np.where(df.a > 0, "x", "z")
+    fr = Frame.from_pandas(df)
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    m = DeepLearning(hidden=(7, 5), epochs=1, seed=2).train(
+        y="y", training_frame=fr)
+    rows = m.model_summary()
+    assert [r["units"] for r in rows] == [2, 7, 5, 2]
+    assert rows[0]["type"] == "Input" and rows[-1]["type"] == "Softmax"

@@ -93,3 +93,16 @@ def test_plot_surface_renders(tmp_path):
         fig = call(p)
         assert fig is not None
         assert (tmp_path / name).stat().st_size > 2000, name
+
+
+def test_roc_plot_without_validation_metrics_errors_clearly():
+    from h2o3_tpu import explain as ex
+    from h2o3_tpu.models import GBM
+
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({"a": rng.normal(size=200)})
+    df["y"] = np.where(df.a > 0, "x", "z")
+    m = GBM(ntrees=2, max_depth=2, seed=1).train(
+        y="y", training_frame=Frame.from_pandas(df))
+    with pytest.raises(ValueError, match="validation"):
+        ex.roc_plot(m, valid=True)

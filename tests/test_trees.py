@@ -678,3 +678,19 @@ def test_nbins_cats_groups_tail_levels():
     m = GBM(ntrees=3, max_depth=3, nbins_cats=8, seed=1).train(
         y="y", training_frame=fr)
     assert float(m.training_metrics.auc) > 0.5
+
+
+def test_model_summary_tree_table():
+    """model_summary (upstream table): tree counts and depth/leaf ranges."""
+    from h2o3_tpu.models import GBM
+
+    rng = np.random.default_rng(6)
+    df = pd.DataFrame({"a": rng.normal(size=800), "b": rng.normal(size=800)})
+    df["y"] = np.where(df.a - df.b > 0, "p", "q")
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=4, max_depth=3, seed=2).train(y="y", training_frame=fr)
+    s = m.model_summary()
+    assert s["number_of_trees"] == 4 and s["number_of_internal_trees"] == 4
+    assert 1 <= s["min_depth"] <= s["max_depth"] <= 3
+    assert 2 <= s["min_leaves"] <= s["max_leaves"] <= 2 ** 3
+    assert s["mean_leaves"] >= s["min_leaves"]
