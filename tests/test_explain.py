@@ -96,6 +96,8 @@ def test_plot_surface_renders(tmp_path):
 
 
 def test_roc_plot_without_validation_metrics_errors_clearly():
+    import pytest
+
     from h2o3_tpu import explain as ex
     from h2o3_tpu.models import GBM
 
