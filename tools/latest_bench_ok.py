@@ -1,0 +1,40 @@
+"""Exit 0 iff the newest BENCH_builder_*.json captured a real headline value
+AND at least one post-headline phase.
+
+Used by tunnel_watch.sh as the 'did the backlog actually measure anything'
+signal — the backlog script's own exit code cannot carry it (tee pipelines,
+error-JSON-by-design). Requiring a post-headline phase matters: round 4's
+failure mode was exactly 'headline measured, every scale phase dead in a
+RESOURCE_EXHAUSTED cascade', and standing down on a headline alone would
+forfeit the later windows this round exists to use.
+"""
+
+import glob
+import json
+import os
+import sys
+
+# keep in sync with bench.py _PHASES (minus headline)
+POST_HEADLINE = (
+    "scale_10m", "cat_1m", "join_10m", "glm_1m", "dl_100k", "automl_50k",
+)
+
+here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+paths = glob.glob(os.path.join(here, "BENCH_builder_*.json"))
+if not paths:
+    sys.exit(1)
+newest = max(paths, key=os.path.getmtime)
+headline_ok = phases_ok = False
+try:
+    with open(newest) as f:
+        d = json.loads(f.readline())
+    if isinstance(d, dict):
+        headline_ok = float(d.get("value") or 0) > 0
+        phases_ok = any(isinstance(d.get(p), dict) for p in POST_HEADLINE)
+except Exception:
+    pass
+print(
+    f"{os.path.basename(newest)}: headline={'ok' if headline_ok else 'MISSING'}"
+    f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
+)
+sys.exit(0 if (headline_ok and phases_ok) else 1)

@@ -1,0 +1,32 @@
+"""Exit 0 iff the axon TPU tunnel answers within the watchdog budget.
+
+A wedged tunnel makes ``jax.devices()`` block forever (no exception), so a
+plain import-and-call would hang any caller; the hard watchdog + ``os._exit``
+pattern is mandatory (see bench.py). Callers should ALSO wrap this in
+``timeout 120`` (comfortably above the 90 s internal watchdog) as a
+belt-and-suspenders kill — tunnel_watch.sh does.
+"""
+
+import os
+import threading
+
+
+def _die() -> None:
+    print("tunnel DOWN (init hung)", flush=True)
+    os._exit(3)
+
+
+t = threading.Timer(90, _die)
+t.daemon = True
+t.start()
+
+import jax  # noqa: E402
+
+kinds = [d.device_kind for d in jax.devices()]
+if not kinds or all("cpu" in k.lower() for k in kinds):
+    # axon failed silently and jax fell back to host CPU (or no devices at
+    # all): NOT a window
+    print(f"tunnel DOWN (cpu fallback: {kinds})", flush=True)
+    os._exit(4)
+print(f"tunnel UP: {kinds}", flush=True)
+os._exit(0)
