@@ -613,12 +613,13 @@ def _phase_headline() -> dict:
         "unit": "trees/sec/chip",
         "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
     }
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
+    hist_flops = None
     try:
         breakdown, hist_flops = _phase_breakdown(
             fr, N_TREES, dt, nbins=kw.get("nbins", MAX_BINS))
         payload["breakdown"] = breakdown
-        kind = jax.devices()[0].device_kind.lower()
-        peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
         if peak is not None and breakdown["hist_s"] > 0:
             payload["mfu"] = round(hist_flops / breakdown["hist_s"] / peak, 4)
         elif peak is None:
@@ -626,6 +627,38 @@ def _phase_headline() -> dict:
         payload["device_kind"] = jax.devices()[0].device_kind
     except Exception as e:  # diagnostics must never sink the headline number
         payload["breakdown_error"] = repr(e)
+    # trace-based breakdown of the program that actually RAN (VERDICT r4
+    # weak #2): phase shares from a jax profiler trace of one more train,
+    # attributed via the ph_* named scopes. Requires the HLO dump that
+    # _child_main arranged before backend init.
+    try:
+        import profile_fused  # path added by _child_main
+
+        dump_dir = os.environ.get(profile_fused._DUMP_ENV)
+        if dump_dir:
+            prof = profile_fused.trace_phases(
+                lambda: GBM(ntrees=N_TREES, **kw).train(
+                    y="label", training_frame=fr
+                ),
+                dump_dir,
+            )
+            payload["fused_profile"] = prof
+            if (
+                peak is not None
+                and hist_flops is not None
+                and prof.get("phases_s", {}).get("ph_hist", 0) > 0
+            ):
+                # phases_s is a PER-DEVICE mean and hist_flops is the whole
+                # mesh's work: each of n_devices chips does ~1/n of it
+                per_dev_flops = (
+                    hist_flops * N_TREES / max(prof.get("n_devices", 1), 1)
+                )
+                payload["mfu_traced"] = round(
+                    per_dev_flops / prof["phases_s"]["ph_hist"] / peak, 4
+                )
+            profile_fused.cleanup_dump_dir()
+    except Exception as e:
+        payload["fused_profile_error"] = repr(e)
     return payload
 
 
@@ -663,6 +696,21 @@ DEADLINE_S = float(os.environ.get("H2O3_TPU_BENCH_DEADLINE_S", 3000))
 def _child_main(phase: str) -> None:
     """Run one phase in this (fresh) process; print its JSON dict."""
     try:
+        if phase == "headline":
+            # arrange the XLA HLO dump BEFORE jax loads, so the fused-profile
+            # trace (tools/profile_fused.py) can attribute ops to phases
+            try:
+                sys.path.insert(
+                    0,
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)), "tools"
+                    ),
+                )
+                import profile_fused
+
+                profile_fused.prepare_dump_dir()
+            except Exception:  # profiling prep must never sink the headline
+                pass
         _init_with_retry()
         out = _PHASES[phase][0]()
     except Exception as e:

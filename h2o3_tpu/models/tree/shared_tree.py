@@ -294,10 +294,12 @@ def _finish_level(
 
     varimp = varimp.at[split_col].add(jnp.where(ok, gain, 0.0).astype(varimp.dtype))
 
-    nid, preds = _partition_update(
-        bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
-        na_left, leaf_now, leaf_val, child_base,
-    )
+    # ph_part: phase tag for tools/profile_fused.py
+    with jax.named_scope("ph_part"):
+        nid, preds = _partition_update(
+            bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
+            na_left, leaf_now, leaf_val, child_base,
+        )
     record = {
         "node_w": node_w.astype(jnp.float32),
         "split_col": split_col.astype(jnp.int32),
@@ -344,9 +346,11 @@ def _level_core(
     keep = jax.random.uniform(key, (n_pad, C)) < col_sample_rate
     keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
     col_mask = col_mask * keep
-    sp = _split_scan(
-        hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols
-    )
+    # ph_split: phase tag for tools/profile_fused.py
+    with jax.named_scope("ph_split"):
+        sp = _split_scan(
+            hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols
+        )
     ok = sp["ok"]
     # frontier cap: children must fit n_pad_next; later nodes go leaf
     fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
@@ -842,10 +846,12 @@ def build_trees_scanned(
                     w_tree = w * mask.astype(w.dtype)
                 else:
                     w_tree = w
-                t, h = grad_fn(F, y, w_tree)
-                wy = w_tree * t
-                wy2 = wy * t
-                wh = jnp.where(w_tree > 0, h, 0.0)
+                # ph_grad: phase tag for tools/profile_fused.py
+                with jax.named_scope("ph_grad"):
+                    t, h = grad_fn(F, y, w_tree)
+                    wy = w_tree * t
+                    wy2 = wy * t
+                    wh = jnp.where(w_tree > 0, h, 0.0)
                 if col_sample_rate_per_tree < 1.0:
                     keep = (
                         jax.random.uniform(jax.random.fold_in(tkey, 1 << 30), (C,))

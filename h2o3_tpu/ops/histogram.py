@@ -190,17 +190,20 @@ def histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, me
         h = local(b, n, w_, wy_, wy2_, wh_, n_nodes, n_bins)
         return jax.lax.psum(h, ROWS_AXIS)
 
-    h = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(ROWS_AXIS),) * 6,
-        out_specs=P(),
-        check_vma=False,
-    )(bins_u8, nid, w, wy, wy2, wh)  # (C, n_nodes*n_bins, 4)
-    C = h.shape[0]
-    return jnp.transpose(
-        h.reshape(C, n_nodes, n_bins, STATS), (1, 0, 2, 3)
-    )  # (n_nodes, C, n_bins, 4)
+    # ph_hist: phase tag consumed by tools/profile_fused.py (HLO op_name
+    # metadata carries the scope path into the profiler trace)
+    with jax.named_scope("ph_hist"):
+        h = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(ROWS_AXIS),) * 6,
+            out_specs=P(),
+            check_vma=False,
+        )(bins_u8, nid, w, wy, wy2, wh)  # (C, n_nodes*n_bins, 4)
+        C = h.shape[0]
+        return jnp.transpose(
+            h.reshape(C, n_nodes, n_bins, STATS), (1, 0, 2, 3)
+        )  # (n_nodes, C, n_bins, 4)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
