@@ -28,7 +28,7 @@ SKIP = "skip"
 @dataclass
 class ColumnSpec:
     name: str
-    kind: str  # "num" | "cat"
+    kind: str  # "num" | "cat" | "hash"
     mean: float = 0.0
     sigma: float = 1.0
     domain: tuple[str, ...] = ()
@@ -58,6 +58,22 @@ class DataInfo:
     missing_handling: str = MEAN_IMPUTATION
     add_intercept: bool = False
     ncols_expanded: int = 0
+    # feature hashing (the sparse-chunk / sparse-DMatrix successor for
+    # Criteo-class cardinalities): cat columns wider than hash_buckets
+    # levels expand to a FIXED hash_buckets-wide indicator block instead of
+    # one column per level, bounding the design matrix at any cardinality.
+    # Buckets come from a stable string hash of (column, level), so train
+    # and scoring frames agree without any domain remap. Values <= 0 mean
+    # "no hashing" (fit coerces them to None). Like the exact cat path,
+    # use_all_factor_levels=False drops bucket 0 as the reference level —
+    # otherwise the block sums to the intercept and the unregularized Gram
+    # goes singular.
+    hash_buckets: int | None = None
+    # per-(column, domain) device LUT cache: rebuilding costs one crc32 per
+    # LEVEL (≈1M Python calls at Criteo cardinality) and must not be paid
+    # again on every scoring call. Values hold the domain tuple itself so
+    # the id() key can never be recycled while the entry lives.
+    _hash_luts: dict = field(default_factory=dict, repr=False, compare=False)
 
     @staticmethod
     def fit(
@@ -68,12 +84,17 @@ class DataInfo:
         missing_handling: str = MEAN_IMPUTATION,
         add_intercept: bool = False,
         interaction_pairs: list[tuple[str, str]] | None = None,
+        hash_buckets: int | None = None,
     ) -> "DataInfo":
+        hash_buckets = (
+            int(hash_buckets) if hash_buckets and int(hash_buckets) > 0 else None
+        )
         di = DataInfo(
             standardize=standardize,
             use_all_factor_levels=use_all_factor_levels,
             missing_handling=missing_handling,
             add_intercept=add_intercept,
+            hash_buckets=hash_buckets,
         )
         off = 0
         # H2O orders the expanded matrix categoricals-first, then numerics
@@ -83,6 +104,17 @@ class DataInfo:
             v = frame.vec(name)
             if v.is_categorical():
                 k = v.cardinality
+                if hash_buckets is not None and k > hash_buckets:
+                    hw = (
+                        hash_buckets
+                        if use_all_factor_levels
+                        else max(1, hash_buckets - 1)
+                    )
+                    di.columns.append(
+                        ColumnSpec(name, "hash", offset=off, width=hw)
+                    )
+                    off += hw
+                    continue
                 width = k if use_all_factor_levels else max(1, k - 1)
                 di.columns.append(
                     ColumnSpec(name, "cat", domain=v.domain or (), offset=off, width=width)
@@ -159,7 +191,9 @@ class DataInfo:
     def coef_names(self) -> list[str]:
         names = []
         for c in self.columns:
-            if c.kind == "cat":
+            if c.kind == "hash":
+                names += [f"{c.name}.hash{i}" for i in range(c.width)]
+            elif c.kind == "cat":
                 lo = 0 if self.use_all_factor_levels else 1
                 if c.pair_domains is not None:  # cat x cat combined factor
                     names += [f"{c.name}.{d}" for d in c.domain[lo : lo + c.width]]
@@ -187,7 +221,19 @@ class DataInfo:
                 cols.append(col)
                 continue
             v = frame.vec(c.name)
-            if c.kind == "cat":
+            if c.kind == "hash":
+                buckets = self._hashed_codes(v, c)
+                if self.missing_handling == SKIP:
+                    valid = valid * (buckets >= 0).astype(jnp.float32)
+                # use_all_factor_levels=False drops bucket 0 (reference),
+                # exactly like the cat path — see the hash_buckets field doc
+                cols.append(
+                    _expand_cat(
+                        buckets, self.hash_buckets, c.width,
+                        self.use_all_factor_levels,
+                    )
+                )
+            elif c.kind == "cat":
                 codes = _adapt_codes(v, c.domain)
                 if self.missing_handling == SKIP:
                     valid = valid * (codes >= 0).astype(jnp.float32)
@@ -210,6 +256,19 @@ class DataInfo:
         # zero out invalid rows so they contribute nothing to reductions
         X = X * valid[:, None]
         return X, valid
+
+    def _hashed_codes(self, v: Vec, c: ColumnSpec):
+        """Device bucket codes for a hashed column, LUT-cached per (column,
+        domain object) so scoring never re-pays the O(cardinality) host
+        hash loop."""
+        key = (c.name, id(v.domain))
+        hit = self._hash_luts.get(key)
+        if hit is not None and hit[0] is v.domain:
+            lut_dev = hit[1]
+        else:
+            lut_dev = _hash_lut(v.domain or (), c.name, self.hash_buckets)
+            self._hash_luts[key] = (v.domain, lut_dev)
+        return jnp.where(v.data >= 0, lut_dev[jnp.clip(v.data, 0)], -1)
 
     def _transform_interaction(self, frame: Frame, c: ColumnSpec, valid):
         """Interaction block: numeric product or onehot(cat) * numeric.
@@ -252,6 +311,35 @@ class DataInfo:
         oh = _expand_cat(codes, len(c.domain), c.width, self.use_all_factor_levels)
         x = jnp.nan_to_num(nv.data, nan=(c.pair_means or (0.0, 0.0))[1])
         return oh * x[:, None], valid
+
+
+def _hash_lut(domain: tuple[str, ...], col_name: str, n_buckets: int):
+    """Device LUT: level code -> hash bucket.
+
+    The bucket of a level is ``crc32(col_name \\0 level) % n_buckets`` — a
+    STABLE string hash (Python's ``hash()`` is process-salted), seeded by the
+    column name so two hashed columns decorrelate. Because the hash sees the
+    level STRING, train and scoring frames land in identical buckets with no
+    domain adaptation, at any cardinality. One crc32 per LEVEL, so callers
+    must cache per domain (``DataInfo._hashed_codes`` does); NA codes (< 0)
+    stay NA (-1) → all-zero indicator row.
+    """
+    import zlib
+
+    prefix = col_name.encode() + b"\x00"
+    lut = np.fromiter(
+        (zlib.crc32(prefix + d.encode()) % n_buckets for d in domain),
+        dtype=np.int32,
+        count=len(domain),
+    )
+    return jnp.asarray(np.append(lut, -1))  # slot keeps the gather in-bounds
+                                            # for an empty domain
+
+
+def _hash_codes(v: Vec, col_name: str, n_buckets: int):
+    """Uncached convenience wrapper (tests / one-off use)."""
+    lut_dev = _hash_lut(v.domain or (), col_name, n_buckets)
+    return jnp.where(v.data >= 0, lut_dev[jnp.clip(v.data, 0)], -1)
 
 
 def _adapt_codes(v: Vec, train_domain: tuple[str, ...]):

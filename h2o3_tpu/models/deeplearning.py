@@ -59,6 +59,8 @@ class DeepLearningParams(CommonParams):
     loss: str = "Automatic"
     reproducible: bool = True  # sync SGD is deterministic by construction
     autoencoder: bool = False  # reconstruct inputs; y is ignored
+    # feature hashing for Criteo-class cardinalities (datainfo.py)
+    hash_buckets: int | None = None
 
 
 class _MLP(nn.Module):
@@ -265,7 +267,8 @@ class DeepLearning(ModelBuilder):
         H2OAutoEncoderEstimator): reconstruct the standardized design
         matrix; no response. Same sync-SGD driver as the supervised path."""
         p: DeepLearningParams = self.params
-        di = DataInfo.fit(train, self._x, standardize=p.standardize)
+        di = DataInfo.fit(train, self._x, standardize=p.standardize,
+                          hash_buckets=p.hash_buckets)
         X, wmask = di.transform(train)
         w = wmask
         if p.weights_column:
@@ -353,7 +356,8 @@ class DeepLearning(ModelBuilder):
         K = yv.cardinality if classification else 1
         n_out = max(K, 1) if classification else 1
 
-        di = DataInfo.fit(train, self._x, standardize=p.standardize)
+        di = DataInfo.fit(train, self._x, standardize=p.standardize,
+                          hash_buckets=p.hash_buckets)
         X, wmask = di.transform(train)
         w = wmask
         if p.weights_column:

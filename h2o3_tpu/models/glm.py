@@ -68,6 +68,9 @@ class GLMParams(CommonParams):
     # `interaction_pairs` (explicit pairs); num x num and cat x num supported
     interactions: Any = None
     interaction_pairs: Any = None
+    # feature hashing for Criteo-class cardinalities: cat columns wider than
+    # this expand to a fixed hash-bucket indicator block (datainfo.py)
+    hash_buckets: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -272,6 +275,7 @@ class GLM(ModelBuilder):
             # ordinal: the K-1 ordered cuts ARE the intercepts
             add_intercept=p.intercept and family != "ordinal",
             interaction_pairs=pairs or None,
+            hash_buckets=int(p.hash_buckets) if p.hash_buckets else None,
         )
         X, valid_mask = di.transform(train)
         w = valid_mask

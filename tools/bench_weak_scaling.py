@@ -2,10 +2,11 @@
 """Weak-scaling measurement for the histogram hot loop on a virtual CPU mesh
 (VERDICT r3 item 4 / SURVEY §4 "real stack, local topology").
 
-Fixed rows PER SHARD; mesh sizes 1/2/4/8. If the sharded pass weak-scales,
-per-step wall time stays flat as shards are added and the psum share stays
-bounded — the property that lets the real TPU pod take Higgs-1B. Writes
-WEAKSCALING_r04.json at the repo root.
+Fixed rows PER SHARD; mesh sizes 1/2/4/8. On this box the virtual devices
+share the physical cores, so wall time CANNOT weak-scale by construction;
+the honest signal (VERDICT r4 weak #3) is ``psum_share`` — the fraction the
+cross-shard reduction adds over the local pass — reported as median with a
+min-max band over repetitions. Writes WEAKSCALING_r05.json at the repo root.
 
     python tools/bench_weak_scaling.py
 """
@@ -69,14 +70,17 @@ def main() -> None:
                 b, i, w_, wy_, w_, w_, N_NODES, N_BINS, mesh=mesh
             )
         )
-        out = fn(bins, nid, w, wy)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        reps = 3
-        for _ in range(reps):
-            out = fn(bins, nid, w, wy)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / reps
+        def timed(f, *a, reps=5):
+            """Per-rep wall times (median/min/max downstream, not a mean)."""
+            jax.block_until_ready(f(*a))  # warm
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(*a))
+                ts.append(time.perf_counter() - t0)
+            return ts
+
+        ts = timed(fn, bins, nid, w, wy)
 
         # local-only variant (no psum) isolates the reduction share
         from h2o3_tpu.ops.histogram import _select_local
@@ -91,40 +95,47 @@ def main() -> None:
                 check_vma=False,
             )
         )
-        out2 = loc_fn(bins, nid, w, wy)
-        jax.block_until_ready(out2)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out2 = loc_fn(bins, nid, w, wy)
-        jax.block_until_ready(out2)
-        dt_local = (time.perf_counter() - t0) / reps
+        ts_local = timed(loc_fn, bins, nid, w, wy)
 
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        shares = [
+            max(t - tl, 0.0) / t
+            for t, tl in zip(sorted(ts), sorted(ts_local))
+            if t > 0
+        ]
         results.append({
             "mesh_shards": k,
             "rows_total": n,
             "rows_per_shard": ROWS_PER_SHARD,
-            "hist_s": round(dt, 4),
-            "hist_local_s": round(dt_local, 4),
-            "psum_share": round(max(dt - dt_local, 0.0) / dt, 4) if dt > 0 else None,
+            "hist_s_median": round(med(ts), 4),
+            "hist_s_minmax": [round(min(ts), 4), round(max(ts), 4)],
+            "hist_local_s_median": round(med(ts_local), 4),
+            "hist_local_s_minmax": [
+                round(min(ts_local), 4), round(max(ts_local), 4)
+            ],
+            "psum_share_median": round(med(shares), 4) if shares else None,
+            "psum_share_minmax": [round(min(shares), 4), round(max(shares), 4)]
+            if shares else None,
         })
         print(results[-1], flush=True)
 
-    base = results[0]["hist_s"]
     payload = {
         "workload": f"histogram pass, {N_COLS} cols x {N_BINS} bins x {N_NODES} nodes, "
                     f"{ROWS_PER_SHARD} rows/shard (weak scaling)",
         "backend": "cpu x 8 virtual devices (XLA_FLAGS force_host_platform_device_count)",
-        "note": "virtual devices share this box's 2 physical cores, so wall "
-                "time grows ~linearly with shards BY CONSTRUCTION; the "
-                "scaling-relevant measurement is psum_share — the fraction "
-                "the cross-shard reduction adds — which stays bounded (<8%) "
-                "at every mesh size. On real chips each shard has its own "
-                "compute, leaving psum as the only scaling cost.",
+        "note": "virtual devices share this box's physical cores, so wall "
+                "time grows ~linearly with shards BY CONSTRUCTION and no "
+                "efficiency number is reported from this box (VERDICT r4 "
+                "weak #3). The scaling-relevant measurement is psum_share "
+                "— the fraction the cross-shard reduction adds over the "
+                "local pass — reported as median with min-max over 5 reps. "
+                "On real chips each shard has its own compute, leaving "
+                "psum as the only scaling cost. The mesh_shards=1 row has "
+                "NO reduction at all: its delta is the replicated-output "
+                "layout/transpose cost and bounds the measurement noise.",
         "results": results,
-        "weak_scaling_efficiency_8x": round(base / results[-1]["hist_s"], 4)
-        if len(results) >= 2 and results[-1]["hist_s"] > 0 else None,
     }
-    out = ROOT / "WEAKSCALING_r04.json"
+    out = ROOT / "WEAKSCALING_r05.json"
     out.write_text(json.dumps(payload, indent=2) + "\n")
     print(f"wrote {out}")
 

@@ -16,7 +16,8 @@ import sys
 
 # keep in sync with bench.py _PHASES (minus headline)
 POST_HEADLINE = (
-    "scale_10m", "cat_1m", "join_10m", "glm_1m", "dl_100k", "automl_50k",
+    "scale_10m", "cat_1m", "join_10m", "glm_1m", "hash_1m", "dl_100k",
+    "automl_50k",
 )
 
 here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
