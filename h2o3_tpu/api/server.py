@@ -1303,7 +1303,59 @@ class _Handler(BaseHTTPRequestHandler):
         })
         return True
 
+    def _auth_rejected(self) -> bool:
+        """Opt-in shared-token auth — the ``-hash_login`` analog (SURVEY
+        §5.6 upstream auth flags). Off unless H2O3_TPU_AUTH_TOKEN is set;
+        when on, every route requires ``Authorization: Bearer <token>`` or
+        HTTP Basic with the token as password (any username — matching the
+        one-credential spirit of a hash_login file with a single entry).
+        Comparisons are constant-time."""
+        from h2o3_tpu import config
+
+        token = config.get("H2O3_TPU_AUTH_TOKEN")
+        if not token:
+            return False
+        import base64
+        import hmac
+
+        hdr = (self.headers.get("Authorization") or "").strip()
+        ok = False
+        if hdr.startswith("Bearer "):
+            try:
+                # bytes on both sides: compare_digest raises TypeError on
+                # non-ASCII str (http.server decodes headers as latin-1),
+                # and this guard runs OUTSIDE the route try/except
+                ok = hmac.compare_digest(
+                    hdr[7:].strip().encode("utf-8", "surrogateescape"),
+                    token.encode(),
+                )
+            except Exception:  # noqa: BLE001 — malformed header == no auth
+                ok = False
+        elif hdr.startswith("Basic "):
+            try:
+                userpass = base64.b64decode(hdr[6:].strip()).decode()
+                pw = userpass.split(":", 1)[1] if ":" in userpass else ""
+                ok = hmac.compare_digest(pw, token)
+            except Exception:  # noqa: BLE001 — malformed header == no auth
+                ok = False
+        if ok:
+            return False
+        self._reply(
+            401,
+            {
+                "__meta": {"schema_type": "Error"},
+                "msg": "authentication required (H2O3_TPU_AUTH_TOKEN is set; "
+                       "send Authorization: Bearer <token> or Basic with the "
+                       "token as password)",
+                "http_status": 401,
+            },
+            extra_headers={"WWW-Authenticate": 'Basic realm="h2o3_tpu"'},
+        )
+        return True
+
     def _dispatch(self, method: str):
+        if self._auth_rejected():
+            return
         if self._blocked_cross_origin(method):
             return
         path = urllib.parse.urlparse(self.path).path
@@ -1341,11 +1393,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(404, {"__meta": {"schema_type": "Error"},
                           "msg": f"no route {method} {path}", "http_status": 404})
 
-    def _reply(self, status: int, payload: dict):
+    def _reply(self, status: int, payload: dict, extra_headers: dict | None = None):
         data = json.dumps(payload, default=_json_default).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 

@@ -27,9 +27,17 @@ class H2OClientError(Exception):
 
 
 class H2OConnection:
-    def __init__(self, url: str, timeout: float = 600.0):
+    def __init__(self, url: str, timeout: float = 600.0, token: str | None = None):
+        """``token`` authenticates against a server running with
+        H2O3_TPU_AUTH_TOKEN (the hash_login analog); defaults to that same
+        env var so client and in-process server pair up automatically."""
         self.url = url.rstrip("/")
         self.timeout = timeout
+        if token is None:
+            from h2o3_tpu import config
+
+            token = config.get("H2O3_TPU_AUTH_TOKEN") or None
+        self.token = token
         cloud = self.get("/3/Cloud")
         if not cloud.get("cloud_healthy"):
             raise H2OClientError(503, "cloud is not healthy")
@@ -49,6 +57,7 @@ class H2OConnection:
                     {k: json.dumps(v) if isinstance(v, (list, dict)) else v
                      for k, v in payload.items() if v is not None}
                 ).encode()
+        headers.update(self._auth_headers())
         req = urllib.request.Request(url, data=data, headers=headers, method=method)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -147,10 +156,14 @@ class H2OConnection:
         )
         return out["model_metrics"][0]
 
+    def _auth_headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.token}"} if self.token else {}
+
     def _raw_post(self, path: str, body: bytes) -> dict:
         req = urllib.request.Request(
             self.url + path, data=body,
-            headers={"Content-Type": "application/octet-stream"}, method="POST",
+            headers={"Content-Type": "application/octet-stream",
+                     **self._auth_headers()}, method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read())
@@ -189,7 +202,8 @@ class H2OConnection:
         """GET /3/Models/{id}/mojo → local file."""
         import urllib.request
 
-        req = urllib.request.Request(f"{self.url}/3/Models/{model_key}/mojo")
+        req = urllib.request.Request(f"{self.url}/3/Models/{model_key}/mojo",
+                                     headers=self._auth_headers())
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             data = r.read()
         with open(path, "wb") as f:
@@ -208,7 +222,8 @@ class H2OConnection:
         import urllib.request
 
         q = urllib.parse.urlencode({"frame_id": frame_key})
-        req = urllib.request.Request(f"{self.url}/3/DownloadDataset?{q}")
+        req = urllib.request.Request(f"{self.url}/3/DownloadDataset?{q}",
+                                     headers=self._auth_headers())
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return resp.read()
 

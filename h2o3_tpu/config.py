@@ -32,6 +32,9 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),
+    "H2O3_TPU_AUTH_TOKEN": (
+        "", "opt-in REST auth token ('' = open, upstream default); when set "
+            "every route requires Bearer/Basic auth (hash_login analog)"),
     "H2O3_TPU_ALLOWED_HOSTS": (
         "", "extra Host header names accepted for state-changing REST "
         "requests (comma list; '*' disables the CSRF/rebinding guard)"),

@@ -18,10 +18,18 @@
 
 .h2o.json <- function(x) jsonlite::toJSON(x, auto_unbox = TRUE, null = "null")
 
+.h2o.auth_args <- function() {
+  # shared by every curl invocation (JSON requests AND the raw download /
+  # upload / csv paths): a token-enabled server 401s any unauthenticated
+  # route, and curl -o would silently write the error JSON into the file
+  if (is.null(.h2o3$token)) character(0)
+  else c("-H", paste0("Authorization: Bearer ", .h2o3$token))
+}
+
 .h2o.req <- function(method, path, body = NULL) {
   stopifnot(!is.null(.h2o3$url))
   url <- paste0(.h2o3$url, path)
-  args <- c("-sS", "-X", method, url)
+  args <- c("-sS", "-X", method, url, .h2o.auth_args())
   if (!is.null(body)) {
     args <- c(args, "-H", "Content-Type: application/json",
               "--data-binary", as.character(.h2o.json(body)))
@@ -55,7 +63,9 @@
 
 # -- connection ---------------------------------------------------------------
 
-h2o.init <- function(url = "http://localhost:54321") {
+h2o.init <- function(url = "http://localhost:54321", token = NULL) {
+  .h2o3$token <- if (is.null(token)) Sys.getenv("H2O3_TPU_AUTH_TOKEN", NA) else token
+  if (is.na(.h2o3$token) || !nzchar(.h2o3$token)) .h2o3$token <- NULL
   .h2o3$url <- sub("/+$", "", url)
   cloud <- .h2o.req("GET", "/3/Cloud")
   message("Connected to ", cloud$cloud_name, " (", cloud$cloud_size,
@@ -179,7 +189,7 @@ h2o.logloss <- function(perf) perf$logloss
 h2o.download_mojo <- function(model, path = ".") {
   url <- paste0(.h2o3$url, "/3/Models/", model$model_id, "/mojo")
   dest <- file.path(path, paste0(model$model_id, ".zip"))
-  system2("curl", shQuote(c("-sS", "-o", dest, url)))
+  system2("curl", shQuote(c("-sS", .h2o.auth_args(), "-o", dest, url)))
   dest
 }
 
@@ -305,7 +315,7 @@ as.data.frame.H2O3Frame <- function(x, ...) {
   url <- paste0(.h2o3$url, "/3/DownloadDataset?frame_id=",
                 utils::URLencode(.h2o.fref(x), TRUE))
   tmp <- tempfile(fileext = ".csv")
-  system2("curl", shQuote(c("-sS", "-o", tmp, url)))
+  system2("curl", shQuote(c("-sS", .h2o.auth_args(), "-o", tmp, url)))
   utils::read.csv(tmp)
 }
 
@@ -316,7 +326,7 @@ h2o.uploadFile <- function(path, destination_frame = NULL) {
     url <- paste0(url, "&destination_frame=",
                   utils::URLencode(destination_frame, TRUE))
   }
-  res <- system2("curl", shQuote(c("-sS", "-X", "POST", "--data-binary",
+  res <- system2("curl", shQuote(c("-sS", .h2o.auth_args(), "-X", "POST", "--data-binary",
                                    paste0("@", path), url)), stdout = TRUE)
   parsed <- jsonlite::fromJSON(paste(res, collapse = ""))
   # PostFile already parses server-side and returns the new frame's KEY

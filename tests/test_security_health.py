@@ -123,3 +123,54 @@ def test_isotonic_knots_collapsed():
     pq = apply_calibration(cal, q)
     assert (np.diff(pq) >= -1e-12).all()
     assert abs(pq[50] - 0.5) < 0.12
+
+
+# -- opt-in token auth (the -hash_login analog, SURVEY §5.6) -----------------
+
+
+def _get_raw(server, path, headers=None):
+    req = urllib.request.Request(server.url + path, headers=headers or {})
+    return urllib.request.urlopen(req)
+
+
+def test_auth_off_by_default(server):
+    # upstream's default is open; auth is strictly opt-in
+    assert _get_raw(server, "/3/Cloud").status == 200
+
+
+def test_auth_token_enforced(server, monkeypatch):
+    import base64
+
+    monkeypatch.setenv("H2O3_TPU_AUTH_TOKEN", "sekrit-42")
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_raw(server, "/3/Cloud")
+    assert ei.value.code == 401
+    assert "Basic" in ei.value.headers.get("WWW-Authenticate", "")
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_raw(server, "/3/Cloud", {"Authorization": "Bearer wrong"})
+    assert ei.value.code == 401
+
+    ok = _get_raw(server, "/3/Cloud", {"Authorization": "Bearer sekrit-42"})
+    assert ok.status == 200
+
+    basic = base64.b64encode(b"anyuser:sekrit-42").decode()
+    ok = _get_raw(server, "/3/Cloud", {"Authorization": f"Basic {basic}"})
+    assert ok.status == 200
+
+    # POSTs are covered too (auth runs before route dispatch)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_raw(server, "/99/Rapids", {"ast": "(+ 1 2)"}, {})
+    assert ei.value.code == 401
+
+
+def test_auth_client_pairs_with_token(server, monkeypatch):
+    from h2o3_tpu.client import H2OConnection
+
+    monkeypatch.setenv("H2O3_TPU_AUTH_TOKEN", "sekrit-43")
+    conn = H2OConnection(server.url, token="sekrit-43")
+    assert conn.cloud.get("cloud_healthy")
+    # the env default pairs automatically when token isn't passed
+    conn2 = H2OConnection(server.url)
+    assert conn2.token == "sekrit-43"
