@@ -680,6 +680,7 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
     non_negative: bool (default False)
     interactions: Any (default None)
     interaction_pairs: Any (default None)
+    hash_buckets: Any (default None)
     """
 
     _BUILDER = "GLM"
@@ -722,6 +723,7 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
         non_negative=False,
         interactions=None,
         interaction_pairs=None,
+        hash_buckets=None,
     ):
         kw = dict(
             response_column=response_column,
@@ -759,6 +761,7 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
             non_negative=non_negative,
             interactions=interactions,
             interaction_pairs=interaction_pairs,
+            hash_buckets=hash_buckets,
         )
         defaults = {
             'response_column': None,
@@ -796,6 +799,7 @@ class H2OGeneralizedLinearEstimator(_EstimatorBase):
             'non_negative': False,
             'interactions': None,
             'interaction_pairs': None,
+            'hash_buckets': None,
         }
         kw = {k: v for k, v in kw.items() if v != defaults[k]}
         super().__init__(model_id=model_id, **kw)
@@ -838,6 +842,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
     loss: str (default 'Automatic')
     reproducible: bool (default True)
     autoencoder: bool (default False)
+    hash_buckets: int | None (default None)
     """
 
     _BUILDER = "DeepLearning"
@@ -877,6 +882,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
         loss='Automatic',
         reproducible=True,
         autoencoder=False,
+        hash_buckets=None,
     ):
         kw = dict(
             response_column=response_column,
@@ -911,6 +917,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
             loss=loss,
             reproducible=reproducible,
             autoencoder=autoencoder,
+            hash_buckets=hash_buckets,
         )
         defaults = {
             'response_column': None,
@@ -945,6 +952,7 @@ class H2ODeepLearningEstimator(_EstimatorBase):
             'loss': 'Automatic',
             'reproducible': True,
             'autoencoder': False,
+            'hash_buckets': None,
         }
         kw = {k: v for k, v in kw.items() if v != defaults[k]}
         super().__init__(model_id=model_id, **kw)

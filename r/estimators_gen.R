@@ -362,7 +362,8 @@ h2o.glm <- function(
     compute_p_values = FALSE,
     non_negative = FALSE,
     interactions = NULL,
-    interaction_pairs = NULL
+    interaction_pairs = NULL,
+    hash_buckets = NULL
 ) {
   p <- list()
   if (!missing(ignored_columns)) p$ignored_columns <- ignored_columns
@@ -399,6 +400,7 @@ h2o.glm <- function(
   if (!missing(non_negative)) p$non_negative <- non_negative
   if (!missing(interactions)) p$interactions <- interactions
   if (!missing(interaction_pairs)) p$interaction_pairs <- interaction_pairs
+  if (!missing(hash_buckets)) p$hash_buckets <- hash_buckets
   .h2o.train_params("glm", y, x, training_frame, validation_frame, p)
 }
 
@@ -437,7 +439,8 @@ h2o.deeplearning <- function(
     standardize = TRUE,
     loss = "Automatic",
     reproducible = TRUE,
-    autoencoder = FALSE
+    autoencoder = FALSE,
+    hash_buckets = NULL
 ) {
   p <- list()
   if (!missing(ignored_columns)) p$ignored_columns <- ignored_columns
@@ -471,6 +474,7 @@ h2o.deeplearning <- function(
   if (!missing(loss)) p$loss <- loss
   if (!missing(reproducible)) p$reproducible <- reproducible
   if (!missing(autoencoder)) p$autoencoder <- autoencoder
+  if (!missing(hash_buckets)) p$hash_buckets <- hash_buckets
   .h2o.train_params("deeplearning", y, x, training_frame, validation_frame, p)
 }
 
