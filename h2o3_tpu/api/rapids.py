@@ -364,7 +364,13 @@ def _apply(op: str, raw_args: list, sess: Session):
         out = Frame([base.vec(i) for i in range(base.ncol)], list(base.names))
         for f in frames[1:]:
             for n in f.names:
-                out[n] = f.vec(n)
+                # duplicate names get a suffix (upstream renames too) —
+                # assignment by name would silently OVERWRITE the original
+                name, k = n, 0
+                while name in out.names:
+                    name = f"{n}{k}"
+                    k += 1
+                out[name] = f.vec(n)
         return out
     if op == "rbind":
         import pandas as pd
@@ -523,6 +529,17 @@ def _apply(op: str, raw_args: list, sess: Session):
     if op == "substring":
         v = _as_vec(args[0])
         return OPS.substring(v, int(args[1]), int(args[2]) if len(args) > 2 else None)
+    if op == "cut":
+        # (cut vec [breaks] ['labels'...]|null include_lowest right) — ASTCut
+        v = _as_vec(args[0])
+        breaks = [float(b) for b in np.asarray(args[1]).ravel()]
+        labels = None
+        if len(args) > 2 and args[2] is not None:
+            labels = [str(s) for s in np.asarray(args[2]).ravel()]
+        inc_low = bool(args[3]) if len(args) > 3 else False
+        right = bool(args[4]) if len(args) > 4 else True
+        return OPS.cut(v, breaks, labels=labels, include_lowest=inc_low,
+                       right=right)
     time_ops = {"year": OPS.year, "month": OPS.month, "day": OPS.day,
                 "hour": OPS.hour, "minute": OPS.minute, "second": OPS.second,
                 "dayOfWeek": OPS.day_of_week, "week": OPS.week}

@@ -309,6 +309,150 @@ h2o.impute <- function(fr, column, method = "mean") {
     "(h2o.impute %s '%s' '%s')", .h2o.fref(fr), column, method)))
 }
 
+h2o.group_by <- function(fr, by, ...) {
+  # aggregations as named args: h2o.group_by(fr, "g", mean = "x", nrow = "x")
+  aggs <- list(...)
+  spec <- paste(vapply(seq_along(aggs), function(i) {
+    sprintf("%s '%s' 'all'", names(aggs)[i], aggs[[i]])
+  }, ""), collapse = " ")
+  .h2o.rapids_frame(sprintf("(GB %s %s %s)", .h2o.fref(fr), .h2o.rvec(by), spec))
+}
+
+h2o.cbind <- function(...) {
+  frs <- list(...)
+  .h2o.rapids_frame(sprintf("(cbind %s)",
+                            paste(vapply(frs, .h2o.fref, ""), collapse = " ")))
+}
+
+h2o.rbind <- function(...) {
+  frs <- list(...)
+  .h2o.rapids_frame(sprintf("(rbind %s)",
+                            paste(vapply(frs, .h2o.fref, ""), collapse = " ")))
+}
+
+.h2o.lit <- function(x) {
+  # scalar literal for an AST: strings must be quoted or the evaluator
+  # resolves them as DKV identifiers
+  if (is.character(x)) sprintf("'%s'", x)
+  else if (is.logical(x)) (if (x) "TRUE" else "FALSE")
+  else as.character(x)
+}
+
+h2o.ifelse <- function(fr, col, yes, no) {
+  .h2o.rapids_frame(sprintf("(ifelse (cols %s '%s') %s %s)", .h2o.fref(fr),
+                            col, .h2o.lit(yes), .h2o.lit(no)))
+}
+
+h2o.cut <- function(fr, col, breaks, labels = NULL,
+                    include.lowest = FALSE, right = TRUE) {
+  lab <- if (is.null(labels)) "null" else .h2o.rvec(labels)
+  .h2o.rapids_frame(sprintf("(cut (cols %s '%s') %s %s %s %s)", .h2o.fref(fr),
+                            col, .h2o.rvec(breaks), lab,
+                            if (include.lowest) "TRUE" else "FALSE",
+                            if (right) "TRUE" else "FALSE"))
+}
+
+h2o.scale <- function(fr, center = TRUE, scale = TRUE) {
+  .h2o.rapids_frame(sprintf("(scale %s %s %s)", .h2o.fref(fr),
+                            if (center) "TRUE" else "FALSE",
+                            if (scale) "TRUE" else "FALSE"))
+}
+
+h2o.cor <- function(fr) {
+  .h2o.rapids_frame(sprintf("(cor %s)", .h2o.fref(fr)))
+}
+
+h2o.hist <- function(fr, col, breaks = 20) {
+  # the server takes a bin COUNT (break vectors are not supported on the
+  # wire); a vector here would also vectorize sprintf into a malformed AST
+  stopifnot(is.numeric(breaks), length(breaks) == 1)
+  .h2o.rapids_frame(sprintf("(hist (cols %s '%s') %s)", .h2o.fref(fr), col, breaks))
+}
+
+h2o.levels <- function(fr, col) {
+  # from frame metadata (a structured JSON list), NOT the rapids string
+  # repr — levels containing commas or quotes must round-trip exactly
+  meta <- h2o.getFrame(.h2o.fref(fr))
+  for (c in meta$columns) {
+    if (identical(c$label, col)) return(unlist(c$domain))
+  }
+  stop("no column '", col, "' in frame")
+}
+
+h2o.nlevels <- function(fr, col) length(h2o.levels(fr, col))
+
+h2o.asfactor <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(as.factor (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.asnumeric <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(as.numeric (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.round <- function(fr, col, digits = 0) {
+  .h2o.rapids_frame(sprintf("(round (cols %s '%s') %s)", .h2o.fref(fr), col, digits))
+}
+
+h2o.signif <- function(fr, col, digits = 6) {
+  .h2o.rapids_frame(sprintf("(signif (cols %s '%s') %s)", .h2o.fref(fr), col, digits))
+}
+
+h2o.toupper <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(toupper (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.tolower <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(tolower (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.trim <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(trim (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.nchar <- function(fr, col) {
+  .h2o.rapids_frame(sprintf("(nchar (cols %s '%s'))", .h2o.fref(fr), col))
+}
+
+h2o.gsub <- function(pattern, replacement, fr, col) {
+  .h2o.rapids_frame(sprintf("(gsub '%s' '%s' (cols %s '%s'))", pattern,
+                            replacement, .h2o.fref(fr), col))
+}
+
+h2o.sub <- function(pattern, replacement, fr, col) {
+  .h2o.rapids_frame(sprintf("(sub '%s' '%s' (cols %s '%s'))", pattern,
+                            replacement, .h2o.fref(fr), col))
+}
+
+h2o.substring <- function(fr, col, first, last = NULL) {
+  # R convention is 1-based INCLUSIVE first..last; the wire takes a 0-based
+  # exclusive-end slice, so ship (first-1, last) like upstream's client
+  .h2o.rapids_frame(sprintf("(substring (cols %s '%s') %s%s)", .h2o.fref(fr),
+                            col, first - 1,
+                            if (is.null(last)) "" else paste0(" ", last)))
+}
+
+h2o.year <- function(fr, col) .h2o.time_part("year", fr, col)
+h2o.month <- function(fr, col) .h2o.time_part("month", fr, col)
+h2o.day <- function(fr, col) .h2o.time_part("day", fr, col)
+h2o.hour <- function(fr, col) .h2o.time_part("hour", fr, col)
+h2o.dayOfWeek <- function(fr, col) .h2o.time_part("dayOfWeek", fr, col)
+h2o.week <- function(fr, col) .h2o.time_part("week", fr, col)
+
+.h2o.time_part <- function(part, fr, col) {
+  .h2o.rapids_frame(sprintf("(%s (cols %s '%s'))", part, .h2o.fref(fr), col))
+}
+
+h2o.mean <- function(fr, col) .h2o.col_reduce("mean", fr, col)
+h2o.sum <- function(fr, col) .h2o.col_reduce("sum", fr, col)
+h2o.sd <- function(fr, col) .h2o.col_reduce("sd", fr, col)
+h2o.var <- function(fr, col) .h2o.col_reduce("var", fr, col)
+h2o.median <- function(fr, col) .h2o.col_reduce("median", fr, col)
+
+.h2o.col_reduce <- function(agg, fr, col) {
+  out <- h2o.rapids(sprintf("(%s (cols %s '%s'))", agg, .h2o.fref(fr), col))
+  as.numeric(out$scalar)
+}
+
 # -- frame download / description --------------------------------------------
 
 as.data.frame.H2O3Frame <- function(x, ...) {

@@ -1,0 +1,118 @@
+"""Executable pin for the R client's munging verbs.
+
+No R runtime exists in this image (see test_r_client.py), so the R surface
+is pinned from the other side of the wire: every Rapids AST template that
+``r/h2o3tpu.R``'s munging verbs sprintf together is replayed here through
+the same REST route R uses (POST /99/Rapids), asserting the response carries
+the exact field each R wrapper reads (frame ``key`` / ``scalar`` /
+``string``). A template drift between the R file and the Rapids dialect
+breaks this test, not an R user."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.api.server import start_server
+
+
+@pytest.fixture(scope="module")
+def server():
+    return start_server(port=0)
+
+
+@pytest.fixture(scope="module")
+def fr(server):
+    df = pd.DataFrame(
+        {
+            "g": pd.Categorical(["a", "b", "a", "b", "a"]),
+            "x": [1.0, 2.0, 3.0, 4.0, np.nan],
+            "s": ["Hi", " lo ", "Mid", "X", "y"],
+        }
+    )
+    return h2o3_tpu.upload_file(df, destination_frame="r_ast_fr")
+
+
+def _rapids(server, ast: str) -> dict:
+    req = urllib.request.Request(
+        server.url + "/99/Rapids",
+        data=json.dumps({"ast": ast}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+# (verb, AST exactly as the R wrapper emits it, response field it reads)
+R_VERB_ASTS = [
+    ("h2o.group_by", "(GB r_ast_fr ['g'] mean 'x' 'all' nrow 'x' 'all')", "key"),
+    ("h2o.cbind", "(cbind r_ast_fr r_ast_fr)", "key"),
+    ("h2o.rbind", "(rbind r_ast_fr r_ast_fr)", "key"),
+    ("h2o.ifelse", "(ifelse (cols r_ast_fr 'x') 1 0)", "key"),
+    ("h2o.cut", "(cut (cols r_ast_fr 'x') [0 2 4] null FALSE TRUE)", "key"),
+    ("h2o.cut+labels", "(cut (cols r_ast_fr 'x') [0 2 4] ['lo' 'hi'] TRUE TRUE)", "key"),
+    ("h2o.scale", "(scale r_ast_fr TRUE TRUE)", "key"),
+    ("h2o.cor", "(cor r_ast_fr)", "key"),
+    ("h2o.hist", "(hist (cols r_ast_fr 'x') 4)", "key"),
+    ("h2o.levels", "(levels (cols r_ast_fr 'g'))", "string"),
+    ("h2o.asfactor", "(as.factor (cols r_ast_fr 'x'))", "key"),
+    ("h2o.asnumeric", "(as.numeric (cols r_ast_fr 'g'))", "key"),
+    ("h2o.round", "(round (cols r_ast_fr 'x') 0)", "key"),
+    ("h2o.signif", "(signif (cols r_ast_fr 'x') 2)", "key"),
+    ("h2o.toupper", "(toupper (cols r_ast_fr 's'))", "key"),
+    ("h2o.tolower", "(tolower (cols r_ast_fr 's'))", "key"),
+    ("h2o.trim", "(trim (cols r_ast_fr 's'))", "key"),
+    ("h2o.nchar", "(nchar (cols r_ast_fr 's'))", "key"),
+    ("h2o.gsub", "(gsub 'i' 'I' (cols r_ast_fr 's'))", "key"),
+    ("h2o.sub", "(sub 'i' 'I' (cols r_ast_fr 's'))", "key"),
+    ("h2o.substring", "(substring (cols r_ast_fr 's') 0 2)", "key"),
+    ("h2o.mean", "(mean (cols r_ast_fr 'x'))", "scalar"),
+    ("h2o.sum", "(sum (cols r_ast_fr 'x'))", "scalar"),
+    ("h2o.sd", "(sd (cols r_ast_fr 'x'))", "scalar"),
+    ("h2o.var", "(var (cols r_ast_fr 'x'))", "scalar"),
+    ("h2o.median", "(median (cols r_ast_fr 'x'))", "scalar"),
+]
+
+
+@pytest.mark.parametrize("verb,ast,field", R_VERB_ASTS, ids=[v for v, _, _ in R_VERB_ASTS])
+def test_r_verb_ast(server, fr, verb, ast, field):
+    out = _rapids(server, ast)
+    assert out.get("http_status", 200) < 400, out
+    assert out.get(field) is not None, (verb, ast, out)
+
+
+def test_r_verb_semantics(server, fr):
+    """Spot-check values, not just shape, for a few verbs."""
+    out = _rapids(server, "(mean (cols r_ast_fr 'x'))")
+    assert float(out["scalar"]) == pytest.approx(2.5)
+    out = _rapids(server, "(levels (cols r_ast_fr 'g'))")
+    assert "a" in out["string"] and "b" in out["string"]
+    gb = _rapids(server, "(GB r_ast_fr ['g'] mean 'x' 'all')")
+    key = gb["key"]["name"]
+    fr2 = h2o3_tpu.get_frame(key)
+    got = fr2.to_pandas().sort_values("g")
+    # group a: mean(1,3,nan->skip)=2.0; group b: mean(2,4)=3.0
+    assert got["mean_x"].tolist() == pytest.approx([2.0, 3.0])
+
+
+def test_r_cbind_duplicate_names_suffixed(server, fr):
+    """cbind with overlapping names must WIDEN, not overwrite (h2o.cbind)."""
+    out = _rapids(server, "(cbind r_ast_fr r_ast_fr)")
+    fr2 = h2o3_tpu.get_frame(out["key"]["name"])
+    assert fr2.ncol == 6  # 3 + 3 suffixed, none dropped
+    assert len(set(fr2.names)) == 6
+
+
+def test_r_levels_from_frame_metadata(server, fr):
+    """h2o.levels reads /3/Frames column domains (structured JSON), so
+    levels with commas/quotes survive — pin the metadata shape it reads."""
+    req = urllib.request.Request(server.url + "/3/Frames/r_ast_fr")
+    with urllib.request.urlopen(req) as r:
+        meta = json.loads(r.read())
+    cols = meta["frames"][0]["columns"]
+    dom = next(c["domain"] for c in cols if c["label"] == "g")
+    assert dom == ["a", "b"]
