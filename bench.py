@@ -228,20 +228,22 @@ def _phase_breakdown(fr, n_trees: int, total_s: float, nbins: int = 255) -> tupl
             row_sharding(),
         )
         hist_s += timed(
-            lambda b, n, ww, wwy: build_histograms(b, n, ww, wwy, ww, ww, n_nodes, n_bins),
+            lambda b, n, ww, wwy: build_histograms(
+                b, n, (ww, wwy, ww), n_nodes, n_bins),
             bins_u8,
             nid,
             w,
             wy,
         )
-        # matmul-path issued FLOPs: 4 stats × 2·n·N·(C·B) per level
-        hist_flops += 4 * 2.0 * n_pad * n_nodes * len(cols) * n_bins
+        # matmul-path issued FLOPs: 3 stats x 2*n*N*(C*B) per level (the
+        # wy2 lane was dropped — its gain contribution cancels exactly)
+        hist_flops += 3 * 2.0 * n_pad * n_nodes * len(cols) * n_bins
 
     # split scan at the deepest level's node count (the most expensive one)
     from h2o3_tpu.models.tree.shared_tree import _split_scan
 
     n_nodes = 2 ** (DEPTH - 1)
-    hist = jnp.zeros((n_nodes, len(cols), n_bins, 4), jnp.float32).at[:, :, :, 0].set(1.0)
+    hist = jnp.zeros((n_nodes, len(cols), n_bins, 3), jnp.float32).at[:, :, :, 0].set(1.0)
     split_fn = jax.jit(
         lambda h: _split_scan(
             h,

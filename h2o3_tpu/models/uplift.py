@@ -170,7 +170,7 @@ def _uplift_level_fn(
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool, metric: str,
 ):
     C = bins_u8.shape[1]
-    hist = histogram_in_jit(bins_u8, nid, wt, wyt, wc, wyc, n_pad, n_bins)
+    hist = histogram_in_jit(bins_u8, nid, (wt, wyt, wc, wyc), n_pad, n_bins)
 
     if force_leaf:
         tot = hist[:, 0, :, :].sum(axis=1)

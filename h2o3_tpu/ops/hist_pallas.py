@@ -12,15 +12,21 @@ This kernel never materializes that transient:
 - grid = (node_tiles, col_tiles, row_chunks), row-fastest, so the output
   block for one (node_tile, col_tile) stays resident in VMEM while every row
   chunk accumulates into it;
-- per step, the (R, CT·B) indicator tile and the (R, NT·4) stat-scaled
+- per step, the (R, CT·B) indicator tile and the (R, NT·S) stat-scaled
   node-one-hot are built in VMEM by iota-compare (VPU) and immediately
-  contracted on the MXU — one f32 dot per step, all 4 stats fused into the
+  contracted on the MXU — one f32 dot per step, all S stats fused into the
   M dimension;
 - rows with nid outside the tile (or nid = -1: retired/padding) match no
   one-hot column and contribute zero, so node tiling and row padding need no
   masking anywhere.
 
-Output layout matches the other local paths: (C, n_nodes·n_bins, 4) per
+``S`` (the stat-lane count) is caller-defined: the GBM/DRF path runs S=3
+{w, wy, wh} — the wy² lane of H2O's DHistogram cancels in the gain and
+carrying it would be 33% more MXU work (see shared_tree._split_scan) —
+while uplift trees run their 4 treatment/control lanes. Kernel cost is
+∝ S, so each consumer pays exactly for what it reads.
+
+Output layout matches the other local paths: (C, n_nodes·n_bins, S) per
 shard; the caller (``histogram.histogram_in_jit``) psums across the mesh.
 """
 
@@ -35,14 +41,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 ROW_TILE = 512  # rows per grid step
 COL_TILE = 8  # feature columns per grid step
-NODE_TILE = 64  # tree nodes per grid step (4·NT = 256 M-rows on the MXU)
+NODE_TILE = 64  # tree nodes per grid step (S·NT = 192-256 M-rows on the MXU)
 
 
 def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, *, nt, ct, bpad):
+def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, *, nt, ct, bpad, ns):
     i_nt = pl.program_id(0)
     i_r = pl.program_id(2)
 
@@ -50,12 +56,12 @@ def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, *, nt, ct, bpad):
     # Everything is built directly in 2D with lane-iota arithmetic: Mosaic
     # cannot relayout (R, k, m) → (R, k·m) for small trailing dims.
 
-    # stat-scaled node one-hot, nodes of this tile only: (R, NT·4) with
-    # column j ↦ (node = j//4, stat = j%4)
+    # stat-scaled node one-hot, nodes of this tile only: (R, NT·S) with
+    # column j ↦ (node = j//S, stat = j%S)
     node_base = i_nt * nt
-    node_j = node_base + jax.lax.broadcasted_iota(jnp.int32, (r, nt * 4), 1) // 4
+    node_j = node_base + jax.lax.broadcasted_iota(jnp.int32, (r, nt * ns), 1) // ns
     nid_match = (nid_ref[:] == node_j).astype(jnp.float32)  # (R,1) broadcasts
-    stat_tile = jnp.tile(stats_ref[:], (1, nt))  # (R, NT·4): [s0..s3]×NT
+    stat_tile = jnp.tile(stats_ref[:], (1, nt))  # (R, NT·S): [s0..s_{S-1}]×NT
     a = nid_match * stat_tile
 
     # (R, CT·Bpad) 0/1 bin indicator, lane j ↦ (bin = j//CT, col = j%CT) —
@@ -79,7 +85,7 @@ def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, *, nt, ct, bpad):
         a_hi, e, dims, preferred_element_type=jnp.float32
     ) + jax.lax.dot_general(
         a_lo, e, dims, preferred_element_type=jnp.float32
-    )  # (NT·4, CT·Bpad)
+    )  # (NT·S, CT·Bpad)
 
     @pl.when(i_r == 0)
     def _():
@@ -94,14 +100,16 @@ def _hist_kernel(bins_ref, nid_ref, stats_ref, out_ref, *, nt, ct, bpad):
     jax.jit, static_argnames=("n_nodes", "n_bins", "interpret")
 )
 def hist_pallas_local(
-    bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, interpret: bool = False
+    bins_u8, nid, stats, n_nodes: int, n_bins: int, interpret: bool = False
 ):
-    """Shard-local Pallas histogram: returns (C, n_nodes*n_bins, 4) float32.
+    """Shard-local Pallas histogram: returns (C, n_nodes*n_bins, S) float32.
 
-    Drop-in replacement for ``_hist_matmul_local`` / ``_hist_scatter_local``.
+    ``stats`` is the (n, S) stat matrix (S static from its shape). Drop-in
+    replacement for ``_hist_matmul_local`` / ``_hist_scatter_local``.
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI).
     """
     n, c = bins_u8.shape
+    ns = stats.shape[1]
     nt = min(NODE_TILE, n_nodes)
     ct = min(COL_TILE, c)
     # pad bins axis so the lane dimension CT·Bpad is a multiple of 128
@@ -117,19 +125,15 @@ def hist_pallas_local(
     if npad != n:
         bins_u8 = jnp.pad(bins_u8, ((0, npad - n), (0, 0)))
         nid = jnp.pad(nid, (0, npad - n), constant_values=-1)
-        w = jnp.pad(w, (0, npad - n))
-        wy = jnp.pad(wy, (0, npad - n))
-        wy2 = jnp.pad(wy2, (0, npad - n))
-        wh = jnp.pad(wh, (0, npad - n))
+        stats = jnp.pad(stats, ((0, npad - n), (0, 0)))
     if cpad != c:
         bins_u8 = jnp.pad(bins_u8, ((0, 0), (0, cpad - c)))
     # (npad, cpad) → (n_ct, npad, CT): each grid step's column tile is the
     # (full) last dim of its block, satisfying Mosaic's lane-divisibility rule
     bins3 = jnp.transpose(bins_u8.reshape(npad, n_ct, ct), (1, 0, 2))
-    stats = jnp.stack([w, wy, wy2, wh], axis=1)  # (npad, 4)
     nid2 = nid.reshape(npad, 1)
 
-    kernel = functools.partial(_hist_kernel, nt=nt, ct=ct, bpad=bpad)
+    kernel = functools.partial(_hist_kernel, nt=nt, ct=ct, bpad=bpad, ns=ns)
     out = pl.pallas_call(
         kernel,
         grid=(n_nt, n_ct, n_r),
@@ -143,25 +147,25 @@ def hist_pallas_local(
                 (ROW_TILE, 1), lambda nt_, ct_, r_: (r_, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (ROW_TILE, 4), lambda nt_, ct_, r_: (r_, 0), memory_space=pltpu.VMEM
+                (ROW_TILE, ns), lambda nt_, ct_, r_: (r_, 0), memory_space=pltpu.VMEM
             ),
         ],
         out_specs=pl.BlockSpec(
-            (nt * 4, ct * bpad), lambda nt_, ct_, r_: (nt_, ct_), memory_space=pltpu.VMEM
+            (nt * ns, ct * bpad), lambda nt_, ct_, r_: (nt_, ct_), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n_nt * nt * 4, cpad * bpad), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_nt * nt * ns, cpad * bpad), jnp.float32),
         cost_estimate=pl.CostEstimate(
-            flops=int(2 * npad * (nt * 4) * cpad * bpad),
+            flops=int(2 * npad * (nt * ns) * cpad * bpad),
             bytes_accessed=int(
-                npad * cpad + npad * (4 + 1) * 4 + n_nt * nt * 4 * cpad * bpad * 4
+                npad * cpad + npad * (ns + 1) * 4 + n_nt * nt * ns * cpad * bpad * 4
             ),
             transcendentals=0,
         ),
         interpret=interpret,
     )(bins3, nid2, stats)
 
-    # unscramble: out rows = node·4+stat, lanes = ct-tile-major [bin//CT, col%CT]
-    h5 = out.reshape(n_nt * nt, 4, n_ct, bpad, ct)
-    h5 = jnp.transpose(h5, (2, 4, 0, 3, 1))  # (n_ct, ct, Npad, Bpad, 4)
-    h = h5.reshape(cpad, n_nt * nt, bpad, 4)[:c, :n_nodes, :n_bins, :]
-    return h.reshape(c, n_nodes * n_bins, 4)
+    # unscramble: out rows = node·S+stat, lanes = ct-tile-major [bin//CT, col%CT]
+    h5 = out.reshape(n_nt * nt, ns, n_ct, bpad, ct)
+    h5 = jnp.transpose(h5, (2, 4, 0, 3, 1))  # (n_ct, ct, Npad, Bpad, S)
+    h = h5.reshape(cpad, n_nt * nt, bpad, ns)[:c, :n_nodes, :n_bins, :]
+    return h.reshape(c, n_nodes * n_bins, ns)

@@ -3,14 +3,18 @@
 replacement for the bundled XGBoost ``gpu_hist`` CUDA builder (§2.4).
 
 The hot loop of tree building: for every row, look up its current leaf
-``nid`` and scatter its {w, wy, wy², wh} stats into (node, col, bin) cells;
-reduce across row shards. Mapping:
+``nid`` and scatter its per-stat values into (node, col, bin) cells; reduce
+across row shards. Mapping:
 
 - H2O's per-chunk fork-join map + pairwise reduce → per-device scatter-add
   + ``psum`` over the rows mesh axis (via ``shard_map``).
-- Stats follow H2O's DHistogram ({Σw, Σwy, Σwy²} for split gain) plus Σwh
-  (Newton denominator, the GammaPass numerator/denominator generalization)
-  so distribution-specific leaf values come from the same pass.
+- The stat lanes are CALLER-DEFINED (``stats`` is a tuple of (n,) arrays):
+  the GBM/DRF path passes {w, wy, wh} — 3 lanes, because the wy² term of
+  H2O's DHistogram squared-error gain cancels exactly across
+  parent−left−right and carrying it would be 33% more MXU/HBM work for a
+  constant offset (see shared_tree._split_scan) — while uplift trees pass
+  their 4 treatment/control lanes. Histogram cost is ∝ lanes, so every
+  consumer pays exactly for what it reads.
 
 Two device implementations, auto-selected by backend:
 - scatter path (CPU mesh): one `.at[].add` scatter per column (vmapped) —
@@ -24,9 +28,8 @@ Two device implementations, auto-selected by backend:
   Inactive rows (nid<0) match no one-hot column and vanish automatically.
   Inputs stay float32 (bf16 would quantize the gradient stats the split
   gains are computed from); XLA runs f32 dots as multi-pass bf16 on the MXU.
-  This is the ScoreBuildHistogram→TPU redesign the north star asks for; a
-  Pallas kernel that fuses the indicator construction into the dot is the
-  planned next step.
+  This is the ScoreBuildHistogram→TPU redesign the north star asks for; the
+  Pallas kernel (hist_pallas.py) fuses the indicator build into the dot.
 
 ``histogram_in_jit`` is the primary entry: a pure traced function usable
 inside a larger jitted program (the tree level step), so histogram + split
@@ -43,41 +46,31 @@ from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
 
-STATS = 4  # w, wy, wy2, wh
-
-
 # Rows per scatter chunk: XLA materializes the vmapped scatter's updates as
-# a (C, chunk, 4) f32 broadcast (~1.2 KB/row at C=28 — measured 13.4 GB temp
-# for the whole 10M-row tree program before chunking). 256k rows bounds the
-# transient at ~115 MB; shards at or under the chunk take the single-chunk
-# path, bit-identical to the unchunked original.
+# a (C, chunk, S) f32 broadcast (~1.2 KB/row at C=28, S=4 — measured 13.4 GB
+# temp for the whole 10M-row tree program before chunking). 256k rows bounds
+# the transient at ~115 MB; shards at or under the chunk take the
+# single-chunk path, bit-identical to the unchunked original.
 _SCATTER_ROW_CHUNK = 262_144
 
 
-def _hist_scatter_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
-    """Device-local scatter histogram: (C, n_nodes*n_bins, 4).
+def _hist_scatter_local(bins_u8, nid, stats, n_nodes: int, n_bins: int):
+    """Device-local scatter histogram: (C, n_nodes*n_bins, S).
 
-    Rows with nid < 0 (finalized leaves / padding) contribute via w=0.
+    Rows with nid < 0 (finalized leaves / padding) MUST arrive with zeroed
+    stats (``histogram_in_jit`` masks them): the scatter clamps their nid
+    to 0 and a nonzero stat would pollute node 0.
     """
-    active = nid >= 0
-    nid_safe = jnp.where(active, nid, 0)
-    stats = jnp.stack(
-        [
-            jnp.where(active, w, 0.0),
-            jnp.where(active, wy, 0.0),
-            jnp.where(active, wy2, 0.0),
-            jnp.where(active, wh, 0.0),
-        ],
-        axis=1,
-    )  # (n, 4)
+    S = stats.shape[1]
+    nid_safe = jnp.maximum(nid, 0)
 
     def scatter_chunk(bins_c, nid_c, stats_c):
         def one_col(col):
             idx = nid_c * n_bins + col.astype(jnp.int32)
-            out = jnp.zeros((n_nodes * n_bins, STATS), jnp.float32)
+            out = jnp.zeros((n_nodes * n_bins, S), jnp.float32)
             return out.at[idx].add(stats_c)
 
-        return jax.vmap(one_col, in_axes=1)(bins_c)  # (C, n_nodes*n_bins, 4)
+        return jax.vmap(one_col, in_axes=1)(bins_c)  # (C, n_nodes*n_bins, S)
 
     n, C = bins_u8.shape
     if n <= _SCATTER_ROW_CHUNK:
@@ -94,14 +87,14 @@ def _hist_scatter_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int)
     def body(acc, args):
         return acc + scatter_chunk(*args), None
 
-    acc0 = jnp.zeros((C, n_nodes * n_bins, STATS), jnp.float32)
+    acc0 = jnp.zeros((C, n_nodes * n_bins, S), jnp.float32)
     acc, _ = jax.lax.scan(
         body,
         acc0,
         (
             bins_u8.reshape(nchunks, chunk, C),
             nid_safe.reshape(nchunks, chunk),
-            stats.reshape(nchunks, chunk, STATS),
+            stats.reshape(nchunks, chunk, S),
         ),
     )
     return acc
@@ -121,10 +114,10 @@ def _select_local():
     if config.get("H2O3_TPU_HIST") == "matmul":
         return _hist_matmul_local
 
-    def pallas_local(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins):
+    def pallas_local(bins_u8, nid, stats, n_nodes, n_bins):
         from h2o3_tpu.ops.hist_pallas import hist_pallas_local
 
-        return hist_pallas_local(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins)
+        return hist_pallas_local(bins_u8, nid, stats, n_nodes, n_bins)
 
     return pallas_local
 
@@ -132,20 +125,20 @@ def _select_local():
 _ROW_CHUNK = 8192  # rows per matmul chunk: (chunk, C*B) transient ≤ ~120MB
 
 
-def _hist_matmul_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
-    """MXU histogram for one shard: returns (C, n_nodes*n_bins, 4)."""
+def _hist_matmul_local(bins_u8, nid, stats, n_nodes: int, n_bins: int):
+    """MXU histogram for one shard: returns (C, n_nodes*n_bins, S)."""
     n, C = bins_u8.shape
+    S = stats.shape[1]
     chunk = min(_ROW_CHUNK, n)
     nchunks = -(-n // chunk)
     pad = nchunks * chunk - n
-    stats = jnp.stack([w, wy, wy2, wh], axis=1)  # (n, 4)
     if pad:
         bins_u8 = jnp.pad(bins_u8, ((0, pad), (0, 0)))
         nid = jnp.pad(nid, (0, pad), constant_values=-1)
         stats = jnp.pad(stats, ((0, pad), (0, 0)))
     bins_ch = bins_u8.reshape(nchunks, chunk, C)
     nid_ch = nid.reshape(nchunks, chunk)
-    stats_ch = stats.reshape(nchunks, chunk, STATS)
+    stats_ch = stats.reshape(nchunks, chunk, S)
 
     iota_nodes = jnp.arange(n_nodes, dtype=jnp.int32)
 
@@ -159,7 +152,7 @@ def _hist_matmul_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
         ).astype(jnp.float32).reshape(chunk, C * n_bins)
         # per-stat scaled nid one-hot (chunk,N) @ indicator (chunk, C*B)
         outs = []
-        for s in range(STATS):
+        for s in range(S):
             A = oh_nid * s_c[:, s : s + 1]
             outs.append(
                 jax.lax.dot_general(
@@ -171,24 +164,30 @@ def _hist_matmul_local(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
             )  # (N, C*B)
         return acc + jnp.stack(outs, axis=-1), None
 
-    acc0 = jnp.zeros((n_nodes, C * n_bins, STATS), jnp.float32)
+    acc0 = jnp.zeros((n_nodes, C * n_bins, S), jnp.float32)
     acc, _ = jax.lax.scan(body, acc0, (bins_ch, nid_ch, stats_ch))
-    # (N, C*B, 4) -> (C, N*B, 4) to match the scatter path's layout
-    h = acc.reshape(n_nodes, C, n_bins, STATS)
-    return jnp.transpose(h, (1, 0, 2, 3)).reshape(C, n_nodes * n_bins, STATS)
+    # (N, C*B, S) -> (C, N*B, S) to match the scatter path's layout
+    h = acc.reshape(n_nodes, C, n_bins, S)
+    return jnp.transpose(h, (1, 0, 2, 3)).reshape(C, n_nodes * n_bins, S)
 
 
-def histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, mesh=None):
+def histogram_in_jit(bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh=None):
     """Cross-device histogram, traceable inside a jitted program.
 
-    Returns (n_nodes, C, n_bins, 4), replicated across the mesh.
+    ``stats`` is a TUPLE of (n,) row-sharded arrays — the stat lanes.
+    Returns (n_nodes, C, n_bins, S), replicated across the mesh.
     """
     mesh = mesh or get_mesh()
     local = _select_local()
+    S = len(stats)
 
-    def body(b, n, w_, wy_, wy2_, wh_):
-        h = local(b, n, w_, wy_, wy2_, wh_, n_nodes, n_bins)
+    def body(b, n, s):
+        # retired/padding rows (nid < 0) carry zero stats into every impl
+        s = jnp.where((n >= 0)[:, None], s, 0.0)
+        h = local(b, n, s, n_nodes, n_bins)
         return jax.lax.psum(h, ROWS_AXIS)
+
+    smat = jnp.stack(list(stats), axis=1)  # (n, S)
 
     # ph_hist: phase tag consumed by tools/profile_fused.py (HLO op_name
     # metadata carries the scope path into the profiler trace)
@@ -196,17 +195,17 @@ def histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int, me
         h = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(ROWS_AXIS),) * 6,
+            in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
             out_specs=P(),
             check_vma=False,
-        )(bins_u8, nid, w, wy, wy2, wh)  # (C, n_nodes*n_bins, 4)
+        )(bins_u8, nid, smat)  # (C, n_nodes*n_bins, S)
         C = h.shape[0]
         return jnp.transpose(
-            h.reshape(C, n_nodes, n_bins, STATS), (1, 0, 2, 3)
-        )  # (n_nodes, C, n_bins, 4)
+            h.reshape(C, n_nodes, n_bins, S), (1, 0, 2, 3)
+        )  # (n_nodes, C, n_bins, S)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
-def build_histograms(bins_u8, nid, w, wy, wy2, wh, n_nodes: int, n_bins: int):
+def build_histograms(bins_u8, nid, stats, n_nodes: int, n_bins: int):
     """Standalone jitted histogram (kept for tests / direct use)."""
-    return histogram_in_jit(bins_u8, nid, w, wy, wy2, wh, n_nodes, n_bins)
+    return histogram_in_jit(bins_u8, nid, stats, n_nodes, n_bins)

@@ -54,8 +54,9 @@ def main() -> None:
                         rng.integers(0, n_nodes, n).astype(np.int32)
                     )
                     try:
+                        stats = jnp.stack([w, wy, w], 1)  # 3-lane GBM shape
                         fn = lambda: hist_pallas.hist_pallas_local(
-                            bins, nid, w, wy, wy, w, n_nodes, n_bins
+                            bins, nid, stats, n_nodes, n_bins
                         )
                         out = fn()
                         jax.block_until_ready(out)

@@ -67,7 +67,7 @@ def main() -> None:
 
         fn = jax.jit(
             lambda b, i, w_, wy_: histogram_in_jit(
-                b, i, w_, wy_, w_, w_, N_NODES, N_BINS, mesh=mesh
+                b, i, (w_, wy_, w_), N_NODES, N_BINS, mesh=mesh
             )
         )
         def timed(f, *a, reps=5):
@@ -88,7 +88,8 @@ def main() -> None:
         local = _select_local()
         loc_fn = jax.jit(
             jax.shard_map(
-                lambda b, i, w_, wy_: local(b, i, w_, wy_, w_, w_, N_NODES, N_BINS),
+                lambda b, i, w_, wy_: local(
+                    b, i, jnp.stack([w_, wy_, w_], 1), N_NODES, N_BINS),
                 mesh=mesh,
                 in_specs=(P("rows"),) * 4,
                 out_specs=P("rows"),
