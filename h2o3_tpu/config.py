@@ -25,6 +25,9 @@ import os
 _KNOBS: dict[str, tuple[str, str]] = {
     # name -> (default, doc)
     "H2O3_TPU_NATIVE": ("1", "C++ scoring runtime on (1) / off (0)"),
+    "H2O3_TPU_NATIVE_PARSE": (
+        "1", "native chunked CSV parser fast path on (1) / off (0); files "
+             "outside the strict dialect always fall back to pandas"),
     "H2O3_TPU_HIST": ("", "histogram impl override: '' auto, 'matmul' forces XLA"),
     "H2O3_TPU_HIST_SUBTRACT": (
         "1", "fused tree builder: build lighter child's histogram, derive "

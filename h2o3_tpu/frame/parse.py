@@ -55,9 +55,93 @@ def _read_any(
         df.insert(0, "target", y)
         return df
     # CSV / TSV / txt (+ .gz transparently via pandas)
-    return pd.read_csv(
-        path, sep=sep or _sniff_sep(path), header=header, engine="c", nrows=nrows
+    sep = sep or _sniff_sep(path)
+    if header == 0 and nrows is None:
+        from h2o3_tpu import config
+
+        if config.get_bool("H2O3_TPU_NATIVE_PARSE"):
+            df = _try_native_csv(path, sep)
+            if df is not None:
+                return df
+    return pd.read_csv(path, sep=sep, header=header, engine="c", nrows=nrows)
+
+
+def _try_native_csv(path: str, sep: str) -> pd.DataFrame | None:
+    """Native chunked-parse fast path (native/fastcsv.cpp via native_csv.py)
+    — the ParseDataset tokenizer analog. Returns None whenever the file is
+    outside the strict fast path, and the caller uses pandas: eligibility
+    is decided from a 2000-row pandas sample so both paths agree on types.
+
+    Known value-semantics deviation (documented): a column whose sampled
+    rows are integers narrows to int64 iff the FULL column is NA-free and
+    integral-valued — a decimal-formatted integral value ("2.0") past the
+    sample keeps it int where pandas would flip the dtype to float. H2O
+    types by value, so this is the upstream-faithful choice.
+    """
+    import gzip
+    import io
+
+    from h2o3_tpu import native_csv
+
+    if not native_csv.available():
+        return None
+    opener = (lambda: gzip.open(path, "rb")) if path.endswith(".gz") else (
+        lambda: open(path, "rb")
     )
+    try:
+        # eligibility from a BOUNDED prefix first — an ineligible multi-GB
+        # file must not be slurped (and then re-read by pandas anyway)
+        with opener() as f:
+            prefix = f.read(4 << 20)
+        if len(prefix) == (4 << 20):
+            # likely truncated mid-line: drop the partial last line so it
+            # cannot poison the dtype sniff
+            cut = prefix.rfind(b"\n")
+            if cut < 0:
+                return None
+            prefix = prefix[: cut + 1]
+        sample = pd.read_csv(io.BytesIO(prefix), sep=sep, nrows=2000, engine="c")
+    except Exception:  # noqa: BLE001 — any sniff trouble means pandas decides
+        return None
+    names = [str(c) for c in sample.columns]
+    if len(set(names)) != len(names):
+        return None  # duplicate headers: pandas mangles, we won't guess
+    kinds: list[int] = []
+    int_named = []
+    for c in sample.columns:
+        s = sample[c]
+        if pd.api.types.is_bool_dtype(s):
+            return None  # pandas bool semantics
+        if pd.api.types.is_integer_dtype(s):
+            kinds.append(0)
+            int_named.append(str(c))
+        elif pd.api.types.is_float_dtype(s):
+            kinds.append(0)
+        elif (
+            pd.api.types.is_object_dtype(s) or pd.api.types.is_string_dtype(s)
+        ) and infer_kind(s) == CAT:
+            # string-ish AND sniffed as enum (pandas ≥2 infers 'str' dtype,
+            # not object, for string columns)
+            kinds.append(1)
+        else:
+            # datetime / TIME-ish / STR / mixed: pandas semantics
+            return None
+    try:
+        with opener() as f:
+            data = f.read()
+        df = native_csv.parse_csv_native(data, names, kinds, sep=sep)
+    except Exception:  # noqa: BLE001 — ANY native trouble means pandas decides
+        return None
+    if df is None:
+        return None
+    for c in int_named:
+        v = df[c].to_numpy()
+        if np.any(np.abs(v) >= 2**53):
+            # f64 already rounded these — only pandas' int64 path is exact
+            return None
+        if not np.isnan(v).any() and np.all(v == np.floor(v)):
+            df[c] = v.astype(np.int64)
+    return df
 
 
 def _sniff_sep(path: str) -> str:

@@ -1,0 +1,159 @@
+"""ctypes binding + build driver for the native chunked CSV parser
+(``native/fastcsv.cpp``) — the ParseDataset tokenizer analog (SURVEY.md
+§2.1: upstream's parser tokenizes/coerces chunks in parallel native code).
+
+Same auto-build contract as :mod:`h2o3_tpu.native` (tmojo): g++ on first
+use, atomic publish, graceful degradation — ``parse_csv_native`` returns
+None whenever the file is outside the fast path (quoted fields, type
+surprises, no compiler) and the caller falls back to pandas, so behavior
+never diverges, only speed.
+
+Fast-path contract (enforced in C, rc < 0 on violation):
+single-char sep, no double quotes anywhere, columns pre-typed from the
+caller's sample as numeric or enum, NA spellings EXACTLY pandas' default
+na_values set (see kNA in fastcsv.cpp), blank lines skipped like pandas.
+Ragged rows or a non-numeric token in a numeric column bail to pandas
+rather than re-implementing pandas' type-flip semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_BUILD_FAILED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "fastcsv.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libfastcsv.so")
+
+_F64P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _build() -> str | None:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode == 0:
+            os.replace(tmp, _SO)
+            return _SO
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return None
+
+
+def _lib() -> ctypes.CDLL | None:
+    global _LIB, _BUILD_FAILED
+    with _LOCK:
+        if _LIB is not None or _BUILD_FAILED:
+            return _LIB
+        so = _build()
+        if so is None:
+            _BUILD_FAILED = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.fastcsv_parse.restype = ctypes.c_void_p
+        lib.fastcsv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int,
+            ctypes.c_int, _I32P, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.fastcsv_nrows.restype = ctypes.c_int64
+        lib.fastcsv_nrows.argtypes = [ctypes.c_void_p]
+        lib.fastcsv_get_numeric.argtypes = [ctypes.c_void_p, ctypes.c_int, _F64P]
+        lib.fastcsv_get_codes.argtypes = [ctypes.c_void_p, ctypes.c_int, _I32P]
+        lib.fastcsv_domain_size.restype = ctypes.c_int64
+        lib.fastcsv_domain_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.fastcsv_domain_bytes.restype = ctypes.c_int64
+        lib.fastcsv_domain_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.fastcsv_get_domain.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                           ctypes.c_char_p]
+        lib.fastcsv_free.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def parse_csv_native(data: bytes, names: list[str], kinds: list[int],
+                     sep: str = ",", has_header: bool = True,
+                     n_threads: int | None = None):
+    """Parse a CSV byte buffer with pre-typed columns.
+
+    ``kinds[i]``: 0 numeric (float64 out), 1 enum (codes + domain out).
+    Returns a pandas DataFrame (numeric columns as float64 — callers
+    integral-narrow afterwards if needed; enum columns as Categorical with
+    SORTED categories, matching the pandas path's sorted-level interning),
+    or None when the buffer is outside the fast path. Non-UTF-8 level
+    bytes return None too — the pandas path then raises its own decode
+    error, keeping error behavior identical.
+    """
+    import pandas as pd
+
+    lib = _lib()
+    if lib is None or len(sep) != 1:
+        return None
+    kinds_arr = np.asarray(kinds, np.int32)
+    rc = ctypes.c_int(0)
+    if n_threads is None:
+        n_threads = min(max(os.cpu_count() or 1, 1), 16)
+    h = lib.fastcsv_parse(
+        data, len(data), sep.encode()[0], int(has_header), len(names),
+        kinds_arr, n_threads, ctypes.byref(rc),
+    )
+    if not h:
+        return None  # rc tells why; every reason means "use pandas"
+    try:
+        n = lib.fastcsv_nrows(h)
+        cols = {}
+        for i, name in enumerate(names):
+            if kinds[i] == 0:
+                out = np.empty(n, np.float64)
+                if n:
+                    lib.fastcsv_get_numeric(h, i, out)
+                cols[name] = out
+            else:
+                codes = np.empty(n, np.int32)
+                if n:
+                    lib.fastcsv_get_codes(h, i, codes)
+                nbytes = lib.fastcsv_domain_bytes(h, i)
+                buf = ctypes.create_string_buffer(int(nbytes) or 1)
+                lib.fastcsv_get_domain(h, i, buf)
+                raw = buf.raw[: int(nbytes)]
+                try:
+                    domain = raw.decode("utf-8").split("\n")[:-1]
+                except UnicodeDecodeError:
+                    return None  # pandas raises the canonical error
+                # sort levels + remap codes: the pandas path interns object
+                # levels in SORTED order and Vec domains must not depend on
+                # which parser ran
+                order = np.argsort(np.asarray(domain, object), kind="stable")
+                remap = np.empty(len(domain) + 1, np.int32)
+                remap[order] = np.arange(len(domain), dtype=np.int32)
+                remap[-1] = -1  # NA slot
+                codes = remap[codes]
+                domain = [domain[j] for j in order]
+                cols[name] = pd.Categorical.from_codes(
+                    codes, categories=pd.Index(domain, dtype=object)
+                )
+        return pd.DataFrame(cols)
+    finally:
+        lib.fastcsv_free(h)

@@ -1,0 +1,267 @@
+// Native chunked CSV parser — the ParseDataset tokenizer analog
+// (SURVEY.md §2.1: upstream's parser is a native multi-chunk subsystem;
+// here the chunk-parallel tokenize/coerce stage runs in C++ threads and
+// Python keeps orchestration, type setup and every non-fast-path format).
+//
+// Scope (the FAST path; anything outside it returns an error and the
+// caller falls back to the pandas reader, so behavior never diverges):
+//   - single-char separator, no quoted fields (a '"' anywhere bails)
+//   - columns pre-typed by the caller's sample: numeric (f64 out) or enum
+//     (int32 codes + interned domain out)
+//   - NA = empty field / NA / N/A / nan / NaN / null / NULL
+//   - ragged rows or a numeric-parse failure bail (rc < 0) rather than
+//     guess — parity with pandas' column-type flip is handled by falling
+//     back, not by re-implementing it
+//
+// Parallel design mirrors upstream's chunk scheme: the buffer splits into
+// T byte-ranges aligned to row boundaries; each thread tokenizes and
+// type-coerces its range into private buffers (per-thread enum intern
+// maps); a merge phase remaps thread-local enum codes onto the global
+// domain (first-seen order, like upstream's categorical interning) and
+// concatenates columns in row order.
+
+#include <atomic>
+#include <cmath>
+#include <charconv>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ColChunk {
+  std::vector<double> nums;          // numeric column slice
+  std::vector<int32_t> codes;        // enum column slice (thread-local ids)
+};
+
+struct ThreadChunk {
+  std::vector<ColChunk> cols;
+  std::vector<std::string> local_domains;  // flattened per enum col below
+  // per enum col: thread-local id -> level string
+  std::vector<std::vector<std::string>> domains;
+  int64_t rows = 0;
+  int error = 0;  // 1 ragged, 2 numeric parse failure
+};
+
+struct Parsed {
+  int ncols = 0;
+  int64_t nrows = 0;
+  std::vector<int> kinds;  // 0 numeric, 1 enum
+  std::vector<std::vector<double>> nums;
+  std::vector<std::vector<int32_t>> codes;
+  std::vector<std::vector<std::string>> domains;
+};
+
+// EXACTLY pandas' default na_values set — the two paths must agree on
+// what is NA, or enum columns silently diverge (e.g. pandas treats 'None'
+// as NA but NOT 'NAN').
+inline bool is_na(const char* b, size_t n) {
+  if (n == 0) return true;
+  static const char* kNA[] = {
+      "#N/A", "#N/A N/A", "#NA", "-1.#IND", "-1.#QNAN", "-NaN", "-nan",
+      "1.#IND", "1.#QNAN", "<NA>", "N/A", "NA", "NULL", "NaN", "None",
+      "n/a", "nan", "null",
+  };
+  for (const char* cand : kNA) {
+    size_t cn = std::strlen(cand);
+    if (cn == n && !std::memcmp(b, cand, n)) return true;
+  }
+  return false;
+}
+
+// trim spaces and a trailing \r (pandas default skipinitialspace=False
+// keeps interior spaces; we trim only the \r plus fully-blank fields)
+inline void trim_cr(const char*& b, size_t& n) {
+  if (n && b[n - 1] == '\r') --n;
+}
+
+void parse_range(const char* buf, int64_t begin, int64_t end, char sep,
+                 int ncols, const int* kinds, ThreadChunk* out) {
+  out->cols.resize(ncols);
+  out->domains.resize(ncols);
+  std::vector<std::unordered_map<std::string, int32_t>> intern(ncols);
+  int64_t pos = begin;
+  while (pos < end) {
+    int64_t eol = pos;
+    while (eol < end && buf[eol] != '\n') ++eol;
+    // blank lines are SKIPPED (pandas skip_blank_lines=True default)
+    if (eol == pos || (eol == pos + 1 && buf[pos] == '\r')) {
+      pos = eol + 1;
+      continue;
+    }
+    // tokenize one row
+    int col = 0;
+    int64_t f0 = pos;
+    for (int64_t i = pos; i <= eol && col < ncols + 1; ++i) {
+      const bool at_end = (i == eol);
+      if (at_end || buf[i] == sep) {
+        if (col >= ncols) { out->error = 1; return; }
+        const char* fb = buf + f0;
+        size_t fn = static_cast<size_t>(i - f0);
+        trim_cr(fb, fn);
+        if (kinds[col] == 0) {
+          double v;
+          if (is_na(fb, fn)) {
+            v = std::nan("");
+          } else {
+            auto [p, ec] = std::from_chars(fb, fb + fn, v);
+            if (ec != std::errc() || p != fb + fn) {
+              // tolerate leading '+' which from_chars rejects
+              if (fn > 1 && fb[0] == '+') {
+                auto [p2, ec2] = std::from_chars(fb + 1, fb + fn, v);
+                if (ec2 != std::errc() || p2 != fb + fn) { out->error = 2; return; }
+              } else { out->error = 2; return; }
+            }
+          }
+          out->cols[col].nums.push_back(v);
+        } else {
+          if (is_na(fb, fn)) {
+            out->cols[col].codes.push_back(-1);
+          } else {
+            std::string key(fb, fn);
+            auto it = intern[col].find(key);
+            int32_t id;
+            if (it == intern[col].end()) {
+              id = static_cast<int32_t>(out->domains[col].size());
+              intern[col].emplace(std::move(key), id);
+              out->domains[col].push_back(std::string(fb, fn));
+            } else {
+              id = it->second;
+            }
+            out->cols[col].codes.push_back(id);
+          }
+        }
+        ++col;
+        f0 = i + 1;
+      }
+    }
+    if (col != ncols) { out->error = 1; return; }
+    ++out->rows;
+    pos = eol + 1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the whole buffer. Returns an opaque handle (call fastcsv_free), or
+// nullptr with *rc set: -1 quote found, -2 ragged row, -3 numeric parse
+// failure, -4 bad args.
+void* fastcsv_parse(const char* buf, int64_t len, char sep, int skip_header,
+                    int ncols, const int* kinds, int n_threads, int* rc) {
+  *rc = 0;
+  if (ncols <= 0 || len < 0) { *rc = -4; return nullptr; }
+  if (std::memchr(buf, '"', static_cast<size_t>(len)) != nullptr) {
+    *rc = -1;  // quoted dialect -> pandas
+    return nullptr;
+  }
+  int64_t begin = 0;
+  if (skip_header) {
+    while (begin < len && buf[begin] != '\n') ++begin;
+    if (begin < len) ++begin;
+  }
+  if (n_threads < 1) n_threads = 1;
+  // split on row boundaries
+  std::vector<int64_t> starts{begin};
+  for (int t = 1; t < n_threads; ++t) {
+    int64_t p = begin + (len - begin) * t / n_threads;
+    while (p < len && buf[p] != '\n') ++p;
+    if (p < len) ++p;
+    starts.push_back(p);
+  }
+  starts.push_back(len);
+
+  std::vector<ThreadChunk> chunks(n_threads);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) {
+    threads.emplace_back(parse_range, buf, starts[t], starts[t + 1], sep,
+                         ncols, kinds, &chunks[t]);
+  }
+  for (auto& th : threads) th.join();
+  for (auto& c : chunks) {
+    if (c.error) { *rc = c.error == 1 ? -2 : -3; return nullptr; }
+  }
+
+  auto* out = new Parsed();
+  out->ncols = ncols;
+  out->kinds.assign(kinds, kinds + ncols);
+  out->nums.resize(ncols);
+  out->codes.resize(ncols);
+  out->domains.resize(ncols);
+  for (auto& c : chunks) out->nrows += c.rows;
+
+  for (int col = 0; col < ncols; ++col) {
+    if (kinds[col] == 0) {
+      auto& dst = out->nums[col];
+      dst.reserve(static_cast<size_t>(out->nrows));
+      for (auto& c : chunks)
+        dst.insert(dst.end(), c.cols[col].nums.begin(), c.cols[col].nums.end());
+    } else {
+      // merge thread-local domains in thread order (== first-seen row
+      // order within each chunk; global order is deterministic for a
+      // given buffer + thread count)
+      std::unordered_map<std::string, int32_t> global;
+      auto& dom = out->domains[col];
+      auto& dst = out->codes[col];
+      dst.reserve(static_cast<size_t>(out->nrows));
+      for (auto& c : chunks) {
+        std::vector<int32_t> remap(c.domains[col].size());
+        for (size_t i = 0; i < c.domains[col].size(); ++i) {
+          auto it = global.find(c.domains[col][i]);
+          if (it == global.end()) {
+            int32_t id = static_cast<int32_t>(dom.size());
+            global.emplace(c.domains[col][i], id);
+            dom.push_back(c.domains[col][i]);
+            remap[i] = id;
+          } else {
+            remap[i] = it->second;
+          }
+        }
+        for (int32_t code : c.cols[col].codes)
+          dst.push_back(code < 0 ? -1 : remap[static_cast<size_t>(code)]);
+      }
+    }
+  }
+  return out;
+}
+
+int64_t fastcsv_nrows(void* h) { return static_cast<Parsed*>(h)->nrows; }
+
+void fastcsv_get_numeric(void* h, int col, double* out) {
+  auto* p = static_cast<Parsed*>(h);
+  std::memcpy(out, p->nums[col].data(), p->nums[col].size() * sizeof(double));
+}
+
+void fastcsv_get_codes(void* h, int col, int32_t* out) {
+  auto* p = static_cast<Parsed*>(h);
+  std::memcpy(out, p->codes[col].data(), p->codes[col].size() * sizeof(int32_t));
+}
+
+int64_t fastcsv_domain_size(void* h, int col) {
+  return static_cast<int64_t>(static_cast<Parsed*>(h)->domains[col].size());
+}
+
+// total bytes needed for the \n-joined domain blob of one column
+int64_t fastcsv_domain_bytes(void* h, int col) {
+  auto* p = static_cast<Parsed*>(h);
+  int64_t total = 0;
+  for (auto& s : p->domains[col]) total += static_cast<int64_t>(s.size()) + 1;
+  return total;
+}
+
+void fastcsv_get_domain(void* h, int col, char* out) {
+  auto* p = static_cast<Parsed*>(h);
+  for (auto& s : p->domains[col]) {
+    std::memcpy(out, s.data(), s.size());
+    out += s.size();
+    *out++ = '\n';
+  }
+}
+
+void fastcsv_free(void* h) { delete static_cast<Parsed*>(h); }
+
+}  // extern "C"

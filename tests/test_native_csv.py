@@ -1,0 +1,183 @@
+"""Native chunked CSV parser (native/fastcsv.cpp + native_csv.py) — the
+ParseDataset tokenizer analog (SURVEY §2.1). Contract under test: the fast
+path is bit-exact against the correctly-rounded reference parse, and EVERY
+out-of-dialect input falls back to pandas (returns None) instead of
+guessing."""
+
+import gzip
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu import native_csv
+from h2o3_tpu.frame import parse as P
+
+pytestmark = pytest.mark.skipif(
+    not native_csv.available(), reason="no g++ toolchain to build libfastcsv"
+)
+
+
+def _csv(tmp_path, text, name="t.csv"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_mixed_frame_parity(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 50_000
+    df = pd.DataFrame(
+        {
+            "x": rng.normal(size=n),
+            "i": rng.integers(-1000, 1000, n),
+            "g": rng.choice(["red", "green", "blue"], n),
+            "y": rng.normal(size=n) * 1e12,
+        }
+    )
+    df.loc[rng.random(n) < 0.03, "x"] = np.nan
+    path = str(tmp_path / "m.csv")
+    df.to_csv(path, index=False)
+
+    got = P._try_native_csv(path, ",")
+    assert got is not None
+    ref = pd.read_csv(path, float_precision="round_trip")
+    # float64 parse is bit-exact vs the correctly-rounded reference
+    # (pandas' DEFAULT parser is the one that's off by an ulp)
+    assert (np.nan_to_num(got["x"].to_numpy(), nan=-9e9)
+            == np.nan_to_num(ref["x"].to_numpy(), nan=-9e9)).all()
+    assert (got["y"].to_numpy() == ref["y"].to_numpy()).all()
+    assert got["i"].dtype == np.int64 and (got["i"] == ref["i"]).all()
+    assert (got["g"].astype(str) == ref["g"].astype(str)).all()
+
+
+def test_na_spellings_and_crlf(tmp_path):
+    path = _csv(tmp_path, "a,g\r\n1.5,x\r\nNA,null\r\n,NaN\r\n+3.25,x\r\n")
+    got = P._try_native_csv(path, ",")
+    assert got is not None
+    a = got["a"].to_numpy()
+    assert a[0] == 1.5 and np.isnan(a[1]) and np.isnan(a[2]) and a[3] == 3.25
+    g = got["g"]
+    assert str(g.iloc[0]) == "x" and pd.isna(g.iloc[1]) and pd.isna(g.iloc[2])
+
+
+def test_na_set_matches_pandas_exactly(tmp_path):
+    """'None' IS pandas-NA; 'NAN' is NOT — both paths must agree."""
+    path = _csv(tmp_path, "g\na\nNone\nNAN\nb\n")
+    got = P._try_native_csv(path, ",")
+    assert got is not None
+    ref = pd.read_csv(path)
+    assert pd.isna(got["g"].iloc[1]) and pd.isna(ref["g"].iloc[1])
+    assert str(got["g"].iloc[2]) == "NAN" == str(ref["g"].iloc[2])
+    # domains come out SORTED, exactly like the pandas-path interning
+    assert list(got["g"].cat.categories) == sorted(["a", "NAN", "b"])
+
+
+def test_blank_lines_skipped_like_pandas(tmp_path):
+    path = _csv(tmp_path, "a\n1\n\n2\n")
+    got = P._try_native_csv(path, ",")
+    assert got is not None
+    assert got["a"].tolist() == [1, 2]  # pandas skip_blank_lines default
+
+
+def test_big_int64_ids_fall_back(tmp_path):
+    # values past 2^53 cannot round-trip through f64; only pandas' int64
+    # path is exact, so the native path must decline
+    path = _csv(tmp_path, "id\n9007199254740993\n9007199254740995\n")
+    assert P._try_native_csv(path, ",") is None
+
+
+def test_no_trailing_newline(tmp_path):
+    path = _csv(tmp_path, "a,b\n1,2\n3,4")
+    got = P._try_native_csv(path, ",")
+    assert got is not None
+    assert got["a"].tolist() == [1, 3] and got["b"].tolist() == [2, 4]
+
+
+def test_gz_supported(tmp_path):
+    p = tmp_path / "z.csv.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("a\n1.25\n2.5\n")
+    got = P._try_native_csv(str(p), ",")
+    assert got is not None and got["a"].tolist() == [1.25, 2.5]
+
+
+def test_quoted_dialect_falls_back(tmp_path):
+    path = _csv(tmp_path, 'a,g\n1,"x,y"\n2,z\n')
+    assert P._try_native_csv(path, ",") is None  # pandas handles quoting
+
+
+def test_numeric_surprise_falls_back(tmp_path):
+    # sample says numeric; a stray token deep in the column must NOT guess
+    rows = "\n".join(["%d" % i for i in range(3000)])
+    path = _csv(tmp_path, f"a\n{rows}\noops\n")
+    assert P._try_native_csv(path, ",") is None
+
+
+def test_ragged_row_falls_back(tmp_path):
+    path = _csv(tmp_path, "a,b\n1,2\n3,4,5\n")
+    assert P._try_native_csv(path, ",") is None
+
+
+def test_time_like_column_falls_back(tmp_path):
+    path = _csv(tmp_path, "t\n2024-01-01\n2024-01-02\n")
+    assert P._try_native_csv(path, ",") is None  # TIME stays pandas-typed
+
+
+def test_duplicate_headers_match_pandas_mangling(tmp_path):
+    # the eligibility sample is read by pandas, which already mangles
+    # duplicates ('a', 'a.1') — so the native path sees unique names and
+    # produces the same columns the pandas path would
+    path = _csv(tmp_path, "a,a\n1,2\n")
+    got = P._try_native_csv(path, ",")
+    if got is not None:
+        assert list(got.columns) == list(pd.read_csv(path).columns)
+
+
+def test_import_file_uses_same_values_either_path(tmp_path, monkeypatch):
+    """End-to-end: the Frame built through import_file carries identical
+    values whether the native fast path or pandas parsed the file."""
+    import h2o3_tpu
+
+    rng = np.random.default_rng(7)
+    n = 5_000
+    df = pd.DataFrame(
+        {
+            "x": rng.normal(size=n),
+            "g": rng.choice(["a", "b", "c"], n),
+            "label": rng.choice(["yes", "no"], n),
+        }
+    )
+    path = str(tmp_path / "e2e.csv")
+    df.to_csv(path, index=False)
+
+    fr_native = h2o3_tpu.import_file(path, destination_frame="ncsv_native")
+    monkeypatch.setenv("H2O3_TPU_NATIVE_PARSE", "0")
+    fr_pandas = h2o3_tpu.import_file(path, destination_frame="ncsv_pandas")
+
+    a = fr_native.to_pandas()
+    b = fr_pandas.to_pandas()
+    assert list(a.columns) == list(b.columns)
+    assert (a["x"].to_numpy() == b["x"].to_numpy()).all()
+    assert (a["g"].astype(str) == b["g"].astype(str)).all()
+    assert (a["label"].astype(str) == b["label"].astype(str)).all()
+
+
+def test_thread_count_invariance():
+    """Row order, values AND enum domains are independent of the thread
+    split (the merge remaps thread-local codes onto sorted global levels)."""
+    rng = np.random.default_rng(3)
+    n = 10_000
+    lines = ["x,g"] + [
+        f"{rng.normal():.6g},{rng.choice(['u', 'v', 'w'])}" for _ in range(n)
+    ]
+    data = ("\n".join(lines) + "\n").encode()
+    ref = native_csv.parse_csv_native(data, ["x", "g"], [0, 1], n_threads=1)
+    assert ref is not None
+    for t in (2, 3, 7):
+        df = native_csv.parse_csv_native(data, ["x", "g"], [0, 1], n_threads=t)
+        assert df is not None, t
+        assert (df["x"] == ref["x"]).all()
+        assert list(df["g"].cat.categories) == list(ref["g"].cat.categories)
+        assert (df["g"].astype(str) == ref["g"].astype(str)).all()
