@@ -1,0 +1,90 @@
+"""Bench infrastructure guards — the TPU measurement window depends on
+bench.py and the watcher gate NOT bitrotting between windows (round 4 lost
+its window partly to untested glue). Cheap structural checks run in the
+default tier; one real phase runs in the slow tier."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, env_extra=None, timeout=600):
+    env = dict(os.environ, **(env_extra or {}))
+    return subprocess.run(
+        [sys.executable, *args], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=ROOT,
+    )
+
+
+def test_latest_bench_ok_gate(monkeypatch):
+    """The gate's phase list must track bench._PHASES (minus headline)."""
+    monkeypatch.syspath_prepend(os.path.join(ROOT, "tools"))
+    import latest_bench_ok as gate
+
+    import bench
+
+    assert set(gate.POST_HEADLINE) == set(bench._PHASES) - {"headline"}
+
+
+@pytest.mark.parametrize(
+    "payload,want_rc",
+    [
+        ({"value": 2.5, "glm_1m": {"seconds": 1}}, 0),
+        ({"value": 2.5, "glm_1m_error": "boom"}, 1),  # r4 cascade mode
+        ({"value": 0.0, "error": "init hung"}, 1),
+        ({}, 1),
+    ],
+)
+def test_latest_bench_ok_cases(tmp_path, payload, want_rc):
+    # run against a scratch dir via a copied script (the tool globs its
+    # parent dir, so exercise it with a fabricated artifact set)
+    import shutil
+
+    tool = os.path.join(ROOT, "tools", "latest_bench_ok.py")
+    scratch_tools = tmp_path / "tools"
+    scratch_tools.mkdir()
+    shutil.copy(tool, scratch_tools / "latest_bench_ok.py")
+    (tmp_path / "BENCH_builder_x.json").write_text(json.dumps(payload) + "\n")
+    r = subprocess.run(
+        [sys.executable, str(scratch_tools / "latest_bench_ok.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == want_rc, r.stdout + r.stderr
+
+
+def test_bench_phases_registry():
+    import bench
+
+    # every phase has a runner and a positive budget; headline first (the
+    # driver contract requires its fields even on failure)
+    names = list(bench._PHASES)
+    assert names[0] == "headline"
+    for name, (fn, budget) in bench._PHASES.items():
+        assert callable(fn) and budget > 0, name
+    assert bench.BASELINE_TREES_PER_SEC > 1.0  # measured, not the old 1.0
+
+
+@pytest.mark.slow
+def test_glm_phase_emits_valid_json():
+    """One real phase end-to-end in a fresh subprocess at 1% scale — the
+    exact invocation shape the TPU backlog uses."""
+    r = _run(
+        ["bench.py", "--phase", "glm_1m"],
+        env_extra={
+            "H2O3_TPU_BENCH_SCALE": "0.01",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+        timeout=500,
+    )
+    assert r.stdout.strip(), f"no stdout (rc={r.returncode}):\n{r.stderr[-2000:]}"
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert "error" not in out, out
+    assert out["rows"] >= 10_000 and "auc" in out

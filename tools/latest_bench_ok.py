@@ -20,22 +20,27 @@ POST_HEADLINE = (
     "automl_50k",
 )
 
-here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-paths = glob.glob(os.path.join(here, "BENCH_builder_*.json"))
-if not paths:
-    sys.exit(1)
-newest = max(paths, key=os.path.getmtime)
-headline_ok = phases_ok = False
-try:
-    with open(newest) as f:
-        d = json.loads(f.readline())
-    if isinstance(d, dict):
-        headline_ok = float(d.get("value") or 0) > 0
-        phases_ok = any(isinstance(d.get(p), dict) for p in POST_HEADLINE)
-except Exception:
-    pass
-print(
-    f"{os.path.basename(newest)}: headline={'ok' if headline_ok else 'MISSING'}"
-    f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
-)
-sys.exit(0 if (headline_ok and phases_ok) else 1)
+def main() -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = glob.glob(os.path.join(here, "BENCH_builder_*.json"))
+    if not paths:
+        return 1
+    newest = max(paths, key=os.path.getmtime)
+    headline_ok = phases_ok = False
+    try:
+        with open(newest) as f:
+            d = json.loads(f.readline())
+        if isinstance(d, dict):
+            headline_ok = float(d.get("value") or 0) > 0
+            phases_ok = any(isinstance(d.get(p), dict) for p in POST_HEADLINE)
+    except Exception:
+        pass
+    print(
+        f"{os.path.basename(newest)}: headline={'ok' if headline_ok else 'MISSING'}"
+        f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
+    )
+    return 0 if (headline_ok and phases_ok) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
