@@ -863,6 +863,11 @@ def main() -> None:
             payload[f"{phase}_error"] = f"skipped: {init_down}"
             continue
         out = _run_phase_subprocess(phase, budget)
+        # progress breadcrumb on stderr: if the wrapper (driver / backlog
+        # timeout) kills this parent before the final stdout line, the
+        # per-phase results still exist in the captured log
+        print(f"[bench] {phase}: {json.dumps(out)}",
+              file=sys.stderr, flush=True)
         if isinstance(out.get("error"), str) and "init" in out["error"] and (
             "hung" in out["error"] or "failed after" in out["error"]
         ):

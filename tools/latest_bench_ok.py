@@ -20,26 +20,46 @@ POST_HEADLINE = (
     "automl_50k",
 )
 
+RECENT_S = 6 * 3600  # this window's artifacts only — stale full runs from
+                     # an earlier round must not stand the watcher down
+
+
 def main() -> int:
+    import time
+
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = glob.glob(os.path.join(here, "BENCH_builder_*.json"))
-    if not paths:
+    now = time.time()
+    # ANY qualifying artifact from this window counts: the backlog writes
+    # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full
+    # run, so "the newest file" is usually a control and judging only it
+    # would loop the watcher forever on a fully successful window
+    recent = [
+        p for p in glob.glob(os.path.join(here, "BENCH_builder_*.json"))
+        if now - os.path.getmtime(p) < RECENT_S
+    ]
+    if not recent:
+        print("no recent BENCH_builder artifacts")
         return 1
-    newest = max(paths, key=os.path.getmtime)
-    headline_ok = phases_ok = False
-    try:
-        with open(newest) as f:
-            d = json.loads(f.readline())
-        if isinstance(d, dict):
-            headline_ok = float(d.get("value") or 0) > 0
-            phases_ok = any(isinstance(d.get(p), dict) for p in POST_HEADLINE)
-    except Exception:
-        pass
-    print(
-        f"{os.path.basename(newest)}: headline={'ok' if headline_ok else 'MISSING'}"
-        f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
-    )
-    return 0 if (headline_ok and phases_ok) else 1
+    for path in sorted(recent, key=os.path.getmtime, reverse=True):
+        headline_ok = phases_ok = False
+        try:
+            with open(path) as f:
+                d = json.loads(f.readline())
+            if isinstance(d, dict):
+                headline_ok = float(d.get("value") or 0) > 0
+                phases_ok = any(
+                    isinstance(d.get(p), dict) for p in POST_HEADLINE
+                )
+        except Exception:
+            pass
+        print(
+            f"{os.path.basename(path)}: "
+            f"headline={'ok' if headline_ok else 'MISSING'}"
+            f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
+        )
+        if headline_ok and phases_ok:
+            return 0
+    return 1
 
 
 if __name__ == "__main__":

@@ -26,7 +26,10 @@ timeout 1800 python tools/tpu_mem_analysis.py --train \
   | tee "MEMDIAG_${stamp}.txt"
 save "MEMDIAG_${stamp}.txt" "TPU memory diagnosis for the 10M-row OOM"
 
-timeout 3600 python bench.py | tee "BENCH_builder_${stamp}.json"
+# bench prints its ONE json line only at the very end: the wrapper timeout
+# must exceed the worst case (launch deadline + the last phase's budget =
+# 2400 + 1800) or a long automl pass kills the run with nothing written
+H2O3_TPU_BENCH_DEADLINE_S=2400 timeout 5400 python bench.py | tee "BENCH_builder_${stamp}.json"
 save "BENCH_builder_${stamp}.json" "TPU bench artifact (all phases, subprocess-isolated)"
 
 H2O3_TPU_BIN_ADAPT=1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
