@@ -750,7 +750,7 @@ def _bench_hash_1m() -> dict:
     t0 = time.time()
     m = GLM(**kw).train(y="label", training_frame=fr)
     dt = time.time() - t0
-    return {
+    out = {
         "rows": n,
         "cardinality": card,
         "hash_buckets": buckets,
@@ -760,6 +760,27 @@ def _bench_hash_1m() -> dict:
         "seconds": round(dt, 3),
         "auc": round(float(m.training_metrics.auc), 4),
     }
+    # trees on the SAME 10^6-level enum: the binned path tail-clamps past
+    # the bin budget (MIGRATION.md scale-limits #2) — prove it trains with
+    # bounded HBM too, and record what clamping costs in AUC. The GLM
+    # result must survive ANY tree failure mode, including the parent
+    # killing this child at the phase budget: emit the GLM-only payload NOW
+    # (the parent keeps the LAST parseable stdout line, and its timeout
+    # path reads the killed child's captured stdout).
+    _emit(out)
+    try:
+        from h2o3_tpu.models.tree import GBM
+
+        gkw = dict(ntrees=5, max_depth=DEPTH, learn_rate=0.1, min_rows=10.0,
+                   score_tree_interval=1000, seed=42)
+        GBM(**gkw).train(y="label", training_frame=fr)  # warm
+        t0 = time.time()
+        gm = GBM(**gkw).train(y="label", training_frame=fr)
+        out["gbm_trees_per_sec"] = round(gkw["ntrees"] / (time.time() - t0), 3)
+        out["gbm_auc"] = round(float(gm.training_metrics.auc), 4)
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        out["gbm_error"] = repr(e)
+    return out
 
 
 def _phase_glm_1m() -> dict:
@@ -785,7 +806,7 @@ _PHASES: dict = {
     "cat_1m": (_bench_cat_1m, 900),       # BASELINE config #3 workload shape
     "join_10m": (_bench_join_10m, 600),   # ASTMerge successor at scale
     "glm_1m": (_phase_glm_1m, 600),
-    "hash_1m": (_bench_hash_1m, 600),     # Criteo-cardinality hashed enums
+    "hash_1m": (_bench_hash_1m, 900),     # Criteo-cardinality hashed enums (+GBM)
     "dl_100k": (_bench_dl, 600),          # sync-SGD MLP (BASELINE config #4)
     "automl_50k": (_phase_automl_50k, 1800),  # cold + warm passes
 }
@@ -830,7 +851,19 @@ def _run_phase_subprocess(phase: str, timeout_s: float) -> dict:
             text=True,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # a killed child may still have emitted partial results (hash_1m
+        # emits its GLM payload before attempting GBM) — keep them
+        for line in reversed((e.stdout or "").strip().splitlines()):
+            try:
+                d = json.loads(line)
+                if isinstance(d, dict):
+                    d.setdefault(
+                        "note", f"partial: phase killed at {timeout_s:.0f}s"
+                    )
+                    return d
+            except json.JSONDecodeError:
+                continue
         return {"error": f"phase timed out after {timeout_s:.0f}s (parent kill)"}
     for line in reversed(proc.stdout.strip().splitlines()):
         try:

@@ -45,11 +45,22 @@ def test_latest_bench_ok_cases(tmp_path, payload, want_rc):
     # parent dir, so exercise it with a fabricated artifact set)
     import shutil
 
+    from datetime import datetime, timezone
+
     tool = os.path.join(ROOT, "tools", "latest_bench_ok.py")
     scratch_tools = tmp_path / "tools"
     scratch_tools.mkdir()
     shutil.copy(tool, scratch_tools / "latest_bench_ok.py")
-    (tmp_path / "BENCH_builder_x.json").write_text(json.dumps(payload) + "\n")
+    # recency comes from the UTC stamp in the FILENAME (mtime is re-stamped
+    # by git checkouts and proves nothing)
+    stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    (tmp_path / f"BENCH_builder_{stamp}.json").write_text(
+        json.dumps(payload) + "\n"
+    )
+    # an OLD full artifact must never qualify, whatever its mtime
+    (tmp_path / "BENCH_builder_20200101T000000Z.json").write_text(
+        json.dumps({"value": 9.9, "glm_1m": {"seconds": 1}}) + "\n"
+    )
     r = subprocess.run(
         [sys.executable, str(scratch_tools / "latest_bench_ok.py")],
         capture_output=True, text=True, timeout=60,

@@ -24,6 +24,25 @@ RECENT_S = 6 * 3600  # this window's artifacts only — stale full runs from
                      # an earlier round must not stand the watcher down
 
 
+def _stamp_age_s(path: str, now: float) -> float | None:
+    """Age from the UTC stamp IN THE FILENAME (BENCH_builder_<stamp>*.json).
+
+    mtime is useless here: these artifacts are git-committed and a fresh
+    checkout re-stamps them to checkout time, which would let a previous
+    round's success stand the watcher down. Old-style names without a
+    stamp are by definition not from this window."""
+    import re
+    from datetime import datetime, timezone
+
+    m = re.search(r"(\d{8}T\d{6})Z", os.path.basename(path))
+    if not m:
+        return None
+    t = datetime.strptime(m.group(1), "%Y%m%dT%H%M%S").replace(
+        tzinfo=timezone.utc
+    )
+    return now - t.timestamp()
+
+
 def main() -> int:
     import time
 
@@ -33,14 +52,16 @@ def main() -> int:
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full
     # run, so "the newest file" is usually a control and judging only it
     # would loop the watcher forever on a fully successful window
-    recent = [
-        p for p in glob.glob(os.path.join(here, "BENCH_builder_*.json"))
-        if now - os.path.getmtime(p) < RECENT_S
-    ]
+    recent = []
+    for p in glob.glob(os.path.join(here, "BENCH_builder_*.json")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    recent = [p for _, p in sorted(recent)]
     if not recent:
         print("no recent BENCH_builder artifacts")
         return 1
-    for path in sorted(recent, key=os.path.getmtime, reverse=True):
+    for path in recent:
         headline_ok = phases_ok = False
         try:
             with open(path) as f:
