@@ -558,7 +558,7 @@ def test_fused_whole_tree_deep_matches_per_level(monkeypatch):
                 n_pad_next = min(2 * n_pad, 2048)
                 step = st._level_step(n_pad, n_pad_next, 32, d == depth, ())
                 nid, preds, vi, n_split, rec = step(
-                    bins, nid, preds, vi, w, w * t, w * t * t, h,
+                    bins, nid, preds, vi, w, w * t, h,
                     jax.random.fold_in(key, d),
                     jnp.ones(c, jnp.float32), jnp.zeros(c, bool),
                     jnp.float32(10.0), jnp.float32(1e-5), jnp.float32(0.1),
@@ -568,7 +568,7 @@ def test_fused_whole_tree_deep_matches_per_level(monkeypatch):
             return preds, vi
         prog = st._tree_program(depth, 32, 2048, ())
         _, preds, vi, _ = prog(
-            bins, preds, vi, w, w * t, w * t * t, h, key,
+            bins, preds, vi, w, w * t, h, key,
             jnp.ones(c, jnp.float32), jnp.zeros(c, bool),
             jnp.float32(10.0), jnp.float32(1e-5), jnp.float32(0.1),
             jnp.float32(np.inf), jnp.float32(1.0), None,
