@@ -416,6 +416,78 @@ def parse_stream(
     return fr
 
 
+def _data_line_offsets(path: str, wanted: set[int]) -> dict[int, int]:
+    """Byte offsets where the requested 0-based DATA rows start (header is
+    file-line 0). One streaming block scan, O(1) memory."""
+    out: dict[int, int] = {}
+    if not wanted:
+        return out
+    remaining = set(wanted)
+    line = 0  # completed newlines so far == file-line index about to start
+    pos = 0
+    with open(path, "rb") as f:
+        while remaining:
+            block = f.read(1 << 22)
+            if not block:
+                break
+            idx = 0
+            while remaining:
+                j = block.find(b"\n", idx)
+                if j < 0:
+                    break
+                # data row (line) starts right after file-line `line` ends
+                if line in remaining:
+                    out[line] = pos + j + 1
+                    remaining.discard(line)
+                line += 1
+                idx = j + 1
+            pos += len(block)
+    return out
+
+
+def _read_rank_rows(path, sep, col_order, kinds, lo: int, hi: int, n: int):
+    """This rank's data rows [lo, hi) as a DataFrame.
+
+    Fast path: byte-range + native chunk parse. Locating the range is a
+    streaming byte scan of the prefix (cheap: no tokenizing, ~GB/s); only
+    the rank's own slice is TOKENIZED — the expensive part. The pandas
+    ``skiprows`` fallback instead re-tokenizes the whole prefix on every
+    rank; it remains the behavior-defining fallback for anything outside
+    the native dialect. The caller (parse_sharded) has already rejected
+    quoted files, so raw-newline row addressing == record addressing here.
+    """
+    from h2o3_tpu import config, native_csv
+
+    if (
+        hi > lo
+        and config.get_bool("H2O3_TPU_NATIVE_PARSE")
+        and native_csv.available()
+    ):
+        try:
+            offs = _data_line_offsets(path, ({lo, hi} if hi < n else {lo}))
+            start = offs.get(lo)
+            end = offs.get(hi, os.path.getsize(path))
+            if start is not None:
+                with open(path, "rb") as f:
+                    f.seek(start)
+                    data = f.read(end - start)
+                nat_kinds = [1 if kinds[c] == CAT else 0 for c in col_order]
+                got = native_csv.parse_csv_native(
+                    data, col_order, nat_kinds, sep=sep, has_header=False
+                )
+                if got is not None and len(got) == hi - lo:
+                    return got
+        except Exception:  # noqa: BLE001 — ANY native trouble (truncated
+            # file mid-flight, decode, ...) must degrade to pandas, not
+            # crash one rank and deadlock the others at the allgather
+            pass
+    return pd.read_csv(
+        path, sep=sep,
+        skiprows=range(1, lo + 1), nrows=max(hi - lo, 0),
+        header=0, names=col_order,
+    )
+
+
 def parse_sharded(
     setup: dict, destination_frame: str | None = None
 ) -> Frame:
@@ -446,8 +518,14 @@ def parse_sharded(
     P = jax.process_count()
     r = jax.process_index()
 
-    # row count: one streaming newline scan (O(1) memory, every rank)
+    # row count: one streaming newline scan (O(1) memory, every rank).
+    # The SAME pass detects double quotes: a quoted field could hide an
+    # embedded newline, which would make this raw-newline row count (and
+    # any byte-offset row addressing) disagree with pandas' record
+    # semantics — silently, and potentially DIFFERENTLY per rank. v1 scope
+    # is plain CSV, so refuse deterministically on every rank instead.
     newlines = 0
+    quotes = 0
     last = b"\n"
     with open(path, "rb") as f:
         while True:
@@ -455,7 +533,14 @@ def parse_sharded(
             if not block:
                 break
             newlines += block.count(b"\n")
+            quotes += block.count(b'"')
             last = block[-1:]
+    if quotes:
+        raise ValueError(
+            "sharded parse v1 requires unquoted CSV (a quoted field could "
+            "embed a newline, breaking row addressing); re-export without "
+            "quotes or use the single-host parse"
+        )
     total_lines = newlines + (0 if last == b"\n" else 1)
     n = max(total_lines - 1, 0)  # minus header
 
@@ -489,11 +574,7 @@ def parse_sharded(
     per = len(positions) * rows_per_dev  # this rank's row block
     lo = min(positions[0] * rows_per_dev, n)
     hi = min(positions[0] * rows_per_dev + per, n)
-    local = pd.read_csv(
-        path, sep=sep,
-        skiprows=range(1, lo + 1), nrows=max(hi - lo, 0),
-        header=0, names=col_order,
-    )
+    local = _read_rank_rows(path, sep, col_order, kinds, lo, hi, n)
 
     # per-rank categorical interning, then the global union pass
     local_domains: dict[str, list] = {}
