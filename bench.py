@@ -780,6 +780,24 @@ def _bench_hash_1m() -> dict:
         out["gbm_auc"] = round(float(gm.training_metrics.auc), 4)
     except Exception as e:  # noqa: BLE001 — diagnostics only
         out["gbm_error"] = repr(e)
+    _emit(out)  # GLM+GBM survive a DL failure/kill the same way
+    # DL over the hashed block — BASELINE config #4's Criteo-CTR shape
+    # (sparse categorical CTR via sync-SGD MLP); hash_buckets bounds the
+    # input layer exactly as it bounds the GLM design matrix
+    try:
+        from h2o3_tpu.models.deeplearning import DeepLearning
+
+        dkw = dict(hidden=[64, 32], epochs=1, mini_batch_size=1024,
+                   hash_buckets=buckets, seed=7)
+        DeepLearning(**dkw).train(y="label", training_frame=fr)  # warm/compile
+        t0 = time.time()
+        dm = DeepLearning(**dkw).train(y="label", training_frame=fr)
+        ddt = time.time() - t0
+        out["dl_seconds"] = round(ddt, 3)
+        out["dl_rows_per_sec"] = round(n / max(ddt, 1e-9), 1)
+        out["dl_auc"] = round(float(dm.training_metrics.auc), 4)
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        out["dl_error"] = repr(e)
     return out
 
 
