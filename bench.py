@@ -182,14 +182,23 @@ def _init_with_retry():
         watchdog.cancel()
 
 
-def _phase_breakdown(fr, n_trees: int, total_s: float, nbins: int = 255) -> tuple[dict, float]:
+def _phase_breakdown(
+    fr, n_trees: int, total_s: float, nbins: int = 255
+) -> tuple[dict, float, float]:
     """Time the histogram / split / partition phases standalone on the bench
     data shapes and estimate histogram-phase MFU.
 
-    Returns ({phase: sec_per_tree}, hist_flops_per_tree). Phases are timed as
-    the same jitted programs the level loop runs, summed over the per-level
-    node counts 1,2,4,...,2^(DEPTH-1); "host_other" is the remainder of the
-    measured wall time.
+    Returns ({phase: sec_per_tree}, hist_flops_per_tree,
+    hist_flops_traced_per_tree). Phases are timed as the same jitted programs
+    the level loop runs, summed over the per-level node counts
+    1,2,4,...,2^(DEPTH-1); "host_other" is the remainder of the measured
+    wall time. ``hist_flops`` prices the standalone direct-scheme programs
+    timed here (every node's histogram built — the denominator for "mfu");
+    ``hist_flops_traced`` prices the program that actually RAN: with
+    H2O3_TPU_HIST_SUBTRACT=1 each level past the root builds only ONE
+    sibling per pair (half the frontier) and derives the other by
+    subtraction, so crediting the traced ph_hist time with every node's
+    FLOPs would overstate mfu_traced ~2x.
     """
     import jax
     import jax.numpy as jnp
@@ -219,10 +228,18 @@ def _phase_breakdown(fr, n_trees: int, total_s: float, nbins: int = 255) -> tupl
         jax.tree.map(lambda x: x.block_until_ready(), out)
         return (time.perf_counter() - t0) / reps
 
+    from h2o3_tpu.models.tree.shared_tree import _subtract_enabled
+
+    subtract = _subtract_enabled()
     hist_s = 0.0
     hist_flops = 0.0
+    hist_flops_traced = 0.0
     for level in range(DEPTH):
         n_nodes = 2**level
+        # nodes whose histogram the fused program actually BUILDS at this
+        # level: all of them in the direct scheme; one sibling per pair
+        # (half) under subtraction, except the root which has no sibling
+        n_built = n_nodes if (level == 0 or not subtract) else n_nodes // 2
         nid = jax.device_put(
             jnp.asarray(rng.integers(0, n_nodes, n_pad).astype(np.int32)),
             row_sharding(),
@@ -238,6 +255,7 @@ def _phase_breakdown(fr, n_trees: int, total_s: float, nbins: int = 255) -> tupl
         # matmul-path issued FLOPs: 3 stats x 2*n*N*(C*B) per level (the
         # wy2 lane was dropped — its gain contribution cancels exactly)
         hist_flops += 3 * 2.0 * n_pad * n_nodes * len(cols) * n_bins
+        hist_flops_traced += 3 * 2.0 * n_pad * n_built * len(cols) * n_bins
 
     # split scan at the deepest level's node count (the most expensive one)
     from h2o3_tpu.models.tree.shared_tree import _split_scan
@@ -310,7 +328,7 @@ def _phase_breakdown(fr, n_trees: int, total_s: float, nbins: int = 255) -> tupl
         per_tree["fused_tree_error"] = repr(e)
     device_s = per_tree.get("fused_tree_s", hist_s + split_s + part_s)
     per_tree["host_other_s"] = round(max(total_s / n_trees - device_s, 0.0), 4)
-    return per_tree, hist_flops
+    return per_tree, hist_flops, hist_flops_traced
 
 
 def _drop_models(*models) -> None:
@@ -541,17 +559,21 @@ def _bench_automl(fr_small) -> dict:
 
     from h2o3_tpu.automl import AutoML
 
+    from h2o3_tpu.models.tree.shared_tree import reset_build_stats
+
     def run(seed):
+        reset_build_stats()
         t0 = time.time()
         aml = AutoML(max_models=3, nfolds=0, seed=seed,
                      max_runtime_secs=900.0, include_algos=["GBM", "GLM"])
         aml.train(y="label", training_frame=fr_small)
-        return time.time() - t0, aml.leaderboard
+        dt = time.time() - t0
+        return dt, aml.leaderboard, reset_build_stats()
 
     cache_entries = _compile_cache_entries()
-    cold_s, lb = run(11)
+    cold_s, lb, cold_stats = run(11)
     _drop_models(*(lb.models if lb else ()))
-    warm_s, lb = run(11)
+    warm_s, lb, warm_stats = run(11)
 
     out = {"max_models": 3,
            "cold_s": round(cold_s, 3),
@@ -559,6 +581,21 @@ def _bench_automl(fr_small) -> dict:
            "compile_share_est": round(max(cold_s - warm_s, 0.0) / cold_s, 3)
            if cold_s > 0 else None,
            "persistent_cache_entries_before": cache_entries,
+           # shape-bucketed whole-tree amortization (ISSUE 1): the warm pass
+           # repeats the cold pass's shapes, so compiled should drop to 0
+           # and every tree program come from the in-process cache
+           "tree_programs_compiled": [
+               cold_stats["tree_programs_compiled"],
+               warm_stats["tree_programs_compiled"],
+           ],
+           "tree_program_cache_hits": [
+               cold_stats["tree_program_cache_hits"],
+               warm_stats["tree_program_cache_hits"],
+           ],
+           "dispatches_per_tree": [
+               round(s["dispatches"] / max(s["trees_built"], 1), 4)
+               for s in (cold_stats, warm_stats)
+           ],
            "models_built": len(lb.models) if lb else 0}
     if lb and lb.models:
         auc = float(lb.as_table()[0].get("auc", float("nan")))
@@ -636,10 +673,14 @@ def _phase_headline() -> dict:
     # specializes on chunk length, so warmup must use the same ntrees)
     GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
 
+    from h2o3_tpu.models.tree.shared_tree import BUILD_STATS, reset_build_stats
+
+    reset_build_stats()
     t0 = time.time()
     m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
     dt = time.time() - t0
     tps = N_TREES / dt
+    stats = reset_build_stats()
 
     payload = {
         "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}"
@@ -648,12 +689,20 @@ def _phase_headline() -> dict:
         "value": round(tps, 3),
         "unit": "trees/sec/chip",
         "vs_baseline": round(tps / BASELINE_TREES_PER_SEC, 3),
+        # whole-tree contract (ISSUE 1): O(1) host dispatches per tree —
+        # per-level dispatch would read DEPTH+1 here
+        "dispatches_per_tree": round(
+            stats["dispatches"] / max(stats["trees_built"], 1), 4
+        ),
+        "tree_programs_compiled": stats["tree_programs_compiled"],
+        "tree_program_cache_hits": stats["tree_program_cache_hits"],
     }
     kind = jax.devices()[0].device_kind.lower()
     peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
     hist_flops = None
+    hist_flops_traced = None
     try:
-        breakdown, hist_flops = _phase_breakdown(
+        breakdown, hist_flops, hist_flops_traced = _phase_breakdown(
             fr, N_TREES, dt, nbins=kw.get("nbins", MAX_BINS))
         payload["breakdown"] = breakdown
         if peak is not None and breakdown["hist_s"] > 0:
@@ -681,13 +730,16 @@ def _phase_headline() -> dict:
             payload["fused_profile"] = prof
             if (
                 peak is not None
-                and hist_flops is not None
+                and hist_flops_traced is not None
                 and prof.get("phases_s", {}).get("ph_hist", 0) > 0
             ):
-                # phases_s is a PER-DEVICE mean and hist_flops is the whole
-                # mesh's work: each of n_devices chips does ~1/n of it
+                # phases_s is a PER-DEVICE mean and hist_flops_traced is the
+                # whole mesh's work AS THE TRACED PROGRAM ISSUES IT (under
+                # H2O3_TPU_HIST_SUBTRACT=1 only the actually-built sibling
+                # histograms count): each of n_devices chips does ~1/n
                 per_dev_flops = (
-                    hist_flops * N_TREES / max(prof.get("n_devices", 1), 1)
+                    hist_flops_traced * N_TREES
+                    / max(prof.get("n_devices", 1), 1)
                 )
                 payload["mfu_traced"] = round(
                     per_dev_flops / prof["phases_s"]["ph_hist"] / peak, 4

@@ -87,11 +87,20 @@ def init(
         # heartbeat_timeout bounds dead-member detection (SURVEY §5.3): the
         # coordination service's heartbeat IS the HeartBeatThread successor;
         # jax's default 100 s is tunable down for tests/latency-sensitive ops
+        import inspect
+
+        kw = {}
+        if "heartbeat_timeout_seconds" in inspect.signature(
+            jax.distributed.initialize
+        ).parameters:  # older jax has no tunable heartbeat — default applies
+            kw["heartbeat_timeout_seconds"] = config.get_int(
+                "H2O3_TPU_HEARTBEAT_TIMEOUT"
+            )
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
-            heartbeat_timeout_seconds=config.get_int("H2O3_TPU_HEARTBEAT_TIMEOUT"),
+            **kw,
         )
     from h2o3_tpu.utils import telemetry
 

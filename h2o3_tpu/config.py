@@ -53,6 +53,19 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_FUSED_MAX_DEPTH": (
         "20", "deepest tree the whole-tree fused program is built for; "
               "beyond it the per-level dispatch loop takes over"),
+    "H2O3_TPU_WHOLE_TREE": (
+        "1", "device-resident whole-tree build: the level loop runs INSIDE "
+             "the compiled program (unrolled growth levels + a lax.while_loop "
+             "over the node_cap-saturated levels with an on-device early-exit "
+             "predicate), one dispatch per tree/chunk on every backend. "
+             "0 = host-driven per-level dispatch loop (debug escape hatch)"),
+    "H2O3_TPU_SHAPE_BUCKETS": (
+        "1", "shape-bucketed padding: round rows (above 64k, ~12.5% geometric "
+             "ladder), feature columns (multiple of 8) and histogram bins "
+             "(power of two) up to a small ladder so AutoML/grid builds of "
+             "near-identical shapes reuse one compiled program instead of "
+             "recompiling per shape. Padding is masked out and proven inert "
+             "(bucketed builds score identically); 0 = exact shapes"),
     "H2O3_TPU_COMPILE_CACHE": ("", "XLA compile-cache dir ('' = <pkg>/.jax_cache)"),
     "H2O3_TPU_NPS_DIR": (
         "", "NodePersistentStorage root (saved Flow notebooks; '' = "

@@ -167,6 +167,27 @@ def _col_codes(table, name, domain, n) -> np.ndarray:
                        if v is not None and v == v else -1 for v in x], np.int64)
 
 
+def _col_hash_buckets(table, name, n_buckets, n) -> np.ndarray:
+    """Feature-hashed categorical → bucket codes; missing → -1.
+
+    The bucket of a value is ``crc32(col_name \\0 level) % n_buckets`` —
+    byte-for-byte the rule in ``models.datainfo._hash_lut`` — computed from
+    the raw level STRING, so the offline scorer agrees with the cluster with
+    no domain shipped in the artifact (that is the point of hashing: the
+    train domain may be Criteo-sized)."""
+    import zlib
+
+    if name not in table:
+        return np.full(n, -1, np.int64)
+    prefix = name.encode() + b"\x00"
+    return np.asarray(
+        [zlib.crc32(prefix + (v if isinstance(v, str) else str(v)).encode())
+         % n_buckets
+         if v is not None and v == v else -1 for v in table[name]],
+        np.int64,
+    )
+
+
 def _n_rows(table: dict) -> int:
     return len(next(iter(table.values())))
 
@@ -376,7 +397,20 @@ def _design_matrix(meta_di: dict, table) -> np.ndarray:
                 xb = np.where(np.isnan(xb), mb, xb)
                 cols.append(onehot * xb[:, None])
             continue
-        if c["kind"] == "cat":
+        if c["kind"] == "hash":
+            # feature-hashed block: bucket straight from the raw level
+            # string (crc32(col \0 level) % hash_buckets — the exact rule
+            # DataInfo._hash_lut applies on-cluster), no domain needed.
+            # use_all_factor_levels=False drops bucket 0 as the reference
+            # level, mirroring the cat path; NA (-1) rows go all-zero.
+            buckets = _col_hash_buckets(
+                table, c["name"], int(meta_di["hash_buckets"]), n
+            )
+            base = 0 if meta_di["use_all_factor_levels"] else 1
+            onehot = ((buckets - base)[:, None]
+                      == np.arange(c["width"])[None, :]).astype(np.float64)
+            cols.append(onehot)
+        elif c["kind"] == "cat":
             codes = _col_codes(table, c["name"], c["domain"], n)
             base = 0 if meta_di["use_all_factor_levels"] else 1
             onehot = ((codes - base)[:, None] == np.arange(c["width"])[None, :]).astype(np.float64)

@@ -69,10 +69,13 @@ class DataInfo:
     # otherwise the block sums to the intercept and the unregularized Gram
     # goes singular.
     hash_buckets: int | None = None
-    # per-(column, domain) device LUT cache: rebuilding costs one crc32 per
-    # LEVEL (≈1M Python calls at Criteo cardinality) and must not be paid
-    # again on every scoring call. Values hold the domain tuple itself so
-    # the id() key can never be recycled while the entry lives.
+    # per-COLUMN device LUT cache (most-recent domain only): rebuilding
+    # costs one crc32 per LEVEL (≈1M Python calls at Criteo cardinality)
+    # and must not be paid again on every scoring call. Keyed by column
+    # name alone — a long-lived scoring server cycling through frames with
+    # distinct domain objects would otherwise pin every domain tuple +
+    # device LUT it ever saw. Values hold the domain tuple so a hit can be
+    # validated by identity and a stale entry is simply replaced.
     _hash_luts: dict = field(default_factory=dict, repr=False, compare=False)
 
     @staticmethod
@@ -258,16 +261,16 @@ class DataInfo:
         return X, valid
 
     def _hashed_codes(self, v: Vec, c: ColumnSpec):
-        """Device bucket codes for a hashed column, LUT-cached per (column,
-        domain object) so scoring never re-pays the O(cardinality) host
-        hash loop."""
-        key = (c.name, id(v.domain))
-        hit = self._hash_luts.get(key)
+        """Device bucket codes for a hashed column, LUT-cached per column
+        (most-recent domain) so steady-state scoring never re-pays the
+        O(cardinality) host hash loop and the cache stays bounded by the
+        model's column count."""
+        hit = self._hash_luts.get(c.name)
         if hit is not None and hit[0] is v.domain:
             lut_dev = hit[1]
         else:
             lut_dev = _hash_lut(v.domain or (), c.name, self.hash_buckets)
-            self._hash_luts[key] = (v.domain, lut_dev)
+            self._hash_luts[c.name] = (v.domain, lut_dev)
         return jnp.where(v.data >= 0, lut_dev[jnp.clip(v.data, 0)], -1)
 
     def _transform_interaction(self, frame: Frame, c: ColumnSpec, valid):

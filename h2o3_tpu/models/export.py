@@ -34,6 +34,10 @@ def _datainfo_meta(di) -> dict:
         "missing_handling": di.missing_handling,
         "add_intercept": di.add_intercept,
         "ncols_expanded": di.ncols_expanded,
+        # feature hashing: the offline scorer re-derives each "hash"
+        # column's bucket from the raw level string, so the bucket count is
+        # part of the scoring spec (None = no hashing anywhere)
+        "hash_buckets": di.hash_buckets,
         "columns": [
             {"name": c.name, "kind": c.kind, "mean": float(c.mean),
              "sigma": float(c.sigma), "domain": list(c.domain),

@@ -31,6 +31,48 @@ from h2o3_tpu.parallel.mesh import row_sharding
 MAX_BINS = 255  # codes 1..255 fit uint8 with 0 reserved for NA
 
 
+# ---------------------------------------------------------------------------
+# shape-bucket ladder (H2O3_TPU_SHAPE_BUCKETS): AutoML/grid builds differ in
+# data-dependent shapes (actual quantile-bin count, feature count after
+# drops), and every distinct shape is a fresh multi-second XLA compile of the
+# whole-tree program. Rounding bins/cols up to a coarse ladder collapses
+# near-identical shapes onto one compiled program. The padding is inert by
+# construction: padded bins are empty (every candidate split there fails
+# min_rows and loses the argmax to a real bin), padded columns carry
+# cols_enabled=0 and the NA code everywhere, and the column-sampling RNG is
+# drawn at the REAL column count — so a bucketed build scores identically to
+# an exact-shape build (pinned by tests).
+
+
+def _buckets_enabled() -> bool:
+    from h2o3_tpu import config
+
+    return config.get_bool("H2O3_TPU_SHAPE_BUCKETS")
+
+
+def bucket_nbins(n_bins: int) -> int:
+    """Histogram bin-axis bucket: next power of two (min 8, cap 256)."""
+    if not _buckets_enabled() or n_bins >= 256:
+        return n_bins
+    b = 8
+    while b < n_bins:
+        b <<= 1
+    return b
+
+
+def bucket_cols(n_cols: int) -> int:
+    """Feature-axis bucket: next multiple of 4 (min 4).
+
+    Histogram cost is ∝ columns, so every padded column is pure overhead on
+    every build that hits the program — a multiple-of-8 ladder costs the
+    28-col headline +14% histogram work forever to save compiles it never
+    needs. Multiple-of-4 keeps the compile-collapse for the odd widths
+    AutoML feature-drops produce at ≤3 padded columns."""
+    if not _buckets_enabled():
+        return n_cols
+    return max(4, -(-n_cols // 4) * 4)
+
+
 @dataclass
 class BinSpec:
     """Fitted binning for one frame's feature set."""

@@ -168,12 +168,14 @@ class DRF(ModelBuilder):
                     rngkey, _ = jax.random.split(rngkey)
 
         # Chunk-scanned path (see gbm.py / build_trees_scanned): one device
-        # dispatch per scoring interval per class. The bootstrap row mask is
-        # keyed by the shared row_key so all K class-trees of iteration m
-        # draw the SAME bootstrap (H2O semantics), while column/level
-        # randomness differs per class.
+        # dispatch per scoring interval per class, on every backend. The
+        # bootstrap row mask is keyed by the shared row_key so all K
+        # class-trees of iteration m draw the SAME bootstrap (H2O
+        # semantics), while column/level randomness differs per class.
         # depth policy lives in use_fused_trees (depth-20 DRF — the H2O
-        # default regime — stays on the scanned path, VERDICT r3 weak #7)
+        # default regime — runs its saturated levels as an on-device
+        # lax.while_loop with early exit, so the scanned path holds at any
+        # depth; H2O3_TPU_WHOLE_TREE=0 restores the per-level loop)
         from h2o3_tpu.models.tree.shared_tree import use_fused_trees
 
         use_scan = use_fused_trees(p.max_depth)

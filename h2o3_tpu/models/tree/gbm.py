@@ -388,10 +388,11 @@ class GBM(ModelBuilder):
             )
 
         # Chunk-scanned path: build a whole scoring interval of trees in ONE
-        # device dispatch (see build_trees_scanned — on the tunneled TPU,
-        # dispatch latency dominates once any D2H transfer has happened).
-        # CPU keeps the per-tree loop (cheap dispatch, early-exit polling,
-        # and the behavior the pinned tests were written against).
+        # device dispatch (see build_trees_scanned). Default on EVERY backend
+        # — on the tunneled TPU dispatch latency dominates once any D2H
+        # transfer has happened, and on the CPU mesh per-level dispatch
+        # overhead × levels × trees was ~a third of build wall-clock.
+        # H2O3_TPU_WHOLE_TREE=0 restores the per-tree per-level loop.
         mono_vec = None
         if p.monotone_constraints:
             if dist not in ("gaussian", "bernoulli", "tweedie", "quantile"):

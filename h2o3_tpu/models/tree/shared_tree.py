@@ -42,6 +42,40 @@ _NEG = -1e30
 
 
 # ---------------------------------------------------------------------------
+# build telemetry: host dispatches and program-cache traffic. The whole-tree
+# design's contract is O(1) dispatches per tree (vs O(depth) for the
+# host-driven level loop) and one compile per shape signature — these
+# counters are how tests assert it and how bench.py reports it.
+
+BUILD_STATS = {
+    "dispatches": 0,  # device-program launches issued by the builders
+    "trees_built": 0,  # trees those dispatches produced
+    "tree_programs_compiled": 0,  # whole-tree/chunk program cache misses
+    "tree_program_cache_hits": 0,  # ... and hits (same shape → no recompile)
+}
+
+
+def reset_build_stats() -> dict:
+    """Zero the counters and return the pre-reset snapshot."""
+    snap = dict(BUILD_STATS)
+    for k in BUILD_STATS:
+        BUILD_STATS[k] = 0
+    return snap
+
+
+def _cached_program(key, make):
+    """_STEP_CACHE lookup with compile/hit accounting for tree programs."""
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        BUILD_STATS["tree_programs_compiled"] += 1
+        fn = make()
+        _STEP_CACHE[key] = fn
+    else:
+        BUILD_STATS["tree_program_cache_hits"] += 1
+    return fn
+
+
+# ---------------------------------------------------------------------------
 # split finding (pure function, traced inside the level step)
 
 
@@ -328,6 +362,7 @@ def _level_core(
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     leaf_reg=None,
     *, n_pad: int, n_pad_next: int, cat_cols: tuple = (),
+    n_cols_real: int | None = None,
 ):
     """Split scan → decisions → partition for one level, given its histogram.
 
@@ -349,10 +384,15 @@ def _level_core(
     # per-(node,col) sampling mask (H2O col_sample_rate per split).
     # Fallback when a node draws no columns: use all (rare; H2O instead
     # redraws one uniformly — indistinguishable in expectation at our
-    # histogram granularity).
+    # histogram granularity). The draw runs at the REAL column count
+    # (n_cols_real) so shape-bucketed column padding cannot perturb which
+    # columns a node samples — bucketed builds stay bit-identical.
+    Cr = n_cols_real or C
     col_mask = jnp.broadcast_to(cols_enabled[None, :], (n_pad, C))
-    keep = jax.random.uniform(key, (n_pad, C)) < col_sample_rate
+    keep = jax.random.uniform(key, (n_pad, Cr)) < col_sample_rate
     keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
+    if Cr < C:
+        keep = jnp.pad(keep, ((0, 0), (0, C - Cr)))
     col_mask = col_mask * keep
     # ph_split: phase tag for tools/profile_fused.py
     with jax.named_scope("ph_split"):
@@ -494,12 +534,32 @@ def _coarsen_hist(hist, ds: int):
     return jnp.concatenate([na, data], axis=2)
 
 
+def _sat_region(max_depth: int, node_cap: int, shifts: list[int]) -> tuple:
+    """(start, count) of the node_cap-SATURATED level run rolled into a
+    ``lax.while_loop``: levels where the frontier is pinned at ``node_cap``
+    (so every iteration has identical shapes) and the bin-coarsening shift is
+    constant from the preceding level on (so the parent-histogram carry needs
+    no per-iteration re-coarsening). Unrolling those levels instead would
+    compile O(depth) copies of the most expensive level body — the while_loop
+    form compiles ONE body and early-exits on device the moment a level
+    produces no splits (the deep-DRF regime where most levels are dead)."""
+    for d in range(1, max_depth):
+        if (
+            min(1 << d, node_cap) == node_cap
+            and len(set(shifts[d - 1 : max_depth])) == 1
+        ):
+            if max_depth - d >= 2:
+                return d, max_depth - d
+            break
+    return None, 0
+
+
 def _fused_levels(
     bins_u8, preds, varimp, w, wy, wh, tkey, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     leaf_reg=None,
     *, max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
-    subtract: bool = True,
+    subtract: bool = True, n_cols_real: int | None = None,
 ):
     """All levels of one tree, traced into a single program, with the two
     histogram work reductions the reference's hot loop embodies
@@ -518,6 +578,15 @@ def _fused_levels(
     the direct scheme — ~4× fewer MXU FLOPs in the phase that dominates
     tree time. ``subtract=False`` recovers the direct scheme (A/B testing,
     ``H2O3_TPU_HIST_SUBTRACT=0``).
+
+    Level structure (one compiled program, zero host round-trips):
+    frontier-GROWTH levels (node count 1, 2, 4, … < node_cap) unroll — each
+    has its own shapes; the node_cap-SATURATED run rolls into a
+    ``lax.while_loop`` whose predicate early-exits on device once a level
+    splits nothing (see :func:`_sat_region`); the terminal level force-leafs.
+    Skipped (post-exit) levels keep their pre-initialized placeholder records
+    — all-leaf, zero-valued, reachable by no row — so replay, export and the
+    level masks need no notion of "how deep did this tree actually go".
     """
     from h2o3_tpu.ops.histogram import histogram_in_jit
 
@@ -529,12 +598,107 @@ def _fused_levels(
     recs = []
     parent_hist = None
     pair_info = None
+    n_split = None
     shifts = _bin_shifts(max_depth, n_bins, cat_cols)
     prev_shift = 0
-    for depth in range(max_depth + 1):
+    sat_start, n_sat = _sat_region(max_depth, node_cap, shifts)
+
+    def level_hist(bins_d, nb_d, depth, nid, pair_info, parent_hist, sd):
+        """One level's (n_pad, C, Bc, 3) histogram — direct or sibling-sub."""
+        n_pad = min(1 << depth, node_cap)
+        if depth == 0 or not subtract:
+            return histogram_in_jit(bins_d, nid, (w, wy, wh), n_pad, nb_d)
+        half = n_pad // 2
+        row_pair = jnp.maximum(nid, 0) >> 1  # pair = nid//2 (child_base even)
+        row_left = (nid & 1) == 0
+        bl = pair_info["build_left"]
+        build_row = (nid >= 0) & (row_left == bl[row_pair])
+        nid_build = jnp.where(build_row, row_pair, -1)
+        built = histogram_in_jit(
+            bins_d, nid_build, (w, wy, wh), half, nb_d
+        )  # (half, C, Bc, 3)
+        # parent histogram was built at the previous level's (finer)
+        # binning — sum its data-bin groups down to this level's
+        psel = jnp.where(
+            pair_info["valid"][:, None, None, None],
+            _coarsen_hist(parent_hist, sd)[pair_info["parent_idx"]],
+            0.0,
+        )
+        sib = psel - built
+        blb = bl[:, None, None, None]
+        return jnp.stack(
+            [jnp.where(blb, built, sib), jnp.where(blb, sib, built)], axis=1
+        ).reshape(n_pad, *built.shape[1:])
+
+    depth = 0
+    while depth <= max_depth:
         n_pad = min(1 << depth, node_cap)
         n_pad_next = min(2 * n_pad, node_cap)
         force_leaf = depth == max_depth
+
+        if depth == sat_start:
+            # ---- saturated run: ONE compiled body, on-device early exit ----
+            sd = shifts[depth]
+            nb_d = _coarse_nbins(n_bins, sd)
+            bins_d = _coarsen_bins(bins_u8, sd)
+            if subtract and parent_hist.shape[0] < node_cap:
+                # first iteration's parent frontier may be node_cap/2 wide;
+                # zero-pad so the carry shape is loop-invariant (the pad rows
+                # are gated off by pair_info["valid"])
+                parent_hist = jnp.pad(
+                    parent_hist,
+                    ((0, node_cap - parent_hist.shape[0]),) + ((0, 0),) * 3,
+                )
+            zf = jnp.zeros((n_sat, node_cap), jnp.float32)
+            zi = jnp.zeros((n_sat, node_cap), jnp.int32)
+            zb = jnp.zeros((n_sat, node_cap), bool)
+            bufs = {
+                "node_w": zf, "split_col": zi, "split_bin": zi,
+                "is_cat": zb, "cat_mask": jnp.zeros((n_sat, node_cap, nb_d), bool),
+                "na_left": zb, "leaf_now": jnp.ones((n_sat, node_cap), bool),
+                "leaf_val": zf, "child_base": zi, "gain": zf,
+            }
+
+            def sat_cond(carry):
+                return (carry[0] < n_sat) & (carry[4] > 0)
+
+            def sat_body(carry):
+                i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c = carry
+                d = sat_start + i
+                lkey = jax.random.fold_in(tkey, d)
+                hist = level_hist(bins_d, nb_d, sat_start, nid_c, pinfo, phist, 0)
+                nid_c, preds_c, vi_c, nsp, rec, pinfo = _level_core(
+                    hist, bins_d, nid_c, preds_c, vi_c, lkey, cols_enabled,
+                    is_cat, min_rows, min_split_improvement, learn_rate,
+                    max_abs_leaf, col_sample_rate, leaf_reg,
+                    n_pad=node_cap, n_pad_next=node_cap, cat_cols=cat_cols,
+                    n_cols_real=n_cols_real,
+                )
+                if sd:
+                    rec = dict(rec, split_bin=rec["split_bin"] << sd)
+                bufs_c = {k: bufs_c[k].at[i].set(rec[k]) for k in bufs_c}
+                # direct mode threads a fixed dummy parent carry instead
+                return (i + 1, nid_c, preds_c, vi_c, nsp,
+                        hist if subtract else phist, pinfo, bufs_c)
+
+            if not subtract:
+                # the direct scheme needs no parent-histogram/pair carry;
+                # thread dummies of fixed shape so one body serves both
+                parent_hist = jnp.zeros((node_cap, 1, 1, 1), jnp.float32)
+                pair_info = pair_info or {}
+            (_, nid, preds, varimp, n_split, parent_hist, pair_info, bufs) = (
+                jax.lax.while_loop(
+                    sat_cond, sat_body,
+                    (jnp.int32(0), nid, preds, varimp, n_split, parent_hist,
+                     pair_info, bufs),
+                )
+            )
+            prev_shift = sd
+            for j in range(n_sat):
+                recs.append({k: bufs[k][j] for k in bufs})
+            depth = max_depth
+            continue
+
         lkey = jax.random.fold_in(tkey, depth)
         sd = shifts[depth]
         nb_d = _coarse_nbins(n_bins, sd)
@@ -551,32 +715,11 @@ def _fused_levels(
                 learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
             )
             recs.append(rec)
-            continue
+            break
 
-        if depth == 0 or not subtract:
-            hist = histogram_in_jit(bins_d, nid, (w, wy, wh), n_pad, nb_d)
-        else:
-            half = n_pad // 2
-            row_pair = jnp.maximum(nid, 0) >> 1  # pair = nid//2 (child_base even)
-            row_left = (nid & 1) == 0
-            bl = pair_info["build_left"]
-            build_row = (nid >= 0) & (row_left == bl[row_pair])
-            nid_build = jnp.where(build_row, row_pair, -1)
-            built = histogram_in_jit(
-                bins_d, nid_build, (w, wy, wh), half, nb_d
-            )  # (half, C, Bc, 3)
-            # parent histogram was built at the previous level's (finer)
-            # binning — sum its data-bin groups down to this level's
-            psel = jnp.where(
-                pair_info["valid"][:, None, None, None],
-                _coarsen_hist(parent_hist, sd - prev_shift)[pair_info["parent_idx"]],
-                0.0,
-            )
-            sib = psel - built
-            blb = bl[:, None, None, None]
-            hist = jnp.stack(
-                [jnp.where(blb, built, sib), jnp.where(blb, sib, built)], axis=1
-            ).reshape(n_pad, *built.shape[1:])
+        hist = level_hist(
+            bins_d, nb_d, depth, nid, pair_info, parent_hist, sd - prev_shift
+        )
 
         if force_leaf:
             tot = hist[:, 0, :, :].sum(axis=1)
@@ -585,11 +728,11 @@ def _fused_levels(
                 learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
             )
         else:
-            nid, preds, varimp, _, rec, pair_info = _level_core(
+            nid, preds, varimp, n_split, rec, pair_info = _level_core(
                 hist, bins_d, nid, preds, varimp, lkey, cols_enabled, is_cat,
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
-                cat_cols=cat_cols,
+                cat_cols=cat_cols, n_cols_real=n_cols_real,
             )
             parent_hist = hist
             prev_shift = sd
@@ -600,6 +743,7 @@ def _fused_levels(
                 # identically either way.) cat_mask is unused: numeric-only.
                 rec = dict(rec, split_bin=rec["split_bin"] << sd)
         recs.append(rec)
+        depth += 1
     return nid, preds, varimp, tuple(recs)
 
 
@@ -611,15 +755,18 @@ def _subtract_enabled() -> bool:
 
 def use_fused_trees(max_depth: int) -> bool:
     """Single policy for every fused/scanned-tree selector (build_tree, GBM
-    and DRF scan paths): accelerators up to H2O3_TPU_FUSED_MAX_DEPTH (the
-    node_cap-bounded frontier keeps deep levels at tile cost; one dispatch
-    per tree beats per-level dispatch gaps through the tunnel). CPU — and
-    depths past the knob, where the unrolled program would compile for
-    minutes while dead-level dispatch is cheap — use the per-level loop."""
+    and DRF scan paths): the device-resident whole-tree program on EVERY
+    backend up to H2O3_TPU_FUSED_MAX_DEPTH. One dispatch per tree beats
+    per-level dispatch gaps everywhere (tunnel latency on networked TPUs,
+    Python/dispatch overhead × levels × trees on the CPU mesh), and the
+    saturated-level ``lax.while_loop`` (see :func:`_fused_levels`) keeps the
+    compile bounded at any depth — deep levels compile ONE body and early-
+    exit on device. ``H2O3_TPU_WHOLE_TREE=0`` restores the host-driven
+    per-level dispatch loop (debug/bisect escape hatch)."""
     from h2o3_tpu import config
 
     return (
-        jax.default_backend() != "cpu"
+        config.get_bool("H2O3_TPU_WHOLE_TREE")
         and max_depth <= config.get_int("H2O3_TPU_FUSED_MAX_DEPTH")
     )
 
@@ -737,42 +884,67 @@ def _level_step(
     return fn
 
 
+def _clamp_node_cap(node_cap: int, npad: int, min_rows) -> int:
+    """node_cap can't usefully exceed the next power of two ≥ the row count:
+    with min_rows ≥ 1 a split needs two rows, so the live frontier is bounded
+    by the rows and every slot past that bound is provably-dead padding the
+    fused program would still trace and execute. Capping it keeps small-frame
+    whole-tree programs (tests, AutoML folds) proportionate. The split chain
+    is unchanged by construction; only the RNG-draw width at depths past the
+    clamped cap differs from an uncapped build."""
+    if float(min_rows) < 1.0:
+        return node_cap
+    cap_rows = 1 << max(1, int(npad - 1).bit_length())
+    return max(2, min(node_cap, cap_rows))
+
+
 def _tree_program(
-    max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple
+    max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
+    n_cols_real: int | None = None, n_cols_pad: int | None = None,
 ):
-    """One jitted program building a WHOLE tree (all levels unrolled).
+    """One jitted program building a WHOLE tree (growth levels unrolled, the
+    saturated run as a lax.while_loop — see :func:`_fused_levels`).
 
     On a networked TPU every dispatch costs tens of ms of tunnel latency;
     per-level dispatch made the host gap the single largest per-tree cost
     (BENCH_r03 breakdown: 2.0 s/tree host vs 2.3 s device). One dispatch per
-    tree removes it. Levels still have level-specific node counts (the
-    frontier cap) — the unrolled program embeds each level's shapes.
+    tree removes it. ``preds``/``varimp`` are DONATED: tree t+1's dispatch
+    reuses tree t's output buffers in place, so nothing is copied and no
+    host sync sits between pipelined trees. ``n_cols_pad`` (shape bucketing)
+    pads the column axis INSIDE the program — callers pass real-width arrays
+    and get a real-width varimp back.
     """
     subtract = _subtract_enabled()
     key = ("tree", max_depth, n_bins, node_cap, cat_cols, subtract,
+           n_cols_real, n_cols_pad,
            tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
            jax.default_backend())
-    fn = _STEP_CACHE.get(key)
-    if fn is not None:
-        return fn
 
-    def whole_tree(
-        bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
-        min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
-        leaf_reg=None,
-    ):
-        nid, preds, varimp, records = _fused_levels(
+    def make():
+        def whole_tree(
             bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
             min_rows, min_split_improvement, learn_rate, max_abs_leaf,
-            col_sample_rate, leaf_reg,
-            max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
-            cat_cols=cat_cols, subtract=subtract,
-        )
-        return nid, preds, varimp, records
+            col_sample_rate, leaf_reg=None,
+        ):
+            C = bins_u8.shape[1]
+            Cp = n_cols_pad or C
+            if Cp > C:  # bucketed column pad: code 0 (NA), masked everywhere
+                bins_u8 = jnp.pad(bins_u8, ((0, 0), (0, Cp - C)))
+                is_cat = jnp.pad(is_cat, (0, Cp - C))
+                varimp = jnp.pad(varimp, (0, Cp - C))
+                cols_enabled = jnp.pad(cols_enabled, (0, Cp - C))
+            nid, preds_, varimp_, records = _fused_levels(
+                bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
+                min_rows, min_split_improvement, learn_rate, max_abs_leaf,
+                col_sample_rate, leaf_reg,
+                max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
+                cat_cols=cat_cols, subtract=subtract, n_cols_real=n_cols_real,
+            )
+            return nid, preds_, varimp_[:C], records
 
-    fn = jax.jit(whole_tree)
-    _STEP_CACHE[key] = fn
-    return fn
+        return jax.jit(whole_tree, donate_argnums=(1, 2))
+
+    return _cached_program(key, make)
 
 
 def build_trees_scanned(
@@ -819,27 +991,37 @@ def build_trees_scanned(
     stacked)`` where ``stacked`` is a tuple over levels of record dicts with
     a leading ``n_trees`` axis — convert with :func:`trees_from_stacked`.
     """
+    from h2o3_tpu.models.tree.binning import bucket_cols, bucket_nbins
+
     C = bins_u8.shape[1]
+    Cp = bucket_cols(C)  # shape-bucketed column padding (inert, see binning)
+    n_bins = bucket_nbins(n_bins)  # padded bins are empty → argmax-inert
+    node_cap = _clamp_node_cap(node_cap, bins_u8.shape[0], min_rows)
     is_cat_np = np.asarray(is_cat_cols, bool)
     cat_cols = tuple(int(i) for i in np.nonzero(is_cat_np)[0])
     is_cat_dev = jnp.asarray(is_cat_np)
 
     subtract = _subtract_enabled()
     # the float rates are baked into the traced closure, so they MUST be part
-    # of the cache key (a boolean would silently reuse another model's rates)
+    # of the cache key (a boolean would silently reuse another model's rates);
+    # C (the real column count) likewise — it sizes the traced RNG draws
     key = (
-        "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key,
+        "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key, C,
         tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
         float(sample_rate), float(col_sample_rate_per_tree), subtract,
         jax.default_backend(),
     )
-    prog = _STEP_CACHE.get(key)
-    if prog is None:
 
+    def make():
         def whole_chunk(
             bins_u8, w, y, preds, varimp, base_key, row_key_, offset, lrs, is_cat,
             min_rows_, msi_, max_abs_leaf_, col_rate_, leaf_reg_,
         ):
+            if Cp > C:  # bucketed column pad: code 0 (NA) everywhere, masked
+                bins_u8 = jnp.pad(bins_u8, ((0, 0), (0, Cp - C)))
+                is_cat = jnp.pad(is_cat, (0, Cp - C))
+                varimp = jnp.pad(varimp, (0, Cp - C))
+
             def body(carry, per_tree):
                 F, vi = carry
                 i, lr = per_tree
@@ -859,6 +1041,8 @@ def build_trees_scanned(
                     t, h = grad_fn(F, y, w_tree)
                     wy = w_tree * t
                     wh = jnp.where(w_tree > 0, h, 0.0)
+                # the per-tree column draw runs at the REAL column count C,
+                # so bucketed padding cannot perturb the sampled columns
                 if col_sample_rate_per_tree < 1.0:
                     keep = (
                         jax.random.uniform(jax.random.fold_in(tkey, 1 << 30), (C,))
@@ -868,23 +1052,28 @@ def build_trees_scanned(
                     cols_enabled = keep.astype(jnp.float32)
                 else:
                     cols_enabled = jnp.ones(C, jnp.float32)
+                if Cp > C:
+                    cols_enabled = jnp.pad(cols_enabled, (0, Cp - C))
 
                 _, F, vi, recs = _fused_levels(
                     bins_u8, F, vi, w_tree, wy, wh, tkey, cols_enabled,
                     is_cat, min_rows_, msi_, lr, max_abs_leaf_, col_rate_,
                     leaf_reg_,
                     max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
-                    cat_cols=cat_cols, subtract=subtract,
+                    cat_cols=cat_cols, subtract=subtract, n_cols_real=C,
                 )
                 return (F, vi), recs
 
             (preds, varimp), stacked = jax.lax.scan(
                 body, (preds, varimp), (jnp.arange(n_trees), lrs)
             )
-            return preds, varimp, stacked
+            return preds, varimp[:C], stacked
 
-        prog = jax.jit(whole_chunk)
-        _STEP_CACHE[key] = prog
+        # preds/varimp donated: chunk t+1 reuses chunk t's output buffers in
+        # place — the running prediction never copies between dispatches
+        return jax.jit(whole_chunk, donate_argnums=(3, 4))
+
+    prog = _cached_program(key, make)
 
     lrs = jnp.asarray(np.asarray(learn_rates, np.float32))
     leaf_reg = (
@@ -892,6 +1081,8 @@ def build_trees_scanned(
         if reg_lambda == 0.0 and reg_alpha == 0.0
         else (jnp.float32(reg_lambda), jnp.float32(reg_alpha))
     )
+    BUILD_STATS["dispatches"] += 1
+    BUILD_STATS["trees_built"] += n_trees
     return prog(
         bins_u8, w, y, preds, varimp, base_key,
         base_key if row_key is None else row_key,
@@ -993,7 +1184,9 @@ def replay_batch(bins_u8, stacked, preds):
             preds, _ = jax.lax.scan(body, preds, stacked)
             return preds
 
-        prog = jax.jit(run)
+        # preds donated: score-keeper replays pipeline behind the next
+        # chunk's build without copying the running prediction
+        prog = jax.jit(run, donate_argnums=(2,))
         _STEP_CACHE[key] = prog
     return prog(bins_u8, stacked, preds)
 
@@ -1122,7 +1315,12 @@ def build_tree(
     w=0, but must still receive leaf predictions — GBM's next-iteration
     gradients depend on F for every row).
     """
+    from h2o3_tpu.models.tree.binning import bucket_cols, bucket_nbins
+
     C = bins_u8.shape[1]
+    Cp = bucket_cols(C)  # shape-bucketed column padding (inert, see binning)
+    n_bins = bucket_nbins(n_bins)  # padded bins are empty → argmax-inert
+    node_cap = _clamp_node_cap(node_cap, bins_u8.shape[0], min_rows)
     is_cat_dev = jnp.asarray(np.asarray(is_cat_cols, bool))
     wy = w * t
     wh = jnp.where(w > 0, h, 0.0)  # sampled-out rows carry no hessian either
@@ -1158,6 +1356,7 @@ def build_tree(
             force_leaf = depth == max_depth
             step = _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols)
             lkey = jax.random.fold_in(key, depth)
+            BUILD_STATS["dispatches"] += 1
             nid, preds, varimp, n_split, rec, node_lo, node_hi = step(
                 bins_u8, nid, preds, varimp, w, wy, wh, lkey,
                 cols_enabled_dev, is_cat_dev,
@@ -1171,11 +1370,16 @@ def build_tree(
                 break
             if jax.default_backend() == "cpu" and int(n_split) == 0:
                 break
+        BUILD_STATS["trees_built"] += 1
         return tree, preds, varimp
 
     fused = use_fused_trees(max_depth)
     if fused:
-        prog = _tree_program(max_depth, n_bins, node_cap, cat_cols)
+        prog = _tree_program(
+            max_depth, n_bins, node_cap, cat_cols, n_cols_real=C, n_cols_pad=Cp
+        )
+        BUILD_STATS["dispatches"] += 1
+        BUILD_STATS["trees_built"] += 1
         _, preds, varimp, records = prog(
             bins_u8, preds, varimp, w, wy, wh, key, cols_enabled_dev,
             is_cat_dev,
@@ -1194,6 +1398,7 @@ def build_tree(
         force_leaf = depth == max_depth
         step = _level_step(n_pad, n_pad_next, n_bins, force_leaf, cat_cols)
         lkey = jax.random.fold_in(key, depth)
+        BUILD_STATS["dispatches"] += 1
         nid, preds, varimp, n_split, rec = step(
             bins_u8, nid, preds, varimp, w, wy, wh, lkey, cols_enabled_dev,
             is_cat_dev,
@@ -1213,4 +1418,5 @@ def build_tree(
         elif depth >= 8 and depth % 4 == 0 and int(n_split) == 0:
             break
 
+    BUILD_STATS["trees_built"] += 1
     return tree, preds, varimp

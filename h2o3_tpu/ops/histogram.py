@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
+from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
 
 # Rows per scatter chunk: XLA materializes the vmapped scatter's updates as
 # a (C, chunk, S) f32 broadcast (~1.2 KB/row at C=28, S=4 — measured 13.4 GB
@@ -192,7 +192,7 @@ def histogram_in_jit(bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh=None):
     # ph_hist: phase tag consumed by tools/profile_fused.py (HLO op_name
     # metadata carries the scope path into the profiler trace)
     with jax.named_scope("ph_hist"):
-        h = jax.shard_map(
+        h = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),

@@ -23,6 +23,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ROWS_AXIS = "rows"
 
+# jax moved shard_map to the top level (and renamed check_rep -> check_vma)
+# after 0.4.x; every shard_map in this codebase goes through this one shim so
+# the whole stack runs on either API generation.
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+else:  # jax 0.4.x: experimental module, kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
 _mesh: Mesh | None = None
 
 
@@ -53,15 +73,40 @@ def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
     return NamedSharding(mesh or get_mesh(), P())
 
 
+_ROW_BUCKET_MIN = 1 << 16  # frames below this keep exact shard-aligned pads
+
+
+def _bucket_rows(n: int) -> int:
+    """Row-count bucket: round up to 5 significant bits (steps ≤ 3.125%).
+
+    Part of the shape-bucket ladder (H2O3_TPU_SHAPE_BUCKETS): AutoML/grid
+    runs over frames of near-identical row counts (CV folds, sampled
+    frames, train/valid splits) then share one compiled program per
+    algorithm instead of recompiling per exact row count. Every padded row
+    is real device work on every build, so the ladder is deliberately
+    fine — ≤3.1% pad buys the collapse of the ±few-percent row-count
+    variation that actually occurs; a coarser ladder charged the 1M-row
+    headline ~5% forever. Only frames above _ROW_BUCKET_MIN bucket —
+    small-frame compiles are cheap and exact shapes keep tests/debug
+    predictable."""
+    from h2o3_tpu import config
+
+    if n <= _ROW_BUCKET_MIN or not config.get_bool("H2O3_TPU_SHAPE_BUCKETS"):
+        return n
+    step = 1 << (n.bit_length() - 5)
+    return -(-n // step) * step
+
+
 def pad_to_shards(n: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
-    """Padded row count: a multiple of (shards * multiple) ≥ n.
+    """Padded row count: a multiple of (shards * multiple) ≥ n, bucketed to
+    the row ladder above _ROW_BUCKET_MIN (see :func:`_bucket_rows`).
 
     The per-shard row count is kept a multiple of 8 (f32 sublane tile) so
     device layouts stay tiling-friendly.
     """
     m = (mesh or get_mesh()).shape[ROWS_AXIS]
     block = m * multiple
-    return max(block, ((n + block - 1) // block) * block)
+    return max(block, ((_bucket_rows(n) + block - 1) // block) * block)
 
 
 def shard_rows(arr, mesh: Mesh | None = None):

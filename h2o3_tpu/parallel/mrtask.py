@@ -30,9 +30,8 @@ from typing import Callable
 
 import jax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
-from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
+from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
 
 
 # Compiled-task cache keyed on (map_fn, arity, mesh, reduce?) — the analog of

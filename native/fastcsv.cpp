@@ -21,9 +21,12 @@
 // concatenates columns in row order.
 
 #include <atomic>
+#include <cctype>
+#include <cerrno>
 #include <cmath>
 #include <charconv>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
@@ -72,10 +75,42 @@ inline bool is_na(const char* b, size_t n) {
   return false;
 }
 
-// trim spaces and a trailing \r (pandas default skipinitialspace=False
-// keeps interior spaces; we trim only the \r plus fully-blank fields)
+// trim the \r of a \r\n line ending. ONLY valid for the final field of a
+// row (the caller gates on at_end): pandas' C parser treats a lone '\r' as
+// a line terminator, so any '\r' not followed by '\n' means the two paths
+// would tokenize different rows — fastcsv_parse prescans and bails to the
+// pandas path for such buffers (rc -5) instead of guessing.
 inline void trim_cr(const char*& b, size_t& n) {
   if (n && b[n - 1] == '\r') --n;
+}
+
+// Whole-field double parse, from_chars{general} semantics: no leading
+// whitespace or '+', no hex, entire field consumed. libstdc++ < 11 ships
+// no floating-point std::from_chars, so older toolchains fall back to
+// glibc strtod (correctly rounded, same result bits) with the laxer
+// strtod acceptances rejected up front. strtod reads LC_NUMERIC's decimal
+// point — embedding interpreters leave it "C" unless the host app calls
+// setlocale, which is outside this parser's contract either way.
+inline bool parse_f64(const char* fb, size_t fn, double& v) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  auto [p, ec] = std::from_chars(fb, fb + fn, v);
+  return ec == std::errc() && p == fb + fn;
+#else
+  if (fn == 0) return false;
+  const unsigned char c0 = static_cast<unsigned char>(fb[0]);
+  if (fb[0] == '+' || std::isspace(c0)) return false;
+  const size_t d = (fb[0] == '-') ? 1 : 0;
+  if (fn > d + 1 && fb[d] == '0' && (fb[d + 1] == 'x' || fb[d + 1] == 'X'))
+    return false;
+  std::string tmp(fb, fn);  // strtod needs NUL termination
+  errno = 0;
+  char* endp = nullptr;
+  v = std::strtod(tmp.c_str(), &endp);
+  if (endp != tmp.c_str() + fn) return false;
+  if (errno == ERANGE && (v == HUGE_VAL || v == -HUGE_VAL))
+    return false;  // overflow -> pandas decides (from_chars errors here too)
+  return true;
+#endif
 }
 
 void parse_range(const char* buf, int64_t begin, int64_t end, char sep,
@@ -101,19 +136,16 @@ void parse_range(const char* buf, int64_t begin, int64_t end, char sep,
         if (col >= ncols) { out->error = 1; return; }
         const char* fb = buf + f0;
         size_t fn = static_cast<size_t>(i - f0);
-        trim_cr(fb, fn);
+        if (at_end) trim_cr(fb, fn);  // only the field ending at EOL owns \r
         if (kinds[col] == 0) {
           double v;
           if (is_na(fb, fn)) {
             v = std::nan("");
-          } else {
-            auto [p, ec] = std::from_chars(fb, fb + fn, v);
-            if (ec != std::errc() || p != fb + fn) {
-              // tolerate leading '+' which from_chars rejects
-              if (fn > 1 && fb[0] == '+') {
-                auto [p2, ec2] = std::from_chars(fb + 1, fb + fn, v);
-                if (ec2 != std::errc() || p2 != fb + fn) { out->error = 2; return; }
-              } else { out->error = 2; return; }
+          } else if (!parse_f64(fb, fn, v)) {
+            // tolerate leading '+' which from_chars-style parsing rejects
+            if (!(fn > 1 && fb[0] == '+' && parse_f64(fb + 1, fn - 1, v))) {
+              out->error = 2;
+              return;
             }
           }
           out->cols[col].nums.push_back(v);
@@ -150,7 +182,7 @@ extern "C" {
 
 // Parse the whole buffer. Returns an opaque handle (call fastcsv_free), or
 // nullptr with *rc set: -1 quote found, -2 ragged row, -3 numeric parse
-// failure, -4 bad args.
+// failure, -4 bad args, -5 stray \r outside a \r\n line ending.
 void* fastcsv_parse(const char* buf, int64_t len, char sep, int skip_header,
                     int ncols, const int* kinds, int n_threads, int* rc) {
   *rc = 0;
@@ -158,6 +190,19 @@ void* fastcsv_parse(const char* buf, int64_t len, char sep, int skip_header,
   if (std::memchr(buf, '"', static_cast<size_t>(len)) != nullptr) {
     *rc = -1;  // quoted dialect -> pandas
     return nullptr;
+  }
+  // stray '\r' (not part of a \r\n ending): pandas' C parser treats a lone
+  // \r as a line terminator, which would split rows differently than the
+  // \n-scan below — bail to pandas rather than silently keeping the byte
+  // inside a field (or mis-trimming it from a non-final field).
+  {
+    const char* p = buf;
+    const char* bend = buf + len;
+    while ((p = static_cast<const char*>(
+                std::memchr(p, '\r', static_cast<size_t>(bend - p)))) != nullptr) {
+      if (p + 1 >= bend || p[1] != '\n') { *rc = -5; return nullptr; }
+      ++p;
+    }
   }
   int64_t begin = 0;
   if (skip_header) {

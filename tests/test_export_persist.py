@@ -100,6 +100,40 @@ def test_glm_mojo_parity(tmp_path):
     _parity(m, df, tmp_path, "pos")
 
 
+def test_glm_hashed_mojo_parity(tmp_path):
+    """Export→score round trip for a feature-HASHED GLM: the artifact ships
+    hash_buckets (no domain — the point of hashing is that the train domain
+    may be Criteo-sized) and the offline scorer re-derives each bucket from
+    the raw level string via crc32(col \\0 level) % hash_buckets, including
+    the bucket-0 reference-level drop (GLM fits use_all_factor_levels=False).
+    Scoring rows include levels NEVER seen in training — hashing must bucket
+    them identically on both paths, not NA them."""
+    rng = np.random.default_rng(9)
+    n, card = 2000, 200
+    code = rng.integers(0, card, n)
+    df = pd.DataFrame({
+        "c": pd.Categorical.from_codes(
+            code, categories=[f"v{i}" for i in range(card)]
+        ),
+        "num1": rng.normal(size=n),
+    })
+    eta = df["num1"] + np.where(code % 2 == 0, 1.0, -1.0)
+    df["y"] = np.where(eta + rng.normal(size=n) > 0, "pos", "neg")
+    fr = Frame.from_pandas(df)
+    m = GLM(family="binomial", lambda_=1e-4, hash_buckets=16,
+            max_iterations=20).train(y="y", training_frame=fr)
+    assert m.output["datainfo"].hash_buckets == 16  # hashing actually on
+    mojo = _parity(m, df, tmp_path, "pos")
+    assert mojo.meta["datainfo"]["hash_buckets"] == 16
+    # unseen level: identical buckets (hence probabilities) on both paths
+    df2 = df.head(8).copy()
+    df2["c"] = [f"unseen{i}" for i in range(8)]
+    fr2 = Frame.from_pandas(df2)
+    a = np.asarray(m.predict(fr2).vec("pos").to_numpy(), np.float64)
+    b = np.asarray(mojo.predict(df2.drop(columns=["y"]))["pos"], np.float64)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=0)
+
+
 def test_deeplearning_mojo_parity(tmp_path):
     df = _df(seed=6)
     fr = Frame.from_pandas(df)

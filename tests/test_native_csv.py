@@ -62,6 +62,26 @@ def test_na_spellings_and_crlf(tmp_path):
     assert str(g.iloc[0]) == "x" and pd.isna(g.iloc[1]) and pd.isna(g.iloc[2])
 
 
+def test_stray_cr_bails_to_pandas(tmp_path):
+    """A '\\r' outside a \\r\\n line ending must NOT be silently trimmed from
+    (or kept inside) a field: pandas' C parser treats a lone \\r as a line
+    terminator, so the native path declines and the import goes through
+    pandas — both paths then see the same rows."""
+    # interior \r inside a non-final enum field, and one ending a non-final
+    # field — historically trim_cr stripped the latter, diverging from pandas
+    for text in ("a,g\n1.5,x\ry\n2.5,z\n", "a,g\n1.5,w\r,z\n"):
+        path = _csv(tmp_path, text)
+        assert P._try_native_csv(path, ",") is None
+    # \r\n endings (every \r followed by \n) stay ON the fast path, and the
+    # final field comes out \r-free
+    path = _csv(tmp_path, "a,g\r\n1.5,x\r\n2.5,y\r\n")
+    got = P._try_native_csv(path, ",")
+    assert got is not None
+    assert [str(v) for v in got["g"]] == ["x", "y"]
+    ref = pd.read_csv(path)
+    assert list(ref["g"]) == ["x", "y"]
+
+
 def test_na_set_matches_pandas_exactly(tmp_path):
     """'None' IS pandas-NA; 'NAN' is NOT — both paths must agree."""
     path = _csv(tmp_path, "g\na\nNone\nNAN\nb\n")

@@ -1,0 +1,112 @@
+"""Device-resident whole-tree build contracts (ISSUE 1): O(1) host
+dispatches per tree, shape-bucketed padding that is provably inert, and
+compile amortization across same-shape builds."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.tree import GBM
+from h2o3_tpu.models.tree import shared_tree as st
+
+
+def _df(n=2000, seed=0, c=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, c))
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(c)])
+    df["y"] = X[:, 0] * 2 - X[:, 1] + 0.3 * rng.normal(size=n)
+    return df
+
+
+def _train(fr, **kw):
+    params = dict(ntrees=10, max_depth=4, seed=7, distribution="gaussian",
+                  score_tree_interval=5)
+    params.update(kw)
+    return GBM(**params).train(y="y", training_frame=fr)
+
+
+def test_whole_tree_dispatches_o1_per_tree():
+    """The whole-tree contract: host dispatches per tree are O(1), not
+    O(depth). With the scanned chunk builder they are FRACTIONAL (one
+    dispatch covers a whole scoring interval); the per-level escape hatch
+    (H2O3_TPU_WHOLE_TREE=0) pays >= depth dispatches per tree — the counter
+    must see both regimes or it is not counting."""
+    fr = Frame.from_pandas(_df())
+    st.reset_build_stats()
+    _train(fr)
+    fused = st.reset_build_stats()
+    assert fused["trees_built"] == 10
+    # ntrees=10, interval=5 -> 2 chunk dispatches, NOT 10 * (depth + 1)
+    assert fused["dispatches"] <= 2
+    assert fused["dispatches"] / fused["trees_built"] < 1  # O(1), amortized
+
+
+def test_per_level_escape_hatch_dispatches_o_depth(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_WHOLE_TREE", "0")
+    fr = Frame.from_pandas(_df())
+    st.reset_build_stats()
+    _train(fr)
+    legacy = st.reset_build_stats()
+    assert legacy["trees_built"] == 10
+    # per-level loop: every tree pays at least one dispatch per grown level
+    assert legacy["dispatches"] >= legacy["trees_built"] * 2
+    assert legacy["dispatches"] > 10 * 2  # strictly worse than whole-tree
+
+
+def test_bucketed_padding_scores_identical(monkeypatch):
+    """Shape-bucketed padding (H2O3_TPU_SHAPE_BUCKETS) must be inert: a
+    bucketed build (cols padded to 8, bins to a power of two) scores
+    IDENTICALLY to the exact-shape build — padded bins are empty, padded
+    columns are disabled, and the column-sampling RNG draws at the real
+    column count. Uses col_sample_rate < 1 so the RNG-width guarantee is
+    actually load-bearing."""
+    df = _df(c=5)  # 5 cols -> pads to 8 when bucketing
+    kw = dict(col_sample_rate=0.7, sample_rate=0.8)
+
+    monkeypatch.setenv("H2O3_TPU_SHAPE_BUCKETS", "1")
+    fr = Frame.from_pandas(df)
+    p_bucketed = _train(fr, **kw).predict(fr).vec("predict").to_numpy()
+    vi_bucketed = _train(fr, **kw).varimp()
+
+    monkeypatch.setenv("H2O3_TPU_SHAPE_BUCKETS", "0")
+    fr = Frame.from_pandas(df)
+    p_exact = _train(fr, **kw).predict(fr).vec("predict").to_numpy()
+    vi_exact = _train(fr, **kw).varimp()
+
+    np.testing.assert_array_equal(np.asarray(p_bucketed), np.asarray(p_exact))
+    assert len(vi_bucketed) == len(vi_exact)  # no phantom padded columns
+    for ra, rb in zip(vi_bucketed, vi_exact):
+        assert ra["variable"] == rb["variable"]
+        assert float(ra["relative_importance"]) == pytest.approx(
+            float(rb["relative_importance"])
+        )
+
+
+def test_same_shape_twice_compiles_once():
+    """Two GBMs of the same shape in one process: the second build's tree
+    programs must ALL come from the in-process cache (zero compiles) —
+    the compile-amortization half of the whole-tree design."""
+    fr = Frame.from_pandas(_df(seed=1))
+    _train(fr)  # whatever this compiles...
+    st.reset_build_stats()
+    _train(fr, seed=99)  # ...a same-shape rebuild reuses, seed is not shape
+    again = st.reset_build_stats()
+    assert again["tree_programs_compiled"] == 0
+    assert again["tree_program_cache_hits"] >= 1
+
+
+def test_nbins_bucket_collapses_nearby_shapes(monkeypatch):
+    """The bin-axis ladder: nbins 100 and 120 both round to 128, so the
+    second model's tree program is a cache HIT — the AutoML/grid sweep
+    amortization the ladder exists for. (Bin EDGES still differ — only the
+    compiled program is shared, not the splits.)"""
+    monkeypatch.setenv("H2O3_TPU_SHAPE_BUCKETS", "1")
+    # many distinct values so fit_bins actually uses ~nbins quantile bins
+    fr = Frame.from_pandas(_df(n=4000, seed=2))
+    _train(fr, nbins=100)
+    st.reset_build_stats()
+    _train(fr, nbins=120)
+    stats = st.reset_build_stats()
+    assert stats["tree_programs_compiled"] == 0
+    assert stats["tree_program_cache_hits"] >= 1
