@@ -820,6 +820,10 @@ class Endpoints:
             kwargs["nfolds"] = spec["nfolds"]
         if spec.get("project_name"):
             kwargs["project_name"] = spec["project_name"]
+        if spec.get("export_checkpoints_dir"):
+            # crash recovery over REST (docs/RECOVERY.md); rejected by
+            # _exec_automl on multi-process clouds like the grid analog
+            kwargs["export_checkpoints_dir"] = spec["export_checkpoints_dir"]
         for src in ("include_algos", "exclude_algos"):
             if build_models.get(src):
                 kwargs[src] = build_models[src]
@@ -1127,6 +1131,10 @@ def _job_schema(j: Job) -> dict:
         "progress": j.progress,
         "exception": j.exception,
         "dest": {"name": getattr(getattr(j, "result", None), "key", "")} if j.result is not None else None,
+        # crash-recovery pointer (latest interval checkpoint) — present when
+        # the build ran with export_checkpoints_dir, so a FAILED job tells
+        # the operator exactly what to resume from (docs/RECOVERY.md)
+        **({"recovery": j.recovery} if getattr(j, "recovery", None) else {}),
     }
 
 

@@ -27,6 +27,7 @@ from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import Model, stopping_metric_direction
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 
@@ -53,6 +54,12 @@ class AutoMLSpec:
     # incumbent best GBM is refined with annealed learn-rate + more trees,
     # and the refinement build is capped at ratio * max_runtime_secs
     exploitation_ratio: float = 0.0
+    # crash durability (docs/RECOVERY.md): every finished model/grid step is
+    # saved here and recorded in an AutoML manifest keyed by project_name, so
+    # a killed run restarted with the SAME spec+data recovers the finished
+    # steps from disk instead of rebuilding them. Grid steps additionally
+    # recover per-model through the grid manifest in the same directory.
+    export_checkpoints_dir: str | None = None
 
 
 class Leaderboard:
@@ -118,6 +125,70 @@ class Leaderboard:
         for r in self.as_table():
             lines.append("  " + "  ".join(f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}" for k, v in r.items()))
         return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# AutoML checkpoint manifest (extends the grid-manifest pattern in
+# models/grid.py to whole modeling steps; written atomically through persist)
+
+
+def _automl_id(spec: "AutoMLSpec") -> str:
+    return spec.project_name or "automl"
+
+
+def _automl_fingerprint(spec: "AutoMLSpec", x, y, train) -> str:
+    """Invalidates recovery when anything but the checkpoint dir changed.
+    NOTE: the training frame enters by KEY — stable recovery across process
+    restarts needs a stable frame key (``destination_frame=``)."""
+    import dataclasses
+    import hashlib
+    import json
+
+    sd = {f.name: getattr(spec, f.name) for f in dataclasses.fields(spec)
+          if f.name != "export_checkpoints_dir"}
+    payload = json.dumps(
+        {"spec": sd, "x": list(x) if x else None, "y": y,
+         "frame": getattr(train, "key", str(train))},
+        sort_keys=True, default=str,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _automl_manifest_path(ckdir: str, aml_id: str) -> str:
+    import os
+
+    return os.path.join(ckdir, f"{aml_id}.automl.json")
+
+
+def _read_automl_manifest(ckdir: str, aml_id: str, fingerprint: str) -> dict[str, list[str]]:
+    import json
+    import os
+
+    path = _automl_manifest_path(ckdir, aml_id)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("fingerprint") not in (None, fingerprint):
+        Log.warn(
+            f"AutoML {aml_id}: checkpoint dir was built with a different "
+            "spec / data — ignoring it and rebuilding"
+        )
+        return {}
+    return {k: list(v) for k, v in payload.get("steps", {}).items()}
+
+
+def _write_automl_manifest(ckdir: str, aml_id: str, fingerprint: str,
+                           steps: dict[str, list[str]]) -> None:
+    import json
+
+    from h2o3_tpu.persist import write_bytes
+
+    write_bytes(
+        json.dumps({"automl_id": aml_id, "fingerprint": fingerprint,
+                    "steps": steps}).encode(),
+        _automl_manifest_path(ckdir, aml_id),
+    )
 
 
 @dataclass
@@ -341,6 +412,31 @@ class AutoML:
         total_w = sum(st.weight for st in plan) or 1
         done_w = 0
 
+        # crash recovery: finished steps recorded in the AutoML manifest
+        # reload from the checkpoint dir instead of rebuilding (grid steps
+        # additionally recover per-model through the grid manifest)
+        ckdir = s.export_checkpoints_dir
+        aml_id = _automl_id(s)
+        fingerprint = None
+        step_models: dict[str, list[str]] = {}
+        if ckdir:
+            fingerprint = _automl_fingerprint(s, x, y, train)
+            step_models = _read_automl_manifest(ckdir, aml_id, fingerprint)
+
+        def _recover_step(st) -> list[Model] | None:
+            if not ckdir or st.name not in step_models:
+                return None
+            from h2o3_tpu.models.grid import _load_checkpointed
+
+            ms = [_load_checkpointed(ckdir, k) for k in step_models[st.name]]
+            return ms if ms and all(m is not None for m in ms) else None
+
+        def _record_step(st, models: list[Model]) -> None:
+            if not ckdir:
+                return
+            step_models[st.name] = [m.key for m in models]
+            _write_automl_manifest(ckdir, aml_id, fingerprint, step_models)
+
         for st in plan:
             if self._remaining() <= 0:
                 self._log("budget", "max_runtime_secs exhausted; stopping plan")
@@ -354,16 +450,44 @@ class AutoML:
                 continue
             try:
                 if st.kind == "model":
-                    m = self._builder(st.algo, {**st.params, **self._common()}).train(
-                        x=x, y=y, training_frame=train, validation_frame=validation_frame
-                    )
-                    if self._te is not None:
-                        m.preprocessors.append(self._te)
-                    self.leaderboard.add(m)
-                    n_models_built += 1
-                    self._update_family_best(family_best, m)
-                    self._log("model", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
+                    recovered = _recover_step(st)
+                    if recovered is not None:
+                        for m in recovered:
+                            self.leaderboard.add(m)
+                            n_models_built += 1
+                            self._update_family_best(family_best, m)
+                        self._log("recover", f"{st.name} recovered from checkpoint dir")
+                    else:
+                        mkw = {**st.params, **self._common()}
+                        if ckdir:
+                            # the builder's own _drive saves the finished
+                            # model into the dir AND writes interval
+                            # snapshots while building (crash protection
+                            # within the step, not just between steps)
+                            mkw["export_checkpoints_dir"] = ckdir
+                        m = self._builder(st.algo, mkw).train(
+                            x=x, y=y, training_frame=train, validation_frame=validation_frame
+                        )
+                        if self._te is not None:
+                            m.preprocessors.append(self._te)
+                        self.leaderboard.add(m)
+                        n_models_built += 1
+                        self._update_family_best(family_best, m)
+                        _record_step(st, [m])
+                        self._log("model", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
+                    faults.abort_check("automl", n_models_built)
                 elif st.kind == "grid":
+                    recovered = _recover_step(st)
+                    if recovered is not None:
+                        for m in recovered:
+                            self.leaderboard.add(m)
+                            n_models_built += 1
+                            self._update_family_best(family_best, m)
+                        self._log("recover", f"{st.name} recovered {len(recovered)} models from checkpoint dir")
+                        faults.abort_check("automl", n_models_built)
+                        done_w += st.weight
+                        job.update(done_w / total_w)
+                        continue
                     from h2o3_tpu.models.grid import GridSearch, SearchCriteria
 
                     budget = self._remaining()
@@ -377,16 +501,26 @@ class AutoML:
                         stopping_metric=s.stopping_metric,
                         stopping_tolerance=s.stopping_tolerance,
                     )
+                    gkw = {**st.params, **self._common()}
+                    grid_id = None
+                    if ckdir:
+                        # a stable grid id + shared dir lets a killed grid
+                        # step recover its finished combos per-model through
+                        # the grid manifest on the next run
+                        gkw["export_checkpoints_dir"] = ckdir
+                        grid_id = f"{aml_id}_{st.name}"
                     gs = GridSearch(self._builder_cls(st.algo), st.hyper,
-                                    search_criteria=crit,
-                                    **{**st.params, **self._common()})
+                                    search_criteria=crit, grid_id=grid_id,
+                                    **gkw)
                     grid = gs.train(x=x, y=y, training_frame=train,
                                     validation_frame=validation_frame)
                     self.leaderboard.add(*grid.models)
                     n_models_built += len(grid.models)
                     for m in grid.models:
                         self._update_family_best(family_best, m)
+                    _record_step(st, grid.models)
                     self._log("grid", f"{st.name} built {len(grid.models)} models")
+                    faults.abort_check("automl", n_models_built)
                 elif st.kind == "exploit":
                     if s.exploitation_ratio <= 0:
                         pass  # disabled by default, like upstream
@@ -402,6 +536,8 @@ class AutoML:
                     if m is not None:
                         self.leaderboard.add(m)
                         self._log("ensemble", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
+            except faults.TrainAbort:
+                raise  # simulated kill -9: die with the manifest on disk
             except Exception as e:
                 self._log("error", f"{st.name} failed: {e!r}")
             done_w += st.weight

@@ -139,6 +139,17 @@ def degraded_reason() -> str | None:
     return _degraded
 
 
+def clear_degraded() -> None:
+    """Un-latch the degraded flag. The latch is one-way BY DESIGN in
+    production (restart is the recovery path) — this exists for the chaos
+    test suite and for an operator who has verified every rank restarted
+    clean and wants the coordinator process reusable."""
+    global _degraded
+    if _degraded is not None:
+        Log.warn(f"cloud degraded latch cleared (was: {_degraded})")
+    _degraded = None
+
+
 def cluster_info() -> dict:
     m = _mesh.get_mesh()
     # per-device health (the /3/Cloud node-table analog): a device that

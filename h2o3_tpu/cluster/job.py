@@ -40,6 +40,7 @@ class Job:
         self.result: Any = None
         self.start_time: float | None = None
         self.end_time: float | None = None
+        self._error: BaseException | None = None
         self._work = work
         self._cancel_requested = threading.Event()
         self._thread: threading.Thread | None = None
@@ -47,6 +48,10 @@ class Job:
         # truncate GRACEFULLY (partial model kept) — unlike cancel(), which
         # aborts via the JobCancelled raise in update()
         self.soft_deadline: float | None = None
+        # crash-recovery state: builders with export_checkpoints_dir record
+        # their latest interval snapshot here, so a FAILED job still tells
+        # operators (over /3/Jobs) where to resume from (docs/RECOVERY.md)
+        self.recovery: dict | None = None
         DKV.put(self.key, self)
 
     # -- driver-side API (the work callable calls these) --
@@ -78,8 +83,9 @@ class Job:
                 self.status = Job.DONE
             except JobCancelled:
                 self.status = Job.CANCELLED
-            except Exception:
+            except Exception as e:
                 self.exception = traceback.format_exc()
+                self._error = e
                 self.status = Job.FAILED
                 Log.err(f"Job {self.key} failed:\n{self.exception}")
             finally:
@@ -97,7 +103,21 @@ class Job:
     def join(self, timeout: float | None = None) -> Any:
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                # still running: a silent partial/None return here let
+                # callers mistake "not done yet" for "done with no result"
+                raise TimeoutError(
+                    f"Job {self.key} still running after {timeout}s "
+                    f"(progress {self.progress:.0%}) — poll again or cancel()"
+                )
         if self.status == Job.FAILED:
+            from h2o3_tpu.utils import faults
+
+            if isinstance(self._error, faults.TrainAbort):
+                # simulated process death must keep its identity: the grid/
+                # AutoML drivers re-raise it instead of logging a combo
+                # failure (a real kill -9 gives them no chance either)
+                raise self._error
             raise RuntimeError(f"Job {self.key} failed:\n{self.exception}")
         if self.status == Job.CANCELLED:
             raise JobCancelled(self.key)
@@ -117,4 +137,5 @@ class Job:
             "exception": self.exception,
             "start_time": self.start_time,
             "end_time": self.end_time,
+            **({"recovery": self.recovery} if self.recovery else {}),
         }

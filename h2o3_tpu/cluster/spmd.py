@@ -314,6 +314,12 @@ def _exec_automl(kwargs, y, train, dest):
 
     _require_deterministic_budget("AutoML", kwargs.get("max_runtime_secs"))
     if multi_process():
+        if kwargs.get("export_checkpoints_dir"):
+            raise ValueError(
+                "AutoML export_checkpoints_dir is not supported on a "
+                "multi-process cloud: per-rank manifest recovery/writes "
+                "desynchronize the replicated sequence (same rule as grids)"
+            )
         # AutoMLSpec defaults max_runtime_secs to 3600 — a wall-clock budget
         # the ranks' clocks would apply differently; force it off and demand
         # the deterministic budget + seed instead
@@ -492,6 +498,9 @@ def run(cmd: str, **kwargs):
                 "restart the cloud; recover models from checkpoints"
             )
         try:
+            from h2o3_tpu.utils import faults
+
+            faults.death_check("spmd_run")  # chaos: synthetic dead member
             _bcast_bytes(pickle.dumps((cmd, kwargs)))
             with replicated_section():
                 return _COMMANDS[cmd](**kwargs)

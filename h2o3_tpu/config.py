@@ -73,6 +73,19 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_HEARTBEAT_TIMEOUT": (
         "100", "multi-host dead-member detection bound, seconds "
         "(jax coordination-service heartbeat timeout)"),
+    "H2O3_TPU_PERSIST_RETRIES": (
+        "4", "transient persist IO failures are retried this many times "
+             "before surfacing (deterministic errors — bad path, collision, "
+             "corrupt file — always fail fast, preserving spmd lockstep)"),
+    "H2O3_TPU_PERSIST_BACKOFF": (
+        "0.2", "base persist retry backoff, seconds: delay = base * 2^attempt "
+               "plus up to +50% DETERMINISTIC jitter (keyed on op+attempt, "
+               "identical on every rank and every run)"),
+    "H2O3_TPU_FAULTS": (
+        "", "fault-injection spec for the chaos suite (utils/faults.py): "
+            "';'-separated entries — 'site=N' fails the first N IO calls at "
+            "the site, 'site@K' aborts training at iteration K, 'death:site' "
+            "raises a synthetic coordination-service death error. '' = off"),
 }
 
 
@@ -83,6 +96,10 @@ def get(name: str) -> str:
 
 def get_int(name: str) -> int:
     return int(get(name))
+
+
+def get_float(name: str) -> float:
+    return float(get(name))
 
 
 def get_bool(name: str) -> bool:

@@ -36,6 +36,7 @@ from h2o3_tpu.models.model_base import (
     ModelBuilder,
     ScoreKeeper,
 )
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 
@@ -92,12 +93,15 @@ class _MLP(nn.Module):
 
 
 def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
-                  nrow: int, npad: int, key, start_epochs: int = 0):
+                  nrow: int, npad: int, key, start_epochs: int = 0,
+                  on_epoch=None):
     """The shared sync-SGD epoch driver for both supervised and autoencoder
     training: permutation shuffling, lax.scan over mini-batches, epoch-loss
     early stopping, checkpoint RNG alignment. ``loss_fn(prm, xb, yb, wb,
     kb)`` supplies the per-batch objective (yb is the permuted target slice
-    — unused by the autoencoder loss). Returns (params, opt_state, history,
+    — unused by the autoencoder loss). ``on_epoch(params, opt_state,
+    epochs_done, history)`` fires at every epoch boundary — the interval-
+    checkpoint/fault hook. Returns (params, opt_state, history,
     epochs_done)."""
     batch = min(int(p.mini_batch_size), npad)
     nbatch = max(1, nrow // batch)
@@ -146,6 +150,8 @@ def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
         epochs_done = e + 1
         history.append({"epoch": e + 1, "loss": float(mean_loss)})
         keeper.record(float(mean_loss))
+        if on_epoch is not None:
+            on_epoch(params, opt_state, epochs_done, history)
         job.update(0.05 + 0.9 * (e + 1) / n_epochs)
         if keeper.should_stop() or job.stop_requested:
             Log.info(f"DeepLearning early stop at epoch {e + 1}")
@@ -262,6 +268,24 @@ class DeepLearning(ModelBuilder):
     algo = "deeplearning"
     PARAMS_CLS = DeepLearningParams
 
+    def _epoch_snapshot(self, key, di, prm, ost, done, hist, domain,
+                        autoencoder=False, expanded=None) -> DeepLearningModel:
+        """Interval-snapshot factory: params + optimizer accumulators +
+        epoch count — everything the existing checkpoint-resume path reads
+        (``apply_fn`` is rebuilt on load by persist._rebuild_deeplearning)."""
+        p = self.params
+        out = {
+            "datainfo": di, "params": prm, "names": list(self._x),
+            "hidden": list(p.hidden), "epochs_trained": done,
+            "opt_state": ost, "response_domain": domain,
+        }
+        if autoencoder:
+            out["autoencoder"] = True
+            out["expanded_names"] = expanded
+        m = DeepLearningModel(key, p, out)
+        m.scoring_history = list(hist)
+        return m
+
     def _build_autoencoder(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         """Autoencoder mode (upstream ``autoencoder=true`` /
         H2OAutoEncoderEstimator): reconstruct the standardized design
@@ -318,10 +342,19 @@ class DeepLearning(ModelBuilder):
                 loss += l1 * sum(jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm))
             return loss
 
+        def on_epoch(prm, ost, done, hist):
+            self._export_interval_checkpoint(
+                job, lambda key: self._epoch_snapshot(
+                    key, di, prm, ost, done, hist, None,
+                    autoencoder=True, expanded=di.coef_names(),
+                )
+            )
+            faults.abort_check(self.algo, done)
+
         params, opt_state, history, epochs_done = _run_sync_sgd(
             job, p, loss_fn, tx, params, opt_state,
             X, jnp.zeros(train.npad, jnp.float32), w,
-            train.nrow, train.npad, key, start_epochs,
+            train.nrow, train.npad, key, start_epochs, on_epoch=on_epoch,
         )
 
         apply_fn = jax.jit(lambda prm, xx: mlp.apply(prm, xx, train=False))
@@ -425,9 +458,19 @@ class DeepLearning(ModelBuilder):
                 )
             return loss
 
+        domain = tuple(yv.domain) if classification else None
+
+        def on_epoch(prm, ost, done, hist):
+            self._export_interval_checkpoint(
+                job, lambda key: self._epoch_snapshot(
+                    key, di, prm, ost, done, hist, domain,
+                )
+            )
+            faults.abort_check(self.algo, done)
+
         params, opt_state, history, epochs_done = _run_sync_sgd(
             job, p, loss_fn, tx, params, opt_state, X, y, w,
-            train.nrow, train.npad, key, start_epochs,
+            train.nrow, train.npad, key, start_epochs, on_epoch=on_epoch,
         )
         apply_fn = jax.jit(lambda prm, xx: mlp.apply(prm, xx, train=False))
 

@@ -38,6 +38,7 @@ from h2o3_tpu.models.datainfo import MEAN_IMPUTATION, SKIP, DataInfo
 from h2o3_tpu.models.glm_families import get_family
 from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
 from h2o3_tpu.ops.gram import admm_elastic_net, solve_cholesky, weighted_gram
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 _HI = jax.lax.Precision.HIGHEST
@@ -297,6 +298,37 @@ class GLM(ModelBuilder):
         nobs = float(np.asarray(w.sum()))
         job.update(0.05)
 
+        from h2o3_tpu.models.model_base import (
+            check_checkpoint_compat,
+            resolve_checkpoint,
+        )
+
+        prior = resolve_checkpoint(p.checkpoint)
+        response_domain = tuple(yv.domain) if classification else None
+        if prior is not None:
+            if family in ("multinomial", "ordinal") or p.solver.upper().replace(
+                "-", "_"
+            ) in ("L_BFGS", "LBFGS"):
+                raise ValueError(
+                    "GLM checkpoint resume supports the IRLSM single-response "
+                    "path only"
+                )
+            check_checkpoint_compat(
+                prior, self,
+                ("family", "link", "solver", "alpha", "lambda_",
+                 "lambda_search", "nlambdas", "lambda_min_ratio",
+                 "standardize", "intercept", "missing_values_handling",
+                 "max_iterations", "beta_epsilon", "objective_epsilon"),
+            )
+            if prior.output.get("irls_state") is None:
+                raise ValueError(
+                    "GLM checkpoint resume needs an in-training snapshot "
+                    "(a COMPLETED GLM fit has converged; there is nothing to "
+                    "continue)"
+                )
+            if len(prior.output["irls_state"]["beta"]) != di.ncols_expanded:
+                raise ValueError("checkpoint design-matrix width differs")
+
         if family == "multinomial":
             out = self._fit_multinomial(job, X, y, w, di, yv, p, nobs)
         elif family == "ordinal":
@@ -304,7 +336,8 @@ class GLM(ModelBuilder):
         elif p.solver.upper().replace("-", "_") in ("L_BFGS", "LBFGS"):
             out = self._fit_lbfgs(job, X, y, w, offset, di, p, family, nobs)
         else:
-            out = self._fit_irls(job, X, y, w, offset, di, p, family, nobs)
+            out = self._fit_irls(job, X, y, w, offset, di, p, family, nobs,
+                                 prior=prior, response_domain=response_domain)
 
         out["datainfo"] = di
         out["response_domain"] = tuple(yv.domain) if classification else None
@@ -316,7 +349,29 @@ class GLM(ModelBuilder):
         return model
 
     # -- single-vector families ---------------------------------------------
-    def _fit_irls(self, job, X, y, w, offset, di, p: GLMParams, family, nobs):
+    def _irls_snapshot(self, key, p: GLMParams, di, beta, family, fam,
+                       response_domain, state: dict) -> GLMModel:
+        """Interval-snapshot factory: a scoreable partial GLM carrying the
+        exact IRLS loop position (``irls_state``) so ``checkpoint=`` resume
+        re-enters the solver at the next iteration and reproduces the
+        uninterrupted trajectory bit-for-bit."""
+        out = self._coef_output(np.asarray(beta, np.float64), di, p)
+        out.update(
+            family=family,
+            family_obj=fam,
+            multinomial=False,
+            datainfo=di,
+            names=list(self._x),
+            response_domain=response_domain,
+            null_deviance=state["null_dev"],
+            residual_deviance=(state["best"]["deviance"]
+                               if state.get("best") else float("nan")),
+            irls_state=state,
+        )
+        return GLMModel(key, p, out)
+
+    def _fit_irls(self, job, X, y, w, offset, di, p: GLMParams, family, nobs,
+                  prior=None, response_domain=None):
         fam_args = (
             p.link,
             float(p.tweedie_variance_power or 1.5),
@@ -352,11 +407,33 @@ class GLM(ModelBuilder):
         best = None
         null_dev = float(dev0)
         path = []
+        # checkpoint resume: the prologue above (beta init, lambda_max,
+        # lambdas, null_dev) is a pure function of the data and params —
+        # recomputed identically — so only the LOOP POSITION is restored
+        li0, it0, iters0, dev_prev0 = 0, 0, 0, np.inf
+        if prior is not None:
+            st = prior.output["irls_state"]
+            li0, it0 = int(st["li"]), int(st["it"])
+            iters0 = int(st.get("iters", it0))
+            dev_prev0 = float(st["dev_prev"])
+            beta = np.asarray(st["beta"], np.float64).copy()
+            best = ({k: (np.asarray(v).copy() if k == "beta" else v)
+                     for k, v in st["best"].items()} if st.get("best") else None)
+            path = [dict(e) for e in st.get("path", ())]
+        tot_iters = 0  # this run's executed iterations (chaos abort site)
+        fam_obj = fam
         for li, lam in enumerate(lambdas):
+            if li < li0:
+                continue
             l1 = lam * alpha * nobs
             l2 = lam * (1 - alpha) * nobs
-            dev_prev = np.inf
-            for it in range(max_iter):
+            dev_prev = dev_prev0 if li == li0 else np.inf
+            # it_pos is the resume marker (max_iter once this lambda's
+            # iterations finished); iters_done is the TRUE iteration count
+            # reported in the regularization path
+            it_pos = it0 if li == li0 else 0
+            iters_done = iters0 if li == li0 else 0
+            while it_pos < max_iter:
                 G, b, dev = _irls_pass(
                     X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
                 )
@@ -377,18 +454,39 @@ class GLM(ModelBuilder):
                 delta = np.max(np.abs(beta_new - beta))
                 beta = beta_new
                 dev_now = float(dev)
-                if delta < p.beta_epsilon or abs(dev_prev - dev_now) / max(
+                iters_done += 1
+                it_pos = iters_done
+                tot_iters += 1
+                stop = delta < p.beta_epsilon or abs(dev_prev - dev_now) / max(
                     abs(dev_now), 1e-10
-                ) < p.objective_epsilon:
+                ) < p.objective_epsilon
+                if stop:
+                    it_pos = max_iter
+                else:
+                    dev_prev = dev_now
+                # snapshot AFTER the stop decision: the recorded (li, it)
+                # is exactly where a resumed run re-enters the loop (it ==
+                # max_iter marks "this lambda's iterations are finished")
+                self._export_interval_checkpoint(
+                    job,
+                    lambda key: self._irls_snapshot(
+                        key, p, di, beta, family, fam_obj, response_domain,
+                        {"li": li, "it": it_pos, "iters": iters_done,
+                         "dev_prev": dev_prev, "beta": beta.copy(),
+                         "best": best, "path": [dict(e) for e in path],
+                         "null_dev": null_dev},
+                    ),
+                )
+                faults.abort_check("glm", tot_iters)
+                if stop:
                     break
-                dev_prev = dev_now
             dev_final = float(
                 _deviance_pass(
                     X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
                 )
             )
             expl = 1 - dev_final / max(null_dev, 1e-30)
-            path.append({"lambda": float(lam), "deviance": dev_final, "dev_ratio": expl, "iters": it + 1})
+            path.append({"lambda": float(lam), "deviance": dev_final, "dev_ratio": expl, "iters": iters_done})
             if best is None or dev_final <= best["deviance"]:
                 best = {"lambda": float(lam), "beta": beta.copy(), "deviance": dev_final}
             job.update(0.05 + 0.8 * (li + 1) / len(lambdas))

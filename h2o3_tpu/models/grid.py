@@ -26,6 +26,7 @@ from h2o3_tpu.models.model_base import (
     ScoreKeeper,
     stopping_metric_direction,
 )
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 
@@ -220,6 +221,7 @@ class GridSearch:
                 if ckdir:
                     done[hv_key] = m.key
                     _write_manifest(ckdir, self.grid, done, fingerprint)
+                faults.abort_check("grid", len(self.grid.models))
                 if c.stopping_rounds:
                     if keeper is None:
                         metric_name, larger = stopping_metric_direction(
@@ -231,6 +233,8 @@ class GridSearch:
                     if keeper.should_stop():
                         Log.info(f"grid {self.grid.key}: early stop after {i + 1} models")
                         break
+            except faults.TrainAbort:
+                raise  # simulated kill -9: the whole grid dies, manifest stays
             except Exception as e:  # a failing combo must not kill the grid (h2o keeps failures)
                 self.grid.failures.append((dict(hv), repr(e)))
                 Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
@@ -289,6 +293,8 @@ class GridSearch:
                         stop_flag.set()
                 job.update(min(1.0, len(self.grid.models) / max(1, n_planned)))
 
+        abort_box: list[BaseException] = []
+
         def build_one(hv: dict, hv_key: str) -> None:
             try:
                 builder = self.builder_cls(**{**self.base_params, **hv})
@@ -297,6 +303,12 @@ class GridSearch:
                     validation_frame=validation_frame, **kw,
                 )
                 record_model(m, hv, hv_key)
+            except faults.TrainAbort as e:
+                # simulated kill -9 from a worker thread: stop feeding the
+                # pool and re-raise from the driver once in-flight work drains
+                with lock:
+                    abort_box.append(e)
+                stop_flag.set()
             except Exception as e:
                 with lock:
                     self.grid.failures.append((dict(hv), repr(e)))
@@ -341,6 +353,8 @@ class GridSearch:
                 fin, pending = wait(pending, return_when=FIRST_COMPLETED)
                 if stop_flag.is_set() and not c.max_models:
                     exhausted = True
+        if abort_box:
+            raise abort_box[0]
         return self.grid
 
 
@@ -408,9 +422,7 @@ def _read_manifest(ckdir: str, grid_key: str, fingerprint: str | None = None) ->
 
 def _write_manifest(ckdir: str, grid: Grid, done: dict[str, str], fingerprint: str | None = None) -> None:
     import json
-    import os
 
-    os.makedirs(ckdir, exist_ok=True)
     payload = {
         "grid_id": grid.key,
         "algo": grid.builder_cls.algo,
@@ -419,8 +431,11 @@ def _write_manifest(ckdir: str, grid: Grid, done: dict[str, str], fingerprint: s
         "built": done,
         "failures": [[{k: _canon(v) for k, v in hv.items()}, msg] for hv, msg in grid.failures],
     }
-    with open(_manifest_path(ckdir, grid.key), "w") as f:
-        json.dump(payload, f)
+    # atomic + retried through the persist layer: a crash mid-write must
+    # never leave a torn manifest (it IS the grid's recovery record)
+    from h2o3_tpu.persist import write_bytes
+
+    write_bytes(json.dumps(payload).encode(), _manifest_path(ckdir, grid.key))
 
 
 def _load_checkpointed(ckdir: str, model_key: str):

@@ -274,6 +274,10 @@ class ModelBuilder:
         self.params = self.PARAMS_CLS(**kwargs)
         self.model: Model | None = None
         self._x: list[str] = []
+        # stable key for this build's periodic in-training snapshot (minted
+        # on first export; every interval overwrites the same file so the
+        # latest interval wins — docs/RECOVERY.md)
+        self._ckpt_key: str | None = None
 
     # -- feature selection (ignored_columns / x handling) --------------------
     def _features(self, frame: Frame, y: str | None) -> list[str]:
@@ -361,6 +365,48 @@ class ModelBuilder:
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         raise NotImplementedError
 
+    # -- periodic in-training checkpoints (crash durability, SURVEY §5.3) ----
+    def _export_interval_checkpoint(self, job: Job | None, make_model) -> str | None:
+        """Snapshot the partial model to ``export_checkpoints_dir`` at a
+        scoring-interval boundary.
+
+        ``make_model(key)`` builds a throwaway Model holding the CURRENT
+        partial state; it is serialized through the standard persist path
+        (every-rank device pull, coordinator-only atomic+retried write — the
+        ``_exec_model_save`` contract) and removed from the registry again.
+        A kill -9 any time after this call loses at most one scoring
+        interval: restart, ``load_model`` the snapshot, and pass it as
+        ``checkpoint=`` to reproduce the uninterrupted run (pinned by the
+        chaos suite). No-op unless ``export_checkpoints_dir`` is set."""
+        p = self.params
+        ckdir = getattr(p, "export_checkpoints_dir", None)
+        if not ckdir:
+            return None
+        from h2o3_tpu import persist
+        from h2o3_tpu.cluster import spmd
+
+        if self._ckpt_key is None:
+            self._ckpt_key = DKV.make_key(f"{self.algo}_ckpt")
+        key = self._ckpt_key
+        model = make_model(key)
+        try:
+            data = persist.serialize_model(model)  # every-rank pull
+            backend, pth = persist.model_path_in_dir(ckdir, key)
+            if spmd.is_coordinator():
+                persist.write_model_bytes(data, backend, pth, key)
+        finally:
+            DKV.remove(key)  # snapshots never linger in the registry
+        if job is not None:
+            # surfaced over /3/Jobs: operators polling a failed job see
+            # where to resume from (api/server._job_schema)
+            job.recovery = {
+                "checkpoint_key": key,
+                "checkpoint_path": pth,
+                "hint": "load_model(checkpoint_path), then rebuild with "
+                        "checkpoint=checkpoint_key to resume",
+            }
+        return pth
+
     # -- CV driver (successor of ModelBuilder.computeCrossValidation) --------
     def _cross_validate(self, job: Job, train: Frame) -> None:
         p = self.params
@@ -424,15 +470,29 @@ class ModelBuilder:
 
 
 def resolve_checkpoint(cp) -> "Model | None":
-    """Checkpoint param → prior Model (key lookup or pass-through)."""
+    """Checkpoint param → prior Model (key lookup, pass-through, or — the
+    kill→restart→resume runbook — a saved model/snapshot FILE path loaded
+    through persist when the key is not in the registry)."""
     if cp is None:
         return None
     if isinstance(cp, Model):
         return cp
     got = DKV.get(str(cp))
-    if not isinstance(got, Model):
-        raise ValueError(f"checkpoint {cp!r} is not a model in the DKV")
-    return got
+    if isinstance(got, Model):
+        return got
+    try:
+        from h2o3_tpu import persist
+
+        backend, p = persist._backend_for(str(cp))
+        found = backend.exists(p) and not backend.is_dir(p)
+    except (ValueError, NotImplementedError):
+        found = False
+    if found:
+        return persist.load_model(str(cp))
+    raise ValueError(
+        f"checkpoint {cp!r} is not a model in the DKV (nor a readable "
+        "model/snapshot file)"
+    )
 
 
 def check_checkpoint_compat(prior: "Model", builder: "ModelBuilder", frozen: Sequence[str]) -> None:

@@ -27,6 +27,7 @@ from h2o3_tpu.models.tree.gbm import SharedTreeModel, SharedTreeParams
 from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
 from h2o3_tpu.models import metrics as MM
 from h2o3_tpu.models.model_base import ModelBuilder
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 
@@ -63,10 +64,30 @@ class DRFModel(SharedTreeModel):
 class DRF(ModelBuilder):
     algo = "drf"
     PARAMS_CLS = DRFParams
+    MODEL_CLS = DRFModel
 
     # XRT ("extremely randomized trees") reuses this builder via the
     # histogram_type=Random analog — see XRT subclass below.
     _extra_random = False
+
+    def _partial_model(self, key, p, spec, trees, n_out, domain, F, yn, wn,
+                       nrow, K, classification, varimp_dev, history):
+        """Interval-snapshot factory (see GBM._partial_model)."""
+        out = {
+            "bin_spec": spec,
+            "trees": [list(g) for g in trees],
+            "n_tree_classes": n_out,
+            "names": list(self._x),
+            "varimp": np.asarray(varimp_dev).astype(np.float64),
+            "response_domain": domain,
+            "ntrees_actual": len(trees),
+        }
+        m = self.MODEL_CLS(key, p, out)
+        m.scoring_history = list(history)
+        m.training_metrics = self._metrics_from_F(
+            F, yn, wn, nrow, max(len(trees), 1), K, classification, domain=domain
+        )
+        return m
 
     def _build(self, job: Job, train: Frame, valid: Frame | None):
         p: DRFParams = self.params
@@ -237,6 +258,16 @@ class DRF(ModelBuilder):
                     stop_val = vval
                 history.append(entry)
                 keeper.record(stop_val)
+                self._export_interval_checkpoint(
+                    job,
+                    lambda key: self._partial_model(
+                        key, p, spec, trees, n_out,
+                        tuple(yv.domain) if classification else None,
+                        F, yn, wn, train.nrow, K, classification,
+                        varimp_dev, history,
+                    ),
+                )
+                faults.abort_check(self.algo, m_done)
                 if keeper.should_stop():
                     Log.info(f"DRF early stop at {m_done} trees")
                     break
@@ -289,6 +320,16 @@ class DRF(ModelBuilder):
                     stop_val = vval
                 history.append(entry)
                 keeper.record(stop_val)
+                self._export_interval_checkpoint(
+                    job,
+                    lambda key: self._partial_model(
+                        key, p, spec, trees, n_out,
+                        tuple(yv.domain) if classification else None,
+                        F, yn, wn, train.nrow, K, classification,
+                        varimp_dev, history,
+                    ),
+                )
+                faults.abort_check(self.algo, m + 1)
                 if keeper.should_stop():
                     Log.info(f"DRF early stop at {m + 1} trees")
                     break

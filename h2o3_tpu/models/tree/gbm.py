@@ -37,6 +37,7 @@ from h2o3_tpu.models.tree.distributions import (
     response_transform,
 )
 from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 
@@ -258,6 +259,27 @@ class GBM(ModelBuilder):
     algo = "gbm"
     PARAMS_CLS = GBMParams
     MODEL_CLS = GBMModel
+
+    def _partial_model(self, key, p, spec, trees, K, dist, f0, varimp_dev,
+                       domain, F, yn, wn, nrow, history) -> Model:
+        """The interval-snapshot factory: a scoreable Model holding the
+        forest SO FAR, shaped exactly like the final model so ``checkpoint=``
+        resume (and plain predict) treat it as a short uninterrupted run."""
+        out = {
+            "bin_spec": spec,
+            "trees": [list(g) for g in trees],
+            "n_tree_classes": K,
+            "distribution": dist,
+            "init_f": f0,
+            "names": list(self._x),
+            "varimp": np.asarray(varimp_dev).astype(np.float64),
+            "response_domain": domain,
+            "ntrees_actual": len(trees),
+        }
+        m = self.MODEL_CLS(key, p, out)
+        m.scoring_history = list(history)
+        m.training_metrics = _metrics_from_F(dist, F, yn, wn, nrow, domain=domain)
+        return m
 
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: GBMParams = self.params
@@ -507,6 +529,15 @@ class GBM(ModelBuilder):
                     stop_val = vval
                 history.append(entry)
                 keeper.record(stop_val)
+                self._export_interval_checkpoint(
+                    job,
+                    lambda key: self._partial_model(
+                        key, p, spec, trees, K, dist, f0, varimp_dev,
+                        tuple(yv.domain) if classification else None,
+                        F, yn, wn, train.nrow, history,
+                    ),
+                )
+                faults.abort_check(self.algo, m_done)
                 if keeper.should_stop():
                     Log.info(
                         f"GBM early stop at {m_done} trees ({metric_name}={stop_val:.5f})"
@@ -600,6 +631,15 @@ class GBM(ModelBuilder):
                     stop_val = vval
                 history.append(entry)
                 keeper.record(stop_val)
+                self._export_interval_checkpoint(
+                    job,
+                    lambda key: self._partial_model(
+                        key, p, spec, trees, K, dist, f0, varimp_dev,
+                        tuple(yv.domain) if classification else None,
+                        F, yn, wn, train.nrow, history,
+                    ),
+                )
+                faults.abort_check(self.algo, m + 1)
                 if keeper.should_stop():
                     Log.info(f"GBM early stop at {m + 1} trees ({metric_name}={stop_val:.5f})")
                     break

@@ -14,6 +14,18 @@ native equivalent is pickle — with two twists handled here:
 Scheme dispatch mirrors the Persist SPI: ``file:`` (and bare paths) are
 implemented; ``s3:``/``hdfs:``/``gs:`` raise cleanly until a backend is
 registered (the SPI point is the registry, not any one cloud SDK).
+
+Durability contract (the fail-stop cloud's other half, SURVEY §5.3):
+- **atomic publish** — every FS write lands in a same-directory temp file
+  and is ``os.replace``d into place on clean close, so a crash mid-write
+  never leaves a partial file at the target path (the cloud backends get
+  the same guarantee from ``_UploadOnClose``: no partial object is ever
+  published);
+- **retry with backoff** — transient IO errors are retried
+  ``H2O3_TPU_PERSIST_RETRIES`` times with exponential backoff and
+  *deterministic* jitter (identical on every rank, preserving the spmd
+  lockstep contract), while deterministic errors (collision, bad path,
+  corrupt file) fail fast on the first attempt.
 """
 
 from __future__ import annotations
@@ -21,7 +33,10 @@ from __future__ import annotations
 import io
 import os
 import pickle
+import tempfile
+import time
 import urllib.parse
+import zlib
 from typing import BinaryIO, Callable
 
 import jax
@@ -29,6 +44,7 @@ import numpy as np
 
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.models.model_base import Model
+from h2o3_tpu.utils import faults
 from h2o3_tpu.utils.log import Log
 
 FORMAT_MAGIC = b"H2O3TPU1"
@@ -45,16 +61,75 @@ class PersistBackend:
     def open_write(self, path: str) -> BinaryIO:
         raise NotImplementedError
 
+    def exists(self, path: str) -> bool:
+        """Scheme-correct existence probe (collision checks, ``force=False``)."""
+        raise NotImplementedError
+
+    def is_dir(self, path: str) -> bool:
+        """True when the path is a directory-like container. Object stores
+        have no real directories — they return False and rely on the
+        trailing-``/`` convention for directory-append semantics."""
+        return False
+
+
+class _AtomicFile(io.FileIO):
+    """FS write handle that publishes atomically on clean close.
+
+    Bytes land in a same-directory temp file; ``os.replace`` moves it onto
+    the target only after a successful close — a crash or an exception in
+    the ``with`` block deletes the temp and leaves NO partial file at the
+    target path. close() stays idempotent like every other file object.
+    """
+
+    def __init__(self, fd: int, tmp_path: str, final_path: str):
+        super().__init__(fd, "wb")
+        self._tmp = tmp_path
+        self._final = final_path
+        self._aborted = False
+        self._published = False
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._aborted = True
+        self.close()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        if not self._aborted:
+            try:
+                self.flush()
+                os.fsync(self.fileno())
+            except OSError:  # fsync is best-effort (some FS reject it)
+                pass
+        super().close()
+        if self._aborted or self._published:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+            return
+        self._published = True
+        os.replace(self._tmp, self._final)
+
 
 class PersistFS(PersistBackend):
     def open_read(self, path: str) -> BinaryIO:
         return open(path, "rb")
 
     def open_write(self, path: str) -> BinaryIO:
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        return open(path, "wb")
+        d = os.path.dirname(path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=d, prefix="." + os.path.basename(path) + ".", suffix=".tmp"
+        )
+        return _AtomicFile(fd, tmp, path)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def is_dir(self, path: str) -> bool:
+        return os.path.isdir(path)
 
 
 class _UploadOnClose(io.BytesIO):
@@ -106,6 +181,14 @@ class PersistS3(PersistBackend):
             lambda data: self._s3.put_object(Bucket=bucket, Key=key, Body=data)
         )
 
+    def exists(self, path: str) -> bool:
+        bucket, key = self._split(path)
+        try:
+            self._s3.head_object(Bucket=bucket, Key=key)
+            return True
+        except Exception:  # botocore ClientError 404 — SDK-typed, gated import
+            return False
+
 
 class PersistGS(PersistBackend):
     """``gs://bucket/key`` via google-cloud-storage (gated)."""
@@ -125,6 +208,9 @@ class PersistGS(PersistBackend):
     def open_write(self, path: str) -> BinaryIO:
         blob = self._blob(path)
         return _UploadOnClose(lambda data: blob.upload_from_string(data))
+
+    def exists(self, path: str) -> bool:
+        return bool(self._blob(path).exists())
 
 
 class PersistHDFS(PersistBackend):
@@ -153,6 +239,16 @@ class PersistHDFS(PersistBackend):
     def open_write(self, path: str) -> BinaryIO:
         f, pth = self._fs_path(path)
         return f.open_output_stream(pth)
+
+    def _info(self, path: str):
+        f, pth = self._fs_path(path)
+        return f.get_file_info(pth)
+
+    def exists(self, path: str) -> bool:
+        return self._info(path).type != self._fs_mod.FileType.NotFound
+
+    def is_dir(self, path: str) -> bool:
+        return self._info(path).type == self._fs_mod.FileType.Directory
 
 
 _BACKENDS: dict[str, PersistBackend] = {"file": PersistFS(), "": PersistFS()}
@@ -197,6 +293,83 @@ def _backend_for(uri: str) -> tuple[PersistBackend, str]:
 
 
 # ---------------------------------------------------------------------------
+# retry/backoff wrapper for transient IO
+
+
+def _is_transient(e: BaseException) -> bool:
+    """Transient (retry) vs deterministic (fail fast) classification.
+
+    Deterministic errors must raise identically on every rank and on every
+    attempt — retrying them burns the budget AND desynchronizes nothing, so
+    they surface immediately. The deterministic OSError subclasses are the
+    path-shape family; everything else OS-level (EIO, ENOSPC-after-cleanup,
+    connection resets, injected faults) is worth retrying.
+    """
+    if isinstance(e, (FileNotFoundError, FileExistsError, PermissionError,
+                      IsADirectoryError, NotADirectoryError)):
+        return False
+    return isinstance(e, OSError)
+
+
+def _retry_delays(desc: str) -> list[float]:
+    """The backoff schedule for one operation: exponential with deterministic
+    jitter keyed on (op, attempt) — every rank computes the same delays."""
+    from h2o3_tpu import config
+
+    retries = max(0, config.get_int("H2O3_TPU_PERSIST_RETRIES"))
+    base = max(0.0, config.get_float("H2O3_TPU_PERSIST_BACKOFF"))
+    out = []
+    for attempt in range(retries):
+        jitter = (zlib.crc32(f"{desc}:{attempt}".encode()) % 1000) / 2000.0
+        out.append(base * (2 ** attempt) * (1.0 + jitter))
+    return out
+
+
+def _with_retries(op: Callable[[], "T"], desc: str):  # noqa: F821 - doc type
+    """Run ``op`` retrying transient IO errors with backoff; the final
+    attempt's (or any deterministic) error surfaces unchanged."""
+    delays = _retry_delays(desc)
+    for attempt in range(len(delays) + 1):
+        try:
+            return op()
+        except Exception as e:
+            if attempt >= len(delays) or not _is_transient(e):
+                raise
+            Log.warn(
+                f"persist: transient failure on {desc} (attempt "
+                f"{attempt + 1}/{len(delays) + 1}): {e!r} — retrying in "
+                f"{delays[attempt]:.2f}s"
+            )
+            time.sleep(delays[attempt])
+
+
+def write_bytes(data: bytes, path: str) -> str:
+    """Atomic, retried byte write through the scheme dispatch — the one
+    durable-write primitive (models, grid/AutoML manifests)."""
+    backend, p = _backend_for(path)
+
+    def attempt():
+        faults.io_check("persist_write", p)
+        with backend.open_write(p) as f:
+            f.write(data)
+
+    _with_retries(attempt, f"write {p}")
+    return p
+
+
+def read_bytes(path: str) -> bytes:
+    """Retried whole-file read through the scheme dispatch."""
+    backend, p = _backend_for(path)
+
+    def attempt():
+        faults.io_check("persist_read", p)
+        with backend.open_read(p) as f:
+            return f.read()
+
+    return _with_retries(attempt, f"read {p}")
+
+
+# ---------------------------------------------------------------------------
 # device → host conversion of the whole model state, in one batched pull
 
 
@@ -230,6 +403,8 @@ def _pull_tree_output(out: dict) -> dict:
         out["trees"] = host_trees
     if "params" in out:  # flax pytree
         out["params"] = jax.device_get(out["params"])
+    if "opt_state" in out and out["opt_state"] is not None:  # optax pytree
+        out["opt_state"] = jax.device_get(out["opt_state"])
     for k, v in list(out.items()):
         if isinstance(v, jax.Array):
             out[k] = np.asarray(v)
@@ -277,6 +452,42 @@ _REBUILDERS["deeplearning"] = _rebuild_deeplearning
 # save / load
 
 
+def _portable_params(params):
+    """A pickle-light copy of the params dataclass: live Frame/Model refs
+    collapse to their DKV keys (the model must not embed the training data —
+    a periodic snapshot would otherwise re-serialize the whole frame every
+    scoring interval, and sharded device columns don't pickle at all on a
+    multi-process cloud). Resume passes frames explicitly, like H2O."""
+    import copy
+    import dataclasses
+
+    if params is None or not dataclasses.is_dataclass(params):
+        return params
+    params = copy.copy(params)
+    for fname in ("training_frame", "validation_frame", "calibration_frame",
+                  "checkpoint"):
+        ref = getattr(params, fname, None)
+        if ref is not None and not isinstance(ref, str):
+            setattr(params, fname, getattr(ref, "key", None))
+    return params
+
+
+def _portable_submodel(m: Model) -> Model:
+    """A pickle-clean shallow clone of a nested model (CV folds, ensemble
+    bases): device pulls + jit-closure strip + params lightening, without
+    mutating the live object."""
+    import copy
+
+    clone = copy.copy(m)
+    out = _pull_tree_output(dict(m.output))
+    for k in _STRIP.get(m.algo, ()):
+        out.pop(k, None)
+    clone.output = out
+    clone.params = _portable_params(m.params)
+    clone.cv_models = []  # folds of folds don't exist; don't nest
+    return clone
+
+
 def serialize_model(model: Model) -> bytes:
     """Model → portable byte string (the device→host pulls happen here).
 
@@ -288,6 +499,11 @@ def serialize_model(model: Model) -> bytes:
     for k in _STRIP.get(model.algo, ()):
         out.pop(k, None)
     state["output"] = out
+    state["params"] = _portable_params(state.get("params"))
+    if state.get("cv_models"):
+        # fold models carry the same jit closures as the main model (a CV'd
+        # GLM save used to die on the family_obj lambda here)
+        state["cv_models"] = [_portable_submodel(m) for m in state["cv_models"]]
     payload = {"cls_module": type(model).__module__,
                "cls_name": type(model).__qualname__,
                "algo": model.algo,
@@ -298,21 +514,42 @@ def serialize_model(model: Model) -> bytes:
     return buf.getvalue()
 
 
+def model_path_in_dir(dir_uri: str, model_key: str) -> tuple[PersistBackend, str]:
+    """(backend, path) for a model file named after its key INSIDE a
+    directory URI — the interval-checkpoint writer's path rule (the dir may
+    not exist yet; FS open_write creates it)."""
+    backend, p = _backend_for(dir_uri)
+    if isinstance(backend, PersistFS):
+        return backend, os.path.join(p, model_key)
+    return backend, p.rstrip("/") + "/" + model_key
+
+
 def resolve_model_path(path: str, model_key: str, force: bool = True):
     """(backend, final_path) for a model save; raises FileExistsError when
     ``force`` is off and the target exists. Shared by :func:`save_model` and
-    the replicated spmd save command (which writes coordinator-side only)."""
+    the replicated spmd save command (which writes coordinator-side only).
+
+    Existence/directory probes go through the backend SPI so ``s3://`` /
+    ``gs://`` / ``hdfs://`` targets are checked on THEIR filesystem, not the
+    coordinator's local disk."""
     backend, p = _backend_for(path)
-    if os.path.isdir(p) or path.endswith(("/", os.sep)):
-        p = os.path.join(p, model_key)
-    if os.path.exists(p) and not force:
+    if path.endswith(("/", os.sep)) or backend.is_dir(p):
+        if isinstance(backend, PersistFS):
+            p = os.path.join(p, model_key)
+        else:
+            p = p.rstrip("/") + "/" + model_key
+    if not force and backend.exists(p):
         raise FileExistsError(p)
     return backend, p
 
 
 def write_model_bytes(data: bytes, backend, p: str, model_key: str) -> str:
-    with backend.open_write(p) as f:
-        f.write(data)
+    def attempt():
+        faults.io_check("persist_write", p)
+        with backend.open_write(p) as f:
+            f.write(data)
+
+    _with_retries(attempt, f"write model {model_key} -> {p}")
     Log.info(f"saved model {model_key} to {p}")
     return p
 
@@ -325,22 +562,54 @@ def save_model(model: Model, path: str, force: bool = True) -> str:
 
 
 def load_model(path: str) -> Model:
-    """``h2o.load_model`` successor: restores the model into the registry."""
-    backend, p = _backend_for(path)
-    with backend.open_read(p) as f:
-        magic = f.read(len(FORMAT_MAGIC))
-        if magic != FORMAT_MAGIC:
-            raise ValueError(f"{path}: not an h2o3_tpu model file")
-        payload = pickle.load(f)
+    """``h2o.load_model`` successor: restores the model into the registry.
 
+    Accepts final saves and in-training interval snapshots alike — a partial
+    snapshot loads into a scoreable Model whose key can be passed as
+    ``checkpoint=`` to continue training (docs/RECOVERY.md)."""
+    backend, p = _backend_for(path)
+
+    def attempt():
+        faults.io_check("persist_read", p)
+        with backend.open_read(p) as f:
+            return f.read()
+
+    blob = _with_retries(attempt, f"read model {p}")
+    if blob[: len(FORMAT_MAGIC)] != FORMAT_MAGIC:
+        raise ValueError(f"{path}: not an h2o3_tpu model file")
+    try:
+        payload = pickle.loads(blob[len(FORMAT_MAGIC):])
+        cls_module = payload["cls_module"]
+        cls_name = payload["cls_name"]
+        state = payload["state"]
+    except ValueError:
+        raise
+    except Exception as e:
+        # a crash mid-write can't truncate an atomically published file, but
+        # foreign/bit-rotted files still deserve a named error, not a bare
+        # unpickling traceback
+        raise ValueError(
+            f"{path}: corrupt or truncated model file "
+            f"({type(e).__name__}: {e})"
+        ) from e
+
+    import functools
     import importlib
 
-    cls = getattr(importlib.import_module(payload["cls_module"]), payload["cls_name"].split(".")[0])
+    # qualname-aware lookup: nested model classes ("Outer.Inner") resolve by
+    # walking the attribute chain, not just the first segment
+    cls = functools.reduce(
+        getattr, cls_name.split("."), importlib.import_module(cls_module)
+    )
     model = cls.__new__(cls)
-    model.__dict__.update(payload["state"])
+    model.__dict__.update(state)
     rebuild = _REBUILDERS.get(payload["algo"])
     if rebuild:
         rebuild(model)
+    for cv in getattr(model, "cv_models", ()) or ():
+        cv_rebuild = _REBUILDERS.get(cv.algo)
+        if cv_rebuild:
+            cv_rebuild(cv)
     DKV.put(model.key, model)
     Log.info(f"loaded model {model.key} from {p}")
     return model
@@ -358,14 +627,22 @@ def export_df(df, path: str, force: bool = False, format: str | None = None) -> 
     collective on multi-process clouds — happens in the caller, so every
     rank can pull while only the coordinator writes; cluster/spmd.py)."""
     backend, p = _backend_for(path)
-    if isinstance(backend, PersistFS) and os.path.exists(p) and not force:
-        raise FileExistsError(p)
+    try:
+        if not force and backend.exists(p):
+            raise FileExistsError(p)
+    except NotImplementedError:  # probe-less custom backend: overwrite
+        pass
     fmt = (format or "").lower() or ("parquet" if p.endswith((".parquet", ".pq")) else "csv")
-    with backend.open_write(p) as f:
-        if fmt == "parquet":
-            df.to_parquet(f, index=False)
-        elif fmt == "csv":
-            df.to_csv(f, index=False)
-        else:
-            raise ValueError(f"unsupported export format {fmt!r}")
+
+    def attempt():
+        faults.io_check("persist_write", p)
+        with backend.open_write(p) as f:
+            if fmt == "parquet":
+                df.to_parquet(f, index=False)
+            elif fmt == "csv":
+                df.to_csv(f, index=False)
+            else:
+                raise ValueError(f"unsupported export format {fmt!r}")
+
+    _with_retries(attempt, f"export {p}")
     return p

@@ -1,0 +1,200 @@
+"""Deterministic fault injection — the chaos half of the fail-stop story
+(SURVEY.md §5.3: "restart is the recovery path; durability comes from model
+checkpoints").
+
+The cloud's failure machinery (persist retry/backoff, the degraded latch,
+checkpoint-resume) is only trustworthy if it can be *exercised*, and real
+faults — a flaky NFS mount, a kill -9 mid-forest, a dead mesh member — are
+neither deterministic nor CI-safe. This module provides the three synthetic
+failure modes the chaos test suite (``pytest -m chaos``) drives:
+
+- **persist IO failures**: ``io_check(site)`` raises :class:`InjectedIOError`
+  (a *transient* ``OSError`` the persist retry wrapper is allowed to retry)
+  for the first N calls at a site (``persist_write``, ``persist_read``).
+- **mid-train aborts**: ``abort_check(site, iteration)`` raises
+  :class:`TrainAbort` when the driver reaches the armed iteration — the
+  in-process stand-in for kill -9, placed AFTER the interval checkpoint
+  export so the snapshot on disk is exactly what a crash would leave.
+- **coordination-service death**: ``make_death_error()`` builds an exception
+  whose type name and message match the signatures
+  ``spmd._maybe_mark_dead_member`` latches on, and ``death_check(site)``
+  raises one at an armed site (e.g. ``spmd_run``) to drive the full
+  broadcast-failure → ``cloud.mark_degraded`` path without a real dead rank.
+
+Arming is explicit (context manager / ``configure``) or via the
+``H2O3_TPU_FAULTS`` env knob (config.py), spec ``;``-separated:
+``site=N`` fails the first N IO calls, ``site@K`` aborts at iteration K,
+``death:site`` raises a synthetic death error at the site. When nothing is
+armed every check is a single module-bool test — hot paths pay ~nothing.
+
+Determinism contract: counters are keyed by site and incremented in call
+order, so a seeded single-threaded run injects at exactly the same point
+every time (and on every rank of a replicated command, preserving the spmd
+lockstep contract).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class InjectedIOError(OSError):
+    """Transient IO failure injected by the fault harness (retryable)."""
+
+
+class TrainAbort(RuntimeError):
+    """Simulated hard process death mid-train.
+
+    Deliberately NOT swallowed by the grid/AutoML per-model failure handlers
+    (a real kill -9 gives them no chance either): they re-raise it so the
+    whole job dies with the latest interval checkpoint on disk.
+    """
+
+
+class XlaRuntimeError(Exception):
+    """Synthetic stand-in matching the real jaxlib XlaRuntimeError by TYPE
+    NAME — ``spmd._maybe_mark_dead_member`` keys on the name, so chaos tests
+    can drive the degraded latch without a real dead mesh member."""
+
+
+_lock = threading.Lock()
+_armed = False
+_fail: dict[str, int] = {}      # io site -> remaining injected failures
+_abort: dict[str, int] = {}     # abort site -> iteration to die at
+_death: set[str] = set()        # sites where a synthetic death error fires
+_counts: dict[str, int] = {}    # site -> observed check calls (tests assert)
+
+_DEATH_MSG = ("injected fault: coordination service reports peer task is "
+              "unhealthy (heartbeat timeout)")
+
+
+def _parse_spec(spec: str) -> None:
+    """Arm from an ``H2O3_TPU_FAULTS`` spec string (see module docstring)."""
+    global _armed
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith("death:"):
+            _death.add(part[len("death:"):])
+        elif "@" in part:
+            site, at = part.split("@", 1)
+            _abort[site] = int(at)
+        elif "=" in part:
+            site, n = part.split("=", 1)
+            _fail[site] = int(n)
+        else:
+            raise ValueError(f"bad H2O3_TPU_FAULTS entry {part!r} "
+                             "(want site=N, site@K or death:site)")
+    _armed = bool(_fail or _abort or _death)
+
+
+def configure(fail: dict[str, int] | None = None,
+              abort: dict[str, int] | None = None,
+              death: set[str] | frozenset[str] | None = None) -> None:
+    """Arm the harness programmatically (additive to whatever is armed)."""
+    global _armed
+    with _lock:
+        _fail.update(fail or {})
+        _abort.update(abort or {})
+        _death.update(death or ())
+        _armed = bool(_fail or _abort or _death)
+
+
+def reset() -> None:
+    """Disarm everything and clear counters (re-reads the env knob)."""
+    global _armed
+    with _lock:
+        _fail.clear()
+        _abort.clear()
+        _death.clear()
+        _counts.clear()
+        _armed = False
+        from h2o3_tpu import config
+
+        spec = config.get("H2O3_TPU_FAULTS")
+        if spec:
+            _parse_spec(spec)
+
+
+@contextlib.contextmanager
+def inject(fail: dict[str, int] | None = None,
+           abort: dict[str, int] | None = None,
+           death: set[str] | frozenset[str] | None = None):
+    """Scoped arming for tests: arms on entry, fully resets on exit."""
+    configure(fail=fail, abort=abort, death=death)
+    try:
+        yield
+    finally:
+        reset()
+
+
+def counts() -> dict[str, int]:
+    """Observed check calls per site (armed sites only) — test assertions."""
+    with _lock:
+        return dict(_counts)
+
+
+def io_check(site: str, detail: str = "") -> None:
+    """Raise an :class:`InjectedIOError` while the site has fail budget.
+
+    Called once per persist IO *attempt* — the retry wrapper re-enters it,
+    so ``fail={"persist_write": 2}`` means attempts 1–2 fail and attempt 3
+    succeeds (proving retry-within-budget)."""
+    if not _armed:
+        return
+    with _lock:
+        _counts[site] = _counts.get(site, 0) + 1
+        left = _fail.get(site, 0)
+        if left <= 0:
+            return
+        _fail[site] = left - 1
+    raise InjectedIOError(
+        f"injected transient IO failure at {site}"
+        + (f" ({detail})" if detail else "")
+    )
+
+
+def abort_check(site: str, iteration: int) -> None:
+    """Raise :class:`TrainAbort` when the armed iteration is reached.
+
+    Drivers call this at every scoring-interval boundary AFTER the interval
+    checkpoint export, with the number of units (trees/iterations/epochs/
+    models) completed so far."""
+    if not _armed:
+        return
+    with _lock:
+        at = _abort.get(site)
+        if at is None or int(iteration) < at:
+            return
+        # one-shot: a restarted (resumed) run in the same process must not
+        # die again at the same boundary
+        _abort.pop(site, None)
+    raise TrainAbort(
+        f"injected mid-train abort at {site} iteration {iteration} "
+        "(simulated process death; resume from the latest checkpoint)"
+    )
+
+
+def make_death_error(msg: str = _DEATH_MSG) -> Exception:
+    """An exception carrying a coordination-service death signature that
+    ``spmd._maybe_mark_dead_member`` recognizes (by type name + message)."""
+    return XlaRuntimeError(msg)
+
+
+def death_check(site: str) -> None:
+    """Raise a synthetic coordination-service death error at an armed site
+    (one-shot, like a real dead member poisoning the next collective)."""
+    if not _armed:
+        return
+    with _lock:
+        if site not in _death:
+            return
+        _death.discard(site)
+    raise make_death_error()
+
+
+# env-armed at import so `H2O3_TPU_FAULTS=... pytest` / launch.py work
+# without code changes; import cost is one config read
+reset()

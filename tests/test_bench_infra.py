@@ -68,6 +68,36 @@ def test_latest_bench_ok_cases(tmp_path, payload, want_rc):
     assert r.returncode == want_rc, r.stdout + r.stderr
 
 
+def test_latest_bench_ok_tolerates_missing_and_garbage(tmp_path):
+    """Missing or torn bench files must yield a clean message + rc 1, never
+    a traceback (the watcher parses this output)."""
+    import shutil
+
+    from datetime import datetime, timezone
+
+    tool = os.path.join(ROOT, "tools", "latest_bench_ok.py")
+    scratch_tools = tmp_path / "tools"
+    scratch_tools.mkdir()
+    shutil.copy(tool, scratch_tools / "latest_bench_ok.py")
+
+    def run():
+        return subprocess.run(
+            [sys.executable, str(scratch_tools / "latest_bench_ok.py")],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    # no artifacts at all
+    r = run()
+    assert r.returncode == 1 and "Traceback" not in r.stderr, r.stderr
+    assert "no recent BENCH_builder artifacts" in r.stdout
+    # a recent artifact that is NOT json
+    stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    (tmp_path / f"BENCH_builder_{stamp}.json").write_text("NOT { JSON\n")
+    r = run()
+    assert r.returncode == 1 and "Traceback" not in r.stderr, r.stderr
+    assert "unparseable" in r.stdout
+
+
 def test_bench_phases_registry():
     import bench
 

@@ -1,0 +1,404 @@
+"""Chaos suite — deterministic fault injection (utils/faults.py) proving the
+crash-durability layer: atomic persist publish, retry-with-backoff, the
+degraded fail-stop latch, and kill→restart→resume reproducing uninterrupted
+runs (the ISSUE-2 acceptance pins). Everything here is fast and runs in
+tier-1 (``pytest -m chaos`` selects just this layer)."""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM, GLM, DeepLearning
+from h2o3_tpu.persist import (
+    PersistBackend,
+    PersistFS,
+    load_model,
+    register_backend,
+    resolve_model_path,
+    save_model,
+    write_bytes,
+)
+from h2o3_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_PERSIST_BACKOFF", "0.01")
+    monkeypatch.setenv("H2O3_TPU_PERSIST_RETRIES", "4")
+    yield
+    faults.reset()
+
+
+def _df(n=1500, seed=3):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    eta = df["a"] * 1.5 + (df["c"] == "x") * 2 - df["b"]
+    df["y"] = np.where(eta + rng.normal(size=n) > 0, "p", "n")
+    return df
+
+
+# ---------------------------------------------------------------------------
+# durable persist: atomic publish + retry/backoff
+
+
+def test_fs_crash_mid_write_leaves_no_partial_file(tmp_path):
+    tgt = str(tmp_path / "model.bin")
+    fs = PersistFS()
+    with pytest.raises(RuntimeError):
+        with fs.open_write(tgt) as f:
+            f.write(b"partial bytes")
+            raise RuntimeError("simulated crash mid-write")
+    assert not os.path.exists(tgt)
+    assert os.listdir(tmp_path) == []  # temp cleaned up too
+    # and a clean write does publish
+    with fs.open_write(tgt) as f:
+        f.write(b"whole")
+    with open(tgt, "rb") as f:
+        assert f.read() == b"whole"
+
+
+def test_transient_write_failure_retried_within_budget(tmp_path):
+    tgt = str(tmp_path / "retry.bin")
+    with faults.inject(fail={"persist_write": 2}):
+        write_bytes(b"payload", tgt)
+        attempts = faults.counts()["persist_write"]
+    assert attempts == 3  # 2 injected failures + the success
+    with open(tgt, "rb") as f:
+        assert f.read() == b"payload"
+
+
+def test_retry_budget_exhausted_surfaces_error_and_no_partial(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_PERSIST_RETRIES", "2")
+    tgt = str(tmp_path / "never.bin")
+    with faults.inject(fail={"persist_write": 99}):
+        with pytest.raises(faults.InjectedIOError):
+            write_bytes(b"payload", tgt)
+        assert faults.counts()["persist_write"] == 3  # 1 + 2 retries
+    assert not os.path.exists(tgt)
+
+
+def test_deterministic_error_fails_fast(tmp_path):
+    blocker = tmp_path / "iam_a_file"
+    blocker.write_bytes(b"x")
+    t0 = time.time()
+    with faults.inject(fail={"persist_write": 0}):  # armed → counts attempts
+        with pytest.raises((NotADirectoryError, FileExistsError)):
+            write_bytes(b"x", str(blocker / "child.bin"))
+        assert faults.counts().get("persist_write", 0) == 1  # no retries
+    assert time.time() - t0 < 1.0  # no backoff sleeps burned
+
+
+def test_transient_read_failure_retried(tmp_path):
+    df = _df(200, seed=9)
+    fr = Frame.from_pandas(df)
+    m = GBM(ntrees=2, max_depth=2, seed=1).train(y="y", training_frame=fr)
+    path = save_model(m, str(tmp_path))
+    h2o3_tpu.remove(m.key)
+    with faults.inject(fail={"persist_read": 2}):
+        m2 = load_model(path)
+        assert faults.counts()["persist_read"] == 3
+    assert m2.output["ntrees_actual"] == 2
+
+
+# ---------------------------------------------------------------------------
+# persist satellites: scheme-correct probes, corrupt files, nested qualnames
+
+
+def test_resolve_model_path_uses_backend_probes_not_local_fs(tmp_path):
+    class Mem(PersistBackend):
+        store = {"mem0://bucket/models/m1": b"x"}
+
+        def exists(self, p):
+            return p in self.store
+
+    register_backend("mem0", Mem())
+    # collision detected on the BACKEND's namespace (local fs knows nothing)
+    with pytest.raises(FileExistsError):
+        resolve_model_path("mem0://bucket/models/m1", "m1", force=False)
+    # trailing slash means directory-append, object-store style
+    _, p = resolve_model_path("mem0://bucket/models/", "m2", force=False)
+    assert p == "mem0://bucket/models/m2"
+
+
+def test_load_model_corrupt_file_names_path(tmp_path):
+    from h2o3_tpu.persist import FORMAT_MAGIC
+
+    bad = tmp_path / "truncated.bin"
+    bad.write_bytes(FORMAT_MAGIC + b"\x80\x05not really a pickle")
+    with pytest.raises(ValueError, match="corrupt or truncated") as ei:
+        load_model(str(bad))
+    assert "truncated.bin" in str(ei.value)  # the error names the path
+    notours = tmp_path / "foreign.bin"
+    notours.write_bytes(b"GARBAGE!")
+    with pytest.raises(ValueError, match="not an h2o3_tpu model file"):
+        load_model(str(notours))
+
+
+class _Outer:
+    class InnerModel(h2o3_tpu.models.model_base.Model):
+        algo = "innertest"
+
+        def __init__(self):  # pragma: no cover - never constructed normally
+            pass
+
+
+def test_load_model_resolves_nested_class_qualnames(tmp_path):
+    import pickle
+
+    from h2o3_tpu.persist import FORMAT_MAGIC
+
+    payload = {
+        "cls_module": __name__,
+        "cls_name": "_Outer.InnerModel",
+        "algo": "innertest",
+        "state": {"key": "inner_1", "output": {}, "params": None},
+    }
+    path = tmp_path / "nested.bin"
+    path.write_bytes(FORMAT_MAGIC + pickle.dumps(payload))
+    m = load_model(str(path))
+    assert type(m) is _Outer.InnerModel
+    assert m.key == "inner_1"
+    h2o3_tpu.remove("inner_1")
+
+
+# ---------------------------------------------------------------------------
+# Job satellites
+
+
+def test_job_join_timeout_raises():
+    from h2o3_tpu.cluster.job import Job
+
+    release = []
+
+    def work(j):
+        while not release:
+            time.sleep(0.01)
+        return "done"
+
+    job = Job(work, "sleepy").start()
+    with pytest.raises(TimeoutError, match="still running"):
+        job.join(timeout=0.05)
+    release.append(1)
+    assert job.join(timeout=5.0) == "done"
+
+
+# ---------------------------------------------------------------------------
+# degraded latch (fail-stop) — the _maybe_mark_dead_member contract
+
+
+@pytest.fixture()
+def _clean_latch():
+    from h2o3_tpu.cluster import cloud
+
+    cloud.clear_degraded()
+    yield
+    cloud.clear_degraded()
+
+
+def test_synthetic_death_signature_latches_degraded(_clean_latch):
+    from h2o3_tpu.cluster import cloud, spmd
+
+    # a deterministic command error must NOT latch (healthy cloud stays up)
+    spmd._maybe_mark_dead_member(ValueError("bad parse path: connection"))
+    assert cloud.degraded_reason() is None
+    # a death-signature XlaRuntimeError latches, one way
+    spmd._maybe_mark_dead_member(faults.make_death_error())
+    assert cloud.degraded_reason() is not None
+    assert cloud.cluster_info()["cloud_healthy"] is False
+    # /3/Cloud surfaces it
+    from h2o3_tpu.api.server import Endpoints
+
+    resp = Endpoints().cloud({})
+    assert resp["cloud_healthy"] is False
+    assert "degraded" in resp
+
+
+def test_degraded_cloud_failstops_queued_spmd_run(_clean_latch, monkeypatch):
+    from h2o3_tpu.cluster import cloud, spmd
+
+    cloud.mark_degraded("test: member died")
+    monkeypatch.setattr(spmd, "_IS_MULTI", True)
+    monkeypatch.setattr(spmd, "is_coordinator", lambda: True)
+    with pytest.raises(RuntimeError, match="fail-stop"):
+        spmd.run("remove", key="whatever")
+
+
+def test_injected_death_in_spmd_run_latches_via_real_path(_clean_latch, monkeypatch):
+    from h2o3_tpu.cluster import cloud, spmd
+
+    monkeypatch.setattr(spmd, "_IS_MULTI", True)
+    monkeypatch.setattr(spmd, "is_coordinator", lambda: True)
+    with faults.inject(death={"spmd_run"}):
+        with pytest.raises(faults.XlaRuntimeError):
+            spmd.run("remove", key="whatever")
+    assert cloud.degraded_reason() is not None
+    # the latch now fail-stops the NEXT command before it broadcasts
+    with pytest.raises(RuntimeError, match="restart the cloud"):
+        spmd.run("remove", key="whatever")
+
+
+# ---------------------------------------------------------------------------
+# kill → restart → resume (the acceptance pins)
+
+
+def _latest_snapshot(ckdir: str, prefix: str) -> str:
+    files = glob.glob(os.path.join(ckdir, f"{prefix}_ckpt_*"))
+    assert files, f"no {prefix} snapshot written to {ckdir}"
+    return max(files, key=os.path.getmtime)
+
+
+def test_gbm_kill_and_resume_matches_uninterrupted(tmp_path):
+    fr = Frame.from_pandas(_df())
+    kw = dict(max_depth=3, seed=11, learn_rate=0.2, score_tree_interval=2)
+
+    full = GBM(ntrees=8, **kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "gbm_ck")
+    with faults.inject(abort={"gbm": 4}):
+        with pytest.raises(faults.TrainAbort):
+            GBM(ntrees=8, export_checkpoints_dir=ckdir, **kw).train(
+                y="y", training_frame=fr
+            )
+    prior = load_model(_latest_snapshot(ckdir, "gbm"))
+    assert prior.output["ntrees_actual"] == 4  # snapshot at the armed interval
+    resumed = GBM(ntrees=8, checkpoint=prior.key, **kw).train(
+        y="y", training_frame=fr
+    )
+    assert resumed.output["ntrees_actual"] == 8
+    np.testing.assert_allclose(
+        resumed.training_metrics.logloss, full.training_metrics.logloss, atol=1e-6
+    )
+    pa = full.predict(fr).vec("p").to_numpy()
+    pb = resumed.predict(fr).vec("p").to_numpy()
+    np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+
+def test_glm_irls_kill_and_resume_matches_uninterrupted(tmp_path):
+    fr = Frame.from_pandas(_df(seed=5))
+    kw = dict(family="binomial", max_iterations=25, seed=1)
+
+    full = GLM(**kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "glm_ck")
+    with faults.inject(abort={"glm": 3}):
+        with pytest.raises(faults.TrainAbort):
+            GLM(export_checkpoints_dir=ckdir, **kw).train(y="y", training_frame=fr)
+    snap = _latest_snapshot(ckdir, "glm")
+    # resume straight from the FILE path — the post-restart runbook shape
+    resumed = GLM(checkpoint=snap, **kw).train(y="y", training_frame=fr)
+    # the restored loop position replays the identical IRLS trajectory
+    np.testing.assert_array_equal(
+        np.asarray(resumed.output["beta_std"]), np.asarray(full.output["beta_std"])
+    )
+    np.testing.assert_allclose(
+        resumed.training_metrics.logloss, full.training_metrics.logloss, atol=1e-6
+    )
+
+
+def test_deeplearning_kill_and_resume_matches_uninterrupted(tmp_path):
+    fr = Frame.from_pandas(_df(seed=9))
+    kw = dict(hidden=[8], seed=4, mini_batch_size=64)
+
+    full = DeepLearning(epochs=4, **kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "dl_ck")
+    with faults.inject(abort={"deeplearning": 2}):
+        with pytest.raises(faults.TrainAbort):
+            DeepLearning(epochs=4, export_checkpoints_dir=ckdir, **kw).train(
+                y="y", training_frame=fr
+            )
+    prior = load_model(_latest_snapshot(ckdir, "deeplearning"))
+    assert prior.output["epochs_trained"] == 2
+    resumed = DeepLearning(epochs=4, checkpoint=prior.key, **kw).train(
+        y="y", training_frame=fr
+    )
+    assert resumed.output["epochs_trained"] == 4
+    pa = full.predict(fr).vec("p").to_numpy()
+    pb = resumed.predict(fr).vec("p").to_numpy()
+    np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+
+def test_automl_kill_and_resume_matches_uninterrupted(tmp_path, monkeypatch):
+    import h2o3_tpu.automl.automl as A
+
+    fr = Frame.from_pandas(_df(600, seed=7))
+    tiny = [
+        A._Step("s_gbm1", "model", "gbm",
+                dict(ntrees=6, max_depth=3, score_tree_interval=3)),
+        A._Step("s_glm", "model", "glm", dict()),
+        A._Step("s_gbm2", "model", "gbm",
+                dict(ntrees=6, max_depth=2, score_tree_interval=3)),
+    ]
+    monkeypatch.setattr(
+        A, "_default_plan",
+        lambda: [A._Step(s.name, s.kind, s.algo, dict(s.params),
+                         dict(s.hyper), s.weight) for s in tiny],
+    )
+    spec = dict(max_models=3, nfolds=2, seed=11, max_runtime_secs=0.0,
+                project_name="chaosml")
+
+    def lb_table(aml):
+        return sorted(
+            (r["model_id"].split("_")[0], round(float(r["auc"]), 10))
+            for r in aml.leaderboard.as_table()
+        )
+
+    full = A.AutoML(**spec)
+    full.train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "aml_ck")
+    with faults.inject(abort={"automl": 2}):
+        with pytest.raises(faults.TrainAbort):
+            A.AutoML(export_checkpoints_dir=ckdir, **spec).train(
+                y="y", training_frame=fr
+            )
+    manifest = json.load(open(glob.glob(os.path.join(ckdir, "*.automl.json"))[0]))
+    assert len(manifest["steps"]) == 2  # two finished steps recorded
+    # cold recovery: drop the aborted run's models from the registry
+    for keys in manifest["steps"].values():
+        for k in keys:
+            h2o3_tpu.remove(k)
+
+    resumed = A.AutoML(export_checkpoints_dir=ckdir, **spec)
+    resumed.train(y="y", training_frame=fr)
+    assert "recover" in {e["stage"] for e in resumed.event_log}
+    assert lb_table(resumed) == lb_table(full)
+
+
+def test_grid_abort_preserves_manifest_and_recovers(tmp_path):
+    from h2o3_tpu.models.grid import GridSearch
+
+    fr = Frame.from_pandas(_df(600, seed=10))
+    ckdir = str(tmp_path / "grid_ck")
+    mk = dict(grid_id="g_chaos", seed=2, ntrees=3, export_checkpoints_dir=ckdir)
+
+    with faults.inject(abort={"grid": 2}):
+        with pytest.raises(faults.TrainAbort):
+            GridSearch(GBM, {"max_depth": [2, 3, 4]}, **mk).train(
+                y="y", training_frame=fr
+            )
+    # the manifest records exactly the finished combos
+    manifest = json.load(open(os.path.join(ckdir, "g_chaos.grid.json")))
+    assert len(manifest["built"]) == 2
+    for k in manifest["built"].values():
+        h2o3_tpu.remove(k)
+    g2 = GridSearch(GBM, {"max_depth": [2, 3, 4]}, **mk).train(
+        y="y", training_frame=fr
+    )
+    assert len(g2.models) == 3
+    assert sorted(manifest["built"].values()) == sorted(
+        m.key for m in g2.models[:2]
+    )

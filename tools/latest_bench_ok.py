@@ -53,7 +53,12 @@ def main() -> int:
     # run, so "the newest file" is usually a control and judging only it
     # would loop the watcher forever on a fully successful window
     recent = []
-    for p in glob.glob(os.path.join(here, "BENCH_builder_*.json")):
+    try:
+        candidates = glob.glob(os.path.join(here, "BENCH_builder_*.json"))
+    except OSError as e:  # unreadable repo dir: clean message, not traceback
+        print(f"cannot list bench artifacts under {here}: {e}")
+        return 1
+    for p in candidates:
         age = _stamp_age_s(p, now)
         if age is not None and 0 <= age < RECENT_S:
             recent.append((age, p))
@@ -63,6 +68,7 @@ def main() -> int:
         return 1
     for path in recent:
         headline_ok = phases_ok = False
+        note = ""
         try:
             with open(path) as f:
                 d = json.loads(f.readline())
@@ -71,12 +77,15 @@ def main() -> int:
                 phases_ok = any(
                     isinstance(d.get(p), dict) for p in POST_HEADLINE
                 )
-        except Exception:
-            pass
+        except OSError as e:  # vanished/unreadable between glob and open
+            note = f" (unreadable: {e.strerror or e})"
+        except Exception as e:  # torn/empty/garbage JSON is a MISSING, not a crash
+            note = f" (unparseable: {type(e).__name__})"
         print(
             f"{os.path.basename(path)}: "
             f"headline={'ok' if headline_ok else 'MISSING'}"
             f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
+            f"{note}"
         )
         if headline_ok and phases_ok:
             return 0
