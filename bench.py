@@ -568,6 +568,8 @@ def _bench_automl(fr_small) -> dict:
                      max_runtime_secs=900.0, include_algos=["GBM", "GLM"])
         aml.train(y="label", training_frame=fr_small)
         dt = time.time() - t0
+        # reset_build_stats snapshots the registry counters (BUILD_STATS is
+        # a registry view) — the same values /3/Metrics would serve
         return dt, aml.leaderboard, reset_build_stats()
 
     cache_entries = _compile_cache_entries()
@@ -673,16 +675,31 @@ def _phase_headline() -> dict:
     # specializes on chunk length, so warmup must use the same ntrees)
     GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
 
-    from h2o3_tpu.models.tree.shared_tree import BUILD_STATS, reset_build_stats
+    # counters come from the cluster metrics registry — the same numbers
+    # GET /3/Metrics serves — so bench artifacts and the live endpoint can
+    # never disagree (BUILD_STATS is a view over the same registry)
+    from h2o3_tpu.models.tree.shared_tree import reset_build_stats
+    from h2o3_tpu.utils import metrics as _mx
 
     reset_build_stats()
     t0 = time.time()
     m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
     dt = time.time() - t0
     tps = N_TREES / dt
-    stats = reset_build_stats()
+    registry_block = _mx.REGISTRY.compact_snapshot()
+    stats = {
+        "dispatches": int(_mx.counter_value("tree_dispatches_total")),
+        "trees_built": int(_mx.counter_value("tree_trees_built_total")),
+        "tree_programs_compiled": int(_mx.counter_value(
+            "tree_programs_compiled_total")),
+        "tree_program_cache_hits": int(_mx.counter_value(
+            "tree_program_cache_hits_total")),
+    }
+    reset_build_stats()
 
     payload = {
+        # the registry-snapshot block (tools/latest_bench_ok.py requires it)
+        "metrics_registry": registry_block,
         "metric": f"GBM trees/sec ({N_ROWS // 1_000_000}M rows x {N_COLS} cols, depth {DEPTH}"
                   + (f", nbins={kw['nbins']}" if "nbins" in kw else "")
                   + f", AUC={m.training_metrics.auc:.4f})",

@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -32,7 +33,17 @@ import numpy as np
 from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils import metrics as _metrics
 from h2o3_tpu.utils.log import Log
+
+# per-route REST telemetry (labels use the route PATTERN, not the raw path —
+# bounded cardinality whatever clients request)
+_REST_REQUESTS = _metrics.counter(
+    "rest_requests_total", "REST requests handled, by method/route/status")
+_REST_SECONDS = _metrics.histogram(
+    "rest_request_seconds", "REST handler latency, by method/route")
+_REST_IN_FLIGHT = _metrics.gauge(
+    "rest_requests_in_flight", "REST requests currently executing")
 
 _ALGOS = ("gbm", "xgboost", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
           "naivebayes", "isolationforest", "stackedensemble",
@@ -549,6 +560,32 @@ class Endpoints:
                     "failure_details": [msg for _, msg in g.failures],
                 }]}
 
+    # -- metrics (the /3/Metrics registry + per-job traces) -----------------
+    def metrics_get(self, params):
+        """``GET /3/Metrics`` — the whole registry. Default is Prometheus
+        text exposition (scrape-ready); ``?format=json`` returns the same
+        families as structured JSON."""
+        # materialize lazily-imported subsystems' metric families so a scrape
+        # right after boot still covers persist/cloud/mrtask (families
+        # register at module import; routes import these modules lazily)
+        import h2o3_tpu.persist  # noqa: F401
+        from h2o3_tpu.cluster import cloud  # noqa: F401
+        from h2o3_tpu.parallel import mrtask  # noqa: F401
+
+        if str(params.get("format", "")).lower() == "json":
+            return {"__meta": {"schema_type": "Metrics"},
+                    "families": _metrics.REGISTRY.snapshot()}
+        return {"__binary__": _metrics.REGISTRY.to_prometheus().encode(),
+                "content_type": "text/plain; version=0.0.4; charset=utf-8"}
+
+    def job_trace(self, params, key):
+        """``GET /3/Jobs/{key}/trace`` — the job's span tree as Chrome-trace
+        JSON (load in Perfetto / chrome://tracing)."""
+        j = DKV.get(key)
+        if not isinstance(j, Job):
+            raise ApiError(404, f"Job {key} not found")
+        return _metrics.chrome_trace(key)
+
     # -- timeline (water.TimeLine /3/Timeline successor) --------------------
     def timeline(self, params):
         from h2o3_tpu.utils import telemetry
@@ -577,11 +614,26 @@ class Endpoints:
 
     # -- logs (water.util.Log REST surface) --------------------------------
     def logs_get(self, params, node, name):
-        lines = list(Log._ring.buffer)
         tail = int(params.get("tail", 1000))
-        kept = lines[-tail:] if tail > 0 else []
+        kept = Log.tail(tail)
         return {"__meta": {"schema_type": "LogsV3"},
                 "log": "\n".join(kept), "name": name, "node": node}
+
+    def logs_tail(self, params):
+        """``GET /3/Logs?n=&level=`` — the in-memory ring buffer tail, with
+        an optional minimum level (FATAL/ERRR/WARN/INFO/DEBUG/TRACE). The
+        plain-path twin of the upstream nodes/files route above."""
+        try:
+            n = int(params.get("n", 100))
+        except (TypeError, ValueError):
+            raise ApiError(400, "n must be an integer")
+        try:
+            lines = Log.tail(n, level=params.get("level"))
+        except ValueError as e:  # unknown level name
+            raise ApiError(400, str(e))
+        return {"__meta": {"schema_type": "LogsV3"},
+                "log": "\n".join(lines), "lines": lines,
+                "count": len(lines)}
 
     # -- mojo download (GET /3/Models/{id}/mojo) ----------------------------
     def model_save_bin(self, params, key):
@@ -1124,12 +1176,19 @@ def _get_model(key):
 
 
 def _job_schema(j: Job) -> dict:
+    span_summary = _metrics.trace_summary(j.key)
     return {
         "key": {"name": j.key},
         "description": j.description,
         "status": j.status,
         "progress": j.progress,
         "exception": j.exception,
+        # wall-clock reporting: started_at is epoch seconds; duration_ms is
+        # live while RUNNING and frozen at end_time once terminal (stable
+        # across polls); span_summary rolls the job's trace up per phase
+        "started_at": j.start_time,
+        "duration_ms": j.duration_ms,
+        **({"span_summary": span_summary} if span_summary else {}),
         "dest": {"name": getattr(getattr(j, "result", None), "key", "")} if j.result is not None else None,
         # crash-recovery pointer (latest interval checkpoint) — present when
         # the build ran with export_checkpoints_dir, so a FAILED job tells
@@ -1188,6 +1247,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/3/Frames/([^/]+)", _EP.frame_get),
     ("DELETE", r"/3/Frames/([^/]+)", _EP.frame_delete),
     ("GET", r"/3/Jobs", _EP.jobs_list),
+    ("GET", r"/3/Jobs/([^/]+)/trace", _EP.job_trace),
     ("GET", r"/3/Jobs/([^/]+)", _EP.job_get),
     ("POST", r"/3/Jobs/([^/]+)/cancel", _EP.job_cancel),
     ("GET", r"/3/ModelBuilders", _EP.model_builders),
@@ -1197,6 +1257,8 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/99/Grids", _EP.grids_list),
     ("GET", r"/99/Grids/([^/]+)", _EP.grid_get),
     ("GET", r"/3/Logs/nodes/([^/]+)/files/([^/]+)", _EP.logs_get),
+    ("GET", r"/3/Logs", _EP.logs_tail),
+    ("GET", r"/3/Metrics", _EP.metrics_get),
     ("GET", r"/3/Timeline", _EP.timeline),
     ("GET", r"/3/Profiler", _EP.profiler),
     ("GET", r"/3/Models", _EP.models_list),
@@ -1223,7 +1285,8 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/99/AutoMLBuilder", _EP.automl_build),
     ("GET", r"/99/AutoML/([^/]+)", _EP.automl_get),
 ]
-_COMPILED = [(m, re.compile("^" + p + "/?$"), h) for m, p, h in _ROUTES]
+# raw pattern rides along as the bounded-cardinality metrics route label
+_COMPILED = [(m, p, re.compile("^" + p + "/?$"), h) for m, p, h in _ROUTES]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -1375,11 +1438,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(500, {"__meta": {"schema_type": "Error"},
                                   "msg": repr(e), "http_status": 500})
             return
-        for m, pat, handler in _COMPILED:
+        for m, route, pat, handler in _COMPILED:
             if m != method:
                 continue
             match = pat.match(path)
             if match:
+                status = 200
+                _REST_IN_FLIGHT.inc()
+                t0 = time.perf_counter()
                 try:
                     params = self._params()
                     args = [urllib.parse.unquote(g) for g in match.groups()]
@@ -1389,15 +1455,25 @@ class _Handler(BaseHTTPRequestHandler):
                     else:
                         self._reply(200, out)
                 except ApiError as e:
+                    status = e.status
                     self._reply(e.status, {"__meta": {"schema_type": "Error"},
                                            "error_url": path, "msg": str(e),
                                            "http_status": e.status})
                 except Exception as e:  # noqa: BLE001 — REST boundary
+                    status = 500
                     Log.err(f"REST {method} {path} failed: {e!r}")
                     self._reply(500, {"__meta": {"schema_type": "Error"},
                                       "error_url": path, "msg": repr(e),
                                       "http_status": 500})
+                finally:
+                    _REST_IN_FLIGHT.dec()
+                    _REST_REQUESTS.inc(
+                        method=method, route=route or "/", status=str(status))
+                    _REST_SECONDS.observe(
+                        time.perf_counter() - t0,
+                        method=method, route=route or "/")
                 return
+        _REST_REQUESTS.inc(method=method, route="<no route>", status="404")
         self._reply(404, {"__meta": {"schema_type": "Error"},
                           "msg": f"no route {method} {path}", "http_status": 404})
 

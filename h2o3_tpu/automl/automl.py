@@ -28,7 +28,13 @@ from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import Model, stopping_metric_direction
 from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
+
+_AUTOML_STEPS = _mx.counter(
+    "automl_steps_total", "AutoML plan steps executed, by kind")
+_AUTOML_STEP_SECONDS = _mx.histogram(
+    "automl_step_seconds", "AutoML plan step wall time, by kind")
 
 
 @dataclass
@@ -448,6 +454,9 @@ class AutoML:
                 done_w += st.weight
                 job.update(done_w / total_w)
                 continue
+            _st_t0 = time.time()
+            _st_span = _mx.span("automl.step", step=st.name, kind=st.kind)
+            _st_span.__enter__()
             try:
                 if st.kind == "model":
                     recovered = _recover_step(st)
@@ -540,6 +549,10 @@ class AutoML:
                 raise  # simulated kill -9: die with the manifest on disk
             except Exception as e:
                 self._log("error", f"{st.name} failed: {e!r}")
+            finally:  # runs on the recovered-grid continue and TrainAbort too
+                _st_span.__exit__(None, None, None)
+                _AUTOML_STEPS.inc(kind=st.kind)
+                _AUTOML_STEP_SECONDS.observe(time.time() - _st_t0, kind=st.kind)
             done_w += st.weight
             job.update(done_w / total_w)
 

@@ -21,9 +21,20 @@ import time
 import jax
 
 from h2o3_tpu.parallel import mesh as _mesh
+from h2o3_tpu.utils import metrics
 from h2o3_tpu.utils.log import Log
 
 _started_at: float | None = None
+
+# cluster health as gauges: a scraper sees the degraded latch / probe
+# failures without polling /3/Cloud JSON, and the transition counter
+# preserves flap history a point-in-time gauge cannot show
+_G_DEGRADED = metrics.gauge(
+    "cloud_degraded", "1 while the fail-stop degraded latch is set")
+_G_HEALTHY = metrics.gauge(
+    "cloud_healthy", "1 while every probed local device passes health checks")
+_C_TRANSITIONS = metrics.counter(
+    "cloud_health_transitions_total", "health state changes, by target state")
 
 
 def init(
@@ -132,6 +143,8 @@ def mark_degraded(reason: str) -> None:
     global _degraded
     if _degraded is None:
         _degraded = reason
+        _G_DEGRADED.set(1)
+        _C_TRANSITIONS.inc(to="degraded")
         Log.err(f"cloud degraded (fail-stop): {reason}")
 
 
@@ -147,7 +160,9 @@ def clear_degraded() -> None:
     global _degraded
     if _degraded is not None:
         Log.warn(f"cloud degraded latch cleared (was: {_degraded})")
+        _C_TRANSITIONS.inc(to="healthy")
     _degraded = None
+    _G_DEGRADED.set(0)
 
 
 def cluster_info() -> dict:
@@ -173,6 +188,7 @@ def cluster_info() -> dict:
     out_degraded = degraded_reason()
     if out_degraded is not None:
         healthy = False
+    _G_HEALTHY.set(1 if healthy else 0)
     return {
         "version": "h2o3_tpu",
         "cloud_healthy": healthy,

@@ -15,7 +15,12 @@ import traceback
 from typing import Any, Callable
 
 from h2o3_tpu.cluster.registry import DKV
+from h2o3_tpu.utils import metrics
 from h2o3_tpu.utils.log import Log
+
+_JOBS_TOTAL = metrics.counter(
+    "jobs_total", "jobs finished, by terminal status")
+_JOBS_RUNNING = metrics.gauge("jobs_running", "jobs currently executing")
 
 
 class JobCancelled(Exception):
@@ -77,8 +82,16 @@ class Job:
         def run() -> None:
             self.status = Job.RUNNING
             self.start_time = time.time()
+            _JOBS_RUNNING.inc()
             try:
-                self.result = self._work(self)
+                # the job key IS the trace id: every span opened inside the
+                # work body lands in this job's trace tree (/3/Jobs/{k}/trace).
+                # A Job nested inside a replicated command joins the OUTER
+                # job's trace — the one the client is polling.
+                with metrics.trace(self.key), metrics.span(
+                    "job", job=self.key, description=self.description
+                ):
+                    self.result = self._work(self)
                 self.progress = 1.0
                 self.status = Job.DONE
             except JobCancelled:
@@ -90,6 +103,8 @@ class Job:
                 Log.err(f"Job {self.key} failed:\n{self.exception}")
             finally:
                 self.end_time = time.time()
+                _JOBS_RUNNING.dec()
+                _JOBS_TOTAL.inc(status=self.status)
 
         self._thread = threading.Thread(
             target=lambda: ctx.run(run), name=self.key, daemon=True
@@ -128,6 +143,15 @@ class Job:
         self.start()
         return self.join()
 
+    @property
+    def duration_ms(self) -> int | None:
+        """Elapsed ms: live for a RUNNING job, frozen at end_time once the
+        job reaches a terminal state (stable across polls)."""
+        if self.start_time is None:
+            return None
+        end = self.end_time if self.end_time is not None else time.time()
+        return int((end - self.start_time) * 1000)
+
     def to_dict(self) -> dict:
         return {
             "key": self.key,
@@ -137,5 +161,8 @@ class Job:
             "exception": self.exception,
             "start_time": self.start_time,
             "end_time": self.end_time,
+            "started_at": self.start_time,
+            "duration_ms": self.duration_ms,
+            "span_summary": metrics.trace_summary(self.key),
             **({"recovery": self.recovery} if self.recovery else {}),
         }

@@ -43,10 +43,23 @@ from __future__ import annotations
 import contextvars
 import pickle
 import threading
+import time
 
 import numpy as np
 
+from h2o3_tpu.utils import metrics
 from h2o3_tpu.utils.log import Log
+
+_CMDS_TOTAL = metrics.counter(
+    "spmd_commands_total", "replicated commands executed, by command")
+_CMD_SECONDS = metrics.histogram(
+    "spmd_command_seconds", "replicated command wall time, by command")
+_BCAST_TOTAL = metrics.counter(
+    "spmd_broadcasts_total", "coordination-service command broadcasts")
+_COLLECTIVE_SECONDS = metrics.counter(
+    "spmd_collective_seconds_total",
+    "wall seconds inside command-broadcast collectives (the mesh "
+    "communication overhead lever — invisible without a dedicated timer)")
 
 _LOCK = threading.RLock()  # serializes the coordinator's device-work commands
 # ContextVar, not a process global: nested Job threads inherit it because
@@ -106,6 +119,7 @@ def _bcast_bytes(payload: bytes | None) -> bytes:
     process must call this — followers pass ``None``)."""
     from jax.experimental import multihost_utils as mh
 
+    t0 = time.perf_counter()
     n = len(payload) if payload is not None else 0
     n_arr = mh.broadcast_one_to_all(np.array([n], np.int32))
     n = int(n_arr[0])
@@ -114,7 +128,10 @@ def _bcast_bytes(payload: bytes | None) -> bytes:
     if payload is not None:
         buf[: len(payload)] = np.frombuffer(payload, np.uint8)
     data = mh.broadcast_one_to_all(buf)
-    return bytes(np.asarray(data[:n], np.uint8))
+    out = bytes(np.asarray(data[:n], np.uint8))
+    _BCAST_TOTAL.inc()
+    _COLLECTIVE_SECONDS.inc(time.perf_counter() - t0)
+    return out
 
 
 # -- command registry --------------------------------------------------------
@@ -484,7 +501,13 @@ def run(cmd: str, **kwargs):
     execution serializes device work — collective order must match on every
     rank, and concurrent jobs on the coordinator would interleave it."""
     if not multi_process():
-        return _COMMANDS[cmd](**kwargs)
+        _CMDS_TOTAL.inc(cmd=cmd)
+        t0 = time.perf_counter()
+        with metrics.span(f"spmd.{cmd}"):
+            try:
+                return _COMMANDS[cmd](**kwargs)
+            finally:
+                _CMD_SECONDS.observe(time.perf_counter() - t0, cmd=cmd)
     if not is_coordinator():  # pragma: no cover - followers use follower_loop
         raise RuntimeError("spmd.run is coordinator-only")
     from h2o3_tpu.cluster import cloud
@@ -501,9 +524,15 @@ def run(cmd: str, **kwargs):
             from h2o3_tpu.utils import faults
 
             faults.death_check("spmd_run")  # chaos: synthetic dead member
-            _bcast_bytes(pickle.dumps((cmd, kwargs)))
-            with replicated_section():
-                return _COMMANDS[cmd](**kwargs)
+            _CMDS_TOTAL.inc(cmd=cmd)
+            t0 = time.perf_counter()
+            with metrics.span(f"spmd.{cmd}", replicated="1"):
+                try:
+                    _bcast_bytes(pickle.dumps((cmd, kwargs)))
+                    with replicated_section():
+                        return _COMMANDS[cmd](**kwargs)
+                finally:
+                    _CMD_SECONDS.observe(time.perf_counter() - t0, cmd=cmd)
         except Exception as e:
             _maybe_mark_dead_member(e)
             raise

@@ -81,6 +81,12 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "0.2", "base persist retry backoff, seconds: delay = base * 2^attempt "
                "plus up to +50% DETERMINISTIC jitter (keyed on op+attempt, "
                "identical on every rank and every run)"),
+    "H2O3_TPU_METRICS": (
+        "1", "observability layer on (1) / off (0): the /3/Metrics registry, "
+             "span tracing and timing histograms (utils/metrics.py). Read "
+             "ONCE at import — hot paths must not re-read the environment. "
+             "The tree-build counters behind BUILD_STATS keep counting "
+             "either way (test/bench contract, not optional telemetry)"),
     "H2O3_TPU_FAULTS": (
         "", "fault-injection spec for the chaos suite (utils/faults.py): "
             "';'-separated entries — 'site=N' fails the first N IO calls at "

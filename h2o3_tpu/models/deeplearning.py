@@ -37,7 +37,12 @@ from h2o3_tpu.models.model_base import (
     ScoreKeeper,
 )
 from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
+
+_DL_EPOCHS = _mx.counter("dl_epochs_total", "DeepLearning epochs executed")
+_DL_EPOCH_SECONDS = _mx.histogram(
+    "dl_epoch_seconds", "per-epoch wall time of the sync-SGD driver")
 
 
 @dataclass
@@ -139,7 +144,10 @@ def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
         rng.permutation(nrow)  # stream aligned with an
         key, _ = jax.random.split(key)  # uninterrupted run
     epochs_done = start_epochs
+    import time as _time
+
     for e in range(start_epochs, n_epochs):
+        _ep_t0 = _time.perf_counter()
         perm = np.zeros(npad, np.int64)
         perm[:nrow] = rng.permutation(nrow)
         perm_j = jnp.asarray(perm)
@@ -148,7 +156,11 @@ def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
             params, opt_state, X[perm_j], y[perm_j], w[perm_j] * slot_mask, dkey
         )
         epochs_done = e + 1
+        # the float() below syncs on the epoch's device work, so the
+        # observation covers shuffle + scan, not just dispatch
         history.append({"epoch": e + 1, "loss": float(mean_loss)})
+        _DL_EPOCHS.inc()
+        _DL_EPOCH_SECONDS.observe(_time.perf_counter() - _ep_t0)
         keeper.record(float(mean_loss))
         if on_epoch is not None:
             on_epoch(params, opt_state, epochs_done, history)

@@ -23,6 +23,7 @@ default — H2O derives a small data-dependent default].
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Sequence
@@ -39,9 +40,16 @@ from h2o3_tpu.models.glm_families import get_family
 from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
 from h2o3_tpu.ops.gram import admm_elastic_net, solve_cholesky, weighted_gram
 from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
 
 _HI = jax.lax.Precision.HIGHEST
+
+_IRLS_ITERS = _mx.counter(
+    "glm_irls_iterations_total", "IRLS iterations executed")
+_IRLS_SECONDS = _mx.histogram(
+    "glm_irls_iteration_seconds",
+    "per-IRLS-iteration wall time (Gram pass + solve; the hex.glm hot loop)")
 
 
 @dataclass
@@ -434,6 +442,7 @@ class GLM(ModelBuilder):
             it_pos = it0 if li == li0 else 0
             iters_done = iters0 if li == li0 else 0
             while it_pos < max_iter:
+                _it_t0 = time.perf_counter()
                 G, b, dev = _irls_pass(
                     X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
                 )
@@ -457,6 +466,11 @@ class GLM(ModelBuilder):
                 iters_done += 1
                 it_pos = iters_done
                 tot_iters += 1
+                # the np.asarray(G) above forced the device sync, so this is
+                # the true Gram+solve iteration time (checkpoint IO excluded;
+                # persist_write_seconds covers it)
+                _IRLS_ITERS.inc()
+                _IRLS_SECONDS.observe(time.perf_counter() - _it_t0)
                 stop = delta < p.beta_epsilon or abs(dev_prev - dev_now) / max(
                     abs(dev_now), 1e-10
                 ) < p.objective_epsilon

@@ -27,7 +27,13 @@ from h2o3_tpu.models.model_base import (
     stopping_metric_direction,
 )
 from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
+
+_GRID_MODELS = _mx.counter(
+    "grid_models_total", "grid-search models finished, by outcome")
+_GRID_MODEL_SECONDS = _mx.histogram(
+    "grid_model_seconds", "wall time of one grid combo's model build")
 
 
 class SearchCriteria:
@@ -211,11 +217,15 @@ class GridSearch:
                     job.update(min(1.0, (i + 1) / max(1, n_planned)))
                     continue
             try:
-                builder = self.builder_cls(**{**self.base_params, **hv})
-                m = builder.train(
-                    x=x, y=y, training_frame=training_frame,
-                    validation_frame=validation_frame, **kw,
-                )
+                _m_t0 = time.perf_counter()
+                with _mx.span("grid.model", combo=_hv_key(hv)):
+                    builder = self.builder_cls(**{**self.base_params, **hv})
+                    m = builder.train(
+                        x=x, y=y, training_frame=training_frame,
+                        validation_frame=validation_frame, **kw,
+                    )
+                _GRID_MODELS.inc(outcome="built")
+                _GRID_MODEL_SECONDS.observe(time.perf_counter() - _m_t0)
                 self.grid.models.append(m)
                 self.grid.hyper_values.append(dict(hv))
                 if ckdir:
@@ -236,6 +246,7 @@ class GridSearch:
             except faults.TrainAbort:
                 raise  # simulated kill -9: the whole grid dies, manifest stays
             except Exception as e:  # a failing combo must not kill the grid (h2o keeps failures)
+                _GRID_MODELS.inc(outcome="failed")
                 self.grid.failures.append((dict(hv), repr(e)))
                 Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")
             job.update(min(1.0, (i + 1) / max(1, n_planned)))
@@ -297,11 +308,15 @@ class GridSearch:
 
         def build_one(hv: dict, hv_key: str) -> None:
             try:
-                builder = self.builder_cls(**{**self.base_params, **hv})
-                m = builder.train(
-                    x=x, y=y, training_frame=training_frame,
-                    validation_frame=validation_frame, **kw,
-                )
+                _m_t0 = time.perf_counter()
+                with _mx.span("grid.model", combo=hv_key):
+                    builder = self.builder_cls(**{**self.base_params, **hv})
+                    m = builder.train(
+                        x=x, y=y, training_frame=training_frame,
+                        validation_frame=validation_frame, **kw,
+                    )
+                _GRID_MODELS.inc(outcome="built")
+                _GRID_MODEL_SECONDS.observe(time.perf_counter() - _m_t0)
                 record_model(m, hv, hv_key)
             except faults.TrainAbort as e:
                 # simulated kill -9 from a worker thread: stop feeding the
@@ -310,6 +325,7 @@ class GridSearch:
                     abort_box.append(e)
                 stop_flag.set()
             except Exception as e:
+                _GRID_MODELS.inc(outcome="failed")
                 with lock:
                     self.grid.failures.append((dict(hv), repr(e)))
                 Log.warn(f"grid {self.grid.key}: combo {hv} failed: {e!r}")

@@ -24,8 +24,12 @@ from h2o3_tpu.cluster.job import Job
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.frame.frame import CAT, Frame, Vec
 from h2o3_tpu.models import metrics as MM
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
 from h2o3_tpu.utils.timer import Timer
+
+_MODELS_BUILT = _mx.counter(
+    "models_built_total", "models trained to completion, by algo")
 
 
 @dataclass
@@ -330,12 +334,15 @@ class ModelBuilder:
         self._validate(train, valid)
         if getattr(p, "checkpoint", None) is not None and p.nfolds and p.nfolds > 1:
             raise ValueError("checkpoint cannot be combined with cross-validation")
-        model = self._build(job, train, valid)
+        with _mx.span(f"{self.algo}.build"):
+            model = self._build(job, train, valid)
         model.run_time_ms = int(t.time_ms())
         self.model = model
+        _MODELS_BUILT.inc(algo=self.algo)
         # cross-validation driver (after main model, like modern H2O order)
         if p.nfolds and p.nfolds > 1:
-            self._cross_validate(job, train)
+            with _mx.span(f"{self.algo}.cv", nfolds=p.nfolds):
+                self._cross_validate(job, train)
         if getattr(p, "export_checkpoints_dir", None):
             # H2O semantics: every finished model auto-saves to the dir
             import os

@@ -38,6 +38,7 @@ from h2o3_tpu.models.tree.distributions import (
 )
 from h2o3_tpu.models.tree.shared_tree import Tree, build_tree
 from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
 
 
@@ -494,26 +495,29 @@ class GBM(ModelBuilder):
             ):
                 chunk = min(interval, cap, p.ntrees - m_done)
                 lrs = lr * (p.learn_rate_annealing ** np.arange(chunk))
-                F, varimp_dev, stacked = build_trees_scanned(
-                    bins, w, y, F, varimp_dev, rngkey, chunk,
-                    tree_offset=m_done,
-                    grad_fn=lambda F_, y_, w_: grad_hess(dist, F_, y_, w_, aux),
-                    grad_key=("gbm", dist, aux),
-                    sample_rate=p.sample_rate,
-                    n_bins=n_bins,
-                    is_cat_cols=spec.is_cat,
-                    max_depth=p.max_depth,
-                    min_rows=p.min_rows,
-                    min_split_improvement=p.min_split_improvement,
-                    learn_rates=lrs,
-                    max_abs_leaf=p.max_abs_leafnode_pred,
-                    col_sample_rate=p.col_sample_rate,
-                    col_sample_rate_per_tree=p.col_sample_rate_per_tree,
-                    reg_lambda=getattr(p, "reg_lambda", 0.0),
-                    reg_alpha=getattr(p, "reg_alpha", 0.0),
-                )
+                with _mx.span("gbm.build_tree", trees=chunk,
+                              tree_offset=m_done):
+                    F, varimp_dev, stacked = build_trees_scanned(
+                        bins, w, y, F, varimp_dev, rngkey, chunk,
+                        tree_offset=m_done,
+                        grad_fn=lambda F_, y_, w_: grad_hess(dist, F_, y_, w_, aux),
+                        grad_key=("gbm", dist, aux),
+                        sample_rate=p.sample_rate,
+                        n_bins=n_bins,
+                        is_cat_cols=spec.is_cat,
+                        max_depth=p.max_depth,
+                        min_rows=p.min_rows,
+                        min_split_improvement=p.min_split_improvement,
+                        learn_rates=lrs,
+                        max_abs_leaf=p.max_abs_leafnode_pred,
+                        col_sample_rate=p.col_sample_rate,
+                        col_sample_rate_per_tree=p.col_sample_rate_per_tree,
+                        reg_lambda=getattr(p, "reg_lambda", 0.0),
+                        reg_alpha=getattr(p, "reg_alpha", 0.0),
+                    )
                 lr *= p.learn_rate_annealing ** chunk
-                trees.extend([[t] for t in trees_from_stacked(stacked, chunk)])
+                with _mx.span("gbm.pull_records", trees=chunk):
+                    trees.extend([[t] for t in trees_from_stacked(stacked, chunk)])
                 if Fv is not None:
                     Fv[0] = replay_batch(bins_v, stacked, Fv[0])
                 m_done += chunk
@@ -558,6 +562,11 @@ class GBM(ModelBuilder):
             tree_key = jax.random.fold_in(rngkey, m)
 
             group: list[Tree] = []
+            # manual enter/exit keeps the two dist branches unindented; an
+            # exception between them kills the whole Job (and its context)
+            # so the unexited span leaks nothing
+            _tree_span = _mx.span("gbm.build_tree", tree=m)
+            _tree_span.__enter__()
             if dist == "multinomial":
                 T, H = multinomial_grad_hess(F, Y1h, w_tree, K)
                 newF = []
@@ -609,6 +618,7 @@ class GBM(ModelBuilder):
                     reg_alpha=getattr(p, "reg_alpha", 0.0),
                 )
                 group.append(tree)
+            _tree_span.__exit__(None, None, None)
             trees.append(group)
             lr *= p.learn_rate_annealing
 

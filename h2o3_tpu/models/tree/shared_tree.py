@@ -45,19 +45,79 @@ _NEG = -1e30
 # build telemetry: host dispatches and program-cache traffic. The whole-tree
 # design's contract is O(1) dispatches per tree (vs O(depth) for the
 # host-driven level loop) and one compile per shape signature — these
-# counters are how tests assert it and how bench.py reports it.
+# counters are how tests assert it and how bench.py reports it. The counts
+# now live in the cluster metrics registry (utils/metrics.py, served over
+# GET /3/Metrics); BUILD_STATS stays as a dict-shaped back-compat alias
+# whose reads and writes go straight through to the registry counters
+# (always=True: the accounting is a test contract, not optional telemetry,
+# so H2O3_TPU_METRICS=0 does not switch it off).
 
-BUILD_STATS = {
-    "dispatches": 0,  # device-program launches issued by the builders
-    "trees_built": 0,  # trees those dispatches produced
-    "tree_programs_compiled": 0,  # whole-tree/chunk program cache misses
-    "tree_program_cache_hits": 0,  # ... and hits (same shape → no recompile)
+from h2o3_tpu.utils import metrics as _metrics
+
+_BUILD_COUNTERS = {
+    # alias key -> registry counter
+    "dispatches": _metrics.counter(
+        "tree_dispatches_total",
+        "device-program launches issued by the tree builders", always=True),
+    "trees_built": _metrics.counter(
+        "tree_trees_built_total", "trees those dispatches produced",
+        always=True),
+    "tree_programs_compiled": _metrics.counter(
+        "tree_programs_compiled_total",
+        "whole-tree/chunk program cache misses", always=True),
+    "tree_program_cache_hits": _metrics.counter(
+        "tree_program_cache_hits_total",
+        "whole-tree/chunk program cache hits (same shape, no recompile)",
+        always=True),
 }
+_FUSED_SECONDS = _metrics.counter(
+    "tree_fused_build_seconds_total",
+    "wall seconds spent inside fused whole-tree/chunk build dispatch calls",
+    always=True)
+
+
+class _BuildStatsAlias:
+    """Mapping view of the tree-build registry counters.
+
+    ``BUILD_STATS["dispatches"] += 1`` and ``dict(BUILD_STATS)`` behave
+    exactly as they did when this was a module-global dict — existing tests
+    and bench code keep working — but the single source of truth is the
+    registry, so /3/Metrics and bench artifacts cannot disagree."""
+
+    def __getitem__(self, k: str) -> int:
+        return int(_BUILD_COUNTERS[k].value())
+
+    def __setitem__(self, k: str, v) -> None:
+        _BUILD_COUNTERS[k].set_(float(v))
+
+    def __iter__(self):
+        return iter(_BUILD_COUNTERS)
+
+    def __len__(self) -> int:
+        return len(_BUILD_COUNTERS)
+
+    def __contains__(self, k) -> bool:
+        return k in _BUILD_COUNTERS
+
+    def keys(self):
+        return _BUILD_COUNTERS.keys()
+
+    def items(self):
+        return [(k, self[k]) for k in _BUILD_COUNTERS]
+
+    def values(self):
+        return [self[k] for k in _BUILD_COUNTERS]
+
+    def __repr__(self) -> str:
+        return repr(dict(self.items()))
+
+
+BUILD_STATS = _BuildStatsAlias()
 
 
 def reset_build_stats() -> dict:
     """Zero the counters and return the pre-reset snapshot."""
-    snap = dict(BUILD_STATS)
+    snap = dict(BUILD_STATS.items())
     for k in BUILD_STATS:
         BUILD_STATS[k] = 0
     return snap
@@ -1083,13 +1143,21 @@ def build_trees_scanned(
     )
     BUILD_STATS["dispatches"] += 1
     BUILD_STATS["trees_built"] += n_trees
-    return prog(
+    # host-side dispatch wall time (includes the trace/compile on a cache
+    # miss; the device work itself completes asynchronously) — the
+    # "fused-build seconds" lane of the registry
+    import time as _time
+
+    _t0 = _time.perf_counter()
+    out = prog(
         bins_u8, w, y, preds, varimp, base_key,
         base_key if row_key is None else row_key,
         jnp.int32(tree_offset), lrs, is_cat_dev,
         jnp.float32(min_rows), jnp.float32(min_split_improvement),
         jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
     )
+    _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
+    return out
 
 
 def scan_chunk_cap(
@@ -1380,6 +1448,9 @@ def build_tree(
         )
         BUILD_STATS["dispatches"] += 1
         BUILD_STATS["trees_built"] += 1
+        import time as _time
+
+        _t0 = _time.perf_counter()
         _, preds, varimp, records = prog(
             bins_u8, preds, varimp, w, wy, wh, key, cols_enabled_dev,
             is_cat_dev,
@@ -1387,6 +1458,7 @@ def build_tree(
             jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
             jnp.float32(col_sample_rate), leaf_reg,
         )
+        _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
         for rec in records:
             tree.levels.append(TreeLevel(**rec))
         return tree, preds, varimp

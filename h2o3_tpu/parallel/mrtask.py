@@ -28,10 +28,20 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable
 
+import time
+
 import jax
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+from h2o3_tpu.utils import metrics
+
+_DISPATCHES = metrics.counter(
+    "mrtask_dispatches_total", "MRTask-style SPMD dispatches, by kind")
+_DISPATCH_SECONDS = metrics.counter(
+    "mrtask_dispatch_seconds_total",
+    "host wall seconds inside MRTask dispatch calls (includes compiles on "
+    "cache misses; device work completes asynchronously)")
 
 
 # Compiled-task cache keyed on (map_fn, arity, mesh, reduce?) — the analog of
@@ -79,7 +89,11 @@ def map_reduce(map_fn: Callable, *cols, mesh=None):
     ``MRTask.map`` + an associative-``+`` ``MRTask.reduce``. Pass a stable
     (module-level) ``map_fn`` so the compilation cache hits.
     """
-    return _compiled(map_fn, len(cols), mesh or get_mesh(), True)(*cols)
+    _DISPATCHES.inc(kind="map_reduce")
+    t0 = time.perf_counter()
+    out = _compiled(map_fn, len(cols), mesh or get_mesh(), True)(*cols)
+    _DISPATCH_SECONDS.inc(time.perf_counter() - t0)
+    return out
 
 
 def map_only(map_fn: Callable, *cols, mesh=None):
@@ -88,4 +102,8 @@ def map_only(map_fn: Callable, *cols, mesh=None):
     Equivalent of an MRTask that only writes ``NewChunk`` outputs: the result
     keeps the row sharding of the inputs.
     """
-    return _compiled(map_fn, len(cols), mesh or get_mesh(), False)(*cols)
+    _DISPATCHES.inc(kind="map_only")
+    t0 = time.perf_counter()
+    out = _compiled(map_fn, len(cols), mesh or get_mesh(), False)(*cols)
+    _DISPATCH_SECONDS.inc(time.perf_counter() - t0)
+    return out

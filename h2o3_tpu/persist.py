@@ -45,7 +45,17 @@ import numpy as np
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.models.model_base import Model
 from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
+
+# flaky storage must be visible BEFORE it becomes an outage: every transient
+# retry bumps this (alongside the Log.warn), and write durations feed the
+# checkpoint-cost histogram
+_RETRIES_TOTAL = _mx.counter(
+    "persist_retries_total", "transient persist IO retries, by operation kind")
+_WRITE_SECONDS = _mx.histogram(
+    "persist_write_seconds",
+    "durable persist write wall time (incl. retries/backoff), by kind")
 
 FORMAT_MAGIC = b"H2O3TPU1"
 
@@ -327,14 +337,19 @@ def _retry_delays(desc: str) -> list[float]:
 
 def _with_retries(op: Callable[[], "T"], desc: str):  # noqa: F821 - doc type
     """Run ``op`` retrying transient IO errors with backoff; the final
-    attempt's (or any deterministic) error surfaces unchanged."""
+    attempt's (or any deterministic) error surfaces unchanged. Every retry
+    is LOUD — a Log.warn with op/attempt/backoff plus a
+    ``persist_retries_total`` bump — so flaky storage shows up in logs and
+    on /3/Metrics before it becomes an outage."""
     delays = _retry_delays(desc)
+    kind = desc.split(" ", 1)[0]  # "write"/"read"/"export"/... bounded labels
     for attempt in range(len(delays) + 1):
         try:
             return op()
         except Exception as e:
             if attempt >= len(delays) or not _is_transient(e):
                 raise
+            _RETRIES_TOTAL.inc(op=kind)
             Log.warn(
                 f"persist: transient failure on {desc} (attempt "
                 f"{attempt + 1}/{len(delays) + 1}): {e!r} — retrying in "
@@ -353,7 +368,9 @@ def write_bytes(data: bytes, path: str) -> str:
         with backend.open_write(p) as f:
             f.write(data)
 
+    t0 = time.perf_counter()
     _with_retries(attempt, f"write {p}")
+    _WRITE_SECONDS.observe(time.perf_counter() - t0, kind="bytes")
     return p
 
 
@@ -549,7 +566,9 @@ def write_model_bytes(data: bytes, backend, p: str, model_key: str) -> str:
         with backend.open_write(p) as f:
             f.write(data)
 
+    t0 = time.perf_counter()
     _with_retries(attempt, f"write model {model_key} -> {p}")
+    _WRITE_SECONDS.observe(time.perf_counter() - t0, kind="model")
     Log.info(f"saved model {model_key} to {p}")
     return p
 
@@ -644,5 +663,7 @@ def export_df(df, path: str, force: bool = False, format: str | None = None) -> 
             else:
                 raise ValueError(f"unsupported export format {fmt!r}")
 
+    t0 = time.perf_counter()
     _with_retries(attempt, f"export {p}")
+    _WRITE_SECONDS.observe(time.perf_counter() - t0, kind="export")
     return p

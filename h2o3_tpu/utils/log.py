@@ -25,12 +25,24 @@ _LEVELS = {
 class _RingHandler(logging.Handler):
     def __init__(self, capacity: int = 4096):
         super().__init__()
-        self.buffer: collections.deque[str] = collections.deque(maxlen=capacity)
+        # (levelno, formatted line): the REST /3/Logs level filter needs the
+        # numeric level — parsing it back out of the formatted string would
+        # break the moment the format changes
+        self.buffer: collections.deque[tuple[int, str]] = collections.deque(
+            maxlen=capacity
+        )
         self._lock2 = threading.Lock()
 
     def emit(self, record: logging.LogRecord) -> None:
         with self._lock2:
-            self.buffer.append(self.format(record))
+            self.buffer.append((record.levelno, self.format(record)))
+
+    def tail(self, n: int, min_levelno: int | None = None) -> list[str]:
+        with self._lock2:
+            snap = list(self.buffer)
+        if min_levelno is not None:
+            snap = [(lv, s) for lv, s in snap if lv >= min_levelno]
+        return [s for _, s in snap[-n:]] if n > 0 else []
 
 
 class Log:
@@ -77,6 +89,13 @@ class Log:
         cls._logger.debug(" ".join(str(m) for m in msg))
 
     @classmethod
-    def tail(cls, n: int = 100) -> list[str]:
+    def tail(cls, n: int = 100, level: str | None = None) -> list[str]:
+        """Last ``n`` buffered lines, optionally at or above ``level``
+        (H2O level names: FATAL/ERRR/WARN/INFO/DEBUG/TRACE)."""
         cls._ensure()
-        return list(cls._ring.buffer)[-n:]
+        min_levelno = _LEVELS.get(level.upper()) if level else None
+        if level and min_levelno is None:
+            raise ValueError(
+                f"unknown log level {level!r} (one of {sorted(_LEVELS)})"
+            )
+        return cls._ring.tail(n, min_levelno)

@@ -73,9 +73,32 @@ def profiler(logdir: str):
 
 
 def timeline(n: int = 200) -> dict:
-    """The GET /3/Timeline payload."""
-    evs = events(n)
+    """The GET /3/Timeline payload: compile/profiler events merged with the
+    metrics layer's recent span events, by timestamp."""
+    # ONE snapshot under the lock serves both the event tail and the compile
+    # count — iterating the live deque unlocked raced concurrent record()
+    # appends (RuntimeError: deque mutated during iteration)
+    with _LOCK:
+        snap = list(_EVENTS)
+    compile_count = sum(1 for e in snap if e["kind"] == "compile")
+    evs = snap[-n:]
+    span_count = 0
+    try:
+        from h2o3_tpu.utils import metrics
+
+        spans = metrics.recent_spans(n)
+        span_count = len(spans)
+        evs = evs + [
+            {"ts": s["ts"], "kind": "span",
+             "msg": s["name"], "dur_ms": round(s["dur_s"] * 1e3, 3),
+             **({"job": s["trace"]} if s["trace"] else {})}
+            for s in spans
+        ]
+        evs = sorted(evs, key=lambda e: e["ts"])[-n:]
+    except Exception:  # metrics layer disabled/broken must not sink /3/Timeline
+        pass
     return {
         "events": evs,
-        "compile_count": sum(1 for e in _EVENTS if e["kind"] == "compile"),
+        "compile_count": compile_count,
+        "span_count": span_count,
     }

@@ -34,8 +34,14 @@ def test_latest_bench_ok_gate(monkeypatch):
 @pytest.mark.parametrize(
     "payload,want_rc",
     [
-        ({"value": 2.5, "glm_1m": {"seconds": 1}}, 0),
-        ({"value": 2.5, "glm_1m_error": "boom"}, 1),  # r4 cascade mode
+        ({"value": 2.5, "glm_1m": {"seconds": 1},
+          "metrics_registry": {"tree_dispatches_total": 4}}, 0),
+        ({"value": 2.5, "glm_1m_error": "boom",
+          "metrics_registry": {"tree_dispatches_total": 4}}, 1),  # r4 cascade
+        # headline + phases but NO registry-snapshot block: produced by a
+        # pre-observability bench — must not stand the watcher down
+        ({"value": 2.5, "glm_1m": {"seconds": 1}}, 1),
+        ({"value": 2.5, "glm_1m": {"seconds": 1}, "metrics_registry": {}}, 1),
         ({"value": 0.0, "error": "init hung"}, 1),
         ({}, 1),
     ],

@@ -67,7 +67,7 @@ def main() -> int:
         print("no recent BENCH_builder artifacts")
         return 1
     for path in recent:
-        headline_ok = phases_ok = False
+        headline_ok = phases_ok = registry_ok = False
         note = ""
         try:
             with open(path) as f:
@@ -77,6 +77,12 @@ def main() -> int:
                 phases_ok = any(
                     isinstance(d.get(p), dict) for p in POST_HEADLINE
                 )
+                # the registry-snapshot block: bench counters sourced from
+                # the live /3/Metrics registry — an artifact without it was
+                # produced by a pre-observability bench and cannot be
+                # cross-checked against the endpoint
+                reg = d.get("metrics_registry")
+                registry_ok = isinstance(reg, dict) and len(reg) > 0
         except OSError as e:  # vanished/unreadable between glob and open
             note = f" (unreadable: {e.strerror or e})"
         except Exception as e:  # torn/empty/garbage JSON is a MISSING, not a crash
@@ -85,9 +91,10 @@ def main() -> int:
             f"{os.path.basename(path)}: "
             f"headline={'ok' if headline_ok else 'MISSING'}"
             f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
+            f" registry-snapshot={'ok' if registry_ok else 'MISSING'}"
             f"{note}"
         )
-        if headline_ok and phases_ok:
+        if headline_ok and phases_ok and registry_ok:
             return 0
     return 1
 
