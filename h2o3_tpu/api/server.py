@@ -44,6 +44,179 @@ _REST_SECONDS = _metrics.histogram(
     "rest_request_seconds", "REST handler latency, by method/route")
 _REST_IN_FLIGHT = _metrics.gauge(
     "rest_requests_in_flight", "REST requests currently executing")
+_REST_REJECTED = _metrics.counter(
+    "rest_rejected_total",
+    "requests shed by admission control (429/503 + Retry-After), "
+    "by method/route/reason")
+_JOB_QUEUE_DEPTH = _metrics.gauge(
+    "rest_job_queue_depth",
+    "live (pending+running) REST-created jobs — the admission queue the "
+    "H2O3_TPU_MAX_QUEUED_JOBS bound applies to")
+_G_DRAINING = _metrics.gauge(
+    "rest_draining", "1 while the server is draining (no mutating admits)")
+_DRAIN_SECONDS = _metrics.gauge(
+    "rest_drain_seconds", "wall seconds the last graceful drain took")
+_IDEM_REPLAYS = _metrics.counter(
+    "rest_idempotent_replays_total",
+    "POSTs answered from the Idempotency-Key response cache (a client "
+    "retry that would otherwise have double-run the mutation)")
+
+
+# ---------------------------------------------------------------------------
+# admission control + drain state (tentpole: overload-safe serving).
+# Process-global on purpose: handlers are module-level and the REST server
+# is a process singleton (start_server) — a second H2OServer in one process
+# shares the gate, which is the correct bound (one process, one mesh).
+
+_DRAINING = False  # begin_drain() flips it; stop() clears it on exit
+
+_GATE_LOCK = threading.Lock()
+_INFLIGHT_MUTATING = 0  # mutating requests currently executing (gate slots)
+
+_JOBS_LOCK = threading.Lock()
+_REST_JOBS: list[Job] = []  # jobs created by REST routes (drain + queue bound)
+
+
+def _admission_enter(method: str, route: str) -> bool:
+    """Admission gate for mutating requests. Returns True when a bounded
+    in-flight slot was taken (release with :func:`_admission_exit`); raises
+    ``ApiError`` 429/503 + ``Retry-After`` when the request must be shed.
+    GETs (health probes, job polls, metrics scrapes) always pass — an
+    overloaded or draining cloud must stay observable."""
+    if method == "GET":
+        return False
+    if route == r"/3/Shutdown":
+        return False  # the drain/shutdown request must land under overload
+    if _DRAINING:
+        _REST_REJECTED.inc(method=method, route=route or "/", reason="draining")
+        raise ApiError(
+            503, "server is draining: no new mutating work is admitted "
+                 "(running jobs are flushing checkpoints; retry against "
+                 "another coordinator or after restart)",
+            headers={"Retry-After": "5"})
+    from h2o3_tpu import config
+
+    cap = config.get_int("H2O3_TPU_MAX_INFLIGHT")
+    if cap <= 0:
+        return False
+    global _INFLIGHT_MUTATING
+    with _GATE_LOCK:
+        if _INFLIGHT_MUTATING >= cap:
+            full = _INFLIGHT_MUTATING
+        else:
+            _INFLIGHT_MUTATING += 1
+            return True
+    _REST_REJECTED.inc(method=method, route=route or "/", reason="inflight_full")
+    raise ApiError(
+        429, f"too many in-flight mutating requests ({full} >= "
+             f"H2O3_TPU_MAX_INFLIGHT={cap}); retry with backoff",
+        headers={"Retry-After": "1"})
+
+
+def _admission_exit() -> None:
+    global _INFLIGHT_MUTATING
+    with _GATE_LOCK:
+        _INFLIGHT_MUTATING = max(0, _INFLIGHT_MUTATING - 1)
+
+
+def _live_rest_jobs() -> int:
+    """Prune terminal jobs from the tracked list; gauge + return the depth."""
+    with _JOBS_LOCK:
+        _REST_JOBS[:] = [
+            j for j in _REST_JOBS if j.status in (Job.PENDING, Job.RUNNING)
+        ]
+        n = len(_REST_JOBS)
+    _JOB_QUEUE_DEPTH.set(n)
+    return n
+
+
+def _start_job(work, description: str, cancellable: bool = True) -> Job:
+    """The one place REST routes create Jobs: applies the bounded pending-job
+    queue (503 + Retry-After when full or draining), the default job
+    deadline knob, and registers the job for graceful drain."""
+    from h2o3_tpu import config
+
+    if _DRAINING:
+        _REST_REJECTED.inc(method="POST", route="<job>", reason="draining")
+        raise ApiError(503, "server is draining: not accepting new jobs",
+                       headers={"Retry-After": "5"})
+    cap = config.get_int("H2O3_TPU_MAX_QUEUED_JOBS")
+    if cap > 0 and _live_rest_jobs() >= cap:
+        _REST_REJECTED.inc(method="POST", route="<job>", reason="job_queue_full")
+        raise ApiError(
+            503, f"job queue full ({cap} live jobs >= "
+                 f"H2O3_TPU_MAX_QUEUED_JOBS={cap}); retry with backoff",
+            headers={"Retry-After": "2"})
+    job = Job(work, description)
+    if not cancellable:
+        job.cancellable = False
+    deadline = config.get_float("H2O3_TPU_JOB_DEADLINE_SECS")
+    if deadline > 0:
+        # enforced between iterations via the soft-deadline plumbing:
+        # iterative builders truncate gracefully, keeping the partial model
+        job.soft_deadline = time.time() + deadline
+    with _JOBS_LOCK:
+        _REST_JOBS.append(job)
+    _JOB_QUEUE_DEPTH.set(len(_REST_JOBS))
+    job.start()
+    return job
+
+
+def _handler_deadline() -> float | None:
+    from h2o3_tpu import config
+
+    v = config.get_float("H2O3_TPU_HANDLER_DEADLINE_SECS")
+    return v if v > 0 else None
+
+
+def _join_for_handler(job: Job):
+    """Synchronous-route join bounded by the handler deadline: past it the
+    route answers 504 with the job key (the job keeps running — poll
+    /3/Jobs) instead of pinning the handler thread forever."""
+    try:
+        return job.join(timeout=_handler_deadline())
+    except TimeoutError:
+        raise ApiError(
+            504, f"handler deadline exceeded; job {job.key} is still "
+                 f"running — poll /3/Jobs/{job.key}",
+            headers={"Retry-After": "5"})
+
+
+# ---------------------------------------------------------------------------
+# Idempotency-Key dedupe: a client retrying a POST (after a timeout, a 429,
+# a dropped connection) sends the same Idempotency-Key; the server replays
+# the first response instead of double-running the mutation (double-training
+# a model, double-parsing a frame). Completed responses are cached in a
+# bounded LRU; an in-flight duplicate gets 409 + Retry-After.
+
+_IDEM_LOCK = threading.Lock()
+_IDEM_PENDING = object()
+_IDEM_CACHE: "dict[str, object]" = {}  # key -> (status, payload) | _IDEM_PENDING
+_IDEM_MAX = 256
+
+
+def _idem_begin(key: str):
+    """Claim the key. Returns a cached (status, payload) to replay, the
+    _IDEM_PENDING sentinel when another thread is mid-flight, or None when
+    this request now owns the key."""
+    with _IDEM_LOCK:
+        hit = _IDEM_CACHE.get(key)
+        if hit is not None:
+            return hit
+        while len(_IDEM_CACHE) >= _IDEM_MAX:
+            _IDEM_CACHE.pop(next(iter(_IDEM_CACHE)))
+        _IDEM_CACHE[key] = _IDEM_PENDING
+        return None
+
+
+def _idem_finish(key: str, status: int, payload: dict | None) -> None:
+    """Publish the outcome: 2xx/4xx responses are cached for replay; 5xx
+    (and non-JSON) outcomes release the key so a retry re-attempts."""
+    with _IDEM_LOCK:
+        if payload is not None and status < 500:
+            _IDEM_CACHE[key] = (status, payload)
+        else:
+            _IDEM_CACHE.pop(key, None)
 
 _ALGOS = ("gbm", "xgboost", "glm", "drf", "xrt", "deeplearning", "kmeans", "pca", "svd",
           "naivebayes", "isolationforest", "stackedensemble",
@@ -88,9 +261,10 @@ def _json_default(o):
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, msg: str):
+    def __init__(self, status: int, msg: str, headers: dict | None = None):
         super().__init__(msg)
         self.status = status
+        self.headers = headers or {}
 
 
 # ---------------------------------------------------------------------------
@@ -287,9 +461,8 @@ class Endpoints:
             setup["sharded"] = True  # per-rank row-range ingest (parse_sharded)
         from h2o3_tpu.cluster import spmd
 
-        job = Job(lambda j: spmd.run("parse", setup=setup, dest=dest),
-                  f"Parse {srcs[0]}")
-        job.start()
+        job = _start_job(lambda j: spmd.run("parse", setup=setup, dest=dest),
+                         f"Parse {srcs[0]}")
         return {"__meta": {"schema_type": "Parse"}, "job": _job_schema(job),
                 "destination_frame": {"name": dest}}
 
@@ -424,14 +597,13 @@ class Endpoints:
         from h2o3_tpu.cluster import spmd
 
         dest = DKV.make_key(algo)  # coordinator-chosen, carried to followers
-        job = Job(
+        job = _start_job(
             lambda j: spmd.run(
                 "build", algo=algo, kwargs=kwargs, x=x, y=y,
                 train=train_key, valid=valid_key, dest=dest,
             ),
             f"{algo} build",
         )
-        job.start()
         return {"__meta": {"schema_type": "ModelBuilder"},
                 "job": _job_schema(job), "algo": algo,
                 "messages": [], "error_count": 0}
@@ -502,13 +674,12 @@ class Endpoints:
 
             gs = GridSearch(cls, hyper, search_criteria=criteria,
                             grid_id=grid_id, parallelism=parallelism, **kwargs)
-            job = Job(
+            job = _start_job(
                 lambda j: gs._drive(j, x, y, DKV.get(train_key),
                                     DKV.get(valid_key) if valid_key else None, {}),
                 f"grid over {algo}",
             )
             gs.job = job
-            job.start()
             return {"__meta": {"schema_type": "GridSearchV99"},
                     "job": _job_schema(job), "grid_id": {"name": gs.grid.key}}
         # multi-process: the whole grid runs as ONE replicated command; every
@@ -520,16 +691,15 @@ class Endpoints:
         from h2o3_tpu.models.grid import Grid as _Grid
 
         _Grid(grid_id, cls, sorted(hyper))
-        job = Job(
+        job = _start_job(
             lambda j: spmd.run(
                 "grid", algo=algo, hyper=hyper, criteria=criteria,
                 grid_id=grid_id, parallelism=parallelism, kwargs=kwargs,
                 x=x, y=y, train=train_key, valid=valid_key,
             ),
             f"grid over {algo}",
+            cancellable=False,  # replicated collective sequence (see spmd)
         )
-        job.cancellable = False  # replicated collective sequence (see spmd)
-        job.start()
         return {"__meta": {"schema_type": "GridSearchV99"},
                 "job": _job_schema(job), "grid_id": {"name": grid_id}}
 
@@ -891,9 +1061,8 @@ class Endpoints:
 
         if not spmd.multi_process():
             aml = AutoML(**kwargs)
-            job = Job(lambda j: aml.train(y=y, training_frame=train_key),
-                      "AutoML build")
-            job.start()
+            job = _start_job(lambda j: aml.train(y=y, training_frame=train_key),
+                             "AutoML build")
             return {"__meta": {"schema_type": "AutoMLBuilder"},
                     "job": _job_schema(job),
                     "automl_id": {"name": aml.key}}
@@ -903,13 +1072,12 @@ class Endpoints:
         DKV.remove(placeholder.key)
         placeholder.key = dest
         DKV.put(dest, placeholder)
-        job = Job(
+        job = _start_job(
             lambda j: spmd.run("automl", kwargs=kwargs, y=y, train=train_key,
                                dest=dest),
             "AutoML build",
+            cancellable=False,  # replicated collective sequence (see spmd)
         )
-        job.cancellable = False  # replicated collective sequence (see spmd)
-        job.start()
         return {"__meta": {"schema_type": "AutoMLBuilder"},
                 "job": _job_schema(job),
                 "automl_id": {"name": dest}}
@@ -982,14 +1150,13 @@ class Endpoints:
             raise ApiError(
                 400, f"destination_frames must name all {n_parts} parts "
                 f"(ratios summing < 1 add a remainder part); got {len(dests)}")
-        job = Job(
+        job = _start_job(
             lambda j: spmd.run("split_frame", frame_key=frame_key,
                                ratios=ratios, dests=dests, seed=seed),
             "SplitFrame",
         )
-        job.start()
         try:
-            job.join()
+            _join_for_handler(job)
         except RuntimeError as e:
             raise ApiError(400, str(e))
         return {"__meta": {"schema_type": "SplitFrame"},
@@ -1022,11 +1189,10 @@ class Endpoints:
                 spec["seed"] = random.randrange(1 << 31)
         except (ValueError, TypeError) as e:
             raise ApiError(400, f"bad CreateFrame parameters: {e}")
-        job = Job(lambda j: spmd.run("create_frame", dest=dest, spec=spec),
-                  "CreateFrame")
-        job.start()
+        job = _start_job(lambda j: spmd.run("create_frame", dest=dest, spec=spec),
+                         "CreateFrame")
         try:
-            job.join()
+            _join_for_handler(job)
         except RuntimeError as e:
             raise ApiError(400, str(e))
         fr = DKV.get(dest)
@@ -1057,7 +1223,7 @@ class Endpoints:
             min_occurrence = int(params.get("min_occurrence", 1))
         except (ValueError, TypeError) as e:
             raise ApiError(400, f"bad Interaction parameters: {e}")
-        job = Job(
+        job = _start_job(
             lambda j: spmd.run(
                 "interaction", frame_key=frame_key, dest=dest,
                 factors=list(factors), pairwise=pairwise,
@@ -1065,9 +1231,8 @@ class Endpoints:
             ),
             "Interaction",
         )
-        job.start()
         try:
-            job.join()
+            _join_for_handler(job)
         except RuntimeError as e:
             raise ApiError(400, str(e))
         fr = DKV.get(dest)
@@ -1165,6 +1330,29 @@ class Endpoints:
             raise ApiError(400, str(e))
         return {"__meta": {"schema_type": "Rapids"}, **result}
 
+    # -- shutdown / drain (water.api.ShutdownHandler successor) -------------
+    def shutdown(self, params):
+        """``POST /3/Shutdown?drain=true`` — stop the coordinator. With
+        ``drain``: stop admitting mutating work immediately, wait (bounded
+        by H2O3_TPU_DRAIN_TIMEOUT_SECS) for running jobs to truncate and
+        flush their latest checkpoints, shut down followers, then close the
+        listener. Without: close immediately (the old hard stop). The k8s
+        ``preStop`` hook POSTs this route so a pod rotation drains instead
+        of killing in-flight training (deploy/k8s.yaml)."""
+        drain = str(params.get("drain", "")).lower() in ("1", "true")
+        srv = _SERVER
+        if srv is None:
+            raise ApiError(503, "no process-wide server to shut down "
+                                "(was it started via start_server?)")
+        if drain:
+            srv.begin_drain()  # synchronous: admission closes NOW
+        threading.Thread(
+            target=srv.stop, kwargs={"drain": drain},
+            name="h2o3-shutdown", daemon=True,
+        ).start()
+        return {"__meta": {"schema_type": "Shutdown"}, "drain": drain,
+                "draining": _DRAINING}
+
 
 def _get_model(key):
     from h2o3_tpu.models.model_base import Model
@@ -1188,6 +1376,10 @@ def _job_schema(j: Job) -> dict:
         # across polls); span_summary rolls the job's trace up per phase
         "started_at": j.start_time,
         "duration_ms": j.duration_ms,
+        # the job's deadline (epoch secs): enforced between iterations via
+        # the soft-deadline plumbing (builders truncate gracefully) — the
+        # client reads it to budget its own polling
+        **({"deadline": j.soft_deadline} if j.soft_deadline else {}),
         **({"span_summary": span_summary} if span_summary else {}),
         "dest": {"name": getattr(getattr(j, "result", None), "key", "")} if j.result is not None else None,
         # crash-recovery pointer (latest interval checkpoint) — present when
@@ -1284,6 +1476,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("DELETE", r"/3/NodePersistentStorage/([^/]+)/([^/]+)", _EP.nps_delete),
     ("POST", r"/99/AutoMLBuilder", _EP.automl_build),
     ("GET", r"/99/AutoML/([^/]+)", _EP.automl_get),
+    ("POST", r"/3/Shutdown", _EP.shutdown),
 ]
 # raw pattern rides along as the bounded-cardinality metrics route label
 _COMPILED = [(m, p, re.compile("^" + p + "/?$"), h) for m, p, h in _ROUTES]
@@ -1432,11 +1625,20 @@ class _Handler(BaseHTTPRequestHandler):
         path = urllib.parse.urlparse(self.path).path
         if method == "POST" and path.rstrip("/") == "/3/PostFile":
             # raw-body file upload (h2o.upload_file to a remote coordinator)
+            gate = False
             try:
+                gate = _admission_enter(method, "/3/PostFile")
                 self._post_file()
+            except ApiError as e:
+                self._reply(e.status, {"__meta": {"schema_type": "Error"},
+                                       "msg": str(e), "http_status": e.status},
+                            extra_headers=e.headers)
             except Exception as e:  # noqa: BLE001 — REST boundary
                 self._reply(500, {"__meta": {"schema_type": "Error"},
                                   "msg": repr(e), "http_status": 500})
+            finally:
+                if gate:
+                    _admission_exit()
             return
         for m, route, pat, handler in _COMPILED:
             if m != method:
@@ -1446,19 +1648,54 @@ class _Handler(BaseHTTPRequestHandler):
                 status = 200
                 _REST_IN_FLIGHT.inc()
                 t0 = time.perf_counter()
+                gate = False
+                idem = (self.headers.get("Idempotency-Key")
+                        if method == "POST" else None)
+                idem_owned = False
                 try:
+                    if idem:
+                        hit = _idem_begin(idem)
+                        if hit is _IDEM_PENDING:
+                            raise ApiError(
+                                409, "a request with this Idempotency-Key "
+                                     "is still in flight; retry shortly",
+                                headers={"Retry-After": "1"})
+                        if hit is not None:
+                            status, payload = hit
+                            _IDEM_REPLAYS.inc(route=route or "/")
+                            self._reply(status, payload, extra_headers={
+                                "Idempotency-Replayed": "true"})
+                            return
+                        idem_owned = True
+                    gate = _admission_enter(method, route)
+                    from h2o3_tpu.utils import faults
+
+                    faults.slow_check("rest")  # chaos: slow-handler injection
                     params = self._params()
                     args = [urllib.parse.unquote(g) for g in match.groups()]
                     out = handler(params, *args)
                     if isinstance(out, dict) and "__binary__" in out:
                         self._reply_binary(out)
+                        if idem_owned:  # binary bodies are not replayable
+                            _idem_finish(idem, 200, None)
+                            idem_owned = False
                     else:
                         self._reply(200, out)
+                        if idem_owned:
+                            _idem_finish(idem, 200, out)
+                            idem_owned = False
                 except ApiError as e:
                     status = e.status
-                    self._reply(e.status, {"__meta": {"schema_type": "Error"},
-                                           "error_url": path, "msg": str(e),
-                                           "http_status": e.status})
+                    body = {"__meta": {"schema_type": "Error"},
+                            "error_url": path, "msg": str(e),
+                            "http_status": e.status}
+                    self._reply(e.status, body, extra_headers=e.headers)
+                    if idem_owned:
+                        # 4xx outcomes are deterministic — replay them;
+                        # 5xx release the key so a retry re-attempts
+                        _idem_finish(idem, e.status,
+                                     body if e.status < 500 else None)
+                        idem_owned = False
                 except Exception as e:  # noqa: BLE001 — REST boundary
                     status = 500
                     Log.err(f"REST {method} {path} failed: {e!r}")
@@ -1466,6 +1703,10 @@ class _Handler(BaseHTTPRequestHandler):
                                       "error_url": path, "msg": repr(e),
                                       "http_status": 500})
                 finally:
+                    if idem_owned:  # still claimed: release, never wedge the key
+                        _idem_finish(idem, 500, None)
+                    if gate:
+                        _admission_exit()
                     _REST_IN_FLIGHT.dec()
                     _REST_REQUESTS.inc(
                         method=method, route=route or "/", status=str(status))
@@ -1499,12 +1740,15 @@ class _Handler(BaseHTTPRequestHandler):
         with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as f:
             f.write(body)
             path = f.name
-        from h2o3_tpu.frame.parse import import_file
-
-        fr = import_file(path, destination_frame=q.get("destination_frame"))
         import os as _os
 
-        _os.unlink(path)
+        from h2o3_tpu.frame.parse import import_file
+
+        try:
+            fr = import_file(path, destination_frame=q.get("destination_frame"))
+        finally:
+            # a failing parse must not leak the staged upload into /tmp
+            _os.unlink(path)
         self._reply(200, {"__meta": {"schema_type": "PostFile"},
                           "destination_frame": fr.key,
                           "total_bytes": length})
@@ -1535,6 +1779,13 @@ class H2OServer:
     """The RequestServer successor: owns the HTTP listener thread."""
 
     def __init__(self, ip: str = "127.0.0.1", port: int = 54321):
+        from h2o3_tpu import config
+
+        # per-connection read deadline: a client that stops sending
+        # mid-request cannot pin a handler thread forever (class-level on
+        # purpose — one process, one handler class, one policy)
+        read_timeout = config.get_float("H2O3_TPU_REQUEST_READ_TIMEOUT")
+        _Handler.timeout = read_timeout if read_timeout > 0 else None
         self.httpd = ThreadingHTTPServer((ip, port), _Handler)
         self.ip, self.port = self.httpd.server_address[:2]
         self._thread: threading.Thread | None = None
@@ -1551,11 +1802,76 @@ class H2OServer:
         Log.info(f"REST server up at {self.url}")
         return self
 
-    def stop(self) -> None:
+    def begin_drain(self) -> None:
+        """Flip the process into draining: mutating requests and new jobs
+        are shed with 503 + Retry-After from this instant; GETs (job polls,
+        health, metrics) keep serving so the drain stays observable."""
+        global _DRAINING
+        if not _DRAINING:
+            _DRAINING = True
+            _G_DRAINING.set(1)
+            Log.info("REST drain: no longer admitting mutating requests")
+
+    def _drain(self, timeout: float | None) -> None:
+        from h2o3_tpu import config
+
+        t0 = time.monotonic()
+        self.begin_drain()
+        budget = (config.get_float("H2O3_TPU_DRAIN_TIMEOUT_SECS")
+                  if timeout is None else timeout)
+        deadline = t0 + max(budget, 0.0)
+        with _JOBS_LOCK:
+            jobs = [j for j in _REST_JOBS
+                    if j.status in (Job.PENDING, Job.RUNNING)]
+        now = time.time()
+        for j in jobs:
+            # truncate gracefully at the next iteration boundary: builders
+            # polling stop_requested finish the current interval, keep the
+            # partial model, and (with export_checkpoints_dir) flush it
+            # through the snapshot path — the resumable-checkpoint contract
+            j.soft_deadline = (now if j.soft_deadline is None
+                               else min(j.soft_deadline, now))
+        flushed = abandoned = 0
+        for j in jobs:
+            left = deadline - time.monotonic()
+            if j.wait(max(left, 0.0)):
+                flushed += 1
+            else:
+                abandoned += 1
+        took = time.monotonic() - t0
+        _DRAIN_SECONDS.set(took)
+        Log.info(
+            f"REST drain finished in {took:.2f}s: {flushed} job(s) flushed, "
+            f"{abandoned} still running at the {budget}s deadline"
+        )
+
+    def stop(self, drain: bool = False, timeout: float | None = None) -> None:
+        """Stop the listener. ``drain=True`` first stops admitting work,
+        waits (bounded by ``timeout`` / H2O3_TPU_DRAIN_TIMEOUT_SECS) for
+        running jobs to truncate and flush their latest checkpoints, and
+        shuts down the follower ranks — the graceful path the k8s preStop
+        hook drives. ``drain=False`` is the old hard stop."""
+        global _DRAINING, _SERVER
+        if drain:
+            self._drain(timeout)
+            from h2o3_tpu.cluster import spmd
+
+            try:
+                spmd.shutdown_followers()
+            except Exception as e:  # noqa: BLE001 — closing anyway
+                Log.warn(f"drain: follower shutdown failed: {e!r}")
         self.httpd.shutdown()
         self.httpd.server_close()
+        # join the serving thread (bounded) so callers — tests binding the
+        # same port next — never race a half-dead listener
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            if self._thread.is_alive():
+                Log.warn("REST serving thread still alive after 10s join")
+            self._thread = None
+        _DRAINING = False  # a later server in this process starts clean
+        _G_DRAINING.set(0)
         # a stopped server must not keep serving as the process singleton
-        global _SERVER
         if _SERVER is self:
             _SERVER = None
 

@@ -17,22 +17,40 @@ import json
 import time
 import urllib.parse
 import urllib.request
+import zlib
 from typing import Any
+
+# HTTP statuses the server's admission/idempotency layer hands back for
+# "try again shortly": 429 (in-flight gate full), 503 (job queue full /
+# draining), 409 (same Idempotency-Key still in flight). All three mean the
+# request did NOT run — retrying is always safe.
+_RETRYABLE_STATUSES = (409, 429, 503)
 
 
 class H2OClientError(Exception):
-    def __init__(self, status: int, msg: str):
+    def __init__(self, status: int, msg: str, retry_after: float | None = None):
         super().__init__(f"HTTP {status}: {msg}")
         self.status = status
+        self.retry_after = retry_after
 
 
 class H2OConnection:
-    def __init__(self, url: str, timeout: float = 600.0, token: str | None = None):
+    def __init__(self, url: str, timeout: float = 600.0, token: str | None = None,
+                 retries: int = 4, retry_backoff: float = 0.25,
+                 retry_cap: float = 5.0):
         """``token`` authenticates against a server running with
         H2O3_TPU_AUTH_TOKEN (the hash_login analog); defaults to that same
-        env var so client and in-process server pair up automatically."""
+        env var so client and in-process server pair up automatically.
+        ``retries`` bounds transient-error retries (429/503/409 shed
+        responses for any method; connection-level errors only for GETs and
+        idempotency-keyed POSTs), with capped exponential backoff
+        (``retry_backoff * 2^attempt`` up to ``retry_cap``) plus
+        deterministic jitter and the server's ``Retry-After`` as a floor."""
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.retries = int(retries)
+        self.retry_backoff = float(retry_backoff)
+        self.retry_cap = float(retry_cap)
         if token is None:
             from h2o3_tpu import config
 
@@ -44,7 +62,42 @@ class H2OConnection:
         self.cloud = cloud
 
     # -- wire helpers -----------------------------------------------------
-    def _request(self, method: str, path: str, payload: dict | None, as_json: bool):
+    def _backoff_delay(self, path: str, attempt: int,
+                       retry_after: float | None) -> float:
+        base = min(self.retry_cap, self.retry_backoff * (2 ** attempt))
+        # DETERMINISTIC jitter (keyed on path+attempt, like persist.py's
+        # retry wrapper): reproducible runs, yet distinct clients desync
+        frac = zlib.crc32(f"{self.url}{path}:{attempt}".encode()) % 1000
+        delay = base * (1.0 + 0.5 * frac / 1000.0)
+        if retry_after:
+            delay = max(delay, float(retry_after))
+        return delay
+
+    def _request(self, method: str, path: str, payload: dict | None,
+                 as_json: bool, idempotency_key: str | None = None):
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(method, path, payload, as_json,
+                                          idempotency_key)
+            except H2OClientError as e:
+                if e.status not in _RETRYABLE_STATUSES or attempt >= self.retries:
+                    raise
+                delay = self._backoff_delay(path, attempt, e.retry_after)
+            except urllib.error.URLError:
+                # connection-level failure: the server may or may not have
+                # seen the request — only safe to retry when re-running it
+                # is harmless (GET) or deduped (Idempotency-Key)
+                if attempt >= self.retries or (
+                    method != "GET" and not idempotency_key
+                ):
+                    raise
+                delay = self._backoff_delay(path, attempt, None)
+            time.sleep(delay)
+            attempt += 1
+
+    def _request_once(self, method: str, path: str, payload: dict | None,
+                      as_json: bool, idempotency_key: str | None = None):
         url = self.url + path
         data = None
         headers = {}
@@ -57,6 +110,8 @@ class H2OConnection:
                     {k: json.dumps(v) if isinstance(v, (list, dict)) else v
                      for k, v in payload.items() if v is not None}
                 ).encode()
+        if idempotency_key:
+            headers["Idempotency-Key"] = idempotency_key
         headers.update(self._auth_headers())
         req = urllib.request.Request(url, data=data, headers=headers, method=method)
         try:
@@ -68,29 +123,57 @@ class H2OConnection:
                 msg = body.get("msg", str(e))
             except Exception:
                 msg = str(e)
-            raise H2OClientError(e.code, msg) from None
+            try:
+                ra = float(e.headers.get("Retry-After"))
+            except (TypeError, ValueError):
+                ra = None
+            raise H2OClientError(e.code, msg, retry_after=ra) from None
 
     def get(self, path: str) -> dict:
         return self._request("GET", path, None, False)
 
-    def post(self, path: str, payload: dict | None = None, as_json: bool = False) -> dict:
-        return self._request("POST", path, payload, as_json)
+    def post(self, path: str, payload: dict | None = None, as_json: bool = False,
+             idempotency_key: str | None = None) -> dict:
+        return self._request("POST", path, payload, as_json,
+                             idempotency_key=idempotency_key)
 
     def delete(self, path: str) -> dict:
         return self._request("DELETE", path, None, False)
 
     # -- job polling (the h2o-py H2OJob.poll contract) --------------------
-    def wait_job(self, job_key: str, poll_interval: float = 0.3) -> dict:
+    def wait_job(self, job_key: str, poll_interval: float = 0.1,
+                 poll_cap: float = 2.0) -> dict:
+        """Poll ``/3/Jobs/{key}`` to a terminal state with capped
+        exponential backoff (starts at ``poll_interval``, grows to
+        ``poll_cap``). The wait budget (``self.timeout``) is measured from
+        the job's OWN start time, so server queue time is never counted
+        against the caller's training budget."""
         t0 = time.time()
+        started: float | None = None
+        delay = poll_interval
         while True:
             j = self.get(f"/3/Jobs/{job_key}")["jobs"][0]
             if j["status"] in ("DONE", "FAILED", "CANCELLED"):
                 if j["status"] == "FAILED":
-                    raise H2OClientError(500, j.get("exception") or "job failed")
+                    raise H2OClientError(
+                        500,
+                        f"job {job_key} failed: "
+                        f"{j.get('exception') or 'job failed'}",
+                    )
                 return j
-            if time.time() - t0 > self.timeout:
-                raise H2OClientError(408, f"job {job_key} timed out")
-            time.sleep(poll_interval)
+            if started is None and (
+                j.get("started_at") or j["status"] == "RUNNING"
+            ):
+                # CLIENT clock at first observed start (the server's
+                # started_at is another machine's clock — skew-unsafe)
+                started = time.time()
+            elapsed = time.time() - (started if started is not None else t0)
+            if elapsed > self.timeout:
+                raise H2OClientError(
+                    408, f"job {job_key} timed out after {elapsed:.1f}s "
+                         f"(progress {j.get('progress', 0):.0%})")
+            time.sleep(delay)
+            delay = min(poll_cap, delay * 1.6)
 
     # -- flows ------------------------------------------------------------
     def import_file(self, path: str, destination_frame: str | None = None) -> str:
@@ -112,6 +195,8 @@ class H2OConnection:
     def train(self, algo: str, y: str | None = None, training_frame: str | Any = None,
               validation_frame: str | Any = None, x=None, **params) -> dict:
         """Build a model synchronously; returns the model schema dict."""
+        import uuid
+
         body = dict(params)
         body["training_frame"] = _key_of(training_frame)
         if validation_frame is not None:
@@ -120,7 +205,11 @@ class H2OConnection:
             body["response_column"] = y
         if x is not None:
             body["x"] = list(x)
-        resp = self.post(f"/3/ModelBuilders/{algo}", body)
+        # one key per LOGICAL build: a transparent retry of this POST (shed
+        # response, dropped connection) replays the first response instead
+        # of training a second model
+        resp = self.post(f"/3/ModelBuilders/{algo}", body,
+                         idempotency_key=uuid.uuid4().hex)
         job = self.wait_job(resp["job"]["key"]["name"])
         return self.get(f"/3/Models/{job['dest']['name']}")["models"][0]
 

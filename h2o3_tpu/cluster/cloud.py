@@ -26,6 +26,21 @@ from h2o3_tpu.utils.log import Log
 
 _started_at: float | None = None
 
+
+def _distributed_initialized() -> bool:
+    """jax-compat: ``jax.distributed.is_initialized`` only exists on newer
+    jax; older releases expose the same fact through ``global_state.client``.
+    This container's jax has the latter shape — without the probe, every
+    multi-host ``init`` dies on AttributeError before forming the cloud."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        try:
+            return bool(is_init())
+        except Exception:  # noqa: BLE001 — treat a broken probe as "not yet"
+            return False
+    state = getattr(jax.distributed, "global_state", None)
+    return bool(getattr(state, "client", None))
+
 # cluster health as gauges: a scraper sees the degraded latch / probe
 # failures without polling /3/Cloud JSON, and the transition counter
 # preserves flap history a point-in-time gauge cannot show
@@ -93,7 +108,7 @@ def init(
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception as e:  # cache is an optimization, never fatal — but say so
         Log.warn(f"compilation cache disabled: {e}")
-    if coordinator is not None and not jax.distributed.is_initialized():
+    if coordinator is not None and not _distributed_initialized():
         # Must run before any backend use (jax.devices() etc.).
         # heartbeat_timeout bounds dead-member detection (SURVEY §5.3): the
         # coordination service's heartbeat IS the HeartBeatThread successor;

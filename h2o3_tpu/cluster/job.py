@@ -9,6 +9,7 @@ iterations — the same granularity H2O uses (between tree levels).
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 import traceback
@@ -25,6 +26,17 @@ _JOBS_RUNNING = metrics.gauge("jobs_running", "jobs currently executing")
 
 class JobCancelled(Exception):
     pass
+
+
+# The job executing on the CURRENT thread (via the context Job.start copies
+# into its worker). Nested Jobs — model_base.train's inner build job, CV
+# fold jobs, grid/AutoML per-model jobs — link to it as their parent, so
+# cancellation and deadlines set on the OUTER (REST-visible) job reach the
+# builder loops polling the inner one, and recovery pointers set by the
+# inner job surface on the outer key the client actually polls.
+_CURRENT_JOB: contextvars.ContextVar["Job | None"] = contextvars.ContextVar(
+    "h2o3_current_job", default=None
+)
 
 
 class Job:
@@ -53,6 +65,9 @@ class Job:
         # truncate GRACEFULLY (partial model kept) — unlike cancel(), which
         # aborts via the JobCancelled raise in update()
         self.soft_deadline: float | None = None
+        # the job this one was created inside (None at top level); deadlines
+        # and cancellation are read through the chain, recovery writes walk up
+        self.parent: Job | None = _CURRENT_JOB.get()
         # crash-recovery state: builders with export_checkpoints_dir record
         # their latest interval snapshot here, so a FAILED job still tells
         # operators (over /3/Jobs) where to resume from (docs/RECOVERY.md)
@@ -62,14 +77,32 @@ class Job:
     # -- driver-side API (the work callable calls these) --
     def update(self, progress: float) -> None:
         self.progress = min(1.0, max(self.progress, float(progress)))
-        if self._cancel_requested.is_set():
-            raise JobCancelled(self.key)
+        j: Job | None = self
+        while j is not None:
+            if j._cancel_requested.is_set():
+                raise JobCancelled(self.key)
+            j = j.parent
 
     @property
     def stop_requested(self) -> bool:
-        if self._cancel_requested.is_set():
-            return True
-        return self.soft_deadline is not None and time.time() > self.soft_deadline
+        now = time.time()
+        j: Job | None = self
+        while j is not None:  # an ancestor's cancel/deadline stops this job too
+            if j._cancel_requested.is_set():
+                return True
+            if j.soft_deadline is not None and now > j.soft_deadline:
+                return True
+            j = j.parent
+        return False
+
+    def set_recovery(self, info: dict) -> None:
+        """Record the latest resumable snapshot on this job AND its
+        ancestors: clients poll the OUTER (REST) job key, so the pointer
+        must surface there, not only on the nested builder job."""
+        j: Job | None = self
+        while j is not None:
+            j.recovery = info
+            j = j.parent
 
     # -- client-side API --
     def start(self) -> "Job":
@@ -80,6 +113,7 @@ class Job:
         ctx = contextvars.copy_context()
 
         def run() -> None:
+            _CURRENT_JOB.set(self)  # nested Jobs link here as their parent
             self.status = Job.RUNNING
             self.start_time = time.time()
             _JOBS_RUNNING.inc()
@@ -137,6 +171,15 @@ class Job:
         if self.status == Job.CANCELLED:
             raise JobCancelled(self.key)
         return self.result
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Wait (bounded) for the job to reach a terminal state WITHOUT
+        raising on failure/cancel — the drain path's primitive: it only
+        needs to know whether the worker thread is done flushing, not
+        whether the job succeeded. Returns True when terminal."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.status not in (Job.PENDING, Job.RUNNING)
 
     def run_sync(self) -> Any:
         """Run inline on the calling thread (used by tests and local API)."""

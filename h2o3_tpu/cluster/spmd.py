@@ -60,6 +60,10 @@ _COLLECTIVE_SECONDS = metrics.counter(
     "spmd_collective_seconds_total",
     "wall seconds inside command-broadcast collectives (the mesh "
     "communication overhead lever — invisible without a dedicated timer)")
+_WATCHDOG_TRIPS = metrics.counter(
+    "spmd_watchdog_trips_total",
+    "replicated commands the collective watchdog presumed wedged "
+    "(H2O3_TPU_SPMD_WATCHDOG_SECS exceeded → degraded latch), by command")
 
 _LOCK = threading.RLock()  # serializes the coordinator's device-work commands
 # ContextVar, not a process global: nested Job threads inherit it because
@@ -90,6 +94,106 @@ def replicated_section():
         yield
     finally:
         _REPLICATED_VAR.reset(token)
+
+
+# -- collective watchdog -----------------------------------------------------
+# A wedged collective (one rank stalled inside a cross-process program) hangs
+# the coordinator's command thread forever while it holds _LOCK; every later
+# spmd.run then blocks on the lock and the cloud goes from healthy to hung
+# with nothing observable in between. The watchdog is the bounded-hang
+# answer: commands register themselves while executing, a monitor thread
+# latches cloud.mark_degraded once one exceeds its budget
+# (H2O3_TPU_SPMD_WATCHDOG_SECS, read per command), and lock waiters poll the
+# latch (bounded acquire below) so they fail-stop instead of queueing behind
+# the wedge. Coordinator-side only — follower clocks diverge from the
+# coordinator's, and followers already fail-stop through the coordination
+# service — and disabled by default: only an operator who knows the
+# workload's longest legitimate command should set a budget.
+
+import itertools as _itertools
+
+_WATCH_LOCK = threading.Lock()
+_WATCH_ACTIVE: dict[int, dict] = {}
+_WATCH_IDS = _itertools.count(1)
+_WATCH_THREAD: threading.Thread | None = None
+
+
+def _watchdog_budget() -> float:
+    from h2o3_tpu import config
+
+    try:
+        return config.get_float("H2O3_TPU_SPMD_WATCHDOG_SECS")
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _watchdog_loop() -> None:
+    while True:
+        with _WATCH_LOCK:
+            active = list(_WATCH_ACTIVE.values())
+        now = time.monotonic()
+        interval = 0.2
+        for w in active:
+            budget = w["budget"]
+            interval = min(interval, max(budget / 4.0, 0.02))
+            if now - w["t0"] > budget and not w["tripped"]:
+                w["tripped"] = True
+                _WATCHDOG_TRIPS.inc(cmd=w["cmd"])
+                from h2o3_tpu.cluster import cloud
+
+                cloud.mark_degraded(
+                    f"spmd watchdog: replicated command {w['cmd']!r} still "
+                    f"running after its {budget}s budget — presumed wedged "
+                    "mid-collective (fail-stop; restart the cloud, recover "
+                    "models from checkpoints)"
+                )
+        time.sleep(interval)
+
+
+@contextlib.contextmanager
+def _watched(cmd: str):
+    """Register ``cmd`` with the watchdog for the duration of its execution
+    (no-op when the budget knob is 0/unset)."""
+    budget = _watchdog_budget()
+    if budget <= 0:
+        yield
+        return
+    global _WATCH_THREAD
+    wid = next(_WATCH_IDS)
+    with _WATCH_LOCK:
+        _WATCH_ACTIVE[wid] = {
+            "cmd": cmd, "t0": time.monotonic(), "budget": budget,
+            "tripped": False,
+        }
+        if _WATCH_THREAD is None or not _WATCH_THREAD.is_alive():
+            _WATCH_THREAD = threading.Thread(
+                target=_watchdog_loop, name="spmd-watchdog", daemon=True
+            )
+            _WATCH_THREAD.start()
+    try:
+        yield
+    finally:
+        with _WATCH_LOCK:
+            _WATCH_ACTIVE.pop(wid, None)
+
+
+def _failstop_if_degraded() -> None:
+    from h2o3_tpu.cluster import cloud
+
+    reason = cloud.degraded_reason()
+    if reason is not None:
+        raise RuntimeError(
+            f"cloud is degraded (fail-stop): {reason} — "
+            "restart the cloud; recover models from checkpoints"
+        )
+
+
+def _acquire_command_lock() -> None:
+    """Acquire ``_LOCK`` but keep polling the degraded latch: a caller
+    queued behind a wedged command must fail-stop the moment the watchdog
+    (or a death signature) latches, never block indefinitely."""
+    while not _LOCK.acquire(timeout=0.25):
+        _failstop_if_degraded()
 
 
 _IS_MULTI = False  # set once by cluster.cloud.init; read on hot paths
@@ -500,48 +604,72 @@ def run(cmd: str, **kwargs):
     first so followers enter the same program. Holding the lock for the whole
     execution serializes device work — collective order must match on every
     rank, and concurrent jobs on the coordinator would interleave it."""
+    from h2o3_tpu.utils import faults
+
     if not multi_process():
-        _CMDS_TOTAL.inc(cmd=cmd)
-        t0 = time.perf_counter()
-        with metrics.span(f"spmd.{cmd}"):
-            try:
-                return _COMMANDS[cmd](**kwargs)
-            finally:
-                _CMD_SECONDS.observe(time.perf_counter() - t0, cmd=cmd)
+        # the degraded latch fail-stops here too: single-host it can only be
+        # set by the collective watchdog (a wedged device program), and a
+        # wedged mesh is no more usable for the next command than a dead one
+        _failstop_if_degraded()
+        try:
+            faults.death_check("spmd_run")  # chaos: synthetic dead member
+            _CMDS_TOTAL.inc(cmd=cmd)
+            t0 = time.perf_counter()
+            with metrics.span(f"spmd.{cmd}"):
+                try:
+                    with _watched(cmd):
+                        faults.stall_check("spmd_run")  # chaos: wedge
+                        return _COMMANDS[cmd](**kwargs)
+                finally:
+                    _CMD_SECONDS.observe(time.perf_counter() - t0, cmd=cmd)
+        except Exception as e:
+            _maybe_mark_dead_member(e)  # runtime death signatures latch here too
+            raise
     if not is_coordinator():  # pragma: no cover - followers use follower_loop
         raise RuntimeError("spmd.run is coordinator-only")
-    from h2o3_tpu.cluster import cloud
-
-    with _LOCK:
+    # bounded acquire: waiters poll the degraded latch so a command wedged
+    # inside the lock (watchdog's case) fail-stops the queue behind it
+    _acquire_command_lock()
+    try:
         # degraded check INSIDE the lock: a job queued on the lock while
         # another latches the failure must not broadcast into the dead cloud
-        if cloud.degraded_reason() is not None:
-            raise RuntimeError(
-                f"cloud is degraded (fail-stop): {cloud.degraded_reason()} — "
-                "restart the cloud; recover models from checkpoints"
-            )
+        _failstop_if_degraded()
         try:
-            from h2o3_tpu.utils import faults
-
             faults.death_check("spmd_run")  # chaos: synthetic dead member
             _CMDS_TOTAL.inc(cmd=cmd)
             t0 = time.perf_counter()
             with metrics.span(f"spmd.{cmd}", replicated="1"):
                 try:
-                    _bcast_bytes(pickle.dumps((cmd, kwargs)))
-                    with replicated_section():
-                        return _COMMANDS[cmd](**kwargs)
+                    with _watched(cmd):
+                        faults.stall_check("spmd_run")  # chaos: wedge
+                        _bcast_bytes(pickle.dumps((cmd, kwargs)))
+                        with replicated_section():
+                            return _COMMANDS[cmd](**kwargs)
                 finally:
                     _CMD_SECONDS.observe(time.perf_counter() - t0, cmd=cmd)
         except Exception as e:
             _maybe_mark_dead_member(e)
             raise
+    finally:
+        _LOCK.release()
 
 
-def shutdown_followers() -> None:
+def shutdown_followers(timeout: float = 10.0) -> None:
     if multi_process() and is_coordinator():
-        with _LOCK:
+        # bounded: a command wedged inside the lock (the watchdog's case)
+        # must not turn shutdown/drain into a second hang — the followers
+        # are stuck in the same dead collective anyway and die on restart
+        if not _LOCK.acquire(timeout=timeout):
+            Log.warn(
+                f"shutdown_followers: command lock still held after "
+                f"{timeout}s (wedged collective?) — skipping the shutdown "
+                "broadcast"
+            )
+            return
+        try:
             _bcast_bytes(pickle.dumps((_SHUTDOWN, {})))
+        finally:
+            _LOCK.release()
 
 
 def follower_loop() -> None:

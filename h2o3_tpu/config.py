@@ -91,7 +91,44 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "", "fault-injection spec for the chaos suite (utils/faults.py): "
             "';'-separated entries — 'site=N' fails the first N IO calls at "
             "the site, 'site@K' aborts training at iteration K, 'death:site' "
-            "raises a synthetic coordination-service death error. '' = off"),
+            "raises a synthetic coordination-service death error, "
+            "'stall:site:SECS' sleeps once at the site (wedged-collective "
+            "stand-in), 'slow:site:SECS' sleeps at EVERY call to the site "
+            "(slow-handler injection). '' = off"),
+    "H2O3_TPU_MAX_INFLIGHT": (
+        "64", "REST admission gate: max concurrently executing mutating "
+              "(POST/DELETE) requests; excess requests are shed with "
+              "429 + Retry-After instead of piling up threads. 0 = unbounded"),
+    "H2O3_TPU_MAX_QUEUED_JOBS": (
+        "32", "REST admission gate: max live (pending+running) REST-created "
+              "jobs; job-creating requests beyond it are shed with "
+              "503 + Retry-After. 0 = unbounded"),
+    "H2O3_TPU_REQUEST_READ_TIMEOUT": (
+        "60", "REST per-connection socket read deadline, seconds — a client "
+              "that stops sending mid-request cannot pin a handler thread "
+              "forever. 0 = no deadline"),
+    "H2O3_TPU_HANDLER_DEADLINE_SECS": (
+        "300", "deadline for REST handlers that wait synchronously on a job "
+               "(SplitFrame/CreateFrame/Interaction): past it the route "
+               "returns 504 with the job key and the job keeps running "
+               "(poll /3/Jobs). 0 = unbounded"),
+    "H2O3_TPU_JOB_DEADLINE_SECS": (
+        "0", "default deadline applied to every REST-created job, seconds; "
+             "enforced between iterations via the soft-deadline plumbing "
+             "(iterative builders truncate GRACEFULLY, keeping the partial "
+             "model) and surfaced as 'deadline' on /3/Jobs. 0 = none"),
+    "H2O3_TPU_SPMD_WATCHDOG_SECS": (
+        "0", "collective watchdog: a replicated command still running after "
+             "this many seconds is presumed wedged mid-collective and trips "
+             "the fail-stop degraded latch (coordinator-side only — rank "
+             "clocks diverge, so followers never arm it). 0 = disabled "
+             "(the default: only an operator who knows the workload's "
+             "longest legitimate command should set a budget)"),
+    "H2O3_TPU_DRAIN_TIMEOUT_SECS": (
+        "30", "graceful-drain bound for H2OServer.stop(drain=True) / "
+              "POST /3/Shutdown?drain=true: how long to wait for running "
+              "jobs to truncate and flush checkpoints before the listener "
+              "closes anyway"),
 }
 
 

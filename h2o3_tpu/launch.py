@@ -50,10 +50,29 @@ def main(argv=None) -> None:
 
     Log.info(f"process {args.process_id}/{args.num_processes} joined: {info}")
     if args.process_id == 0:
-        h2o3_tpu.start_server(ip=args.ip, port=args.port)
+        import signal
+
+        from h2o3_tpu.api import server as _api_server
+
+        srv = h2o3_tpu.start_server(ip=args.ip, port=args.port)
+
+        def _graceful_term(signum, frame):
+            # k8s rotation (or any SIGTERM) drains before dying even when no
+            # preStop hook fired: stop admitting, flush running jobs'
+            # checkpoints, shut down followers, close the listener
+            Log.info("SIGTERM: graceful drain starting")
+            try:
+                srv.stop(drain=True)
+            except Exception as e:  # noqa: BLE001 — exiting either way
+                Log.warn(f"drain on SIGTERM failed: {e!r}")
+
+        signal.signal(signal.SIGTERM, _graceful_term)
         try:
-            while True:  # serve until killed (fail-stop, like an H2O node)
-                time.sleep(3600)
+            # serve until stopped — a REST /3/Shutdown (or the SIGTERM drain
+            # above) clears the process singleton, and the launcher exits so
+            # the pod terminates instead of sleeping out its grace period
+            while _api_server._SERVER is srv:
+                time.sleep(1.0)
         except KeyboardInterrupt:
             pass
     else:

@@ -18,7 +18,7 @@ from typing import Any, Sequence, Type
 
 import numpy as np
 
-from h2o3_tpu.cluster.job import Job
+from h2o3_tpu.cluster.job import Job, JobCancelled
 from h2o3_tpu.cluster.registry import DKV
 from h2o3_tpu.models.model_base import (
     Model,
@@ -245,6 +245,8 @@ class GridSearch:
                         break
             except faults.TrainAbort:
                 raise  # simulated kill -9: the whole grid dies, manifest stays
+            except JobCancelled:
+                raise  # cancellation/drain is not a combo failure
             except Exception as e:  # a failing combo must not kill the grid (h2o keeps failures)
                 _GRID_MODELS.inc(outcome="failed")
                 self.grid.failures.append((dict(hv), repr(e)))

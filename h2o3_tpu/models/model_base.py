@@ -405,13 +405,19 @@ class ModelBuilder:
             DKV.remove(key)  # snapshots never linger in the registry
         if job is not None:
             # surfaced over /3/Jobs: operators polling a failed job see
-            # where to resume from (api/server._job_schema)
-            job.recovery = {
+            # where to resume from (api/server._job_schema). set_recovery
+            # walks the parent chain so the OUTER (REST-visible) job carries
+            # the pointer, not just the nested builder job
+            info = {
                 "checkpoint_key": key,
                 "checkpoint_path": pth,
                 "hint": "load_model(checkpoint_path), then rebuild with "
                         "checkpoint=checkpoint_key to resume",
             }
+            if hasattr(job, "set_recovery"):
+                job.set_recovery(info)
+            else:  # follower _JobShim
+                job.recovery = info
         return pth
 
     # -- CV driver (successor of ModelBuilder.computeCrossValidation) --------

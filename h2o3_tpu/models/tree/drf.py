@@ -268,6 +268,7 @@ class DRF(ModelBuilder):
                     ),
                 )
                 faults.abort_check(self.algo, m_done)
+                faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():
                     Log.info(f"DRF early stop at {m_done} trees")
                     break
@@ -330,6 +331,7 @@ class DRF(ModelBuilder):
                     ),
                 )
                 faults.abort_check(self.algo, m + 1)
+                faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():
                     Log.info(f"DRF early stop at {m + 1} trees")
                     break

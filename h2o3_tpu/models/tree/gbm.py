@@ -542,6 +542,7 @@ class GBM(ModelBuilder):
                     ),
                 )
                 faults.abort_check(self.algo, m_done)
+                faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():
                     Log.info(
                         f"GBM early stop at {m_done} trees ({metric_name}={stop_val:.5f})"
@@ -650,6 +651,7 @@ class GBM(ModelBuilder):
                     ),
                 )
                 faults.abort_check(self.algo, m + 1)
+                faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():
                     Log.info(f"GBM early stop at {m + 1} trees ({metric_name}={stop_val:.5f})")
                     break

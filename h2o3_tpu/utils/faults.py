@@ -20,12 +20,20 @@ failure modes the chaos test suite (``pytest -m chaos``) drives:
   ``spmd._maybe_mark_dead_member`` latches on, and ``death_check(site)``
   raises one at an armed site (e.g. ``spmd_run``) to drive the full
   broadcast-failure → ``cloud.mark_degraded`` path without a real dead rank.
+- **stalls** (the overload/hang chaos half): ``stall_check(site)`` sleeps the
+  armed number of seconds ONCE (the in-process stand-in for a wedged
+  collective — drives the spmd watchdog), and ``slow_check(site)`` sleeps at
+  EVERY call while armed (slow-handler injection: makes a REST handler or a
+  training interval slow enough for admission-control/drain tests to
+  observe overload deterministically).
 
 Arming is explicit (context manager / ``configure``) or via the
 ``H2O3_TPU_FAULTS`` env knob (config.py), spec ``;``-separated:
 ``site=N`` fails the first N IO calls, ``site@K`` aborts at iteration K,
-``death:site`` raises a synthetic death error at the site. When nothing is
-armed every check is a single module-bool test — hot paths pay ~nothing.
+``death:site`` raises a synthetic death error at the site,
+``stall:site:SECS`` sleeps once, ``slow:site:SECS`` sleeps every call. When
+nothing is armed every check is a single module-bool test — hot paths pay
+~nothing.
 
 Determinism contract: counters are keyed by site and incremented in call
 order, so a seeded single-threaded run injects at exactly the same point
@@ -63,6 +71,8 @@ _armed = False
 _fail: dict[str, int] = {}      # io site -> remaining injected failures
 _abort: dict[str, int] = {}     # abort site -> iteration to die at
 _death: set[str] = set()        # sites where a synthetic death error fires
+_stall: dict[str, float] = {}   # site -> one-shot sleep seconds (wedge)
+_slow: dict[str, float] = {}    # site -> per-call sleep seconds (slowdown)
 _counts: dict[str, int] = {}    # site -> observed check calls (tests assert)
 
 _DEATH_MSG = ("injected fault: coordination service reports peer task is "
@@ -78,6 +88,13 @@ def _parse_spec(spec: str) -> None:
             continue
         if part.startswith("death:"):
             _death.add(part[len("death:"):])
+        elif part.startswith(("stall:", "slow:")):
+            kind, rest = part.split(":", 1)
+            site, _, secs = rest.rpartition(":")
+            if not site:
+                raise ValueError(f"bad H2O3_TPU_FAULTS entry {part!r} "
+                                 "(want stall:site:SECS or slow:site:SECS)")
+            (_stall if kind == "stall" else _slow)[site] = float(secs)
         elif "@" in part:
             site, at = part.split("@", 1)
             _abort[site] = int(at)
@@ -85,21 +102,26 @@ def _parse_spec(spec: str) -> None:
             site, n = part.split("=", 1)
             _fail[site] = int(n)
         else:
-            raise ValueError(f"bad H2O3_TPU_FAULTS entry {part!r} "
-                             "(want site=N, site@K or death:site)")
-    _armed = bool(_fail or _abort or _death)
+            raise ValueError(
+                f"bad H2O3_TPU_FAULTS entry {part!r} (want site=N, site@K, "
+                "death:site, stall:site:SECS or slow:site:SECS)")
+    _armed = bool(_fail or _abort or _death or _stall or _slow)
 
 
 def configure(fail: dict[str, int] | None = None,
               abort: dict[str, int] | None = None,
-              death: set[str] | frozenset[str] | None = None) -> None:
+              death: set[str] | frozenset[str] | None = None,
+              stall: dict[str, float] | None = None,
+              slow: dict[str, float] | None = None) -> None:
     """Arm the harness programmatically (additive to whatever is armed)."""
     global _armed
     with _lock:
         _fail.update(fail or {})
         _abort.update(abort or {})
         _death.update(death or ())
-        _armed = bool(_fail or _abort or _death)
+        _stall.update(stall or {})
+        _slow.update(slow or {})
+        _armed = bool(_fail or _abort or _death or _stall or _slow)
 
 
 def reset() -> None:
@@ -109,6 +131,8 @@ def reset() -> None:
         _fail.clear()
         _abort.clear()
         _death.clear()
+        _stall.clear()
+        _slow.clear()
         _counts.clear()
         _armed = False
         from h2o3_tpu import config
@@ -121,9 +145,11 @@ def reset() -> None:
 @contextlib.contextmanager
 def inject(fail: dict[str, int] | None = None,
            abort: dict[str, int] | None = None,
-           death: set[str] | frozenset[str] | None = None):
+           death: set[str] | frozenset[str] | None = None,
+           stall: dict[str, float] | None = None,
+           slow: dict[str, float] | None = None):
     """Scoped arming for tests: arms on entry, fully resets on exit."""
-    configure(fail=fail, abort=abort, death=death)
+    configure(fail=fail, abort=abort, death=death, stall=stall, slow=slow)
     try:
         yield
     finally:
@@ -175,6 +201,39 @@ def abort_check(site: str, iteration: int) -> None:
         f"injected mid-train abort at {site} iteration {iteration} "
         "(simulated process death; resume from the latest checkpoint)"
     )
+
+
+def stall_check(site: str) -> None:
+    """Sleep the armed seconds ONCE at the site — the wedged-collective
+    stand-in (a replicated command that stops making progress). One-shot so
+    the command FINISHES after the stall: the spmd watchdog's latch, not the
+    sleep itself, is what the chaos test asserts on."""
+    if not _armed:
+        return
+    with _lock:
+        secs = _stall.pop(site, None)
+        if secs is None:
+            return
+        _counts[site] = _counts.get(site, 0) + 1
+    import time
+
+    time.sleep(secs)
+
+
+def slow_check(site: str) -> None:
+    """Sleep the armed seconds at EVERY call while the site stays armed —
+    slow-handler injection (an overloaded route, a slow training interval).
+    Stays armed until reset so concurrent requests all feel the slowdown."""
+    if not _armed:
+        return
+    with _lock:
+        secs = _slow.get(site)
+        if secs is None:
+            return
+        _counts[site] = _counts.get(site, 0) + 1
+    import time
+
+    time.sleep(secs)
 
 
 def make_death_error(msg: str = _DEATH_MSG) -> Exception:

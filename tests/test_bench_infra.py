@@ -104,6 +104,18 @@ def test_latest_bench_ok_tolerates_missing_and_garbage(tmp_path):
     assert "unparseable" in r.stdout
 
 
+def test_knob_docs_check_gate():
+    """Every H2O3_TPU_* knob in config.py must be documented under docs/
+    (tools/knob_docs_check.py), and the gate must actually fail on an
+    undocumented knob (the --extra self-test)."""
+    r = _run(["tools/knob_docs_check.py"], timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run(["tools/knob_docs_check.py",
+              "--extra", "H2O3_TPU_NOT_A_REAL_KNOB"], timeout=120)
+    assert r.returncode == 1
+    assert "H2O3_TPU_NOT_A_REAL_KNOB" in r.stdout
+
+
 def test_bench_phases_registry():
     import bench
 

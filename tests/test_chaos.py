@@ -378,6 +378,233 @@ def test_automl_kill_and_resume_matches_uninterrupted(tmp_path, monkeypatch):
     assert lb_table(resumed) == lb_table(full)
 
 
+# ---------------------------------------------------------------------------
+# overload-safe serving (ISSUE 4): admission control, collective watchdog,
+# graceful drain — the shed/bound/drain acceptance pins
+
+
+def _rest_post(url, path, payload, headers=None, timeout=30):
+    import urllib.parse
+    import urllib.request
+
+    data = urllib.parse.urlencode(payload or {}).encode()
+    req = urllib.request.Request(url + path, data=data,
+                                 headers=headers or {}, method="POST")
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def _rest_get(url, path, timeout=30):
+    import urllib.request
+
+    return json.loads(urllib.request.urlopen(url + path, timeout=timeout).read())
+
+
+def test_overload_shed_and_client_backoff_retry(tmp_path, monkeypatch):
+    """The full overload story: with the in-flight gate at 1 and a
+    fault-injected slow handler holding the slot, excess mutating requests
+    are shed 429 + Retry-After (never queued), GETs keep serving, the shed
+    counter moves, and the client's capped-backoff retry eventually lands."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.client import H2OConnection
+    from h2o3_tpu.utils import metrics as mx
+
+    monkeypatch.setenv("H2O3_TPU_MAX_INFLIGHT", "1")
+    srv = start_server(port=0)
+    csv = tmp_path / "ov.csv"
+    csv.write_text("x\n1\n2\n")
+    before = mx.counter_value(
+        "rest_rejected_total", method="POST", route="/3/ImportFiles",
+        reason="inflight_full")
+
+    with faults.inject(slow={"rest": 0.8}):
+        def _blocker():
+            _rest_post(srv.url, "/3/ImportFiles", {"path": str(csv)})
+
+        t = threading.Thread(target=_blocker)
+        t.start()
+        time.sleep(0.25)  # the blocker now owns the single in-flight slot
+        # a direct POST is shed with the Retry-After contract, instantly
+        t0 = time.time()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _rest_post(srv.url, "/3/ImportFiles", {"path": str(csv)})
+        assert ei.value.code == 429
+        assert float(ei.value.headers.get("Retry-After")) > 0
+        assert time.time() - t0 < 0.5  # rejected at admission, not queued
+        # GETs pass the gate even under overload: the cloud stays observable
+        assert _rest_get(srv.url, "/3/Ping")["ok"]
+        # a client with backoff-retry rides out the overload
+        conn = H2OConnection(srv.url, retries=10, retry_backoff=0.1)
+        out = conn.post("/3/ImportFiles", {"path": str(csv)})
+        assert out["files"] == [str(csv)]
+        t.join(timeout=10)
+    after = mx.counter_value(
+        "rest_rejected_total", method="POST", route="/3/ImportFiles",
+        reason="inflight_full")
+    assert after > before
+
+
+def test_idempotent_retried_post_trains_once():
+    """A retried POST carrying the same Idempotency-Key replays the first
+    response instead of double-training: same job key, no second job."""
+    import urllib.parse
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.client import H2OConnection
+    from h2o3_tpu.cluster.job import Job
+    from h2o3_tpu.cluster.registry import DKV
+
+    srv = start_server(port=0)
+    Frame.from_pandas(_df(300, seed=21), destination_frame="idem_fr")
+    conn = H2OConnection(srv.url)
+    body = {"training_frame": "idem_fr", "response_column": "y",
+            "ntrees": 2, "max_depth": 2, "seed": 1}
+    key = "chaos-idem-1"
+    r1 = conn.post("/3/ModelBuilders/gbm", body, idempotency_key=key)
+    jkey = r1["job"]["key"]["name"]
+    n_jobs = sum(1 for j in DKV.values_of_type(Job)
+                 if j.description == "gbm build")
+    # duplicate while (possibly) still running AND after completion: both
+    # replay the original response
+    r2 = conn.post("/3/ModelBuilders/gbm", body, idempotency_key=key)
+    assert r2["job"]["key"]["name"] == jkey
+    conn.wait_job(jkey)
+    data = urllib.parse.urlencode(body).encode()
+    req = urllib.request.Request(
+        srv.url + "/3/ModelBuilders/gbm", data=data, method="POST",
+        headers={"Idempotency-Key": key})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        r3 = json.loads(r.read())
+        assert r.headers.get("Idempotency-Replayed") == "true"
+    assert r3["job"]["key"]["name"] == jkey
+    assert sum(1 for j in DKV.values_of_type(Job)
+               if j.description == "gbm build") == n_jobs  # exactly one train
+
+
+def test_watchdog_latches_on_stalled_command(_clean_latch, monkeypatch):
+    """A stall-injected replicated command exceeding its watchdog budget
+    trips the degraded latch; the NEXT command fail-stops instead of
+    entering the wedged mesh."""
+    from h2o3_tpu.cluster import cloud, spmd
+    from h2o3_tpu.utils import metrics as mx
+
+    monkeypatch.setenv("H2O3_TPU_SPMD_WATCHDOG_SECS", "0.15")
+    before = mx.counter_value("spmd_watchdog_trips_total", cmd="remove")
+    with faults.inject(stall={"spmd_run": 0.7}):
+        spmd.run("remove", key="watchdog_nope")  # stalls past the budget
+    reason = cloud.degraded_reason()
+    assert reason is not None and "watchdog" in reason
+    assert mx.counter_value("spmd_watchdog_trips_total", cmd="remove") == before + 1
+    with pytest.raises(RuntimeError, match="fail-stop"):
+        spmd.run("remove", key="watchdog_nope2")
+
+
+def test_degraded_latch_unblocks_lock_waiters(_clean_latch, monkeypatch):
+    """A caller queued on spmd._LOCK behind a wedged command fail-stops the
+    moment the latch is set — no indefinite block on the lock."""
+    import threading
+
+    from h2o3_tpu.cluster import cloud, spmd
+
+    monkeypatch.setattr(spmd, "_IS_MULTI", True)
+    monkeypatch.setattr(spmd, "is_coordinator", lambda: True)
+    outcome = []
+    assert spmd._LOCK.acquire(timeout=1)  # stand-in for the wedged command
+    try:
+        def _caller():
+            try:
+                spmd.run("remove", key="lock_wait")
+                outcome.append(None)
+            except Exception as e:  # noqa: BLE001 — captured for assert
+                outcome.append(e)
+
+        t = threading.Thread(target=_caller)
+        t.start()
+        time.sleep(0.6)
+        assert t.is_alive() and not outcome  # genuinely waiting on the lock
+        cloud.mark_degraded("test: wedged collective holds the lock")
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        spmd._LOCK.release()
+    assert isinstance(outcome[0], RuntimeError)
+    assert "fail-stop" in str(outcome[0])
+
+
+def test_drain_flushes_resumable_checkpoint(tmp_path, monkeypatch):
+    """stop(drain=True) via POST /3/Shutdown?drain=true during a running
+    GBM job: mutating admits stop instantly (503 + Retry-After), the job
+    truncates gracefully at the next interval and flushes a checkpoint,
+    and resuming from it reproduces the uninterrupted run at 1e-6 (the PR 2
+    harness contract). Then the listener closes."""
+    import urllib.error
+    import urllib.request
+
+    from h2o3_tpu.api import server as S
+    from h2o3_tpu.cluster.job import Job
+    from h2o3_tpu.cluster.registry import DKV
+
+    fr = Frame.from_pandas(_df(), destination_frame="drain_fr")
+    kw = dict(max_depth=3, seed=11, learn_rate=0.2, score_tree_interval=2)
+    full = GBM(ntrees=8, **kw).train(y="y", training_frame=fr)
+
+    srv = S.start_server(port=0)
+    url = srv.url
+    ckdir = str(tmp_path / "drain_ck")
+    with faults.inject(slow={"gbm": 0.5}):
+        resp = _rest_post(url, "/3/ModelBuilders/gbm", {
+            "training_frame": "drain_fr", "response_column": "y",
+            "ntrees": 8, "export_checkpoints_dir": ckdir, **kw,
+        })
+        jkey = resp["job"]["key"]["name"]
+        # wait for the first interval snapshot (the /3/Jobs recovery block)
+        deadline = time.time() + 120
+        j = None
+        while time.time() < deadline:
+            j = _rest_get(url, f"/3/Jobs/{jkey}")["jobs"][0]
+            if j.get("recovery") or j["status"] != "RUNNING":
+                break
+            time.sleep(0.02)
+        assert j and j["status"] == "RUNNING" and j.get("recovery"), j
+
+        out = _rest_post(url, "/3/Shutdown?drain=true", {})
+        assert out["drain"] is True
+        # draining: mutating work is shed while the job flushes...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _rest_post(url, "/3/ImportFiles", {"path": "/nope.csv"})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        # ...then the listener closes
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                _rest_get(url, "/3/Ping", timeout=2)
+                time.sleep(0.1)
+            except Exception:
+                break
+        else:
+            raise AssertionError("listener still up 60s after drain")
+
+    job = DKV.get(jkey)
+    assert isinstance(job, Job) and job.status == Job.DONE
+    partial = job.result
+    # truncated mid-build, on an interval boundary, never empty
+    assert 2 <= partial.output["ntrees_actual"] < 8
+    prior = load_model(_latest_snapshot(ckdir, "gbm"))
+    resumed = GBM(ntrees=8, checkpoint=prior.key, **kw).train(
+        y="y", training_frame=fr
+    )
+    assert resumed.output["ntrees_actual"] == 8
+    np.testing.assert_allclose(
+        resumed.training_metrics.logloss, full.training_metrics.logloss,
+        atol=1e-6,
+    )
+
+
 def test_grid_abort_preserves_manifest_and_recovers(tmp_path):
     from h2o3_tpu.models.grid import GridSearch
 

@@ -13,9 +13,84 @@ import textwrap
 
 import pytest
 
+# ---------------------------------------------------------------------------
+# environment probe: some jaxlib builds (including this CI container's)
+# accept jax.distributed.initialize but then refuse CROSS-PROCESS
+# computations on the CPU backend ("Multiprocess computations aren't
+# implemented on the CPU backend"). The two-process tests below cannot pass
+# there for environmental reasons — probe ONCE (bounded) and auto-skip with
+# the real reason instead of carrying known-environmental failures as red.
+
+_TWO_PROC_REASON: str | None = None  # None = not probed; "" = capable
+
+
+def _two_process_blocker() -> str:
+    global _TWO_PROC_REASON
+    if _TWO_PROC_REASON is not None:
+        return _TWO_PROC_REASON
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    prog = textwrap.dedent(f"""
+        import os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
+                                   num_processes=2,
+                                   process_id=int(sys.argv[1]))
+        assert jax.device_count() == 4, jax.device_count()
+        # the real capability test: an actual cross-process collective
+        import numpy as np
+        from jax.experimental import multihost_utils as mh
+        out = mh.broadcast_one_to_all(np.array([7], np.int32))
+        assert int(out[0]) == 7, out
+        print("PROBE OK", sys.argv[1])
+    """)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", prog, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    outs, timed_out = [], False
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=90)
+            outs.append(out.decode(errors="replace"))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            timed_out = True
+            outs.append("")
+    if timed_out:
+        _TWO_PROC_REASON = "2-process jax.distributed probe timed out (90s)"
+    elif all(p.returncode == 0 for p in procs):
+        _TWO_PROC_REASON = ""
+    else:
+        # surface the root-cause line when recognizable, else the tail
+        joined = "\n".join(outs)
+        reason = next(
+            (ln.strip() for ln in joined.splitlines()
+             if "Error" in ln or "error" in ln), joined[-300:])
+        _TWO_PROC_REASON = reason[-300:]
+    return _TWO_PROC_REASON
+
+
+def _skip_unless_two_process_capable() -> None:
+    reason = _two_process_blocker()
+    if reason:
+        pytest.skip(
+            "two-process jax.distributed is unavailable in this environment "
+            f"(auto-skip, pre-existing environmental limitation): {reason}"
+        )
+
 
 @pytest.mark.slow
 def test_two_process_jax_distributed_psum(tmp_path):
+    _skip_unless_two_process_capable()
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -105,6 +180,7 @@ def test_launch_rest_train_across_two_processes(tmp_path):
     trains THROUGH REST with the spmd command replication executing the same
     device programs on both ranks (VERDICT r3 item 3 / SURVEY §4 multi-node
     row). Default tier: tiny shapes, 2 CPU devices per process."""
+    _skip_unless_two_process_capable()
     import json
     import time
     import urllib.error
@@ -318,6 +394,7 @@ def test_sharded_parse_two_processes(tmp_path):
     """Each rank parses ONLY its own row range (ParseDataset distributed
     ingest successor) and the global frame is correct: per-rank host reads
     are asserted disjoint and the global sums match the full-file truth."""
+    _skip_unless_two_process_capable()
     import numpy as np
     import pandas as pd
 
@@ -388,6 +465,7 @@ def test_grid_over_rest_across_two_processes(tmp_path):
     """Grid search replicates as ONE spmd command: the deterministic key
     sequence keeps every rank's grid-model keys aligned (registry.make_key
     replicated mode), so /99/Grids and predictions work afterwards."""
+    _skip_unless_two_process_capable()
     import json
     import time
     import urllib.parse
@@ -500,6 +578,7 @@ def test_dead_rank_fails_stop(tmp_path):
     exactly H2O's fail-stop contract (a dead node makes the cluster
     unusable; restart + checkpoints are the recovery path). The assertion is
     BOUNDED DEATH, not survival: the coordinator must exit, not hang."""
+    _skip_unless_two_process_capable()
     import json
     import signal
     import time

@@ -506,6 +506,81 @@ def test_typeahead_and_metadata_routes(server, tmp_path):
                for r in md["routes"])
 
 
+def test_wait_job_failure_includes_job_key(server):
+    """client.wait_job on a FAILED job raises with the JOB KEY in the
+    message (not just the traceback text)."""
+    from h2o3_tpu.client import H2OClientError, H2OConnection
+
+    conn = H2OConnection(server.url)
+    resp = _post(server, "/3/Parse", {
+        "source_frames": "/definitely/not/here.csv",
+        "destination_frame": "nope_fr"})
+    jkey = resp["job"]["key"]["name"]
+    with pytest.raises(H2OClientError) as ei:
+        conn.wait_job(jkey)
+    assert jkey in str(ei.value)
+
+
+def test_job_deadline_knob_surfaces_on_jobs(server, monkeypatch):
+    """H2O3_TPU_JOB_DEADLINE_SECS stamps a deadline on REST-created jobs and
+    /3/Jobs propagates it to the client."""
+    monkeypatch.setenv("H2O3_TPU_JOB_DEADLINE_SECS", "120")
+    t0 = time.time()
+    resp = _post(server, "/3/CreateFrame",
+                 {"dest": "deadline_fr", "rows": 50, "cols": 2, "seed": 1},
+                 as_json=True)
+    j = resp["job"]
+    assert "deadline" in j, j
+    assert 30 < j["deadline"] - t0 <= 200
+
+
+def test_job_queue_bound_sheds_503(server, monkeypatch):
+    """Job-creating routes beyond H2O3_TPU_MAX_QUEUED_JOBS are shed with
+    503 + Retry-After instead of queueing unboundedly."""
+    import threading
+
+    from h2o3_tpu.api import server as S
+
+    monkeypatch.setenv("H2O3_TPU_MAX_QUEUED_JOBS", "1")
+    release = threading.Event()
+    occupier = S._start_job(lambda j: release.wait(20), "queue occupier")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server, "/3/CreateFrame",
+                  {"rows": 10, "cols": 2, "seed": 1}, as_json=True)
+        assert ei.value.code == 503
+        assert float(ei.value.headers.get("Retry-After")) > 0
+        body = json.loads(ei.value.read())
+        assert "queue full" in body["msg"]
+    finally:
+        release.set()
+        assert occupier.wait(20)
+
+
+def test_admission_gate_healthy_path_overhead(server):
+    """Acceptance bound: the admission gate costs ≤ 2% of serving-path
+    latency on the healthy path. Measured directly: per-call gate cost
+    (enter+exit) vs the median round-trip of the CHEAPEST real route."""
+    import timeit
+
+    from h2o3_tpu.api import server as S
+
+    n = 5000
+    per_call = timeit.timeit(
+        lambda: (S._admission_enter("POST", "/3/Parse"), S._admission_exit()),
+        number=n) / n
+    # median of real /3/Ping round-trips (the lightest handler there is —
+    # every mutating route does strictly more work than this)
+    times = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        _get(server, "/3/Ping")
+        times.append(time.perf_counter() - t0)
+    ping_median = sorted(times)[len(times) // 2]
+    assert per_call < 0.02 * ping_median, (per_call, ping_median)
+    assert per_call < 50e-6  # absolute sanity: microseconds, not millis
+
+
 def test_weighted_quantile_over_rapids(server):
     rng = np.random.default_rng(7)
     x = rng.normal(size=300)

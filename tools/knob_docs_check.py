@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Knob/docs drift gate: every ``H2O3_TPU_*`` knob registered in
+``h2o3_tpu/config.py`` must be mentioned somewhere under ``docs/`` — an
+operator reading the runbooks has to be able to find every switch that
+exists. Exits 1 listing the undocumented knobs; wired into tier-1 through
+``tests/test_bench_infra.py`` so a new knob cannot merge undocumented.
+
+Usage::
+
+    python tools/knob_docs_check.py [--extra KNOB ...]
+
+``--extra`` injects fabricated knob names (the self-test hook: the wiring
+test proves the gate actually fails on an undocumented knob).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--extra", action="append", default=[],
+                    help="pretend this knob is registered too (self-test)")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, ROOT)
+    from h2o3_tpu import config
+
+    docs = ""
+    for path in sorted(glob.glob(os.path.join(ROOT, "docs", "*.md"))):
+        with open(path, encoding="utf-8") as f:
+            docs += f.read()
+    if not docs:
+        print("knob_docs_check: no docs/*.md found")
+        return 1
+
+    knobs = sorted(set(config._KNOBS) | set(args.extra))
+    missing = [k for k in knobs if k not in docs]
+    if missing:
+        print("knob_docs_check: knobs registered in config.py but absent "
+              "from docs/*.md:")
+        for k in missing:
+            print(f"  {k}")
+        print("document them (the full table lives in docs/MIGRATION.md).")
+        return 1
+    print(f"knob_docs_check: all {len(knobs)} knobs documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
