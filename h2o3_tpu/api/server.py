@@ -119,17 +119,6 @@ def _admission_exit() -> None:
         _INFLIGHT_MUTATING = max(0, _INFLIGHT_MUTATING - 1)
 
 
-def _live_rest_jobs() -> int:
-    """Prune terminal jobs from the tracked list; gauge + return the depth."""
-    with _JOBS_LOCK:
-        _REST_JOBS[:] = [
-            j for j in _REST_JOBS if j.status in (Job.PENDING, Job.RUNNING)
-        ]
-        n = len(_REST_JOBS)
-    _JOB_QUEUE_DEPTH.set(n)
-    return n
-
-
 def _start_job(work, description: str, cancellable: bool = True) -> Job:
     """The one place REST routes create Jobs: applies the bounded pending-job
     queue (503 + Retry-After when full or draining), the default job
@@ -141,12 +130,6 @@ def _start_job(work, description: str, cancellable: bool = True) -> Job:
         raise ApiError(503, "server is draining: not accepting new jobs",
                        headers={"Retry-After": "5"})
     cap = config.get_int("H2O3_TPU_MAX_QUEUED_JOBS")
-    if cap > 0 and _live_rest_jobs() >= cap:
-        _REST_REJECTED.inc(method="POST", route="<job>", reason="job_queue_full")
-        raise ApiError(
-            503, f"job queue full ({cap} live jobs >= "
-                 f"H2O3_TPU_MAX_QUEUED_JOBS={cap}); retry with backoff",
-            headers={"Retry-After": "2"})
     job = Job(work, description)
     if not cancellable:
         job.cancellable = False
@@ -155,9 +138,25 @@ def _start_job(work, description: str, cancellable: bool = True) -> Job:
         # enforced between iterations via the soft-deadline plumbing:
         # iterative builders truncate gracefully, keeping the partial model
         job.soft_deadline = time.time() + deadline
+    # prune + count + append under one lock hold: a check-then-act gap here
+    # would let concurrent creates all pass the cap check and exceed it
     with _JOBS_LOCK:
-        _REST_JOBS.append(job)
-    _JOB_QUEUE_DEPTH.set(len(_REST_JOBS))
+        _REST_JOBS[:] = [
+            j for j in _REST_JOBS if j.status in (Job.PENDING, Job.RUNNING)
+        ]
+        depth = len(_REST_JOBS)
+        admitted = not (cap > 0 and depth >= cap)
+        if admitted:
+            _REST_JOBS.append(job)
+            depth += 1
+    _JOB_QUEUE_DEPTH.set(depth)
+    if not admitted:
+        DKV.remove(job.key)  # never started; don't leak it into /3/Jobs
+        _REST_REJECTED.inc(method="POST", route="<job>", reason="job_queue_full")
+        raise ApiError(
+            503, f"job queue full ({depth} live jobs >= "
+                 f"H2O3_TPU_MAX_QUEUED_JOBS={cap}); retry with backoff",
+            headers={"Retry-After": "2"})
     job.start()
     return job
 
@@ -204,16 +203,32 @@ def _idem_begin(key: str):
         if hit is not None:
             return hit
         while len(_IDEM_CACHE) >= _IDEM_MAX:
-            _IDEM_CACHE.pop(next(iter(_IDEM_CACHE)))
+            # Evict completed entries only: popping a _IDEM_PENDING key would
+            # let its retry re-run the mutation concurrently. Pending entries
+            # are bounded by the in-flight admission gate, so letting them
+            # exceed _IDEM_MAX is safe.
+            victim = next((k for k, v in _IDEM_CACHE.items()
+                           if v is not _IDEM_PENDING), None)
+            if victim is None:
+                break
+            _IDEM_CACHE.pop(victim)
         _IDEM_CACHE[key] = _IDEM_PENDING
         return None
 
 
+# Statuses the client retries with the SAME key (admission shed, queue full,
+# draining, in-flight dup): caching them would replay the rejection forever,
+# so they release the key like 5xx and the retry re-attempts.
+_IDEM_TRANSIENT = frozenset({409, 429, 503})
+
+
 def _idem_finish(key: str, status: int, payload: dict | None) -> None:
-    """Publish the outcome: 2xx/4xx responses are cached for replay; 5xx
-    (and non-JSON) outcomes release the key so a retry re-attempts."""
+    """Publish the outcome: deterministic 2xx/4xx responses are cached for
+    replay; 5xx, transient shed statuses (409/429/503), and non-JSON
+    outcomes release the key so a retry re-attempts."""
     with _IDEM_LOCK:
-        if payload is not None and status < 500:
+        if (payload is not None and status < 500
+                and status not in _IDEM_TRANSIENT):
             _IDEM_CACHE[key] = (status, payload)
         else:
             _IDEM_CACHE.pop(key, None)
@@ -1691,10 +1706,10 @@ class _Handler(BaseHTTPRequestHandler):
                             "http_status": e.status}
                     self._reply(e.status, body, extra_headers=e.headers)
                     if idem_owned:
-                        # 4xx outcomes are deterministic — replay them;
-                        # 5xx release the key so a retry re-attempts
-                        _idem_finish(idem, e.status,
-                                     body if e.status < 500 else None)
+                        # deterministic 4xx outcomes get cached for replay;
+                        # 5xx and transient shed statuses (429/503) release
+                        # the key so a retry re-attempts (_idem_finish)
+                        _idem_finish(idem, e.status, body)
                         idem_owned = False
                 except Exception as e:  # noqa: BLE001 — REST boundary
                     status = 500

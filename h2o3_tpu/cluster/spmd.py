@@ -127,27 +127,42 @@ def _watchdog_budget() -> float:
         return 0.0
 
 
+def _watchdog_pass(active: "list[tuple[int, dict]]") -> float:
+    """One monitor sweep over a snapshot of the active commands; returns
+    the sleep interval until the next sweep."""
+    now = time.monotonic()
+    interval = 0.2
+    for wid, w in active:
+        budget = w["budget"]
+        interval = min(interval, max(budget / 4.0, 0.02))
+        if now - w["t0"] <= budget or w["tripped"]:
+            continue
+        # Re-check under the lock before latching: the command may have
+        # completed (and been popped by _watched's finally) between the
+        # snapshot and now — tripping then would permanently degrade a
+        # healthy cloud. Only a wid still registered is actually running.
+        with _WATCH_LOCK:
+            if (_WATCH_ACTIVE.get(wid) is not w or w["tripped"]
+                    or time.monotonic() - w["t0"] <= budget):
+                continue
+            w["tripped"] = True
+        _WATCHDOG_TRIPS.inc(cmd=w["cmd"])
+        from h2o3_tpu.cluster import cloud
+
+        cloud.mark_degraded(
+            f"spmd watchdog: replicated command {w['cmd']!r} still "
+            f"running after its {budget}s budget — presumed wedged "
+            "mid-collective (fail-stop; restart the cloud, recover "
+            "models from checkpoints)"
+        )
+    return interval
+
+
 def _watchdog_loop() -> None:
     while True:
         with _WATCH_LOCK:
-            active = list(_WATCH_ACTIVE.values())
-        now = time.monotonic()
-        interval = 0.2
-        for w in active:
-            budget = w["budget"]
-            interval = min(interval, max(budget / 4.0, 0.02))
-            if now - w["t0"] > budget and not w["tripped"]:
-                w["tripped"] = True
-                _WATCHDOG_TRIPS.inc(cmd=w["cmd"])
-                from h2o3_tpu.cluster import cloud
-
-                cloud.mark_degraded(
-                    f"spmd watchdog: replicated command {w['cmd']!r} still "
-                    f"running after its {budget}s budget — presumed wedged "
-                    "mid-collective (fail-stop; restart the cloud, recover "
-                    "models from checkpoints)"
-                )
-        time.sleep(interval)
+            active = list(_WATCH_ACTIVE.items())
+        time.sleep(_watchdog_pass(active))
 
 
 @contextlib.contextmanager

@@ -464,10 +464,16 @@ def test_idempotent_retried_post_trains_once():
     body = {"training_frame": "idem_fr", "response_column": "y",
             "ntrees": 2, "max_depth": 2, "seed": 1}
     key = "chaos-idem-1"
+    # count ROOT builds only: the builder spawns a nested "gbm build" job
+    # asynchronously under the REST job (parent set), so counting children
+    # would race the build thread
+    def _root_builds():
+        return sum(1 for j in DKV.values_of_type(Job)
+                   if j.description == "gbm build" and j.parent is None)
+
     r1 = conn.post("/3/ModelBuilders/gbm", body, idempotency_key=key)
     jkey = r1["job"]["key"]["name"]
-    n_jobs = sum(1 for j in DKV.values_of_type(Job)
-                 if j.description == "gbm build")
+    n_jobs = _root_builds()
     # duplicate while (possibly) still running AND after completion: both
     # replay the original response
     r2 = conn.post("/3/ModelBuilders/gbm", body, idempotency_key=key)
@@ -481,8 +487,7 @@ def test_idempotent_retried_post_trains_once():
         r3 = json.loads(r.read())
         assert r.headers.get("Idempotency-Replayed") == "true"
     assert r3["job"]["key"]["name"] == jkey
-    assert sum(1 for j in DKV.values_of_type(Job)
-               if j.description == "gbm build") == n_jobs  # exactly one train
+    assert _root_builds() == n_jobs  # exactly one train
 
 
 def test_watchdog_latches_on_stalled_command(_clean_latch, monkeypatch):
@@ -501,6 +506,32 @@ def test_watchdog_latches_on_stalled_command(_clean_latch, monkeypatch):
     assert mx.counter_value("spmd_watchdog_trips_total", cmd="remove") == before + 1
     with pytest.raises(RuntimeError, match="fail-stop"):
         spmd.run("remove", key="watchdog_nope2")
+
+
+def test_watchdog_stale_snapshot_does_not_trip(_clean_latch):
+    """Regression: a command that completed (and was popped) after the
+    watchdog snapshotted it must NOT latch degraded — the monitor re-checks
+    registration under _WATCH_LOCK before tripping, so only a still-running
+    command can degrade the cloud."""
+    from h2o3_tpu.cluster import cloud, spmd
+
+    wid = 10**9  # never collides with real _WATCH_IDS
+    w = {"cmd": "stale", "t0": time.monotonic() - 99.0, "budget": 0.05,
+         "tripped": False}
+    # stale snapshot: over budget, but no longer registered (completed)
+    spmd._watchdog_pass([(wid, w)])
+    assert cloud.degraded_reason() is None
+    assert not w["tripped"]
+    # the same entry while still registered DOES trip, one way
+    with spmd._WATCH_LOCK:
+        spmd._WATCH_ACTIVE[wid] = w
+    try:
+        spmd._watchdog_pass([(wid, w)])
+        assert w["tripped"]
+        assert cloud.degraded_reason() is not None
+    finally:
+        with spmd._WATCH_LOCK:
+            spmd._WATCH_ACTIVE.pop(wid, None)
 
 
 def test_degraded_latch_unblocks_lock_waiters(_clean_latch, monkeypatch):

@@ -557,6 +557,112 @@ def test_job_queue_bound_sheds_503(server, monkeypatch):
         assert occupier.wait(20)
 
 
+def test_shed_response_not_cached_under_idempotency_key(server, monkeypatch):
+    """Regression: a 503 shed (queue full) must NOT be cached under the
+    request's Idempotency-Key — the client retries 429/503 with the SAME
+    key, so a cached shed would replay the rejection forever."""
+    import threading
+
+    from h2o3_tpu.api import server as S
+
+    monkeypatch.setenv("H2O3_TPU_MAX_QUEUED_JOBS", "1")
+    release = threading.Event()
+    occupier = S._start_job(lambda j: release.wait(20), "idem shed occupier")
+    key = "idem-shed-regression"
+
+    def _keyed_post():
+        data = json.dumps({"dest": "idem_shed_fr", "rows": 10, "cols": 2,
+                           "seed": 1}).encode()
+        req = urllib.request.Request(
+            server.url + "/3/CreateFrame", data=data, method="POST",
+            headers={"Content-Type": "application/json",
+                     "Idempotency-Key": key})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read()), r.headers
+
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _keyed_post()
+        assert ei.value.code == 503
+    finally:
+        release.set()
+        assert occupier.wait(20)
+    # retry with the SAME key once the shed clears: the mutation must RUN
+    # (fresh job), not replay the stored 503
+    resp, headers = _keyed_post()
+    assert headers.get("Idempotency-Replayed") is None
+    assert "job" in resp
+    _wait_job(server, resp["job"]["key"]["name"])
+
+
+def test_idem_eviction_never_drops_inflight_key():
+    """Regression: when the idempotency cache is at capacity, eviction must
+    skip in-flight (_IDEM_PENDING) entries — evicting one would let a retry
+    of that key re-run the mutation a second time, concurrently."""
+    from h2o3_tpu.api import server as S
+
+    with S._IDEM_LOCK:
+        saved = dict(S._IDEM_CACHE)
+        S._IDEM_CACHE.clear()
+    try:
+        assert S._idem_begin("pending-key") is None  # in flight, unfinished
+        for i in range(S._IDEM_MAX + 8):  # sustained eviction pressure
+            k = f"done-{i}"
+            assert S._idem_begin(k) is None
+            S._idem_finish(k, 200, {"i": i})
+        with S._IDEM_LOCK:
+            assert S._IDEM_CACHE.get("pending-key") is S._IDEM_PENDING
+        # a duplicate of the in-flight key is still serialized behind the
+        # owner (409 path), never admitted as a new owner
+        assert S._idem_begin("pending-key") is S._IDEM_PENDING
+    finally:
+        with S._IDEM_LOCK:
+            S._IDEM_CACHE.clear()
+            S._IDEM_CACHE.update(saved)
+
+
+def test_job_queue_cap_exact_under_concurrency(monkeypatch):
+    """Regression: the prune+count+append sequence in _start_job is one
+    critical section — concurrent creates can never exceed the cap."""
+    import threading
+
+    from h2o3_tpu.api import server as S
+
+    with S._JOBS_LOCK:
+        S._REST_JOBS[:] = [j for j in S._REST_JOBS
+                           if j.status in (S.Job.PENDING, S.Job.RUNNING)]
+        live0 = len(S._REST_JOBS)
+    cap = live0 + 3
+    monkeypatch.setenv("H2O3_TPU_MAX_QUEUED_JOBS", str(cap))
+    release = threading.Event()
+    admitted, shed = [], []
+    seen = threading.Lock()
+    start = threading.Barrier(12)
+
+    def _create():
+        start.wait(5)
+        try:
+            j = S._start_job(lambda job: release.wait(20), "cap hammer")
+            with seen:
+                admitted.append(j)
+        except S.ApiError as e:
+            with seen:
+                shed.append(e.status)
+
+    threads = [threading.Thread(target=_create) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    try:
+        assert len(admitted) == 3  # exactly up to the cap, never beyond
+        assert len(shed) == 9 and all(s == 503 for s in shed)
+    finally:
+        release.set()
+        for j in admitted:
+            assert j.wait(20)
+
+
 def test_admission_gate_healthy_path_overhead(server):
     """Acceptance bound: the admission gate costs ≤ 2% of serving-path
     latency on the healthy path. Measured directly: per-call gate cost
