@@ -642,6 +642,57 @@ def _bench_glm_1m(fr) -> dict:
     }
 
 
+def _collective_microbench(n_nodes=64, n_bins=128, iters=10) -> dict | None:
+    """MEASURED seconds for the split phase's collectives at bench shapes:
+    the histogram all-reduce vs reduce-scatter and the per-block winner
+    gather, timed as standalone dispatches on the real mesh (collectives
+    inside the fused program cannot be host-timed individually — this is
+    the calibration that fills ``tree_collective_seconds_total``). Returns
+    None on a 1-device mesh (nothing to move)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.models.tree.shared_tree import _COLL_SECONDS, _split_shard_on
+    from h2o3_tpu.parallel.mesh import (
+        ROWS_AXIS, get_mesh, pad_cols_to_shards, shard_map)
+
+    mesh = get_mesh()
+    if mesh.shape[ROWS_AXIS] <= 1:
+        return None
+    Cp = pad_cols_to_shards(N_COLS, mesh)
+    hist = jnp.ones((Cp, n_nodes * n_bins, 3), jnp.float32)  # one local hist
+    win = jnp.ones((n_nodes, 14), jnp.float32)  # ~the winner tuple payload
+
+    def timed(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    sm = lambda f, outs: jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P(),), out_specs=outs, check_vma=False))
+    ar_s = timed(sm(lambda v: jax.lax.psum(v, ROWS_AXIS), P()), hist)
+    rs_s = timed(sm(
+        lambda v: jax.lax.psum_scatter(
+            v, ROWS_AXIS, scatter_dimension=0, tiled=True),
+        P(ROWS_AXIS)), hist)
+    wg_s = timed(sm(lambda v: jax.lax.all_gather(v, ROWS_AXIS), P()), win)
+    sharded = _split_shard_on()
+    _COLL_SECONDS.inc(rs_s if sharded else ar_s, phase="hist_reduce")
+    if sharded:
+        _COLL_SECONDS.inc(wg_s, phase="winner_gather")
+    return {
+        "allreduce_s": round(ar_s, 6),
+        "reduce_scatter_s": round(rs_s, 6),
+        "winner_gather_s": round(wg_s, 6),
+        "mode": "sharded" if sharded else "replicated",
+    }
+
+
 def _phase_headline() -> dict:
     """1M-row GBM trees/sec — the driver's headline metric — plus the
     per-phase breakdown and MFU estimate (same process: they share the
@@ -682,10 +733,24 @@ def _phase_headline() -> dict:
     from h2o3_tpu.utils import metrics as _mx
 
     reset_build_stats()
+    _coll_phases = ("hist_reduce", "winner_gather")
+    coll_before = {
+        ph: _mx.counter_value("tree_collective_bytes_total", phase=ph)
+        for ph in _coll_phases
+    }
     t0 = time.time()
     m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
     dt = time.time() - t0
     tps = N_TREES / dt
+    coll_bytes = {
+        ph: _mx.counter_value("tree_collective_bytes_total", phase=ph)
+        - coll_before[ph]
+        for ph in _coll_phases
+    }
+    try:  # measured collective seconds (fills tree_collective_seconds_total)
+        coll_s = _collective_microbench()
+    except Exception as e:  # noqa: BLE001 — diagnostics never sink the headline
+        coll_s = {"error": repr(e)[:120]}
     registry_block = _mx.REGISTRY.compact_snapshot()
     stats = {
         "dispatches": int(_mx.counter_value("tree_dispatches_total")),
@@ -713,7 +778,19 @@ def _phase_headline() -> dict:
         ),
         "tree_programs_compiled": stats["tree_programs_compiled"],
         "tree_program_cache_hits": stats["tree_program_cache_hits"],
+        # split-phase collective traffic, from the traced-program byte tally
+        # (replication-volume model, ops/histogram.py): the sharded split
+        # pipeline's acceptance metric — a sharded run must undercut the
+        # replicated control >= 2x at the same shape
+        "psum_bytes_per_tree": round(
+            sum(coll_bytes.values()) / max(stats["trees_built"], 1), 1
+        ),
+        "psum_bytes_by_phase": {
+            ph: round(v, 1) for ph, v in coll_bytes.items()
+        },
     }
+    if coll_s is not None:
+        payload["collective_s"] = coll_s
     kind = jax.devices()[0].device_kind.lower()
     peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
     hist_flops = None

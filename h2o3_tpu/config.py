@@ -28,10 +28,22 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_NATIVE_PARSE": (
         "1", "native chunked CSV parser fast path on (1) / off (0); files "
              "outside the strict dialect always fall back to pandas"),
-    "H2O3_TPU_HIST": ("", "histogram impl override: '' auto, 'matmul' forces XLA"),
+    "H2O3_TPU_HIST": (
+        "", "histogram impl override: '' auto (scatter on CPU, Pallas on "
+            "TPU), 'matmul' forces the plain-XLA MXU path, 'scatter' forces "
+            "the scatter-add path (TPU-side debug A/B — all three local "
+            "impls are reachable on any backend)"),
     "H2O3_TPU_HIST_SUBTRACT": (
         "1", "fused tree builder: build lighter child's histogram, derive "
         "sibling by parent subtraction (0 = direct per-node histograms)"),
+    "H2O3_TPU_SPLIT_SHARD": (
+        "1", "column-sharded split pipeline on meshes with >1 device: the "
+             "histogram reduction ends in a reduce-scatter over column "
+             "blocks (each device keeps only its C/P columns), the split "
+             "scan runs on the local block, and a tiny all-gather of "
+             "per-block winners merges bit-exactly against jnp.argmax's "
+             "lowest-index tie-breaking. 0 = replicated histogram + "
+             "replicated split scan (the pre-sharding path)"),
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),

@@ -75,6 +75,56 @@ _FUSED_SECONDS = _metrics.counter(
     "wall seconds spent inside fused whole-tree/chunk build dispatch calls",
     always=True)
 
+# Collective observability for the split pipeline (labeled by phase:
+# hist_reduce = the histogram psum / psum_scatter, winner_gather = the
+# sharded scan's per-block winner all-gather). Bytes use the replication-
+# volume model (see ops/histogram.py record_collective): what the collective
+# leaves on each device — the O(C·N·B·S) vs O(C·N·B·S/P) quantity the
+# sharded pipeline shrinks — tallied from the traced program structure and
+# replayed per dispatch, so bench's psum_bytes_per_tree is derived from what
+# actually ran, not asserted. Seconds are filled by bench.py's collective
+# calibration microbench (collectives inside a fused program cannot be
+# host-timed individually).
+_COLL_BYTES = _metrics.counter(
+    "tree_collective_bytes_total",
+    "per-device collective payload bytes moved by tree builds (replication-"
+    "volume model), by phase", always=True)
+_COLL_SECONDS = _metrics.counter(
+    "tree_collective_seconds_total",
+    "measured seconds of representative tree-phase collectives (bench "
+    "calibration microbench), by phase", always=True)
+
+# program-key registry + per-program collective tallies: _run_counted
+# captures a program's (phase -> bytes) tally during its first (tracing)
+# dispatch and replays it on every later one.
+_PROG_KEY: dict[int, tuple] = {}
+_PROG_COLL: dict = {}
+
+
+def _run_counted(fn, args, mult: int = 1):
+    """Dispatch ``fn(*args)`` with collective byte accounting.
+
+    ``mult`` scales the traced tally per dispatch (a scanned chunk's body
+    traces once but executes once per tree)."""
+    from h2o3_tpu.ops.histogram import collective_tally
+
+    key = _PROG_KEY.get(id(fn), id(fn))
+    agg = _PROG_COLL.get(key)
+    if agg is None:
+        entries: list = []
+        with collective_tally(entries):
+            out = fn(*args)
+        agg = {}
+        for ph, b in entries:
+            agg[ph] = agg.get(ph, 0.0) + b
+        _PROG_COLL[key] = agg
+    else:
+        out = fn(*args)
+    for ph, b in agg.items():
+        if b:
+            _COLL_BYTES.inc(b * mult, phase=ph)
+    return out
+
 
 class _BuildStatsAlias:
     """Mapping view of the tree-build registry counters.
@@ -132,6 +182,7 @@ def _cached_program(key, make):
         _STEP_CACHE[key] = fn
     else:
         BUILD_STATS["tree_program_cache_hits"] += 1
+    _PROG_KEY[id(fn)] = key
     return fn
 
 
@@ -140,7 +191,7 @@ def _cached_program(key, make):
 
 
 def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols=(),
-                mono=None, node_lo=None, node_hi=None):
+                mono=None, node_lo=None, node_hi=None, node_totals=None):
     """Best split per node from hist (N, C, B, 3). Returns per-node arrays.
 
     Stats axis: 0=w, 1=wy, 2=wh. Bin 0 is the NA bin.
@@ -163,9 +214,17 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
     categorical or other-numeric split wins on merit), and the result gains
     ``mid``/``mono_col`` for child-bound propagation. The unconstrained path
     is untouched (this branch doesn't trace when mono is None).
+
+    ``node_totals`` ((N, 3), optional) overrides the per-node {w, wy, wh}
+    totals that feed ``parent_fit`` and the node stats. The replicated path
+    derives them from column 0's bin sum ("any column sums to the node
+    totals" — every row lights exactly one bin per column); the sharded
+    path passes GLOBAL column 0's totals in, because a different column's
+    bin partition sums the same rows in a different grouping and the float
+    result can differ in the last bits — which would make per-block gains
+    incomparable with the replicated scan's.
     """
     N, C, B, _ = hist.shape
-    total = hist.sum(axis=2)  # (N, C, 3)
     na = hist[:, :, 0, :]  # (N, C, 3)
     data = hist[:, :, 1:, :]  # (N, C, B-1, 3)
 
@@ -173,7 +232,9 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
         w = s[..., 0]
         return -jnp.where(w > 0, s[..., 1] ** 2 / jnp.maximum(w, 1e-30), 0.0)
 
-    parent_fit = fit(total[:, 0:1, :]).squeeze(1)  # same for every col: (N,)
+    if node_totals is None:
+        node_totals = hist.sum(axis=2)[:, 0, :]  # (N, 3), from column 0
+    parent_fit = fit(node_totals[:, None, :]).squeeze(1)  # same for every col: (N,)
 
     def gain_with_na(L, R):
         gl = fit(L)
@@ -269,14 +330,18 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
         cat_mask = jnp.concatenate(
             [bc_na_left[:, None], cat_left], axis=1
         )  # (N, B): bin0 = NA direction
+        # canonical form: numeric winners record an all-False mask (every
+        # consumer gates on is_cat, and a garbage mask would differ between
+        # the replicated and column-sharded scans)
+        cat_mask = jnp.where(bc_is_cat[:, None], cat_mask, False)
     else:
         bc_is_cat = jnp.zeros(N, bool)
         bc_na_left = take(num_na_left)
         cat_mask = jnp.zeros((N, B), bool)
 
-    node_w = total[:, 0, 0]
-    node_wy = total[:, 0, 1]
-    node_wh = total[:, 0, 2]
+    node_w = node_totals[:, 0]
+    node_wy = node_totals[:, 1]
+    node_wh = node_totals[:, 2]
     ok_split = best_gain >= min_split_improvement
 
     # Chosen-split child stats {w, wy, wh} (N, 3) for the left/right
@@ -330,6 +395,156 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
         out["mid"] = 0.5 * (vL + vR)
         out["mono_col"] = jnp.where(bc_is_cat, 0, mono[best_col])
     return out
+
+
+# ---------------------------------------------------------------------------
+# column-sharded split pipeline (H2O3_TPU_SPLIT_SHARD): the histogram
+# reduction ends in a reduce-scatter over contiguous column blocks
+# (histogram_in_jit col_sharded=True — each device keeps only its C/P
+# columns, 1/P of the all-reduce's replication volume), the split scan runs
+# on the local block only (FLOPs / P), and a tiny all-gather of per-block
+# winner tuples feeds a merge that reproduces jnp.argmax's
+# lowest-global-index tie-breaking bit-exactly.
+
+
+def _split_shard_on() -> bool:
+    """Single policy for the sharded split pipeline: on by default on any
+    mesh with >1 device (``H2O3_TPU_SPLIT_SHARD=0`` restores the replicated
+    scan). A 1-device mesh has nothing to shard — the replicated path IS
+    the local path there."""
+    from h2o3_tpu import config
+    from h2o3_tpu.parallel.mesh import n_shards
+
+    return config.get_bool("H2O3_TPU_SPLIT_SHARD") and n_shards() > 1
+
+
+def _split_scan_sharded(
+    hist, is_cat, col_mask, min_rows, min_split_improvement,
+    any_cat: bool, mono=None, node_lo=None, node_hi=None, mesh=None,
+):
+    """Blockwise :func:`_split_scan` over a column-sharded histogram, merged
+    bit-exactly against the replicated scan's ``jnp.argmax``.
+
+    ``hist`` is (N, Cp, B, S) with the column axis sharded over the mesh
+    (``histogram_in_jit(..., col_sharded=True)``'s layout; Cp = C padded to
+    a multiple of the shard count). Each device scans ONLY its contiguous
+    block of Cp/P columns, then every device gathers the per-block winner
+    tuples — O(N·P) scalars, not the O(C·N·B·S) histogram — and merges them
+    identically (replicated output).
+
+    Bit-exactness, piece by piece:
+    - each block's histogram cells equal the replicated reduction's
+      (reduce-scatter and all-reduce combine shards in the same order);
+    - every block computes gains against GLOBAL column 0's node totals
+      (gathered once, (N, S)), because a different column's bin partition
+      can change the float total in the last bits (``node_totals`` in
+      :func:`_split_scan`) — so per-(node, col) gains are the identical
+      floats the replicated scan compares;
+    - the block-local argmax picks the lowest LOCAL index among ties, the
+      merge's argmax over the gathered (P, N) gains picks the lowest BLOCK,
+      and blocks are contiguous ascending column ranges — lexicographic
+      (block, local) is exactly lowest-global-index.
+
+    When the frame has categorical columns (``any_cat``), every block runs
+    the mean-sort categorical branch on ALL its local columns (block
+    membership is dynamic, the traced program is one-per-mesh) and selects
+    per-column by the sliced ``is_cat`` — same per-column floats, so parity
+    holds for categorical winners too; the winner tuple then carries the
+    (N, B) membership mask, making the gather O(N·B·P) instead of O(N·P).
+    """
+    import jax.tree_util as jtu
+
+    from h2o3_tpu.ops.histogram import record_collective
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or get_mesh()
+    n_dev = mesh.shape[ROWS_AXIS]
+    N, Cp, B, S = hist.shape
+    Cb = Cp // n_dev
+    C = is_cat.shape[0]
+    if Cp > C:  # histogram divisibility padding: zero hists, masked columns
+        is_cat = jnp.pad(is_cat, (0, Cp - C))
+        col_mask = jnp.pad(col_mask, ((0, 0), (0, Cp - C)))
+        if mono is not None:
+            mono = jnp.pad(mono, (0, Cp - C))
+    local_cats = tuple(range(Cb)) if any_cat else ()
+
+    # winner-gather payload per device (trace-time byte tally): the scalar
+    # tuple + the block-0 node-totals broadcast, + the membership mask when
+    # categorical columns exist
+    if n_dev > 1:
+        per_dev = N * (4 + 4 + 4 + 1 + 1 + 12 + 12 + 4 * S)
+        if any_cat:
+            per_dev += N * B
+        if mono is not None:
+            per_dev += N * 8
+        record_collective("winner_gather", n_dev * per_dev)
+
+    def body(h_blk, cm, ic, mono_g, lo, hi):
+        d = jax.lax.axis_index(ROWS_AXIS)
+        col0 = (d * Cb).astype(jnp.int32)
+        # node totals from GLOBAL column 0 = block 0's local column 0
+        tot_loc = h_blk[:, 0, :, :].sum(axis=1)  # (N, S)
+        tot0 = jax.lax.all_gather(tot_loc, ROWS_AXIS)[0]
+        cm_blk = jax.lax.dynamic_slice_in_dim(cm, col0, Cb, axis=1)
+        ic_blk = jax.lax.dynamic_slice_in_dim(ic, col0, Cb, axis=0)
+        mono_blk = (
+            None if mono_g is None
+            else jax.lax.dynamic_slice_in_dim(mono_g, col0, Cb, axis=0)
+        )
+        sp = _split_scan(
+            h_blk, ic_blk, cm_blk, min_rows, min_split_improvement,
+            local_cats, mono=mono_blk, node_lo=lo, node_hi=hi,
+            node_totals=tot0,
+        )
+        win = {
+            "gain": sp["gain"],
+            "col": col0 + sp["col"].astype(jnp.int32),
+            "split_bin": sp["split_bin"],
+            "na_left": sp["na_left"],
+            "is_cat": sp["is_cat"],
+            "Lst": sp["Lst"],
+            "Rst": sp["Rst"],
+        }
+        if any_cat:
+            win["cat_mask"] = sp["cat_mask"]
+        if mono_g is not None:
+            win["mid"] = sp["mid"]
+            win["mono_col"] = sp["mono_col"]
+        g = jtu.tree_map(lambda a: jax.lax.all_gather(a, ROWS_AXIS), win)
+        # the merge, computed identically on every device: argmax over the
+        # gathered block axis — first max wins, i.e. the LOWEST block
+        bb = jnp.argmax(g["gain"], axis=0)  # (N,)
+
+        def pick(a):
+            idx = bb.reshape((1,) + bb.shape + (1,) * (a.ndim - 2))
+            return jnp.take_along_axis(a, idx, axis=0).squeeze(0)
+
+        out = {k: pick(v) for k, v in g.items()}
+        out["ok"] = out["gain"] >= min_split_improvement
+        out["node_w"] = tot0[:, 0]
+        out["node_wy"] = tot0[:, 1]
+        out["node_wh"] = tot0[:, 2]
+        if not any_cat:
+            out["cat_mask"] = jnp.zeros((N, B), bool)
+        return out
+
+    if mono is None:
+        return shard_map(
+            lambda h, cm, ic: body(h, cm, ic, None, None, None),
+            mesh=mesh,
+            in_specs=(P(None, ROWS_AXIS), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(hist, col_mask, is_cat)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, ROWS_AXIS), P(), P(), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(hist, col_mask, is_cat, mono, node_lo, node_hi)
 
 
 # ---------------------------------------------------------------------------
@@ -422,9 +637,14 @@ def _level_core(
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     leaf_reg=None,
     *, n_pad: int, n_pad_next: int, cat_cols: tuple = (),
-    n_cols_real: int | None = None,
+    n_cols_real: int | None = None, split_shard: bool = False,
 ):
     """Split scan → decisions → partition for one level, given its histogram.
+
+    ``split_shard`` selects the column-sharded scan: ``hist`` then arrives
+    column-sharded (and possibly padded past the real column count — the
+    sharded scan masks the pad), and the scan+merge reproduces the
+    replicated path's decisions bit-exactly (:func:`_split_scan_sharded`).
 
     Returns ``(nid, preds, varimp, n_split, record, pair_info)``.
     ``pair_info`` carries, per next-level child PAIR slot (``n_pad_next//2``
@@ -456,9 +676,16 @@ def _level_core(
     col_mask = col_mask * keep
     # ph_split: phase tag for tools/profile_fused.py
     with jax.named_scope("ph_split"):
-        sp = _split_scan(
-            hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols
-        )
+        if split_shard:
+            sp = _split_scan_sharded(
+                hist, is_cat, col_mask, min_rows, min_split_improvement,
+                any_cat=bool(cat_cols),
+            )
+        else:
+            sp = _split_scan(
+                hist, is_cat, col_mask, min_rows, min_split_improvement,
+                cat_cols,
+            )
     ok = sp["ok"]
     # frontier cap: children must fit n_pad_next; later nodes go leaf
     fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
@@ -510,7 +737,7 @@ def _level_step_fn(
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     leaf_reg=None,
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
-    cat_cols: tuple = (),
+    cat_cols: tuple = (), split_shard: bool = False,
 ):
     """One whole tree level on device (histogram built from scratch).
 
@@ -520,7 +747,9 @@ def _level_step_fn(
     """
     from h2o3_tpu.ops.histogram import histogram_in_jit
 
-    hist = histogram_in_jit(bins_u8, nid, (w, wy, wh), n_pad, n_bins)
+    hist = histogram_in_jit(
+        bins_u8, nid, (w, wy, wh), n_pad, n_bins, col_sharded=split_shard
+    )
 
     if force_leaf:
         tot = hist[:, 0, :, :].sum(axis=1)  # (n_pad, 3); col 0 ≡ any col
@@ -532,7 +761,7 @@ def _level_step_fn(
         hist, bins_u8, nid, preds, varimp, key, cols_enabled, is_cat,
         min_rows, min_split_improvement, learn_rate, max_abs_leaf,
         col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
-        cat_cols=cat_cols,
+        cat_cols=cat_cols, split_shard=split_shard,
     )
     return out[:5]
 
@@ -620,6 +849,7 @@ def _fused_levels(
     leaf_reg=None,
     *, max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     subtract: bool = True, n_cols_real: int | None = None,
+    split_shard: bool = False,
 ):
     """All levels of one tree, traced into a single program, with the two
     histogram work reductions the reference's hot loop embodies
@@ -664,10 +894,16 @@ def _fused_levels(
     sat_start, n_sat = _sat_region(max_depth, node_cap, shifts)
 
     def level_hist(bins_d, nb_d, depth, nid, pair_info, parent_hist, sd):
-        """One level's (n_pad, C, Bc, 3) histogram — direct or sibling-sub."""
+        """One level's (n_pad, C, Bc, 3) histogram — direct or sibling-sub.
+        Under ``split_shard`` the column axis comes back sharded (and padded
+        to the shard count); subtraction, coarsening and the parent carry
+        are columnwise ops, so they stay block-local."""
         n_pad = min(1 << depth, node_cap)
         if depth == 0 or not subtract:
-            return histogram_in_jit(bins_d, nid, (w, wy, wh), n_pad, nb_d)
+            return histogram_in_jit(
+                bins_d, nid, (w, wy, wh), n_pad, nb_d,
+                col_sharded=split_shard,
+            )
         half = n_pad // 2
         row_pair = jnp.maximum(nid, 0) >> 1  # pair = nid//2 (child_base even)
         row_left = (nid & 1) == 0
@@ -675,7 +911,8 @@ def _fused_levels(
         build_row = (nid >= 0) & (row_left == bl[row_pair])
         nid_build = jnp.where(build_row, row_pair, -1)
         built = histogram_in_jit(
-            bins_d, nid_build, (w, wy, wh), half, nb_d
+            bins_d, nid_build, (w, wy, wh), half, nb_d,
+            col_sharded=split_shard,
         )  # (half, C, Bc, 3)
         # parent histogram was built at the previous level's (finer)
         # binning — sum its data-bin groups down to this level's
@@ -732,7 +969,7 @@ def _fused_levels(
                     is_cat, min_rows, min_split_improvement, learn_rate,
                     max_abs_leaf, col_sample_rate, leaf_reg,
                     n_pad=node_cap, n_pad_next=node_cap, cat_cols=cat_cols,
-                    n_cols_real=n_cols_real,
+                    n_cols_real=n_cols_real, split_shard=split_shard,
                 )
                 if sd:
                     rec = dict(rec, split_bin=rec["split_bin"] << sd)
@@ -746,13 +983,18 @@ def _fused_levels(
                 # thread dummies of fixed shape so one body serves both
                 parent_hist = jnp.zeros((node_cap, 1, 1, 1), jnp.float32)
                 pair_info = pair_info or {}
-            (_, nid, preds, varimp, n_split, parent_hist, pair_info, bufs) = (
-                jax.lax.while_loop(
+            from h2o3_tpu.ops.histogram import tally_weight
+
+            # the saturated body traces ONCE but executes up to n_sat times:
+            # scale its collective byte tally accordingly (an upper bound —
+            # the on-device early exit can skip levels the tally counts)
+            with tally_weight(n_sat):
+                (_, nid, preds, varimp, n_split, parent_hist, pair_info,
+                 bufs) = jax.lax.while_loop(
                     sat_cond, sat_body,
                     (jnp.int32(0), nid, preds, varimp, n_split, parent_hist,
                      pair_info, bufs),
                 )
-            )
             prev_shift = sd
             for j in range(n_sat):
                 recs.append({k: bufs[k][j] for k in bufs})
@@ -793,6 +1035,7 @@ def _fused_levels(
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
                 cat_cols=cat_cols, n_cols_real=n_cols_real,
+                split_shard=split_shard,
             )
             parent_hist = hist
             prev_shift = sd
@@ -842,14 +1085,16 @@ def _level_step_mono_fn(
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     mono, node_lo, node_hi, leaf_reg=None,
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
-    cat_cols: tuple = (),
+    cat_cols: tuple = (), split_shard: bool = False,
 ):
     """Monotone variant of _level_step_fn: leaf values clamp to the node's
     [lo, hi] bounds; children of a constrained split get tightened bounds."""
     from h2o3_tpu.ops.histogram import histogram_in_jit
 
     C = bins_u8.shape[1]
-    hist = histogram_in_jit(bins_u8, nid, (w, wy, wh), n_pad, n_bins)
+    hist = histogram_in_jit(
+        bins_u8, nid, (w, wy, wh), n_pad, n_bins, col_sharded=split_shard
+    )
 
     if force_leaf:
         tot = hist[:, 0, :, :].sum(axis=1)
@@ -868,10 +1113,17 @@ def _level_step_mono_fn(
         keep = jax.random.uniform(key, (n_pad, C)) < col_sample_rate
         keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
         col_mask = col_mask * keep
-        sp = _split_scan(
-            hist, is_cat, col_mask, min_rows, min_split_improvement, cat_cols,
-            mono=mono, node_lo=node_lo, node_hi=node_hi,
-        )
+        if split_shard:
+            sp = _split_scan_sharded(
+                hist, is_cat, col_mask, min_rows, min_split_improvement,
+                any_cat=bool(cat_cols),
+                mono=mono, node_lo=node_lo, node_hi=node_hi,
+            )
+        else:
+            sp = _split_scan(
+                hist, is_cat, col_mask, min_rows, min_split_improvement,
+                cat_cols, mono=mono, node_lo=node_lo, node_hi=node_hi,
+            )
         ok = sp["ok"]
         fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
         ok = ok & fits
@@ -908,9 +1160,23 @@ def _level_step_mono_fn(
     return nid, preds, varimp, n_split, record, new_lo, new_hi
 
 
-def _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols=()):
+def _mesh_key():
+    """Program-cache component for the process mesh: the traced collectives
+    (and the sharded split's block layout) bake the mesh in at trace time,
+    so a program compiled for one mesh must never serve another (tests swap
+    sub-meshes of different sizes within one process)."""
+    from h2o3_tpu.parallel.mesh import get_mesh
+
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS
+
+    m = get_mesh()
+    return (m.shape[ROWS_AXIS] if hasattr(m, "shape") else 0, id(m))
+
+
+def _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols=(),
+                     split_shard=False):
     key = ("mono", n_pad, n_pad_next, n_bins, force_leaf, cat_cols,
-           jax.default_backend())
+           split_shard, _mesh_key(), jax.default_backend())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(
@@ -918,9 +1184,11 @@ def _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols=()):
                 _level_step_mono_fn,
                 n_pad=n_pad, n_pad_next=n_pad_next, n_bins=n_bins,
                 force_leaf=force_leaf, cat_cols=cat_cols,
+                split_shard=split_shard,
             )
         )
         _STEP_CACHE[key] = fn
+    _PROG_KEY[id(fn)] = key
     return fn
 
 
@@ -928,9 +1196,11 @@ _STEP_CACHE: dict = {}
 
 
 def _level_step(
-    n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool, cat_cols: tuple = ()
+    n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
+    cat_cols: tuple = (), split_shard: bool = False,
 ):
-    key = (n_pad, n_pad_next, n_bins, force_leaf, cat_cols, jax.default_backend())
+    key = (n_pad, n_pad_next, n_bins, force_leaf, cat_cols, split_shard,
+           _mesh_key(), jax.default_backend())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(
@@ -938,9 +1208,11 @@ def _level_step(
                 _level_step_fn,
                 n_pad=n_pad, n_pad_next=n_pad_next,
                 n_bins=n_bins, force_leaf=force_leaf, cat_cols=cat_cols,
+                split_shard=split_shard,
             )
         )
         _STEP_CACHE[key] = fn
+    _PROG_KEY[id(fn)] = key
     return fn
 
 
@@ -975,8 +1247,9 @@ def _tree_program(
     and get a real-width varimp back.
     """
     subtract = _subtract_enabled()
+    split_shard = _split_shard_on()
     key = ("tree", max_depth, n_bins, node_cap, cat_cols, subtract,
-           n_cols_real, n_cols_pad,
+           n_cols_real, n_cols_pad, split_shard, _mesh_key(),
            tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
            jax.default_backend())
 
@@ -999,6 +1272,7 @@ def _tree_program(
                 col_sample_rate, leaf_reg,
                 max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                 cat_cols=cat_cols, subtract=subtract, n_cols_real=n_cols_real,
+                split_shard=split_shard,
             )
             return nid, preds_, varimp_[:C], records
 
@@ -1062,6 +1336,7 @@ def build_trees_scanned(
     is_cat_dev = jnp.asarray(is_cat_np)
 
     subtract = _subtract_enabled()
+    split_shard = _split_shard_on()
     # the float rates are baked into the traced closure, so they MUST be part
     # of the cache key (a boolean would silently reuse another model's rates);
     # C (the real column count) likewise — it sizes the traced RNG draws
@@ -1069,6 +1344,7 @@ def build_trees_scanned(
         "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key, C,
         tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
         float(sample_rate), float(col_sample_rate_per_tree), subtract,
+        split_shard, _mesh_key(),
         jax.default_backend(),
     )
 
@@ -1121,6 +1397,7 @@ def build_trees_scanned(
                     leaf_reg_,
                     max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                     cat_cols=cat_cols, subtract=subtract, n_cols_real=C,
+                    split_shard=split_shard,
                 )
                 return (F, vi), recs
 
@@ -1149,12 +1426,17 @@ def build_trees_scanned(
     import time as _time
 
     _t0 = _time.perf_counter()
-    out = prog(
-        bins_u8, w, y, preds, varimp, base_key,
-        base_key if row_key is None else row_key,
-        jnp.int32(tree_offset), lrs, is_cat_dev,
-        jnp.float32(min_rows), jnp.float32(min_split_improvement),
-        jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
+    # the scan body traces once but runs once per tree: mult=n_trees
+    out = _run_counted(
+        prog,
+        (
+            bins_u8, w, y, preds, varimp, base_key,
+            base_key if row_key is None else row_key,
+            jnp.int32(tree_offset), lrs, is_cat_dev,
+            jnp.float32(min_rows), jnp.float32(min_split_improvement),
+            jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
+        ),
+        mult=n_trees,
     )
     _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
     return out
@@ -1413,6 +1695,7 @@ def build_tree(
     # Monotone constraints carry per-node [lo, hi] bound state level to
     # level — a separate per-level loop (constrained builds trade the fused
     # dispatch for correctness; the default path is untouched).
+    split_shard = _split_shard_on()
     if monotone is not None and np.any(np.asarray(monotone) != 0):
         mono_dev = jnp.asarray(np.asarray(monotone, np.int32))
         nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
@@ -1422,16 +1705,21 @@ def build_tree(
             n_pad = min(1 << depth, node_cap)
             n_pad_next = min(2 * n_pad, node_cap)
             force_leaf = depth == max_depth
-            step = _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols)
+            step = _level_step_mono(
+                n_pad, n_pad_next, n_bins, force_leaf, cat_cols, split_shard
+            )
             lkey = jax.random.fold_in(key, depth)
             BUILD_STATS["dispatches"] += 1
-            nid, preds, varimp, n_split, rec, node_lo, node_hi = step(
-                bins_u8, nid, preds, varimp, w, wy, wh, lkey,
-                cols_enabled_dev, is_cat_dev,
-                jnp.float32(min_rows), jnp.float32(min_split_improvement),
-                jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-                jnp.float32(col_sample_rate),
-                mono_dev, node_lo, node_hi, leaf_reg,
+            nid, preds, varimp, n_split, rec, node_lo, node_hi = _run_counted(
+                step,
+                (
+                    bins_u8, nid, preds, varimp, w, wy, wh, lkey,
+                    cols_enabled_dev, is_cat_dev,
+                    jnp.float32(min_rows), jnp.float32(min_split_improvement),
+                    jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+                    jnp.float32(col_sample_rate),
+                    mono_dev, node_lo, node_hi, leaf_reg,
+                ),
             )
             tree.levels.append(TreeLevel(**rec))
             if force_leaf:
@@ -1451,12 +1739,15 @@ def build_tree(
         import time as _time
 
         _t0 = _time.perf_counter()
-        _, preds, varimp, records = prog(
-            bins_u8, preds, varimp, w, wy, wh, key, cols_enabled_dev,
-            is_cat_dev,
-            jnp.float32(min_rows), jnp.float32(min_split_improvement),
-            jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-            jnp.float32(col_sample_rate), leaf_reg,
+        _, preds, varimp, records = _run_counted(
+            prog,
+            (
+                bins_u8, preds, varimp, w, wy, wh, key, cols_enabled_dev,
+                is_cat_dev,
+                jnp.float32(min_rows), jnp.float32(min_split_improvement),
+                jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+                jnp.float32(col_sample_rate), leaf_reg,
+            ),
         )
         _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
         for rec in records:
@@ -1468,15 +1759,20 @@ def build_tree(
         n_pad = min(1 << depth, node_cap)
         n_pad_next = min(2 * n_pad, node_cap)
         force_leaf = depth == max_depth
-        step = _level_step(n_pad, n_pad_next, n_bins, force_leaf, cat_cols)
+        step = _level_step(
+            n_pad, n_pad_next, n_bins, force_leaf, cat_cols, split_shard
+        )
         lkey = jax.random.fold_in(key, depth)
         BUILD_STATS["dispatches"] += 1
-        nid, preds, varimp, n_split, rec = step(
-            bins_u8, nid, preds, varimp, w, wy, wh, lkey, cols_enabled_dev,
-            is_cat_dev,
-            jnp.float32(min_rows), jnp.float32(min_split_improvement),
-            jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-            jnp.float32(col_sample_rate), leaf_reg,
+        nid, preds, varimp, n_split, rec = _run_counted(
+            step,
+            (
+                bins_u8, nid, preds, varimp, w, wy, wh, lkey,
+                cols_enabled_dev, is_cat_dev,
+                jnp.float32(min_rows), jnp.float32(min_split_improvement),
+                jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+                jnp.float32(col_sample_rate), leaf_reg,
+            ),
         )
         tree.levels.append(TreeLevel(**rec))
         if force_leaf:

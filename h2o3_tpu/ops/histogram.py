@@ -38,13 +38,67 @@ scan + partition fuse into one compiled launch with zero host round-trips.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+from h2o3_tpu.parallel.mesh import (
+    ROWS_AXIS,
+    get_mesh,
+    pad_cols_to_shards,
+    shard_map,
+)
+
+# ---------------------------------------------------------------------------
+# collective byte tally — trace-time accounting of the cross-device payload
+# the tree phases move. Collectives live inside fused jitted programs, so
+# per-execution host counting is impossible; instead every collective call
+# site below records, AT TRACE TIME, the bytes its one execution will move,
+# and the dispatching caller (shared_tree._run_counted) captures the tally
+# during the program's first trace and replays it per dispatch. The model is
+# REPLICATION VOLUME — the reduced/gathered bytes the collective leaves on
+# each device (psum: the full reduced tensor, psum_scatter: only the kept
+# 1/P shard, all_gather: P x the local contribution) — i.e. the O(C·N·B·S)
+# vs O(C·N·B·S/P) quantity the sharded split pipeline shrinks, not wire
+# bytes. A 1-device mesh moves nothing and tallies 0.
+
+_TALLY: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "h2o3_coll_tally", default=None
+)
+_TALLY_WEIGHT: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "h2o3_coll_weight", default=1
+)
+
+
+@contextlib.contextmanager
+def collective_tally(out: list):
+    """Collect (phase, bytes) entries recorded while tracing under this."""
+    tok = _TALLY.set(out)
+    try:
+        yield out
+    finally:
+        _TALLY.reset(tok)
+
+
+@contextlib.contextmanager
+def tally_weight(k: int):
+    """Scale entries recorded inside by ``k`` (loop bodies traced once but
+    executed up to ``k`` times — e.g. the node_cap-saturated while_loop)."""
+    tok = _TALLY_WEIGHT.set(_TALLY_WEIGHT.get() * max(int(k), 0))
+    try:
+        yield
+    finally:
+        _TALLY_WEIGHT.reset(tok)
+
+
+def record_collective(phase: str, nbytes: float) -> None:
+    lst = _TALLY.get()
+    if lst is not None and nbytes > 0:
+        lst.append((phase, float(nbytes) * _TALLY_WEIGHT.get()))
 
 # Rows per scatter chunk: XLA materializes the vmapped scatter's updates as
 # a (C, chunk, S) f32 broadcast (~1.2 KB/row at C=28, S=4 — measured 13.4 GB
@@ -103,16 +157,20 @@ def _hist_scatter_local(bins_u8, nid, stats, n_nodes: int, n_bins: int):
 def _select_local():
     """Backend-appropriate shard-local histogram implementation.
 
-    CPU: scatter-add (fast there, pathological on TPU). TPU: the Pallas
-    kernel (hist_pallas.py) unless ``H2O3_TPU_HIST=matmul`` forces the plain
-    XLA fallback.
+    Auto: scatter-add on CPU (fast there, pathological on TPU), the Pallas
+    kernel (hist_pallas.py) on TPU. ``H2O3_TPU_HIST=matmul`` forces the
+    plain-XLA MXU path and ``=scatter`` forces the scatter path on ANY
+    backend, so A/B sweeps can reach all three local impls.
     """
     from h2o3_tpu import config
 
+    override = config.get("H2O3_TPU_HIST")
+    if override == "scatter":
+        return _hist_scatter_local
+    if override == "matmul":
+        return _hist_matmul_local
     if jax.default_backend() == "cpu":
         return _hist_scatter_local
-    if config.get("H2O3_TPU_HIST") == "matmul":
-        return _hist_matmul_local
 
     def pallas_local(bins_u8, nid, stats, n_nodes, n_bins):
         from h2o3_tpu.ops.hist_pallas import hist_pallas_local
@@ -150,19 +208,19 @@ def _hist_matmul_local(bins_u8, nid, stats, n_nodes: int, n_bins: int):
             b_c[:, :, None].astype(jnp.int32)
             == jnp.arange(n_bins, dtype=jnp.int32)[None, None, :]
         ).astype(jnp.float32).reshape(chunk, C * n_bins)
-        # per-stat scaled nid one-hot (chunk,N) @ indicator (chunk, C*B)
-        outs = []
-        for s in range(S):
-            A = oh_nid * s_c[:, s : s + 1]
-            outs.append(
-                jax.lax.dot_general(
-                    A,
-                    oh_cb,
-                    (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )  # (N, C*B)
-        return acc + jnp.stack(outs, axis=-1), None
+        # stat-scaled nid one-hot with the S lanes folded into A's columns:
+        # ONE (chunk, N*S) @ (chunk, C*B) dot instead of S separate dots —
+        # same contraction over the same rows per output cell, so the result
+        # is bit-identical, but the fused program carries one HLO dot per
+        # chunk instead of S
+        A = (oh_nid[:, :, None] * s_c[:, None, :]).reshape(chunk, -1)
+        out = jax.lax.dot_general(
+            A,
+            oh_cb,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(-1, S, C * n_bins)  # (N, S, C*B)
+        return acc + jnp.transpose(out, (0, 2, 1)), None
 
     acc0 = jnp.zeros((n_nodes, C * n_bins, S), jnp.float32)
     acc, _ = jax.lax.scan(body, acc0, (bins_ch, nid_ch, stats_ch))
@@ -171,23 +229,56 @@ def _hist_matmul_local(bins_u8, nid, stats, n_nodes: int, n_bins: int):
     return jnp.transpose(h, (1, 0, 2, 3)).reshape(C, n_nodes * n_bins, S)
 
 
-def histogram_in_jit(bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh=None):
+def histogram_in_jit(
+    bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh=None,
+    *, col_sharded: bool = False,
+):
     """Cross-device histogram, traceable inside a jitted program.
 
     ``stats`` is a TUPLE of (n,) row-sharded arrays — the stat lanes.
     Returns (n_nodes, C, n_bins, S), replicated across the mesh.
+
+    ``col_sharded=True`` is the split-pipeline mode: the cross-device
+    reduction ends in ``lax.psum_scatter`` over contiguous COLUMN blocks
+    instead of a full ``psum`` — each device reduces (and keeps) only its
+    C/P columns, moving 1/P of the all-reduce's replication volume — and the
+    result comes back as (n_nodes, Cp, n_bins, S) with the column axis
+    sharded over the mesh (Cp = C padded up to a multiple of the shard
+    count; the padding columns hold all-zero histograms, are masked by the
+    callers' column masks, and can never win a split). Each block's cells
+    are bit-identical to the same slice of the replicated reduction, which
+    is what lets the downstream per-block winner merge reproduce the
+    replicated argmax exactly.
     """
     mesh = mesh or get_mesh()
     local = _select_local()
     S = len(stats)
+    n_dev = mesh.shape[ROWS_AXIS]
+    C = bins_u8.shape[1]
+    Cp = pad_cols_to_shards(C, mesh) if col_sharded else C
 
     def body(b, n, s):
         # retired/padding rows (nid < 0) carry zero stats into every impl
         s = jnp.where((n >= 0)[:, None], s, 0.0)
         h = local(b, n, s, n_nodes, n_bins)
-        return jax.lax.psum(h, ROWS_AXIS)
+        if not col_sharded:
+            return jax.lax.psum(h, ROWS_AXIS)
+        if Cp > C:
+            # divisibility pad on the HISTOGRAM (cheap: hist-sized, not
+            # bins-sized) so C < P and C % P != 0 stay correct with no
+            # full-frame column padding anywhere
+            h = jnp.pad(h, ((0, Cp - C), (0, 0), (0, 0)))
+        return jax.lax.psum_scatter(
+            h, ROWS_AXIS, scatter_dimension=0, tiled=True
+        )
 
     smat = jnp.stack(list(stats), axis=1)  # (n, S)
+    if n_dev > 1:
+        cell_bytes = n_nodes * n_bins * S * 4
+        if col_sharded:
+            record_collective("hist_reduce", Cp * cell_bytes / n_dev)
+        else:
+            record_collective("hist_reduce", C * cell_bytes)
 
     # ph_hist: phase tag consumed by tools/profile_fused.py (HLO op_name
     # metadata carries the scope path into the profiler trace)
@@ -196,13 +287,12 @@ def histogram_in_jit(bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh=None):
             body,
             mesh=mesh,
             in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
-            out_specs=P(),
+            out_specs=P(ROWS_AXIS) if col_sharded else P(),
             check_vma=False,
-        )(bins_u8, nid, smat)  # (C, n_nodes*n_bins, S)
-        C = h.shape[0]
+        )(bins_u8, nid, smat)  # (C[p], n_nodes*n_bins, S)
         return jnp.transpose(
-            h.reshape(C, n_nodes, n_bins, S), (1, 0, 2, 3)
-        )  # (n_nodes, C, n_bins, S)
+            h.reshape(h.shape[0], n_nodes, n_bins, S), (1, 0, 2, 3)
+        )  # (n_nodes, C[p], n_bins, S)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins"))

@@ -69,6 +69,33 @@ def row_sharding(mesh: Mesh | None = None) -> NamedSharding:
     return NamedSharding(mesh or get_mesh(), P(ROWS_AXIS))
 
 
+# ---------------------------------------------------------------------------
+# column-block layout (the sharded split pipeline, shared_tree/_split_scan):
+# the SAME 1-D device axis that shards rows for the histogram pass re-shards
+# the histogram's column axis for the split phase — device d owns the
+# contiguous block of columns [d*Cb, (d+1)*Cb). Contiguity is load-bearing:
+# lowest-block-then-lowest-local-index IS lowest-global-index, which is what
+# lets the per-block winner merge reproduce jnp.argmax tie-breaking exactly.
+
+
+def pad_cols_to_shards(n_cols: int, mesh: Mesh | None = None) -> int:
+    """Smallest multiple of the shard count >= n_cols (and >= shard count,
+    so C < P still gives every device a block — the extra blocks hold only
+    zero-histogram padding columns that can never win a split)."""
+    m = (mesh or get_mesh()).shape[ROWS_AXIS]
+    return max(m, -(-n_cols // m) * m)
+
+
+def col_block_size(n_cols: int, mesh: Mesh | None = None) -> int:
+    """Columns per device block under :func:`pad_cols_to_shards` padding."""
+    return pad_cols_to_shards(n_cols, mesh) // (mesh or get_mesh()).shape[ROWS_AXIS]
+
+
+def col_block_spec(axis: int = 0) -> P:
+    """PartitionSpec sharding dimension ``axis`` over the column blocks."""
+    return P(*((None,) * axis + (ROWS_AXIS,)))
+
+
 def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
     return NamedSharding(mesh or get_mesh(), P())
 

@@ -8,6 +8,11 @@ The kernel's per-step cost is dominated by the VPU indicator build
 barely matters — bin count and tile sizes are the levers.
 
     python tools/bench_kernel_sweep.py        # prints one JSON line per cfg
+    python tools/bench_kernel_sweep.py --split-ab [--rows N]
+        # sharded-vs-replicated split pipeline A/B (H2O3_TPU_SPLIT_SHARD):
+        # one JSON line per mode with fused_tree_s + psum_bytes_per_tree,
+        # then a {"split_ab": ...} summary line. Runs on any backend (the
+        # 8-device CPU mesh is the CI proxy; queue on TPU for real numbers).
 """
 
 from __future__ import annotations
@@ -20,6 +25,82 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def split_ab(rows: int = 10_000, cols: int = 28, depth: int = 6,
+             trees: int = 4) -> None:
+    """A/B the column-sharded split pipeline against the replicated path on
+    the SAME mesh and data: per-tree fused seconds (median of 3 timed chunk
+    dispatches after a compile warmup) and the per-tree collective byte
+    tally, per mode. The env toggle works in-process because the tree
+    program caches key on the mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.models.tree import shared_tree as st
+    from h2o3_tpu.parallel.mesh import get_mesh, pad_to_shards, shard_rows
+    from h2o3_tpu.utils import metrics as mx
+
+    n = pad_to_shards(rows)
+    rng = np.random.default_rng(0)
+    bins = shard_rows(jnp.asarray(
+        rng.integers(0, 128, (n, cols)).astype(np.uint8)))
+    y = shard_rows(jnp.asarray(rng.normal(size=n).astype(np.float32)))
+    w = shard_rows(jnp.ones(n, jnp.float32))
+
+    def grad_fn(F, y_, w_):  # gaussian residuals, unit hessian
+        return y_ - F, jnp.ones_like(F)
+
+    results = {}
+    for mode in ("1", "0"):
+        os.environ["H2O3_TPU_SPLIT_SHARD"] = mode
+        times = []
+        h0 = mx.counter_value(
+            "tree_collective_bytes_total", phase="hist_reduce")
+        w0 = mx.counter_value(
+            "tree_collective_bytes_total", phase="winner_gather")
+        for rep in range(4):  # rep 0 = compile warmup
+            preds = shard_rows(jnp.zeros(n, jnp.float32))
+            varimp = jnp.zeros(cols, jnp.float32)
+            t0 = time.perf_counter()
+            out = st.build_trees_scanned(
+                bins, w, y, preds, varimp, jax.random.PRNGKey(7), trees,
+                grad_fn=grad_fn, grad_key="gaussian-ab", sample_rate=1.0,
+                n_bins=128, is_cat_cols=np.zeros(cols, bool),
+                max_depth=depth, min_rows=10.0, min_split_improvement=1e-5,
+                learn_rates=np.full(trees, 0.1, np.float32),
+                max_abs_leaf=float("inf"), col_sample_rate=1.0,
+                col_sample_rate_per_tree=1.0,
+            )
+            jax.block_until_ready(out[0])
+            if rep:
+                times.append(time.perf_counter() - t0)
+        built = 4 * trees
+        rec = {
+            "phase": "split_ab",
+            "mode": "sharded" if mode == "1" else "replicated",
+            "n_devices": get_mesh().devices.size,
+            "rows": n, "cols": cols, "depth": depth, "trees": trees,
+            "fused_tree_s": round(sorted(times)[len(times) // 2] / trees, 4),
+            "psum_bytes_per_tree": round((
+                mx.counter_value(
+                    "tree_collective_bytes_total", phase="hist_reduce")
+                + mx.counter_value(
+                    "tree_collective_bytes_total", phase="winner_gather")
+                - h0 - w0) / built, 1),
+        }
+        print(json.dumps(rec), flush=True)
+        results[rec["mode"]] = rec
+    os.environ.pop("H2O3_TPU_SPLIT_SHARD", None)
+    if len(results) == 2 and results["sharded"]["psum_bytes_per_tree"] > 0:
+        print(json.dumps({"split_ab": {
+            "bytes_ratio_replicated_over_sharded": round(
+                results["replicated"]["psum_bytes_per_tree"]
+                / results["sharded"]["psum_bytes_per_tree"], 2),
+            "time_ratio_replicated_over_sharded": round(
+                results["replicated"]["fused_tree_s"]
+                / max(results["sharded"]["fused_tree_s"], 1e-9), 3),
+        }}), flush=True)
 
 
 def main() -> None:
@@ -82,4 +163,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--split-ab" in sys.argv:
+        kw = {}
+        if "--rows" in sys.argv:
+            kw["rows"] = int(sys.argv[sys.argv.index("--rows") + 1])
+        split_ab(**kw)
+    else:
+        main()

@@ -68,6 +68,7 @@ def main() -> int:
         return 1
     for path in recent:
         headline_ok = phases_ok = registry_ok = False
+        psum_note = ""
         note = ""
         try:
             with open(path) as f:
@@ -83,6 +84,23 @@ def main() -> int:
                 # cross-checked against the endpoint
                 reg = d.get("metrics_registry")
                 registry_ok = isinstance(reg, dict) and len(reg) > 0
+                # psum_bytes_per_tree (split-pipeline traffic, ISSUE 5) is
+                # OPTIONAL — older artifacts predate it — but when present
+                # it must be a sane number: a negative/NaN/garbage value
+                # means the byte tally broke and the A/B replay would be
+                # comparing noise, so the artifact does not count
+                if "psum_bytes_per_tree" in d:
+                    try:
+                        v = float(d["psum_bytes_per_tree"])
+                        sane = v >= 0 and v == v and v != float("inf")
+                    except (TypeError, ValueError):
+                        sane = False
+                    psum_note = (
+                        f" psum-bytes/tree={d['psum_bytes_per_tree']}"
+                        if sane else " psum-bytes/tree=INSANE"
+                    )
+                    if not sane:
+                        headline_ok = False
         except OSError as e:  # vanished/unreadable between glob and open
             note = f" (unreadable: {e.strerror or e})"
         except Exception as e:  # torn/empty/garbage JSON is a MISSING, not a crash
@@ -92,7 +110,7 @@ def main() -> int:
             f"headline={'ok' if headline_ok else 'MISSING'}"
             f" post-headline-phases={'ok' if phases_ok else 'MISSING'}"
             f" registry-snapshot={'ok' if registry_ok else 'MISSING'}"
-            f"{note}"
+            f"{psum_note}{note}"
         )
         if headline_ok and phases_ok and registry_ok:
             return 0

@@ -11,6 +11,10 @@
 #      headline only.
 #   3. Pallas tile sweep (tools/bench_kernel_sweep.py) for the next kernel
 #      iteration.
+#   4. column-sharded split pipeline A/B (ISSUE 5): default is now SHARDED
+#      (measured 6.2x less split-phase traffic + ~17% faster trees on the
+#      8-device CPU proxy); the control run measures the replicated path,
+#      headline only, plus the dedicated sweep A/B with byte tallies.
 set -x
 cd "$(dirname "$0")/.."
 
@@ -43,6 +47,14 @@ save "BENCH_builder_${stamp}_nbins127.json" "TPU bench 127-bin A/B (headline onl
 H2O3_TPU_HIST=matmul H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_matmul.json"  # Pallas kernel vs plain-XLA A/B
 save "BENCH_builder_${stamp}_matmul.json" "TPU bench plain-XLA histogram control (headline only)"
+
+H2O3_TPU_SPLIT_SHARD=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_replsplit.json"  # replicated-split control
+save "BENCH_builder_${stamp}_replsplit.json" "TPU bench replicated-split control (headline only)"
+
+timeout 1200 python tools/bench_kernel_sweep.py --split-ab --rows 1000000 \
+  | tee "SPLIT_AB_${stamp}.jsonl"  # sharded-vs-replicated split, byte tallies
+save "SPLIT_AB_${stamp}.jsonl" "Split-pipeline sharded-vs-replicated A/B (1M rows)"
 
 timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
