@@ -734,9 +734,14 @@ def _phase_headline() -> dict:
 
     reset_build_stats()
     _coll_phases = ("hist_reduce", "winner_gather")
+    _hbm_paths = ("fused", "pallas_unfused", "dense", "fused_via_dense")
     coll_before = {
         ph: _mx.counter_value("tree_collective_bytes_total", phase=ph)
         for ph in _coll_phases
+    }
+    hbm_before = {
+        p: _mx.counter_value("tree_hist_hbm_bytes_total", path=p)
+        for p in _hbm_paths
     }
     t0 = time.time()
     m = GBM(ntrees=N_TREES, **kw).train(y="label", training_frame=fr)
@@ -746,6 +751,11 @@ def _phase_headline() -> dict:
         ph: _mx.counter_value("tree_collective_bytes_total", phase=ph)
         - coll_before[ph]
         for ph in _coll_phases
+    }
+    hbm_bytes = {
+        p: _mx.counter_value("tree_hist_hbm_bytes_total", path=p)
+        - hbm_before[p]
+        for p in _hbm_paths
     }
     try:  # measured collective seconds (fills tree_collective_seconds_total)
         coll_s = _collective_microbench()
@@ -787,6 +797,16 @@ def _phase_headline() -> dict:
         ),
         "psum_bytes_by_phase": {
             ph: round(v, 1) for ph, v in coll_bytes.items()
+        },
+        # modeled hist+split HBM traffic (traced-structure tally,
+        # tree_hist_hbm_bytes_total): the fused Pallas pipeline's
+        # acceptance metric — a fused run must undercut the
+        # H2O3_TPU_SPLIT_FUSE=0 control >= 2x at the same shape
+        "hist_hbm_bytes_per_tree": round(
+            sum(hbm_bytes.values()) / max(stats["trees_built"], 1), 1
+        ),
+        "hist_hbm_bytes_by_path": {
+            p: round(v, 1) for p, v in hbm_bytes.items() if v
         },
     }
     if coll_s is not None:

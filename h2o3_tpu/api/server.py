@@ -1689,31 +1689,40 @@ class _Handler(BaseHTTPRequestHandler):
                     params = self._params()
                     args = [urllib.parse.unquote(g) for g in match.groups()]
                     out = handler(params, *args)
+                    # the idempotency outcome publishes BEFORE the response
+                    # bytes leave: the moment the client sees the reply it
+                    # may retry with the same key, and a retry racing a
+                    # post-reply release/cache would 409 (observed: a shed
+                    # 503's key still _IDEM_PENDING when the retry landed)
                     if isinstance(out, dict) and "__binary__" in out:
-                        self._reply_binary(out)
                         if idem_owned:  # binary bodies are not replayable
                             _idem_finish(idem, 200, None)
                             idem_owned = False
+                        self._reply_binary(out)
                     else:
-                        self._reply(200, out)
                         if idem_owned:
                             _idem_finish(idem, 200, out)
                             idem_owned = False
+                        self._reply(200, out)
                 except ApiError as e:
                     status = e.status
                     body = {"__meta": {"schema_type": "Error"},
                             "error_url": path, "msg": str(e),
                             "http_status": e.status}
-                    self._reply(e.status, body, extra_headers=e.headers)
                     if idem_owned:
                         # deterministic 4xx outcomes get cached for replay;
                         # 5xx and transient shed statuses (429/503) release
-                        # the key so a retry re-attempts (_idem_finish)
+                        # the key so a retry re-attempts (_idem_finish) —
+                        # published before the reply, see above
                         _idem_finish(idem, e.status, body)
                         idem_owned = False
+                    self._reply(e.status, body, extra_headers=e.headers)
                 except Exception as e:  # noqa: BLE001 — REST boundary
                     status = 500
                     Log.err(f"REST {method} {path} failed: {e!r}")
+                    if idem_owned:  # release before the reply (retry race)
+                        _idem_finish(idem, 500, None)
+                        idem_owned = False
                     self._reply(500, {"__meta": {"schema_type": "Error"},
                                       "error_url": path, "msg": repr(e),
                                       "http_status": 500})

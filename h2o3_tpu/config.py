@@ -36,6 +36,26 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_HIST_SUBTRACT": (
         "1", "fused tree builder: build lighter child's histogram, derive "
         "sibling by parent subtraction (0 = direct per-node histograms)"),
+    "H2O3_TPU_SPLIT_FUSE": (
+        "auto", "fused Pallas histogram→split pipeline: the histogram kernel "
+                "emits its native VMEM tile layout (no HBM unscramble "
+                "passes), the cross-device reduce-scatter ships whole column "
+                "tiles, and a Pallas split-scan kernel consumes the tiles "
+                "block-by-block in VMEM so only per-(node,col) winner "
+                "candidates reach HBM. 'auto' = on for non-CPU backends; "
+                "'1' forces it on any backend (CPU runs the kernels in the "
+                "Pallas interpreter — the CI/parity lane); '0' = the "
+                "unfused path (dense histogram + XLA split scan). Monotone-"
+                "constraint builds and, on >1-device meshes, frames with "
+                "categorical columns always use the unfused path (see "
+                "docs/MIGRATION.md fallback matrix)"),
+    "H2O3_TPU_PALLAS_TILES": (
+        "", "Pallas histogram/split kernel tile sizes as 'ROW,COL,NODE' "
+            "(e.g. '512,8,64' — the built-in defaults). Tiles are a static "
+            "compile key: every setting gets its own executable, so the "
+            "tile sweep (tools/bench_kernel_sweep.py, run_tpu_backlog.sh) "
+            "varies them via the environment with no monkeypatching. "
+            "'' = built-in defaults"),
     "H2O3_TPU_SPLIT_SHARD": (
         "1", "column-sharded split pipeline on meshes with >1 device: the "
              "histogram reduction ends in a reduce-scatter over column "

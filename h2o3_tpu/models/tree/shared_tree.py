@@ -94,6 +94,23 @@ _COLL_SECONDS = _metrics.counter(
     "measured seconds of representative tree-phase collectives (bench "
     "calibration microbench), by phase", always=True)
 
+# HBM-traffic model of the histogram+split phases, by pipeline path
+# (``path``: fused = blocked Pallas histogram → Pallas split kernel, no
+# unscramble pass; pallas_unfused = Pallas histogram + two HBM unscramble
+# transposes + dense XLA scan; dense = scatter/matmul histogram + dense
+# scan; fused_via_dense = the CPU correctness lane that re-blocks a dense
+# histogram). Same traced-structure tally mechanism as the collective
+# bytes (ops/histogram.record_hbm): one write per materialized
+# intermediate + one read per consumed one, recorded at trace time and
+# replayed per dispatch — so the fused pipeline's "no full-histogram HBM
+# round-trip" claim is a measured artifact number, not prose. Terminal
+# force-leaf levels skip the scan read the model counts: an upper bound,
+# like the saturated-region collective tally.
+_HIST_HBM_BYTES = _metrics.counter(
+    "tree_hist_hbm_bytes_total",
+    "modeled per-device HBM bytes moved by the histogram+split phases of "
+    "tree builds, by pipeline path", always=True)
+
 # program-key registry + per-program collective tallies: _run_counted
 # captures a program's (phase -> bytes) tally during its first (tracing)
 # dispatch and replays it on every later one.
@@ -121,7 +138,11 @@ def _run_counted(fn, args, mult: int = 1):
     else:
         out = fn(*args)
     for ph, b in agg.items():
-        if b:
+        if not b:
+            continue
+        if ph.startswith("hbm/"):
+            _HIST_HBM_BYTES.inc(b * mult, path=ph[4:])
+        else:
             _COLL_BYTES.inc(b * mult, phase=ph)
     return out
 
@@ -418,6 +439,127 @@ def _split_shard_on() -> bool:
     return config.get_bool("H2O3_TPU_SPLIT_SHARD") and n_shards() > 1
 
 
+def _split_fuse_on() -> bool:
+    """Policy knob for the fused Pallas histogram→split pipeline
+    (``H2O3_TPU_SPLIT_FUSE``): 'auto' (default) = on for non-CPU backends
+    (the Pallas kernels run native there); '1' forces it anywhere (CPU runs
+    the kernels in the Pallas interpreter — the CI/parity lane, slower than
+    the scatter+XLA path and never a default); '0' = the unfused path."""
+    from h2o3_tpu import config
+
+    v = config.get("H2O3_TPU_SPLIT_FUSE")
+    if v in ("auto", ""):
+        return jax.default_backend() != "cpu"
+    return v not in ("0", "false", "False")
+
+
+def _split_fuse_active(cat_cols: tuple, split_shard: bool) -> bool:
+    """Whether a program being built NOW should trace the fused pipeline.
+
+    The fallback matrix (docs/MIGRATION.md): monotone-constraint builds
+    never fuse (their feasibility mask is per-bin — the callers simply
+    don't ask), and on a column-sharded mesh a frame WITH categorical
+    columns falls back wholly (block membership of a cat column is dynamic
+    there, so the static per-column routing the kernel needs doesn't
+    exist). On the replicated path categorical columns route to the
+    mean-sort fallback branch per column while numeric columns stay on the
+    kernel (ops/split_pallas.fused_split_scan)."""
+    return _split_fuse_on() and not (split_shard and cat_cols)
+
+
+def _kernel_key() -> tuple:
+    """Program-cache component for everything that changes the TRACED
+    kernels without changing any call-site argument: the fuse toggle, the
+    Pallas tile triple, and the local-histogram override. Without these a
+    cached program compiled under one setting would silently serve another
+    (the --fused-ab sweep toggles H2O3_TPU_SPLIT_FUSE in-process)."""
+    from h2o3_tpu import config
+    from h2o3_tpu.ops.hist_pallas import _tiles
+
+    return (_split_fuse_on(), _tiles(), config.get("H2O3_TPU_HIST"))
+
+
+def _split_scan_sharded_fused(
+    blk, layout, is_cat, col_mask, min_rows, min_split_improvement, mesh=None,
+):
+    """Column-sharded split scan on a BLOCKED histogram: each device runs
+    the Pallas split kernel (ops/split_pallas.py) on its own 1/P tile range
+    in VMEM — the full histogram never exists on any device — and the
+    winner merge is byte-identical to the dense sharded path's: per-block
+    winners all_gather (O(N·P) scalars), argmax over blocks picks the
+    lowest block, blocks are contiguous ascending column ranges, and every
+    block's gains are computed against GLOBAL column 0's node totals.
+    Numeric-only by construction (``_split_fuse_active``: categorical
+    frames fall back to the dense sharded scan on >1-device meshes)."""
+    import jax.tree_util as jtu
+
+    from h2o3_tpu.ops.histogram import record_collective
+    from h2o3_tpu.ops.hist_pallas import blocked_node_totals
+    from h2o3_tpu.ops.split_pallas import fused_split_scan
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or get_mesh()
+    n_dev = mesh.shape[ROWS_AXIS]
+    L = layout
+    lloc = L.local(n_dev)
+    N, B, S = L.n_nodes, L.n_bins, L.ns
+    C = is_cat.shape[0]
+    if L.cpad > C:  # layout padding columns: masked, can never win
+        is_cat = jnp.pad(is_cat, (0, L.cpad - C))
+        col_mask = jnp.pad(col_mask, ((0, 0), (0, L.cpad - C)))
+
+    if n_dev > 1:
+        per_dev = N * (4 + 4 + 4 + 1 + 1 + 12 + 12 + 4 * S)
+        record_collective("winner_gather", n_dev * per_dev)
+
+    def body(blk_loc, cm, ic):
+        d = jax.lax.axis_index(ROWS_AXIS)
+        col0 = (d * lloc.cpad).astype(jnp.int32)
+        # node totals from GLOBAL column 0 = block 0's local column 0
+        tot_loc = blocked_node_totals(blk_loc, lloc)
+        tot0 = jax.lax.all_gather(tot_loc, ROWS_AXIS)[0]
+        cm_blk = jax.lax.dynamic_slice_in_dim(cm, col0, lloc.cpad, axis=1)
+        ic_blk = jax.lax.dynamic_slice_in_dim(ic, col0, lloc.cpad, axis=0)
+        sp = fused_split_scan(
+            blk_loc, lloc, ic_blk, cm_blk, min_rows, min_split_improvement,
+            (), node_totals=tot0,
+        )
+        win = {
+            "gain": sp["gain"],
+            "col": col0 + sp["col"].astype(jnp.int32),
+            "split_bin": sp["split_bin"],
+            "na_left": sp["na_left"],
+            "is_cat": sp["is_cat"],
+            "Lst": sp["Lst"],
+            "Rst": sp["Rst"],
+        }
+        g = jtu.tree_map(lambda a: jax.lax.all_gather(a, ROWS_AXIS), win)
+        # identical merge to the dense sharded path: argmax over the block
+        # axis — first max wins, i.e. the LOWEST block
+        bb = jnp.argmax(g["gain"], axis=0)  # (N,)
+
+        def pick(a):
+            idx = bb.reshape((1,) + bb.shape + (1,) * (a.ndim - 2))
+            return jnp.take_along_axis(a, idx, axis=0).squeeze(0)
+
+        out = {k: pick(v) for k, v in g.items()}
+        out["ok"] = out["gain"] >= min_split_improvement
+        out["node_w"] = tot0[:, 0]
+        out["node_wy"] = tot0[:, 1]
+        out["node_wh"] = tot0[:, 2]
+        out["cat_mask"] = jnp.zeros((N, B), bool)
+        return out
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ROWS_AXIS), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(blk, col_mask, is_cat)
+
+
 def _split_scan_sharded(
     hist, is_cat, col_mask, min_rows, min_split_improvement,
     any_cat: bool, mono=None, node_lo=None, node_hi=None, mesh=None,
@@ -638,6 +780,7 @@ def _level_core(
     leaf_reg=None,
     *, n_pad: int, n_pad_next: int, cat_cols: tuple = (),
     n_cols_real: int | None = None, split_shard: bool = False,
+    fuse_layout=None,
 ):
     """Split scan → decisions → partition for one level, given its histogram.
 
@@ -645,6 +788,11 @@ def _level_core(
     column-sharded (and possibly padded past the real column count — the
     sharded scan masks the pad), and the scan+merge reproduces the
     replicated path's decisions bit-exactly (:func:`_split_scan_sharded`).
+
+    ``fuse_layout`` (a ``hist_pallas.HistLayout``) selects the fused Pallas
+    pipeline: ``hist`` is then the BLOCKED histogram tensor and the scan
+    runs as the VMEM-tile split kernel (``ops/split_pallas.py``) — sharded
+    or replicated — emitting the same decision dict.
 
     Returns ``(nid, preds, varimp, n_split, record, pair_info)``.
     ``pair_info`` carries, per next-level child PAIR slot (``n_pad_next//2``
@@ -676,7 +824,19 @@ def _level_core(
     col_mask = col_mask * keep
     # ph_split: phase tag for tools/profile_fused.py
     with jax.named_scope("ph_split"):
-        if split_shard:
+        if fuse_layout is not None and split_shard:
+            sp = _split_scan_sharded_fused(
+                hist, fuse_layout, is_cat, col_mask, min_rows,
+                min_split_improvement,
+            )
+        elif fuse_layout is not None:
+            from h2o3_tpu.ops.split_pallas import fused_split_scan
+
+            sp = fused_split_scan(
+                hist, fuse_layout, is_cat, col_mask, min_rows,
+                min_split_improvement, cat_cols,
+            )
+        elif split_shard:
             sp = _split_scan_sharded(
                 hist, is_cat, col_mask, min_rows, min_split_improvement,
                 any_cat=bool(cat_cols),
@@ -709,8 +869,8 @@ def _level_core(
             jnp.zeros(half, jnp.int32), jnp.arange(n_pad, dtype=jnp.int32)
         ),
         "build_left": scat(jnp.zeros(half, bool), sp["Lst"][:, 0] <= sp["Rst"][:, 0]),
-        "Lst": scat(jnp.zeros((half, 3), hist.dtype), sp["Lst"]),
-        "Rst": scat(jnp.zeros((half, 3), hist.dtype), sp["Rst"]),
+        "Lst": scat(jnp.zeros((half, 3), sp["Lst"].dtype), sp["Lst"]),
+        "Rst": scat(jnp.zeros((half, 3), sp["Rst"].dtype), sp["Rst"]),
     }
     return nid, preds, varimp, n_split, record, pair_info
 
@@ -738,6 +898,7 @@ def _level_step_fn(
     leaf_reg=None,
     *, n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
     cat_cols: tuple = (), split_shard: bool = False,
+    split_fuse: bool = False,
 ):
     """One whole tree level on device (histogram built from scratch).
 
@@ -748,11 +909,20 @@ def _level_step_fn(
     from h2o3_tpu.ops.histogram import histogram_in_jit
 
     hist = histogram_in_jit(
-        bins_u8, nid, (w, wy, wh), n_pad, n_bins, col_sharded=split_shard
+        bins_u8, nid, (w, wy, wh), n_pad, n_bins, col_sharded=split_shard,
+        fused=split_fuse,
     )
+    lay = None
+    if split_fuse:
+        hist, lay = hist
 
     if force_leaf:
-        tot = hist[:, 0, :, :].sum(axis=1)  # (n_pad, 3); col 0 ≡ any col
+        if split_fuse:
+            from h2o3_tpu.ops.hist_pallas import blocked_node_totals
+
+            tot = blocked_node_totals(hist, lay)  # global col 0 ≡ any col
+        else:
+            tot = hist[:, 0, :, :].sum(axis=1)  # (n_pad, 3); col 0 ≡ any col
         return _force_leaf_from_stats(
             bins_u8, nid, preds, varimp, tot[:, 0], tot[:, 1], tot[:, 2],
             learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
@@ -761,7 +931,7 @@ def _level_step_fn(
         hist, bins_u8, nid, preds, varimp, key, cols_enabled, is_cat,
         min_rows, min_split_improvement, learn_rate, max_abs_leaf,
         col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
-        cat_cols=cat_cols, split_shard=split_shard,
+        cat_cols=cat_cols, split_shard=split_shard, fuse_layout=lay,
     )
     return out[:5]
 
@@ -849,7 +1019,7 @@ def _fused_levels(
     leaf_reg=None,
     *, max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     subtract: bool = True, n_cols_real: int | None = None,
-    split_shard: bool = False,
+    split_shard: bool = False, split_fuse: bool = False,
 ):
     """All levels of one tree, traced into a single program, with the two
     histogram work reductions the reference's hot loop embodies
@@ -887,29 +1057,67 @@ def _fused_levels(
     nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
     recs = []
     parent_hist = None
+    parent_lay = None  # static HistLayout of the blocked parent (fused path)
     pair_info = None
     n_split = None
     shifts = _bin_shifts(max_depth, n_bins, cat_cols)
     prev_shift = 0
     sat_start, n_sat = _sat_region(max_depth, node_cap, shifts)
 
-    def level_hist(bins_d, nb_d, depth, nid, pair_info, parent_hist, sd):
-        """One level's (n_pad, C, Bc, 3) histogram — direct or sibling-sub.
+    def level_hist(bins_d, nb_d, depth, nid, pair_info, parent_hist, sd,
+                   parent_lay=None):
+        """One level's histogram — direct or sibling-sub; returns
+        ``(hist, layout)`` where ``layout`` is None on the dense path and
+        the ``HistLayout`` of the blocked tensor on the fused one.
         Under ``split_shard`` the column axis comes back sharded (and padded
         to the shard count); subtraction, coarsening and the parent carry
-        are columnwise ops, so they stay block-local."""
+        are columnwise (fused: tile-local reshape) ops, so they stay
+        block-local and never transpose in HBM."""
         n_pad = min(1 << depth, node_cap)
         if depth == 0 or not subtract:
-            return histogram_in_jit(
+            h = histogram_in_jit(
                 bins_d, nid, (w, wy, wh), n_pad, nb_d,
-                col_sharded=split_shard,
+                col_sharded=split_shard, fused=split_fuse,
             )
+            return h if split_fuse else (h, None)
         half = n_pad // 2
         row_pair = jnp.maximum(nid, 0) >> 1  # pair = nid//2 (child_base even)
         row_left = (nid & 1) == 0
         bl = pair_info["build_left"]
         build_row = (nid >= 0) & (row_left == bl[row_pair])
         nid_build = jnp.where(build_row, row_pair, -1)
+        if split_fuse:
+            from h2o3_tpu.ops.hist_pallas import (
+                blocked_coarsen, relayout_nodes,
+            )
+
+            built, blay = histogram_in_jit(
+                bins_d, nid_build, (w, wy, wh), half, nb_d,
+                col_sharded=split_shard, fused=True,
+            )
+            # the blocked tensor's node axis is a pure row-reshape
+            # (rows = node·S + stat), so sibling selection/stacking runs on
+            # logical (n_ct, node, S, lanes) views with no lane transpose
+            psel_blk, clay = blocked_coarsen(parent_hist, parent_lay, sd)
+            lanes = clay.ct * clay.bpad
+            v = psel_blk.reshape(clay.n_ct, clay.nn, clay.ns, lanes)
+            psel = jnp.where(
+                pair_info["valid"][None, :, None, None],
+                v[:, pair_info["parent_idx"], :, :],
+                0.0,
+            )  # (n_ct, half, S, lanes)
+            b4 = built.reshape(blay.n_ct, blay.nn, blay.ns, lanes)[:, :half]
+            sib = psel - b4
+            blb = bl[None, :, None, None]
+            stacked = jnp.stack(
+                [jnp.where(blb, b4, sib), jnp.where(blb, sib, b4)], axis=2
+            ).reshape(blay.n_ct, n_pad, blay.ns, lanes)
+            flay = relayout_nodes(blay, n_pad)
+            if flay.nn > n_pad:
+                stacked = jnp.pad(
+                    stacked, ((0, 0), (0, flay.nn - n_pad), (0, 0), (0, 0))
+                )
+            return stacked.reshape(flay.shape), flay
         built = histogram_in_jit(
             bins_d, nid_build, (w, wy, wh), half, nb_d,
             col_sharded=split_shard,
@@ -925,7 +1133,7 @@ def _fused_levels(
         blb = bl[:, None, None, None]
         return jnp.stack(
             [jnp.where(blb, built, sib), jnp.where(blb, sib, built)], axis=1
-        ).reshape(n_pad, *built.shape[1:])
+        ).reshape(n_pad, *built.shape[1:]), None
 
     depth = 0
     while depth <= max_depth:
@@ -938,7 +1146,13 @@ def _fused_levels(
             sd = shifts[depth]
             nb_d = _coarse_nbins(n_bins, sd)
             bins_d = _coarsen_bins(bins_u8, sd)
-            if subtract and parent_hist.shape[0] < node_cap:
+            if split_fuse and subtract and parent_lay.n_nodes < node_cap:
+                from h2o3_tpu.ops.hist_pallas import blocked_pad_nodes
+
+                parent_hist, parent_lay = blocked_pad_nodes(
+                    parent_hist, parent_lay, node_cap
+                )
+            elif not split_fuse and subtract and parent_hist.shape[0] < node_cap:
                 # first iteration's parent frontier may be node_cap/2 wide;
                 # zero-pad so the carry shape is loop-invariant (the pad rows
                 # are gated off by pair_info["valid"])
@@ -963,13 +1177,17 @@ def _fused_levels(
                 i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c = carry
                 d = sat_start + i
                 lkey = jax.random.fold_in(tkey, d)
-                hist = level_hist(bins_d, nb_d, sat_start, nid_c, pinfo, phist, 0)
+                hist, hlay = level_hist(
+                    bins_d, nb_d, sat_start, nid_c, pinfo, phist, 0,
+                    parent_lay=parent_lay,
+                )
                 nid_c, preds_c, vi_c, nsp, rec, pinfo = _level_core(
                     hist, bins_d, nid_c, preds_c, vi_c, lkey, cols_enabled,
                     is_cat, min_rows, min_split_improvement, learn_rate,
                     max_abs_leaf, col_sample_rate, leaf_reg,
                     n_pad=node_cap, n_pad_next=node_cap, cat_cols=cat_cols,
                     n_cols_real=n_cols_real, split_shard=split_shard,
+                    fuse_layout=hlay,
                 )
                 if sd:
                     rec = dict(rec, split_bin=rec["split_bin"] << sd)
@@ -1019,12 +1237,18 @@ def _fused_levels(
             recs.append(rec)
             break
 
-        hist = level_hist(
-            bins_d, nb_d, depth, nid, pair_info, parent_hist, sd - prev_shift
+        hist, hlay = level_hist(
+            bins_d, nb_d, depth, nid, pair_info, parent_hist,
+            sd - prev_shift, parent_lay=parent_lay,
         )
 
         if force_leaf:
-            tot = hist[:, 0, :, :].sum(axis=1)
+            if split_fuse:
+                from h2o3_tpu.ops.hist_pallas import blocked_node_totals
+
+                tot = blocked_node_totals(hist, hlay)
+            else:
+                tot = hist[:, 0, :, :].sum(axis=1)
             nid, preds, varimp, _, rec = _force_leaf_from_stats(
                 bins_u8, nid, preds, varimp, tot[:, 0], tot[:, 1], tot[:, 2],
                 learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
@@ -1035,9 +1259,10 @@ def _fused_levels(
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
                 cat_cols=cat_cols, n_cols_real=n_cols_real,
-                split_shard=split_shard,
+                split_shard=split_shard, fuse_layout=hlay,
             )
             parent_hist = hist
+            parent_lay = hlay
             prev_shift = sd
             if sd:
                 # a coarse prefix split IS a full-res prefix split: convert
@@ -1175,8 +1400,10 @@ def _mesh_key():
 
 def _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols=(),
                      split_shard=False):
+    # _kernel_key: the Pallas tile/override knobs change the traced
+    # histogram kernel even though mono levels never fuse the split
     key = ("mono", n_pad, n_pad_next, n_bins, force_leaf, cat_cols,
-           split_shard, _mesh_key(), jax.default_backend())
+           split_shard, _kernel_key(), _mesh_key(), jax.default_backend())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(
@@ -1198,9 +1425,10 @@ _STEP_CACHE: dict = {}
 def _level_step(
     n_pad: int, n_pad_next: int, n_bins: int, force_leaf: bool,
     cat_cols: tuple = (), split_shard: bool = False,
+    split_fuse: bool = False,
 ):
     key = (n_pad, n_pad_next, n_bins, force_leaf, cat_cols, split_shard,
-           _mesh_key(), jax.default_backend())
+           split_fuse, _kernel_key(), _mesh_key(), jax.default_backend())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(
@@ -1208,7 +1436,7 @@ def _level_step(
                 _level_step_fn,
                 n_pad=n_pad, n_pad_next=n_pad_next,
                 n_bins=n_bins, force_leaf=force_leaf, cat_cols=cat_cols,
-                split_shard=split_shard,
+                split_shard=split_shard, split_fuse=split_fuse,
             )
         )
         _STEP_CACHE[key] = fn
@@ -1248,8 +1476,10 @@ def _tree_program(
     """
     subtract = _subtract_enabled()
     split_shard = _split_shard_on()
+    split_fuse = _split_fuse_active(cat_cols, split_shard)
     key = ("tree", max_depth, n_bins, node_cap, cat_cols, subtract,
-           n_cols_real, n_cols_pad, split_shard, _mesh_key(),
+           n_cols_real, n_cols_pad, split_shard, split_fuse, _kernel_key(),
+           _mesh_key(),
            tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
            jax.default_backend())
 
@@ -1272,7 +1502,7 @@ def _tree_program(
                 col_sample_rate, leaf_reg,
                 max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                 cat_cols=cat_cols, subtract=subtract, n_cols_real=n_cols_real,
-                split_shard=split_shard,
+                split_shard=split_shard, split_fuse=split_fuse,
             )
             return nid, preds_, varimp_[:C], records
 
@@ -1337,6 +1567,7 @@ def build_trees_scanned(
 
     subtract = _subtract_enabled()
     split_shard = _split_shard_on()
+    split_fuse = _split_fuse_active(cat_cols, split_shard)
     # the float rates are baked into the traced closure, so they MUST be part
     # of the cache key (a boolean would silently reuse another model's rates);
     # C (the real column count) likewise — it sizes the traced RNG draws
@@ -1344,7 +1575,7 @@ def build_trees_scanned(
         "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key, C,
         tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
         float(sample_rate), float(col_sample_rate_per_tree), subtract,
-        split_shard, _mesh_key(),
+        split_shard, split_fuse, _kernel_key(), _mesh_key(),
         jax.default_backend(),
     )
 
@@ -1397,7 +1628,7 @@ def build_trees_scanned(
                     leaf_reg_,
                     max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                     cat_cols=cat_cols, subtract=subtract, n_cols_real=C,
-                    split_shard=split_shard,
+                    split_shard=split_shard, split_fuse=split_fuse,
                 )
                 return (F, vi), recs
 
@@ -1755,12 +1986,14 @@ def build_tree(
         return tree, preds, varimp
 
     nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+    split_fuse = _split_fuse_active(cat_cols, split_shard)
     for depth in range(max_depth + 1):
         n_pad = min(1 << depth, node_cap)
         n_pad_next = min(2 * n_pad, node_cap)
         force_leaf = depth == max_depth
         step = _level_step(
-            n_pad, n_pad_next, n_bins, force_leaf, cat_cols, split_shard
+            n_pad, n_pad_next, n_bins, force_leaf, cat_cols, split_shard,
+            split_fuse,
         )
         lkey = jax.random.fold_in(key, depth)
         BUILD_STATS["dispatches"] += 1

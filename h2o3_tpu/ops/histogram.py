@@ -100,6 +100,16 @@ def record_collective(phase: str, nbytes: float) -> None:
     if lst is not None and nbytes > 0:
         lst.append((phase, float(nbytes) * _TALLY_WEIGHT.get()))
 
+
+def record_hbm(path: str, nbytes: float) -> None:
+    """Trace-time tally of the MODELED per-device HBM traffic of the
+    histogram+split phases (``tree_hist_hbm_bytes_total{path}``): one write
+    per materialized intermediate plus one read per consumed one, recorded
+    where the intermediates are created (here) and replayed per dispatch by
+    shared_tree._run_counted — the fused pipeline's acceptance metric. Rides
+    the same tally as the collective bytes under an ``hbm/`` phase prefix."""
+    record_collective("hbm/" + path, nbytes)
+
 # Rows per scatter chunk: XLA materializes the vmapped scatter's updates as
 # a (C, chunk, S) f32 broadcast (~1.2 KB/row at C=28, S=4 — measured 13.4 GB
 # temp for the whole 10M-row tree program before chunking). 256k rows bounds
@@ -159,8 +169,10 @@ def _select_local():
 
     Auto: scatter-add on CPU (fast there, pathological on TPU), the Pallas
     kernel (hist_pallas.py) on TPU. ``H2O3_TPU_HIST=matmul`` forces the
-    plain-XLA MXU path and ``=scatter`` forces the scatter path on ANY
-    backend, so A/B sweeps can reach all three local impls.
+    plain-XLA MXU path, ``=scatter`` forces the scatter path, and
+    ``=pallas`` forces the Pallas kernel (in the interpreter on CPU — the
+    fused-pipeline parity/CI lane) on ANY backend, so A/B sweeps can reach
+    all three local impls everywhere.
     """
     from h2o3_tpu import config
 
@@ -169,15 +181,22 @@ def _select_local():
         return _hist_scatter_local
     if override == "matmul":
         return _hist_matmul_local
-    if jax.default_backend() == "cpu":
+    if override != "pallas" and jax.default_backend() == "cpu":
         return _hist_scatter_local
 
     def pallas_local(bins_u8, nid, stats, n_nodes, n_bins):
-        from h2o3_tpu.ops.hist_pallas import hist_pallas_local
+        from h2o3_tpu.ops.hist_pallas import _tiles, hist_pallas_local
 
-        return hist_pallas_local(bins_u8, nid, stats, n_nodes, n_bins)
+        return hist_pallas_local(
+            bins_u8, nid, stats, n_nodes, n_bins,
+            interpret=jax.default_backend() == "cpu", tiles=_tiles(),
+        )
 
     return pallas_local
+
+
+def _local_is_pallas(local) -> bool:
+    return local not in (_hist_scatter_local, _hist_matmul_local)
 
 
 _ROW_CHUNK = 8192  # rows per matmul chunk: (chunk, C*B) transient ≤ ~120MB
@@ -231,7 +250,7 @@ def _hist_matmul_local(bins_u8, nid, stats, n_nodes: int, n_bins: int):
 
 def histogram_in_jit(
     bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh=None,
-    *, col_sharded: bool = False,
+    *, col_sharded: bool = False, fused: bool = False,
 ):
     """Cross-device histogram, traceable inside a jitted program.
 
@@ -249,6 +268,18 @@ def histogram_in_jit(
     are bit-identical to the same slice of the replicated reduction, which
     is what lets the downstream per-block winner merge reproduce the
     replicated argmax exactly.
+
+    ``fused=True`` (the ``H2O3_TPU_SPLIT_FUSE`` pipeline) returns
+    ``(blk, layout)`` instead: the histogram in the Pallas kernel's NATIVE
+    blocked tile layout (``hist_pallas.HistLayout``) with NO unscramble
+    pass — the split kernel (``ops/split_pallas.py``) consumes the tiles
+    directly in VMEM. Composes with ``col_sharded``: the reduce-scatter
+    then runs over axis 0 (whole column tiles → contiguous column ranges
+    per device) and the returned block is each device's 1/P slice; the full
+    histogram never exists replicated anywhere. When the selected local
+    impl is scatter/matmul (CPU CI, H2O3_TPU_HIST overrides) the dense
+    result is re-blocked locally — a correctness lane, counted honestly by
+    the HBM model.
     """
     mesh = mesh or get_mesh()
     local = _select_local()
@@ -256,6 +287,12 @@ def histogram_in_jit(
     n_dev = mesh.shape[ROWS_AXIS]
     C = bins_u8.shape[1]
     Cp = pad_cols_to_shards(C, mesh) if col_sharded else C
+
+    if fused:
+        return _histogram_in_jit_fused(
+            bins_u8, nid, stats, n_nodes, n_bins, mesh, local,
+            col_sharded=col_sharded,
+        )
 
     def body(b, n, s):
         # retired/padding rows (nid < 0) carry zero stats into every impl
@@ -280,6 +317,22 @@ def histogram_in_jit(
         else:
             record_collective("hist_reduce", C * cell_bytes)
 
+    # HBM model of the unfused pipeline (see record_hbm): the dense tensor
+    # is written once and its (possibly column-sharded) slice re-read by the
+    # split scan; the Pallas local impl additionally pays its two unscramble
+    # passes over the padded kernel output. Terminal force-leaf levels skip
+    # the scan read — like the saturated-region collective tally, this is a
+    # deliberate upper bound.
+    dense_b = C * n_nodes * n_bins * S * 4
+    scan_b = (Cp / n_dev if col_sharded else C) * n_nodes * n_bins * S * 4
+    if _local_is_pallas(local):
+        from h2o3_tpu.ops.hist_pallas import _tiles, plan_layout
+
+        opad = plan_layout(C, n_nodes, n_bins, S, tiles=_tiles()).nbytes
+        record_hbm("pallas_unfused", 4 * opad + dense_b + scan_b)
+    else:
+        record_hbm("dense", dense_b + scan_b)
+
     # ph_hist: phase tag consumed by tools/profile_fused.py (HLO op_name
     # metadata carries the scope path into the profiler trace)
     with jax.named_scope("ph_hist"):
@@ -293,6 +346,72 @@ def histogram_in_jit(
         return jnp.transpose(
             h.reshape(h.shape[0], n_nodes, n_bins, S), (1, 0, 2, 3)
         )  # (n_nodes, C[p], n_bins, S)
+
+
+def _histogram_in_jit_fused(
+    bins_u8, nid, stats, n_nodes: int, n_bins: int, mesh, local,
+    *, col_sharded: bool,
+):
+    """Blocked-layout histogram body: see ``histogram_in_jit(fused=True)``."""
+    from h2o3_tpu.ops.hist_pallas import (
+        _tiles,
+        blocked_from_dense,
+        hist_pallas_local,
+        plan_layout,
+    )
+
+    S = len(stats)
+    n_dev = mesh.shape[ROWS_AXIS]
+    C = bins_u8.shape[1]
+    is_pallas = _local_is_pallas(local)
+    layout = plan_layout(
+        C, n_nodes, n_bins, S, tiles=_tiles(),
+        n_shards=n_dev if col_sharded else 1,
+    )
+
+    def body(b, n, s):
+        s = jnp.where((n >= 0)[:, None], s, 0.0)
+        if is_pallas:
+            h = hist_pallas_local(
+                b, n, s, n_nodes, n_bins,
+                interpret=jax.default_backend() == "cpu",
+                blocked=True, tiles=layout.tiles,
+                n_shards=n_dev if col_sharded else 1,
+            )
+        else:
+            h = blocked_from_dense(local(b, n, s, n_nodes, n_bins), layout)
+        if not col_sharded:
+            return jax.lax.psum(h, ROWS_AXIS)
+        return jax.lax.psum_scatter(
+            h, ROWS_AXIS, scatter_dimension=0, tiled=True
+        )
+
+    smat = jnp.stack(list(stats), axis=1)
+    if n_dev > 1:
+        record_collective(
+            "hist_reduce",
+            layout.nbytes / n_dev if col_sharded else layout.nbytes,
+        )
+    # HBM model (see record_hbm): the blocked tensor is written once by the
+    # kernel and its (possibly 1/P) slice read once by the split kernel —
+    # no unscramble pass exists. The dense-impl lane re-blocks locally and
+    # pays for the dense intermediate it materializes.
+    blk_scan = layout.nbytes / n_dev if col_sharded else layout.nbytes
+    if is_pallas:
+        record_hbm("fused", layout.nbytes + blk_scan)
+    else:
+        dense_b = C * n_nodes * n_bins * S * 4
+        record_hbm("fused_via_dense", 2 * dense_b + layout.nbytes + blk_scan)
+
+    with jax.named_scope("ph_hist"):
+        blk = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
+            out_specs=P(ROWS_AXIS) if col_sharded else P(),
+            check_vma=False,
+        )(bins_u8, nid, smat)
+    return blk, layout
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins"))

@@ -13,6 +13,16 @@ barely matters — bin count and tile sizes are the levers.
         # one JSON line per mode with fused_tree_s + psum_bytes_per_tree,
         # then a {"split_ab": ...} summary line. Runs on any backend (the
         # 8-device CPU mesh is the CI proxy; queue on TPU for real numbers).
+    python tools/bench_kernel_sweep.py --fused-ab [--rows N]
+        # fused-vs-unfused Pallas split pipeline A/B (H2O3_TPU_SPLIT_FUSE,
+        # ISSUE 6): both modes pin H2O3_TPU_HIST=pallas (interpret mode on
+        # CPU — slow but like-for-like), one JSON line per mode with
+        # fused_tree_s + hist_hbm_bytes_per_tree (the modeled HBM traffic
+        # of the hist+split phases), then a {"fused_ab": ...} summary.
+
+The tile sweep varies ROW/COL/NODE tiles through the H2O3_TPU_PALLAS_TILES
+knob (a static compile key — every setting gets its own executable), so no
+module monkeypatching and no jit-cache clearing is needed.
 """
 
 from __future__ import annotations
@@ -103,6 +113,87 @@ def split_ab(rows: int = 10_000, cols: int = 28, depth: int = 6,
         }}), flush=True)
 
 
+def fused_ab(rows: int = 4_000, cols: int = 28, depth: int = 6,
+             trees: int = 2) -> None:
+    """A/B the fused Pallas histogram→split pipeline (H2O3_TPU_SPLIT_FUSE)
+    against the unfused Pallas path on the SAME mesh and data: per-tree
+    fused seconds (median of 3 timed chunk dispatches after a compile
+    warmup) plus the modeled hist+split HBM bytes per tree
+    (tree_hist_hbm_bytes_total — the traffic the fusion removes). Both
+    modes pin H2O3_TPU_HIST=pallas so the comparison isolates the split
+    pipeline; on CPU both run the Pallas interpreter (like-for-like proxy —
+    queue on TPU for real numbers). The env toggle works in-process because
+    the tree program caches key on the mode (_kernel_key)."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.models.tree import shared_tree as st
+    from h2o3_tpu.parallel.mesh import get_mesh, pad_to_shards, shard_rows
+    from h2o3_tpu.utils import metrics as mx
+
+    os.environ["H2O3_TPU_HIST"] = "pallas"
+    n = pad_to_shards(rows)
+    rng = np.random.default_rng(0)
+    bins = shard_rows(jnp.asarray(
+        rng.integers(0, 128, (n, cols)).astype(np.uint8)))
+    y = shard_rows(jnp.asarray(rng.normal(size=n).astype(np.float32)))
+    w = shard_rows(jnp.ones(n, jnp.float32))
+
+    def grad_fn(F, y_, w_):  # gaussian residuals, unit hessian
+        return y_ - F, jnp.ones_like(F)
+
+    hbm_paths = ("fused", "pallas_unfused", "dense", "fused_via_dense")
+    results = {}
+    for mode in ("1", "0"):
+        os.environ["H2O3_TPU_SPLIT_FUSE"] = mode
+        times = []
+        b0 = {p: mx.counter_value("tree_hist_hbm_bytes_total", path=p)
+              for p in hbm_paths}
+        for rep in range(4):  # rep 0 = compile warmup
+            preds = shard_rows(jnp.zeros(n, jnp.float32))
+            varimp = jnp.zeros(cols, jnp.float32)
+            t0 = time.perf_counter()
+            out = st.build_trees_scanned(
+                bins, w, y, preds, varimp, jax.random.PRNGKey(7), trees,
+                grad_fn=grad_fn, grad_key="gaussian-fab", sample_rate=1.0,
+                n_bins=128, is_cat_cols=np.zeros(cols, bool),
+                max_depth=depth, min_rows=10.0, min_split_improvement=1e-5,
+                learn_rates=np.full(trees, 0.1, np.float32),
+                max_abs_leaf=float("inf"), col_sample_rate=1.0,
+                col_sample_rate_per_tree=1.0,
+            )
+            jax.block_until_ready(out[0])
+            if rep:
+                times.append(time.perf_counter() - t0)
+        built = 4 * trees
+        hbm = sum(
+            mx.counter_value("tree_hist_hbm_bytes_total", path=p) - b0[p]
+            for p in hbm_paths
+        )
+        rec = {
+            "phase": "fused_ab",
+            "mode": "fused" if mode == "1" else "unfused",
+            "backend": jax.default_backend(),
+            "n_devices": get_mesh().devices.size,
+            "rows": n, "cols": cols, "depth": depth, "trees": trees,
+            "fused_tree_s": round(sorted(times)[len(times) // 2] / trees, 4),
+            "hist_hbm_bytes_per_tree": round(hbm / built, 1),
+        }
+        print(json.dumps(rec), flush=True)
+        results[rec["mode"]] = rec
+    os.environ.pop("H2O3_TPU_SPLIT_FUSE", None)
+    os.environ.pop("H2O3_TPU_HIST", None)
+    if len(results) == 2 and results["fused"]["hist_hbm_bytes_per_tree"] > 0:
+        print(json.dumps({"fused_ab": {
+            "hbm_ratio_unfused_over_fused": round(
+                results["unfused"]["hist_hbm_bytes_per_tree"]
+                / results["fused"]["hist_hbm_bytes_per_tree"], 2),
+            "time_ratio_unfused_over_fused": round(
+                results["unfused"]["fused_tree_s"]
+                / max(results["fused"]["fused_tree_s"], 1e-9), 3),
+        }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -120,14 +211,13 @@ def main() -> None:
         for col_tile in (4, 8, 14, 28):
             for n_bins in (255, 127, 63):
                 for n_nodes in (16, 64):
-                    hist_pallas.ROW_TILE = row_tile
-                    hist_pallas.COL_TILE = col_tile
-                    # hist_pallas_local is JITTED and its cache keys on
-                    # shapes/static args only — the tile module globals are
-                    # baked in at trace time, so without this clear every
-                    # config after the first would silently re-time the
-                    # first-compiled executable under a wrong label
-                    hist_pallas.hist_pallas_local.clear_cache()
+                    # tiles flow through the knob (static compile key: each
+                    # setting compiles its own executable — no stale-cache
+                    # clearing, and the exact production read path is what
+                    # gets swept)
+                    os.environ["H2O3_TPU_PALLAS_TILES"] = (
+                        f"{row_tile},{col_tile},{hist_pallas.NODE_TILE}"
+                    )
                     bins = jnp.asarray(
                         (base_bins % n_bins).astype(np.uint8)
                     )
@@ -137,7 +227,8 @@ def main() -> None:
                     try:
                         stats = jnp.stack([w, wy, w], 1)  # 3-lane GBM shape
                         fn = lambda: hist_pallas.hist_pallas_local(
-                            bins, nid, stats, n_nodes, n_bins
+                            bins, nid, stats, n_nodes, n_bins,
+                            tiles=hist_pallas._tiles(),
                         )
                         out = fn()
                         jax.block_until_ready(out)
@@ -155,6 +246,7 @@ def main() -> None:
                                "error": repr(e)[:200]}
                     print(json.dumps(rec), flush=True)
                     results.append(rec)
+    os.environ.pop("H2O3_TPU_PALLAS_TILES", None)
 
     ok = [r for r in results if "hist_s" in r]
     if ok:
@@ -163,10 +255,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    kw = {}
+    if "--rows" in sys.argv:
+        kw["rows"] = int(sys.argv[sys.argv.index("--rows") + 1])
     if "--split-ab" in sys.argv:
-        kw = {}
-        if "--rows" in sys.argv:
-            kw["rows"] = int(sys.argv[sys.argv.index("--rows") + 1])
         split_ab(**kw)
+    elif "--fused-ab" in sys.argv:
+        fused_ab(**kw)
     else:
         main()

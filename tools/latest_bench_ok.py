@@ -101,6 +101,22 @@ def main() -> int:
                     )
                     if not sane:
                         headline_ok = False
+                # hist_hbm_bytes_per_tree (fused split pipeline, ISSUE 6) is
+                # OPTIONAL like psum above, but when present it must be a
+                # sane non-negative finite number or the fused-vs-unfused
+                # A/B would be comparing noise
+                if "hist_hbm_bytes_per_tree" in d:
+                    try:
+                        v = float(d["hist_hbm_bytes_per_tree"])
+                        sane = v >= 0 and v == v and v != float("inf")
+                    except (TypeError, ValueError):
+                        sane = False
+                    psum_note += (
+                        f" hist-hbm-bytes/tree={d['hist_hbm_bytes_per_tree']}"
+                        if sane else " hist-hbm-bytes/tree=INSANE"
+                    )
+                    if not sane:
+                        headline_ok = False
         except OSError as e:  # vanished/unreadable between glob and open
             note = f" (unreadable: {e.strerror or e})"
         except Exception as e:  # torn/empty/garbage JSON is a MISSING, not a crash

@@ -15,6 +15,11 @@
 #      (measured 6.2x less split-phase traffic + ~17% faster trees on the
 #      8-device CPU proxy); the control run measures the replicated path,
 #      headline only, plus the dedicated sweep A/B with byte tallies.
+#   5. fused histogram->split Pallas pipeline A/B (ISSUE 6): default is now
+#      FUSED on TPU (H2O3_TPU_SPLIT_FUSE=auto; 3x less modeled hist+split
+#      HBM traffic on the CPU proxy); the control run measures the unfused
+#      path, headline only, plus the dedicated sweep A/B with HBM tallies.
+#      The tile sweep (step 3) now varies tiles via H2O3_TPU_PALLAS_TILES.
 set -x
 cd "$(dirname "$0")/.."
 
@@ -51,6 +56,14 @@ save "BENCH_builder_${stamp}_matmul.json" "TPU bench plain-XLA histogram control
 H2O3_TPU_SPLIT_SHARD=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_replsplit.json"  # replicated-split control
 save "BENCH_builder_${stamp}_replsplit.json" "TPU bench replicated-split control (headline only)"
+
+H2O3_TPU_SPLIT_FUSE=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_unfused.json"  # fused-split-pipeline control
+save "BENCH_builder_${stamp}_unfused.json" "TPU bench unfused split-pipeline control (headline only)"
+
+timeout 1200 python tools/bench_kernel_sweep.py --fused-ab --rows 1000000 \
+  | tee "FUSED_AB_${stamp}.jsonl"  # fused-vs-unfused Pallas pipeline, HBM tallies
+save "FUSED_AB_${stamp}.jsonl" "Fused-vs-unfused histogram->split pipeline A/B (1M rows)"
 
 timeout 1200 python tools/bench_kernel_sweep.py --split-ab --rows 1000000 \
   | tee "SPLIT_AB_${stamp}.jsonl"  # sharded-vs-replicated split, byte tallies
