@@ -60,6 +60,11 @@ _IDEM_REPLAYS = _metrics.counter(
     "rest_idempotent_replays_total",
     "POSTs answered from the Idempotency-Key response cache (a client "
     "retry that would otherwise have double-run the mutation)")
+_PRED_EVICTED = _metrics.counter(
+    "rest_prediction_frames_evicted_total",
+    "generated /3/Predictions result frames evicted by the "
+    "H2O3_TPU_PREDICTIONS_RETAIN bound (serving load no longer grows "
+    "the DKV without bound)")
 
 
 # ---------------------------------------------------------------------------
@@ -280,6 +285,38 @@ class ApiError(Exception):
         super().__init__(msg)
         self.status = status
         self.headers = headers or {}
+
+
+# ---------------------------------------------------------------------------
+# bounded retention of generated prediction frames (serving-load DKV fix):
+# every /3/Predictions call with a server-generated dest used to leak one
+# Frame into the DKV forever. Only GENERATED keys are tracked — a client
+# that names its predictions_frame owns its lifecycle.
+
+import collections as _collections
+
+_PRED_LOCK = threading.Lock()
+_PRED_FRAMES: "_collections.deque[str]" = _collections.deque()
+
+
+def _retain_prediction_frame(dest: str) -> None:
+    from h2o3_tpu import config
+    from h2o3_tpu.cluster import spmd
+
+    cap = config.get_int("H2O3_TPU_PREDICTIONS_RETAIN")
+    if cap <= 0:
+        return
+    evict: list[str] = []
+    with _PRED_LOCK:
+        _PRED_FRAMES.append(dest)
+        while len(_PRED_FRAMES) > cap:
+            evict.append(_PRED_FRAMES.popleft())
+    for k in evict:
+        try:
+            spmd.run("remove", key=k)  # replicated: every rank's DKV agrees
+            _PRED_EVICTED.inc()
+        except Exception as e:  # noqa: BLE001 — eviction must not fail predict
+            Log.warn(f"prediction-frame eviction of {k} failed: {e!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -754,6 +791,7 @@ class Endpoints:
         # right after boot still covers persist/cloud/mrtask (families
         # register at module import; routes import these modules lazily)
         import h2o3_tpu.persist  # noqa: F401
+        import h2o3_tpu.serving  # noqa: F401
         from h2o3_tpu.cluster import cloud  # noqa: F401
         from h2o3_tpu.parallel import mrtask  # noqa: F401
 
@@ -903,6 +941,7 @@ class Endpoints:
         fr = DKV.get(frame_key)
         if not isinstance(fr, Frame):
             raise ApiError(404, f"Frame {frame_key} not found")
+        generated_dest = not params.get("predictions_frame")
         dest = params.get("predictions_frame") or DKV.make_key("prediction")
 
         def _flag(name):
@@ -936,9 +975,67 @@ class Endpoints:
             # user-input errors from the option paths (multinomial
             # contributions, bad leaf type) are 400s, not server faults
             raise ApiError(400, str(e))
+        if generated_dest:
+            _retain_prediction_frame(dest)
         return {"__meta": {"schema_type": "Predictions"},
                 "predictions_frame": {"name": dest},
                 "model_metrics": []}
+
+    def predict_rows(self, params):
+        """``POST /3/Predictions/rows`` — the low-latency scoring route: row
+        payloads in, predictions out, no DKV frame round-trip. Requests are
+        coalesced into batched device dispatches by the scoring tier
+        (h2o3_tpu/serving; H2O3_TPU_SCORE_* knobs) and run behind the
+        admission gates with a per-route deadline. Body (JSON)::
+
+            {"model": "<model key>",
+             "rows": [{"col": value, ...}, ...]}   # or a column table
+
+        Returns ``predictions`` as column arrays in the EasyPredict layout
+        (``predict`` + per-class probabilities + ``cal_p*`` when the model
+        is calibrated)."""
+        model_key = params.get("model") or params.get("model_id")
+        if isinstance(model_key, dict):
+            model_key = model_key.get("name")
+        if not model_key:
+            raise ApiError(400, "model is required")
+        m = _get_model(str(model_key))
+        rows = params.get("rows")
+        if isinstance(rows, str):
+            try:
+                rows = json.loads(rows)
+            except ValueError as e:
+                raise ApiError(400, f"bad rows payload: {e}")
+        if not rows:
+            raise ApiError(
+                400, "rows is required (a list of {column: value} dicts or "
+                     "a {column: [values]} table)")
+        from h2o3_tpu.cluster import spmd
+
+        if spmd.multi_process():
+            # the compiled scorer dispatches locally, outside the replicated
+            # command stream — on a multi-host training cloud that would
+            # desync the ranks' collective order. Scoring scales OUT via
+            # single-process replicas (deploy/k8s.yaml h2o3-tpu-score).
+            raise ApiError(
+                501, "/3/Predictions/rows serves from single-process "
+                     "scoring replicas, not a multi-process training cloud "
+                     "— see the h2o3-tpu-score Deployment in deploy/k8s.yaml")
+        from h2o3_tpu import serving
+
+        try:
+            with _metrics.span("serving.predict_rows"):
+                out = serving.score_rows(m, rows)
+        except serving.ShedError as e:
+            raise ApiError(e.status, str(e),
+                           headers={"Retry-After": e.retry_after})
+        except (ValueError, KeyError, TypeError) as e:
+            raise ApiError(400, str(e))
+        n = len(next(iter(out.values()))) if out else 0
+        return {"__meta": {"schema_type": "PredictionsRows"},
+                "model_id": {"name": m.key},
+                "rows": n,
+                "predictions": out}
 
     def model_metrics(self, params, model_key, frame_key):
         m = _get_model(model_key)
@@ -1475,6 +1572,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/3/Models/([^/]+)/pojo", _EP.model_pojo),
     ("GET", r"/3/Models/([^/]+)", _EP.model_get),
     ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),
+    ("POST", r"/3/Predictions/rows", _EP.predict_rows),
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
     ("POST", r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", _EP.model_metrics),
     ("POST", r"/3/ModelMetrics/predictions_frame/([^/]+)/actuals_frame/([^/]+)",

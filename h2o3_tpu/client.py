@@ -223,6 +223,17 @@ class H2OConnection:
         )
         return out["predictions_frame"]["name"]
 
+    def predict_rows(self, model_key: str, rows) -> dict:
+        """Low-latency row scoring (``POST /3/Predictions/rows``): ``rows``
+        is a list of ``{column: value}`` dicts or a ``{column: [values]}``
+        table — no frame upload, no DKV round-trip. Returns the
+        ``predictions`` column table (``predict`` + per-class
+        probabilities). Requests are coalesced server-side into batched
+        device dispatches (the scoring tier; see docs/MIGRATION.md)."""
+        out = self.post("/3/Predictions/rows",
+                        {"model": model_key, "rows": rows}, as_json=True)
+        return out["predictions"]
+
     def split_frame(self, frame: str | Any, ratios, destination_frames=None,
                     seed: int = 1234) -> list[str]:
         """Random row split via /3/SplitFrame; returns the part keys."""

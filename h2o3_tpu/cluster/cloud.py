@@ -50,6 +50,35 @@ _G_HEALTHY = metrics.gauge(
     "cloud_healthy", "1 while every probed local device passes health checks")
 _C_TRANSITIONS = metrics.counter(
     "cloud_health_transitions_total", "health state changes, by target state")
+_C_CACHE_HITS = metrics.counter(
+    "compile_cache_hits_total",
+    "persistent XLA compilation-cache hits (jax monitoring event "
+    "'/jax/compilation_cache/cache_hits') — a warm scoring replica or a "
+    "same-shape-bucket rebuild should count only hits here and compile "
+    "zero new programs")
+
+_CACHE_LISTENER_INSTALLED = False
+
+
+def _install_cache_hit_listener() -> None:
+    """Bridge jax's compilation-cache monitoring events into the registry
+    so operators can watch cross-process cache effectiveness (replica
+    cold-start, AutoML same-bucket rebuilds) from /3/Metrics. Best-effort:
+    the monitoring module is jax-internal and absent on some versions."""
+    global _CACHE_LISTENER_INSTALLED
+    if _CACHE_LISTENER_INSTALLED:
+        return
+    try:
+        from jax._src import monitoring as _mon
+
+        def _on_event(event, **kw):
+            if "compilation_cache" in event and "cache_hits" in event:
+                _C_CACHE_HITS.inc()
+
+        _mon.register_event_listener(_on_event)
+        _CACHE_LISTENER_INSTALLED = True
+    except Exception as e:  # noqa: BLE001 — telemetry only, never fatal
+        Log.debug(f"compile-cache hit listener unavailable: {e!r}")
 
 
 def init(
@@ -88,6 +117,7 @@ def init(
     # cpu_aot_loader "machine type mismatch" error), observed crashing the
     # test suite inside cache (de)serialization. CPU compiles are fast
     # enough to skip caching entirely.
+    _install_cache_hit_listener()
     cache_dir = config.get("H2O3_TPU_COMPILE_CACHE")
     if not cache_dir:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

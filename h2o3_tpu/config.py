@@ -161,6 +161,31 @@ _KNOBS: dict[str, tuple[str, str]] = {
               "POST /3/Shutdown?drain=true: how long to wait for running "
               "jobs to truncate and flush checkpoints before the listener "
               "closes anyway"),
+    "H2O3_TPU_SCORE_BATCH_WINDOW_MS": (
+        "2", "scoring tier micro-batch window: concurrent "
+             "/3/Predictions/rows requests for one model coalesce for up to "
+             "this many ms (or until H2O3_TPU_SCORE_BATCH_MAX rows) and "
+             "dispatch as ONE device call. 0 = per-request dispatch (the "
+             "unbatched control lane of the load-test A/B)"),
+    "H2O3_TPU_SCORE_BATCH_MAX": (
+        "4096", "scoring tier: max rows per batched dispatch — a full batch "
+                "dispatches immediately without waiting out the window"),
+    "H2O3_TPU_SCORE_DEADLINE_MS": (
+        "2000", "per-request deadline on /3/Predictions/rows: a request "
+                "that cannot be scored within this budget is shed with 504 "
+                "+ Retry-After instead of queueing unboundedly (a late "
+                "scoring answer is worthless). 0 = no deadline"),
+    "H2O3_TPU_SCORE_QUEUE_MAX": (
+        "32768", "scoring tier admission bound: max rows waiting in the "
+                 "coalescing queue; arrivals beyond it are shed with 429 + "
+                 "Retry-After. 0 = unbounded"),
+    "H2O3_TPU_PREDICTIONS_RETAIN": (
+        "64", "bounded retention of GENERATED /3/Predictions result frames: "
+              "the newest N generated prediction frames stay in the DKV, "
+              "older ones are removed (replicated delete) — serving load no "
+              "longer grows the DKV without bound. Frames named explicitly "
+              "via predictions_frame are never auto-evicted. 0 = keep all "
+              "(the pre-retention behavior)"),
 }
 
 

@@ -1,0 +1,97 @@
+"""High-throughput scoring tier — the serving-side successor of H2O's
+in-cluster ``BigScore`` + external Steam/REST scoring deployments
+[UNVERIFIED upstream analogs, SURVEY.md §2.3].
+
+Training got fused and sharded (PR 1/5/6); this package makes *predict* a
+device-speed problem too, the way the XGBoost-GPU design (arXiv:1806.11248)
+treats inference: tree ensembles only score at hardware speed when requests
+are batched into one dispatch. Three pieces:
+
+- :mod:`scorer` — a compiled, shape-bucketed batch scorer per model: the
+  whole forest replays as ONE jitted program (donated input buffer), with
+  batch row counts rounded up a power-of-two ladder so every batch size in a
+  bucket reuses one compiled program — and, through the persistent XLA
+  compilation cache (cluster/cloud.py), across *processes*: a rebuilt or
+  AutoML-winner model of the same shape bucket compiles zero new programs.
+- :mod:`batcher` — a micro-batch coalescing queue per model: concurrent
+  ``/3/Predictions/rows`` requests collect for up to
+  ``H2O3_TPU_SCORE_BATCH_WINDOW_MS`` (or ``H2O3_TPU_SCORE_BATCH_MAX`` rows)
+  and dispatch as one device call, results split back per request.
+  ``WINDOW_MS=0`` is the per-request control lane (the load-test A/B).
+- the REST surface (``POST /3/Predictions/rows`` in api/server.py): row
+  payloads scored directly — no DKV frame round-trip — behind the PR-4
+  admission gates with a per-route deadline (``H2O3_TPU_SCORE_DEADLINE_MS``).
+
+``tools/load_test.py`` is the measured proof: open-loop Poisson arrivals,
+offered-QPS sweep, artifact with p50/p99 + shed rate + batch-size histogram.
+
+Single-process only: the compiled scorer dispatches on local devices without
+the SPMD command broadcast, which on a multi-process training cloud would
+desync the ranks' collective order. The scoring tier scales OUT instead —
+independent single-process replicas behind a load balancer (the HPA'd
+``h2o3-tpu-score`` Deployment in deploy/k8s.yaml).
+"""
+
+from __future__ import annotations
+
+from h2o3_tpu.utils import metrics as _mx
+
+# -- serving metric families (docs/OBSERVABILITY.md has the runbook rows) ----
+REQUESTS = _mx.counter(
+    "serving_requests_total",
+    "row-scoring requests through the scoring tier, by mode "
+    "(batched/inline) and status (ok/shed/error)")
+ROWS = _mx.counter(
+    "serving_rows_total", "rows scored by the scoring tier")
+BATCHES = _mx.counter(
+    "serving_batches_total", "batched scoring dispatches")
+SHED = _mx.counter(
+    "serving_shed_total",
+    "scoring requests shed by the tier, by reason (deadline/queue_full)")
+QUEUE_DEPTH = _mx.gauge(
+    "serving_queue_depth", "rows waiting in the coalescing queue")
+BATCH_OCCUPANCY = _mx.histogram(
+    "serving_batch_occupancy",
+    "requests coalesced into one scoring dispatch (mean > 1 under load is "
+    "the tier doing its job)",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128))
+BATCH_ROWS = _mx.histogram(
+    "serving_batch_rows", "rows per scoring dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+DISPATCH_SECONDS = _mx.histogram(
+    "serving_dispatch_seconds",
+    "device dispatch wall time of the batch scorer, by lane (tree/generic)")
+SCORER_PROGRAMS = _mx.counter(
+    "serving_scorer_programs_total",
+    "batch-scorer program events, by event: 'compile' = a new "
+    "(bucket-shaped) program was built, 'hit' = an existing one was reused. "
+    "After warmup a healthy tier is ~all hits — the shape-bucket ladder "
+    "collapsing batch sizes and rebuilt same-bucket models onto one program")
+
+
+class ShedError(Exception):
+    """A scoring request the tier refused (queue full / deadline exceeded).
+    The REST route maps ``status`` + ``retry_after`` onto the PR-4
+    overload contract (429/503/504 + Retry-After)."""
+
+    def __init__(self, status: int, msg: str, retry_after: str = "1"):
+        super().__init__(msg)
+        self.status = status
+        self.retry_after = retry_after
+
+
+def scorer_for(model):
+    from h2o3_tpu.serving.scorer import scorer_for as _sf
+
+    return _sf(model)
+
+
+def score_rows(model, rows):
+    """Score a row payload (list of row dicts, or a column table) through the
+    coalescing batch scorer. Returns ``{"predict": ..., "<class>": ...}``
+    column arrays — the EasyPredict layout, vectorized."""
+    from h2o3_tpu.serving.batcher import batcher_for
+
+    sc = scorer_for(model)
+    cols, n = sc.prepare(rows)
+    return batcher_for(model).submit(cols, n)

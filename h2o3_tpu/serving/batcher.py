@@ -1,0 +1,222 @@
+"""Micro-batch coalescing queue — the request-side half of the scoring tier.
+
+Concurrent row-scoring requests for one model collect in a per-model queue;
+a dispatcher thread waits up to ``H2O3_TPU_SCORE_BATCH_WINDOW_MS`` from the
+first arrival (or until ``H2O3_TPU_SCORE_BATCH_MAX`` rows are waiting),
+concatenates the payloads, scores them as ONE device dispatch through the
+compiled :mod:`scorer`, and splits the results back per request. The window
+is the latency the tier spends buying throughput: at light load a request
+pays ~one window of queueing; at heavy load batches fill before the window
+expires and the queue adds nothing.
+
+Overload behavior follows the PR-4 admission contract: more than
+``H2O3_TPU_SCORE_QUEUE_MAX`` rows waiting sheds new arrivals immediately
+(429-shaped :class:`ShedError`), and a request that cannot be dispatched
+within its ``H2O3_TPU_SCORE_DEADLINE_MS`` budget is dropped from the batch
+and shed 504-shaped — a late answer to a scoring request is worthless, and
+scoring it anyway would steal capacity from requests that can still make
+their deadline.
+
+``WINDOW_MS=0`` bypasses the queue entirely — one dispatch per request, the
+measured control lane of the load-test A/B.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from h2o3_tpu.serving import (
+    BATCH_OCCUPANCY,
+    BATCH_ROWS,
+    BATCHES,
+    QUEUE_DEPTH,
+    REQUESTS,
+    ROWS,
+    SHED,
+    ShedError,
+)
+from h2o3_tpu.utils.log import Log
+
+_IDLE_EXIT_S = 30.0  # dispatcher threads die after this much idle time
+
+
+class _Pending:
+    __slots__ = ("cols", "n", "deadline", "t0", "event", "result", "error")
+
+    def __init__(self, cols, n, deadline):
+        self.cols = cols
+        self.n = n
+        self.deadline = deadline
+        self.t0 = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error: Exception | None = None
+
+
+def _knobs():
+    from h2o3_tpu import config
+
+    return (
+        config.get_float("H2O3_TPU_SCORE_BATCH_WINDOW_MS") / 1e3,
+        max(config.get_int("H2O3_TPU_SCORE_BATCH_MAX"), 1),
+        config.get_float("H2O3_TPU_SCORE_DEADLINE_MS") / 1e3,
+        config.get_int("H2O3_TPU_SCORE_QUEUE_MAX"),
+    )
+
+
+class ModelBatcher:
+    """One coalescing queue + dispatcher thread per model."""
+
+    def __init__(self, model, scorer):
+        self.model = model
+        self.scorer = scorer
+        self._cond = threading.Condition()
+        self._queue: list[_Pending] = []
+        self._rows_queued = 0
+        self._thread: threading.Thread | None = None
+
+    # -- request side -------------------------------------------------------
+    def submit(self, cols, n: int):
+        window, max_rows, deadline_s, qmax = _knobs()
+        deadline = (time.monotonic() + deadline_s) if deadline_s > 0 else None
+        if window <= 0 or max_rows <= 1:
+            # per-request control lane: no queue, one dispatch per request
+            try:
+                out = self.scorer.score_table(cols, n)
+            except Exception:
+                REQUESTS.inc(mode="inline", status="error")
+                raise
+            REQUESTS.inc(mode="inline", status="ok")
+            ROWS.inc(n)
+            return out
+        p = _Pending(cols, n, deadline)
+        with self._cond:
+            # an empty queue always admits (even a request larger than the
+            # bound — it dispatches alone); the bound sheds pile-up, not size
+            if qmax > 0 and self._rows_queued and self._rows_queued + n > qmax:
+                SHED.inc(reason="queue_full")
+                REQUESTS.inc(mode="batched", status="shed")
+                raise ShedError(
+                    429, f"scoring queue full ({self._rows_queued} rows "
+                         f">= H2O3_TPU_SCORE_QUEUE_MAX={qmax}); retry "
+                         "with backoff")
+            self._queue.append(p)
+            self._rows_queued += n
+            QUEUE_DEPTH.set(self._rows_queued)
+            self._ensure_thread()
+            self._cond.notify_all()
+        # +1s grace over the request deadline: the dispatcher sheds expired
+        # entries itself — this outer wait only bounds a wedged dispatcher
+        ok = p.event.wait((deadline - time.monotonic() + 1.0)
+                          if deadline else None)
+        if not ok and not p.event.is_set():
+            SHED.inc(reason="deadline")
+            REQUESTS.inc(mode="batched", status="shed")
+            raise ShedError(
+                504, "scoring request missed its deadline in the queue "
+                     "(H2O3_TPU_SCORE_DEADLINE_MS); the tier is saturated — "
+                     "retry with backoff")
+        if p.error is not None:
+            REQUESTS.inc(mode="batched", status=(
+                "shed" if isinstance(p.error, ShedError) else "error"))
+            raise p.error
+        REQUESTS.inc(mode="batched", status="ok")
+        ROWS.inc(n)
+        return p.result
+
+    # -- dispatcher side ----------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name=f"h2o3-score-{self.model.key}",
+                daemon=True)
+            self._thread.start()
+
+    def _take_batch(self) -> list[_Pending] | None:
+        """Block for work, honor the window, pop up to max_rows. Returns
+        None when idle long enough to retire the thread."""
+        window, max_rows, _, _ = _knobs()
+        with self._cond:
+            idle_t0 = time.monotonic()
+            while not self._queue:
+                if not self._cond.wait(timeout=1.0) and (
+                    time.monotonic() - idle_t0 > _IDLE_EXIT_S
+                ):
+                    self._thread = None
+                    return None
+            batch_deadline = self._queue[0].t0 + window
+            while (
+                self._rows_queued < max_rows
+                and (left := batch_deadline - time.monotonic()) > 0
+            ):
+                self._cond.wait(timeout=left)
+            take: list[_Pending] = []
+            rows = 0
+            while self._queue and (
+                not take or rows + self._queue[0].n <= max_rows
+            ):
+                p = self._queue.pop(0)
+                take.append(p)
+                rows += p.n
+            self._rows_queued -= rows
+            QUEUE_DEPTH.set(self._rows_queued)
+            return take
+
+    def _loop(self) -> None:
+        while True:
+            take = self._take_batch()
+            if take is None:
+                return
+            now = time.monotonic()
+            live: list[_Pending] = []
+            for p in take:
+                if p.deadline is not None and now > p.deadline:
+                    SHED.inc(reason="deadline")
+                    p.error = ShedError(
+                        504, "scoring request missed its deadline in the "
+                             "queue (H2O3_TPU_SCORE_DEADLINE_MS); the tier "
+                             "is saturated — retry with backoff")
+                    p.event.set()
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            try:
+                names = list(live[0].cols)
+                cat_cols = {
+                    name: np.concatenate([p.cols[name] for p in live])
+                    for name in names
+                }
+                total = sum(p.n for p in live)
+                out = self.scorer.score_table(cat_cols, total)
+                BATCHES.inc()
+                BATCH_OCCUPANCY.observe(len(live))
+                BATCH_ROWS.observe(total)
+                off = 0
+                for p in live:
+                    p.result = {k: v[off:off + p.n] for k, v in out.items()}
+                    off += p.n
+                    p.event.set()
+            except Exception as e:  # noqa: BLE001 — per-request surfacing
+                Log.err(f"batch scorer dispatch failed: {e!r}")
+                for p in live:
+                    if not p.event.is_set():
+                        p.error = e
+                        p.event.set()
+
+
+_BATCHERS: dict[str, ModelBatcher] = {}
+_BLOCK = threading.Lock()
+
+
+def batcher_for(model) -> ModelBatcher:
+    from h2o3_tpu.serving.scorer import scorer_for
+
+    with _BLOCK:
+        b = _BATCHERS.get(model.key)
+        if b is None or b.model is not model:  # rebuilt model under same key
+            b = _BATCHERS[model.key] = ModelBatcher(model, scorer_for(model))
+        return b

@@ -1,0 +1,384 @@
+"""Compiled, shape-bucketed batch scorer — one jitted program per model
+*bucket*, not per model or per batch size.
+
+Two lanes:
+
+- **tree** (GBM-family models): the forest is pre-stacked ONCE into device
+  tensors grouped exactly like ``SharedTreeModel._replay_all_dev`` (by class,
+  then by recorded depth, in insertion order — the grouping is load-bearing
+  for bit-exactness), and the whole replay + link transform compiles into a
+  single program. The stacked forest is a program *argument*, so two models
+  of the same shape bucket (same ntrees/depth/bins/cols ladder rungs — e.g.
+  an AutoML winner rebuilt on refreshed data) hit the same compiled program;
+  with the persistent XLA cache (cluster/cloud.py) that holds across
+  processes too. Batch row counts round up a power-of-two ladder
+  (:func:`bucket_batch_rows`) so every batch size in a bucket reuses one
+  program; padding rows carry only NA codes and their outputs are sliced
+  off — per-row elementwise replay makes the pad inert by construction
+  (same argument as the PR-1 shape buckets).
+- **generic** (every other algo, preprocessed/offset models): the batch
+  still coalesces into one ``model.predict`` pass over a temporary frame —
+  batched, just not single-program.
+
+Bit-exactness contract (pinned by tests/test_serving.py): the tree lane's
+probabilities are byte-equal to ``Model.predict`` through the frame path —
+same ``_partition_update`` ops in the same order, same link transform, and
+no cross-row reductions anywhere in scoring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import CAT, Frame, Vec
+from h2o3_tpu.serving import DISPATCH_SECONDS, SCORER_PROGRAMS
+
+# ---------------------------------------------------------------------------
+# payload adaptation (the adaptTestForTrain analog for row payloads)
+
+
+def _rows_to_table(rows) -> dict[str, list]:
+    """list-of-row-dicts | dict-of-columns -> {col: list}."""
+    if isinstance(rows, dict):
+        out = {str(k): (list(v) if isinstance(v, (list, tuple, np.ndarray))
+                        else [v])
+               for k, v in rows.items()}
+        ns = {len(v) for v in out.values()}
+        if len(ns) > 1:
+            raise ValueError(f"ragged column table: lengths {sorted(ns)}")
+        return out
+    if isinstance(rows, (list, tuple)):
+        if not rows:
+            raise ValueError("rows is empty")
+        if not all(isinstance(r, dict) for r in rows):
+            raise ValueError("rows must be dicts of {column: value}")
+        keys: list[str] = []
+        for r in rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(str(k))
+        return {k: [r.get(k) for r in rows] for k in keys}
+    raise ValueError(f"cannot score rows of type {type(rows).__name__}")
+
+
+def _coerce_numeric(vals) -> np.ndarray:
+    """Payload values -> f32 with NaN NAs (unparseable strings are NA, the
+    parse-time coercion contract)."""
+    out = np.full(len(vals), np.nan, np.float32)
+    for i, v in enumerate(vals):
+        if v is None or (isinstance(v, float) and v != v):
+            continue
+        if isinstance(v, bool):
+            out[i] = 1.0 if v else 0.0
+            continue
+        if isinstance(v, (int, float, np.integer, np.floating)):
+            out[i] = np.float32(v)
+            continue
+        try:
+            out[i] = np.float32(float(str(v)))
+        except (TypeError, ValueError):
+            pass  # NA
+    return out
+
+
+def _coerce_cat(vals, domain: tuple) -> np.ndarray:
+    """Payload values -> training-domain int32 codes; unseen/None -> -1
+    (NA), matching ``_adapt_codes``' unseen-level policy. Numeric payloads
+    against a string domain match on their canonical string form ("1" and
+    1.0 both hit a "1" level)."""
+    lut = {str(d): i for i, d in enumerate(domain or ())}
+    out = np.full(len(vals), -1, np.int32)
+    for i, v in enumerate(vals):
+        if v is None or (isinstance(v, float) and v != v):
+            continue
+        code = lut.get(v if isinstance(v, str) else str(v), -1)
+        if code < 0 and isinstance(v, (int, float, np.integer, np.floating)):
+            f = float(v)
+            if f.is_integer():
+                code = lut.get(str(int(f)), -1)
+        out[i] = code
+    return out
+
+
+def bucket_batch_rows(n: int, lo: int = 64) -> int:
+    """Batch-row bucket: next power of two (min ``lo`` = one full 8-shard
+    row block). Every batch size in a bucket reuses one compiled program —
+    the serving twin of the PR-1 row ladder."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# the compiled tree-lane program
+
+
+_PROG_CACHE: dict = {}
+_SHAPES_SEEN: set = set()
+_CACHE_LOCK = threading.Lock()
+
+
+def _tree_program(struct_key):
+    """One jitted callable per forest *structure* (distribution, class count,
+    per-class depth-group layout); jit's own cache handles the shape axes
+    (rows bucket, tree counts, node widths). ``bins`` is donated — it is
+    freshly built per batch and dead after the dispatch."""
+    prog = _PROG_CACHE.get(struct_key)
+    if prog is not None:
+        return prog
+    dist, K = struct_key[0], struct_key[1]
+    from h2o3_tpu.models.tree.distributions import response_transform
+    from h2o3_tpu.models.tree.shared_tree import _partition_update
+
+    def run(bins, groups, init_f):
+        outs = []
+        for gk in groups:  # per class, grouped by depth like _replay_all_dev
+            pk = jnp.zeros(bins.shape[0], jnp.float32)
+            for stacked in gk:
+
+                def body(p, recs):
+                    nid = jnp.zeros(bins.shape[0], jnp.int32)
+                    for rec in recs:  # unrolled over the recorded levels
+                        nid, p = _partition_update(
+                            bins, nid, p, rec["split_col"], rec["split_bin"],
+                            rec["is_cat"], rec["cat_mask"], rec["na_left"],
+                            rec["leaf_now"], rec["leaf_val"],
+                            rec["child_base"],
+                        )
+                    return p, None
+
+                pk, _ = jax.lax.scan(body, pk, stacked)
+            outs.append(pk)
+        raw = jnp.stack(outs, axis=1) if K > 1 else outs[0]
+        if dist == "multinomial":
+            return jax.nn.softmax(raw + init_f[None, :], axis=1)
+        f = raw + init_f
+        mu = response_transform(dist, f)
+        if dist == "bernoulli":
+            return jnp.stack([1 - mu, mu], axis=1)
+        return mu
+
+    prog = jax.jit(run, donate_argnums=(0,))
+    with _CACHE_LOCK:
+        _PROG_CACHE.setdefault(struct_key, prog)
+    return _PROG_CACHE[struct_key]
+
+
+def _group_shapes(groups) -> tuple:
+    return tuple(
+        tuple(
+            tuple(sorted((k, v.shape) for k, v in lvl.items()))
+            for lvl in stacked
+        )
+        for gk in groups for stacked in gk
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+class BatchScorer:
+    """Per-model scorer. ``prepare`` adapts a payload to canonical column
+    arrays (cheap host work, runs on the request thread); ``score_table``
+    runs one device pass over a whole coalesced batch."""
+
+    def __init__(self, model):
+        self.model = model
+        self.lane = "generic"
+        self._lock = threading.Lock()  # one dispatch at a time per model
+        out = model.output if isinstance(model.output, dict) else {}
+        from h2o3_tpu.models.tree.gbm import GBMModel
+
+        if (
+            isinstance(model, GBMModel)
+            and out.get("trees")
+            and out.get("bin_spec") is not None
+            and not model.preprocessors
+            and not getattr(model.params, "offset_column", None)
+        ):
+            self.lane = "tree"
+            self._spec = out["bin_spec"]
+            self._dist = out["distribution"]
+            self._K = out.get("n_tree_classes", 1)
+            self._stack_forest(out["trees"])
+            if self._dist == "multinomial":
+                self._init_f = jnp.asarray(
+                    np.asarray(out["init_f"], np.float32))
+            else:
+                self._init_f = jnp.asarray(np.float32(out["init_f"]))
+            self._struct = (
+                self._dist, self._K,
+                tuple(tuple(len(s) for s in gk) for gk in self._groups_key),
+                jax.default_backend(),
+            )
+
+    # -- forest stacking (once per model) -----------------------------------
+    def _stack_forest(self, trees) -> None:
+        """Stack per-(class, depth) groups in the SAME insertion order as
+        ``SharedTreeModel._replay_all_dev`` — the accumulation order is part
+        of the bit-exactness contract."""
+        from collections import defaultdict
+
+        from h2o3_tpu.models.tree.gbm import SharedTreeModel
+
+        fields = SharedTreeModel._REPLAY_FIELDS
+        groups = []
+        for k in range(self._K):
+            by_depth = defaultdict(list)
+            for group in trees:
+                t = group[k]
+                by_depth[len(t.levels)].append(t)
+            gk = []
+            for depth, ts in by_depth.items():
+                vals = jax.device_get(
+                    [
+                        [
+                            [getattr(t.levels[li], f) for f in fields]
+                            for li in range(depth)
+                        ]
+                        for t in ts
+                    ]
+                )
+                stacked = tuple(
+                    {
+                        f: jnp.asarray(
+                            np.stack([vals[ti][li][fi]
+                                      for ti in range(len(ts))])
+                        )
+                        for fi, f in enumerate(fields)
+                    }
+                    for li in range(depth)
+                )
+                gk.append(stacked)
+            groups.append(tuple(gk))
+        self._groups = tuple(groups)
+        self._groups_key = self._groups
+
+    # -- payload -> canonical columns ---------------------------------------
+    def prepare(self, rows) -> tuple[dict[str, np.ndarray], int]:
+        table = _rows_to_table(rows)
+        ns = {len(v) for v in table.values()}
+        if not ns or max(ns) == 0:
+            raise ValueError("rows is empty")
+        n = ns.pop()
+        if self.lane == "tree":
+            spec = self._spec
+            cols = {}
+            for ci, name in enumerate(spec.names):
+                vals = table.get(name)
+                if vals is None:
+                    vals = [None] * n  # absent column scores as all-NA
+                if spec.is_cat[ci]:
+                    dom = (spec.domains[ci] if spec.domains else None) or ()
+                    cols[name] = _coerce_cat(vals, tuple(dom))
+                else:
+                    cols[name] = _coerce_numeric(vals)
+            return cols, n
+        # generic lane: raw object columns; the model's own frame-adaptation
+        # path (from_pandas kinds + per-algo adapt) does the rest
+        return {k: np.asarray(v, dtype=object) for k, v in table.items()}, n
+
+    # -- scoring ------------------------------------------------------------
+    def score_table(self, cols: dict[str, np.ndarray], n: int) -> dict:
+        t0 = time.perf_counter()
+        with self._lock:
+            out = (self._score_tree(cols, n) if self.lane == "tree"
+                   else self._score_generic(cols, n))
+        DISPATCH_SECONDS.observe(time.perf_counter() - t0, lane=self.lane)
+        return out
+
+    def _score_tree(self, cols, n: int) -> dict:
+        from h2o3_tpu.models.tree.binning import bin_frame
+
+        spec = self._spec
+        b = bucket_batch_rows(n)
+        vecs, names = [], []
+        for ci, name in enumerate(spec.names):
+            arr = cols[name]
+            if spec.is_cat[ci]:
+                pad = np.full(b, -1, np.int32)
+                pad[:n] = arr
+                dom = (spec.domains[ci] if spec.domains else None) or ()
+                vecs.append(Vec.from_numpy(pad, CAT, name=name,
+                                           domain=tuple(dom)))
+            else:
+                pad = np.full(b, np.nan, np.float32)
+                pad[:n] = arr
+                vecs.append(Vec.from_numpy(pad, "real", name=name))
+            names.append(name)
+        fr = Frame(vecs, names)  # unregistered temporary
+        bins = bin_frame(spec, fr)
+        shape_key = (self._struct, bins.shape,
+                     _group_shapes(self._groups_key))
+        with _CACHE_LOCK:
+            seen = shape_key in _SHAPES_SEEN
+            _SHAPES_SEEN.add(shape_key)
+        SCORER_PROGRAMS.inc(event="hit" if seen else "compile")
+        prog = _tree_program(self._struct)
+        raw = np.asarray(jax.device_get(prog(bins, self._groups,
+                                             self._init_f)))[:n]
+        return self._format_tree(raw, n)
+
+    def _format_tree(self, raw: np.ndarray, n: int) -> dict:
+        """Label + probability columns from raw predictions — the same host
+        math as ``Model.predict`` (threshold, calibration), so the two
+        surfaces cannot disagree."""
+        m = self.model
+        if not m.is_classifier:
+            return {"predict": raw.astype(np.float32, copy=False)}
+        domain = m.output["response_domain"]
+        probs = raw if raw.ndim > 1 else np.stack([1 - raw, raw], axis=1)
+        if m.nclasses == 2:
+            thr = 0.5
+            if m.training_metrics is not None:
+                thr = m.training_metrics._v.get("default_threshold", 0.5)
+            idx = (probs[:, 1] >= thr).astype(np.int32)
+        else:
+            idx = probs.argmax(axis=1).astype(np.int32)
+        out = {"predict": np.asarray(domain, dtype=object)[idx]}
+        for k, d in enumerate(domain):
+            out[str(d)] = probs[:, k]
+        cal = m.output.get("calibration")
+        if cal is not None and probs.shape[1] == 2:
+            from h2o3_tpu.models.calibration import apply_calibration
+
+            cp1 = apply_calibration(cal, probs[:, 1])
+            out["cal_p0"] = 1.0 - cp1
+            out["cal_p1"] = cp1
+        return out
+
+    def _score_generic(self, cols, n: int) -> dict:
+        import pandas as pd
+
+        df = pd.DataFrame({k: v for k, v in cols.items()})
+        fr = Frame.from_pandas(df)
+        pf = self.model.predict(fr)
+        out = {}
+        for name in pf.names:
+            v = pf.vec(name)
+            if v.is_categorical():
+                codes = v.to_numpy()
+                dom = np.asarray(v.domain, dtype=object)
+                col = np.full(len(codes), None, dtype=object)
+                ok = codes >= 0
+                col[ok] = dom[codes[ok]]
+                out[name] = col[:n]
+            else:
+                out[name] = v.to_numpy()[:n]
+        return out
+
+
+def scorer_for(model) -> BatchScorer:
+    """The per-model scorer, cached on the model object (models are
+    immutable after build; the cache dies with the model)."""
+    sc = model.__dict__.get("_h2o3_batch_scorer")
+    if sc is None:
+        sc = BatchScorer(model)
+        model.__dict__["_h2o3_batch_scorer"] = sc
+    return sc

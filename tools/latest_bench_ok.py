@@ -43,11 +43,70 @@ def _stamp_age_s(path: str, now: float) -> float | None:
     return now - t.timestamp()
 
 
+def _loadtest_ok(here: str, now: float):
+    """Sanity-check the newest recent LOADTEST_*.json (tools/load_test.py,
+    the serving-tier A/B). Returns None when no recent artifact exists (no
+    opinion), else True/False. Checks: non-empty steps each carrying a p99,
+    non-zero achieved throughput somewhere, and shed rate <= 1% on every
+    step offered at or below half the mode's sustained capacity — a tier
+    shedding sub-capacity traffic is broken, not overloaded."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "LOADTEST_*.json")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            d = json.loads(f.readline())
+        steps = d.get("steps") or []
+        summary = d.get("summary") or {}
+        if not steps:
+            print(f"{name}: NO steps")
+            return False
+        if not all(s.get("p99_ms") is not None or s.get("ok", 0) == 0
+                   for s in steps):
+            print(f"{name}: step missing p99")
+            return False
+        if not any(float(s.get("achieved_qps") or 0) > 0 for s in steps):
+            print(f"{name}: zero throughput everywhere")
+            return False
+        for s in steps:
+            cap = summary.get(f"{s.get('mode')}_sustained_qps") or 0
+            if cap and s["offered_qps"] <= 0.5 * cap and s["shed_rate"] > 0.01:
+                print(f"{name}: shed at sub-capacity load "
+                      f"({s['mode']} offered={s['offered_qps']} "
+                      f"shed_rate={s['shed_rate']})")
+                return False
+        parity = summary.get("parity_byte_equal")
+        if parity is False:
+            print(f"{name}: batched/control predictions DIVERGED")
+            return False
+        print(f"{name}: steps=ok p99=ok throughput=ok"
+              f" speedup={summary.get('speedup')}"
+              f" parity={'ok' if parity else 'n/a'}")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def main() -> int:
     import time
 
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     now = time.time()
+    # serving-tier artifact gate: when a recent load-test artifact exists it
+    # must be sane, or the window's serving A/B numbers are untrustworthy
+    lt = _loadtest_ok(here, now)
+    if lt is False:
+        return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full
     # run, so "the newest file" is usually a control and judging only it

@@ -20,6 +20,11 @@
 #      HBM traffic on the CPU proxy); the control run measures the unfused
 #      path, headline only, plus the dedicated sweep A/B with HBM tallies.
 #      The tile sweep (step 3) now varies tiles via H2O3_TPU_PALLAS_TILES.
+#   6. serving load A/B (ISSUE 7): open-loop Poisson sweep against the
+#      batched /3/Predictions/rows route vs the per-request control
+#      (H2O3_TPU_SCORE_BATCH_WINDOW_MS=0); artifact carries p50/p99, shed
+#      rate, batch-occupancy histogram and the byte-parity probe.
+#      tools/latest_bench_ok.py gates on the artifact's sanity.
 set -x
 cd "$(dirname "$0")/.."
 
@@ -72,3 +77,10 @@ save "SPLIT_AB_${stamp}.jsonl" "Split-pipeline sharded-vs-replicated A/B (1M row
 timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
 save "KERNEL_SWEEP_${stamp}.jsonl" "Pallas histogram kernel tile sweep"
+
+# serving load A/B (ISSUE 7): batched coalescing tier vs per-request control
+# on the real accelerator. The harness spawns one server subprocess per mode
+# and writes its own stamped artifact; stdout is the artifact JSON line.
+timeout 1800 python tools/load_test.py --mode both --duration 8 \
+  --out "LOADTEST_${stamp}.json" | tail -1 > /dev/null
+save "LOADTEST_${stamp}.json" "Serving load A/B: batched rows route vs per-request control"
