@@ -532,13 +532,23 @@ def _bench_dl(n: int = max(int(100_000 * _SCALE), 5_000), d: int = 784, k: int =
     fr = _make_data_device(n, c=d, seed=5, labeler=labeler, col_prefix="p")
     m0 = m = None
     try:
+        from h2o3_tpu.utils import metrics as _mx
+
         kw = dict(hidden=(128, 128), epochs=1.0, mini_batch_size=256, seed=3)
         m0 = DeepLearning(**kw).train(y="label", training_frame=fr)  # compile
+        d0 = _mx.counter_value("dl_dispatches_total")
+        e0 = _mx.counter_value("dl_epochs_total")
         t0 = time.time()
         m = DeepLearning(**kw).train(y="label", training_frame=fr)
         dt = time.time() - t0
+        epochs = int(_mx.counter_value("dl_epochs_total") - e0) or 1
         return {"rows": n, "cols": d, "epochs": 1,
-                "rows_per_sec": round(n / dt, 0), "seconds": round(dt, 3)}
+                "rows_per_sec": round(n / dt, 0), "seconds": round(dt, 3),
+                # per-round tracked summary (ISSUE 8): wall seconds per
+                # epoch plus the chunked-driver dispatch count
+                "dl_epoch_s": round(dt / epochs, 3),
+                "dispatches_per_model": int(
+                    _mx.counter_value("dl_dispatches_total") - d0)}
     finally:
         _drop_models(m0, m)
         DKV.remove(fr.key)
@@ -580,6 +590,10 @@ def _bench_automl(fr_small) -> dict:
     out = {"max_models": 3,
            "cold_s": round(cold_s, 3),
            "warm_s": round(warm_s, 3),
+           # per-round tracked summary (ISSUE 8): total AutoML wall time
+           # across the cold+warm passes — the end-to-end number the fused
+           # GLM/DL lanes must not regress
+           "automl_total_s": round(cold_s + warm_s, 3),
            "compile_share_est": round(max(cold_s - warm_s, 0.0) / cold_s, 3)
            if cold_s > 0 else None,
            "persistent_cache_entries_before": cache_entries,
@@ -625,20 +639,35 @@ def _compile_cache_entries() -> int | None:
 
 def _bench_glm_1m(fr) -> dict:
     """GLM binomial IRLS on the bench frame (BASELINE config #1 analog):
-    Gram + solve per iteration, the hex.glm hot loop."""
+    Gram + solve per iteration, the hex.glm hot loop. Reports the fused-
+    lane contract numbers (ISSUE 8): measured iterations/sec and host
+    dispatches per model from the registry counters — O(iterations/K)
+    fused vs O(iterations) unfused."""
     from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.utils import metrics as _mx
 
     kw = dict(family="binomial", lambda_=1e-4, max_iterations=20, seed=1)
     GLM(**kw).train(y="label", training_frame=fr)  # compile
+    i0 = _mx.counter_value("glm_irls_iterations_total")
+    d0 = _mx.counter_value("glm_dispatches_total")
+    g0 = sum(_mx.counter_value("tree_collective_bytes_total", phase=ph)
+             for ph in ("gram_reduce", "gram_gather"))
     t0 = time.time()
     m = GLM(**kw).train(y="label", training_frame=fr)
     dt = time.time() - t0
-    iters = len(m.scoring_history) or kw["max_iterations"]
+    iters = int(_mx.counter_value("glm_irls_iterations_total") - i0) or kw[
+        "max_iterations"]
     return {
         "rows": N_ROWS,
         "seconds": round(dt, 3),
         "auc": round(float(m.training_metrics.auc), 4),
         "iterations": iters,
+        "glm_iters_per_s": round(iters / max(dt, 1e-9), 3),
+        "dispatches_per_model": int(
+            _mx.counter_value("glm_dispatches_total") - d0),
+        "gram_collective_bytes": round(sum(
+            _mx.counter_value("tree_collective_bytes_total", phase=ph)
+            for ph in ("gram_reduce", "gram_gather")) - g0, 1),
     }
 
 
@@ -1116,6 +1145,16 @@ def main() -> None:
         else:
             out.pop("traceback", None)
             payload[phase] = out
+    # tracked per-round summary (ISSUE 8 / ROADMAP item 5): lift the
+    # GLM/DL/AutoML phase numbers to headline keys so the round-over-round
+    # artifact diff shows the whole-program gains at a glance
+    # (tools/latest_bench_ok.py sanity-checks them when present)
+    for phase, k in (("glm_1m", "glm_iters_per_s"),
+                     ("dl_100k", "dl_epoch_s"),
+                     ("automl_50k", "automl_total_s")):
+        ph = payload.get(phase)
+        if isinstance(ph, dict) and ph.get(k) is not None:
+            payload[k] = ph[k]
     _emit(payload)
 
 

@@ -64,6 +64,44 @@ _KNOBS: dict[str, tuple[str, str]] = {
              "per-block winners merges bit-exactly against jnp.argmax's "
              "lowest-index tie-breaking. 0 = replicated histogram + "
              "replicated split scan (the pre-sharding path)"),
+    "H2O3_TPU_GLM_FUSE": (
+        "auto", "whole-program GLM IRLS (the PR-1 tree pattern ported to "
+                "hex.glm): the IRLS loop runs as a compiled lax.while_loop "
+                "executing up to K iterations per host dispatch, the Gram "
+                "pass ends in a psum_scatter of contiguous G row blocks over "
+                "the rows mesh axis (gathered once for the solve), and the "
+                "Cholesky-with-jitter / ADMM solve moves on-device "
+                "(float32); the host float64 lstsq lane remains as the "
+                "singular-tail fallback. 'auto' = on with K=8; an integer "
+                "N>=1 forces chunk size N; '0' restores the per-iteration "
+                "host-solve path bit-for-bit. With export_checkpoints_dir "
+                "set the chunk is clamped to 1 so PR-2 irls_state snapshots "
+                "land at every iteration boundary. Fallback matrix "
+                "(docs/MIGRATION.md): compute_p_values, multinomial "
+                "cycling, ordinal and L_BFGS solves stay on their existing "
+                "paths"),
+    "H2O3_TPU_DL_EPOCH_CHUNK": (
+        "auto", "DeepLearning epoch fusion: fold this many epochs into ONE "
+                "compiled program per dispatch with donated (params, "
+                "opt_state) buffers; the shuffle permutations are "
+                "precomputed host-side and the dropout RNG threads through "
+                "the carry, so epoch trajectories are bit-identical to the "
+                "per-epoch path. 'auto' = 8; '1' = one dispatch per epoch "
+                "(the pre-fusion cadence). Clamped to 1 when "
+                "export_checkpoints_dir, early stopping (stopping_rounds>0) "
+                "or fault injection is active so per-epoch snapshots/stops "
+                "keep their positions"),
+    "H2O3_TPU_DL_GRAD_SHARD": (
+        "auto", "DeepLearning minibatch gradient reduction sharded over the "
+                "mesh: each device grads its local batch rows, the flat "
+                "gradient is psum_scatter'd (1/P per device), the optimizer "
+                "updates only its parameter shard and the updated params "
+                "all_gather for the next step (ZeRO-style; replaces the "
+                "replicated allreduce+update). 'auto' = on for >1-device "
+                "meshes when eligible (no dropout, elementwise optimizer "
+                "state, mini_batch_size divisible by the shard count); "
+                "'0' = always replicated; '1' = on when eligible. "
+                "Ineligible configs always use the replicated reduce"),
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),

@@ -43,6 +43,106 @@ from h2o3_tpu.utils.log import Log
 _DL_EPOCHS = _mx.counter("dl_epochs_total", "DeepLearning epochs executed")
 _DL_EPOCH_SECONDS = _mx.histogram(
     "dl_epoch_seconds", "per-epoch wall time of the sync-SGD driver")
+# host dispatches issued by the epoch driver (the epoch-chunk acceptance
+# metric: O(epochs) per-epoch vs O(epochs/K) chunked) and program-cache
+# traffic for the chunk programs — BUILD_STATS-style contract counters
+_DL_DISPATCHES = _mx.counter(
+    "dl_dispatches_total",
+    "device-program launches issued by the DeepLearning epoch driver",
+    always=True)
+_DL_COMPILED = _mx.counter(
+    "dl_programs_compiled_total",
+    "DeepLearning epoch-chunk program cache misses", always=True)
+_DL_HITS = _mx.counter(
+    "dl_program_cache_hits_total",
+    "DeepLearning epoch-chunk program cache hits (same shape bucket, no "
+    "recompile)", always=True)
+# the PR-5 collective byte family grows DL phases (dl_grad_reduce = the
+# per-minibatch gradient psum_scatter — or the replicated allreduce volume
+# on the unsharded lane — dl_param_gather = the all_gather of updated
+# parameter shards); replication-volume model, tallied per dispatch
+_COLL_BYTES = _mx.counter(
+    "tree_collective_bytes_total",
+    "per-device collective payload bytes moved by tree builds (replication-"
+    "volume model), by phase", always=True)
+
+# epoch-chunk program cache: (shape bucket, net/optimizer descriptor,
+# lanes, mesh, backend) -> compiled chunk
+_DL_PROGRAMS: dict = {}
+
+
+def _dl_epoch_chunk(p) -> int:
+    """Epochs folded into one compiled dispatch (H2O3_TPU_DL_EPOCH_CHUNK).
+
+    Clamped to 1 whenever per-epoch boundaries are load-bearing: interval
+    checkpoints (export_checkpoints_dir — PR-2 snapshots must land at every
+    epoch), epoch-loss early stopping (stopping_rounds), or armed fault
+    injection (the chaos suite aborts at exact epoch counts)."""
+    from h2o3_tpu import config
+
+    raw = config.get("H2O3_TPU_DL_EPOCH_CHUNK").strip().lower()
+    k = int(raw) if raw.isdigit() else 8
+    if (getattr(p, "export_checkpoints_dir", None)
+            or (p.stopping_rounds or 0) > 0 or faults.armed()):
+        return 1
+    return max(k, 1)
+
+
+def _flat_state_ok(opt_state, params) -> bool:
+    """True iff every optimizer-state field is parameter-shaped (one array
+    per param leaf, elementwise semantics) — the eligibility gate for the
+    sharded-gradient lane, which runs the optimizer on 1/P slices of the
+    FLATTENED parameter vector. Adadelta qualifies; a schedule's scalar
+    step counter does not (its update is not elementwise in the flat
+    view)."""
+    pl = jax.tree.leaves(params)
+    sl = jax.tree.leaves(opt_state)
+    n = len(pl)
+    if n == 0 or len(sl) % n != 0:
+        return False
+    return all(s.shape == pl[i % n].shape for i, s in enumerate(sl))
+
+
+def _dl_grad_shard(p, dropout, input_dropout, batch: int, opt_ok: bool) -> bool:
+    """Sharded minibatch gradient reduction (H2O3_TPU_DL_GRAD_SHARD):
+    psum_scatter the flat gradient, update only the local parameter shard,
+    all_gather the updated params — instead of the replicated
+    allreduce+full-update. Eligible when the mesh has >1 device, the batch
+    splits evenly over it, no dropout is active (per-shard RNG would
+    decorrelate masks) and the optimizer state is elementwise."""
+    from h2o3_tpu import config
+    from h2o3_tpu.parallel.mesh import n_shards
+
+    raw = config.get("H2O3_TPU_DL_GRAD_SHARD").strip().lower()
+    if raw == "0":
+        return False
+    n_sh = n_shards()
+    return (n_sh > 1 and batch % n_sh == 0 and opt_ok
+            and float(input_dropout) == 0.0
+            and all(float(d) == 0.0 for d in dropout))
+
+
+def _state_to_flat(opt_state, params, tx, fpad: int):
+    """Standard (params-structured) optimizer state -> the state of the
+    same optimizer over the zero-padded FLAT parameter vector. Field order
+    follows ``jax.tree.leaves``; padded tail entries are zero and stay zero
+    (zero gradients under an elementwise transform). Inverse of
+    :func:`_state_from_flat`; only called when :func:`_flat_state_ok`."""
+    pl = jax.tree.leaves(params)
+    n = len(pl)
+    sl = jax.tree.leaves(opt_state)
+    fields = []
+    for i in range(0, len(sl), n):
+        flat = jnp.concatenate([jnp.ravel(a) for a in sl[i:i + n]])
+        fields.append(jnp.pad(flat, (0, fpad - flat.size)))
+    ref = jax.tree.structure(tx.init(jnp.zeros(fpad, jnp.float32)))
+    return jax.tree.unflatten(ref, fields)
+
+
+def _state_from_flat(flat_state, unravel, n_real: int):
+    """Flat optimizer state back to the standard params-structured form
+    (what checkpoints serialize and the unsharded lane consumes)."""
+    return jax.tree.map(lambda leaf: unravel(leaf[:n_real]), flat_state)
 
 
 @dataclass
@@ -97,41 +197,189 @@ class _MLP(nn.Module):
 
 
 
-def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
+def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
+                      n_chunk: int, shard_on: bool, unravel=None,
+                      n_real: int = 0, fpad: int = 0):
+    """Build (or fetch) the compiled K-epochs-per-dispatch training chunk.
+
+    One program runs ``n_chunk`` whole epochs: an outer fori over the
+    host-precomputed shuffle permutations (stacked ``(K, npad)`` — the
+    permutation RNG stays host-side so trajectories are bit-identical to
+    the per-epoch path), an inner fori over minibatches with a DYNAMIC trip
+    count (row-count variation inside a shape bucket never recompiles), the
+    dropout RNG threading through the carry exactly as the per-epoch path
+    split it. ``params``/``opt_state`` are donated — chunk d+1 reuses chunk
+    d's buffers with no copies.
+
+    On the sharded lane (``shard_on``) params/opt_state are flat
+    ``(fpad,)`` vectors: each device grads its local batch rows, the flat
+    gradient ends in a ``psum_scatter`` (each device keeps 1/P), the
+    elementwise optimizer updates only that shard, and one ``all_gather``
+    republishes the updated parameters for the next forward.
+    """
+    import jax.tree_util as jtu
+
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, mesh_key, shard_map
+    from jax.sharding import PartitionSpec as Spec
+
+    key = ("dl_chunk", desc, batch, npad, n_chunk, bool(shard_on),
+           mesh_key(), jax.default_backend())
+    fn = _DL_PROGRAMS.get(key)
+    if fn is not None:
+        _DL_HITS.inc()
+        return fn
+    _DL_COMPILED.inc()
+
+    def row_loss(prm, xb, yb, kb):
+        out = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
+        if kind == "ce":
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, yb.astype(jnp.int32)
+            )
+        if kind == "mse":
+            return (out[:, 0] - yb) ** 2
+        return jnp.mean((out - xb) ** 2, axis=1)  # recon: the input IS the target
+
+    def penalties(prm, l1, l2):
+        # written unconditionally with dynamic scalars: +0.0 when a knob is
+        # zero, which leaves loss AND gradient bits identical to the old
+        # `if l2:` closures while letting one program serve every (l1, l2)
+        pen = l2 * 0.5 * sum(jnp.sum(q**2) for q in jax.tree.leaves(prm))
+        return pen + l1 * sum(jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm))
+
+    def loss_fn(prm, xb, yb, wb, kb, l1, l2):
+        ll = row_loss(prm, xb, yb, kb)
+        loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
+        return loss + penalties(prm, l1, l2)
+
+    if shard_on:
+        mesh = get_mesh()
+        n_sh = mesh.shape[ROWS_AXIS]
+        fb = fpad // n_sh
+
+        def shard_step(prm_flat, ost, xb, yb, wb, bk, l1, l2):
+            def local(prm_flat, ost_l, xb_l, yb_l, wb_l, bk, l1, l2):
+                def wsum_loss(pf):
+                    prm = unravel(pf[:n_real])
+                    return jnp.sum(wb_l * row_loss(prm, xb_l, yb_l, bk))
+
+                lsum, g = jax.value_and_grad(wsum_loss)(prm_flat)
+                gs = jax.lax.psum_scatter(
+                    g, ROWS_AXIS, scatter_dimension=0, tiled=True)
+                wsum = jax.lax.psum(jnp.sum(wb_l), ROWS_AXIS)
+                d = jax.lax.axis_index(ROWS_AXIS)
+                my = jax.lax.dynamic_slice(prm_flat, (d * fb,), (fb,))
+                gshard = (gs / jnp.maximum(wsum, 1e-9)
+                          + l2 * my + l1 * jnp.sign(my))
+                upd, ost_l = tx.update(gshard, ost_l, my)
+                my = optax.apply_updates(my, upd)
+                prm_new = jax.lax.all_gather(
+                    my, ROWS_AXIS, axis=0, tiled=True)
+                loss = (jax.lax.psum(lsum, ROWS_AXIS)
+                        / jnp.maximum(wsum, 1e-9)
+                        + penalties(prm_flat[:n_real], l1, l2))
+                return loss, prm_new, ost_l
+
+            ost_spec = jtu.tree_map(lambda _: Spec(ROWS_AXIS), ost)
+            return shard_map(
+                local, mesh,
+                in_specs=(Spec(), ost_spec, Spec(ROWS_AXIS, None),
+                          Spec(ROWS_AXIS), Spec(ROWS_AXIS), Spec(), Spec(),
+                          Spec()),
+                out_specs=(Spec(), Spec(), ost_spec),
+                check_vma=False,
+            )(prm_flat, ost, xb, yb, wb, bk, l1, l2)
+
+    def chunk(params, opt_state, X, y, w, perms, key, nbatch, l1, l2,
+              slot_mask):
+        D = X.shape[1]
+
+        def epoch_body(e, c):
+            prm, ost, key, losses = c
+            perm = perms[e]
+            Xp, yp, wp = X[perm], y[perm], w[perm] * slot_mask
+            key, dkey = jax.random.split(key)
+
+            def step(i, sc):
+                prm, ost, k, loss_sum = sc
+                k, bk = jax.random.split(k)
+                start = i * batch
+                xb = jax.lax.dynamic_slice(Xp, (start, 0), (batch, D))
+                yb = jax.lax.dynamic_slice(yp, (start,), (batch,))
+                wb = jax.lax.dynamic_slice(wp, (start,), (batch,))
+                if shard_on:
+                    loss, prm, ost = shard_step(
+                        prm, ost, xb, yb, wb, bk, l1, l2)
+                else:
+                    loss, g = jax.value_and_grad(loss_fn)(
+                        prm, xb, yb, wb, bk, l1, l2)
+                    upd, ost = tx.update(g, ost, prm)
+                    prm = optax.apply_updates(prm, upd)
+                return (prm, ost, k, loss_sum + loss)
+
+            prm, ost, _, loss_sum = jax.lax.fori_loop(
+                0, nbatch, step, (prm, ost, dkey, jnp.float32(0.0)))
+            losses = losses.at[e].set(loss_sum / nbatch)
+            return (prm, ost, key, losses)
+
+        params, opt_state, key, losses = jax.lax.fori_loop(
+            0, n_chunk, epoch_body,
+            (params, opt_state, key, jnp.zeros(n_chunk, jnp.float32)))
+        return params, opt_state, key, losses
+
+    fn = jax.jit(chunk, donate_argnums=(0, 1))
+    _DL_PROGRAMS[key] = fn
+    return fn
+
+
+def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
                   nrow: int, npad: int, key, start_epochs: int = 0,
                   on_epoch=None):
     """The shared sync-SGD epoch driver for both supervised and autoencoder
-    training: permutation shuffling, lax.scan over mini-batches, epoch-loss
-    early stopping, checkpoint RNG alignment. ``loss_fn(prm, xb, yb, wb,
-    kb)`` supplies the per-batch objective (yb is the permuted target slice
-    — unused by the autoencoder loss). ``on_epoch(params, opt_state,
-    epochs_done, history)`` fires at every epoch boundary — the interval-
-    checkpoint/fault hook. Returns (params, opt_state, history,
+    training: permutation shuffling, epoch-chunk compiled loops
+    (H2O3_TPU_DL_EPOCH_CHUNK) with donated (params, opt_state) buffers,
+    epoch-loss early stopping, checkpoint RNG alignment. ``kind`` selects
+    the per-row objective ('ce' | 'mse' | 'recon'). ``on_epoch(params,
+    opt_state, epochs_done, history)`` fires at every chunk boundary — with
+    checkpoints/faults/early-stopping active the chunk clamps to one epoch,
+    so that IS every epoch boundary. Returns (params, opt_state, history,
     epochs_done)."""
+    import time as _time
+
+    from h2o3_tpu.parallel.mesh import n_shards, pad_flat_to_shards
+
     batch = min(int(p.mini_batch_size), npad)
     nbatch = max(1, nrow // batch)
     # padded permutation slots alias row 0 — a SLOT mask zeroes their weight
     # so a final partial batch cannot over-count real rows (nrow < batch)
     slot_mask = jnp.asarray((np.arange(npad) < nrow).astype(np.float32))
+    l1, l2 = jnp.float32(p.l1), jnp.float32(p.l2)
 
-    @jax.jit
-    def epoch(params, opt_state, Xp, yp, wp, dkey):
-        def step(carry, i):
-            prm, ost, k = carry
-            k, bk = jax.random.split(k)
-            start = i * batch
-            xb = jax.lax.dynamic_slice(Xp, (start, 0), (batch, Xp.shape[1]))
-            yb = jax.lax.dynamic_slice(yp, (start,), (batch,))
-            wb = jax.lax.dynamic_slice(wp, (start,), (batch,))
-            loss, g = jax.value_and_grad(loss_fn)(prm, xb, yb, wb, bk)
-            upd, ost = tx.update(g, ost, prm)
-            prm = optax.apply_updates(prm, upd)
-            return (prm, ost, k), loss
+    chunk_k = _dl_epoch_chunk(p)
+    dropout = _resolved_dropout(p, len(p.hidden))
+    shard_on = _dl_grad_shard(
+        p, dropout, p.input_dropout_ratio, batch, _flat_state_ok(opt_state, params)
+    )
+    n_sh = n_shards()
+    # the FULL network + optimizer identity: n_out matters even at equal
+    # hidden/width (a cached program's closed-over mlp bakes the output
+    # head), and every optimizer hyper is baked into tx's update closure
+    desc = (tuple(int(h) for h in mlp.hidden), mlp.activation.lower(),
+            tuple(mlp.dropout), float(mlp.input_dropout), int(mlp.n_out),
+            kind, X.shape[1],
+            bool(p.adaptive_rate), float(p.rho), float(p.epsilon),
+            float(p.rate), float(p.rate_decay), float(p.momentum_start or 0))
 
-        (params, opt_state, _), losses = jax.lax.scan(
-            step, (params, opt_state, dkey), jnp.arange(nbatch)
-        )
-        return params, opt_state, losses.mean()
+    unravel = None
+    n_real = fpad = 0
+    if shard_on:
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(params)
+        n_real = int(flat.size)
+        fpad = pad_flat_to_shards(n_real)
+        params = jnp.pad(flat, (0, fpad - n_real))
+        opt_state = _state_to_flat(opt_state, unravel(flat), tx, fpad)
 
     # epoch-level stopping tracks the (always smaller-is-better) training
     # loss; the resolved stopping_metric drives final scoring only
@@ -144,30 +392,62 @@ def _run_sync_sgd(job, p, loss_fn, tx, params, opt_state, X, y, w,
         rng.permutation(nrow)  # stream aligned with an
         key, _ = jax.random.split(key)  # uninterrupted run
     epochs_done = start_epochs
-    import time as _time
 
-    for e in range(start_epochs, n_epochs):
+    # modeled per-batch collective volume (replication-volume model):
+    # sharded = the 1/P gradient scatter + the full param gather; unsharded
+    # = the full replicated gradient reduce. Zero on a 1-device mesh.
+    coll = {}
+    if n_sh > 1:
+        n_param = n_real if shard_on else sum(
+            int(np.prod(q.shape)) for q in jax.tree.leaves(params))
+        if shard_on:
+            coll = {"dl_grad_reduce": (fpad / n_sh + 1) * 4.0,
+                    "dl_param_gather": fpad * 4.0}
+        else:
+            coll = {"dl_grad_reduce": n_param * 4.0}
+
+    e = start_epochs
+    stopped = False
+    while e < n_epochs and not stopped:
+        k_i = min(chunk_k, n_epochs - e)
         _ep_t0 = _time.perf_counter()
-        perm = np.zeros(npad, np.int64)
-        perm[:nrow] = rng.permutation(nrow)
-        perm_j = jnp.asarray(perm)
-        key, dkey = jax.random.split(key)
-        params, opt_state, mean_loss = epoch(
-            params, opt_state, X[perm_j], y[perm_j], w[perm_j] * slot_mask, dkey
+        perms = np.zeros((k_i, npad), np.int64)
+        for j in range(k_i):
+            perms[j, :nrow] = rng.permutation(nrow)
+        prog = _dl_chunk_program(
+            desc, mlp, tx, kind, batch, npad, k_i, shard_on,
+            unravel=unravel, n_real=n_real, fpad=fpad,
         )
-        epochs_done = e + 1
-        # the float() below syncs on the epoch's device work, so the
-        # observation covers shuffle + scan, not just dispatch
-        history.append({"epoch": e + 1, "loss": float(mean_loss)})
-        _DL_EPOCHS.inc()
-        _DL_EPOCH_SECONDS.observe(_time.perf_counter() - _ep_t0)
-        keeper.record(float(mean_loss))
+        _DL_DISPATCHES.inc()
+        params, opt_state, key, losses = prog(
+            params, opt_state, X, y, w, jnp.asarray(perms), key,
+            jnp.int32(nbatch), l1, l2, slot_mask,
+        )
+        losses = np.asarray(losses, np.float64)  # syncs the chunk's work
+        _dt = _time.perf_counter() - _ep_t0
+        for j in range(k_i):
+            epochs_done = e + j + 1
+            history.append({"epoch": epochs_done, "loss": float(losses[j])})
+            _DL_EPOCHS.inc()
+            _DL_EPOCH_SECONDS.observe(_dt / k_i)
+            keeper.record(float(losses[j]))
+        for ph, nb in coll.items():
+            _COLL_BYTES.inc(nb * k_i * nbatch, phase=ph)
         if on_epoch is not None:
-            on_epoch(params, opt_state, epochs_done, history)
-        job.update(0.05 + 0.9 * (e + 1) / n_epochs)
+            if shard_on:
+                on_epoch(unravel(params[:n_real]),
+                         _state_from_flat(opt_state, unravel, n_real),
+                         epochs_done, history)
+            else:
+                on_epoch(params, opt_state, epochs_done, history)
+        job.update(0.05 + 0.9 * epochs_done / n_epochs)
+        e += k_i
         if keeper.should_stop() or job.stop_requested:
-            Log.info(f"DeepLearning early stop at epoch {e + 1}")
-            break
+            Log.info(f"DeepLearning early stop at epoch {epochs_done}")
+            stopped = True
+    if shard_on:
+        params = unravel(params[:n_real])
+        opt_state = _state_from_flat(opt_state, unravel, n_real)
     return params, opt_state, history, epochs_done
 
 
@@ -178,6 +458,37 @@ def _make_optimizer(p):
         optax.exponential_decay(p.rate, 1000, p.rate_decay),
         momentum=p.momentum_start or None,
     )
+
+
+def _dl_pad_cols(d: int) -> int:
+    """Bucketed input width for the supervised DL program keys: columns to
+    a multiple of 4 (the PR-1 ladder) so AutoML/grid steps over
+    near-identical frames share one compiled chunk program. Padded input
+    columns are all-zero; the first Dense kernel's extra rows start at zero
+    and receive zero gradients forever, so a bucketed build's trajectory is
+    bit-identical to the exact-shape one."""
+    from h2o3_tpu import config
+
+    if not config.get_bool("H2O3_TPU_SHAPE_BUCKETS"):
+        return d
+    return -(-d // 4) * 4
+
+
+def _repad_input_kernel(params, d_real: int, d_pad: int):
+    """Zero-pad (or re-pad, on checkpoint resume across bucket settings)
+    the first Dense kernel's input rows to ``d_pad``. Rows past ``d_real``
+    are exactly zero by construction, so slicing them off is lossless."""
+    import flax.core
+
+    frozen = isinstance(params, flax.core.FrozenDict)
+    prm = flax.core.unfreeze(params) if frozen else jax.tree.map(
+        lambda x: x, params)
+    k = prm["params"]["Dense_0"]["kernel"]
+    if int(k.shape[0]) != d_pad:
+        k = k[:d_real]
+        k = jnp.pad(k, ((0, d_pad - d_real), (0, 0)))
+        prm["params"]["Dense_0"]["kernel"] = k
+    return flax.core.freeze(prm) if frozen else prm
 
 
 def _resolved_dropout(p, n_hidden: int) -> tuple:
@@ -203,6 +514,9 @@ class DeepLearningModel(Model):
     def _predict_raw(self, frame: Frame) -> np.ndarray:
         di: DataInfo = self.output["datainfo"]
         X, _ = di.transform(frame)
+        pad = int(self.output.get("input_pad") or 0)
+        if pad:  # bucketed input width: scoring pads with the same zeros
+            X = jnp.pad(X, ((0, 0), (0, pad)))
         logits = self.output["apply_fn"](self.output["params"], X)
         if self.output.get("autoencoder"):
             return np.asarray(logits)[: frame.nrow]  # (n, expanded) recon
@@ -294,6 +608,9 @@ class DeepLearning(ModelBuilder):
         if autoencoder:
             out["autoencoder"] = True
             out["expanded_names"] = expanded
+        else:
+            k0 = prm["params"]["Dense_0"]["kernel"]
+            out["input_pad"] = int(k0.shape[0]) - di.ncols_expanded
         m = DeepLearningModel(key, p, out)
         m.scoring_history = list(hist)
         return m
@@ -342,18 +659,6 @@ class DeepLearning(ModelBuilder):
         if prior is not None and prior.output.get("opt_state") is not None:
             opt_state = prior.output["opt_state"]
 
-        l1, l2 = float(p.l1), float(p.l2)
-
-        def loss_fn(prm, xb, yb, wb, kb):  # yb unused: the input IS the target
-            recon = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
-            ll = jnp.mean((recon - xb) ** 2, axis=1)
-            loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
-            if l2:
-                loss += l2 * 0.5 * sum(jnp.sum(q**2) for q in jax.tree.leaves(prm))
-            if l1:
-                loss += l1 * sum(jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm))
-            return loss
-
         def on_epoch(prm, ost, done, hist):
             self._export_interval_checkpoint(
                 job, lambda key: self._epoch_snapshot(
@@ -363,8 +668,11 @@ class DeepLearning(ModelBuilder):
             )
             faults.abort_check(self.algo, done)
 
+        # autoencoder inputs are NOT shape-bucketed: the reconstruction
+        # target is the input itself, so padded columns would enter the
+        # per-row MSE mean (docs/MIGRATION.md fallback matrix)
         params, opt_state, history, epochs_done = _run_sync_sgd(
-            job, p, loss_fn, tx, params, opt_state,
+            job, p, mlp, "recon", tx, params, opt_state,
             X, jnp.zeros(train.npad, jnp.float32), w,
             train.nrow, train.npad, key, start_epochs, on_epoch=on_epoch,
         )
@@ -404,6 +712,12 @@ class DeepLearning(ModelBuilder):
         di = DataInfo.fit(train, self._x, standardize=p.standardize,
                           hash_buckets=p.hash_buckets)
         X, wmask = di.transform(train)
+        # shape-bucket ladder on the input width (zero columns, proven
+        # bit-inert via the zero-padded first kernel — _dl_pad_cols)
+        D = di.ncols_expanded
+        d_pad = _dl_pad_cols(D)
+        if d_pad > D:
+            X = jnp.pad(X, ((0, 0), (0, d_pad - D)))
         w = wmask
         if p.weights_column:
             w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
@@ -421,7 +735,11 @@ class DeepLearning(ModelBuilder):
         seed = abs(p.seed) if p.seed and p.seed > 0 else 99
         key = jax.random.PRNGKey(seed)
         key, init_key = jax.random.split(key)
+        # init at the EXACT width (initializer fan-in must not see padding)
+        # then zero-pad the first kernel's rows to the bucketed width
         params = mlp.init(init_key, jnp.zeros((1, di.ncols_expanded)), train=False)
+        if d_pad > D:
+            params = _repad_input_kernel(params, D, d_pad)
 
         from h2o3_tpu.models.model_base import check_checkpoint_compat, resolve_checkpoint
 
@@ -438,7 +756,7 @@ class DeepLearning(ModelBuilder):
                 raise ValueError(
                     f"checkpoint continuation needs epochs > {start_epochs}"
                 )
-            params = prior.output["params"]
+            params = _repad_input_kernel(prior.output["params"], D, d_pad)
 
         tx = _make_optimizer(p)
         opt_state = tx.init(params)
@@ -446,29 +764,21 @@ class DeepLearning(ModelBuilder):
             # carry the optimizer accumulators (adadelta rho-averages /
             # momentum + schedule counter) so continuation matches an
             # uninterrupted run, like GBM carries F and the split chain
-            opt_state = prior.output["opt_state"]
-
-        l1, l2 = float(p.l1), float(p.l2)
-        use_ce = classification
-
-        def loss_fn(prm, xb, yb, wb, kb):
-            logits = mlp.apply(prm, xb, train=True, rngs={"dropout": kb})
-            if use_ce:
-                ll = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, yb.astype(jnp.int32)
+            prior_ost = prior.output["opt_state"]
+            shapes_ok = jax.tree.structure(prior_ost) == jax.tree.structure(
+                opt_state
+            ) and all(
+                a.shape == b.shape
+                for a, b in zip(jax.tree.leaves(prior_ost),
+                                jax.tree.leaves(opt_state))
+            )
+            if shapes_ok:
+                opt_state = prior_ost
+            else:  # bucket-width change between runs: accumulators reset
+                Log.warn(
+                    "DeepLearning checkpoint optimizer state has a "
+                    "different shape bucket; accumulators re-initialized"
                 )
-            else:
-                ll = (logits[:, 0] - yb) ** 2
-            loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
-            if l2:
-                loss += l2 * 0.5 * sum(
-                    jnp.sum(q**2) for q in jax.tree.leaves(prm)
-                )
-            if l1:
-                loss += l1 * sum(
-                    jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm)
-                )
-            return loss
 
         domain = tuple(yv.domain) if classification else None
 
@@ -481,7 +791,8 @@ class DeepLearning(ModelBuilder):
             faults.abort_check(self.algo, done)
 
         params, opt_state, history, epochs_done = _run_sync_sgd(
-            job, p, loss_fn, tx, params, opt_state, X, y, w,
+            job, p, mlp, "ce" if classification else "mse", tx, params,
+            opt_state, X, y, w,
             train.nrow, train.npad, key, start_epochs, on_epoch=on_epoch,
         )
         apply_fn = jax.jit(lambda prm, xx: mlp.apply(prm, xx, train=False))
@@ -494,6 +805,7 @@ class DeepLearning(ModelBuilder):
             "hidden": list(p.hidden),
             "epochs_trained": epochs_done,
             "opt_state": opt_state,
+            "input_pad": d_pad - D,
             "response_domain": tuple(yv.domain) if classification else None,
         }
         model = DeepLearningModel(DKV.make_key("dl"), p, out)

@@ -125,6 +125,9 @@ def _export_deeplearning(model, meta, arrays) -> None:
     for i, name in enumerate(layers):
         arrays[f"W{i}"] = np.asarray(params[name]["kernel"])
         arrays[f"b{i}"] = np.asarray(params[name]["bias"])
+    pad = int(out.get("input_pad") or 0)
+    if pad:  # MOJO scores the REAL design width; bucket pad rows are zero
+        arrays["W0"] = arrays["W0"][:-pad]
 
 
 def _export_kmeans(model, meta, arrays) -> None:

@@ -38,7 +38,14 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.datainfo import MEAN_IMPUTATION, SKIP, DataInfo
 from h2o3_tpu.models.glm_families import get_family
 from h2o3_tpu.models.model_base import CommonParams, Model, ModelBuilder
-from h2o3_tpu.ops.gram import admm_elastic_net, solve_cholesky, weighted_gram
+from h2o3_tpu.ops.gram import (
+    admm_elastic_net,
+    admm_elastic_net_device,
+    cho_solve_jitter_device,
+    gram_collective_bytes,
+    solve_cholesky,
+    weighted_gram,
+)
 from h2o3_tpu.utils import faults
 from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
@@ -50,6 +57,82 @@ _IRLS_ITERS = _mx.counter(
 _IRLS_SECONDS = _mx.histogram(
     "glm_irls_iteration_seconds",
     "per-IRLS-iteration wall time (Gram pass + solve; the hex.glm hot loop)")
+_IRLS_SOLVE_SECONDS = _mx.histogram(
+    "glm_irls_solve_seconds",
+    "host-side (p,p) solve wall time per IRLS iteration (Cholesky/ADMM), "
+    "split out of glm_irls_iteration_seconds so the fused-IRLS A/B can "
+    "attribute its win; the fused lane solves on-device and reports only "
+    "the iteration histogram")
+# host dispatches issued by the IRLS loop (the fused-lane acceptance
+# metric: O(iterations) unfused vs O(iterations/K) fused) and program-cache
+# traffic for the fused chunk programs — the BUILD_STATS-style contract
+# counters (always on, like the tree builders')
+_GLM_DISPATCHES = _mx.counter(
+    "glm_dispatches_total",
+    "device-program launches issued by the GLM IRLS loop", always=True)
+_GLM_COMPILED = _mx.counter(
+    "glm_programs_compiled_total",
+    "fused IRLS chunk program cache misses", always=True)
+_GLM_HITS = _mx.counter(
+    "glm_program_cache_hits_total",
+    "fused IRLS chunk program cache hits (same shape bucket, no recompile)",
+    always=True)
+# the PR-5 collective byte family grows GLM phases (gram_reduce = the
+# psum_scatter of G row blocks + b/sw psums, gram_gather = the one
+# all_gather that reassembles G for the solve); same replication-volume
+# model, tallied per executed iteration at dispatch time
+_COLL_BYTES = _mx.counter(
+    "tree_collective_bytes_total",
+    "per-device collective payload bytes moved by tree builds (replication-"
+    "volume model), by phase", always=True)
+
+# fused IRLS chunk program cache: (shape bucket, family, solver branch,
+# mesh, backend) -> compiled chunk. The shape-bucket ladder (rows ride the
+# frame's bucketed npad; design columns pad to a multiple of 4 below) makes
+# AutoML/grid rebuilds of near-identical frames reuse one program.
+_GLM_PROGRAMS: dict = {}
+
+
+def _glm_fuse_chunk(params) -> int:
+    """Iterations per fused dispatch (K); 0 = the unfused per-iteration
+    path. ``auto`` fuses with K=8 everywhere (the chunk program is plain
+    XLA — while_loop + Cholesky — so the CPU proxy runs it too); an integer
+    forces that K. compute_p_values keeps today's host-f64 trajectory
+    (fallback matrix, docs/MIGRATION.md). With export_checkpoints_dir set
+    the chunk clamps to 1 so PR-2's per-iteration irls_state snapshots land
+    at the same loop positions."""
+    from h2o3_tpu import config
+
+    raw = config.get("H2O3_TPU_GLM_FUSE").strip().lower()
+    if raw == "0":
+        return 0
+    if getattr(params, "compute_p_values", False):
+        return 0
+    k = int(raw) if raw.isdigit() else 8
+    if getattr(params, "export_checkpoints_dir", None):
+        return 1
+    return max(k, 1)
+
+
+def _mesh_shards() -> int:
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
+
+    return get_mesh().shape[ROWS_AXIS]
+
+
+def _glm_pad_cols(p_real: int) -> int:
+    """Design-matrix width for the fused lane: the PR-1 shape-bucket ladder
+    (multiple of 4 under H2O3_TPU_SHAPE_BUCKETS) and then a multiple of the
+    shard count so the Gram psum_scatter deals equal row blocks. Padded
+    columns are all-zero with a unit solve diagonal — their coefficients
+    are exactly zero, proven inert in tests/test_glm_dl_fuse.py."""
+    from h2o3_tpu import config
+    from h2o3_tpu.parallel.mesh import pad_cols_to_shards
+
+    p = p_real
+    if config.get_bool("H2O3_TPU_SHAPE_BUCKETS"):
+        p = -(-p // 4) * 4
+    return pad_cols_to_shards(p)
 
 
 @dataclass
@@ -86,10 +169,11 @@ class GLMParams(CommonParams):
 # device programs (cached per family via partial+jit)
 
 
-@partial(jax.jit, static_argnames=("family_key", "fam_args"))
-def _irls_pass(X, y, w, offset, beta, family_key, fam_args):
-    """One GLMIterationTask: Gram/XtWz for the current beta + deviance."""
-    fam = get_family(family_key, *fam_args)
+def _irls_weights(fam, X, y, w, offset, beta):
+    """The GLMIterationTask row math for the current beta: IRLS working
+    weights W, working response z, and the deviance — shared op-for-op by
+    the per-iteration pass and the fused while_loop body so the two lanes
+    compute identical iterations."""
     eta = jnp.einsum("np,p->n", X, beta, precision=_HI) + offset
     mu = fam.link.inv(eta)
     d = fam.link.dinv(eta)
@@ -97,9 +181,129 @@ def _irls_pass(X, y, w, offset, beta, family_key, fam_args):
     var = fam.variance(mu)
     z = (eta - offset) + (y - mu) / d
     W = w * d * d / var
-    G, b, sw = weighted_gram(X, W, z)
     dev = fam.deviance(y, mu, w)
+    return W, z, dev
+
+
+@partial(jax.jit, static_argnames=("family_key", "fam_args"))
+def _irls_pass(X, y, w, offset, beta, family_key, fam_args):
+    """One GLMIterationTask: Gram/XtWz for the current beta + deviance."""
+    fam = get_family(family_key, *fam_args)
+    W, z, dev = _irls_weights(fam, X, y, w, offset, beta)
+    G, b, sw = weighted_gram(X, W, z)
     return G, b, dev
+
+
+def _fused_chunk_program(npad, p_pad, family_key, fam_args, l1_on,
+                         non_negative):
+    """Build (or fetch) the compiled K-iterations-per-dispatch IRLS chunk.
+
+    One ``lax.while_loop`` runs up to ``kmax`` IRLS iterations entirely on
+    device: the Gram pass ends in a psum_scatter of contiguous G row blocks
+    over the rows mesh axis (each device keeps p/P rows; one all_gather
+    hands the full G to the replicated solve), and the Cholesky-with-jitter
+    or ADMM solve runs in f32 on device. The loop exits early on
+    convergence (``stop``) or a non-finite solve (``bad`` — the host f64
+    lstsq fallback lane takes over). All regularization/convergence scalars
+    are DYNAMIC arguments so one program serves the whole lambda path;
+    ``beta`` is donated (the carry pipelines across chunk dispatches)."""
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, mesh_key
+
+    key = ("glm_irls_chunk", npad, p_pad, family_key, fam_args, bool(l1_on),
+           bool(non_negative), mesh_key(), jax.default_backend())
+    fn = _GLM_PROGRAMS.get(key)
+    if fn is not None:
+        _GLM_HITS.inc()
+        return fn
+    _GLM_COMPILED.inc()
+
+    from jax.sharding import PartitionSpec as Spec
+
+    fam = get_family(family_key, *fam_args)
+    mesh = get_mesh()
+    n_sh = mesh.shape[ROWS_AXIS]
+    ar = jnp.arange(p_pad)
+
+    def gram_dev_sharded(X, y, w, offset, beta):
+        """One GLMIterationTask with the MRTask reduce made explicit and
+        PACKED: the per-device row math (working weights, working response,
+        local Gram/XtWz partials, local deviance) runs inside shard_map,
+        the Gram reduction ends in a psum_scatter of contiguous (p/P, p)
+        row blocks, b and the deviance ride ONE packed psum, and a single
+        all_gather reassembles G for the solve — three collective
+        rendezvous per iteration instead of five (collective count, not
+        just volume, is what the CPU proxy pays for)."""
+        def local(Xl, yl, wl, ol, beta):
+            W, z, dev = _irls_weights(fam, Xl, yl, wl, ol, beta)
+            Xw = Xl * W[:, None]
+            G_l = jnp.einsum("np,nq->pq", Xw, Xl, precision=_HI)
+            b_l = jnp.einsum("np,n->p", Xw, z, precision=_HI)
+            G_blk = jax.lax.psum_scatter(
+                G_l, ROWS_AXIS, scatter_dimension=0, tiled=True)
+            vec = jax.lax.psum(
+                jnp.concatenate([b_l, dev[None]]), ROWS_AXIS)
+            G = jax.lax.all_gather(G_blk, ROWS_AXIS, axis=0, tiled=True)
+            return G, vec[:p_pad], vec[p_pad]
+
+        from h2o3_tpu.parallel.mesh import shard_map
+
+        return shard_map(
+            local, mesh,
+            in_specs=(Spec(ROWS_AXIS, None), Spec(ROWS_AXIS),
+                      Spec(ROWS_AXIS), Spec(ROWS_AXIS), Spec()),
+            out_specs=(Spec(), Spec(), Spec()),
+            check_vma=False,
+        )(X, y, w, offset, beta)
+
+    def chunk(beta, dev_prev, X, y, w, offset, kmax, l1, l2,
+              beta_eps, obj_eps, icpt, pad_diag, real_p):
+        def cond(c):
+            _, _, it, stop, bad = c
+            return (it < kmax) & ~stop & ~bad
+
+        def body(c):
+            beta, dev_prev, it, stop, bad = c
+            if n_sh > 1:
+                G, b, dev = gram_dev_sharded(X, y, w, offset, beta)
+            else:
+                W, z, dev = _irls_weights(fam, X, y, w, offset, beta)
+                G, b, _sw = weighted_gram(X, W, z)
+            if l1_on:
+                beta_new, ok = admm_elastic_net_device(
+                    G, b, l1, l2, icpt, pad_diag, real_p,
+                    non_negative=non_negative,
+                )
+            else:
+                # Gp = G + l2*I with the intercept unpenalized (the host
+                # path's Gp[icpt, icpt] -= l2), plus the unit diagonal that
+                # keeps padded bucket columns invertible at exactly zero
+                extra = l2 * jnp.where(ar == icpt, 0.0, 1.0) + pad_diag
+                beta_new, ok = cho_solve_jitter_device(G, b, extra)
+                if non_negative:
+                    beta_new = jnp.where(
+                        (ar != icpt) & (beta_new < 0), 0.0, beta_new
+                    )
+            bad = ~ok | ~jnp.all(jnp.isfinite(beta_new))
+            delta = jnp.max(jnp.abs(beta_new - beta))
+            stop = ~bad & (
+                (delta < beta_eps)
+                | (jnp.abs(dev_prev - dev)
+                   / jnp.maximum(jnp.abs(dev), 1e-10) < obj_eps)
+            )
+            beta = jnp.where(bad, beta, beta_new)
+            dev_prev = jnp.where(stop | bad, dev_prev, dev)
+            it = it + jnp.where(bad, 0, 1)
+            return beta, dev_prev, it, stop, bad
+
+        return jax.lax.while_loop(
+            cond, body,
+            (beta, dev_prev, jnp.int32(0), jnp.asarray(False),
+             jnp.asarray(False)),
+        )
+
+    fn = jax.jit(chunk, donate_argnums=(0,))
+    _GLM_PROGRAMS[key] = fn
+    return fn
 
 
 @partial(jax.jit, static_argnames=("family_key", "fam_args"))
@@ -392,6 +596,16 @@ class GLM(ModelBuilder):
         alpha = 0.5 if p.alpha is None else float(p.alpha)
         max_iter = p.max_iterations if p.max_iterations > 0 else 50
 
+        # fused whole-program lane (H2O3_TPU_GLM_FUSE): pad the design to
+        # the shape-bucket/mesh width up front — padded columns are
+        # all-zero, contribute exactly zero to every Gram/gradient below,
+        # and every host-side vector stays REAL length (padding happens at
+        # the dispatch boundary only)
+        fuse_k = _glm_fuse_chunk(p)
+        p_pad = _glm_pad_cols(P) if fuse_k else P
+        if p_pad > P:
+            X = jnp.pad(X, ((0, 0), (0, p_pad - P)))
+
         beta = np.zeros(P, np.float64)
         if p.intercept:
             mu0 = float(np.asarray(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-10)))
@@ -399,11 +613,16 @@ class GLM(ModelBuilder):
                 mu0 = min(max(mu0, 1e-4), 1 - 1e-4)
             beta[icpt] = float(np.asarray(fam.link.fwd(jnp.asarray(mu0))))
 
+        def pad_beta(b64):
+            return np.concatenate([b64, np.zeros(p_pad - P)]) if p_pad > P else b64
+
         # lambda path
         G0, b0, dev0 = _irls_pass(
-            X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
+            X, y, w, offset, jnp.asarray(pad_beta(beta), jnp.float32),
+            family, fam_args
         )
-        g0 = np.asarray(b0, np.float64) - np.asarray(G0, np.float64) @ beta
+        g0 = (np.asarray(b0, np.float64)
+              - np.asarray(G0, np.float64) @ pad_beta(beta))[:P]
         if icpt is not None:
             g0_pen = np.delete(g0, icpt)
         else:
@@ -430,6 +649,50 @@ class GLM(ModelBuilder):
             path = [dict(e) for e in st.get("path", ())]
         tot_iters = 0  # this run's executed iterations (chaos abort site)
         fam_obj = fam
+
+        def snapshot(li, it_pos, iters_done, dev_prev, beta):
+            self._export_interval_checkpoint(
+                job,
+                lambda key: self._irls_snapshot(
+                    key, p, di, beta, family, fam_obj, response_domain,
+                    {"li": li, "it": it_pos, "iters": iters_done,
+                     "dev_prev": dev_prev, "beta": beta.copy(),
+                     "best": best, "path": [dict(e) for e in path],
+                     "null_dev": null_dev},
+                ),
+            )
+
+        def host_iteration(beta, l1, l2):
+            """One per-iteration host-solve IRLS step (the pre-fused path
+            and the fused lane's singular-tail fallback): Gram on device,
+            float64 Cholesky/ADMM on host. Returns (beta, dev_now, delta).
+            """
+            _GLM_DISPATCHES.inc()
+            G, b, dev = _irls_pass(
+                X, y, w, offset, jnp.asarray(pad_beta(beta), jnp.float32),
+                family, fam_args
+            )
+            G = np.asarray(G, np.float64)[:P, :P]
+            b = np.asarray(b, np.float64)[:P]
+            _solve_t0 = time.perf_counter()
+            if l1 > 0:
+                beta_new = admm_elastic_net(
+                    G, b, l1, l2, icpt, non_negative=p.non_negative
+                )
+            else:
+                Gp = G + l2 * np.eye(P)
+                if icpt is not None:
+                    Gp[icpt, icpt] -= l2
+                beta_new = solve_cholesky(Gp, b)
+                if p.non_negative:
+                    mask = np.arange(P) != (icpt if icpt is not None else -1)
+                    beta_new = np.where(mask & (beta_new < 0), 0.0, beta_new)
+            _IRLS_SOLVE_SECONDS.observe(time.perf_counter() - _solve_t0)
+            delta = np.max(np.abs(beta_new - beta))
+            return beta_new, float(dev), delta
+
+        coll_model = gram_collective_bytes(
+            p_pad, _mesh_shards()) if fuse_k else None
         for li, lam in enumerate(lambdas):
             if li < li0:
                 continue
@@ -441,34 +704,66 @@ class GLM(ModelBuilder):
             # reported in the regularization path
             it_pos = it0 if li == li0 else 0
             iters_done = iters0 if li == li0 else 0
+            fused_ok = bool(fuse_k)  # a bad (singular-in-f32) chunk drops
+            #                          this lambda to the host-f64 tail
             while it_pos < max_iter:
-                _it_t0 = time.perf_counter()
-                G, b, dev = _irls_pass(
-                    X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
-                )
-                G = np.asarray(G, np.float64)
-                b = np.asarray(b, np.float64)
-                if l1 > 0:
-                    beta_new = admm_elastic_net(
-                        G, b, l1, l2, icpt, non_negative=p.non_negative
+                if fused_ok:
+                    prog = _fused_chunk_program(
+                        X.shape[0], p_pad, family, fam_args, l1 > 0,
+                        p.non_negative,
                     )
-                else:
-                    Gp = G + l2 * np.eye(P)
-                    if icpt is not None:
-                        Gp[icpt, icpt] -= l2
-                    beta_new = solve_cholesky(Gp, b)
-                    if p.non_negative:
-                        mask = np.arange(P) != (icpt if icpt is not None else -1)
-                        beta_new = np.where(mask & (beta_new < 0), 0.0, beta_new)
-                delta = np.max(np.abs(beta_new - beta))
+                    kmax = min(fuse_k, max_iter - iters_done)
+                    _it_t0 = time.perf_counter()
+                    _GLM_DISPATCHES.inc()
+                    beta_j, devp_j, ndone_j, stop_j, bad_j = prog(
+                        jnp.asarray(pad_beta(beta), jnp.float32),
+                        jnp.float32(dev_prev), X, y, w, offset,
+                        jnp.int32(kmax), jnp.float32(l1), jnp.float32(l2),
+                        jnp.float32(p.beta_epsilon),
+                        jnp.float32(p.objective_epsilon),
+                        jnp.int32(icpt if icpt is not None else -1),
+                        jnp.asarray(
+                            (np.arange(p_pad) >= P).astype(np.float32)),
+                        jnp.float32(P),
+                    )
+                    n_done = int(ndone_j)
+                    stop, bad = bool(stop_j), bool(bad_j)
+                    _dt = time.perf_counter() - _it_t0
+                    if n_done:
+                        beta = np.asarray(beta_j, np.float64)[:P]
+                        dev_prev = float(devp_j)
+                        _IRLS_ITERS.inc(n_done)
+                        for _ in range(n_done):
+                            _IRLS_SECONDS.observe(_dt / n_done)
+                        for ph, nb in coll_model.items():
+                            if nb:
+                                _COLL_BYTES.inc(nb * n_done, phase=ph)
+                    iters_done += n_done
+                    it_pos = max_iter if stop else iters_done
+                    snapshot(li, it_pos, iters_done, dev_prev, beta)
+                    first = tot_iters + 1
+                    tot_iters += n_done
+                    for i in range(first, tot_iters + 1):
+                        faults.abort_check("glm", i)
+                    if bad:
+                        Log.warn(
+                            "GLM fused IRLS chunk hit a non-finite f32 "
+                            "solve; falling back to the host float64 lane "
+                            f"for lambda index {li}"
+                        )
+                        fused_ok = False
+                    if stop:
+                        break
+                    continue
+                _it_t0 = time.perf_counter()
+                beta_new, dev_now, delta = host_iteration(beta, l1, l2)
                 beta = beta_new
-                dev_now = float(dev)
                 iters_done += 1
                 it_pos = iters_done
                 tot_iters += 1
-                # the np.asarray(G) above forced the device sync, so this is
-                # the true Gram+solve iteration time (checkpoint IO excluded;
-                # persist_write_seconds covers it)
+                # the np.asarray(G) in host_iteration forced the device
+                # sync, so this is the true Gram+solve iteration time
+                # (checkpoint IO excluded; persist_write_seconds covers it)
                 _IRLS_ITERS.inc()
                 _IRLS_SECONDS.observe(time.perf_counter() - _it_t0)
                 stop = delta < p.beta_epsilon or abs(dev_prev - dev_now) / max(
@@ -481,22 +776,14 @@ class GLM(ModelBuilder):
                 # snapshot AFTER the stop decision: the recorded (li, it)
                 # is exactly where a resumed run re-enters the loop (it ==
                 # max_iter marks "this lambda's iterations are finished")
-                self._export_interval_checkpoint(
-                    job,
-                    lambda key: self._irls_snapshot(
-                        key, p, di, beta, family, fam_obj, response_domain,
-                        {"li": li, "it": it_pos, "iters": iters_done,
-                         "dev_prev": dev_prev, "beta": beta.copy(),
-                         "best": best, "path": [dict(e) for e in path],
-                         "null_dev": null_dev},
-                    ),
-                )
+                snapshot(li, it_pos, iters_done, dev_prev, beta)
                 faults.abort_check("glm", tot_iters)
                 if stop:
                     break
             dev_final = float(
                 _deviance_pass(
-                    X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
+                    X, y, w, offset,
+                    jnp.asarray(pad_beta(beta), jnp.float32), family, fam_args
                 )
             )
             expl = 1 - dev_final / max(null_dev, 1e-30)

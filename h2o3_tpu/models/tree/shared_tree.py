@@ -1390,12 +1390,9 @@ def _mesh_key():
     (and the sharded split's block layout) bake the mesh in at trace time,
     so a program compiled for one mesh must never serve another (tests swap
     sub-meshes of different sizes within one process)."""
-    from h2o3_tpu.parallel.mesh import get_mesh
+    from h2o3_tpu.parallel.mesh import mesh_key
 
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS
-
-    m = get_mesh()
-    return (m.shape[ROWS_AXIS] if hasattr(m, "shape") else 0, id(m))
+    return mesh_key()
 
 
 def _level_step_mono(n_pad, n_pad_next, n_bins, force_leaf, cat_cols=(),

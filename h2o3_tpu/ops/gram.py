@@ -8,9 +8,21 @@ inserts the cross-chip ``psum`` automatically (the MRTask reduce). float32
 with HIGHEST precision keeps the normal equations accurate; the (p,p) solve
 happens host-side in float64 — same split as H2O (distributed accumulate,
 local solve).
+
+The fused whole-program IRLS lane (H2O3_TPU_GLM_FUSE, models/glm.py) uses
+the explicit variants below instead: :func:`weighted_gram_sharded` ends in a
+``psum_scatter`` of contiguous G row blocks over the rows mesh axis (each
+device keeps p/P rows; the solve gathers them once — the hierarchical-
+reduction placement of arXiv:2110.10548 at one mesh level), and
+:func:`cho_solve_jitter_device` / :func:`admm_elastic_net_device` move the
+per-iteration solve on-device (float32) so a K-iteration chunk runs with
+zero host round-trips. The host float64 functions stay as the singular-tail
+fallback lane.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +39,143 @@ def weighted_gram(X, w, z):
     G = jnp.einsum("np,nq->pq", Xw, X, precision=_P)
     b = jnp.einsum("np,n->p", Xw, z, precision=_P)
     return G, b, w.sum(dtype=jnp.float32)
+
+
+def weighted_gram_sharded(X, w, z, mesh=None):
+    """:func:`weighted_gram` with the MRTask reduce made explicit: each
+    device contracts its local row block, the Gram reduction ends in a
+    ``psum_scatter`` of contiguous (p/P, p) row blocks over the rows mesh
+    axis, and one ``all_gather`` reassembles G for the (replicated) solve.
+
+    Traceable inside a larger jitted program (the fused IRLS while_loop).
+    Requires ``X.shape[1]`` divisible by the shard count (the caller pads —
+    models/glm.py pads the design matrix columns to the shape-bucket ladder
+    and then to the mesh). Row blocks are contiguous, so device d's slice
+    is exactly rows [d·p/P, (d+1)·p/P) of the replicated-einsum G.
+    """
+    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+    from jax.sharding import PartitionSpec as Spec
+
+    mesh = mesh or get_mesh()
+    n_sh = mesh.shape[ROWS_AXIS]
+    if n_sh <= 1:
+        return weighted_gram(X, w, z)
+    p = X.shape[1]
+    assert p % n_sh == 0, f"gram width {p} not divisible by {n_sh} shards"
+
+    def local(Xl, wl, zl):
+        Xw = Xl * wl[:, None]
+        G_l = jnp.einsum("np,nq->pq", Xw, Xl, precision=_P)
+        b_l = jnp.einsum("np,n->p", Xw, zl, precision=_P)
+        # contiguous row blocks: device d keeps G rows [d*p/P, (d+1)*p/P)
+        G_blk = jax.lax.psum_scatter(
+            G_l, ROWS_AXIS, scatter_dimension=0, tiled=True
+        )
+        # the solve needs the full (p, p) matrix exactly once per iteration
+        G = jax.lax.all_gather(G_blk, ROWS_AXIS, axis=0, tiled=True)
+        b = jax.lax.psum(b_l, ROWS_AXIS)
+        sw = jax.lax.psum(wl.sum(dtype=jnp.float32), ROWS_AXIS)
+        return G, b, sw
+
+    return shard_map(
+        local, mesh,
+        in_specs=(Spec(ROWS_AXIS, None), Spec(ROWS_AXIS), Spec(ROWS_AXIS)),
+        out_specs=(Spec(), Spec(), Spec()),
+        check_vma=False,
+    )(X, w, z)
+
+
+def gram_collective_bytes(p_pad: int, n_shards: int) -> dict:
+    """Replication-volume model (the PR-5 accounting) of ONE sharded Gram
+    pass: ``gram_reduce`` = what the psum_scatter + b/sw psums leave on each
+    device, ``gram_gather`` = the one all_gather that reassembles G for the
+    solve. Zero on a 1-device mesh (nothing moves)."""
+    if n_shards <= 1:
+        return {"gram_reduce": 0.0, "gram_gather": 0.0}
+    return {
+        "gram_reduce": (p_pad * p_pad / n_shards + p_pad + 1) * 4.0,
+        "gram_gather": p_pad * p_pad * 4.0,
+    }
+
+
+# jitter ladder mirroring solve_cholesky's host escalation: first try is
+# bare, then max(1e-10, 10x) per retry — six attempts before the caller's
+# lstsq fallback
+_JITTERS = (0.0, 1e-10, 1e-9, 1e-8, 1e-7, 1e-6)
+
+
+def cho_solve_jitter_device(G, b, extra_diag=None):
+    """On-device SPD solve with jitter escalation — the traceable f32
+    analog of :func:`solve_cholesky`. ``jax.scipy`` Cholesky reports
+    non-SPD as NaNs instead of raising, so every rung of the ladder is
+    factored and the first finite solution wins. Returns ``(x, ok)``;
+    ``ok=False`` (no rung produced a finite solution) routes the caller to
+    the host float64 lstsq fallback lane. ``extra_diag`` is a per-column
+    additive diagonal (ridge wiring + the unit diagonal that keeps padded
+    bucket columns invertible without touching real coefficients)."""
+    p = G.shape[0]
+    eye = jnp.eye(p, dtype=G.dtype)
+    if extra_diag is not None:
+        G = G + jnp.diag(extra_diag)
+    x = jnp.zeros_like(b)
+    ok = jnp.asarray(False)
+    for j in _JITTERS:
+        c, low = jax.scipy.linalg.cho_factor(G + j * eye, lower=True)
+        xj = jax.scipy.linalg.cho_solve((c, low), b)
+        okj = jnp.all(jnp.isfinite(xj))
+        take = (~ok) & okj
+        x = jnp.where(take, xj, x)
+        ok = ok | okj
+    return x, ok
+
+
+@partial(jax.jit, static_argnames=("iters", "non_negative"))
+def admm_elastic_net_device(
+    G, b, l1, l2, icpt, pad_diag, real_p,
+    rho=None, iters=500, tol=1e-6, non_negative=False,
+):
+    """Traceable f32 ADMM elastic net mirroring :func:`admm_elastic_net`
+    op-for-op (same rho heuristic, same soft-threshold loop, same stopping
+    rule) with a while_loop early exit. ``icpt`` is a DYNAMIC index (-1 for
+    no intercept) so one compiled program serves every design width in a
+    shape bucket; ``pad_diag`` adds a unit diagonal on padded bucket columns
+    (their b entries are zero, so their coefficients stay exactly zero) and
+    ``real_p`` is the true column count for the rho diagonal mean. Returns
+    ``(z, ok)`` like the Cholesky lane."""
+    p = G.shape[0]
+    ar = jnp.arange(p)
+    diag = jnp.diagonal(G)
+    if rho is None:
+        rho = jnp.maximum(
+            1e-3, jnp.sum(diag * (1.0 - pad_diag)) / jnp.maximum(real_p, 1.0)
+        )
+    A = G + jnp.diag(pad_diag) + (l2 + rho) * jnp.eye(p, dtype=G.dtype)
+    c, low = jax.scipy.linalg.cho_factor(A, lower=True)
+    thr = jnp.where(ar == icpt, 0.0, l1 / rho)
+    neg_mask = ar != icpt
+
+    def body(carry):
+        x, z, u, z_old, i, done = carry
+        x = jax.scipy.linalg.cho_solve((c, low), b + rho * (z - u))
+        v = x + u
+        z_new = jnp.sign(v) * jnp.maximum(jnp.abs(v) - thr, 0.0)
+        if non_negative:
+            z_new = jnp.where(neg_mask & (z_new < 0), 0.0, z_new)
+        done = (jnp.max(jnp.abs(z_new - z)) < tol) & (
+            jnp.max(jnp.abs(x - z_new)) < tol
+        )
+        return x, z_new, u + x - z_new, z, i + 1, done
+
+    def cond(carry):
+        _, _, _, _, i, done = carry
+        return (i < iters) & ~done
+
+    z0 = jnp.zeros_like(b)
+    x, z, u, _, _, _ = jax.lax.while_loop(
+        cond, body, (z0, z0, z0, z0, jnp.int32(0), jnp.asarray(False))
+    )
+    ok = jnp.all(jnp.isfinite(z)) & jnp.all(jnp.isfinite(c))
+    return z, ok
 
 
 def solve_cholesky(G: np.ndarray, b: np.ndarray, ridge: float = 0.0) -> np.ndarray:

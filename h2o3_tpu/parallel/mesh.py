@@ -96,6 +96,26 @@ def col_block_spec(axis: int = 0) -> P:
     return P(*((None,) * axis + (ROWS_AXIS,)))
 
 
+def pad_flat_to_shards(n: int, mesh: Mesh | None = None) -> int:
+    """Smallest multiple of the shard count >= max(n, shard count) — the
+    padded length of a FLATTENED parameter/gradient vector so a
+    ``psum_scatter`` over the rows axis deals every device an equal slice
+    (the DL sharded-gradient lane; padded tail entries are zero and their
+    zero gradients keep elementwise optimizer state zero forever)."""
+    m = (mesh or get_mesh()).shape[ROWS_AXIS]
+    return max(m, -(-n // m) * m)
+
+
+def mesh_key() -> tuple:
+    """Program-cache component for the process mesh: traced collectives and
+    shard_map block layouts bake the mesh in at trace time, so a program
+    compiled for one mesh must never serve another (tests swap 1/2/8-device
+    sub-meshes within one process). Shared by the tree, GLM and DL program
+    caches."""
+    m = get_mesh()
+    return (m.shape[ROWS_AXIS] if hasattr(m, "shape") else 0, id(m))
+
+
 def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
     return NamedSharding(mesh or get_mesh(), P())
 

@@ -124,6 +124,14 @@ def configure(fail: dict[str, int] | None = None,
         _armed = bool(_fail or _abort or _death or _stall or _slow)
 
 
+def armed() -> bool:
+    """True when any fault primitive is armed. Chaos-aware subsystems read
+    this to clamp batching/fusion that would move abort or snapshot
+    boundaries (the DL epoch-chunk loop drops to one epoch per dispatch so
+    ``site@K`` aborts land at exact epoch counts)."""
+    return _armed
+
+
 def reset() -> None:
     """Disarm everything and clear counters (re-reads the env knob)."""
     global _armed

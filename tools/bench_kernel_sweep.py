@@ -194,6 +194,171 @@ def fused_ab(rows: int = 4_000, cols: int = 28, depth: int = 6,
         }}), flush=True)
 
 
+def _ab_frame(rows: int, cols: int, seed: int = 0, classify: bool = True):
+    """Synthetic numeric frame + binary/real response for the GLM/DL A/Bs."""
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    eta = X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2]
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(cols)])
+    if classify:
+        y = rng.random(rows) < 1.0 / (1.0 + np.exp(-eta))
+        df["label"] = np.where(y, "s", "b")
+    else:
+        df["label"] = (eta + 0.3 * rng.normal(size=rows)).astype(np.float32)
+    from h2o3_tpu.frame.frame import Frame
+
+    return Frame.from_pandas(df)
+
+
+def _hist_sum_count(name: str):
+    """(sum, count) of an unlabeled registry histogram."""
+    from h2o3_tpu.utils import metrics as mx
+
+    for labels, _cum, s, n in mx.REGISTRY.histogram(name).samples():
+        if not labels:
+            return float(s), int(n)
+    return 0.0, 0
+
+
+def glm_ab(rows: int = 8_000, cols: int = 12) -> None:
+    """Fused-vs-unfused whole-program GLM IRLS A/B (H2O3_TPU_GLM_FUSE,
+    ISSUE 8) on the SAME mesh and frame: hot-loop iterations/sec from the
+    glm_irls_iteration_seconds histogram (whole-train wall time is
+    dominated by transform/scoring overhead both lanes share), host
+    dispatches per model (O(iters/K) fused vs O(iters) unfused) and the
+    Gram collective byte tally, per mode, then a {"glm_ab": ...} summary.
+    The env toggle works in-process because the fused chunk programs key
+    on the knob-derived lanes and the unfused path never touches them."""
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.parallel.mesh import get_mesh
+    from h2o3_tpu.utils import metrics as mx
+
+    fr = _ab_frame(rows, cols)
+    # epsilons pinned to zero-ish so BOTH lanes run the full iteration
+    # budget: the A/B measures steady-state iterations/sec of the hot
+    # loop, not time-to-convergence on an easy synthetic problem
+    kw = dict(family="binomial", lambda_=1e-4, max_iterations=20, seed=1,
+              beta_epsilon=0.0, objective_epsilon=0.0)
+    results = {}
+    for mode in ("fused", "unfused"):
+        if mode == "unfused":
+            os.environ["H2O3_TPU_GLM_FUSE"] = "0"
+        else:
+            os.environ.pop("H2O3_TPU_GLM_FUSE", None)
+        GLM(**kw).train(y="label", training_frame=fr)  # compile warmup
+        g0 = sum(mx.counter_value("tree_collective_bytes_total", phase=ph)
+                 for ph in ("gram_reduce", "gram_gather"))
+        d0 = mx.counter_value("glm_dispatches_total")
+        s0, c0 = _hist_sum_count("glm_irls_iteration_seconds")
+        n_rep = 3
+        times = []
+        for _ in range(n_rep):
+            t0 = time.perf_counter()
+            m = GLM(**kw).train(y="label", training_frame=fr)
+            times.append(time.perf_counter() - t0)
+        s1, c1 = _hist_sum_count("glm_irls_iteration_seconds")
+        iters = c1 - c0
+        disp = int(mx.counter_value("glm_dispatches_total") - d0)
+        gbytes = sum(
+            mx.counter_value("tree_collective_bytes_total", phase=ph)
+            for ph in ("gram_reduce", "gram_gather")) - g0
+        med = sorted(times)[len(times) // 2]
+        rec = {
+            "phase": "glm_ab", "mode": mode,
+            "n_devices": get_mesh().devices.size,
+            "rows": rows, "cols": cols,
+            "train_s": round(med, 4),
+            "iters_per_s": round(iters / max(s1 - s0, 1e-9), 3),
+            "iteration_ms": round((s1 - s0) / max(iters, 1) * 1000, 3),
+            "dispatches_per_model": round(disp / n_rep, 2),
+            "gram_bytes_per_model": round(gbytes / n_rep, 1),
+            "auc": round(float(m.training_metrics.auc), 4),
+        }
+        print(json.dumps(rec), flush=True)
+        results[mode] = rec
+    os.environ.pop("H2O3_TPU_GLM_FUSE", None)
+    if len(results) == 2 and results["unfused"]["iters_per_s"] > 0:
+        print(json.dumps({"glm_ab": {
+            "iters_per_s_ratio_fused_over_unfused": round(
+                results["fused"]["iters_per_s"]
+                / results["unfused"]["iters_per_s"], 3),
+            "dispatch_ratio_unfused_over_fused": round(
+                results["unfused"]["dispatches_per_model"]
+                / max(results["fused"]["dispatches_per_model"], 1e-9), 2),
+            "auc_delta": round(
+                abs(results["fused"]["auc"] - results["unfused"]["auc"]), 5),
+        }}), flush=True)
+
+
+def dl_ab(rows: int = 20_000, cols: int = 16) -> None:
+    """Chunked-vs-per-epoch DeepLearning A/B (H2O3_TPU_DL_EPOCH_CHUNK +
+    H2O3_TPU_DL_GRAD_SHARD, ISSUE 8) on the SAME mesh and frame: measured
+    epochs/sec, host dispatches per model and the gradient collective byte
+    tally, per mode, then a {"dl_ab": ...} summary. The control pins
+    chunk=1 + shard=0 (the pre-fusion lane)."""
+    from h2o3_tpu.models.deeplearning import DeepLearning
+    from h2o3_tpu.parallel.mesh import get_mesh
+    from h2o3_tpu.utils import metrics as mx
+
+    fr = _ab_frame(rows, cols)
+    kw = dict(hidden=[64, 64], epochs=4, mini_batch_size=256, seed=3)
+    results = {}
+    for mode in ("chunked", "per_epoch"):
+        if mode == "per_epoch":
+            os.environ["H2O3_TPU_DL_EPOCH_CHUNK"] = "1"
+            os.environ["H2O3_TPU_DL_GRAD_SHARD"] = "0"
+        else:
+            os.environ.pop("H2O3_TPU_DL_EPOCH_CHUNK", None)
+            os.environ.pop("H2O3_TPU_DL_GRAD_SHARD", None)
+        DeepLearning(**kw).train(y="label", training_frame=fr)  # warmup
+        d0 = mx.counter_value("dl_dispatches_total")
+        g0 = sum(mx.counter_value("tree_collective_bytes_total", phase=ph)
+                 for ph in ("dl_grad_reduce", "dl_param_gather"))
+        s0, c0 = _hist_sum_count("dl_epoch_seconds")
+        n_rep = 3
+        times = []
+        for _ in range(n_rep):
+            t0 = time.perf_counter()
+            m = DeepLearning(**kw).train(y="label", training_frame=fr)
+            times.append(time.perf_counter() - t0)
+        s1, c1 = _hist_sum_count("dl_epoch_seconds")
+        epochs = c1 - c0
+        disp = int(mx.counter_value("dl_dispatches_total") - d0)
+        gbytes = sum(
+            mx.counter_value("tree_collective_bytes_total", phase=ph)
+            for ph in ("dl_grad_reduce", "dl_param_gather")) - g0
+        med = sorted(times)[len(times) // 2]
+        rec = {
+            "phase": "dl_ab", "mode": mode,
+            "n_devices": get_mesh().devices.size,
+            "rows": rows, "cols": cols,
+            "train_s": round(med, 4),
+            "epochs_per_s": round(epochs / max(s1 - s0, 1e-9), 3),
+            "epoch_s": round((s1 - s0) / max(epochs, 1), 4),
+            "dispatches_per_model": round(disp / n_rep, 2),
+            "grad_bytes_per_model": round(gbytes / n_rep, 1),
+            "auc": round(float(m.training_metrics.auc), 4),
+        }
+        print(json.dumps(rec), flush=True)
+        results[mode] = rec
+    for k in ("H2O3_TPU_DL_EPOCH_CHUNK", "H2O3_TPU_DL_GRAD_SHARD"):
+        os.environ.pop(k, None)
+    if len(results) == 2 and results["per_epoch"]["epochs_per_s"] > 0:
+        print(json.dumps({"dl_ab": {
+            "epochs_per_s_ratio_chunked_over_per_epoch": round(
+                results["chunked"]["epochs_per_s"]
+                / results["per_epoch"]["epochs_per_s"], 3),
+            "dispatch_ratio_per_epoch_over_chunked": round(
+                results["per_epoch"]["dispatches_per_model"]
+                / max(results["chunked"]["dispatches_per_model"], 1e-9), 2),
+            "auc_delta": round(
+                abs(results["chunked"]["auc"] - results["per_epoch"]["auc"]),
+                5),
+        }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -262,5 +427,9 @@ if __name__ == "__main__":
         split_ab(**kw)
     elif "--fused-ab" in sys.argv:
         fused_ab(**kw)
+    elif "--glm-ab" in sys.argv:
+        glm_ab(**kw)
+    elif "--dl-ab" in sys.argv:
+        dl_ab(**kw)
     else:
         main()

@@ -176,6 +176,24 @@ def main() -> int:
                     )
                     if not sane:
                         headline_ok = False
+                # tracked GLM/DL/AutoML summary keys (ISSUE 8) are OPTIONAL
+                # — artifacts from partial runs lack them — but when
+                # present they must be finite positives or the per-round
+                # trend they exist to track is garbage
+                for k in ("glm_iters_per_s", "dl_epoch_s",
+                          "automl_total_s"):
+                    if k not in d:
+                        continue
+                    try:
+                        v = float(d[k])
+                        sane = v > 0 and v == v and v != float("inf")
+                    except (TypeError, ValueError):
+                        sane = False
+                    psum_note += (
+                        f" {k}={d[k]}" if sane else f" {k}=INSANE"
+                    )
+                    if not sane:
+                        headline_ok = False
         except OSError as e:  # vanished/unreadable between glob and open
             note = f" (unreadable: {e.strerror or e})"
         except Exception as e:  # torn/empty/garbage JSON is a MISSING, not a crash

@@ -84,3 +84,26 @@ save "KERNEL_SWEEP_${stamp}.jsonl" "Pallas histogram kernel tile sweep"
 timeout 1800 python tools/load_test.py --mode both --duration 8 \
   --out "LOADTEST_${stamp}.json" | tail -1 > /dev/null
 save "LOADTEST_${stamp}.json" "Serving load A/B: batched rows route vs per-request control"
+
+# whole-program GLM IRLS + DL epoch-chunk A/Bs (ISSUE 8): fused-vs-unfused
+# hot-loop iterations/sec + dispatch counts + Gram/gradient collective byte
+# tallies on the real accelerator (CPU-proxy numbers in the committed
+# GLMDL_AB_*_cpu8proxy.jsonl: GLM 1.62x iters/sec, DL 2.9x epochs/sec).
+timeout 1200 python tools/bench_kernel_sweep.py --glm-ab --rows 1000000 \
+  | tee "GLMDL_AB_${stamp}_glm.jsonl"
+save "GLMDL_AB_${stamp}_glm.jsonl" "Whole-program GLM IRLS fused-vs-unfused A/B (1M rows)"
+
+timeout 1200 python tools/bench_kernel_sweep.py --dl-ab --rows 100000 \
+  | tee "GLMDL_AB_${stamp}_dl.jsonl"
+save "GLMDL_AB_${stamp}_dl.jsonl" "DL epoch-chunk + sharded-grad A/B (100k rows)"
+
+# bench headline controls for the fused GLM/DL lanes: full phase run above
+# measured the fused defaults; these pin the pre-fusion paths
+H2O3_TPU_GLM_FUSE=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_glmunfused.json"  # per-iteration GLM control
+save "BENCH_builder_${stamp}_glmunfused.json" "TPU bench unfused-GLM control (headline only)"
+
+H2O3_TPU_DL_EPOCH_CHUNK=1 H2O3_TPU_DL_GRAD_SHARD=0 H2O3_TPU_BENCH_DEADLINE_S=1 \
+  timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_dlperepoch.json"  # per-epoch DL control
+save "BENCH_builder_${stamp}_dlperepoch.json" "TPU bench per-epoch DL control (headline only)"
