@@ -672,26 +672,39 @@ def _bench_glm_1m(fr) -> dict:
 
 
 def _collective_microbench(n_nodes=64, n_bins=128, iters=10) -> dict | None:
-    """MEASURED seconds for the split phase's collectives at bench shapes:
-    the histogram all-reduce vs reduce-scatter and the per-block winner
-    gather, timed as standalone dispatches on the real mesh (collectives
-    inside the fused program cannot be host-timed individually — this is
-    the calibration that fills ``tree_collective_seconds_total``). Returns
-    None on a 1-device mesh (nothing to move)."""
+    """MEASURED seconds for every hot collective phase at bench shapes —
+    the histogram all-reduce vs reduce-scatter + winner gather (trees), the
+    Gram reduce-scatter + solve gather (fused GLM), the flat-gradient
+    scatter + param gather (sharded DL) — timed as standalone dispatches on
+    the real mesh (collectives inside the fused programs cannot be
+    host-timed individually; this calibration fills
+    ``tree_collective_seconds_total{phase}``). The reduces run through the
+    ops/collectives lane, so whatever lane is ACTIVE (quantized,
+    hierarchical, exact) is what gets measured — the --quant-ab seconds are
+    measured, not modeled. Returns None on a 1-device mesh."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from h2o3_tpu.models.glm import _glm_pad_cols
     from h2o3_tpu.models.tree.shared_tree import _COLL_SECONDS, _split_shard_on
+    from h2o3_tpu.ops import collectives
     from h2o3_tpu.parallel.mesh import (
-        ROWS_AXIS, get_mesh, pad_cols_to_shards, shard_map)
+        ROWS_AXIS, get_mesh, pad_cols_to_shards, pad_flat_to_shards,
+        shard_map)
 
     mesh = get_mesh()
-    if mesh.shape[ROWS_AXIS] <= 1:
+    n_dev = mesh.shape[ROWS_AXIS]
+    if n_dev <= 1:
         return None
     Cp = pad_cols_to_shards(N_COLS, mesh)
     hist = jnp.ones((Cp, n_nodes * n_bins, 3), jnp.float32)  # one local hist
     win = jnp.ones((n_nodes, 14), jnp.float32)  # ~the winner tuple payload
+    p_pad = _glm_pad_cols(N_COLS + 1)  # bench GLM design width (+intercept)
+    gram = jnp.ones((p_pad, p_pad), jnp.float32)
+    # bench DL network (hidden 64x64 on the bench frame) flat param vector
+    n_param = (N_COLS * 64 + 64) + (64 * 64 + 64) + (64 + 1)
+    grad = jnp.ones((pad_flat_to_shards(n_param, mesh),), jnp.float32)
 
     def timed(fn, *args):
         out = fn(*args)
@@ -704,21 +717,43 @@ def _collective_microbench(n_nodes=64, n_bins=128, iters=10) -> dict | None:
 
     sm = lambda f, outs: jax.jit(shard_map(
         f, mesh=mesh, in_specs=(P(),), out_specs=outs, check_vma=False))
-    ar_s = timed(sm(lambda v: jax.lax.psum(v, ROWS_AXIS), P()), hist)
+    ar_s = timed(sm(
+        lambda v: collectives.psum(v, n_dev=n_dev, lane_axis=-1), P()), hist)
     rs_s = timed(sm(
-        lambda v: jax.lax.psum_scatter(
-            v, ROWS_AXIS, scatter_dimension=0, tiled=True),
+        lambda v: collectives.psum_scatter(v, n_dev=n_dev, lane_axis=-1),
         P(ROWS_AXIS)), hist)
     wg_s = timed(sm(lambda v: jax.lax.all_gather(v, ROWS_AXIS), P()), win)
+    gr_s = timed(sm(
+        lambda v: collectives.psum_scatter(v, n_dev=n_dev, passes=2),
+        P(ROWS_AXIS)), gram)
+    gg_s = timed(sm(
+        lambda v: jax.lax.all_gather(
+            v, ROWS_AXIS, axis=0, tiled=True), P()),
+        gram.reshape(n_dev, -1)[0])
+    dg_s = timed(sm(
+        lambda v: collectives.psum_scatter(v, n_dev=n_dev, passes=2),
+        P(ROWS_AXIS)), grad)
+    pg_s = timed(sm(
+        lambda v: jax.lax.all_gather(v, ROWS_AXIS, axis=0, tiled=True),
+        P()), grad.reshape(n_dev, -1)[0])
     sharded = _split_shard_on()
     _COLL_SECONDS.inc(rs_s if sharded else ar_s, phase="hist_reduce")
     if sharded:
         _COLL_SECONDS.inc(wg_s, phase="winner_gather")
+    _COLL_SECONDS.inc(gr_s, phase="gram_reduce")
+    _COLL_SECONDS.inc(gg_s, phase="gram_gather")
+    _COLL_SECONDS.inc(dg_s, phase="dl_grad_reduce")
+    _COLL_SECONDS.inc(pg_s, phase="dl_param_gather")
     return {
         "allreduce_s": round(ar_s, 6),
         "reduce_scatter_s": round(rs_s, 6),
         "winner_gather_s": round(wg_s, 6),
+        "gram_reduce_s": round(gr_s, 6),
+        "gram_gather_s": round(gg_s, 6),
+        "dl_grad_reduce_s": round(dg_s, 6),
+        "dl_param_gather_s": round(pg_s, 6),
         "mode": "sharded" if sharded else "replicated",
+        "lane": "quant" if collectives.quant_enabled() else "exact",
     }
 
 

@@ -102,6 +102,34 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "state, mini_batch_size divisible by the shard count); "
                 "'0' = always replicated; '1' = on when eligible. "
                 "Ineligible configs always use the replicated reduce"),
+    "H2O3_TPU_COLLECTIVE_QUANT": (
+        "auto", "block-quantized collective lane (ops/collectives.py, "
+                "EQuARX-style) for the hot reduces — the tree histogram "
+                "hist_reduce, the GLM Gram gram_reduce, the DL gradient "
+                "dl_grad_reduce: each device's contribution crosses the "
+                "wire as an int8 payload + one f32 power-of-two scale per "
+                "block (all_to_all + dequantize-sum), ~4x fewer reduce "
+                "bytes; gain/solve-critical side payloads (b/deviance "
+                "psums, node totals, winner gathers, solve/param gathers) "
+                "stay exact f32, and the Gram/gradient reduces add a "
+                "residual-correction pass (~14 effective mantissa bits). "
+                "'auto' = on only when the mesh spans >1 process (the "
+                "ICI+DCN regime); '1' forces it anywhere (the A/B + parity "
+                "lane); '0' restores the stock f32 collectives bit-for-bit"),
+    "H2O3_TPU_COLLECTIVE_QUANT_BLOCK": (
+        "256", "elements per quantization block (one f32 scale each) in the "
+               "quantized collective lane; smaller blocks = tighter scales "
+               "= more accuracy and more scale overhead"),
+    "H2O3_TPU_COLLECTIVE_HIER": (
+        "auto", "two-stage hierarchical reduction placement for the "
+                "collective lane (arXiv:2110.10548): reduce exactly within "
+                "each contiguous inner sub-axis group first (the cheap ICI "
+                "level), then move only the — quantized, under "
+                "COLLECTIVE_QUANT — chunk payloads across groups (the "
+                "expensive DCN hop). 'auto' = group by each process's "
+                "devices when the mesh spans >1 process; an integer forces "
+                "that inner-group size (the A/B/test lane on the CPU "
+                "proxy); '0' = single-stage"),
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),

@@ -264,8 +264,13 @@ def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
                     return jnp.sum(wb_l * row_loss(prm, xb_l, yb_l, bk))
 
                 lsum, g = jax.value_and_grad(wsum_loss)(prm_flat)
-                gs = jax.lax.psum_scatter(
-                    g, ROWS_AXIS, scatter_dimension=0, tiled=True)
+                # the flat-gradient reduce rides the collective lane
+                # (ops/collectives.py): block-quantized with a residual-
+                # correction pass when on — the optimizer consumes the
+                # shard directly — stock psum_scatter bit-for-bit when off
+                from h2o3_tpu.ops import collectives
+
+                gs = collectives.psum_scatter(g, n_dev=n_sh, passes=2)
                 wsum = jax.lax.psum(jnp.sum(wb_l), ROWS_AXIS)
                 d = jax.lax.axis_index(ROWS_AXIS)
                 my = jax.lax.dynamic_slice(prm_flat, (d * fb,), (fb,))
@@ -393,18 +398,25 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
         key, _ = jax.random.split(key)  # uninterrupted run
     epochs_done = start_epochs
 
-    # modeled per-batch collective volume (replication-volume model):
-    # sharded = the 1/P gradient scatter + the full param gather; unsharded
-    # = the full replicated gradient reduce. Zero on a 1-device mesh.
+    # modeled per-batch collective volume, per lane: sharded = the 1/P
+    # gradient scatter (through the quantized collective lane when on —
+    # wire bytes + residual pass — exact f32 otherwise) + the exact wsum
+    # psum + the exact full param gather; unsharded = the full replicated
+    # gradient reduce (XLA-inserted — the lane cannot intercept it, exact
+    # by construction). Zero on a 1-device mesh.
     coll = {}
     if n_sh > 1:
+        from h2o3_tpu.ops.collectives import modeled_reduce_bytes
+
         n_param = n_real if shard_on else sum(
             int(np.prod(q.shape)) for q in jax.tree.leaves(params))
         if shard_on:
-            coll = {"dl_grad_reduce": (fpad / n_sh + 1) * 4.0,
-                    "dl_param_gather": fpad * 4.0}
+            reduce_lanes = dict(modeled_reduce_bytes(fpad, n_sh, passes=2))
+            reduce_lanes["exact"] = reduce_lanes.get("exact", 0.0) + 4.0
+            coll = {"dl_grad_reduce": reduce_lanes,
+                    "dl_param_gather": {"exact": fpad * 4.0}}
         else:
-            coll = {"dl_grad_reduce": n_param * 4.0}
+            coll = {"dl_grad_reduce": {"exact": n_param * 4.0}}
 
     e = start_epochs
     stopped = False
@@ -431,8 +443,11 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
             _DL_EPOCHS.inc()
             _DL_EPOCH_SECONDS.observe(_dt / k_i)
             keeper.record(float(losses[j]))
-        for ph, nb in coll.items():
-            _COLL_BYTES.inc(nb * k_i * nbatch, phase=ph)
+        for ph, lanes in coll.items():
+            for lane, nb in lanes.items():
+                if nb:
+                    _COLL_BYTES.inc(nb * k_i * nbatch, phase=ph)
+                    _COLL_BYTES.inc(nb * k_i * nbatch, phase=ph, lane=lane)
         if on_epoch is not None:
             if shard_on:
                 on_epoch(unravel(params[:n_real]),

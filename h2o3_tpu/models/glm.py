@@ -234,12 +234,18 @@ def _fused_chunk_program(npad, p_pad, family_key, fam_args, l1_on,
         rendezvous per iteration instead of five (collective count, not
         just volume, is what the CPU proxy pays for)."""
         def local(Xl, yl, wl, ol, beta):
+            from h2o3_tpu.ops import collectives
+
             W, z, dev = _irls_weights(fam, Xl, yl, wl, ol, beta)
             Xw = Xl * W[:, None]
             G_l = jnp.einsum("np,nq->pq", Xw, Xl, precision=_HI)
             b_l = jnp.einsum("np,n->p", Xw, z, precision=_HI)
-            G_blk = jax.lax.psum_scatter(
-                G_l, ROWS_AXIS, scatter_dimension=0, tiled=True)
+            # the bulk G reduce rides the collective lane (quantized with a
+            # residual-correction pass when on — the solve consumes G, so
+            # it keeps ~14 effective mantissa bits); the small packed
+            # b/deviance psum and the solve's G gather stay exact f32 so
+            # convergence tests and the solve RHS are untouched
+            G_blk = collectives.psum_scatter(G_l, n_dev=n_sh, passes=2)
             vec = jax.lax.psum(
                 jnp.concatenate([b_l, dev[None]]), ROWS_AXIS)
             G = jax.lax.all_gather(G_blk, ROWS_AXIS, axis=0, tiled=True)
@@ -735,9 +741,12 @@ class GLM(ModelBuilder):
                         _IRLS_ITERS.inc(n_done)
                         for _ in range(n_done):
                             _IRLS_SECONDS.observe(_dt / n_done)
-                        for ph, nb in coll_model.items():
-                            if nb:
-                                _COLL_BYTES.inc(nb * n_done, phase=ph)
+                        for ph, lanes in coll_model.items():
+                            for lane, nb in lanes.items():
+                                if nb:
+                                    _COLL_BYTES.inc(nb * n_done, phase=ph)
+                                    _COLL_BYTES.inc(
+                                        nb * n_done, phase=ph, lane=lane)
                     iters_done += n_done
                     it_pos = max_iter if stop else iters_done
                     snapshot(li, it_pos, iters_done, dev_prev, beta)

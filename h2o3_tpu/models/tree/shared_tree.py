@@ -69,6 +69,13 @@ _BUILD_COUNTERS = {
         "tree_program_cache_hits_total",
         "whole-tree/chunk program cache hits (same shape, no recompile)",
         always=True),
+    # saturated-region while_loop iterations that actually EXECUTED (the
+    # on-device early exit can skip the rest): read back per dispatch and
+    # used to scale the sat-region byte tallies to actual volume
+    "sat_levels_executed": _metrics.counter(
+        "tree_sat_levels_total",
+        "node_cap-saturated tree levels actually executed by the fused "
+        "builds' while_loop (post-early-exit)", always=True),
 }
 _FUSED_SECONDS = _metrics.counter(
     "tree_fused_build_seconds_total",
@@ -112,18 +119,26 @@ _HIST_HBM_BYTES = _metrics.counter(
     "tree builds, by pipeline path", always=True)
 
 # program-key registry + per-program collective tallies: _run_counted
-# captures a program's (phase -> bytes) tally during its first (tracing)
-# dispatch and replays it on every later one.
+# captures a program's ((phase, lane, group) -> bytes) tally during its
+# first (tracing) dispatch and replays it on every later one.
 _PROG_KEY: dict[int, tuple] = {}
 _PROG_COLL: dict = {}
 
 
-def _run_counted(fn, args, mult: int = 1):
+def _run_counted(fn, args, mult: int = 1, sat_from=None):
     """Dispatch ``fn(*args)`` with collective byte accounting.
 
     ``mult`` scales the traced tally per dispatch (a scanned chunk's body
-    traces once but executes once per tree)."""
-    from h2o3_tpu.ops.histogram import collective_tally
+    traces once but executes once per tree). Entries recorded under
+    ``tally_group("sat")`` — the node_cap-saturated while_loop body, traced
+    once but executed a data-dependent number of times — are instead
+    scaled by the EXECUTED iteration count, extracted from the program's
+    output via ``sat_from(out)`` (the fused programs return it), so the
+    counters report actual volume, not the old n_sat trace-time upper
+    bound. Reading that scalar syncs the dispatch — one int32 pull, and
+    only for programs that traced a saturated region at all (deep builds
+    whose per-level cost dwarfs it; GBM-typical shallow trees never pay)."""
+    from h2o3_tpu.ops.collectives import collective_tally
 
     key = _PROG_KEY.get(id(fn), id(fn))
     agg = _PROG_COLL.get(key)
@@ -132,18 +147,31 @@ def _run_counted(fn, args, mult: int = 1):
         with collective_tally(entries):
             out = fn(*args)
         agg = {}
-        for ph, b in entries:
-            agg[ph] = agg.get(ph, 0.0) + b
+        for ph, lane, grp, b in entries:
+            k = (ph, lane, grp)
+            agg[k] = agg.get(k, 0.0) + b
         _PROG_COLL[key] = agg
     else:
         out = fn(*args)
-    for ph, b in agg.items():
-        if not b:
+    sat_n = None
+    for (ph, lane, grp), b in agg.items():
+        if grp == "sat":
+            if sat_n is None:
+                sat_n = (
+                    int(jax.device_get(sat_from(out)))
+                    if sat_from is not None else 0
+                )
+                BUILD_STATS["sat_levels_executed"] += sat_n
+            m = sat_n
+        else:
+            m = mult
+        if not b or not m:
             continue
         if ph.startswith("hbm/"):
-            _HIST_HBM_BYTES.inc(b * mult, path=ph[4:])
+            _HIST_HBM_BYTES.inc(b * m, path=ph[4:])
         else:
-            _COLL_BYTES.inc(b * mult, phase=ph)
+            _COLL_BYTES.inc(b * m, phase=ph)
+            _COLL_BYTES.inc(b * m, phase=ph, lane=lane)
     return out
 
 
@@ -1136,6 +1164,7 @@ def _fused_levels(
         ).reshape(n_pad, *built.shape[1:]), None
 
     depth = 0
+    sat_iters = jnp.int32(0)  # executed saturated-region levels (0 if none)
     while depth <= max_depth:
         n_pad = min(1 << depth, node_cap)
         n_pad_next = min(2 * n_pad, node_cap)
@@ -1201,14 +1230,16 @@ def _fused_levels(
                 # thread dummies of fixed shape so one body serves both
                 parent_hist = jnp.zeros((node_cap, 1, 1, 1), jnp.float32)
                 pair_info = pair_info or {}
-            from h2o3_tpu.ops.histogram import tally_weight
+            from h2o3_tpu.ops.collectives import tally_group
 
-            # the saturated body traces ONCE but executes up to n_sat times:
-            # scale its collective byte tally accordingly (an upper bound —
-            # the on-device early exit can skip levels the tally counts)
-            with tally_weight(n_sat):
-                (_, nid, preds, varimp, n_split, parent_hist, pair_info,
-                 bufs) = jax.lax.while_loop(
+            # the saturated body traces ONCE but executes a data-dependent
+            # number of times (on-device early exit): its tally entries are
+            # tagged and scaled at DISPATCH time by the executed iteration
+            # count returned below (_run_counted), so the byte counters
+            # report actual volume, not the n_sat upper bound
+            with tally_group("sat"):
+                (sat_iters, nid, preds, varimp, n_split, parent_hist,
+                 pair_info, bufs) = jax.lax.while_loop(
                     sat_cond, sat_body,
                     (jnp.int32(0), nid, preds, varimp, n_split, parent_hist,
                      pair_info, bufs),
@@ -1272,7 +1303,7 @@ def _fused_levels(
                 rec = dict(rec, split_bin=rec["split_bin"] << sd)
         recs.append(rec)
         depth += 1
-    return nid, preds, varimp, tuple(recs)
+    return nid, preds, varimp, tuple(recs), sat_iters
 
 
 def _subtract_enabled() -> bool:
@@ -1493,7 +1524,7 @@ def _tree_program(
                 is_cat = jnp.pad(is_cat, (0, Cp - C))
                 varimp = jnp.pad(varimp, (0, Cp - C))
                 cols_enabled = jnp.pad(cols_enabled, (0, Cp - C))
-            nid, preds_, varimp_, records = _fused_levels(
+            nid, preds_, varimp_, records, sat_iters = _fused_levels(
                 bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg,
@@ -1501,7 +1532,7 @@ def _tree_program(
                 cat_cols=cat_cols, subtract=subtract, n_cols_real=n_cols_real,
                 split_shard=split_shard, split_fuse=split_fuse,
             )
-            return nid, preds_, varimp_[:C], records
+            return nid, preds_, varimp_[:C], records, sat_iters
 
         return jax.jit(whole_tree, donate_argnums=(1, 2))
 
@@ -1619,7 +1650,7 @@ def build_trees_scanned(
                 if Cp > C:
                     cols_enabled = jnp.pad(cols_enabled, (0, Cp - C))
 
-                _, F, vi, recs = _fused_levels(
+                _, F, vi, recs, sat_i = _fused_levels(
                     bins_u8, F, vi, w_tree, wy, wh, tkey, cols_enabled,
                     is_cat, min_rows_, msi_, lr, max_abs_leaf_, col_rate_,
                     leaf_reg_,
@@ -1627,12 +1658,14 @@ def build_trees_scanned(
                     cat_cols=cat_cols, subtract=subtract, n_cols_real=C,
                     split_shard=split_shard, split_fuse=split_fuse,
                 )
-                return (F, vi), recs
+                return (F, vi), (recs, sat_i)
 
-            (preds, varimp), stacked = jax.lax.scan(
+            (preds, varimp), (stacked, sat_per_tree) = jax.lax.scan(
                 body, (preds, varimp), (jnp.arange(n_trees), lrs)
             )
-            return preds, varimp[:C], stacked
+            # total executed saturated-region levels across the chunk's
+            # trees — the dispatch-time weight for the sat byte tallies
+            return preds, varimp[:C], stacked, sat_per_tree.sum()
 
         # preds/varimp donated: chunk t+1 reuses chunk t's output buffers in
         # place — the running prediction never copies between dispatches
@@ -1654,7 +1687,9 @@ def build_trees_scanned(
     import time as _time
 
     _t0 = _time.perf_counter()
-    # the scan body traces once but runs once per tree: mult=n_trees
+    # the scan body traces once but runs once per tree: mult=n_trees; the
+    # saturated-region tallies instead scale by the chunk's total EXECUTED
+    # sat levels, returned as the program's last output
     out = _run_counted(
         prog,
         (
@@ -1665,9 +1700,10 @@ def build_trees_scanned(
             jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
         ),
         mult=n_trees,
+        sat_from=lambda o: o[3],
     )
     _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
-    return out
+    return out[:3]
 
 
 def scan_chunk_cap(
@@ -1967,7 +2003,7 @@ def build_tree(
         import time as _time
 
         _t0 = _time.perf_counter()
-        _, preds, varimp, records = _run_counted(
+        _, preds, varimp, records, _sat = _run_counted(
             prog,
             (
                 bins_u8, preds, varimp, w, wy, wh, key, cols_enabled_dev,
@@ -1976,6 +2012,7 @@ def build_tree(
                 jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
                 jnp.float32(col_sample_rate), leaf_reg,
             ),
+            sat_from=lambda o: o[4],
         )
         _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
         for rec in records:

@@ -63,15 +63,20 @@ def weighted_gram_sharded(X, w, z, mesh=None):
     p = X.shape[1]
     assert p % n_sh == 0, f"gram width {p} not divisible by {n_sh} shards"
 
+    from h2o3_tpu.ops import collectives
+
     def local(Xl, wl, zl):
         Xw = Xl * wl[:, None]
         G_l = jnp.einsum("np,nq->pq", Xw, Xl, precision=_P)
         b_l = jnp.einsum("np,n->p", Xw, zl, precision=_P)
-        # contiguous row blocks: device d keeps G rows [d*p/P, (d+1)*p/P)
-        G_blk = jax.lax.psum_scatter(
-            G_l, ROWS_AXIS, scatter_dimension=0, tiled=True
-        )
+        # contiguous row blocks: device d keeps G rows [d*p/P, (d+1)*p/P).
+        # The reduce runs through the collective lane (stock psum_scatter
+        # when quant is off); passes=2 adds the residual-correction pass —
+        # G feeds the solve directly, so it gets ~14 effective mantissa
+        # bits instead of bare int8
+        G_blk = collectives.psum_scatter(G_l, n_dev=n_sh, passes=2)
         # the solve needs the full (p, p) matrix exactly once per iteration
+        # — and exactly as reduced: the gather stays f32 (exact lane)
         G = jax.lax.all_gather(G_blk, ROWS_AXIS, axis=0, tiled=True)
         b = jax.lax.psum(b_l, ROWS_AXIS)
         sw = jax.lax.psum(wl.sum(dtype=jnp.float32), ROWS_AXIS)
@@ -86,15 +91,23 @@ def weighted_gram_sharded(X, w, z, mesh=None):
 
 
 def gram_collective_bytes(p_pad: int, n_shards: int) -> dict:
-    """Replication-volume model (the PR-5 accounting) of ONE sharded Gram
-    pass: ``gram_reduce`` = what the psum_scatter + b/sw psums leave on each
-    device, ``gram_gather`` = the one all_gather that reassembles G for the
-    solve. Zero on a 1-device mesh (nothing moves)."""
+    """Per-lane replication-volume model (the PR-5 accounting) of ONE
+    sharded Gram pass: ``gram_reduce`` = the G psum_scatter (through the
+    quantized lane when on — ``lane=quant`` wire bytes, with its
+    residual-correction pass) + the exact b/sw (or packed b/deviance)
+    psums, ``gram_gather`` = the one exact all_gather that reassembles G
+    for the solve. Shape: {phase: {lane: bytes}}; empty lanes on a
+    1-device mesh (nothing moves)."""
+    from h2o3_tpu.ops.collectives import modeled_reduce_bytes
+
     if n_shards <= 1:
-        return {"gram_reduce": 0.0, "gram_gather": 0.0}
+        return {"gram_reduce": {}, "gram_gather": {}}
+    reduce_lanes = dict(modeled_reduce_bytes(
+        p_pad * p_pad, n_shards, passes=2))
+    reduce_lanes["exact"] = reduce_lanes.get("exact", 0.0) + (p_pad + 1) * 4.0
     return {
-        "gram_reduce": (p_pad * p_pad / n_shards + p_pad + 1) * 4.0,
-        "gram_gather": p_pad * p_pad * 4.0,
+        "gram_reduce": reduce_lanes,
+        "gram_gather": {"exact": p_pad * p_pad * 4.0},
     }
 
 

@@ -38,8 +38,6 @@ scan + partition fuse into one compiled launch with zero host round-trips.
 
 from __future__ import annotations
 
-import contextlib
-import contextvars
 from functools import partial
 
 import jax
@@ -53,62 +51,16 @@ from h2o3_tpu.parallel.mesh import (
     shard_map,
 )
 
-# ---------------------------------------------------------------------------
-# collective byte tally — trace-time accounting of the cross-device payload
-# the tree phases move. Collectives live inside fused jitted programs, so
-# per-execution host counting is impossible; instead every collective call
-# site below records, AT TRACE TIME, the bytes its one execution will move,
-# and the dispatching caller (shared_tree._run_counted) captures the tally
-# during the program's first trace and replays it per dispatch. The model is
-# REPLICATION VOLUME — the reduced/gathered bytes the collective leaves on
-# each device (psum: the full reduced tensor, psum_scatter: only the kept
-# 1/P shard, all_gather: P x the local contribution) — i.e. the O(C·N·B·S)
-# vs O(C·N·B·S/P) quantity the sharded split pipeline shrinks, not wire
-# bytes. A 1-device mesh moves nothing and tallies 0.
-
-_TALLY: contextvars.ContextVar[list | None] = contextvars.ContextVar(
-    "h2o3_coll_tally", default=None
+# The trace-time collective byte tally moved to ops/collectives.py (which
+# also owns the quantized/hierarchical reduce lane the reductions below run
+# through); re-exported here because this module is where the tally was
+# born and half the stack imports it from here.
+from h2o3_tpu.ops.collectives import (  # noqa: F401  (re-exports)
+    collective_tally,
+    record_collective,
+    record_hbm,
+    tally_group,
 )
-_TALLY_WEIGHT: contextvars.ContextVar[int] = contextvars.ContextVar(
-    "h2o3_coll_weight", default=1
-)
-
-
-@contextlib.contextmanager
-def collective_tally(out: list):
-    """Collect (phase, bytes) entries recorded while tracing under this."""
-    tok = _TALLY.set(out)
-    try:
-        yield out
-    finally:
-        _TALLY.reset(tok)
-
-
-@contextlib.contextmanager
-def tally_weight(k: int):
-    """Scale entries recorded inside by ``k`` (loop bodies traced once but
-    executed up to ``k`` times — e.g. the node_cap-saturated while_loop)."""
-    tok = _TALLY_WEIGHT.set(_TALLY_WEIGHT.get() * max(int(k), 0))
-    try:
-        yield
-    finally:
-        _TALLY_WEIGHT.reset(tok)
-
-
-def record_collective(phase: str, nbytes: float) -> None:
-    lst = _TALLY.get()
-    if lst is not None and nbytes > 0:
-        lst.append((phase, float(nbytes) * _TALLY_WEIGHT.get()))
-
-
-def record_hbm(path: str, nbytes: float) -> None:
-    """Trace-time tally of the MODELED per-device HBM traffic of the
-    histogram+split phases (``tree_hist_hbm_bytes_total{path}``): one write
-    per materialized intermediate plus one read per consumed one, recorded
-    where the intermediates are created (here) and replayed per dispatch by
-    shared_tree._run_counted — the fused pipeline's acceptance metric. Rides
-    the same tally as the collective bytes under an ``hbm/`` phase prefix."""
-    record_collective("hbm/" + path, nbytes)
 
 # Rows per scatter chunk: XLA materializes the vmapped scatter's updates as
 # a (C, chunk, S) f32 broadcast (~1.2 KB/row at C=28, S=4 — measured 13.4 GB
@@ -294,35 +246,39 @@ def histogram_in_jit(
             col_sharded=col_sharded,
         )
 
+    from h2o3_tpu.ops import collectives
+
     def body(b, n, s):
         # retired/padding rows (nid < 0) carry zero stats into every impl
         s = jnp.where((n >= 0)[:, None], s, 0.0)
         h = local(b, n, s, n_nodes, n_bins)
+        # the cross-device reduction runs through the collective lane
+        # (ops/collectives.py): stock psum/psum_scatter when the quant lane
+        # is off — bit-for-bit the pre-lane program — or the block-
+        # quantized / hierarchical variant when on; the lane records the
+        # hist_reduce byte tally (per lane) itself
+        # lane_axis=-1: the S stat lanes {w, wy, wh} differ by orders of
+        # magnitude and must not share quantization blocks
         if not col_sharded:
-            return jax.lax.psum(h, ROWS_AXIS)
+            return collectives.psum(
+                h, n_dev=n_dev, phase="hist_reduce", lane_axis=-1)
         if Cp > C:
             # divisibility pad on the HISTOGRAM (cheap: hist-sized, not
             # bins-sized) so C < P and C % P != 0 stay correct with no
             # full-frame column padding anywhere
             h = jnp.pad(h, ((0, Cp - C), (0, 0), (0, 0)))
-        return jax.lax.psum_scatter(
-            h, ROWS_AXIS, scatter_dimension=0, tiled=True
-        )
+        return collectives.psum_scatter(
+            h, n_dev=n_dev, phase="hist_reduce", lane_axis=-1)
 
     smat = jnp.stack(list(stats), axis=1)  # (n, S)
-    if n_dev > 1:
-        cell_bytes = n_nodes * n_bins * S * 4
-        if col_sharded:
-            record_collective("hist_reduce", Cp * cell_bytes / n_dev)
-        else:
-            record_collective("hist_reduce", C * cell_bytes)
 
     # HBM model of the unfused pipeline (see record_hbm): the dense tensor
     # is written once and its (possibly column-sharded) slice re-read by the
     # split scan; the Pallas local impl additionally pays its two unscramble
     # passes over the padded kernel output. Terminal force-leaf levels skip
-    # the scan read — like the saturated-region collective tally, this is a
-    # deliberate upper bound.
+    # the scan read this counts — a deliberate (small) upper bound; the
+    # saturated-region entries, by contrast, are scaled by the EXECUTED
+    # iteration count at dispatch time (tally_group in collectives.py).
     dense_b = C * n_nodes * n_bins * S * 4
     scan_b = (Cp / n_dev if col_sharded else C) * n_nodes * n_bins * S * 4
     if _local_is_pallas(local):
@@ -369,6 +325,8 @@ def _histogram_in_jit_fused(
         n_shards=n_dev if col_sharded else 1,
     )
 
+    from h2o3_tpu.ops import collectives
+
     def body(b, n, s):
         s = jnp.where((n >= 0)[:, None], s, 0.0)
         if is_pallas:
@@ -380,18 +338,14 @@ def _histogram_in_jit_fused(
             )
         else:
             h = blocked_from_dense(local(b, n, s, n_nodes, n_bins), layout)
+        # whole-column-tile reduce through the collective lane (quantized /
+        # hierarchical when on, stock otherwise) — it records the
+        # hist_reduce byte tally per lane
         if not col_sharded:
-            return jax.lax.psum(h, ROWS_AXIS)
-        return jax.lax.psum_scatter(
-            h, ROWS_AXIS, scatter_dimension=0, tiled=True
-        )
+            return collectives.psum(h, n_dev=n_dev, phase="hist_reduce")
+        return collectives.psum_scatter(h, n_dev=n_dev, phase="hist_reduce")
 
     smat = jnp.stack(list(stats), axis=1)
-    if n_dev > 1:
-        record_collective(
-            "hist_reduce",
-            layout.nbytes / n_dev if col_sharded else layout.nbytes,
-        )
     # HBM model (see record_hbm): the blocked tensor is written once by the
     # kernel and its (possibly 1/P) slice read once by the split kernel —
     # no unscramble pass exists. The dense-impl lane re-blocks locally and

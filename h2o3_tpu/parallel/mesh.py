@@ -111,9 +111,68 @@ def mesh_key() -> tuple:
     shard_map block layouts bake the mesh in at trace time, so a program
     compiled for one mesh must never serve another (tests swap 1/2/8-device
     sub-meshes within one process). Shared by the tree, GLM and DL program
-    caches."""
+    caches. Includes the collective-lane key (ops/collectives.quant_key):
+    the quant/hierarchy knobs change the traced reduce structure, so every
+    program cache picks them up through this one chokepoint."""
+    from h2o3_tpu.ops.collectives import quant_key
+
     m = get_mesh()
-    return (m.shape[ROWS_AXIS] if hasattr(m, "shape") else 0, id(m))
+    return (
+        m.shape[ROWS_AXIS] if hasattr(m, "shape") else 0, id(m), quant_key()
+    )
+
+
+# ---------------------------------------------------------------------------
+# hierarchical reduction placement (ops/collectives.py two-stage lane): the
+# 1-D rows axis factors into contiguous INNER groups (the cheap interconnect
+# level — ICI within a slice/host) × an OUTER level (the expensive hop —
+# DCN across hosts). This module owns the mesh-level resolution so a future
+# 2D mesh (ROADMAP item 2) changes exactly one function.
+
+
+def hier_inner(n_dev: int | None = None) -> int:
+    """Inner-group size of the two-stage hierarchical reduction, or 0 for
+    single-stage. ``H2O3_TPU_COLLECTIVE_HIER``: 'auto' groups by the
+    devices each process contributes (the ICI/DCN boundary) when the mesh
+    spans >1 process and the factorization is clean; an integer forces that
+    inner size (the A/B + test lane — e.g. '2' splits the 8-device CPU
+    proxy into 4 fake-ICI pairs); '0'/'' disables."""
+    from h2o3_tpu import config
+
+    if n_dev is None:
+        n_dev = n_shards()
+    v = config.get("H2O3_TPU_COLLECTIVE_HIER").strip().lower()
+    if v in ("0", "", "false"):
+        return 0
+    if v == "auto":
+        try:
+            inner = jax.local_device_count()
+        except RuntimeError:
+            return 0
+        if jax.process_count() <= 1:
+            return 0
+    else:
+        inner = int(v)
+    if 1 < inner < n_dev and n_dev % inner == 0:
+        return inner
+    return 0
+
+
+def hier_groups(n_dev: int, inner: int) -> tuple[list, list]:
+    """(inner_groups, cross_groups) for :func:`hier_inner`'s factorization:
+    inner groups are contiguous runs of ``inner`` device indices (stage-1
+    exact reduce); cross groups tie position ``j`` of every inner group
+    together (stage-2 quantized exchange). Ascending order inside every
+    group is load-bearing: grouped collectives exchange by listed position,
+    and the lane's chunk remap assumes position == outer index."""
+    outer = n_dev // inner
+    inner_groups = [
+        list(range(g * inner, (g + 1) * inner)) for g in range(outer)
+    ]
+    cross_groups = [
+        [g * inner + j for g in range(outer)] for j in range(inner)
+    ]
+    return inner_groups, cross_groups
 
 
 def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:

@@ -359,6 +359,116 @@ def dl_ab(rows: int = 20_000, cols: int = 16) -> None:
         }}), flush=True)
 
 
+def quant_ab(rows: int = 16_000, cols: int = 12) -> None:
+    """Quantized-collective-lane A/B (H2O3_TPU_COLLECTIVE_QUANT, ISSUE 9)
+    on the SAME mesh and frames: per mode (quant / exact), a GBM train
+    (modeled per-phase collective bytes WITH the {lane} split, train wall
+    seconds, AUC) plus a GLM train (Gram bytes, coefficient vector) plus
+    MEASURED reduce seconds at the bench histogram/Gram shapes through the
+    active lane — then a {"quant_ab": ...} summary with the byte ratios and
+    the accuracy deltas the acceptance pins (hist_reduce >= 2x fewer
+    modeled bytes, GBM AUC delta <= 1e-3, GLM coefficient parity). The env
+    toggle works in-process because every program cache keys on the lane
+    through mesh_key(). On the CPU proxy the quantized lane's measured
+    seconds are usually SLOWER (the int8 encode + all_to_all emulation of a
+    fused quantized collective is extra host-side work); the wire-byte
+    model is the claim, and the real-TPU/DCN window decides the wall-clock
+    question — which is why the measured seconds ride along."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as Spec
+
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.models.tree import GBM
+    from h2o3_tpu.ops import collectives
+    from h2o3_tpu.parallel.mesh import (
+        ROWS_AXIS, get_mesh, pad_cols_to_shards, shard_map)
+    from h2o3_tpu.utils import metrics as mx
+
+    mesh = get_mesh()
+    n_dev = mesh.devices.size
+    fr = _ab_frame(rows, cols)
+    phases = ("hist_reduce", "winner_gather", "gram_reduce", "gram_gather")
+
+    def measured_reduce_s(iters=10):
+        hist = jnp.ones((pad_cols_to_shards(28), 64 * 128, 3), jnp.float32)
+        fn = jax.jit(shard_map(
+            lambda v: collectives.psum_scatter(
+                v, n_dev=n_dev, lane_axis=-1),
+            mesh=mesh, in_specs=(Spec(),), out_specs=Spec(ROWS_AXIS),
+            check_vma=False))
+        out = fn(hist)
+        jax.block_until_ready(out)
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            out = fn(hist)
+        jax.block_until_ready(out)
+        return (_time.perf_counter() - t0) / iters
+
+    results = {}
+    for mode in ("quant", "exact"):
+        os.environ["H2O3_TPU_COLLECTIVE_QUANT"] = (
+            "1" if mode == "quant" else "0")
+        b0 = {(ph, ln): mx.counter_value(
+            "tree_collective_bytes_total", phase=ph, **(
+                {"lane": ln} if ln else {}))
+            for ph in phases for ln in ("", "quant", "exact")}
+
+        GBM(ntrees=10, max_depth=5, seed=7).train(
+            y="label", training_frame=fr)  # compile warmup
+        t0 = _time.perf_counter()
+        m = GBM(ntrees=10, max_depth=5, seed=7).train(
+            y="label", training_frame=fr)
+        gbm_s = _time.perf_counter() - t0
+        glm = GLM(family="binomial", lambda_=1e-4, max_iterations=20,
+                  seed=1).train(y="label", training_frame=fr)
+
+        db = {}
+        for ph in phases:
+            for ln in ("", "quant", "exact"):
+                v = mx.counter_value(
+                    "tree_collective_bytes_total", phase=ph, **(
+                        {"lane": ln} if ln else {})) - b0[(ph, ln)]
+                if v:
+                    db[ph if not ln else f"{ph}{{lane={ln}}}"] = round(v, 1)
+        rec = {
+            "phase": "quant_ab", "mode": mode, "n_devices": n_dev,
+            "rows": rows, "cols": cols,
+            "quant_block": collectives.quant_block(),
+            "gbm_train_s": round(gbm_s, 4),
+            "gbm_auc": round(float(m.training_metrics.auc), 5),
+            "glm_coef": {k: round(v, 8) for k, v in glm.coef.items()},
+            "glm_auc": round(float(glm.training_metrics.auc), 5),
+            "collective_bytes": db,
+            "measured_hist_reduce_s": round(measured_reduce_s(), 6),
+        }
+        print(json.dumps(rec), flush=True)
+        results[mode] = rec
+    os.environ.pop("H2O3_TPU_COLLECTIVE_QUANT", None)
+    if len(results) == 2:
+        q, e = results["quant"], results["exact"]
+        hq = q["collective_bytes"].get("hist_reduce", 0)
+        he = e["collective_bytes"].get("hist_reduce", 0)
+        coef_delta = max(
+            abs(q["glm_coef"][k] - e["glm_coef"][k]) for k in e["glm_coef"])
+        print(json.dumps({"quant_ab": {
+            "hist_bytes_ratio_exact_over_quant": round(he / max(hq, 1), 2),
+            "gram_bytes_ratio_exact_over_quant": round(
+                e["collective_bytes"].get("gram_reduce", 0)
+                / max(q["collective_bytes"].get("gram_reduce", 0), 1), 2),
+            "gbm_auc_delta": round(abs(q["gbm_auc"] - e["gbm_auc"]), 5),
+            "glm_coef_max_delta": round(coef_delta, 8),
+            "time_ratio_exact_over_quant": round(
+                e["gbm_train_s"] / max(q["gbm_train_s"], 1e-9), 3),
+            "measured_hist_reduce_s": {
+                "quant": q["measured_hist_reduce_s"],
+                "exact": e["measured_hist_reduce_s"],
+            },
+        }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -431,5 +541,7 @@ if __name__ == "__main__":
         glm_ab(**kw)
     elif "--dl-ab" in sys.argv:
         dl_ab(**kw)
+    elif "--quant-ab" in sys.argv:
+        quant_ab(**kw)
     else:
         main()

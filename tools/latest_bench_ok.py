@@ -97,6 +97,59 @@ def _loadtest_ok(here: str, now: float):
         return False
 
 
+def _quant_ab_ok(here: str, now: float):
+    """Sanity-check the newest recent QUANT_AB_*.jsonl (bench_kernel_sweep
+    --quant-ab, the quantized-collective-lane A/B). Returns None when no
+    recent artifact exists (no opinion), else True/False. Checks the
+    acceptance pins: modeled hist_reduce bytes ratio >= 2 (the lane's
+    reason to exist), GBM AUC delta <= 1e-3 and a finite small GLM
+    coefficient delta (accuracy envelopes) — a summary violating them
+    means the lane regressed and the window's numbers are noise."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "QUANT_AB_*.jsonl")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "quant_ab" in d:
+                    summary = d["quant_ab"]
+        if not summary:
+            print(f"{name}: NO quant_ab summary line")
+            return False
+        ratio = float(summary.get("hist_bytes_ratio_exact_over_quant") or 0)
+        auc_d = float(summary.get("gbm_auc_delta", float("nan")))
+        coef_d = float(summary.get("glm_coef_max_delta", float("nan")))
+        if not ratio >= 2.0:
+            print(f"{name}: hist_reduce byte ratio {ratio} < 2x")
+            return False
+        if not auc_d <= 1e-3:
+            print(f"{name}: GBM AUC delta {auc_d} > 1e-3")
+            return False
+        if not coef_d <= 1e-2:
+            print(f"{name}: GLM coef delta {coef_d} > 1e-2")
+            return False
+        print(f"{name}: bytes-ratio={ratio} auc-delta={auc_d} "
+              f"coef-delta={coef_d} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def main() -> int:
     import time
 
@@ -106,6 +159,12 @@ def main() -> int:
     # must be sane, or the window's serving A/B numbers are untrustworthy
     lt = _loadtest_ok(here, now)
     if lt is False:
+        return 1
+    # quantized-collective-lane gate (ISSUE 9): same contract — a recent
+    # --quant-ab artifact must satisfy the acceptance pins or the window
+    # stands
+    qa = _quant_ab_ok(here, now)
+    if qa is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -25,6 +25,15 @@
 #      (H2O3_TPU_SCORE_BATCH_WINDOW_MS=0); artifact carries p50/p99, shed
 #      rate, batch-occupancy histogram and the byte-parity probe.
 #      tools/latest_bench_ok.py gates on the artifact's sanity.
+#   7. quantized collective lane A/B (ISSUE 9): H2O3_TPU_COLLECTIVE_QUANT=1
+#      vs =0 — per-phase modeled bytes with the {lane} split, measured
+#      reduce seconds through the active lane, GBM AUC + GLM coefficient
+#      deltas (CPU-proxy numbers in QUANT_AB_*_cpu8proxy.jsonl: 3.94x fewer
+#      hist_reduce bytes, AUC delta <1e-3). The wire-byte win is a DCN
+#      claim — THIS window's measured seconds on real interconnect are the
+#      number that decides whether the lane defaults on for pods. Plus a
+#      QUANT=1 headline run and the QUANT=0 headline control.
+#      tools/latest_bench_ok.py gates on the artifact's sanity.
 set -x
 cd "$(dirname "$0")/.."
 
@@ -107,3 +116,20 @@ H2O3_TPU_DL_EPOCH_CHUNK=1 H2O3_TPU_DL_GRAD_SHARD=0 H2O3_TPU_BENCH_DEADLINE_S=1 \
   timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_dlperepoch.json"  # per-epoch DL control
 save "BENCH_builder_${stamp}_dlperepoch.json" "TPU bench per-epoch DL control (headline only)"
+
+# quantized collective lane A/B (ISSUE 9): modeled bytes + measured reduce
+# seconds + accuracy deltas, quant vs exact, on the real interconnect
+timeout 1200 python tools/bench_kernel_sweep.py --quant-ab \
+  | tee "QUANT_AB_${stamp}.jsonl"
+save "QUANT_AB_${stamp}.jsonl" "Quantized-collective-lane A/B (bytes, measured seconds, accuracy)"
+
+# bench headline under the quantized lane, with the exact-lane control:
+# H2O3_TPU_COLLECTIVE_QUANT=auto is off for single-process meshes, so both
+# sides pin the knob explicitly
+H2O3_TPU_COLLECTIVE_QUANT=1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_quant.json"
+save "BENCH_builder_${stamp}_quant.json" "TPU bench quantized-collective headline (headline only)"
+
+H2O3_TPU_COLLECTIVE_QUANT=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_quant0.json"  # exact-lane headline control
+save "BENCH_builder_${stamp}_quant0.json" "TPU bench exact-collective control (headline only)"
