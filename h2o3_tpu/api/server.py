@@ -90,8 +90,8 @@ def _admission_enter(method: str, route: str) -> bool:
     overloaded or draining cloud must stay observable."""
     if method == "GET":
         return False
-    if route == r"/3/Shutdown":
-        return False  # the drain/shutdown request must land under overload
+    if route in (r"/3/Shutdown", r"/3/Recover"):
+        return False  # drain/shutdown/recover ops must land under overload
     if _DRAINING:
         _REST_REJECTED.inc(method=method, route=route or "/", reason="draining")
         raise ApiError(
@@ -413,6 +413,9 @@ class Endpoints:
             # fail-stop latch reason (cluster_info sets it after a dead-member
             # collective failure) — the diagnostic operators need
             **({"degraded": info["degraded"]} if info.get("degraded") else {}),
+            # cloud formation epoch: ticks on every supervised recover()
+            # reform (cluster/recovery.py; the spmd generation fence)
+            "generation": info.get("generation", 0),
             "nodes": nodes,
         }
 
@@ -646,16 +649,30 @@ class Endpoints:
         if train_key is None:
             raise ApiError(400, "training_frame is required")
         cls(**kwargs)  # validate params NOW so bad requests fail fast
-        from h2o3_tpu.cluster import spmd
+        from h2o3_tpu.cluster import recovery, spmd
 
         dest = DKV.make_key(algo)  # coordinator-chosen, carried to followers
-        job = _start_job(
-            lambda j: spmd.run(
-                "build", algo=algo, kwargs=kwargs, x=x, y=y,
-                train=train_key, valid=valid_key, dest=dest,
-            ),
-            f"{algo} build",
-        )
+        ckdir = kwargs.get("export_checkpoints_dir")
+
+        def _work(j):
+            # checkpointed builds run under the recovery supervisor: a cloud
+            # failure (dead member, watchdog trip) re-forms the cloud and
+            # relaunches from the latest interval snapshot instead of dying
+            # at the operator (cluster/recovery.py; H2O3_TPU_RECOVERY=0
+            # restores the plain fail-stop launch — run_supervised then
+            # propagates the first failure untouched)
+            def _launch(ckpt):
+                kw = dict(kwargs, checkpoint=ckpt) if ckpt else kwargs
+                return spmd.run(
+                    "build", algo=algo, kwargs=kw, x=x, y=y,
+                    train=train_key, valid=valid_key, dest=dest,
+                )
+
+            return recovery.run_supervised(
+                _launch, ckdir=ckdir, algo=algo,
+                description=f"{algo} build", job=j)
+
+        job = _start_job(_work, f"{algo} build")
         return {"__meta": {"schema_type": "ModelBuilder"},
                 "job": _job_schema(job), "algo": algo,
                 "messages": [], "error_count": 0}
@@ -1172,12 +1189,39 @@ class Endpoints:
         from h2o3_tpu.cluster import spmd
 
         if not spmd.multi_process():
+            from h2o3_tpu.cluster import recovery
+
             aml = AutoML(**kwargs)
-            job = _start_job(lambda j: aml.train(y=y, training_frame=train_key),
-                             "AutoML build")
+            aml_key = aml.key
+
+            def _aml_work(j, first=aml):
+                # checkpointed AutoML self-heals through its step manifest: a
+                # relaunch with the same spec + dir recovers finished steps
+                # (and the poison-step guard skips a step that keeps
+                # crashing), so the supervisor's "checkpoint" is the
+                # manifest itself — each attempt gets a FRESH AutoML bound
+                # to the original key the client is polling
+                holder = {"aml": first}
+
+                def _launch(_ckpt):
+                    if holder["aml"] is None:
+                        fresh = AutoML(**kwargs)
+                        DKV.remove(fresh.key)
+                        fresh.key = aml_key
+                        DKV.put(aml_key, fresh)
+                        holder["aml"] = fresh
+                    a, holder["aml"] = holder["aml"], None
+                    return a.train(y=y, training_frame=train_key)
+
+                return recovery.run_supervised(
+                    _launch,
+                    ckdir=kwargs.get("export_checkpoints_dir"),
+                    description="AutoML build", job=j)
+
+            job = _start_job(_aml_work, "AutoML build")
             return {"__meta": {"schema_type": "AutoMLBuilder"},
                     "job": _job_schema(job),
-                    "automl_id": {"name": aml.key}}
+                    "automl_id": {"name": aml_key}}
         dest = DKV.make_key("automl")
         # placeholder for the response→command registration window
         placeholder = AutoML(**kwargs)
@@ -1465,6 +1509,31 @@ class Endpoints:
         return {"__meta": {"schema_type": "Shutdown"}, "drain": drain,
                 "draining": _DRAINING}
 
+    def recover(self, params):
+        """``POST /3/Recover`` — the supervised reform, over the wire: when
+        the degraded latch is set, re-form the cloud (degraded → recovering
+        → healthy; ``cloud_generation`` ticks, fencing every pre-reform
+        command out) and report the new state. Idempotent: a healthy cloud
+        just reports its current generation. 409 when recovery is disabled
+        (``H2O3_TPU_RECOVERY=0`` keeps the latch strictly one-way over REST
+        too — ``clear_degraded`` stays a code-level operator hatch)."""
+        from h2o3_tpu.cluster import cloud, recovery
+
+        was = cloud.degraded_reason()
+        if was is not None:
+            if not recovery.enabled():
+                raise ApiError(
+                    409, "supervised recovery is disabled "
+                         "(H2O3_TPU_RECOVERY=0): the degraded latch is "
+                         "one-way — restart the cloud and recover models "
+                         "from checkpoints")
+            recovery.reform(f"REST /3/Recover (was: {was})")
+        return {"__meta": {"schema_type": "Recover"},
+                "recovered": was is not None,
+                **({"was_degraded": was} if was else {}),
+                "generation": cloud.generation(),
+                "cloud_healthy": cloud.degraded_reason() is None}
+
 
 def _get_model(key):
     from h2o3_tpu.models.model_base import Model
@@ -1498,6 +1567,9 @@ def _job_schema(j: Job) -> dict:
         # the build ran with export_checkpoints_dir, so a FAILED job tells
         # the operator exactly what to resume from (docs/RECOVERY.md)
         **({"recovery": j.recovery} if getattr(j, "recovery", None) else {}),
+        # supervised-recovery restarts this job survived (reform + resume
+        # from its latest snapshot, cluster/recovery.py)
+        **({"restarts": j.restarts} if getattr(j, "restarts", 0) else {}),
     }
 
 
@@ -1590,6 +1662,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("POST", r"/99/AutoMLBuilder", _EP.automl_build),
     ("GET", r"/99/AutoML/([^/]+)", _EP.automl_get),
     ("POST", r"/3/Shutdown", _EP.shutdown),
+    ("POST", r"/3/Recover", _EP.recover),
 ]
 # raw pattern rides along as the bounded-cardinality metrics route label
 _COMPILED = [(m, p, re.compile("^" + p + "/?$"), h) for m, p, h in _ROUTES]

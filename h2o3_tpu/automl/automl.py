@@ -166,13 +166,19 @@ def _automl_manifest_path(ckdir: str, aml_id: str) -> str:
     return os.path.join(ckdir, f"{aml_id}.automl.json")
 
 
-def _read_automl_manifest(ckdir: str, aml_id: str, fingerprint: str) -> dict[str, list[str]]:
+def _read_automl_manifest(
+    ckdir: str, aml_id: str, fingerprint: str
+) -> tuple[dict[str, list[str]], dict[str, int]]:
+    """Returns (finished step -> model keys, step -> recorded build
+    attempts). Attempt counts survive auto-resumes so the poison-step guard
+    (``H2O3_TPU_AUTOML_STEP_RETRIES``) can skip a step that crashes every
+    resume at the same place."""
     import json
     import os
 
     path = _automl_manifest_path(ckdir, aml_id)
     if not os.path.exists(path):
-        return {}
+        return {}, {}
     with open(path) as f:
         payload = json.load(f)
     if payload.get("fingerprint") not in (None, fingerprint):
@@ -180,19 +186,23 @@ def _read_automl_manifest(ckdir: str, aml_id: str, fingerprint: str) -> dict[str
             f"AutoML {aml_id}: checkpoint dir was built with a different "
             "spec / data — ignoring it and rebuilding"
         )
-        return {}
-    return {k: list(v) for k, v in payload.get("steps", {}).items()}
+        return {}, {}
+    return (
+        {k: list(v) for k, v in payload.get("steps", {}).items()},
+        {k: int(v) for k, v in payload.get("attempts", {}).items()},
+    )
 
 
 def _write_automl_manifest(ckdir: str, aml_id: str, fingerprint: str,
-                           steps: dict[str, list[str]]) -> None:
+                           steps: dict[str, list[str]],
+                           attempts: dict[str, int] | None = None) -> None:
     import json
 
     from h2o3_tpu.persist import write_bytes
 
     write_bytes(
         json.dumps({"automl_id": aml_id, "fingerprint": fingerprint,
-                    "steps": steps}).encode(),
+                    "steps": steps, "attempts": attempts or {}}).encode(),
         _automl_manifest_path(ckdir, aml_id),
     )
 
@@ -425,9 +435,11 @@ class AutoML:
         aml_id = _automl_id(s)
         fingerprint = None
         step_models: dict[str, list[str]] = {}
+        step_attempts: dict[str, int] = {}
         if ckdir:
             fingerprint = _automl_fingerprint(s, x, y, train)
-            step_models = _read_automl_manifest(ckdir, aml_id, fingerprint)
+            step_models, step_attempts = _read_automl_manifest(
+                ckdir, aml_id, fingerprint)
 
         def _recover_step(st) -> list[Model] | None:
             if not ckdir or st.name not in step_models:
@@ -441,7 +453,13 @@ class AutoML:
             if not ckdir:
                 return
             step_models[st.name] = [m.key for m in models]
-            _write_automl_manifest(ckdir, aml_id, fingerprint, step_models)
+            step_attempts.pop(st.name, None)  # finished: attempts moot
+            _write_automl_manifest(ckdir, aml_id, fingerprint, step_models,
+                                   step_attempts)
+
+        from h2o3_tpu import config as _config
+
+        step_retries = _config.get_int("H2O3_TPU_AUTOML_STEP_RETRIES")
 
         for st in plan:
             if self._remaining() <= 0:
@@ -454,6 +472,29 @@ class AutoML:
                 done_w += st.weight
                 job.update(done_w / total_w)
                 continue
+            # poison-step guard: the manifest records how many times this
+            # step's build has STARTED across auto-resumes; a step that
+            # crashed its whole retry budget is skipped so a
+            # deterministically-failing step cannot kill every resume at the
+            # same place forever (the supervised-recovery loop depends on
+            # resumes making progress)
+            if ckdir and st.kind in ("model", "grid") and st.name not in step_models:
+                att = step_attempts.get(st.name, 0)
+                if 0 < step_retries <= att:
+                    Log.warn(
+                        f"AutoML step {st.name} skipped: {att} crashed "
+                        f"attempt(s) recorded in the manifest "
+                        f"(H2O3_TPU_AUTOML_STEP_RETRIES={step_retries}) — "
+                        "a poisoned step must not kill every auto-resume"
+                    )
+                    self._log("skip", f"{st.name} skipped after {att} "
+                                      "crashed attempts (poison-step guard)")
+                    done_w += st.weight
+                    job.update(done_w / total_w)
+                    continue
+                step_attempts[st.name] = att + 1
+                _write_automl_manifest(ckdir, aml_id, fingerprint,
+                                       step_models, step_attempts)
             _st_t0 = time.time()
             _st_span = _mx.span("automl.step", step=st.name, kind=st.kind)
             _st_span.__enter__()
@@ -484,6 +525,7 @@ class AutoML:
                         self._update_family_best(family_best, m)
                         _record_step(st, [m])
                         self._log("model", f"{st.name} -> {m.key} {sort_metric}={self.leaderboard._metric_of(m):.5g}")
+                    faults.die_check("automl")  # chaos: worker death
                     faults.abort_check("automl", n_models_built)
                 elif st.kind == "grid":
                     recovered = _recover_step(st)
@@ -548,6 +590,13 @@ class AutoML:
             except faults.TrainAbort:
                 raise  # simulated kill -9: die with the manifest on disk
             except Exception as e:
+                from h2o3_tpu.cluster import recovery as _recovery
+
+                if _recovery.is_cloud_failure(e):
+                    # a dead/degraded cloud fails every later step the same
+                    # way — die with the manifest on disk so the recovery
+                    # supervisor (or the operator) resumes the whole run
+                    raise
                 self._log("error", f"{st.name} failed: {e!r}")
             finally:  # runs on the recovered-grid continue and TrainAbort too
                 _st_span.__exit__(None, None, None)

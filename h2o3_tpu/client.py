@@ -28,10 +28,16 @@ _RETRYABLE_STATUSES = (409, 429, 503)
 
 
 class H2OClientError(Exception):
-    def __init__(self, status: int, msg: str, retry_after: float | None = None):
+    def __init__(self, status: int, msg: str, retry_after: float | None = None,
+                 recovery: dict | None = None):
         super().__init__(f"HTTP {status}: {msg}")
         self.status = status
         self.retry_after = retry_after
+        # the failed/timed-out job's crash-recovery pointer (the /3/Jobs
+        # `recovery` block: latest interval snapshot key + path) — scripts
+        # resume with checkpoint=e.recovery["checkpoint_path"] without a
+        # second /3/Jobs round-trip (docs/RECOVERY.md)
+        self.recovery = recovery
 
 
 class H2OConnection:
@@ -155,10 +161,17 @@ class H2OConnection:
             j = self.get(f"/3/Jobs/{job_key}")["jobs"][0]
             if j["status"] in ("DONE", "FAILED", "CANCELLED"):
                 if j["status"] == "FAILED":
+                    rec = j.get("recovery")
+                    hint = (
+                        f" — resumable: latest snapshot "
+                        f"{rec.get('checkpoint_path')} (pass it as "
+                        "checkpoint= to continue)" if rec else ""
+                    )
                     raise H2OClientError(
                         500,
                         f"job {job_key} failed: "
-                        f"{j.get('exception') or 'job failed'}",
+                        f"{j.get('exception') or 'job failed'}{hint}",
+                        recovery=rec,
                     )
                 return j
             if started is None and (
@@ -169,9 +182,15 @@ class H2OConnection:
                 started = time.time()
             elapsed = time.time() - (started if started is not None else t0)
             if elapsed > self.timeout:
+                rec = j.get("recovery")
+                hint = (
+                    f" — resumable: latest snapshot "
+                    f"{rec.get('checkpoint_path')}" if rec else ""
+                )
                 raise H2OClientError(
                     408, f"job {job_key} timed out after {elapsed:.1f}s "
-                         f"(progress {j.get('progress', 0):.0%})")
+                         f"(progress {j.get('progress', 0):.0%}){hint}",
+                    recovery=rec)
             time.sleep(delay)
             delay = min(poll_cap, delay * 1.6)
 

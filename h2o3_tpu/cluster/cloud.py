@@ -48,6 +48,13 @@ _G_DEGRADED = metrics.gauge(
     "cloud_degraded", "1 while the fail-stop degraded latch is set")
 _G_HEALTHY = metrics.gauge(
     "cloud_healthy", "1 while every probed local device passes health checks")
+_G_GENERATION = metrics.gauge(
+    "cloud_generation",
+    "cloud formation epoch: starts at 0 and ticks on every supervised "
+    "recover() reform (cluster/recovery.py). Replicated spmd commands are "
+    "stamped with the generation they entered under and fail-stop if the "
+    "cloud re-formed while they waited — a retried collective can never "
+    "interleave with a wedged predecessor")
 _C_TRANSITIONS = metrics.counter(
     "cloud_health_transitions_total", "health state changes, by target state")
 _C_CACHE_HITS = metrics.counter(
@@ -178,6 +185,28 @@ def init(
 
 
 _degraded: str | None = None
+_generation = 0
+
+
+def generation() -> int:
+    """Current cloud formation epoch (see the ``cloud_generation`` gauge).
+    Moves ONLY through :func:`recover` — ``clear_degraded`` (the manual
+    escape hatch) leaves it alone, so a cloud that never reforms keeps
+    generation 0 forever and the spmd generation fence stays inert."""
+    return _generation
+
+
+def adopt_generation(gen: int) -> None:
+    """Fast-forward this rank's generation to a NEWER one observed on the
+    replicated command stream (a follower learning the coordinator's
+    reform). Never moves backwards — the fence against pre-reform commands
+    stays intact."""
+    global _generation
+    if gen > _generation:
+        Log.warn(f"cloud generation adopted from command stream: "
+                 f"{_generation} -> {gen}")
+        _generation = gen
+        _G_GENERATION.set(_generation)
 
 
 def mark_degraded(reason: str) -> None:
@@ -195,6 +224,32 @@ def mark_degraded(reason: str) -> None:
 
 def degraded_reason() -> str | None:
     return _degraded
+
+
+def recover(reason: str = "") -> int:
+    """The SINGLE supervised un-latch transition (degraded → recovering →
+    healthy): tick the cloud generation and release the latch. Only the
+    recovery supervisor (cluster/recovery.py) should call this — ticking
+    the generation is what fences every command stamped under the old
+    formation out of the re-formed cloud, which is the invariant that makes
+    auto-restart safe. ``clear_degraded()`` remains the manual escape hatch
+    (no generation tick: the operator is asserting the OLD cloud is fine).
+    No-op (returns the current generation) when the latch is not set."""
+    global _degraded, _generation
+    if _degraded is None:
+        return _generation
+    _C_TRANSITIONS.inc(to="recovering")
+    _generation += 1
+    _G_GENERATION.set(_generation)
+    Log.warn(
+        f"cloud recovering (generation {_generation - 1} -> {_generation}; "
+        f"was degraded: {_degraded})"
+        + (f" — {reason}" if reason else "")
+    )
+    _degraded = None
+    _G_DEGRADED.set(0)
+    _C_TRANSITIONS.inc(to="healthy")
+    return _generation
 
 
 def clear_degraded() -> None:
@@ -238,6 +293,7 @@ def cluster_info() -> dict:
         "version": "h2o3_tpu",
         "cloud_healthy": healthy,
         **({"degraded": out_degraded} if out_degraded else {}),
+        "generation": _generation,
         "cloud_size": len(jax.devices()),
         "processes": jax.process_count(),
         "platform": jax.devices()[0].platform,

@@ -72,6 +72,9 @@ class Job:
         # their latest interval snapshot here, so a FAILED job still tells
         # operators (over /3/Jobs) where to resume from (docs/RECOVERY.md)
         self.recovery: dict | None = None
+        # supervised-recovery restarts survived by this job (the recovery
+        # supervisor bumps it on every reform+resume; /3/Jobs surfaces it)
+        self.restarts: int = 0
         DKV.put(self.key, self)
 
     # -- driver-side API (the work callable calls these) --
@@ -208,4 +211,5 @@ class Job:
             "duration_ms": self.duration_ms,
             "span_summary": metrics.trace_summary(self.key),
             **({"recovery": self.recovery} if self.recovery else {}),
+            **({"restarts": self.restarts} if self.restarts else {}),
         }

@@ -1,0 +1,273 @@
+"""Supervised auto-recovery — the self-healing layer over the fail-stop
+cloud (SURVEY.md §5.3; the ISSUE-10 tentpole).
+
+The PR-2/PR-4 machinery detects every failure class — a dead mesh member
+poisons the next collective and latches ``cloud.mark_degraded``, the spmd
+watchdog trips on wedged commands, durable snapshots land at every scoring
+interval — but each of those paths ends at an *operator* holding a
+``checkpoint=`` flag. This module closes the loop:
+
+- :func:`run_supervised` wraps a job launch. When the launch dies of a
+  *cloud* failure (degraded latch, coordination-service death signature,
+  stale generation) and recovery is enabled, it re-forms the cloud
+  (:func:`reform`) and relaunches from the latest PR-2 snapshot in the
+  job's ``export_checkpoints_dir`` — bounded by
+  ``H2O3_TPU_RECOVERY_MAX_RESTARTS`` restarts with exponential backoff +
+  deterministic jitter (``H2O3_TPU_RECOVERY_BACKOFF``). Deterministic
+  command errors (bad params, a failing combo, :class:`faults.TrainAbort`)
+  are NEVER retried — they would fail identically on the new cloud.
+- :func:`reform` is the degraded → recovering → healthy transition: latch
+  (if not already latched), rebuild the device mesh over the devices that
+  are live now (``parallel/mesh.reform_mesh`` — on a multi-process cloud
+  whose distributed runtime cannot re-initialize in-process, this shrinks
+  to the surviving local mesh), then ``cloud.recover()`` which ticks the
+  ``cloud_generation`` gauge. The generation tick is the correctness
+  keystone: every replicated command is stamped with the generation it
+  entered under (cluster/spmd.py), so a command from the failure epoch can
+  never execute — or broadcast — into the re-formed cloud.
+- :func:`install` starts the background supervisor thread (launch.py,
+  coordinator only): it watches the degraded latch — wherever it came from
+  (watchdog trip, death signature, operator) — and re-forms the cloud with
+  backoff so the REST tier keeps serving and the serving circuit breakers
+  (serving/batcher.py) get their half-open signal without an operator.
+
+``H2O3_TPU_RECOVERY=0`` disables all of it: failures propagate exactly as
+today (fail-stop; the degraded latch stays one-way until an operator acts).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+import zlib
+
+from h2o3_tpu.utils import metrics
+from h2o3_tpu.utils.log import Log
+
+_ATTEMPTS = metrics.counter(
+    "recovery_attempts_total",
+    "supervised recovery attempts, by outcome: 'resumed' = the cloud was "
+    "re-formed and the job relaunched from its latest snapshot, "
+    "'exhausted' = the restart budget (H2O3_TPU_RECOVERY_MAX_RESTARTS) ran "
+    "out and the failure surfaced, 'reform' = a background supervisor "
+    "reform of the degraded latch with no job attached")
+_SECONDS = metrics.histogram(
+    "recovery_seconds",
+    "wall seconds from failure detection to the relaunch dispatch of a "
+    "supervised recovery (includes the backoff sleep and the cloud reform)",
+    buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120, 300))
+
+
+class RecoveryExhausted(RuntimeError):
+    """The supervised restart budget ran out; the last failure is chained."""
+
+
+def enabled() -> bool:
+    """Supervised recovery on/off (``H2O3_TPU_RECOVERY``): '0' restores the
+    pure fail-stop contract; 'auto'/'1' arm the supervisor wherever it is
+    wired (REST builds with ``export_checkpoints_dir``, the launch.py
+    watcher, :func:`run_supervised` callers)."""
+    from h2o3_tpu import config
+
+    return config.get("H2O3_TPU_RECOVERY").strip().lower() not in (
+        "0", "false", "")
+
+
+def _max_restarts() -> int:
+    from h2o3_tpu import config
+
+    return config.get_int("H2O3_TPU_RECOVERY_MAX_RESTARTS")
+
+
+def backoff_delay(attempt: int, key: str = "recovery") -> float:
+    """Capped exponential backoff with DETERMINISTIC jitter (same scheme as
+    persist.py / client.py: keyed on op+attempt, reproducible run-to-run,
+    yet distinct supervisors desynchronize)."""
+    from h2o3_tpu import config
+
+    base = config.get_float("H2O3_TPU_RECOVERY_BACKOFF")
+    delay = min(30.0, base * (2 ** attempt))
+    frac = zlib.crc32(f"{key}:{attempt}".encode()) % 1000
+    return delay * (1.0 + 0.5 * frac / 1000.0)
+
+
+# signatures beyond spmd._DEATH_SIGNATURES that mark an exception as a
+# CLOUD failure (recoverable by reform+resume) rather than a deterministic
+# command failure (which would fail identically on the new cloud)
+_CLOUD_FAILURE_MARKS = (
+    "cloud is degraded (fail-stop)",
+    "cloud re-formed (generation",
+)
+
+
+def is_cloud_failure(exc: BaseException) -> bool:
+    """True when ``exc`` is a failure of the CLOUD, not of the command: the
+    degraded latch is set, the exception carries a coordination-service
+    death signature (``spmd._DEATH_SIGNATURES`` — matched on the repr/str
+    because Job.join re-wraps worker exceptions with their traceback text),
+    or it is a fail-stop / stale-generation error. ``faults.TrainAbort``
+    (the simulated kill -9 of *this* process) is deliberately NOT a cloud
+    failure: a process that died cannot supervise its own restart — the
+    chaos suite's kill→restart→resume contract stays untouched."""
+    from h2o3_tpu.cluster import cloud, spmd
+    from h2o3_tpu.utils import faults
+
+    if isinstance(exc, faults.TrainAbort):
+        return False
+    if isinstance(exc, spmd.StaleGeneration):
+        return True
+    if cloud.degraded_reason() is not None:
+        return True
+    msg = (repr(exc) + " " + str(exc)).lower()
+    if any(m.lower() in msg for m in _CLOUD_FAILURE_MARKS):
+        return True
+    return any(sig.lower() in msg for sig in spmd._DEATH_SIGNATURES)
+
+
+def latest_snapshot(ckdir: str | None, algo: str | None) -> str | None:
+    """Newest PR-2 interval snapshot (``<algo>_ckpt_*``) in ``ckdir``, or
+    None. This is the same file the ``/3/Jobs`` recovery block points at —
+    the supervisor resumes from exactly what the runbook tells an operator
+    to pass as ``checkpoint=``."""
+    if not ckdir or not algo:
+        return None
+    files = glob.glob(os.path.join(ckdir, f"{algo}_ckpt_*"))
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def reform(reason: str = "") -> int:
+    """Re-form the cloud: degraded → recovering → healthy, returning the
+    new generation. Ensures the latch is set first (so the transition
+    counter and waiting commands observe the degraded epoch even when the
+    failure surfaced as an exception without latching), rebuilds the device
+    mesh over the currently-live devices, and ``cloud.recover()``s.
+
+    Multi-process clouds: the JAX distributed runtime on current jaxlibs
+    cannot re-initialize inside a poisoned process — a REAL member death
+    still requires every rank to restart (the launch.py loop). What reform
+    gives the coordinator is a *survivor island*: a local mesh it can keep
+    serving and resuming checkpointed jobs on while the pod reschedules."""
+    from h2o3_tpu.cluster import cloud
+    from h2o3_tpu.parallel import mesh as _mesh
+
+    if cloud.degraded_reason() is None:
+        cloud.mark_degraded(reason or "supervised reform")
+    try:
+        _mesh.reform_mesh()
+    except Exception as e:  # noqa: BLE001 — a dead backend must not stop the
+        # state transition; the next dispatch surfaces the real error
+        Log.warn(f"recovery: mesh rebuild failed ({e!r}); proceeding with "
+                 "the recover transition — the next dispatch will retry it")
+    return cloud.recover(reason)
+
+
+def run_supervised(launch, *, ckdir: str | None = None, algo: str | None = None,
+                   description: str = "job", max_restarts: int | None = None,
+                   job=None):
+    """Run ``launch(checkpoint)`` under the recovery supervisor.
+
+    ``launch`` is called with ``None`` first; on a qualifying cloud failure
+    (see :func:`is_cloud_failure`) the supervisor backs off, re-forms the
+    cloud, and calls it again with the latest snapshot path from ``ckdir``
+    (or the previous checkpoint when no newer snapshot landed). Anything
+    that is not a cloud failure — or any failure when recovery is disabled
+    — propagates unchanged, preserving today's fail-stop semantics
+    bit-for-bit under ``H2O3_TPU_RECOVERY=0``."""
+    if max_restarts is None:
+        max_restarts = _max_restarts()
+    attempt = 0
+    ckpt: str | None = None
+    while True:
+        try:
+            return launch(ckpt)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not enabled() or not is_cloud_failure(e):
+                raise
+            if attempt >= max_restarts:
+                _ATTEMPTS.inc(outcome="exhausted")
+                raise RecoveryExhausted(
+                    f"supervised recovery of {description!r} gave up after "
+                    f"{attempt} restart(s) "
+                    f"(H2O3_TPU_RECOVERY_MAX_RESTARTS={max_restarts}); "
+                    f"latest snapshot: {latest_snapshot(ckdir, algo)}"
+                ) from e
+            t0 = time.monotonic()
+            snap = latest_snapshot(ckdir, algo)
+            delay = backoff_delay(attempt, key=description)
+            Log.warn(
+                f"recovery: {description} died of a cloud failure "
+                f"({type(e).__name__}); restart {attempt + 1}/{max_restarts} "
+                f"in {delay:.2f}s"
+                + (f" from snapshot {snap}" if snap else " from scratch")
+            )
+            time.sleep(delay)
+            reform(f"supervised restart of {description} "
+                   f"(attempt {attempt + 1}/{max_restarts})")
+            if snap is not None:
+                ckpt = snap
+            attempt += 1
+            if job is not None and hasattr(job, "restarts"):
+                job.restarts = attempt
+            _ATTEMPTS.inc(outcome="resumed")
+            _SECONDS.observe(time.monotonic() - t0)
+
+
+# ---------------------------------------------------------------------------
+# background supervisor: the launch.py-installed watcher that re-forms the
+# cloud when the degraded latch is set with no supervised job attached (a
+# watchdog trip between jobs, a death signature on an unsupervised command).
+# Without it, a coordinator whose cloud degraded while idle stays bricked
+# until an operator calls clear_degraded — with it, the serving tier's
+# circuit breakers half-open and checkpointed work becomes resumable again.
+
+_WATCHER: threading.Thread | None = None
+_WATCH_STOP = threading.Event()
+
+
+def _watch_loop(poll: float) -> None:
+    from h2o3_tpu.cluster import cloud
+
+    consecutive = 0
+    last_reform = 0.0
+    while not _WATCH_STOP.wait(poll):
+        if not enabled() or cloud.degraded_reason() is None:
+            if consecutive and time.monotonic() - last_reform > 60.0:
+                consecutive = 0  # a minute of health resets the backoff
+            continue
+        t0 = time.monotonic()
+        delay = backoff_delay(min(consecutive, 6), key="latch-watch")
+        if _WATCH_STOP.wait(delay):
+            return
+        if cloud.degraded_reason() is None:
+            continue  # resolved (operator / job supervisor) while backing off
+        gen = reform("background supervisor: degraded latch with no "
+                     "supervised job attached")
+        _ATTEMPTS.inc(outcome="reform")
+        _SECONDS.observe(time.monotonic() - t0)
+        Log.warn(f"recovery: background reform complete (generation {gen})")
+        consecutive += 1
+        last_reform = time.monotonic()
+
+
+def install(poll: float = 0.5) -> None:
+    """Start the background latch watcher (idempotent; daemon thread). The
+    loop no-ops while recovery is disabled, so installing it is always safe
+    — launch.py installs it on the REST coordinator."""
+    global _WATCHER
+    if _WATCHER is not None and _WATCHER.is_alive():
+        return
+    _WATCH_STOP.clear()
+    _WATCHER = threading.Thread(
+        target=_watch_loop, args=(poll,), name="h2o3-recovery", daemon=True)
+    _WATCHER.start()
+
+
+def uninstall() -> None:
+    """Stop the background watcher (tests)."""
+    global _WATCHER
+    _WATCH_STOP.set()
+    if _WATCHER is not None:
+        _WATCHER.join(timeout=5)
+    _WATCHER = None

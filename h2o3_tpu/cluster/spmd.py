@@ -203,12 +203,58 @@ def _failstop_if_degraded() -> None:
         )
 
 
-def _acquire_command_lock() -> None:
-    """Acquire ``_LOCK`` but keep polling the degraded latch: a caller
-    queued behind a wedged command must fail-stop the moment the watchdog
-    (or a death signature) latches, never block indefinitely."""
+class StaleGeneration(RuntimeError):
+    """A replicated command stamped under one cloud formation observed a
+    reform (``cloud.recover``) to a newer generation before it could
+    execute. The command fail-stops — it belongs to the failure epoch, and
+    letting it run (or broadcast) could interleave its collectives with a
+    wedged predecessor's on some rank. The supervisor's retry re-enters
+    under the NEW generation."""
+
+
+def _check_generation(entry_gen: int) -> None:
+    from h2o3_tpu.cluster import cloud
+
+    cur = cloud.generation()
+    if cur != entry_gen:
+        raise StaleGeneration(
+            f"cloud re-formed (generation {entry_gen} -> {cur}) while this "
+            "command waited (fail-stop): the command belongs to the failed "
+            "formation — retry it against the new cloud"
+        )
+
+
+def _stale_reason(gen: int | None) -> str | None:
+    """Follower-side fence: reject a command stamped with a generation OLDER
+    than this rank's (a reform raced the broadcast — the command belongs to
+    a pre-reform formation). A NEWER stamp is adopted: the coordinator
+    re-formed and this rank learns the reform through the command stream,
+    exactly how it learns everything else. Returns the rejection reason, or
+    None when the command should execute."""
+    if gen is None:  # legacy 2-tuple payload (no stamp): nothing to check
+        return None
+    from h2o3_tpu.cluster import cloud
+
+    cur = cloud.generation()
+    if gen < cur:
+        return (f"stale-generation command (stamped {gen}, cloud is at "
+                f"{cur}) rejected: it belongs to a pre-reform formation")
+    if gen > cur:
+        cloud.adopt_generation(gen)
+    return None
+
+
+def _acquire_command_lock(entry_gen: int) -> None:
+    """Acquire ``_LOCK`` but keep polling the degraded latch AND the cloud
+    generation: a caller queued behind a wedged command must fail-stop the
+    moment the watchdog (or a death signature) latches — and must STAY
+    fail-stopped if the supervisor re-forms the cloud while it waits. The
+    generation poll is what drains pre-reform waiters: without it, a waiter
+    that slept through the whole degraded window would acquire the lock on
+    the re-formed cloud and execute a command from the failure epoch."""
     while not _LOCK.acquire(timeout=0.25):
         _failstop_if_degraded()
+        _check_generation(entry_gen)
 
 
 _IS_MULTI = False  # set once by cluster.cloud.init; read on hot paths
@@ -237,7 +283,9 @@ def _bcast_bytes(payload: bytes | None) -> bytes:
     """Broadcast a byte string from process 0 to all (collective: every
     process must call this — followers pass ``None``)."""
     from jax.experimental import multihost_utils as mh
+    from h2o3_tpu.utils import faults
 
+    faults.die_check("bcast")  # chaos: process death at a collective boundary
     t0 = time.perf_counter()
     n = len(payload) if payload is not None else 0
     n_arr = mh.broadcast_one_to_all(np.array([n], np.int32))
@@ -618,14 +666,23 @@ def run(cmd: str, **kwargs):
     Single-process clouds execute directly; multi-process clouds broadcast
     first so followers enter the same program. Holding the lock for the whole
     execution serializes device work — collective order must match on every
-    rank, and concurrent jobs on the coordinator would interleave it."""
+    rank, and concurrent jobs on the coordinator would interleave it.
+
+    Every command is stamped with the cloud generation it entered under
+    (``cloud.generation``): if a supervised reform (cluster/recovery.py)
+    ticks the generation while the command waits on the lock, the command
+    fail-stops with :class:`StaleGeneration` instead of executing against a
+    formation it was never stamped for."""
+    from h2o3_tpu.cluster import cloud
     from h2o3_tpu.utils import faults
 
+    entry_gen = cloud.generation()
     if not multi_process():
         # the degraded latch fail-stops here too: single-host it can only be
         # set by the collective watchdog (a wedged device program), and a
         # wedged mesh is no more usable for the next command than a dead one
         _failstop_if_degraded()
+        _check_generation(entry_gen)
         try:
             faults.death_check("spmd_run")  # chaos: synthetic dead member
             _CMDS_TOTAL.inc(cmd=cmd)
@@ -642,13 +699,17 @@ def run(cmd: str, **kwargs):
             raise
     if not is_coordinator():  # pragma: no cover - followers use follower_loop
         raise RuntimeError("spmd.run is coordinator-only")
-    # bounded acquire: waiters poll the degraded latch so a command wedged
-    # inside the lock (watchdog's case) fail-stops the queue behind it
-    _acquire_command_lock()
+    # bounded acquire: waiters poll the degraded latch AND the generation so
+    # a command wedged inside the lock (watchdog's case) fail-stops the
+    # queue behind it — including waiters that outlive a supervised reform
+    _acquire_command_lock(entry_gen)
     try:
-        # degraded check INSIDE the lock: a job queued on the lock while
-        # another latches the failure must not broadcast into the dead cloud
+        # degraded + generation checks INSIDE the lock: a job queued on the
+        # lock while another latches the failure must not broadcast into the
+        # dead cloud, and one that slept through a reform must not broadcast
+        # a pre-reform command into the new one
         _failstop_if_degraded()
+        _check_generation(entry_gen)
         try:
             faults.death_check("spmd_run")  # chaos: synthetic dead member
             _CMDS_TOTAL.inc(cmd=cmd)
@@ -657,7 +718,7 @@ def run(cmd: str, **kwargs):
                 try:
                     with _watched(cmd):
                         faults.stall_check("spmd_run")  # chaos: wedge
-                        _bcast_bytes(pickle.dumps((cmd, kwargs)))
+                        _bcast_bytes(pickle.dumps((entry_gen, cmd, kwargs)))
                         with replicated_section():
                             return _COMMANDS[cmd](**kwargs)
                 finally:
@@ -682,7 +743,9 @@ def shutdown_followers(timeout: float = 10.0) -> None:
             )
             return
         try:
-            _bcast_bytes(pickle.dumps((_SHUTDOWN, {})))
+            from h2o3_tpu.cluster import cloud
+
+            _bcast_bytes(pickle.dumps((cloud.generation(), _SHUTDOWN, {})))
         finally:
             _LOCK.release()
 
@@ -700,13 +763,24 @@ def follower_loop() -> None:
     Log.info(f"spmd follower loop up (process {__import__('jax').process_index()})")
     while True:
         try:
-            cmd, kwargs = pickle.loads(_bcast_bytes(None))
+            payload = pickle.loads(_bcast_bytes(None))
         except Exception as e:  # dead coordinator/member: fail-stop the rank
             _maybe_mark_dead_member(e)
             raise
+        if len(payload) == 3:
+            gen, cmd, kwargs = payload
+        else:  # legacy unstamped (cmd, kwargs) payload
+            gen, (cmd, kwargs) = None, payload
         if cmd == _SHUTDOWN:
             Log.info("spmd follower shutdown")
             return
+        stale = _stale_reason(gen)
+        if stale is not None:
+            # deterministic rejection: the coordinator's own generation
+            # check raises the same epoch for its copy, so skipping here
+            # keeps the ranks' replicated key/collective sequences aligned
+            Log.err(f"spmd follower {stale}")
+            continue
         Log.info(f"spmd follower executing {cmd}")
         try:
             with replicated_section():

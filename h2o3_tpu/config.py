@@ -190,9 +190,40 @@ _KNOBS: dict[str, tuple[str, str]] = {
             "';'-separated entries — 'site=N' fails the first N IO calls at "
             "the site, 'site@K' aborts training at iteration K, 'death:site' "
             "raises a synthetic coordination-service death error, "
-            "'stall:site:SECS' sleeps once at the site (wedged-collective "
-            "stand-in), 'slow:site:SECS' sleeps at EVERY call to the site "
-            "(slow-handler injection). '' = off"),
+            "'die:site' raises one at a COLLECTIVE BOUNDARY site (the "
+            "worker-death-mid-collective stand-in the supervised-recovery "
+            "drills use), 'blackout:SECS' fails EVERY persist IO for a "
+            "wall-clock window of SECS from arming (storage-outage "
+            "stand-in), 'stall:site:SECS' sleeps once at the site "
+            "(wedged-collective stand-in), 'slow:site:SECS' sleeps at EVERY "
+            "call to the site (slow-handler injection). '' = off"),
+    "H2O3_TPU_RECOVERY": (
+        "auto", "supervised auto-recovery (cluster/recovery.py): on a cloud "
+                "failure — degraded latch, watchdog trip, coordination-"
+                "service death signature, stale generation — supervised "
+                "jobs with export_checkpoints_dir re-form the cloud "
+                "(degraded -> recovering -> healthy, cloud_generation "
+                "ticks) and resume from their latest interval snapshot "
+                "with no operator in the path. 'auto'/'1' = on; '0' = off "
+                "(restores the pure fail-stop contract: failures surface, "
+                "the degraded latch stays one-way until clear_degraded)"),
+    "H2O3_TPU_RECOVERY_MAX_RESTARTS": (
+        "3", "supervised-recovery restart budget per job: after this many "
+             "reform+resume attempts the failure surfaces "
+             "(RecoveryExhausted) with the latest snapshot path in the "
+             "message"),
+    "H2O3_TPU_RECOVERY_BACKOFF": (
+        "0.5", "supervised-recovery base backoff, seconds: delay = "
+               "base * 2^attempt (capped at 30 s) plus up to +50% "
+               "DETERMINISTIC jitter (keyed on job+attempt, identical "
+               "run-to-run)"),
+    "H2O3_TPU_AUTOML_STEP_RETRIES": (
+        "2", "AutoML poison-step guard: a plan step whose build has already "
+             "crashed this many recorded attempts (the step manifest "
+             "tracks per-step attempt counts across auto-resumes) is "
+             "SKIPPED with a Log.warn instead of killing every resume at "
+             "the same place forever. 0 = unlimited attempts (the "
+             "pre-guard behavior)"),
     "H2O3_TPU_MAX_INFLIGHT": (
         "64", "REST admission gate: max concurrently executing mutating "
               "(POST/DELETE) requests; excess requests are shed with "

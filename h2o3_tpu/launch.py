@@ -53,7 +53,12 @@ def main(argv=None) -> None:
         import signal
 
         from h2o3_tpu.api import server as _api_server
+        from h2o3_tpu.cluster import recovery
 
+        # self-healing: the background supervisor re-forms the cloud when
+        # the degraded latch is set with no supervised job attached (a
+        # watchdog trip between jobs) — no-op under H2O3_TPU_RECOVERY=0
+        recovery.install()
         srv = h2o3_tpu.start_server(ip=args.ip, port=args.port)
 
         def _graceful_term(signum, frame):

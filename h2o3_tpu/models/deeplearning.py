@@ -681,6 +681,7 @@ class DeepLearning(ModelBuilder):
                     autoencoder=True, expanded=di.coef_names(),
                 )
             )
+            faults.die_check(self.algo)  # chaos: worker death at boundary
             faults.abort_check(self.algo, done)
 
         # autoencoder inputs are NOT shape-bucketed: the reconstruction
@@ -803,6 +804,7 @@ class DeepLearning(ModelBuilder):
                     key, di, prm, ost, done, hist, domain,
                 )
             )
+            faults.die_check(self.algo)  # chaos: worker death at boundary
             faults.abort_check(self.algo, done)
 
         params, opt_state, history, epochs_done = _run_sync_sgd(

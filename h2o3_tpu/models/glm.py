@@ -752,6 +752,7 @@ class GLM(ModelBuilder):
                     snapshot(li, it_pos, iters_done, dev_prev, beta)
                     first = tot_iters + 1
                     tot_iters += n_done
+                    faults.die_check("glm")  # chaos: worker death at boundary
                     for i in range(first, tot_iters + 1):
                         faults.abort_check("glm", i)
                     if bad:
@@ -786,6 +787,7 @@ class GLM(ModelBuilder):
                 # is exactly where a resumed run re-enters the loop (it ==
                 # max_iter marks "this lambda's iterations are finished")
                 snapshot(li, it_pos, iters_done, dev_prev, beta)
+                faults.die_check("glm")  # chaos: worker death at boundary
                 faults.abort_check("glm", tot_iters)
                 if stop:
                     break

@@ -267,6 +267,7 @@ class DRF(ModelBuilder):
                         varimp_dev, history,
                     ),
                 )
+                faults.die_check(self.algo)  # chaos: worker death at boundary
                 faults.abort_check(self.algo, m_done)
                 faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():
@@ -330,6 +331,7 @@ class DRF(ModelBuilder):
                         varimp_dev, history,
                     ),
                 )
+                faults.die_check(self.algo)  # chaos: worker death at boundary
                 faults.abort_check(self.algo, m + 1)
                 faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():

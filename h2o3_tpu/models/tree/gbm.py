@@ -541,6 +541,7 @@ class GBM(ModelBuilder):
                         F, yn, wn, train.nrow, history,
                     ),
                 )
+                faults.die_check(self.algo)  # chaos: worker death at boundary
                 faults.abort_check(self.algo, m_done)
                 faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():
@@ -650,6 +651,7 @@ class GBM(ModelBuilder):
                         F, yn, wn, train.nrow, history,
                     ),
                 )
+                faults.die_check(self.algo)  # chaos: worker death at boundary
                 faults.abort_check(self.algo, m + 1)
                 faults.slow_check(self.algo)  # chaos: slow training interval
                 if keeper.should_stop():

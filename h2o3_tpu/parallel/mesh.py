@@ -60,6 +60,18 @@ def get_mesh() -> Mesh:
     return _mesh
 
 
+def reform_mesh() -> Mesh:
+    """Drop the cached mesh and rebuild over the devices that are live NOW —
+    the supervised-recovery reform step (cluster/recovery.py). The new Mesh
+    is a distinct object, so every program cache keyed through
+    :func:`mesh_key` (which includes ``id(mesh)``) misses and retraces
+    against the re-formed topology instead of replaying a program compiled
+    for the dead one."""
+    global _mesh
+    _mesh = None
+    return get_mesh()
+
+
 def n_shards() -> int:
     return get_mesh().shape[ROWS_AXIS]
 

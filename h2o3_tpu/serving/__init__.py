@@ -47,7 +47,17 @@ BATCHES = _mx.counter(
     "serving_batches_total", "batched scoring dispatches")
 SHED = _mx.counter(
     "serving_shed_total",
-    "scoring requests shed by the tier, by reason (deadline/queue_full)")
+    "scoring requests shed by the tier, by reason: deadline (504 — "
+    "saturated), queue_full (429), degraded (503 — the training cloud "
+    "degraded while the request was queued/dispatching; failed fast "
+    "instead of timing out), breaker_open (503 — the per-model circuit "
+    "breaker is open after a cloud failure)")
+BREAKER = _mx.counter(
+    "serving_breaker_transitions_total",
+    "per-model circuit-breaker transitions, by new state: 'open' on a "
+    "cloud failure mid-dispatch (subsequent requests shed 503 instantly), "
+    "'half_open' when the cloud reports healthy again (ONE probe request "
+    "is admitted), 'closed' when the probe succeeds (traffic re-admitted)")
 QUEUE_DEPTH = _mx.gauge(
     "serving_queue_depth", "rows waiting in the coalescing queue")
 BATCH_OCCUPANCY = _mx.histogram(

@@ -17,6 +17,17 @@ and shed 504-shaped — a late answer to a scoring request is worthless, and
 scoring it anyway would steal capacity from requests that can still make
 their deadline.
 
+Cloud degradation (the ISSUE-10 serving half): when the training cloud
+trips its fail-stop latch mid-dispatch (``cluster/cloud.mark_degraded`` —
+a wedged collective, a dead member), every queued and in-flight request
+fails FAST with a 503-shaped :class:`ShedError` + Retry-After instead of
+timing out at ``_DEADLINE_MS`` one by one, and a per-model circuit breaker
+opens: new arrivals shed instantly while the cloud is down, a single probe
+request is admitted once the cloud reports healthy again (half-open — the
+supervised ``recover()`` reform or an operator ``clear_degraded``), and a
+successful probe closes the breaker. The scoring tier rides through a
+training-cloud incident without burning its deadline budget on a dead mesh.
+
 ``WINDOW_MS=0`` bypasses the queue entirely — one dispatch per request, the
 measured control lane of the load-test A/B.
 """
@@ -32,6 +43,7 @@ from h2o3_tpu.serving import (
     BATCH_OCCUPANCY,
     BATCH_ROWS,
     BATCHES,
+    BREAKER,
     QUEUE_DEPTH,
     REQUESTS,
     ROWS,
@@ -41,6 +53,99 @@ from h2o3_tpu.serving import (
 from h2o3_tpu.utils.log import Log
 
 _IDLE_EXIT_S = 30.0  # dispatcher threads die after this much idle time
+_DEGRADE_POLL_S = 0.05  # waiter latch-poll cadence (the "shed budget")
+
+
+def _cloud_down() -> str | None:
+    """The fail-stop latch, read lazily (no import cycle at module load)."""
+    from h2o3_tpu.cluster import cloud
+
+    return cloud.degraded_reason()
+
+
+def _is_cloud_failure(exc: Exception) -> bool:
+    from h2o3_tpu.cluster import recovery
+
+    return recovery.is_cloud_failure(exc)
+
+
+def _degraded_error() -> ShedError:
+    return ShedError(
+        503, "scoring unavailable: the training cloud is degraded "
+             f"(fail-stop: {_cloud_down()}); failed fast instead of "
+             "waiting out the request deadline — retry after recovery",
+        retry_after="5")
+
+
+class _Breaker:
+    """Per-model circuit breaker over the cloud's fail-stop latch.
+
+    closed → (cloud failure) → open → (latch released: supervised recover()
+    or operator clear_degraded) → half_open (ONE probe admitted) →
+    (probe ok) → closed / (probe fails) → open again.
+    """
+
+    def __init__(self, model_key: str):
+        self.key = model_key
+        self.state = "closed"
+        self.probing = False
+        self._lock = threading.Lock()
+
+    def admit(self) -> str:
+        """Gate a new request: returns 'ok' or 'probe', or raises the
+        503-shaped ShedError when the breaker (or the latch) says no."""
+        down = _cloud_down()
+        with self._lock:
+            if self.state == "closed":
+                if down is None:
+                    return "ok"
+                self._open_locked()  # degraded on arrival: open + shed
+            if self.state == "open":
+                if down is not None:
+                    SHED.inc(reason="breaker_open")
+                    REQUESTS.inc(mode="batched", status="shed")
+                    raise ShedError(
+                        503, "scoring circuit breaker open for model "
+                             f"{self.key}: the training cloud is degraded "
+                             f"({down}); retry after recovery",
+                        retry_after="5")
+                # latch released (recover()/clear_degraded): half-open
+                self.state = "half_open"
+                self.probing = False
+                BREAKER.inc(state="half_open")
+                Log.info(f"scoring breaker half-open for {self.key} "
+                         "(cloud healthy again; admitting one probe)")
+            # half_open: exactly one probe in flight, others shed
+            if self.probing:
+                SHED.inc(reason="breaker_open")
+                REQUESTS.inc(mode="batched", status="shed")
+                raise ShedError(
+                    503, f"scoring circuit breaker half-open for model "
+                         f"{self.key}: a probe is already in flight",
+                    retry_after="1")
+            self.probing = True
+            return "probe"
+
+    def _open_locked(self) -> None:
+        if self.state != "open":
+            self.state = "open"
+            self.probing = False
+            BREAKER.inc(state="open")
+            Log.warn(f"scoring breaker OPEN for {self.key} (cloud failure)")
+
+    def record(self, ok: bool, probe: bool) -> None:
+        """Outcome of an admitted request: a successful probe closes the
+        breaker; a cloud failure (from any request) opens it."""
+        with self._lock:
+            if probe:
+                self.probing = False
+            if not ok:
+                self._open_locked()
+            elif self.state != "closed" and probe:
+                self.state = "closed"
+                BREAKER.inc(state="closed")
+                Log.info(f"scoring breaker closed for {self.key} "
+                         "(probe succeeded; traffic re-admitted)")
 
 
 class _Pending:
@@ -77,18 +182,23 @@ class ModelBatcher:
         self._queue: list[_Pending] = []
         self._rows_queued = 0
         self._thread: threading.Thread | None = None
+        self._breaker = _Breaker(model.key)
 
     # -- request side -------------------------------------------------------
     def submit(self, cols, n: int):
         window, max_rows, deadline_s, qmax = _knobs()
         deadline = (time.monotonic() + deadline_s) if deadline_s > 0 else None
+        admit = self._breaker.admit()  # raises 503-shaped when open
+        probe = admit == "probe"
         if window <= 0 or max_rows <= 1:
             # per-request control lane: no queue, one dispatch per request
             try:
                 out = self.scorer.score_table(cols, n)
-            except Exception:
+            except Exception as e:
+                self._breaker.record(ok=not _is_cloud_failure(e), probe=probe)
                 REQUESTS.inc(mode="inline", status="error")
                 raise
+            self._breaker.record(ok=True, probe=probe)
             REQUESTS.inc(mode="inline", status="ok")
             ROWS.inc(n)
             return out
@@ -97,6 +207,8 @@ class ModelBatcher:
             # an empty queue always admits (even a request larger than the
             # bound — it dispatches alone); the bound sheds pile-up, not size
             if qmax > 0 and self._rows_queued and self._rows_queued + n > qmax:
+                if probe:
+                    self._breaker.record(ok=True, probe=True)  # not a verdict
                 SHED.inc(reason="queue_full")
                 REQUESTS.inc(mode="batched", status="shed")
                 raise ShedError(
@@ -108,11 +220,33 @@ class ModelBatcher:
             QUEUE_DEPTH.set(self._rows_queued)
             self._ensure_thread()
             self._cond.notify_all()
+        # wait in short slices, polling the fail-stop latch: when the cloud
+        # degrades while we queue (or while the dispatcher is wedged inside
+        # a dead collective) the request fails 503 within the shed budget
+        # (~_DEGRADE_POLL_S) instead of burning its whole _DEADLINE_MS.
         # +1s grace over the request deadline: the dispatcher sheds expired
-        # entries itself — this outer wait only bounds a wedged dispatcher
-        ok = p.event.wait((deadline - time.monotonic() + 1.0)
-                          if deadline else None)
-        if not ok and not p.event.is_set():
+        # entries itself — the outer bound only covers a wedged dispatcher
+        limit = (deadline - time.monotonic() + 1.0) if deadline else None
+        t_end = (time.monotonic() + limit) if limit is not None else None
+        timed_out = False
+        while not p.event.is_set():
+            remaining = (t_end - time.monotonic()) if t_end is not None else None
+            if remaining is not None and remaining <= 0:
+                timed_out = True
+                break
+            slice_ = _DEGRADE_POLL_S if remaining is None else min(
+                _DEGRADE_POLL_S, remaining)
+            if p.event.wait(slice_):
+                break
+            if _cloud_down() is not None:
+                self._abandon(p)
+                self._breaker.record(ok=False, probe=probe)
+                SHED.inc(reason="degraded")
+                REQUESTS.inc(mode="batched", status="shed")
+                raise _degraded_error()
+        if timed_out and not p.event.is_set():
+            if probe:
+                self._breaker.record(ok=True, probe=True)  # not a verdict
             SHED.inc(reason="deadline")
             REQUESTS.inc(mode="batched", status="shed")
             raise ShedError(
@@ -120,12 +254,27 @@ class ModelBatcher:
                      "(H2O3_TPU_SCORE_DEADLINE_MS); the tier is saturated — "
                      "retry with backoff")
         if p.error is not None:
+            self._breaker.record(
+                ok=not _is_cloud_failure(p.error), probe=probe)
             REQUESTS.inc(mode="batched", status=(
                 "shed" if isinstance(p.error, ShedError) else "error"))
             raise p.error
+        self._breaker.record(ok=True, probe=probe)
         REQUESTS.inc(mode="batched", status="ok")
         ROWS.inc(n)
         return p.result
+
+    def _abandon(self, p: _Pending) -> None:
+        """Remove a still-queued request its waiter is giving up on (cloud
+        degraded); if the dispatcher already popped it, the discarded result
+        is harmless. Also forgets a dispatcher thread that may be wedged
+        inside a dead collective so the next submit gets a fresh one."""
+        with self._cond:
+            if p in self._queue:
+                self._queue.remove(p)
+                self._rows_queued -= p.n
+                QUEUE_DEPTH.set(self._rows_queued)
+            self._thread = None
 
     # -- dispatcher side ----------------------------------------------------
     def _ensure_thread(self) -> None:
@@ -170,6 +319,16 @@ class ModelBatcher:
             take = self._take_batch()
             if take is None:
                 return
+            if _cloud_down() is not None:
+                # the cloud degraded while this batch coalesced: fail the
+                # whole batch fast (503 + Retry-After) and open the breaker
+                # instead of dispatching into a dead mesh
+                self._breaker.record(ok=False, probe=False)
+                for p in take:
+                    SHED.inc(reason="degraded")
+                    p.error = _degraded_error()
+                    p.event.set()
+                continue
             now = time.monotonic()
             live: list[_Pending] = []
             for p in take:
@@ -202,9 +361,22 @@ class ModelBatcher:
                     p.event.set()
             except Exception as e:  # noqa: BLE001 — per-request surfacing
                 Log.err(f"batch scorer dispatch failed: {e!r}")
+                if _is_cloud_failure(e):
+                    # mid-dispatch cloud death: open the breaker and shed
+                    # the batch 503-shaped (retryable after recovery)
+                    # instead of surfacing a raw runtime error per request
+                    self._breaker.record(ok=False, probe=False)
+                    err: Exception = ShedError(
+                        503, "scoring dispatch died of a training-cloud "
+                             f"failure ({e!r}); retry after recovery",
+                        retry_after="5")
+                else:
+                    err = e
                 for p in live:
                     if not p.event.is_set():
-                        p.error = e
+                        if err is not e:
+                            SHED.inc(reason="degraded")
+                        p.error = err
                         p.event.set()
 
 

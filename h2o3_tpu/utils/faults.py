@@ -20,6 +20,17 @@ failure modes the chaos test suite (``pytest -m chaos``) drives:
   ``spmd._maybe_mark_dead_member`` latches on, and ``death_check(site)``
   raises one at an armed site (e.g. ``spmd_run``) to drive the full
   broadcast-failure → ``cloud.mark_degraded`` path without a real dead rank.
+- **process death at a collective boundary**: ``die_check(site)`` raises the
+  same death-signature error one-shot, but its call sites live at the
+  COLLECTIVE BOUNDARIES of the training drivers (the per-interval
+  checkpoint boundary of GBM/DRF/GLM/DL/AutoML, the spmd command broadcast)
+  — the in-process stand-in for a WORKER dying mid-collective, which is
+  what the supervised-recovery drills (cluster/recovery.py) recover from.
+- **persist blackout**: ``blackout:SECS`` makes EVERY persist IO call fail
+  transiently for a wall-clock window of SECS from arming — the storage
+  *outage* stand-in (vs ``site=N``'s counted flakes): proves the retry
+  backoff rides out an outage shorter than its budget horizon and surfaces
+  cleanly past it.
 - **stalls** (the overload/hang chaos half): ``stall_check(site)`` sleeps the
   armed number of seconds ONCE (the in-process stand-in for a wedged
   collective — drives the spmd watchdog), and ``slow_check(site)`` sleeps at
@@ -30,10 +41,11 @@ failure modes the chaos test suite (``pytest -m chaos``) drives:
 Arming is explicit (context manager / ``configure``) or via the
 ``H2O3_TPU_FAULTS`` env knob (config.py), spec ``;``-separated:
 ``site=N`` fails the first N IO calls, ``site@K`` aborts at iteration K,
-``death:site`` raises a synthetic death error at the site,
-``stall:site:SECS`` sleeps once, ``slow:site:SECS`` sleeps every call. When
-nothing is armed every check is a single module-bool test — hot paths pay
-~nothing.
+``death:site`` raises a synthetic death error at the site, ``die:site``
+raises one at a collective-boundary site, ``blackout:SECS`` fails all
+persist IO for a SECS window, ``stall:site:SECS`` sleeps once,
+``slow:site:SECS`` sleeps every call. When nothing is armed every check is
+a single module-bool test — hot paths pay ~nothing.
 
 Determinism contract: counters are keyed by site and incremented in call
 order, so a seeded single-threaded run injects at exactly the same point
@@ -71,6 +83,8 @@ _armed = False
 _fail: dict[str, int] = {}      # io site -> remaining injected failures
 _abort: dict[str, int] = {}     # abort site -> iteration to die at
 _death: set[str] = set()        # sites where a synthetic death error fires
+_die: set[str] = set()          # collective-boundary sites (worker death)
+_blackout_until: float | None = None  # persist outage window end (monotonic)
 _stall: dict[str, float] = {}   # site -> one-shot sleep seconds (wedge)
 _slow: dict[str, float] = {}    # site -> per-call sleep seconds (slowdown)
 _counts: dict[str, int] = {}    # site -> observed check calls (tests assert)
@@ -81,13 +95,20 @@ _DEATH_MSG = ("injected fault: coordination service reports peer task is "
 
 def _parse_spec(spec: str) -> None:
     """Arm from an ``H2O3_TPU_FAULTS`` spec string (see module docstring)."""
-    global _armed
+    global _armed, _blackout_until
     for part in spec.split(";"):
         part = part.strip()
         if not part:
             continue
         if part.startswith("death:"):
             _death.add(part[len("death:"):])
+        elif part.startswith("die:"):
+            _die.add(part[len("die:"):])
+        elif part.startswith("blackout:"):
+            import time
+
+            secs = float(part[len("blackout:"):])
+            _blackout_until = time.monotonic() + secs
         elif part.startswith(("stall:", "slow:")):
             kind, rest = part.split(":", 1)
             site, _, secs = rest.rpartition(":")
@@ -104,24 +125,34 @@ def _parse_spec(spec: str) -> None:
         else:
             raise ValueError(
                 f"bad H2O3_TPU_FAULTS entry {part!r} (want site=N, site@K, "
-                "death:site, stall:site:SECS or slow:site:SECS)")
-    _armed = bool(_fail or _abort or _death or _stall or _slow)
+                "death:site, die:site, blackout:SECS, stall:site:SECS or "
+                "slow:site:SECS)")
+    _armed = bool(_fail or _abort or _death or _die or _blackout_until
+                  or _stall or _slow)
 
 
 def configure(fail: dict[str, int] | None = None,
               abort: dict[str, int] | None = None,
               death: set[str] | frozenset[str] | None = None,
+              die: set[str] | frozenset[str] | None = None,
+              blackout: float | None = None,
               stall: dict[str, float] | None = None,
               slow: dict[str, float] | None = None) -> None:
     """Arm the harness programmatically (additive to whatever is armed)."""
-    global _armed
+    global _armed, _blackout_until
     with _lock:
         _fail.update(fail or {})
         _abort.update(abort or {})
         _death.update(death or ())
+        _die.update(die or ())
+        if blackout is not None:
+            import time
+
+            _blackout_until = time.monotonic() + float(blackout)
         _stall.update(stall or {})
         _slow.update(slow or {})
-        _armed = bool(_fail or _abort or _death or _stall or _slow)
+        _armed = bool(_fail or _abort or _death or _die or _blackout_until
+                      or _stall or _slow)
 
 
 def armed() -> bool:
@@ -134,11 +165,13 @@ def armed() -> bool:
 
 def reset() -> None:
     """Disarm everything and clear counters (re-reads the env knob)."""
-    global _armed
+    global _armed, _blackout_until
     with _lock:
         _fail.clear()
         _abort.clear()
         _death.clear()
+        _die.clear()
+        _blackout_until = None
         _stall.clear()
         _slow.clear()
         _counts.clear()
@@ -154,10 +187,13 @@ def reset() -> None:
 def inject(fail: dict[str, int] | None = None,
            abort: dict[str, int] | None = None,
            death: set[str] | frozenset[str] | None = None,
+           die: set[str] | frozenset[str] | None = None,
+           blackout: float | None = None,
            stall: dict[str, float] | None = None,
            slow: dict[str, float] | None = None):
     """Scoped arming for tests: arms on entry, fully resets on exit."""
-    configure(fail=fail, abort=abort, death=death, stall=stall, slow=slow)
+    configure(fail=fail, abort=abort, death=death, die=die,
+              blackout=blackout, stall=stall, slow=slow)
     try:
         yield
     finally:
@@ -180,6 +216,13 @@ def io_check(site: str, detail: str = "") -> None:
         return
     with _lock:
         _counts[site] = _counts.get(site, 0) + 1
+        if _blackout_until is not None:
+            import time
+
+            if time.monotonic() < _blackout_until:
+                raise InjectedIOError(
+                    f"injected persist blackout at {site} (outage window "
+                    "still open)")
         left = _fail.get(site, 0)
         if left <= 0:
             return
@@ -260,6 +303,27 @@ def death_check(site: str) -> None:
             return
         _death.discard(site)
     raise make_death_error()
+
+
+def die_check(site: str) -> None:
+    """Simulated WORKER death at a collective boundary (one-shot): raises
+    the same death-signature error as :func:`death_check`, but its call
+    sites live where the training drivers cross collective boundaries (the
+    per-interval loops of GBM/DRF/GLM/DL/AutoML — right after the interval
+    checkpoint export, so the snapshot on disk is exactly what a real death
+    would leave — and the spmd command broadcast). The supervised-recovery
+    chaos drills arm this to prove detection → reform → resume end-to-end."""
+    if not _armed:
+        return
+    with _lock:
+        if site not in _die:
+            return
+        _die.discard(site)
+        _counts[site] = _counts.get(site, 0) + 1
+    raise make_death_error(
+        f"injected fault: worker died at collective boundary {site!r} "
+        "(coordination service reports peer task is unhealthy; "
+        "heartbeat timeout)")
 
 
 # env-armed at import so `H2O3_TPU_FAULTS=... pytest` / launch.py work

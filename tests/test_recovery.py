@@ -1,0 +1,583 @@
+"""Self-healing chaos suite (ISSUE 10): supervised auto-resume from
+checkpoints, generation-fenced spmd, batcher degradation + circuit breaker,
+the AutoML poison-step guard, and the new ``die``/``blackout`` fault
+primitives. Everything is deterministic (utils/faults.py) and fast enough
+for tier-1; ``pytest -m chaos`` selects the failure-semantics layer."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.cluster import cloud, recovery, spmd
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM, GLM
+from h2o3_tpu.utils import faults
+from h2o3_tpu.utils import metrics as mx
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fast_recovery(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY", "1")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_BACKOFF", "0.01")
+    monkeypatch.setenv("H2O3_TPU_PERSIST_BACKOFF", "0.01")
+    cloud.clear_degraded()
+    yield
+    faults.reset()
+    cloud.clear_degraded()
+
+
+def _df(n=1500, seed=3):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    eta = df["a"] * 1.5 + (df["c"] == "x") * 2 - df["b"]
+    df["y"] = np.where(eta + rng.normal(size=n) > 0, "p", "n")
+    return df
+
+
+# ---------------------------------------------------------------------------
+# the recover() state machine and generation semantics
+
+
+def test_recover_ticks_generation_and_transitions():
+    g0 = cloud.generation()
+    before = mx.counter_value("cloud_health_transitions_total", to="recovering")
+    assert cloud.recover("noop") == g0  # healthy: recover is a no-op
+    cloud.mark_degraded("test: member died")
+    g1 = cloud.recover("supervised reform")
+    assert g1 == g0 + 1
+    assert cloud.degraded_reason() is None
+    assert cloud.cluster_info()["generation"] == g1
+    assert mx.counter_value(
+        "cloud_health_transitions_total", to="recovering") == before + 1
+
+
+def test_clear_degraded_never_ticks_generation():
+    """The manual escape hatch keeps today's semantics exactly: latch
+    released, generation untouched — the fence stays inert for operators
+    asserting the OLD cloud is fine."""
+    g0 = cloud.generation()
+    cloud.mark_degraded("test")
+    cloud.clear_degraded()
+    assert cloud.generation() == g0
+    assert cloud.degraded_reason() is None
+
+
+def test_adopt_generation_moves_forward_only():
+    g0 = cloud.generation()
+    cloud.adopt_generation(g0 + 3)
+    assert cloud.generation() == g0 + 3
+    cloud.adopt_generation(g0)  # never backwards
+    assert cloud.generation() == g0 + 3
+
+
+# ---------------------------------------------------------------------------
+# generation fencing in spmd (the auto-restart correctness keystone)
+
+
+def test_command_stamped_old_generation_is_rejected(monkeypatch):
+    """A command that entered under generation N and queued behind a wedged
+    command must fail-stop when it finally gets the lock on a cloud that
+    re-formed to N+1 — it may NOT execute against the new formation."""
+    monkeypatch.setattr(spmd, "_IS_MULTI", True)
+    monkeypatch.setattr(spmd, "is_coordinator", lambda: True)
+    from h2o3_tpu.cluster.registry import DKV
+
+    DKV.put("fence_probe", "still here")
+    outcome = []
+    assert spmd._LOCK.acquire(timeout=1)  # stand-in for the wedged command
+    try:
+        def _caller():
+            try:
+                spmd.run("remove", key="fence_probe")
+                outcome.append(None)
+            except Exception as e:  # noqa: BLE001 — captured for assert
+                outcome.append(e)
+
+        t = threading.Thread(target=_caller)
+        t.start()
+        time.sleep(0.4)
+        assert t.is_alive() and not outcome  # queued on the lock, gen N
+        # the reform lands while the waiter sleeps (generation N -> N+1;
+        # latch already released) — then the lock frees
+        cloud.adopt_generation(cloud.generation() + 1)
+        spmd._LOCK.release()
+        t.join(timeout=5)
+    except BaseException:
+        spmd._LOCK.release()
+        raise
+    assert isinstance(outcome[0], spmd.StaleGeneration)
+    assert "generation" in str(outcome[0])
+    assert DKV.get("fence_probe") == "still here"  # never executed
+    DKV.remove("fence_probe")
+
+
+def test_queued_waiter_observes_failstop_during_reform(monkeypatch):
+    """While the wedged command still holds the lock, a reform (latch set →
+    recover) must unblock the waiter with a fail-stop — the generation poll
+    in the bounded acquire, since the degraded window may close before the
+    waiter ever polls the latch."""
+    monkeypatch.setattr(spmd, "_IS_MULTI", True)
+    monkeypatch.setattr(spmd, "is_coordinator", lambda: True)
+    outcome = []
+    assert spmd._LOCK.acquire(timeout=1)
+    try:
+        def _caller():
+            try:
+                spmd.run("remove", key="nope")
+                outcome.append(None)
+            except Exception as e:  # noqa: BLE001
+                outcome.append(e)
+
+        t = threading.Thread(target=_caller)
+        t.start()
+        time.sleep(0.4)
+        assert t.is_alive() and not outcome
+        cloud.mark_degraded("test: wedge")
+        cloud.recover("reform while the wedge still holds the lock")
+        t.join(timeout=5)  # lock is STILL held — only the poll frees it
+        assert not t.is_alive()
+    finally:
+        spmd._LOCK.release()
+    # the waiter observed the fail-stop — as a stale-generation rejection
+    # (it slept through the whole degraded window) or, if a poll landed
+    # inside the brief latched window, as the degraded fail-stop error;
+    # either way it never executed against the re-formed cloud
+    assert isinstance(outcome[0], (spmd.StaleGeneration, RuntimeError))
+    assert ("generation" in str(outcome[0])
+            or "fail-stop" in str(outcome[0]))
+
+
+def test_follower_fence_rejects_stale_adopts_newer():
+    g = cloud.generation()
+    assert spmd._stale_reason(None) is None       # legacy payloads pass
+    assert spmd._stale_reason(g) is None          # current generation passes
+    reason = spmd._stale_reason(g - 1)            # pre-reform: rejected
+    assert reason and "stale-generation" in reason
+    assert spmd._stale_reason(g + 2) is None      # newer: adopted
+    assert cloud.generation() == g + 2
+
+
+# ---------------------------------------------------------------------------
+# supervised auto-resume: worker death mid-train completes WITHOUT operator
+# action, pinned against the uninterrupted run (the acceptance drills)
+
+
+def _latest_snapshot(ckdir, prefix):
+    files = glob.glob(os.path.join(ckdir, f"{prefix}_ckpt_*"))
+    assert files, f"no {prefix} snapshot in {ckdir}"
+    return max(files, key=os.path.getmtime)
+
+
+def test_gbm_worker_death_auto_resumes(tmp_path):
+    fr = Frame.from_pandas(_df())
+    kw = dict(max_depth=3, seed=11, learn_rate=0.2, score_tree_interval=2)
+    full = GBM(ntrees=8, **kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "gbm_heal")
+    g0 = cloud.generation()
+    resumed_before = mx.counter_value("recovery_attempts_total",
+                                      outcome="resumed")
+
+    def _launch(ckpt):
+        kw2 = dict(kw, export_checkpoints_dir=ckdir)
+        if ckpt:
+            kw2["checkpoint"] = ckpt
+        return GBM(ntrees=8, **kw2).train(y="y", training_frame=fr)
+
+    with faults.inject(die={"gbm"}):
+        healed = recovery.run_supervised(_launch, ckdir=ckdir, algo="gbm",
+                                         description="gbm drill")
+    # no operator action: the run completed, the cloud re-formed once
+    assert healed.output["ntrees_actual"] == 8
+    assert cloud.degraded_reason() is None
+    assert cloud.generation() == g0 + 1
+    assert mx.counter_value("recovery_attempts_total",
+                            outcome="resumed") == resumed_before + 1
+    np.testing.assert_allclose(
+        healed.training_metrics.logloss, full.training_metrics.logloss,
+        atol=1e-6)
+    pa = full.predict(fr).vec("p").to_numpy()
+    pb = healed.predict(fr).vec("p").to_numpy()
+    np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+
+def test_glm_worker_death_auto_resumes(tmp_path):
+    fr = Frame.from_pandas(_df(seed=5))
+    kw = dict(family="binomial", max_iterations=25, seed=1)
+    full = GLM(**kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "glm_heal")
+
+    def _launch(ckpt):
+        kw2 = dict(kw, export_checkpoints_dir=ckdir)
+        if ckpt:
+            kw2["checkpoint"] = ckpt
+        return GLM(**kw2).train(y="y", training_frame=fr)
+
+    with faults.inject(die={"glm"}):
+        healed = recovery.run_supervised(_launch, ckdir=ckdir, algo="glm",
+                                         description="glm drill")
+    # the restored loop position replays the identical IRLS trajectory
+    np.testing.assert_array_equal(
+        np.asarray(healed.output["beta_std"]),
+        np.asarray(full.output["beta_std"]))
+    np.testing.assert_allclose(
+        healed.training_metrics.logloss, full.training_metrics.logloss,
+        atol=1e-6)
+
+
+def test_automl_worker_death_auto_resumes(tmp_path, monkeypatch):
+    import h2o3_tpu.automl.automl as A
+
+    fr = Frame.from_pandas(_df(600, seed=7))
+    tiny = [
+        A._Step("s_gbm1", "model", "gbm",
+                dict(ntrees=6, max_depth=3, score_tree_interval=3)),
+        A._Step("s_glm", "model", "glm", dict()),
+        A._Step("s_gbm2", "model", "gbm",
+                dict(ntrees=6, max_depth=2, score_tree_interval=3)),
+    ]
+    monkeypatch.setattr(
+        A, "_default_plan",
+        lambda: [A._Step(s.name, s.kind, s.algo, dict(s.params),
+                         dict(s.hyper), s.weight) for s in tiny],
+    )
+    spec = dict(max_models=3, nfolds=2, seed=11, max_runtime_secs=0.0,
+                project_name="healml")
+
+    def lb_table(aml):
+        return sorted(
+            (r["model_id"].split("_")[0], round(float(r["auc"]), 10))
+            for r in aml.leaderboard.as_table()
+        )
+
+    full = A.AutoML(**spec)
+    full.train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "aml_heal")
+
+    def _launch(_ckpt):
+        # each attempt is a fresh AutoML over the same dir: the step
+        # manifest IS the checkpoint (finished steps recover, the poisoned
+        # ones are guarded)
+        aml = A.AutoML(export_checkpoints_dir=ckdir, **spec)
+        aml.train(y="y", training_frame=fr)
+        return aml
+
+    with faults.inject(die={"automl"}):
+        healed = recovery.run_supervised(_launch, description="automl drill")
+    assert "recover" in {e["stage"] for e in healed.event_log}
+    assert lb_table(healed) == lb_table(full)
+    assert cloud.degraded_reason() is None
+
+
+def test_rest_build_supervised_auto_resume(tmp_path):
+    """The production surface end-to-end: a checkpointed REST build survives
+    an injected worker death — the job completes DONE with restarts=1 in
+    /3/Jobs, no operator in the path."""
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.client import H2OConnection
+
+    srv = start_server(port=0)
+    Frame.from_pandas(_df(400, seed=13), destination_frame="heal_fr")
+    conn = H2OConnection(srv.url)
+    ckdir = str(tmp_path / "rest_heal")
+    with faults.inject(die={"gbm"}):
+        model = conn.train("gbm", y="y", training_frame="heal_fr",
+                           ntrees=4, max_depth=2, seed=1,
+                           score_tree_interval=2,
+                           export_checkpoints_dir=ckdir)
+    # the build completed: the DKV model is the full 4-tree forest
+    mkey = model["model_id"]["name"]
+    from h2o3_tpu.cluster.registry import DKV
+
+    assert DKV.get(mkey).output["ntrees_actual"] == 4
+    jkey = None
+    for j in conn.get("/3/Jobs")["jobs"]:
+        if j.get("restarts"):
+            jkey = j["key"]["name"]
+            assert j["restarts"] == 1
+            assert j["status"] == "DONE"
+    assert jkey, "no job surfaced a supervised restart over /3/Jobs"
+    info = conn.get("/3/Cloud")
+    assert info["cloud_healthy"] and info["generation"] >= 1
+
+
+def test_rest_recover_route(monkeypatch):
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.client import H2OConnection
+
+    srv = start_server(port=0)
+    conn = H2OConnection(srv.url)
+    g0 = cloud.generation()
+    out = conn.post("/3/Recover")  # healthy: idempotent no-op
+    assert out["recovered"] is False and out["generation"] == g0
+    cloud.mark_degraded("test: REST recover drill")
+    out = conn.post("/3/Recover")
+    assert out["recovered"] is True and out["generation"] == g0 + 1
+    assert out["cloud_healthy"] is True
+    # disabled: the latch is one-way over REST too
+    cloud.mark_degraded("test: latched under RECOVERY=0")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY", "0")
+    from h2o3_tpu.client import H2OClientError
+
+    with pytest.raises(H2OClientError) as ei:
+        conn._request_once("POST", "/3/Recover", None, False)
+    assert ei.value.status == 409
+    assert cloud.degraded_reason() is not None
+
+
+# ---------------------------------------------------------------------------
+# H2O3_TPU_RECOVERY=0 restores today's fail-stop semantics bit-for-bit
+
+
+def test_recovery_disabled_restores_failstop(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY", "0")
+    g0 = cloud.generation()
+    calls = []
+
+    def _launch(ckpt):
+        calls.append(ckpt)
+        raise faults.make_death_error()
+
+    with pytest.raises(faults.XlaRuntimeError):
+        recovery.run_supervised(_launch, description="disabled drill")
+    assert calls == [None]  # exactly one attempt, no reform
+    assert cloud.generation() == g0
+    # and the latch stays one-way: nothing auto-clears it
+    cloud.mark_degraded("test: latched")
+    time.sleep(0.1)
+    assert cloud.degraded_reason() is not None
+
+
+def test_recovery_budget_exhausted(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_MAX_RESTARTS", "2")
+    exhausted_before = mx.counter_value("recovery_attempts_total",
+                                        outcome="exhausted")
+    calls = []
+
+    def _launch(ckpt):
+        calls.append(ckpt)
+        raise faults.make_death_error()
+
+    with pytest.raises(recovery.RecoveryExhausted, match="gave up after 2"):
+        recovery.run_supervised(_launch, description="hopeless drill")
+    assert len(calls) == 3  # 1 + 2 restarts
+    assert mx.counter_value("recovery_attempts_total",
+                            outcome="exhausted") == exhausted_before + 1
+
+
+def test_deterministic_failure_never_retried():
+    calls = []
+
+    def _launch(ckpt):
+        calls.append(ckpt)
+        raise ValueError("bad params")
+
+    with pytest.raises(ValueError):
+        recovery.run_supervised(_launch, description="deterministic")
+    assert calls == [None]
+    # TrainAbort (simulated kill -9 of THIS process) is not a cloud failure
+    assert not recovery.is_cloud_failure(faults.TrainAbort("kill -9"))
+
+
+# ---------------------------------------------------------------------------
+# batcher degradation + circuit breaker (the serving half)
+
+
+class _WedgeScorer:
+    """First dispatch wedges (a dead collective); later ones return."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def score_table(self, cols, n):
+        self.calls += 1
+        if self.calls == 1:
+            self.release.wait(15)
+        return {"predict": np.zeros(n)}
+
+
+class _FakeModel:
+    key = "breaker_model"
+
+
+def test_batcher_degradation_fails_fast_and_breaker_reopens(monkeypatch):
+    from h2o3_tpu.serving import ShedError
+    from h2o3_tpu.serving.batcher import ModelBatcher
+
+    monkeypatch.setenv("H2O3_TPU_SCORE_BATCH_WINDOW_MS", "10")
+    monkeypatch.setenv("H2O3_TPU_SCORE_DEADLINE_MS", "8000")  # deliberately long
+    sc = _WedgeScorer()
+    b = ModelBatcher(_FakeModel(), sc)
+    cols = {"a": np.zeros(1)}
+    results = []
+
+    def _req():
+        try:
+            b.submit(dict(cols), 1)
+            results.append(None)
+        except Exception as e:  # noqa: BLE001 — captured for assert
+            results.append(e)
+
+    t1 = threading.Thread(target=_req)
+    t1.start()
+    deadline = time.time() + 5
+    while sc.calls == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert sc.calls == 1  # dispatcher is now wedged mid-dispatch
+    t2 = threading.Thread(target=_req)
+    t2.start()
+    time.sleep(0.2)
+
+    shed_before = mx.counter_value("serving_shed_total", reason="degraded")
+    t0 = time.time()
+    cloud.mark_degraded("test: training cloud incident")
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    fast = time.time() - t0
+    # both in-flight requests failed FAST with the 503 contract — nowhere
+    # near the 8 s deadline they would otherwise burn
+    assert fast < 2.0, fast
+    assert len(results) == 2
+    for e in results:
+        assert isinstance(e, ShedError) and e.status == 503
+        assert e.retry_after
+    assert mx.counter_value("serving_shed_total",
+                            reason="degraded") >= shed_before + 1
+
+    # breaker is open while the cloud stays degraded: instant shed
+    t0 = time.time()
+    with pytest.raises(ShedError) as ei:
+        b.submit(dict(cols), 1)
+    assert ei.value.status == 503 and "breaker" in str(ei.value)
+    assert time.time() - t0 < 0.2
+
+    # recovery half-opens the breaker; the probe re-admits traffic
+    cloud.recover("test: incident over")
+    out = b.submit(dict(cols), 1)  # the probe — dispatches on a fresh thread
+    assert len(out["predict"]) == 1
+    assert b._breaker.state == "closed"
+    out = b.submit(dict(cols), 1)  # steady state restored
+    assert len(out["predict"]) == 1
+    sc.release.set()  # unwedge the stuck dispatcher for cleanup
+
+
+# ---------------------------------------------------------------------------
+# AutoML poison-step guard
+
+
+def test_automl_poison_step_skipped_after_retry_budget(tmp_path, monkeypatch):
+    import h2o3_tpu.automl.automl as A
+
+    monkeypatch.setenv("H2O3_TPU_AUTOML_STEP_RETRIES", "2")
+    fr = Frame.from_pandas(_df(600, seed=17))
+    tiny = [
+        A._Step("poison_gbm", "model", "gbm",
+                dict(ntrees=4, max_depth=3, score_tree_interval=2)),
+        A._Step("ok_glm", "model", "glm", dict()),
+        A._Step("ok_gbm", "model", "gbm",
+                dict(ntrees=4, max_depth=2, score_tree_interval=2)),
+    ]
+    monkeypatch.setattr(
+        A, "_default_plan",
+        lambda: [A._Step(s.name, s.kind, s.algo, dict(s.params),
+                         dict(s.hyper), s.weight) for s in tiny],
+    )
+    ckdir = str(tmp_path / "poison_ck")
+    spec = dict(max_models=3, nfolds=0, seed=11, max_runtime_secs=0.0,
+                project_name="poisonml", export_checkpoints_dir=ckdir)
+
+    # the poison step crashes DETERMINISTICALLY on every resume (re-armed
+    # abort at the same tree) — without the guard this loops forever
+    for attempt in range(2):
+        with faults.inject(abort={"gbm": 2}):
+            with pytest.raises(faults.TrainAbort):
+                A.AutoML(**spec).train(y="y", training_frame=fr)
+        manifest = json.load(
+            open(os.path.join(ckdir, "poisonml.automl.json")))
+        assert manifest["attempts"]["poison_gbm"] == attempt + 1
+
+    # third resume: budget exhausted → the step is SKIPPED and the run
+    # completes with the healthy steps
+    healed = A.AutoML(**spec)
+    healed.train(y="y", training_frame=fr)
+    stages = {e["stage"] for e in healed.event_log}
+    assert "skip" in stages
+    assert any("poison_gbm" in e["message"] for e in healed.event_log
+               if e["stage"] == "skip")
+    assert len(healed.leaderboard.models) == 2  # glm + the healthy gbm
+
+
+# ---------------------------------------------------------------------------
+# blackout fault primitive: a persist outage window
+
+
+def test_blackout_rides_out_within_retry_budget(tmp_path, monkeypatch):
+    from h2o3_tpu.persist import write_bytes
+
+    monkeypatch.setenv("H2O3_TPU_PERSIST_RETRIES", "8")
+    monkeypatch.setenv("H2O3_TPU_PERSIST_BACKOFF", "0.05")
+    tgt = str(tmp_path / "rode_out.bin")
+    t0 = time.time()
+    with faults.inject(blackout=0.15):
+        write_bytes(b"payload", tgt)
+        assert faults.counts()["persist_write"] >= 2  # retried through it
+    assert time.time() - t0 >= 0.15  # the outage was real
+    with open(tgt, "rb") as f:
+        assert f.read() == b"payload"
+
+
+def test_blackout_surfaces_past_budget(tmp_path, monkeypatch):
+    from h2o3_tpu.persist import write_bytes
+
+    monkeypatch.setenv("H2O3_TPU_PERSIST_RETRIES", "1")
+    monkeypatch.setenv("H2O3_TPU_PERSIST_BACKOFF", "0.01")
+    tgt = str(tmp_path / "never.bin")
+    with faults.inject(blackout=5.0):
+        with pytest.raises(faults.InjectedIOError, match="blackout"):
+            write_bytes(b"payload", tgt)
+    assert not os.path.exists(tgt)
+
+
+# ---------------------------------------------------------------------------
+# client: failure/timeout errors embed the recovery pointer
+
+
+def test_client_job_failure_embeds_recovery_pointer(tmp_path):
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.client import H2OClientError, H2OConnection
+
+    srv = start_server(port=0)
+    Frame.from_pandas(_df(400, seed=23), destination_frame="ptr_fr")
+    conn = H2OConnection(srv.url, retries=0)
+    ckdir = str(tmp_path / "ptr_ck")
+    # TrainAbort is NOT a cloud failure: the supervised path propagates it
+    # (a dead process cannot supervise itself) and the job FAILS with its
+    # recovery block populated — which the client error must carry
+    with faults.inject(abort={"gbm": 2}):
+        with pytest.raises(H2OClientError) as ei:
+            conn.train("gbm", y="y", training_frame="ptr_fr",
+                       ntrees=6, max_depth=2, seed=1, score_tree_interval=2,
+                       export_checkpoints_dir=ckdir)
+    e = ei.value
+    assert e.recovery, "client error carries no recovery pointer"
+    assert e.recovery["checkpoint_path"] == _latest_snapshot(ckdir, "gbm")
+    assert "resumable" in str(e) and e.recovery["checkpoint_path"] in str(e)
+    # the pointer is live: resuming from it works without a /3/Jobs trip
+    prior = h2o3_tpu.load_model(e.recovery["checkpoint_path"])
+    assert prior.output["ntrees_actual"] == 2

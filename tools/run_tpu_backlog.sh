@@ -25,6 +25,11 @@
 #      (H2O3_TPU_SCORE_BATCH_WINDOW_MS=0); artifact carries p50/p99, shed
 #      rate, batch-occupancy histogram and the byte-parity probe.
 #      tools/latest_bench_ok.py gates on the artifact's sanity.
+#   8. recovery drill (ISSUE 10): kill a worker mid-bench-GBM (die: fault
+#      at a collective boundary, right after an interval snapshot) and
+#      assert the supervised loop auto-resumes with the PR-2 1e-6 pin and
+#      NO operator action; the artifact logs the recovery_seconds histogram
+#      + restart counts + generation ticks (same drill for GLM and AutoML).
 #   7. quantized collective lane A/B (ISSUE 9): H2O3_TPU_COLLECTIVE_QUANT=1
 #      vs =0 — per-phase modeled bytes with the {lane} split, measured
 #      reduce seconds through the active lane, GBM AUC + GLM coefficient
@@ -133,3 +138,12 @@ save "BENCH_builder_${stamp}_quant.json" "TPU bench quantized-collective headlin
 H2O3_TPU_COLLECTIVE_QUANT=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_quant0.json"  # exact-lane headline control
 save "BENCH_builder_${stamp}_quant0.json" "TPU bench exact-collective control (headline only)"
+
+# self-healing recovery drill (ISSUE 10): worker death mid-GBM/GLM/AutoML
+# with checkpoints enabled — asserts supervised auto-resume completes
+# (1e-6 pin, no operator) and logs the recovery_seconds histogram into the
+# artifact. On TPU the interesting number is the reform+recompile cost on
+# real hardware (the CPU-proxy artifact is committed alongside the PR).
+timeout 1800 python tools/recovery_drill.py \
+  --out "RECOVERY_DRILL_${stamp}.json" > /dev/null
+save "RECOVERY_DRILL_${stamp}.json" "Recovery drill: worker death mid-train, supervised auto-resume + recovery_seconds"
