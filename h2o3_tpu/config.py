@@ -130,6 +130,38 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "devices when the mesh spans >1 process; an integer forces "
                 "that inner-group size (the A/B/test lane on the CPU "
                 "proxy); '0' = single-stage"),
+    "H2O3_TPU_FRAME_COMPRESS": (
+        "1", "compressed device residency for the out-of-core data plane "
+             "(frame/chunkstore.py): tree features live on device as the "
+             "uint8 bin codes the histogram kernels consume (4x capacity "
+             "vs f32, zero accuracy cost), categoricals as their narrow "
+             "int8/int16 codes, and f32 columns materialize only at "
+             "dispatch boundaries — streaming builds release the f32 "
+             "device copies of binned feature columns to the host tier "
+             "and rebuild them lazily on next touch. '0' disables the "
+             "whole plane (no spill, no streaming, no release) and "
+             "restores the fully-resident behavior bit-for-bit, even "
+             "when H2O3_TPU_HBM_WINDOW_BYTES is set"),
+    "H2O3_TPU_HBM_WINDOW_BYTES": (
+        "0", "device-memory budget for one training pipeline's frame "
+             "residency (the out-of-core streaming window): a frame whose "
+             "per-row lanes exceed it trains as a block-accumulate outer "
+             "loop — row-block chunks stream host->device through an LRU "
+             "window of this many bytes (double-buffered prefetch, "
+             "H2O3_TPU_PREFETCH_DEPTH) while evicted chunks park as host "
+             "arrays, so GBM histograms / GLM IRLS Grams / DL epochs run "
+             "at rows >> HBM through a fixed device footprint. Frames "
+             "that fit take the resident path unchanged (bit-parity by "
+             "construction). '0' (default) = unbounded, everything "
+             "resident (today's behavior)"),
+    "H2O3_TPU_PREFETCH_DEPTH": (
+        "1", "how many row-block chunks ahead the out-of-core streaming "
+             "loop issues host->device transfers (frame/chunkstore.py): "
+             "1 = double buffering (block k+1 uploads while block k "
+             "computes — jax device_put is async), higher values deepen "
+             "the pipeline at the cost of a proportionally larger share "
+             "of the HBM window; 0 = synchronous fetches (the A/B "
+             "control for frame_prefetch_overlap_seconds)"),
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),

@@ -1,0 +1,348 @@
+"""Out-of-core data plane — compressed device frames + a host-RAM chunk
+spill tier with double-buffered host→device prefetch (the DKV-chunk
+successor for datasets ≫ HBM; PAPER.md §1: frames are *compressed columnar
+chunks* and compute moves to the data).
+
+The resident frame layer keeps every numeric column device-resident as f32 —
+Higgs-1B at f32×28 cols is ~112 GB and no pod bracket fits it. This module
+is the piece that makes rows ≥ 10× device memory trainable through a FIXED
+device footprint:
+
+- **Compressed device residency** (``H2O3_TPU_FRAME_COMPRESS``, default on):
+  tree features live on device as the uint8 bin codes the histogram kernels
+  already consume (a 4× capacity win at zero accuracy cost — ``bins_u8`` is
+  what the hist/split lane eats), categoricals as their narrow int8/int16
+  codes (frame.Vec.device_dtype), and f32 materializes only at dispatch
+  boundaries; streaming builds release the f32 device copies of binned
+  feature columns to the host tier (``Vec.release_device``) and the ``data``
+  property rebuilds them lazily on next touch.
+- **Host-RAM chunk spill tier** (:class:`ChunkStore`): a training pipeline's
+  per-row lanes (binned features, design-matrix blocks, targets, weights,
+  running per-row state) partition into row-block chunks; an LRU device
+  window bounded by ``H2O3_TPU_HBM_WINDOW_BYTES`` holds the blocks in
+  flight, evicted chunks park as host numpy arrays.
+- **Double-buffered prefetch** (:meth:`ChunkStore.stream`): block k+1's
+  host→device transfer is issued while block k computes (``jax.device_put``
+  is asynchronous), ``H2O3_TPU_PREFETCH_DEPTH`` deep.
+
+The drivers (tree histogram loop, GLM IRLS Gram, DL epochs) become
+block-accumulate outer loops around their EXISTING fused programs —
+histogram accumulation is associative over row blocks, the Gram is a sum,
+DL already minibatches — so the PR-6/PR-8 compiled pipelines and the PR-9
+collective lanes run untouched inside each block. A frame that fits the
+window takes the resident path unchanged (``plan`` returns None), which is
+what pins bit-parity on small frames; ``H2O3_TPU_FRAME_COMPRESS=0``
+disables the whole plane and restores today's resident behavior
+bit-for-bit.
+
+Observability: ``frame_bytes_resident{tier=hbm|host}`` (both tiers'
+current residency), ``frame_chunk_evictions_total`` (LRU churn — the
+oversized-frame smoke test counts eviction cycles here) and
+``frame_prefetch_overlap_seconds`` (wall time each prefetched chunk's
+transfer had to overlap compute before the consumer asked for it).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from h2o3_tpu.utils import metrics as _mx
+
+RESIDENT_BYTES = _mx.gauge(
+    "frame_bytes_resident",
+    "bytes of frame/lane data currently resident, by tier (hbm = device "
+    "arrays owned by Vecs and chunk windows, host = spill-tier numpy "
+    "mirrors and parked chunk lanes)", always=True)
+EVICTIONS = _mx.counter(
+    "frame_chunk_evictions_total",
+    "out-of-core chunks evicted from the LRU device window back to the "
+    "host tier", always=True)
+PREFETCH_OVERLAP = _mx.counter(
+    "frame_prefetch_overlap_seconds",
+    "cumulative wall seconds between issuing a chunk's host->device "
+    "prefetch and the consumer requesting it — the window in which the "
+    "transfer overlapped compute", always=True)
+
+
+def account(tier: str, delta_bytes: float) -> None:
+    """Adjust the two-tier residency gauge (tier = 'hbm' | 'host')."""
+    RESIDENT_BYTES.inc(float(delta_bytes), tier=tier)
+
+
+def compress_on() -> bool:
+    """H2O3_TPU_FRAME_COMPRESS: the master switch of the out-of-core plane.
+    '0' restores the fully-resident behavior bit-for-bit — no spill, no
+    streaming, no device release — even when a window is configured."""
+    from h2o3_tpu import config
+
+    return config.get_bool("H2O3_TPU_FRAME_COMPRESS")
+
+
+def window_bytes() -> int:
+    """H2O3_TPU_HBM_WINDOW_BYTES (0 = unbounded -> everything resident)."""
+    from h2o3_tpu import config
+
+    return max(config.get_int("H2O3_TPU_HBM_WINDOW_BYTES"), 0)
+
+
+def prefetch_depth() -> int:
+    """H2O3_TPU_PREFETCH_DEPTH (1 = double buffering, 0 = synchronous)."""
+    from h2o3_tpu import config
+
+    return max(config.get_int("H2O3_TPU_PREFETCH_DEPTH"), 0)
+
+
+def streaming_enabled() -> bool:
+    """Whether ANY frame may stream: compress on AND a finite window set."""
+    return compress_on() and window_bytes() > 0
+
+
+# stats of the most recently closed ChunkStore (peak_hbm, window, n_blocks,
+# block_rows, evictions): the --oocore-ab harness and the oversized-frame
+# smoke test read the "peak device bytes bounded by the window" acceptance
+# number here, after the driver has already released the store.
+LAST_STORE_STATS: dict = {}
+
+
+class ChunkStore:
+    """Row-blocked two-tier store for one training pipeline's arrays.
+
+    Lanes are full ``(npad, ...)`` host numpy arrays (the spill tier);
+    blocks are contiguous row slices of every lane, sized so that one
+    block's device bytes across the streamed lanes fit the LRU window's
+    per-buffer share (window / (1 + prefetch_depth) — the prefetched
+    block(s) need room beside the computing one). Device copies are cached
+    per (lane, block) in an LRU bounded by the window; mutable lanes write
+    back through :meth:`update`, which refreshes both tiers so an evicted
+    chunk re-uploads the current values.
+    """
+
+    def __init__(self, npad: int, bytes_per_row: float, *,
+                 window: int | None = None, prefetch: int | None = None):
+        from h2o3_tpu.parallel.mesh import stream_block_rows
+
+        self.npad = int(npad)
+        self.window = window_bytes() if window is None else int(window)
+        self.depth = prefetch_depth() if prefetch is None else int(prefetch)
+        budget_rows = int(
+            self.window // max(bytes_per_row * (1 + self.depth), 1))
+        self.block_rows = stream_block_rows(self.npad, budget_rows)
+        self.n_blocks = -(-self.npad // self.block_rows)
+        self._lanes: dict[str, np.ndarray] = {}
+        # (lane, block) -> device array, in LRU order (oldest first)
+        self._dev: OrderedDict[tuple[str, int], object] = OrderedDict()
+        self._pinned: set[tuple[str, int]] = set()
+        self._issued_at: dict[int, float] = {}  # block -> prefetch stamp
+        self._hbm = 0
+        self.peak_hbm = 0
+        self.evictions = 0
+
+    # -- planning -----------------------------------------------------------
+    @staticmethod
+    def plan(npad: int, bytes_per_row: float) -> "ChunkStore | None":
+        """The ONE policy gate every driver uses: None (stay resident) when
+        the plane is off, no window is set, or the frame's streamed lanes
+        fit the window whole — the resident path is bit-for-bit today's.
+        Otherwise a store whose block geometry fits the window."""
+        if not streaming_enabled():
+            return None
+        if npad * bytes_per_row <= window_bytes():
+            return None
+        store = ChunkStore(npad, bytes_per_row)
+        if store.n_blocks <= 1:
+            return None
+        return store
+
+    # -- lanes (host tier) --------------------------------------------------
+    def add(self, name: str, arr: np.ndarray) -> np.ndarray:
+        """Register a host lane (leading axis npad). Returns the lane so
+        callers can fill it in place."""
+        arr = np.ascontiguousarray(arr)
+        assert arr.shape[0] == self.npad, (name, arr.shape, self.npad)
+        old = self._lanes.get(name)
+        if old is not None:
+            account("host", -old.nbytes)
+        self._lanes[name] = arr
+        account("host", arr.nbytes)
+        return arr
+
+    def add_empty(self, name: str, shape: tuple, dtype, fill=0) -> np.ndarray:
+        return self.add(name, np.full(shape, fill, dtype=dtype))
+
+    def lane(self, name: str) -> np.ndarray:
+        return self._lanes[name]
+
+    def fill(self, name: str, value) -> None:
+        """Reset a mutable lane on both tiers (drops stale device copies)."""
+        self._lanes[name].fill(value)
+        for bi in range(self.n_blocks):
+            self._drop((name, bi))
+
+    def span(self, bi: int) -> tuple[int, int]:
+        lo = bi * self.block_rows
+        return lo, min(lo + self.block_rows, self.npad)
+
+    def rows(self, bi: int) -> int:
+        lo, hi = self.span(bi)
+        return hi - lo
+
+    # -- device window ------------------------------------------------------
+    def _drop(self, key: tuple[str, int], evict: bool = False) -> None:
+        arr = self._dev.pop(key, None)
+        if arr is not None:
+            self._hbm -= arr.nbytes
+            account("hbm", -arr.nbytes)
+            if evict:
+                self.evictions += 1
+                EVICTIONS.inc()
+
+    def _evict_to(self, budget: int) -> None:
+        for key in list(self._dev):
+            if self._hbm <= budget:
+                break
+            if key in self._pinned:
+                continue
+            self._drop(key, evict=True)
+
+    def fetch(self, bi: int, names: Sequence[str], pin: bool = False) -> dict:
+        """Device arrays for block ``bi``'s named lanes, through the LRU
+        window (misses upload from the host tier; the window evicts
+        least-recently-used unpinned chunks past the budget)."""
+        from h2o3_tpu.parallel.mesh import shard_rows
+
+        lo, hi = self.span(bi)
+        out = {}
+        for name in names:
+            key = (name, bi)
+            arr = self._dev.get(key)
+            if arr is None:
+                lane = self._lanes[name][lo:hi]
+                if self.window:
+                    # evict BEFORE the upload so the window bounds the PEAK
+                    # residency, not just the steady state (the bound can
+                    # still exceed the window when the pinned in-flight
+                    # blocks alone do — the documented one-quantum floor)
+                    self._evict_to(max(self.window - lane.nbytes, 0))
+                arr = shard_rows(lane)
+                self._dev[key] = arr
+                self._hbm += arr.nbytes
+                account("hbm", arr.nbytes)
+                self.peak_hbm = max(self.peak_hbm, self._hbm)
+            else:
+                self._dev.move_to_end(key)
+            if pin:
+                self._pinned.add(key)
+            out[name] = arr
+        return out
+
+    def update(self, bi: int, **arrays) -> None:
+        """Write a block's new device values back: the host lane slice is
+        refreshed (the spill tier stays current, so eviction loses nothing)
+        and the device copy in the window is replaced in place."""
+        import jax
+
+        lo, hi = self.span(bi)
+        for name, arr in arrays.items():
+            self._lanes[name][lo:hi] = np.asarray(jax.device_get(arr)).reshape(
+                self._lanes[name][lo:hi].shape)
+            key = (name, bi)
+            old = self._dev.pop(key, None)
+            if old is not None:
+                self._hbm -= old.nbytes
+                account("hbm", -old.nbytes)
+            if self.window:
+                # same pre-insert eviction as fetch: the window bounds PEAK
+                self._evict_to(max(self.window - arr.nbytes, 0))
+            self._dev[key] = arr
+            self._hbm += arr.nbytes
+            account("hbm", arr.nbytes)
+            self.peak_hbm = max(self.peak_hbm, self._hbm)
+
+    def unpin(self, bi: int) -> None:
+        self._pinned = {k for k in self._pinned if k[1] != bi}
+
+    def stream(self, names: Sequence[str]):
+        """Iterate ``(bi, {name: device_array})`` over every block with
+        ``prefetch_depth`` blocks of lookahead: block k+1's upload is issued
+        (pinned against eviction) before block k is yielded, so the
+        transfer rides behind block k's compute."""
+        for bi in range(self.n_blocks):
+            for j in range(bi + 1, min(bi + 1 + self.depth, self.n_blocks)):
+                if j not in self._issued_at:
+                    self._issued_at[j] = time.perf_counter()
+                    self.fetch(j, names, pin=True)
+            t0 = self._issued_at.pop(bi, None)
+            if t0 is not None:
+                PREFETCH_OVERLAP.inc(time.perf_counter() - t0)
+            blk = self.fetch(bi, names)
+            self.unpin(bi)
+            yield bi, blk
+        self._issued_at.clear()
+
+    def close(self) -> None:
+        """Release both tiers (gauge returns to its prior level) and
+        publish the run's stats into :data:`LAST_STORE_STATS` — the A/B
+        harness and the oversized-frame smoke test read the peak/eviction
+        numbers there after the driver is done."""
+        LAST_STORE_STATS.update(
+            peak_hbm=self.peak_hbm, window=self.window,
+            n_blocks=self.n_blocks, block_rows=self.block_rows,
+            evictions=self.evictions,
+        )
+        for key in list(self._dev):
+            self._drop(key)
+        self._pinned.clear()
+        for name in list(self._lanes):
+            account("host", -self._lanes.pop(name).nbytes)
+
+    def __repr__(self) -> str:
+        return (f"<ChunkStore {self.npad} rows x {len(self._lanes)} lanes, "
+                f"{self.n_blocks} blocks of {self.block_rows}, "
+                f"window {self.window} B, hbm {self._hbm} B>")
+
+
+# ---------------------------------------------------------------------------
+# frame helpers: host block sub-frames + compressed-residency release
+
+
+def host_block_frame(frame, names: Iterable[str], lo: int, hi: int):
+    """A block sub-frame over rows ``[lo, hi)`` of ``frame``'s PADDED host
+    mirrors: each named column slices its host tier copy and ships one
+    block-sized device array. ``hi - lo`` must divide the mesh
+    (``mesh.block_quantum`` multiples do), so the sub-frame needs no extra
+    padding rows and every elementwise transform (binning, DataInfo
+    standardize/one-hot) yields EXACTLY the row slice of the full frame's
+    transform — the bit-parity backbone of the streaming setup passes."""
+    from h2o3_tpu.frame.frame import STR, Frame, Vec
+    from h2o3_tpu.parallel.mesh import shard_rows
+
+    nrow_blk = max(min(hi, frame.nrow) - lo, 0)
+    vecs = []
+    for name in names:
+        v = frame.vec(name)
+        assert v.kind != STR, "streaming lanes are numeric/categorical only"
+        buf = v.host_values()[lo:hi]
+        vecs.append(
+            Vec(shard_rows(buf), v.kind, name=name, domain=v.domain,
+                nrow=nrow_blk)
+        )
+    return Frame(vecs, list(names), register=False)
+
+
+def release_frame_features(frame, names: Iterable[str]) -> int:
+    """Compressed device residency: drop the f32/int device copies of the
+    named feature columns (their information lives on as bin codes /
+    design-matrix lanes in a ChunkStore) — the host tier keeps the exact
+    values and ``Vec.data`` rebuilds lazily on next touch. No-op (returns
+    0) with H2O3_TPU_FRAME_COMPRESS=0. Returns bytes released."""
+    if not compress_on():
+        return 0
+    freed = 0
+    for name in names:
+        v = frame.vec(name)
+        freed += v.release_device()
+    return freed

@@ -40,6 +40,20 @@ NUM, CAT, STR, TIME = "real", "enum", "string", "time"
 INT = "int"  # integral-valued numeric; stored like NUM but reported as int
 
 
+def _vec_gc(acct: dict) -> None:
+    """weakref.finalize hook: return a dead Vec's remaining accounted bytes
+    to the two-tier residency gauge (frame/chunkstore.py)."""
+    try:
+        from h2o3_tpu.frame import chunkstore as _cs
+
+        for tier, amt in acct.items():
+            if amt:
+                _cs.account(tier, -amt)
+                acct[tier] = 0.0
+    except Exception:  # noqa: BLE001 — interpreter teardown must stay quiet
+        pass
+
+
 class Vec:
     """One column. Device-resident for num/cat/time; host-resident for str.
 
@@ -47,7 +61,23 @@ class Vec:
     the host (``_host``): the device array is float32 (fine for model math,
     like H2O treating time as numeric), but f32 quantizes epoch-ms to ~2-minute
     steps, so materialization/round-trips use the exact copy.
+
+    Two-tier residency (the out-of-core data plane, frame/chunkstore.py):
+    ``data`` is a property over ``_data``. :meth:`release_device` parks the
+    padded values as a host mirror (``_hostbuf``) and drops the device
+    array; the property rebuilds it lazily — bit-identical, a device_get/
+    device_put round trip of the same dtype — on next touch. Both tiers are
+    accounted in the ``frame_bytes_resident{tier=hbm|host}`` gauge, and a
+    finalizer returns a collected Vec's bytes so the gauge tracks LIVE
+    residency, not cumulative traffic.
     """
+
+    # class-level defaults so the Vec flavors that skip __init__ (LazyVec,
+    # WrappedCatVec — frame/lazy.py) inherit working tier methods with
+    # accounting as a no-op
+    _hostbuf: np.ndarray | None = None
+    _acct: dict | None = None
+    _data = None
 
     def __init__(
         self,
@@ -58,19 +88,99 @@ class Vec:
         nrow: int | None = None,
         host_exact: np.ndarray | None = None,
     ):
+        import weakref
+
         self.kind = kind
         self.name = name
         self.domain = tuple(domain) if domain is not None else None
+        self._acct = {"hbm": 0.0, "host": 0.0}
+        self._hostbuf: np.ndarray | None = None
+        self._data = None
+        weakref.finalize(self, _vec_gc, self._acct)
         if kind == STR:
             self._host = np.asarray(data, dtype=object)
-            self.data = None
             self.nrow = len(self._host) if nrow is None else nrow
         else:
             self._host = host_exact
+            if self._host is not None:
+                self._acct_add("host", self._host.nbytes)
             self.data = data  # padded, sharded jax array
             assert nrow is not None
             self.nrow = nrow
         self._stats: dict | None = None
+
+    # -- two-tier residency --------------------------------------------------
+    def _acct_add(self, tier: str, delta: float) -> None:
+        if self._acct is None:  # Vec flavors that skip __init__
+            return
+        from h2o3_tpu.frame import chunkstore as _cs
+
+        self._acct[tier] += delta
+        _cs.account(tier, delta)
+
+    @property
+    def data(self):
+        """Padded, sharded device array; rebuilt lazily from the host mirror
+        after :meth:`release_device` (bit-identical values)."""
+        if self._data is None and self._hostbuf is not None:
+            from h2o3_tpu.parallel.mesh import shard_rows
+
+            d = shard_rows(self._hostbuf)
+            self._data = d
+            self._acct_add("hbm", d.nbytes)
+        return self._data
+
+    @data.setter
+    def data(self, v) -> None:
+        if self._data is not None:
+            self._acct_add("hbm", -self._data.nbytes)
+        self._data = v
+        if v is not None:
+            self._acct_add("hbm", getattr(v, "nbytes", 0))
+
+    def host_values(self) -> np.ndarray:
+        """PADDED host mirror in the device dtype — the spill-tier copy the
+        out-of-core block slicer reads. Cached; identical bits to the
+        device array (a plain device_get)."""
+        if self.kind == STR:
+            return self._host
+        if self._hostbuf is None:
+            from h2o3_tpu.parallel.mesh import pull_to_host
+
+            self._hostbuf = np.asarray(pull_to_host(self.data))
+            self._acct_add("host", self._hostbuf.nbytes)
+        return self._hostbuf
+
+    def release_device(self) -> int:
+        """Compressed residency: ensure the host mirror exists, then drop
+        the device array (HBM freed; ``data`` rebuilds lazily). Returns the
+        device bytes released."""
+        if self.kind == STR or self._data is None:
+            return 0
+        self.host_values()
+        freed = int(self._data.nbytes)
+        self.data = None
+        return freed
+
+    def _seed_host_mirror(self, buf: np.ndarray) -> None:
+        """Adopt an ingest-time padded host buffer as the spill-tier mirror
+        (frame/parse.py batched upload): a later streaming build's
+        ``host_values()`` then costs nothing instead of a device pull."""
+        if self.kind == STR or self._hostbuf is not None:
+            return
+        self._hostbuf = np.ascontiguousarray(buf)
+        self._acct_add("host", self._hostbuf.nbytes)
+
+    def drop_host_mirror(self) -> int:
+        """Release the spill-tier mirror (satellite of the double-residency
+        fix: once a device copy exists again, the mirror is redundant and a
+        long-lived frame should not pay host RAM for both tiers)."""
+        if self._hostbuf is None:
+            return 0
+        freed = int(self._hostbuf.nbytes)
+        self._acct_add("host", -freed)
+        self._hostbuf = None
+        return freed
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -105,7 +215,11 @@ class Vec:
     # -- basics --------------------------------------------------------------
     @property
     def npad(self) -> int:
-        return len(self._host) if self.data is None else self.data.shape[0]
+        if self._data is not None:
+            return self._data.shape[0]
+        if self._hostbuf is not None:  # device-released: don't re-upload
+            return self._hostbuf.shape[0]
+        return len(self._host)
 
     def is_numeric(self) -> bool:
         return self.kind in (NUM, INT, TIME)
@@ -119,6 +233,8 @@ class Vec:
             return self._host
         if self.kind == TIME and self._host is not None:
             return self._host
+        if self._data is None and self._hostbuf is not None:
+            return self._hostbuf[: self.nrow]  # device-released: host tier
         from h2o3_tpu.parallel.mesh import pull_to_host
 
         return np.asarray(pull_to_host(self.data))[: self.nrow]
@@ -347,6 +463,25 @@ class Frame:
                 vals = np.concatenate([va.to_numpy(), vb.to_numpy()])
                 vecs.append(Vec.from_numpy(vals, va.kind, name=va.name))
         return Frame(vecs, self._names)
+
+    # -- two-tier residency (out-of-core data plane, frame/chunkstore.py) ----
+    def spill_to_host(self, cols: Sequence[str] | None = None) -> int:
+        """Release the device copies of (the named, default all) non-string
+        columns to the host tier; ``Vec.data`` rebuilds lazily on next
+        touch. No-op under ``H2O3_TPU_FRAME_COMPRESS=0``. Returns device
+        bytes released."""
+        from h2o3_tpu.frame import chunkstore as _cs
+
+        names = list(cols) if cols is not None else self._names
+        return _cs.release_frame_features(self, names)
+
+    def resident_bytes(self) -> dict:
+        """Per-tier bytes this frame's Vecs currently account."""
+        out = {"hbm": 0.0, "host": 0.0}
+        for v in self._vecs:
+            for tier, amt in (v._acct or {}).items():
+                out[tier] += amt
+        return out
 
     # -- row mask ------------------------------------------------------------
     def row_mask(self):

@@ -268,6 +268,9 @@ def dataframe_to_vecs(df: pd.DataFrame, column_types: Mapping[str, str]) -> list
             dt, fill = Vec.device_dtype(kind, domain)
             groups.setdefault(dt.name, (dt, fill, []))[2].append(i)
 
+    from h2o3_tpu.frame import chunkstore as _cs
+
+    seed_mirror = _cs.streaming_enabled()
     for dt, fill, idxs in groups.values():
         mat = np.full((npad, len(idxs)), fill, dtype=dt)
         for j, i in enumerate(idxs):
@@ -277,6 +280,12 @@ def dataframe_to_vecs(df: pd.DataFrame, column_types: Mapping[str, str]) -> list
             name, kind, _arr, domain, exact = specs[i]
             vecs[i] = Vec(dmat[:, j], kind, name=name, domain=domain,
                           nrow=n, host_exact=exact)
+            if seed_mirror:
+                # an HBM window is configured: the ingest buffer already
+                # holds the padded column, so seed the spill-tier mirror
+                # now — a streaming build's host_values() then costs
+                # nothing instead of a device pull per column
+                vecs[i]._seed_host_mirror(mat[:, j])
     return vecs
 
 

@@ -351,7 +351,14 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
     epochs_done)."""
     import time as _time
 
+    from h2o3_tpu.frame.chunkstore import ChunkStore
     from h2o3_tpu.parallel.mesh import n_shards, pad_flat_to_shards
+
+    if isinstance(X, ChunkStore):
+        return _run_sync_sgd_streamed(
+            job, p, mlp, kind, tx, params, opt_state, X, nrow, key,
+            start_epochs, on_epoch,
+        )
 
     batch = min(int(p.mini_batch_size), npad)
     nbatch = max(1, nrow // batch)
@@ -457,6 +464,132 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
                 on_epoch(params, opt_state, epochs_done, history)
         job.update(0.05 + 0.9 * epochs_done / n_epochs)
         e += k_i
+        if keeper.should_stop() or job.stop_requested:
+            Log.info(f"DeepLearning early stop at epoch {epochs_done}")
+            stopped = True
+    if shard_on:
+        params = unravel(params[:n_real])
+        opt_state = _state_from_flat(opt_state, unravel, n_real)
+    return params, opt_state, history, epochs_done
+
+
+def _run_sync_sgd_streamed(job, p, mlp, kind, tx, params, opt_state, store,
+                           nrow: int, key, start_epochs: int = 0,
+                           on_epoch=None):
+    """Out-of-core epoch driver (ISSUE 11): one epoch = one pass over the
+    ChunkStore's row blocks, each block running the EXISTING compiled
+    chunk program (one-epoch form) on its streamed (X, y, w) lanes while
+    the next block's transfer rides behind it. Shuffling is within-block —
+    the documented deviation from the resident global shuffle (frames that
+    fit the window never reach this driver, so the bit-parity pins hold on
+    the resident path). params/opt_state stay donated across block
+    dispatches; epoch-loss early stopping and checkpoint cadence match the
+    resident driver's."""
+    import time as _time
+
+    from h2o3_tpu.parallel.mesh import n_shards, pad_flat_to_shards
+
+    blk_rows = store.block_rows
+    batch = min(int(p.mini_batch_size), blk_rows)
+    l1, l2 = jnp.float32(p.l1), jnp.float32(p.l2)
+    dropout = _resolved_dropout(p, len(p.hidden))
+    shard_on = _dl_grad_shard(
+        p, dropout, p.input_dropout_ratio, batch,
+        _flat_state_ok(opt_state, params),
+    )
+    n_sh = n_shards()
+    D = store.lane("X").shape[1]
+    desc = (tuple(int(h) for h in mlp.hidden), mlp.activation.lower(),
+            tuple(mlp.dropout), float(mlp.input_dropout), int(mlp.n_out),
+            kind, D,
+            bool(p.adaptive_rate), float(p.rho), float(p.epsilon),
+            float(p.rate), float(p.rate_decay), float(p.momentum_start or 0))
+
+    unravel = None
+    n_real = fpad = 0
+    if shard_on:
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(params)
+        n_real = int(flat.size)
+        fpad = pad_flat_to_shards(n_real)
+        params = jnp.pad(flat, (0, fpad - n_real))
+        opt_state = _state_to_flat(opt_state, unravel(flat), tx, fpad)
+
+    keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, False)
+    seed = abs(p.seed) if p.seed and p.seed > 0 else 99
+    rng = np.random.default_rng(seed)
+    history = []
+    n_epochs = max(1, int(np.ceil(p.epochs)))
+    real = [max(min(store.span(bi)[1], nrow) - store.span(bi)[0], 0)
+            for bi in range(store.n_blocks)]
+    for _ in range(start_epochs):  # continuation: keep the RNG streams
+        for bi in range(store.n_blocks):  # aligned with an uninterrupted
+            if real[bi]:  # streamed run
+                rng.permutation(real[bi])
+        key, _ = jax.random.split(key)
+    epochs_done = start_epochs
+
+    coll = {}
+    if n_sh > 1:
+        from h2o3_tpu.ops.collectives import modeled_reduce_bytes
+
+        n_param = n_real if shard_on else sum(
+            int(np.prod(q.shape)) for q in jax.tree.leaves(params))
+        if shard_on:
+            reduce_lanes = dict(modeled_reduce_bytes(fpad, n_sh, passes=2))
+            reduce_lanes["exact"] = reduce_lanes.get("exact", 0.0) + 4.0
+            coll = {"dl_grad_reduce": reduce_lanes,
+                    "dl_param_gather": {"exact": fpad * 4.0}}
+        else:
+            coll = {"dl_grad_reduce": {"exact": n_param * 4.0}}
+
+    e = start_epochs
+    stopped = False
+    while e < n_epochs and not stopped:
+        _ep_t0 = _time.perf_counter()
+        key, ekey = jax.random.split(key)
+        loss_sum, nb_sum = 0.0, 0
+        for bi, blk in store.stream(("X", "y", "w")):
+            if real[bi] == 0:
+                continue  # all-padding tail block
+            nbatch = max(1, real[bi] // batch)
+            perm = np.zeros((1, blk_rows), np.int64)
+            perm[0, : real[bi]] = rng.permutation(real[bi])
+            slot = jnp.asarray(
+                (np.arange(blk_rows) < real[bi]).astype(np.float32))
+            prog = _dl_chunk_program(
+                desc, mlp, tx, kind, batch, blk_rows, 1, shard_on,
+                unravel=unravel, n_real=n_real, fpad=fpad,
+            )
+            _DL_DISPATCHES.inc()
+            params, opt_state, _k, losses = prog(
+                params, opt_state, blk["X"], blk["y"], blk["w"],
+                jnp.asarray(perm), jax.random.fold_in(ekey, bi),
+                jnp.int32(nbatch), l1, l2, slot,
+            )
+            loss_sum += float(np.asarray(losses)[0]) * nbatch
+            nb_sum += nbatch
+        epochs_done = e + 1
+        loss = loss_sum / max(nb_sum, 1)
+        history.append({"epoch": epochs_done, "loss": loss})
+        _DL_EPOCHS.inc()
+        _DL_EPOCH_SECONDS.observe(_time.perf_counter() - _ep_t0)
+        keeper.record(loss)
+        for ph, lanes in coll.items():
+            for lane, nb in lanes.items():
+                if nb:
+                    _COLL_BYTES.inc(nb * nb_sum, phase=ph)
+                    _COLL_BYTES.inc(nb * nb_sum, phase=ph, lane=lane)
+        if on_epoch is not None:
+            if shard_on:
+                on_epoch(unravel(params[:n_real]),
+                         _state_from_flat(opt_state, unravel, n_real),
+                         epochs_done, history)
+            else:
+                on_epoch(params, opt_state, epochs_done, history)
+        job.update(0.05 + 0.9 * epochs_done / n_epochs)
+        e += 1
         if keeper.should_stop() or job.stop_requested:
             Log.info(f"DeepLearning early stop at epoch {epochs_done}")
             stopped = True
@@ -609,6 +742,62 @@ class DeepLearning(ModelBuilder):
     algo = "deeplearning"
     PARAMS_CLS = DeepLearningParams
 
+    def _plan_streamed(self, train: Frame, di, p, d_pad: int, ybuf, okresp):
+        """ChunkStore of block design lanes for out-of-core epochs, or
+        None for the resident path (autoencoder is excluded — its
+        reconstruction target is the whole design; docs/MIGRATION.md
+        fallback matrix)."""
+        from h2o3_tpu.frame import chunkstore as cs
+
+        if p.autoencoder:
+            return None
+        store = cs.ChunkStore.plan(train.npad, (d_pad + 2) * 4 + 8)
+        if store is None:
+            return None
+        npad = train.npad
+        Log.info(
+            f"DeepLearning out-of-core streaming: {store.n_blocks} blocks "
+            f"x {store.block_rows} rows, input width {d_pad}"
+        )
+        Xlane = store.add_empty("X", (npad, d_pad), np.float32)
+        vmask = np.zeros(npad, np.float32)
+        need = [c.name for c in di.columns if c.pair is None]
+        for c in di.columns:
+            if c.pair is not None:
+                need += [nm for nm in c.pair if nm not in need]
+        for bi in range(store.n_blocks):
+            lo, hi = store.span(bi)
+            bf = cs.host_block_frame(train, need, lo, hi)
+            Xb, vb = di.transform(bf)
+            Xlane[lo:hi, : di.ncols_expanded] = np.asarray(jax.device_get(Xb))
+            vmask[lo:hi] = np.asarray(jax.device_get(vb))
+        cs.release_frame_features(train, need)
+        w_np = vmask
+        if p.weights_column:
+            w_np = w_np * np.nan_to_num(
+                train.vec(p.weights_column).host_values().astype(np.float32))
+        store.add("w", (w_np * okresp).astype(np.float32))
+        store.add("y", np.asarray(ybuf, np.float32))
+        return store
+
+    def _streamed_metrics(self, model: "DeepLearningModel", store,
+                          frame: Frame):
+        """Training metrics from per-block forward passes over the store's
+        design lanes — the resident design is never re-materialized."""
+        from h2o3_tpu.models.model_base import _make_metrics
+
+        parts = []
+        for bi, blk in store.stream(("X",)):
+            logits = model.output["apply_fn"](model.output["params"],
+                                              blk["X"])
+            if model.is_classifier:
+                parts.append(np.asarray(jax.nn.softmax(logits, axis=1)))
+            else:
+                parts.append(np.asarray(logits[:, 0]))
+        raw = np.concatenate(parts)[: frame.nrow]
+        yh, wh = model._response_and_weights(frame)
+        return _make_metrics(model, raw, yh, wh)
+
     def _epoch_snapshot(self, key, di, prm, ost, done, hist, domain,
                         autoencoder=False, expanded=None) -> DeepLearningModel:
         """Interval-snapshot factory: params + optimizer accumulators +
@@ -727,16 +916,10 @@ class DeepLearning(ModelBuilder):
 
         di = DataInfo.fit(train, self._x, standardize=p.standardize,
                           hash_buckets=p.hash_buckets)
-        X, wmask = di.transform(train)
         # shape-bucket ladder on the input width (zero columns, proven
         # bit-inert via the zero-padded first kernel — _dl_pad_cols)
         D = di.ncols_expanded
         d_pad = _dl_pad_cols(D)
-        if d_pad > D:
-            X = jnp.pad(X, ((0, 0), (0, d_pad - D)))
-        w = wmask
-        if p.weights_column:
-            w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
         y_np = yv.to_numpy().astype(np.float64)
         ybuf = np.zeros(train.npad, np.float32)
         ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
@@ -744,8 +927,25 @@ class DeepLearning(ModelBuilder):
         okresp[: train.nrow] = (
             (y_np >= 0) if classification else ~np.isnan(y_np)
         ).astype(np.float32)
-        w = jnp.asarray(np.asarray(w) * okresp)
-        y = jnp.asarray(ybuf)
+
+        # out-of-core streaming (ISSUE 11, frame/chunkstore.py): a design
+        # matrix past the HBM window trains as row-block epochs — DL
+        # already minibatches, so each block runs the existing chunk
+        # program; shuffling is within-block (documented deviation).
+        stream = self._plan_streamed(train, di, p, d_pad, ybuf, okresp)
+        if stream is not None:
+            X = stream
+            w = jnp.asarray(stream.lane("w"))
+            y = jnp.asarray(ybuf)
+        else:
+            X, wmask = di.transform(train)
+            if d_pad > D:
+                X = jnp.pad(X, ((0, 0), (0, d_pad - D)))
+            w = wmask
+            if p.weights_column:
+                w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
+            w = jnp.asarray(np.asarray(w) * okresp)
+            y = jnp.asarray(ybuf)
 
         mlp = _make_mlp(p, n_out=n_out)
         seed = abs(p.seed) if p.seed and p.seed > 0 else 99
@@ -827,7 +1027,12 @@ class DeepLearning(ModelBuilder):
         }
         model = DeepLearningModel(DKV.make_key("dl"), p, out)
         model.scoring_history = history
-        model.training_metrics = model._score_metrics(train)
+        if stream is not None:
+            # streamed scoring: never re-materialize the resident design
+            model.training_metrics = self._streamed_metrics(model, stream, train)
+            stream.close()
+        else:
+            model.training_metrics = model._score_metrics(train)
         if valid is not None:
             model.validation_metrics = model._score_metrics(valid)
         return model

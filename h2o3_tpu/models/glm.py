@@ -496,11 +496,6 @@ class GLM(ModelBuilder):
             interaction_pairs=pairs or None,
             hash_buckets=int(p.hash_buckets) if p.hash_buckets else None,
         )
-        X, valid_mask = di.transform(train)
-        w = valid_mask
-        if p.weights_column:
-            w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
-        offset = _offset_col(p, train)
 
         y_np = yv.to_numpy()
         if yv.is_categorical():
@@ -510,8 +505,30 @@ class GLM(ModelBuilder):
         ybuf[: train.nrow] = np.nan_to_num(y_np, nan=0.0)
         yna = np.zeros(train.npad, np.float32)
         yna[: train.nrow] = np.isnan(y_np)
-        w = w * (1.0 - jnp.asarray(yna))  # rows with NA response get weight 0
-        y = jnp.asarray(ybuf)
+
+        # out-of-core streaming (ISSUE 11, frame/chunkstore.py): a design
+        # matrix past the HBM window streams as row-block chunks through
+        # the per-iteration Gram accumulation (the IRLS Gram is a sum over
+        # row blocks). Fallback matrix (docs/MIGRATION.md): multinomial /
+        # ordinal / L-BFGS / compute_p_values stay resident.
+        stream = None
+        if (family not in ("multinomial", "ordinal")
+                and p.solver.upper().replace("-", "_") not in ("L_BFGS", "LBFGS")
+                and not p.compute_p_values):
+            stream = self._plan_streamed(train, di, p, ybuf, yna)
+        if stream is not None:
+            X = stream
+            w = stream.lane("w")
+            y = ybuf
+            offset = stream.lane("offset")
+        else:
+            X, valid_mask = di.transform(train)
+            w = valid_mask
+            if p.weights_column:
+                w = w * jnp.nan_to_num(train.vec(p.weights_column).data)
+            offset = _offset_col(p, train)
+            w = w * (1.0 - jnp.asarray(yna))  # NA-response rows get weight 0
+            y = jnp.asarray(ybuf)
 
         nobs = float(np.asarray(w.sum()))
         job.update(0.05)
@@ -561,10 +578,78 @@ class GLM(ModelBuilder):
         out["response_domain"] = tuple(yv.domain) if classification else None
         out["names"] = list(self._x)
         model = GLMModel(DKV.make_key("glm"), p, out)
-        model.training_metrics = model._score_metrics(train)
+        if stream is not None:
+            # streamed scoring: never re-materialize the resident design
+            model.training_metrics = self._streamed_metrics(model, stream, train)
+            stream.close()
+        else:
+            model.training_metrics = model._score_metrics(train)
         if valid is not None:
             model.validation_metrics = model._score_metrics(valid)
         return model
+
+    def _plan_streamed(self, train: Frame, di, p: GLMParams, ybuf, yna):
+        """ChunkStore with the block-transformed design lanes, or None for
+        the resident path. The block transform reuses ``di.transform`` on
+        host-block sub-frames — elementwise per row, so each lane equals
+        the resident design matrix row-for-row — and the source feature
+        columns then drop to compressed/host residency."""
+        from h2o3_tpu.frame import chunkstore as cs
+
+        P = di.ncols_expanded
+        store = cs.ChunkStore.plan(train.npad, (P + 3) * 4)
+        if store is None:
+            return None
+        npad = train.npad
+        Log.info(
+            f"GLM out-of-core streaming: {store.n_blocks} blocks x "
+            f"{store.block_rows} rows, design width {P}"
+        )
+        Xlane = store.add_empty("X", (npad, P), np.float32)
+        vmask = np.zeros(npad, np.float32)
+        need: list[str] = []
+        for c in di.columns:
+            for nm in (c.pair if c.pair is not None else (c.name,)):
+                if nm not in need:
+                    need.append(nm)
+        for bi in range(store.n_blocks):
+            lo, hi = store.span(bi)
+            bf = cs.host_block_frame(train, need, lo, hi)
+            Xb, vb = di.transform(bf)
+            Xlane[lo:hi] = np.asarray(jax.device_get(Xb))
+            vmask[lo:hi] = np.asarray(jax.device_get(vb))
+        cs.release_frame_features(train, need)
+        w_np = vmask
+        if p.weights_column:
+            w_np = w_np * np.nan_to_num(
+                train.vec(p.weights_column).host_values().astype(np.float32))
+        w_np = (w_np * (1.0 - yna)).astype(np.float32)
+        store.add("w", w_np)
+        store.add("y", np.asarray(ybuf, np.float32))
+        off = np.zeros(npad, np.float32)
+        if p.offset_column:
+            off = np.nan_to_num(
+                train.vec(p.offset_column).host_values().astype(np.float32))
+        store.add("offset", off)
+        return store
+
+    def _streamed_metrics(self, model: "GLMModel", store, frame: Frame):
+        """Training metrics without re-materializing the resident design:
+        per-block linear predictor + link inverse over the store's lanes,
+        then the standard metric builder on the host-assembled raw."""
+        from h2o3_tpu.models.model_base import _make_metrics
+
+        fam = model.output["family_obj"]
+        beta = jnp.asarray(model.output["beta_std"], jnp.float32)
+        parts = []
+        for bi, blk in store.stream(("X", "offset")):
+            eta = jnp.einsum(
+                "np,p->n", blk["X"], beta, precision=_HI) + blk["offset"]
+            parts.append(np.asarray(fam.link.inv(eta)))
+        mu = np.concatenate(parts)[: frame.nrow]
+        raw = np.stack([1 - mu, mu], axis=1) if model.is_classifier else mu
+        yh, wh = model._response_and_weights(frame)
+        return _make_metrics(model, raw, yh, wh)
 
     # -- single-vector families ---------------------------------------------
     def _irls_snapshot(self, key, p: GLMParams, di, beta, family, fam,
@@ -602,12 +687,21 @@ class GLM(ModelBuilder):
         alpha = 0.5 if p.alpha is None else float(p.alpha)
         max_iter = p.max_iterations if p.max_iterations > 0 else 50
 
+        # out-of-core lane: X is a ChunkStore of row-block design lanes;
+        # every full-batch pass becomes a block-accumulate loop around the
+        # SAME _irls_pass program (the Gram is a sum over row blocks) and
+        # the solve stays on the host float64 path (fallback matrix: the
+        # fused while_loop needs the whole design resident per dispatch)
+        from h2o3_tpu.frame.chunkstore import ChunkStore
+
+        streaming = isinstance(X, ChunkStore)
+
         # fused whole-program lane (H2O3_TPU_GLM_FUSE): pad the design to
         # the shape-bucket/mesh width up front — padded columns are
         # all-zero, contribute exactly zero to every Gram/gradient below,
         # and every host-side vector stays REAL length (padding happens at
         # the dispatch boundary only)
-        fuse_k = _glm_fuse_chunk(p)
+        fuse_k = 0 if streaming else _glm_fuse_chunk(p)
         p_pad = _glm_pad_cols(P) if fuse_k else P
         if p_pad > P:
             X = jnp.pad(X, ((0, 0), (0, p_pad - P)))
@@ -622,13 +716,46 @@ class GLM(ModelBuilder):
         def pad_beta(b64):
             return np.concatenate([b64, np.zeros(p_pad - P)]) if p_pad > P else b64
 
+        def gram_pass(b64):
+            """One GLMIterationTask over ALL rows for host-f64 consumers:
+            resident = one _irls_pass dispatch; streamed = the same program
+            per row block with the Gram/XtWz/deviance partials accumulated
+            in float64 on host (the reduce the MRTask log-tree did).
+            Returns (G (P,P) f64, b (P,) f64, dev float)."""
+            b32 = jnp.asarray(pad_beta(b64), jnp.float32)
+            if not streaming:
+                G, b, dev = _irls_pass(X, y, w, offset, b32, family, fam_args)
+                return (np.asarray(G, np.float64)[:P, :P],
+                        np.asarray(b, np.float64)[:P], float(dev))
+            G = np.zeros((P, P), np.float64)
+            bb = np.zeros(P, np.float64)
+            dev = 0.0
+            for _bi, blk in X.stream(("X", "y", "w", "offset")):
+                _GLM_DISPATCHES.inc()
+                Gb, bbb, db = _irls_pass(
+                    blk["X"], blk["y"], blk["w"], blk["offset"], b32,
+                    family, fam_args,
+                )
+                G += np.asarray(Gb, np.float64)
+                bb += np.asarray(bbb, np.float64)
+                dev += float(db)
+            return G, bb, dev
+
+        def dev_pass(b64):
+            b32 = jnp.asarray(pad_beta(b64), jnp.float32)
+            if not streaming:
+                return float(
+                    _deviance_pass(X, y, w, offset, b32, family, fam_args))
+            return sum(
+                float(_deviance_pass(
+                    blk["X"], blk["y"], blk["w"], blk["offset"], b32,
+                    family, fam_args))
+                for _bi, blk in X.stream(("X", "y", "w", "offset"))
+            )
+
         # lambda path
-        G0, b0, dev0 = _irls_pass(
-            X, y, w, offset, jnp.asarray(pad_beta(beta), jnp.float32),
-            family, fam_args
-        )
-        g0 = (np.asarray(b0, np.float64)
-              - np.asarray(G0, np.float64) @ pad_beta(beta))[:P]
+        G0, b0, dev0 = gram_pass(beta)
+        g0 = b0 - G0 @ beta
         if icpt is not None:
             g0_pen = np.delete(g0, icpt)
         else:
@@ -669,17 +796,14 @@ class GLM(ModelBuilder):
             )
 
         def host_iteration(beta, l1, l2):
-            """One per-iteration host-solve IRLS step (the pre-fused path
-            and the fused lane's singular-tail fallback): Gram on device,
-            float64 Cholesky/ADMM on host. Returns (beta, dev_now, delta).
-            """
-            _GLM_DISPATCHES.inc()
-            G, b, dev = _irls_pass(
-                X, y, w, offset, jnp.asarray(pad_beta(beta), jnp.float32),
-                family, fam_args
-            )
-            G = np.asarray(G, np.float64)[:P, :P]
-            b = np.asarray(b, np.float64)[:P]
+            """One per-iteration host-solve IRLS step (the pre-fused path,
+            the fused lane's singular-tail fallback, and the out-of-core
+            streamed lane): Gram on device — full batch or block-
+            accumulated — float64 Cholesky/ADMM on host. Returns
+            (beta, dev_now, delta)."""
+            if not streaming:
+                _GLM_DISPATCHES.inc()
+            G, b, dev = gram_pass(beta)
             _solve_t0 = time.perf_counter()
             if l1 > 0:
                 beta_new = admm_elastic_net(
@@ -791,12 +915,7 @@ class GLM(ModelBuilder):
                 faults.abort_check("glm", tot_iters)
                 if stop:
                     break
-            dev_final = float(
-                _deviance_pass(
-                    X, y, w, offset,
-                    jnp.asarray(pad_beta(beta), jnp.float32), family, fam_args
-                )
-            )
+            dev_final = dev_pass(beta)
             expl = 1 - dev_final / max(null_dev, 1e-30)
             path.append({"lambda": float(lam), "deviance": dev_final, "dev_ratio": expl, "iters": iters_done})
             if best is None or dev_final <= best["deviance"]:

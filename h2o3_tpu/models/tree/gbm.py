@@ -282,6 +282,243 @@ class GBM(ModelBuilder):
         m.training_metrics = _metrics_from_F(dist, F, yn, wn, nrow, domain=domain)
         return m
 
+    def _plan_streamed(self, train: Frame):
+        """ChunkStore for this build's lanes, or None for the resident
+        path: bins (1 B/row/col) + the six f32 per-row lanes + nid int32."""
+        from h2o3_tpu.frame import chunkstore as cs
+
+        return cs.ChunkStore.plan(train.npad, len(self._x) + 28)
+
+    def _build_streamed(self, job, train, valid, p, spec, dist, aux, yv,
+                        prior, store, classification):
+        """Out-of-core GBM: per-block binning into the store's host tier,
+        compressed device residency for the source columns, and the
+        interval loop driving :func:`build_trees_streamed`. Metrics come
+        from the running score lane (host tier) — no resident replay."""
+        from collections import defaultdict
+
+        from h2o3_tpu.frame import chunkstore as cs
+        from h2o3_tpu.models.tree.shared_tree import (
+            build_trees_streamed,
+            replay_batch,
+        )
+
+        npad, nrow = train.npad, train.nrow
+        n_bins = spec.max_bins
+        C = len(self._x)
+        K = 1
+        Log.info(
+            f"GBM out-of-core streaming: {store.n_blocks} blocks x "
+            f"{store.block_rows} rows through a {store.window} B HBM window"
+        )
+
+        # response / weights (host tier; same rules as the resident build)
+        y_np = yv.to_numpy().astype(np.float64)
+        w_np = np.zeros(npad, np.float32)
+        w_np[:nrow] = 1.0
+        if p.weights_column:
+            w_np[:nrow] *= np.nan_to_num(
+                train.vec(p.weights_column).to_numpy()
+            ).astype(np.float32)
+        w_np[:nrow] *= ~np.isnan(y_np) if not classification else (y_np >= 0)
+        ybuf = np.zeros(npad, np.float32)
+        ybuf[:nrow] = np.nan_to_num(y_np, nan=0.0)
+        spw = float(getattr(p, "scale_pos_weight", 1.0))
+        w_train = w_np
+        if spw != 1.0:
+            if dist != "bernoulli":
+                raise ValueError("scale_pos_weight requires a binary response")
+            w_train = w_np.copy()
+            w_train[:nrow] *= np.where(
+                ybuf[:nrow] == 1.0, spw, 1.0
+            ).astype(np.float32)
+        offset_np = np.zeros(npad, np.float32)
+        if p.offset_column:
+            offset_np = np.nan_to_num(
+                train.vec(p.offset_column).host_values().astype(np.float32)
+            )
+        wn, yn = w_np, ybuf
+
+        store.add("y", ybuf)
+        store.add("w", w_train)
+        for name in ("F", "wt", "wy", "wh"):
+            store.add_empty(name, (npad,), np.float32)
+        store.add_empty("nid", (npad,), np.int32)
+
+        # per-block binning: the binning transform is per-row, so each
+        # block lane equals the resident bin_frame row-for-row
+        bins_lane = store.add_empty("bins", (npad, C), np.uint8)
+        for bi in range(store.n_blocks):
+            lo, hi = store.span(bi)
+            bf = cs.host_block_frame(train, list(spec.names), lo, hi)
+            bins_lane[lo:hi] = np.asarray(
+                jax.device_get(bin_frame(spec, bf)))
+        # compressed residency: features now live as u8 codes in the host
+        # tier; drop their f32/int device copies (lazy rebuild on demand)
+        cs.release_frame_features(train, spec.names)
+
+        rngkey = jax.random.PRNGKey(
+            abs(p.seed) if p.seed and p.seed > 0 else 1234)
+        metric_name, larger = stopping_metric_direction(
+            p.stopping_metric, classification, 2)
+        keeper = ScoreKeeper(p.stopping_rounds, p.stopping_tolerance, larger)
+        history: list[dict] = []
+        trees: list[list[Tree]] = []
+        varimp_dev = jnp.zeros(C, jnp.float32)
+        domain = tuple(yv.domain) if classification else None
+
+        # validation stays resident (a holdout is window-sized in practice;
+        # docs/MIGRATION.md fallback matrix)
+        bins_v = yv_np = wv_np = Fv = None
+        if valid is not None:
+            bins_v = bin_frame(spec, valid)
+            vv = valid.vec(p.response_column)
+            from h2o3_tpu.models.model_base import _remap_response
+
+            yv_np = (
+                _remap_response(vv, yv.domain).astype(np.float64)
+                if classification else vv.to_numpy().astype(np.float64)
+            )
+            wv_np = np.ones(valid.nrow, np.float32)
+            if p.weights_column and p.weights_column in valid:
+                wv_np *= np.nan_to_num(
+                    valid.vec(p.weights_column).to_numpy()).astype(np.float32)
+
+        start_trees = 0
+        if prior is not None:
+            f0 = prior.output["init_f"]
+            trees.extend([list(g) for g in prior.output["trees"]])
+            varimp_dev = jnp.asarray(
+                np.asarray(prior.output["varimp"], np.float32))
+            start_trees = prior.output["ntrees_actual"]
+            # per-block replay of the prior forest into the running score
+            # lane (the resident path's prior._replay_all_dev, blockwise)
+            by_depth: dict[int, list[Tree]] = defaultdict(list)
+            for group in trees:
+                t = group[0]
+                by_depth[len(t.levels)].append(t)
+            stacked_by_depth = {}
+            for depth, ts in by_depth.items():
+                vals = jax.device_get(
+                    [[[getattr(t.levels[li], f)
+                       for f in SharedTreeModel._REPLAY_FIELDS]
+                      for li in range(depth)] for t in ts]
+                )
+                stacked_by_depth[depth] = tuple(
+                    {
+                        f: np.stack([vals[ti][li][fi]
+                                     for ti in range(len(ts))])
+                        for fi, f in enumerate(SharedTreeModel._REPLAY_FIELDS)
+                    }
+                    for li in range(depth)
+                )
+            for bi, blk in store.stream(("bins",)):
+                lo, hi = store.span(bi)
+                pk = jnp.asarray(
+                    np.float32(f0) + offset_np[lo:hi])
+                for depth in stacked_by_depth:
+                    pk = replay_batch(blk["bins"], stacked_by_depth[depth], pk)
+                store.update(bi, F=pk)
+        else:
+            f0 = init_score(dist, yn[:nrow], wn[:nrow], aux)
+            store.lane("F")[:] = np.float32(f0) + offset_np
+        if bins_v is not None:
+            offset_v = jnp.zeros(bins_v.shape[0], jnp.float32)
+            if p.offset_column and p.offset_column in valid:
+                offset_v = jnp.nan_to_num(valid.vec(p.offset_column).data)
+            Fv = jnp.full(bins_v.shape[0], np.float32(f0), jnp.float32) + offset_v
+            if prior is not None:
+                Fv = Fv + prior._replay_all_dev(valid)
+
+        lr = p.learn_rate * (p.learn_rate_annealing ** start_trees)
+        interval = max(1, p.score_tree_interval)
+        m_done = start_trees
+        while m_done < p.ntrees and (
+            m_done == start_trees or not job.stop_requested
+        ):
+            chunk = min(interval, p.ntrees - m_done)
+            lrs = lr * (p.learn_rate_annealing ** np.arange(chunk))
+            with _mx.span("gbm.build_tree", trees=chunk, tree_offset=m_done,
+                          streamed=store.n_blocks):
+                new_trees, varimp_dev = build_trees_streamed(
+                    store, chunk, base_key=rngkey, tree_offset=m_done,
+                    grad_fn=lambda F_, y_, w_: grad_hess(dist, F_, y_, w_, aux),
+                    grad_key=("gbm", dist, aux),
+                    sample_rate=p.sample_rate,
+                    n_bins=n_bins,
+                    is_cat_cols=spec.is_cat,
+                    max_depth=p.max_depth,
+                    min_rows=p.min_rows,
+                    min_split_improvement=p.min_split_improvement,
+                    learn_rates=lrs,
+                    max_abs_leaf=p.max_abs_leafnode_pred,
+                    col_sample_rate=p.col_sample_rate,
+                    col_sample_rate_per_tree=p.col_sample_rate_per_tree,
+                    varimp=varimp_dev,
+                    reg_lambda=getattr(p, "reg_lambda", 0.0),
+                    reg_alpha=getattr(p, "reg_alpha", 0.0),
+                )
+            lr *= p.learn_rate_annealing ** chunk
+            trees.extend([[t] for t in new_trees])
+            if Fv is not None:
+                for t in new_trees:
+                    _, Fv = t.replay(
+                        bins_v, jnp.zeros(bins_v.shape[0], jnp.int32), Fv)
+            m_done += chunk
+
+            F_host = store.lane("F")
+            mval = _train_metric(dist, F_host, yn, wn, nrow, metric_name, K)
+            entry = {"ntrees": m_done, f"training_{metric_name}": mval}
+            stop_val = mval
+            if Fv is not None:
+                vval = _train_metric(
+                    dist, Fv, yv_np, wv_np, valid.nrow, metric_name, K)
+                entry[f"validation_{metric_name}"] = vval
+                stop_val = vval
+            history.append(entry)
+            keeper.record(stop_val)
+            self._export_interval_checkpoint(
+                job,
+                lambda key: self._partial_model(
+                    key, p, spec, trees, K, dist, f0, varimp_dev, domain,
+                    F_host, yn, wn, nrow, history,
+                ),
+            )
+            faults.die_check(self.algo)  # chaos: worker death at boundary
+            faults.abort_check(self.algo, m_done)
+            faults.slow_check(self.algo)
+            if keeper.should_stop():
+                Log.info(
+                    f"GBM early stop at {m_done} trees "
+                    f"({metric_name}={stop_val:.5f})"
+                )
+                break
+            job.update(0.05 + 0.9 * m_done / p.ntrees)
+
+        out = {
+            "bin_spec": spec,
+            "trees": trees,
+            "n_tree_classes": K,
+            "distribution": dist,
+            "init_f": f0,
+            "names": list(self._x),
+            "varimp": np.asarray(varimp_dev).astype(np.float64),
+            "response_domain": domain,
+            "ntrees_actual": len(trees),
+        }
+        model = self.MODEL_CLS(DKV.make_key(self.algo), p, out)
+        model.scoring_history = history
+        model.training_metrics = _metrics_from_F(
+            dist, store.lane("F"), yn, wn, nrow, domain=domain)
+        if valid is not None:
+            model.validation_metrics = _metrics_from_F(
+                dist, Fv, yv_np, wv_np, valid.nrow, domain=domain)
+        store.close()
+        from h2o3_tpu.models.calibration import maybe_fit_calibration
+
+        maybe_fit_calibration(self, model)
+        return model
+
     def _build(self, job: Job, train: Frame, valid: Frame | None) -> Model:
         p: GBMParams = self.params
         if p.ntrees < 1 or p.max_depth < 1:
@@ -313,6 +550,20 @@ class GBM(ModelBuilder):
             spec = prior.output["bin_spec"]
         else:
             spec = fit_bins_for(p, train, self._x)
+
+        # out-of-core streaming (ISSUE 11, frame/chunkstore.py): when the
+        # frame's per-row training lanes exceed the configured HBM window,
+        # train as a block-accumulate outer loop around the existing
+        # compiled programs instead of materializing the resident arrays.
+        # Fallback matrix (docs/MIGRATION.md): multinomial (K per-class
+        # trees share row state) and monotone builds stay resident.
+        if dist != "multinomial" and not p.monotone_constraints:
+            stream = self._plan_streamed(train)
+            if stream is not None:
+                return self._build_streamed(
+                    job, train, valid, p, spec, dist, aux, yv, prior, stream,
+                    classification,
+                )
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
         npad = train.npad

@@ -746,17 +746,15 @@ def _partition_update(
 # the fused level step
 
 
-def _finish_level(
-    bins_u8, nid, preds, varimp, ok, gain, node_w, node_wy, node_wh,
-    split_col, split_bin, is_cat_n, cat_mask, na_left,
-    learn_rate, max_abs_leaf, n_pad, node_lo=None, node_hi=None,
-    reg_lambda=None, reg_alpha=None,
+def _leaf_decide(
+    ok, gain, node_w, node_wy, node_wh, split_col, split_bin,
+    is_cat_n, cat_mask, na_left, learn_rate, max_abs_leaf, n_pad,
+    node_lo=None, node_hi=None, reg_lambda=None, reg_alpha=None,
 ):
-    """Shared tail of every level: leaf decision, child-id assignment,
-    varimp scatter, partition update, and the replayable record.
-
-    ``node_lo``/``node_hi`` (monotone-constraint bound state) clamp leaf
-    values when given; None leaves the unconstrained trace byte-identical.
+    """Leaf decision + child-id assignment + the replayable record — the
+    partition-free head of :func:`_finish_level`, shared with the
+    out-of-core streamed driver (:func:`build_trees_streamed`), which runs
+    the partition update per row block instead of over one resident array.
 
     ``reg_lambda``/``reg_alpha`` (XGBoost leaf regularization, traced
     scalars): leaf = soft_threshold(Σwy, α) / (Σwh + λ) — xgboost's
@@ -779,14 +777,6 @@ def _finish_level(
     child_base = jnp.where(ok, 2 * (cs - 1), 0).astype(jnp.int32)
     n_split = cs[-1] if n_pad else jnp.int32(0)
 
-    varimp = varimp.at[split_col].add(jnp.where(ok, gain, 0.0).astype(varimp.dtype))
-
-    # ph_part: phase tag for tools/profile_fused.py
-    with jax.named_scope("ph_part"):
-        nid, preds = _partition_update(
-            bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
-            na_left, leaf_now, leaf_val, child_base,
-        )
     record = {
         "node_w": node_w.astype(jnp.float32),
         "split_col": split_col.astype(jnp.int32),
@@ -799,6 +789,36 @@ def _finish_level(
         "child_base": child_base,
         "gain": gain,
     }
+    return leaf_now, leaf_val, child_base, cs, n_split, record
+
+
+def _finish_level(
+    bins_u8, nid, preds, varimp, ok, gain, node_w, node_wy, node_wh,
+    split_col, split_bin, is_cat_n, cat_mask, na_left,
+    learn_rate, max_abs_leaf, n_pad, node_lo=None, node_hi=None,
+    reg_lambda=None, reg_alpha=None,
+):
+    """Shared tail of every level: leaf decision, child-id assignment,
+    varimp scatter, partition update, and the replayable record.
+
+    ``node_lo``/``node_hi`` (monotone-constraint bound state) clamp leaf
+    values when given; None leaves the unconstrained trace byte-identical.
+    """
+    leaf_now, leaf_val, child_base, cs, n_split, record = _leaf_decide(
+        ok, gain, node_w, node_wy, node_wh, split_col, split_bin,
+        is_cat_n, cat_mask, na_left, learn_rate, max_abs_leaf, n_pad,
+        node_lo=node_lo, node_hi=node_hi,
+        reg_lambda=reg_lambda, reg_alpha=reg_alpha,
+    )
+
+    varimp = varimp.at[split_col].add(jnp.where(ok, gain, 0.0).astype(varimp.dtype))
+
+    # ph_part: phase tag for tools/profile_fused.py
+    with jax.named_scope("ph_part"):
+        nid, preds = _partition_update(
+            bins_u8, nid, preds, split_col, split_bin, is_cat_n, cat_mask,
+            na_left, leaf_now, leaf_val, child_base,
+        )
     return nid, preds, varimp, n_split, record, cs
 
 
@@ -2055,3 +2075,249 @@ def build_tree(
 
     BUILD_STATS["trees_built"] += 1
     return tree, preds, varimp
+
+
+# ---------------------------------------------------------------------------
+# out-of-core streamed forest build (ISSUE 11, frame/chunkstore.py): the
+# level math as a BLOCK-ACCUMULATE outer loop over a ChunkStore's row
+# blocks. Histogram accumulation is associative over row blocks, so one
+# level = Σ_blocks histogram_in_jit(block) (the existing fused histogram
+# program — incl. its hist_reduce psum and the PR-9 collective lane — runs
+# untouched inside each block), then ONE replicated split-scan/decide
+# dispatch on the accumulated (n_pad, C, B, S) tensor (node-frontier sized,
+# tiny next to the data), then one _partition_update per block. Per-row
+# state (running score F, node ids) lives in the store's host tier between
+# touches, so the device footprint is the HBM window, not the frame.
+# Frames that fit the window never get here (ChunkStore.plan routes them
+# to the resident whole-tree programs — bit-parity by construction).
+
+
+def _stream_hist_prog(n_pad: int, n_bins: int):
+    """One block's histogram contribution, accumulated in place: the
+    donated ``acc`` buffer pipelines across block dispatches with no
+    copies. Dense replicated mode — the streamed decide needs the full
+    (n_pad, C, B, S) tensor on every device anyway, and it is bounded by
+    the node frontier, not the rows."""
+    from h2o3_tpu.ops.histogram import histogram_in_jit
+
+    key = ("stream_hist", n_pad, n_bins, _kernel_key(), _mesh_key(),
+           jax.default_backend())
+
+    def make():
+        def run(bins_u8, nid, wt, wy, wh, acc):
+            return acc + histogram_in_jit(
+                bins_u8, nid, (wt, wy, wh), n_pad, n_bins
+            )
+
+        return jax.jit(run, donate_argnums=(5,))
+
+    return _cached_program(key, make)
+
+
+def _stream_decide_prog(n_pad: int, n_pad_next: int, n_bins: int,
+                        cat_cols: tuple, force_leaf: bool, n_cols: int):
+    """Split scan + leaf decision on the block-accumulated histogram —
+    ``_level_core``'s math with the partition update factored out (it runs
+    per block). Returns ``(varimp, n_split, record)``."""
+    key = ("stream_decide", n_pad, n_pad_next, n_bins, cat_cols, force_leaf,
+           n_cols, _mesh_key(), jax.default_backend())
+
+    def make():
+        def run(hist, key_, cols_enabled, is_cat, varimp, min_rows, msi,
+                learn_rate, max_abs_leaf, col_sample_rate, leaf_reg=None):
+            rl, ra = (None, None) if leaf_reg is None else leaf_reg
+            if force_leaf:
+                tot = hist[:, 0, :, :].sum(axis=1)  # col 0 ≡ any col
+                ok = jnp.zeros(n_pad, bool)
+                gain = jnp.zeros(n_pad, jnp.float32)
+                zi = jnp.zeros(n_pad, jnp.int32)
+                _, _, _, _, n_split, rec = _leaf_decide(
+                    ok, gain, tot[:, 0], tot[:, 1], tot[:, 2], zi, zi,
+                    jnp.zeros(n_pad, bool),
+                    jnp.zeros((n_pad, n_bins), bool),
+                    jnp.zeros(n_pad, bool), learn_rate, max_abs_leaf,
+                    n_pad, reg_lambda=rl, reg_alpha=ra,
+                )
+                return varimp, n_split, rec
+            # per-(node,col) sampling mask — same draw as _level_core at
+            # the REAL column count (the streamed path never column-pads)
+            col_mask = jnp.broadcast_to(cols_enabled[None, :], (n_pad, n_cols))
+            keep = jax.random.uniform(key_, (n_pad, n_cols)) < col_sample_rate
+            keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
+            col_mask = col_mask * keep
+            sp = _split_scan(hist, is_cat, col_mask, min_rows, msi, cat_cols)
+            ok = sp["ok"]
+            fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
+            ok = ok & fits
+            gain = jnp.where(ok, jnp.maximum(sp["gain"], 0.0), 0.0)
+            _, _, _, _, n_split, rec = _leaf_decide(
+                ok, gain, sp["node_w"], sp["node_wy"], sp["node_wh"],
+                sp["col"], sp["split_bin"], sp["is_cat"], sp["cat_mask"],
+                sp["na_left"], learn_rate, max_abs_leaf, n_pad,
+                reg_lambda=rl, reg_alpha=ra,
+            )
+            varimp = varimp.at[sp["col"]].add(
+                jnp.where(ok, gain, 0.0).astype(varimp.dtype))
+            return varimp, n_split, rec
+
+        return jax.jit(run)
+
+    return _cached_program(key, make)
+
+
+_STREAM_GRAD_CACHE: dict = {}
+
+
+def _stream_grad_prog(grad_fn, grad_key, sample: bool):
+    """Per-block pseudo-residuals/hessians (+ the per-tree row bootstrap
+    when sampling): (F, y, w, key, rate) -> (w_tree, wy, wh)."""
+    key = ("stream_grad", grad_key, sample, jax.default_backend())
+    fn = _STREAM_GRAD_CACHE.get(key)
+    if fn is None:
+
+        def run(F, y, w, skey, rate):
+            if sample:
+                mask = jax.random.bernoulli(skey, rate, w.shape)
+                wt = w * mask.astype(w.dtype)
+            else:
+                wt = w
+            t, h = grad_fn(F, y, wt)
+            wy = wt * t
+            wh = jnp.where(wt > 0, h, 0.0)
+            return wt, wy, wh
+
+        fn = jax.jit(run)
+        _STREAM_GRAD_CACHE[key] = fn
+    return fn
+
+
+def build_trees_streamed(
+    store,
+    n_trees: int,
+    *,
+    base_key,
+    row_key=None,
+    tree_offset: int = 0,
+    grad_fn,
+    grad_key,
+    sample_rate: float,
+    n_bins: int,
+    is_cat_cols,
+    max_depth: int,
+    min_rows: float,
+    min_split_improvement: float,
+    learn_rates,
+    max_abs_leaf: float,
+    col_sample_rate: float,
+    col_sample_rate_per_tree: float,
+    varimp,
+    node_cap: int = 2048,
+    reg_lambda: float = 0.0,
+    reg_alpha: float = 0.0,
+):
+    """Build ``n_trees`` trees over a :class:`~h2o3_tpu.frame.chunkstore.
+    ChunkStore` whose rows exceed the HBM window.
+
+    Lanes consumed: ``bins`` (uint8 (npad, C)), ``y``/``w``/``F`` (f32 —
+    ``F`` is the running score, updated in place per level) plus the
+    driver-owned scratch lanes ``wt``/``wy``/``wh`` (f32) and ``nid``
+    (int32). Per tree: one gradient pass over the blocks, then per level
+    one histogram-accumulate pass, one decide dispatch, one partition
+    pass — O(levels · blocks) dispatches, the irreducible cost of touching
+    every row per level out of core. The per-tree column subsample and the
+    per-(node,col) draw use the scanned path's exact key folds; the row
+    bootstrap additionally folds the block index (a per-block draw — the
+    resident and streamed bootstraps are different RNG streams, same
+    marginal rate).
+
+    Returns ``(trees, varimp)`` with host-resident tree records (streamed
+    frames are too big to keep per-level device state around).
+    """
+    from h2o3_tpu.models.tree.binning import bucket_nbins
+
+    n_bins = bucket_nbins(n_bins)
+    node_cap = _clamp_node_cap(node_cap, store.npad, min_rows)
+    is_cat_np = np.asarray(is_cat_cols, bool)
+    cat_cols = tuple(int(i) for i in np.nonzero(is_cat_np)[0])
+    is_cat_dev = jnp.asarray(is_cat_np)
+    C = len(is_cat_np)
+    if row_key is None:
+        row_key = base_key
+    lrs = np.asarray(learn_rates, np.float32)
+    leaf_reg = (
+        None if reg_lambda == 0.0 and reg_alpha == 0.0
+        else (jnp.float32(reg_lambda), jnp.float32(reg_alpha))
+    )
+    gprog = _stream_grad_prog(grad_fn, grad_key, sample_rate < 1.0)
+    trees: list[Tree] = []
+    import time as _time
+
+    for m in range(n_trees):
+        g = m + tree_offset
+        tkey = jax.random.fold_in(base_key, g)
+        _t0 = _time.perf_counter()
+        if col_sample_rate_per_tree < 1.0:
+            keep = (
+                jax.random.uniform(jax.random.fold_in(tkey, 1 << 30), (C,))
+                < col_sample_rate_per_tree
+            )
+            keep = jnp.where(keep.any(), keep, True)
+            cols_enabled = keep.astype(jnp.float32)
+        else:
+            cols_enabled = jnp.ones(C, jnp.float32)
+        skey = jax.random.fold_in(jax.random.fold_in(row_key, g), 1 << 29)
+
+        # gradient/bootstrap pass
+        for bi, blk in store.stream(("F", "y", "w")):
+            BUILD_STATS["dispatches"] += 1
+            wt, wy, wh = gprog(
+                blk["F"], blk["y"], blk["w"],
+                jax.random.fold_in(skey, bi), jnp.float32(sample_rate),
+            )
+            store.update(bi, wt=wt, wy=wy, wh=wh)
+        store.fill("nid", 0)
+
+        tree = Tree()
+        for depth in range(max_depth + 1):
+            n_pad = min(1 << depth, node_cap)
+            n_pad_next = min(2 * n_pad, node_cap)
+            force_leaf = depth == max_depth
+            hist = jnp.zeros((n_pad, C, n_bins, 3), jnp.float32)
+            hprog = _stream_hist_prog(n_pad, n_bins)
+            for bi, blk in store.stream(("bins", "nid", "wt", "wy", "wh")):
+                BUILD_STATS["dispatches"] += 1
+                hist = _run_counted(
+                    hprog,
+                    (blk["bins"], blk["nid"], blk["wt"], blk["wy"],
+                     blk["wh"], hist),
+                )
+            dprog = _stream_decide_prog(
+                n_pad, n_pad_next, n_bins, cat_cols, force_leaf, C
+            )
+            BUILD_STATS["dispatches"] += 1
+            varimp, n_split, rec = dprog(
+                hist, jax.random.fold_in(tkey, depth), cols_enabled,
+                is_cat_dev, varimp, jnp.float32(min_rows),
+                jnp.float32(min_split_improvement), jnp.float32(lrs[m]),
+                jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate),
+                leaf_reg,
+            )
+            for bi, blk in store.stream(("bins", "nid", "F")):
+                BUILD_STATS["dispatches"] += 1
+                nid_b, F_b = _partition_update(
+                    blk["bins"], blk["nid"], blk["F"], rec["split_col"],
+                    rec["split_bin"], rec["is_cat"], rec["cat_mask"],
+                    rec["na_left"], rec["leaf_now"], rec["leaf_val"],
+                    rec["child_base"],
+                )
+                store.update(bi, nid=nid_b, F=F_b)
+            rec_host = jax.device_get(rec)
+            tree.levels.append(
+                TreeLevel(**{k: np.asarray(v) for k, v in rec_host.items()})
+            )
+            if force_leaf or int(n_split) == 0:
+                break
+        BUILD_STATS["trees_built"] += 1
+        _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
+        trees.append(tree)
+    return trees, varimp

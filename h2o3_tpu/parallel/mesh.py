@@ -108,6 +108,27 @@ def col_block_spec(axis: int = 0) -> P:
     return P(*((None,) * axis + (ROWS_AXIS,)))
 
 
+def block_quantum(mesh: Mesh | None = None, multiple: int = 8) -> int:
+    """Smallest row count a streamed chunk can carry: one f32 sublane tile
+    (``multiple``) per shard. Every out-of-core row block is a multiple of
+    this, so a block slices into equal per-device shards with the same
+    tiling-friendly layout the resident ``pad_to_shards`` rows get — and a
+    block-sized sub-frame's device arrays divide the mesh exactly with no
+    extra padding rows (padding would perturb block-local reductions)."""
+    return (mesh or get_mesh()).shape[ROWS_AXIS] * multiple
+
+
+def stream_block_rows(npad: int, budget_rows: int, mesh: Mesh | None = None) -> int:
+    """Row count per out-of-core chunk: the largest multiple of
+    :func:`block_quantum` that fits ``budget_rows`` (the HBM-window share one
+    resident block may occupy), clamped to [quantum, npad]. A window too
+    small for even one quantum block still streams — the device footprint is
+    then one quantum block, the documented floor (frame/chunkstore.py)."""
+    q = block_quantum(mesh)
+    b = max(q, (max(budget_rows, 0) // q) * q)
+    return min(b, max(npad, q))
+
+
 def pad_flat_to_shards(n: int, mesh: Mesh | None = None) -> int:
     """Smallest multiple of the shard count >= max(n, shard count) — the
     padded length of a FLATTENED parameter/gradient vector so a

@@ -20,6 +20,13 @@ barely matters — bin count and tile sizes are the levers.
         # fused_tree_s + hist_hbm_bytes_per_tree (the modeled HBM traffic
         # of the hist+split phases), then a {"fused_ab": ...} summary.
 
+    python tools/bench_kernel_sweep.py --oocore-ab [--rows N]
+        # streamed-vs-resident out-of-core A/B (ISSUE 11): forces an HBM
+        # window of 1/10th the frame's training lanes, measures wall time,
+        # AUC and the peak frame device bytes per mode (+ a COMPRESS=0
+        # control), then an {"oocore_ab": ...} summary with the acceptance
+        # pins (peak bounded by the window, rows >= 10x window).
+
 The tile sweep varies ROW/COL/NODE tiles through the H2O3_TPU_PALLAS_TILES
 knob (a static compile key — every setting gets its own executable), so no
 module monkeypatching and no jit-cache clearing is needed.
@@ -469,6 +476,86 @@ def quant_ab(rows: int = 16_000, cols: int = 12) -> None:
         }}), flush=True)
 
 
+def oocore_ab(rows: int = 120_000, cols: int = 12) -> None:
+    """Streamed-vs-resident out-of-core A/B (H2O3_TPU_HBM_WINDOW_BYTES /
+    H2O3_TPU_FRAME_COMPRESS, ISSUE 11) on the SAME mesh and data: the
+    streamed mode forces an HBM window of 1/10th of the frame's training
+    lanes (rows >= 10x window — the acceptance geometry), the resident
+    mode runs today's whole-frame path, and a COMPRESS=0 control proves
+    the kill switch routes back to resident. Per mode: GBM train wall
+    seconds, AUC, and the peak frame device bytes (streamed = the
+    ChunkStore's measured peak, resident = the frame lanes' modeled
+    residency), then an {"oocore_ab": ...} summary carrying the acceptance
+    pins (peak bounded by the window, rows_over_window >= 10, AUC delta)."""
+    import time as _time
+
+    from h2o3_tpu.frame import chunkstore as cs
+    from h2o3_tpu.models.tree import GBM
+    from h2o3_tpu.parallel.mesh import get_mesh, pad_to_shards
+    from h2o3_tpu.utils import metrics as mx
+
+    bytes_per_row = cols + 28  # bins u8 + six f32 lanes + nid i32
+    npad = pad_to_shards(rows)
+    window = int(npad * bytes_per_row // 10)
+    kw = dict(ntrees=10, max_depth=5, seed=7, score_tree_interval=5)
+    results = {}
+    for mode in ("resident", "streamed", "compress0"):
+        os.environ.pop("H2O3_TPU_HBM_WINDOW_BYTES", None)
+        os.environ.pop("H2O3_TPU_FRAME_COMPRESS", None)
+        if mode == "streamed":
+            os.environ["H2O3_TPU_HBM_WINDOW_BYTES"] = str(window)
+        elif mode == "compress0":
+            os.environ["H2O3_TPU_HBM_WINDOW_BYTES"] = str(window)
+            os.environ["H2O3_TPU_FRAME_COMPRESS"] = "0"
+        cs.LAST_STORE_STATS.clear()
+        e0 = mx.counter_value("frame_chunk_evictions_total")
+        fr = _ab_frame(rows, cols)
+        GBM(**kw).train(y="label", training_frame=fr)  # compile warmup
+        t0 = _time.perf_counter()
+        m = GBM(**kw).train(y="label", training_frame=fr)
+        dt = _time.perf_counter() - t0
+        stats = dict(cs.LAST_STORE_STATS)
+        streamed = bool(stats.get("n_blocks", 0) > 1)
+        peak = (stats.get("peak_hbm")
+                if streamed else npad * bytes_per_row)
+        rec = {
+            "phase": "oocore_ab", "mode": mode,
+            "n_devices": get_mesh().devices.size,
+            "rows": rows, "cols": cols,
+            "window_bytes": window if mode != "resident" else 0,
+            "streamed": streamed,
+            "train_s": round(dt, 4),
+            "auc": round(float(m.training_metrics.auc), 5),
+            "peak_frame_device_bytes": int(peak),
+            "n_blocks": stats.get("n_blocks", 1),
+            "block_rows": stats.get("block_rows", npad),
+            "evictions": int(
+                mx.counter_value("frame_chunk_evictions_total") - e0),
+            "prefetch_overlap_s": round(mx.counter_value(
+                "frame_prefetch_overlap_seconds"), 4),
+        }
+        print(json.dumps(rec), flush=True)
+        results[mode] = rec
+    for k in ("H2O3_TPU_HBM_WINDOW_BYTES", "H2O3_TPU_FRAME_COMPRESS"):
+        os.environ.pop(k, None)
+    if len(results) == 3:
+        r, s, c0 = (results[m] for m in ("resident", "streamed", "compress0"))
+        print(json.dumps({"oocore_ab": {
+            "rows_over_window": round(
+                npad * bytes_per_row / max(window, 1), 2),
+            "streamed_engaged": s["streamed"],
+            "compress0_stayed_resident": not c0["streamed"],
+            "peak_within_window": s["peak_frame_device_bytes"] <= window,
+            "peak_bytes_ratio_resident_over_streamed": round(
+                r["peak_frame_device_bytes"]
+                / max(s["peak_frame_device_bytes"], 1), 2),
+            "time_ratio_streamed_over_resident": round(
+                s["train_s"] / max(r["train_s"], 1e-9), 3),
+            "auc_delta": round(abs(s["auc"] - r["auc"]), 5),
+            "compress0_auc_delta": round(abs(c0["auc"] - r["auc"]), 5),
+        }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -543,5 +630,7 @@ if __name__ == "__main__":
         dl_ab(**kw)
     elif "--quant-ab" in sys.argv:
         quant_ab(**kw)
+    elif "--oocore-ab" in sys.argv:
+        oocore_ab(**kw)
     else:
         main()

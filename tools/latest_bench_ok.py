@@ -150,6 +150,66 @@ def _quant_ab_ok(here: str, now: float):
         return False
 
 
+def _oocore_ab_ok(here: str, now: float):
+    """Sanity-check the newest recent OOCORE_AB_*.jsonl (bench_kernel_sweep
+    --oocore-ab, the out-of-core streaming A/B). Returns None when no
+    recent artifact exists (no opinion), else True/False. Checks the
+    acceptance pins: the streamed mode really streamed at rows >= 10x the
+    window with its peak frame device bytes bounded by the window (the
+    fixed-footprint claim), the COMPRESS=0 control stayed resident (the
+    kill switch works), and the AUC delta stays inside the f32
+    block-summation envelope."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "OOCORE_AB_*.jsonl")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "oocore_ab" in d:
+                    summary = d["oocore_ab"]
+        if not summary:
+            print(f"{name}: NO oocore_ab summary line")
+            return False
+        if not summary.get("streamed_engaged"):
+            print(f"{name}: streamed mode never streamed")
+            return False
+        if not summary.get("compress0_stayed_resident"):
+            print(f"{name}: COMPRESS=0 control STREAMED (kill switch broken)")
+            return False
+        if not summary.get("peak_within_window"):
+            print(f"{name}: peak frame device bytes EXCEEDED the window")
+            return False
+        if not float(summary.get("rows_over_window") or 0) >= 10.0:
+            print(f"{name}: rows_over_window "
+                  f"{summary.get('rows_over_window')} < 10x")
+            return False
+        auc_d = float(summary.get("auc_delta", float("nan")))
+        if not auc_d <= 5e-3:
+            print(f"{name}: streamed AUC delta {auc_d} > 5e-3")
+            return False
+        print(f"{name}: streamed=ok peak-in-window=ok "
+              f"rows/window={summary['rows_over_window']} "
+              f"auc-delta={auc_d} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def main() -> int:
     import time
 
@@ -165,6 +225,11 @@ def main() -> int:
     # stands
     qa = _quant_ab_ok(here, now)
     if qa is False:
+        return 1
+    # out-of-core streaming gate (ISSUE 11): a recent --oocore-ab artifact
+    # must satisfy the fixed-footprint acceptance pins or the window stands
+    oo = _oocore_ab_ok(here, now)
+    if oo is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -147,3 +147,24 @@ save "BENCH_builder_${stamp}_quant0.json" "TPU bench exact-collective control (h
 timeout 1800 python tools/recovery_drill.py \
   --out "RECOVERY_DRILL_${stamp}.json" > /dev/null
 save "RECOVERY_DRILL_${stamp}.json" "Recovery drill: worker death mid-train, supervised auto-resume + recovery_seconds"
+
+# out-of-core streaming A/B (ISSUE 11): streamed vs resident GBM at rows
+# >= 10x a forced HBM window — wall time, AUC, peak frame device bytes
+# (the fixed-footprint claim) + the COMPRESS=0 kill-switch control inside
+# the harness; tools/latest_bench_ok.py gates on the summary's pins. On
+# TPU the interesting numbers are real transfer overlap (PCIe/ICI
+# host->HBM) vs the CPU proxy's memcpy, and where the streamed wall-clock
+# ratio lands once transfers are truly asynchronous.
+timeout 1800 python tools/bench_kernel_sweep.py --oocore-ab --rows 1000000 \
+  | tee "OOCORE_AB_${stamp}.jsonl"
+save "OOCORE_AB_${stamp}.jsonl" "Out-of-core streamed-vs-resident A/B (1M rows, 10x window)"
+
+# refreshed capacity model: largest trainable rows per bracket, resident
+# f32 vs compressed u8 vs streamed (analytic; commit alongside the A/B)
+timeout 600 python tools/tpu_mem_analysis.py --oocore \
+  --out "OOCORE_MEM_${stamp}.json" > /dev/null
+save "OOCORE_MEM_${stamp}.json" "Out-of-core capacity model (compressed frames + HBM window)"
+
+H2O3_TPU_FRAME_COMPRESS=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_nocompress.json"  # out-of-core plane kill-switch control
+save "BENCH_builder_${stamp}_nocompress.json" "TPU bench FRAME_COMPRESS=0 control (headline only)"

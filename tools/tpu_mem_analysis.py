@@ -1,4 +1,5 @@
-"""Diagnose the 10M-row GBM RESOURCE_EXHAUSTED on the tunneled TPU.
+"""Diagnose the 10M-row GBM RESOURCE_EXHAUSTED on the tunneled TPU — and
+model the out-of-core data plane's capacity math (``--oocore``).
 
 The 20260731T0101Z bench lost every entry after the headline to an OOM
 cascade that started in the 10M build; an isolated 10M run reproduces it
@@ -14,6 +15,12 @@ smaller. This tool gets the REAL number from the TPU compiler:
      to find where execution, as opposed to allocation plan, fails.
 
 Usage (tunnel up): python tools/tpu_mem_analysis.py [--train]
+       python tools/tpu_mem_analysis.py --oocore [--out FILE]
+          # analytic capacity model of compressed/binned frames + the HBM
+          # window (ISSUE 11): largest trainable rows per pod bracket
+          # before/after compression, and the streamed geometry that makes
+          # Higgs-1B trainable through a fixed window. Pure host math —
+          # runs anywhere, artifact committed alongside the PR.
 """
 
 from __future__ import annotations
@@ -24,6 +31,67 @@ import time
 sys.path.insert(0, ".")
 
 import numpy as np
+
+
+def oocore_model(out_path: str | None = None) -> dict:
+    """Largest-trainable-rows per bracket, resident f32 vs compressed
+    (binned uint8) vs streamed through an HBM window (frame/chunkstore.py).
+
+    Per-row device bytes during a GBM build:
+    - resident f32 frame: C*4 (columns) + C (bins_u8) + 24 (w/y/F/wy/wh f32
+      + nid i32) — the pre-ISSUE-11 layout keeps BOTH the f32 columns and
+      the binned matrix resident;
+    - compressed (H2O3_TPU_FRAME_COMPRESS): C (bins_u8) + 24 — the f32
+      columns are released to the host tier after binning;
+    - streamed (H2O3_TPU_HBM_WINDOW_BYTES): device holds only the window;
+      rows are bounded by HOST RAM at (C + 24 + C*4) bytes/row host tier
+      (the f32 mirrors + lanes), not by HBM.
+    ``usable`` reserves HBM for compiled programs/temporaries (the 10M-row
+    RESOURCE_EXHAUSTED above is exactly what ignoring that costs).
+    """
+    import json
+
+    GiB = 1 << 30
+    C = 28  # Higgs feature width
+    usable = 0.70
+    state = 24  # per-row f32 lanes + nid
+    brackets = [
+        ("v5e-1", 1), ("v5e-4", 4), ("v5e-8", 8), ("v5e-16", 16),
+        ("v5e-32", 32),
+    ]
+    hbm_per_chip = 16 * GiB
+    rows_resident = lambda hbm: int(usable * hbm // (C * 4 + C + state))
+    rows_compressed = lambda hbm: int(usable * hbm // (C + state))
+    out = {"phase": "oocore_mem_model", "cols": C, "usable_fraction": usable,
+           "hbm_per_chip_gib": hbm_per_chip / GiB, "brackets": []}
+    for name, chips in brackets:
+        hbm = chips * hbm_per_chip
+        r_res, r_cmp = rows_resident(hbm), rows_compressed(hbm)
+        out["brackets"].append({
+            "bracket": name, "chips": chips, "hbm_gib": hbm / GiB,
+            "max_rows_resident_f32": r_res,
+            "max_rows_compressed_u8": r_cmp,
+            "compression_capacity_ratio": round(r_cmp / max(r_res, 1), 2),
+            "higgs_1b_fits_resident": r_res >= 1_000_000_000,
+            "higgs_1b_fits_compressed": r_cmp >= 1_000_000_000,
+        })
+    # streamed geometry: Higgs-1B through a fixed per-chip window
+    window = int(0.25 * usable * hbm_per_chip)
+    host_bytes_per_row = C * 4 + C + state  # f32 mirrors + lanes, host tier
+    out["streamed"] = {
+        "window_bytes_per_chip": window,
+        "bytes_per_row_device_lanes": C + state,
+        "block_rows_per_chip_window": int(window // (2 * (C + state))),
+        "higgs_1b_host_tier_gib": round(1e9 * host_bytes_per_row / GiB, 1),
+        "note": "rows are host-RAM bound, not HBM bound: the device holds "
+                "only the LRU window; Higgs-1B streams through any bracket "
+                "whose hosts carry the spill tier",
+    }
+    print(json.dumps(out), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
 
 
 def main() -> None:
@@ -108,4 +176,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--oocore" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            out = sys.argv[sys.argv.index("--out") + 1]
+        oocore_model(out)
+    else:
+        main()
