@@ -949,8 +949,29 @@ class Endpoints:
     def model_delete(self, params, key):
         from h2o3_tpu.cluster import spmd
 
+        from h2o3_tpu.models.model_base import Model
+
+        m = DKV.get(key)
+        m = m if isinstance(m, Model) else None
         spmd.run("remove", key=key)  # replicated: every rank's DKV must agree
+        if m is not None:
+            # a deleted model must not keep a dispatcher thread + HBM
+            from h2o3_tpu import serving
+
+            serving.retire_model(key, m)
         return {"__meta": {"schema_type": "Models"}, "models": []}
+
+    def serving_registry(self, params):
+        """``GET /3/ServingRegistry`` — the fleet serving plane's state:
+        registry entries (key, generation, snapshot path/etag, scorer lane,
+        residency tier) plus the device-residency LRU totals the HPA
+        scrapes. Serves (with enabled=false) even when
+        H2O3_TPU_SERVE_REGISTRY=0 so operators can see the switch state."""
+        from h2o3_tpu.serving import registry as _sreg
+
+        out = _sreg.REGISTRY.status()
+        out["__meta"] = {"schema_type": "ServingRegistry"}
+        return out
 
     # -- predictions ------------------------------------------------------
     def predict(self, params, model_key, frame_key):
@@ -1016,7 +1037,16 @@ class Endpoints:
             model_key = model_key.get("name")
         if not model_key:
             raise ApiError(400, "model is required")
-        m = _get_model(str(model_key))
+        model_key = str(model_key)
+        # fleet resolution: the serving registry's current generation wins
+        # (watch-and-load rollouts without operator action); disabled or
+        # unknown keys fall through to the DKV (the PR-7 manual-load path)
+        from h2o3_tpu.serving import registry as _sreg
+
+        m = _sreg.resolve(model_key)
+        from_registry = m is not None
+        if m is None:
+            m = _get_model(model_key)
         rows = params.get("rows")
         if isinstance(rows, str):
             try:
@@ -1047,7 +1077,15 @@ class Endpoints:
             raise ApiError(e.status, str(e),
                            headers={"Retry-After": e.retry_after})
         except (ValueError, KeyError, TypeError) as e:
-            raise ApiError(400, str(e))
+            raise ApiError(400, str(e))  # payload errors never trip rollback
+        except Exception as e:
+            if from_registry:
+                # the rollout breaker: a freshly rolled-out generation that
+                # cannot score rolls back to the previous one
+                _sreg.REGISTRY.note_score_failure(model_key, e)
+            raise
+        if from_registry:
+            _sreg.REGISTRY.note_score_ok(model_key)
         n = len(next(iter(out.values()))) if out else 0
         return {"__meta": {"schema_type": "PredictionsRows"},
                 "model_id": {"name": m.key},
@@ -1644,6 +1682,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/3/Models/([^/]+)/pojo", _EP.model_pojo),
     ("GET", r"/3/Models/([^/]+)", _EP.model_get),
     ("DELETE", r"/3/Models/([^/]+)", _EP.model_delete),
+    ("GET", r"/3/ServingRegistry", _EP.serving_registry),
     ("POST", r"/3/Predictions/rows", _EP.predict_rows),
     ("POST", r"/3/Predictions/models/([^/]+)/frames/([^/]+)", _EP.predict),
     ("POST", r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", _EP.model_metrics),
@@ -2085,4 +2124,9 @@ def start_server(ip: str = "127.0.0.1", port: int | None = None) -> H2OServer:
 
             port = config.get_int("H2O3_TPU_PORT")
         _SERVER = H2OServer(ip, port).start()
+        # fleet serving: a replica with a configured watch dir starts its
+        # model-store watcher with the server (no-op otherwise)
+        from h2o3_tpu.serving import registry as _sreg
+
+        _sreg.install()
     return _SERVER

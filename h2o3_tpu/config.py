@@ -308,6 +308,59 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "32768", "scoring tier admission bound: max rows waiting in the "
                  "coalescing queue; arrivals beyond it are shed with 429 + "
                  "Retry-After. 0 = unbounded"),
+    "H2O3_TPU_SERVE_REGISTRY": (
+        "auto", "fleet serving registry (serving/registry.py): scoring "
+                "replicas resolve /3/Predictions/rows model keys through a "
+                "generation-tagged model registry fed by a watch-and-load "
+                "loop over shared storage, so AutoML winners roll out with "
+                "no operator action. 'auto' = on when "
+                "H2O3_TPU_SERVE_WATCH_DIR is set; '1' = registry resolution "
+                "on even without a watch dir (models enter via /3/Recover-"
+                "style explicit loads); '0' = off — restores the PR-7 "
+                "manual-load behavior bit-for-bit (models only via "
+                "/99/Models.bin + DKV)"),
+    "H2O3_TPU_SERVE_WATCH_DIR": (
+        "", "shared model store the serving registry watches: every "
+            "serialize_model file in this directory (the same files "
+            "save_model / AutoML export_checkpoints_dir write) is loaded "
+            "and kept current by mtime/size etag polling — a changed file "
+            "swaps in as a NEW generation of its model key; in-flight "
+            "batches finish on the old generation. '' = no watching "
+            "(registry still serves explicitly loaded models under "
+            "SERVE_REGISTRY=1)"),
+    "H2O3_TPU_SERVE_POLL_SECS": (
+        "5", "serving-registry watch poll period, seconds: an exported "
+             "model is picked up within one poll (the rollout latency "
+             "floor). Polling is one directory scan + per-file stat etag "
+             "probes (persist.probe) — no bytes are read unless an etag "
+             "changed"),
+    "H2O3_TPU_SERVE_HBM_BYTES": (
+        "0", "device-memory budget for resident scorer model payloads "
+             "(serving/residency.py): the stacked forests / coefficient / "
+             "MLP-parameter device arguments of compiled scorer lanes live "
+             "in an LRU bounded by this many bytes — past it, "
+             "least-recently-scored models demote to their host-RAM "
+             "mirrors (page-in re-uploads on next score, "
+             "serving_page_in_seconds) so one replica serves far more "
+             "models than fit in HBM. The budget floor is one model: the "
+             "model currently dispatching is never evicted. '0' (default) "
+             "= unbounded, every scored model stays device-resident "
+             "(the pre-fleet behavior)"),
+    "H2O3_TPU_SCORE_IDLE_SECS": (
+        "30", "scoring-tier idle reaping: a per-model batcher whose "
+              "dispatcher thread saw no work for this many seconds retires "
+              "the thread, drops the batcher from the per-model cache and "
+              "demotes the model's scorer device arguments to host RAM — "
+              "an idle model costs neither a parked thread nor HBM. The "
+              "next request rebuilds the batcher and pages the scorer "
+              "back in"),
+    "H2O3_TPU_SERVE_BAD_GEN_ERRORS": (
+        "3", "serving-registry rollout breaker: this many consecutive "
+             "scoring failures on a freshly rolled-out model generation "
+             "trip a rollback — the registry re-serves the previous "
+             "generation and quarantines the bad file's etag (it will not "
+             "be reloaded until the file changes). A successful score "
+             "resets the count. 0 = never roll back"),
     "H2O3_TPU_PREDICTIONS_RETAIN": (
         "64", "bounded retention of GENERATED /3/Predictions result frames: "
               "the newest N generated prediction frames stay in the DKV, "

@@ -131,7 +131,17 @@ class IsolationForest(ModelBuilder):
             trees.append(self._grow(Xn[idx], depth, rng, mtries))
             job.update(0.9 * (m + 1) / p.ntrees)
 
-        out = {"trees": trees, "names": list(names), "response_domain": None}
+        out = {
+            "trees": trees, "names": list(names), "response_domain": None,
+            # the serving tier's compiled walk lane (serving/scorer.py) only
+            # engages for all-numeric forests: categorical codes through the
+            # frame path depend on the scoring frame's own domain, which a
+            # row payload cannot reproduce byte-exactly
+            "feature_kinds": [
+                "cat" if train.vec(n).is_categorical() else "num"
+                for n in names
+            ],
+        }
         model = IsolationForestModel(DKV.make_key("isofor"), p, out)
         raw = model._predict_raw(train)
         model.training_metrics = ModelMetrics(

@@ -81,6 +81,20 @@ class PersistBackend:
         trailing-``/`` convention for directory-append semantics."""
         return False
 
+    def probe(self, path: str) -> tuple | None:
+        """Cheap change-detection etag for ``path`` — any hashable tuple
+        that changes when the content does (FS: mtime_ns + size; object
+        stores would surface their ETag/generation). ``None`` means the
+        backend cannot probe without reading bytes; the serving registry's
+        watch loop (serving/registry.py) requires a probing backend."""
+        return None
+
+    def list_dir(self, path: str) -> list[str]:
+        """File names directly inside a directory-like path (no recursion,
+        no directories). Backends without listings raise — the watch loop
+        reports the scheme as unwatchable instead of spinning."""
+        raise NotImplementedError(f"{type(self).__name__} cannot list {path}")
+
 
 class _AtomicFile(io.FileIO):
     """FS write handle that publishes atomically on clean close.
@@ -140,6 +154,17 @@ class PersistFS(PersistBackend):
 
     def is_dir(self, path: str) -> bool:
         return os.path.isdir(path)
+
+    def probe(self, path: str) -> tuple | None:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
+    def list_dir(self, path: str) -> list[str]:
+        with os.scandir(path) as it:
+            return sorted(e.name for e in it if e.is_file())
 
 
 class _UploadOnClose(io.BytesIO):
@@ -374,6 +399,21 @@ def write_bytes(data: bytes, path: str) -> str:
     return p
 
 
+def probe(path: str) -> tuple | None:
+    """Change-detection etag through the scheme dispatch (None = the
+    backend cannot probe cheaply, or the path does not exist). The serving
+    registry's watch loop stats every candidate file each poll — this must
+    stay a metadata call, never a read."""
+    backend, p = _backend_for(path)
+    return backend.probe(p)
+
+
+def list_dir(path: str) -> list[str]:
+    """File names inside a directory URI through the scheme dispatch."""
+    backend, p = _backend_for(path)
+    return backend.list_dir(p)
+
+
 def read_bytes(path: str) -> bytes:
     """Retried whole-file read through the scheme dispatch."""
     backend, p = _backend_for(path)
@@ -496,6 +536,8 @@ def _portable_submodel(m: Model) -> Model:
     import copy
 
     clone = copy.copy(m)
+    clone.__dict__.pop("_h2o3_batch_scorer", None)  # locks don't pickle
+    clone.__dict__.pop("serving_generation", None)
     out = _pull_tree_output(dict(m.output))
     for k in _STRIP.get(m.algo, ()):
         out.pop(k, None)
@@ -512,6 +554,11 @@ def serialize_model(model: Model) -> bytes:
     pulls — collectives when output arrays span processes — on EVERY rank
     while only the coordinator writes the file (cluster/spmd.py)."""
     state = dict(model.__dict__)
+    # serving-plane state is process-local: the cached batch scorer holds
+    # locks + device arrays, and the registry generation is assigned by the
+    # process that loads the snapshot, not baked into it
+    state.pop("_h2o3_batch_scorer", None)
+    state.pop("serving_generation", None)
     out = _pull_tree_output(state.pop("output"))
     for k in _STRIP.get(model.algo, ()):
         out.pop(k, None)

@@ -22,8 +22,26 @@ are batched into one dispatch. Three pieces:
   payloads scored directly — no DKV frame round-trip — behind the PR-4
   admission gates with a per-route deadline (``H2O3_TPU_SCORE_DEADLINE_MS``).
 
+The fleet serving plane (ISSUE 12) grows this into a registry-driven
+multi-model tier:
+
+- :mod:`registry` — a generation-tagged model registry with a
+  watch-and-load loop over shared storage (``H2O3_TPU_SERVE_WATCH_DIR``):
+  exported ``serialize_model`` files roll out to every replica within one
+  poll, swap atomically (in-flight batches finish on the old generation),
+  and bad generations quarantine or roll back (the rollout breaker).
+- :mod:`residency` — LRU paging of scorer model payloads under
+  ``H2O3_TPU_SERVE_HBM_BYTES``: device memory is a managed cache over the
+  host-RAM mirrors, so one replica serves far more models than fit in HBM
+  (byte-equal across page-out/page-in).
+- :mod:`scorer` lanes beyond the GBM family: DRF/XRT (byte-equal),
+  IsolationForest/ExtendedIsolationForest (byte-equal), GLM and
+  DeepLearning (1e-6) — all arguments-not-constants, with the generic
+  frame-path lane as the documented fallback.
+
 ``tools/load_test.py`` is the measured proof: open-loop Poisson arrivals,
-offered-QPS sweep, artifact with p50/p99 + shed rate + batch-size histogram.
+offered-QPS sweep, artifact with p50/p99 + shed rate + batch-size
+histogram; ``--fleet`` adds the Zipf-over-M-models oversubscription A/B.
 
 Single-process only: the compiled scorer dispatches on local devices without
 the SPMD command broadcast, which on a multi-process training cloud would
@@ -77,6 +95,37 @@ SCORER_PROGRAMS = _mx.counter(
     "(bucket-shaped) program was built, 'hit' = an existing one was reused. "
     "After warmup a healthy tier is ~all hits — the shape-bucket ladder "
     "collapsing batch sizes and rebuilt same-bucket models onto one program")
+MODELS_RESIDENT = _mx.gauge(
+    "serving_models_resident",
+    "scorer model payloads currently resident, by tier (hbm = device "
+    "arguments live in the H2O3_TPU_SERVE_HBM_BYTES LRU, host = demoted "
+    "to the host-RAM mirror, page-in on next score)")
+MODEL_BYTES = _mx.gauge(
+    "serving_model_bytes",
+    "bytes of scorer model payloads resident, by tier (hbm/host); the "
+    "hbm series is bounded by H2O3_TPU_SERVE_HBM_BYTES (floor: the one "
+    "model currently dispatching)")
+MODEL_EVICTIONS = _mx.counter(
+    "serving_model_evictions_total",
+    "scorer model payloads pushed out of the device LRU, by kind: "
+    "'demoted' = device arguments dropped to the host tier under HBM "
+    "pressure (page-in restores them), 'released' = the scorer was retired "
+    "entirely (model deleted / replaced by a new registry generation / "
+    "garbage-collected)")
+PAGE_IN_SECONDS = _mx.histogram(
+    "serving_page_in_seconds",
+    "wall time to re-upload a demoted model's scorer device arguments on "
+    "its next score (the oversubscription tax; the HPA's signal that the "
+    "working set outgrew the fleet)")
+ROLLOUTS = _mx.counter(
+    "serving_rollouts_total",
+    "serving-registry model rollout events, by event: 'loaded' = a "
+    "watched snapshot swapped in as a new generation, 'failed' = a "
+    "snapshot refused to load (old generation keeps serving), "
+    "'rolled_back' = a loaded generation tripped the rollout breaker "
+    "(H2O3_TPU_SERVE_BAD_GEN_ERRORS consecutive scoring failures) and the "
+    "previous generation was restored, 'retired' = a replaced generation "
+    "finished draining and dropped its scorer/batcher")
 
 
 class ShedError(Exception):
@@ -105,3 +154,13 @@ def score_rows(model, rows):
     sc = scorer_for(model)
     cols, n = sc.prepare(rows)
     return batcher_for(model).submit(cols, n)
+
+
+def retire_model(model_key: str, model=None) -> None:
+    """Drop a model's serving state: its batcher (the dispatcher thread
+    drains in-flight work, then exits), its scorer, and its device-resident
+    payload. Called on model delete and on registry generation swaps —
+    a replaced model must not keep a thread + HBM forever."""
+    from h2o3_tpu.serving.batcher import retire_model as _rm
+
+    _rm(model_key, model)

@@ -30,6 +30,14 @@ training-cloud incident without burning its deadline budget on a dead mesh.
 
 ``WINDOW_MS=0`` bypasses the queue entirely — one dispatch per request, the
 measured control lane of the load-test A/B.
+
+Fleet behavior (ISSUE 12): every dispatch — batched or inline — passes the
+round-robin :class:`_FairGate`, so one hot model cannot starve other
+models' queues past their deadlines; a batcher idle past
+``H2O3_TPU_SCORE_IDLE_SECS`` reaps its dispatcher thread, drops out of the
+per-model cache and demotes its scorer's device payload
+(serving/residency.py), and :func:`retire_model` (model delete, registry
+generation swap) drains in-flight work then releases everything.
 """
 
 from __future__ import annotations
@@ -52,8 +60,78 @@ from h2o3_tpu.serving import (
 )
 from h2o3_tpu.utils.log import Log
 
-_IDLE_EXIT_S = 30.0  # dispatcher threads die after this much idle time
 _DEGRADE_POLL_S = 0.05  # waiter latch-poll cadence (the "shed budget")
+
+
+def _idle_exit_s() -> float:
+    """H2O3_TPU_SCORE_IDLE_SECS: a dispatcher this long without work retires
+    its thread AND reaps the whole batcher + the scorer's device payload —
+    an idle model must not park a thread and pin HBM forever (the fleet's
+    unbounded-cache fix)."""
+    from h2o3_tpu import config
+
+    return max(config.get_float("H2O3_TPU_SCORE_IDLE_SECS"), 0.1)
+
+
+class _FairGate:
+    """Round-robin dispatch turnstile across models with queued work.
+
+    Device dispatches from every model's batcher (and the window=0 inline
+    lane) pass through here; when more than one model is waiting, grants
+    rotate model-by-model — a hot model's continuous batch stream cannot
+    starve a cold model past its deadline, because after each dispatch the
+    served model goes to the BACK of the rotation. Uncontended, the gate is
+    one lock acquire.
+
+    A holder that wedges mid-dispatch (a dead collective — the same
+    failure the batcher's abandon/respawn logic covers) is ABANDONED after
+    ``_STALL_S``: a waiter revokes its turn so one model's corpse cannot
+    block the whole fleet, and the corpse's eventual release is ignored
+    via a ticket mismatch. Rotation slots are consumed at acquire time, so
+    abandoned holders leave no residue in the queue."""
+
+    _STALL_S = 2.0
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._waiters: dict[str, int] = {}  # key -> threads WAITING
+        self._order: list[str] = []  # distinct waiting keys, service order
+        self._active: str | None = None
+        self._ticket = 0  # tenure id of the active holder
+        self._active_t0 = 0.0
+
+    def acquire(self, key: str) -> int:
+        with self._cond:
+            self._waiters[key] = self._waiters.get(key, 0) + 1
+            if self._waiters[key] == 1:
+                self._order.append(key)
+            while self._active is not None or self._order[0] != key:
+                if (self._active is not None and
+                        time.monotonic() - self._active_t0 > self._STALL_S):
+                    self._active = None  # abandoned; late release no-ops
+                    self._cond.notify_all()
+                    continue
+                self._cond.wait(timeout=0.2)
+            # take the turn: consume this key's rotation slot
+            self._waiters[key] -= 1
+            self._order.pop(0)
+            if self._waiters[key] > 0:  # same-key waiters: back of the line
+                self._order.append(key)
+            else:
+                del self._waiters[key]
+            self._ticket += 1
+            self._active = key
+            self._active_t0 = time.monotonic()
+            return self._ticket
+
+    def release(self, key: str, ticket: int) -> None:
+        with self._cond:
+            if self._active == key and self._ticket == ticket:
+                self._active = None
+            self._cond.notify_all()
+
+
+_FAIR = _FairGate()
 
 
 def _cloud_down() -> str | None:
@@ -183,6 +261,7 @@ class ModelBatcher:
         self._rows_queued = 0
         self._thread: threading.Thread | None = None
         self._breaker = _Breaker(model.key)
+        self._retiring = False  # drain in-flight work, then drop everything
 
     # -- request side -------------------------------------------------------
     def submit(self, cols, n: int):
@@ -192,8 +271,14 @@ class ModelBatcher:
         probe = admit == "probe"
         if window <= 0 or max_rows <= 1:
             # per-request control lane: no queue, one dispatch per request
+            # (still through the fair gate — a hot inline model must not
+            # starve other models' dispatchers either)
             try:
-                out = self.scorer.score_table(cols, n)
+                tk = _FAIR.acquire(self.model.key)
+                try:
+                    out = self.scorer.score_table(cols, n)
+                finally:
+                    _FAIR.release(self.model.key, tk)
             except Exception as e:
                 self._breaker.record(ok=not _is_cloud_failure(e), probe=probe)
                 REQUESTS.inc(mode="inline", status="error")
@@ -286,13 +371,18 @@ class ModelBatcher:
 
     def _take_batch(self) -> list[_Pending] | None:
         """Block for work, honor the window, pop up to max_rows. Returns
-        None when idle long enough to retire the thread."""
+        None when idle long enough (H2O3_TPU_SCORE_IDLE_SECS) to retire the
+        thread, or when the batcher was retired and the queue drained."""
         window, max_rows, _, _ = _knobs()
         with self._cond:
             idle_t0 = time.monotonic()
             while not self._queue:
-                if not self._cond.wait(timeout=1.0) and (
-                    time.monotonic() - idle_t0 > _IDLE_EXIT_S
+                if self._retiring:
+                    self._thread = None
+                    return None
+                idle_s = _idle_exit_s()
+                if not self._cond.wait(timeout=min(1.0, idle_s)) and (
+                    time.monotonic() - idle_t0 > idle_s
                 ):
                     self._thread = None
                     return None
@@ -318,6 +408,7 @@ class ModelBatcher:
         while True:
             take = self._take_batch()
             if take is None:
+                self._reap()
                 return
             if _cloud_down() is not None:
                 # the cloud degraded while this batch coalesced: fail the
@@ -350,7 +441,11 @@ class ModelBatcher:
                     for name in names
                 }
                 total = sum(p.n for p in live)
-                out = self.scorer.score_table(cat_cols, total)
+                tk = _FAIR.acquire(self.model.key)
+                try:
+                    out = self.scorer.score_table(cat_cols, total)
+                finally:
+                    _FAIR.release(self.model.key, tk)
                 BATCHES.inc()
                 BATCH_OCCUPANCY.observe(len(live))
                 BATCH_ROWS.observe(total)
@@ -380,6 +475,41 @@ class ModelBatcher:
                         p.event.set()
 
 
+    # -- lifecycle ----------------------------------------------------------
+    def _reap(self) -> None:
+        """The dispatcher retired (idle past H2O3_TPU_SCORE_IDLE_SECS, or an
+        explicit retire()): drop this batcher from the per-model cache and
+        release device memory. Idle reaping DEMOTES the scorer (host mirror
+        stays; the next request pages back in); a retire() releases it
+        entirely (the model is gone or replaced)."""
+        from h2o3_tpu.serving.residency import MANAGER
+
+        with _BLOCK:
+            with self._cond:
+                if self._queue or (self._thread is not None
+                                   and self._thread.is_alive()):
+                    return  # new work raced the idle exit; stay cached
+                retiring = self._retiring
+            if _BATCHERS.get(self.model.key) is self:
+                del _BATCHERS[self.model.key]
+        if retiring:
+            MANAGER.release(self.scorer)
+            self.model.__dict__.pop("_h2o3_batch_scorer", None)
+        else:
+            MANAGER.demote(self.scorer)
+
+    def retire(self) -> None:
+        """Drain in-flight work, then drop the thread, the batcher and the
+        scorer's residency. New requests never reach a retired batcher —
+        batcher_for() already stopped handing it out."""
+        with self._cond:
+            self._retiring = True
+            alive = self._thread is not None and self._thread.is_alive()
+            self._cond.notify_all()
+        if not alive:
+            self._reap()  # no dispatcher to do it
+
+
 _BATCHERS: dict[str, ModelBatcher] = {}
 _BLOCK = threading.Lock()
 
@@ -392,3 +522,25 @@ def batcher_for(model) -> ModelBatcher:
         if b is None or b.model is not model:  # rebuilt model under same key
             b = _BATCHERS[model.key] = ModelBatcher(model, scorer_for(model))
         return b
+
+
+def retire_model(model_key: str, model=None) -> None:
+    """Drop a model's serving state (batcher + dispatcher thread + scorer
+    residency). With ``model`` given, only that exact object's batcher is
+    retired — a registry generation swap must not tear down the NEW
+    generation that already took over the key."""
+    with _BLOCK:
+        b = _BATCHERS.get(model_key)
+        if b is not None and model is not None and b.model is not model:
+            b = None  # the key moved on to a newer generation; leave it
+        elif b is not None:
+            del _BATCHERS[model_key]
+    if b is not None:
+        b.retire()
+    elif model is not None:
+        # no live batcher, but the model may still hold a scorer + HBM
+        from h2o3_tpu.serving.residency import MANAGER
+
+        sc = model.__dict__.pop("_h2o3_batch_scorer", None)
+        if sc is not None:
+            MANAGER.release(sc)

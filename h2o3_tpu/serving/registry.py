@@ -1,0 +1,316 @@
+"""Fleet model registry — watch-and-load distribution over shared storage
+(the DKV-replication analog from PAPER.md §1: a model is just a replicated
+KV entry any scoring node can serve).
+
+The training side already exports ``serialize_model`` files — final saves,
+AutoML winners, interval checkpoints — through the persist SPI. This module
+closes the loop for the scoring fleet: every replica points
+``H2O3_TPU_SERVE_WATCH_DIR`` at the shared model store (the RWX volume in
+deploy/k8s.yaml), and a poll loop (``H2O3_TPU_SERVE_POLL_SECS``) picks up
+new/changed files by mtime/size etag (``persist.probe`` — a stat, never a
+read) and swaps them in with **generation-tagged atomic swap** semantics:
+
+- each model key carries a monotonically increasing generation; a changed
+  file loads into a NEW generation and replaces the registry entry under
+  one lock — resolution is atomic;
+- in-flight batches finish on the OLD generation: the batcher holds its
+  model/scorer by reference, and the swap retires the old generation's
+  batcher with drain semantics (serving/batcher.retire_model);
+- a snapshot that refuses to load (corrupt, foreign, mid-rollout trash)
+  is quarantined by etag and the old generation KEEPS SERVING
+  (``serving_rollouts_total{event=failed}``);
+- a generation that loads but then fails scoring trips the **rollout
+  breaker** (``H2O3_TPU_SERVE_BAD_GEN_ERRORS`` consecutive scoring
+  failures, the serving-plane sibling of the PR-10 per-model circuit
+  breaker): the registry rolls back to the previous generation, quarantines
+  the bad file's etag, and retires the bad model
+  (``serving_rollouts_total{event=rolled_back}``).
+
+``H2O3_TPU_SERVE_REGISTRY=0`` disables everything — resolution, watching,
+rollback — restoring the PR-7 manual-load behavior bit-for-bit.
+``GET /3/ServingRegistry`` (api/server.py) surfaces the entries plus the
+residency tiers for the HPA and operators.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from h2o3_tpu.serving import ROLLOUTS
+from h2o3_tpu.utils.log import Log
+
+
+def _knob(name: str) -> str:
+    from h2o3_tpu import config
+
+    return config.get(name)
+
+
+def enabled() -> bool:
+    """'0' = off; '1' = on; 'auto' = on iff a watch dir is configured."""
+    v = _knob("H2O3_TPU_SERVE_REGISTRY")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    return bool(_knob("H2O3_TPU_SERVE_WATCH_DIR"))
+
+
+class _Generation:
+    __slots__ = ("gen", "model", "etag", "path", "loaded_at")
+
+    def __init__(self, gen, model, etag, path, loaded_at):
+        self.gen = gen
+        self.model = model
+        self.etag = etag
+        self.path = path
+        self.loaded_at = loaded_at
+
+
+class _KeyEntry:
+    __slots__ = ("current", "prev", "failures")
+
+    def __init__(self, current: _Generation):
+        self.current = current
+        self.prev: _Generation | None = None
+        self.failures = 0
+
+
+class ServingRegistry:
+    """Generation-tagged model map + the watch-and-load poll loop."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: dict[str, _KeyEntry] = {}
+        self._etags: dict[str, tuple] = {}  # path -> last loaded etag
+        self._quarantine: dict[str, tuple] = {}  # path -> bad etag
+        self._gen_seq = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._watch_error_logged = False
+
+    # -- resolution (the scoring hot path) ----------------------------------
+    def resolve(self, key: str):
+        """Current-generation model for ``key``, or None (fall through to
+        the DKV — the manual-load path)."""
+        if not enabled():
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            return e.current.model if e is not None else None
+
+    def generation_of(self, model) -> int | None:
+        with self._lock:
+            e = self._entries.get(getattr(model, "key", None))
+            if e is not None and e.current.model is model:
+                return e.current.gen
+        return None
+
+    # -- rollout breaker ----------------------------------------------------
+    def note_score_ok(self, key: str) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.failures:
+                e.failures = 0
+
+    def note_score_failure(self, key: str, exc: Exception) -> None:
+        """A registry-served model failed a (non-payload) scoring dispatch.
+        Past the breaker threshold, roll the key back to its previous
+        generation and quarantine the bad snapshot."""
+        from h2o3_tpu import config
+
+        thresh = config.get_int("H2O3_TPU_SERVE_BAD_GEN_ERRORS")
+        if thresh <= 0 or not enabled():
+            return
+        retired = None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            e.failures += 1
+            if e.failures < thresh or e.prev is None:
+                return
+            bad = e.current
+            self._quarantine[bad.path] = bad.etag
+            self._gen_seq += 1
+            e.current = _Generation(self._gen_seq, e.prev.model,
+                                    e.prev.etag, e.prev.path, time.time())
+            e.prev = None
+            e.failures = 0
+            retired = bad
+        from h2o3_tpu.cluster.registry import DKV
+        from h2o3_tpu.serving.batcher import retire_model
+
+        DKV.put(key, e.current.model)
+        retire_model(key, retired.model)
+        ROLLOUTS.inc(event="rolled_back")
+        Log.warn(
+            f"serving registry rolled BACK model {key}: generation "
+            f"{retired.gen} ({retired.path}) tripped the rollout breaker "
+            f"({thresh} consecutive scoring failures: {exc!r}); generation "
+            f"{e.current.gen} re-serves the previous snapshot and the bad "
+            "etag is quarantined until the file changes")
+
+    # -- loading / swapping -------------------------------------------------
+    def load_path(self, path: str, etag: tuple | None = None) -> bool:
+        """Load one snapshot file and swap it in as a new generation of its
+        model key. Returns True on success; a failure quarantines the etag
+        and keeps whatever was serving."""
+        from h2o3_tpu import persist
+
+        if etag is None:
+            etag = persist.probe(path)
+        try:
+            model = persist.load_model(path)  # DKV.put + closure rebuilds
+        except Exception as e:  # noqa: BLE001 — any bad file keeps serving
+            if etag is not None:
+                self._quarantine[path] = etag
+                self._etags[path] = etag
+            ROLLOUTS.inc(event="failed")
+            Log.err(f"serving registry: snapshot {path} refused to load "
+                    f"({e!r}); the previous generation keeps serving")
+            return False
+        retired = None
+        with self._lock:
+            self._etags[path] = etag
+            self._quarantine.pop(path, None)
+            self._gen_seq += 1
+            gen = _Generation(self._gen_seq, model, etag, path, time.time())
+            e = self._entries.get(model.key)
+            if e is None:
+                self._entries[model.key] = _KeyEntry(gen)
+            else:
+                retired = e.current
+                e.prev = e.current
+                e.current = gen
+                e.failures = 0
+        model.__dict__["serving_generation"] = gen.gen
+        ROLLOUTS.inc(event="loaded")
+        Log.info(f"serving registry: model {model.key} generation "
+                 f"{gen.gen} loaded from {path}")
+        if retired is not None and retired.model is not model:
+            # in-flight batches on the old generation finish (drain
+            # semantics), THEN its scorer/batcher/thread drop
+            from h2o3_tpu.serving.batcher import retire_model
+
+            retire_model(model.key, retired.model)
+            ROLLOUTS.inc(event="retired")
+        return True
+
+    def poll_once(self) -> int:
+        """One watch pass over the configured dir: load every file whose
+        etag changed (skipping quarantined etags and in-flight temp files).
+        Returns how many snapshots were (re)loaded."""
+        watch = _knob("H2O3_TPU_SERVE_WATCH_DIR")
+        if not watch or not enabled():
+            return 0
+        from h2o3_tpu import persist
+
+        try:
+            names = persist.list_dir(watch)
+        except FileNotFoundError:
+            return 0  # the store volume isn't mounted yet; keep polling
+        except NotImplementedError:
+            if not self._watch_error_logged:
+                self._watch_error_logged = True
+                Log.err(f"serving registry: persist scheme of {watch!r} "
+                        "cannot list/probe — watching disabled (point the "
+                        "watch dir at a file: path / mounted volume)")
+            return 0
+        loaded = 0
+        for name in names:
+            if name.startswith(".") or name.endswith(".tmp"):
+                continue  # atomic-publish temp files mid-write
+            path = watch.rstrip("/") + "/" + name
+            etag = persist.probe(path)
+            if etag is None:
+                continue  # vanished between list and stat
+            if self._etags.get(path) == etag:
+                continue  # unchanged since last load
+            if self._quarantine.get(path) == etag:
+                continue  # known-bad snapshot; wait for the file to change
+            if self.load_path(path, etag):
+                loaded += 1
+        return loaded
+
+    # -- the watcher thread -------------------------------------------------
+    def install(self) -> bool:
+        """Start the watch loop (idempotent). Returns whether a watcher is
+        running after the call."""
+        if not enabled() or not _knob("H2O3_TPU_SERVE_WATCH_DIR"):
+            return False
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._watch_loop, name="h2o3-serve-watch", daemon=True)
+            self._thread.start()
+        return True
+
+    def _watch_loop(self) -> None:
+        from h2o3_tpu import config
+
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                Log.err(f"serving registry watch pass failed: {e!r}")
+            poll = max(config.get_float("H2O3_TPU_SERVE_POLL_SECS"), 0.05)
+            self._stop.wait(timeout=poll)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def reset(self) -> None:
+        """Test hook: forget everything (models stay in the DKV)."""
+        self.stop()
+        with self._lock:
+            self._entries.clear()
+            self._etags.clear()
+            self._quarantine.clear()
+
+    # -- observability ------------------------------------------------------
+    def status(self) -> dict:
+        from h2o3_tpu.serving.residency import MANAGER
+
+        with self._lock:
+            models = []
+            for key, e in sorted(self._entries.items()):
+                g = e.current
+                sc = g.model.__dict__.get("_h2o3_batch_scorer")
+                models.append({
+                    "key": key,
+                    "generation": g.gen,
+                    "path": g.path,
+                    "etag": list(g.etag) if g.etag else None,
+                    "loaded_at": g.loaded_at,
+                    "failures": e.failures,
+                    "lane": sc.lane if sc is not None else None,
+                    "residency": (MANAGER.tier_of(sc)
+                                  if sc is not None else None),
+                })
+        return {
+            "enabled": enabled(),
+            "watch_dir": _knob("H2O3_TPU_SERVE_WATCH_DIR") or None,
+            "poll_secs": float(_knob("H2O3_TPU_SERVE_POLL_SECS")),
+            "watching": self._thread is not None and self._thread.is_alive(),
+            "models": models,
+            "residency": MANAGER.status(),
+        }
+
+
+REGISTRY = ServingRegistry()
+
+
+def resolve(key: str):
+    return REGISTRY.resolve(key)
+
+
+def install() -> bool:
+    return REGISTRY.install()

@@ -1,0 +1,226 @@
+"""Device-residency paging for scorer model payloads — the serving twin of
+the PR-11 ChunkStore window (frame/chunkstore.py): device memory is a
+managed cache, not a ledger of everything ever scored.
+
+Every compiled scorer lane keeps its model payload (stacked forest level
+arrays, GLM coefficient vectors, DL parameter pytrees, IF/EIF stacked
+trees) twice:
+
+- a **host tier** numpy pytree, built once at scorer construction — the
+  authoritative copy, cheap RAM;
+- a **device tier** jax pytree, uploaded on demand through an LRU bounded
+  by ``H2O3_TPU_SERVE_HBM_BYTES`` (0 = unbounded, the pre-fleet behavior).
+
+A score acquires the device pytree via :meth:`ResidencyManager.hold`; a
+miss pages the host copy in (``serving_page_in_seconds``), evicting the
+least-recently-scored *other* models first — the ChunkStore pre-insert
+pattern, so the budget bounds PEAK residency, with the documented floor of
+the one model currently dispatching. Eviction is **demotion**: the device
+arrays drop, the host pytree stays, and the next score re-uploads a
+bit-identical copy (device_get → device_put round-trips exactly, so scores
+are byte-equal across page-out/page-in — pinned by
+tests/test_serving_fleet.py). Full **release** happens only when a model
+is retired (deleted, replaced by a new registry generation) or its scorer
+is garbage-collected — entries hold the scorer by weakref, so a dead model
+returns its bytes instead of leaking them.
+
+Observability: ``serving_models_resident{tier}`` / ``serving_model_bytes
+{tier}`` gauges, ``serving_model_evictions_total{kind}`` and the page-in
+histogram feed the HPA (deploy/k8s.yaml): sustained page-in traffic means
+the fleet's working set outgrew its replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from contextlib import contextmanager
+
+from h2o3_tpu.serving import (
+    MODEL_BYTES,
+    MODEL_EVICTIONS,
+    MODELS_RESIDENT,
+    PAGE_IN_SECONDS,
+)
+
+
+def budget_bytes() -> int:
+    """H2O3_TPU_SERVE_HBM_BYTES (0 = unbounded)."""
+    from h2o3_tpu import config
+
+    return max(config.get_int("H2O3_TPU_SERVE_HBM_BYTES"), 0)
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return int(sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+class _Entry:
+    __slots__ = ("ref", "model_key", "host_bytes", "dev", "dev_bytes",
+                 "in_use")
+
+    def __init__(self, ref, model_key: str, host_bytes: int):
+        self.ref = ref  # weakref to the owning BatchScorer
+        self.model_key = model_key
+        self.host_bytes = host_bytes
+        self.dev = None  # device pytree while tier == hbm
+        self.dev_bytes = 0
+        self.in_use = 0  # dispatches currently holding the device pytree
+
+
+class ResidencyManager:
+    """LRU of scorer device payloads, keyed by scorer identity (two
+    generations of one model key are distinct entries — an in-flight batch
+    on the old generation keeps ITS payload until it finishes)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: OrderedDict[int, _Entry] = OrderedDict()
+        self.peak_hbm = 0
+        self.evictions = 0
+        self.page_ins = 0
+
+    # -- registration -------------------------------------------------------
+    def register(self, scorer) -> None:
+        """Track a scorer whose lane carries a pageable device payload
+        (``scorer._host_args`` is a numpy pytree). Idempotent."""
+        host = getattr(scorer, "_host_args", None)
+        if host is None:
+            return
+        sid = id(scorer)
+        with self._lock:
+            if sid in self._entries:
+                return
+            ref = weakref.ref(scorer, lambda _r, sid=sid: self._forget(sid))
+            ent = _Entry(ref, scorer.model_key, _tree_bytes(host))
+            self._entries[sid] = ent
+            MODELS_RESIDENT.inc(1, tier="host")
+            MODEL_BYTES.inc(ent.host_bytes, tier="host")
+
+    def _forget(self, sid: int) -> None:
+        """Weakref callback: the scorer (and its model) died — return the
+        bytes without anyone having to call release()."""
+        with self._lock:
+            ent = self._entries.pop(sid, None)
+            if ent is None:
+                return
+            self._drop_dev(ent, kind="released")
+            MODELS_RESIDENT.inc(-1, tier="host")
+            MODEL_BYTES.inc(-ent.host_bytes, tier="host")
+
+    # -- the device LRU -----------------------------------------------------
+    def _drop_dev(self, ent: _Entry, kind: str) -> None:
+        if ent.dev is None:
+            return
+        ent.dev = None
+        MODELS_RESIDENT.inc(-1, tier="hbm")
+        MODEL_BYTES.inc(-ent.dev_bytes, tier="hbm")
+        ent.dev_bytes = 0
+        self.evictions += 1
+        MODEL_EVICTIONS.inc(kind=kind)
+
+    def _hbm_bytes(self) -> int:
+        return sum(e.dev_bytes for e in self._entries.values())
+
+    def _evict_to(self, target: int) -> None:
+        """Demote LRU entries (oldest first) until the device tier fits
+        ``target`` bytes; entries mid-dispatch are never touched."""
+        for ent in list(self._entries.values()):
+            if self._hbm_bytes() <= target:
+                return
+            if ent.dev is None or ent.in_use:
+                continue
+            self._drop_dev(ent, kind="demoted")
+
+    @contextmanager
+    def hold(self, scorer):
+        """Yield the scorer's device pytree, paging it in if demoted, and
+        pin it against eviction for the duration of the dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        sid = id(scorer)
+        with self._lock:
+            ent = self._entries.get(sid)
+            if ent is None:
+                self.register(scorer)
+                ent = self._entries[sid]
+            if ent.dev is None:
+                budget = budget_bytes()
+                if budget:
+                    # pre-insert eviction: the budget bounds PEAK residency
+                    self._evict_to(max(budget - ent.host_bytes, 0))
+                t0 = time.perf_counter()
+                dev = jax.tree_util.tree_map(jnp.asarray, scorer._host_args)
+                jax.block_until_ready(dev)
+                PAGE_IN_SECONDS.observe(time.perf_counter() - t0)
+                self.page_ins += 1
+                ent.dev = dev
+                ent.dev_bytes = _tree_bytes(dev)
+                MODELS_RESIDENT.inc(1, tier="hbm")
+                MODEL_BYTES.inc(ent.dev_bytes, tier="hbm")
+                self.peak_hbm = max(self.peak_hbm, self._hbm_bytes())
+            self._entries.move_to_end(sid)
+            ent.in_use += 1
+            dev = ent.dev
+            budget = budget_bytes()
+            if budget:
+                # enforce on hits too: the budget may have shrunk, and a
+                # pile of older residents must not outlive it (the current
+                # entry is pinned by in_use and never evicted)
+                self._evict_to(budget)
+        try:
+            yield dev
+        finally:
+            with self._lock:
+                ent.in_use -= 1
+
+    # -- lifecycle ----------------------------------------------------------
+    def demote(self, scorer) -> None:
+        """Drop a scorer's device payload (idle reaping); host tier stays."""
+        with self._lock:
+            ent = self._entries.get(id(scorer))
+            if ent is not None and not ent.in_use:
+                self._drop_dev(ent, kind="demoted")
+
+    def release(self, scorer) -> None:
+        """Forget a retired scorer entirely (both tiers de-accounted)."""
+        if scorer is None:
+            return
+        with self._lock:
+            ent = self._entries.pop(id(scorer), None)
+            if ent is None:
+                return
+            self._drop_dev(ent, kind="released")
+            MODELS_RESIDENT.inc(-1, tier="host")
+            MODEL_BYTES.inc(-ent.host_bytes, tier="host")
+
+    def status(self) -> dict:
+        """Snapshot for ``GET /3/ServingRegistry`` and the fleet harness."""
+        with self._lock:
+            return {
+                "hbm_budget_bytes": budget_bytes(),
+                "hbm_bytes": self._hbm_bytes(),
+                "hbm_peak_bytes": self.peak_hbm,
+                "host_bytes": sum(e.host_bytes for e in
+                                  self._entries.values()),
+                "models_hbm": sum(1 for e in self._entries.values()
+                                  if e.dev is not None),
+                "models_tracked": len(self._entries),
+                "evictions": self.evictions,
+                "page_ins": self.page_ins,
+            }
+
+    def tier_of(self, scorer) -> str | None:
+        with self._lock:
+            ent = self._entries.get(id(scorer))
+            if ent is None:
+                return None
+            return "hbm" if ent.dev is not None else "host"
+
+
+MANAGER = ResidencyManager()

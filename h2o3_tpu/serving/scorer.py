@@ -1,29 +1,44 @@
-"""Compiled, shape-bucketed batch scorer — one jitted program per model
-*bucket*, not per model or per batch size.
+"""Compiled, shape-bucketed batch scorers — one jitted program per model
+*bucket*, not per model or per batch size, for EVERY algo family the fleet
+serves (ISSUE 12 closes ROADMAP item 3c: no mainstream algo falls back to
+the slow frame path).
 
-Two lanes:
+Lanes (fallback matrix in docs/MIGRATION.md):
 
-- **tree** (GBM-family models): the forest is pre-stacked ONCE into device
-  tensors grouped exactly like ``SharedTreeModel._replay_all_dev`` (by class,
-  then by recorded depth, in insertion order — the grouping is load-bearing
-  for bit-exactness), and the whole replay + link transform compiles into a
-  single program. The stacked forest is a program *argument*, so two models
-  of the same shape bucket (same ntrees/depth/bins/cols ladder rungs — e.g.
-  an AutoML winner rebuilt on refreshed data) hit the same compiled program;
-  with the persistent XLA cache (cluster/cloud.py) that holds across
-  processes too. Batch row counts round up a power-of-two ladder
-  (:func:`bucket_batch_rows`) so every batch size in a bucket reuses one
-  program; padding rows carry only NA codes and their outputs are sliced
-  off — per-row elementwise replay makes the pad inert by construction
-  (same argument as the PR-1 shape buckets).
-- **generic** (every other algo, preprocessed/offset models): the batch
-  still coalesces into one ``model.predict`` pass over a temporary frame —
-  batched, just not single-program.
+- **tree** (GBM/XGBoost and DRF/XRT): the forest is pre-stacked ONCE into
+  host tensors grouped exactly like ``SharedTreeModel._replay_all_dev`` (by
+  class, then by recorded depth, in insertion order — the grouping is
+  load-bearing for bit-exactness) and the whole replay + head transform
+  (link for the GBM family, tree-averaging for the DRF family) compiles
+  into a single program. The stacked forest is a program *argument*, so two
+  models of the same shape bucket hit the same compiled program.
+- **iforest** (IsolationForest, numeric-feature models): the per-tree
+  device walk (``_path_lengths``) scans over the stacked ``(T, L, N)``
+  split arrays inside ONE program, accumulating path lengths in the frame
+  path's tree order; the host tail (c(n) normalizer, 2^-E[h]/c) reuses the
+  identical numpy expressions, so scores are byte-equal.
+- **eif** (ExtendedIsolationForest): same shape, with per-level oblique
+  hyperplane arrays stacked over trees (short trees pad with leaf levels —
+  inert by the walk's ``done`` mask).
+- **glm** (binomial/regression/multinomial GLMs): the DataInfo transform
+  feeds ONE jitted link-transformed matvec (softmax matmul for
+  multinomial) whose coefficient vector is an argument; parity 1e-6.
+- **dl** (non-autoencoder DeepLearning): the stacked MLP forward + softmax
+  as one jitted program keyed by architecture, parameters as arguments;
+  parity 1e-6.
+- **generic** (everything else — preprocessed/offset models, ordinal GLM,
+  autoencoders, categorical-feature IF): the batch still coalesces into
+  one ``model.predict`` pass over a temporary frame.
 
-Bit-exactness contract (pinned by tests/test_serving.py): the tree lane's
-probabilities are byte-equal to ``Model.predict`` through the frame path —
-same ``_partition_update`` ops in the same order, same link transform, and
-no cross-row reductions anywhere in scoring.
+Model payloads (stacked forests, betas, MLP params) are built once as host
+numpy pytrees and uploaded through the device-residency LRU
+(:mod:`h2o3_tpu.serving.residency`, ``H2O3_TPU_SERVE_HBM_BYTES``): an idle
+model costs host RAM, not HBM, and page-out/page-in round-trips bit-exactly.
+
+Bit-exactness contract (pinned by tests/test_serving.py and
+tests/test_serving_fleet.py): tree-family lanes are byte-equal to
+``Model.predict`` through the frame path — same replay/walk ops in the
+same order, no cross-row reductions anywhere; GLM/DL lanes pin 1e-6.
 """
 
 from __future__ import annotations
@@ -66,10 +81,11 @@ def _rows_to_table(rows) -> dict[str, list]:
     raise ValueError(f"cannot score rows of type {type(rows).__name__}")
 
 
-def _coerce_numeric(vals) -> np.ndarray:
-    """Payload values -> f32 with NaN NAs (unparseable strings are NA, the
-    parse-time coercion contract)."""
-    out = np.full(len(vals), np.nan, np.float32)
+def _coerce_numeric(vals, dtype=np.float32) -> np.ndarray:
+    """Payload values -> float with NaN NAs (unparseable strings are NA, the
+    parse-time coercion contract). f32 for the binned/stacked lanes; f64
+    for lanes whose frame path goes through pandas (GLM/DL design)."""
+    out = np.full(len(vals), np.nan, dtype)
     for i, v in enumerate(vals):
         if v is None or (isinstance(v, float) and v != v):
             continue
@@ -77,10 +93,10 @@ def _coerce_numeric(vals) -> np.ndarray:
             out[i] = 1.0 if v else 0.0
             continue
         if isinstance(v, (int, float, np.integer, np.floating)):
-            out[i] = np.float32(v)
+            out[i] = dtype(v)
             continue
         try:
-            out[i] = np.float32(float(str(v)))
+            out[i] = dtype(float(str(v)))
         except (TypeError, ValueError):
             pass  # NA
     return out
@@ -116,7 +132,8 @@ def bucket_batch_rows(n: int, lo: int = 64) -> int:
 
 
 # ---------------------------------------------------------------------------
-# the compiled tree-lane program
+# compiled programs, one per lane *structure*; jit's own cache handles the
+# shape axes (rows bucket, tree counts, node widths, design columns)
 
 
 _PROG_CACHE: dict = {}
@@ -124,50 +141,175 @@ _SHAPES_SEEN: set = set()
 _CACHE_LOCK = threading.Lock()
 
 
-def _tree_program(struct_key):
-    """One jitted callable per forest *structure* (distribution, class count,
-    per-class depth-group layout); jit's own cache handles the shape axes
-    (rows bucket, tree counts, node widths). ``bins`` is donated — it is
-    freshly built per batch and dead after the dispatch."""
+def _cached_program(struct_key, build):
     prog = _PROG_CACHE.get(struct_key)
     if prog is not None:
         return prog
-    dist, K = struct_key[0], struct_key[1]
-    from h2o3_tpu.models.tree.distributions import response_transform
-    from h2o3_tpu.models.tree.shared_tree import _partition_update
-
-    def run(bins, groups, init_f):
-        outs = []
-        for gk in groups:  # per class, grouped by depth like _replay_all_dev
-            pk = jnp.zeros(bins.shape[0], jnp.float32)
-            for stacked in gk:
-
-                def body(p, recs):
-                    nid = jnp.zeros(bins.shape[0], jnp.int32)
-                    for rec in recs:  # unrolled over the recorded levels
-                        nid, p = _partition_update(
-                            bins, nid, p, rec["split_col"], rec["split_bin"],
-                            rec["is_cat"], rec["cat_mask"], rec["na_left"],
-                            rec["leaf_now"], rec["leaf_val"],
-                            rec["child_base"],
-                        )
-                    return p, None
-
-                pk, _ = jax.lax.scan(body, pk, stacked)
-            outs.append(pk)
-        raw = jnp.stack(outs, axis=1) if K > 1 else outs[0]
-        if dist == "multinomial":
-            return jax.nn.softmax(raw + init_f[None, :], axis=1)
-        f = raw + init_f
-        mu = response_transform(dist, f)
-        if dist == "bernoulli":
-            return jnp.stack([1 - mu, mu], axis=1)
-        return mu
-
-    prog = jax.jit(run, donate_argnums=(0,))
+    prog = build()
     with _CACHE_LOCK:
         _PROG_CACHE.setdefault(struct_key, prog)
     return _PROG_CACHE[struct_key]
+
+
+def _note_shapes(shape_key) -> None:
+    """compile-vs-hit accounting for the serving_scorer_programs_total
+    counter (a proxy for jit's per-shape cache, shared across models)."""
+    with _CACHE_LOCK:
+        seen = shape_key in _SHAPES_SEEN
+        _SHAPES_SEEN.add(shape_key)
+    SCORER_PROGRAMS.inc(event="hit" if seen else "compile")
+
+
+def _tree_program(struct_key):
+    """One jitted callable per forest *structure*: (head kind, head mode,
+    class count, per-class depth-group layout). ``bins`` is donated — it is
+    freshly built per batch and dead after the dispatch. The head transform
+    mirrors ``GBMModel._predict_raw_dev`` / ``DRFModel._predict_raw_dev``
+    op-for-op (the byte-equality contract)."""
+
+    def build():
+        head_kind, mode, K = struct_key[0], struct_key[1], struct_key[2]
+        from h2o3_tpu.models.tree.distributions import response_transform
+        from h2o3_tpu.models.tree.shared_tree import _partition_update
+
+        def run(bins, groups, head):
+            outs = []
+            for gk in groups:  # per class, by depth like _replay_all_dev
+                pk = jnp.zeros(bins.shape[0], jnp.float32)
+                for stacked in gk:
+
+                    def body(p, recs):
+                        nid = jnp.zeros(bins.shape[0], jnp.int32)
+                        for rec in recs:  # unrolled over recorded levels
+                            nid, p = _partition_update(
+                                bins, nid, p, rec["split_col"],
+                                rec["split_bin"], rec["is_cat"],
+                                rec["cat_mask"], rec["na_left"],
+                                rec["leaf_now"], rec["leaf_val"],
+                                rec["child_base"],
+                            )
+                        return p, None
+
+                    pk, _ = jax.lax.scan(body, pk, stacked)
+                outs.append(pk)
+            raw = jnp.stack(outs, axis=1) if K > 1 else outs[0]
+            if head_kind == "drf":
+                avg = raw / head  # head = ntrees (f32 scalar)
+                if mode == "reg":
+                    return avg
+                if mode == "binom":
+                    p1 = jnp.clip(avg, 0.0, 1.0)
+                    return jnp.stack([1 - p1, p1], axis=1)
+                P = jnp.clip(avg, 1e-9, None)
+                return P / P.sum(axis=1, keepdims=True)
+            # gbm family: head = init_f
+            if mode == "multinomial":
+                return jax.nn.softmax(raw + head[None, :], axis=1)
+            f = raw + head
+            mu = response_transform(mode, f)
+            if mode == "bernoulli":
+                return jnp.stack([1 - mu, mu], axis=1)
+            return mu
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    return _cached_program(struct_key, build)
+
+
+def _iforest_program(struct_key):
+    """Scan the frame path's per-tree walk (``_path_lengths``) over the
+    stacked forest in insertion order — the accumulation order IS the
+    frame path's eager tree loop, so the total is bit-identical."""
+
+    def build():
+        n_levels = struct_key[1]
+        from h2o3_tpu.models.isolation_forest import _path_lengths
+
+        def run(X, feat, thr, leaf):
+            def body(total, tree):
+                f, t, ll = tree
+                return total + _path_lengths(X, f, t, ll, n_levels), None
+
+            total, _ = jax.lax.scan(
+                body, jnp.zeros(X.shape[0], jnp.float32), (feat, thr, leaf))
+            return total
+
+        return jax.jit(run)
+
+    return _cached_program(struct_key, build)
+
+
+def _eif_program(struct_key):
+    def build():
+        n_levels = struct_key[1]
+        from h2o3_tpu.models.extended_isolation_forest import _eif_paths
+
+        def run(X, normals, ds, is_leaf, lens):
+            def body(total, tree):
+                nr, d_, il, ln = tree
+                return total + _eif_paths(X, nr, d_, il, ln, n_levels), None
+
+            total, _ = jax.lax.scan(
+                body, jnp.zeros(X.shape[0], jnp.float32),
+                (normals, ds, is_leaf, lens))
+            return total
+
+        return jax.jit(run)
+
+    return _cached_program(struct_key, build)
+
+
+def _glm_program(struct_key):
+    """Link-transformed matvec (softmax matmul for multinomial) with the
+    coefficient vector as an ARGUMENT — one program per family/link config,
+    shared by every model that shape-bucket-matches."""
+
+    def build():
+        (_, family, link, var_power, link_power, theta, multinomial,
+         classifier) = struct_key
+        from h2o3_tpu.models.glm import _HI
+        from h2o3_tpu.models.glm_families import get_family
+
+        fam = None if multinomial else get_family(
+            family, link, var_power, link_power, theta)
+
+        def run(X, beta):
+            if multinomial:
+                eta = jnp.einsum("np,pk->nk", X, beta, precision=_HI)
+                return jax.nn.softmax(eta, axis=1)
+            eta = jnp.einsum("np,p->n", X, beta, precision=_HI)
+            mu = fam.link.inv(eta)
+            if classifier:
+                return jnp.stack([1 - mu, mu], axis=1)
+            return mu
+
+        return jax.jit(run)
+
+    return _cached_program(struct_key, build)
+
+
+def _dl_program(struct_key):
+    """Stacked MLP forward (+ softmax head) with the parameter pytree as an
+    ARGUMENT — one program per architecture."""
+
+    def build():
+        _, hidden, activation, n_out, pad, classifier = struct_key
+        from h2o3_tpu.models.deeplearning import _MLP
+
+        mlp = _MLP(hidden=tuple(hidden), n_out=n_out, activation=activation,
+                   dropout=(0.0,) * len(hidden), input_dropout=0.0)
+
+        def run(X, prm):
+            if pad:
+                X = jnp.pad(X, ((0, 0), (0, pad)))
+            logits = mlp.apply(prm, X, train=False)
+            if classifier:
+                return jax.nn.softmax(logits, axis=1)
+            return logits[:, 0]
+
+        return jax.jit(run)
+
+    return _cached_program(struct_key, build)
 
 
 def _group_shapes(groups) -> tuple:
@@ -186,43 +328,83 @@ def _group_shapes(groups) -> tuple:
 class BatchScorer:
     """Per-model scorer. ``prepare`` adapts a payload to canonical column
     arrays (cheap host work, runs on the request thread); ``score_table``
-    runs one device pass over a whole coalesced batch."""
+    runs one device pass over a whole coalesced batch, holding the model's
+    device payload through the residency LRU."""
 
     def __init__(self, model):
         self.model = model
+        self.model_key = model.key
         self.lane = "generic"
         self._lock = threading.Lock()  # one dispatch at a time per model
+        self._host_args = None  # numpy pytree; the pageable device payload
         out = model.output if isinstance(model.output, dict) else {}
-        from h2o3_tpu.models.tree.gbm import GBMModel
+        if model.preprocessors or getattr(
+                model.params, "offset_column", None):
+            return  # generic: per-algo preprocessing owns these paths
+        from h2o3_tpu.models.deeplearning import DeepLearningModel
+        from h2o3_tpu.models.extended_isolation_forest import (
+            ExtendedIsolationForestModel,
+        )
+        from h2o3_tpu.models.glm import GLMModel
+        from h2o3_tpu.models.isolation_forest import IsolationForestModel
+        from h2o3_tpu.models.tree.gbm import GBMModel, SharedTreeModel
 
-        if (
-            isinstance(model, GBMModel)
-            and out.get("trees")
-            and out.get("bin_spec") is not None
-            and not model.preprocessors
-            and not getattr(model.params, "offset_column", None)
-        ):
-            self.lane = "tree"
-            self._spec = out["bin_spec"]
-            self._dist = out["distribution"]
-            self._K = out.get("n_tree_classes", 1)
-            self._stack_forest(out["trees"])
-            if self._dist == "multinomial":
-                self._init_f = jnp.asarray(
-                    np.asarray(out["init_f"], np.float32))
+        if (isinstance(model, SharedTreeModel)
+                and out.get("trees") and out.get("bin_spec") is not None
+                and model.algo in ("gbm", "xgboost", "drf", "xrt")):
+            self._init_tree(out, gbm_family=isinstance(model, GBMModel))
+        elif (isinstance(model, IsolationForestModel) and out.get("trees")
+                and out.get("feature_kinds") is not None
+                and all(k == "num" for k in out["feature_kinds"])):
+            self._init_iforest(out)
+        elif (isinstance(model, ExtendedIsolationForestModel)
+                and out.get("stacked_trees")):
+            self._init_eif(out)
+        elif (isinstance(model, GLMModel) and not out.get("ordinal")
+                and out.get("datainfo") is not None
+                and not any(c.pair for c in out["datainfo"].columns)):
+            self._init_glm(out)
+        elif (isinstance(model, DeepLearningModel)
+                and not out.get("autoencoder")
+                and out.get("datainfo") is not None
+                and not any(c.pair for c in out["datainfo"].columns)):
+            self._init_dl(out)
+        if self._host_args is not None:
+            from h2o3_tpu.serving.residency import MANAGER
+
+            MANAGER.register(self)
+
+    # -- lane constructors (host-tier payload stacking) ---------------------
+    def _init_tree(self, out, gbm_family: bool) -> None:
+        self.lane = "tree"
+        self._spec = out["bin_spec"]
+        self._K = out.get("n_tree_classes", 1)
+        groups = self._stack_forest(out["trees"])
+        if gbm_family:
+            dist = out["distribution"]
+            if dist == "multinomial":
+                head = np.asarray(out["init_f"], np.float32)
             else:
-                self._init_f = jnp.asarray(np.float32(out["init_f"]))
-            self._struct = (
-                self._dist, self._K,
-                tuple(tuple(len(s) for s in gk) for gk in self._groups_key),
-                jax.default_backend(),
-            )
+                head = np.float32(out["init_f"])
+            kind, mode = "gbm", dist
+        else:
+            m = self.model
+            mode = ("reg" if not m.is_classifier
+                    else ("binom" if self._K == 1 else "multi"))
+            head = np.float32(max(out["ntrees_actual"], 1))
+            kind = "drf"
+        self._host_args = {"groups": groups, "head": head}
+        self._struct = (
+            kind, mode, self._K,
+            tuple(tuple(len(s) for s in gk) for gk in groups),
+            jax.default_backend(),
+        )
 
-    # -- forest stacking (once per model) -----------------------------------
-    def _stack_forest(self, trees) -> None:
+    def _stack_forest(self, trees):
         """Stack per-(class, depth) groups in the SAME insertion order as
         ``SharedTreeModel._replay_all_dev`` — the accumulation order is part
-        of the bit-exactness contract."""
+        of the bit-exactness contract. Host numpy; the residency LRU owns
+        the device copies."""
         from collections import defaultdict
 
         from h2o3_tpu.models.tree.gbm import SharedTreeModel
@@ -247,18 +429,88 @@ class BatchScorer:
                 )
                 stacked = tuple(
                     {
-                        f: jnp.asarray(
-                            np.stack([vals[ti][li][fi]
-                                      for ti in range(len(ts))])
-                        )
+                        f: np.stack([vals[ti][li][fi]
+                                     for ti in range(len(ts))])
                         for fi, f in enumerate(fields)
                     }
                     for li in range(depth)
                 )
                 gk.append(stacked)
             groups.append(tuple(gk))
-        self._groups = tuple(groups)
-        self._groups_key = self._groups
+        return tuple(groups)
+
+    def _init_iforest(self, out) -> None:
+        trees = out["trees"]
+        shapes = {np.asarray(f).shape for f, _t, _l in trees}
+        if len(shapes) != 1:
+            return  # ragged forest (shouldn't happen): generic lane
+        self.lane = "iforest"
+        self._names = list(out["names"])
+        self._host_args = {
+            "feat": np.stack([np.asarray(f, np.int32) for f, _, _ in trees]),
+            "thr": np.stack([np.asarray(t, np.float32)
+                             for _, t, _ in trees]),
+            "leaf": np.stack([np.asarray(ll, np.float32)
+                              for _, _, ll in trees]),
+        }
+        self._struct = ("iforest", int(shapes.pop()[0]),
+                        jax.default_backend())
+
+    def _init_eif(self, out) -> None:
+        self.lane = "eif"
+        self._names = list(out["names"])
+        self._col_means = np.asarray(out["col_means"], np.float64)
+        stacked = out["stacked_trees"]
+        T = len(stacked)
+        C = len(self._names)
+        L = max(len(levels) for levels in stacked)
+        normals, ds, is_leaf, lens = [], [], [], []
+        for d in range(L):
+            w = 1 << d
+            nr = np.zeros((T, w, C), np.float32)
+            dd = np.zeros((T, w), np.float32)
+            il = np.ones((T, w), bool)  # pad levels are all-leaf (inert)
+            ln = np.zeros((T, w), np.float32)
+            for ti, levels in enumerate(stacked):
+                if d < len(levels):
+                    nr[ti], dd[ti], il[ti], ln[ti] = levels[d]
+            normals.append(nr)
+            ds.append(dd)
+            is_leaf.append(il)
+            lens.append(ln)
+        self._host_args = {"normals": tuple(normals), "ds": tuple(ds),
+                           "is_leaf": tuple(is_leaf), "lens": tuple(lens)}
+        self._struct = ("eif", L, C, jax.default_backend())
+
+    def _init_glm(self, out) -> None:
+        self.lane = "glm"
+        self._di = out["datainfo"]
+        p = self.model.params
+        multinomial = bool(out.get("multinomial"))
+        beta = (out["beta_multinomial_std"] if multinomial
+                else out["beta_std"])
+        self._host_args = {"beta": np.asarray(beta, np.float32)}
+        self._struct = (
+            "glm", out["family"], p.link,
+            float(p.tweedie_variance_power or 1.5),
+            float(p.tweedie_link_power), float(p.theta),
+            multinomial, self.model.is_classifier,
+        )
+
+    def _init_dl(self, out) -> None:
+        self.lane = "dl"
+        self._di = out["datainfo"]
+        params = jax.device_get(out["params"])
+        inner = params["params"] if "params" in params else params
+        last = sorted(inner.keys(), key=lambda k: int(k.split("_")[-1]))[-1]
+        n_out = int(np.asarray(inner[last]["bias"]).shape[0])
+        hidden = tuple(out.get("hidden") or self.model.params.hidden)
+        self._host_args = {"params": params}
+        self._struct = (
+            "dl", tuple(int(h) for h in hidden),
+            self.model.params.activation, n_out,
+            int(out.get("input_pad") or 0), self.model.is_classifier,
+        )
 
     # -- payload -> canonical columns ---------------------------------------
     def prepare(self, rows) -> tuple[dict[str, np.ndarray], int]:
@@ -280,6 +532,25 @@ class BatchScorer:
                 else:
                     cols[name] = _coerce_numeric(vals)
             return cols, n
+        if self.lane in ("iforest", "eif"):
+            return {
+                name: _coerce_numeric(table.get(name) or [None] * n)
+                for name in self._names
+            }, n
+        if self.lane in ("glm", "dl"):
+            # normalized to the DataInfo base columns so coalesced batches
+            # always concatenate the same column set; the frame-adaptation
+            # path (from_pandas kinds + _adapt_codes) does the rest
+            cols = {}
+            for c in self._di.columns:
+                vals = table.get(c.name)
+                if vals is None:
+                    vals = [None] * n
+                if c.kind == "num":
+                    cols[c.name] = _coerce_numeric(vals, np.float64)
+                else:  # cat / hash: raw values, coded against the frame
+                    cols[c.name] = np.asarray(list(vals), dtype=object)
+            return cols, n
         # generic lane: raw object columns; the model's own frame-adaptation
         # path (from_pandas kinds + per-algo adapt) does the rest
         return {k: np.asarray(v, dtype=object) for k, v in table.items()}, n
@@ -288,12 +559,17 @@ class BatchScorer:
     def score_table(self, cols: dict[str, np.ndarray], n: int) -> dict:
         t0 = time.perf_counter()
         with self._lock:
-            out = (self._score_tree(cols, n) if self.lane == "tree"
-                   else self._score_generic(cols, n))
+            if self.lane == "generic":
+                out = self._score_generic(cols, n)
+            else:
+                from h2o3_tpu.serving.residency import MANAGER
+
+                with MANAGER.hold(self) as dev:
+                    out = getattr(self, "_score_" + self.lane)(cols, n, dev)
         DISPATCH_SECONDS.observe(time.perf_counter() - t0, lane=self.lane)
         return out
 
-    def _score_tree(self, cols, n: int) -> dict:
+    def _score_tree(self, cols, n: int, dev) -> dict:
         from h2o3_tpu.models.tree.binning import bin_frame
 
         spec = self._spec
@@ -314,24 +590,98 @@ class BatchScorer:
             names.append(name)
         fr = Frame(vecs, names)  # unregistered temporary
         bins = bin_frame(spec, fr)
-        shape_key = (self._struct, bins.shape,
-                     _group_shapes(self._groups_key))
-        with _CACHE_LOCK:
-            seen = shape_key in _SHAPES_SEEN
-            _SHAPES_SEEN.add(shape_key)
-        SCORER_PROGRAMS.inc(event="hit" if seen else "compile")
+        _note_shapes((self._struct, bins.shape,
+                      _group_shapes(self._host_args["groups"])))
         prog = _tree_program(self._struct)
-        raw = np.asarray(jax.device_get(prog(bins, self._groups,
-                                             self._init_f)))[:n]
-        return self._format_tree(raw, n)
+        raw = np.asarray(jax.device_get(
+            prog(bins, dev["groups"], dev["head"])))[:n]
+        if not self.model.is_classifier:
+            return {"predict": raw.astype(np.float32, copy=False)}
+        return self._format_probs(raw, n)
 
-    def _format_tree(self, raw: np.ndarray, n: int) -> dict:
+    def _score_iforest(self, cols, n: int, dev) -> dict:
+        b = bucket_batch_rows(n)
+        X = np.full((b, len(self._names)), np.nan, np.float32)
+        for ci, name in enumerate(self._names):
+            X[:n, ci] = cols[name]
+        _note_shapes((self._struct, X.shape, self._host_args["feat"].shape))
+        prog = _iforest_program(self._struct)
+        total = np.asarray(jax.device_get(
+            prog(jnp.asarray(X), dev["feat"], dev["thr"], dev["leaf"])))[:n]
+        ntrees = len(self._host_args["feat"])
+        # host tail mirrors IsolationForestModel._predict_raw op-for-op
+        from h2o3_tpu.models.isolation_forest import _c
+
+        mean_len = total / ntrees
+        cn = _c(self.model.params.sample_size)
+        score = np.power(2.0, -mean_len / max(cn, 1e-9))
+        return {"predict": np.asarray(score, np.float32),
+                "mean_length": np.asarray(mean_len, np.float32)}
+
+    def _score_eif(self, cols, n: int, dev) -> dict:
+        b = bucket_batch_rows(n)
+        C = len(self._names)
+        X64 = np.full((b, C), np.nan, np.float64)
+        for ci, name in enumerate(self._names):
+            X64[:n, ci] = cols[name].astype(np.float64)
+        X = np.where(np.isnan(X64), self._col_means[None, :],
+                     X64).astype(np.float32)
+        _note_shapes((self._struct, X.shape))
+        prog = _eif_program(self._struct)
+        total = np.asarray(jax.device_get(prog(
+            jnp.asarray(X), dev["normals"], dev["ds"], dev["is_leaf"],
+            dev["lens"])))[:n]
+        # host tail mirrors ExtendedIsolationForestModel._predict_raw
+        from h2o3_tpu.models.extended_isolation_forest import _c
+
+        ntrees = len(self._host_args["normals"][0])
+        mean_len = total / max(ntrees, 1)
+        score = 2.0 ** (-mean_len / max(_c(self.model.output["sample_size"]),
+                                        1e-9))
+        return {"anomaly_score": np.asarray(score, np.float32),
+                "mean_length": np.asarray(mean_len, np.float32)}
+
+    def _design_matrix(self, cols, n: int):
+        """Payload columns -> the model's (padded-bucket, p) design matrix
+        through the SAME DataInfo transform as the frame path."""
+        import pandas as pd
+
+        b = bucket_batch_rows(n)
+        padded = {}
+        for name, arr in cols.items():
+            if arr.dtype == object:
+                buf = np.full(b, None, dtype=object)
+            else:
+                buf = np.full(b, np.nan, arr.dtype)
+            buf[:n] = arr
+            padded[name] = buf
+        fr = Frame.from_pandas(pd.DataFrame(padded))
+        X, _ = self._di.transform(fr)
+        return X
+
+    def _score_glm(self, cols, n: int, dev) -> dict:
+        X = self._design_matrix(cols, n)
+        _note_shapes((self._struct, X.shape, dev["beta"].shape))
+        prog = _glm_program(self._struct)
+        raw = np.asarray(jax.device_get(prog(X, dev["beta"])))[:n]
+        if not self.model.is_classifier:
+            return {"predict": raw.astype(np.float32, copy=False)}
+        return self._format_probs(raw, n)
+
+    def _score_dl(self, cols, n: int, dev) -> dict:
+        X = self._design_matrix(cols, n)
+        _note_shapes((self._struct, X.shape))
+        prog = _dl_program(self._struct)
+        raw = np.asarray(jax.device_get(prog(X, dev["params"])))[:n]
+        if not self.model.is_classifier:
+            return {"predict": raw.astype(np.float32, copy=False)}
+        return self._format_probs(raw, n)
+
+    def _format_probs(self, raw: np.ndarray, n: int) -> dict:
         """Label + probability columns from raw predictions — the same host
         math as ``Model.predict`` (threshold, calibration), so the two
         surfaces cannot disagree."""
         m = self.model
-        if not m.is_classifier:
-            return {"predict": raw.astype(np.float32, copy=False)}
         domain = m.output["response_domain"]
         probs = raw if raw.ndim > 1 else np.stack([1 - raw, raw], axis=1)
         if m.nclasses == 2:
@@ -376,7 +726,8 @@ class BatchScorer:
 
 def scorer_for(model) -> BatchScorer:
     """The per-model scorer, cached on the model object (models are
-    immutable after build; the cache dies with the model)."""
+    immutable after build; the cache — and the residency entry, via its
+    weakref — dies with the model)."""
     sc = model.__dict__.get("_h2o3_batch_scorer")
     if sc is None:
         sc = BatchScorer(model)

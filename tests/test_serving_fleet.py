@@ -1,0 +1,565 @@
+"""Fleet serving plane tests (ISSUE 12): the model registry's
+watch-and-load + generation swap + rollout breaker, device-residency LRU
+paging, the per-algo compiled scorer lanes, batcher idle reaping, and
+per-model dispatch fairness.
+
+The load-bearing pins:
+- a new snapshot is picked up within one poll and swaps in atomically
+  under concurrent scoring (every response matches exactly one generation);
+- a bad rollout keeps the old generation serving (corrupt file) or rolls
+  back to it (rollout breaker on scoring failures);
+- LRU paging bounds resident model bytes at N× oversubscription with
+  BYTE-equal scores across page-out/page-in;
+- DRF/IF/EIF lanes are byte-equal to ``Model.predict`` through the frame
+  path, GLM/DL lanes 1e-6;
+- one hot model cannot starve cold models past their deadline.
+"""
+
+import copy
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu import persist, serving
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM
+from h2o3_tpu.serving.registry import REGISTRY, ServingRegistry
+from h2o3_tpu.serving.residency import MANAGER
+from h2o3_tpu.utils import metrics as _mx
+
+ROWS = [{"a": 0.37, "b": -1.25}, {"a": None, "b": 0.0},
+        {"a": 2.25, "b": 1.5}]
+
+
+def _rows_df(rows=ROWS, cols=("a", "b")):
+    return pd.DataFrame({c: [r.get(c) for r in rows] for c in cols})
+
+
+@pytest.fixture(scope="module")
+def train_frame():
+    rng = np.random.default_rng(11)
+    n = 500
+    df = pd.DataFrame({
+        "a": rng.normal(size=n), "b": rng.normal(size=n),
+        "y": np.where(rng.random(n) < 0.5, "dog", "cat"),
+    })
+    df.loc[::13, "a"] = np.nan
+    return Frame.from_pandas(df, destination_frame="fleet_train")
+
+
+def _train(train_frame, seed=1, ntrees=4):
+    return GBM(ntrees=ntrees, max_depth=3, seed=seed).train(
+        y="y", training_frame=train_frame)
+
+
+def _probs(out, domain):
+    return np.stack([np.asarray(out[str(d)], np.float32) for d in domain],
+                    axis=1)
+
+
+# ---------------------------------------------------------------------------
+# watch-and-load + generation swap
+
+
+def test_watch_and_load_within_one_poll(train_frame, tmp_path, monkeypatch):
+    """A snapshot written to the watch dir is serving within one poll of
+    the background watcher — no operator action."""
+    wd = str(tmp_path / "store")
+    os.makedirs(wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_WATCH_DIR", wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_POLL_SECS", "0.1")
+    m = _train(train_frame, seed=21)
+    want = serving.score_rows(m, ROWS)
+    reg = ServingRegistry()
+    try:
+        assert reg.install()
+        persist.save_model(m, os.path.join(wd, "fleet_m1"))
+        deadline = time.monotonic() + 10
+        while reg.resolve(m.key) is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        served = reg.resolve(m.key)
+        assert served is not None, "watcher never picked up the snapshot"
+        assert served.serving_generation == 1
+        got = serving.score_rows(served, ROWS)
+        dom = m.output["response_domain"]
+        assert _probs(got, dom).tobytes() == _probs(want, dom).tobytes()
+    finally:
+        reg.stop()
+
+
+def test_generation_swap_atomic_under_concurrent_scoring(
+        train_frame, tmp_path, monkeypatch):
+    """Scores taken across a rollout each match EXACTLY one generation —
+    never a blend — and after the swap every request serves the new one."""
+    wd = str(tmp_path / "store")
+    os.makedirs(wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_WATCH_DIR", wd)
+    m1 = _train(train_frame, seed=31, ntrees=3)
+    m2_src = _train(train_frame, seed=32, ntrees=5)
+    dom = m1.output["response_domain"]
+    want1 = _probs(serving.score_rows(m1, ROWS), dom)
+    want2 = _probs(serving.score_rows(m2_src, ROWS), dom)
+    assert want1.tobytes() != want2.tobytes()  # distinguishable generations
+
+    reg = ServingRegistry()
+    persist.save_model(m1, os.path.join(wd, "fleet_swap"))
+    assert reg.poll_once() == 1
+    key = m1.key
+
+    stop = threading.Event()
+    results, errors = [], []
+
+    def scorer():
+        while not stop.is_set():
+            try:
+                served = reg.resolve(key)
+                out = serving.score_rows(served, ROWS)
+                results.append(_probs(out, dom).tobytes())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=scorer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    m2 = copy.copy(m2_src)
+    m2.key = key  # same model key: a retrained winner rolling out
+    time.sleep(0.02)  # distinct mtime etag even on coarse clocks
+    persist.save_model(m2, os.path.join(wd, "fleet_swap"))
+    assert reg.poll_once() == 1
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert results
+    legal = {want1.tobytes(), want2.tobytes()}
+    assert set(results) <= legal  # atomic: one generation per response
+    # steady state after the swap: the new generation serves
+    out = serving.score_rows(reg.resolve(key), ROWS)
+    assert _probs(out, dom).tobytes() == want2.tobytes()
+    assert reg.resolve(key).serving_generation == 2
+
+
+def test_bad_snapshot_keeps_old_generation(train_frame, tmp_path,
+                                           monkeypatch):
+    wd = str(tmp_path / "store")
+    os.makedirs(wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_WATCH_DIR", wd)
+    m = _train(train_frame, seed=41)
+    dom = m.output["response_domain"]
+    want = _probs(serving.score_rows(m, ROWS), dom)
+    reg = ServingRegistry()
+    persist.save_model(m, os.path.join(wd, "fleet_bad"))
+    assert reg.poll_once() == 1
+    served = reg.resolve(m.key)
+    failed0 = _mx.counter_value("serving_rollouts_total", event="failed")
+    time.sleep(0.02)
+    with open(os.path.join(wd, "fleet_bad"), "wb") as f:
+        f.write(b"garbage, not a model file")
+    assert reg.poll_once() == 0
+    assert reg.resolve(m.key) is served  # old generation keeps serving
+    got = _probs(serving.score_rows(reg.resolve(m.key), ROWS), dom)
+    assert got.tobytes() == want.tobytes()
+    assert _mx.counter_value(
+        "serving_rollouts_total", event="failed") == failed0 + 1
+    # quarantined: the same bad etag is not retried every poll
+    assert reg.poll_once() == 0
+
+
+def test_rollout_breaker_rolls_back_over_rest(train_frame, tmp_path,
+                                              monkeypatch):
+    """A generation that loads but cannot score trips the rollout breaker
+    THROUGH the REST route and the previous generation resumes serving."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+
+    srv = start_server(port=0)
+    wd = str(tmp_path / "store")
+    os.makedirs(wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_WATCH_DIR", wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_BAD_GEN_ERRORS", "1")
+    m1 = _train(train_frame, seed=51, ntrees=3)
+    m2_src = _train(train_frame, seed=52, ntrees=5)
+    dom = m1.output["response_domain"]
+    want1 = _probs(serving.score_rows(m1, ROWS), dom)
+    key = m1.key
+    try:
+        persist.save_model(m1, os.path.join(wd, "fleet_breaker"))
+        assert REGISTRY.poll_once() == 1
+        m2 = copy.copy(m2_src)
+        m2.key = key
+        time.sleep(0.02)
+        persist.save_model(m2, os.path.join(wd, "fleet_breaker"))
+        assert REGISTRY.poll_once() == 1
+        served = REGISTRY.resolve(key)
+        assert served.serving_generation == 2
+        # sabotage the rolled-out generation's scorer: every dispatch dies
+        sc = serving.scorer_for(served)
+
+        def boom(*a, **k):
+            raise RuntimeError("bad generation: scorer exploded")
+
+        monkeypatch.setattr(sc, "score_table", boom)
+
+        def post(rows):
+            req = urllib.request.Request(
+                srv.url + "/3/Predictions/rows",
+                data=json.dumps({"model": key, "rows": rows}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(ROWS)
+        assert ei.value.code == 500
+        # the breaker rolled the key back: generation 1's snapshot serves
+        back = REGISTRY.resolve(key)
+        assert back is not served
+        out = post(ROWS)
+        got = np.stack([np.asarray(out["predictions"][str(d)], np.float32)
+                        for d in dom], axis=1)
+        assert got.tobytes() == want1.tobytes()
+        assert _mx.counter_value(
+            "serving_rollouts_total", event="rolled_back") >= 1
+    finally:
+        REGISTRY.reset()
+
+
+def test_registry_disabled_restores_manual_load(train_frame, monkeypatch):
+    """H2O3_TPU_SERVE_REGISTRY=0: resolution is off and scoring runs the
+    PR-7 DKV path bit-for-bit."""
+    monkeypatch.setenv("H2O3_TPU_SERVE_REGISTRY", "0")
+    monkeypatch.setenv("H2O3_TPU_SERVE_WATCH_DIR", "/nonexistent")
+    m = _train(train_frame, seed=61)
+    assert REGISTRY.resolve(m.key) is None
+    assert not REGISTRY.install()
+    dom = m.output["response_domain"]
+    got = _probs(serving.score_rows(m, ROWS), dom)
+    pf = m.predict(Frame.from_pandas(_rows_df()))
+    want = np.stack([pf.vec(str(d)).to_numpy() for d in dom], axis=1)
+    assert got.tobytes() == want.tobytes()
+
+
+def test_serving_registry_route(train_frame, tmp_path, monkeypatch):
+    import json
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+
+    srv = start_server(port=0)
+    wd = str(tmp_path / "store")
+    os.makedirs(wd)
+    monkeypatch.setenv("H2O3_TPU_SERVE_WATCH_DIR", wd)
+    m = _train(train_frame, seed=71)
+    try:
+        persist.save_model(m, os.path.join(wd, "fleet_route"))
+        assert REGISTRY.poll_once() == 1
+        serving.score_rows(REGISTRY.resolve(m.key), ROWS)
+        with urllib.request.urlopen(srv.url + "/3/ServingRegistry") as r:
+            out = json.loads(r.read())
+        assert out["enabled"] is True
+        assert out["watch_dir"] == wd
+        entry = [e for e in out["models"] if e["key"] == m.key]
+        assert entry and entry[0]["generation"] >= 1  # seq is registry-wide
+        assert entry[0]["lane"] == "tree"
+        assert entry[0]["residency"] in ("hbm", "host")
+        assert out["residency"]["models_tracked"] >= 1
+    finally:
+        REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# device-residency paging
+
+
+def test_lru_paging_bounds_resident_bytes(train_frame, monkeypatch):
+    """6 models through a ~2-model HBM budget: resident bytes stay under
+    the budget, evictions happen, and every model's scores stay BYTE-equal
+    across page-out/page-in cycles."""
+    models = [_train(train_frame, seed=100 + s) for s in range(6)]
+    dom = models[0].output["response_domain"]
+    base = [_probs(serving.score_rows(m, ROWS), dom) for m in models]
+    sizes = []
+    for m in models:
+        sc = serving.scorer_for(m)
+        sizes.append(sum(leaf.nbytes for leaf in
+                         __import__("jax").tree_util.tree_leaves(
+                             sc._host_args)))
+    budget = int(2 * max(sizes) + 1024)
+    monkeypatch.setenv("H2O3_TPU_SERVE_HBM_BYTES", str(budget))
+    ev0 = MANAGER.evictions
+    pi0 = MANAGER.page_ins
+    for _round in range(2):
+        for i, m in enumerate(models):
+            got = _probs(serving.score_rows(m, ROWS), dom)
+            assert got.tobytes() == base[i].tobytes(), i
+            st = MANAGER.status()
+            assert st["hbm_bytes"] <= budget, st
+    st = MANAGER.status()
+    assert MANAGER.evictions > ev0, "oversubscription never evicted"
+    assert MANAGER.page_ins > pi0 + len(models), "no page-in cycles"
+    assert st["hbm_bytes"] <= budget
+    # gauges track the tiers
+    hbm = _mx.counter_value  # gauges share the read helper
+    assert _mx.counter_value("serving_model_bytes", tier="hbm") <= budget
+    assert _mx.counter_value("serving_models_resident", tier="host") >= 6
+    assert hbm("serving_model_evictions_total", kind="demoted") > 0
+
+
+def test_retire_releases_scorer_and_batcher(train_frame):
+    from h2o3_tpu.serving.batcher import _BATCHERS
+
+    m = _train(train_frame, seed=200)
+    serving.score_rows(m, ROWS)
+    assert m.key in _BATCHERS
+    sc = m.__dict__.get("_h2o3_batch_scorer")
+    assert sc is not None and MANAGER.tier_of(sc) is not None
+    serving.retire_model(m.key, m)
+    # the dispatcher drains and releases asynchronously; wait on the result
+    deadline = time.monotonic() + 15
+    while MANAGER.tier_of(sc) is not None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert m.key not in _BATCHERS
+    assert "_h2o3_batch_scorer" not in m.__dict__
+    assert MANAGER.tier_of(sc) is None  # released from both tiers
+
+
+def test_idle_reap_drops_batcher_and_demotes(train_frame, monkeypatch):
+    from h2o3_tpu.serving.batcher import _BATCHERS
+
+    monkeypatch.setenv("H2O3_TPU_SCORE_IDLE_SECS", "0.2")
+    m = _train(train_frame, seed=201)
+    serving.score_rows(m, ROWS)
+    assert m.key in _BATCHERS
+    sc = serving.scorer_for(m)
+    deadline = time.monotonic() + 15
+    while m.key in _BATCHERS and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert m.key not in _BATCHERS, "idle batcher never reaped"
+    assert MANAGER.tier_of(sc) == "host"  # demoted, not released
+    # next request rebuilds transparently, byte-equal
+    dom = m.output["response_domain"]
+    a = _probs(serving.score_rows(m, ROWS), dom)
+    monkeypatch.setenv("H2O3_TPU_SCORE_IDLE_SECS", "30")
+    b = _probs(serving.score_rows(m, ROWS), dom)
+    assert a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# per-model fairness
+
+
+def test_hot_model_does_not_starve_cold(train_frame, monkeypatch):
+    """1 hot + 8 cold models: the round-robin dispatch gate keeps every
+    cold request inside its deadline while the hot model floods its queue."""
+    monkeypatch.setenv("H2O3_TPU_SCORE_BATCH_WINDOW_MS", "5")
+    monkeypatch.setenv("H2O3_TPU_SCORE_DEADLINE_MS", "5000")
+    hot = _train(train_frame, seed=300, ntrees=3)
+    cold = [_train(train_frame, seed=301 + i, ntrees=3) for i in range(8)]
+    for m in [hot] + cold:  # warm programs out of the measured window
+        serving.score_rows(m, ROWS)
+    stop = threading.Event()
+    hot_errors = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                serving.score_rows(hot, ROWS * 4)
+            except serving.ShedError:
+                pass  # the hot model MAY shed; the cold ones must not
+            except Exception as e:  # noqa: BLE001
+                hot_errors.append(e)
+                return
+
+    hammers = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in hammers:
+        t.start()
+    time.sleep(0.2)
+    cold_lat, cold_errors = [], []
+
+    def probe(m):
+        try:
+            for _ in range(3):
+                t0 = time.monotonic()
+                serving.score_rows(m, [ROWS[0]])
+                cold_lat.append(time.monotonic() - t0)
+        except Exception as e:  # noqa: BLE001
+            cold_errors.append(e)
+
+    probes = [threading.Thread(target=probe, args=(m,)) for m in cold]
+    for t in probes:
+        t.start()
+    for t in probes:
+        t.join(timeout=60)
+    stop.set()
+    for t in hammers:
+        t.join(timeout=30)
+    assert not cold_errors, f"cold models starved: {cold_errors[:3]}"
+    assert not hot_errors, hot_errors
+    assert len(cold_lat) == 24  # every cold request completed
+    assert max(cold_lat) < 5.0  # inside H2O3_TPU_SCORE_DEADLINE_MS
+
+
+# ---------------------------------------------------------------------------
+# compiled lane parity: DRF / IF / EIF / GLM / DL
+
+
+def test_drf_lane_byte_equal(train_frame, rng):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    m = DRF(ntrees=5, max_depth=4, seed=3).train(
+        y="y", training_frame=train_frame)
+    assert serving.scorer_for(m).lane == "tree"
+    dom = m.output["response_domain"]
+    got = _probs(serving.score_rows(m, ROWS), dom)
+    pf = m.predict(Frame.from_pandas(_rows_df()))
+    want = np.stack([pf.vec(str(d)).to_numpy() for d in dom], axis=1)
+    assert got.tobytes() == want.tobytes()
+    # regression DRF
+    n = 400
+    df = pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n),
+                       "y": rng.normal(size=n)})
+    mr = DRF(ntrees=4, max_depth=4, seed=4).train(
+        y="y", training_frame=Frame.from_pandas(
+            df, destination_frame="fleet_drf_reg"))
+    assert serving.scorer_for(mr).lane == "tree"
+    out = serving.score_rows(mr, ROWS)
+    pfr = mr.predict(Frame.from_pandas(_rows_df()))
+    assert (np.asarray(out["predict"], np.float32).tobytes()
+            == pfr.vec("predict").to_numpy().tobytes())
+
+
+def test_iforest_lane_byte_equal(rng):
+    from h2o3_tpu.models.isolation_forest import IsolationForest
+
+    n = 300
+    df = pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n),
+                       "d": rng.normal(size=n)})
+    fr = Frame.from_pandas(df, destination_frame="fleet_if")
+    m = IsolationForest(ntrees=12, sample_size=64, seed=5).train(
+        x=["a", "b", "d"], training_frame=fr)
+    assert serving.scorer_for(m).lane == "iforest"
+    rows = [{"a": 0.3, "b": -1.0, "d": 0.1}, {"a": None, "b": 2.0, "d": -.5}]
+    out = serving.score_rows(m, rows)
+    pf = m.predict(Frame.from_pandas(_rows_df(rows, ("a", "b", "d"))))
+    for col in ("predict", "mean_length"):
+        assert np.array_equal(pf.vec(col).to_numpy()[:2],
+                              np.asarray(out[col])), col
+
+
+def test_eif_lane_byte_equal(rng):
+    from h2o3_tpu.models.extended_isolation_forest import (
+        ExtendedIsolationForest,
+    )
+
+    n = 300
+    df = pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n),
+                       "d": rng.normal(size=n)})
+    fr = Frame.from_pandas(df, destination_frame="fleet_eif")
+    m = ExtendedIsolationForest(ntrees=10, sample_size=64, seed=6).train(
+        training_frame=fr)
+    assert serving.scorer_for(m).lane == "eif"
+    rows = [{"a": 0.3, "b": -1.0, "d": 0.1}, {"a": None, "b": 2.0, "d": -.5}]
+    out = serving.score_rows(m, rows)
+    pf = m.predict(Frame.from_pandas(_rows_df(rows, ("a", "b", "d"))))
+    for col in ("anomaly_score", "mean_length"):
+        assert np.array_equal(pf.vec(col).to_numpy()[:2],
+                              np.asarray(out[col])), col
+
+
+def test_glm_lane_parity(rng):
+    from h2o3_tpu.models.glm import GLM
+
+    n = 400
+    df = pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n),
+                       "c": rng.choice(["x", "y", "z"], n),
+                       "y": np.where(rng.random(n) < 0.5, "p", "q")})
+    df.loc[::17, "a"] = np.nan
+    fr = Frame.from_pandas(df, destination_frame="fleet_glm")
+    rows = [{"a": 0.3, "b": -1.0, "c": "x"},
+            {"a": None, "b": 2.0, "c": "NEVER_SEEN"},
+            {"b": 0.5, "c": "z"}]
+    df2 = _rows_df(rows, ("a", "b", "c"))
+    m = GLM(family="binomial", seed=1).train(y="y", training_frame=fr)
+    assert serving.scorer_for(m).lane == "glm"
+    out = serving.score_rows(m, rows)
+    pf = m.predict(Frame.from_pandas(df2))
+    dom = m.output["response_domain"]
+    for d in dom:
+        np.testing.assert_allclose(
+            np.asarray(out[str(d)], np.float64),
+            pf.vec(str(d)).to_numpy()[:3].astype(np.float64), atol=1e-6)
+    assert list(out["predict"]) == [
+        dom[i] for i in
+        (pf.vec("predict").to_numpy()[:3]).astype(int)]
+    # multinomial
+    dfm = df.copy()
+    dfm["y"] = rng.choice(["r", "g", "bl"], n)
+    mm = GLM(family="multinomial", seed=1).train(
+        y="y", training_frame=Frame.from_pandas(
+            dfm, destination_frame="fleet_glm_m"))
+    assert serving.scorer_for(mm).lane == "glm"
+    outm = serving.score_rows(mm, rows)
+    pfm = mm.predict(Frame.from_pandas(df2))
+    for d in mm.output["response_domain"]:
+        np.testing.assert_allclose(
+            np.asarray(outm[str(d)], np.float64),
+            pfm.vec(str(d)).to_numpy()[:3].astype(np.float64), atol=1e-6)
+    # regression
+    dfr = df.copy()
+    dfr["y"] = rng.normal(size=n)
+    mr = GLM(family="gaussian", seed=1).train(
+        y="y", training_frame=Frame.from_pandas(
+            dfr, destination_frame="fleet_glm_r"))
+    assert serving.scorer_for(mr).lane == "glm"
+    outr = serving.score_rows(mr, rows)
+    pfr = mr.predict(Frame.from_pandas(df2))
+    np.testing.assert_allclose(
+        np.asarray(outr["predict"], np.float64),
+        pfr.vec("predict").to_numpy()[:3].astype(np.float64), atol=1e-6)
+
+
+def test_dl_lane_parity(rng):
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    n = 400
+    df = pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n),
+                       "c": rng.choice(["x", "y"], n),
+                       "y": np.where(rng.random(n) < 0.5, "p", "q")})
+    fr = Frame.from_pandas(df, destination_frame="fleet_dl")
+    m = DeepLearning(hidden=[8, 8], epochs=2, seed=2,
+                     reproducible=True).train(y="y", training_frame=fr)
+    assert serving.scorer_for(m).lane == "dl"
+    rows = [{"a": 0.3, "b": -1.0, "c": "x"}, {"a": None, "b": 2.0, "c": "y"}]
+    out = serving.score_rows(m, rows)
+    pf = m.predict(Frame.from_pandas(_rows_df(rows, ("a", "b", "c"))))
+    for d in m.output["response_domain"]:
+        np.testing.assert_allclose(
+            np.asarray(out[str(d)], np.float64),
+            pf.vec(str(d)).to_numpy()[:2].astype(np.float64), atol=1e-6)
+
+
+def test_lane_program_reuse_same_bucket(train_frame):
+    """A second same-shape DRF model scores with ZERO new scorer program
+    shapes — the arguments-not-constants contract beyond the GBM family."""
+    from h2o3_tpu.models.tree.drf import DRF
+
+    m1 = DRF(ntrees=4, max_depth=4, seed=8).train(
+        y="y", training_frame=train_frame)
+    serving.score_rows(m1, ROWS)
+    compiled = _mx.counter_value("serving_scorer_programs_total",
+                                 event="compile")
+    m2 = DRF(ntrees=4, max_depth=4, seed=9).train(
+        y="y", training_frame=train_frame)
+    serving.score_rows(m2, ROWS)
+    assert _mx.counter_value(
+        "serving_scorer_programs_total", event="compile") == compiled

@@ -210,6 +210,61 @@ def _oocore_ab_ok(here: str, now: float):
         return False
 
 
+def _fleet_ok(here: str, now: float):
+    """Sanity-check the newest recent FLEET_*.json (tools/load_test.py
+    --fleet, the serving-plane oversubscription A/B). Returns None when no
+    recent artifact exists (no opinion), else True/False. Checks the
+    ISSUE-12 acceptance pins: resident model bytes stayed under
+    H2O3_TPU_SERVE_HBM_BYTES at oversubscription, paging actually happened
+    (evictions > 0), every model's scores were byte-stable across
+    page-out/page-in AND across the resident control, and the oversub
+    tier's sustained QPS held >= 0.5x the all-resident run."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "FLEET_*.json")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            d = json.loads(f.readline())
+        s = d.get("summary") or {}
+        if not d.get("steps"):
+            print(f"{name}: NO steps")
+            return False
+        if not s.get("peak_within_budget"):
+            print(f"{name}: resident model bytes EXCEEDED the HBM budget "
+                  f"(peak {s.get('oversub_hbm_peak_bytes')} > "
+                  f"{s.get('hbm_budget_bytes')})")
+            return False
+        if not (s.get("oversub_evictions") or 0) > 0:
+            print(f"{name}: oversubscription never paged (evictions=0)")
+            return False
+        if not (s.get("oversub_parity_stable")
+                and s.get("parity_across_modes")):
+            print(f"{name}: paging perturbed scores (parity_stable="
+                  f"{s.get('oversub_parity_stable')}, across_modes="
+                  f"{s.get('parity_across_modes')})")
+            return False
+        ratio = s.get("qps_ratio_vs_resident")
+        if ratio is not None and ratio < 0.5:
+            print(f"{name}: oversub sustained QPS ratio {ratio} < 0.5x "
+                  "resident")
+            return False
+        print(f"{name}: peak-in-budget=ok evictions="
+              f"{s.get('oversub_evictions')} parity=ok qps-ratio={ratio} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def main() -> int:
     import time
 
@@ -230,6 +285,11 @@ def main() -> int:
     # must satisfy the fixed-footprint acceptance pins or the window stands
     oo = _oocore_ab_ok(here, now)
     if oo is False:
+        return 1
+    # fleet serving gate (ISSUE 12): a recent --fleet artifact must satisfy
+    # the oversubscription acceptance pins or the window stands
+    fl = _fleet_ok(here, now)
+    if fl is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -113,6 +113,54 @@ def _serve(args) -> None:
         time.sleep(3600)
 
 
+def _serve_fleet(args) -> None:
+    """Fleet server-subprocess mode (--fleet): train M models, export each
+    through serialize_model into the watch dir (H2O3_TPU_SERVE_WATCH_DIR —
+    set by the parent), let the serving REGISTRY load them (the real
+    rollout path), size the HBM budget to H2O3_TPU_FLEET_OVERSUB× less
+    than the fleet's total scorer bytes (0 = unbounded, the all-resident
+    control), and serve REST."""
+    import h2o3_tpu
+    from h2o3_tpu import persist, serving
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.serving.registry import REGISTRY
+    from h2o3_tpu.serving.residency import MANAGER
+
+    h2o3_tpu.init(log_level="WARN")
+    watch = os.environ["H2O3_TPU_SERVE_WATCH_DIR"]
+    oversub = int(os.environ.get("H2O3_TPU_FLEET_OVERSUB", "0"))
+    fr = Frame.from_pandas(_train_df(), destination_frame="fleet_train")
+    keys = []
+    for i in range(args.models):
+        m = GBM(ntrees=8, max_depth=4, seed=100 + i).train(
+            y="y", training_frame=fr)
+        persist.save_model(m, os.path.join(watch, f"fleet_model_{i:03d}"))
+        keys.append(m.key)
+    loaded = REGISTRY.poll_once()
+    assert loaded == args.models, (loaded, args.models)
+    # stack every registry-served model's HOST payload first (scorer_for
+    # uploads nothing), size the budget from the measured fleet bytes,
+    # THEN warm-score — so every device upload happens under the budget
+    # and hbm_peak_bytes is an honest bound
+    for k in keys:
+        serving.scorer_for(REGISTRY.resolve(k))
+    total = MANAGER.status()["host_bytes"]
+    if oversub > 0:
+        os.environ["H2O3_TPU_SERVE_HBM_BYTES"] = str(
+            max(total // oversub, 1))
+    probe = _row_pool(1)[0]
+    for k in keys:
+        serving.score_rows(REGISTRY.resolve(k), [probe])
+    srv = start_server(port=args.port)
+    print(f"READY {srv.url} {','.join(keys)} total_bytes={total} "
+          f"budget={os.environ.get('H2O3_TPU_SERVE_HBM_BYTES', '0')}",
+          flush=True)
+    while True:
+        time.sleep(3600)
+
+
 # ---------------------------------------------------------------------------
 # client side
 
@@ -142,7 +190,11 @@ def _scrape_hist(url: str, family: str):
 
 
 def _run_step(url: str, model_key: str, qps: float, duration: float,
-              rows_per_req: int, threads: int, pool: list[dict]) -> dict:
+              rows_per_req: int, threads: int, pool: list[dict],
+              model_pick=None) -> dict:
+    """One offered-QPS step. ``model_pick`` (fleet mode) is a deterministic
+    per-arrival model-key array — Zipf-distributed traffic over the fleet
+    instead of one hot key."""
     rng = np.random.default_rng(int(qps * 1000) ^ 0x5EED)
     gaps = rng.exponential(1.0 / qps, size=int(qps * duration * 1.2) + 8)
     arrivals = np.cumsum(gaps)
@@ -183,9 +235,11 @@ def _run_step(url: str, model_key: str, qps: float, duration: float,
                 time.sleep(delay)  # behind schedule -> fire immediately
             rows = [pool[(i * rows_per_req + j) % len(pool)]
                     for j in range(rows_per_req)]
+            mk = (model_key if model_pick is None
+                  else model_pick[i % len(model_pick)])
             r0 = time.monotonic()
             try:
-                _post_rows(url, model_key, rows)
+                _post_rows(url, mk, rows)
                 done = time.monotonic()
                 with lat_lock:
                     lat_ms.append((done - r0) * 1e3)
@@ -269,6 +323,153 @@ def _spawn_server(mode: str, window_ms: str | None) -> tuple:
     raise RuntimeError(f"{mode} server never became ready")
 
 
+def _spawn_fleet_server(mode: str, args, watch_dir: str) -> tuple:
+    """mode 'oversub' bounds HBM to total/oversub; 'resident' leaves the
+    budget unbounded (the all-resident control)."""
+    env = dict(os.environ)
+    env.setdefault("H2O3_TPU_LOG_LEVEL", "WARN")
+    env["H2O3_TPU_SERVE_WATCH_DIR"] = watch_dir
+    env["H2O3_TPU_FLEET_OVERSUB"] = (
+        str(args.oversub) if mode == "oversub" else "0")
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve-fleet",
+         "--port", "0", "--models", str(args.models)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=ROOT)
+    deadline = time.monotonic() + 900
+    while time.monotonic() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(f"fleet {mode} server died (rc={p.poll()})")
+        if line.startswith("READY "):
+            parts = line.split()
+            url, keys = parts[1], parts[2].split(",")
+            extra = dict(kv.split("=") for kv in parts[3:])
+            _log(f"fleet {mode} server up at {url}: {len(keys)} models, "
+                 f"total_bytes={extra.get('total_bytes')} "
+                 f"budget={extra.get('budget')}")
+            return p, url, keys, extra
+    p.kill()
+    raise RuntimeError(f"fleet {mode} server never became ready")
+
+
+def _scrape_registry(url: str) -> dict:
+    try:
+        with urllib.request.urlopen(url + "/3/ServingRegistry",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        _log(f"registry scrape failed: {e!r}")
+        return {}
+
+
+def _zipf_pick(keys: list[str], n: int, s: float, seed: int) -> list[str]:
+    """Deterministic Zipf-ranked model choice: p_i ∝ 1/(i+1)^s."""
+    rng = np.random.default_rng(seed)
+    w = 1.0 / np.power(np.arange(1, len(keys) + 1, dtype=np.float64), s)
+    w /= w.sum()
+    idx = rng.choice(len(keys), size=n, p=w)
+    return [keys[i] for i in idx]
+
+
+def _run_fleet(args, stamp: str) -> int:
+    """The fleet A/B (ISSUE 12 acceptance): Zipf traffic over M models at
+    K× HBM oversubscription vs the all-resident control — sustained QPS,
+    eviction/page-in counters, the peak-bytes-under-budget pin, and
+    byte-parity per model before/after the sweep AND across modes."""
+    import tempfile
+
+    qps_list = [float(q) for q in args.qps.split(",") if q.strip()]
+    pool = _row_pool()
+    probe_rows = pool[:8]
+    artifact = {
+        "schema": "fleet-loadtest/v1", "stamp": stamp,
+        "models": args.models, "oversub": args.oversub,
+        "zipf_s": args.zipf, "rows_per_request": args.rows,
+        "duration_s_per_step": args.duration, "steps": [],
+        "env": {
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS", ""),
+        },
+    }
+    parity: dict[str, dict] = {}
+    registry_stats: dict[str, dict] = {}
+    budgets: dict[str, int] = {}
+
+    for mode in ("oversub", "resident"):
+        watch = tempfile.mkdtemp(prefix=f"fleet_store_{mode}_")
+        proc, url, keys, extra = _spawn_fleet_server(mode, args, watch)
+        budgets[mode] = int(extra.get("budget") or 0)
+        try:
+            # ordered by training seed, NOT keyed by model key: keys are
+            # per-process uuids, but seed i's model is identical across the
+            # two servers (deterministic training)
+            before = [_post_rows(url, k, probe_rows)["predictions"]
+                      for k in keys]
+            for q in qps_list:
+                pick = _zipf_pick(keys, max(int(q * args.duration * 2), 64),
+                                  args.zipf, seed=int(q))
+                step = _run_step(url, keys[0], q, args.duration, args.rows,
+                                 args.threads, pool, model_pick=pick)
+                step["mode"] = mode
+                artifact["steps"].append(step)
+                _log(f"[fleet {mode}] offered={q:>7.0f}/s achieved="
+                     f"{step['achieved_qps']:>7.1f}/s shed_rate="
+                     f"{step['shed_rate']:.3f} p50={step['p50_ms']}ms "
+                     f"p99={step['p99_ms']}ms")
+            # byte-parity per model across the whole sweep's page-out/in
+            after = [_post_rows(url, k, probe_rows)["predictions"]
+                     for k in keys]
+            parity[mode] = {"before": before, "after": after,
+                            "stable": before == after}
+            registry_stats[mode] = _scrape_registry(url)
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    summary: dict = {}
+    for mode in ("oversub", "resident"):
+        steps = [s for s in artifact["steps"] if s["mode"] == mode]
+        best = _sustained(steps)
+        summary[f"{mode}_sustained_qps"] = best["offered_qps"] if best else 0.0
+        summary[f"{mode}_p99_ms_at_sustained"] = (best["p99_ms"] if best
+                                                  else None)
+        res = (registry_stats.get(mode) or {}).get("residency") or {}
+        summary[f"{mode}_hbm_peak_bytes"] = res.get("hbm_peak_bytes")
+        summary[f"{mode}_evictions"] = res.get("evictions")
+        summary[f"{mode}_page_ins"] = res.get("page_ins")
+        summary[f"{mode}_parity_stable"] = parity[mode]["stable"]
+    summary["hbm_budget_bytes"] = budgets["oversub"]
+    peak = summary.get("oversub_hbm_peak_bytes") or 0
+    summary["peak_within_budget"] = bool(
+        budgets["oversub"] and peak <= budgets["oversub"])
+    # cross-mode parity: same seeds, same data -> same models; paging must
+    # not perturb a single bit
+    summary["parity_across_modes"] = (
+        parity["oversub"]["after"] == parity["resident"]["after"])
+    c = summary.get("resident_sustained_qps") or 0.0
+    b = summary.get("oversub_sustained_qps") or 0.0
+    summary["qps_ratio_vs_resident"] = round(b / c, 3) if c else None
+    artifact["summary"] = summary
+    artifact["registry"] = {
+        m: (registry_stats.get(m) or {}).get("residency")
+        for m in registry_stats
+    }
+
+    out_path = args.out or os.path.join(ROOT, f"FLEET_{stamp}.json")
+    line = json.dumps(artifact)
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    print(line)
+    _log(f"fleet artifact written to {out_path}")
+    ok = (summary["peak_within_budget"]
+          and summary["parity_across_modes"]
+          and summary["oversub_parity_stable"]
+          and (summary["qps_ratio_vs_resident"] or 0) >= 0.5)
+    _log(f"fleet acceptance {'OK' if ok else 'NOT MET'}: {summary}")
+    return 0
+
+
 def _sustained(steps: list[dict]) -> dict | None:
     """Highest offered rate the tier sustains: <= 1% of the offered load was
     shed, errored, or left unissued inside the step window (shed_rate
@@ -286,9 +487,21 @@ def _sustained(steps: list[dict]) -> dict | None:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--serve-fleet", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
     ap.add_argument("--mode", default="both",
                     choices=("both", "batched", "control"))
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet A/B: Zipf traffic over --models models at "
+                         "--oversub x HBM oversubscription through the "
+                         "serving registry, vs the all-resident control")
+    ap.add_argument("--models", type=int, default=10,
+                    help="fleet mode: how many models to train/serve")
+    ap.add_argument("--oversub", type=int, default=10,
+                    help="fleet mode: HBM budget = fleet bytes / this")
+    ap.add_argument("--zipf", type=float, default=1.2,
+                    help="fleet mode: Zipf skew of the per-model traffic")
     ap.add_argument("--qps", default="25,50,100,200,400,800,1600,3200",
                     help="comma list of offered QPS steps")
     ap.add_argument("--duration", type=float, default=6.0,
@@ -306,11 +519,16 @@ def main(argv=None) -> int:
                     help="artifact path (default LOADTEST_<stamp>.json)")
     args = ap.parse_args(argv)
 
+    if args.serve_fleet:
+        _serve_fleet(args)
+        return 0
     if args.serve:
         _serve(args)
         return 0
 
     stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    if args.fleet:
+        return _run_fleet(args, stamp)
     qps_list = [float(q) for q in args.qps.split(",") if q.strip()]
     pool = _row_pool()
     modes = (["batched", "control"] if args.mode == "both" else [args.mode])

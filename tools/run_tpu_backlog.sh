@@ -168,3 +168,16 @@ save "OOCORE_MEM_${stamp}.json" "Out-of-core capacity model (compressed frames +
 H2O3_TPU_FRAME_COMPRESS=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
   | tee "BENCH_builder_${stamp}_nocompress.json"  # out-of-core plane kill-switch control
 save "BENCH_builder_${stamp}_nocompress.json" "TPU bench FRAME_COMPRESS=0 control (headline only)"
+
+# fleet serving A/B (ISSUE 12): Zipf traffic over 16 models at 10x HBM
+# oversubscription through the serving registry + residency LRU, vs the
+# all-resident control — sustained QPS ratio (>= 0.5x required),
+# peak-resident-bytes-under-budget pin, eviction/page-in counters, and the
+# per-model byte-parity probe across page-out/page-in and across modes.
+# On TPU the interesting number is the real page-in cost (PCIe/ICI
+# host->HBM re-upload) vs the CPU proxy's memcpy — it decides how tight
+# H2O3_TPU_SERVE_HBM_BYTES can run before the paging tax eats the tail.
+timeout 1800 python tools/load_test.py --fleet --models 16 --oversub 10 \
+  --qps 25,50,100,200,400,800 --duration 6 \
+  --out "FLEET_${stamp}.json" | tail -1 > /dev/null
+save "FLEET_${stamp}.json" "Fleet serving A/B: 10x HBM oversubscription vs all-resident"
