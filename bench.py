@@ -1063,6 +1063,27 @@ _PHASES: dict = {
 DEADLINE_S = float(os.environ.get("H2O3_TPU_BENCH_DEADLINE_S", 3000))
 
 
+def _devmem_block() -> dict:
+    """Per-phase HBM attribution snapshot (utils/devmem.py): live + peak
+    bytes per owning residency plane, and the device in_use/unattributed
+    split when the backend reports memory_stats. Every phase subprocess
+    embeds one, so the artifact shows peak-per-owner-PER-PHASE — the
+    number the TPU-window A/Bs compare against the static capacity model
+    (tools/tpu_mem_analysis.py --live is the interactive twin)."""
+    from h2o3_tpu.utils import devmem
+
+    devmem.poll(force=True)
+    s = devmem.status()
+    out = {
+        "owned_bytes": s["owned_bytes"],
+        "peak_owned_bytes": s["peak_owned_bytes"],
+    }
+    for k in ("in_use_bytes", "limit_bytes", "unattributed_bytes"):
+        if s.get(k) is not None:
+            out[k] = s[k]
+    return out
+
+
 def _child_main(phase: str) -> None:
     """Run one phase in this (fresh) process; print its JSON dict."""
     try:
@@ -1083,6 +1104,11 @@ def _child_main(phase: str) -> None:
                 pass
         _init_with_retry()
         out = _PHASES[phase][0]()
+        if isinstance(out, dict):
+            try:
+                out["devmem"] = _devmem_block()
+            except Exception:  # noqa: BLE001 — diagnostics never sink a phase
+                pass
     except Exception as e:
         tb = traceback.format_exc(limit=20)
         out = {"error": repr(e), "traceback": tb}

@@ -826,6 +826,28 @@ class Endpoints:
             raise ApiError(404, f"Job {key} not found")
         return _metrics.chrome_trace(key)
 
+    def flight_recorder(self, params):
+        """``GET /3/FlightRecorder?n=&kind=`` — the always-on dispatch ring
+        (utils/flightrec.py) plus the devmem attribution snapshot and the
+        last incident-bundle path: the live half of what an incident
+        bundle freezes. ``n`` bounds the returned events (default 512),
+        ``kind`` filters (dispatch_start/dispatch_end/chunk_fetch/...)."""
+        from h2o3_tpu.utils import devmem, flightrec
+
+        try:
+            n = int(params.get("n", 512))
+        except (TypeError, ValueError):
+            raise ApiError(400, "n must be an integer")
+        kind = params.get("kind") or None
+        return {
+            "__meta": {"schema_type": "FlightRecorder"},
+            "ring": flightrec.ring_status(),
+            "events": flightrec.events(n=max(n, 0) or None, kind=kind),
+            "last_incident": flightrec.last_incident(),
+            "incident_dir": flightrec.incident_dir(),
+            "devmem": devmem.status(),
+        }
+
     # -- timeline (water.TimeLine /3/Timeline successor) --------------------
     def timeline(self, params):
         from h2o3_tpu.utils import telemetry
@@ -1673,6 +1695,7 @@ _ROUTES: list[tuple[str, re.Pattern, object]] = [
     ("GET", r"/3/Logs/nodes/([^/]+)/files/([^/]+)", _EP.logs_get),
     ("GET", r"/3/Logs", _EP.logs_tail),
     ("GET", r"/3/Metrics", _EP.metrics_get),
+    ("GET", r"/3/FlightRecorder", _EP.flight_recorder),
     ("GET", r"/3/Timeline", _EP.timeline),
     ("GET", r"/3/Profiler", _EP.profiler),
     ("GET", r"/3/Models", _EP.models_list),
@@ -2129,4 +2152,10 @@ def start_server(ip: str = "127.0.0.1", port: int | None = None) -> H2OServer:
         from h2o3_tpu.serving import registry as _sreg
 
         _sreg.install()
+        # device-memory ledger: the background poller keeps the
+        # device_hbm_bytes / unattributed series fresh on an IDLE server
+        # (busy processes refresh at dispatch boundaries)
+        from h2o3_tpu.utils import devmem as _devmem
+
+        _devmem.install()
     return _SERVER

@@ -213,13 +213,22 @@ def mark_degraded(reason: str) -> None:
     """Latch the cloud unhealthy (fail-stop semantics, SURVEY §5.3): called
     when a replicated command dies with a coordination-service failure
     signature — a dead member makes the cloud unusable; restart is the
-    recovery path, durability comes from checkpoints. `/3/Cloud` surfaces it."""
+    recovery path, durability comes from checkpoints. `/3/Cloud` surfaces it.
+
+    The latch instant is when the flight-recorder ring still holds the
+    dying dispatch, so the incident bundle captures HERE — before any
+    supervisor reform/retry (or operator restart) discards the evidence."""
     global _degraded
     if _degraded is None:
         _degraded = reason
         _G_DEGRADED.set(1)
         _C_TRANSITIONS.inc(to="degraded")
         Log.err(f"cloud degraded (fail-stop): {reason}")
+        from h2o3_tpu.utils import flightrec
+
+        flightrec.record("degraded", reason=str(reason)[:200],
+                         generation=_generation)
+        flightrec.capture_incident(reason, trigger="degraded")
 
 
 def degraded_reason() -> str | None:
@@ -249,6 +258,10 @@ def recover(reason: str = "") -> int:
     _degraded = None
     _G_DEGRADED.set(0)
     _C_TRANSITIONS.inc(to="healthy")
+    from h2o3_tpu.utils import flightrec
+
+    flightrec.record("generation", generation=_generation,
+                     was=_generation - 1)
     return _generation
 
 
@@ -266,23 +279,26 @@ def clear_degraded() -> None:
 
 
 def cluster_info() -> dict:
+    from h2o3_tpu.utils import devmem
+
     m = _mesh.get_mesh()
-    # per-device health (the /3/Cloud node-table analog): a device that
-    # errors on the stats probe reports unhealthy instead of killing the route
+    # per-device health (the /3/Cloud node-table analog), read through the
+    # devmem ledger's rate-limited poller — the ONE memory_stats reader in
+    # the process (the node table may be up to H2O3_TPU_DEVMEM_POLL_SECS
+    # old; a device that errors on the probe reports unhealthy instead of
+    # killing the route). Only addressable devices are probed: remote
+    # hosts' devices reject memory_stats and must not mark a healthy
+    # multi-host cloud unhealthy.
     nodes = []
     healthy = True
-    # only addressable devices are probed: remote hosts' devices reject
-    # memory_stats and must not mark a healthy multi-host cloud unhealthy
-    for d in jax.local_devices():
-        node = {"id": d.id, "platform": d.platform,
-                "process": getattr(d, "process_index", 0), "healthy": True}
-        try:
-            stats = d.memory_stats() if hasattr(d, "memory_stats") else None
-            if stats:
-                node["mem_in_use"] = stats.get("bytes_in_use")
-                node["mem_limit"] = stats.get("bytes_limit")
-        except Exception:  # noqa: BLE001 — health probe must not throw
-            node["healthy"] = False
+    for d in devmem.device_stats():
+        node = {"id": d["id"], "platform": d["platform"],
+                "process": d["process"], "healthy": d["error"] is None}
+        if "in_use" in d:
+            node["mem_in_use"] = d["in_use"]
+        if "limit" in d:
+            node["mem_limit"] = d["limit"]
+        if not node["healthy"]:
             healthy = False
         nodes.append(node)
     out_degraded = degraded_reason()

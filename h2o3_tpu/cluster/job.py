@@ -101,10 +101,13 @@ class Job:
     def set_recovery(self, info: dict) -> None:
         """Record the latest resumable snapshot on this job AND its
         ancestors: clients poll the OUTER (REST) job key, so the pointer
-        must surface there, not only on the nested builder job."""
+        must surface there, not only on the nested builder job. MERGES
+        into the existing block: a checkpoint update after a supervised
+        restart must not drop the ``incident_bundle`` pointer the
+        recovery loop attached (utils/flightrec.py)."""
         j: Job | None = self
         while j is not None:
-            j.recovery = info
+            j.recovery = {**(j.recovery or {}), **info}
             j = j.parent
 
     # -- client-side API --

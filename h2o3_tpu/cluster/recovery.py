@@ -151,9 +151,14 @@ def reform(reason: str = "") -> int:
     serving and resuming checkpointed jobs on while the pod reschedules."""
     from h2o3_tpu.cluster import cloud
     from h2o3_tpu.parallel import mesh as _mesh
+    from h2o3_tpu.utils import flightrec
 
     if cloud.degraded_reason() is None:
         cloud.mark_degraded(reason or "supervised reform")
+    # freeze the evidence BEFORE the reform discards it (dedups with the
+    # capture mark_degraded already made for this episode)
+    flightrec.capture_incident(
+        reason or "supervised reform", trigger="reform")
     try:
         _mesh.reform_mesh()
     except Exception as e:  # noqa: BLE001 — a dead backend must not stop the
@@ -195,6 +200,25 @@ def run_supervised(launch, *, ckdir: str | None = None, algo: str | None = None,
                 ) from e
             t0 = time.monotonic()
             snap = latest_snapshot(ckdir, algo)
+            # the postmortem evidence, captured before the retry discards
+            # it; the path surfaces in the job's recovery block so the
+            # /3/Jobs poller (and the runbook) can find the bundle
+            from h2o3_tpu.cluster import cloud
+            from h2o3_tpu.utils import flightrec
+
+            flightrec.record(
+                "cloud_failure", job=description,
+                error=type(e).__name__, generation=cloud.generation(),
+                attempt=attempt + 1)
+            bundle = flightrec.capture_incident(
+                f"{description}: {type(e).__name__}: {e}", trigger="retry")
+            if bundle is not None and job is not None:
+                info = dict(getattr(job, "recovery", None) or {})
+                info["incident_bundle"] = bundle
+                if hasattr(job, "set_recovery"):
+                    job.set_recovery(info)
+                else:
+                    job.recovery = info
             delay = backoff_delay(attempt, key=description)
             Log.warn(
                 f"recovery: {description} died of a cloud failure "

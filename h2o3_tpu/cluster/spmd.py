@@ -148,7 +148,11 @@ def _watchdog_pass(active: "list[tuple[int, dict]]") -> float:
             w["tripped"] = True
         _WATCHDOG_TRIPS.inc(cmd=w["cmd"])
         from h2o3_tpu.cluster import cloud
+        from h2o3_tpu.utils import flightrec
 
+        flightrec.record("watchdog_trip", cmd=w["cmd"],
+                         budget_s=w["budget"],
+                         running_s=round(time.monotonic() - w["t0"], 3))
         cloud.mark_degraded(
             f"spmd watchdog: replicated command {w['cmd']!r} still "
             f"running after its {budget}s budget — presumed wedged "

@@ -361,6 +361,31 @@ _KNOBS: dict[str, tuple[str, str]] = {
              "generation and quarantines the bad file's etag (it will not "
              "be reloaded until the file changes). A successful score "
              "resets the count. 0 = never roll back"),
+    "H2O3_TPU_FLIGHTREC_SIZE": (
+        "4096", "incident flight recorder ring capacity, events "
+                "(utils/flightrec.py): the always-on bounded ring of "
+                "structured dispatch/collective/residency/cluster events "
+                "every process keeps — O(µs) lock-free append, read once "
+                "at import like H2O3_TPU_METRICS (the append is the hot "
+                "path). Served over GET /3/FlightRecorder and frozen into "
+                "incident bundles. '0' disables the ring (incident "
+                "bundles still capture metrics/devmem/logs)"),
+    "H2O3_TPU_DEVMEM_POLL_SECS": (
+        "5", "device-memory ledger poll period, seconds "
+             "(utils/devmem.py): how often device.memory_stats() is "
+             "actually read — the ONE reader behind the "
+             "device_hbm_bytes{device,kind} gauges, the computed "
+             "hbm_owned_bytes{owner=unattributed} series, the "
+             "hbm_headroom_bytes gauge and /3/Cloud's per-node memory "
+             "fields. Dispatch boundaries and the background poller both "
+             "refresh through this rate limit, so a hot loop never "
+             "reads stats more than once per period"),
+    "H2O3_TPU_INCIDENT_DIR": (
+        "", "directory incident bundles are written to "
+            "(utils/flightrec.py: ring dump + metrics snapshot + devmem "
+            "attribution + log tail, atomic through persist — any persist "
+            "scheme works, s3://... included). '' = "
+            "<system tmp>/h2o3_incidents"),
     "H2O3_TPU_PREDICTIONS_RETAIN": (
         "64", "bounded retention of GENERATED /3/Predictions result frames: "
               "the newest N generated prediction frames stay in the DKV, "

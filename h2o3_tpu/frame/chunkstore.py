@@ -50,6 +50,8 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
+from h2o3_tpu.utils import devmem as _dm
+from h2o3_tpu.utils import flightrec as _fr
 from h2o3_tpu.utils import metrics as _mx
 
 RESIDENT_BYTES = _mx.gauge(
@@ -66,11 +68,28 @@ PREFETCH_OVERLAP = _mx.counter(
     "cumulative wall seconds between issuing a chunk's host->device "
     "prefetch and the consumer requesting it — the window in which the "
     "transfer overlapped compute", always=True)
+WINDOW_PEAK = _mx.gauge(
+    "frame_window_peak_bytes",
+    "peak device bytes the most recently closed ChunkStore window held "
+    "(published at close(); the --oocore-ab acceptance number — must be "
+    "<= H2O3_TPU_HBM_WINDOW_BYTES)", always=True)
+WINDOW_EVICTIONS = _mx.counter(
+    "frame_window_evictions_total",
+    "per-store eviction counts rolled into the registry at ChunkStore "
+    "close() — the A/B-readable sum across finished streamed runs "
+    "(frame_chunk_evictions_total is the same churn counted live)",
+    always=True)
 
 
-def account(tier: str, delta_bytes: float) -> None:
-    """Adjust the two-tier residency gauge (tier = 'hbm' | 'host')."""
+def account(tier: str, delta_bytes: float,
+            owner: str = "frame_resident") -> None:
+    """Adjust the two-tier residency gauge (tier = 'hbm' | 'host') and,
+    for device bytes, the cross-plane devmem ledger under ``owner``
+    (Vec residency defaults to 'frame_resident'; the ChunkStore window
+    reports as 'frame_window')."""
     RESIDENT_BYTES.inc(float(delta_bytes), tier=tier)
+    if tier == "hbm":
+        _dm.adjust(owner, float(delta_bytes))
 
 
 def compress_on() -> bool:
@@ -101,10 +120,12 @@ def streaming_enabled() -> bool:
     return compress_on() and window_bytes() > 0
 
 
-# stats of the most recently closed ChunkStore (peak_hbm, window, n_blocks,
-# block_rows, evictions): the --oocore-ab harness and the oversized-frame
-# smoke test read the "peak device bytes bounded by the window" acceptance
-# number here, after the driver has already released the store.
+# DEPRECATED alias: stats of the most recently closed ChunkStore (peak_hbm,
+# window, n_blocks, block_rows, evictions). A bare module-global dict that
+# concurrent/overlapping stores clobber — close() now publishes the same
+# numbers through the registry (frame_window_peak_bytes gauge +
+# frame_window_evictions_total counter), which is what the A/B tools read;
+# the dict stays as a back-compat alias for existing callers/tests.
 LAST_STORE_STATS: dict = {}
 
 
@@ -195,10 +216,12 @@ class ChunkStore:
         arr = self._dev.pop(key, None)
         if arr is not None:
             self._hbm -= arr.nbytes
-            account("hbm", -arr.nbytes)
+            account("hbm", -arr.nbytes, owner="frame_window")
             if evict:
                 self.evictions += 1
                 EVICTIONS.inc()
+                _fr.record("chunk_evict", lane=key[0], block=key[1],
+                           bytes=int(arr.nbytes))
 
     def _evict_to(self, budget: int) -> None:
         for key in list(self._dev):
@@ -230,8 +253,10 @@ class ChunkStore:
                 arr = shard_rows(lane)
                 self._dev[key] = arr
                 self._hbm += arr.nbytes
-                account("hbm", arr.nbytes)
+                account("hbm", arr.nbytes, owner="frame_window")
                 self.peak_hbm = max(self.peak_hbm, self._hbm)
+                _fr.record("chunk_fetch", lane=name, block=bi,
+                           bytes=int(arr.nbytes))
             else:
                 self._dev.move_to_end(key)
             if pin:
@@ -253,13 +278,13 @@ class ChunkStore:
             old = self._dev.pop(key, None)
             if old is not None:
                 self._hbm -= old.nbytes
-                account("hbm", -old.nbytes)
+                account("hbm", -old.nbytes, owner="frame_window")
             if self.window:
                 # same pre-insert eviction as fetch: the window bounds PEAK
                 self._evict_to(max(self.window - arr.nbytes, 0))
             self._dev[key] = arr
             self._hbm += arr.nbytes
-            account("hbm", arr.nbytes)
+            account("hbm", arr.nbytes, owner="frame_window")
             self.peak_hbm = max(self.peak_hbm, self._hbm)
 
     def unpin(self, bi: int) -> None:
@@ -269,7 +294,10 @@ class ChunkStore:
         """Iterate ``(bi, {name: device_array})`` over every block with
         ``prefetch_depth`` blocks of lookahead: block k+1's upload is issued
         (pinned against eviction) before block k is yielded, so the
-        transfer rides behind block k's compute."""
+        transfer rides behind block k's compute. Each yielded block is a
+        ``stream_block`` dispatch site: the time the CONSUMER holds the
+        block (the per-block compute) lands in
+        ``dispatch_device_seconds{site=stream_block}`` and the flight ring."""
         for bi in range(self.n_blocks):
             for j in range(bi + 1, min(bi + 1 + self.depth, self.n_blocks)):
                 if j not in self._issued_at:
@@ -280,14 +308,22 @@ class ChunkStore:
                 PREFETCH_OVERLAP.inc(time.perf_counter() - t0)
             blk = self.fetch(bi, names)
             self.unpin(bi)
-            yield bi, blk
+            with _fr.dispatch("stream_block", block=bi,
+                              blocks=self.n_blocks):
+                yield bi, blk
         self._issued_at.clear()
 
     def close(self) -> None:
         """Release both tiers (gauge returns to its prior level) and
-        publish the run's stats into :data:`LAST_STORE_STATS` — the A/B
-        harness and the oversized-frame smoke test read the peak/eviction
-        numbers there after the driver is done."""
+        publish the run's stats through the REGISTRY — the
+        ``frame_window_peak_bytes`` gauge and the cumulative
+        ``frame_window_evictions_total`` counter are what the A/B harness
+        and the oversized-frame smoke test read (/3/Metrics and bench
+        artifacts agree by construction). :data:`LAST_STORE_STATS` stays
+        as the deprecated dict alias."""
+        WINDOW_PEAK.set(float(self.peak_hbm))
+        if self.evictions:
+            WINDOW_EVICTIONS.inc(float(self.evictions))
         LAST_STORE_STATS.update(
             peak_hbm=self.peak_hbm, window=self.window,
             n_blocks=self.n_blocks, block_rows=self.block_rows,

@@ -276,16 +276,25 @@ def dataframe_to_vecs(df: pd.DataFrame, column_types: Mapping[str, str]) -> list
         for j, i in enumerate(idxs):
             mat[:n, j] = specs[i][2].astype(dt, copy=False)
         dmat = shard_rows(mat)  # ONE transfer for the whole dtype group
-        for j, i in enumerate(idxs):
-            name, kind, _arr, domain, exact = specs[i]
-            vecs[i] = Vec(dmat[:, j], kind, name=name, domain=domain,
-                          nrow=n, host_exact=exact)
-            if seed_mirror:
-                # an HBM window is configured: the ingest buffer already
-                # holds the padded column, so seed the spill-tier mirror
-                # now — a streaming build's host_values() then costs
-                # nothing instead of a device pull per column
-                vecs[i]._seed_host_mirror(mat[:, j])
+        # the staging matrix is live device memory no Vec owns yet: claim
+        # it in the devmem ledger under 'parse' until the per-column
+        # slices (each its own device array) take over as frame_resident
+        from h2o3_tpu.utils import devmem as _dm
+
+        _dm.adjust("parse", dmat.nbytes)
+        try:
+            for j, i in enumerate(idxs):
+                name, kind, _arr, domain, exact = specs[i]
+                vecs[i] = Vec(dmat[:, j], kind, name=name, domain=domain,
+                              nrow=n, host_exact=exact)
+                if seed_mirror:
+                    # an HBM window is configured: the ingest buffer already
+                    # holds the padded column, so seed the spill-tier mirror
+                    # now — a streaming build's host_values() then costs
+                    # nothing instead of a device pull per column
+                    vecs[i]._seed_host_mirror(mat[:, j])
+        finally:
+            _dm.adjust("parse", -dmat.nbytes)
     return vecs
 
 

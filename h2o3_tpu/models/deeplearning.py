@@ -438,11 +438,14 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
             unravel=unravel, n_real=n_real, fpad=fpad,
         )
         _DL_DISPATCHES.inc()
-        params, opt_state, key, losses = prog(
-            params, opt_state, X, y, w, jnp.asarray(perms), key,
-            jnp.int32(nbatch), l1, l2, slot_mask,
-        )
-        losses = np.asarray(losses, np.float64)  # syncs the chunk's work
+        from h2o3_tpu.utils import flightrec as _fr
+
+        with _fr.dispatch("dl_chunk", epochs=int(k_i), rows=int(npad)):
+            params, opt_state, key, losses = prog(
+                params, opt_state, X, y, w, jnp.asarray(perms), key,
+                jnp.int32(nbatch), l1, l2, slot_mask,
+            )
+            losses = np.asarray(losses, np.float64)  # syncs the chunk's work
         _dt = _time.perf_counter() - _ep_t0
         for j in range(k_i):
             epochs_done = e + j + 1
@@ -563,12 +566,16 @@ def _run_sync_sgd_streamed(job, p, mlp, kind, tx, params, opt_state, store,
                 unravel=unravel, n_real=n_real, fpad=fpad,
             )
             _DL_DISPATCHES.inc()
-            params, opt_state, _k, losses = prog(
-                params, opt_state, blk["X"], blk["y"], blk["w"],
-                jnp.asarray(perm), jax.random.fold_in(ekey, bi),
-                jnp.int32(nbatch), l1, l2, slot,
-            )
-            loss_sum += float(np.asarray(losses)[0]) * nbatch
+            from h2o3_tpu.utils import flightrec as _fr
+
+            with _fr.dispatch("dl_chunk", block=int(bi),
+                              rows=int(blk_rows)):
+                params, opt_state, _k, losses = prog(
+                    params, opt_state, blk["X"], blk["y"], blk["w"],
+                    jnp.asarray(perm), jax.random.fold_in(ekey, bi),
+                    jnp.int32(nbatch), l1, l2, slot,
+                )
+                loss_sum += float(np.asarray(losses)[0]) * nbatch
             nb_sum += nbatch
         epochs_done = e + 1
         loss = loss_sum / max(nb_sum, 1)

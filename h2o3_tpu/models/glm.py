@@ -845,18 +845,22 @@ class GLM(ModelBuilder):
                     kmax = min(fuse_k, max_iter - iters_done)
                     _it_t0 = time.perf_counter()
                     _GLM_DISPATCHES.inc()
-                    beta_j, devp_j, ndone_j, stop_j, bad_j = prog(
-                        jnp.asarray(pad_beta(beta), jnp.float32),
-                        jnp.float32(dev_prev), X, y, w, offset,
-                        jnp.int32(kmax), jnp.float32(l1), jnp.float32(l2),
-                        jnp.float32(p.beta_epsilon),
-                        jnp.float32(p.objective_epsilon),
-                        jnp.int32(icpt if icpt is not None else -1),
-                        jnp.asarray(
-                            (np.arange(p_pad) >= P).astype(np.float32)),
-                        jnp.float32(P),
-                    )
-                    n_done = int(ndone_j)
+                    from h2o3_tpu.utils import flightrec as _fr
+
+                    with _fr.dispatch("irls_chunk", rows=int(X.shape[0]),
+                                      cols=int(p_pad), k=int(kmax)):
+                        beta_j, devp_j, ndone_j, stop_j, bad_j = prog(
+                            jnp.asarray(pad_beta(beta), jnp.float32),
+                            jnp.float32(dev_prev), X, y, w, offset,
+                            jnp.int32(kmax), jnp.float32(l1), jnp.float32(l2),
+                            jnp.float32(p.beta_epsilon),
+                            jnp.float32(p.objective_epsilon),
+                            jnp.int32(icpt if icpt is not None else -1),
+                            jnp.asarray(
+                                (np.arange(p_pad) >= P).astype(np.float32)),
+                            jnp.float32(P),
+                        )
+                        n_done = int(ndone_j)
                     stop, bad = bool(stop_j), bool(bad_j)
                     _dt = time.perf_counter() - _it_t0
                     if n_done:

@@ -139,12 +139,16 @@ def _run_counted(fn, args, mult: int = 1, sat_from=None):
     only for programs that traced a saturated region at all (deep builds
     whose per-level cost dwarfs it; GBM-typical shallow trees never pay)."""
     from h2o3_tpu.ops.collectives import collective_tally
+    from h2o3_tpu.utils import flightrec as _fr
 
     key = _PROG_KEY.get(id(fn), id(fn))
+    # the flight-recorder dispatch event: the cached-program key already
+    # carries shape bucket + mesh key + lane knobs (the jit cache key)
+    _disp = _fr.dispatch("tree", program=str(key)[:160], mult=mult)
     agg = _PROG_COLL.get(key)
     if agg is None:
         entries: list = []
-        with collective_tally(entries):
+        with _disp, collective_tally(entries):
             out = fn(*args)
         agg = {}
         for ph, lane, grp, b in entries:
@@ -152,7 +156,15 @@ def _run_counted(fn, args, mult: int = 1, sat_from=None):
             agg[k] = agg.get(k, 0.0) + b
         _PROG_COLL[key] = agg
     else:
-        out = fn(*args)
+        with _disp:
+            out = fn(*args)
+    if agg:
+        # per-dispatch collective phase tallies ride the ring too, so an
+        # incident bundle shows what the dying dispatch was reducing
+        by_phase: dict = {}
+        for (ph, _lane, _grp), b in agg.items():
+            by_phase[ph] = by_phase.get(ph, 0) + int(b)
+        _fr.record("collectives", **by_phase)
     sat_n = None
     for (ph, lane, grp), b in agg.items():
         if grp == "sat":

@@ -44,6 +44,8 @@ from h2o3_tpu.serving import (
     MODELS_RESIDENT,
     PAGE_IN_SECONDS,
 )
+from h2o3_tpu.utils import devmem as _dm
+from h2o3_tpu.utils import flightrec as _fr
 
 
 def budget_bytes() -> int:
@@ -119,6 +121,9 @@ class ResidencyManager:
         ent.dev = None
         MODELS_RESIDENT.inc(-1, tier="hbm")
         MODEL_BYTES.inc(-ent.dev_bytes, tier="hbm")
+        _dm.adjust("serving", -ent.dev_bytes)
+        _fr.record("serve_evict", model=ent.model_key, reason=kind,
+                   bytes=int(ent.dev_bytes))
         ent.dev_bytes = 0
         self.evictions += 1
         MODEL_EVICTIONS.inc(kind=kind)
@@ -163,6 +168,9 @@ class ResidencyManager:
                 ent.dev_bytes = _tree_bytes(dev)
                 MODELS_RESIDENT.inc(1, tier="hbm")
                 MODEL_BYTES.inc(ent.dev_bytes, tier="hbm")
+                _dm.adjust("serving", ent.dev_bytes)
+                _fr.record("serve_page_in", model=ent.model_key,
+                           bytes=int(ent.dev_bytes))
                 self.peak_hbm = max(self.peak_hbm, self._hbm_bytes())
             self._entries.move_to_end(sid)
             ent.in_use += 1

@@ -557,15 +557,20 @@ class BatchScorer:
 
     # -- scoring ------------------------------------------------------------
     def score_table(self, cols: dict[str, np.ndarray], n: int) -> dict:
-        t0 = time.perf_counter()
-        with self._lock:
-            if self.lane == "generic":
-                out = self._score_generic(cols, n)
-            else:
-                from h2o3_tpu.serving.residency import MANAGER
+        from h2o3_tpu.utils import flightrec as _fr
 
-                with MANAGER.hold(self) as dev:
-                    out = getattr(self, "_score_" + self.lane)(cols, n, dev)
+        t0 = time.perf_counter()
+        with _fr.dispatch("serving_batch", lane=self.lane,
+                          model=self.model_key, rows=n):
+            with self._lock:
+                if self.lane == "generic":
+                    out = self._score_generic(cols, n)
+                else:
+                    from h2o3_tpu.serving.residency import MANAGER
+
+                    with MANAGER.hold(self) as dev:
+                        out = getattr(self, "_score_" + self.lane)(
+                            cols, n, dev)
         DISPATCH_SECONDS.observe(time.perf_counter() - t0, lane=self.lane)
         return out
 

@@ -1,0 +1,286 @@
+"""Per-device HBM ledger — attribution of live device bytes to an OWNER
+(the ISSUE-13 tentpole, piece 1).
+
+Three residency planes now compete for the same HBM — the out-of-core frame
+window (``H2O3_TPU_HBM_WINDOW_BYTES``, frame/chunkstore.py), the serving
+residency LRU (``H2O3_TPU_SERVE_HBM_BYTES``, serving/residency.py) and
+XLA's own program/temp buffers — and before this module each tracked its
+own bytes in plane-local gauges that could not be cross-read. The ledger is
+the ONE place they report into, plus the ONE low-rate reader of
+``device.memory_stats()`` (``cluster/cloud.py``'s health probe routes here
+instead of probing ad hoc):
+
+- ``hbm_owned_bytes{owner}`` — live device bytes each plane claims
+  (``frame_window`` = ChunkStore LRU windows, ``frame_resident`` = Vec
+  device arrays, ``serving`` = paged scorer payloads, ``parse`` = the
+  transient ingest upload staging buffer), with a computed
+  ``owner="unattributed"`` series (device in_use − Σ owned = the XLA
+  program/temp share — the OOM-forensics number).
+- ``device_hbm_bytes{device, kind=in_use|peak|limit}`` — what the runtime
+  itself reports per local device, polled at most once per
+  ``H2O3_TPU_DEVMEM_POLL_SECS`` (the CPU proxy's devices return
+  ``memory_stats() = None``: the per-owner ledger still works, the
+  device series and the unattributed split just stay absent).
+- ``hbm_headroom_bytes`` — Σ limit − Σ in_use across local devices: the
+  number the ChunkStore/Residency planes can consult (:func:`headroom`)
+  instead of trusting their static budgets.
+
+High-water marks are sampled at dispatch boundaries: every
+``flightrec.dispatch(...)`` site calls :func:`on_dispatch`, which refreshes
+the rate-limited poll — so the per-owner peaks (exact, updated on every
+``adjust``) and the device peaks (``memory_stats()['peak_bytes_in_use']``)
+line up with the program dispatches the flight-recorder ring records.
+
+The ledger is ALWAYS on (``always=True`` gauges): the planes' budget
+decisions and the incident bundles read it, so ``H2O3_TPU_METRICS=0``
+must not blind it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from h2o3_tpu.utils import metrics as _mx
+
+#: the registered residency planes (docs/OBSERVABILITY.md has the rows);
+#: "unattributed" is computed, never adjusted directly
+OWNERS = ("frame_window", "frame_resident", "serving", "parse")
+
+_DEVICE_HBM = _mx.gauge(
+    "device_hbm_bytes",
+    "per-local-device HBM as the runtime reports it (memory_stats), by "
+    "kind: in_use = bytes_in_use, peak = peak_bytes_in_use, limit = "
+    "bytes_limit; absent on backends whose devices report no stats "
+    "(the CPU proxy)", always=True)
+_OWNED = _mx.gauge(
+    "hbm_owned_bytes",
+    "live device bytes attributed to an owning residency plane "
+    "(frame_window = out-of-core chunk windows, frame_resident = Vec "
+    "device arrays, serving = paged scorer payloads, parse = ingest "
+    "upload staging); owner=unattributed is computed at poll time as "
+    "device in_use - sum(owned) — the XLA program/temp share", always=True)
+_HEADROOM = _mx.gauge(
+    "hbm_headroom_bytes",
+    "sum(limit) - sum(in_use) across local devices at the last poll — the "
+    "measured budget the residency planes can consult instead of their "
+    "static byte knobs (0 while the backend reports no stats)", always=True)
+
+_LOCK = threading.Lock()
+_owned: dict[str, float] = {}
+_peak: dict[str, float] = {}
+_last_poll = 0.0            # monotonic stamp of the last real stats read
+_poll_lock = threading.Lock()
+_devices: list[dict] = []   # cached per-device stats (the ONE-reader cache)
+_in_use_total: float | None = None
+_limit_total: float | None = None
+_unattributed: float | None = None
+
+
+def poll_period() -> float:
+    """H2O3_TPU_DEVMEM_POLL_SECS — the memory_stats read rate bound."""
+    from h2o3_tpu import config
+
+    try:
+        return max(config.get_float("H2O3_TPU_DEVMEM_POLL_SECS"), 0.05)
+    except (TypeError, ValueError):
+        return 5.0
+
+
+def _stats_fn(device) -> dict | None:
+    """The one memory_stats call site (tests monkeypatch this to inject
+    synthetic in_use/limit on the CPU proxy, whose devices return None)."""
+    if not hasattr(device, "memory_stats"):
+        return None
+    return device.memory_stats()
+
+
+# -- the owner ledger --------------------------------------------------------
+
+def adjust(owner: str, delta: float) -> None:
+    """A residency plane claiming (+) or returning (−) live device bytes.
+    Per-owner peaks update here — exact high-water, not poll-sampled."""
+    if not delta:
+        return
+    with _LOCK:
+        v = _owned.get(owner, 0.0) + float(delta)
+        _owned[owner] = v
+        if v > _peak.get(owner, 0.0):
+            _peak[owner] = v
+    _OWNED.set(v, owner=owner)
+
+
+def owned() -> dict[str, float]:
+    """Current per-owner claims (a copy)."""
+    with _LOCK:
+        return dict(_owned)
+
+
+def peaks() -> dict[str, float]:
+    """Per-owner high-water marks since process start / :func:`reset_peaks`."""
+    with _LOCK:
+        return dict(_peak)
+
+
+def reset_peaks() -> dict[str, float]:
+    """Re-arm the per-owner high-water marks (bench phase isolation);
+    returns the pre-reset peaks."""
+    with _LOCK:
+        snap = dict(_peak)
+        for k, v in _owned.items():
+            _peak[k] = max(v, 0.0)
+    return snap
+
+
+# -- the device poller (the ONE memory_stats reader) -------------------------
+
+def poll(force: bool = False) -> list[dict]:
+    """Read every local device's ``memory_stats()`` — rate-limited to one
+    real read per :func:`poll_period` unless ``force`` — publish the
+    ``device_hbm_bytes``/``hbm_headroom_bytes`` gauges and the computed
+    ``unattributed`` owner series, and return the per-device list
+    (cluster/cloud.py builds its ``/3/Cloud`` node table from this)."""
+    global _last_poll, _devices, _in_use_total, _limit_total, _unattributed
+
+    now = time.monotonic()
+    if not force and _devices and now - _last_poll < poll_period():
+        return list(_devices)
+    with _poll_lock:
+        now = time.monotonic()
+        if not force and _devices and now - _last_poll < poll_period():
+            return list(_devices)
+        import jax
+
+        devs: list[dict] = []
+        in_use = limit = 0.0
+        any_stats = False
+        for d in jax.local_devices():
+            node = {"id": d.id, "platform": d.platform,
+                    "process": getattr(d, "process_index", 0), "error": None}
+            try:
+                stats = _stats_fn(d)
+            except Exception as e:  # noqa: BLE001 — the probe must not throw
+                stats = None
+                node["error"] = repr(e)[:200]
+            if stats:
+                any_stats = True
+                for kind, skey in (("in_use", "bytes_in_use"),
+                                   ("peak", "peak_bytes_in_use"),
+                                   ("limit", "bytes_limit")):
+                    v = stats.get(skey)
+                    if v is not None:
+                        node[kind] = int(v)
+                        _DEVICE_HBM.set(float(v), device=str(d.id), kind=kind)
+                in_use += float(stats.get("bytes_in_use") or 0)
+                limit += float(stats.get("bytes_limit") or 0)
+            devs.append(node)
+        _devices = devs
+        _last_poll = time.monotonic()
+        if any_stats:
+            _in_use_total = in_use
+            _limit_total = limit if limit else None
+            owned_total = sum(owned().values())
+            # the OOM-forensics number: what the runtime holds that no
+            # plane claims = XLA program/temp buffers (+ poll jitter)
+            _unattributed = max(in_use - owned_total, 0.0)
+            _OWNED.set(_unattributed, owner="unattributed")
+            if _limit_total:
+                _HEADROOM.set(max(_limit_total - in_use, 0.0))
+        return list(devs)
+
+
+def device_stats(force: bool = False) -> list[dict]:
+    """The cached per-device list (≤ one poll period old) — the single
+    entry point every health/diagnostic reader goes through."""
+    return poll(force=force)
+
+
+def headroom() -> float | None:
+    """Measured Σ limit − Σ in_use at the last poll, or None while the
+    backend reports no stats — what a residency plane consults before
+    trusting its static byte budget."""
+    poll()
+    with _poll_lock:
+        if _limit_total is None or _in_use_total is None:
+            return None
+        return max(_limit_total - _in_use_total, 0.0)
+
+
+def on_dispatch() -> None:
+    """Dispatch-boundary sampling hook (called by every
+    ``flightrec.dispatch`` site): refresh the rate-limited poll so device
+    high-water marks land at program boundaries. O(ns) between polls —
+    one monotonic read and a compare."""
+    if time.monotonic() - _last_poll >= poll_period():
+        try:
+            poll()
+        except Exception:  # noqa: BLE001 — telemetry must never sink a dispatch
+            pass
+
+
+def status() -> dict:
+    """One attribution snapshot — the ``/3/FlightRecorder`` devmem block,
+    the incident-bundle devmem section, and ``tpu_mem_analysis --live``'s
+    table source."""
+    with _LOCK:
+        own, pk = dict(_owned), dict(_peak)
+    return {
+        "owned_bytes": {k: int(v) for k, v in own.items()},
+        "peak_owned_bytes": {k: int(v) for k, v in pk.items()},
+        "owned_total_bytes": int(sum(own.values())),
+        "in_use_bytes": None if _in_use_total is None else int(_in_use_total),
+        "limit_bytes": None if _limit_total is None else int(_limit_total),
+        "unattributed_bytes": (
+            None if _unattributed is None else int(_unattributed)),
+        "headroom_bytes": (
+            None if (_limit_total is None or _in_use_total is None)
+            else int(max(_limit_total - _in_use_total, 0.0))),
+        "devices": list(_devices),
+    }
+
+
+# -- background poller (idle servers still publish fresh series) -------------
+
+_POLLER: threading.Thread | None = None
+_POLL_STOP = threading.Event()
+
+
+def _poll_loop() -> None:
+    while not _POLL_STOP.wait(poll_period()):
+        try:
+            poll()
+        except Exception:  # noqa: BLE001 — the poller must never die loud
+            pass
+
+
+def install() -> None:
+    """Start the low-rate background poller (idempotent; daemon). The REST
+    coordinator installs it at start_server so an IDLE server's device
+    series stay fresh — busy processes refresh through on_dispatch."""
+    global _POLLER
+    if _POLLER is not None and _POLLER.is_alive():
+        return
+    _POLL_STOP.clear()
+    _POLLER = threading.Thread(
+        target=_poll_loop, name="h2o3-devmem", daemon=True)
+    _POLLER.start()
+
+
+def uninstall() -> None:
+    """Stop the background poller (tests)."""
+    global _POLLER
+    _POLL_STOP.set()
+    if _POLLER is not None:
+        _POLLER.join(timeout=5)
+    _POLLER = None
+
+
+def _reset_for_tests() -> None:
+    global _last_poll, _devices, _in_use_total, _limit_total, _unattributed
+    with _LOCK:
+        _owned.clear()
+        _peak.clear()
+    with _poll_lock:
+        _last_poll = 0.0
+        _devices = []
+        _in_use_total = _limit_total = _unattributed = None

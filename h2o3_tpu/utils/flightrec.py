@@ -1,0 +1,236 @@
+"""Incident flight recorder — an always-on bounded ring of structured
+events + automatic incident bundles (the ISSUE-13 tentpole, pieces 2–3).
+
+The PR-10 self-healing cloud retries past failures but used to discard
+exactly the evidence a postmortem needs: what the dead generation was
+dispatching when the latch tripped. This module keeps the last
+``H2O3_TPU_FLIGHTREC_SIZE`` events in a preallocated ring whose append is
+O(µs) and lock-free (one atomic counter bump + one list-slot store — safe
+under the GIL; readers snapshot and sort by sequence number), so it runs in
+EVERY process all the time, including ``H2O3_TPU_METRICS=0``:
+
+- program dispatch start/end with program key + shape bucket + mesh key
+  (the cached-program key carries all three) via :func:`dispatch`, which
+  also feeds the ``dispatch_device_seconds{site}`` histogram — measured
+  device-time attribution per hot site (tree chunk, IRLS chunk, DL chunk,
+  serving batch, stream block), cross-referenceable by timestamp with
+  ``tools/profile_train_stages.py`` and the ``jax.profiler`` wrapper
+  (utils/telemetry.py stamps ``profiler`` events into the same ring);
+- collective phase tallies (per-dispatch byte totals, models/tree);
+- stream-block fetch/evict (frame/chunkstore.py), serving
+  page-in/eviction (serving/residency.py);
+- generation ticks, degraded latches, watchdog trips (cluster/*).
+
+**Incident bundles**: :func:`capture_incident` freezes the evidence — ring
+dump + metrics registry snapshot + devmem attribution state + the log tail
+— into one JSON file written atomically through persist (temp-file +
+``os.replace``; survives a crash mid-write) under
+``H2O3_TPU_INCIDENT_DIR``. ``cloud.mark_degraded`` captures at the latch
+(the watchdog/death-signature instant — the ring still holds the dying
+dispatch), ``recovery.reform`` captures before any reform/retry, and the
+supervised-restart loop surfaces the bundle path in the job's recovery
+block. Captures dedup per degraded episode (same cloud generation within
+:data:`_DEDUP_SECS`) so a failure storm writes one bundle, not hundreds.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+
+from h2o3_tpu import config as _config
+from h2o3_tpu.utils import metrics as _mx
+
+_DISPATCH_SECONDS = _mx.histogram(
+    "dispatch_device_seconds",
+    "wall seconds inside hot device-dispatch sites, by site (tree = fused "
+    "tree/level programs, irls_chunk = fused GLM chunk, dl_chunk = DL "
+    "epoch-chunk program, serving_batch = batched scorer dispatch, "
+    "stream_block = out-of-core per-block compute). Host wall of the "
+    "dispatch call: on the synchronous proxy/tunnel paths this IS device "
+    "time; async residue attributes to the site that syncs")
+_INCIDENTS = _mx.counter(
+    "incident_bundles_total",
+    "incident bundles written (ring dump + metrics + devmem + log tail), "
+    "by trigger", always=True)
+
+# ring size is read ONCE at import (like H2O3_TPU_METRICS): the append is
+# the hot path and must not re-read the environment. 0 disables the ring.
+try:
+    _SIZE = max(int(_config.get("H2O3_TPU_FLIGHTREC_SIZE")), 0)
+except (TypeError, ValueError):
+    _SIZE = 4096
+
+_RING: list = [None] * _SIZE
+_SEQ = itertools.count()
+_last_seq = -1  # advisory high-water for status(); exact value via events()
+
+
+def record(kind: str, **fields) -> None:
+    """Append one structured event. O(µs), no locks: one atomic counter
+    bump + one slot store (field values should be JSON-safe scalars)."""
+    global _last_seq
+    if not _SIZE:
+        return
+    i = next(_SEQ)
+    _RING[i % _SIZE] = (i, time.time(), kind, fields)
+    _last_seq = i
+
+
+def events(n: int | None = None, kind: str | None = None) -> list[dict]:
+    """Snapshot of the ring, oldest→newest (sorted by sequence number;
+    torn slots from concurrent appends simply reflect whichever event won
+    the slot). ``kind`` filters; ``n`` keeps the newest n."""
+    snap = [e for e in list(_RING) if e is not None]
+    snap.sort(key=lambda e: e[0])
+    out = [
+        {"seq": s, "ts": ts, "kind": k, **f}
+        for s, ts, k, f in snap
+        if kind is None or k == kind
+    ]
+    return out[-n:] if n else out
+
+
+def ring_status() -> dict:
+    nxt = _last_seq + 1
+    return {
+        "size": _SIZE,
+        "next_seq": nxt,
+        "dropped": max(nxt - _SIZE, 0),
+    }
+
+
+def reset() -> None:
+    """Drop every recorded event (tests). Sequence numbers keep counting
+    so ordering stays monotonic across a reset."""
+    for i in range(_SIZE):
+        _RING[i] = None
+
+
+# -- per-dispatch device-time attribution ------------------------------------
+
+class _Dispatch:
+    """Context manager stamping dispatch start/end events into the ring and
+    feeding ``dispatch_device_seconds{site}``. A class, not a
+    @contextmanager: the hot sites enter/exit this once per device program
+    and the generator machinery is measurably slower."""
+
+    __slots__ = ("site", "meta", "_t0")
+
+    def __init__(self, site: str, meta: dict):
+        self.site = site
+        self.meta = meta
+
+    def __enter__(self):
+        record("dispatch_start", site=self.site, **self.meta)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        record("dispatch_end", site=self.site,
+               dur_ms=round(dur * 1e3, 3),
+               **({"error": exc_type.__name__} if exc_type else {}))
+        _DISPATCH_SECONDS.observe(dur, site=self.site)
+        from h2o3_tpu.utils import devmem
+
+        devmem.on_dispatch()  # high-water marks sample at dispatch boundaries
+        return False
+
+
+def dispatch(site: str, **meta) -> _Dispatch:
+    """Wrap one hot device dispatch: ``with flightrec.dispatch("tree",
+    program=key): out = fn(*args)``. Meta lands in the ring only (free-form
+    — program keys, block indices), never as metric labels."""
+    return _Dispatch(site, meta)
+
+
+# -- incident bundles --------------------------------------------------------
+
+_DEDUP_SECS = 30.0
+_CAP_LOCK = threading.Lock()
+_last_bundle: tuple[float, int, str] | None = None  # (monotonic, gen, path)
+
+
+def incident_dir() -> str:
+    """H2O3_TPU_INCIDENT_DIR ('' = <tmp>/h2o3_incidents)."""
+    d = _config.get("H2O3_TPU_INCIDENT_DIR").strip()
+    return d or os.path.join(tempfile.gettempdir(), "h2o3_incidents")
+
+
+def last_incident() -> str | None:
+    """Path of the most recently written bundle (None before the first)."""
+    return _last_bundle[2] if _last_bundle else None
+
+
+def capture_incident(reason: str, trigger: str = "degraded",
+                     extra: dict | None = None) -> str | None:
+    """Freeze the evidence for a postmortem: ring dump + metrics registry
+    snapshot + devmem attribution + log tail, written atomically through
+    persist BEFORE any reform/retry discards the dying state. Returns the
+    bundle path (the cached one when this degraded episode — same cloud
+    generation within the dedup window — already captured), or None when
+    capture itself fails (never raises: this runs on failure paths)."""
+    global _last_bundle
+    try:
+        from h2o3_tpu.cluster import cloud
+
+        gen = cloud.generation()
+    except Exception:  # noqa: BLE001 — capture must work before cloud init
+        gen = -1
+    with _CAP_LOCK:
+        if (_last_bundle is not None and _last_bundle[1] == gen
+                and time.monotonic() - _last_bundle[0] < _DEDUP_SECS):
+            return _last_bundle[2]
+        try:
+            from h2o3_tpu import persist
+            from h2o3_tpu.utils import devmem
+            from h2o3_tpu.utils.log import Log
+
+            bundle = {
+                "schema": "h2o3_incident/1",
+                "ts": time.time(),
+                "reason": str(reason)[:2000],
+                "trigger": trigger,
+                "generation": gen,
+                "ring": ring_status(),
+                "events": events(),
+                "devmem": devmem.status(),
+                "metrics": _mx.REGISTRY.compact_snapshot(),
+                "log_tail": Log.tail(200),
+                **(extra or {}),
+            }
+            stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            path = os.path.join(
+                incident_dir(),
+                f"incident_{stamp}_gen{gen}_{os.getpid()}.json")
+            d = os.path.dirname(path)
+            if d and "://" not in path:
+                os.makedirs(d, exist_ok=True)
+            persist.write_bytes(
+                json.dumps(bundle, default=str).encode(), path)
+            _last_bundle = (time.monotonic(), gen, path)
+            _INCIDENTS.inc(trigger=trigger)
+            record("incident", path=path, trigger=trigger,
+                   reason=str(reason)[:200])
+            Log.warn(f"incident bundle written: {path} ({trigger}: "
+                     f"{str(reason)[:120]})")
+            return path
+        except Exception as e:  # noqa: BLE001 — never raise on a failure path
+            try:
+                from h2o3_tpu.utils.log import Log
+
+                Log.warn(f"incident bundle capture failed: {e!r}")
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+            return None
+
+
+def _reset_incidents_for_tests() -> None:
+    global _last_bundle
+    with _CAP_LOCK:
+        _last_bundle = None

@@ -63,13 +63,20 @@ def install() -> None:
 
 @contextlib.contextmanager
 def profiler(logdir: str):
-    """``jax.profiler.trace`` wrapper — xplane dumps for TensorBoard/XProf."""
+    """``jax.profiler.trace`` wrapper — xplane dumps for TensorBoard/XProf.
+    Start/end also stamp the flight-recorder ring (utils/flightrec.py), so
+    an xplane capture window cross-references with the dispatch events by
+    timestamp — which programs the profiler saw is readable from the ring."""
     import jax
 
+    from h2o3_tpu.utils import flightrec
+
     record("profiler", f"trace started → {logdir}")
+    flightrec.record("profiler_start", logdir=logdir)
     with jax.profiler.trace(logdir):
         yield
     record("profiler", f"trace written → {logdir}")
+    flightrec.record("profiler_end", logdir=logdir)
 
 
 def timeline(n: int = 200) -> dict:

@@ -514,9 +514,12 @@ def oocore_ab(rows: int = 120_000, cols: int = 12) -> None:
         t0 = _time.perf_counter()
         m = GBM(**kw).train(y="label", training_frame=fr)
         dt = _time.perf_counter() - t0
+        # the window stats now come from the REGISTRY (ChunkStore.close
+        # publishes frame_window_peak_bytes there — same numbers
+        # /3/Metrics serves); the dict stays as the geometry alias
         stats = dict(cs.LAST_STORE_STATS)
         streamed = bool(stats.get("n_blocks", 0) > 1)
-        peak = (stats.get("peak_hbm")
+        peak = (mx.counter_value("frame_window_peak_bytes")
                 if streamed else npad * bytes_per_row)
         rec = {
             "phase": "oocore_ab", "mode": mode,

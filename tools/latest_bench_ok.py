@@ -378,6 +378,32 @@ def main() -> int:
                     )
                     if not sane:
                         headline_ok = False
+                # devmem attribution block (ISSUE 13) is OPTIONAL — older
+                # artifacts predate the ledger — but when present every
+                # per-owner byte count must be a finite non-negative int
+                # and each peak must be >= its live value, or the HBM
+                # attribution the TPU-window A/Bs rely on is garbage
+                if "devmem" in d:
+                    dv = d["devmem"]
+                    sane = isinstance(dv, dict)
+                    if sane:
+                        own = dv.get("owned_bytes", {})
+                        pk = dv.get("peak_owned_bytes", {})
+                        try:
+                            for o, v in {**own, **pk}.items():
+                                v = float(v)
+                                if not (v >= 0 and v == v
+                                        and v != float("inf")):
+                                    sane = False
+                            for o, v in own.items():
+                                if float(pk.get(o, v)) < float(v):
+                                    sane = False
+                        except (TypeError, ValueError):
+                            sane = False
+                    psum_note += (" devmem=ok" if sane
+                                  else " devmem=INSANE")
+                    if not sane:
+                        headline_ok = False
         except OSError as e:  # vanished/unreadable between glob and open
             note = f" (unreadable: {e.strerror or e})"
         except Exception as e:  # torn/empty/garbage JSON is a MISSING, not a crash

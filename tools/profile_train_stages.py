@@ -36,7 +36,15 @@ def _wrap(mod, name, label):
             )
         except Exception:
             pass
-        STAGES[label] = STAGES.get(label, 0.0) + time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        STAGES[label] = STAGES.get(label, 0.0) + dt
+        try:  # stamp the flight ring so stage walls cross-reference the
+            # dispatch_device_seconds events by timestamp (ISSUE 13)
+            from h2o3_tpu.utils import flightrec
+
+            flightrec.record("stage", stage=label, dur_ms=round(dt * 1e3, 3))
+        except Exception:
+            pass
         return out
 
     setattr(mod, name, timed)

@@ -181,3 +181,44 @@ timeout 1800 python tools/load_test.py --fleet --models 16 --oversub 10 \
   --qps 25,50,100,200,400,800 --duration 6 \
   --out "FLEET_${stamp}.json" | tail -1 > /dev/null
 save "FLEET_${stamp}.json" "Fleet serving A/B: 10x HBM oversubscription vs all-resident"
+
+# HBM attribution + flight-recorder capture (ISSUE 13): re-run the
+# headline GBM config under a jax.profiler xplane trace with the devmem
+# ledger polling real memory_stats, then dump the dispatch ring + the
+# per-owner attribution table. This is the first window that lands
+# MEASURED device-byte/device-time artifacts (not the CPU-proxy's modeled
+# numbers): the xplane dump cross-references the ring by timestamp
+# (profiler_start/profiler_end events bracket the capture), and the
+# unattributed series is the XLA program/temp share the 10M-row OOM
+# forensics needs. The stage attribution (profile_train_stages) rides
+# along so dispatch_device_seconds{site} can be sanity-checked against
+# wrapped-stage wall time.
+timeout 1200 python - "FLIGHTREC_${stamp}.json" << 'PYEOF'
+import json, sys
+import bench
+import h2o3_tpu
+from h2o3_tpu.utils import devmem, flightrec, telemetry
+
+h2o3_tpu.init(log_level="WARN")
+fr = h2o3_tpu.upload_file(bench.make_data())
+from h2o3_tpu.models.tree import GBM
+kw = dict(ntrees=20, max_depth=6, learn_rate=0.1, min_rows=10.0,
+          score_tree_interval=1000, seed=42)
+GBM(**kw).train(y="label", training_frame=fr)  # warm compile
+devmem.reset_peaks()
+with telemetry.profiler("/tmp/h2o3_xplane"):
+    GBM(**kw).train(y="label", training_frame=fr)
+devmem.poll(force=True)
+out = {"phase": "flightrec_capture", "devmem": devmem.status(),
+       "ring": flightrec.ring_status(),
+       "events": flightrec.events(),
+       "xplane_dir": "/tmp/h2o3_xplane"}
+with open(sys.argv[1], "w") as f:
+    json.dump(out, f)
+print("flightrec capture:", out["ring"], flush=True)
+PYEOF
+save "FLIGHTREC_${stamp}.json" "HBM attribution + flight-recorder capture under a profiler trace"
+
+timeout 900 python tools/profile_train_stages.py \
+  | tee "STAGES_${stamp}.json"
+save "STAGES_${stamp}.json" "Stage wall-time attribution (cross-check for dispatch_device_seconds)"

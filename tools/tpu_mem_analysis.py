@@ -21,6 +21,14 @@ Usage (tunnel up): python tools/tpu_mem_analysis.py [--train]
           # before/after compression, and the streamed geometry that makes
           # Higgs-1B trainable through a fixed window. Pure host math —
           # runs anywhere, artifact committed alongside the PR.
+       python tools/tpu_mem_analysis.py --live [URL]
+          # read the devmem ledger + flight-recorder ring from a RUNNING
+          # server (GET /3/Metrics?format=json + /3/FlightRecorder,
+          # default http://127.0.0.1:54321) and print the measured
+          # attribution table — per-owner live/peak bytes, per-device
+          # in_use/limit, the unattributed (XLA program/temp) share —
+          # next to the static capacity model, flagging an unattributed
+          # share > 25% of in_use (the OOM-forensics threshold).
 """
 
 from __future__ import annotations
@@ -91,6 +99,67 @@ def oocore_model(out_path: str | None = None) -> dict:
     if out_path:
         with open(out_path, "w") as f:
             json.dump(out, f, indent=1)
+    return out
+
+
+def live_attribution(url: str = "http://127.0.0.1:54321") -> dict:
+    """The measured twin of :func:`oocore_model`: pull the devmem ledger
+    and the flight-recorder ring off a running server and print the
+    attribution table. Returns the combined dict (and exits nonzero from
+    __main__ when the unattributed share exceeds 25% — that much
+    unclaimed HBM means XLA temps/programs, not the residency planes,
+    are what an OOM investigation should chase)."""
+    import json
+    import urllib.request
+
+    def _get(path):
+        with urllib.request.urlopen(url.rstrip("/") + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    fr = _get("/3/FlightRecorder?n=64")
+    dm = fr.get("devmem", {})
+    owned = dm.get("owned_bytes", {})
+    peaks = dm.get("peak_owned_bytes", {})
+    in_use = dm.get("in_use_bytes")
+    unattr = dm.get("unattributed_bytes")
+
+    print(f"== live HBM attribution ({url}) ==")
+    print(f"{'owner':16s} {'live_bytes':>14s} {'peak_bytes':>14s}")
+    for owner in sorted(set(owned) | set(peaks)):
+        print(f"{owner:16s} {owned.get(owner, 0):>14,} "
+              f"{peaks.get(owner, 0):>14,}")
+    print(f"{'TOTAL owned':16s} {sum(owned.values()):>14,}")
+    if in_use is not None:
+        share = (unattr or 0) / max(in_use, 1)
+        print(f"{'device in_use':16s} {in_use:>14,}")
+        print(f"{'unattributed':16s} {unattr or 0:>14,}  "
+              f"({share:.0%} of in_use — XLA program/temp share)")
+        if share > 0.25:
+            print("FLAG: unattributed share > 25% — the residency planes "
+                  "are not what is eating HBM; dump the flight ring and "
+                  "check compiled-program temps (memory_analysis)")
+    else:
+        print("device in_use: unavailable (backend reports no "
+              "memory_stats — CPU proxy); per-owner ledger only")
+    for d in dm.get("devices", []):
+        if "in_use" in d or d.get("error"):
+            print(f"  device {d['id']}: in_use={d.get('in_use')} "
+                  f"limit={d.get('limit')} peak={d.get('peak')} "
+                  f"err={d.get('error')}")
+    ring = fr.get("ring", {})
+    print(f"flight ring: {ring.get('next_seq', 0)} events recorded, "
+          f"size {ring.get('size')}, last incident: "
+          f"{fr.get('last_incident')}")
+    for ev in fr.get("events", [])[-8:]:
+        print(f"  [{ev['seq']}] {ev['kind']}: "
+              + ", ".join(f"{k}={v}" for k, v in ev.items()
+                          if k not in ("seq", "ts", "kind")))
+    print()
+    print("== static capacity model (for comparison) ==")
+    model = oocore_model(None)
+    out = {"live": dm, "ring": ring, "static_model": model}
+    out["unattributed_flag"] = bool(
+        in_use is not None and (unattr or 0) / max(in_use, 1) > 0.25)
     return out
 
 
@@ -176,7 +245,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--oocore" in sys.argv:
+    if "--live" in sys.argv:
+        i = sys.argv.index("--live")
+        url = (sys.argv[i + 1] if i + 1 < len(sys.argv)
+               and not sys.argv[i + 1].startswith("--")
+               else "http://127.0.0.1:54321")
+        res = live_attribution(url)
+        sys.exit(1 if res.get("unattributed_flag") else 0)
+    elif "--oocore" in sys.argv:
         out = None
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]
