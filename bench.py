@@ -690,13 +690,18 @@ def _collective_microbench(n_nodes=64, n_bins=128, iters=10) -> dict | None:
     from h2o3_tpu.models.tree.shared_tree import _COLL_SECONDS, _split_shard_on
     from h2o3_tpu.ops import collectives
     from h2o3_tpu.parallel.mesh import (
-        ROWS_AXIS, get_mesh, pad_cols_to_shards, pad_flat_to_shards,
-        shard_map)
+        col_axis_name, get_mesh, n_col_shards, pad_cols_to_shards,
+        pad_flat_to_shards, shard_map)
 
     mesh = get_mesh()
-    n_dev = mesh.shape[ROWS_AXIS]
+    n_dev = int(mesh.devices.size)
     if n_dev <= 1:
         return None
+    # scattered results shard over the COLUMN-BLOCK axis (the whole 1-D
+    # mesh, or the cols axis of a 2-D pod mesh — the wrappers run their
+    # exact rows-axis stage internally either way)
+    cax = col_axis_name(mesh)
+    n_blk = n_col_shards(mesh)
     Cp = pad_cols_to_shards(N_COLS, mesh)
     hist = jnp.ones((Cp, n_nodes * n_bins, 3), jnp.float32)  # one local hist
     win = jnp.ones((n_nodes, 14), jnp.float32)  # ~the winner tuple payload
@@ -721,21 +726,21 @@ def _collective_microbench(n_nodes=64, n_bins=128, iters=10) -> dict | None:
         lambda v: collectives.psum(v, n_dev=n_dev, lane_axis=-1), P()), hist)
     rs_s = timed(sm(
         lambda v: collectives.psum_scatter(v, n_dev=n_dev, lane_axis=-1),
-        P(ROWS_AXIS)), hist)
-    wg_s = timed(sm(lambda v: jax.lax.all_gather(v, ROWS_AXIS), P()), win)
+        P(cax)), hist)
+    wg_s = timed(sm(lambda v: jax.lax.all_gather(v, cax), P()), win)
     gr_s = timed(sm(
         lambda v: collectives.psum_scatter(v, n_dev=n_dev, passes=2),
-        P(ROWS_AXIS)), gram)
+        P(cax)), gram)
     gg_s = timed(sm(
         lambda v: jax.lax.all_gather(
-            v, ROWS_AXIS, axis=0, tiled=True), P()),
-        gram.reshape(n_dev, -1)[0])
+            v, cax, axis=0, tiled=True), P()),
+        gram.reshape(n_blk, -1)[0])
     dg_s = timed(sm(
         lambda v: collectives.psum_scatter(v, n_dev=n_dev, passes=2),
-        P(ROWS_AXIS)), grad)
+        P(cax)), grad)
     pg_s = timed(sm(
-        lambda v: jax.lax.all_gather(v, ROWS_AXIS, axis=0, tiled=True),
-        P()), grad.reshape(n_dev, -1)[0])
+        lambda v: jax.lax.all_gather(v, cax, axis=0, tiled=True),
+        P()), grad.reshape(n_blk, -1)[0])
     sharded = _split_shard_on()
     _COLL_SECONDS.inc(rs_s if sharded else ar_s, phase="hist_reduce")
     if sharded:

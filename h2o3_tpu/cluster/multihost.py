@@ -1,0 +1,288 @@
+"""Multihost pod runtime — the ``h2odriver``/``h2o-k8s`` bootstrap proper
+(ISSUE 14 tentpole; SURVEY.md §2.3 launchers row).
+
+``cluster/cloud.py`` owns the low-level ``jax.distributed.initialize`` call;
+this module is the POD-SHAPED layer above it:
+
+- :func:`pod_env` resolves the bootstrap triple (coordinator address,
+  process count, process id) from environment knobs the k8s StatefulSet
+  sets (``H2O3_TPU_COORDINATOR`` / ``H2O3_TPU_NUM_PROCESSES`` /
+  ``H2O3_TPU_PROCESS_ID``), deriving the rank from the trailing pod
+  ordinal (``pod-name-N``, the StatefulSet convention) when no explicit id
+  is given — so the SAME container command works on every replica.
+- :func:`bootstrap` runs env/args → ``cloud.init`` (distributed init,
+  2-D mesh formation per ``H2O3_TPU_MESH_ROWS``) → :func:`formation`: a
+  cross-process barrier plus per-host device enumeration — the
+  ``water.Paxos`` cloud-lock analog: after it returns, every rank has
+  agreed on the member list and the mesh shape, and the formation record
+  lands in the flight recorder.
+- :func:`probe_capability` is the runtime sibling of the PR-4 test probe:
+  one bounded REAL cross-process collective, cached, so callers (and the
+  two-process test fixture) can distinguish "this jaxlib refuses
+  cross-process CPU collectives" from genuine cloud failures.
+- :func:`install_pod_restart` closes the recovery loop on a REAL pod: the
+  JAX runtime cannot re-initialize in-process, so a dead member leaves
+  every surviving rank holding only the PR-10 survivor island. Under
+  ``H2O3_TPU_POD_EXIT_DEGRADED=N`` a multi-process rank whose degraded
+  latch persists N seconds EXITS (code 23); on k8s the restartPolicy
+  brings every rank back, the cloud re-forms through this bootstrap, and
+  the PR-10 supervisor resumes from the latest interval snapshot —
+  ``recovery_seconds`` lands in the flight recorder and metrics
+  (docs/RECOVERY.md "Pod restart").
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+
+from h2o3_tpu.utils.log import Log
+
+#: exit code of the pod-restart path — distinct from crashes so operators
+#: (and k8s events) can tell "deliberate restart-to-reform" from a bug
+POD_RESTART_EXIT_CODE = 23
+
+
+def pod_env() -> dict | None:
+    """The env-driven bootstrap triple, or None when no coordinator is
+    configured (single-host mode). Raises on a half-configured pod — a
+    rank that silently boots single-host would hang the others at init."""
+    from h2o3_tpu import config
+
+    coordinator = config.get("H2O3_TPU_COORDINATOR").strip()
+    if not coordinator:
+        return None
+    num = config.get_int("H2O3_TPU_NUM_PROCESSES")
+    if num <= 0:
+        raise ValueError(
+            "H2O3_TPU_COORDINATOR is set but H2O3_TPU_NUM_PROCESSES is not "
+            "— set it to the StatefulSet replica count")
+    pid_raw = config.get("H2O3_TPU_PROCESS_ID").strip()
+    if pid_raw:
+        pid = int(pid_raw)
+    else:
+        pid = _ordinal_from_pod_name()
+        if pid is None:
+            raise ValueError(
+                "H2O3_TPU_PROCESS_ID is unset and no trailing ordinal was "
+                "found in H2O3_TPU_POD_NAME/POD_NAME/HOSTNAME — set one "
+                "(the k8s StatefulSet convention is pod-name-N)")
+    if not 0 <= pid < num:
+        raise ValueError(
+            f"process id {pid} out of range for {num} processes")
+    return {"coordinator": coordinator, "num_processes": num,
+            "process_id": pid}
+
+
+def _ordinal_from_pod_name() -> int | None:
+    """Trailing integer of the pod/host name — the StatefulSet ordinal."""
+    for var in ("H2O3_TPU_POD_NAME", "POD_NAME", "HOSTNAME"):
+        name = os.environ.get(var, "")
+        m = re.search(r"-(\d+)$", name.strip())
+        if m:
+            return int(m.group(1))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# capability probe (the PR-4 auto-skip probe, runtime form)
+
+_CAPABILITY: str | None = None  # None = not probed; "" = capable
+
+
+def probe_capability(timeout: float = 30.0) -> str:
+    """'' when this cloud can run REAL cross-process collectives; else the
+    root-cause string (the auto-skip reason the tests surface). Single-
+    process clouds are trivially capable. The probe is ONE bounded
+    broadcast (every rank must call this at the same point — it is a
+    collective) and the verdict is cached for the process lifetime."""
+    global _CAPABILITY
+    if _CAPABILITY is not None:
+        return _CAPABILITY
+    import jax
+
+    if jax.process_count() <= 1:
+        _CAPABILITY = ""
+        return _CAPABILITY
+    import numpy as np
+
+    out: dict = {}
+
+    def attempt():
+        try:
+            from jax.experimental import multihost_utils as mh
+
+            got = mh.broadcast_one_to_all(np.array([7], np.int32))
+            out["ok"] = int(np.asarray(got)[0]) == 7
+        except Exception as e:  # noqa: BLE001 — the reason IS the result
+            out["err"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        _CAPABILITY = (f"cross-process collective probe timed out after "
+                       f"{timeout:.0f}s")
+    elif out.get("ok"):
+        _CAPABILITY = ""
+    else:
+        _CAPABILITY = out.get(
+            "err", "cross-process collective returned a wrong value")
+    if _CAPABILITY:
+        Log.warn(f"multihost capability probe: {_CAPABILITY}")
+    return _CAPABILITY
+
+
+# ---------------------------------------------------------------------------
+# formation
+
+def formation(barrier: bool = True) -> dict:
+    """Cloud-formation record: barrier + per-host device enumeration.
+
+    The barrier is the Paxos cloud-lock analog — after it, every rank has
+    initialized its backend and agreed on membership (a rank that died
+    during init fails the barrier instead of wedging the first real
+    collective). The returned record (also pushed into the flight
+    recorder) is what ``/3/Cloud`` cannot show: which DEVICES live on
+    which HOST, and how the mesh factors over them."""
+    import jax
+
+    from h2o3_tpu.parallel import mesh as _mesh
+
+    if barrier and jax.process_count() > 1 and not probe_capability():
+        from jax.experimental import multihost_utils as mh
+
+        mh.sync_global_devices("h2o3_tpu_formation")
+    m = _mesh.get_mesh()
+    hosts: dict[int, list] = {}
+    for d in jax.devices():
+        hosts.setdefault(int(d.process_index), []).append(int(d.id))
+    rec = {
+        "processes": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+        "local_devices": int(jax.local_device_count()),
+        "devices": int(jax.device_count()),
+        "platform": jax.devices()[0].platform,
+        "mesh": dict(m.shape),
+        "mesh_2d": _mesh.is_2d(m),
+        "hosts": {str(k): sorted(v) for k, v in sorted(hosts.items())},
+    }
+    from h2o3_tpu.utils import flightrec
+
+    flightrec.record(
+        "formation", processes=rec["processes"],
+        devices=rec["devices"], mesh=str(rec["mesh"]))
+    return rec
+
+
+def bootstrap(coordinator: str | None = None, num_processes: int | None = None,
+              process_id: int | None = None,
+              log_level: str | None = None) -> dict:
+    """env/args → ``jax.distributed`` init → barrier → formation record.
+
+    Explicit args win; anything left None fills from :func:`pod_env`.
+    Single-host (no coordinator anywhere) still boots a cloud — the
+    degenerate 1-process pod — so one entrypoint serves laptops and pods."""
+    env = pod_env() or {}
+    coordinator = coordinator if coordinator is not None else env.get(
+        "coordinator")
+    if num_processes is None:
+        num_processes = env.get("num_processes")
+    if process_id is None:
+        process_id = env.get("process_id")
+    from h2o3_tpu.cluster import cloud
+
+    cloud.init(
+        coordinator=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        log_level=log_level,
+    )
+    rec = formation()
+    Log.info(
+        f"pod formation: process {rec['process_index']}/{rec['processes']}, "
+        f"{rec['devices']} device(s) over {len(rec['hosts'])} host(s), "
+        f"mesh {rec['mesh']}")
+    return rec
+
+
+def bootstrap_from_env(log_level: str | None = None) -> dict | None:
+    """The k8s entrypoint half of :func:`bootstrap`: None (do nothing) when
+    no H2O3_TPU_COORDINATOR is configured, else the formation record."""
+    if pod_env() is None:
+        return None
+    return bootstrap(log_level=log_level)
+
+
+# ---------------------------------------------------------------------------
+# pod-restart recovery loop
+
+_EXIT_WATCHER: threading.Thread | None = None
+_EXIT_STOP = threading.Event()
+
+
+def _exit_grace() -> float:
+    from h2o3_tpu import config
+
+    return config.get_float("H2O3_TPU_POD_EXIT_DEGRADED")
+
+
+def _exit_watch_loop(poll: float) -> None:
+    import jax
+
+    from h2o3_tpu.cluster import cloud
+
+    latched_at: float | None = None
+    while not _EXIT_STOP.wait(poll):
+        grace = _exit_grace()
+        if grace <= 0 or jax.process_count() <= 1:
+            latched_at = None
+            continue
+        if cloud.degraded_reason() is None:
+            latched_at = None  # recovered in-process (operator / supervisor)
+            continue
+        now = time.monotonic()
+        if latched_at is None:
+            latched_at = now
+            continue
+        if now - latched_at < grace:
+            continue
+        # the evidence is already frozen (mark_degraded captured an
+        # incident bundle); flush checkpoints via the normal interval
+        # machinery — they are already on durable storage — and restart
+        Log.err(
+            f"pod restart: degraded latch held {now - latched_at:.1f}s on a "
+            f"{jax.process_count()}-process cloud (reason: "
+            f"{cloud.degraded_reason()}); exiting with code "
+            f"{POD_RESTART_EXIT_CODE} so the pod supervisor re-forms the "
+            "cloud — resumable snapshots are in each job's "
+            "export_checkpoints_dir")
+        from h2o3_tpu.utils import flightrec
+
+        flightrec.record("pod_restart_exit",
+                         reason=str(cloud.degraded_reason())[:200])
+        os._exit(POD_RESTART_EXIT_CODE)
+
+
+def install_pod_restart(poll: float = 1.0) -> None:
+    """Start the pod-restart watcher (idempotent daemon; no-op while
+    H2O3_TPU_POD_EXIT_DEGRADED is 0 or the cloud is single-process).
+    launch.py installs it on every rank of a multi-process pod."""
+    global _EXIT_WATCHER
+    if _EXIT_WATCHER is not None and _EXIT_WATCHER.is_alive():
+        return
+    _EXIT_STOP.clear()
+    _EXIT_WATCHER = threading.Thread(
+        target=_exit_watch_loop, args=(poll,), name="h2o3-pod-restart",
+        daemon=True)
+    _EXIT_WATCHER.start()
+
+
+def uninstall_pod_restart() -> None:
+    global _EXIT_WATCHER
+    _EXIT_STOP.set()
+    if _EXIT_WATCHER is not None:
+        _EXIT_WATCHER.join(timeout=5)
+    _EXIT_WATCHER = None

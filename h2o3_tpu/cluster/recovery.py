@@ -235,7 +235,14 @@ def run_supervised(launch, *, ckdir: str | None = None, algo: str | None = None,
             if job is not None and hasattr(job, "restarts"):
                 job.restarts = attempt
             _ATTEMPTS.inc(outcome="resumed")
-            _SECONDS.observe(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            _SECONDS.observe(dt)
+            # recovery_seconds rides the flight recorder too, so an
+            # incident bundle (or the pod-restart drill) shows failure →
+            # relaunch latency next to the dispatches it interrupted
+            flightrec.record(
+                "recovery", seconds=round(dt, 3), outcome="resumed",
+                job=description, generation=cloud.generation())
 
 
 # ---------------------------------------------------------------------------
@@ -269,7 +276,12 @@ def _watch_loop(poll: float) -> None:
         gen = reform("background supervisor: degraded latch with no "
                      "supervised job attached")
         _ATTEMPTS.inc(outcome="reform")
-        _SECONDS.observe(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        _SECONDS.observe(dt)
+        from h2o3_tpu.utils import flightrec
+
+        flightrec.record("recovery", seconds=round(dt, 3),
+                         outcome="reform", generation=gen)
         Log.warn(f"recovery: background reform complete (generation {gen})")
         consecutive += 1
         last_reform = time.monotonic()

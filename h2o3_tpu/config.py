@@ -164,6 +164,15 @@ _KNOBS: dict[str, tuple[str, str]] = {
              "control for frame_prefetch_overlap_seconds)"),
     "H2O3_TPU_STREAM_BYTES": (str(256 * 1024 * 1024),
                               "CSV bytes above which parse streams in chunks"),
+    "H2O3_TPU_INGEST_SHARDS": (
+        "0", "coordinator-free sharded CSV ingest (frame/parse.py "
+             "parse_sharded): how many byte ranges ONE process splits the "
+             "source into and parses independently (each range located by "
+             "a streaming newline scan and tokenized by the native "
+             "byte-range parser) before concatenating — the single-process "
+             "test/A-B lane of the per-host sharded parse, pinned "
+             "byte-equal to the plain parse. 0 = one range per process "
+             "(multi-process clouds still parse per-rank ranges)"),
     "H2O3_TPU_PORT": ("54321", "default REST port"),
     "H2O3_TPU_AUTH_TOKEN": (
         "", "opt-in REST auth token ('' = open, upstream default); when set "
@@ -203,6 +212,49 @@ _KNOBS: dict[str, tuple[str, str]] = {
     "H2O3_TPU_HEARTBEAT_TIMEOUT": (
         "100", "multi-host dead-member detection bound, seconds "
         "(jax coordination-service heartbeat timeout)"),
+    "H2O3_TPU_MESH_ROWS": (
+        "", "2-D rows×cols pod mesh (parallel/mesh.py): how many ROWS-axis "
+            "groups the device mesh factors into. Frame rows still shard "
+            "over EVERY device (cols-major, so shard i sits on device i "
+            "exactly like the 1-D mesh); histogram/Gram/gradient reduces "
+            "run stage-1 EXACT over the rows axis (the contiguous-device / "
+            "ICI level) and the collective lane proper over cols, and the "
+            "split phase's column blocks shard over cols only — row "
+            "sharding and the PR-5/PR-6 column blocks compose instead of "
+            "sharing one axis, and the PR-9 quantized lane compresses "
+            "exactly the cross-group stage. ''/'0'/'1' = the legacy 1-D "
+            "rows mesh (bit-for-bit today's programs); 'auto' = rows = "
+            "each process's local device count on multi-process clouds "
+            "(rows=ICI, cols=DCN) and 1-D otherwise; an integer forces "
+            "that rows size (the CPU-proxy A/B lane — '2' makes the "
+            "8-device proxy a 2x4 pod stand-in). Non-dividing values fall "
+            "back to 1-D with a warning"),
+    "H2O3_TPU_COORDINATOR": (
+        "", "jax.distributed coordinator address host:port for env-driven "
+            "pod bootstrap (cluster/multihost.py): when set, launch.py and "
+            "bootstrap_from_env() initialize the coordination service "
+            "before any backend touch — the k8s StatefulSet points every "
+            "pod at the rank-0 pod's headless-service DNS name. '' = "
+            "single-host (no distributed init)"),
+    "H2O3_TPU_NUM_PROCESSES": (
+        "0", "process count of the env-driven pod bootstrap (must equal "
+             "the StatefulSet replica count); 0 = unset"),
+    "H2O3_TPU_PROCESS_ID": (
+        "", "this process's rank in the env-driven pod bootstrap; '' = "
+            "derive from the trailing ordinal of H2O3_TPU_POD_NAME / "
+            "POD_NAME / HOSTNAME (the k8s StatefulSet convention "
+            "pod-name-N), the launcher arg, or fail loudly"),
+    "H2O3_TPU_POD_EXIT_DEGRADED": (
+        "0", "pod-restart recovery loop (cluster/multihost.py): on a "
+             "MULTI-PROCESS cloud whose degraded latch persists past this "
+             "many seconds, the process EXITS (code 23) instead of holding "
+             "a survivor island — the JAX runtime cannot re-initialize "
+             "in-process, so on k8s the restartPolicy brings every rank "
+             "back, the cloud re-forms, and the PR-10 supervisor resumes "
+             "from the interval snapshot (recovery_seconds lands in the "
+             "flight recorder + metrics). '0' = never exit (the in-process "
+             "survivor island keeps serving — single-host default and the "
+             "two-process test fixture's mode)"),
     "H2O3_TPU_PERSIST_RETRIES": (
         "4", "transient persist IO failures are retried this many times "
              "before surfacing (deterministic errors — bad path, collision, "
@@ -354,6 +406,15 @@ _KNOBS: dict[str, tuple[str, str]] = {
               "an idle model costs neither a parked thread nor HBM. The "
               "next request rebuilds the batcher and pages the scorer "
               "back in"),
+    "H2O3_TPU_SERVE_WARM_MODELS": (
+        "0", "serving-registry warm boot (serving/registry.py): at replica "
+             "boot the watcher's FIRST poll pre-loads the newest N model "
+             "files from the watch dir, pages their payloads into device "
+             "residency and precompiles each model's smallest scoring "
+             "shape bucket — a fresh HPA replica serves its first request "
+             "at speed instead of paying model load + page-in + compile on "
+             "the request path. 0 = no warm-up (load on first pickup, "
+             "compile on first request — the pre-warm behavior)"),
     "H2O3_TPU_SERVE_BAD_GEN_ERRORS": (
         "3", "serving-registry rollout breaker: this many consecutive "
              "scoring failures on a freshly rolled-out model generation "

@@ -592,7 +592,22 @@ def parse_sharded(
     per = len(positions) * rows_per_dev  # this rank's row block
     lo = min(positions[0] * rows_per_dev, n)
     hi = min(positions[0] * rows_per_dev + per, n)
-    local = _read_rank_rows(path, sep, col_order, kinds, lo, hi, n)
+    from h2o3_tpu import config as _cfg
+
+    k_ranges = max(_cfg.get_int("H2O3_TPU_INGEST_SHARDS"), 0)
+    if P == 1 and k_ranges > 1 and hi > lo:
+        # coordinator-free single-process sharded lane (the pod ingest's
+        # test/A-B form): split THIS range into k byte ranges, parse each
+        # independently through the same byte-range reader a pod rank uses,
+        # and concatenate — pinned byte-equal to the one-range parse
+        bounds = [lo + (hi - lo) * j // k_ranges for j in range(k_ranges + 1)]
+        parts = [
+            _read_rank_rows(path, sep, col_order, kinds, a, b, n)
+            for a, b in zip(bounds, bounds[1:]) if b > a
+        ]
+        local = pd.concat(parts, ignore_index=True)
+    else:
+        local = _read_rank_rows(path, sep, col_order, kinds, lo, hi, n)
 
     # per-rank categorical interning, then the global union pass
     local_domains: dict[str, list] = {}
@@ -645,6 +660,14 @@ def parse_sharded(
         ]
         return jax.make_array_from_single_device_arrays((npad,), sh, parts)
 
+    from h2o3_tpu.frame import chunkstore as _cs
+
+    # ChunkStore lane: on a single process the local block IS the whole
+    # padded column, so an out-of-core config (HBM window set) adopts it as
+    # the spill-tier host mirror — a streaming build's host_values() then
+    # costs nothing instead of a device pull per column. Multi-process
+    # ranks hold only their slice; mirrors stay lazy there (documented).
+    seed_mirror = P == 1 and _cs.streaming_enabled()
     vecs: list[Vec] = []
     for c in col_order:
         k = kinds[c]
@@ -659,13 +682,19 @@ def parse_sharded(
             lc = local_codes[c]
             codes[: len(lc)] = np.where(lc >= 0, remap[np.clip(lc, 0, None)], -1)
             data = _global_from_local(codes, dt)
-            vecs.append(Vec(data, CAT, name=c, domain=tuple(union[c]), nrow=n))
+            v = Vec(data, CAT, name=c, domain=tuple(union[c]), nrow=n)
+            if seed_mirror:
+                v._seed_host_mirror(codes)
+            vecs.append(v)
         else:
             vals = np.full(per, np.nan, np.float32)
             got = pd.to_numeric(local[c], errors="coerce").to_numpy(np.float32)
             vals[: len(got)] = got
             data = _global_from_local(vals, np.float32)
-            vecs.append(Vec(data, INT if k == INT else NUM, name=c, nrow=n))
+            v = Vec(data, INT if k == INT else NUM, name=c, nrow=n)
+            if seed_mirror:
+                v._seed_host_mirror(vals)
+            vecs.append(v)
 
     fr = Frame(vecs, col_order, key=destination_frame, register=True)
     Log.info(

@@ -3,15 +3,25 @@
 
 H2O launches one JVM per Hadoop/k8s node and gossips a cloud; here each
 host runs one process of a ``jax.distributed`` pod and the coordination
-service forms the cloud (cluster/cloud.py). On k8s, point every pod at the
-rank-0 pod's headless-service DNS name:
+service forms the cloud (cluster/cloud.py, bootstrapped through
+cluster/multihost.py). On k8s, point every pod at the rank-0 pod's
+headless-service DNS name — via args:
 
     python -m h2o3_tpu.launch --coordinator pod-0.svc:1234 \
         --num-processes 4 --process-id $POD_INDEX --port 54321
 
+or entirely via environment (the StatefulSet mode — the SAME command runs
+on every replica, the rank deriving from the pod-name ordinal):
+
+    H2O3_TPU_COORDINATOR=h2o3-tpu-0.h2o3-tpu:1234 \
+    H2O3_TPU_NUM_PROCESSES=4 python -m h2o3_tpu.launch
+
 Process 0 additionally serves the REST coordinator (any process can, but
 one suffices — clients talk to one coordinator like H2O clients talk to any
-cloud member).
+cloud member). On a multi-process pod every rank installs the pod-restart
+watcher (H2O3_TPU_POD_EXIT_DEGRADED, cluster/multihost.py): a degraded
+latch that cannot heal in-process exits the rank so the pod supervisor
+re-forms the whole cloud and the PR-10 supervisor resumes from snapshots.
 """
 
 from __future__ import annotations
@@ -22,10 +32,14 @@ import time
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="h2o3_tpu.launch")
-    ap.add_argument("--coordinator", required=True,
-                    help="rank-0 address host:port (the -flatfile successor)")
-    ap.add_argument("--num-processes", type=int, required=True)
-    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--coordinator", default=None,
+                    help="rank-0 address host:port (the -flatfile successor; "
+                         "default: H2O3_TPU_COORDINATOR env)")
+    ap.add_argument("--num-processes", type=int, default=None,
+                    help="pod size (default: H2O3_TPU_NUM_PROCESSES env)")
+    ap.add_argument("--process-id", type=int, default=None,
+                    help="this rank (default: H2O3_TPU_PROCESS_ID env, or "
+                         "the trailing pod-name ordinal)")
     ap.add_argument("--ip", default="0.0.0.0",
                     help="REST bind address for process 0 (default: all "
                          "interfaces — other pods must reach it)")
@@ -38,9 +52,9 @@ def main(argv=None) -> None:
     ap.add_argument("--log-level", default="INFO")
     args = ap.parse_args(argv)
 
-    import h2o3_tpu
+    from h2o3_tpu.cluster import multihost
 
-    info = h2o3_tpu.init(
+    rec = multihost.bootstrap(
         coordinator=args.coordinator,
         num_processes=args.num_processes,
         process_id=args.process_id,
@@ -48,10 +62,15 @@ def main(argv=None) -> None:
     )
     from h2o3_tpu.utils.log import Log
 
-    Log.info(f"process {args.process_id}/{args.num_processes} joined: {info}")
-    if args.process_id == 0:
+    pid, nproc = rec["process_index"], rec["processes"]
+    Log.info(f"process {pid}/{nproc} joined: {rec}")
+    if nproc > 1:
+        # the k8s restart loop's trigger (no-op while the knob is 0)
+        multihost.install_pod_restart()
+    if pid == 0:
         import signal
 
+        import h2o3_tpu
         from h2o3_tpu.api import server as _api_server
         from h2o3_tpu.cluster import recovery
 

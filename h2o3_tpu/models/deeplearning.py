@@ -219,7 +219,9 @@ def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
     """
     import jax.tree_util as jtu
 
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, mesh_key, shard_map
+    from h2o3_tpu.parallel.mesh import (
+        col_axis_name, get_mesh, mesh_key, n_col_shards, row_pspec, shard_map,
+    )
     from jax.sharding import PartitionSpec as Spec
 
     key = ("dl_chunk", desc, batch, npad, n_chunk, bool(shard_on),
@@ -254,8 +256,9 @@ def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
 
     if shard_on:
         mesh = get_mesh()
-        n_sh = mesh.shape[ROWS_AXIS]
-        fb = fpad // n_sh
+        n_sh = int(mesh.devices.size)
+        cax = col_axis_name(mesh)
+        fb = fpad // n_col_shards(mesh)
 
         def shard_step(prm_flat, ost, xb, yb, wb, bk, l1, l2):
             def local(prm_flat, ost_l, xb_l, yb_l, wb_l, bk, l1, l2):
@@ -267,29 +270,34 @@ def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
                 # the flat-gradient reduce rides the collective lane
                 # (ops/collectives.py): block-quantized with a residual-
                 # correction pass when on — the optimizer consumes the
-                # shard directly — stock psum_scatter bit-for-bit when off
+                # shard directly — stock psum_scatter bit-for-bit when off.
+                # On a 2-D mesh the wrapper reduces the rows axis exactly
+                # first and param shards live on the COLS axis (replicated
+                # across rows groups — identical updates by construction)
                 from h2o3_tpu.ops import collectives
 
-                gs = collectives.psum_scatter(g, n_dev=n_sh, passes=2)
-                wsum = jax.lax.psum(jnp.sum(wb_l), ROWS_AXIS)
-                d = jax.lax.axis_index(ROWS_AXIS)
+                gs = collectives.psum_scatter(
+                    g, n_dev=n_sh, passes=2, mesh=mesh)
+                wsum = collectives.exact_psum(jnp.sum(wb_l), mesh)
+                d = jax.lax.axis_index(cax)
                 my = jax.lax.dynamic_slice(prm_flat, (d * fb,), (fb,))
                 gshard = (gs / jnp.maximum(wsum, 1e-9)
                           + l2 * my + l1 * jnp.sign(my))
                 upd, ost_l = tx.update(gshard, ost_l, my)
                 my = optax.apply_updates(my, upd)
                 prm_new = jax.lax.all_gather(
-                    my, ROWS_AXIS, axis=0, tiled=True)
-                loss = (jax.lax.psum(lsum, ROWS_AXIS)
+                    my, cax, axis=0, tiled=True)
+                loss = (collectives.exact_psum(lsum, mesh)
                         / jnp.maximum(wsum, 1e-9)
                         + penalties(prm_flat[:n_real], l1, l2))
                 return loss, prm_new, ost_l
 
-            ost_spec = jtu.tree_map(lambda _: Spec(ROWS_AXIS), ost)
+            rspec = row_pspec(mesh)
+            ost_spec = jtu.tree_map(lambda _: Spec(cax), ost)
             return shard_map(
                 local, mesh,
-                in_specs=(Spec(), ost_spec, Spec(ROWS_AXIS, None),
-                          Spec(ROWS_AXIS), Spec(ROWS_AXIS), Spec(), Spec(),
+                in_specs=(Spec(), ost_spec, row_pspec(mesh, ndim=2),
+                          rspec, rspec, Spec(), Spec(),
                           Spec()),
                 out_specs=(Spec(), Spec(), ost_spec),
                 check_vma=False,

@@ -115,9 +115,9 @@ def _glm_fuse_chunk(params) -> int:
 
 
 def _mesh_shards() -> int:
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh
+    from h2o3_tpu.parallel.mesh import get_mesh
 
-    return get_mesh().shape[ROWS_AXIS]
+    return int(get_mesh().devices.size)
 
 
 def _glm_pad_cols(p_real: int) -> int:
@@ -207,7 +207,7 @@ def _fused_chunk_program(npad, p_pad, family_key, fam_args, l1_on,
     lstsq fallback lane takes over). All regularization/convergence scalars
     are DYNAMIC arguments so one program serves the whole lambda path;
     ``beta`` is donated (the carry pipelines across chunk dispatches)."""
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, mesh_key
+    from h2o3_tpu.parallel.mesh import get_mesh, mesh_key
 
     key = ("glm_irls_chunk", npad, p_pad, family_key, fam_args, bool(l1_on),
            bool(non_negative), mesh_key(), jax.default_backend())
@@ -219,20 +219,25 @@ def _fused_chunk_program(npad, p_pad, family_key, fam_args, l1_on,
 
     from jax.sharding import PartitionSpec as Spec
 
+    from h2o3_tpu.parallel.mesh import col_axis_name, row_pspec
+
     fam = get_family(family_key, *fam_args)
     mesh = get_mesh()
-    n_sh = mesh.shape[ROWS_AXIS]
+    n_sh = int(mesh.devices.size)
+    cax = col_axis_name(mesh)
     ar = jnp.arange(p_pad)
 
     def gram_dev_sharded(X, y, w, offset, beta):
         """One GLMIterationTask with the MRTask reduce made explicit and
         PACKED: the per-device row math (working weights, working response,
         local Gram/XtWz partials, local deviance) runs inside shard_map,
-        the Gram reduction ends in a psum_scatter of contiguous (p/P, p)
-        row blocks, b and the deviance ride ONE packed psum, and a single
-        all_gather reassembles G for the solve — three collective
-        rendezvous per iteration instead of five (collective count, not
-        just volume, is what the CPU proxy pays for)."""
+        the Gram reduction ends in a psum_scatter of contiguous G row
+        blocks over the column-block axis (2-D meshes reduce the rows axis
+        exactly first, inside the wrapper), b and the deviance ride ONE
+        packed psum, and a single all_gather reassembles G for the solve —
+        three collective rendezvous per iteration instead of five
+        (collective count, not just volume, is what the CPU proxy pays
+        for)."""
         def local(Xl, yl, wl, ol, beta):
             from h2o3_tpu.ops import collectives
 
@@ -245,18 +250,19 @@ def _fused_chunk_program(npad, p_pad, family_key, fam_args, l1_on,
             # it keeps ~14 effective mantissa bits); the small packed
             # b/deviance psum and the solve's G gather stay exact f32 so
             # convergence tests and the solve RHS are untouched
-            G_blk = collectives.psum_scatter(G_l, n_dev=n_sh, passes=2)
-            vec = jax.lax.psum(
-                jnp.concatenate([b_l, dev[None]]), ROWS_AXIS)
-            G = jax.lax.all_gather(G_blk, ROWS_AXIS, axis=0, tiled=True)
+            G_blk = collectives.psum_scatter(
+                G_l, n_dev=n_sh, passes=2, mesh=mesh)
+            vec = collectives.exact_psum(
+                jnp.concatenate([b_l, dev[None]]), mesh)
+            G = jax.lax.all_gather(G_blk, cax, axis=0, tiled=True)
             return G, vec[:p_pad], vec[p_pad]
 
         from h2o3_tpu.parallel.mesh import shard_map
 
+        rspec = row_pspec(mesh)
         return shard_map(
             local, mesh,
-            in_specs=(Spec(ROWS_AXIS, None), Spec(ROWS_AXIS),
-                      Spec(ROWS_AXIS), Spec(ROWS_AXIS), Spec()),
+            in_specs=(row_pspec(mesh, ndim=2), rspec, rspec, rspec, Spec()),
             out_specs=(Spec(), Spec(), Spec()),
             check_vma=False,
         )(X, y, w, offset, beta)

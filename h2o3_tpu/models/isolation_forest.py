@@ -75,7 +75,9 @@ class IsolationForestModel(Model):
     algo = "isolationforest"
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
-        X = _feature_matrix(frame, self.output["names"])
+        X = _feature_matrix(
+            frame, self.output["names"],
+            domains=self.output.get("feature_domains"))
         total = jnp.zeros(X.shape[0], jnp.float32)
         for feat, thr, ll in self.output["trees"]:
             total = total + _path_lengths(
@@ -94,13 +96,36 @@ class IsolationForestModel(Model):
         )
 
 
-def _feature_matrix(frame: Frame, names) -> "jnp.ndarray":
+def _feature_matrix(frame: Frame, names, domains=None) -> "jnp.ndarray":
+    """Feature columns as f32: numerics as-is, categoricals as their codes.
+
+    ``domains`` (the trained model's ``feature_domains`` output, ISSUE 14)
+    remaps a scoring frame's frame-local codes into TRAINING-domain codes
+    (unseen levels → -1, the NA code) so predictions do not depend on the
+    scoring frame's own interning order — and so the serving tier's
+    compiled iforest lane, which encodes row payloads straight into
+    training codes (scorer._coerce_cat), is byte-equal to this path. The
+    training frame itself remaps identically (its domains ARE the training
+    domains), keeping pre-existing behavior bit-for-bit there; models
+    saved before feature_domains existed pass None and keep raw codes."""
     cols = []
-    for n in names:
+    for ci, n in enumerate(names):
         v = frame.vec(n)
-        cols.append(
-            v.data.astype(jnp.float32) if v.is_categorical() else v.data
-        )
+        if not v.is_categorical():
+            cols.append(v.data)
+            continue
+        dom = domains[ci] if domains is not None else None
+        vdom = tuple(v.domain or ())
+        if dom is None or tuple(dom) == vdom:
+            cols.append(v.data.astype(jnp.float32))
+            continue
+        lut = {lv: i for i, lv in enumerate(dom)}
+        remap = jnp.asarray(
+            np.array([lut.get(lv, -1) for lv in vdom] or [-1], np.int32))
+        codes = v.data.astype(jnp.int32)
+        mapped = jnp.where(
+            codes < 0, -1, remap[jnp.clip(codes, 0, len(vdom) - 1)])
+        cols.append(mapped.astype(jnp.float32))
     return jnp.stack(cols, axis=1)
 
 
@@ -133,12 +158,19 @@ class IsolationForest(ModelBuilder):
 
         out = {
             "trees": trees, "names": list(names), "response_domain": None,
-            # the serving tier's compiled walk lane (serving/scorer.py) only
-            # engages for all-numeric forests: categorical codes through the
-            # frame path depend on the scoring frame's own domain, which a
-            # row payload cannot reproduce byte-exactly
             "feature_kinds": [
                 "cat" if train.vec(n).is_categorical() else "num"
+                for n in names
+            ],
+            # training-domain codes (ISSUE 14): categorical features carry
+            # the TRAINING frame's level domains, so scoring frames remap
+            # into them (_feature_matrix) and the serving tier's compiled
+            # walk lane can encode row payloads byte-identically
+            # (scorer._coerce_cat against these domains) — categorical
+            # forests no longer fall back to the generic lane
+            "feature_domains": [
+                tuple(train.vec(n).domain or ())
+                if train.vec(n).is_categorical() else None
                 for n in names
             ],
         }

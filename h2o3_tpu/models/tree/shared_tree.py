@@ -469,14 +469,16 @@ def _split_scan(hist, is_cat, col_mask, min_rows, min_split_improvement, cat_col
 
 
 def _split_shard_on() -> bool:
-    """Single policy for the sharded split pipeline: on by default on any
-    mesh with >1 device (``H2O3_TPU_SPLIT_SHARD=0`` restores the replicated
-    scan). A 1-device mesh has nothing to shard — the replicated path IS
-    the local path there."""
+    """Single policy for the sharded split pipeline: on by default whenever
+    the mesh deals >1 COLUMN block (``H2O3_TPU_SPLIT_SHARD=0`` restores the
+    replicated scan). On the legacy 1-D mesh that is any >1-device mesh; on
+    a 2-D rows×cols mesh the block count is the ``cols`` axis — an R×1 mesh
+    has nothing to shard columns over and scans replicated (its histogram
+    still reduces over the rows axis)."""
     from h2o3_tpu import config
-    from h2o3_tpu.parallel.mesh import n_shards
+    from h2o3_tpu.parallel.mesh import n_col_shards
 
-    return config.get_bool("H2O3_TPU_SPLIT_SHARD") and n_shards() > 1
+    return config.get_bool("H2O3_TPU_SPLIT_SHARD") and n_col_shards() > 1
 
 
 def _split_fuse_on() -> bool:
@@ -536,11 +538,14 @@ def _split_scan_sharded_fused(
     from h2o3_tpu.ops.histogram import record_collective
     from h2o3_tpu.ops.hist_pallas import blocked_node_totals
     from h2o3_tpu.ops.split_pallas import fused_split_scan
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+    from h2o3_tpu.parallel.mesh import (
+        col_axis_name, get_mesh, n_col_shards, shard_map,
+    )
     from jax.sharding import PartitionSpec as P
 
     mesh = mesh or get_mesh()
-    n_dev = mesh.shape[ROWS_AXIS]
+    n_dev = n_col_shards(mesh)
+    cax = col_axis_name(mesh)
     L = layout
     lloc = L.local(n_dev)
     N, B, S = L.n_nodes, L.n_bins, L.ns
@@ -554,11 +559,11 @@ def _split_scan_sharded_fused(
         record_collective("winner_gather", n_dev * per_dev)
 
     def body(blk_loc, cm, ic):
-        d = jax.lax.axis_index(ROWS_AXIS)
+        d = jax.lax.axis_index(cax)
         col0 = (d * lloc.cpad).astype(jnp.int32)
         # node totals from GLOBAL column 0 = block 0's local column 0
         tot_loc = blocked_node_totals(blk_loc, lloc)
-        tot0 = jax.lax.all_gather(tot_loc, ROWS_AXIS)[0]
+        tot0 = jax.lax.all_gather(tot_loc, cax)[0]
         cm_blk = jax.lax.dynamic_slice_in_dim(cm, col0, lloc.cpad, axis=1)
         ic_blk = jax.lax.dynamic_slice_in_dim(ic, col0, lloc.cpad, axis=0)
         sp = fused_split_scan(
@@ -574,7 +579,7 @@ def _split_scan_sharded_fused(
             "Lst": sp["Lst"],
             "Rst": sp["Rst"],
         }
-        g = jtu.tree_map(lambda a: jax.lax.all_gather(a, ROWS_AXIS), win)
+        g = jtu.tree_map(lambda a: jax.lax.all_gather(a, cax), win)
         # identical merge to the dense sharded path: argmax over the block
         # axis — first max wins, i.e. the LOWEST block
         bb = jnp.argmax(g["gain"], axis=0)  # (N,)
@@ -594,7 +599,7 @@ def _split_scan_sharded_fused(
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(ROWS_AXIS), P(), P()),
+        in_specs=(P(cax), P(), P()),
         out_specs=P(),
         check_vma=False,
     )(blk, col_mask, is_cat)
@@ -637,11 +642,14 @@ def _split_scan_sharded(
     import jax.tree_util as jtu
 
     from h2o3_tpu.ops.histogram import record_collective
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+    from h2o3_tpu.parallel.mesh import (
+        col_axis_name, get_mesh, n_col_shards, shard_map,
+    )
     from jax.sharding import PartitionSpec as P
 
     mesh = mesh or get_mesh()
-    n_dev = mesh.shape[ROWS_AXIS]
+    n_dev = n_col_shards(mesh)
+    cax = col_axis_name(mesh)
     N, Cp, B, S = hist.shape
     Cb = Cp // n_dev
     C = is_cat.shape[0]
@@ -664,11 +672,11 @@ def _split_scan_sharded(
         record_collective("winner_gather", n_dev * per_dev)
 
     def body(h_blk, cm, ic, mono_g, lo, hi):
-        d = jax.lax.axis_index(ROWS_AXIS)
+        d = jax.lax.axis_index(cax)
         col0 = (d * Cb).astype(jnp.int32)
         # node totals from GLOBAL column 0 = block 0's local column 0
         tot_loc = h_blk[:, 0, :, :].sum(axis=1)  # (N, S)
-        tot0 = jax.lax.all_gather(tot_loc, ROWS_AXIS)[0]
+        tot0 = jax.lax.all_gather(tot_loc, cax)[0]
         cm_blk = jax.lax.dynamic_slice_in_dim(cm, col0, Cb, axis=1)
         ic_blk = jax.lax.dynamic_slice_in_dim(ic, col0, Cb, axis=0)
         mono_blk = (
@@ -694,7 +702,7 @@ def _split_scan_sharded(
         if mono_g is not None:
             win["mid"] = sp["mid"]
             win["mono_col"] = sp["mono_col"]
-        g = jtu.tree_map(lambda a: jax.lax.all_gather(a, ROWS_AXIS), win)
+        g = jtu.tree_map(lambda a: jax.lax.all_gather(a, cax), win)
         # the merge, computed identically on every device: argmax over the
         # gathered block axis — first max wins, i.e. the LOWEST block
         bb = jnp.argmax(g["gain"], axis=0)  # (N,)
@@ -716,14 +724,14 @@ def _split_scan_sharded(
         return shard_map(
             lambda h, cm, ic: body(h, cm, ic, None, None, None),
             mesh=mesh,
-            in_specs=(P(None, ROWS_AXIS), P(), P()),
+            in_specs=(P(None, cax), P(), P()),
             out_specs=P(),
             check_vma=False,
         )(hist, col_mask, is_cat)
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(None, ROWS_AXIS), P(), P(), P(), P(), P()),
+        in_specs=(P(None, cax), P(), P(), P(), P(), P()),
         out_specs=P(),
         check_vma=False,
     )(hist, col_mask, is_cat, mono, node_lo, node_hi)

@@ -161,9 +161,9 @@ def quant_key() -> tuple:
     a program compiled under one (quant, block, hierarchy) setting must
     never serve another. Folded into ``parallel/mesh.mesh_key`` so every
     tree/GLM/DL program cache picks it up through the one chokepoint."""
-    from h2o3_tpu.parallel.mesh import hier_inner, n_shards
+    from h2o3_tpu.parallel.mesh import hier_inner, n_col_shards
 
-    return (quant_enabled(), quant_block(), hier_inner(n_shards()))
+    return (quant_enabled(), quant_block(), hier_inner(n_col_shards()))
 
 
 def lane_active(n_dev: int) -> bool:
@@ -186,22 +186,30 @@ def modeled_reduce_bytes(
     """Per-lane replication-volume model of ONE wrapped ``psum_scatter``
     over ``nelem`` elements — what the GLM/DL host tallies (which cannot
     ride the trace-time tally) record per executed iteration/minibatch.
-    Mirrors the wrapper's own recording exactly."""
-    from h2o3_tpu.parallel.mesh import hier_inner
+    Mirrors the wrapper's own recording exactly, including the 2-D mesh's
+    stage-1 exact rows-axis psum (``n_dev`` stays the TOTAL device count;
+    the lane geometry is read from the process mesh)."""
+    from h2o3_tpu.parallel.mesh import hier_inner, n_col_shards, n_row_groups
 
     if n_dev <= 1:
         return {}
     quant = quant_enabled()
-    inner = hier_inner(n_dev)
-    if not quant and not inner:
-        return {"exact": nelem * 4.0 / n_dev}
+    rows = n_row_groups()
+    ncol = n_col_shards()
+    inner = hier_inner(ncol)
     out = {"exact": 0.0, "quant": 0.0}
-    if inner:
-        out["exact"] += nelem * 4.0  # stage-1 intra-group exact reduce
-    out["quant" if quant else "exact"] += payload_bytes(
-        nelem // n_dev, quant, quant_block(), passes
-    )
-    return out
+    if rows > 1:
+        out["exact"] += nelem * 4.0  # stage-1 exact rows-axis reduce
+    if ncol > 1:
+        if not quant and not inner:
+            out["exact"] += nelem * 4.0 / ncol
+        else:
+            if inner:
+                out["exact"] += nelem * 4.0  # intra-group exact reduce
+            out["quant" if quant else "exact"] += payload_bytes(
+                nelem // ncol, quant, quant_block(), passes
+            )
+    return {k: v for k, v in out.items() if v}
 
 
 # ---------------------------------------------------------------------------
@@ -320,49 +328,102 @@ def _scatter_lane(x, axis_name, n_dev: int, phase: str | None, passes: int,
 # public wrappers (call inside shard_map bodies, like the lax primitives)
 
 
+def _lane_geometry(mesh, axis_name: str | None, n_dev: int):
+    """``(stage1_axis, lane_axis, lane_width)`` — the reduce decomposition
+    for the current mesh. On a 2-D rows×cols mesh the wrappers first run an
+    EXACT ``lax.psum`` over the ``rows`` axis (the contiguous-device /
+    intra-host level — arXiv:2110.10548's placement expressed as mesh
+    structure) and the lane proper (quantized, scattered) runs over
+    ``cols`` alone; the legacy 1-D mesh keeps its single ``rows``-axis lane
+    with the caller-passed ``n_dev`` width. An explicit ``axis_name`` pins
+    a single-stage reduce over that axis (test/microbench lane)."""
+    from h2o3_tpu.parallel.mesh import (
+        COLS_AXIS, get_mesh, is_2d, n_row_groups,
+    )
+
+    if axis_name is not None:
+        return None, axis_name, n_dev
+    m = mesh or get_mesh()
+    if is_2d(m):
+        rows = n_row_groups(m)
+        return (ROWS_AXIS if rows > 1 else None), COLS_AXIS, m.shape[COLS_AXIS]
+    return None, ROWS_AXIS, n_dev
+
+
 def psum_scatter(x, *, n_dev: int, phase: str | None = None,
                  passes: int = 1, lane_axis: int | None = None,
-                 axis_name: str = ROWS_AXIS):
+                 axis_name: str | None = None, mesh=None):
     """Drop-in for ``lax.psum_scatter(x, axis, scatter_dimension=0,
     tiled=True)`` routed through the quantized/hierarchical lane when
     active. ``phase`` (when given) records the byte tally — call sites
     whose dispatch loop tallies host-side (GLM/DL) pass None and use
     :func:`modeled_reduce_bytes`. ``passes=2`` adds the residual-correction
     pass (the solve-critical reduces); ``lane_axis`` keeps mixed-magnitude
-    stat lanes in separate quantization blocks (see :func:`_scatter_lane`)."""
-    if n_dev <= 1:
-        return jax.lax.psum_scatter(
-            x, axis_name, scatter_dimension=0, tiled=True)
-    if not lane_active(n_dev):
+    stat lanes in separate quantization blocks (see :func:`_scatter_lane`).
+
+    ``n_dev`` is the TOTAL device count of the caller's mesh; on a 2-D
+    rows×cols mesh the reduce decomposes as exact ``psum`` over ``rows`` +
+    a ``cols``-wide scatter, so the result is sharded over the COLUMN-BLOCK
+    axis (1/n_col_shards per device, replicated across rows groups)."""
+    stage1, ax, ncol = _lane_geometry(mesh, axis_name, n_dev)
+    if stage1 is not None:
+        x = jax.lax.psum(x, stage1)
         if phase:
-            record_collective(phase, x.size * 4.0 / n_dev, lane="exact")
+            record_collective(phase, x.size * 4.0, lane="exact")
+    if ncol <= 1:
         return jax.lax.psum_scatter(
-            x, axis_name, scatter_dimension=0, tiled=True)
-    return _scatter_lane(x, axis_name, n_dev, phase, passes, lane_axis)
+            x, ax, scatter_dimension=0, tiled=True)
+    if not lane_active(ncol):
+        if phase:
+            record_collective(phase, x.size * 4.0 / ncol, lane="exact")
+        return jax.lax.psum_scatter(
+            x, ax, scatter_dimension=0, tiled=True)
+    return _scatter_lane(x, ax, ncol, phase, passes, lane_axis)
 
 
 def psum(x, *, n_dev: int, phase: str | None = None, passes: int = 1,
-         lane_axis: int | None = None, axis_name: str = ROWS_AXIS):
+         lane_axis: int | None = None, axis_name: str | None = None,
+         mesh=None):
     """Drop-in for ``lax.psum(x, axis)`` (leading-axis tensors). The lane
-    form is reduce-scatter over the SAME P-chunk grid as
-    :func:`psum_scatter` (axis 0 padded up to the device count) + an EXACT
+    form is reduce-scatter over the SAME chunk grid as
+    :func:`psum_scatter` (axis 0 padded up to the lane width) + an EXACT
     all_gather — so a replicated reduction's chunk ``d`` stays
     bit-identical to the sharded lane's device-``d`` block, for any data.
-    The broadcast half therefore stays f32 (exact lane) by design; the
-    compression claim lives on the scatter pipeline, which is the default
-    (``H2O3_TPU_SPLIT_SHARD=1``)."""
-    if n_dev <= 1:
-        return jax.lax.psum(x, axis_name)
-    if not lane_active(n_dev):
+    On a 2-D mesh both wrappers share the identical stage-1 rows-axis
+    ``psum``, so the invariant carries over to the pod shape. The broadcast
+    half stays f32 (exact lane) by design; the compression claim lives on
+    the scatter pipeline, which is the default (``H2O3_TPU_SPLIT_SHARD=1``)."""
+    stage1, ax, ncol = _lane_geometry(mesh, axis_name, n_dev)
+    if stage1 is not None:
+        x = jax.lax.psum(x, stage1)
         if phase:
             record_collective(phase, x.size * 4.0, lane="exact")
-        return jax.lax.psum(x, axis_name)
+    if ncol <= 1:
+        return jax.lax.psum(x, ax)
+    if not lane_active(ncol):
+        if phase:
+            record_collective(phase, x.size * 4.0, lane="exact")
+        return jax.lax.psum(x, ax)
     M0 = x.shape[0]
-    M0p = -(-M0 // n_dev) * n_dev
+    M0p = -(-M0 // ncol) * ncol
     if M0p > M0:
         x = jnp.pad(x, ((0, M0p - M0),) + ((0, 0),) * (x.ndim - 1))
-    red = _scatter_lane(x, axis_name, n_dev, phase, passes, lane_axis)
-    full = jax.lax.all_gather(red, axis_name, axis=0, tiled=True)
+    red = _scatter_lane(x, ax, ncol, phase, passes, lane_axis)
+    full = jax.lax.all_gather(red, ax, axis=0, tiled=True)
     if phase:  # the broadcast leaves the full reduced tensor on each device
         record_collective(phase, x.size * 4.0, lane="exact")
     return full[:M0]
+
+
+def exact_psum(x, mesh=None):
+    """Exact f32 ``psum`` over the FULL row-shard device set — the small
+    gain/solve-critical side payloads (packed b/deviance, weight sums,
+    losses). On a 2-D mesh it stages rows-then-cols so its float grouping
+    matches the lane wrappers' stage-1 exactly; on the 1-D mesh it is the
+    stock single-axis psum, bit-for-bit."""
+    from h2o3_tpu.parallel.mesh import COLS_AXIS, get_mesh, is_2d
+
+    m = mesh or get_mesh()
+    if is_2d(m):
+        return jax.lax.psum(jax.lax.psum(x, ROWS_AXIS), COLS_AXIS)
+    return jax.lax.psum(x, ROWS_AXIS)

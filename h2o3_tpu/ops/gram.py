@@ -53,15 +53,19 @@ def weighted_gram_sharded(X, w, z, mesh=None):
     and then to the mesh). Row blocks are contiguous, so device d's slice
     is exactly rows [d·p/P, (d+1)·p/P) of the replicated-einsum G.
     """
-    from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+    from h2o3_tpu.parallel.mesh import (
+        col_axis_name, get_mesh, n_col_shards, row_pspec, shard_map,
+    )
     from jax.sharding import PartitionSpec as Spec
 
     mesh = mesh or get_mesh()
-    n_sh = mesh.shape[ROWS_AXIS]
+    n_sh = int(mesh.devices.size)
     if n_sh <= 1:
         return weighted_gram(X, w, z)
+    n_blk = n_col_shards(mesh)
+    cax = col_axis_name(mesh)
     p = X.shape[1]
-    assert p % n_sh == 0, f"gram width {p} not divisible by {n_sh} shards"
+    assert p % n_blk == 0, f"gram width {p} not divisible by {n_blk} blocks"
 
     from h2o3_tpu.ops import collectives
 
@@ -69,22 +73,25 @@ def weighted_gram_sharded(X, w, z, mesh=None):
         Xw = Xl * wl[:, None]
         G_l = jnp.einsum("np,nq->pq", Xw, Xl, precision=_P)
         b_l = jnp.einsum("np,n->p", Xw, zl, precision=_P)
-        # contiguous row blocks: device d keeps G rows [d*p/P, (d+1)*p/P).
+        # contiguous row blocks: col-block d keeps G rows [d*p/B, (d+1)*p/B)
+        # (on a 2-D mesh an exact rows-axis psum runs first inside the
+        # wrapper and the scatter deals blocks over the cols axis only).
         # The reduce runs through the collective lane (stock psum_scatter
         # when quant is off); passes=2 adds the residual-correction pass —
         # G feeds the solve directly, so it gets ~14 effective mantissa
         # bits instead of bare int8
-        G_blk = collectives.psum_scatter(G_l, n_dev=n_sh, passes=2)
+        G_blk = collectives.psum_scatter(G_l, n_dev=n_sh, passes=2, mesh=mesh)
         # the solve needs the full (p, p) matrix exactly once per iteration
         # — and exactly as reduced: the gather stays f32 (exact lane)
-        G = jax.lax.all_gather(G_blk, ROWS_AXIS, axis=0, tiled=True)
-        b = jax.lax.psum(b_l, ROWS_AXIS)
-        sw = jax.lax.psum(wl.sum(dtype=jnp.float32), ROWS_AXIS)
+        G = jax.lax.all_gather(G_blk, cax, axis=0, tiled=True)
+        b = collectives.exact_psum(b_l, mesh)
+        sw = collectives.exact_psum(wl.sum(dtype=jnp.float32), mesh)
         return G, b, sw
 
+    rspec = row_pspec(mesh)
     return shard_map(
         local, mesh,
-        in_specs=(Spec(ROWS_AXIS, None), Spec(ROWS_AXIS), Spec(ROWS_AXIS)),
+        in_specs=(row_pspec(mesh, ndim=2), rspec, rspec),
         out_specs=(Spec(), Spec(), Spec()),
         check_vma=False,
     )(X, w, z)

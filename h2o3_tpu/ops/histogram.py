@@ -45,9 +45,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel.mesh import (
-    ROWS_AXIS,
+    col_block_spec,
     get_mesh,
+    n_col_shards,
     pad_cols_to_shards,
+    row_pspec,
     shard_map,
 )
 
@@ -236,7 +238,8 @@ def histogram_in_jit(
     mesh = mesh or get_mesh()
     local = _select_local()
     S = len(stats)
-    n_dev = mesh.shape[ROWS_AXIS]
+    n_dev = int(mesh.devices.size)
+    n_col = n_col_shards(mesh)
     C = bins_u8.shape[1]
     Cp = pad_cols_to_shards(C, mesh) if col_sharded else C
 
@@ -255,20 +258,22 @@ def histogram_in_jit(
         # the cross-device reduction runs through the collective lane
         # (ops/collectives.py): stock psum/psum_scatter when the quant lane
         # is off — bit-for-bit the pre-lane program — or the block-
-        # quantized / hierarchical variant when on; the lane records the
-        # hist_reduce byte tally (per lane) itself
+        # quantized / hierarchical variant when on; on a 2-D mesh the lane
+        # itself stages an exact rows-axis psum first and scatters column
+        # blocks over the cols axis only; the lane records the hist_reduce
+        # byte tally (per lane) itself
         # lane_axis=-1: the S stat lanes {w, wy, wh} differ by orders of
         # magnitude and must not share quantization blocks
         if not col_sharded:
             return collectives.psum(
-                h, n_dev=n_dev, phase="hist_reduce", lane_axis=-1)
+                h, n_dev=n_dev, phase="hist_reduce", lane_axis=-1, mesh=mesh)
         if Cp > C:
             # divisibility pad on the HISTOGRAM (cheap: hist-sized, not
             # bins-sized) so C < P and C % P != 0 stay correct with no
             # full-frame column padding anywhere
             h = jnp.pad(h, ((0, Cp - C), (0, 0), (0, 0)))
         return collectives.psum_scatter(
-            h, n_dev=n_dev, phase="hist_reduce", lane_axis=-1)
+            h, n_dev=n_dev, phase="hist_reduce", lane_axis=-1, mesh=mesh)
 
     smat = jnp.stack(list(stats), axis=1)  # (n, S)
 
@@ -280,7 +285,7 @@ def histogram_in_jit(
     # saturated-region entries, by contrast, are scaled by the EXECUTED
     # iteration count at dispatch time (tally_group in collectives.py).
     dense_b = C * n_nodes * n_bins * S * 4
-    scan_b = (Cp / n_dev if col_sharded else C) * n_nodes * n_bins * S * 4
+    scan_b = (Cp / n_col if col_sharded else C) * n_nodes * n_bins * S * 4
     if _local_is_pallas(local):
         from h2o3_tpu.ops.hist_pallas import _tiles, plan_layout
 
@@ -291,12 +296,13 @@ def histogram_in_jit(
 
     # ph_hist: phase tag consumed by tools/profile_fused.py (HLO op_name
     # metadata carries the scope path into the profiler trace)
+    rspec = row_pspec(mesh)
     with jax.named_scope("ph_hist"):
         h = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
-            out_specs=P(ROWS_AXIS) if col_sharded else P(),
+            in_specs=(rspec, rspec, rspec),
+            out_specs=col_block_spec(0, mesh) if col_sharded else P(),
             check_vma=False,
         )(bins_u8, nid, smat)  # (C[p], n_nodes*n_bins, S)
         return jnp.transpose(
@@ -317,12 +323,13 @@ def _histogram_in_jit_fused(
     )
 
     S = len(stats)
-    n_dev = mesh.shape[ROWS_AXIS]
+    n_dev = int(mesh.devices.size)
+    n_col = n_col_shards(mesh)
     C = bins_u8.shape[1]
     is_pallas = _local_is_pallas(local)
     layout = plan_layout(
         C, n_nodes, n_bins, S, tiles=_tiles(),
-        n_shards=n_dev if col_sharded else 1,
+        n_shards=n_col if col_sharded else 1,
     )
 
     from h2o3_tpu.ops import collectives
@@ -334,35 +341,38 @@ def _histogram_in_jit_fused(
                 b, n, s, n_nodes, n_bins,
                 interpret=jax.default_backend() == "cpu",
                 blocked=True, tiles=layout.tiles,
-                n_shards=n_dev if col_sharded else 1,
+                n_shards=n_col if col_sharded else 1,
             )
         else:
             h = blocked_from_dense(local(b, n, s, n_nodes, n_bins), layout)
         # whole-column-tile reduce through the collective lane (quantized /
-        # hierarchical when on, stock otherwise) — it records the
-        # hist_reduce byte tally per lane
+        # hierarchical when on, stock otherwise; 2-D meshes stage the exact
+        # rows-axis psum first) — it records the hist_reduce tally per lane
         if not col_sharded:
-            return collectives.psum(h, n_dev=n_dev, phase="hist_reduce")
-        return collectives.psum_scatter(h, n_dev=n_dev, phase="hist_reduce")
+            return collectives.psum(
+                h, n_dev=n_dev, phase="hist_reduce", mesh=mesh)
+        return collectives.psum_scatter(
+            h, n_dev=n_dev, phase="hist_reduce", mesh=mesh)
 
     smat = jnp.stack(list(stats), axis=1)
     # HBM model (see record_hbm): the blocked tensor is written once by the
     # kernel and its (possibly 1/P) slice read once by the split kernel —
     # no unscramble pass exists. The dense-impl lane re-blocks locally and
     # pays for the dense intermediate it materializes.
-    blk_scan = layout.nbytes / n_dev if col_sharded else layout.nbytes
+    blk_scan = layout.nbytes / n_col if col_sharded else layout.nbytes
     if is_pallas:
         record_hbm("fused", layout.nbytes + blk_scan)
     else:
         dense_b = C * n_nodes * n_bins * S * 4
         record_hbm("fused_via_dense", 2 * dense_b + layout.nbytes + blk_scan)
 
+    rspec = row_pspec(mesh)
     with jax.named_scope("ph_hist"):
         blk = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS)),
-            out_specs=P(ROWS_AXIS) if col_sharded else P(),
+            in_specs=(rspec, rspec, rspec),
+            out_specs=col_block_spec(0, mesh) if col_sharded else P(),
             check_vma=False,
         )(bins_u8, nid, smat)
     return blk, layout

@@ -1,22 +1,28 @@
 from h2o3_tpu.parallel.mesh import (
+    COLS_AXIS,
     ROWS_AXIS,
     get_mesh,
     set_mesh,
+    make_mesh_2d,
     row_sharding,
     replicated_sharding,
     n_shards,
+    n_col_shards,
     shard_rows,
     pad_to_shards,
 )
 from h2o3_tpu.parallel.mrtask import map_reduce, map_only
 
 __all__ = [
+    "COLS_AXIS",
     "ROWS_AXIS",
     "get_mesh",
     "set_mesh",
+    "make_mesh_2d",
     "row_sharding",
     "replicated_sharding",
     "n_shards",
+    "n_col_shards",
     "shard_rows",
     "pad_to_shards",
     "map_reduce",

@@ -2,17 +2,36 @@
 
 H2O forms a static cloud of JVM nodes (``water.H2O.CLOUD`` / ``water.Paxos``
 [UNVERIFIED upstream paths, SURVEY.md §0]) and homes chunk *i* of every Vec on
-a fixed node. Here the "cloud" is a 1-D ``jax.sharding.Mesh`` over all
-addressable devices with a single ``"rows"`` axis: every column of a Frame is
-sharded the same way along rows, which reproduces H2O's aligned chunk layout
-(row-local compute) by construction. Like the H2O cloud, the mesh is static
-once created.
+a fixed node. Here the "cloud" is a ``jax.sharding.Mesh`` over all addressable
+devices: every column of a Frame is sharded the same way along rows, which
+reproduces H2O's aligned chunk layout (row-local compute) by construction.
+Like the H2O cloud, the mesh is static once created.
 
-Multi-host (the H2O multi-node analog) rides the same mesh: ``jax.distributed``
-initializes the coordination service and ``jax.devices()`` spans hosts; XLA
-collectives ride ICI within a slice and DCN across slices. Nothing in the
-algorithm layer knows about hosts — exactly as H2O algorithms never touch
-``water.RPC`` directly.
+Two mesh generations coexist (ISSUE 14):
+
+- **1-D** ``("rows",)`` — the historical default: ONE device axis shards
+  frame rows for the data-parallel phases AND re-shards histogram columns
+  for the split phase (PR 5). Every pre-pod program ever compiled ran on
+  this shape; it stays the single-process default bit-for-bit.
+- **2-D** ``("rows", "cols")`` — the pod shape (``H2O3_TPU_MESH_ROWS``):
+  frame rows shard over BOTH axes (cols-major, so row-shard *i* sits on
+  ``jax.devices()[i]`` exactly like the 1-D mesh and per-process shard
+  ranges stay contiguous — sharded ingest depends on it), histogram/Gram/
+  gradient reductions run stage-1 EXACT over the ``rows`` axis (contiguous
+  device runs — the ICI/intra-host level when rows = local device count)
+  and stage-2 over ``cols`` (the DCN hop), and the split phase's column
+  blocks shard over ``cols`` ONLY — row sharding and the PR-5/PR-6 column
+  blocks finally compose instead of sharing one axis. This is
+  hierarchy-aware reduction placement (arXiv:2110.10548) expressed as mesh
+  structure; the PR-9 quantized lane then compresses exactly the cross-
+  group stage (ops/collectives.py).
+
+Multi-host (the H2O multi-node analog) rides the same mesh:
+``jax.distributed`` initializes the coordination service
+(cluster/multihost.py bootstraps it from env/args) and ``jax.devices()``
+spans hosts; XLA collectives ride ICI within a slice and DCN across slices.
+Nothing in the algorithm layer knows about hosts — exactly as H2O
+algorithms never touch ``water.RPC`` directly.
 """
 
 from __future__ import annotations
@@ -22,6 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ROWS_AXIS = "rows"
+COLS_AXIS = "cols"
 
 # jax moved shard_map to the top level (and renamed check_rep -> check_vma)
 # after 0.4.x; every shard_map in this codebase goes through this one shim so
@@ -51,13 +71,107 @@ def set_mesh(mesh: Mesh | None) -> None:
     _mesh = mesh
 
 
+def _mesh_rows_knob(n_dev: int) -> int:
+    """Resolved ``H2O3_TPU_MESH_ROWS``: how many ROWS-axis groups the
+    process mesh factors into. 0/1/'' = the legacy 1-D mesh; 'auto' = each
+    process's local device count when the cloud spans >1 process (rows =
+    the ICI/intra-host level, cols = hosts) and 1-D otherwise; an integer
+    forces that rows size (the CPU-proxy A/B + test lane — e.g. '2' makes
+    the 8-device proxy a 2x4 pod stand-in). A value that does not divide
+    the device count falls back to 1-D with a warning rather than refusing
+    to form a cloud."""
+    from h2o3_tpu import config
+    from h2o3_tpu.utils.log import Log
+
+    v = config.get("H2O3_TPU_MESH_ROWS").strip().lower()
+    if v in ("", "0", "1", "false"):
+        return 1
+    if v == "auto":
+        try:
+            if jax.process_count() <= 1:
+                return 1
+            r = jax.local_device_count()
+        except RuntimeError:
+            return 1
+    else:
+        r = int(v)
+    if r <= 1:
+        return 1
+    if n_dev % r != 0:
+        Log.warn(
+            f"H2O3_TPU_MESH_ROWS={v} does not divide the {n_dev}-device "
+            "cloud; using the 1-D rows mesh")
+        return 1
+    return r
+
+
+def make_mesh_2d(rows: int, cols: int, devices=None) -> Mesh:
+    """A rows×cols mesh over the first rows*cols ``devices``. The device
+    grid is filled COLS-MAJOR (``mesh.devices[r, c] = devices[c*rows + r]``)
+    so each ``rows``-axis group is a contiguous run of the device list —
+    the intra-host/ICI level when rows = local device count — and so the
+    cols-major row-shard order (:func:`row_pspec`) lands shard *i* on
+    ``devices[i]``, identical to the 1-D mesh's layout."""
+    devices = np.array(jax.devices() if devices is None else devices)
+    grid = devices[: rows * cols].reshape(cols, rows).T
+    return Mesh(grid, (ROWS_AXIS, COLS_AXIS))
+
+
 def get_mesh() -> Mesh:
-    """The process-wide mesh, created lazily over all devices."""
+    """The process-wide mesh, created lazily over all devices: 1-D
+    ``("rows",)`` by default, rows×cols under ``H2O3_TPU_MESH_ROWS``."""
     global _mesh
     if _mesh is None:
         devices = np.array(jax.devices())
-        _mesh = Mesh(devices, (ROWS_AXIS,))
+        r = _mesh_rows_knob(devices.size)
+        if r > 1:
+            _mesh = make_mesh_2d(r, devices.size // r, devices)
+        else:
+            _mesh = Mesh(devices, (ROWS_AXIS,))
     return _mesh
+
+
+def is_2d(mesh: Mesh | None = None) -> bool:
+    """Whether the mesh is the rows×cols pod shape (vs the legacy 1-D)."""
+    return COLS_AXIS in (mesh or get_mesh()).axis_names
+
+
+def row_axes(mesh: Mesh | None = None) -> tuple:
+    """Mesh axes sharding FRAME ROWS, in shard-major order. 2-D meshes
+    shard rows over BOTH axes, cols-major: shard index c*R + r sits on
+    mesh.devices[r, c] = jax.devices()[c*R + r] — the same shard→device map
+    as the 1-D mesh, which keeps per-process shard ranges contiguous (the
+    sharded-ingest and make_array_from_callback contracts)."""
+    m = mesh or get_mesh()
+    return (COLS_AXIS, ROWS_AXIS) if is_2d(m) else (ROWS_AXIS,)
+
+
+def row_pspec(mesh: Mesh | None = None, ndim: int = 1, axis: int = 0) -> P:
+    """PartitionSpec sharding dimension ``axis`` of an ``ndim``-dim array
+    over the frame-row axes (replicated elsewhere)."""
+    ax = row_axes(mesh)
+    spec = [None] * ndim
+    spec[axis] = ax if len(ax) > 1 else ax[0]
+    return P(*spec)
+
+
+def col_axis_name(mesh: Mesh | None = None) -> str:
+    """The mesh axis COLUMN BLOCKS shard over: ``cols`` on a 2-D mesh,
+    the one shared ``rows`` axis on the legacy 1-D mesh."""
+    return COLS_AXIS if is_2d(mesh) else ROWS_AXIS
+
+
+def n_col_shards(mesh: Mesh | None = None) -> int:
+    """How many column blocks the split/Gram/DL scatter phase deals."""
+    m = mesh or get_mesh()
+    return m.shape[col_axis_name(m)]
+
+
+def n_row_groups(mesh: Mesh | None = None) -> int:
+    """Width of the stage-1 exact reduce (the ``rows`` axis of a 2-D mesh;
+    1 on the legacy mesh — no separate stage exists there)."""
+    m = mesh or get_mesh()
+    return m.shape[ROWS_AXIS] if is_2d(m) else 1
 
 
 def reform_mesh() -> Mesh:
@@ -73,39 +187,46 @@ def reform_mesh() -> Mesh:
 
 
 def n_shards() -> int:
-    return get_mesh().shape[ROWS_AXIS]
+    """Row-shard count: the TOTAL device count of the process mesh (frame
+    rows always shard over every device, on either mesh generation)."""
+    return int(get_mesh().devices.size)
 
 
 def row_sharding(mesh: Mesh | None = None) -> NamedSharding:
     """Sharding for a row-partitioned column (1-D or leading-row N-D array)."""
-    return NamedSharding(mesh or get_mesh(), P(ROWS_AXIS))
+    m = mesh or get_mesh()
+    return NamedSharding(m, row_pspec(m))
 
 
 # ---------------------------------------------------------------------------
 # column-block layout (the sharded split pipeline, shared_tree/_split_scan):
-# the SAME 1-D device axis that shards rows for the histogram pass re-shards
-# the histogram's column axis for the split phase — device d owns the
-# contiguous block of columns [d*Cb, (d+1)*Cb). Contiguity is load-bearing:
-# lowest-block-then-lowest-local-index IS lowest-global-index, which is what
-# lets the per-block winner merge reproduce jnp.argmax tie-breaking exactly.
+# on the legacy 1-D mesh the SAME device axis that shards rows for the
+# histogram pass re-shards the histogram's column axis for the split phase;
+# on the 2-D pod mesh column blocks shard over the ``cols`` axis ONLY (the
+# ``rows`` axis finished its exact stage-1 reduce first), so device (r, c)
+# owns the contiguous block of columns [c*Cb, (c+1)*Cb). Contiguity is
+# load-bearing either way: lowest-block-then-lowest-local-index IS
+# lowest-global-index, which is what lets the per-block winner merge
+# reproduce jnp.argmax tie-breaking exactly.
 
 
 def pad_cols_to_shards(n_cols: int, mesh: Mesh | None = None) -> int:
-    """Smallest multiple of the shard count >= n_cols (and >= shard count,
-    so C < P still gives every device a block — the extra blocks hold only
-    zero-histogram padding columns that can never win a split)."""
-    m = (mesh or get_mesh()).shape[ROWS_AXIS]
+    """Smallest multiple of the column-block count >= n_cols (and >= the
+    block count, so C < blocks still gives every block real shape — the
+    extra blocks hold only zero-histogram padding columns that can never
+    win a split)."""
+    m = n_col_shards(mesh)
     return max(m, -(-n_cols // m) * m)
 
 
 def col_block_size(n_cols: int, mesh: Mesh | None = None) -> int:
     """Columns per device block under :func:`pad_cols_to_shards` padding."""
-    return pad_cols_to_shards(n_cols, mesh) // (mesh or get_mesh()).shape[ROWS_AXIS]
+    return pad_cols_to_shards(n_cols, mesh) // n_col_shards(mesh)
 
 
-def col_block_spec(axis: int = 0) -> P:
+def col_block_spec(axis: int = 0, mesh: Mesh | None = None) -> P:
     """PartitionSpec sharding dimension ``axis`` over the column blocks."""
-    return P(*((None,) * axis + (ROWS_AXIS,)))
+    return P(*((None,) * axis + (col_axis_name(mesh),)))
 
 
 def block_quantum(mesh: Mesh | None = None, multiple: int = 8) -> int:
@@ -115,7 +236,7 @@ def block_quantum(mesh: Mesh | None = None, multiple: int = 8) -> int:
     tiling-friendly layout the resident ``pad_to_shards`` rows get — and a
     block-sized sub-frame's device arrays divide the mesh exactly with no
     extra padding rows (padding would perturb block-local reductions)."""
-    return (mesh or get_mesh()).shape[ROWS_AXIS] * multiple
+    return int((mesh or get_mesh()).devices.size) * multiple
 
 
 def stream_block_rows(npad: int, budget_rows: int, mesh: Mesh | None = None) -> int:
@@ -130,12 +251,14 @@ def stream_block_rows(npad: int, budget_rows: int, mesh: Mesh | None = None) -> 
 
 
 def pad_flat_to_shards(n: int, mesh: Mesh | None = None) -> int:
-    """Smallest multiple of the shard count >= max(n, shard count) — the
-    padded length of a FLATTENED parameter/gradient vector so a
-    ``psum_scatter`` over the rows axis deals every device an equal slice
-    (the DL sharded-gradient lane; padded tail entries are zero and their
-    zero gradients keep elementwise optimizer state zero forever)."""
-    m = (mesh or get_mesh()).shape[ROWS_AXIS]
+    """Smallest multiple of the SCATTER-block count >= max(n, blocks) — the
+    padded length of a FLATTENED parameter/gradient vector so the gradient
+    ``psum_scatter`` (over the col-block axis: the whole 1-D mesh, or the
+    ``cols`` axis of a 2-D one after its exact rows stage) deals every
+    block an equal slice (the DL sharded-gradient lane; padded tail entries
+    are zero and their zero gradients keep elementwise optimizer state zero
+    forever)."""
+    m = n_col_shards(mesh)
     return max(m, -(-n // m) * m)
 
 
@@ -150,9 +273,8 @@ def mesh_key() -> tuple:
     from h2o3_tpu.ops.collectives import quant_key
 
     m = get_mesh()
-    return (
-        m.shape[ROWS_AXIS] if hasattr(m, "shape") else 0, id(m), quant_key()
-    )
+    shape = tuple(m.shape.items()) if hasattr(m, "shape") else ()
+    return (shape, id(m), quant_key())
 
 
 # ---------------------------------------------------------------------------
@@ -164,20 +286,26 @@ def mesh_key() -> tuple:
 
 
 def hier_inner(n_dev: int | None = None) -> int:
-    """Inner-group size of the two-stage hierarchical reduction, or 0 for
-    single-stage. ``H2O3_TPU_COLLECTIVE_HIER``: 'auto' groups by the
-    devices each process contributes (the ICI/DCN boundary) when the mesh
-    spans >1 process and the factorization is clean; an integer forces that
-    inner size (the A/B + test lane — e.g. '2' splits the 8-device CPU
-    proxy into 4 fake-ICI pairs); '0'/'' disables."""
+    """Inner-group size of the two-stage hierarchical reduction WITHIN the
+    collective lane's one reduce axis, or 0 for single-stage.
+    ``H2O3_TPU_COLLECTIVE_HIER``: 'auto' groups by the devices each process
+    contributes (the ICI/DCN boundary) when the mesh spans >1 process and
+    the factorization is clean; an integer forces that inner size (the A/B
+    + test lane — e.g. '2' splits the 8-device CPU proxy into 4 fake-ICI
+    pairs); '0'/'' disables. On a 2-D rows×cols mesh the MESH is the
+    hierarchy — stage 1 is the exact ``rows``-axis psum the reduce wrappers
+    already run (ops/collectives.py) — so 'auto' resolves to 0 there and
+    only an explicit integer further subdivides the ``cols`` lane."""
     from h2o3_tpu import config
 
     if n_dev is None:
-        n_dev = n_shards()
+        n_dev = n_col_shards()
     v = config.get("H2O3_TPU_COLLECTIVE_HIER").strip().lower()
     if v in ("0", "", "false"):
         return 0
     if v == "auto":
+        if is_2d():
+            return 0  # the rows axis already reduces the ICI level exactly
         try:
             inner = jax.local_device_count()
         except RuntimeError:
@@ -243,7 +371,7 @@ def pad_to_shards(n: int, mesh: Mesh | None = None, multiple: int = 8) -> int:
     The per-shard row count is kept a multiple of 8 (f32 sublane tile) so
     device layouts stay tiling-friendly.
     """
-    m = (mesh or get_mesh()).shape[ROWS_AXIS]
+    m = int((mesh or get_mesh()).devices.size)
     block = m * multiple
     return max(block, ((_bucket_rows(n) + block - 1) // block) * block)
 

@@ -33,7 +33,7 @@ import time
 import jax
 from jax.sharding import PartitionSpec as P
 
-from h2o3_tpu.parallel.mesh import ROWS_AXIS, get_mesh, shard_map
+from h2o3_tpu.parallel.mesh import get_mesh, row_pspec, shard_map
 from h2o3_tpu.utils import metrics
 
 _DISPATCHES = metrics.counter(
@@ -56,22 +56,26 @@ def _compiled(map_fn: Callable, nargs: int, mesh, reduce: bool):
     if fn is not None:
         return fn
 
+    rspec = row_pspec(mesh)
     if reduce:
+        from h2o3_tpu.ops.collectives import exact_psum
 
         def body(*shards):
             out = map_fn(*shards)
-            return jax.tree.map(lambda a: jax.lax.psum(a, ROWS_AXIS), out)
+            # staged rows-then-cols on a 2-D mesh — same float grouping as
+            # every other exact reduce (ops/collectives.exact_psum)
+            return jax.tree.map(lambda a: exact_psum(a, mesh), out)
 
         out_specs = P()
     else:
         body = map_fn
-        out_specs = P(ROWS_AXIS)
+        out_specs = rspec
 
     fn = jax.jit(
         shard_map(
             body,
             mesh=mesh,
-            in_specs=tuple(P(ROWS_AXIS) for _ in range(nargs)),
+            in_specs=tuple(rspec for _ in range(nargs)),
             out_specs=out_specs,
             check_vma=False,
         )

@@ -224,6 +224,39 @@ class PersistS3(PersistBackend):
         except Exception:  # botocore ClientError 404 — SDK-typed, gated import
             return False
 
+    def probe(self, path: str) -> tuple | None:
+        """ETag-based change etag (ISSUE 14: the serving registry's model
+        store need not be a filesystem): one HEAD per file per poll — the
+        object-store analog of the FS mtime_ns+size stat, never a read."""
+        bucket, key = self._split(path)
+        try:
+            head = self._s3.head_object(Bucket=bucket, Key=key)
+        except Exception:  # 404/permission — watch loop treats as vanished
+            return None
+        return (head.get("ETag", "").strip('"'),
+                int(head.get("ContentLength", 0)))
+
+    def list_dir(self, path: str) -> list[str]:
+        """Direct children of an s3 'directory' (Delimiter-scoped listing —
+        no recursion, no pseudo-directories), paginated."""
+        bucket, key = self._split(path)
+        prefix = key.rstrip("/") + "/" if key else ""
+        names: list[str] = []
+        token = None
+        while True:
+            kw = {"Bucket": bucket, "Prefix": prefix, "Delimiter": "/"}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self._s3.list_objects_v2(**kw)
+            for obj in resp.get("Contents", ()):
+                name = obj["Key"][len(prefix):]
+                if name:  # skip the prefix marker object itself
+                    names.append(name)
+            if not resp.get("IsTruncated"):
+                break
+            token = resp.get("NextContinuationToken")
+        return sorted(names)
+
 
 class PersistGS(PersistBackend):
     """``gs://bucket/key`` via google-cloud-storage (gated)."""
@@ -246,6 +279,26 @@ class PersistGS(PersistBackend):
 
     def exists(self, path: str) -> bool:
         return bool(self._blob(path).exists())
+
+    def probe(self, path: str) -> tuple | None:
+        """Generation/ETag change etag (one metadata GET, never a read).
+        GCS generations are monotone per object — strictly stronger than
+        mtime: an overwrite ALWAYS changes the etag."""
+        blob = self._blob(path)
+        try:
+            blob.reload()
+        except Exception:  # NotFound/permission — treated as vanished
+            return None
+        return (blob.etag or "", int(blob.generation or 0),
+                int(blob.size or 0))
+
+    def list_dir(self, path: str) -> list[str]:
+        p = urllib.parse.urlparse(path)
+        prefix = p.path.lstrip("/")
+        prefix = prefix.rstrip("/") + "/" if prefix else ""
+        it = self._client.list_blobs(p.netloc, prefix=prefix, delimiter="/")
+        names = [b.name[len(prefix):] for b in it]
+        return sorted(n for n in names if n)
 
 
 class PersistHDFS(PersistBackend):

@@ -234,6 +234,70 @@ class ServingRegistry:
                 loaded += 1
         return loaded
 
+    # -- warm boot (ISSUE 14 satellite: ROADMAP 3c) -------------------------
+    def warm_boot(self) -> int:
+        """Pre-page the residency LRU with the watch dir's newest N models
+        (``H2O3_TPU_SERVE_WARM_MODELS``) and precompile their smallest
+        scoring shape bucket, so a fresh HPA replica serves its first
+        request at speed instead of paying model load + device page-in +
+        XLA compile on the request path. Runs once at watcher start,
+        BEFORE the first regular poll (which then picks up the rest).
+        Returns how many models were warmed."""
+        from h2o3_tpu import config, persist
+
+        n_warm = config.get_int("H2O3_TPU_SERVE_WARM_MODELS")
+        watch = _knob("H2O3_TPU_SERVE_WATCH_DIR")
+        if n_warm <= 0 or not watch or not enabled():
+            return 0
+        try:
+            names = persist.list_dir(watch)
+        except Exception:  # noqa: BLE001 — store not mounted yet: the
+            return 0  # regular poll loop keeps trying
+        cand = []
+        for name in names:
+            if name.startswith(".") or name.endswith(".tmp"):
+                continue
+            path = watch.rstrip("/") + "/" + name
+            etag = persist.probe(path)
+            if etag is not None:
+                cand.append((etag, path))
+        try:
+            # FS etags are (mtime_ns, size): newest first. Object-store
+            # etags are content hashes/generations — no time order exists;
+            # the sort is then arbitrary-but-deterministic, which still
+            # bounds warm-up to N models.
+            cand.sort(key=lambda t: t[0], reverse=True)
+        except TypeError:
+            cand.sort(key=lambda t: t[1])
+        warmed = 0
+        for etag, path in cand[:n_warm]:
+            if not self.load_path(path, etag):
+                continue
+            with self._lock:
+                entry = next((e for e in self._entries.values()
+                              if e.current.path == path), None)
+            if entry is None:
+                continue
+            model = entry.current.model
+            try:
+                from h2o3_tpu.serving.scorer import scorer_for
+
+                # one all-NA row through the compiled lane: builds (or
+                # persistent-cache-loads) the smallest batch bucket's
+                # program AND uploads the payload into device residency
+                sc = scorer_for(model)
+                feats = list(getattr(model, "output", {}).get("names") or ())
+                cols, n = sc.prepare([{nm: None for nm in feats}])
+                sc.score_table(cols, n)
+                warmed += 1
+                Log.info(f"serving registry: warmed model {model.key} "
+                         f"(lane {sc.lane}) from {path}")
+            except Exception as e:  # noqa: BLE001 — warm-up must never
+                # block boot; the request path compiles lazily as before
+                Log.warn(f"serving registry: warm-up of {path} failed "
+                         f"({e!r}); the model still serves (lazy compile)")
+        return warmed
+
     # -- the watcher thread -------------------------------------------------
     def install(self) -> bool:
         """Start the watch loop (idempotent). Returns whether a watcher is
@@ -252,6 +316,10 @@ class ServingRegistry:
     def _watch_loop(self) -> None:
         from h2o3_tpu import config
 
+        try:
+            self.warm_boot()  # no-op under H2O3_TPU_SERVE_WARM_MODELS=0
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            Log.err(f"serving registry warm boot failed: {e!r}")
         while not self._stop.is_set():
             try:
                 self.poll_once()

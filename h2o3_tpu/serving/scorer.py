@@ -355,7 +355,13 @@ class BatchScorer:
             self._init_tree(out, gbm_family=isinstance(model, GBMModel))
         elif (isinstance(model, IsolationForestModel) and out.get("trees")
                 and out.get("feature_kinds") is not None
-                and all(k == "num" for k in out["feature_kinds"])):
+                and (all(k == "num" for k in out["feature_kinds"])
+                     or out.get("feature_domains") is not None)):
+            # categorical forests ride the lane when the model carries its
+            # TRAINING-domain feature codes (ISSUE 14) — payload values
+            # then encode through _coerce_cat byte-identically to the
+            # frame path's training-domain remap; older snapshots without
+            # feature_domains stay numeric-only (generic lane otherwise)
             self._init_iforest(out)
         elif (isinstance(model, ExtendedIsolationForestModel)
                 and out.get("stacked_trees")):
@@ -446,6 +452,8 @@ class BatchScorer:
             return  # ragged forest (shouldn't happen): generic lane
         self.lane = "iforest"
         self._names = list(out["names"])
+        self._domains = list(
+            out.get("feature_domains") or [None] * len(self._names))
         self._host_args = {
             "feat": np.stack([np.asarray(f, np.int32) for f, _, _ in trees]),
             "thr": np.stack([np.asarray(t, np.float32)
@@ -533,10 +541,20 @@ class BatchScorer:
                     cols[name] = _coerce_numeric(vals)
             return cols, n
         if self.lane in ("iforest", "eif"):
-            return {
-                name: _coerce_numeric(table.get(name) or [None] * n)
-                for name in self._names
-            }, n
+            doms = (getattr(self, "_domains", None)
+                    if self.lane == "iforest" else None)
+            cols = {}
+            for ci, name in enumerate(self._names):
+                vals = table.get(name) or [None] * n
+                dom = doms[ci] if doms else None
+                # categorical features encode into TRAINING-domain codes
+                # (unseen/None -> -1) — the same floats the frame path's
+                # training-domain remap produces, so the lane stays
+                # byte-equal on categorical frames too
+                cols[name] = (
+                    _coerce_cat(vals, tuple(dom)).astype(np.float32)
+                    if dom else _coerce_numeric(vals))
+            return cols, n
         if self.lane in ("glm", "dl"):
             # normalized to the DataInfo base columns so coalesced batches
             # always concatenate the same column set; the frame-adaptation

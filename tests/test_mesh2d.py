@@ -1,0 +1,387 @@
+"""2-D rows×cols pod mesh (ISSUE 14): row sharding and the PR-5/PR-6
+column blocks compose over separate axes instead of sharing one.
+
+The acceptance pins, exercised on the 8-device CPU proxy's 2-D sub-mesh
+shapes (1×8 / 2×4 / 4×2 / 8×1):
+
+- the PR-5 adversarial tie suites stay BIT-equal between the sharded and
+  replicated split pipelines on every shape, and split decisions are
+  bit-equal ACROSS shapes (the tie data is exact in f32, so any reduce
+  regrouping that changed a decision would show);
+- the legacy 1-D mesh and the degenerate 1×8 2-D mesh produce
+  bit-identical trees (the 2-D generalization is a strict superset);
+- ``histogram_in_jit(col_sharded=True)`` blocks equal the replicated
+  reduction's slices bit-for-bit on every 2-D shape (the stage-1 rows-axis
+  psum is shared by both wrappers);
+- the PR-9 quant/hier lanes ride the 2-D mesh: QUANT=1 keeps the tie
+  suites bit-exact (power-of-two scales) and 'auto' hierarchy resolves to
+  0 there (the mesh IS the hierarchy);
+- streamed (out-of-core) GBM keeps resident split decisions on a 2-D mesh;
+- GLM coefficients and DL predictions match the 1-D mesh within their
+  pinned envelopes on ≥2 genuinely-2-D shapes.
+"""
+
+import contextlib
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from h2o3_tpu.models.tree import shared_tree as st
+from h2o3_tpu.parallel import mesh as pm
+
+SHAPES = [(1, 8), (2, 4), (4, 2), (8, 1)]
+SHAPES_2D = [(2, 4), (4, 2)]  # rows>1 AND cols>1: both stages real
+
+
+@contextlib.contextmanager
+def _use_mesh2d(r: int, c: int):
+    devs = jax.devices("cpu")
+    assert len(devs) >= r * c, "8-device conftest pin did not land"
+    old = pm._mesh
+    pm.set_mesh(pm.make_mesh_2d(r, c, devs))
+    try:
+        yield
+    finally:
+        pm.set_mesh(old)
+
+
+@contextlib.contextmanager
+def _use_mesh1d(k: int):
+    devs = jax.devices("cpu")
+    old = pm._mesh
+    pm.set_mesh(Mesh(np.array(devs[:k]), (pm.ROWS_AXIS,)))
+    try:
+        yield
+    finally:
+        pm.set_mesh(old)
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    old = {k: os.environ.get(k) for k in kv}
+    os.environ.update({k: str(v) for k, v in kv.items()})
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _bits(a) -> bytes:
+    return np.ascontiguousarray(np.asarray(a)).tobytes()
+
+
+def _tree_fields(tree: st.Tree) -> list[dict]:
+    host = tree.to_host()
+    return [
+        {
+            "split_col": lv.split_col, "split_bin": lv.split_bin,
+            "is_cat": lv.is_cat, "na_left": lv.na_left,
+            "leaf_now": lv.leaf_now, "leaf_val": lv.leaf_val,
+            "child_base": lv.child_base, "gain": lv.gain,
+        }
+        for lv in host.levels
+    ]
+
+
+def _assert_trees_bit_equal(a: st.Tree, b: st.Tree, what: str):
+    fa, fb = _tree_fields(a), _tree_fields(b)
+    assert len(fa) == len(fb), what
+    for li, (la, lb) in enumerate(zip(fa, fb)):
+        for k in la:
+            assert _bits(la[k]) == _bits(lb[k]), (
+                f"{what}: level {li} field {k} diverged")
+
+
+def _build_one(bins_np, t_np, *, split_shard: int, max_depth=3, n_bins=16,
+               env=None, seed=5):
+    n, C = bins_np.shape
+    with _env(H2O3_TPU_SPLIT_SHARD=split_shard, **(env or {})):
+        bins = pm.shard_rows(jnp.asarray(bins_np))
+        w = pm.shard_rows(jnp.ones(n, jnp.float32))
+        t = pm.shard_rows(jnp.asarray(t_np, dtype=jnp.float32))
+        preds = pm.shard_rows(jnp.zeros(n, jnp.float32))
+        tree, preds, varimp = st.build_tree(
+            bins, w, t, pm.shard_rows(jnp.ones(n, jnp.float32)),
+            n_bins=n_bins, is_cat_cols=np.zeros(C, bool),
+            max_depth=max_depth, min_rows=1.0, min_split_improvement=0.0,
+            learn_rate=0.1, preds=preds, key=jax.random.PRNGKey(seed),
+            varimp=jnp.zeros(C, jnp.float32), node_cap=2048,
+        )
+        return tree, np.asarray(preds), np.asarray(varimp)
+
+
+def _tie_data(n_pad: int, C: int, n_bins: int, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.integers(1, n_bins, n_pad).astype(np.uint8)
+    bins = np.tile(base[:, None], (1, C))
+    t = np.ones(n_pad, np.float32)  # every candidate gain exactly 0.0
+    return bins, t
+
+
+# ---------------------------------------------------------------------------
+# mesh construction + geometry
+
+
+def test_mesh_rows_knob_builds_2d_and_falls_back():
+    with _env(H2O3_TPU_MESH_ROWS="2"):
+        pm.set_mesh(None)
+        m = pm.get_mesh()
+        assert pm.is_2d(m) and dict(m.shape) == {"rows": 2, "cols": 4}
+        assert pm.n_shards() == 8 and pm.n_col_shards(m) == 4
+        assert pm.n_row_groups(m) == 2
+    with _env(H2O3_TPU_MESH_ROWS="3"):  # does not divide 8 → 1-D fallback
+        pm.set_mesh(None)
+        m = pm.get_mesh()
+        assert not pm.is_2d(m) and dict(m.shape) == {"rows": 8}
+    pm.set_mesh(None)
+    m = pm.get_mesh()  # default stays the legacy 1-D mesh
+    assert not pm.is_2d(m) and pm.n_col_shards(m) == 8
+
+
+def test_2d_mesh_row_shard_order_matches_device_order():
+    """Cols-major row sharding: shard i of a row-sharded array must sit on
+    jax.devices()[i] exactly like the 1-D mesh (per-process contiguity is
+    the sharded-ingest contract)."""
+    devs = jax.devices("cpu")
+    with _use_mesh2d(2, 4):
+        x = pm.shard_rows(np.arange(pm.pad_to_shards(64), dtype=np.float32))
+        per = x.shape[0] // 8
+        for s in x.addressable_shards:
+            lo = int(np.asarray(s.data)[0])
+            assert devs.index(s.device) == lo // per
+
+
+def test_hier_auto_is_zero_on_2d_mesh():
+    with _use_mesh2d(2, 4), _env(H2O3_TPU_COLLECTIVE_HIER="auto"):
+        assert pm.hier_inner(4) == 0
+    with _use_mesh2d(2, 4), _env(H2O3_TPU_COLLECTIVE_HIER="2"):
+        assert pm.hier_inner(4) == 2  # explicit ints still subdivide cols
+
+
+# ---------------------------------------------------------------------------
+# adversarial tie suites over the 2-D shape ladder
+
+
+@pytest.mark.parametrize("r,c", SHAPES)
+def test_tie_suite_sharded_equals_replicated_2d(r, c):
+    with _use_mesh2d(r, c):
+        n_pad = pm.pad_to_shards(960)
+        bins, t = _tie_data(n_pad, C=13, n_bins=16)
+        t1, p1, v1 = _build_one(bins, t, split_shard=1)
+        t0, p0, v0 = _build_one(bins, t, split_shard=0)
+        _assert_trees_bit_equal(t1, t0, f"ties/{r}x{c}")
+        assert _bits(p1) == _bits(p0) and _bits(v1) == _bits(v0)
+        # lowest-global-index tie-break must survive the 2-D merge
+        assert int(np.asarray(t1.levels[0].split_col)[0]) == 0
+
+
+def test_tie_suite_decisions_bit_equal_across_shapes():
+    """Split decisions on the exact-tie suite are bit-equal across every
+    2-D shape AND the legacy 1-D mesh (exact f32 sums: regrouping the
+    reduce cannot change any histogram cell)."""
+    n_pad = pm.pad_to_shards(960)
+    bins, t = _tie_data(n_pad, C=13, n_bins=16, seed=3)
+    with _use_mesh1d(8):
+        t_ref, p_ref, v_ref = _build_one(bins, t, split_shard=1)
+    for r, c in SHAPES:
+        with _use_mesh2d(r, c):
+            t2, p2, v2 = _build_one(bins, t, split_shard=1)
+            _assert_trees_bit_equal(t2, t_ref, f"cross-shape {r}x{c}")
+            assert _bits(p2) == _bits(p_ref) and _bits(v2) == _bits(v_ref)
+
+
+def test_real_signal_preds_close_across_shapes():
+    """Non-tie data: decisions may legitimately differ only if a gain
+    comparison flips on the last f32 bit — preds stay within 1e-6 across
+    shapes (the acceptance envelope)."""
+    n_pad = pm.pad_to_shards(960)
+    rng = np.random.default_rng(7)
+    bins = rng.integers(0, 16, (n_pad, 9)).astype(np.uint8)
+    t = rng.normal(size=n_pad).astype(np.float32)
+    with _use_mesh1d(8):
+        _, p_ref, _ = _build_one(bins, t, split_shard=1)
+    for r, c in SHAPES:
+        with _use_mesh2d(r, c):
+            _, p2, _ = _build_one(bins, t, split_shard=1)
+            np.testing.assert_allclose(p2, p_ref, atol=1e-6)
+
+
+def test_1d_mesh_equals_1x8_2d_bitwise():
+    n_pad = pm.pad_to_shards(700)
+    rng = np.random.default_rng(11)
+    bins = rng.integers(0, 16, (n_pad, 7)).astype(np.uint8)
+    t = rng.normal(size=n_pad).astype(np.float32)
+    with _use_mesh1d(8):
+        ta, pa, va = _build_one(bins, t, split_shard=1)
+    with _use_mesh2d(1, 8):
+        tb, pb, vb = _build_one(bins, t, split_shard=1)
+    _assert_trees_bit_equal(ta, tb, "1d-vs-1x8")
+    assert _bits(pa) == _bits(pb) and _bits(va) == _bits(vb)
+
+
+# ---------------------------------------------------------------------------
+# histogram blocks + quant lane on the 2-D mesh
+
+
+@pytest.mark.parametrize("r,c", SHAPES_2D)
+def test_sharded_histogram_blocks_bit_equal_2d(r, c):
+    from h2o3_tpu.ops.histogram import histogram_in_jit
+
+    with _use_mesh2d(r, c):
+        rng = np.random.default_rng(2)
+        n, C, N, B = pm.pad_to_shards(2000), 7, 8, 16
+        bins = pm.shard_rows(jnp.asarray(
+            rng.integers(0, B, (n, C)), jnp.uint8))
+        nid = pm.shard_rows(jnp.asarray(rng.integers(-1, N, n), jnp.int32))
+        w = pm.shard_rows(jnp.asarray(rng.random(n), jnp.float32))
+        wy = pm.shard_rows(jnp.asarray(rng.normal(size=n), jnp.float32))
+        rep = jax.jit(
+            lambda b, i, *s: histogram_in_jit(b, i, s, N, B)
+        )(bins, nid, w, wy, w)
+        shd = jax.jit(
+            lambda b, i, *s: histogram_in_jit(b, i, s, N, B, col_sharded=True)
+        )(bins, nid, w, wy, w)
+        rep, shd = np.asarray(rep), np.asarray(shd)
+        Cp = pm.pad_cols_to_shards(C)
+        assert Cp % c == 0 and shd.shape[1] == Cp
+        assert _bits(rep) == _bits(shd[:, :C])
+        assert not shd[:, C:].any()
+
+
+def test_quant_lane_tie_suite_bit_exact_on_2d():
+    """QUANT=1 on a genuinely-2-D mesh: the cols-stage quantizes (power-of-
+    two scales, integer payloads ≤127 lossless) after the exact rows-stage
+    psum — the tie suite must stay bit-equal sharded vs replicated."""
+    with _use_mesh2d(2, 4), _env(H2O3_TPU_COLLECTIVE_QUANT="1"):
+        n_pad = pm.pad_to_shards(960)
+        bins, t = _tie_data(n_pad, C=13, n_bins=16, seed=5)
+        t1, p1, v1 = _build_one(bins, t, split_shard=1)
+        t0, p0, v0 = _build_one(bins, t, split_shard=0)
+        _assert_trees_bit_equal(t1, t0, "quant-2d")
+        assert _bits(p1) == _bits(p0) and _bits(v1) == _bits(v0)
+
+
+def test_collective_bytes_record_both_stages_on_2d():
+    """The hist_reduce tally on a 2-D mesh carries the stage-1 exact psum
+    PLUS the cols-stage scatter — strictly more than the pure scatter, and
+    the winner gather shrinks to the cols width."""
+    from h2o3_tpu.utils import metrics as mx
+
+    n_pad = pm.pad_to_shards(700)
+    rng = np.random.default_rng(19)
+    bins = rng.integers(0, 32, (n_pad, 28)).astype(np.uint8)
+    t = rng.normal(size=n_pad).astype(np.float32)
+
+    def run():
+        h0 = mx.counter_value(
+            "tree_collective_bytes_total", phase="hist_reduce")
+        w0 = mx.counter_value(
+            "tree_collective_bytes_total", phase="winner_gather")
+        _build_one(bins, t, split_shard=1, n_bins=32, seed=23)
+        return (
+            mx.counter_value(
+                "tree_collective_bytes_total", phase="hist_reduce") - h0,
+            mx.counter_value(
+                "tree_collective_bytes_total", phase="winner_gather") - w0,
+        )
+
+    with _use_mesh2d(2, 4):
+        h2d, w2d = run()
+    with _use_mesh1d(8):
+        h1d, w1d = run()
+    assert h2d > 0 and w2d > 0
+    assert h2d > h1d  # the exact rows-stage volume is accounted
+    assert w2d < w1d  # winners gather over 4 blocks instead of 8
+
+
+# ---------------------------------------------------------------------------
+# streamed (out-of-core) GBM + GLM + DL on 2-D meshes
+
+
+def _frame(n, c, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    eta = X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2]
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(c)])
+    y = rng.random(n) < 1.0 / (1.0 + np.exp(-eta))
+    df["label"] = np.where(y, "s", "b")
+    from h2o3_tpu.frame.frame import Frame
+
+    return Frame.from_pandas(df)
+
+
+def _p1(model, fr):
+    pf = model.predict(fr)
+    return pf.vec(pf.names[-1]).to_numpy()
+
+
+def _tree_decisions(model):
+    out = []
+    for group in model.output["trees"]:
+        for t in group:
+            h = t.to_host()
+            out.append([(np.asarray(lv.split_col), np.asarray(lv.split_bin),
+                         np.asarray(lv.leaf_now)) for lv in h.levels])
+    return out
+
+
+def test_streamed_gbm_parity_on_2d_mesh():
+    from h2o3_tpu.frame import chunkstore as cs
+    from h2o3_tpu.models.tree import GBM
+
+    with _use_mesh2d(2, 4):
+        kw = dict(ntrees=4, max_depth=4, seed=11, score_tree_interval=2)
+        fr = _frame(3000, 6, seed=7)
+        m_res = GBM(**kw).train(y="label", training_frame=fr)
+        with _env(H2O3_TPU_HBM_WINDOW_BYTES=str(48 * 1024)):
+            fr2 = _frame(3000, 6, seed=7)
+            m_str = GBM(**kw).train(y="label", training_frame=fr2)
+        assert cs.LAST_STORE_STATS["n_blocks"] > 1  # really streamed
+        dres, dstr = _tree_decisions(m_res), _tree_decisions(m_str)
+        assert len(dres) == len(dstr)
+        for tr, ts in zip(dres, dstr):
+            for (c1, b1, l1), (c2, b2, l2) in zip(tr, ts):
+                assert np.array_equal(l1, l2)
+                live = ~l1
+                assert np.array_equal(c1[live], c2[live])
+                assert np.array_equal(b1[live], b2[live])
+        np.testing.assert_allclose(_p1(m_res, fr), _p1(m_str, fr), atol=1e-6)
+
+
+@pytest.mark.parametrize("r,c", SHAPES_2D)
+def test_glm_coef_parity_2d(r, c):
+    from h2o3_tpu.models.glm import GLM
+
+    kw = dict(family="binomial", lambda_=1e-4, max_iterations=10, seed=1)
+    fr = _frame(2000, 6, seed=13)
+    m_ref = GLM(**kw).train(y="label", training_frame=fr)
+    with _use_mesh2d(r, c):
+        fr2 = _frame(2000, 6, seed=13)
+        m_2d = GLM(**kw).train(y="label", training_frame=fr2)
+    delta = max(abs(m_ref.coef[k] - m_2d.coef[k]) for k in m_ref.coef)
+    assert delta < 2e-4, delta  # observed ~3e-7: f32 reduce regrouping only
+
+
+@pytest.mark.parametrize("r,c", SHAPES_2D)
+def test_dl_preds_parity_2d(r, c):
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    kw = dict(hidden=[16], epochs=2, mini_batch_size=200, seed=3)
+    fr = _frame(2000, 6, seed=17)
+    m_ref = DeepLearning(**kw).train(y="label", training_frame=fr)
+    p_ref = _p1(m_ref, fr)
+    with _use_mesh2d(r, c):
+        fr2 = _frame(2000, 6, seed=17)
+        m_2d = DeepLearning(**kw).train(y="label", training_frame=fr2)
+        p_2d = _p1(m_2d, fr2)
+    np.testing.assert_allclose(p_2d, p_ref, atol=1e-4)  # PR-8 envelope

@@ -391,7 +391,7 @@ def quant_ab(rows: int = 16_000, cols: int = 12) -> None:
     from h2o3_tpu.models.tree import GBM
     from h2o3_tpu.ops import collectives
     from h2o3_tpu.parallel.mesh import (
-        ROWS_AXIS, get_mesh, pad_cols_to_shards, shard_map)
+        col_axis_name, get_mesh, pad_cols_to_shards, shard_map)
     from h2o3_tpu.utils import metrics as mx
 
     mesh = get_mesh()
@@ -404,7 +404,8 @@ def quant_ab(rows: int = 16_000, cols: int = 12) -> None:
         fn = jax.jit(shard_map(
             lambda v: collectives.psum_scatter(
                 v, n_dev=n_dev, lane_axis=-1),
-            mesh=mesh, in_specs=(Spec(),), out_specs=Spec(ROWS_AXIS),
+            mesh=mesh, in_specs=(Spec(),),
+            out_specs=Spec(col_axis_name(mesh)),
             check_vma=False))
         out = fn(hist)
         jax.block_until_ready(out)
@@ -559,6 +560,93 @@ def oocore_ab(rows: int = 120_000, cols: int = 12) -> None:
         }}), flush=True)
 
 
+def mesh2d_ab(rows: int = 10_000, cols: int = 28, depth: int = 6,
+              trees: int = 4) -> None:
+    """1-D vs 2-D mesh A/B (H2O3_TPU_MESH_ROWS, ISSUE 14) on the SAME
+    device set and data: the legacy 1-D rows mesh against the 2x4 (and
+    4x2) rows×cols pod meshes — per mode, fused tree seconds plus the
+    collective bytes BY PHASE (hist_reduce including the 2-D stage-1 exact
+    rows psum, winner_gather shrinking to the cols width), then a
+    {"mesh2d_ab": ...} summary with the acceptance pins (per-phase bytes
+    recorded on every shape; 2-D fused_tree_s no worse than ~1-D on the
+    proxy). On the CPU proxy all 8 'devices' are one host's threads — the
+    placement claim (exact stage intra-host, quantized stage cross) is the
+    queued v5e-16 pod bracket's number; the proxy pins correctness and the
+    no-regression bound."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.models.tree import shared_tree as st
+    from h2o3_tpu.parallel import mesh as pm
+    from h2o3_tpu.utils import metrics as mx
+
+    def grad_fn(F, y_, w_):  # gaussian residuals, unit hessian
+        return y_ - F, jnp.ones_like(F)
+
+    phases = ("hist_reduce", "winner_gather")
+    results = {}
+    for mode, shape in (("1d", None), ("2x4", (2, 4)), ("4x2", (4, 2))):
+        pm.set_mesh(None if shape is None else pm.make_mesh_2d(*shape))
+        n = pm.pad_to_shards(rows)
+        rng = np.random.default_rng(0)
+        bins = pm.shard_rows(jnp.asarray(
+            rng.integers(0, 128, (n, cols)).astype(np.uint8)))
+        y = pm.shard_rows(jnp.asarray(rng.normal(size=n).astype(np.float32)))
+        w = pm.shard_rows(jnp.ones(n, jnp.float32))
+        times = []
+        b0 = {ph: mx.counter_value("tree_collective_bytes_total", phase=ph)
+              for ph in phases}
+        for rep in range(4):  # rep 0 = compile warmup
+            preds = pm.shard_rows(jnp.zeros(n, jnp.float32))
+            varimp = jnp.zeros(cols, jnp.float32)
+            t0 = time.perf_counter()
+            out = st.build_trees_scanned(
+                bins, w, y, preds, varimp, jax.random.PRNGKey(7), trees,
+                grad_fn=grad_fn, grad_key="gaussian-m2d", sample_rate=1.0,
+                n_bins=128, is_cat_cols=np.zeros(cols, bool),
+                max_depth=depth, min_rows=10.0, min_split_improvement=1e-5,
+                learn_rates=np.full(trees, 0.1, np.float32),
+                max_abs_leaf=float("inf"), col_sample_rate=1.0,
+                col_sample_rate_per_tree=1.0,
+            )
+            jax.block_until_ready(out[0])
+            if rep:
+                times.append(time.perf_counter() - t0)
+        built = 4 * trees
+        by_phase = {
+            ph: round((mx.counter_value(
+                "tree_collective_bytes_total", phase=ph) - b0[ph]) / built, 1)
+            for ph in phases
+        }
+        rec = {
+            "phase": "mesh2d_ab", "mode": mode,
+            "mesh": dict(pm.get_mesh().shape),
+            "n_devices": int(pm.get_mesh().devices.size),
+            "rows": n, "cols": cols, "depth": depth, "trees": trees,
+            "fused_tree_s": round(sorted(times)[len(times) // 2] / trees, 4),
+            "psum_bytes_by_phase": by_phase,
+            "psum_bytes_per_tree": round(sum(by_phase.values()), 1),
+        }
+        print(json.dumps(rec), flush=True)
+        results[mode] = rec
+    pm.set_mesh(None)
+    if len(results) == 3:
+        r1, r2 = results["1d"], results["2x4"]
+        print(json.dumps({"mesh2d_ab": {
+            "time_ratio_2x4_over_1d": round(
+                r2["fused_tree_s"] / max(r1["fused_tree_s"], 1e-9), 3),
+            "time_ratio_4x2_over_1d": round(
+                results["4x2"]["fused_tree_s"]
+                / max(r1["fused_tree_s"], 1e-9), 3),
+            "winner_gather_ratio_1d_over_2x4": round(
+                r1["psum_bytes_by_phase"]["winner_gather"]
+                / max(r2["psum_bytes_by_phase"]["winner_gather"], 1), 2),
+            "phases_recorded_all_modes": all(
+                all(v > 0 for v in r["psum_bytes_by_phase"].values())
+                for r in results.values()),
+        }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -635,5 +723,7 @@ if __name__ == "__main__":
         quant_ab(**kw)
     elif "--oocore-ab" in sys.argv:
         oocore_ab(**kw)
+    elif "--mesh2d-ab" in sys.argv:
+        mesh2d_ab(**kw)
     else:
         main()

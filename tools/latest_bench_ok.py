@@ -210,6 +210,61 @@ def _oocore_ab_ok(here: str, now: float):
         return False
 
 
+def _mesh2d_ab_ok(here: str, now: float):
+    """Sanity-check the newest recent MESH2D_AB_*.jsonl (bench_kernel_sweep
+    --mesh2d-ab, the 1-D vs 2-D pod-mesh A/B, ISSUE 14). Returns None when
+    no recent artifact exists (no opinion), else True/False. Checks the
+    acceptance pins: collective bytes recorded BY PHASE on every mesh shape
+    (a zero phase means the 2-D tally broke), the winner gather shrank with
+    the cols width, and 2x4 fused_tree_s held within 1.10x of the 1-D mesh
+    — 'no worse' up to proxy noise: on the one-host CPU proxy the stage-1
+    rows psum is pure emulation overhead with none of the ICI placement
+    payoff, so a small regression is expected there and the real
+    ICI-vs-DCN number is the queued v5e-16 pod bracket's."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "MESH2D_AB_*.jsonl")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "mesh2d_ab" in d:
+                    summary = d["mesh2d_ab"]
+        if not summary:
+            print(f"{name}: NO mesh2d_ab summary line")
+            return False
+        if not summary.get("phases_recorded_all_modes"):
+            print(f"{name}: a mesh shape recorded ZERO bytes for a phase")
+            return False
+        ratio = float(summary.get("time_ratio_2x4_over_1d") or 0)
+        if not 0 < ratio <= 1.10:
+            print(f"{name}: 2x4 fused_tree_s ratio {ratio} outside (0, 1.10]")
+            return False
+        wg = float(summary.get("winner_gather_ratio_1d_over_2x4") or 0)
+        if not wg >= 1.5:
+            print(f"{name}: winner gather did not shrink with cols ({wg})")
+            return False
+        print(f"{name}: phases=ok 2x4-time-ratio={ratio} "
+              f"winner-gather-ratio={wg} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def _fleet_ok(here: str, now: float):
     """Sanity-check the newest recent FLEET_*.json (tools/load_test.py
     --fleet, the serving-plane oversubscription A/B). Returns None when no
@@ -290,6 +345,11 @@ def main() -> int:
     # the oversubscription acceptance pins or the window stands
     fl = _fleet_ok(here, now)
     if fl is False:
+        return 1
+    # 2-D pod-mesh gate (ISSUE 14): a recent --mesh2d-ab artifact must
+    # satisfy the no-regression + per-phase-bytes pins or the window stands
+    m2 = _mesh2d_ab_ok(here, now)
+    if m2 is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -222,3 +222,68 @@ save "FLIGHTREC_${stamp}.json" "HBM attribution + flight-recorder capture under 
 timeout 900 python tools/profile_train_stages.py \
   | tee "STAGES_${stamp}.json"
 save "STAGES_${stamp}.json" "Stage wall-time attribution (cross-check for dispatch_device_seconds)"
+
+# ---------------------------------------------------------------------------
+# v5e-16 POD BRACKET (ISSUE 14): the multihost runs proper. Everything above
+# measures one process; these need the 4-host pod brought up via
+# deploy/k8s.yaml (or 4 x `python -m h2o3_tpu.launch` with
+# H2O3_TPU_COORDINATOR/H2O3_TPU_NUM_PROCESSES set). They are written to run
+# ON RANK 0 of a formed pod; single-process runs of the same commands are
+# still valid (degenerate pod) and keep the artifacts comparable.
+
+# 2-D mesh A/B on the pod: 1-D vs rows×cols (stage-1 exact reduce over ICI,
+# quantized stage over DCN — the placement claim arXiv:2110.10548 makes).
+# On the pod this is the number that decides H2O3_TPU_MESH_ROWS=auto's
+# default: the CPU-proxy artifact (MESH2D_AB_*_cpu8proxy.jsonl) only pins
+# no-regression, because its one-host topology has no cheap/expensive
+# split for the placement to exploit.
+timeout 1200 python tools/bench_kernel_sweep.py --mesh2d-ab --rows 1000000 \
+  | tee "MESH2D_AB_${stamp}.jsonl"
+save "MESH2D_AB_${stamp}.jsonl" "1-D vs 2-D pod-mesh A/B (1M rows: per-phase bytes + tree wall)"
+
+# pod-mesh bench headline: the full pipeline under MESH_ROWS=auto (2-D on
+# the pod), with the 1-D control
+H2O3_TPU_MESH_ROWS=auto H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_mesh2d.json"
+save "BENCH_builder_${stamp}_mesh2d.json" "TPU bench 2-D pod-mesh headline (headline only)"
+
+# sharded-ingest timing: per-host byte-range parses vs the single-host
+# parse on the pod's shared filesystem — wall time for the 1M-row CSV and
+# the byte-parity pin (the single-process lane re-checks it in-tree; the
+# pod number is the scaling claim: ingest wall should fall ~linearly with
+# hosts until storage saturates)
+timeout 1200 python - << 'PYEOF'
+import json, time
+import h2o3_tpu, bench
+from h2o3_tpu.frame.parse import parse, parse_sharded
+
+h2o3_tpu.init(log_level="WARN")
+csv = bench.make_csv() if hasattr(bench, "make_csv") else None
+if csv is None:
+    import numpy as np, pandas as pd, tempfile
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame(rng.normal(size=(1_000_000, 28)),
+                      columns=[f"x{i}" for i in range(28)])
+    csv = tempfile.mktemp(suffix=".csv"); df.to_csv(csv, index=False)
+t0 = time.perf_counter(); a = parse({"source_frames": [csv]}); t_one = time.perf_counter() - t0
+t0 = time.perf_counter(); b = parse_sharded({"source_frames": [csv]}); t_shard = time.perf_counter() - t0
+import numpy as np
+eq = all(np.asarray(a.vec(c).to_numpy(), np.float32).tobytes()
+         == np.asarray(b.vec(c).to_numpy(), np.float32).tobytes()
+         for c in a.names[:4])
+print(json.dumps({"phase": "ingest_ab", "rows": a.nrow,
+                  "single_host_s": round(t_one, 3),
+                  "sharded_s": round(t_shard, 3),
+                  "byte_equal_probe": bool(eq)}), flush=True)
+PYEOF
+
+# induced-preemption recovery drill on the pod: kill ONE RANK of the formed
+# pod mid-GBM (a real member death, not the in-process die: fault) — the
+# coordination service fail-stops every rank, the k8s restart loop
+# (H2O3_TPU_POD_EXIT_DEGRADED) brings the pod back, and the supervisor
+# resumes from the interval snapshot. recovery_seconds (metrics + flight
+# recorder) is the headline: detection (heartbeat) + restart + re-formation
+# + recompile + resume on real hardware.
+timeout 2400 python tools/recovery_drill.py \
+  --out "POD_RECOVERY_${stamp}.json" > /dev/null
+save "POD_RECOVERY_${stamp}.json" "Pod preemption drill: member death -> restart loop -> supervised resume (recovery_seconds)"
