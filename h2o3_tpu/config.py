@@ -45,9 +45,10 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "candidates reach HBM. 'auto' = on for non-CPU backends; "
                 "'1' forces it on any backend (CPU runs the kernels in the "
                 "Pallas interpreter — the CI/parity lane); '0' = the "
-                "unfused path (dense histogram + XLA split scan). Monotone-"
-                "constraint builds and, on >1-device meshes, frames with "
-                "categorical columns always use the unfused path (see "
+                "unfused path (dense histogram + XLA split scan). Monotone "
+                "builds and categorical columns on sharded meshes fuse too "
+                "(ISSUE 15); only uplift trees keep their own unfused scan "
+                "(tree_fused_fallbacks_total tallies — see the "
                 "docs/MIGRATION.md fallback matrix)"),
     "H2O3_TPU_PALLAS_TILES": (
         "", "Pallas histogram/split kernel tile sizes as 'ROW,COL,NODE' "
@@ -55,7 +56,12 @@ _KNOBS: dict[str, tuple[str, str]] = {
             "compile key: every setting gets its own executable, so the "
             "tile sweep (tools/bench_kernel_sweep.py, run_tpu_backlog.sh) "
             "varies them via the environment with no monkeypatching. "
-            "'' = built-in defaults"),
+            "'auto' = the tile AUTOTUNER: a first-build micro-sweep over a "
+            "small tile grid, cached per (shape-bucket, mesh) in the "
+            "persistent compile-cache dir — same-bucket rebuilds (and "
+            "later processes) perform zero new sweeps "
+            "(pallas_tile_sweeps_total); explicit values bypass the sweep "
+            "unchanged. '' = built-in defaults"),
     "H2O3_TPU_SPLIT_SHARD": (
         "1", "column-sharded split pipeline on meshes with >1 device: the "
              "histogram reduction ends in a reduce-scatter over column "
@@ -76,10 +82,13 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "N>=1 forces chunk size N; '0' restores the per-iteration "
                 "host-solve path bit-for-bit. With export_checkpoints_dir "
                 "set the chunk is clamped to 1 so PR-2 irls_state snapshots "
-                "land at every iteration boundary. Fallback matrix "
-                "(docs/MIGRATION.md): compute_p_values, multinomial "
-                "cycling, ordinal and L_BFGS solves stay on their existing "
-                "paths"),
+                "land at every iteration boundary (multinomial included — "
+                "its cycling IRLS now fuses as a lax.scan over classes "
+                "inside one while_loop, and ordinal fits run one on-device "
+                "BFGS program; ISSUE 15). Fallback matrix "
+                "(docs/MIGRATION.md): compute_p_values, L_BFGS and "
+                "out-of-core streamed fits stay on their existing paths "
+                "(glm_fuse_fallbacks_total tallies)"),
     "H2O3_TPU_DL_EPOCH_CHUNK": (
         "auto", "DeepLearning epoch fusion: fold this many epochs into ONE "
                 "compiled program per dispatch with donated (params, "
@@ -98,10 +107,15 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "updates only its parameter shard and the updated params "
                 "all_gather for the next step (ZeRO-style; replaces the "
                 "replicated allreduce+update). 'auto' = on for >1-device "
-                "meshes when eligible (no dropout, elementwise optimizer "
-                "state, mini_batch_size divisible by the shard count); "
-                "'0' = always replicated; '1' = on when eligible. "
-                "Ineligible configs always use the replicated reduce"),
+                "meshes when eligible (elementwise optimizer state, "
+                "mini_batch_size divisible by the shard count; dropout "
+                "composes since ISSUE 15 — each device folds its shard "
+                "index into the dropout key); '0' = always replicated "
+                "(today's full-batch masks); '1' = on when eligible; "
+                "'ctl' = the replicated PARITY CONTROL drawing the sharded "
+                "lane's exact per-chunk dropout masks (the A/B lane). "
+                "Ineligible configs use the replicated reduce and tally "
+                "dl_shard_fallbacks_total"),
     "H2O3_TPU_COLLECTIVE_QUANT": (
         "auto", "block-quantized collective lane (ops/collectives.py, "
                 "EQuARX-style) for the hot reduces — the tree histogram "

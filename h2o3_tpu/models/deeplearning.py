@@ -66,6 +66,17 @@ _COLL_BYTES = _mx.counter(
     "per-device collective payload bytes moved by tree builds (replication-"
     "volume model), by phase", always=True)
 
+# Fallback observability (ISSUE 15): trainings that WANT the sharded
+# gradient lane (knob on, >1-device mesh) but drop to the replicated
+# reduce for a structural reason. Dropout is no longer one — the dropout
+# key folds the shard index per device (see _dl_chunk_program) — leaving
+# batch divisibility and non-elementwise optimizer state.
+_DL_SHARD_FALLBACKS = _mx.counter(
+    "dl_shard_fallbacks_total",
+    "DeepLearning trainings that fell back from the sharded-gradient lane "
+    "while the knob was on and the mesh had >1 device, by structural "
+    "reason", always=True)
+
 # epoch-chunk program cache: (shape bucket, net/optimizer descriptor,
 # lanes, mesh, backend) -> compiled chunk
 _DL_PROGRAMS: dict = {}
@@ -108,18 +119,43 @@ def _dl_grad_shard(p, dropout, input_dropout, batch: int, opt_ok: bool) -> bool:
     psum_scatter the flat gradient, update only the local parameter shard,
     all_gather the updated params — instead of the replicated
     allreduce+full-update. Eligible when the mesh has >1 device, the batch
-    splits evenly over it, no dropout is active (per-shard RNG would
-    decorrelate masks) and the optimizer state is elementwise."""
+    splits evenly over it and the optimizer state is elementwise. Dropout
+    composes since ISSUE 15: each device folds its shard index into the
+    minibatch dropout key (``H2O3_TPU_DL_GRAD_SHARD=ctl`` is the matching
+    replicated parity-control lane — see :func:`_dl_dropout_ctl`).
+    Structural ineligibility tallies ``dl_shard_fallbacks_total``."""
     from h2o3_tpu import config
     from h2o3_tpu.parallel.mesh import n_shards
 
     raw = config.get("H2O3_TPU_DL_GRAD_SHARD").strip().lower()
-    if raw == "0":
+    if raw in ("0", "ctl"):
         return False
     n_sh = n_shards()
-    return (n_sh > 1 and batch % n_sh == 0 and opt_ok
-            and float(input_dropout) == 0.0
-            and all(float(d) == 0.0 for d in dropout))
+    if n_sh <= 1:
+        return False
+    ok = batch % n_sh == 0 and opt_ok
+    if not ok:
+        _DL_SHARD_FALLBACKS.inc(
+            reason="batch_indivisible" if batch % n_sh else "opt_state")
+    return ok
+
+
+def _dl_dropout_ctl(p, dropout, input_dropout) -> int:
+    """Shard count for the ``H2O3_TPU_DL_GRAD_SHARD=ctl`` parity-control
+    lane: the REPLICATED trainer draws its dropout masks in n_shards
+    contiguous batch chunks with the sharded lane's exact per-chunk key
+    folds, so a ctl run is the trajectory-parity control for the sharded
+    dropout run (same masks, replicated math). 0 = not the ctl lane or no
+    dropout to control for."""
+    from h2o3_tpu import config
+    from h2o3_tpu.parallel.mesh import n_shards
+
+    raw = config.get("H2O3_TPU_DL_GRAD_SHARD").strip().lower()
+    if raw != "ctl":
+        return 0
+    if float(input_dropout) == 0.0 and all(float(d) == 0.0 for d in dropout):
+        return 0  # no masks to align — plain replicated lane
+    return n_shards()
 
 
 def _state_to_flat(opt_state, params, tx, fpad: int):
@@ -199,7 +235,7 @@ class _MLP(nn.Module):
 
 def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
                       n_chunk: int, shard_on: bool, unravel=None,
-                      n_real: int = 0, fpad: int = 0):
+                      n_real: int = 0, fpad: int = 0, ctl_shards: int = 0):
     """Build (or fetch) the compiled K-epochs-per-dispatch training chunk.
 
     One program runs ``n_chunk`` whole epochs: an outer fori over the
@@ -215,17 +251,24 @@ def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
     ``(fpad,)`` vectors: each device grads its local batch rows, the flat
     gradient ends in a ``psum_scatter`` (each device keeps 1/P), the
     elementwise optimizer updates only that shard, and one ``all_gather``
-    republishes the updated parameters for the next forward.
+    republishes the updated parameters for the next forward. With dropout
+    active (ISSUE 15), each device folds its flat shard index into the
+    minibatch dropout key before the forward — batch rows are contiguous
+    per shard, so a replicated trainer drawing its masks in the same
+    per-chunk folds reproduces the identical masks: that is the
+    ``ctl_shards`` lane (``H2O3_TPU_DL_GRAD_SHARD=ctl``), the
+    trajectory-parity control for the sharded dropout run.
     """
     import jax.tree_util as jtu
 
     from h2o3_tpu.parallel.mesh import (
-        col_axis_name, get_mesh, mesh_key, n_col_shards, row_pspec, shard_map,
+        col_axis_name, get_mesh, mesh_key, n_col_shards, row_axes, row_pspec,
+        shard_map,
     )
     from jax.sharding import PartitionSpec as Spec
 
     key = ("dl_chunk", desc, batch, npad, n_chunk, bool(shard_on),
-           mesh_key(), jax.default_backend())
+           int(ctl_shards), mesh_key(), jax.default_backend())
     fn = _DL_PROGRAMS.get(key)
     if fn is not None:
         _DL_HITS.inc()
@@ -249,19 +292,49 @@ def _dl_chunk_program(desc, mlp, tx, kind: str, batch: int, npad: int,
         pen = l2 * 0.5 * sum(jnp.sum(q**2) for q in jax.tree.leaves(prm))
         return pen + l1 * sum(jnp.sum(jnp.abs(q)) for q in jax.tree.leaves(prm))
 
+    def row_loss_ctl(prm, xb, yb, kb):
+        """The ctl parity lane's row loss: the SAME masks as the sharded
+        lane — the batch in ``ctl_shards`` contiguous chunks, chunk d's
+        dropout drawn from fold_in(kb, d), vmapped (identical bits to
+        per-chunk applies)."""
+        D = xb.shape[1]
+        xbr = xb.reshape(ctl_shards, batch // ctl_shards, D)
+        ybr = yb.reshape(ctl_shards, batch // ctl_shards)
+        keys = jax.vmap(lambda i: jax.random.fold_in(kb, i))(
+            jnp.arange(ctl_shards, dtype=jnp.int32))
+        ll = jax.vmap(row_loss, in_axes=(None, 0, 0, 0))(prm, xbr, ybr, keys)
+        return ll.reshape(batch)
+
     def loss_fn(prm, xb, yb, wb, kb, l1, l2):
-        ll = row_loss(prm, xb, yb, kb)
+        rl = row_loss_ctl if ctl_shards > 1 else row_loss
+        ll = rl(prm, xb, yb, kb)
         loss = jnp.sum(wb * ll) / jnp.maximum(jnp.sum(wb), 1e-9)
         return loss + penalties(prm, l1, l2)
+
+    has_drop = float(mlp.input_dropout) > 0 or any(
+        float(d) > 0 for d in mlp.dropout)
 
     if shard_on:
         mesh = get_mesh()
         n_sh = int(mesh.devices.size)
         cax = col_axis_name(mesh)
+        raxes = row_axes(mesh)
         fb = fpad // n_col_shards(mesh)
 
         def shard_step(prm_flat, ost, xb, yb, wb, bk, l1, l2):
             def local(prm_flat, ost_l, xb_l, yb_l, wb_l, bk, l1, l2):
+                # dropout composes with sharding (ISSUE 15): fold the FLAT
+                # row-shard index into the minibatch key so each device
+                # draws its own rows' masks — shard-major order matches
+                # row_axes, so the ctl lane's per-chunk folds reproduce
+                # the identical mask sequence. No-dropout nets skip the
+                # fold: their traced program stays byte-identical
+                if has_drop:
+                    sidx = jax.lax.axis_index(raxes[0])
+                    for a in raxes[1:]:
+                        sidx = sidx * mesh.shape[a] + jax.lax.axis_index(a)
+                    bk = jax.random.fold_in(bk, sidx)
+
                 def wsum_loss(pf):
                     prm = unravel(pf[:n_real])
                     return jnp.sum(wb_l * row_loss(prm, xb_l, yb_l, bk))
@@ -380,6 +453,9 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
     shard_on = _dl_grad_shard(
         p, dropout, p.input_dropout_ratio, batch, _flat_state_ok(opt_state, params)
     )
+    ctl = _dl_dropout_ctl(p, dropout, p.input_dropout_ratio)
+    if ctl and batch % ctl:
+        ctl = 0  # the sharded lane it controls for would be ineligible too
     n_sh = n_shards()
     # the FULL network + optimizer identity: n_out matters even at equal
     # hidden/width (a cached program's closed-over mlp bakes the output
@@ -443,7 +519,7 @@ def _run_sync_sgd(job, p, mlp, kind, tx, params, opt_state, X, y, w,
             perms[j, :nrow] = rng.permutation(nrow)
         prog = _dl_chunk_program(
             desc, mlp, tx, kind, batch, npad, k_i, shard_on,
-            unravel=unravel, n_real=n_real, fpad=fpad,
+            unravel=unravel, n_real=n_real, fpad=fpad, ctl_shards=ctl,
         )
         _DL_DISPATCHES.inc()
         from h2o3_tpu.utils import flightrec as _fr
@@ -508,6 +584,9 @@ def _run_sync_sgd_streamed(job, p, mlp, kind, tx, params, opt_state, store,
         p, dropout, p.input_dropout_ratio, batch,
         _flat_state_ok(opt_state, params),
     )
+    ctl = _dl_dropout_ctl(p, dropout, p.input_dropout_ratio)
+    if ctl and batch % ctl:
+        ctl = 0
     n_sh = n_shards()
     D = store.lane("X").shape[1]
     desc = (tuple(int(h) for h in mlp.hidden), mlp.activation.lower(),
@@ -571,7 +650,7 @@ def _run_sync_sgd_streamed(job, p, mlp, kind, tx, params, opt_state, store,
                 (np.arange(blk_rows) < real[bi]).astype(np.float32))
             prog = _dl_chunk_program(
                 desc, mlp, tx, kind, batch, blk_rows, 1, shard_on,
-                unravel=unravel, n_real=n_real, fpad=fpad,
+                unravel=unravel, n_real=n_real, fpad=fpad, ctl_shards=ctl,
             )
             _DL_DISPATCHES.inc()
             from h2o3_tpu.utils import flightrec as _fr

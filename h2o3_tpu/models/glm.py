@@ -86,6 +86,17 @@ _COLL_BYTES = _mx.counter(
     "per-device collective payload bytes moved by tree builds (replication-"
     "volume model), by phase", always=True)
 
+# Fallback observability (ISSUE 15): fits that WANT the fused while_loop
+# lane (the knob says fuse) but drop to a slow lane for a structural
+# reason — p_values pins the host-f64 trajectory, out-of-core streaming
+# needs per-block host accumulation, a singular-in-f32 chunk drops its
+# lambda to the host f64 tail, and a rejected fused-ordinal optimum falls
+# back to the scipy driver.
+_GLM_FALLBACKS = _mx.counter(
+    "glm_fuse_fallbacks_total",
+    "GLM fits (or lambda steps) that fell back from the fused while_loop "
+    "lane while the fuse knob was on, by structural reason", always=True)
+
 # fused IRLS chunk program cache: (shape bucket, family, solver branch,
 # mesh, backend) -> compiled chunk. The shape-bucket ladder (rows ride the
 # frame's bucketed npad; design columns pad to a multiple of 4 below) makes
@@ -107,6 +118,7 @@ def _glm_fuse_chunk(params) -> int:
     if raw == "0":
         return 0
     if getattr(params, "compute_p_values", False):
+        _GLM_FALLBACKS.inc(reason="p_values")
         return 0
     k = int(raw) if raw.isdigit() else 8
     if getattr(params, "export_checkpoints_dir", None):
@@ -318,6 +330,145 @@ def _fused_chunk_program(npad, p_pad, family_key, fam_args, l1_on,
     return fn
 
 
+def _fused_multinomial_program(npad, p_pad, K, l1_on, non_negative):
+    """Build (or fetch) the compiled fused multinomial cycling-IRLS chunk
+    (ISSUE 15): ONE ``lax.while_loop`` runs up to ``kmax`` outer iterations
+    per dispatch, each iteration a ``lax.scan`` over the K classes — class
+    k's Gram pass sees the classes already updated this iteration, exactly
+    the host loop's in-place cycling — with the sharded-Gram psum_scatter
+    and the on-device Cholesky/ADMM solve per class reused from the
+    single-response lane. The convergence exit replays the host rule
+    (relative -2LL change from the LAST class's pass); any non-finite f32
+    class solve sets ``bad``, discards that iteration's Beta wholesale and
+    exits so the host float64 cycling tail takes over mid-trajectory."""
+    from jax.sharding import PartitionSpec as Spec
+
+    from h2o3_tpu.parallel.mesh import (
+        col_axis_name, get_mesh, mesh_key, row_pspec, shard_map,
+    )
+
+    key = ("glm_multinom_chunk", npad, p_pad, K, bool(l1_on),
+           bool(non_negative), mesh_key(), jax.default_backend())
+    fn = _GLM_PROGRAMS.get(key)
+    if fn is not None:
+        _GLM_HITS.inc()
+        return fn
+    _GLM_COMPILED.inc()
+
+    mesh = get_mesh()
+    n_sh = int(mesh.devices.size)
+    cax = col_axis_name(mesh)
+    ar = jnp.arange(p_pad)
+
+    def row_math(Xl, Yl, wl, Beta, k):
+        """The _multinomial_pass row ops for class k — shared by the
+        replicated and sharded bodies so both lanes compute the identical
+        per-row floats."""
+        Eta = jnp.einsum("np,pk->nk", Xl, Beta, precision=_HI)
+        Eta = Eta - jax.scipy.special.logsumexp(Eta, axis=1, keepdims=True)
+        Mu = jnp.exp(Eta)
+        mu_k = jnp.clip(
+            jax.lax.dynamic_index_in_dim(Mu, k, 1, keepdims=False),
+            1e-10, 1 - 1e-10)
+        wk = wl * mu_k * (1 - mu_k)
+        beta_k = jax.lax.dynamic_index_in_dim(Beta, k, 1, keepdims=False)
+        eta_k = jnp.einsum("np,p->n", Xl, beta_k, precision=_HI)
+        yk = jax.lax.dynamic_index_in_dim(Yl, k, 1, keepdims=False)
+        z = eta_k + (yk - mu_k) / jnp.maximum(
+            wk / jnp.maximum(wl, 1e-10), 1e-10)
+        Xw = Xl * wk[:, None]
+        G_l = jnp.einsum("np,nq->pq", Xw, Xl, precision=_HI)
+        b_l = jnp.einsum("np,n->p", Xw, z, precision=_HI)
+        ll_l = jnp.sum(wl * jnp.sum(Yl * Eta, axis=1))
+        return G_l, b_l, ll_l
+
+    def class_pass(X, Y1h, w, Beta, k):
+        if n_sh <= 1:
+            G, b, ll = row_math(X, Y1h, w, Beta, k)
+            return G, b, -2.0 * ll
+
+        def local(Xl, Yl, wl, Beta, k):
+            from h2o3_tpu.ops import collectives
+
+            G_l, b_l, ll_l = row_math(Xl, Yl, wl, Beta, k)
+            # same collective shape as the single-response fused lane:
+            # bulk G through the (possibly quantized, residual-corrected)
+            # scatter, packed exact psum for b/ll, one exact G gather
+            G_blk = collectives.psum_scatter(
+                G_l, n_dev=n_sh, passes=2, mesh=mesh)
+            vec = collectives.exact_psum(
+                jnp.concatenate([b_l, ll_l[None]]), mesh)
+            G = jax.lax.all_gather(G_blk, cax, axis=0, tiled=True)
+            return G, vec[:p_pad], -2.0 * vec[p_pad]
+
+        rspec = row_pspec(mesh)
+        return shard_map(
+            local, mesh,
+            in_specs=(row_pspec(mesh, ndim=2), row_pspec(mesh, ndim=2),
+                      rspec, Spec(), Spec()),
+            out_specs=(Spec(), Spec(), Spec()),
+            check_vma=False,
+        )(X, Y1h, w, Beta, k)
+
+    def chunk(Beta, ll_prev, X, Y1h, w, kmax, l1, l2, obj_eps, icpt,
+              pad_diag, real_p):
+        def cond(c):
+            _, _, it, stop, bad = c
+            return (it < kmax) & ~stop & ~bad
+
+        def body(c):
+            Beta0, ll_prev, it, stop, bad = c
+
+            def cstep(carry, k):
+                Beta, bad_c = carry
+                G, b, m2ll = class_pass(X, Y1h, w, Beta, k)
+                if l1_on:
+                    beta_k, ok = admm_elastic_net_device(
+                        G, b, l1, l2, icpt, pad_diag, real_p,
+                        non_negative=non_negative,
+                    )
+                else:
+                    extra = l2 * jnp.where(ar == icpt, 0.0, 1.0) + pad_diag
+                    beta_k, ok = cho_solve_jitter_device(G, b, extra)
+                    if non_negative:
+                        beta_k = jnp.where(
+                            (ar != icpt) & (beta_k < 0), 0.0, beta_k)
+                bad_k = ~ok | ~jnp.all(jnp.isfinite(beta_k))
+                Beta = jnp.where(
+                    bad_k, Beta,
+                    jax.lax.dynamic_update_slice(
+                        Beta, beta_k[:, None], (0, k)),
+                )
+                return (Beta, bad_c | bad_k), m2ll
+
+            (Beta_new, bad_it), m2lls = jax.lax.scan(
+                cstep, (Beta0, jnp.asarray(False)),
+                jnp.arange(K, dtype=jnp.int32),
+            )
+            ll_now = m2lls[-1]  # the host rule: the LAST class's pass
+            bad = bad_it
+            stop = ~bad & (
+                jnp.abs(ll_prev - ll_now)
+                / jnp.maximum(jnp.abs(ll_now), 1e-10) < obj_eps
+            )
+            # a bad iteration is discarded WHOLE: the host f64 tail redoes
+            # it from the pre-iteration Beta (the single-response rule)
+            Beta = jnp.where(bad, Beta0, Beta_new)
+            ll_prev = jnp.where(stop | bad, ll_prev, ll_now)
+            it = it + jnp.where(bad, 0, 1)
+            return Beta, ll_prev, it, stop, bad
+
+        return jax.lax.while_loop(
+            cond, body,
+            (Beta, ll_prev, jnp.int32(0), jnp.asarray(False),
+             jnp.asarray(False)),
+        )
+
+    fn = jax.jit(chunk, donate_argnums=(0,))
+    _GLM_PROGRAMS[key] = fn
+    return fn
+
+
 @partial(jax.jit, static_argnames=("family_key", "fam_args"))
 def _glm_dev_grad(X, y, w, offset, beta, family_key, fam_args):
     """Full-batch deviance + gradient in one fused pass (L-BFGS objective)."""
@@ -392,6 +543,41 @@ def _ordinal_nll_grad(X, y, w, beta, raw_cuts, K):
 
     val, g = jax.value_and_grad(nll)(jnp.concatenate([beta, raw_cuts]))
     return val, g
+
+
+@partial(jax.jit, static_argnames=("K", "maxiter"))
+def _ordinal_fused_fit(X, y, w, x0, K, maxiter):
+    """Whole-program ordinal fit (ISSUE 15): the SAME proportional-odds NLL
+    as :func:`_ordinal_nll_grad`, minimized entirely on device by
+    ``jax.scipy.optimize.minimize(method='BFGS')`` — one dispatch instead
+    of one per scipy line-search evaluation. The objective is convex in
+    this parameterization, so BFGS and the host L-BFGS-B driver converge to
+    the same optimum (pinned within the f32 envelope); a non-finite or
+    unconverged result routes the caller back to the scipy path. Returns
+    ``(x, nll, ok)``."""
+    P = X.shape[1]
+
+    def nll(params):
+        b = params[:P]
+        raw = params[P:]
+        theta = jnp.cumsum(jnp.concatenate([raw[:1], jnp.exp(raw[1:])]))
+        eta = jnp.einsum("np,p->n", X, b, precision=_HI)
+        cum = jax.nn.sigmoid(theta[None, :] - eta[:, None])
+        lo = jnp.concatenate([jnp.zeros((X.shape[0], 1)), cum], axis=1)
+        hi = jnp.concatenate([cum, jnp.ones((X.shape[0], 1))], axis=1)
+        pk = jnp.clip(hi - lo, 1e-12, 1.0)
+        yi = jnp.clip(y.astype(jnp.int32), 0, K - 1)
+        ll = jnp.take_along_axis(jnp.log(pk), yi[:, None], axis=1)[:, 0]
+        return -jnp.sum(w * ll)
+
+    import jax.scipy.optimize as _jsp_opt  # lazy submodule: import explicitly
+
+    res = _jsp_opt.minimize(
+        nll, x0, method="BFGS",
+        options={"maxiter": maxiter, "gtol": 1e-6},
+    )
+    ok = jnp.all(jnp.isfinite(res.x)) & jnp.isfinite(res.fun)
+    return res.x, res.fun, ok
 
 
 # ---------------------------------------------------------------------------
@@ -547,12 +733,11 @@ class GLM(ModelBuilder):
         prior = resolve_checkpoint(p.checkpoint)
         response_domain = tuple(yv.domain) if classification else None
         if prior is not None:
-            if family in ("multinomial", "ordinal") or p.solver.upper().replace(
+            if family == "ordinal" or p.solver.upper().replace(
                 "-", "_"
             ) in ("L_BFGS", "LBFGS"):
                 raise ValueError(
-                    "GLM checkpoint resume supports the IRLSM single-response "
-                    "path only"
+                    "GLM checkpoint resume supports the IRLSM paths only"
                 )
             check_checkpoint_compat(
                 prior, self,
@@ -561,17 +746,26 @@ class GLM(ModelBuilder):
                  "standardize", "intercept", "missing_values_handling",
                  "max_iterations", "beta_epsilon", "objective_epsilon"),
             )
-            if prior.output.get("irls_state") is None:
+            st = prior.output.get("irls_state")
+            if st is None:
                 raise ValueError(
                     "GLM checkpoint resume needs an in-training snapshot "
                     "(a COMPLETED GLM fit has converged; there is nothing to "
                     "continue)"
                 )
-            if len(prior.output["irls_state"]["beta"]) != di.ncols_expanded:
+            if family == "multinomial":
+                if not st.get("multinomial"):
+                    raise ValueError(
+                        "checkpoint is not a multinomial irls_state snapshot"
+                    )
+                if np.asarray(st["Beta"]).shape[0] != di.ncols_expanded:
+                    raise ValueError("checkpoint design-matrix width differs")
+            elif len(st["beta"]) != di.ncols_expanded:
                 raise ValueError("checkpoint design-matrix width differs")
 
         if family == "multinomial":
-            out = self._fit_multinomial(job, X, y, w, di, yv, p, nobs)
+            out = self._fit_multinomial(job, X, y, w, di, yv, p, nobs,
+                                        prior=prior)
         elif family == "ordinal":
             out = self._fit_ordinal(job, X, y, w, di, yv, p)
         elif p.solver.upper().replace("-", "_") in ("L_BFGS", "LBFGS"):
@@ -707,7 +901,14 @@ class GLM(ModelBuilder):
         # all-zero, contribute exactly zero to every Gram/gradient below,
         # and every host-side vector stays REAL length (padding happens at
         # the dispatch boundary only)
-        fuse_k = 0 if streaming else _glm_fuse_chunk(p)
+        if streaming:
+            from h2o3_tpu import config as _cfg
+
+            if _cfg.get("H2O3_TPU_GLM_FUSE").strip().lower() != "0":
+                _GLM_FALLBACKS.inc(reason="streamed")
+            fuse_k = 0
+        else:
+            fuse_k = _glm_fuse_chunk(p)
         p_pad = _glm_pad_cols(P) if fuse_k else P
         if p_pad > P:
             X = jnp.pad(X, ((0, 0), (0, p_pad - P)))
@@ -895,6 +1096,7 @@ class GLM(ModelBuilder):
                             "solve; falling back to the host float64 lane "
                             f"for lambda index {li}"
                         )
+                        _GLM_FALLBACKS.inc(reason="singular")
                         fused_ok = False
                     if stop:
                         break
@@ -1027,20 +1229,45 @@ class GLM(ModelBuilder):
         raw0 = np.zeros(K - 1)
         raw0[0] = -1.0
         x0 = np.concatenate([np.zeros(P), raw0])
+        maxiter = p.max_iterations if p.max_iterations > 0 else 200
 
-        def fun(params):
-            val, g = _ordinal_nll_grad(
-                X, y, w, jnp.asarray(params[:P], jnp.float32),
-                jnp.asarray(params[P:], jnp.float32), K,
+        # fused lane (ISSUE 15): the whole BFGS optimization of the SAME
+        # convex proportional-odds NLL runs as one device program — one
+        # dispatch instead of one per scipy line-search evaluation; a
+        # non-finite result falls back to the host scipy driver below
+        x_fit = None
+        fun_val = None
+        if _glm_fuse_chunk(p):
+            _GLM_DISPATCHES.inc()
+            x_j, f_j, ok_j = _ordinal_fused_fit(
+                X, y, w, jnp.asarray(x0, jnp.float32), K, maxiter
             )
-            return float(val), np.asarray(g, np.float64)
+            if bool(ok_j):
+                x_fit = np.asarray(x_j, np.float64)
+                fun_val = float(f_j)
+            else:
+                Log.warn(
+                    "GLM fused ordinal BFGS returned a non-finite optimum; "
+                    "falling back to the host L-BFGS-B driver"
+                )
+                _GLM_FALLBACKS.inc(reason="ordinal_opt")
 
-        res = spo.minimize(
-            fun, x0, jac=True, method="L-BFGS-B",
-            options={"maxiter": p.max_iterations if p.max_iterations > 0 else 200},
-        )
-        beta = res.x[:P]
-        raw = res.x[P:]
+        if x_fit is None:
+            def fun(params):
+                val, g = _ordinal_nll_grad(
+                    X, y, w, jnp.asarray(params[:P], jnp.float32),
+                    jnp.asarray(params[P:], jnp.float32), K,
+                )
+                return float(val), np.asarray(g, np.float64)
+
+            res = spo.minimize(
+                fun, x0, jac=True, method="L-BFGS-B",
+                options={"maxiter": maxiter},
+            )
+            x_fit = res.x
+            fun_val = float(res.fun)
+        beta = x_fit[:P]
+        raw = x_fit[P:]
         theta = np.cumsum(np.concatenate([raw[:1], np.exp(raw[1:])]))
         out = self._coef_output(beta, di, p, has_intercept=False)
         out.update(
@@ -1051,7 +1278,7 @@ class GLM(ModelBuilder):
             # original-scale cuts: eta_std = eta_orig_lin - shift, so the
             # same cumulative probabilities come from theta + shift
             theta_orig=theta + out["destandardize_shift"],
-            residual_deviance=2.0 * float(res.fun),
+            residual_deviance=2.0 * fun_val,
             null_deviance=float("nan"),
             multinomial=False,
         )
@@ -1188,43 +1415,7 @@ class GLM(ModelBuilder):
         return out
 
     # -- multinomial ---------------------------------------------------------
-    def _fit_multinomial(self, job, X, y, w, di, yv, p: GLMParams, nobs):
-        K = yv.cardinality
-        P = di.ncols_expanded
-        icpt = P - 1 if p.intercept else None
-        alpha = 0.5 if p.alpha is None else float(p.alpha)
-        lam = 0.0
-        if p.lambda_ is not None:
-            lam = float(np.atleast_1d(np.asarray(p.lambda_))[0])
-        max_iter = p.max_iterations if p.max_iterations > 0 else 30
-
-        Y1h = (y[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32) * (
-            w[:, None] > 0
-        )
-        Beta = np.zeros((P, K), np.float64)
-        ll_prev = np.inf
-        for it in range(max_iter):
-            for k in range(K):
-                G, b, m2ll = _multinomial_pass(
-                    X, Y1h, w, jnp.asarray(Beta, jnp.float32), K, k
-                )
-                G = np.asarray(G, np.float64)
-                b = np.asarray(b, np.float64)
-                l1 = lam * alpha * nobs
-                l2 = lam * (1 - alpha) * nobs
-                if l1 > 0:
-                    Beta[:, k] = admm_elastic_net(G, b, l1, l2, icpt)
-                else:
-                    Gp = G + l2 * np.eye(P)
-                    if icpt is not None:
-                        Gp[icpt, icpt] -= l2
-                    Beta[:, k] = solve_cholesky(Gp, b)
-            ll_now = float(m2ll)
-            job.update(0.05 + 0.8 * (it + 1) / max_iter)
-            if abs(ll_prev - ll_now) / max(abs(ll_now), 1e-10) < p.objective_epsilon:
-                break
-            ll_prev = ll_now
-
+    def _multinomial_output(self, di, Beta) -> dict:
         names = di.coef_names()
         return {
             "coef_names": names,
@@ -1235,5 +1426,151 @@ class GLM(ModelBuilder):
             "family": "multinomial",
             "family_obj": get_family("binomial"),
             "multinomial": True,
-            "residual_deviance": ll_prev,
         }
+
+    def _multinomial_snapshot(self, key, p: GLMParams, di, Beta,
+                              response_domain, state: dict) -> GLMModel:
+        """Interval-snapshot factory for the cycling IRLS: a scoreable
+        partial multinomial GLM carrying the outer-iteration position
+        (``irls_state``: it / ll_prev / Beta) so ``checkpoint=`` resume
+        re-enters the cycle at the next iteration and reproduces the
+        uninterrupted trajectory bit-for-bit (the fused lane clamps its
+        chunk to one iteration whenever export_checkpoints_dir is set)."""
+        out = self._multinomial_output(di, np.asarray(Beta, np.float64))
+        out.update(
+            datainfo=di,
+            names=list(self._x),
+            response_domain=response_domain,
+            residual_deviance=state["ll_prev"],
+            irls_state=state,
+        )
+        return GLMModel(key, p, out)
+
+    def _fit_multinomial(self, job, X, y, w, di, yv, p: GLMParams, nobs,
+                         prior=None):
+        K = yv.cardinality
+        P = di.ncols_expanded
+        icpt = P - 1 if p.intercept else None
+        alpha = 0.5 if p.alpha is None else float(p.alpha)
+        lam = 0.0
+        if p.lambda_ is not None:
+            lam = float(np.atleast_1d(np.asarray(p.lambda_))[0])
+        max_iter = p.max_iterations if p.max_iterations > 0 else 30
+        l1 = lam * alpha * nobs
+        l2 = lam * (1 - alpha) * nobs
+        response_domain = tuple(yv.domain)
+
+        Y1h = (y[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32) * (
+            w[:, None] > 0
+        )
+        # fused whole-program lane (ISSUE 15): the K-class cycling IRLS was
+        # per-class-per-iteration host-dispatched — exactly the
+        # many-dispatch regime the single-response fusion pays off in. The
+        # fused chunk runs up to K_chunk outer iterations as one program
+        # (lax.scan over classes inside one while_loop); the host f64
+        # cycling tail below stays as the non-finite escape hatch.
+        fuse_k = _glm_fuse_chunk(p)
+        p_pad = _glm_pad_cols(P) if fuse_k else P
+        Xf = jnp.pad(X, ((0, 0), (0, p_pad - P))) if p_pad > P else X
+
+        Beta = np.zeros((P, K), np.float64)
+        ll_prev = np.inf
+        it = 0
+        if prior is not None:
+            st = prior.output["irls_state"]
+            it = int(st["it"])
+            ll_prev = float(st["ll_prev"])
+            Beta = np.asarray(st["Beta"], np.float64).copy()
+
+        def snapshot(it_pos, ll_prev_v, Beta_v):
+            self._export_interval_checkpoint(
+                job,
+                lambda key: self._multinomial_snapshot(
+                    key, p, di, Beta_v, response_domain,
+                    {"multinomial": True, "it": it_pos,
+                     "ll_prev": ll_prev_v, "Beta": Beta_v.copy()},
+                ),
+            )
+
+        def pad_Beta(B64):
+            if p_pad > P:
+                return np.concatenate(
+                    [B64, np.zeros((p_pad - P, K))], axis=0)
+            return B64
+
+        fused_ok = bool(fuse_k)
+        stop = False
+        while it < max_iter and not stop:
+            if fused_ok:
+                prog = _fused_multinomial_program(
+                    Xf.shape[0], p_pad, K, l1 > 0, p.non_negative
+                )
+                kmax = min(fuse_k, max_iter - it)
+                _GLM_DISPATCHES.inc()
+                from h2o3_tpu.utils import flightrec as _fr
+
+                with _fr.dispatch("irls_chunk", rows=int(Xf.shape[0]),
+                                  cols=int(p_pad), k=int(kmax), classes=K):
+                    Beta_j, llp_j, ndone_j, stop_j, bad_j = prog(
+                        jnp.asarray(pad_Beta(Beta), jnp.float32),
+                        jnp.float32(ll_prev), Xf, Y1h, w,
+                        jnp.int32(kmax), jnp.float32(l1), jnp.float32(l2),
+                        jnp.float32(p.objective_epsilon),
+                        jnp.int32(icpt if icpt is not None else -1),
+                        jnp.asarray(
+                            (np.arange(p_pad) >= P).astype(np.float32)),
+                        jnp.float32(P),
+                    )
+                    n_done = int(ndone_j)
+                stop, bad = bool(stop_j), bool(bad_j)
+                if n_done:
+                    Beta = np.asarray(Beta_j, np.float64)[:P]
+                    ll_prev = float(llp_j)
+                first = it + 1
+                it += n_done
+                snapshot(it, ll_prev, Beta)
+                faults.die_check("glm")  # chaos: worker death at boundary
+                for i in range(first, it + 1):
+                    faults.abort_check("glm", i)
+                if bad:
+                    Log.warn(
+                        "GLM fused multinomial chunk hit a non-finite f32 "
+                        "class solve; falling back to the host float64 "
+                        "cycling lane"
+                    )
+                    _GLM_FALLBACKS.inc(reason="singular")
+                    fused_ok = False
+                job.update(0.05 + 0.8 * min(it + 1, max_iter) / max_iter)
+                continue
+            # host float64 cycling lane (the pre-fusion path and the
+            # singular-tail fallback): one dispatch per (iteration, class)
+            for k in range(K):
+                _GLM_DISPATCHES.inc()
+                G, b, m2ll = _multinomial_pass(
+                    X, Y1h, w, jnp.asarray(Beta, jnp.float32), K, k
+                )
+                G = np.asarray(G, np.float64)
+                b = np.asarray(b, np.float64)
+                if l1 > 0:
+                    Beta[:, k] = admm_elastic_net(G, b, l1, l2, icpt)
+                else:
+                    Gp = G + l2 * np.eye(P)
+                    if icpt is not None:
+                        Gp[icpt, icpt] -= l2
+                    Beta[:, k] = solve_cholesky(Gp, b)
+            ll_now = float(m2ll)
+            it += 1
+            stop = (
+                abs(ll_prev - ll_now) / max(abs(ll_now), 1e-10)
+                < p.objective_epsilon
+            )
+            if not stop:
+                ll_prev = ll_now
+            snapshot(it, ll_prev, Beta)
+            faults.die_check("glm")  # chaos: worker death at boundary
+            faults.abort_check("glm", it)
+            job.update(0.05 + 0.8 * it / max_iter)
+
+        out = self._multinomial_output(di, Beta)
+        out["residual_deviance"] = ll_prev
+        return out

@@ -290,7 +290,7 @@ class GBM(ModelBuilder):
         return cs.ChunkStore.plan(train.npad, len(self._x) + 28)
 
     def _build_streamed(self, job, train, valid, p, spec, dist, aux, yv,
-                        prior, store, classification):
+                        prior, store, classification, mono_vec=None):
         """Out-of-core GBM: per-block binning into the store's host tier,
         compressed device residency for the source columns, and the
         interval loop driving :func:`build_trees_streamed`. Metrics come
@@ -457,6 +457,7 @@ class GBM(ModelBuilder):
                     varimp=varimp_dev,
                     reg_lambda=getattr(p, "reg_lambda", 0.0),
                     reg_alpha=getattr(p, "reg_alpha", 0.0),
+                    monotone=mono_vec,
                 )
             lr *= p.learn_rate_annealing ** chunk
             trees.extend([[t] for t in new_trees])
@@ -551,18 +552,45 @@ class GBM(ModelBuilder):
         else:
             spec = fit_bins_for(p, train, self._x)
 
+        # monotone constraints resolve BEFORE the lane gates: both the
+        # streamed and the scanned/fused lanes now accept them (ISSUE 15)
+        mono_vec = None
+        if p.monotone_constraints:
+            if dist not in ("gaussian", "bernoulli", "tweedie", "quantile"):
+                raise ValueError(
+                    "monotone_constraints supports gaussian/bernoulli/"
+                    "tweedie/quantile distributions"
+                )
+            mono_vec = np.zeros(len(self._x), np.int32)
+            for cname, d in dict(p.monotone_constraints).items():
+                if int(d) == 0:  # upstream accepts 0 = unconstrained
+                    continue
+                if cname not in self._x:
+                    raise ValueError(f"monotone constraint on unknown column {cname!r}")
+                ci = self._x.index(cname)
+                if spec.is_cat[ci]:
+                    raise ValueError(
+                        f"monotone constraint on categorical column {cname!r}"
+                    )
+                if int(d) not in (-1, 1):
+                    raise ValueError("monotone directions must be -1, 0 or 1")
+                mono_vec[ci] = int(d)
+            if not mono_vec.any():
+                mono_vec = None
+
         # out-of-core streaming (ISSUE 11, frame/chunkstore.py): when the
         # frame's per-row training lanes exceed the configured HBM window,
         # train as a block-accumulate outer loop around the existing
         # compiled programs instead of materializing the resident arrays.
         # Fallback matrix (docs/MIGRATION.md): multinomial (K per-class
-        # trees share row state) and monotone builds stay resident.
-        if dist != "multinomial" and not p.monotone_constraints:
+        # trees share row state) stays resident; monotone builds stream
+        # too since ISSUE 15 (the bound state is per-node, not per-block).
+        if dist != "multinomial":
             stream = self._plan_streamed(train)
             if stream is not None:
                 return self._build_streamed(
                     job, train, valid, p, spec, dist, aux, yv, prior, stream,
-                    classification,
+                    classification, mono_vec=mono_vec,
                 )
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
@@ -667,34 +695,18 @@ class GBM(ModelBuilder):
         # transfer has happened, and on the CPU mesh per-level dispatch
         # overhead × levels × trees was ~a third of build wall-clock.
         # H2O3_TPU_WHOLE_TREE=0 restores the per-tree per-level loop.
-        mono_vec = None
-        if p.monotone_constraints:
-            if dist not in ("gaussian", "bernoulli", "tweedie", "quantile"):
-                raise ValueError(
-                    "monotone_constraints supports gaussian/bernoulli/"
-                    "tweedie/quantile distributions"
-                )
-            mono_vec = np.zeros(len(self._x), np.int32)
-            for cname, d in dict(p.monotone_constraints).items():
-                if int(d) == 0:  # upstream accepts 0 = unconstrained
-                    continue
-                if cname not in self._x:
-                    raise ValueError(f"monotone constraint on unknown column {cname!r}")
-                ci = self._x.index(cname)
-                if spec.is_cat[ci]:
-                    raise ValueError(
-                        f"monotone constraint on categorical column {cname!r}"
-                    )
-                if int(d) not in (-1, 1):
-                    raise ValueError("monotone directions must be -1, 0 or 1")
-                mono_vec[ci] = int(d)
-            if not mono_vec.any():
-                mono_vec = None
-
-        from h2o3_tpu.models.tree.shared_tree import use_fused_trees
+        # Monotone builds take the scanned lane when the fused Pallas
+        # pipeline is active (ISSUE 15: the constraint mask runs inside the
+        # split kernel and the bound state rides the fused level carry);
+        # with the fuse gate off they keep the legacy per-level loop
+        # bit-for-bit.
+        from h2o3_tpu.models.tree.shared_tree import (
+            _split_fuse_on,
+            use_fused_trees,
+        )
 
         use_scan = (dist != "multinomial" and use_fused_trees(p.max_depth)
-                    and mono_vec is None)
+                    and (mono_vec is None or _split_fuse_on()))
 
         start_trees = 0
         if prior is not None:
@@ -765,6 +777,7 @@ class GBM(ModelBuilder):
                         col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                         reg_lambda=getattr(p, "reg_lambda", 0.0),
                         reg_alpha=getattr(p, "reg_alpha", 0.0),
+                        monotone=mono_vec,
                     )
                 lr *= p.learn_rate_annealing ** chunk
                 with _mx.span("gbm.pull_records", trees=chunk):

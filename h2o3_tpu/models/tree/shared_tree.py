@@ -118,6 +118,17 @@ _HIST_HBM_BYTES = _metrics.counter(
     "modeled per-device HBM bytes moved by the histogram+split phases of "
     "tree builds, by pipeline path", always=True)
 
+# Fallback observability (ISSUE 15): builds that WANT the fused Pallas lane
+# (the knob/backend gate says fuse) but drop to a slow lane for a
+# structural reason. After the ISSUE-15 closure the only structural tree
+# reason left is uplift (its 4-lane scan was never fused); the mono /
+# cat_sharded reasons stay wired so a future regression of the closure is
+# a counter bump, not an archaeology dig through MIGRATION.md.
+_FUSED_FALLBACKS = _metrics.counter(
+    "tree_fused_fallbacks_total",
+    "tree builds that fell back from the fused Pallas histogram→split lane "
+    "while the fuse gate was ON, by structural reason", always=True)
+
 # program-key registry + per-program collective tallies: _run_counted
 # captures a program's ((phase, lane, group) -> bytes) tally during its
 # first (tracing) dispatch and replays it on every later one.
@@ -495,18 +506,25 @@ def _split_fuse_on() -> bool:
     return v not in ("0", "false", "False")
 
 
-def _split_fuse_active(cat_cols: tuple, split_shard: bool) -> bool:
+def _split_fuse_active(cat_cols: tuple, split_shard: bool,
+                       uplift: bool = False) -> bool:
     """Whether a program being built NOW should trace the fused pipeline.
 
-    The fallback matrix (docs/MIGRATION.md): monotone-constraint builds
-    never fuse (their feasibility mask is per-bin — the callers simply
-    don't ask), and on a column-sharded mesh a frame WITH categorical
-    columns falls back wholly (block membership of a cat column is dynamic
-    there, so the static per-column routing the kernel needs doesn't
-    exist). On the replicated path categorical columns route to the
-    mean-sort fallback branch per column while numeric columns stay on the
-    kernel (ops/split_pallas.fused_split_scan)."""
-    return _split_fuse_on() and not (split_shard and cat_cols)
+    The post-ISSUE-15 fallback matrix (docs/MIGRATION.md): monotone builds
+    fuse (the per-bin feasibility mask runs inside the kernel grid step —
+    ops/split_pallas._split_kernel_mono) and categorical columns on a
+    column-sharded mesh fuse too (every block runs the mean-sort branch on
+    a BLOCK-LOCAL dense gather, selecting per column — the dense sharded
+    scan's own scheme, now fed from the blocked tiles). Only uplift trees
+    (their 4-lane scan was never ported) and the knob/backend gate itself
+    fall back; a structural fallback while the gate is ON tallies
+    ``tree_fused_fallbacks_total{reason}``."""
+    if not _split_fuse_on():
+        return False
+    if uplift:
+        _FUSED_FALLBACKS.inc(reason="uplift")
+        return False
+    return True
 
 
 def _kernel_key() -> tuple:
@@ -518,11 +536,17 @@ def _kernel_key() -> tuple:
     from h2o3_tpu import config
     from h2o3_tpu.ops.hist_pallas import _tiles
 
-    return (_split_fuse_on(), _tiles(), config.get("H2O3_TPU_HIST"))
+    # the RAW spec rides along because 'auto' (the tile autotuner) resolves
+    # shape-dependent tiles inside the trace — _tiles() alone could not
+    # distinguish 'auto' from the '' defaults
+    return (_split_fuse_on(), _tiles(),
+            config.get("H2O3_TPU_PALLAS_TILES").strip(),
+            config.get("H2O3_TPU_HIST"))
 
 
 def _split_scan_sharded_fused(
-    blk, layout, is_cat, col_mask, min_rows, min_split_improvement, mesh=None,
+    blk, layout, is_cat, col_mask, min_rows, min_split_improvement,
+    any_cat: bool = False, mono=None, node_lo=None, node_hi=None, mesh=None,
 ):
     """Column-sharded split scan on a BLOCKED histogram: each device runs
     the Pallas split kernel (ops/split_pallas.py) on its own 1/P tile range
@@ -531,8 +555,19 @@ def _split_scan_sharded_fused(
     winners all_gather (O(N·P) scalars), argmax over blocks picks the
     lowest block, blocks are contiguous ascending column ranges, and every
     block's gains are computed against GLOBAL column 0's node totals.
-    Numeric-only by construction (``_split_fuse_active``: categorical
-    frames fall back to the dense sharded scan on >1-device meshes)."""
+
+    ``any_cat`` (ISSUE 15) closes the cat+sharded fallback: block
+    membership of a categorical column is dynamic (the traced body is
+    one-per-mesh), so — exactly like the dense sharded scan — every block
+    runs the mean-sort categorical branch on ALL its local columns via a
+    BLOCK-LOCAL dense gather (``blocked_cols_dense`` over the local tiles,
+    O(N·(C/P)·B·S) HBM, never the full histogram) and selects per column by
+    the sliced ``is_cat``; the winner tuple then carries the (N, B)
+    membership mask. Numeric columns stay on the kernel throughout.
+
+    ``mono``/``node_lo``/``node_hi`` thread the monotone-constrained kernel
+    variant per block (the direction lane slices like the column mask) and
+    the winner tuple gains ``mid``/``mono_col`` for bound propagation."""
     import jax.tree_util as jtu
 
     from h2o3_tpu.ops.histogram import record_collective
@@ -553,12 +588,21 @@ def _split_scan_sharded_fused(
     if L.cpad > C:  # layout padding columns: masked, can never win
         is_cat = jnp.pad(is_cat, (0, L.cpad - C))
         col_mask = jnp.pad(col_mask, ((0, 0), (0, L.cpad - C)))
+        if mono is not None:
+            mono = jnp.pad(mono, (0, L.cpad - C))
+    # the dense sharded scan's scheme: every local column routes through
+    # the categorical branch, per-column selection by is_cat
+    local_cats = tuple(range(lloc.cpad)) if any_cat else ()
 
     if n_dev > 1:
         per_dev = N * (4 + 4 + 4 + 1 + 1 + 12 + 12 + 4 * S)
+        if any_cat:
+            per_dev += N * B
+        if mono is not None:
+            per_dev += N * 8
         record_collective("winner_gather", n_dev * per_dev)
 
-    def body(blk_loc, cm, ic):
+    def body(blk_loc, cm, ic, mono_g, lo, hi):
         d = jax.lax.axis_index(cax)
         col0 = (d * lloc.cpad).astype(jnp.int32)
         # node totals from GLOBAL column 0 = block 0's local column 0
@@ -566,9 +610,14 @@ def _split_scan_sharded_fused(
         tot0 = jax.lax.all_gather(tot_loc, cax)[0]
         cm_blk = jax.lax.dynamic_slice_in_dim(cm, col0, lloc.cpad, axis=1)
         ic_blk = jax.lax.dynamic_slice_in_dim(ic, col0, lloc.cpad, axis=0)
+        mono_blk = (
+            None if mono_g is None
+            else jax.lax.dynamic_slice_in_dim(mono_g, col0, lloc.cpad, axis=0)
+        )
         sp = fused_split_scan(
             blk_loc, lloc, ic_blk, cm_blk, min_rows, min_split_improvement,
-            (), node_totals=tot0,
+            local_cats, node_totals=tot0,
+            mono=mono_blk, node_lo=lo, node_hi=hi,
         )
         win = {
             "gain": sp["gain"],
@@ -579,6 +628,11 @@ def _split_scan_sharded_fused(
             "Lst": sp["Lst"],
             "Rst": sp["Rst"],
         }
+        if any_cat:
+            win["cat_mask"] = sp["cat_mask"]
+        if mono_g is not None:
+            win["mid"] = sp["mid"]
+            win["mono_col"] = sp["mono_col"]
         g = jtu.tree_map(lambda a: jax.lax.all_gather(a, cax), win)
         # identical merge to the dense sharded path: argmax over the block
         # axis — first max wins, i.e. the LOWEST block
@@ -593,16 +647,25 @@ def _split_scan_sharded_fused(
         out["node_w"] = tot0[:, 0]
         out["node_wy"] = tot0[:, 1]
         out["node_wh"] = tot0[:, 2]
-        out["cat_mask"] = jnp.zeros((N, B), bool)
+        if not any_cat:
+            out["cat_mask"] = jnp.zeros((N, B), bool)
         return out
 
+    if mono is None:
+        return shard_map(
+            lambda b, cm, ic: body(b, cm, ic, None, None, None),
+            mesh=mesh,
+            in_specs=(P(cax), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(blk, col_mask, is_cat)
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(cax), P(), P()),
+        in_specs=(P(cax), P(), P(), P(), P(), P()),
         out_specs=P(),
         check_vma=False,
-    )(blk, col_mask, is_cat)
+    )(blk, col_mask, is_cat, mono, node_lo, node_hi)
 
 
 def _split_scan_sharded(
@@ -842,13 +905,38 @@ def _finish_level(
     return nid, preds, varimp, n_split, record, cs
 
 
+def _child_bounds(ok, child_base, mono_col, mid, node_lo, node_hi,
+                  n_pad_next: int):
+    """Monotone child-bound propagation: children of a constrained split
+    tighten to the parent's ``mid`` on the constrained side (left child at
+    ``child_base``, right at ``child_base+1``; leaves drop out-of-bounds).
+    Factored out of the per-level mono step so the fused whole-tree
+    program, the streamed decide and the per-level loop scatter the SAME
+    ops. Returns ``(new_lo, new_hi)`` sized ``n_pad_next``."""
+    new_lo = jnp.full(n_pad_next, -jnp.inf, jnp.float32)
+    new_hi = jnp.full(n_pad_next, jnp.inf, jnp.float32)
+    inc = mono_col > 0
+    dec = mono_col < 0
+    l_lo = jnp.where(dec, mid, node_lo)
+    l_hi = jnp.where(inc, mid, node_hi)
+    r_lo = jnp.where(inc, mid, node_lo)
+    r_hi = jnp.where(dec, mid, node_hi)
+    li = jnp.where(ok, child_base, n_pad_next)  # OOB drop for leaves
+    ri = jnp.where(ok, child_base + 1, n_pad_next)
+    new_lo = new_lo.at[li].set(l_lo, mode="drop")
+    new_lo = new_lo.at[ri].set(r_lo, mode="drop")
+    new_hi = new_hi.at[li].set(l_hi, mode="drop")
+    new_hi = new_hi.at[ri].set(r_hi, mode="drop")
+    return new_lo, new_hi
+
+
 def _level_core(
     hist, bins_u8, nid, preds, varimp, key, cols_enabled, is_cat,
     min_rows, min_split_improvement, learn_rate, max_abs_leaf, col_sample_rate,
     leaf_reg=None,
     *, n_pad: int, n_pad_next: int, cat_cols: tuple = (),
     n_cols_real: int | None = None, split_shard: bool = False,
-    fuse_layout=None,
+    fuse_layout=None, mono=None, node_lo=None, node_hi=None,
 ):
     """Split scan → decisions → partition for one level, given its histogram.
 
@@ -861,6 +949,12 @@ def _level_core(
     pipeline: ``hist`` is then the BLOCKED histogram tensor and the scan
     runs as the VMEM-tile split kernel (``ops/split_pallas.py``) — sharded
     or replicated — emitting the same decision dict.
+
+    ``mono`` ((C,) int, traced) + ``node_lo``/``node_hi`` ((n_pad,)) select
+    monotone-constrained split finding on EVERY scan variant (fused or
+    dense, sharded or replicated — ISSUE 15 closed the fused gap); the
+    return then appends ``(new_lo, new_hi)`` sized ``n_pad_next`` for the
+    caller's bound carry.
 
     Returns ``(nid, preds, varimp, n_split, record, pair_info)``.
     ``pair_info`` carries, per next-level child PAIR slot (``n_pad_next//2``
@@ -895,7 +989,8 @@ def _level_core(
         if fuse_layout is not None and split_shard:
             sp = _split_scan_sharded_fused(
                 hist, fuse_layout, is_cat, col_mask, min_rows,
-                min_split_improvement,
+                min_split_improvement, any_cat=bool(cat_cols),
+                mono=mono, node_lo=node_lo, node_hi=node_hi,
             )
         elif fuse_layout is not None:
             from h2o3_tpu.ops.split_pallas import fused_split_scan
@@ -903,16 +998,18 @@ def _level_core(
             sp = fused_split_scan(
                 hist, fuse_layout, is_cat, col_mask, min_rows,
                 min_split_improvement, cat_cols,
+                mono=mono, node_lo=node_lo, node_hi=node_hi,
             )
         elif split_shard:
             sp = _split_scan_sharded(
                 hist, is_cat, col_mask, min_rows, min_split_improvement,
                 any_cat=bool(cat_cols),
+                mono=mono, node_lo=node_lo, node_hi=node_hi,
             )
         else:
             sp = _split_scan(
                 hist, is_cat, col_mask, min_rows, min_split_improvement,
-                cat_cols,
+                cat_cols, mono=mono, node_lo=node_lo, node_hi=node_hi,
             )
     ok = sp["ok"]
     # frontier cap: children must fit n_pad_next; later nodes go leaf
@@ -925,7 +1022,8 @@ def _level_core(
         bins_u8, nid, preds, varimp, ok, gain,
         sp["node_w"], sp["node_wy"], sp["node_wh"],
         sp["col"], sp["split_bin"], sp["is_cat"], sp["cat_mask"], sp["na_left"],
-        learn_rate, max_abs_leaf, n_pad, reg_lambda=rl, reg_alpha=ra,
+        learn_rate, max_abs_leaf, n_pad, node_lo=node_lo, node_hi=node_hi,
+        reg_lambda=rl, reg_alpha=ra,
     )
 
     half = n_pad_next // 2
@@ -940,14 +1038,22 @@ def _level_core(
         "Lst": scat(jnp.zeros((half, 3), sp["Lst"].dtype), sp["Lst"]),
         "Rst": scat(jnp.zeros((half, 3), sp["Rst"].dtype), sp["Rst"]),
     }
+    if mono is not None:
+        new_lo, new_hi = _child_bounds(
+            ok, record["child_base"], sp["mono_col"], sp["mid"],
+            node_lo, node_hi, n_pad_next,
+        )
+        return nid, preds, varimp, n_split, record, pair_info, new_lo, new_hi
     return nid, preds, varimp, n_split, record, pair_info
 
 
 def _force_leaf_from_stats(
     bins_u8, nid, preds, varimp, node_w, node_wy, node_wh,
     learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg=None,
+    node_lo=None, node_hi=None,
 ):
-    """Terminal level: every active node becomes a leaf (no split scan)."""
+    """Terminal level: every active node becomes a leaf (no split scan).
+    ``node_lo``/``node_hi`` clamp the leaf values on monotone builds."""
     ok = jnp.zeros(n_pad, bool)
     zi = jnp.zeros(n_pad, jnp.int32)
     rl, ra = (None, None) if leaf_reg is None else leaf_reg
@@ -955,7 +1061,8 @@ def _force_leaf_from_stats(
         bins_u8, nid, preds, varimp, ok, jnp.zeros(n_pad, jnp.float32),
         node_w, node_wy, node_wh, zi, zi, jnp.zeros(n_pad, bool),
         jnp.zeros((n_pad, n_bins), bool), jnp.zeros(n_pad, bool),
-        learn_rate, max_abs_leaf, n_pad, reg_lambda=rl, reg_alpha=ra,
+        learn_rate, max_abs_leaf, n_pad, node_lo=node_lo, node_hi=node_hi,
+        reg_lambda=rl, reg_alpha=ra,
     )
     return nid, preds, varimp, n_split, record
 
@@ -1087,7 +1194,7 @@ def _fused_levels(
     leaf_reg=None,
     *, max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     subtract: bool = True, n_cols_real: int | None = None,
-    split_shard: bool = False, split_fuse: bool = False,
+    split_shard: bool = False, split_fuse: bool = False, mono=None,
 ):
     """All levels of one tree, traced into a single program, with the two
     histogram work reductions the reference's hot loop embodies
@@ -1115,6 +1222,12 @@ def _fused_levels(
     Skipped (post-exit) levels keep their pre-initialized placeholder records
     — all-leaf, zero-valued, reachable by no row — so replay, export and the
     level masks need no notion of "how deep did this tree actually go".
+
+    ``mono`` ((Cp,) int, traced) threads monotone constraints through every
+    level IN the fused program (ISSUE 15): per-node ``[lo, hi]`` bound
+    state rides the level-to-level carry (including the saturated
+    while_loop's), each level's scan masks infeasible candidates inside
+    the kernel, and both force-leaf paths clamp their leaf values.
     """
     from h2o3_tpu.ops.histogram import histogram_in_jit
 
@@ -1123,6 +1236,10 @@ def _fused_levels(
     # stack/reshape interleave
     node_cap = max(2, node_cap - (node_cap % 2))
     nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+    # monotone bound carry: level d's bounds are sized to its frontier
+    # (level d-1's n_pad_next), starting from the unbounded root
+    node_lo = jnp.full(1, -jnp.inf, jnp.float32) if mono is not None else None
+    node_hi = jnp.full(1, jnp.inf, jnp.float32) if mono is not None else None
     recs = []
     parent_hist = None
     parent_lay = None  # static HistLayout of the blocked parent (fused path)
@@ -1243,27 +1360,37 @@ def _fused_levels(
                 return (carry[0] < n_sat) & (carry[4] > 0)
 
             def sat_body(carry):
-                i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c = carry
+                if mono is not None:
+                    (i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c,
+                     lo_c, hi_c) = carry
+                else:
+                    i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c = carry
+                    lo_c = hi_c = None
                 d = sat_start + i
                 lkey = jax.random.fold_in(tkey, d)
                 hist, hlay = level_hist(
                     bins_d, nb_d, sat_start, nid_c, pinfo, phist, 0,
                     parent_lay=parent_lay,
                 )
-                nid_c, preds_c, vi_c, nsp, rec, pinfo = _level_core(
+                out = _level_core(
                     hist, bins_d, nid_c, preds_c, vi_c, lkey, cols_enabled,
                     is_cat, min_rows, min_split_improvement, learn_rate,
                     max_abs_leaf, col_sample_rate, leaf_reg,
                     n_pad=node_cap, n_pad_next=node_cap, cat_cols=cat_cols,
                     n_cols_real=n_cols_real, split_shard=split_shard,
-                    fuse_layout=hlay,
+                    fuse_layout=hlay, mono=mono, node_lo=lo_c, node_hi=hi_c,
                 )
+                if mono is not None:
+                    nid_c, preds_c, vi_c, nsp, rec, pinfo, lo_c, hi_c = out
+                else:
+                    nid_c, preds_c, vi_c, nsp, rec, pinfo = out
                 if sd:
                     rec = dict(rec, split_bin=rec["split_bin"] << sd)
                 bufs_c = {k: bufs_c[k].at[i].set(rec[k]) for k in bufs_c}
                 # direct mode threads a fixed dummy parent carry instead
-                return (i + 1, nid_c, preds_c, vi_c, nsp,
+                base = (i + 1, nid_c, preds_c, vi_c, nsp,
                         hist if subtract else phist, pinfo, bufs_c)
+                return base + ((lo_c, hi_c) if mono is not None else ())
 
             if not subtract:
                 # the direct scheme needs no parent-histogram/pair carry;
@@ -1277,13 +1404,18 @@ def _fused_levels(
             # tagged and scaled at DISPATCH time by the executed iteration
             # count returned below (_run_counted), so the byte counters
             # report actual volume, not the n_sat upper bound
+            carry0 = (jnp.int32(0), nid, preds, varimp, n_split, parent_hist,
+                      pair_info, bufs)
+            if mono is not None:
+                carry0 = carry0 + (node_lo, node_hi)
             with tally_group("sat"):
+                out = jax.lax.while_loop(sat_cond, sat_body, carry0)
+            if mono is not None:
                 (sat_iters, nid, preds, varimp, n_split, parent_hist,
-                 pair_info, bufs) = jax.lax.while_loop(
-                    sat_cond, sat_body,
-                    (jnp.int32(0), nid, preds, varimp, n_split, parent_hist,
-                     pair_info, bufs),
-                )
+                 pair_info, bufs, node_lo, node_hi) = out
+            else:
+                (sat_iters, nid, preds, varimp, n_split, parent_hist,
+                 pair_info, bufs) = out
             prev_shift = sd
             for j in range(n_sat):
                 recs.append({k: bufs[k][j] for k in bufs})
@@ -1304,6 +1436,7 @@ def _fused_levels(
                 bins_u8, nid, preds, varimp,
                 node_stats[:, 0], node_stats[:, 1], node_stats[:, 2],
                 learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
+                node_lo=node_lo, node_hi=node_hi,
             )
             recs.append(rec)
             break
@@ -1323,15 +1456,22 @@ def _fused_levels(
             nid, preds, varimp, _, rec = _force_leaf_from_stats(
                 bins_u8, nid, preds, varimp, tot[:, 0], tot[:, 1], tot[:, 2],
                 learn_rate, max_abs_leaf, n_pad, n_bins, leaf_reg,
+                node_lo=node_lo, node_hi=node_hi,
             )
         else:
-            nid, preds, varimp, n_split, rec, pair_info = _level_core(
+            out = _level_core(
                 hist, bins_d, nid, preds, varimp, lkey, cols_enabled, is_cat,
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg, n_pad=n_pad, n_pad_next=n_pad_next,
                 cat_cols=cat_cols, n_cols_real=n_cols_real,
                 split_shard=split_shard, fuse_layout=hlay,
+                mono=mono, node_lo=node_lo, node_hi=node_hi,
             )
+            if mono is not None:
+                (nid, preds, varimp, n_split, rec, pair_info,
+                 node_lo, node_hi) = out
+            else:
+                nid, preds, varimp, n_split, rec, pair_info = out
             parent_hist = hist
             parent_lay = hlay
             prev_shift = sd
@@ -1436,23 +1576,10 @@ def _level_step_mono_fn(
         learn_rate, max_abs_leaf, n_pad, node_lo=node_lo, node_hi=node_hi,
         reg_lambda=rl, reg_alpha=ra,
     )
-    child_base = record["child_base"]
-
     # child bounds scatter: left child at child_base, right at child_base+1
-    new_lo = jnp.full(n_pad_next, -jnp.inf, jnp.float32)
-    new_hi = jnp.full(n_pad_next, jnp.inf, jnp.float32)
-    inc = mono_col > 0
-    dec = mono_col < 0
-    l_lo = jnp.where(dec, mid, node_lo)
-    l_hi = jnp.where(inc, mid, node_hi)
-    r_lo = jnp.where(inc, mid, node_lo)
-    r_hi = jnp.where(dec, mid, node_hi)
-    li = jnp.where(ok, child_base, n_pad_next)  # OOB drop for leaves
-    ri = jnp.where(ok, child_base + 1, n_pad_next)
-    new_lo = new_lo.at[li].set(l_lo, mode="drop")
-    new_lo = new_lo.at[ri].set(r_lo, mode="drop")
-    new_hi = new_hi.at[li].set(l_hi, mode="drop")
-    new_hi = new_hi.at[ri].set(r_hi, mode="drop")
+    new_lo, new_hi = _child_bounds(
+        ok, record["child_base"], mono_col, mid, node_lo, node_hi, n_pad_next
+    )
     return nid, preds, varimp, n_split, record, new_lo, new_hi
 
 
@@ -1529,6 +1656,7 @@ def _clamp_node_cap(node_cap: int, npad: int, min_rows) -> int:
 def _tree_program(
     max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     n_cols_real: int | None = None, n_cols_pad: int | None = None,
+    mono: bool = False,
 ):
     """One jitted program building a WHOLE tree (growth levels unrolled, the
     saturated run as a lax.while_loop — see :func:`_fused_levels`).
@@ -1546,8 +1674,8 @@ def _tree_program(
     split_shard = _split_shard_on()
     split_fuse = _split_fuse_active(cat_cols, split_shard)
     key = ("tree", max_depth, n_bins, node_cap, cat_cols, subtract,
-           n_cols_real, n_cols_pad, split_shard, split_fuse, _kernel_key(),
-           _mesh_key(),
+           n_cols_real, n_cols_pad, split_shard, split_fuse, bool(mono),
+           _kernel_key(), _mesh_key(),
            tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
            jax.default_backend())
 
@@ -1555,7 +1683,7 @@ def _tree_program(
         def whole_tree(
             bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
             min_rows, min_split_improvement, learn_rate, max_abs_leaf,
-            col_sample_rate, leaf_reg=None,
+            col_sample_rate, leaf_reg=None, mono_vec=None,
         ):
             C = bins_u8.shape[1]
             Cp = n_cols_pad or C
@@ -1564,13 +1692,15 @@ def _tree_program(
                 is_cat = jnp.pad(is_cat, (0, Cp - C))
                 varimp = jnp.pad(varimp, (0, Cp - C))
                 cols_enabled = jnp.pad(cols_enabled, (0, Cp - C))
+                if mono_vec is not None:  # pad columns are unconstrained
+                    mono_vec = jnp.pad(mono_vec, (0, Cp - C))
             nid, preds_, varimp_, records, sat_iters = _fused_levels(
                 bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
                 min_rows, min_split_improvement, learn_rate, max_abs_leaf,
                 col_sample_rate, leaf_reg,
                 max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                 cat_cols=cat_cols, subtract=subtract, n_cols_real=n_cols_real,
-                split_shard=split_shard, split_fuse=split_fuse,
+                split_shard=split_shard, split_fuse=split_fuse, mono=mono_vec,
             )
             return nid, preds_, varimp_[:C], records, sat_iters
 
@@ -1605,6 +1735,7 @@ def build_trees_scanned(
     node_cap: int = 2048,
     reg_lambda: float = 0.0,
     reg_alpha: float = 0.0,
+    monotone=None,
 ):
     """Build ``n_trees`` trees in ONE device dispatch (lax.scan over trees).
 
@@ -1643,19 +1774,22 @@ def build_trees_scanned(
         "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key, C,
         tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
         float(sample_rate), float(col_sample_rate_per_tree), subtract,
-        split_shard, split_fuse, _kernel_key(), _mesh_key(),
-        jax.default_backend(),
+        split_shard, split_fuse, monotone is not None, _kernel_key(),
+        _mesh_key(), jax.default_backend(),
     )
 
     def make():
         def whole_chunk(
             bins_u8, w, y, preds, varimp, base_key, row_key_, offset, lrs, is_cat,
             min_rows_, msi_, max_abs_leaf_, col_rate_, leaf_reg_,
+            mono_vec=None,
         ):
             if Cp > C:  # bucketed column pad: code 0 (NA) everywhere, masked
                 bins_u8 = jnp.pad(bins_u8, ((0, 0), (0, Cp - C)))
                 is_cat = jnp.pad(is_cat, (0, Cp - C))
                 varimp = jnp.pad(varimp, (0, Cp - C))
+                if mono_vec is not None:  # pad columns are unconstrained
+                    mono_vec = jnp.pad(mono_vec, (0, Cp - C))
 
             def body(carry, per_tree):
                 F, vi = carry
@@ -1697,6 +1831,7 @@ def build_trees_scanned(
                     max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                     cat_cols=cat_cols, subtract=subtract, n_cols_real=C,
                     split_shard=split_shard, split_fuse=split_fuse,
+                    mono=mono_vec,
                 )
                 return (F, vi), (recs, sat_i)
 
@@ -1730,6 +1865,10 @@ def build_trees_scanned(
     # the scan body traces once but runs once per tree: mult=n_trees; the
     # saturated-region tallies instead scale by the chunk's total EXECUTED
     # sat levels, returned as the program's last output
+    mono_dev = (
+        None if monotone is None
+        else jnp.asarray(np.asarray(monotone, np.int32))
+    )
     out = _run_counted(
         prog,
         (
@@ -1738,6 +1877,7 @@ def build_trees_scanned(
             jnp.int32(tree_offset), lrs, is_cat_dev,
             jnp.float32(min_rows), jnp.float32(min_split_improvement),
             jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
+            mono_dev,
         ),
         mult=n_trees,
         sat_from=lambda o: o[3],
@@ -1997,11 +2137,46 @@ def build_tree(
     )
 
     # Monotone constraints carry per-node [lo, hi] bound state level to
+    # level. With the fused Pallas lane active the whole constrained tree
+    # runs as ONE whole-tree program (the ISSUE-15 closure: the feasibility
+    # mask lives in the kernel grid step and the bound state rides the
+    # level carry — see _fused_levels); with the fuse gate off, the legacy
+    # per-level host loop below is today's path bit-for-bit.
     # level — a separate per-level loop (constrained builds trade the fused
     # dispatch for correctness; the default path is untouched).
     split_shard = _split_shard_on()
     if monotone is not None and np.any(np.asarray(monotone) != 0):
         mono_dev = jnp.asarray(np.asarray(monotone, np.int32))
+        if _split_fuse_on() and use_fused_trees(max_depth):
+            prog = _tree_program(
+                max_depth, n_bins, node_cap, cat_cols, n_cols_real=C,
+                n_cols_pad=Cp, mono=True,
+            )
+            BUILD_STATS["dispatches"] += 1
+            BUILD_STATS["trees_built"] += 1
+            import time as _time
+
+            _t0 = _time.perf_counter()
+            _, preds, varimp, records, _sat = _run_counted(
+                prog,
+                (
+                    bins_u8, preds, varimp, w, wy, wh, key, cols_enabled_dev,
+                    is_cat_dev,
+                    jnp.float32(min_rows), jnp.float32(min_split_improvement),
+                    jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
+                    jnp.float32(col_sample_rate), leaf_reg, mono_dev,
+                ),
+                sat_from=lambda o: o[4],
+            )
+            _FUSED_SECONDS.inc(_time.perf_counter() - _t0)
+            for rec in records:
+                tree.levels.append(TreeLevel(**rec))
+            return tree, preds, varimp
+        if _split_fuse_on():
+            # fuse gate on but the whole-tree program is off
+            # (H2O3_TPU_WHOLE_TREE=0 / depth cap): the per-level mono loop
+            # below runs the unfused scan — make that visible
+            _FUSED_FALLBACKS.inc(reason="mono")
         nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
         node_lo = jnp.full(1, -jnp.inf, jnp.float32)
         node_hi = jnp.full(1, jnp.inf, jnp.float32)
@@ -2135,16 +2310,22 @@ def _stream_hist_prog(n_pad: int, n_bins: int):
 
 
 def _stream_decide_prog(n_pad: int, n_pad_next: int, n_bins: int,
-                        cat_cols: tuple, force_leaf: bool, n_cols: int):
+                        cat_cols: tuple, force_leaf: bool, n_cols: int,
+                        mono: bool = False):
     """Split scan + leaf decision on the block-accumulated histogram —
     ``_level_core``'s math with the partition update factored out (it runs
-    per block). Returns ``(varimp, n_split, record)``."""
+    per block). Returns ``(varimp, n_split, record)``; with ``mono`` the
+    inputs grow (mono_vec, node_lo, node_hi) and the return appends
+    ``(new_lo, new_hi)`` — the constraint state is per-NODE, so it rides
+    the host level loop untouched by the block structure (the ISSUE-15
+    streamed-GBM gate fix)."""
     key = ("stream_decide", n_pad, n_pad_next, n_bins, cat_cols, force_leaf,
-           n_cols, _mesh_key(), jax.default_backend())
+           n_cols, bool(mono), _mesh_key(), jax.default_backend())
 
     def make():
         def run(hist, key_, cols_enabled, is_cat, varimp, min_rows, msi,
-                learn_rate, max_abs_leaf, col_sample_rate, leaf_reg=None):
+                learn_rate, max_abs_leaf, col_sample_rate, leaf_reg=None,
+                mono_vec=None, node_lo=None, node_hi=None):
             rl, ra = (None, None) if leaf_reg is None else leaf_reg
             if force_leaf:
                 tot = hist[:, 0, :, :].sum(axis=1)  # col 0 ≡ any col
@@ -2156,8 +2337,13 @@ def _stream_decide_prog(n_pad: int, n_pad_next: int, n_bins: int,
                     jnp.zeros(n_pad, bool),
                     jnp.zeros((n_pad, n_bins), bool),
                     jnp.zeros(n_pad, bool), learn_rate, max_abs_leaf,
-                    n_pad, reg_lambda=rl, reg_alpha=ra,
+                    n_pad, node_lo=node_lo, node_hi=node_hi,
+                    reg_lambda=rl, reg_alpha=ra,
                 )
+                if mono:
+                    return (varimp, n_split, rec,
+                            jnp.full(n_pad_next, -jnp.inf, jnp.float32),
+                            jnp.full(n_pad_next, jnp.inf, jnp.float32))
                 return varimp, n_split, rec
             # per-(node,col) sampling mask — same draw as _level_core at
             # the REAL column count (the streamed path never column-pads)
@@ -2165,7 +2351,8 @@ def _stream_decide_prog(n_pad: int, n_pad_next: int, n_bins: int,
             keep = jax.random.uniform(key_, (n_pad, n_cols)) < col_sample_rate
             keep = jnp.where(keep.any(axis=1, keepdims=True), keep, True)
             col_mask = col_mask * keep
-            sp = _split_scan(hist, is_cat, col_mask, min_rows, msi, cat_cols)
+            sp = _split_scan(hist, is_cat, col_mask, min_rows, msi, cat_cols,
+                             mono=mono_vec, node_lo=node_lo, node_hi=node_hi)
             ok = sp["ok"]
             fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
             ok = ok & fits
@@ -2174,10 +2361,17 @@ def _stream_decide_prog(n_pad: int, n_pad_next: int, n_bins: int,
                 ok, gain, sp["node_w"], sp["node_wy"], sp["node_wh"],
                 sp["col"], sp["split_bin"], sp["is_cat"], sp["cat_mask"],
                 sp["na_left"], learn_rate, max_abs_leaf, n_pad,
+                node_lo=node_lo, node_hi=node_hi,
                 reg_lambda=rl, reg_alpha=ra,
             )
             varimp = varimp.at[sp["col"]].add(
                 jnp.where(ok, gain, 0.0).astype(varimp.dtype))
+            if mono:
+                new_lo, new_hi = _child_bounds(
+                    ok, rec["child_base"], sp["mono_col"], sp["mid"],
+                    node_lo, node_hi, n_pad_next,
+                )
+                return varimp, n_split, rec, new_lo, new_hi
             return varimp, n_split, rec
 
         return jax.jit(run)
@@ -2234,6 +2428,7 @@ def build_trees_streamed(
     node_cap: int = 2048,
     reg_lambda: float = 0.0,
     reg_alpha: float = 0.0,
+    monotone=None,
 ):
     """Build ``n_trees`` trees over a :class:`~h2o3_tpu.frame.chunkstore.
     ChunkStore` whose rows exceed the HBM window.
@@ -2252,6 +2447,11 @@ def build_trees_streamed(
 
     Returns ``(trees, varimp)`` with host-resident tree records (streamed
     frames are too big to keep per-level device state around).
+
+    ``monotone`` ((C,) int {-1,0,1}) accepts constrained builds in the
+    streamed lane (ISSUE 15): the per-node [lo, hi] bound state is
+    frontier-sized — it rides the host level loop and the decide dispatch,
+    untouched by the row-block structure.
     """
     from h2o3_tpu.models.tree.binning import bucket_nbins
 
@@ -2269,6 +2469,9 @@ def build_trees_streamed(
         else (jnp.float32(reg_lambda), jnp.float32(reg_alpha))
     )
     gprog = _stream_grad_prog(grad_fn, grad_key, sample_rate < 1.0)
+    mono_dev = None
+    if monotone is not None and np.any(np.asarray(monotone) != 0):
+        mono_dev = jnp.asarray(np.asarray(monotone, np.int32))
     trees: list[Tree] = []
     import time as _time
 
@@ -2298,6 +2501,10 @@ def build_trees_streamed(
         store.fill("nid", 0)
 
         tree = Tree()
+        node_lo = node_hi = None
+        if mono_dev is not None:
+            node_lo = jnp.full(1, -jnp.inf, jnp.float32)
+            node_hi = jnp.full(1, jnp.inf, jnp.float32)
         for depth in range(max_depth + 1):
             n_pad = min(1 << depth, node_cap)
             n_pad_next = min(2 * n_pad, node_cap)
@@ -2312,16 +2519,21 @@ def build_trees_streamed(
                      blk["wh"], hist),
                 )
             dprog = _stream_decide_prog(
-                n_pad, n_pad_next, n_bins, cat_cols, force_leaf, C
+                n_pad, n_pad_next, n_bins, cat_cols, force_leaf, C,
+                mono=mono_dev is not None,
             )
             BUILD_STATS["dispatches"] += 1
-            varimp, n_split, rec = dprog(
+            dout = dprog(
                 hist, jax.random.fold_in(tkey, depth), cols_enabled,
                 is_cat_dev, varimp, jnp.float32(min_rows),
                 jnp.float32(min_split_improvement), jnp.float32(lrs[m]),
                 jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate),
-                leaf_reg,
+                leaf_reg, mono_dev, node_lo, node_hi,
             )
+            if mono_dev is not None:
+                varimp, n_split, rec, node_lo, node_hi = dout
+            else:
+                varimp, n_split, rec = dout
             for bi, blk in store.stream(("bins", "nid", "F")):
                 BUILD_STATS["dispatches"] += 1
                 nid_b, F_b = _partition_update(

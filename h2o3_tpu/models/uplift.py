@@ -244,6 +244,15 @@ def _uplift_level(n_pad, n_pad_next, n_bins, force_leaf, metric):
 def _build_uplift_tree(bins_u8, wt, y, wc, *, n_bins, is_cat_cols, max_depth,
                        min_rows, min_split_improvement, col_sample_rate,
                        preds, key, varimp, metric, node_cap=1024):
+    # fallback observability (ISSUE 15): uplift's 4-lane scan is the one
+    # remaining structural hole in the fused matrix — tally it per tree
+    # when the fuse gate wanted the fused lane
+    from h2o3_tpu.models.tree.shared_tree import (
+        _split_fuse_active,
+        _split_shard_on,
+    )
+
+    _split_fuse_active((), _split_shard_on(), uplift=True)
     is_cat_dev = jnp.asarray(np.asarray(is_cat_cols, bool))
     wyt = wt * y
     wyc = wc * y

@@ -68,19 +68,176 @@ def _tiles() -> tuple[int, int, int]:
     through the environment instead of monkeypatching module globals).
     Callers pass the resolved tuple into :func:`hist_pallas_local` /
     :func:`plan_layout` as a static argument, so every tile choice gets its
-    own jit cache entry — no stale-executable footgun."""
+    own jit cache entry — no stale-executable footgun.
+
+    ``'auto'`` is the SHAPE-AWARE autotuner (ISSUE 15): this shapeless
+    accessor then returns the built-in defaults; shape-aware call sites
+    resolve through :func:`tiles_for`, which runs a first-build micro-sweep
+    per (shape-bucket, mesh) and caches the winner persistently."""
     from h2o3_tpu import config
 
     spec = config.get("H2O3_TPU_PALLAS_TILES").strip()
-    if not spec:
+    if not spec or spec == "auto":
         return (ROW_TILE, COL_TILE, NODE_TILE)
     parts = [int(x) for x in spec.split(",")]
     if len(parts) != 3 or any(p <= 0 for p in parts):
         raise ValueError(
-            f"H2O3_TPU_PALLAS_TILES must be 'ROW,COL,NODE' positive ints, "
-            f"got {spec!r}"
+            f"H2O3_TPU_PALLAS_TILES must be 'ROW,COL,NODE' positive ints "
+            f"or 'auto', got {spec!r}"
         )
     return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# tile autotuner (H2O3_TPU_PALLAS_TILES=auto, ISSUE 15 / ROADMAP 4b): a
+# first-build micro-sweep over a small tile grid, cached per
+# (shape-bucket, mesh) in the persistent compile-cache dir so the queued
+# TPU window tunes itself and same-bucket rebuilds (and later processes)
+# perform ZERO new sweeps. Explicit "ROW,COL,NODE" values bypass the sweep
+# unchanged; '' keeps the built-in defaults.
+
+from h2o3_tpu.utils import metrics as _mx
+
+_TILE_SWEEPS = _mx.counter(
+    "pallas_tile_sweeps_total",
+    "tile-autotuner micro-sweeps executed (H2O3_TPU_PALLAS_TILES=auto; a "
+    "same-bucket rebuild must add zero)", always=True)
+_TUNED_TILES: dict = {}  # in-process cache: key -> (row, col, node)
+_SWEEP_ROWS = 4096  # rows of synthetic data per sweep candidate
+
+
+def _tile_cache_path() -> str:
+    """The persistent winner store, colocated with the XLA compile cache
+    (H2O3_TPU_COMPILE_CACHE, same default as cluster/cloud.py) so one warm
+    volume carries both the executables and the tile choices."""
+    import os
+
+    from h2o3_tpu import config
+
+    d = config.get("H2O3_TPU_COMPILE_CACHE")
+    if not d:
+        import h2o3_tpu
+
+        d = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                h2o3_tpu.__file__))), ".jax_cache")
+    return os.path.join(d, "pallas_tiles.json")
+
+
+def _tile_bucket(c: int, n_nodes: int, n_bins: int, ns: int) -> tuple:
+    """Shape bucket for the tuner cache: columns to the PR-1 ladder
+    granularity (multiple of 8), nodes/bins to powers of two — the same
+    coarsening the program caches already ride, so one sweep serves every
+    shape that compiles to the same kernel geometry family."""
+    cb = -(-c // 8) * 8
+    nb = 1 << max(int(n_nodes - 1).bit_length(), 1)
+    bb = 1 << max(int(n_bins - 1).bit_length(), 3)
+    return (cb, nb, bb, ns)
+
+
+def _sweep_grid(c: int, n_nodes: int) -> list:
+    """The candidate triples: a small cross of row/col/node tiles clamped
+    to the problem (12 candidates max — a first-build cost, paid once per
+    bucket per mesh and then cached persistently)."""
+    rows = (256, 512, 1024)
+    cols = tuple(sorted({min(4, c), min(8, c)}))
+    nodes = tuple(sorted({min(32, n_nodes), min(64, n_nodes)}))
+    return [(r, ct, nt) for r in rows for ct in cols for nt in nodes]
+
+
+def _run_tile_sweep(c, n_nodes, n_bins, ns, interpret: bool) -> tuple:
+    """Time each candidate on synthetic data of the real geometry; return
+    the fastest triple. Runs eagerly (concrete arrays) — safe to call from
+    inside an outer trace, where it executes at trace time exactly once."""
+    import time
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    n = _SWEEP_ROWS
+    bins = jnp.asarray(rng.integers(0, n_bins, (n, c)).astype(np.uint8))
+    nid = jnp.asarray(rng.integers(0, n_nodes, n).astype(np.int32))
+    stats = jnp.asarray(rng.normal(size=(n, ns)).astype(np.float32))
+    best, best_t = None, None
+    for tiles in _sweep_grid(c, n_nodes):
+        try:
+            fn = lambda: hist_pallas_local(
+                bins, nid, stats, n_nodes, n_bins, interpret=interpret,
+                blocked=True, tiles=tiles,
+            )
+            jax.block_until_ready(fn())  # compile
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            dt = time.perf_counter() - t0
+        except Exception:  # a candidate the backend rejects: skip it
+            continue
+        if best_t is None or dt < best_t:
+            best, best_t = tiles, dt
+    # the candidate executables are one-shot — drop them (the winner
+    # recompiles once inside the real program; keeping 11 losers loaded
+    # per bucket would only grow the process's executable footprint)
+    hist_pallas_local.clear_cache()
+    return best or (ROW_TILE, COL_TILE, NODE_TILE)
+
+
+def tiles_for(c: int, n_nodes: int, n_bins: int, ns: int) -> tuple:
+    """The tile triple for a problem shape — THE shape-aware resolver.
+
+    Explicit ``H2O3_TPU_PALLAS_TILES="ROW,COL,NODE"`` values (and the ''
+    defaults) bypass the tuner unchanged; ``'auto'`` looks the shape bucket
+    up in the in-process cache, then the persistent winner store, and only
+    then runs the micro-sweep (``pallas_tile_sweeps_total`` counts actual
+    sweeps — the same-bucket-rebuild-adds-zero pin)."""
+    from h2o3_tpu import config
+
+    spec = config.get("H2O3_TPU_PALLAS_TILES").strip()
+    if spec != "auto":
+        return _tiles()
+    from h2o3_tpu.parallel.mesh import mesh_key
+
+    bucket = _tile_bucket(c, n_nodes, n_bins, ns)
+    key = (bucket, mesh_key(), jax.default_backend())
+    hit = _TUNED_TILES.get(key)
+    if hit is not None:
+        return hit
+    import json
+    import os
+
+    path = _tile_cache_path()
+    skey = repr(key)
+    try:
+        with open(path) as f:
+            stored = json.load(f)
+    except (OSError, ValueError):
+        stored = {}
+    if skey in stored:
+        tiles = tuple(int(x) for x in stored[skey])
+        _TUNED_TILES[key] = tiles
+        return tiles
+    _TILE_SWEEPS.inc()
+    tiles = _run_tile_sweep(
+        # sweep at the BUCKET geometry so every shape in the bucket lands
+        # on the same winner (and the cache key matches what was measured)
+        bucket[0], bucket[1], min(bucket[2], 256), ns,
+        interpret=jax.default_backend() == "cpu",
+    )
+    _TUNED_TILES[key] = tiles
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        stored[skey] = list(tiles)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(stored, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)  # atomic publish (the PR-2 persist idiom)
+    except OSError:
+        pass  # read-only cache volume: the in-process cache still holds
+    from h2o3_tpu.utils.log import Log
+
+    Log.info(
+        f"Pallas tile autotuner: bucket {bucket} on "
+        f"{jax.default_backend()} -> tiles {tiles}"
+    )
+    return tiles
 
 
 @dataclass(frozen=True)

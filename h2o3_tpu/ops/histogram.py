@@ -139,11 +139,13 @@ def _select_local():
         return _hist_scatter_local
 
     def pallas_local(bins_u8, nid, stats, n_nodes, n_bins):
-        from h2o3_tpu.ops.hist_pallas import _tiles, hist_pallas_local
+        from h2o3_tpu.ops.hist_pallas import hist_pallas_local, tiles_for
 
         return hist_pallas_local(
             bins_u8, nid, stats, n_nodes, n_bins,
-            interpret=jax.default_backend() == "cpu", tiles=_tiles(),
+            interpret=jax.default_backend() == "cpu",
+            tiles=tiles_for(
+                bins_u8.shape[1], n_nodes, n_bins, stats.shape[1]),
         )
 
     return pallas_local
@@ -287,9 +289,11 @@ def histogram_in_jit(
     dense_b = C * n_nodes * n_bins * S * 4
     scan_b = (Cp / n_col if col_sharded else C) * n_nodes * n_bins * S * 4
     if _local_is_pallas(local):
-        from h2o3_tpu.ops.hist_pallas import _tiles, plan_layout
+        from h2o3_tpu.ops.hist_pallas import plan_layout, tiles_for
 
-        opad = plan_layout(C, n_nodes, n_bins, S, tiles=_tiles()).nbytes
+        opad = plan_layout(
+            C, n_nodes, n_bins, S, tiles=tiles_for(C, n_nodes, n_bins, S)
+        ).nbytes
         record_hbm("pallas_unfused", 4 * opad + dense_b + scan_b)
     else:
         record_hbm("dense", dense_b + scan_b)
@@ -316,10 +320,10 @@ def _histogram_in_jit_fused(
 ):
     """Blocked-layout histogram body: see ``histogram_in_jit(fused=True)``."""
     from h2o3_tpu.ops.hist_pallas import (
-        _tiles,
         blocked_from_dense,
         hist_pallas_local,
         plan_layout,
+        tiles_for,
     )
 
     S = len(stats)
@@ -328,7 +332,7 @@ def _histogram_in_jit_fused(
     C = bins_u8.shape[1]
     is_pallas = _local_is_pallas(local)
     layout = plan_layout(
-        C, n_nodes, n_bins, S, tiles=_tiles(),
+        C, n_nodes, n_bins, S, tiles=tiles_for(C, n_nodes, n_bins, S),
         n_shards=n_col if col_sharded else 1,
     )
 

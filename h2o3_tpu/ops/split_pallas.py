@@ -28,8 +28,14 @@ Categorical columns keep the mean-sorted XLA branch (argsorts are not a
 Pallas-friendly shape): :func:`fused_split_scan` gathers ONLY the
 categorical columns' tiles into a small dense (N, Cc, B, S) tensor and runs
 the existing formulas there — per-column routing, numeric stays on the
-kernel. Monotone-constraint builds use the unfused scan entirely (the
-feasibility mask is per-bin; see the fallback matrix in docs/MIGRATION.md).
+kernel. Monotone constraints (ISSUE 15) thread INTO the kernel grid step:
+the per-bin feasibility mask — bound-clamped child Newton values must not
+violate the column's direction — is mirrored op-for-op from
+``_split_scan``'s ``mono`` branch (a per-column ``mono`` lane and per-node
+``node_lo``/``node_hi`` bounds are extra kernel inputs), and the winner's
+``mid``/``mono_col`` bound-propagation outputs are derived from the folded
+child stats exactly as the unfused scan derives them. The unconstrained
+kernel trace is untouched (the mono variant is a separate kernel).
 """
 
 from __future__ import annotations
@@ -60,7 +66,7 @@ def _fit(s):
 
 def _split_kernel(
     blk_ref, tot_ref, mr_ref, gain_ref, t_ref, nal_ref, lst_ref, rst_ref,
-    *, nt, ct, bpad, ns, n_bins,
+    *, nt, ct, bpad, ns, n_bins, mono_ref=None, lo_ref=None, hi_ref=None,
 ):
     # one histogram tile, exactly as hist_pallas emitted it:
     # rows = node·S + stat, lanes = bin·CT + col
@@ -87,6 +93,27 @@ def _split_kernel(
 
     g_nal = gain_with_na(left + na[:, :, None, :], right)
     g_nar = gain_with_na(left, right + na[:, :, None, :])
+    if mono_ref is not None:
+        # monotone feasibility, the same ops as _split_scan's mono branch:
+        # bound-clamped child Newton values must not violate the direction
+        mono = mono_ref[0].astype(jnp.int32)  # (ct,) this tile's columns
+        lo = lo_ref[:, 0]  # (nt,) this tile's node bounds
+        hi = hi_ref[:, 0]
+
+        def child_val(s):  # wy/wh clamped to the node's [lo, hi]
+            v = jnp.where(
+                s[..., 2] > 0, s[..., 1] / jnp.maximum(s[..., 2], 1e-30), 0.0
+            )
+            return jnp.clip(v, lo[:, None, None], hi[:, None, None])
+
+        m = mono[None, :, None]
+        na_b = na[:, :, None, :]
+        ok_nl = (m == 0) | (
+            m * (child_val(right) - child_val(left + na_b)) >= 0)
+        ok_nr = (m == 0) | (
+            m * (child_val(right + na_b) - child_val(left)) >= 0)
+        g_nal = jnp.where(ok_nl, g_nal, _NEG)
+        g_nar = jnp.where(ok_nr, g_nar, _NEG)
     # candidates past the REAL bin range (bpad tile padding) must not exist:
     # with min_rows == 0 an all-left "split" on a pad slot would otherwise
     # become feasible, which the dense scan never even enumerates
@@ -116,11 +143,27 @@ def _split_kernel(
     rst_ref[0] = jnp.transpose(Rst, (0, 2, 1)).reshape(nt * ns, ct)
 
 
+def _split_kernel_mono(
+    blk_ref, tot_ref, mr_ref, mono_ref, lo_ref, hi_ref,
+    gain_ref, t_ref, nal_ref, lst_ref, rst_ref,
+    *, nt, ct, bpad, ns, n_bins,
+):
+    """Monotone-constrained grid step: the same kernel with the per-column
+    direction lane and per-node bound inputs threaded through (the
+    unconstrained trace above stays byte-identical — separate kernel)."""
+    _split_kernel(
+        blk_ref, tot_ref, mr_ref, gain_ref, t_ref, nal_ref, lst_ref, rst_ref,
+        nt=nt, ct=ct, bpad=bpad, ns=ns, n_bins=n_bins,
+        mono_ref=mono_ref, lo_ref=lo_ref, hi_ref=hi_ref,
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("layout", "interpret")
 )
 def split_candidates(
-    blk, node_totals, min_rows, layout: HistLayout, interpret: bool = False
+    blk, node_totals, min_rows, layout: HistLayout, interpret: bool = False,
+    mono=None, node_lo=None, node_hi=None,
 ):
     """Per-(node, col) numeric split candidates from a blocked histogram.
 
@@ -129,6 +172,11 @@ def split_candidates(
     tiny next to the histogram. ``node_totals`` is (n_nodes, S): the GLOBAL
     column-0 totals every block's gains are computed against (the sharded
     merge's bit-exactness contract, see shared_tree._split_scan_sharded).
+
+    ``mono`` ((cpad,) int {-1,0,1}) + ``node_lo``/``node_hi`` ((n_nodes,))
+    select the monotone-constrained kernel variant: infeasible candidates
+    are masked to ``_NEG`` inside the grid step, exactly as the unfused
+    scan masks them before its argmax.
     """
     L = layout
     nt, ct, bpad, ns = L.nt, L.ct, L.bpad, L.ns
@@ -137,9 +185,50 @@ def split_candidates(
         tot = jnp.pad(tot, ((0, L.nn - L.n_nodes), (0, 0)))
     mr = jnp.asarray(min_rows, jnp.float32).reshape(1, 1)
 
-    kernel = functools.partial(
-        _split_kernel, nt=nt, ct=ct, bpad=bpad, ns=ns, n_bins=L.n_bins
-    )
+    specs = [
+        pl.BlockSpec(
+            (1, nt * ns, ct * bpad),
+            lambda ct_, nt_: (ct_, nt_, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (nt, ns), lambda ct_, nt_: (nt_, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (1, 1), lambda ct_, nt_: (0, 0), memory_space=pltpu.VMEM
+        ),
+    ]
+    args = [blk, tot, mr]
+    if mono is not None:
+        kernel = functools.partial(
+            _split_kernel_mono, nt=nt, ct=ct, bpad=bpad, ns=ns,
+            n_bins=L.n_bins,
+        )
+        mono_t = mono.astype(jnp.int32).reshape(L.n_ct, ct)
+        # pad-node bounds are inert: their histograms are all zero, so no
+        # candidate there is ever feasible regardless of the bound values
+        lo = node_lo.astype(jnp.float32)
+        hi = node_hi.astype(jnp.float32)
+        if L.nn > L.n_nodes:
+            lo = jnp.pad(lo, (0, L.nn - L.n_nodes),
+                         constant_values=-jnp.inf)
+            hi = jnp.pad(hi, (0, L.nn - L.n_nodes), constant_values=jnp.inf)
+        specs += [
+            pl.BlockSpec(
+                (1, ct), lambda ct_, nt_: (ct_, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (nt, 1), lambda ct_, nt_: (nt_, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (nt, 1), lambda ct_, nt_: (nt_, 0), memory_space=pltpu.VMEM
+            ),
+        ]
+        args += [mono_t, lo.reshape(L.nn, 1), hi.reshape(L.nn, 1)]
+    else:
+        kernel = functools.partial(
+            _split_kernel, nt=nt, ct=ct, bpad=bpad, ns=ns, n_bins=L.n_bins
+        )
     scalar_spec = lambda: pl.BlockSpec(
         (1, nt, ct), lambda ct_, nt_: (ct_, nt_, 0), memory_space=pltpu.VMEM
     )
@@ -150,19 +239,7 @@ def split_candidates(
     gain, tbest, nal, lst, rst = pl.pallas_call(
         kernel,
         grid=(L.n_ct, L.n_nt),
-        in_specs=[
-            pl.BlockSpec(
-                (1, nt * ns, ct * bpad),
-                lambda ct_, nt_: (ct_, nt_, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (nt, ns), lambda ct_, nt_: (nt_, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, 1), lambda ct_, nt_: (0, 0), memory_space=pltpu.VMEM
-            ),
-        ],
+        in_specs=specs,
         out_specs=[
             scalar_spec(), scalar_spec(), scalar_spec(),
             stat_spec(), stat_spec(),
@@ -182,7 +259,7 @@ def split_candidates(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(blk, tot, mr)
+    )(*args)
 
     N, Cp = L.n_nodes, L.cpad
     to_nc = lambda a: jnp.transpose(a, (1, 0, 2)).reshape(L.nn, Cp)[:N]
@@ -200,7 +277,7 @@ def split_candidates(
 def fused_split_scan(
     blk, layout: HistLayout, is_cat, col_mask, min_rows,
     min_split_improvement, cat_cols=(), node_totals=None,
-    interpret: bool | None = None,
+    interpret: bool | None = None, mono=None, node_lo=None, node_hi=None,
 ):
     """Best split per node from a BLOCKED histogram — the drop-in fused
     replacement for ``shared_tree._split_scan`` (same return dict, same
@@ -212,6 +289,12 @@ def fused_split_scan(
     ``cat_cols`` (static GLOBAL column indices) routes those columns to the
     mean-sorted fallback branch on a small dense gather; ``node_totals``
     overrides the column-0 totals exactly as in ``_split_scan``.
+
+    ``mono`` ((C,) int {-1,0,1}) activates the monotone-constrained kernel
+    variant with per-node ``node_lo``/``node_hi`` bounds; the result then
+    carries ``mid``/``mono_col`` for child-bound propagation, mirroring the
+    unfused scan (categorical winners carry ``mono_col`` 0 — the cat branch
+    is unconstrained there too).
     """
     L = layout
     if interpret is None:
@@ -223,9 +306,12 @@ def fused_split_scan(
     if Cp > C:
         is_cat = jnp.pad(is_cat, (0, Cp - C))
         col_mask = jnp.pad(col_mask, ((0, 0), (0, Cp - C)))
+        if mono is not None:  # pad columns are unconstrained (and masked)
+            mono = jnp.pad(mono, (0, Cp - C))
 
     num_best_gain, num_best_t, num_na_left, Lst_n, Rst_n = split_candidates(
-        blk, node_totals, min_rows, layout=L, interpret=interpret
+        blk, node_totals, min_rows, layout=L, interpret=interpret,
+        mono=mono, node_lo=node_lo, node_hi=node_hi,
     )
 
     if cat_cols:
@@ -323,7 +409,7 @@ def fused_split_scan(
         bc_na_left = take(num_na_left)
         cat_mask = jnp.zeros((N, B), bool)
 
-    return {
+    out = {
         "Lst": Lst,
         "Rst": Rst,
         "gain": best_gain,
@@ -337,3 +423,20 @@ def fused_split_scan(
         "node_wy": node_totals[:, 1],
         "node_wh": node_totals[:, 2],
     }
+    if mono is not None:
+        # chosen split's clamped child values -> mid for bound propagation;
+        # same formulas as _split_scan's tail (categorical winners carry
+        # mono_col 0, so their mid is never consumed)
+        vL = jnp.clip(
+            jnp.where(Lst[:, 2] > 0,
+                      Lst[:, 1] / jnp.maximum(Lst[:, 2], 1e-30), 0.0),
+            node_lo, node_hi,
+        )
+        vR = jnp.clip(
+            jnp.where(Rst[:, 2] > 0,
+                      Rst[:, 1] / jnp.maximum(Rst[:, 2], 1e-30), 0.0),
+            node_lo, node_hi,
+        )
+        out["mid"] = 0.5 * (vL + vR)
+        out["mono_col"] = jnp.where(bc_is_cat, 0, mono[best_col])
+    return out

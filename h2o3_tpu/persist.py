@@ -533,8 +533,14 @@ def _rebuild_glm(model: Model) -> None:
     from h2o3_tpu.models.glm_families import get_family
 
     p = model.params
+    fam = model.output["family"]
+    if fam in ("multinomial", "ordinal"):
+        # these fits carry a binomial family_obj only for metric plumbing
+        # (scoring goes through beta_multinomial_std / theta directly)
+        model.output["family_obj"] = get_family("binomial")
+        return
     model.output["family_obj"] = get_family(
-        model.output["family"], p.link,
+        fam, p.link,
         float(p.tweedie_variance_power or 1.5),
         float(p.tweedie_link_power), float(p.theta),
     )

@@ -267,6 +267,142 @@ def test_glm_fused_checkpoint_kill_and_resume_bit_exact(tmp_path):
         np.asarray(full.output["beta_std"]))
 
 
+def _free_compile_state():
+    """Drop in-memory compiled executables after a compile-heavy test —
+    the ISSUE-15 suites add dozens of programs (fused multinomial on three
+    sub-meshes, dropout lanes) to a long-lived tier-1 process that this
+    jaxlib's CPU backend can otherwise crash compiling into (see the
+    test_split_pallas twin of this helper); later tests re-read the
+    persistent compile cache, so the wall cost is small."""
+    jax.clear_caches()
+
+
+def _df_multinomial(n=1200, c=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    eta = np.stack([X[:, 0], -X[:, 1], 0.5 * X[:, 2]], 1)
+    pm_ = np.exp(eta)
+    pm_ /= pm_.sum(1, keepdims=True)
+    yk = np.array([rng.choice(3, p=pr) for pr in pm_])
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(c)])
+    df["y"] = np.array(["a", "b", "c"])[yk]
+    return df
+
+
+def test_glm_fused_multinomial_parity_and_dispatches():
+    """ISSUE-15 closure (b): the K-class cycling IRLS runs as ONE fused
+    program (lax.scan over classes inside one while_loop). Coefficient
+    parity <= 2e-3 vs the host f64 cycling loop at equal iteration count,
+    and dispatches/model drop >= 3x (counter-pinned: the host loop pays
+    one dispatch per (iteration, class))."""
+    fr = Frame.from_pandas(_df_multinomial(seed=21))
+    # objective_epsilon=0 pins both lanes to the FULL iteration budget so
+    # the dispatch ratio compares equal work
+    kw = dict(family="multinomial", max_iterations=8, seed=1,
+              objective_epsilon=0.0)
+    d0 = mx.counter_value("glm_dispatches_total")
+    m_f = GLM(**kw).train(y="y", training_frame=fr)
+    d1 = mx.counter_value("glm_dispatches_total")
+    with _env(H2O3_TPU_GLM_FUSE="0"):
+        m_u = GLM(**kw).train(y="y", training_frame=fr)
+    d2 = mx.counter_value("glm_dispatches_total")
+    fused_disp, unfused_disp = d1 - d0, d2 - d1
+    assert unfused_disp == 8 * 3  # one per (iteration, class)
+    assert unfused_disp >= 3 * fused_disp, (unfused_disp, fused_disp)
+    Bf = np.asarray(m_f.output["beta_multinomial_std"])
+    Bu = np.asarray(m_u.output["beta_multinomial_std"])
+    np.testing.assert_allclose(Bf, Bu, atol=2e-3)
+    pf = m_f.predict(fr)
+    pu = m_u.predict(fr)
+    np.testing.assert_allclose(
+        pf.vec(pf.names[-1]).to_numpy(), pu.vec(pu.names[-1]).to_numpy(),
+        atol=1e-4)
+    _free_compile_state()
+
+
+@pytest.mark.parametrize("k", [2, 8])
+def test_glm_fused_multinomial_mesh_sweep(k):
+    """The fused multinomial's sharded Gram (per-class psum_scatter +
+    gather) on 2/8-device sub-meshes matches the 1-device fused run."""
+    df = _df_multinomial(seed=22)
+    kw = dict(family="multinomial", max_iterations=6, seed=1)
+    with _use_mesh(1):
+        m1 = GLM(**kw).train(y="y", training_frame=Frame.from_pandas(df))
+    with _use_mesh(k):
+        mk = GLM(**kw).train(y="y", training_frame=Frame.from_pandas(df))
+    np.testing.assert_allclose(
+        np.asarray(m1.output["beta_multinomial_std"]),
+        np.asarray(mk.output["beta_multinomial_std"]), atol=2e-3)
+    _free_compile_state()
+
+
+def test_glm_fused_multinomial_kill_and_resume_bit_exact(tmp_path):
+    """Multinomial irls_state (NEW in ISSUE 15): with
+    export_checkpoints_dir the fused chunk clamps to one outer iteration,
+    snapshots carry (it, ll_prev, Beta), and a killed run resumed from the
+    snapshot reproduces the uninterrupted FUSED trajectory bit-for-bit."""
+    from h2o3_tpu.persist import load_model
+
+    fr = Frame.from_pandas(_df_multinomial(seed=23))
+    kw = dict(family="multinomial", max_iterations=8, seed=1,
+              objective_epsilon=0.0)
+    full = GLM(**kw).train(y="y", training_frame=fr)
+    ckdir = str(tmp_path / "glm_mn_ck")
+    with faults.inject(abort={"glm": 3}):
+        with pytest.raises(faults.TrainAbort):
+            GLM(export_checkpoints_dir=ckdir, **kw).train(
+                y="y", training_frame=fr)
+    snaps = [f for f in os.listdir(ckdir) if "glm_ckpt" in f]
+    assert snaps
+    prior = load_model(os.path.join(ckdir, snaps[0]))
+    st_ = prior.output["irls_state"]
+    assert st_["multinomial"] and st_["it"] <= 3
+    resumed = GLM(checkpoint=prior.key, **kw).train(y="y", training_frame=fr)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.output["beta_multinomial_std"]),
+        np.asarray(full.output["beta_multinomial_std"]))
+    _free_compile_state()
+
+
+def test_glm_fused_ordinal_matches_host_driver():
+    """The fused on-device BFGS ordinal fit converges to the host
+    L-BFGS-B optimum (the NLL is convex in this parameterization);
+    predictions within the f32 optimization envelope."""
+    rng = np.random.default_rng(24)
+    n, c = 1000, 4
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    lat = X[:, 0] - 0.7 * X[:, 1] + 0.5 * rng.normal(size=n)
+    yk = np.digitize(lat, [-0.7, 0.7])
+    df = pd.DataFrame(X, columns=[f"x{i}" for i in range(c)])
+    df["y"] = np.array(["lo", "mid", "hi"])[yk]
+    fr = Frame.from_pandas(df)
+    m_f = GLM(family="ordinal", seed=1).train(y="y", training_frame=fr)
+    with _env(H2O3_TPU_GLM_FUSE="0"):
+        m_h = GLM(family="ordinal", seed=1).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(
+        np.asarray(m_f.output["beta_std"]),
+        np.asarray(m_h.output["beta_std"]), atol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(m_f.output["theta"]),
+        np.asarray(m_h.output["theta"]), atol=2e-3)
+    pf = m_f.predict(fr)
+    ph = m_h.predict(fr)
+    np.testing.assert_allclose(
+        pf.vec(pf.names[-1]).to_numpy(), ph.vec(ph.names[-1]).to_numpy(),
+        atol=2e-3)
+
+
+def test_glm_fallback_counter_p_values():
+    """glm_fuse_fallbacks_total{reason=p_values}: the surviving structural
+    GLM fallback tallies at the gate."""
+    fr = Frame.from_pandas(_df(seed=25))
+    f0 = mx.counter_value("glm_fuse_fallbacks_total", reason="p_values")
+    GLM(family="binomial", lambda_=0.0, alpha=0.0, compute_p_values=True,
+        max_iterations=5, seed=1).train(y="y", training_frame=fr)
+    assert mx.counter_value(
+        "glm_fuse_fallbacks_total", reason="p_values") > f0
+
+
 def test_glm_p_values_fall_back_unfused():
     """compute_p_values pins the host-f64 trajectory (fallback matrix):
     the fused chunk cache must see no traffic."""
@@ -390,6 +526,61 @@ def test_dl_same_bucket_rebuild_zero_new_compiles():
         y="y", training_frame=Frame.from_pandas(_df(seed=16, c=7)))
     assert mx.counter_value("dl_programs_compiled_total") == c0
     assert mx.counter_value("dl_program_cache_hits_total") > h0
+
+
+def test_dl_dropout_trains_on_sharded_lane_with_ctl_parity():
+    """ISSUE-15 closure (c): dropout no longer gates the sharded-gradient
+    lane — each device folds its shard index into the minibatch dropout
+    key. The H2O3_TPU_DL_GRAD_SHARD=ctl lane is the replicated control
+    drawing the SAME masks (per-chunk folds): trajectory parity pinned at
+    1e-4 preds. The old replicated lane (full-batch masks) must genuinely
+    DIFFER — proving the dropout actually fires — and GRAD_SHARD=0 still
+    restores it."""
+    fr = Frame.from_pandas(_df(seed=26))
+    kw = dict(hidden=[16], epochs=4, mini_batch_size=64, seed=7,
+              activation="RectifierWithDropout",
+              hidden_dropout_ratios=[0.3], input_dropout_ratio=0.1)
+    g0 = mx.counter_value("tree_collective_bytes_total",
+                          phase="dl_grad_reduce")
+    m_s = DeepLearning(**kw).train(y="y", training_frame=fr)
+    assert mx.counter_value(
+        "tree_collective_bytes_total", phase="dl_grad_reduce") > g0, \
+        "dropout training no longer engaged the sharded lane"
+    with _env(H2O3_TPU_DL_GRAD_SHARD="ctl"):
+        m_c = DeepLearning(**kw).train(y="y", training_frame=fr)
+    with _env(H2O3_TPU_DL_GRAD_SHARD="0"):
+        m_r = DeepLearning(**kw).train(y="y", training_frame=fr)
+    ps = m_s.predict(fr)
+    pc = m_c.predict(fr)
+    pr = m_r.predict(fr)
+    a = ps.vec(ps.names[-1]).to_numpy()
+    b = pc.vec(pc.names[-1]).to_numpy()
+    c = pr.vec(pr.names[-1]).to_numpy()
+    np.testing.assert_allclose(a, b, atol=1e-4)  # the trajectory-parity pin
+    # full-batch masks are a DIFFERENT dropout stream: if these matched,
+    # the parity above would be vacuous (dropout never fired)
+    assert np.max(np.abs(a - c)) > 1e-3
+    _free_compile_state()
+
+
+def test_dl_shard_fallback_counter_reasons():
+    """dl_shard_fallbacks_total{reason}: batch indivisibility and
+    non-elementwise optimizer state still fall back — and tally."""
+    fr = Frame.from_pandas(_df(seed=27))
+    b0 = mx.counter_value("dl_shard_fallbacks_total",
+                          reason="batch_indivisible")
+    # 63 % 8 != 0 on the 8-device mesh -> replicated + counter
+    DeepLearning(hidden=[8], epochs=2, mini_batch_size=63, seed=4).train(
+        y="y", training_frame=fr)
+    assert mx.counter_value(
+        "dl_shard_fallbacks_total", reason="batch_indivisible") > b0
+    o0 = mx.counter_value("dl_shard_fallbacks_total", reason="opt_state")
+    # momentum SGD carries a schedule step counter -> non-elementwise
+    DeepLearning(hidden=[8], epochs=2, mini_batch_size=64, seed=4,
+                 adaptive_rate=False, rate=0.01, rate_decay=0.9,
+                 momentum_start=0.5).train(y="y", training_frame=fr)
+    assert mx.counter_value(
+        "dl_shard_fallbacks_total", reason="opt_state") > o0
 
 
 def test_dl_chunked_checkpoint_resume_matches_full():

@@ -20,6 +20,12 @@ barely matters — bin count and tile sizes are the levers.
         # fused_tree_s + hist_hbm_bytes_per_tree (the modeled HBM traffic
         # of the hist+split phases), then a {"fused_ab": ...} summary.
 
+    python tools/bench_kernel_sweep.py --fallback-ab [--rows N]
+        # fallback-matrix closure A/B (ISSUE 15): monotone GBM, multinomial
+        # GLM and dropout DL each run the NOW-fused lane vs the forced
+        # fallback it replaces (kill-switch knobs), with parity pins and
+        # dispatch/wall ratios in a {"fallback_ab": ...} summary line.
+
     python tools/bench_kernel_sweep.py --oocore-ab [--rows N]
         # streamed-vs-resident out-of-core A/B (ISSUE 11): forces an HBM
         # window of 1/10th the frame's training lanes, measures wall time,
@@ -560,6 +566,159 @@ def oocore_ab(rows: int = 120_000, cols: int = 12) -> None:
         }}), flush=True)
 
 
+def fallback_ab(rows: int = 8_000, cols: int = 12) -> None:
+    """Fallback-matrix closure A/B (ISSUE 15): for each production shape
+    that used to hit a slow lane — monotone GBM, multinomial GLM, dropout
+    DL — run the NOW-fused lane against the forced fallback it replaces
+    (the respective kill-switch knob), on the SAME mesh and data. Per mode:
+    wall seconds + host dispatches; then a {"fallback_ab": ...} summary
+    with the parity pins (mono preds allclose fused-vs-fallback on the
+    integer-exact data, GLM coef delta <= 2e-3, DL preds <= 1e-4 vs the
+    =ctl same-masks control) and the dispatch/wall ratios. The tree lanes
+    pin H2O3_TPU_HIST=pallas so the comparison isolates the pipeline."""
+    import jax
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.deeplearning import DeepLearning
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.models.tree import GBM
+    from h2o3_tpu.parallel.mesh import get_mesh
+    from h2o3_tpu.utils import metrics as mx
+
+    n_dev = int(get_mesh().devices.size)
+    summary = {}
+
+    def timed(fn, counter):
+        fn()  # compile warmup
+        d0 = mx.counter_value(counter)
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        return out, dt, int(mx.counter_value(counter) - d0)
+
+    # ---- (a) monotone GBM: fused whole-tree lane vs the legacy per-level
+    # mono loop (H2O3_TPU_SPLIT_FUSE=0) ----
+    rng = np.random.default_rng(0)
+    df = {"a": rng.integers(0, 50, rows).astype(np.float64)}
+    for i in range(cols - 1):
+        df[f"x{i}"] = rng.normal(size=rows)
+    import pandas as pd
+
+    dfp = pd.DataFrame(df)
+    dfp["label"] = (dfp["a"] * 0.1 + 0.5 * dfp["x0"]
+                    + 0.1 * rng.normal(size=rows))
+    fr_m = Frame.from_pandas(dfp)
+    kw_m = dict(ntrees=8, max_depth=5, seed=7,
+                monotone_constraints={"a": 1})
+    os.environ["H2O3_TPU_HIST"] = "pallas"
+    preds = {}
+    for mode, fuse in (("fused", "1"), ("fallback", "0")):
+        os.environ["H2O3_TPU_SPLIT_FUSE"] = fuse
+
+        def run_m():
+            m = GBM(**kw_m).train(y="label", training_frame=fr_m)
+            pr = m.predict(fr_m)
+            return pr.vec(pr.names[-1]).to_numpy()
+
+        p, dt, disp = timed(run_m, "tree_dispatches_total")
+        preds[mode] = p
+        rec = {"phase": "fallback_ab", "case": "mono_gbm", "mode": mode,
+               "n_devices": n_dev, "rows": rows,
+               "train_s": round(dt, 4), "dispatches": disp}
+        print(json.dumps(rec), flush=True)
+        summary[f"mono_{mode}"] = rec
+    os.environ.pop("H2O3_TPU_SPLIT_FUSE", None)
+    os.environ.pop("H2O3_TPU_HIST", None)
+    mono_delta = float(np.max(np.abs(preds["fused"] - preds["fallback"])))
+
+    # ---- (b) multinomial GLM: fused class-scan chunk vs the host f64
+    # cycling loop (H2O3_TPU_GLM_FUSE=0) ----
+    K = 3
+    X = rng.normal(size=(rows, 5)).astype(np.float32)
+    eta = np.stack([X[:, 0], -X[:, 1], 0.5 * X[:, 2]], 1)
+    pmat = np.exp(eta)
+    pmat /= pmat.sum(1, keepdims=True)
+    yk = np.array([rng.choice(K, p=pr_) for pr_ in pmat])
+    dfg = pd.DataFrame(X, columns=[f"g{i}" for i in range(5)])
+    dfg["label"] = np.array(["a", "b", "c"])[yk]
+    fr_g = Frame.from_pandas(dfg)
+    kw_g = dict(family="multinomial", max_iterations=10, seed=1,
+                objective_epsilon=0.0)
+    betas = {}
+    for mode, fuse in (("fused", ""), ("fallback", "0")):
+        if fuse:
+            os.environ["H2O3_TPU_GLM_FUSE"] = fuse
+        else:
+            os.environ.pop("H2O3_TPU_GLM_FUSE", None)
+
+        def run_g():
+            m = GLM(**kw_g).train(y="label", training_frame=fr_g)
+            return np.asarray(m.output["beta_multinomial_std"])
+
+        B, dt, disp = timed(run_g, "glm_dispatches_total")
+        betas[mode] = B
+        rec = {"phase": "fallback_ab", "case": "multinomial_glm",
+               "mode": mode, "n_devices": n_dev, "rows": rows,
+               "classes": K, "train_s": round(dt, 4), "dispatches": disp}
+        print(json.dumps(rec), flush=True)
+        summary[f"glm_{mode}"] = rec
+    os.environ.pop("H2O3_TPU_GLM_FUSE", None)
+    glm_delta = float(np.max(np.abs(betas["fused"] - betas["fallback"])))
+
+    # ---- (c) dropout DL: sharded-grad lane vs the =ctl same-masks
+    # replicated control (the parity pin) AND the =0 replicated lane (the
+    # wall-clock fallback it replaces) ----
+    fr_d = _ab_frame(rows, cols)
+    kw_d = dict(hidden=[64], epochs=4, mini_batch_size=256, seed=3,
+                activation="RectifierWithDropout",
+                hidden_dropout_ratios=[0.3], input_dropout_ratio=0.1)
+    dpreds = {}
+    for mode, knob in (("fused", None), ("ctl", "ctl"), ("fallback", "0")):
+        if knob is None:
+            os.environ.pop("H2O3_TPU_DL_GRAD_SHARD", None)
+        else:
+            os.environ["H2O3_TPU_DL_GRAD_SHARD"] = knob
+
+        def run_d():
+            m = DeepLearning(**kw_d).train(y="label", training_frame=fr_d)
+            pr = m.predict(fr_d)
+            return pr.vec(pr.names[-1]).to_numpy()
+
+        p, dt, disp = timed(run_d, "dl_dispatches_total")
+        dpreds[mode] = p
+        rec = {"phase": "fallback_ab", "case": "dropout_dl", "mode": mode,
+               "n_devices": n_dev, "rows": rows,
+               "train_s": round(dt, 4), "dispatches": disp}
+        print(json.dumps(rec), flush=True)
+        summary[f"dl_{mode}"] = rec
+    os.environ.pop("H2O3_TPU_DL_GRAD_SHARD", None)
+    dl_ctl_delta = float(np.max(np.abs(dpreds["fused"] - dpreds["ctl"])))
+
+    print(json.dumps({"fallback_ab": {
+        # parity pins
+        "mono_pred_max_delta": round(mono_delta, 9),
+        "glm_coef_max_delta": round(glm_delta, 7),
+        "dl_ctl_pred_max_delta": round(dl_ctl_delta, 7),
+        # dispatch contracts (the raw-speed coverage claim)
+        "mono_dispatch_ratio_fallback_over_fused": round(
+            summary["mono_fallback"]["dispatches"]
+            / max(summary["mono_fused"]["dispatches"], 1), 2),
+        "glm_dispatch_ratio_fallback_over_fused": round(
+            summary["glm_fallback"]["dispatches"]
+            / max(summary["glm_fused"]["dispatches"], 1), 2),
+        # wall ratios (fused must be no worse than the lane it replaces)
+        "mono_time_ratio_fused_over_fallback": round(
+            summary["mono_fused"]["train_s"]
+            / max(summary["mono_fallback"]["train_s"], 1e-9), 3),
+        "glm_time_ratio_fused_over_fallback": round(
+            summary["glm_fused"]["train_s"]
+            / max(summary["glm_fallback"]["train_s"], 1e-9), 3),
+        "dl_time_ratio_fused_over_fallback": round(
+            summary["dl_fused"]["train_s"]
+            / max(summary["dl_fallback"]["train_s"], 1e-9), 3),
+    }}), flush=True)
+
+
 def mesh2d_ab(rows: int = 10_000, cols: int = 28, depth: int = 6,
               trees: int = 4) -> None:
     """1-D vs 2-D mesh A/B (H2O3_TPU_MESH_ROWS, ISSUE 14) on the SAME
@@ -723,6 +882,8 @@ if __name__ == "__main__":
         quant_ab(**kw)
     elif "--oocore-ab" in sys.argv:
         oocore_ab(**kw)
+    elif "--fallback-ab" in sys.argv:
+        fallback_ab(**kw)
     elif "--mesh2d-ab" in sys.argv:
         mesh2d_ab(**kw)
     else:

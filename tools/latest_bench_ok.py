@@ -210,6 +210,73 @@ def _oocore_ab_ok(here: str, now: float):
         return False
 
 
+def _fallback_ab_ok(here: str, now: float):
+    """Sanity-check the newest recent FALLBACK_AB_*.jsonl
+    (bench_kernel_sweep --fallback-ab, the ISSUE-15 fallback-matrix
+    closure A/B). Returns None when no recent artifact exists (no
+    opinion), else True/False. Checks the acceptance pins: mono GBM preds
+    fused-vs-fallback within the block-sum envelope, multinomial GLM coef
+    parity <= 2e-3, dropout-DL trajectory parity <= 1e-4 vs the same-masks
+    ctl control, the multinomial dispatch drop >= 3x, and the fused lanes'
+    wall no worse than the fallback they replace (1.10x proxy-noise
+    allowance)."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "FALLBACK_AB_*.jsonl")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "fallback_ab" in d:
+                    summary = d["fallback_ab"]
+        if not summary:
+            print(f"{name}: NO fallback_ab summary line")
+            return False
+        mono_d = float(summary.get("mono_pred_max_delta", float("nan")))
+        glm_d = float(summary.get("glm_coef_max_delta", float("nan")))
+        dl_d = float(summary.get("dl_ctl_pred_max_delta", float("nan")))
+        if not mono_d <= 1e-4:
+            print(f"{name}: mono pred delta {mono_d} > 1e-4")
+            return False
+        if not glm_d <= 2e-3:
+            print(f"{name}: multinomial coef delta {glm_d} > 2e-3")
+            return False
+        if not dl_d <= 1e-4:
+            print(f"{name}: dropout-DL ctl pred delta {dl_d} > 1e-4")
+            return False
+        gr = float(summary.get("glm_dispatch_ratio_fallback_over_fused")
+                   or 0)
+        if not gr >= 3.0:
+            print(f"{name}: multinomial dispatch ratio {gr} < 3x")
+            return False
+        for k in ("mono_time_ratio_fused_over_fallback",
+                  "glm_time_ratio_fused_over_fallback",
+                  "dl_time_ratio_fused_over_fallback"):
+            r = float(summary.get(k) or 0)
+            if not 0 < r <= 1.10:
+                print(f"{name}: {k}={r} outside (0, 1.10]")
+                return False
+        print(f"{name}: mono-delta={mono_d} glm-delta={glm_d} "
+              f"dl-delta={dl_d} glm-dispatch-ratio={gr} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def _mesh2d_ab_ok(here: str, now: float):
     """Sanity-check the newest recent MESH2D_AB_*.jsonl (bench_kernel_sweep
     --mesh2d-ab, the 1-D vs 2-D pod-mesh A/B, ISSUE 14). Returns None when
@@ -350,6 +417,11 @@ def main() -> int:
     # satisfy the no-regression + per-phase-bytes pins or the window stands
     m2 = _mesh2d_ab_ok(here, now)
     if m2 is False:
+        return 1
+    # fallback-matrix closure gate (ISSUE 15): a recent --fallback-ab
+    # artifact must satisfy the parity + dispatch + no-worse-wall pins
+    fb = _fallback_ab_ok(here, now)
+    if fb is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -92,6 +92,33 @@ timeout 2400 python tools/bench_kernel_sweep.py \
   | tee "KERNEL_SWEEP_${stamp}.jsonl"
 save "KERNEL_SWEEP_${stamp}.jsonl" "Pallas histogram kernel tile sweep"
 
+# fallback-matrix closure A/B (ISSUE 15): monotone GBM, multinomial GLM,
+# dropout DL — each NOW-fused lane vs the forced fallback it replaces, with
+# the parity pins and dispatch/wall ratios. The real-TPU numbers decide how
+# much of the CPU-proxy dispatch win survives on hardware where the kernels
+# run native instead of interpreted.
+timeout 1800 python tools/bench_kernel_sweep.py --fallback-ab --rows 100000 \
+  | tee "FALLBACK_AB_${stamp}.jsonl"
+save "FALLBACK_AB_${stamp}.jsonl" "Fallback-matrix closure A/B (mono GBM / multinomial GLM / dropout DL, fused vs forced fallback)"
+
+# tile-autotuner first-build sweep (ISSUE 15 / ROADMAP 4b): run the bench
+# headline under H2O3_TPU_PALLAS_TILES=auto on a COLD tile store — the
+# first build sweeps once per shape bucket and persists the winners next to
+# the compile cache; the second run must log zero new sweeps
+# (pallas_tile_sweeps_total) and its headline is the self-tuned number to
+# compare against the hand-swept KERNEL_SWEEP best.
+rm -f "$(python - <<'PYEOF'
+from h2o3_tpu.ops.hist_pallas import _tile_cache_path
+print(_tile_cache_path())
+PYEOF
+)" 2>/dev/null || true
+H2O3_TPU_PALLAS_TILES=auto H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_tilesauto.json"
+save "BENCH_builder_${stamp}_tilesauto.json" "TPU bench headline under the tile autotuner, cold store (headline only)"
+H2O3_TPU_PALLAS_TILES=auto H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_tilesauto2.json"
+save "BENCH_builder_${stamp}_tilesauto2.json" "TPU bench headline under the tile autotuner, warm store — must report zero new sweeps (headline only)"
+
 # serving load A/B (ISSUE 7): batched coalescing tier vs per-request control
 # on the real accelerator. The harness spawns one server subprocess per mode
 # and writes its own stamped artifact; stdout is the artifact JSON line.
