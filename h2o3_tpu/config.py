@@ -47,9 +47,11 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "Pallas interpreter — the CI/parity lane); '0' = the "
                 "unfused path (dense histogram + XLA split scan). Monotone "
                 "builds and categorical columns on sharded meshes fuse too "
-                "(ISSUE 15); only uplift trees keep their own unfused scan "
-                "(tree_fused_fallbacks_total tallies — see the "
-                "docs/MIGRATION.md fallback matrix)"),
+                "(ISSUE 15), and uplift trees run their 4-lane scan through "
+                "the whole-tree fused program (ISSUE 16) — "
+                "tree_fused_fallbacks_total only tallies on the legacy "
+                "per-level uplift loop (H2O3_TPU_WHOLE_TREE=0); see the "
+                "docs/MIGRATION.md fallback matrix"),
     "H2O3_TPU_PALLAS_TILES": (
         "", "Pallas histogram/split kernel tile sizes as 'ROW,COL,NODE' "
             "(e.g. '512,8,64' — the built-in defaults). Tiles are a static "
@@ -85,10 +87,13 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "land at every iteration boundary (multinomial included — "
                 "its cycling IRLS now fuses as a lax.scan over classes "
                 "inside one while_loop, and ordinal fits run one on-device "
-                "BFGS program; ISSUE 15). Fallback matrix "
-                "(docs/MIGRATION.md): compute_p_values, L_BFGS and "
-                "out-of-core streamed fits stay on their existing paths "
-                "(glm_fuse_fallbacks_total tallies)"),
+                "BFGS program; ISSUE 15). compute_p_values rides the fused "
+                "lane too (ISSUE 16): the covariance comes from the final "
+                "device Gram at the converged beta, so p-values no longer "
+                "force the per-iteration host trajectory. Fallback matrix "
+                "(docs/MIGRATION.md): L_BFGS and out-of-core streamed fits "
+                "stay on their existing paths (glm_fuse_fallbacks_total "
+                "tallies)"),
     "H2O3_TPU_DL_EPOCH_CHUNK": (
         "auto", "DeepLearning epoch fusion: fold this many epochs into ONE "
                 "compiled program per dispatch with donated (params, "
@@ -203,6 +208,53 @@ _KNOBS: dict[str, tuple[str, str]] = {
              "2.55 trees/sec, BENCH_builder_20260731T010117Z*) — the extra "
              "full-matrix coarsen copies outweigh the smaller histograms at "
              "the subtraction path's already-reduced node counts"),
+    "H2O3_TPU_TREE_GOSS": (
+        "", "gradient-based one-side sampling for tree builds (arXiv:"
+            "1706.08359, ISSUE 16): 'a,b' keeps the top-a fraction of rows "
+            "by |gradient| plus a uniformly-sampled b fraction of the rest, "
+            "with the sampled rows' stat lanes amplified by (1-a)/b so "
+            "split gains stay unbiased — each tree then streams ~(a+b) of "
+            "the rows' stats through the unchanged fused level programs. "
+            "Composes with sample_rate (GOSS applies after the bootstrap "
+            "mask), the streamed out-of-core lane (per-block threshold) "
+            "and the 2-D mesh row axis (global sort). '' = off "
+            "(bit-for-bit today's path); tree_rows_sampled_total counts "
+            "rows kept"),
+    "H2O3_TPU_TREE_EFB": (
+        "0", "exclusive feature bundling (arXiv:1706.08359, ISSUE 16): a "
+             "host-side greedy pass at BinSpec build time packs columns "
+             "that are almost-everywhere at their dominant bin code "
+             "(sparse/one-hot suites) into shared u8 bundle columns, "
+             "shrinking the histogram C dimension before the kernel grid "
+             "sees it; the device histogram is expanded back to real "
+             "columns right after accumulation so split records, varimp, "
+             "MOJO and scoring are unchanged (bundling requires ZERO "
+             "conflicts, so expanded histograms — and split decisions — "
+             "are bit-equal). Dense-histogram lane only (the fused Pallas "
+             "split path and streamed blocks skip bundling); "
+             "tree_cols_bundled_total counts columns eliminated. "
+             "0 = off (today's path bit-for-bit)"),
+    "H2O3_TPU_HIST_I16": (
+        "0", "int16 histogram accumulation lanes (arXiv:1806.11248, ISSUE "
+             "16): per-(node,stat) rescaled gradient/hessian codes "
+             "accumulate through the scatter/matmul histogram impls in a "
+             "+-32767 integer budget and rescale back after the reduce — "
+             "exact on in-range integer stats (weights/counts), ~15-bit "
+             "mantissa otherwise. An overflow latch recomputes the full "
+             "f32 histogram on-device when any cell would exceed the "
+             "budget (tree_hist_i16_overflows_total tallies). Applies to "
+             "the non-Pallas local impls; 0 = off (f32 accumulation, "
+             "today's path bit-for-bit)"),
+    "H2O3_TPU_TREE_U8CACHE": (
+        "1", "u8-code-native frames (ISSUE 16): bin_frame memoizes the "
+             "binned u8 code matrix on the frame keyed by the BinSpec "
+             "fingerprint, so repeated builds over one frame (AutoML, "
+             "grids, CV, checkpoint restarts) re-read the cached codes "
+             "instead of re-binning every f32 column per build — "
+             "tree_hist_hbm_bytes_total{path=rebin} accounts the traffic "
+             "actually spent binning and stays flat on cache hits. 0 = "
+             "re-bin every call (today's path; a hit returns the identical "
+             "buffer, so this knob is bit-for-bit by construction)"),
     "H2O3_TPU_FUSED_MAX_DEPTH": (
         "20", "deepest tree the whole-tree fused program is built for; "
               "beyond it the per-level dispatch loop takes over"),

@@ -88,10 +88,12 @@ _COLL_BYTES = _mx.counter(
 
 # Fallback observability (ISSUE 15): fits that WANT the fused while_loop
 # lane (the knob says fuse) but drop to a slow lane for a structural
-# reason — p_values pins the host-f64 trajectory, out-of-core streaming
-# needs per-block host accumulation, a singular-in-f32 chunk drops its
-# lambda to the host f64 tail, and a rejected fused-ordinal optimum falls
-# back to the scipy driver.
+# reason — out-of-core streaming needs per-block host accumulation, a
+# singular-in-f32 chunk drops its lambda to the host f64 tail, and a
+# rejected fused-ordinal optimum falls back to the scipy driver.
+# (compute_p_values rode the host-f64 trajectory until ISSUE 16; it now
+# fuses — the covariance comes from the final device Gram at the
+# converged beta, so the p_values reason only fires on a regression.)
 _GLM_FALLBACKS = _mx.counter(
     "glm_fuse_fallbacks_total",
     "GLM fits (or lambda steps) that fell back from the fused while_loop "
@@ -108,17 +110,16 @@ def _glm_fuse_chunk(params) -> int:
     """Iterations per fused dispatch (K); 0 = the unfused per-iteration
     path. ``auto`` fuses with K=8 everywhere (the chunk program is plain
     XLA — while_loop + Cholesky — so the CPU proxy runs it too); an integer
-    forces that K. compute_p_values keeps today's host-f64 trajectory
-    (fallback matrix, docs/MIGRATION.md). With export_checkpoints_dir set
+    forces that K. compute_p_values fits fuse too (ISSUE 16): the
+    covariance derives from the final device Gram at the converged beta
+    (:meth:`GLM._p_values` re-runs one ``_irls_pass``), so nothing about
+    the trajectory lane constrains it. With export_checkpoints_dir set
     the chunk clamps to 1 so PR-2's per-iteration irls_state snapshots land
     at the same loop positions."""
     from h2o3_tpu import config
 
     raw = config.get("H2O3_TPU_GLM_FUSE").strip().lower()
     if raw == "0":
-        return 0
-    if getattr(params, "compute_p_values", False):
-        _GLM_FALLBACKS.inc(reason="p_values")
         return 0
     k = int(raw) if raw.isdigit() else 8
     if getattr(params, "export_checkpoints_dir", None):
@@ -1185,11 +1186,15 @@ class GLM(ModelBuilder):
         }
 
     def _p_values(self, X, y, w, offset, beta, family, fam_args, di, p, nobs) -> dict:
-        G, b, dev = _irls_pass(
-            X, y, w, offset, jnp.asarray(beta, jnp.float32), family, fam_args
-        )
-        G = np.asarray(G, np.float64)
-        P = G.shape[0]
+        P = int(np.shape(beta)[0])
+        b32 = jnp.asarray(beta, jnp.float32)
+        if X.shape[1] > P:
+            # fused lane: the design was padded to the shape-bucket width up
+            # front; the padded columns are all-zero so slicing the Gram back
+            # to the real width reproduces the unpadded pass exactly
+            b32 = jnp.pad(b32, (0, X.shape[1] - P))
+        G, b, dev = _irls_pass(X, y, w, offset, b32, family, fam_args)
+        G = np.asarray(G, np.float64)[:P, :P]
         fam = get_family(family, *fam_args)
         try:
             inv = np.linalg.inv(G)

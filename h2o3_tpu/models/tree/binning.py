@@ -208,12 +208,53 @@ def fit_bins_for(params, frame: Frame, cols: list[str]) -> BinSpec:
 _BINFRAME_PROG: dict = {}
 
 
+def _u8_cache_enabled() -> bool:
+    from h2o3_tpu import config
+
+    return config.get_bool("H2O3_TPU_TREE_U8CACHE")
+
+
+def _spec_fingerprint(spec: BinSpec) -> tuple:
+    """Content fingerprint of a BinSpec — the u8 bin-code cache key.
+
+    Two specs with equal fingerprints bin a given frame to the identical
+    code matrix, so a cache hit returns the same buffer a fresh bin_frame
+    call would produce (the knob's bit-for-bit guarantee)."""
+    doms = tuple(
+        tuple(d) if d is not None else None
+        for d in (spec.domains or [None] * spec.ncols)
+    )
+    return (
+        tuple(spec.names), spec.is_cat.tobytes(), spec.nbins.tobytes(),
+        spec.edges.tobytes(), doms, jax.default_backend(),
+    )
+
+
 def bin_frame(spec: BinSpec, frame: Frame):
     """Prebin all feature columns to a row-sharded (npad, C) uint8 matrix.
 
     All columns bin in ONE fused device program (per-column dispatch costs
-    dominate on a tunneled TPU)."""
+    dominate on a tunneled TPU).
+
+    u8-code-native frames (ISSUE 16, ``H2O3_TPU_TREE_U8CACHE``): the code
+    matrix is memoized on the frame keyed by the spec's content
+    fingerprint, so repeated builds over one frame (AutoML, grids, CV,
+    checkpoint restarts) stop re-reading every f32 column per build — the
+    dominant frame HBM traffic of a multi-model session. The traffic an
+    ACTUAL binning pass moves (one f32 read + one u8 write per cell) is
+    tallied under ``tree_hist_hbm_bytes_total{path=rebin}``; cache hits
+    move nothing and tally nothing, which is what the wave-2 A/B measures.
+    """
     from h2o3_tpu.models.datainfo import _adapt_codes
+
+    cache = None
+    fp = None
+    if _u8_cache_enabled():
+        fp = _spec_fingerprint(spec)
+        cache = frame.__dict__.setdefault("_bin_cache", {})
+        hit = cache.get(fp)
+        if hit is not None:
+            return hit
 
     datas = []
     for ci, name in enumerate(spec.names):
@@ -247,4 +288,221 @@ def bin_frame(spec: BinSpec, frame: Frame):
         _BINFRAME_PROG[key] = prog
 
     B = prog(tuple(datas), jnp.asarray(spec.edges))
-    return jax.device_put(B, row_sharding())
+    B = jax.device_put(B, row_sharding())
+    # rebin traffic model: one f32 read + one u8 write per (row, col) cell
+    # (lazy import: shared_tree imports this module)
+    from h2o3_tpu.models.tree.shared_tree import _HIST_HBM_BYTES
+
+    _HIST_HBM_BYTES.inc(5.0 * B.shape[0] * B.shape[1], path="rebin")
+    if cache is not None:
+        cache[fp] = B
+    return B
+
+
+# ---------------------------------------------------------------------------
+# Exclusive feature bundling (ISSUE 16, H2O3_TPU_TREE_EFB — arXiv:1706.08359
+# §4). Sparse/one-hot suites carry many columns that sit at one dominant bin
+# code almost everywhere; two such columns whose non-default rows never
+# overlap can share ONE u8 column (their non-default codes mapped to
+# disjoint sub-ranges), shrinking the histogram C dimension before the
+# kernel grid sees it. The pass is host-side and greedy at BinSpec build
+# time, requires ZERO conflicts (no row non-default in two bundled columns
+# at once — the lossless regime, unlike LightGBM's bounded-conflict mode),
+# and the device histogram is expanded back to real columns right after
+# accumulation (expand_hist), so split records, varimp, MOJO and scoring
+# never see bundle ids. The default-bin cell is reconstructed as
+# node_total − Σ(non-default cells): exact whenever the stat lanes are
+# dyadic/in-range (the parity suites), within f32 associativity otherwise.
+
+
+@dataclass
+class EFBPlan:
+    """Host-side exclusive-feature-bundling plan for one BinSpec."""
+
+    n_cols: int          # real feature count C
+    n_bins: int          # total code space per column (spec.max_bins)
+    bundles: list        # list[list[int]] — real col ids per bundled column
+    src_col: np.ndarray  # (C,) int32: bundled column carrying real col f
+    offset: np.ndarray   # (C,) int32: code offset of col f inside its bundle
+    default: np.ndarray  # (C,) int32: dominant code d_f; -1 = pass-through
+    nbins: np.ndarray    # (C,) int32: non-default code count per column
+
+    @property
+    def n_cols_b(self) -> int:
+        return len(self.bundles)
+
+    @property
+    def key(self) -> tuple:
+        """Hashable content fingerprint for program caches."""
+        return (self.n_cols, self.n_bins, self.src_col.tobytes(),
+                self.offset.tobytes(), self.default.tobytes(),
+                self.nbins.tobytes())
+
+
+def fit_efb(spec: BinSpec, bins_u8, nrow: int | None = None):
+    """Greedy zero-conflict bundling over the frame's host bin codes.
+
+    Returns an :class:`EFBPlan` when bundling shrinks the column count,
+    else ``None``. O(C · bundles · rows) host work on the pulled u8 matrix
+    — a one-time cost per BinSpec, dwarfed by the per-tree device work it
+    removes."""
+    B_host = np.asarray(bins_u8)
+    if nrow is not None:
+        B_host = B_host[:nrow]
+    n, C = B_host.shape
+    if C != spec.ncols or n == 0:
+        return None
+    total_codes = spec.max_bins
+
+    # dominant code + non-default mask per column (cols at >50% non-default
+    # rows can hardly co-bundle and skip straight to pass-through)
+    dominant = np.zeros(C, np.int32)
+    nz_masks: list = [None] * C
+    order: list[int] = []
+    for f in range(C):
+        codes, counts = np.unique(B_host[:, f], return_counts=True)
+        d = int(codes[np.argmax(counts)])
+        nnz = n - int(counts.max())
+        if nnz > n // 2 or int(spec.nbins[f]) + 1 > total_codes:
+            continue
+        dominant[f] = d
+        nz_masks[f] = B_host[:, f] != d
+        order.append(f)
+    order.sort(key=lambda f: int(nz_masks[f].sum()))
+
+    src_col = np.zeros(C, np.int32)
+    offset = np.zeros(C, np.int32)
+    default = np.full(C, -1, np.int32)
+    nbins_nd = np.asarray(spec.nbins, np.int32).copy()  # non-default codes
+
+    bundles: list[list[int]] = []
+    occ: list[np.ndarray] = []   # per-bundle occupied-rows mask
+    used: list[int] = []         # per-bundle consumed code count
+    multi: set[int] = set()      # bundles holding >1 column
+    for f in order:
+        need = int(nbins_nd[f])
+        placed = False
+        for bi in range(len(bundles)):
+            if used[bi] + need > total_codes - 1:
+                continue
+            if np.any(occ[bi] & nz_masks[f]):
+                continue
+            src_col[f] = bi
+            offset[f] = used[bi]
+            default[f] = dominant[f]
+            bundles[bi].append(f)
+            occ[bi] |= nz_masks[f]
+            used[bi] += need
+            multi.add(bi)
+            placed = True
+            break
+        if not placed:
+            src_col[f] = len(bundles)
+            offset[f] = 0
+            default[f] = dominant[f]
+            bundles.append([f])
+            occ.append(nz_masks[f].copy())
+            used.append(need)
+    # cols skipped above (dense / wide) pass through unchanged
+    for f in range(C):
+        if nz_masks[f] is None:
+            src_col[f] = len(bundles)
+            bundles.append([f])
+            occ.append(np.zeros(0, bool))
+            used.append(0)
+    # a column alone in its bundle needs no re-coding: pass it through so
+    # its histogram column is bit-identical (no rank mapping at all)
+    for bi, group in enumerate(bundles):
+        if bi not in multi and len(group) == 1:
+            default[group[0]] = -1
+            offset[group[0]] = 0
+
+    if len(bundles) >= C:
+        return None
+    return EFBPlan(C, total_codes, bundles, src_col, offset, default,
+                   nbins_nd)
+
+
+_BUNDLE_PROG: dict = {}
+
+
+def bundle_bins(plan: EFBPlan, bins_u8):
+    """Build the (npad, Cb) bundled u8 code matrix on device.
+
+    Bundle code 0 = every member at its default; member f's code c != d_f
+    maps to ``offset_f + rank_f(c)`` where rank skips d_f (rank 1..nbins_f)
+    — a bijection, since zero conflicts mean at most one member is
+    non-default per row. Pass-through columns copy verbatim."""
+    key = (plan.key, jax.default_backend())
+    prog = _BUNDLE_PROG.get(key)
+    if prog is None:
+        groups = [list(g) for g in plan.bundles]
+        offs = plan.offset.copy()
+        defs = plan.default.copy()
+
+        def run(B):
+            cols = []
+            for group in groups:
+                if len(group) == 1 and defs[group[0]] < 0:
+                    cols.append(B[:, group[0]])
+                    continue
+                acc = jnp.zeros(B.shape[0], jnp.int32)
+                for f in group:
+                    c = B[:, f].astype(jnp.int32)
+                    d = int(defs[f])
+                    rank = jnp.where(c < d, c + 1, c)
+                    acc = acc + jnp.where(c == d, 0, int(offs[f]) + rank)
+                cols.append(acc.astype(jnp.uint8))
+            return jnp.stack(cols, axis=1)
+
+        prog = jax.jit(run)
+        _BUNDLE_PROG[key] = prog
+    return jax.device_put(prog(bins_u8), row_sharding())
+
+
+def expand_arrays(plan: EFBPlan, n_cols_pad: int, n_bins_h: int):
+    """Precompute the (Cp, Bh) gather tables expand_hist consumes.
+
+    ``kind``: 0 = structurally-zero cell, 1 = gather from src_bin of the
+    carrying bundled column, 2 = the default cell (node_total − Σ
+    non-default). Padded columns (f >= C) reproduce the all-codes-NA
+    padding histogram: all node mass in bin 0."""
+    Cp, Bh = n_cols_pad, n_bins_h
+    src_col = np.zeros(Cp, np.int32)
+    src_bin = np.zeros((Cp, Bh), np.int32)
+    kind = np.zeros((Cp, Bh), np.int8)
+    for f in range(plan.n_cols):
+        src_col[f] = plan.src_col[f]
+        ncodes = int(plan.nbins[f]) + 1  # real codes 0..nbins_f
+        d = int(plan.default[f])
+        for b in range(min(ncodes, Bh)):
+            if d < 0:  # pass-through: identity gather
+                src_bin[f, b] = b
+                kind[f, b] = 1
+            elif b == d:
+                kind[f, b] = 2
+            else:
+                rank = b + 1 if b < d else b
+                src_bin[f, b] = int(plan.offset[f]) + rank
+                kind[f, b] = 1
+    for f in range(plan.n_cols, Cp):
+        kind[f, 0] = 2  # padded col: everything at the NA code
+    return src_col, src_bin, kind
+
+
+def expand_hist(arrs, hist_b):
+    """Expand a bundled histogram (N, Cb', Bh, S) to real columns
+    (N, Cp, Bh, S) — pure traced function, usable inside the tree
+    programs. ``node_total`` per (node, stat) comes from summing any one
+    bundled column's bins (every row lands in exactly one code of every
+    column)."""
+    src_col, src_bin, kind = (jnp.asarray(a) for a in arrs)
+    g = jnp.take(hist_b, src_col, axis=1)              # (N, Cp, Bh, S)
+    idx = jnp.broadcast_to(src_bin[None, :, :, None], g.shape)
+    G = jnp.take_along_axis(g, idx, axis=2)
+    node_tot = hist_b[:, 0, :, :].sum(axis=1)          # (N, S)
+    gather = (kind == 1)[None, :, :, None]
+    dflt = node_tot[:, None, :] - jnp.where(gather, G, 0.0).sum(axis=2)
+    return jnp.where(
+        gather, G,
+        jnp.where((kind == 2)[None, :, :, None], dflt[:, :, None, :], 0.0))

@@ -61,6 +61,13 @@ class SharedTreeParams(CommonParams):
     sample_rate: float = 1.0
     col_sample_rate_per_tree: float = 1.0
     score_tree_interval: int = 5
+    # ISSUE 16 leaf-wise growth: "depthwise" (default, upstream's level
+    # order) or "lossguide" (xgboost-surface loss-guide — each level's
+    # splits are rationed by gain rank against a max_leaves budget; runs on
+    # the fused whole-tree lane). max_leaves bounds the leaf count and is
+    # only consulted under lossguide.
+    grow_policy: str = "depthwise"
+    max_leaves: int = 0
     # probability calibration (upstream calibrate_model/calibration_frame on
     # tree models): fits Platt scaling or isotonic regression on a holdout
     # frame's predictions; predict() then appends cal_p0/cal_p1 columns
@@ -578,6 +585,33 @@ class GBM(ModelBuilder):
             if not mono_vec.any():
                 mono_vec = None
 
+        # leaf-wise growth (ISSUE 16): lossguide rations each level's splits
+        # by gain rank against the remaining max_leaves budget; the budget
+        # rides the fused whole-tree program's level carry, so the policy is
+        # fused-lane-only (the per-level host loop never sees it)
+        if p.grow_policy not in ("depthwise", "lossguide"):
+            raise ValueError(
+                f"grow_policy must be 'depthwise' or 'lossguide', got {p.grow_policy!r}"
+            )
+        max_leaves = 0
+        if p.grow_policy == "lossguide":
+            from h2o3_tpu.models.tree.shared_tree import (
+                _split_fuse_on as _sf_on,
+                use_fused_trees as _fused_ok,
+            )
+
+            if p.max_leaves < 2:
+                raise ValueError("grow_policy=lossguide requires max_leaves >= 2")
+            if not _fused_ok(p.max_depth) or (
+                mono_vec is not None and not _sf_on()
+            ):
+                raise ValueError(
+                    "grow_policy=lossguide runs on the fused whole-tree lane "
+                    "(H2O3_TPU_WHOLE_TREE=1 within H2O3_TPU_FUSED_MAX_DEPTH; "
+                    "monotone lossguide additionally needs H2O3_TPU_SPLIT_FUSE)"
+                )
+            max_leaves = int(p.max_leaves)
+
         # out-of-core streaming (ISSUE 11, frame/chunkstore.py): when the
         # frame's per-row training lanes exceed the configured HBM window,
         # train as a block-accumulate outer loop around the existing
@@ -588,6 +622,12 @@ class GBM(ModelBuilder):
         if dist != "multinomial":
             stream = self._plan_streamed(train)
             if stream is not None:
+                if max_leaves:
+                    raise ValueError(
+                        "grow_policy=lossguide is resident-only: raise the "
+                        "HBM window (H2O3_TPU_HBM_WINDOW_MB) or drop the "
+                        "frame below the streaming threshold"
+                    )
                 return self._build_streamed(
                     job, train, valid, p, spec, dist, aux, yv, prior, stream,
                     classification, mono_vec=mono_vec,
@@ -595,6 +635,44 @@ class GBM(ModelBuilder):
         bins = bin_frame(spec, train)
         n_bins = spec.max_bins
         npad = train.npad
+
+        # EFB (ISSUE 16, H2O3_TPU_TREE_EFB): host-side greedy bundling of
+        # mutually-exclusive sparse/one-hot columns into shared u8 code
+        # columns — the histogram grid accumulates over the bundled Cb < C
+        # axis and expands back to real columns right after (split records,
+        # varimp, MOJO and scoring never see bundle space). Fused
+        # whole-tree lanes only; bin-adapt coarsening would scramble bundle
+        # codes, so nonzero shifts (or a streamed build, which returns
+        # above) skip bundling entirely.
+        efb = bins_b = None
+        from h2o3_tpu import config as _config
+
+        if _config.get_bool("H2O3_TPU_TREE_EFB"):
+            from h2o3_tpu.models.tree.binning import (
+                bucket_nbins as _bnb,
+                bundle_bins,
+                fit_efb,
+            )
+            from h2o3_tpu.models.tree.shared_tree import (
+                _bin_shifts,
+                _split_fuse_on as _sf_on2,
+                use_fused_trees as _fused_ok2,
+            )
+
+            _cats = tuple(
+                int(i) for i in np.nonzero(np.asarray(spec.is_cat, bool))[0]
+            )
+            if (
+                _fused_ok2(p.max_depth)
+                and (mono_vec is None or _sf_on2())
+                and all(
+                    s == 0
+                    for s in _bin_shifts(p.max_depth, _bnb(n_bins), _cats)
+                )
+            ):
+                efb = fit_efb(spec, bins, nrow=train.nrow)
+                if efb is not None:
+                    bins_b = bundle_bins(efb, bins)
 
         # response / weights on device
         y_np = yv.to_numpy().astype(np.float64)
@@ -778,6 +856,9 @@ class GBM(ModelBuilder):
                         reg_lambda=getattr(p, "reg_lambda", 0.0),
                         reg_alpha=getattr(p, "reg_alpha", 0.0),
                         monotone=mono_vec,
+                        max_leaves=max_leaves,
+                        efb=efb,
+                        bins_b=bins_b,
                     )
                 lr *= p.learn_rate_annealing ** chunk
                 with _mx.span("gbm.pull_records", trees=chunk):
@@ -856,6 +937,9 @@ class GBM(ModelBuilder):
                         max_abs_leaf=p.max_abs_leafnode_pred,
                         reg_lambda=getattr(p, "reg_lambda", 0.0),
                         reg_alpha=getattr(p, "reg_alpha", 0.0),
+                        max_leaves=max_leaves,
+                        efb=efb,
+                        bins_b=bins_b,
                     )
                     group.append(tree)
                     newF.append(fk)
@@ -882,6 +966,9 @@ class GBM(ModelBuilder):
                     monotone=mono_vec,
                     reg_lambda=getattr(p, "reg_lambda", 0.0),
                     reg_alpha=getattr(p, "reg_alpha", 0.0),
+                    max_leaves=max_leaves,
+                    efb=efb,
+                    bins_b=bins_b,
                 )
                 group.append(tree)
             _tree_span.__exit__(None, None, None)

@@ -120,14 +120,30 @@ _HIST_HBM_BYTES = _metrics.counter(
 
 # Fallback observability (ISSUE 15): builds that WANT the fused Pallas lane
 # (the knob/backend gate says fuse) but drop to a slow lane for a
-# structural reason. After the ISSUE-15 closure the only structural tree
-# reason left is uplift (its 4-lane scan was never fused); the mono /
-# cat_sharded reasons stay wired so a future regression of the closure is
-# a counter bump, not an archaeology dig through MIGRATION.md.
+# structural reason. ISSUE 16 closed the last structural reason (uplift's
+# 4-lane scan now runs through the whole-tree fused program); the uplift /
+# mono / cat_sharded reasons stay wired so a future regression of the
+# closure is a counter bump, not an archaeology dig through MIGRATION.md —
+# uplift only tallies on the legacy per-level loop (H2O3_TPU_WHOLE_TREE=0).
 _FUSED_FALLBACKS = _metrics.counter(
     "tree_fused_fallbacks_total",
     "tree builds that fell back from the fused Pallas histogram→split lane "
     "while the fuse gate was ON, by structural reason", always=True)
+
+# Wave-2 arithmetic-reduction observability (ISSUE 16). Rows-sampled is the
+# MODELED kept-row volume of GOSS builds ((a+b) · padded rows · trees —
+# the expected fraction, same modeled-volume convention as the HBM bytes);
+# cols-bundled counts real feature columns EFB eliminated from the
+# histogram grid, per build.
+_ROWS_SAMPLED = _metrics.counter(
+    "tree_rows_sampled_total",
+    "modeled rows kept by GOSS one-side sampling across tree builds "
+    "(expected (a+b) fraction of the padded row count, per tree)",
+    always=True)
+_COLS_BUNDLED = _metrics.counter(
+    "tree_cols_bundled_total",
+    "feature columns removed from the histogram C dimension by exclusive "
+    "feature bundling, per build", always=True)
 
 # program-key registry + per-program collective tallies: _run_counted
 # captures a program's ((phase, lane, group) -> bytes) tally during its
@@ -515,10 +531,12 @@ def _split_fuse_active(cat_cols: tuple, split_shard: bool,
     ops/split_pallas._split_kernel_mono) and categorical columns on a
     column-sharded mesh fuse too (every block runs the mean-sort branch on
     a BLOCK-LOCAL dense gather, selecting per column — the dense sharded
-    scan's own scheme, now fed from the blocked tiles). Only uplift trees
-    (their 4-lane scan was never ported) and the knob/backend gate itself
-    fall back; a structural fallback while the gate is ON tallies
-    ``tree_fused_fallbacks_total{reason}``."""
+    scan's own scheme, now fed from the blocked tiles). ISSUE 16 closed
+    uplift too: its 4-lane scan runs through the whole-tree fused uplift
+    program (models/uplift._uplift_tree_program), so ``uplift=True`` here
+    is only reached from the LEGACY per-level uplift loop
+    (H2O3_TPU_WHOLE_TREE=0 / depth cap); a structural fallback while the
+    gate is ON tallies ``tree_fused_fallbacks_total{reason}``."""
     if not _split_fuse_on():
         return False
     if uplift:
@@ -538,10 +556,12 @@ def _kernel_key() -> tuple:
 
     # the RAW spec rides along because 'auto' (the tile autotuner) resolves
     # shape-dependent tiles inside the trace — _tiles() alone could not
-    # distinguish 'auto' from the '' defaults
+    # distinguish 'auto' from the '' defaults; HIST_I16 changes the traced
+    # local accumulation (ops/histogram._maybe_i16)
     return (_split_fuse_on(), _tiles(),
             config.get("H2O3_TPU_PALLAS_TILES").strip(),
-            config.get("H2O3_TPU_HIST"))
+            config.get("H2O3_TPU_HIST"),
+            config.get_bool("H2O3_TPU_HIST_I16"))
 
 
 def _split_scan_sharded_fused(
@@ -937,6 +957,7 @@ def _level_core(
     *, n_pad: int, n_pad_next: int, cat_cols: tuple = (),
     n_cols_real: int | None = None, split_shard: bool = False,
     fuse_layout=None, mono=None, node_lo=None, node_hi=None,
+    leaf_budget=None,
 ):
     """Split scan → decisions → partition for one level, given its histogram.
 
@@ -955,6 +976,14 @@ def _level_core(
     dense, sharded or replicated — ISSUE 15 closed the fused gap); the
     return then appends ``(new_lo, new_hi)`` sized ``n_pad_next`` for the
     caller's bound carry.
+
+    ``leaf_budget`` (traced int32 scalar, ISSUE 16 ``grow_policy=lossguide``)
+    rations this level's splits by gain rank: only the ``leaf_budget``
+    highest-gain candidates split (each split adds one net leaf), and the
+    return appends the decremented budget for the caller's carry. The
+    ranking argsort is stable, so ties break toward the lower node slot —
+    deterministic across backends. A budget ≥ the candidate count leaves
+    the level's decisions bit-identical to depth-wise growth.
 
     Returns ``(nid, preds, varimp, n_split, record, pair_info)``.
     ``pair_info`` carries, per next-level child PAIR slot (``n_pad_next//2``
@@ -1015,6 +1044,16 @@ def _level_core(
     # frontier cap: children must fit n_pad_next; later nodes go leaf
     fits = 2 * jnp.cumsum(ok.astype(jnp.int32)) <= n_pad_next
     ok = ok & fits
+    new_budget = None
+    if leaf_budget is not None:
+        # loss-guide ration: keep only the budget's worth of highest-gain
+        # candidates (stable argsort — ties go to the lower node slot)
+        order = jnp.argsort(jnp.where(ok, -sp["gain"], jnp.inf))
+        rank = jnp.zeros(n_pad, jnp.int32).at[order].set(
+            jnp.arange(n_pad, dtype=jnp.int32)
+        )
+        ok = ok & (rank < leaf_budget)
+        new_budget = (leaf_budget - ok.sum()).astype(jnp.int32)
     gain = jnp.where(ok, jnp.maximum(sp["gain"], 0.0), 0.0)
 
     rl, ra = (None, None) if leaf_reg is None else leaf_reg
@@ -1038,13 +1077,16 @@ def _level_core(
         "Lst": scat(jnp.zeros((half, 3), sp["Lst"].dtype), sp["Lst"]),
         "Rst": scat(jnp.zeros((half, 3), sp["Rst"].dtype), sp["Rst"]),
     }
+    extra = ()
     if mono is not None:
         new_lo, new_hi = _child_bounds(
             ok, record["child_base"], sp["mono_col"], sp["mid"],
             node_lo, node_hi, n_pad_next,
         )
-        return nid, preds, varimp, n_split, record, pair_info, new_lo, new_hi
-    return nid, preds, varimp, n_split, record, pair_info
+        extra = (new_lo, new_hi)
+    if leaf_budget is not None:
+        extra = extra + (new_budget,)
+    return (nid, preds, varimp, n_split, record, pair_info) + extra
 
 
 def _force_leaf_from_stats(
@@ -1195,6 +1237,7 @@ def _fused_levels(
     *, max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     subtract: bool = True, n_cols_real: int | None = None,
     split_shard: bool = False, split_fuse: bool = False, mono=None,
+    max_leaves: int = 0, efb=None, bins_b=None,
 ):
     """All levels of one tree, traced into a single program, with the two
     histogram work reductions the reference's hot loop embodies
@@ -1228,8 +1271,34 @@ def _fused_levels(
     state rides the level-to-level carry (including the saturated
     while_loop's), each level's scan masks infeasible candidates inside
     the kernel, and both force-leaf paths clamp their leaf values.
+
+    ``max_leaves`` > 0 (ISSUE 16 ``grow_policy=lossguide``) threads an
+    int32 remaining-leaf budget through the same carry: each level rations
+    its splits by gain rank (:func:`_level_core`) and decrements the
+    budget, so the finished tree has at most ``max_leaves`` leaves.
+
+    ``efb``/``bins_b`` (ISSUE 16 exclusive feature bundling) accumulate
+    every level's histogram from the BUNDLED code matrix ``bins_b``
+    ((npad, Cb), Cb < C) and expand it back to real columns immediately
+    after accumulation (:func:`~h2o3_tpu.models.tree.binning.expand_hist`),
+    so subtraction, coarsening, the split scans and the partition walk are
+    untouched — the O(rows · C) accumulation is the only thing that
+    shrinks. EFB rides the replicated dense lane only (callers force
+    ``split_shard=split_fuse=False``) and requires the bin-adapt shifts to
+    be zero (bundle codes don't survive coarsening).
     """
     from h2o3_tpu.ops.histogram import histogram_in_jit
+
+    efb_expand = None
+    if efb is not None:
+        from h2o3_tpu.models.tree.binning import expand_arrays, expand_hist
+
+        assert not split_shard and not split_fuse, "EFB is dense-lane only"
+        assert all(
+            s == 0 for s in _bin_shifts(max_depth, n_bins, cat_cols)
+        ), "EFB requires zero bin-adapt shifts"
+        _efb_arrs = expand_arrays(efb, bins_u8.shape[1], n_bins)
+        efb_expand = lambda h: expand_hist(_efb_arrs, h)
 
     # pair bookkeeping (children 2i/2i+1 share pair slot i) needs an even
     # frontier; round an odd node_cap down rather than trace-crash on the
@@ -1240,6 +1309,8 @@ def _fused_levels(
     # (level d-1's n_pad_next), starting from the unbounded root
     node_lo = jnp.full(1, -jnp.inf, jnp.float32) if mono is not None else None
     node_hi = jnp.full(1, jnp.inf, jnp.float32) if mono is not None else None
+    # lossguide: remaining net-leaf budget (root is 1 leaf; a split adds 1)
+    leaf_budget = jnp.int32(max_leaves - 1) if max_leaves else None
     recs = []
     parent_hist = None
     parent_lay = None  # static HistLayout of the blocked parent (fused path)
@@ -1261,9 +1332,11 @@ def _fused_levels(
         n_pad = min(1 << depth, node_cap)
         if depth == 0 or not subtract:
             h = histogram_in_jit(
-                bins_d, nid, (w, wy, wh), n_pad, nb_d,
-                col_sharded=split_shard, fused=split_fuse,
+                bins_b if efb_expand else bins_d, nid, (w, wy, wh), n_pad,
+                nb_d, col_sharded=split_shard, fused=split_fuse,
             )
+            if efb_expand:
+                return efb_expand(h), None
             return h if split_fuse else (h, None)
         half = n_pad // 2
         row_pair = jnp.maximum(nid, 0) >> 1  # pair = nid//2 (child_base even)
@@ -1304,9 +1377,11 @@ def _fused_levels(
                 )
             return stacked.reshape(flay.shape), flay
         built = histogram_in_jit(
-            bins_d, nid_build, (w, wy, wh), half, nb_d,
-            col_sharded=split_shard,
-        )  # (half, C, Bc, 3)
+            bins_b if efb_expand else bins_d, nid_build, (w, wy, wh), half,
+            nb_d, col_sharded=split_shard,
+        )  # (half, C, Bc, 3) — EFB accumulates bundled, expands to real C
+        if efb_expand:
+            built = efb_expand(built)
         # parent histogram was built at the previous level's (finer)
         # binning — sum its data-bin groups down to this level's
         psel = jnp.where(
@@ -1360,12 +1435,14 @@ def _fused_levels(
                 return (carry[0] < n_sat) & (carry[4] > 0)
 
             def sat_body(carry):
+                i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c = carry[:8]
+                lo_c = hi_c = bgt_c = None
+                k = 8
                 if mono is not None:
-                    (i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c,
-                     lo_c, hi_c) = carry
-                else:
-                    i, nid_c, preds_c, vi_c, _, phist, pinfo, bufs_c = carry
-                    lo_c = hi_c = None
+                    lo_c, hi_c = carry[8], carry[9]
+                    k = 10
+                if max_leaves:
+                    bgt_c = carry[k]
                 d = sat_start + i
                 lkey = jax.random.fold_in(tkey, d)
                 hist, hlay = level_hist(
@@ -1379,18 +1456,21 @@ def _fused_levels(
                     n_pad=node_cap, n_pad_next=node_cap, cat_cols=cat_cols,
                     n_cols_real=n_cols_real, split_shard=split_shard,
                     fuse_layout=hlay, mono=mono, node_lo=lo_c, node_hi=hi_c,
+                    leaf_budget=bgt_c,
                 )
+                nid_c, preds_c, vi_c, nsp, rec, pinfo = out[:6]
                 if mono is not None:
-                    nid_c, preds_c, vi_c, nsp, rec, pinfo, lo_c, hi_c = out
-                else:
-                    nid_c, preds_c, vi_c, nsp, rec, pinfo = out
+                    lo_c, hi_c = out[6], out[7]
+                if max_leaves:
+                    bgt_c = out[-1]
                 if sd:
                     rec = dict(rec, split_bin=rec["split_bin"] << sd)
                 bufs_c = {k: bufs_c[k].at[i].set(rec[k]) for k in bufs_c}
                 # direct mode threads a fixed dummy parent carry instead
                 base = (i + 1, nid_c, preds_c, vi_c, nsp,
                         hist if subtract else phist, pinfo, bufs_c)
-                return base + ((lo_c, hi_c) if mono is not None else ())
+                base = base + ((lo_c, hi_c) if mono is not None else ())
+                return base + ((bgt_c,) if max_leaves else ())
 
             if not subtract:
                 # the direct scheme needs no parent-histogram/pair carry;
@@ -1408,14 +1488,16 @@ def _fused_levels(
                       pair_info, bufs)
             if mono is not None:
                 carry0 = carry0 + (node_lo, node_hi)
+            if max_leaves:
+                carry0 = carry0 + (leaf_budget,)
             with tally_group("sat"):
                 out = jax.lax.while_loop(sat_cond, sat_body, carry0)
+            (sat_iters, nid, preds, varimp, n_split, parent_hist,
+             pair_info, bufs) = out[:8]
             if mono is not None:
-                (sat_iters, nid, preds, varimp, n_split, parent_hist,
-                 pair_info, bufs, node_lo, node_hi) = out
-            else:
-                (sat_iters, nid, preds, varimp, n_split, parent_hist,
-                 pair_info, bufs) = out
+                node_lo, node_hi = out[8], out[9]
+            if max_leaves:
+                leaf_budget = out[-1]
             prev_shift = sd
             for j in range(n_sat):
                 recs.append({k: bufs[k][j] for k in bufs})
@@ -1466,12 +1548,13 @@ def _fused_levels(
                 cat_cols=cat_cols, n_cols_real=n_cols_real,
                 split_shard=split_shard, fuse_layout=hlay,
                 mono=mono, node_lo=node_lo, node_hi=node_hi,
+                leaf_budget=leaf_budget,
             )
+            nid, preds, varimp, n_split, rec, pair_info = out[:6]
             if mono is not None:
-                (nid, preds, varimp, n_split, rec, pair_info,
-                 node_lo, node_hi) = out
-            else:
-                nid, preds, varimp, n_split, rec, pair_info = out
+                node_lo, node_hi = out[6], out[7]
+            if max_leaves:
+                leaf_budget = out[-1]
             parent_hist = hist
             parent_lay = hlay
             prev_shift = sd
@@ -1508,6 +1591,61 @@ def use_fused_trees(max_depth: int) -> bool:
         config.get_bool("H2O3_TPU_WHOLE_TREE")
         and max_depth <= config.get_int("H2O3_TPU_FUSED_MAX_DEPTH")
     )
+
+
+# ---------------------------------------------------------------------------
+# GOSS — gradient-based one-side sampling (ISSUE 16, after arXiv:1706.08359):
+# keep the top-a fraction of rows by |gradient| exactly, sample a b fraction
+# of the rest uniformly, and amplify the sampled rest by (1-a)/b so the
+# histogram stat sums stay unbiased. Rows drop out the same way sample_rate
+# rows do — weight 0 — so every downstream lane (hists, partition, streamed
+# blocks, the 2-D mesh row axis) composes with no new code paths.
+
+
+def _goss_ab() -> tuple[float, float] | None:
+    """Parse ``H2O3_TPU_TREE_GOSS='a,b'``; None (knob empty) = GOSS off."""
+    from h2o3_tpu import config
+
+    raw = config.get("H2O3_TPU_TREE_GOSS").strip()
+    if not raw:
+        return None
+    try:
+        a_s, b_s = raw.split(",")
+        a, b = float(a_s), float(b_s)
+    except ValueError:
+        raise ValueError(
+            f"H2O3_TPU_TREE_GOSS must be 'a,b' (two floats), got {raw!r}"
+        ) from None
+    if not (0.0 <= a < 1.0):
+        raise ValueError(f"GOSS top fraction a must be in [0, 1), got {a}")
+    if not (0.0 < b <= 1.0 - a):
+        raise ValueError(f"GOSS rest fraction b must be in (0, 1-a], got {b}")
+    return a, b
+
+
+def _goss_factor(w_tree, wy, gkey, a: float, b: float):
+    """Traced per-row GOSS factor: 1 for the top-a rows by |weighted
+    gradient|, (1-a)/b for the kept b-sample of the rest, 0 otherwise.
+
+    The top set is selected by a rank-k threshold over the VALID rows
+    (``w_tree > 0`` — bootstrap/sample_rate dropouts and row padding never
+    count toward the top fraction), with ties at the threshold all kept
+    (the cheap, deterministic resolution — the set can exceed a·n by the
+    tie count). ``a == 0`` degrades to plain amplified row sampling at
+    rate ``b``."""
+    valid = w_tree > 0
+    gmag = jnp.where(valid, jnp.abs(wy), -jnp.inf)
+    n_valid = valid.sum()
+    k = jnp.round(a * n_valid).astype(jnp.int32)
+    srt = jnp.sort(gmag)[::-1]  # descending; invalid (-inf) rows sort last
+    thr = srt[jnp.maximum(k - 1, 0)]
+    top = valid & (gmag >= thr) & (k > 0)
+    rest = valid & ~top
+    keep_rest = rest & jax.random.bernoulli(gkey, b / (1.0 - a), w_tree.shape)
+    amp = jnp.float32((1.0 - a) / b)
+    return jnp.where(
+        top, 1.0, jnp.where(keep_rest, amp, 0.0)
+    ).astype(w_tree.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -1656,7 +1794,7 @@ def _clamp_node_cap(node_cap: int, npad: int, min_rows) -> int:
 def _tree_program(
     max_depth: int, n_bins: int, node_cap: int, cat_cols: tuple,
     n_cols_real: int | None = None, n_cols_pad: int | None = None,
-    mono: bool = False,
+    mono: bool = False, max_leaves: int = 0, efb=None,
 ):
     """One jitted program building a WHOLE tree (growth levels unrolled, the
     saturated run as a lax.while_loop — see :func:`_fused_levels`).
@@ -1671,10 +1809,17 @@ def _tree_program(
     and get a real-width varimp back.
     """
     subtract = _subtract_enabled()
-    split_shard = _split_shard_on()
-    split_fuse = _split_fuse_active(cat_cols, split_shard)
+    if efb is not None:
+        # EFB rides the replicated dense lane only: the bundled C axis is
+        # too small to shard/fuse profitably, and the dense scans are
+        # decision-equal to the sharded/fused ones by construction
+        split_shard = split_fuse = False
+    else:
+        split_shard = _split_shard_on()
+        split_fuse = _split_fuse_active(cat_cols, split_shard)
     key = ("tree", max_depth, n_bins, node_cap, cat_cols, subtract,
            n_cols_real, n_cols_pad, split_shard, split_fuse, bool(mono),
+           int(max_leaves), None if efb is None else efb.key,
            _kernel_key(), _mesh_key(),
            tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
            jax.default_backend())
@@ -1683,7 +1828,7 @@ def _tree_program(
         def whole_tree(
             bins_u8, preds, varimp, w, wy, wh, key_, cols_enabled, is_cat,
             min_rows, min_split_improvement, learn_rate, max_abs_leaf,
-            col_sample_rate, leaf_reg=None, mono_vec=None,
+            col_sample_rate, leaf_reg=None, mono_vec=None, bins_b=None,
         ):
             C = bins_u8.shape[1]
             Cp = n_cols_pad or C
@@ -1701,6 +1846,7 @@ def _tree_program(
                 max_depth=max_depth, n_bins=n_bins, node_cap=node_cap,
                 cat_cols=cat_cols, subtract=subtract, n_cols_real=n_cols_real,
                 split_shard=split_shard, split_fuse=split_fuse, mono=mono_vec,
+                max_leaves=max_leaves, efb=efb, bins_b=bins_b,
             )
             return nid, preds_, varimp_[:C], records, sat_iters
 
@@ -1736,6 +1882,9 @@ def build_trees_scanned(
     reg_lambda: float = 0.0,
     reg_alpha: float = 0.0,
     monotone=None,
+    max_leaves: int = 0,
+    efb=None,
+    bins_b=None,
 ):
     """Build ``n_trees`` trees in ONE device dispatch (lax.scan over trees).
 
@@ -1765,16 +1914,22 @@ def build_trees_scanned(
     is_cat_dev = jnp.asarray(is_cat_np)
 
     subtract = _subtract_enabled()
-    split_shard = _split_shard_on()
-    split_fuse = _split_fuse_active(cat_cols, split_shard)
+    if efb is not None:
+        split_shard = split_fuse = False  # EFB: replicated dense lane only
+    else:
+        split_shard = _split_shard_on()
+        split_fuse = _split_fuse_active(cat_cols, split_shard)
+    goss = _goss_ab()
     # the float rates are baked into the traced closure, so they MUST be part
     # of the cache key (a boolean would silently reuse another model's rates);
-    # C (the real column count) likewise — it sizes the traced RNG draws
+    # C (the real column count) likewise — it sizes the traced RNG draws;
+    # goss (a, b floats) and the EFB plan fingerprint bake in the same way
     key = (
         "scan", n_trees, max_depth, n_bins, node_cap, cat_cols, grad_key, C,
         tuple(_bin_shifts(max_depth, n_bins, cat_cols)),
         float(sample_rate), float(col_sample_rate_per_tree), subtract,
-        split_shard, split_fuse, monotone is not None, _kernel_key(),
+        split_shard, split_fuse, monotone is not None, goss,
+        int(max_leaves), None if efb is None else efb.key, _kernel_key(),
         _mesh_key(), jax.default_backend(),
     )
 
@@ -1782,7 +1937,7 @@ def build_trees_scanned(
         def whole_chunk(
             bins_u8, w, y, preds, varimp, base_key, row_key_, offset, lrs, is_cat,
             min_rows_, msi_, max_abs_leaf_, col_rate_, leaf_reg_,
-            mono_vec=None,
+            mono_vec=None, bins_b=None,
         ):
             if Cp > C:  # bucketed column pad: code 0 (NA) everywhere, masked
                 bins_u8 = jnp.pad(bins_u8, ((0, 0), (0, Cp - C)))
@@ -1810,6 +1965,13 @@ def build_trees_scanned(
                     t, h = grad_fn(F, y, w_tree)
                     wy = w_tree * t
                     wh = jnp.where(w_tree > 0, h, 0.0)
+                if goss is not None:
+                    gf = _goss_factor(
+                        w_tree, wy, jax.random.fold_in(tkey, 1 << 28), *goss
+                    )
+                    w_tree = w_tree * gf
+                    wy = wy * gf
+                    wh = wh * gf
                 # the per-tree column draw runs at the REAL column count C,
                 # so bucketed padding cannot perturb the sampled columns
                 if col_sample_rate_per_tree < 1.0:
@@ -1832,6 +1994,7 @@ def build_trees_scanned(
                     cat_cols=cat_cols, subtract=subtract, n_cols_real=C,
                     split_shard=split_shard, split_fuse=split_fuse,
                     mono=mono_vec,
+                    max_leaves=max_leaves, efb=efb, bins_b=bins_b,
                 )
                 return (F, vi), (recs, sat_i)
 
@@ -1869,6 +2032,12 @@ def build_trees_scanned(
         None if monotone is None
         else jnp.asarray(np.asarray(monotone, np.int32))
     )
+    if goss is not None:
+        # modeled expected kept-row volume, same convention as the HBM
+        # byte tallies (host-side: the factor never leaves the program)
+        _ROWS_SAMPLED.inc((goss[0] + goss[1]) * bins_u8.shape[0] * n_trees)
+    if efb is not None:
+        _COLS_BUNDLED.inc(C - efb.n_cols_b)
     out = _run_counted(
         prog,
         (
@@ -1877,7 +2046,7 @@ def build_trees_scanned(
             jnp.int32(tree_offset), lrs, is_cat_dev,
             jnp.float32(min_rows), jnp.float32(min_split_improvement),
             jnp.float32(max_abs_leaf), jnp.float32(col_sample_rate), leaf_reg,
-            mono_dev,
+            mono_dev, bins_b,
         ),
         mult=n_trees,
         sat_from=lambda o: o[3],
@@ -2097,6 +2266,9 @@ def build_tree(
     monotone=None,  # (C,) int {-1,0,1} per-column constraint directions
     reg_lambda: float = 0.0,
     reg_alpha: float = 0.0,
+    max_leaves: int = 0,
+    efb=None,
+    bins_b=None,
 ):
     """Build one tree without any host↔device traffic in the level loop.
 
@@ -2118,6 +2290,17 @@ def build_tree(
     is_cat_dev = jnp.asarray(np.asarray(is_cat_cols, bool))
     wy = w * t
     wh = jnp.where(w > 0, h, 0.0)  # sampled-out rows carry no hessian either
+    goss = _goss_ab()
+    if goss is not None:
+        # GOSS composes with every build lane from here: the factor folds
+        # into the row weights before any histogram sees them
+        gf = _goss_factor(w, wy, jax.random.fold_in(key, 1 << 28), *goss)
+        w = w * gf
+        wy = wy * gf
+        wh = wh * gf
+        _ROWS_SAMPLED.inc((goss[0] + goss[1]) * w.shape[0])
+    if efb is not None:
+        _COLS_BUNDLED.inc(C - efb.n_cols_b)
     if cols_enabled is not None:
         cols_enabled_dev = jnp.asarray(np.asarray(cols_enabled, np.float32))
     elif col_sample_rate_per_tree < 1.0:
@@ -2150,7 +2333,7 @@ def build_tree(
         if _split_fuse_on() and use_fused_trees(max_depth):
             prog = _tree_program(
                 max_depth, n_bins, node_cap, cat_cols, n_cols_real=C,
-                n_cols_pad=Cp, mono=True,
+                n_cols_pad=Cp, mono=True, max_leaves=max_leaves, efb=efb,
             )
             BUILD_STATS["dispatches"] += 1
             BUILD_STATS["trees_built"] += 1
@@ -2164,7 +2347,7 @@ def build_tree(
                     is_cat_dev,
                     jnp.float32(min_rows), jnp.float32(min_split_improvement),
                     jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-                    jnp.float32(col_sample_rate), leaf_reg, mono_dev,
+                    jnp.float32(col_sample_rate), leaf_reg, mono_dev, bins_b,
                 ),
                 sat_from=lambda o: o[4],
             )
@@ -2209,9 +2392,15 @@ def build_tree(
         return tree, preds, varimp
 
     fused = use_fused_trees(max_depth)
+    if (max_leaves or efb is not None) and not fused:
+        raise ValueError(
+            "grow_policy=lossguide / EFB need the fused whole-tree program "
+            "(H2O3_TPU_WHOLE_TREE=1 within the fused depth cap)"
+        )
     if fused:
         prog = _tree_program(
-            max_depth, n_bins, node_cap, cat_cols, n_cols_real=C, n_cols_pad=Cp
+            max_depth, n_bins, node_cap, cat_cols, n_cols_real=C,
+            n_cols_pad=Cp, max_leaves=max_leaves, efb=efb,
         )
         BUILD_STATS["dispatches"] += 1
         BUILD_STATS["trees_built"] += 1
@@ -2225,7 +2414,7 @@ def build_tree(
                 is_cat_dev,
                 jnp.float32(min_rows), jnp.float32(min_split_improvement),
                 jnp.float32(learn_rate), jnp.float32(max_abs_leaf),
-                jnp.float32(col_sample_rate), leaf_reg,
+                jnp.float32(col_sample_rate), leaf_reg, None, bins_b,
             ),
             sat_from=lambda o: o[4],
         )
@@ -2382,10 +2571,16 @@ def _stream_decide_prog(n_pad: int, n_pad_next: int, n_bins: int,
 _STREAM_GRAD_CACHE: dict = {}
 
 
-def _stream_grad_prog(grad_fn, grad_key, sample: bool):
+def _stream_grad_prog(grad_fn, grad_key, sample: bool, goss=None):
     """Per-block pseudo-residuals/hessians (+ the per-tree row bootstrap
-    when sampling): (F, y, w, key, rate) -> (w_tree, wy, wh)."""
-    key = ("stream_grad", grad_key, sample, jax.default_backend())
+    when sampling): (F, y, w, key, rate) -> (w_tree, wy, wh).
+
+    ``goss`` ((a, b) floats) applies GOSS per BLOCK: the top-a threshold is
+    taken over each block's rows rather than the whole frame — a documented
+    approximation of the resident lanes' global threshold (same expected
+    kept volume and amplification; the out-of-core frame never holds the
+    global gradient ranking)."""
+    key = ("stream_grad", grad_key, sample, goss, jax.default_backend())
     fn = _STREAM_GRAD_CACHE.get(key)
     if fn is None:
 
@@ -2398,6 +2593,11 @@ def _stream_grad_prog(grad_fn, grad_key, sample: bool):
             t, h = grad_fn(F, y, wt)
             wy = wt * t
             wh = jnp.where(wt > 0, h, 0.0)
+            if goss is not None:
+                gf = _goss_factor(
+                    wt, wy, jax.random.fold_in(skey, 1 << 28), *goss
+                )
+                wt, wy, wh = wt * gf, wy * gf, wh * gf
             return wt, wy, wh
 
         fn = jax.jit(run)
@@ -2468,7 +2668,10 @@ def build_trees_streamed(
         None if reg_lambda == 0.0 and reg_alpha == 0.0
         else (jnp.float32(reg_lambda), jnp.float32(reg_alpha))
     )
-    gprog = _stream_grad_prog(grad_fn, grad_key, sample_rate < 1.0)
+    goss = _goss_ab()
+    gprog = _stream_grad_prog(grad_fn, grad_key, sample_rate < 1.0, goss)
+    if goss is not None:
+        _ROWS_SAMPLED.inc((goss[0] + goss[1]) * store.npad * n_trees)
     mono_dev = None
     if monotone is not None and np.any(np.asarray(monotone) != 0):
         mono_dev = jnp.asarray(np.asarray(monotone, np.int32))

@@ -241,22 +241,71 @@ def _uplift_level(n_pad, n_pad_next, n_bins, force_leaf, metric):
     return fn
 
 
+def _uplift_tree_program(max_depth: int, n_bins: int, node_cap: int,
+                         metric: str):
+    """Whole-tree uplift program (ISSUE 16: the last fused-matrix closure).
+
+    All levels of one uplift tree trace into a single jitted dispatch —
+    the 4-lane (wt, wyt, wc, wyc) scan runs through the same unrolled
+    level structure the GBM/DRF whole-tree programs use. Levels past the
+    point where every branch retired produce all-leaf placeholder records
+    (zero histograms → no splits) that replay inertly, exactly like the
+    fused GBM program's post-exit levels, so the recorded tree is
+    bit-equal to the legacy per-level loop's on every REAL level."""
+    key = ("uplift_tree", max_depth, n_bins, node_cap, metric,
+           jax.default_backend())
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+
+        def whole_tree(bins_u8, preds, varimp, wt, wyt, wc, wyc, key_,
+                       is_cat, min_rows, msi, col_rate):
+            nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
+            recs = []
+            for depth in range(max_depth + 1):
+                n_pad = min(1 << depth, node_cap)
+                n_pad_next = min(2 * n_pad, node_cap)
+                nid, preds, varimp, _, rec = _uplift_level_fn(
+                    bins_u8, nid, preds, varimp, wt, wyt, wc, wyc,
+                    jax.random.fold_in(key_, depth), is_cat,
+                    min_rows, msi, col_rate,
+                    n_pad=n_pad, n_pad_next=n_pad_next, n_bins=n_bins,
+                    force_leaf=depth == max_depth, metric=metric,
+                )
+                recs.append(rec)
+            return nid, preds, varimp, tuple(recs)
+
+        fn = jax.jit(whole_tree, donate_argnums=(1, 2))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
 def _build_uplift_tree(bins_u8, wt, y, wc, *, n_bins, is_cat_cols, max_depth,
                        min_rows, min_split_improvement, col_sample_rate,
                        preds, key, varimp, metric, node_cap=1024):
-    # fallback observability (ISSUE 15): uplift's 4-lane scan is the one
-    # remaining structural hole in the fused matrix — tally it per tree
-    # when the fuse gate wanted the fused lane
     from h2o3_tpu.models.tree.shared_tree import (
         _split_fuse_active,
         _split_shard_on,
+        use_fused_trees,
     )
 
-    _split_fuse_active((), _split_shard_on(), uplift=True)
     is_cat_dev = jnp.asarray(np.asarray(is_cat_cols, bool))
     wyt = wt * y
     wyc = wc * y
     tree = Tree()
+    if use_fused_trees(max_depth):
+        prog = _uplift_tree_program(max_depth, n_bins, node_cap, metric)
+        _, preds, varimp, records = prog(
+            bins_u8, preds, varimp, wt, wyt, wc, wyc, key, is_cat_dev,
+            jnp.float32(min_rows), jnp.float32(min_split_improvement),
+            jnp.float32(col_sample_rate),
+        )
+        for rec in records:
+            tree.levels.append(TreeLevel(**rec))
+        return tree, preds, varimp
+    # legacy per-level host loop (H2O3_TPU_WHOLE_TREE=0 / depth cap): the
+    # only remaining structural fallback — tally it per tree when the fuse
+    # gate wanted the fused lane (ISSUE 15/16 observability)
+    _split_fuse_active((), _split_shard_on(), uplift=True)
     nid = jnp.zeros(bins_u8.shape[0], jnp.int32)
     for depth in range(max_depth + 1):
         n_pad = min(1 << depth, node_cap)

@@ -155,6 +155,76 @@ def _local_is_pallas(local) -> bool:
     return local not in (_hist_scatter_local, _hist_matmul_local)
 
 
+# ---------------------------------------------------------------------------
+# int16 histogram accumulation lanes (ISSUE 16, H2O3_TPU_HIST_I16 —
+# arXiv:1806.11248's quantized gradient/hessian accumulation). Each stat
+# lane is rescaled per node so row values fit an int8-range code
+# (scale = absmax/127; scale 1 — EXACT — when the node's lane is already
+# small integers, the w/count lanes and the parity suites), accumulated
+# through the unchanged local impl inside a ±32767 int16 cell budget, and
+# rescaled back after. A node whose accumulated cells would exceed the
+# budget trips the overflow latch: the whole shard-local pass recomputes in
+# f32 on-device (lax.cond) and tree_hist_i16_overflows_total tallies. The
+# rescale happens BEFORE the cross-device reduce, so per-shard scales need
+# not agree and the collective lane (quantized or not) is untouched.
+
+from h2o3_tpu.utils import metrics as _mx
+
+_I16_OVERFLOWS = _mx.counter(
+    "tree_hist_i16_overflows_total",
+    "shard-local int16 histogram accumulations that tripped the overflow "
+    "latch and recomputed in f32 (H2O3_TPU_HIST_I16)", always=True)
+
+
+def _i16_enabled() -> bool:
+    from h2o3_tpu import config
+
+    return config.get_bool("H2O3_TPU_HIST_I16")
+
+
+def _i16_overflow_cb(flag) -> None:
+    if bool(flag):
+        _I16_OVERFLOWS.inc()
+
+
+def _i16_local(local, bins_u8, nid, stats, n_nodes: int, n_bins: int):
+    """Quantized shard-local accumulation with the f32 overflow fallback."""
+    S = stats.shape[1]
+    nid_safe = jnp.maximum(nid, 0)
+    amag = jnp.abs(stats)
+    absmax = jnp.zeros((n_nodes, S), jnp.float32).at[nid_safe].max(
+        amag, mode="drop")
+    nonint = jnp.zeros((n_nodes, S), jnp.float32).at[nid_safe].max(
+        (stats != jnp.round(stats)).astype(jnp.float32), mode="drop")
+    exact = (absmax <= 127.0) & (nonint == 0.0)
+    scale = jnp.where(exact, 1.0, jnp.maximum(absmax, 1e-30) / 127.0)
+    q = jnp.round(stats / scale[nid_safe])
+    hq = local(bins_u8, nid, q, n_nodes, n_bins)  # (C, n_nodes*n_bins, S)
+    C = hq.shape[0]
+    hq4 = hq.reshape(C, n_nodes, n_bins, S)
+    overflow = (jnp.abs(hq4) > 32767.0).any()
+    hist = jax.lax.cond(
+        overflow,
+        lambda _: local(bins_u8, nid, stats, n_nodes, n_bins),
+        lambda _: (hq4 * scale[None, :, None, :]).reshape(
+            C, n_nodes * n_bins, S),
+        None,
+    )
+    jax.debug.callback(_i16_overflow_cb, overflow)
+    return hist
+
+
+def _maybe_i16(local):
+    """Wrap a dense local impl in the i16 lane when the knob is on.
+
+    The Pallas kernel accumulates in its own VMEM tiles and is left alone
+    (documented in MIGRATION.md); read at trace time, so every program
+    cache keyed on shared_tree._kernel_key retraces on a knob flip."""
+    if not _i16_enabled() or _local_is_pallas(local):
+        return local
+    return partial(_i16_local, local)
+
+
 _ROW_CHUNK = 8192  # rows per matmul chunk: (chunk, C*B) transient ≤ ~120MB
 
 
@@ -253,10 +323,12 @@ def histogram_in_jit(
 
     from h2o3_tpu.ops import collectives
 
+    local_acc = _maybe_i16(local)
+
     def body(b, n, s):
         # retired/padding rows (nid < 0) carry zero stats into every impl
         s = jnp.where((n >= 0)[:, None], s, 0.0)
-        h = local(b, n, s, n_nodes, n_bins)
+        h = local_acc(b, n, s, n_nodes, n_bins)
         # the cross-device reduction runs through the collective lane
         # (ops/collectives.py): stock psum/psum_scatter when the quant lane
         # is off — bit-for-bit the pre-lane program — or the block-
@@ -348,7 +420,8 @@ def _histogram_in_jit_fused(
                 n_shards=n_col if col_sharded else 1,
             )
         else:
-            h = blocked_from_dense(local(b, n, s, n_nodes, n_bins), layout)
+            h = blocked_from_dense(
+                _maybe_i16(local)(b, n, s, n_nodes, n_bins), layout)
         # whole-column-tile reduce through the collective lane (quantized /
         # hierarchical when on, stock otherwise; 2-D meshes stage the exact
         # rows-axis psum first) — it records the hist_reduce tally per lane
@@ -382,7 +455,22 @@ def _histogram_in_jit_fused(
     return blk, layout
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+_BUILD_HIST_PROG: dict = {}
+
+
 def build_histograms(bins_u8, nid, stats, n_nodes: int, n_bins: int):
-    """Standalone jitted histogram (kept for tests / direct use)."""
-    return histogram_in_jit(bins_u8, nid, stats, n_nodes, n_bins)
+    """Standalone jitted histogram (kept for tests / direct use).
+
+    Cached per (shape statics, impl knobs): the local-impl selection and
+    the i16 lane are trace-time decisions, so an env flip must reach a
+    fresh program here just like in the tree builders."""
+    from h2o3_tpu import config
+
+    key = (n_nodes, n_bins, config.get("H2O3_TPU_HIST"), _i16_enabled(),
+           jax.default_backend())
+    prog = _BUILD_HIST_PROG.get(key)
+    if prog is None:
+        prog = jax.jit(
+            partial(histogram_in_jit, n_nodes=n_nodes, n_bins=n_bins))
+        _BUILD_HIST_PROG[key] = prog
+    return prog(bins_u8, nid, stats)

@@ -26,6 +26,13 @@ barely matters — bin count and tile sizes are the levers.
         # fallback it replaces (kill-switch knobs), with parity pins and
         # dispatch/wall ratios in a {"fallback_ab": ...} summary line.
 
+    python tools/bench_kernel_sweep.py --wave2-ab [--rows N]
+        # tree-kernel wave-2 A/B (ISSUE 16): GOSS row sampling, EFB column
+        # bundling, the u8-code cache, int16 hist lanes and lossguide
+        # growth each run knob-on vs knob-off with parity/quality pins
+        # (bit-identical controls, AUC/RMSE envelopes, shrink ratios),
+        # then a {"wave2_ab": ...} summary line.
+
     python tools/bench_kernel_sweep.py --oocore-ab [--rows N]
         # streamed-vs-resident out-of-core A/B (ISSUE 11): forces an HBM
         # window of 1/10th the frame's training lanes, measures wall time,
@@ -806,6 +813,218 @@ def mesh2d_ab(rows: int = 10_000, cols: int = 28, depth: int = 6,
         }}), flush=True)
 
 
+def wave2_ab(rows: int = 8_000) -> None:
+    """Tree kernel wave-2 A/B (ISSUE 16): GOSS, EFB, u8-code-native frames,
+    int16 hist lanes and lossguide growth, each against the baseline path
+    on the SAME data, with the forced-off knob controls pinned bit-for-bit.
+    One JSON line per case, then a {"wave2_ab": ...} summary carrying the
+    acceptance pins: GOSS row-stats ratio >= 2x at AUC delta <= 1e-3, EFB
+    C shrink >= 1.5x with bit-equal splits, u8-native rebin traffic cut
+    >= 2x across repeated builds, every knob=0 control bit-for-bit."""
+    import pandas as pd
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.tree import GBM
+    from h2o3_tpu.utils import metrics as mx
+
+    rng = np.random.default_rng(0)
+    summary = {}
+
+    def envs(**kv):
+        for k, v in kv.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+
+    def pred(m, fr, col):
+        pr = m.predict(fr)
+        return pr.vec(col if col in pr.names else pr.names[-1]).to_numpy()
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
+
+    # ---- (a) GOSS: (a=0.2, b=0.1) vs full rows, binomial AUC pin ----
+    from sklearn.metrics import roc_auc_score
+
+    # 4x the base rows, a strong signal and modest capacity: the AUC-delta
+    # pin wants the CONVERGED regime (both models capture the same signal),
+    # not the overfit regime where the sampled fit drifts by more than the
+    # pin just from which rows each tree saw
+    rows_g = rows * 4
+    X = rng.normal(size=(rows_g, 8)).astype(np.float32)
+    eta = 3.0 * (1.5 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3])
+    yb = rng.random(rows_g) < 1 / (1 + np.exp(-eta))
+    dfc = pd.DataFrame(X, columns=[f"x{i}" for i in range(8)])
+    dfc["label"] = np.where(yb, "a", "b")
+    fr_c = Frame.from_pandas(dfc)
+    trees = 60
+    kw_c = dict(ntrees=trees, max_depth=3, seed=7, distribution="bernoulli")
+    aucs, gpreds = {}, {}
+    for mode, knob in (("baseline", None), ("goss", "0.2,0.1"),
+                       ("goss_off", "")):
+        envs(H2O3_TPU_TREE_GOSS=knob)
+        r0 = mx.counter_value("tree_rows_sampled_total")
+        m, dt = timed(lambda: GBM(**kw_c).train(
+            y="label", training_frame=fr_c))
+        p = pred(m, fr_c, "a")
+        gpreds[mode] = p
+        aucs[mode] = roc_auc_score(yb, p)
+        rec = {"phase": "wave2_ab", "case": "goss", "mode": mode,
+               "rows": rows_g, "trees": trees, "train_s": round(dt, 4),
+               "auc": round(aucs[mode], 6),
+               "rows_sampled": mx.counter_value(
+                   "tree_rows_sampled_total") - r0}
+        print(json.dumps(rec), flush=True)
+        summary[f"goss_{mode}"] = rec
+    envs(H2O3_TPU_TREE_GOSS=None)
+    # modeled per-level row-stat work: kept rows vs all rows
+    kept_frac = summary["goss_goss"]["rows_sampled"] / (fr_c.npad * trees)
+    summary["goss_row_stats_ratio"] = round(1.0 / max(kept_frac, 1e-9), 2)
+    summary["goss_auc_delta"] = round(
+        abs(aucs["baseline"] - aucs["goss"]), 6)
+    summary["goss_off_bit_identical"] = bool(
+        np.array_equal(gpreds["baseline"], gpreds["goss_off"]))
+
+    # ---- (b) EFB: one-hot design, C shrink + bit-equal splits. The
+    # parity frame uses an INTEGER exactly-zero-mean response so the stat
+    # lanes stay in-range integers and the default-cell reconstruction is
+    # bit-exact (the theorem regime; float lanes carry an f32-associativity
+    # envelope and may break equal-gain threshold ties differently) ----
+    levels, dense = 12, 3
+    g = rng.integers(0, levels, rows // 2)
+    yh = (g % 3 - 1).astype(np.float32)
+    g = np.concatenate([g, g])
+    dfe = pd.DataFrame(
+        {f"oh{j}": (g == j).astype(np.float32) for j in range(levels)})
+    for j in range(dense):
+        dfe[f"d{j}"] = rng.normal(size=rows).astype(np.float32)
+    dfe["label"] = (0.7 * (g % 3) + dfe["d0"] - 0.5 * dfe["d1"]
+                    + 0.2 * rng.normal(size=rows))
+    fr_e = Frame.from_pandas(dfe)
+    kw_e = dict(ntrees=8, max_depth=5, seed=7, distribution="gaussian")
+    dfp = dfe.drop(columns=["label"]).copy()
+    dfp["label"] = np.concatenate([yh, -yh])  # integer sum == exactly 0
+    fr_p = Frame.from_pandas(dfp)
+    kw_p = dict(ntrees=1, max_depth=5, seed=7, distribution="gaussian")
+
+    def split_structure(m):
+        out = []
+        for it in m.output["trees"]:
+            for t in it:
+                h = t.to_host()
+                for lv, mk in zip(h.levels, h.real_level_masks()):
+                    out.append((np.asarray(lv.split_col)[mk],
+                                np.asarray(lv.split_bin)[mk],
+                                np.asarray(lv.leaf_now)[mk]))
+        return out
+
+    emodels = {}
+    for mode, knob in (("baseline", None), ("efb", "1")):
+        envs(H2O3_TPU_TREE_EFB=knob)
+        c0 = mx.counter_value("tree_cols_bundled_total")
+        m, dt = timed(lambda: GBM(**kw_e).train(
+            y="label", training_frame=fr_e))
+        emodels[mode] = GBM(**kw_p).train(y="label", training_frame=fr_p)
+        rec = {"phase": "wave2_ab", "case": "efb", "mode": mode,
+               "rows": rows, "cols": levels + dense,
+               "train_s": round(dt, 4),
+               "cols_bundled": mx.counter_value(
+                   "tree_cols_bundled_total") - c0}
+        print(json.dumps(rec), flush=True)
+        summary[f"efb_{mode}"] = rec
+    envs(H2O3_TPU_TREE_EFB=None)
+    # C shrink straight from the plan (counter tallies per build/chunk)
+    from h2o3_tpu.models.tree.binning import bin_frame, fit_bins, fit_efb
+
+    cols_e = [c for c in dfe.columns if c != "label"]
+    spec_e = fit_bins(fr_e, cols_e)
+    plan_e = fit_efb(spec_e, bin_frame(spec_e, fr_e), nrow=fr_e.nrow)
+    summary["efb_c_shrink"] = round(
+        plan_e.n_cols / plan_e.n_cols_b, 2) if plan_e else 1.0
+    summary["efb_splits_bit_equal"] = bool(all(
+        all(np.array_equal(a, b) for a, b in zip(s0, s1))
+        for s0, s1 in zip(split_structure(emodels["baseline"]),
+                          split_structure(emodels["efb"]))))
+
+    # ---- (c) u8-code-native frames: rebin HBM traffic across 3 repeated
+    # builds over one frame, cache on vs off ----
+    rebin = {}
+    upreds = {}
+    for mode, knob in (("u8cache", None), ("u8cache_off", "0")):
+        envs(H2O3_TPU_TREE_U8CACHE=knob)
+        fr_u = Frame.from_pandas(dfe)  # fresh frame: empty bin cache
+        r0 = mx.counter_value("tree_hist_hbm_bytes_total", path="rebin")
+        for rep in range(3):
+            m = GBM(**kw_e).train(y="label", training_frame=fr_u)
+        upreds[mode] = pred(m, fr_u, "predict")
+        rebin[mode] = mx.counter_value(
+            "tree_hist_hbm_bytes_total", path="rebin") - r0
+        rec = {"phase": "wave2_ab", "case": "u8_native", "mode": mode,
+               "rows": rows, "builds": 3, "rebin_bytes": rebin[mode]}
+        print(json.dumps(rec), flush=True)
+    envs(H2O3_TPU_TREE_U8CACHE=None)
+    summary["u8_rebin_bytes_ratio"] = round(
+        rebin["u8cache_off"] / max(rebin["u8cache"], 1.0), 2)
+    summary["u8_off_bit_identical"] = bool(
+        np.array_equal(upreds["u8cache"], upreds["u8cache_off"]))
+
+    # ---- (d) int16 hist lanes: envelope + forced-off control ----
+    ipreds = {}
+    for mode, knob in (("f32", None), ("i16", "1"), ("i16_off", "0")):
+        envs(H2O3_TPU_HIST_I16=knob)
+        o0 = mx.counter_value("tree_hist_i16_overflows_total")
+        m, dt = timed(lambda: GBM(**kw_e).train(
+            y="label", training_frame=fr_e))
+        ipreds[mode] = pred(m, fr_e, "predict")
+        rec = {"phase": "wave2_ab", "case": "i16", "mode": mode,
+               "rows": rows, "train_s": round(dt, 4),
+               "overflows": mx.counter_value(
+                   "tree_hist_i16_overflows_total") - o0}
+        print(json.dumps(rec), flush=True)
+    envs(H2O3_TPU_HIST_I16=None)
+    yl = dfe["label"].to_numpy()
+    rmse = {m: float(np.sqrt(np.mean((p - yl) ** 2)))
+            for m, p in ipreds.items()}
+    # quantized near-tie splits diverge tree-by-tree; model QUALITY is the
+    # envelope that holds (same contract as the parity tests)
+    summary["i16_rmse_ratio"] = round(rmse["i16"] / max(rmse["f32"], 1e-9), 4)
+    summary["i16_off_bit_identical"] = bool(
+        np.array_equal(ipreds["f32"], ipreds["i16_off"]))
+
+    # ---- (e) lossguide: bounded-leaves headline + unbound control ----
+    for mode, kw_l in (
+            ("depthwise", {}),
+            ("lossguide", dict(grow_policy="lossguide", max_leaves=16)),
+            ("lossguide_unbound",
+             dict(grow_policy="lossguide", max_leaves=2 ** 5))):
+        m, dt = timed(lambda: GBM(**kw_e, **kw_l).train(
+            y="label", training_frame=fr_e))
+        rec = {"phase": "wave2_ab", "case": "lossguide", "mode": mode,
+               "rows": rows, "train_s": round(dt, 4),
+               "max_n_leaves": max(t.n_leaves
+                                   for it in m.output["trees"] for t in it)}
+        print(json.dumps(rec), flush=True)
+        summary[f"lossguide_{mode}"] = rec
+        ipreds[mode] = pred(m, fr_e, "predict")
+    summary["lossguide_leaves_bounded"] = bool(
+        summary["lossguide_lossguide"]["max_n_leaves"] <= 16)
+    summary["lossguide_unbound_bit_identical"] = bool(np.array_equal(
+        ipreds["depthwise"], ipreds["lossguide_unbound"]))
+
+    print(json.dumps({"wave2_ab": {
+        k: summary[k] for k in (
+            "goss_row_stats_ratio", "goss_auc_delta",
+            "goss_off_bit_identical", "efb_c_shrink",
+            "efb_splits_bit_equal", "u8_rebin_bytes_ratio",
+            "u8_off_bit_identical", "i16_rmse_ratio",
+            "i16_off_bit_identical", "lossguide_leaves_bounded",
+            "lossguide_unbound_bit_identical")
+    }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -886,5 +1105,7 @@ if __name__ == "__main__":
         fallback_ab(**kw)
     elif "--mesh2d-ab" in sys.argv:
         mesh2d_ab(**kw)
+    elif "--wave2-ab" in sys.argv:
+        wave2_ab(**kw)
     else:
         main()

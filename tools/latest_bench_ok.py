@@ -277,6 +277,77 @@ def _fallback_ab_ok(here: str, now: float):
         return False
 
 
+def _wave2_ab_ok(here: str, now: float):
+    """Sanity-check the newest recent WAVE2_AB_*.jsonl (bench_kernel_sweep
+    --wave2-ab, the ISSUE-16 tree-kernel wave-2 A/B). Returns None when no
+    recent artifact exists (no opinion), else True/False. Checks the
+    acceptance pins: GOSS at a=0.2,b=0.1 streams >=2x fewer row stats per
+    level at AUC delta <=1e-3, EFB shrinks the histogram C dimension
+    >=1.5x with bit-equal split structure on the integer-exact parity
+    frame, the u8-code cache cuts rebin HBM traffic >=2x across repeated
+    builds, the int16 lane holds a 1.10x RMSE envelope, lossguide honors
+    its leaf budget, and EVERY knob-off control is bit-identical."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "WAVE2_AB_*.jsonl")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "wave2_ab" in d:
+                    summary = d["wave2_ab"]
+        if not summary:
+            print(f"{name}: NO wave2_ab summary line")
+            return False
+        goss_r = float(summary.get("goss_row_stats_ratio") or 0)
+        if not goss_r >= 2.0:
+            print(f"{name}: GOSS row-stats ratio {goss_r} < 2x")
+            return False
+        goss_d = float(summary.get("goss_auc_delta", float("nan")))
+        if not goss_d <= 1e-3:
+            print(f"{name}: GOSS AUC delta {goss_d} > 1e-3")
+            return False
+        efb_s = float(summary.get("efb_c_shrink") or 0)
+        if not efb_s >= 1.5:
+            print(f"{name}: EFB C shrink {efb_s} < 1.5x")
+            return False
+        u8_r = float(summary.get("u8_rebin_bytes_ratio") or 0)
+        if not u8_r >= 2.0:
+            print(f"{name}: u8 rebin-bytes ratio {u8_r} < 2x")
+            return False
+        i16_r = float(summary.get("i16_rmse_ratio", float("nan")))
+        if not 0 < i16_r <= 1.10:
+            print(f"{name}: i16 RMSE ratio {i16_r} outside (0, 1.10]")
+            return False
+        for k in ("efb_splits_bit_equal", "goss_off_bit_identical",
+                  "u8_off_bit_identical", "i16_off_bit_identical",
+                  "lossguide_leaves_bounded",
+                  "lossguide_unbound_bit_identical"):
+            if summary.get(k) is not True:
+                print(f"{name}: {k}={summary.get(k)!r} (want true)")
+                return False
+        print(f"{name}: goss-ratio={goss_r} goss-auc-delta={goss_d} "
+              f"efb-shrink={efb_s} u8-ratio={u8_r} i16-rmse={i16_r} "
+              f"controls=bit-identical ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def _mesh2d_ab_ok(here: str, now: float):
     """Sanity-check the newest recent MESH2D_AB_*.jsonl (bench_kernel_sweep
     --mesh2d-ab, the 1-D vs 2-D pod-mesh A/B, ISSUE 14). Returns None when
@@ -422,6 +493,12 @@ def main() -> int:
     # artifact must satisfy the parity + dispatch + no-worse-wall pins
     fb = _fallback_ab_ok(here, now)
     if fb is False:
+        return 1
+    # tree-kernel wave-2 gate (ISSUE 16): a recent --wave2-ab artifact
+    # must satisfy the sampling/bundling/quantization pins + bit-identical
+    # knob-off controls or the window stands
+    w2 = _wave2_ab_ok(here, now)
+    if w2 is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -101,6 +101,25 @@ timeout 1800 python tools/bench_kernel_sweep.py --fallback-ab --rows 100000 \
   | tee "FALLBACK_AB_${stamp}.jsonl"
 save "FALLBACK_AB_${stamp}.jsonl" "Fallback-matrix closure A/B (mono GBM / multinomial GLM / dropout DL, fused vs forced fallback)"
 
+# tree-kernel wave-2 A/B (ISSUE 16): GOSS / EFB / u8-code cache / int16
+# hist lanes / lossguide, each knob-on vs knob-off with the parity pins and
+# bit-identical controls. The CPU-proxy artifact (WAVE2_AB_*_cpu8proxy)
+# pins correctness; the real-TPU run here decides the wall-clock story —
+# GOSS and int16 only pay off where histogram bandwidth is the bottleneck.
+timeout 1800 python tools/bench_kernel_sweep.py --wave2-ab --rows 1000000 \
+  | tee "WAVE2_AB_${stamp}.jsonl"
+save "WAVE2_AB_${stamp}.jsonl" "Tree-kernel wave-2 A/B (GOSS / EFB / u8 cache / int16 lanes / lossguide, 1M rows)"
+
+# wave-2 bench headlines: the full-pipeline trees/sec under GOSS and under
+# the int16 lanes (one control each; EFB and the u8 cache show up in the
+# A/B's own counters, and the dense bench frame has nothing to bundle)
+H2O3_TPU_TREE_GOSS=0.2,0.1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_goss.json"
+save "BENCH_builder_${stamp}_goss.json" "TPU bench GOSS a=0.2,b=0.1 headline (headline only)"
+H2O3_TPU_HIST_I16=1 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_i16.json"
+save "BENCH_builder_${stamp}_i16.json" "TPU bench int16 histogram-lane headline (headline only)"
+
 # tile-autotuner first-build sweep (ISSUE 15 / ROADMAP 4b): run the bench
 # headline under H2O3_TPU_PALLAS_TILES=auto on a COLD tile store — the
 # first build sweeps once per shape bucket and persists the winners next to
