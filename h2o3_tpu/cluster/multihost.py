@@ -70,6 +70,24 @@ def pod_env() -> dict | None:
                 "found in H2O3_TPU_POD_NAME/POD_NAME/HOSTNAME — set one "
                 "(the k8s StatefulSet convention is pod-name-N)")
     if not 0 <= pid < num:
+        # elastic scale-down (ISSUE 17): when the formation manifest shows
+        # this ordinal WAS a member of a previously larger formation, the
+        # replica count shrank underneath a restart — the rank is RETIRED,
+        # not misconfigured. Exit cleanly instead of crash-looping on a
+        # ValueError the pod supervisor would restart forever.
+        prev = read_manifest()
+        if prev and pid < int(prev.get("processes", 0)):
+            Log.warn(
+                f"pod rank {pid} retired: formation scaled down from "
+                f"{prev.get('processes')} to {num} process(es) "
+                "(elastic transition) — exiting cleanly; the surviving "
+                "ranks re-form and resume from the interval snapshots")
+            from h2o3_tpu.utils import flightrec
+
+            flightrec.record(
+                "elastic_retired", rank=pid,
+                prev_processes=int(prev.get("processes", 0)), processes=num)
+            raise SystemExit(0)
         raise ValueError(
             f"process id {pid} out of range for {num} processes")
     return {"coordinator": coordinator, "num_processes": num,
@@ -136,7 +154,64 @@ def probe_capability(timeout: float = 30.0) -> str:
 
 
 # ---------------------------------------------------------------------------
-# formation
+# formation manifest (ISSUE 17, elastic recovery): the durable record of the
+# last AGREED formation — member count + mesh shape. A restarted rank reads
+# it before re-bootstrapping: a changed H2O3_TPU_NUM_PROCESSES is an ELASTIC
+# TRANSITION (spot preemption shrank the pod; the autoscaler grew it), not an
+# error — the rank boots into the NEW shape and the resumed job re-plans
+# rows×cols from the surviving host set instead of barriering against the
+# old count forever.
+
+
+def _manifest_path() -> str | None:
+    """Resolved H2O3_TPU_FORMATION_MANIFEST path, or None when disabled."""
+    from h2o3_tpu import config
+
+    v = config.get("H2O3_TPU_FORMATION_MANIFEST").strip()
+    if v in ("0", "false", "off"):
+        return None
+    if v:
+        return v
+    import tempfile
+
+    uid = getattr(os, "getuid", lambda: 0)()
+    return os.path.join(tempfile.gettempdir(),
+                        f"h2o3tpu_formation_{uid}.json")
+
+
+def read_manifest() -> dict | None:
+    """The last published formation record, or None (missing/disabled/
+    torn — a torn manifest means no opinion, never a crash)."""
+    path = _manifest_path()
+    if not path:
+        return None
+    import json
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def write_manifest(rec: dict) -> None:
+    """Atomically publish the formation record (persist's temp+rename, so a
+    crash mid-write never leaves a torn manifest for the next boot)."""
+    path = _manifest_path()
+    if not path:
+        return
+    import json
+
+    from h2o3_tpu import persist
+
+    try:
+        persist.write_bytes(
+            json.dumps(rec, sort_keys=True).encode("utf-8"), path)
+    except Exception as e:  # noqa: BLE001 — the manifest is advisory
+        Log.warn(f"formation manifest write failed ({e!r}); elastic "
+                 "transitions will not be detected on the next restart")
+
 
 def formation(barrier: bool = True) -> dict:
     """Cloud-formation record: barrier + per-host device enumeration.
@@ -174,6 +249,25 @@ def formation(barrier: bool = True) -> dict:
     flightrec.record(
         "formation", processes=rec["processes"],
         devices=rec["devices"], mesh=str(rec["mesh"]))
+    # elastic transition detection (ISSUE 17): a previous manifest recording
+    # a DIFFERENT member count or mesh shape means the topology changed
+    # across a restart — record it loudly (the runbook's signal that resumed
+    # jobs will re-plan rows×cols), then publish the new formation
+    prev = read_manifest()
+    if prev and (int(prev.get("processes", 0)) != rec["processes"]
+                 or prev.get("mesh") != rec["mesh"]):
+        Log.warn(
+            f"elastic transition: formation changed from "
+            f"{prev.get('processes')} process(es) mesh {prev.get('mesh')} "
+            f"to {rec['processes']} process(es) mesh {rec['mesh']} — "
+            "resumed jobs re-plan onto the new shape")
+        flightrec.record(
+            "elastic_transition",
+            prev_processes=int(prev.get("processes", 0)),
+            processes=rec["processes"],
+            prev_mesh=str(prev.get("mesh")), mesh=str(rec["mesh"]))
+    write_manifest(dict(rec, stamp=time.strftime(
+        "%Y%m%dT%H%M%SZ", time.gmtime())))
     return rec
 
 

@@ -81,6 +81,15 @@ def _max_restarts() -> int:
     return config.get_int("H2O3_TPU_RECOVERY_MAX_RESTARTS")
 
 
+def _reset_secs() -> float:
+    """``H2O3_TPU_RECOVERY_RESET_SECS``: a supervised job that runs healthy
+    this long since its last relaunch gets its restart budget back
+    (0 = never reset — the pre-ISSUE-17 lifetime budget)."""
+    from h2o3_tpu import config
+
+    return config.get_float("H2O3_TPU_RECOVERY_RESET_SECS")
+
+
 def backoff_delay(attempt: int, key: str = "recovery") -> float:
     """Capped exponential backoff with DETERMINISTIC jitter (same scheme as
     persist.py / client.py: keyed on op+attempt, reproducible run-to-run,
@@ -126,33 +135,95 @@ def is_cloud_failure(exc: BaseException) -> bool:
     return any(sig.lower() in msg for sig in spmd._DEATH_SIGNATURES)
 
 
+def _snapshot_progress(path: str) -> float:
+    """Embedded progress counter of an interval snapshot: trees for GBM/DRF
+    (``ntrees_actual``), epochs for DL (``epochs_trained``), the (lambda
+    index, iteration) position for GLM (``irls_state``), folded into one
+    orderable float. Raises on torn/unreadable/foreign files (the caller
+    skips them); returns -1.0 for readable payloads with no recognizable
+    counter, leaving the mtime tiebreak to decide."""
+    import pickle
+
+    from h2o3_tpu import persist
+
+    blob = persist.read_bytes(path)
+    if blob[: len(persist.FORMAT_MAGIC)] != persist.FORMAT_MAGIC:
+        raise ValueError("not an h2o3_tpu model file")
+    payload = pickle.loads(blob[len(persist.FORMAT_MAGIC):])
+    out = (payload.get("state") or {}).get("output") or {}
+    for k in ("ntrees_actual", "epochs_trained"):
+        if out.get(k) is not None:
+            return float(out[k])
+    st = out.get("irls_state")
+    if isinstance(st, dict):
+        return float(int(st.get("li", 0)) * 1_000_000
+                     + int(st.get("iters", st.get("it", 0))))
+    return -1.0
+
+
 def latest_snapshot(ckdir: str | None, algo: str | None) -> str | None:
-    """Newest PR-2 interval snapshot (``<algo>_ckpt_*``) in ``ckdir``, or
-    None. This is the same file the ``/3/Jobs`` recovery block points at —
-    the supervisor resumes from exactly what the runbook tells an operator
-    to pass as ``checkpoint=``."""
+    """Most-advanced PR-2 interval snapshot (``<algo>_ckpt_*``) in
+    ``ckdir``, or None. This is the same file the ``/3/Jobs`` recovery
+    block points at — the supervisor resumes from exactly what the runbook
+    tells an operator to pass as ``checkpoint=``.
+
+    Picking is by the EMBEDDED progress counter in the checkpoint payload
+    (trees/epochs/IRLS position), with mtime only as tiebreak: clock skew
+    or a restored volume can stamp a stale snapshot newest, and resuming
+    from it would silently retrain finished work. Torn/unreadable files (a
+    crash during a foreign copy, bit rot) are skipped with a warning
+    instead of crashing the resume — the previous intact snapshot wins."""
     if not ckdir or not algo:
         return None
-    files = glob.glob(os.path.join(ckdir, f"{algo}_ckpt_*"))
-    return max(files, key=os.path.getmtime) if files else None
+    best: tuple[tuple[float, float], str] | None = None
+    for f in glob.glob(os.path.join(ckdir, f"{algo}_ckpt_*")):
+        try:
+            key = (_snapshot_progress(f), os.path.getmtime(f))
+        except Exception as e:  # noqa: BLE001 — torn file, not a crash
+            Log.warn(f"recovery: skipping torn/unreadable snapshot {f} "
+                     f"({type(e).__name__}: {e})")
+            continue
+        if best is None or key > best[0]:
+            best = (key, f)
+    return best[1] if best else None
 
 
-def reform(reason: str = "") -> int:
+def reform(reason: str = "",
+           topology: tuple[int, int] | str | None = None) -> int:
     """Re-form the cloud: degraded → recovering → healthy, returning the
     new generation. Ensures the latch is set first (so the transition
     counter and waiting commands observe the degraded epoch even when the
     failure surfaced as an exception without latching), rebuilds the device
     mesh over the currently-live devices, and ``cloud.recover()``s.
 
+    Elastic recovery (ISSUE 17): topology is a RESUMABLE PARAMETER, not an
+    invariant. ``topology=(rows, cols)`` (or ``"RxC"``) re-forms onto that
+    explicit shape — the scale-down/scale-up resume path; ``topology=None``
+    first consumes a pending induced reshape from the chaos harness
+    (``faults.take_reshape`` — the ``reshape:RxC`` fault) and otherwise
+    re-plans from the knob over every live device, exactly the same-shape
+    behavior recovery has always had. Either way the topology epoch ticks,
+    so frames padded for the old shard counts re-derive on next touch and
+    GBM/GLM/DL resume re-shards carried state from the host pytree.
+
     Multi-process clouds: the JAX distributed runtime on current jaxlibs
     cannot re-initialize inside a poisoned process — a REAL member death
-    still requires every rank to restart (the launch.py loop). What reform
-    gives the coordinator is a *survivor island*: a local mesh it can keep
+    still requires every rank to restart (the launch.py loop; the
+    formation manifest in cluster/multihost.py lets the restarted ranks
+    bootstrap into a CHANGED H2O3_TPU_NUM_PROCESSES). What reform gives
+    the coordinator is a *survivor island*: a local mesh it can keep
     serving and resuming checkpointed jobs on while the pod reschedules."""
     from h2o3_tpu.cluster import cloud
     from h2o3_tpu.parallel import mesh as _mesh
-    from h2o3_tpu.utils import flightrec
+    from h2o3_tpu.utils import faults, flightrec
 
+    if topology is None:
+        topology = faults.take_reshape()
+    shape: tuple[int, int] | None = None
+    if topology is not None:
+        shape = (faults._parse_reshape(topology)
+                 if isinstance(topology, str)
+                 else (int(topology[0]), int(topology[1])))
     if cloud.degraded_reason() is None:
         cloud.mark_degraded(reason or "supervised reform")
     # freeze the evidence BEFORE the reform discards it (dedups with the
@@ -160,7 +231,14 @@ def reform(reason: str = "") -> int:
     flightrec.capture_incident(
         reason or "supervised reform", trigger="reform")
     try:
-        _mesh.reform_mesh()
+        m = _mesh.reform_mesh(shape) if shape is not None \
+            else _mesh.reform_mesh()
+        if shape is not None:
+            Log.warn(f"recovery: cloud re-formed onto CHANGED topology "
+                     f"{shape[0]}x{shape[1]} ({m.devices.size} device(s), "
+                     f"epoch {_mesh.mesh_epoch()})")
+            flightrec.record("reform_topology", rows=shape[0],
+                             cols=shape[1], epoch=_mesh.mesh_epoch())
     except Exception as e:  # noqa: BLE001 — a dead backend must not stop the
         # state transition; the next dispatch surfaces the real error
         Log.warn(f"recovery: mesh rebuild failed ({e!r}); proceeding with "
@@ -185,11 +263,24 @@ def run_supervised(launch, *, ckdir: str | None = None, algo: str | None = None,
     attempt = 0
     ckpt: str | None = None
     while True:
+        launched_at = time.monotonic()
         try:
             return launch(ckpt)
         except BaseException as e:  # noqa: BLE001 — classified below
             if not enabled() or not is_cloud_failure(e):
                 raise
+            healthy = time.monotonic() - launched_at
+            reset_secs = _reset_secs()
+            if attempt and reset_secs > 0 and healthy >= reset_secs:
+                # the job ran healthy past the configured window since its
+                # last restart: old restarts no longer predict the next
+                # transient, so the budget resets instead of a days-long
+                # job dying on its 3rd unrelated blip
+                Log.info(
+                    f"recovery: {description} ran healthy {healthy:.0f}s "
+                    f">= H2O3_TPU_RECOVERY_RESET_SECS={reset_secs:.0f} — "
+                    f"restart budget reset (was {attempt})")
+                attempt = 0
             if attempt >= max_restarts:
                 _ATTEMPTS.inc(outcome="exhausted")
                 raise RecoveryExhausted(

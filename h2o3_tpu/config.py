@@ -367,6 +367,25 @@ _KNOBS: dict[str, tuple[str, str]] = {
                "base * 2^attempt (capped at 30 s) plus up to +50% "
                "DETERMINISTIC jitter (keyed on job+attempt, identical "
                "run-to-run)"),
+    "H2O3_TPU_RECOVERY_RESET_SECS": (
+        "300", "supervised-recovery healthy window, seconds: a job that "
+               "runs this long since its last relaunch without a cloud "
+               "failure gets its restart budget back (attempt counter "
+               "resets to 0) — a days-long job that restarted twice early "
+               "on no longer dies on its 3rd unrelated transient. 0 = "
+               "never reset (the lifetime budget of PR 10)"),
+    "H2O3_TPU_FORMATION_MANIFEST": (
+        "", "formation manifest path (cluster/multihost.py): every "
+            "formation() writes the agreed member set + mesh shape here "
+            "(atomic publish), and a RESTARTED rank compares the recorded "
+            "process count against its env — a changed "
+            "H2O3_TPU_NUM_PROCESSES is logged as an ELASTIC TRANSITION "
+            "(scale-down after preemption / scale-up after autoscale) and "
+            "the rank bootstraps into the NEW shape instead of "
+            "crash-looping against the old barrier count; a rank whose "
+            "ordinal fell off the shrunk formation exits cleanly (retired) "
+            "instead of raising. '' = <tmpdir>/h2o3tpu_formation_<uid>."
+            "json; '0' disables the manifest"),
     "H2O3_TPU_AUTOML_STEP_RETRIES": (
         "2", "AutoML poison-step guard: a plan step whose build has already "
              "crashed this many recorded attempts (the step manifest "

@@ -37,6 +37,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
+    grow_policy: str (default 'depthwise')
+    max_leaves: int (default 0)
     calibrate_model: bool (default False)
     calibration_frame: Any (default None)
     calibration_method: str (default 'AUTO')
@@ -80,6 +82,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
+        grow_policy='depthwise',
+        max_leaves=0,
         calibrate_model=False,
         calibration_frame=None,
         calibration_method='AUTO',
@@ -118,6 +122,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
+            grow_policy=grow_policy,
+            max_leaves=max_leaves,
             calibrate_model=calibrate_model,
             calibration_frame=calibration_frame,
             calibration_method=calibration_method,
@@ -156,6 +162,8 @@ class H2OGradientBoostingEstimator(_EstimatorBase):
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
+            'grow_policy': 'depthwise',
+            'max_leaves': 0,
             'calibrate_model': False,
             'calibration_frame': None,
             'calibration_method': 'AUTO',
@@ -202,6 +210,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
+    grow_policy: str (default 'depthwise')
+    max_leaves: int (default 0)
     calibrate_model: bool (default False)
     calibration_frame: Any (default None)
     calibration_method: str (default 'AUTO')
@@ -217,7 +227,6 @@ class H2OXGBoostEstimator(_EstimatorBase):
     reg_lambda: float (default 1.0)
     reg_alpha: float (default 0.0)
     tree_method: str (default 'auto')
-    grow_policy: str (default 'depthwise')
     booster: str (default 'gbtree')
     scale_pos_weight: float (default 1.0)
     dmatrix_type: str (default 'auto')
@@ -252,6 +261,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
+        grow_policy='depthwise',
+        max_leaves=0,
         calibrate_model=False,
         calibration_frame=None,
         calibration_method='AUTO',
@@ -267,7 +278,6 @@ class H2OXGBoostEstimator(_EstimatorBase):
         reg_lambda=1.0,
         reg_alpha=0.0,
         tree_method='auto',
-        grow_policy='depthwise',
         booster='gbtree',
         scale_pos_weight=1.0,
         dmatrix_type='auto',
@@ -297,6 +307,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
+            grow_policy=grow_policy,
+            max_leaves=max_leaves,
             calibrate_model=calibrate_model,
             calibration_frame=calibration_frame,
             calibration_method=calibration_method,
@@ -312,7 +324,6 @@ class H2OXGBoostEstimator(_EstimatorBase):
             reg_lambda=reg_lambda,
             reg_alpha=reg_alpha,
             tree_method=tree_method,
-            grow_policy=grow_policy,
             booster=booster,
             scale_pos_weight=scale_pos_weight,
             dmatrix_type=dmatrix_type,
@@ -342,6 +353,8 @@ class H2OXGBoostEstimator(_EstimatorBase):
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
+            'grow_policy': 'depthwise',
+            'max_leaves': 0,
             'calibrate_model': False,
             'calibration_frame': None,
             'calibration_method': 'AUTO',
@@ -357,7 +370,6 @@ class H2OXGBoostEstimator(_EstimatorBase):
             'reg_lambda': 1.0,
             'reg_alpha': 0.0,
             'tree_method': 'auto',
-            'grow_policy': 'depthwise',
             'booster': 'gbtree',
             'scale_pos_weight': 1.0,
             'dmatrix_type': 'auto',
@@ -395,6 +407,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
     sample_rate: float (default 0.632)
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
+    grow_policy: str (default 'depthwise')
+    max_leaves: int (default 0)
     calibrate_model: bool (default False)
     calibration_frame: Any (default None)
     calibration_method: str (default 'AUTO')
@@ -431,6 +445,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
         sample_rate=0.632,
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
+        grow_policy='depthwise',
+        max_leaves=0,
         calibrate_model=False,
         calibration_frame=None,
         calibration_method='AUTO',
@@ -462,6 +478,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
+            grow_policy=grow_policy,
+            max_leaves=max_leaves,
             calibrate_model=calibrate_model,
             calibration_frame=calibration_frame,
             calibration_method=calibration_method,
@@ -493,6 +511,8 @@ class H2ORandomForestEstimator(_EstimatorBase):
             'sample_rate': 0.632,
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
+            'grow_policy': 'depthwise',
+            'max_leaves': 0,
             'calibrate_model': False,
             'calibration_frame': None,
             'calibration_method': 'AUTO',
@@ -532,6 +552,8 @@ class H2OXRTEstimator(_EstimatorBase):
     sample_rate: float (default 0.632)
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
+    grow_policy: str (default 'depthwise')
+    max_leaves: int (default 0)
     calibrate_model: bool (default False)
     calibration_frame: Any (default None)
     calibration_method: str (default 'AUTO')
@@ -568,6 +590,8 @@ class H2OXRTEstimator(_EstimatorBase):
         sample_rate=0.632,
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
+        grow_policy='depthwise',
+        max_leaves=0,
         calibrate_model=False,
         calibration_frame=None,
         calibration_method='AUTO',
@@ -599,6 +623,8 @@ class H2OXRTEstimator(_EstimatorBase):
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
+            grow_policy=grow_policy,
+            max_leaves=max_leaves,
             calibrate_model=calibrate_model,
             calibration_frame=calibration_frame,
             calibration_method=calibration_method,
@@ -630,6 +656,8 @@ class H2OXRTEstimator(_EstimatorBase):
             'sample_rate': 0.632,
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
+            'grow_policy': 'depthwise',
+            'max_leaves': 0,
             'calibrate_model': False,
             'calibration_frame': None,
             'calibration_method': 'AUTO',
@@ -1844,6 +1872,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
+    grow_policy: str (default 'depthwise')
+    max_leaves: int (default 0)
     calibrate_model: bool (default False)
     calibration_frame: Any (default None)
     calibration_method: str (default 'AUTO')
@@ -1881,6 +1911,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
+        grow_policy='depthwise',
+        max_leaves=0,
         calibrate_model=False,
         calibration_frame=None,
         calibration_method='AUTO',
@@ -1913,6 +1945,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
+            grow_policy=grow_policy,
+            max_leaves=max_leaves,
             calibrate_model=calibrate_model,
             calibration_frame=calibration_frame,
             calibration_method=calibration_method,
@@ -1945,6 +1979,8 @@ class H2OAdaBoostEstimator(_EstimatorBase):
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
+            'grow_policy': 'depthwise',
+            'max_leaves': 0,
             'calibrate_model': False,
             'calibration_frame': None,
             'calibration_method': 'AUTO',
@@ -1985,6 +2021,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
     sample_rate: float (default 1.0)
     col_sample_rate_per_tree: float (default 1.0)
     score_tree_interval: int (default 5)
+    grow_policy: str (default 'depthwise')
+    max_leaves: int (default 0)
     calibrate_model: bool (default False)
     calibration_frame: Any (default None)
     calibration_method: str (default 'AUTO')
@@ -2019,6 +2057,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
         sample_rate=1.0,
         col_sample_rate_per_tree=1.0,
         score_tree_interval=5,
+        grow_policy='depthwise',
+        max_leaves=0,
         calibrate_model=False,
         calibration_frame=None,
         calibration_method='AUTO',
@@ -2048,6 +2088,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
             sample_rate=sample_rate,
             col_sample_rate_per_tree=col_sample_rate_per_tree,
             score_tree_interval=score_tree_interval,
+            grow_policy=grow_policy,
+            max_leaves=max_leaves,
             calibrate_model=calibrate_model,
             calibration_frame=calibration_frame,
             calibration_method=calibration_method,
@@ -2077,6 +2119,8 @@ class H2ODecisionTreeEstimator(_EstimatorBase):
             'sample_rate': 1.0,
             'col_sample_rate_per_tree': 1.0,
             'score_tree_interval': 5,
+            'grow_policy': 'depthwise',
+            'max_leaves': 0,
             'calibrate_model': False,
             'calibration_frame': None,
             'calibration_method': 'AUTO',

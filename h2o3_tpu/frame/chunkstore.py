@@ -144,8 +144,12 @@ class ChunkStore:
 
     def __init__(self, npad: int, bytes_per_row: float, *,
                  window: int | None = None, prefetch: int | None = None):
-        from h2o3_tpu.parallel.mesh import stream_block_rows
+        from h2o3_tpu.parallel.mesh import mesh_epoch, stream_block_rows
 
+        # block geometry (block_rows, n_blocks) bakes the mesh's shard
+        # count in — a store planned under a dead topology must never serve
+        # blocks onto the re-formed one (ISSUE 17); fetch() checks this
+        self._epoch = mesh_epoch()
         self.npad = int(npad)
         self.window = window_bytes() if window is None else int(window)
         self.depth = prefetch_depth() if prefetch is None else int(prefetch)
@@ -235,7 +239,14 @@ class ChunkStore:
         """Device arrays for block ``bi``'s named lanes, through the LRU
         window (misses upload from the host tier; the window evicts
         least-recently-used unpinned chunks past the budget)."""
-        from h2o3_tpu.parallel.mesh import shard_rows
+        from h2o3_tpu.parallel.mesh import mesh_epoch, shard_rows
+
+        if self._epoch != mesh_epoch():
+            raise RuntimeError(
+                "ChunkStore was planned under topology epoch "
+                f"{self._epoch} but the mesh re-formed (epoch "
+                f"{mesh_epoch()}); re-plan the store — resumed streamed "
+                "builds re-derive block geometry from the new shard counts")
 
         lo, hi = self.span(bi)
         out = {}
@@ -367,6 +378,29 @@ def host_block_frame(frame, names: Iterable[str], lo: int, hi: int):
                 nrow=nrow_blk)
         )
     return Frame(vecs, list(names), register=False)
+
+
+def reshard_host_mirrors(frame) -> int:
+    """Elastic recovery (ISSUE 17): force every column of ``frame`` onto
+    the CURRENT topology — host mirrors re-pad to the new shard counts (NA
+    fill beyond ``nrow``, real rows copied exactly) and stale device
+    placements drop so ``Vec.data`` rebuilds on the re-formed mesh. The
+    per-Vec work is the same lazy ``_maybe_reshard`` the ``data``/
+    ``host_values`` properties run on next touch; this helper is the eager
+    form the resume path (and the elastic drill) calls so sharded/streamed
+    ingest state survives the reshape at a known point instead of
+    mid-dispatch. Returns the number of columns re-sharded."""
+    from h2o3_tpu.frame.frame import STR
+
+    n = 0
+    for name in frame.names:
+        v = frame.vec(name)
+        if v.kind == STR or getattr(v, "_epoch", None) is None:
+            continue
+        before = v._epoch
+        v._maybe_reshard()
+        n += int(v._epoch != before)
+    return n
 
 
 def release_frame_features(frame, names: Iterable[str]) -> int:

@@ -74,10 +74,13 @@ class Vec:
 
     # class-level defaults so the Vec flavors that skip __init__ (LazyVec,
     # WrappedCatVec — frame/lazy.py) inherit working tier methods with
-    # accounting as a no-op
+    # accounting as a no-op. _epoch None = "unmanaged": elastic re-sharding
+    # (ISSUE 17) only applies to Vecs that recorded the topology epoch they
+    # were padded under.
     _hostbuf: np.ndarray | None = None
     _acct: dict | None = None
     _data = None
+    _epoch: int | None = None
 
     def __init__(
         self,
@@ -96,6 +99,9 @@ class Vec:
         self._acct = {"hbm": 0.0, "host": 0.0}
         self._hostbuf: np.ndarray | None = None
         self._data = None
+        from h2o3_tpu.parallel.mesh import mesh_epoch
+
+        self._epoch = mesh_epoch()
         weakref.finalize(self, _vec_gc, self._acct)
         if kind == STR:
             self._host = np.asarray(data, dtype=object)
@@ -118,10 +124,52 @@ class Vec:
         self._acct[tier] += delta
         _cs.account(tier, delta)
 
+    def _maybe_reshard(self) -> None:
+        """Elastic recovery (ISSUE 17): when the topology epoch moved past
+        the one this Vec was padded under (``mesh.reform_mesh`` on a changed
+        rows×cols shape), re-derive the padded width from the NEW shard
+        counts and re-shard — real rows copied exactly, pad rows refilled
+        with the NA sentinel, the device array rebuilt lazily on the new
+        mesh. Same-shape reforms re-place the identical bits (a device
+        round trip), so non-elastic recovery stays bit-for-bit."""
+        from h2o3_tpu.parallel import mesh as _m
+
+        if self._epoch is None or self._epoch == _m.mesh_epoch():
+            return
+        if self.kind == STR:
+            self._epoch = _m.mesh_epoch()
+            return
+        if self._hostbuf is None and self._data is not None:
+            import jax
+
+            if not getattr(self._data, "is_fully_addressable", True):
+                # a cross-process array of the DEAD formation cannot be
+                # pulled rank-locally; the restarted rank re-ingests — keep
+                # the stale placement and let the resume path replace it
+                return
+            self._hostbuf = np.ascontiguousarray(jax.device_get(self._data))
+            self._acct_add("host", self._hostbuf.nbytes)
+        if self._hostbuf is not None:
+            from h2o3_tpu.parallel.mesh import pad_to_shards
+
+            npad_new = pad_to_shards(self.nrow)
+            if self._hostbuf.shape[0] != npad_new:
+                old = self._hostbuf
+                dt, fill = Vec.device_dtype(self.kind, self.domain)
+                buf = np.full((npad_new,) + old.shape[1:], fill,
+                              dtype=old.dtype)
+                buf[: self.nrow] = old[: self.nrow]
+                self._acct_add("host", buf.nbytes - old.nbytes)
+                self._hostbuf = buf
+        if self._data is not None:
+            self.data = None  # stale-mesh placement; rebuilt lazily
+        self._epoch = _m.mesh_epoch()
+
     @property
     def data(self):
         """Padded, sharded device array; rebuilt lazily from the host mirror
         after :meth:`release_device` (bit-identical values)."""
+        self._maybe_reshard()
         if self._data is None and self._hostbuf is not None:
             from h2o3_tpu.parallel.mesh import shard_rows
 
@@ -144,6 +192,7 @@ class Vec:
         device array (a plain device_get)."""
         if self.kind == STR:
             return self._host
+        self._maybe_reshard()
         if self._hostbuf is None:
             from h2o3_tpu.parallel.mesh import pull_to_host
 
@@ -215,6 +264,7 @@ class Vec:
     # -- basics --------------------------------------------------------------
     @property
     def npad(self) -> int:
+        self._maybe_reshard()
         if self._data is not None:
             return self._data.shape[0]
         if self._hostbuf is not None:  # device-released: don't re-upload

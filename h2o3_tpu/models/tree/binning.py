@@ -247,6 +247,8 @@ def bin_frame(spec: BinSpec, frame: Frame):
     """
     from h2o3_tpu.models.datainfo import _adapt_codes
 
+    from h2o3_tpu.parallel.mesh import mesh_epoch
+
     cache = None
     fp = None
     if _u8_cache_enabled():
@@ -254,7 +256,12 @@ def bin_frame(spec: BinSpec, frame: Frame):
         cache = frame.__dict__.setdefault("_bin_cache", {})
         hit = cache.get(fp)
         if hit is not None:
-            return hit
+            epoch, B = hit
+            if epoch == mesh_epoch():
+                return B
+            # cached codes were padded/placed for a dead topology (elastic
+            # reform, ISSUE 17): drop and rebin on the new mesh
+            cache.pop(fp, None)
 
     datas = []
     for ci, name in enumerate(spec.names):
@@ -295,7 +302,7 @@ def bin_frame(spec: BinSpec, frame: Frame):
 
     _HIST_HBM_BYTES.inc(5.0 * B.shape[0] * B.shape[1], path="rebin")
     if cache is not None:
-        cache[fp] = B
+        cache[fp] = (mesh_epoch(), B)
     return B
 
 

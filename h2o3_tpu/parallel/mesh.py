@@ -65,6 +65,20 @@ else:  # jax 0.4.x: experimental module, kwarg named check_rep
 
 _mesh: Mesh | None = None
 
+# Monotonic topology generation (ISSUE 17, elastic recovery): bumped ONLY by
+# :func:`reform_mesh` — the supervised-recovery reshape point. Vec device
+# arrays and host mirrors record the epoch they were padded/placed under and
+# lazily re-pad + re-shard when it moves (frame/frame.py); ChunkStores refuse
+# to serve blocks planned under a dead topology (frame/chunkstore.py).
+# ``set_mesh`` deliberately does NOT bump it: tests swap sub-meshes and
+# manage their frames' placement themselves — that contract stays bit-exact.
+_mesh_epoch: int = 0
+
+
+def mesh_epoch() -> int:
+    """The current topology generation (see ``_mesh_epoch``)."""
+    return _mesh_epoch
+
 
 def set_mesh(mesh: Mesh | None) -> None:
     global _mesh
@@ -174,16 +188,72 @@ def n_row_groups(mesh: Mesh | None = None) -> int:
     return m.shape[ROWS_AXIS] if is_2d(m) else 1
 
 
-def reform_mesh() -> Mesh:
+def plan_mesh(n_devices: int, n_hosts: int = 1) -> tuple[int, int]:
+    """Re-plan the rows×cols factorization for a (possibly changed)
+    formation of ``n_devices`` devices over ``n_hosts`` hosts — the elastic
+    half of :func:`_mesh_rows_knob`. ``H2O3_TPU_MESH_ROWS=auto`` resolves
+    against the NEW formation (rows = devices per host when the formation
+    spans >1 host), not the boot-time one; an explicit integer is honored
+    when it divides the new device count and falls back to 1-D with a
+    warning otherwise; ''/'0'/'1' stays 1-D. Returns ``(rows, cols)`` with
+    ``rows == 1`` meaning the legacy 1-D ``("rows",)`` mesh."""
+    from h2o3_tpu import config
+    from h2o3_tpu.utils.log import Log
+
+    n_devices = int(n_devices)
+    v = config.get("H2O3_TPU_MESH_ROWS").strip().lower()
+    if v in ("", "0", "1", "false"):
+        return 1, n_devices
+    if v == "auto":
+        if n_hosts <= 1:
+            return 1, n_devices
+        r = max(n_devices // max(n_hosts, 1), 1)
+    else:
+        r = int(v)
+    if r <= 1:
+        return 1, n_devices
+    if n_devices % r != 0:
+        Log.warn(
+            f"H2O3_TPU_MESH_ROWS={v} does not divide the re-planned "
+            f"{n_devices}-device formation; using the 1-D rows mesh")
+        return 1, n_devices
+    return r, n_devices // r
+
+
+def reform_mesh(shape: tuple[int, int] | None = None) -> Mesh:
     """Drop the cached mesh and rebuild over the devices that are live NOW —
     the supervised-recovery reform step (cluster/recovery.py). The new Mesh
     is a distinct object, so every program cache keyed through
     :func:`mesh_key` (which includes ``id(mesh)``) misses and retraces
     against the re-formed topology instead of replaying a program compiled
-    for the dead one."""
-    global _mesh
-    _mesh = None
-    return get_mesh()
+    for the dead one.
+
+    Elastic recovery (ISSUE 17): ``shape=(rows, cols)`` re-forms onto an
+    EXPLICIT topology over the first ``rows*cols`` live devices — ``rows ==
+    1`` builds the legacy 1-D ``("rows",)`` mesh, ``rows > 1`` the 2-D pod
+    mesh — which is how a job resumes on fewer (or more) devices than it
+    started with. ``shape=None`` keeps the same-topology behavior: re-plan
+    from the knob over every live device. Either way the topology epoch
+    (:func:`mesh_epoch`) ticks, so Vec placements and host mirrors padded
+    for the old shard counts re-derive lazily on next touch."""
+    global _mesh, _mesh_epoch
+    _mesh_epoch += 1
+    if shape is None:
+        _mesh = None
+        return get_mesh()
+    rows, cols = int(shape[0]), int(shape[1])
+    if rows < 1 or cols < 1:
+        raise ValueError(f"reform_mesh: bad shape {shape!r}")
+    devices = np.array(jax.devices())
+    if rows * cols > devices.size:
+        raise ValueError(
+            f"reform_mesh: shape {rows}x{cols} needs {rows * cols} devices "
+            f"but only {devices.size} are live")
+    if rows > 1:
+        _mesh = make_mesh_2d(rows, cols, devices)
+    else:
+        _mesh = Mesh(devices[:cols], (ROWS_AXIS,))
+    return _mesh
 
 
 def n_shards() -> int:

@@ -42,10 +42,14 @@ Arming is explicit (context manager / ``configure``) or via the
 ``H2O3_TPU_FAULTS`` env knob (config.py), spec ``;``-separated:
 ``site=N`` fails the first N IO calls, ``site@K`` aborts at iteration K,
 ``death:site`` raises a synthetic death error at the site, ``die:site``
-raises one at a collective-boundary site, ``blackout:SECS`` fails all
-persist IO for a SECS window, ``stall:site:SECS`` sleeps once,
-``slow:site:SECS`` sleeps every call. When nothing is armed every check is
-a single module-bool test — hot paths pay ~nothing.
+raises one at a collective-boundary site, ``reshape:RxC`` induces a
+one-shot TOPOLOGY CHANGE at the next collective boundary (the death error
+fires and the RxC target parks for ``recovery.reform`` to consume via
+:func:`take_reshape` — the elastic-recovery chaos primitive, ISSUE 17),
+``blackout:SECS`` fails all persist IO for a SECS window,
+``stall:site:SECS`` sleeps once, ``slow:site:SECS`` sleeps every call.
+When nothing is armed every check is a single module-bool test — hot paths
+pay ~nothing.
 
 Determinism contract: counters are keyed by site and incremented in call
 order, so a seeded single-threaded run injects at exactly the same point
@@ -88,20 +92,41 @@ _blackout_until: float | None = None  # persist outage window end (monotonic)
 _stall: dict[str, float] = {}   # site -> one-shot sleep seconds (wedge)
 _slow: dict[str, float] = {}    # site -> per-call sleep seconds (slowdown)
 _counts: dict[str, int] = {}    # site -> observed check calls (tests assert)
+# elastic-recovery chaos (ISSUE 17): an induced TOPOLOGY CHANGE at the next
+# collective boundary. _reshape is the armed (rows, cols) target; when the
+# one-shot fires (die_check, any site) it moves to _reshape_pending, where
+# recovery.reform() consumes it via take_reshape() and re-forms the mesh
+# onto that shape — the in-process stand-in for "the autoscaler gave the
+# job back a different pod".
+_reshape: tuple[int, int] | None = None
+_reshape_pending: tuple[int, int] | None = None
 
 _DEATH_MSG = ("injected fault: coordination service reports peer task is "
               "unhealthy (heartbeat timeout)")
 
 
+def _parse_reshape(val: str) -> tuple[int, int]:
+    """'RxC' (or 'R×C') -> (rows, cols); rows=1 means the 1-D mesh."""
+    m = val.strip().lower().replace("×", "x").split("x")
+    if len(m) != 2:
+        raise ValueError(f"bad reshape spec {val!r} (want RxC, e.g. 2x4)")
+    r, c = int(m[0]), int(m[1])
+    if r < 1 or c < 1:
+        raise ValueError(f"bad reshape spec {val!r} (rows/cols must be >=1)")
+    return r, c
+
+
 def _parse_spec(spec: str) -> None:
     """Arm from an ``H2O3_TPU_FAULTS`` spec string (see module docstring)."""
-    global _armed, _blackout_until
+    global _armed, _blackout_until, _reshape
     for part in spec.split(";"):
         part = part.strip()
         if not part:
             continue
         if part.startswith("death:"):
             _death.add(part[len("death:"):])
+        elif part.startswith("reshape:"):
+            _reshape = _parse_reshape(part[len("reshape:"):])
         elif part.startswith("die:"):
             _die.add(part[len("die:"):])
         elif part.startswith("blackout:"):
@@ -125,10 +150,10 @@ def _parse_spec(spec: str) -> None:
         else:
             raise ValueError(
                 f"bad H2O3_TPU_FAULTS entry {part!r} (want site=N, site@K, "
-                "death:site, die:site, blackout:SECS, stall:site:SECS or "
-                "slow:site:SECS)")
+                "death:site, die:site, reshape:RxC, blackout:SECS, "
+                "stall:site:SECS or slow:site:SECS)")
     _armed = bool(_fail or _abort or _death or _die or _blackout_until
-                  or _stall or _slow)
+                  or _stall or _slow or _reshape)
 
 
 def configure(fail: dict[str, int] | None = None,
@@ -137,9 +162,10 @@ def configure(fail: dict[str, int] | None = None,
               die: set[str] | frozenset[str] | None = None,
               blackout: float | None = None,
               stall: dict[str, float] | None = None,
-              slow: dict[str, float] | None = None) -> None:
+              slow: dict[str, float] | None = None,
+              reshape: tuple[int, int] | str | None = None) -> None:
     """Arm the harness programmatically (additive to whatever is armed)."""
-    global _armed, _blackout_until
+    global _armed, _blackout_until, _reshape
     with _lock:
         _fail.update(fail or {})
         _abort.update(abort or {})
@@ -151,8 +177,11 @@ def configure(fail: dict[str, int] | None = None,
             _blackout_until = time.monotonic() + float(blackout)
         _stall.update(stall or {})
         _slow.update(slow or {})
+        if reshape is not None:
+            _reshape = (_parse_reshape(reshape) if isinstance(reshape, str)
+                        else (int(reshape[0]), int(reshape[1])))
         _armed = bool(_fail or _abort or _death or _die or _blackout_until
-                      or _stall or _slow)
+                      or _stall or _slow or _reshape)
 
 
 def armed() -> bool:
@@ -165,7 +194,7 @@ def armed() -> bool:
 
 def reset() -> None:
     """Disarm everything and clear counters (re-reads the env knob)."""
-    global _armed, _blackout_until
+    global _armed, _blackout_until, _reshape, _reshape_pending
     with _lock:
         _fail.clear()
         _abort.clear()
@@ -175,6 +204,8 @@ def reset() -> None:
         _stall.clear()
         _slow.clear()
         _counts.clear()
+        _reshape = None
+        _reshape_pending = None
         _armed = False
         from h2o3_tpu import config
 
@@ -190,10 +221,11 @@ def inject(fail: dict[str, int] | None = None,
            die: set[str] | frozenset[str] | None = None,
            blackout: float | None = None,
            stall: dict[str, float] | None = None,
-           slow: dict[str, float] | None = None):
+           slow: dict[str, float] | None = None,
+           reshape: tuple[int, int] | str | None = None):
     """Scoped arming for tests: arms on entry, fully resets on exit."""
     configure(fail=fail, abort=abort, death=death, die=die,
-              blackout=blackout, stall=stall, slow=slow)
+              blackout=blackout, stall=stall, slow=slow, reshape=reshape)
     try:
         yield
     finally:
@@ -313,9 +345,23 @@ def die_check(site: str) -> None:
     checkpoint export, so the snapshot on disk is exactly what a real death
     would leave — and the spmd command broadcast). The supervised-recovery
     chaos drills arm this to prove detection → reform → resume end-to-end."""
+    global _reshape, _reshape_pending
     if not _armed:
         return
     with _lock:
+        if _reshape is not None:
+            # induced topology change (ISSUE 17): the formation "comes back
+            # different" at this collective boundary — one-shot; the target
+            # shape parks in the pending slot until recovery.reform()
+            # consumes it via take_reshape()
+            shape, _reshape = _reshape, None
+            _reshape_pending = shape
+            _counts[site] = _counts.get(site, 0) + 1
+            raise make_death_error(
+                f"injected fault: topology changed at collective boundary "
+                f"{site!r} — formation re-plans to {shape[0]}x{shape[1]} "
+                "(coordination service reports peer task is unhealthy; "
+                "heartbeat timeout)")
         if site not in _die:
             return
         _die.discard(site)
@@ -324,6 +370,15 @@ def die_check(site: str) -> None:
         f"injected fault: worker died at collective boundary {site!r} "
         "(coordination service reports peer task is unhealthy; "
         "heartbeat timeout)")
+
+
+def take_reshape() -> tuple[int, int] | None:
+    """Consume (and clear) the pending induced-reshape target, if any —
+    called by ``recovery.reform`` so the resume lands on the new shape."""
+    global _reshape_pending
+    with _lock:
+        shape, _reshape_pending = _reshape_pending, None
+    return shape
 
 
 # env-armed at import so `H2O3_TPU_FAULTS=... pytest` / launch.py work

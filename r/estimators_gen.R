@@ -46,6 +46,8 @@ h2o.gbm <- function(
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
     score_tree_interval = 5,
+    grow_policy = "depthwise",
+    max_leaves = 0,
     calibrate_model = FALSE,
     calibration_frame = NULL,
     calibration_method = "AUTO",
@@ -83,6 +85,8 @@ h2o.gbm <- function(
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
   if (!missing(score_tree_interval)) p$score_tree_interval <- score_tree_interval
+  if (!missing(grow_policy)) p$grow_policy <- grow_policy
+  if (!missing(max_leaves)) p$max_leaves <- max_leaves
   if (!missing(calibrate_model)) p$calibrate_model <- calibrate_model
   if (!missing(calibration_frame)) p$calibration_frame <- calibration_frame
   if (!missing(calibration_method)) p$calibration_method <- calibration_method
@@ -126,6 +130,8 @@ h2o.xgboost <- function(
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
     score_tree_interval = 5,
+    grow_policy = "depthwise",
+    max_leaves = 0,
     calibrate_model = FALSE,
     calibration_frame = NULL,
     calibration_method = "AUTO",
@@ -141,7 +147,6 @@ h2o.xgboost <- function(
     reg_lambda = 1.0,
     reg_alpha = 0.0,
     tree_method = "auto",
-    grow_policy = "depthwise",
     booster = "gbtree",
     scale_pos_weight = 1.0,
     dmatrix_type = "auto"
@@ -170,6 +175,8 @@ h2o.xgboost <- function(
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
   if (!missing(score_tree_interval)) p$score_tree_interval <- score_tree_interval
+  if (!missing(grow_policy)) p$grow_policy <- grow_policy
+  if (!missing(max_leaves)) p$max_leaves <- max_leaves
   if (!missing(calibrate_model)) p$calibrate_model <- calibrate_model
   if (!missing(calibration_frame)) p$calibration_frame <- calibration_frame
   if (!missing(calibration_method)) p$calibration_method <- calibration_method
@@ -185,7 +192,6 @@ h2o.xgboost <- function(
   if (!missing(reg_lambda)) p$reg_lambda <- reg_lambda
   if (!missing(reg_alpha)) p$reg_alpha <- reg_alpha
   if (!missing(tree_method)) p$tree_method <- tree_method
-  if (!missing(grow_policy)) p$grow_policy <- grow_policy
   if (!missing(booster)) p$booster <- booster
   if (!missing(scale_pos_weight)) p$scale_pos_weight <- scale_pos_weight
   if (!missing(dmatrix_type)) p$dmatrix_type <- dmatrix_type
@@ -220,6 +226,8 @@ h2o.randomForest <- function(
     sample_rate = 0.632,
     col_sample_rate_per_tree = 1.0,
     score_tree_interval = 5,
+    grow_policy = "depthwise",
+    max_leaves = 0,
     calibrate_model = FALSE,
     calibration_frame = NULL,
     calibration_method = "AUTO",
@@ -250,6 +258,8 @@ h2o.randomForest <- function(
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
   if (!missing(score_tree_interval)) p$score_tree_interval <- score_tree_interval
+  if (!missing(grow_policy)) p$grow_policy <- grow_policy
+  if (!missing(max_leaves)) p$max_leaves <- max_leaves
   if (!missing(calibrate_model)) p$calibrate_model <- calibrate_model
   if (!missing(calibration_frame)) p$calibration_frame <- calibration_frame
   if (!missing(calibration_method)) p$calibration_method <- calibration_method
@@ -286,6 +296,8 @@ h2o.xrt <- function(
     sample_rate = 0.632,
     col_sample_rate_per_tree = 1.0,
     score_tree_interval = 5,
+    grow_policy = "depthwise",
+    max_leaves = 0,
     calibrate_model = FALSE,
     calibration_frame = NULL,
     calibration_method = "AUTO",
@@ -316,6 +328,8 @@ h2o.xrt <- function(
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
   if (!missing(score_tree_interval)) p$score_tree_interval <- score_tree_interval
+  if (!missing(grow_policy)) p$grow_policy <- grow_policy
+  if (!missing(max_leaves)) p$max_leaves <- max_leaves
   if (!missing(calibrate_model)) p$calibrate_model <- calibrate_model
   if (!missing(calibration_frame)) p$calibration_frame <- calibration_frame
   if (!missing(calibration_method)) p$calibration_method <- calibration_method
@@ -912,6 +926,8 @@ h2o.adaBoost <- function(
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
     score_tree_interval = 5,
+    grow_policy = "depthwise",
+    max_leaves = 0,
     calibrate_model = FALSE,
     calibration_frame = NULL,
     calibration_method = "AUTO",
@@ -943,6 +959,8 @@ h2o.adaBoost <- function(
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
   if (!missing(score_tree_interval)) p$score_tree_interval <- score_tree_interval
+  if (!missing(grow_policy)) p$grow_policy <- grow_policy
+  if (!missing(max_leaves)) p$max_leaves <- max_leaves
   if (!missing(calibrate_model)) p$calibrate_model <- calibrate_model
   if (!missing(calibration_frame)) p$calibration_frame <- calibration_frame
   if (!missing(calibration_method)) p$calibration_method <- calibration_method
@@ -980,6 +998,8 @@ h2o.decision_tree <- function(
     sample_rate = 1.0,
     col_sample_rate_per_tree = 1.0,
     score_tree_interval = 5,
+    grow_policy = "depthwise",
+    max_leaves = 0,
     calibrate_model = FALSE,
     calibration_frame = NULL,
     calibration_method = "AUTO"
@@ -1008,6 +1028,8 @@ h2o.decision_tree <- function(
   if (!missing(sample_rate)) p$sample_rate <- sample_rate
   if (!missing(col_sample_rate_per_tree)) p$col_sample_rate_per_tree <- col_sample_rate_per_tree
   if (!missing(score_tree_interval)) p$score_tree_interval <- score_tree_interval
+  if (!missing(grow_policy)) p$grow_policy <- grow_policy
+  if (!missing(max_leaves)) p$max_leaves <- max_leaves
   if (!missing(calibrate_model)) p$calibrate_model <- calibrate_model
   if (!missing(calibration_frame)) p$calibration_frame <- calibration_frame
   if (!missing(calibration_method)) p$calibration_method <- calibration_method

@@ -1,0 +1,437 @@
+"""Elastic recovery chaos suite (ISSUE 17): topology is a RESUMABLE
+parameter, not an invariant. A checkpointed job killed mid-train by an
+induced topology change (``reshape:RxC``) must resume its snapshot on the
+NEW mesh shape and land within the PR-2 1e-6 resume pin of the
+uninterrupted run — while ``H2O3_TPU_RECOVERY=0`` and same-shape resume
+keep today's semantics bit-for-bit. The measured-artifact version of the
+full shape-change matrix lives in ``tools/recovery_drill.py --elastic``."""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu import persist
+from h2o3_tpu.cluster import cloud, multihost, recovery
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM, GLM
+from h2o3_tpu.parallel import mesh
+from h2o3_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fast_recovery(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY", "1")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_BACKOFF", "0.01")
+    cloud.clear_degraded()
+    yield
+    faults.reset()
+    cloud.clear_degraded()
+    # every test leaves the default mesh behind for the rest of the suite
+    if dict(mesh.get_mesh().shape).get("rows") != 8:
+        mesh.reform_mesh((1, 8))
+
+
+def _df(n=1500, seed=3):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "c": rng.choice(["x", "y", "z"], n),
+    })
+    eta = df["a"] * 1.5 + (df["c"] == "x") * 2 - df["b"]
+    df["y"] = np.where(eta + rng.normal(size=n) > 0, "p", "n")
+    return df
+
+
+# ---------------------------------------------------------------------------
+# mesh re-planning and the topology epoch
+
+
+def test_plan_mesh_knob_matrix(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_MESH_ROWS", "")
+    assert mesh.plan_mesh(8) == (1, 8)
+    assert mesh.plan_mesh(4) == (1, 4)
+    monkeypatch.setenv("H2O3_TPU_MESH_ROWS", "2")
+    assert mesh.plan_mesh(8) == (2, 4)
+    assert mesh.plan_mesh(4) == (2, 2)
+    # a rows knob that no longer divides the shrunken formation falls back
+    # to 1-D instead of refusing to re-form
+    assert mesh.plan_mesh(5) == (1, 5)
+    monkeypatch.setenv("H2O3_TPU_MESH_ROWS", "auto")
+    assert mesh.plan_mesh(8, n_hosts=1) == (1, 8)
+    assert mesh.plan_mesh(8, n_hosts=2) == (4, 2)
+    assert mesh.plan_mesh(8, n_hosts=4) == (2, 4)
+
+
+def test_reform_mesh_explicit_shape_ticks_epoch():
+    e0 = mesh.mesh_epoch()
+    m = mesh.reform_mesh((2, 4))
+    assert mesh.mesh_epoch() == e0 + 1
+    assert dict(m.shape) == {"rows": 2, "cols": 4}
+    m = mesh.reform_mesh((1, 4))
+    assert mesh.mesh_epoch() == e0 + 2
+    assert dict(m.shape) == {"rows": 4}
+    assert mesh.n_shards() == 4
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        mesh.reform_mesh((2, 8))
+    with pytest.raises(ValueError, match="bad shape"):
+        mesh.reform_mesh((0, 4))
+    m = mesh.reform_mesh((1, 8))
+    assert dict(m.shape) == {"rows": 8}
+
+
+def test_set_mesh_never_ticks_epoch():
+    """Tests (and the 2-D A/B lane) swap sub-meshes with set_mesh and manage
+    their own frames — that must NOT invalidate every Vec placement."""
+    e0 = mesh.mesh_epoch()
+    m = mesh.get_mesh()
+    mesh.set_mesh(m)
+    assert mesh.mesh_epoch() == e0
+
+
+def test_vec_reshards_host_mirror_across_epochs():
+    fr = Frame.from_pandas(_df(900, seed=21))
+    before = {n: fr.vec(n).to_numpy().copy() for n in fr.names}
+    npad8 = fr.npad
+    mesh.reform_mesh((1, 4))
+    # lazily re-derived on next touch: new padded width, identical values
+    assert fr.npad == mesh.pad_to_shards(fr.nrow)
+    for n in fr.names:
+        np.testing.assert_array_equal(fr.vec(n).to_numpy(), before[n])
+    assert fr.vec("a").data.shape[0] == fr.npad
+    mesh.reform_mesh((2, 4))
+    for n in fr.names:
+        np.testing.assert_array_equal(fr.vec(n).to_numpy(), before[n])
+    mesh.reform_mesh((1, 8))
+    assert fr.npad == npad8
+    np.testing.assert_array_equal(fr.vec("a").to_numpy(), before["a"])
+
+
+def test_reshard_host_mirrors_eager_helper():
+    from h2o3_tpu.frame.chunkstore import reshard_host_mirrors
+
+    fr = Frame.from_pandas(_df(600, seed=31))
+    assert reshard_host_mirrors(fr) == 0  # same epoch: nothing to do
+    mesh.reform_mesh((1, 4))
+    assert reshard_host_mirrors(fr) == len(fr.names)
+    assert reshard_host_mirrors(fr) == 0  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# the reshape:RxC chaos primitive
+
+
+def test_reshape_spec_parsing():
+    assert faults._parse_reshape("2x4") == (2, 4)
+    assert faults._parse_reshape("1X8") == (1, 8)
+    assert faults._parse_reshape("4×2") == (4, 2)  # unicode ×
+    with pytest.raises(ValueError, match="bad reshape spec"):
+        faults._parse_reshape("8")
+    with pytest.raises(ValueError, match="rows/cols"):
+        faults._parse_reshape("0x4")
+
+
+def test_reshape_fault_fires_once_and_parks_for_reform():
+    with faults.inject(reshape="1x4"):
+        with pytest.raises(faults.XlaRuntimeError, match="topology changed"):
+            faults.die_check("gbm")
+        faults.die_check("gbm")  # one-shot: the second boundary passes
+        assert faults.take_reshape() == (1, 4)
+        assert faults.take_reshape() is None  # consumed
+    assert faults.take_reshape() is None  # reset clears the pending slot
+
+
+def test_env_spec_arms_reshape(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_FAULTS", "reshape:2x4")
+    faults.reset()  # re-reads the env knob
+    assert faults.armed()
+    with pytest.raises(faults.XlaRuntimeError):
+        faults.die_check("bcast")
+    assert faults.take_reshape() == (2, 4)
+    monkeypatch.delenv("H2O3_TPU_FAULTS")
+    faults.reset()
+
+
+def test_reform_consumes_pending_reshape():
+    e0 = mesh.mesh_epoch()
+    g0 = cloud.generation()
+    faults.configure(reshape=(1, 4))
+    with pytest.raises(faults.XlaRuntimeError):
+        faults.die_check("glm")
+    recovery.reform("elastic unit test")
+    assert dict(mesh.get_mesh().shape) == {"rows": 4}
+    assert mesh.mesh_epoch() == e0 + 1
+    assert cloud.generation() == g0 + 1
+    assert cloud.degraded_reason() is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: kill mid-train, resume on a CHANGED topology (the fast CI
+# version of tools/recovery_drill.py --elastic)
+
+
+def test_gbm_elastic_resume_8_to_4(tmp_path):
+    fr = Frame.from_pandas(_df())
+    kw = dict(ntrees=8, max_depth=3, seed=11, learn_rate=0.2,
+              score_tree_interval=2)
+    full = GBM(**kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "elastic_gbm")
+
+    def _launch(ckpt):
+        kw2 = dict(kw, export_checkpoints_dir=ckdir)
+        if ckpt:
+            kw2["checkpoint"] = ckpt
+        return GBM(**kw2).train(y="y", training_frame=fr)
+
+    e0 = mesh.mesh_epoch()
+    with faults.inject(reshape=(1, 4)):
+        healed = recovery.run_supervised(_launch, ckdir=ckdir, algo="gbm",
+                                         description="elastic gbm 8->4")
+    # the resume landed on the SHRUNKEN formation, not the boot-time one
+    assert dict(mesh.get_mesh().shape) == {"rows": 4}
+    assert mesh.mesh_epoch() == e0 + 1
+    assert cloud.degraded_reason() is None
+    assert healed.output["ntrees_actual"] == 8
+    np.testing.assert_allclose(
+        healed.training_metrics.logloss, full.training_metrics.logloss,
+        atol=1e-6)
+    pa = full.predict(fr).vec("p").to_numpy()
+    pb = healed.predict(fr).vec("p").to_numpy()
+    np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+
+def test_glm_elastic_resume_1d_to_2d(tmp_path):
+    fr = Frame.from_pandas(_df(seed=5))
+    kw = dict(family="binomial", max_iterations=20, seed=1)
+    full = GLM(**kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "elastic_glm")
+
+    def _launch(ckpt):
+        kw2 = dict(kw, export_checkpoints_dir=ckdir)
+        if ckpt:
+            kw2["checkpoint"] = ckpt
+        return GLM(**kw2).train(y="y", training_frame=fr)
+
+    with faults.inject(reshape=(2, 4)):
+        healed = recovery.run_supervised(_launch, ckdir=ckdir, algo="glm",
+                                         description="elastic glm 1d->2d")
+    assert dict(mesh.get_mesh().shape) == {"rows": 2, "cols": 4}
+    np.testing.assert_allclose(
+        healed.training_metrics.logloss, full.training_metrics.logloss,
+        atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(healed.output["beta_std"]),
+        np.asarray(full.output["beta_std"]), atol=1e-5)
+
+
+def test_same_shape_resume_stays_bitexact(tmp_path):
+    """A reform that does NOT change the shape (today's worker-death path)
+    keeps the PR-10 contract bit-for-bit: the epoch ticks and every Vec
+    makes a host round trip, which must be an identity."""
+    fr = Frame.from_pandas(_df(seed=5))
+    kw = dict(family="binomial", max_iterations=20, seed=1)
+    full = GLM(**kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "same_shape")
+
+    def _launch(ckpt):
+        kw2 = dict(kw, export_checkpoints_dir=ckdir)
+        if ckpt:
+            kw2["checkpoint"] = ckpt
+        return GLM(**kw2).train(y="y", training_frame=fr)
+
+    with faults.inject(die={"glm"}):
+        healed = recovery.run_supervised(_launch, ckdir=ckdir, algo="glm",
+                                         description="same-shape glm")
+    assert dict(mesh.get_mesh().shape) == {"rows": 8}
+    np.testing.assert_array_equal(
+        np.asarray(healed.output["beta_std"]),
+        np.asarray(full.output["beta_std"]))
+
+
+def test_recovery_disabled_reshape_failstops(monkeypatch):
+    """H2O3_TPU_RECOVERY=0: the induced topology change surfaces as today's
+    fail-stop — no reform, no epoch tick, the mesh stays what it was."""
+    monkeypatch.setenv("H2O3_TPU_RECOVERY", "0")
+    e0 = mesh.mesh_epoch()
+    g0 = cloud.generation()
+
+    def _launch(ckpt):
+        faults.die_check("gbm")
+
+    with faults.inject(reshape=(1, 4)):
+        with pytest.raises(faults.XlaRuntimeError, match="topology changed"):
+            recovery.run_supervised(_launch, description="disabled elastic")
+    assert mesh.mesh_epoch() == e0
+    assert cloud.generation() == g0
+    assert dict(mesh.get_mesh().shape) == {"rows": 8}
+
+
+# ---------------------------------------------------------------------------
+# latest_snapshot: counter preference, mtime tiebreak, torn-file skip
+
+
+def _fake_ckpt(path, output):
+    payload = {"cls_module": "h2o3_tpu.models.model_base",
+               "cls_name": "Model", "algo": "gbm",
+               "state": {"output": output}}
+    with open(path, "wb") as f:
+        f.write(persist.FORMAT_MAGIC + pickle.dumps(payload))
+
+
+def test_latest_snapshot_prefers_progress_counter(tmp_path):
+    d = str(tmp_path)
+    a = os.path.join(d, "gbm_ckpt_aaa")
+    b = os.path.join(d, "gbm_ckpt_bbb")
+    _fake_ckpt(a, {"ntrees_actual": 6})
+    _fake_ckpt(b, {"ntrees_actual": 2})
+    # clock skew stamps the STALE snapshot newest — the embedded counter,
+    # not mtime, must decide
+    now = time.time()
+    os.utime(a, (now - 600, now - 600))
+    os.utime(b, (now, now))
+    assert recovery.latest_snapshot(d, "gbm") == a
+    # equal counters: mtime is the tiebreak
+    _fake_ckpt(b, {"ntrees_actual": 6})
+    os.utime(b, (now, now))
+    assert recovery.latest_snapshot(d, "gbm") == b
+
+
+def test_latest_snapshot_irls_position_orders_glm(tmp_path):
+    d = str(tmp_path)
+    a = os.path.join(d, "glm_ckpt_aaa")
+    b = os.path.join(d, "glm_ckpt_bbb")
+    _fake_ckpt(a, {"irls_state": {"li": 0, "iters": 9}})
+    _fake_ckpt(b, {"irls_state": {"li": 1, "iters": 2}})
+    now = time.time()
+    os.utime(a, (now, now))            # newest mtime...
+    os.utime(b, (now - 600, now - 600))
+    # ...but lambda index 1 is FURTHER along the path than li 0 iter 9
+    assert recovery.latest_snapshot(d, "glm") == b
+
+
+def test_latest_snapshot_skips_torn_files(tmp_path):
+    d = str(tmp_path)
+    good = os.path.join(d, "gbm_ckpt_good")
+    _fake_ckpt(good, {"ntrees_actual": 4})
+    torn = os.path.join(d, "gbm_ckpt_torn")
+    blob = persist.FORMAT_MAGIC + pickle.dumps({"state": {}})
+    with open(torn, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # crash mid-write
+    foreign = os.path.join(d, "gbm_ckpt_foreign")
+    with open(foreign, "wb") as f:
+        f.write(b"not a model at all")
+    now = time.time()
+    os.utime(good, (now - 600, now - 600))
+    os.utime(torn, (now, now))
+    os.utime(foreign, (now, now))
+    assert recovery.latest_snapshot(d, "gbm") == good
+    assert recovery.latest_snapshot(None, "gbm") is None
+    assert recovery.latest_snapshot(d, None) is None
+
+
+# ---------------------------------------------------------------------------
+# restart-budget reset after a healthy window (SATELLITE 2)
+
+
+def test_restart_budget_resets_after_healthy_window(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_MAX_RESTARTS", "1")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_RESET_SECS", "0.2")
+    calls = []
+
+    def _launch(ckpt):
+        calls.append(ckpt)
+        if len(calls) == 2:
+            time.sleep(0.3)  # ran healthy PAST the reset window
+        if len(calls) < 3:
+            raise faults.make_death_error()
+        return "done"
+
+    # without the reset, a 1-restart budget dies on the second failure;
+    # the healthy window between them gives the budget back
+    assert recovery.run_supervised(_launch, description="reset drill") == "done"
+    assert len(calls) == 3
+
+
+def test_restart_budget_reset_disabled_by_zero(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_MAX_RESTARTS", "1")
+    monkeypatch.setenv("H2O3_TPU_RECOVERY_RESET_SECS", "0")
+    calls = []
+
+    def _launch(ckpt):
+        calls.append(ckpt)
+        if len(calls) == 2:
+            time.sleep(0.3)
+        raise faults.make_death_error()
+
+    with pytest.raises(recovery.RecoveryExhausted):
+        recovery.run_supervised(_launch, description="lifetime budget")
+    assert len(calls) == 2  # 1 + 1 restart, no reset
+
+
+# ---------------------------------------------------------------------------
+# formation manifest (cluster/multihost.py)
+
+
+def test_formation_manifest_roundtrip(tmp_path, monkeypatch):
+    path = str(tmp_path / "formation.json")
+    monkeypatch.setenv("H2O3_TPU_FORMATION_MANIFEST", path)
+    assert multihost.read_manifest() is None  # missing: no opinion
+    rec = {"processes": 2, "mesh": {"rows": 8}, "cloud_size": 16}
+    multihost.write_manifest(rec)
+    assert multihost.read_manifest() == rec
+    # torn manifest: no opinion, never a crash
+    with open(path, "w") as f:
+        f.write('{"processes": 2, "mesh"')
+    assert multihost.read_manifest() is None
+
+
+def test_formation_manifest_disabled(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_FORMATION_MANIFEST", "0")
+    assert multihost._manifest_path() is None
+    multihost.write_manifest({"processes": 1})  # no-op, no crash
+    assert multihost.read_manifest() is None
+    monkeypatch.setenv("H2O3_TPU_FORMATION_MANIFEST", "")
+    assert multihost._manifest_path()  # default: per-uid tempdir path
+
+
+def test_retired_rank_exits_clean(tmp_path, monkeypatch):
+    """A restarted pod scales 4 -> 2: ranks 2 and 3 come back up with stale
+    launch env, observe the manifest, and exit 0 instead of crash-looping
+    against a formation that no longer includes them."""
+    path = str(tmp_path / "formation.json")
+    monkeypatch.setenv("H2O3_TPU_FORMATION_MANIFEST", path)
+    multihost.write_manifest({"processes": 4, "mesh": {"rows": 8}})
+    monkeypatch.setenv("H2O3_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("H2O3_TPU_PROCESS_ID", "3")
+    monkeypatch.setenv("H2O3_TPU_COORDINATOR", "127.0.0.1:7777")
+    with pytest.raises(SystemExit) as ei:
+        multihost.pod_env()
+    assert ei.value.code == 0
+    # a rank that was NEVER part of the formation is still a config error
+    multihost.write_manifest({"processes": 2, "mesh": {"rows": 8}})
+    with pytest.raises(ValueError, match="out of range"):
+        multihost.pod_env()
+
+
+# ---------------------------------------------------------------------------
+# ChunkStore epoch guard: block geometry bakes the shard count in
+
+
+def test_chunkstore_refuses_stale_epoch():
+    from h2o3_tpu.frame import chunkstore as cs
+
+    store = cs.ChunkStore(1024, 16, window=4096, prefetch=1)
+    store.add_empty("x", (1024, 4), np.float32)
+    store.fetch(0, ("x",))  # same epoch: fine
+    mesh.reform_mesh((1, 4))
+    with pytest.raises(RuntimeError, match="topology epoch"):
+        store.fetch(0, ("x",))

@@ -340,6 +340,49 @@ def test_rest_recover_route(monkeypatch):
     assert cloud.degraded_reason() is not None
 
 
+def test_crash_during_checkpoint_write_falls_back(tmp_path):
+    """A run that dies WHILE writing its interval snapshot leaves a
+    truncated ``<algo>_ckpt_*`` — latest_snapshot must skip the torn file
+    (with a warning, not a crash) and the supervisor falls back to the
+    previous intact snapshot, still resuming 1e-6-clean."""
+    fr = Frame.from_pandas(_df())
+    kw = dict(ntrees=8, max_depth=3, seed=11, learn_rate=0.2,
+              score_tree_interval=2)
+    full = GBM(**kw).train(y="y", training_frame=fr)
+
+    ckdir = str(tmp_path / "torn_ck")
+    # first attempt dies at tree 4 with an intact 4-tree snapshot...
+    with faults.inject(abort={"gbm": 4}):
+        with pytest.raises(faults.TrainAbort):
+            GBM(export_checkpoints_dir=ckdir, **kw).train(
+                y="y", training_frame=fr)
+    snap4 = recovery.latest_snapshot(ckdir, "gbm")
+    assert snap4 is not None
+    # ...then the crash-during-write: a NEWER but truncated snapshot file
+    with open(snap4, "rb") as f:
+        blob = f.read()
+    torn = os.path.join(ckdir, "gbm_ckpt_torn")
+    with open(torn, "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    now = time.time()
+    os.utime(torn, (now + 60, now + 60))
+    assert recovery.latest_snapshot(ckdir, "gbm") == snap4  # torn skipped
+
+    def _launch(ckpt):
+        kw2 = dict(kw, export_checkpoints_dir=ckdir)
+        if ckpt:
+            kw2["checkpoint"] = ckpt
+        return GBM(**kw2).train(y="y", training_frame=fr)
+
+    with faults.inject(die={"gbm"}):
+        healed = recovery.run_supervised(_launch, ckdir=ckdir, algo="gbm",
+                                         description="torn-ckpt gbm")
+    assert healed.output["ntrees_actual"] == 8
+    np.testing.assert_allclose(
+        healed.training_metrics.logloss, full.training_metrics.logloss,
+        atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # H2O3_TPU_RECOVERY=0 restores today's fail-stop semantics bit-for-bit
 

@@ -458,6 +458,67 @@ def _fleet_ok(here: str, now: float):
         return False
 
 
+def _elastic_drill_ok(here: str, now: float):
+    """Sanity-check the newest recent ELASTIC_DRILL_*.json
+    (tools/recovery_drill.py --elastic, the ISSUE-17 topology-chaos drill).
+    Returns None when no recent artifact exists (no opinion), else
+    True/False. Checks the elastic acceptance pins: every shape transition
+    in the matrix completed with the 1e-6 final-metric parity, the resumes
+    actually re-formed the cloud (generations ticked), and the
+    recovery_seconds measurement is present."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "ELASTIC_DRILL_*.json")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            d = json.load(f)  # indented JSON (same format as RECOVERY_DRILL)
+        if not d.get("ok"):
+            print(f"{name}: ok flag not set")
+            return False
+        results = d.get("results") or []
+        if len(results) < 3:
+            print(f"{name}: only {len(results)} transitions drilled "
+                  "(want the full shape-change matrix)")
+            return False
+        algos = {r.get("algo") for r in results}
+        if not {"gbm", "glm", "deeplearning"} <= algos:
+            print(f"{name}: matrix missing algos (have {sorted(algos)})")
+            return False
+        for r in results:
+            label = f"{r.get('algo')} {r.get('from')}->{r.get('to')}"
+            if not (0 <= float(r.get("logloss_delta", 1)) <= 1e-6):
+                print(f"{name}: {label} parity pin violated "
+                      f"(logloss_delta={r.get('logloss_delta')})")
+                return False
+            if r.get("recovery_seconds") is None:
+                print(f"{name}: {label} has no recovery_seconds")
+                return False
+        if not (d.get("generations_ticked") or 0) >= len(results):
+            print(f"{name}: generations_ticked="
+                  f"{d.get('generations_ticked')} < {len(results)} resumes "
+                  "— the drill never actually re-formed")
+            return False
+        if d.get("recovery_seconds") is None:
+            print(f"{name}: no headline recovery_seconds")
+            return False
+        print(f"{name}: {len(results)} transitions, parity<=1e-6, "
+              f"generations={d.get('generations_ticked')} "
+              f"recovery_seconds={d.get('recovery_seconds'):.2f} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def main() -> int:
     import time
 
@@ -499,6 +560,11 @@ def main() -> int:
     # knob-off controls or the window stands
     w2 = _wave2_ab_ok(here, now)
     if w2 is False:
+        return 1
+    # elastic-recovery gate (ISSUE 17): a recent --elastic drill artifact
+    # must satisfy the shape-change parity pins or the window stands
+    el = _elastic_drill_ok(here, now)
+    if el is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

@@ -333,3 +333,15 @@ PYEOF
 timeout 2400 python tools/recovery_drill.py \
   --out "POD_RECOVERY_${stamp}.json" > /dev/null
 save "POD_RECOVERY_${stamp}.json" "Pod preemption drill: member death -> restart loop -> supervised resume (recovery_seconds)"
+
+# elastic scale-down drill (ISSUE 17): kill mid-GBM/GLM/DL with a
+# reshape:RxC fault so the v5e-16 formation "comes back" smaller /
+# re-factored, and prove the checkpointed job resumes on the CHANGED
+# topology (16->8 scale-down, 2-D re-factorization) within the 1e-6
+# parity pin. On real hardware the headline is recovery_seconds across a
+# shape change: reform + full retrace for the new mesh + re-shard of the
+# carried state (the CPU-proxy ELASTIC_DRILL artifact is committed
+# alongside the PR; tools/latest_bench_ok.py gates on its pins).
+timeout 2400 python tools/recovery_drill.py --elastic \
+  --out "ELASTIC_DRILL_${stamp}.json" > /dev/null
+save "ELASTIC_DRILL_${stamp}.json" "Elastic drill: kill mid-train, resume on a changed topology (shape matrix + recovery_seconds)"
