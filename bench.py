@@ -1089,6 +1089,19 @@ def _devmem_block() -> dict:
     return out
 
 
+def _ledger_block() -> dict:
+    """Per-job resource ledgers accumulated in this phase subprocess
+    (utils/jobacct.py): device-seconds + dispatch counts by site,
+    collective bytes by lane, frame-window bytes, queue waits — keyed by
+    job id. The artifact twin of the ``/3/Jobs`` ledger embed; every
+    phase's training runs as a Job, so this shows which job spent the
+    phase's device time. latest_bench_ok pins the totals as finite and
+    bounded by the phase wall."""
+    from h2o3_tpu.utils import jobacct
+
+    return jobacct.all_jobs()
+
+
 def _child_main(phase: str) -> None:
     """Run one phase in this (fresh) process; print its JSON dict."""
     try:
@@ -1112,6 +1125,12 @@ def _child_main(phase: str) -> None:
         if isinstance(out, dict):
             try:
                 out["devmem"] = _devmem_block()
+            except Exception:  # noqa: BLE001 — diagnostics never sink a phase
+                pass
+            try:
+                led = _ledger_block()
+                if led:
+                    out["jobs"] = led
             except Exception:  # noqa: BLE001 — diagnostics never sink a phase
                 pass
     except Exception as e:

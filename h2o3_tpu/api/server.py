@@ -36,6 +36,13 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.utils import metrics as _metrics
 from h2o3_tpu.utils.log import Log
 
+import itertools as _itertools
+
+# per-request trace ids minted at ingress (when the client sends no
+# X-Request-Id): "rest-<n>" — the attribution key ring events and ledger
+# entries produced by the handler carry, echoed back as X-H2O3-Trace
+_REQ_IDS = _itertools.count(1)
+
 # per-route REST telemetry (labels use the route PATTERN, not the raw path —
 # bounded cardinality whatever clients request)
 _REST_REQUESTS = _metrics.counter(
@@ -803,7 +810,12 @@ class Endpoints:
     def metrics_get(self, params):
         """``GET /3/Metrics`` — the whole registry. Default is Prometheus
         text exposition (scrape-ready); ``?format=json`` returns the same
-        families as structured JSON."""
+        families as structured JSON. ``?scope=pod`` federates every rank's
+        registry into one view (counters sum, histograms merge, gauges keep
+        per-rank series under a ``rank`` label) — on a multi-process cloud
+        the snapshot gather is a collective, dispatched as the replicated
+        ``metrics_pod`` command, so it serializes behind running device
+        work like any other command."""
         # materialize lazily-imported subsystems' metric families so a scrape
         # right after boot still covers persist/cloud/mrtask (families
         # register at module import; routes import these modules lazily)
@@ -812,7 +824,19 @@ class Endpoints:
         from h2o3_tpu.cluster import cloud  # noqa: F401
         from h2o3_tpu.parallel import mrtask  # noqa: F401
 
-        if str(params.get("format", "")).lower() == "json":
+        as_json = str(params.get("format", "")).lower() == "json"
+        if str(params.get("scope", "")).lower() == "pod":
+            from h2o3_tpu.cluster import federation, spmd
+
+            merged = (spmd.run("metrics_pod") if spmd.multi_process()
+                      else federation.pod_snapshot())
+            if as_json:
+                return {"__meta": {"schema_type": "Metrics"},
+                        "scope": "pod", "families": merged}
+            return {"__binary__": _metrics.render_snapshot(merged).encode(),
+                    "content_type":
+                        "text/plain; version=0.0.4; charset=utf-8"}
+        if as_json:
             return {"__meta": {"schema_type": "Metrics"},
                     "families": _metrics.REGISTRY.snapshot()}
         return {"__binary__": _metrics.REGISTRY.to_prometheus().encode(),
@@ -831,13 +855,20 @@ class Endpoints:
         (utils/flightrec.py) plus the devmem attribution snapshot and the
         last incident-bundle path: the live half of what an incident
         bundle freezes. ``n`` bounds the returned events (default 512),
-        ``kind`` filters (dispatch_start/dispatch_end/chunk_fetch/...)."""
+        ``kind`` filters (dispatch_start/dispatch_end/chunk_fetch/...).
+        ``?format=trace`` instead renders the ring's span trees as
+        Chrome/Perfetto trace JSON (one lane per trace id; ``?trace=``
+        narrows to one job/request trace) — save it and open in
+        https://ui.perfetto.dev or chrome://tracing."""
         from h2o3_tpu.utils import devmem, flightrec
 
         try:
             n = int(params.get("n", 512))
         except (TypeError, ValueError):
             raise ApiError(400, "n must be an integer")
+        if str(params.get("format", "")).lower() == "trace":
+            return flightrec.trace_export(
+                trace=params.get("trace") or None, n=max(n, 0) or None)
         kind = params.get("kind") or None
         return {
             "__meta": {"schema_type": "FlightRecorder"},
@@ -1605,7 +1636,10 @@ def _get_model(key):
 
 
 def _job_schema(j: Job) -> dict:
+    from h2o3_tpu.utils import jobacct as _jobacct
+
     span_summary = _metrics.trace_summary(j.key)
+    ledger = _jobacct.snapshot(j.key)
     return {
         "key": {"name": j.key},
         "description": j.description,
@@ -1622,6 +1656,11 @@ def _job_schema(j: Job) -> dict:
         # client reads it to budget its own polling
         **({"deadline": j.soft_deadline} if j.soft_deadline else {}),
         **({"span_summary": span_summary} if span_summary else {}),
+        # the per-job resource ledger (utils/jobacct.py): device-seconds,
+        # dispatch counts by site, collective bytes by lane, window bytes
+        # and queue waits attributed to THIS job's trace — the budget
+        # signal a fleet scheduler reads off /3/Jobs
+        **({"ledger": ledger} if ledger else {}),
         "dest": {"name": getattr(getattr(j, "result", None), "key", "")} if j.result is not None else None,
         # crash-recovery pointer (latest interval checkpoint) — present when
         # the build ran with export_checkpoints_dir, so a FAILED job tells
@@ -1921,7 +1960,22 @@ class _Handler(BaseHTTPRequestHandler):
                     faults.slow_check("rest")  # chaos: slow-handler injection
                     params = self._params()
                     args = [urllib.parse.unquote(g) for g in match.groups()]
-                    out = handler(params, *args)
+                    # every request runs under its own trace id (client-
+                    # supplied X-Request-Id wins, for cross-system
+                    # correlation): ring events and ledger entries produced
+                    # by the handler — a scorer dispatch, a batcher queue
+                    # wait — attribute to THIS request, and the id is echoed
+                    # back as X-H2O3-Trace so the caller can pull its span
+                    # tree from /3/FlightRecorder?format=trace. Jobs
+                    # launched by the handler shadow it with their own
+                    # job-key trace (metrics.trace kind rules).
+                    rid = (self.headers.get("X-Request-Id")
+                           or f"rest-{next(_REQ_IDS)}")[:120]
+                    self._trace_id = rid
+                    with _metrics.trace(rid, kind="request"), _metrics.span(
+                        "rest.request", route=route or "/", method=method
+                    ):
+                        out = handler(params, *args)
                     # the idempotency outcome publishes BEFORE the response
                     # bytes leave: the moment the client sees the reply it
                     # may retry with the same key, and a retry racing a
@@ -1980,6 +2034,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if getattr(self, "_trace_id", None):
+            self.send_header("X-H2O3-Trace", self._trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -2019,6 +2075,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "Content-Disposition", f'attachment; filename="{out["filename"]}"'
             )
         self.send_header("Content-Length", str(len(data)))
+        if getattr(self, "_trace_id", None):
+            self.send_header("X-H2O3-Trace", self._trace_id)
         self.end_headers()
         self.wfile.write(data)
 

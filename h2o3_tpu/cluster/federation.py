@@ -1,0 +1,108 @@
+"""Pod-federated metrics — merge every rank's registry into one view
+(the ISSUE-18 tentpole, piece c).
+
+Each process keeps its own :data:`metrics.REGISTRY` (like H2O's per-node
+logs), so on a multi-host pod `GET /3/Metrics` only ever showed the
+coordinator's counters — a follower's dispatch seconds, HBM ledger and
+collective bytes were invisible unless you could shell into the rank.
+This module gathers per-rank snapshots with the same collective machinery
+every other cross-rank exchange uses (length-prefix + pow2-padded
+``process_allgather``, bounding the number of distinct gather programs at
+O(log max_payload)) and merges them:
+
+- **counters** sum across ranks per label-set (pod-total work);
+- **histograms** merge bucket-by-bucket (cumulative counts, sums and
+  counts all add — sum of cumulative prefixes is the cumulative prefix of
+  the sum);
+- **gauges** keep per-rank series under an added ``rank`` label (summing
+  a gauge like ``hbm_owned_bytes`` across ranks would fabricate a device
+  no rank has).
+
+The gather is a collective: every rank must enter it in lockstep, so the
+REST path dispatches it as the replicated ``metrics_pod`` spmd command
+(single-process clouds merge the local snapshot directly as rank 0 — same
+shape out, no collective, no command-lock wait).
+"""
+
+from __future__ import annotations
+
+import json
+
+from h2o3_tpu.utils import metrics as _mx
+
+
+def _gather_bytes(payload: bytes) -> list[bytes]:
+    """Allgather one byte string per rank (collective: every process must
+    call this together). Returns the payloads in rank order."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils as mh
+
+    n = len(payload)
+    lens = np.asarray(mh.process_allgather(np.array([n], np.int32)))
+    lens = lens.reshape(-1)
+    cap = 1 << max(10, (int(lens.max()) - 1).bit_length())
+    buf = np.zeros(cap, np.uint8)
+    buf[:n] = np.frombuffer(payload, np.uint8)
+    data = np.asarray(mh.process_allgather(buf)).reshape(
+        jax.process_count(), cap)
+    return [bytes(data[r, : int(lens[r])]) for r in range(data.shape[0])]
+
+
+def merge(snaps: dict[int, dict]) -> dict:
+    """Merge per-rank ``REGISTRY.snapshot()`` dicts into one snapshot-shaped
+    dict (render with :func:`metrics.render_snapshot` or serve as JSON).
+
+    ``snaps`` maps rank → snapshot. Counters/untyped sum per label-set,
+    histograms merge buckets/sum/count per label-set, gauges gain a
+    ``rank`` label so each rank's series survives side by side."""
+    out: dict = {}
+    agg_by_name: dict[str, dict] = {}
+    for rank in sorted(snaps):
+        for name, fam in snaps[rank].items():
+            kind = fam.get("type", "untyped")
+            if name not in out:
+                out[name] = {"type": kind, "help": fam.get("help", ""),
+                             "values": []}
+                agg_by_name[name] = {}
+            agg = agg_by_name[name]
+            for val in fam.get("values", ()):
+                labels = dict(val.get("labels", {}))
+                if kind == "gauge":
+                    labels["rank"] = str(rank)
+                key = tuple(sorted(labels.items()))
+                cur = agg.get(key)
+                if "buckets" in val:
+                    if cur is None:
+                        agg[key] = {"labels": labels,
+                                    "buckets": dict(val["buckets"]),
+                                    "sum": float(val["sum"]),
+                                    "count": int(val["count"])}
+                    else:
+                        for le, c in val["buckets"].items():
+                            cur["buckets"][le] = cur["buckets"].get(le, 0) + c
+                        cur["sum"] += float(val["sum"])
+                        cur["count"] += int(val["count"])
+                elif cur is None:
+                    agg[key] = {"labels": labels,
+                                "value": float(val["value"])}
+                else:
+                    cur["value"] += float(val["value"])
+    for name, fam in out.items():
+        agg = agg_by_name[name]
+        fam["values"] = [agg[k] for k in sorted(agg)]
+    return out
+
+
+def pod_snapshot() -> dict:
+    """Merged pod-wide snapshot. COLLECTIVE on multi-process clouds — every
+    rank must call this in lockstep, which is why the REST layer reaches it
+    through ``spmd.run("metrics_pod")``. Single-process: merges the local
+    snapshot as rank 0 directly (same output shape, no collective)."""
+    from h2o3_tpu.cluster import spmd
+
+    local = _mx.REGISTRY.snapshot()
+    if not spmd.multi_process():
+        return merge({0: local})
+    payloads = _gather_bytes(json.dumps(local).encode())
+    return merge({r: json.loads(p.decode()) for r, p in enumerate(payloads)})

@@ -202,6 +202,9 @@ class Job:
         return int((end - self.start_time) * 1000)
 
     def to_dict(self) -> dict:
+        from h2o3_tpu.utils import jobacct
+
+        ledger = jobacct.snapshot(self.key)
         return {
             "key": self.key,
             "description": self.description,
@@ -213,6 +216,10 @@ class Job:
             "started_at": self.start_time,
             "duration_ms": self.duration_ms,
             "span_summary": metrics.trace_summary(self.key),
+            # the per-job resource ledger (utils/jobacct.py): device-seconds,
+            # dispatch counts, collective/window bytes attributed to THIS
+            # job's trace — the budget signal the fleet scheduler reads
+            **({"ledger": ledger} if ledger else {}),
             **({"recovery": self.recovery} if self.recovery else {}),
             **({"restarts": self.restarts} if self.restarts else {}),
         }

@@ -614,6 +614,14 @@ def _exec_model_load(dir: str):
     return load_model(dir)
 
 
+def _exec_metrics_pod():
+    from h2o3_tpu.cluster import federation
+
+    # the snapshot allgather inside is the collective — every rank enters
+    # it through this command, in lockstep with the rest of the stream
+    return federation.pod_snapshot()
+
+
 _COMMANDS = {
     "parse": _exec_parse,
     "build": _exec_build,
@@ -629,6 +637,7 @@ _COMMANDS = {
     "frame_export": _exec_frame_export,
     "model_save": _exec_model_save,
     "model_load": _exec_model_load,
+    "metrics_pod": _exec_metrics_pod,
     "remove": _exec_remove,
 }
 

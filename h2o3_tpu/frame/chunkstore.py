@@ -52,6 +52,7 @@ import numpy as np
 
 from h2o3_tpu.utils import devmem as _dm
 from h2o3_tpu.utils import flightrec as _fr
+from h2o3_tpu.utils import jobacct as _jobacct
 from h2o3_tpu.utils import metrics as _mx
 
 RESIDENT_BYTES = _mx.gauge(
@@ -265,6 +266,10 @@ class ChunkStore:
                 self._dev[key] = arr
                 self._hbm += arr.nbytes
                 account("hbm", arr.nbytes, owner="frame_window")
+                # the plane ledger above knows "frame_window" spent it;
+                # the job ledger charges the trace this fetch ran under
+                _jobacct.on_window_bytes(_mx.current_trace(),
+                                         int(arr.nbytes))
                 self.peak_hbm = max(self.peak_hbm, self._hbm)
                 _fr.record("chunk_fetch", lane=name, block=bi,
                            bytes=int(arr.nbytes))

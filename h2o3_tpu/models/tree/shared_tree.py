@@ -52,6 +52,7 @@ _NEG = -1e30
 # (always=True: the accounting is a test contract, not optional telemetry,
 # so H2O3_TPU_METRICS=0 does not switch it off).
 
+from h2o3_tpu.utils import jobacct as _jobacct
 from h2o3_tpu.utils import metrics as _metrics
 
 _BUILD_COUNTERS = {
@@ -211,6 +212,10 @@ def _run_counted(fn, args, mult: int = 1, sat_from=None):
         else:
             _COLL_BYTES.inc(b * m, phase=ph)
             _COLL_BYTES.inc(b * m, phase=ph, lane=lane)
+            # per-job attribution: the replayed tally charges the job whose
+            # trace this dispatch ran under (utils/jobacct.py), lane-split
+            _jobacct.on_collective_bytes(
+                _metrics.current_trace(), b * m, lane=lane)
     return out
 
 

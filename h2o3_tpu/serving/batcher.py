@@ -58,6 +58,9 @@ from h2o3_tpu.serving import (
     SHED,
     ShedError,
 )
+from h2o3_tpu.utils import flightrec as _fr
+from h2o3_tpu.utils import jobacct as _jobacct
+from h2o3_tpu.utils import metrics as _mx
 from h2o3_tpu.utils.log import Log
 
 _DEGRADE_POLL_S = 0.05  # waiter latch-poll cadence (the "shed budget")
@@ -227,7 +230,8 @@ class _Breaker:
 
 
 class _Pending:
-    __slots__ = ("cols", "n", "deadline", "t0", "event", "result", "error")
+    __slots__ = ("cols", "n", "deadline", "t0", "event", "result", "error",
+                 "trace", "parent")
 
     def __init__(self, cols, n, deadline):
         self.cols = cols
@@ -237,6 +241,11 @@ class _Pending:
         self.event = threading.Event()
         self.result = None
         self.error: Exception | None = None
+        # the submitter's trace context, carried across the queue: the
+        # dispatcher thread runs in no trace, so the request's span tree is
+        # stitched from these at dispatch time (queue_wait ring events)
+        self.trace = _mx.current_trace()
+        self.parent = _mx.current_span()
 
 
 def _knobs():
@@ -441,10 +450,28 @@ class ModelBatcher:
                     for name in names
                 }
                 total = sum(p.n for p in live)
+                # span-tree stitching (ISSUE 18): the coalesced dispatch is
+                # ONE span shared by every member request, so it cannot live
+                # in any single request's trace. Each request instead gets a
+                # queue_wait span in its OWN trace (submit → here), carrying
+                # batch_span as the cross-reference to the shared dispatch;
+                # the batch span id is pushed around score_table so the
+                # serving_batch dispatch (and its page-in) parent under it.
+                bspan = _mx.next_span_id()
+                t_disp = time.monotonic()
+                for p in live:
+                    wait_s = t_disp - p.t0
+                    _fr.record("queue_wait", trace=p.trace, parent=p.parent,
+                               span=_mx.next_span_id(), batch_span=bspan,
+                               dur_ms=round(wait_s * 1e3, 3), rows=p.n,
+                               model=self.model.key)
+                    _jobacct.on_queue_wait(p.trace, wait_s)
                 tk = _FAIR.acquire(self.model.key)
+                stok = _mx.push_span(bspan)
                 try:
                     out = self.scorer.score_table(cat_cols, total)
                 finally:
+                    _mx.pop_span(stok)
                     _FAIR.release(self.model.key, tk)
                 BATCHES.inc()
                 BATCH_OCCUPANCY.observe(len(live))

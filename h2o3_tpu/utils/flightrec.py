@@ -43,6 +43,7 @@ import threading
 import time
 
 from h2o3_tpu import config as _config
+from h2o3_tpu.utils import jobacct as _jobacct
 from h2o3_tpu.utils import metrics as _mx
 
 _DISPATCH_SECONDS = _mx.histogram(
@@ -104,6 +105,97 @@ def ring_status() -> dict:
     }
 
 
+def trace_export(trace: str | None = None, n: int | None = None) -> dict:
+    """Chrome/Perfetto trace JSON of the ring (``GET
+    /3/FlightRecorder?format=trace``; tools/trace_report.py renders the
+    same shape from an incident bundle). One lane per trace id:
+    ``dispatch_end`` events — which carry the measured duration plus
+    trace/span/parent ids — render as complete ("X") spans positioned at
+    end-timestamp minus duration; every other ring kind (chunk_fetch,
+    queue_wait, collectives, …) renders as an instant event on its trace's
+    lane; ``profiler_start``/``profiler_end`` pairs render the xplane
+    capture window on a dedicated lane, so which dispatches the profiler
+    saw is readable by timestamp overlap. Registry spans of the exported
+    traces (the "job" / "rest.request" parents) merge onto the same lanes,
+    completing the span tree Perfetto shows."""
+    return render_trace(events(n=n), trace=trace,
+                        span_fetch=_mx.trace_events)
+
+
+def render_trace(evs: list[dict], trace: str | None = None,
+                 span_fetch=None) -> dict:
+    """Render a list of ring-shaped events (live ring or an incident
+    bundle's ``events``) as Chrome/Perfetto trace JSON. ``span_fetch``
+    (trace_id -> registry span list) merges in-process registry spans —
+    pass None when rendering a bundle, whose registry spans are gone."""
+    if trace is not None:
+        trace = str(trace)
+        evs = [e for e in evs if e.get("trace") == trace
+               or e["kind"] in ("profiler_start", "profiler_end")]
+    out: list[dict] = [{"name": "process_name", "ph": "M", "pid": 1,
+                        "tid": 0, "args": {"name": "h2o3_tpu flight recorder"}}]
+    lanes: dict[str, int] = {}
+
+    def lane(tr) -> int:
+        key = tr if tr else "(untraced)"
+        tid = lanes.get(key)
+        if tid is None:
+            tid = lanes[key] = len(lanes) + 1
+        return tid
+
+    if trace is not None:
+        lane(trace)  # registry-only traces still get their lane
+    prof_open: dict[str, float] = {}
+    for e in evs:
+        kind = e["kind"]
+        args = {k: v for k, v in e.items()
+                if k not in ("ts", "kind") and v is not None}
+        if kind == "dispatch_start":
+            continue  # the matching dispatch_end carries the measured span
+        if kind == "dispatch_end" or "dur_ms" in e:
+            # duration-carrying events (dispatch_end, the batcher's
+            # queue_wait, …) render as complete spans anchored at their
+            # end timestamp minus the measured duration
+            dur_s = float(e.get("dur_ms") or 0.0) / 1e3
+            name = (f"dispatch:{e.get('site', '?')}"
+                    if kind == "dispatch_end" else kind)
+            out.append({"name": name, "ph": "X",
+                        "ts": (e["ts"] - dur_s) * 1e6,
+                        "dur": max(dur_s * 1e6, 1.0),
+                        "pid": 1, "tid": lane(e.get("trace")), "args": args})
+        elif kind == "profiler_start":
+            prof_open[str(e.get("logdir") or "")] = e["ts"]
+        elif kind == "profiler_end":
+            t0 = prof_open.pop(str(e.get("logdir") or ""), None)
+            if t0 is not None:
+                out.append({"name": "xplane_capture", "ph": "X",
+                            "ts": t0 * 1e6,
+                            "dur": max((e["ts"] - t0) * 1e6, 1.0),
+                            "pid": 1, "tid": 0, "args": args})
+        else:
+            out.append({"name": kind, "ph": "i", "s": "t",
+                        "ts": e["ts"] * 1e6,
+                        "pid": 1, "tid": lane(e.get("trace")), "args": args})
+    if span_fetch is not None:
+        for tr, tid in list(lanes.items()):
+            for s in span_fetch(tr):
+                out.append({"name": s["name"], "ph": "X",
+                            "ts": s["ts"] * 1e6,
+                            "dur": max(s["dur_s"] * 1e6, 1.0), "pid": 1,
+                            "tid": tid,
+                            "args": {"span_id": s["id"],
+                                     "parent_id": s["parent"],
+                                     **s["labels"]}})
+    out.append({"name": "thread_name", "ph": "M", "pid": 1, "tid": 0,
+                "args": {"name": "profiler"}})
+    for tr, tid in lanes.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                    "args": {"name": f"trace {tr}"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"traces": sorted(lanes),
+                          **({"trace": trace} if trace else {})}}
+
+
 def reset() -> None:
     """Drop every recorded event (tests). Sequence numbers keep counting
     so ordering stays monotonic across a reset."""
@@ -117,25 +209,43 @@ class _Dispatch:
     """Context manager stamping dispatch start/end events into the ring and
     feeding ``dispatch_device_seconds{site}``. A class, not a
     @contextmanager: the hot sites enter/exit this once per device program
-    and the generator machinery is measurably slower."""
+    and the generator machinery is measurably slower.
 
-    __slots__ = ("site", "meta", "_t0")
+    Every dispatch is also a **span** in the active trace tree (ISSUE-18):
+    start/end events carry ``trace`` (the enclosing job/request trace id,
+    None when untraced), a fresh ``span`` id from the shared metrics
+    sequence, and the ``parent`` span active at entry. The span id is
+    pushed as the active span for the dispatch body, so nested dispatches
+    (a stream_block wrapping a tree chunk) and registry spans parent
+    correctly — all of it gate-free, like the ring itself. On exit the
+    measured wall feeds the per-job ledger (utils/jobacct.py) under the
+    same trace id."""
+
+    __slots__ = ("site", "meta", "_t0", "_trace", "_span", "_parent", "_tok")
 
     def __init__(self, site: str, meta: dict):
         self.site = site
         self.meta = meta
 
     def __enter__(self):
-        record("dispatch_start", site=self.site, **self.meta)
+        self._trace = _mx.current_trace()
+        self._parent = _mx.current_span()
+        self._span = _mx.next_span_id()
+        record("dispatch_start", site=self.site, trace=self._trace,
+               span=self._span, parent=self._parent, **self.meta)
+        self._tok = _mx.push_span(self._span)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
+        _mx.pop_span(self._tok)
         record("dispatch_end", site=self.site,
                dur_ms=round(dur * 1e3, 3),
+               trace=self._trace, span=self._span, parent=self._parent,
                **({"error": exc_type.__name__} if exc_type else {}))
         _DISPATCH_SECONDS.observe(dur, site=self.site)
+        _jobacct.on_dispatch(self._trace, self.site, dur)
         from h2o3_tpu.utils import devmem
 
         devmem.on_dispatch()  # high-water marks sample at dispatch boundaries
@@ -167,6 +277,37 @@ def last_incident() -> str | None:
     return _last_bundle[2] if _last_bundle else None
 
 
+def _rank() -> int:
+    """This process's pod rank (0 single-process / before jax init)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — capture must work before jax init
+        return 0
+
+
+def _sibling_bundles(path: str, gen: int) -> list[str]:
+    """Other ranks' bundles for the same degraded episode. Every rank's
+    latch fires `capture_incident` locally (collectives are dead on the
+    failure path, so no gather — each rank freezes its OWN ring), and the
+    incident dir is a shared volume on pods: bundles of the same cloud
+    generation ARE the pod-wide capture. This cross-references them so one
+    bundle leads a postmortem to the rest."""
+    d = os.path.dirname(path)
+    if not d or "://" in path:
+        return []
+    try:
+        tag = f"_gen{gen}_"
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if tag in f and f.endswith(".json")
+            and os.path.join(d, f) != path
+        )
+    except OSError:
+        return []
+
+
 def capture_incident(reason: str, trigger: str = "degraded",
                      extra: dict | None = None) -> str | None:
     """Freeze the evidence for a postmortem: ring dump + metrics registry
@@ -191,26 +332,33 @@ def capture_incident(reason: str, trigger: str = "degraded",
             from h2o3_tpu.utils import devmem
             from h2o3_tpu.utils.log import Log
 
+            rank = _rank()
             bundle = {
-                "schema": "h2o3_incident/1",
+                "schema": "h2o3_incident/2",
                 "ts": time.time(),
                 "reason": str(reason)[:2000],
                 "trigger": trigger,
                 "generation": gen,
+                "rank": rank,
                 "ring": ring_status(),
                 "events": events(),
                 "devmem": devmem.status(),
                 "metrics": _mx.REGISTRY.compact_snapshot(),
+                "jobs": _jobacct.all_jobs(),
                 "log_tail": Log.tail(200),
                 **(extra or {}),
             }
             stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
             path = os.path.join(
                 incident_dir(),
-                f"incident_{stamp}_gen{gen}_{os.getpid()}.json")
+                f"incident_{stamp}_gen{gen}_r{rank}_{os.getpid()}.json")
             d = os.path.dirname(path)
             if d and "://" not in path:
                 os.makedirs(d, exist_ok=True)
+            # each rank captures its OWN ring at its own latch; siblings of
+            # this generation already on the (shared) volume get linked so
+            # the bundle set is discoverable from any one of them.
+            bundle["pod_bundles"] = _sibling_bundles(path, gen)
             persist.write_bytes(
                 json.dumps(bundle, default=str).encode(), path)
             _last_bundle = (time.monotonic(), gen, path)

@@ -1,0 +1,169 @@
+"""Per-job resource ledger — job/request-scoped accounting on top of the
+flight recorder's plane-level attribution (the ISSUE-18 tentpole, piece b).
+
+PR 13 answered "which *plane* spent it": ``hbm_owned_bytes{owner}`` knows
+"frame_window" holds bytes, ``dispatch_device_seconds{site}`` knows "tree"
+burned device time. This module answers "which *job*": every dispatch,
+collective tally, ChunkStore window upload and batcher queue-wait that runs
+under a trace (``metrics.trace`` — entered by ``Job.start`` with the job
+key, and by the REST server with a per-request id) accumulates into a
+bounded per-job ledger keyed by that trace id.
+
+The ledger is the measured budget signal the multi-tenant scheduler
+(ROADMAP item 3) will enforce against, so it accumulates **always** —
+including under ``H2O3_TPU_METRICS=0`` — exactly like the flight-recorder
+ring it rides on. Publication is two-channel:
+
+- registry families ``job_device_seconds{job}`` / ``job_hbm_bytes{job}``
+  (gauges mirroring the ledger totals; LRU-evicted children are removed so
+  cardinality stays bounded at :data:`_MAX_JOBS`) and the unlabeled
+  ``job_queue_wait_seconds`` histogram — these follow the normal
+  ``H2O3_TPU_METRICS`` gate;
+- :func:`snapshot` — the raw dict embedded in every ``/3/Jobs`` entry and
+  in bench.py's per-phase artifact block, gate or no gate.
+
+Hot-path budget: one lock + dict update per dispatch — same order as the
+``dispatch_device_seconds`` histogram observe that already runs at every
+site. Call sites pass the trace id they already read for ring stamping, so
+no extra contextvar lookups happen here.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from h2o3_tpu.utils import metrics as _mx
+
+# LRU bound on tracked jobs: grid/AutoML runs launch hundreds of child jobs
+# per session; the scheduler only needs the live ones and /3/Jobs only shows
+# recent ones. Evicting a job drops its registry children too.
+_MAX_JOBS = 128
+
+_JOB_DEVICE_SECONDS = _mx.gauge(
+    "job_device_seconds",
+    "device-dispatch wall seconds attributed to each live job/request "
+    "trace (sum over that job's dispatch spans; LRU-bounded cardinality)")
+_JOB_HBM_BYTES = _mx.gauge(
+    "job_hbm_bytes",
+    "ChunkStore window bytes uploaded on behalf of each live job trace "
+    "(frame_window plane, attributed per job; LRU-bounded cardinality)")
+_JOB_QUEUE_WAIT = _mx.histogram(
+    "job_queue_wait_seconds",
+    "per-request wait between batcher submit and dispatch start — the "
+    "queue-wait leg of the request span tree (unlabeled: one histogram "
+    "across all models, the batch-window tuning input)")
+
+_LOCK = threading.Lock()
+_LEDGERS: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+
+
+def _ledger(job: str) -> dict:
+    """Get-or-create under _LOCK; touches LRU order and evicts past the
+    bound (registry children of evicted jobs are removed)."""
+    led = _LEDGERS.get(job)
+    if led is None:
+        while len(_LEDGERS) >= _MAX_JOBS:
+            old, _ = _LEDGERS.popitem(last=False)
+            _JOB_DEVICE_SECONDS.remove(job=old)
+            _JOB_HBM_BYTES.remove(job=old)
+        led = _LEDGERS[job] = {
+            "device_seconds": 0.0,
+            "dispatches": {},        # site -> count
+            "collective_bytes": {},  # lane -> bytes (exact/quantized/...)
+            "window_bytes": 0,
+            "queue_wait_seconds": 0.0,
+            "queue_waits": 0,
+        }
+    else:
+        _LEDGERS.move_to_end(job)
+    return led
+
+
+def on_dispatch(job: str | None, site: str, dur_s: float) -> None:
+    """One device dispatch ran for ``dur_s`` under ``job``'s trace. Called
+    by flightrec._Dispatch.__exit__ with the trace id it already stamped
+    into the ring (None outside any trace → unattributed, not ledgered)."""
+    if not job:
+        return
+    with _LOCK:
+        led = _ledger(job)
+        led["device_seconds"] += dur_s
+        led["dispatches"][site] = led["dispatches"].get(site, 0) + 1
+        total = led["device_seconds"]
+    _JOB_DEVICE_SECONDS.set(total, job=job)
+
+
+def on_collective_bytes(job: str | None, nbytes: float,
+                        lane: str = "exact") -> None:
+    """Collective wire bytes moved for ``job`` (lane-split, same lanes as
+    ``tree_collective_bytes_total``: exact intra-host vs quantized DCN)."""
+    if not job or nbytes <= 0:
+        return
+    with _LOCK:
+        led = _ledger(job)
+        led["collective_bytes"][lane] = (
+            led["collective_bytes"].get(lane, 0) + int(nbytes))
+
+
+def on_window_bytes(job: str | None, nbytes: int) -> None:
+    """ChunkStore uploaded ``nbytes`` into the device window for ``job``."""
+    if not job or nbytes <= 0:
+        return
+    with _LOCK:
+        led = _ledger(job)
+        led["window_bytes"] += int(nbytes)
+        total = led["window_bytes"]
+    _JOB_HBM_BYTES.set(total, job=job)
+
+
+def on_queue_wait(job: str | None, seconds: float) -> None:
+    """One request spent ``seconds`` queued in the batcher before its batch
+    dispatched. Observed into the histogram even without a trace (the
+    latency curve wants every request); ledgered only under one."""
+    _JOB_QUEUE_WAIT.observe(max(seconds, 0.0))
+    if not job:
+        return
+    with _LOCK:
+        led = _ledger(job)
+        led["queue_wait_seconds"] += max(seconds, 0.0)
+        led["queue_waits"] += 1
+
+
+def snapshot(job: str) -> dict | None:
+    """Ledger dict for one job (None if never traced / already evicted).
+    Embedded in the job's ``/3/Jobs`` entry and bench phase artifacts."""
+    with _LOCK:
+        led = _LEDGERS.get(job)
+        if led is None:
+            return None
+        return {
+            "device_seconds": round(led["device_seconds"], 6),
+            "dispatches": dict(led["dispatches"]),
+            "collective_bytes": dict(led["collective_bytes"]),
+            "window_bytes": led["window_bytes"],
+            "queue_wait_seconds": round(led["queue_wait_seconds"], 6),
+            "queue_waits": led["queue_waits"],
+        }
+
+
+def all_jobs() -> dict[str, dict]:
+    """{job_id: ledger} for every tracked job, LRU order (oldest first)."""
+    with _LOCK:
+        keys = list(_LEDGERS)
+    out = {}
+    for k in keys:
+        snap = snapshot(k)
+        if snap is not None:
+            out[k] = snap
+    return out
+
+
+def reset() -> None:
+    """Drop every ledger and its registry children (tests/bench phases)."""
+    with _LOCK:
+        keys = list(_LEDGERS)
+        _LEDGERS.clear()
+    for k in keys:
+        _JOB_DEVICE_SECONDS.remove(job=k)
+        _JOB_HBM_BYTES.remove(job=k)

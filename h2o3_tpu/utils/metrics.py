@@ -99,6 +99,13 @@ class _Family:
     def _on(self) -> bool:
         return _ENABLED or self.always
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled child. Bounded-cardinality families (the per-job
+        ledger's ``job_*`` series) evict LRU jobs through this so the
+        registry can't grow one child per job forever."""
+        with self._lock:
+            self._children.pop(_label_key(labels), None)
+
 
 class Counter(_Family):
     kind = "counter"
@@ -319,6 +326,34 @@ def _flat_name(name: str, labels: dict) -> str:
     return f"{name}{{{inner}}}"
 
 
+def render_snapshot(snap: dict) -> str:
+    """Prometheus text exposition of a :meth:`MetricsRegistry.snapshot`-shaped
+    dict. The pod-federation path (cluster/federation.py) merges per-rank
+    snapshots into one dict that lives in no registry — this renders it with
+    the exact same escaping/formatting rules as :meth:`to_prometheus`."""
+    lines: list[str] = []
+    for name in sorted(snap):
+        fam = snap[name]
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam.get('type', 'untyped')}")
+        for val in fam.get("values", ()):
+            base = [f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(val.get("labels", {}).items())]
+            if "buckets" in val:
+                for le, c in val["buckets"].items():
+                    lab = ",".join(base + [f'le="{le}"'])
+                    lines.append(f"{name}_bucket{{{lab}}} {_fmt(c)}")
+                suffix = "{" + ",".join(base) + "}" if base else ""
+                lines.append(f"{name}_sum{suffix} {_fmt(val['sum'])}")
+                lines.append(f"{name}_count{suffix} {_fmt(val['count'])}")
+            elif base:
+                lines.append(f"{name}{{{','.join(base)}}} {_fmt(val['value'])}")
+            else:
+                lines.append(f"{name} {_fmt(val['value'])}")
+    return "\n".join(lines) + "\n"
+
+
 REGISTRY = MetricsRegistry()
 
 
@@ -355,6 +390,10 @@ _SPAN_VAR: contextvars.ContextVar[int | None] = contextvars.ContextVar(
     "h2o3_span", default=None
 )
 
+_TRACE_KIND_VAR: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "h2o3_trace_kind", default=None
+)
+
 _IDS = itertools.count(1)
 
 _MAX_TRACES = 128
@@ -369,23 +408,68 @@ _SPAN_SECONDS = histogram(
 
 
 @contextlib.contextmanager
-def trace(trace_id: str):
-    """Enter a trace scope (Job.start does this with the job key). Joins an
-    already-active trace instead of replacing it: a Job nested inside a
+def trace(trace_id: str, kind: str = "job"):
+    """Enter a trace scope (Job.start does this with the job key; the REST
+    server with a per-request id and ``kind="request"``). Joins an
+    already-active JOB trace instead of replacing it: a Job nested inside a
     replicated command (spmd _exec_build's inner Job) contributes its spans
-    to the OUTER job's trace — the one the client is polling."""
-    if not _ENABLED or _TRACE_VAR.get() is not None:
+    to the OUTER job's trace — the one the client is polling. A job entered
+    under a REQUEST trace is the opposite case: the job outlives the
+    request and is polled by its own key, so a ``kind="job"`` trace SHADOWS
+    an active request trace (the POST that launched a 10-minute build must
+    not be charged the build's device-seconds).
+
+    NOT gated by H2O3_TPU_METRICS: the trace id is the attribution key the
+    flight-recorder ring and the per-job ledger (utils/jobacct.py) stamp on
+    every dispatch, and those run in every process all the time. The gate
+    only controls whether :func:`span` RECORDS into the registry."""
+    if _TRACE_VAR.get() is not None and not (
+        kind == "job" and _TRACE_KIND_VAR.get() == "request"
+    ):
         yield
         return
     token = _TRACE_VAR.set(str(trace_id))
+    ktoken = _TRACE_KIND_VAR.set(kind)
+    # a NEW trace roots its own span tree: clear any span inherited from
+    # the shadowed scope (a job thread copies the launching request's
+    # contextvars — without this the job's root span would parent under
+    # the request's rest.request span, a node in a DIFFERENT trace)
+    stoken = _SPAN_VAR.set(None)
     try:
         yield
     finally:
+        _SPAN_VAR.reset(stoken)
         _TRACE_VAR.reset(token)
+        _TRACE_KIND_VAR.reset(ktoken)
 
 
 def current_trace() -> str | None:
     return _TRACE_VAR.get()
+
+
+def current_span() -> int | None:
+    """Active span id (None outside any span) — the parent the flight
+    recorder links its dispatch events under."""
+    return _SPAN_VAR.get()
+
+
+def next_span_id() -> int:
+    """Allocate a span id from the shared sequence. The ring's dispatch
+    spans and the registry spans draw from ONE counter so a trace tree
+    mixing both never collides."""
+    return next(_IDS)
+
+
+def push_span(sid: int):
+    """Make ``sid`` the active span (returns the reset token). The flight
+    recorder's dispatch context manager uses this so nested dispatches —
+    and registry spans opened inside one — parent correctly even under
+    H2O3_TPU_METRICS=0."""
+    return _SPAN_VAR.set(sid)
+
+
+def pop_span(token) -> None:
+    _SPAN_VAR.reset(token)
 
 
 def _record_span(ev: dict) -> None:

@@ -67,12 +67,42 @@ _SLOW_BY_NAME = {
     "test_cartesian_grid_covers_product_and_ranks",
     "test_drf_checkpoint_adds_trees",
     "test_gbm_regression_beats_baseline_and_tracks_sklearn",
+    # re-measured 2026-08-06 (--durations=60, tier-1 at ~18.5 min against
+    # the 870 s window): the heaviest compile-bound cases move to the slow
+    # tier. Families keep a tier-1 smoke — e.g. the binomial mojo parity,
+    # the gbm worker-death resume, and one param variant of each swept
+    # parity case stay (bracketed entries below mark ONE variant, not all).
+    "test_profiler_writes_trace",
+    "test_glm_fused_multinomial_parity_and_dispatches",
+    "test_automl_budget_caps_each_model",
+    "test_gbm_elastic_resume_8_to_4",
+    "test_compile_cache_cross_process",
+    "test_automl_poison_step_skipped_after_retry_budget",
+    "test_pdp_recovers_shape",
+    "test_gbm_regression_mojo_parity",
+    "test_automl_worker_death_auto_resumes",
+    "test_streamed_mono_matches_resident",
+    "test_oversized_streamed_train_bounds_ledger_claims",
+    "test_plot_surface_renders",
+    "test_streamed_gbm_parity_on_2d_mesh",
+    "test_adversarial_tie_suites_bit_exact_under_quant",
+    "test_fused_parity_coarsened_saturated_levels",
+    "test_get_leaderboard_extra_columns",
+    "test_infogram_core_ranks_signal_over_noise",
+    "test_oversized_frame_trains_through_eviction_cycles",
+    "test_fused_mono_tie_break[1]",
+    "test_fused_mono_constrained_signal[8]",
+    "test_gbm_streaming_matches_resident[2]",
+    "test_fused_cat_sharded_tie_break[2]",
+    "test_fused_tie_break_duplicated_columns_nonzero_gains[8]",
+    "test_upliftdrf_recovers_heterogeneous_effect[KL]",
 }
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
-        if item.name.split("[")[0] in _SLOW_BY_NAME:
+        if (item.name in _SLOW_BY_NAME
+                or item.name.split("[")[0] in _SLOW_BY_NAME):
             item.add_marker(pytest.mark.slow)
 
 

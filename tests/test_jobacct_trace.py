@@ -1,0 +1,241 @@
+"""Job-scoped tracing + accounting plane tests (ISSUE 18): trace context
+through REST ingress and the coalescing batcher, the per-job ledger
+against the dispatch spans it mirrors, pod-federated metric merging, and
+the METRICS=0 contract (trace ids are attribution, not telemetry — they
+stay on when the registry is gated).
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.cluster import federation
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM
+from h2o3_tpu.utils import flightrec
+from h2o3_tpu.utils import jobacct
+from h2o3_tpu.utils import metrics as _mx
+
+
+@pytest.fixture(scope="module")
+def score_model():
+    rng = np.random.default_rng(11)
+    n = 600
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "y": np.where(rng.random(n) < 0.5, "dog", "cat"),
+    })
+    fr = Frame.from_pandas(df, destination_frame="jobacct_train")
+    return GBM(ntrees=5, max_depth=3, seed=1).train(y="y",
+                                                   training_frame=fr)
+
+
+# ---------------------------------------------------------------------------
+# span trees: a coalesced request keeps ITS trace; the shared batch
+# dispatch is cross-referenced, not stolen
+
+
+def test_coalesced_request_keeps_own_span_tree(score_model, monkeypatch):
+    """N concurrent traced requests coalesce into one batch dispatch. Each
+    request's trace must still carry its OWN queue_wait span, and that
+    span's batch_span id must resolve to a serving_batch dispatch — the
+    shared dispatch parents under the batch span, never under any single
+    request."""
+    from h2o3_tpu import serving
+
+    monkeypatch.setenv("H2O3_TPU_SCORE_BATCH_WINDOW_MS", "60")
+    flightrec.reset()
+    errors = []
+
+    def worker(i):
+        try:
+            with _mx.trace(f"req-span-{i}", kind="request"), \
+                    _mx.span("rest.request", route="/3/Predictions/rows"):
+                serving.score_rows(score_model, [{"a": 0.1 * i, "b": -0.5}])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+
+    evs = flightrec.events()
+    waits = {e["trace"]: e for e in evs if e["kind"] == "queue_wait"
+             and str(e.get("trace", "")).startswith("req-span-")}
+    assert len(waits) == 6  # every request got its own queue_wait span
+    batch_parents = {e.get("parent") for e in evs
+                     if e["kind"] == "dispatch_end"
+                     and e.get("site") == "serving_batch"}
+    for i in range(6):
+        w = waits[f"req-span-{i}"]
+        assert w.get("span") is not None
+        assert w.get("dur_ms") is not None and w["dur_ms"] >= 0
+        # the cross-reference: this request's batch dispatched under the
+        # shared batch span, and the dispatch span parents under it
+        assert w.get("batch_span") in batch_parents
+        # the request's registry span tree is its own (the shared dispatch
+        # never appears inside any single request's trace)
+        names = {s["name"] for s in _mx.trace_events(f"req-span-{i}")}
+        assert "rest.request" in names
+
+
+def test_rest_ingress_assigns_and_echoes_trace():
+    """REST ingress starts a request trace (client X-Request-Id wins, else
+    rest-{n}) and echoes the id back as X-H2O3-Trace."""
+    from h2o3_tpu.api.server import start_server
+
+    server = start_server(port=0)
+    req = urllib.request.Request(server.url + "/3/Ping",
+                                 headers={"X-Request-Id": "my-req-77"})
+    with urllib.request.urlopen(req) as r:
+        assert r.headers.get("X-H2O3-Trace") == "my-req-77"
+    names = {s["name"] for s in _mx.trace_events("my-req-77")}
+    assert "rest.request" in names
+    with urllib.request.urlopen(server.url + "/3/Ping") as r:
+        assigned = r.headers.get("X-H2O3-Trace")
+    assert assigned and assigned.startswith("rest-")
+
+
+# ---------------------------------------------------------------------------
+# the ledger against the spans it mirrors
+
+
+def test_gbm_job_ledger_matches_dispatch_spans():
+    """The build job's ledger device-seconds must equal the sum of its
+    dispatch spans within 5% — same measurement accumulated two ways (ring
+    events vs jobacct), so a drift means one side lost dispatches."""
+    rng = np.random.default_rng(3)
+    n = 500
+    df = pd.DataFrame({
+        "a": rng.normal(size=n),
+        "b": rng.normal(size=n),
+        "y": rng.normal(size=n),
+    })
+    fr = Frame.from_pandas(df, destination_frame="jobacct_ledger_train")
+    jobacct.reset()
+    flightrec.reset()
+    GBM(ntrees=5, max_depth=3, seed=2).train(y="y", training_frame=fr)
+
+    jobs = jobacct.all_jobs()
+    assert jobs, "the build job never ledgered"
+    job = max(jobs, key=lambda k: jobs[k]["device_seconds"])
+    led = jobs[job]
+    assert led["dispatches"].get("tree", 0) >= 1
+    span_s = sum(e["dur_ms"] for e in flightrec.events(kind="dispatch_end")
+                 if e.get("trace") == job) / 1e3
+    assert span_s > 0
+    assert led["device_seconds"] == pytest.approx(span_s, rel=0.05)
+    # dispatch counts agree exactly with the job's dispatch_end spans
+    n_spans = sum(1 for e in flightrec.events(kind="dispatch_end")
+                  if e.get("trace") == job)
+    assert sum(led["dispatches"].values()) == n_spans
+    # the registry gauge mirrors the ledger total
+    fam = _mx.REGISTRY.gauge("job_device_seconds")
+    vals = {tuple(sorted(l.items())): v for l, v in fam.samples()}
+    assert vals.get((("job", job),)) == pytest.approx(
+        led["device_seconds"], rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# pod federation
+
+
+def test_pod_merge_sums_counters_and_rank_labels_gauges():
+    mk_hist = lambda s, c, inf: {  # noqa: E731
+        "labels": {}, "buckets": {"0.1": c, "+Inf": inf}, "sum": s,
+        "count": inf}
+    snap_a = {
+        "reqs_total": {"type": "counter", "help": "h", "values": [
+            {"labels": {"route": "/3/Ping"}, "value": 3}]},
+        "models_resident": {"type": "gauge", "help": "", "values": [
+            {"labels": {"tier": "hbm"}, "value": 1.5}]},
+        "wait_seconds": {"type": "histogram", "help": "", "values": [
+            mk_hist(1.0, 1, 2)]},
+    }
+    snap_b = {
+        "reqs_total": {"type": "counter", "help": "h", "values": [
+            {"labels": {"route": "/3/Ping"}, "value": 4}]},
+        "models_resident": {"type": "gauge", "help": "", "values": [
+            {"labels": {"tier": "hbm"}, "value": 2.5}]},
+        "wait_seconds": {"type": "histogram", "help": "", "values": [
+            mk_hist(3.04, 0, 2)]},
+    }
+    merged = federation.merge({0: snap_a, 1: snap_b})
+    # counters SUM across ranks per label set
+    assert merged["reqs_total"]["values"] == [
+        {"labels": {"route": "/3/Ping"}, "value": 7}]
+    # gauges keep one series per rank, rank-labeled
+    gvals = {v["labels"]["rank"]: v["value"]
+             for v in merged["models_resident"]["values"]}
+    assert gvals == {"0": 1.5, "1": 2.5}
+    assert all(v["labels"]["tier"] == "hbm"
+               for v in merged["models_resident"]["values"])
+    # histograms merge cumulative buckets / sums / counts
+    (h,) = merged["wait_seconds"]["values"]
+    assert h["count"] == 4 and h["sum"] == pytest.approx(4.04)
+    assert h["buckets"] == {"0.1": 1, "+Inf": 4}
+    # and the merged dict (which lives in no registry) renders as a normal
+    # Prometheus exposition
+    text = _mx.render_snapshot(merged)
+    assert 'reqs_total{route="/3/Ping"} 7' in text
+    assert 'models_resident{rank="0",tier="hbm"} 1.5' in text
+    assert 'wait_seconds_bucket{le="+Inf"} 4' in text
+
+
+def test_single_process_pod_snapshot_is_rank0():
+    snap = federation.pod_snapshot()
+    assert isinstance(snap, dict) and snap
+    for fam in snap.values():
+        if fam.get("type") == "gauge":
+            for v in fam["values"]:
+                assert v["labels"].get("rank") == "0"
+
+
+# ---------------------------------------------------------------------------
+# METRICS=0: trace ids are attribution, not telemetry
+
+
+def test_metrics_off_keeps_spans_in_ring_not_registry():
+    _mx.set_enabled(False)
+    try:
+        jobacct.reset()
+        flightrec.reset()
+        with _mx.trace("job-gated"):
+            with _mx.span("gated.build"):
+                with flightrec.dispatch("tree", program="p"):
+                    pass
+        ev = flightrec.events(kind="dispatch_end")[-1]
+        # the ring event still carries the full span identity
+        assert ev["trace"] == "job-gated"
+        assert ev.get("span") is not None
+        # ...and the ledger still accumulated (the scheduler's signal)
+        led = jobacct.snapshot("job-gated")
+        assert led is not None and led["dispatches"] == {"tree": 1}
+        # ...but the REGISTRY recorded nothing: no span tree, no gauge child
+        assert _mx.trace_events("job-gated") == []
+        fam = _mx.REGISTRY.gauge("job_device_seconds")
+        assert not any(l.get("job") == "job-gated"
+                       for l, _v in fam.samples())
+    finally:
+        _mx.set_enabled(True)
+
+
+def test_ring_append_stays_microseconds_with_span_fields():
+    """The PR-13 O(µs) append bound, re-run with the ISSUE-18 span fields
+    attached — the trace plane must not buy attribution with hot-path
+    time."""
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        flightrec.record("dispatch_end", site="tree", dur_ms=0.5,
+                         trace="job-bound", span=i, parent=i - 1)
+    per_event = (time.perf_counter() - t0) / n
+    assert per_event < 100e-6, f"{per_event * 1e6:.1f}µs per append"

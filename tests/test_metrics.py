@@ -235,6 +235,14 @@ def test_job_trace_endpoint_serves_chrome_trace_with_nested_builds(server):
     j = _wait_job(server, key)
     assert j["status"] == "DONE", j
 
+    # the per-job resource ledger rides the /3/Jobs wire schema (the
+    # budget signal a fleet scheduler reads): device-seconds and the
+    # tree-dispatch counts this build just spent
+    led = j.get("ledger")
+    assert led, "no ledger block on /3/Jobs"
+    assert led["device_seconds"] > 0
+    assert led["dispatches"].get("tree", 0) >= 1
+
     trace = _get_json(server, f"/3/Jobs/{key}/trace")
     evs = trace["traceEvents"]
     assert isinstance(evs, list) and evs, trace

@@ -85,6 +85,23 @@ def _loadtest_ok(here: str, now: float):
         if parity is False:
             print(f"{name}: batched/control predictions DIVERGED")
             return False
+        # span-sourced latency breakdown (ISSUE 18) is OPTIONAL — older
+        # artifacts predate it — but when a step carries one, every leg
+        # that counted requests must carry a finite non-negative mean, or
+        # the breakdown the batch-window tuning relies on is garbage
+        for s in steps:
+            for leg, st in (s.get("latency_breakdown") or {}).items():
+                if not st.get("count"):
+                    continue
+                try:
+                    v = float(st.get("mean_ms"))
+                    sane = v >= 0 and v == v and v != float("inf")
+                except (TypeError, ValueError):
+                    sane = False
+                if not sane:
+                    print(f"{name}: breakdown leg {leg} mean_ms INSANE "
+                          f"({st.get('mean_ms')!r})")
+                    return False
         print(f"{name}: steps=ok p99=ok throughput=ok"
               f" speedup={summary.get('speedup')}"
               f" parity={'ok' if parity else 'n/a'}")
@@ -519,6 +536,85 @@ def _elastic_drill_ok(here: str, now: float):
         return False
 
 
+def _ledger_sane(led: dict) -> bool:
+    """One per-job ledger's totals: finite non-negative numbers, counts
+    non-negative ints. Shared by the TRACE gate and the BENCH jobs block."""
+    try:
+        for k in ("device_seconds", "queue_wait_seconds"):
+            v = float(led.get(k, 0) or 0)
+            if not (v >= 0 and v == v and v != float("inf")):
+                return False
+        for v in (led.get("dispatches") or {}).values():
+            if not (isinstance(v, int) and v >= 0):
+                return False
+        for v in list((led.get("collective_bytes") or {}).values()) + [
+                led.get("window_bytes", 0) or 0]:
+            v = float(v)
+            if not (v >= 0 and v == v and v != float("inf")):
+                return False
+    except (TypeError, ValueError):
+        return False
+    return True
+
+
+def _trace_ok(here: str, now: float):
+    """Sanity-check the newest recent TRACE_*.json (the run_tpu_backlog
+    traced-headline-GBM capture, ISSUE 18). Returns None when no recent
+    artifact exists (no opinion), else True/False. Checks the acceptance
+    pins: the Perfetto export carries a span for EVERY site the job's
+    ledger says it dispatched (a missing site means the trace plane lost a
+    dispatch path), and the ledger totals are finite with device-seconds
+    bounded by the measured wall-clock — attribution that exceeds the wall
+    is double-counting, not measurement."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "TRACE_*.json")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        led = d.get("ledger") or {}
+        evs = (d.get("trace") or {}).get("traceEvents") or []
+        if not evs:
+            print(f"{name}: trace export has NO events")
+            return False
+        span_names = {e.get("name") for e in evs if e.get("ph") == "X"}
+        missing = [site for site in (led.get("dispatches") or {})
+                   if f"dispatch:{site}" not in span_names]
+        if missing:
+            print(f"{name}: ledger dispatched {missing} but the trace "
+                  "has no spans for them")
+            return False
+        if not led.get("dispatches"):
+            print(f"{name}: traced GBM job recorded ZERO dispatches")
+            return False
+        bad = [j for j, lj in (d.get("jobs") or {}).items()
+               if not _ledger_sane(lj)]
+        if bad:
+            print(f"{name}: ledger totals INSANE for {bad}")
+            return False
+        wall = float(d.get("wall_s") or 0)
+        ds = float(led.get("device_seconds") or 0)
+        if not (wall > 0 and 0 <= ds <= wall):
+            print(f"{name}: ledger device-seconds {ds} outside "
+                  f"[0, wall={wall}]")
+            return False
+        print(f"{name}: spans-per-site=ok dispatches={led['dispatches']} "
+              f"device_s={ds} wall_s={wall} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def main() -> int:
     import time
 
@@ -565,6 +661,11 @@ def main() -> int:
     # must satisfy the shape-change parity pins or the window stands
     el = _elastic_drill_ok(here, now)
     if el is False:
+        return 1
+    # job-scoped tracing gate (ISSUE 18): a recent traced-GBM capture must
+    # carry a span per dispatched site and a wall-bounded ledger
+    tr = _trace_ok(here, now)
+    if tr is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full
@@ -677,6 +778,18 @@ def main() -> int:
                             sane = False
                     psum_note += (" devmem=ok" if sane
                                   else " devmem=INSANE")
+                    if not sane:
+                        headline_ok = False
+                # per-job ledger block (ISSUE 18) is OPTIONAL — older
+                # artifacts predate jobacct — but when present every
+                # job's totals must be finite non-negative numbers or
+                # the device-time attribution is garbage
+                if "jobs" in d:
+                    jb = d["jobs"]
+                    sane = isinstance(jb, dict) and all(
+                        isinstance(lj, dict) and _ledger_sane(lj)
+                        for lj in jb.values())
+                    psum_note += (" jobs=ok" if sane else " jobs=INSANE")
                     if not sane:
                         headline_ok = False
         except OSError as e:  # vanished/unreadable between glob and open

@@ -175,18 +175,54 @@ def _post_rows(url: str, model_key: str, rows: list[dict],
         return json.loads(r.read())
 
 
-def _scrape_hist(url: str, family: str):
+def _scrape_hist(url: str, family: str, labels: dict | None = None):
+    """(buckets, sum, count) of one histogram child. ``labels`` selects the
+    child whose labels contain them (dispatch_device_seconds{site=...});
+    None keeps the old first-child behavior (unlabeled families)."""
     try:
         with urllib.request.urlopen(url + "/3/Metrics?format=json",
                                     timeout=10) as r:
             fam = json.loads(r.read())["families"].get(family)
         if not fam or not fam["values"]:
             return {}, 0.0, 0
-        v = fam["values"][0]
+        v = None
+        if labels is None:
+            v = fam["values"][0]
+        else:
+            for cand in fam["values"]:
+                if all(cand["labels"].get(k) == lv
+                       for k, lv in labels.items()):
+                    v = cand
+                    break
+        if v is None:
+            return {}, 0.0, 0
         return dict(v["buckets"]), float(v["sum"]), int(v["count"])
     except Exception as e:  # noqa: BLE001 — metrics are best-effort here
         _log(f"metrics scrape failed: {e!r}")
         return {}, 0.0, 0
+
+
+def _leg_stats(h0, h1) -> dict:
+    """Per-step delta stats for one latency leg (two _scrape_hist results):
+    request count, mean ms, and the bucket upper bound covering p99 —
+    bucket-resolution, which is what the batch-window tuner needs."""
+    b0, s0, c0 = h0
+    b1, s1, c1 = h1
+    n = c1 - c0
+    if n <= 0:
+        return {"count": 0}
+    out = {"count": n, "mean_ms": round((s1 - s0) / n * 1e3, 3)}
+    prev1 = prev0 = 0
+    acc = 0.0
+    for le in b1:
+        c0le = b0.get(le, 0) if b0 else 0
+        acc += (b1[le] - prev1) - (c0le - prev0)
+        prev1, prev0 = b1[le], c0le
+        if acc >= 0.99 * n:
+            out["p99_le_ms"] = (None if le == "+Inf"
+                                else round(float(le) * 1e3, 3))
+            break
+    return out
 
 
 def _run_step(url: str, model_key: str, qps: float, duration: float,
@@ -201,6 +237,12 @@ def _run_step(url: str, model_key: str, qps: float, duration: float,
     arrivals = arrivals[arrivals < duration]
     occ0 = _scrape_hist(url, "serving_batch_occupancy")
     rows0 = _scrape_hist(url, "serving_batch_rows")
+    # per-request latency legs, from the tracing plane: time queued in the
+    # batcher, device time in the coalesced dispatch, residency page-ins
+    qw0 = _scrape_hist(url, "job_queue_wait_seconds")
+    dd0 = _scrape_hist(url, "dispatch_device_seconds",
+                       {"site": "serving_batch"})
+    pi0 = _scrape_hist(url, "serving_page_in_seconds")
 
     idx_lock = threading.Lock()
     nxt = [0]
@@ -295,6 +337,15 @@ def _run_step(url: str, model_key: str, qps: float, duration: float,
         "mean_batch_occupancy": (
             round(d_occ_sum / d_occ_count, 2) if d_occ_count else None),
         "batch_rows_hist": hist,
+        "latency_breakdown": {
+            "queue_wait": _leg_stats(
+                qw0, _scrape_hist(url, "job_queue_wait_seconds")),
+            "dispatch": _leg_stats(
+                dd0, _scrape_hist(url, "dispatch_device_seconds",
+                                  {"site": "serving_batch"})),
+            "page_in": _leg_stats(
+                pi0, _scrape_hist(url, "serving_page_in_seconds")),
+        },
     }
     return step
 
@@ -434,6 +485,9 @@ def _run_fleet(args, stamp: str) -> int:
         summary[f"{mode}_sustained_qps"] = best["offered_qps"] if best else 0.0
         summary[f"{mode}_p99_ms_at_sustained"] = (best["p99_ms"] if best
                                                   else None)
+        if best:
+            summary[f"{mode}_breakdown_at_sustained"] = best.get(
+                "latency_breakdown")
         res = (registry_stats.get(mode) or {}).get("residency") or {}
         summary[f"{mode}_hbm_peak_bytes"] = res.get("hbm_peak_bytes")
         summary[f"{mode}_evictions"] = res.get("evictions")
@@ -580,6 +634,9 @@ def main(argv=None) -> int:
         best = _sustained(steps)
         summary[f"{mode}_sustained_qps"] = best["offered_qps"] if best else 0.0
         summary[f"{mode}_p99_ms_at_sustained"] = best["p99_ms"] if best else None
+        if best:
+            summary[f"{mode}_breakdown_at_sustained"] = best.get(
+                "latency_breakdown")
         if mode == "batched" and best:
             summary["batched_occupancy_at_sustained"] = best[
                 "mean_batch_occupancy"]

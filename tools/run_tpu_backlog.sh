@@ -220,6 +220,8 @@ save "BENCH_builder_${stamp}_nocompress.json" "TPU bench FRAME_COMPRESS=0 contro
 # all-resident control — sustained QPS ratio (>= 0.5x required),
 # peak-resident-bytes-under-budget pin, eviction/page-in counters, and the
 # per-model byte-parity probe across page-out/page-in and across modes.
+# Each step now also carries the ISSUE-18 span-sourced latency breakdown
+# (queue-wait / dispatch / page-in legs) scraped from the tracing plane.
 # On TPU the interesting number is the real page-in cost (PCIe/ICI
 # host->HBM re-upload) vs the CPU proxy's memcpy — it decides how tight
 # H2O3_TPU_SERVE_HBM_BYTES can run before the paging tax eats the tail.
@@ -268,6 +270,45 @@ save "FLIGHTREC_${stamp}.json" "HBM attribution + flight-recorder capture under 
 timeout 900 python tools/profile_train_stages.py \
   | tee "STAGES_${stamp}.json"
 save "STAGES_${stamp}.json" "Stage wall-time attribution (cross-check for dispatch_device_seconds)"
+
+# job-scoped trace capture (ISSUE 18): the headline GBM as a TRACED job —
+# every dispatch carries trace/span/parent ids, the per-job ledger
+# accumulates device-seconds/collective-bytes/window-bytes under the job
+# key, and the export is Perfetto-loadable trace JSON cross-referenced
+# with the xplane window (telemetry.profiler stamps the same ring).
+# tools/latest_bench_ok.py gates on the artifact: a span per dispatched
+# site and ledger totals finite and bounded by the measured wall.
+timeout 1200 python - "TRACE_${stamp}.json" << 'PYEOF'
+import json, sys, time
+import bench
+import h2o3_tpu
+from h2o3_tpu.utils import flightrec, jobacct, telemetry
+
+h2o3_tpu.init(log_level="WARN")
+fr = h2o3_tpu.upload_file(bench.make_data())
+from h2o3_tpu.models.tree import GBM
+kw = dict(ntrees=20, max_depth=6, learn_rate=0.1, min_rows=10.0,
+          score_tree_interval=1000, seed=42)
+GBM(**kw).train(y="label", training_frame=fr)  # warm compile
+flightrec.reset()
+jobacct.reset()
+t0 = time.perf_counter()
+with telemetry.profiler("/tmp/h2o3_xplane_traced"):
+    GBM(**kw).train(y="label", training_frame=fr)
+wall = time.perf_counter() - t0
+jobs = jobacct.all_jobs()
+job = (max(jobs, key=lambda k: jobs[k].get("device_seconds") or 0)
+       if jobs else None)
+out = {"schema": "trace_capture/v1", "wall_s": round(wall, 3),
+       "job": job, "ledger": jobs.get(job), "jobs": jobs,
+       "trace": flightrec.trace_export(),
+       "xplane_dir": "/tmp/h2o3_xplane_traced"}
+with open(sys.argv[1], "w") as f:
+    json.dump(out, f)
+print("trace capture:", job, ledger and ledger.get("dispatches"),
+      flush=True)
+PYEOF
+save "TRACE_${stamp}.json" "Traced headline GBM: span tree + per-job ledger + Perfetto export"
 
 # ---------------------------------------------------------------------------
 # v5e-16 POD BRACKET (ISSUE 14): the multihost runs proper. Everything above

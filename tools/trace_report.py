@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Render flight-recorder spans as Chrome/Perfetto trace JSON (ISSUE 18).
+
+Three sources, same output shape as ``GET /3/FlightRecorder?format=trace``:
+
+- an **incident bundle** (``incident_*.json``): renders the frozen ring's
+  ``events`` list — the postmortem view of what the dead generation was
+  dispatching, one lane per trace id, with the bundle's per-job ledgers
+  summarized alongside;
+- a **live server** (``--url http://host:54321``): fetches the rendered
+  trace straight off the REST plane (registry spans included);
+- the **local ring** of this process (no args) — mostly for smoke tests.
+
+The trace JSON loads in ``chrome://tracing`` or https://ui.perfetto.dev.
+``profiler_start``/``profiler_end`` ring events render the xplane capture
+window on lane 0, so lining a trace up against a
+``telemetry.profiler`` capture is a timestamp overlap, not guesswork.
+
+Usage::
+
+    python tools/trace_report.py /tmp/h2o3_incidents/incident_*.json
+    python tools/trace_report.py --url http://localhost:54321 --trace job-3
+    python tools/trace_report.py bundle.json --out trace.json --summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _fetch_live(url: str, trace: str | None, n: int | None) -> dict:
+    q = [f"n={n}" if n else "n=0", "format=trace"]
+    if trace:
+        q.append(f"trace={trace}")
+    with urllib.request.urlopen(
+            url.rstrip("/") + "/3/FlightRecorder?" + "&".join(q),
+            timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _from_bundle(path: str, trace: str | None) -> tuple[dict, dict]:
+    """(trace_json, bundle) from an incident bundle file."""
+    from h2o3_tpu.utils import flightrec
+
+    with open(path) as f:
+        bundle = json.load(f)
+    evs = bundle.get("events") or []
+    return flightrec.render_trace(evs, trace=trace), bundle
+
+
+def summarize(tj: dict, jobs: dict | None = None) -> str:
+    """Human-readable digest of a trace JSON: per-lane span totals (who
+    spent how long where), then any per-job ledgers riding along."""
+    lanes: dict[int, str] = {}
+    totals: dict[tuple[int, str], tuple[int, float]] = {}
+    for e in tj.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lanes[e["tid"]] = e["args"]["name"]
+        elif e.get("ph") == "X":
+            k = (e["tid"], e["name"])
+            n, tot = totals.get(k, (0, 0.0))
+            totals[k] = (n + 1, tot + float(e.get("dur", 0.0)) / 1e3)
+    lines = []
+    for (tid, name), (n, tot_ms) in sorted(
+            totals.items(), key=lambda kv: (kv[0][0], -kv[1][1])):
+        lines.append(f"  {lanes.get(tid, f'tid {tid}'):<28} "
+                     f"{name:<28} n={n:<5} total={tot_ms:9.3f}ms")
+    for job, led in sorted((jobs or {}).items()):
+        lines.append(f"  ledger {job}: "
+                     f"device={led.get('device_seconds')}s "
+                     f"dispatches={led.get('dispatches')} "
+                     f"window_bytes={led.get('window_bytes')} "
+                     f"queue_wait={led.get('queue_wait_seconds')}s")
+    return "\n".join(lines) if lines else "  (no spans)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", nargs="?",
+                    help="incident bundle JSON to render (omit for "
+                         "--url or the local ring)")
+    ap.add_argument("--url", help="fetch the trace from a live server "
+                                  "instead of a bundle file")
+    ap.add_argument("--trace", help="keep only this trace id's lane")
+    ap.add_argument("--n", type=int, default=None,
+                    help="newest N ring events (default: all)")
+    ap.add_argument("--out", help="write trace JSON here "
+                                  "(default: stdout)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print a per-lane span digest to stderr")
+    args = ap.parse_args(argv)
+
+    jobs = None
+    if args.url:
+        tj = _fetch_live(args.url, args.trace, args.n)
+    elif args.bundle:
+        tj, bundle = _from_bundle(args.bundle, args.trace)
+        jobs = bundle.get("jobs")
+    else:
+        from h2o3_tpu.utils import flightrec
+
+        tj = flightrec.trace_export(trace=args.trace, n=args.n)
+
+    line = json.dumps(tj)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"trace written to {args.out} "
+              f"({len(tj.get('traceEvents', []))} events, traces: "
+              f"{tj.get('otherData', {}).get('traces')})", file=sys.stderr)
+    else:
+        print(line)
+    if args.summary:
+        print(summarize(tj, jobs), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
