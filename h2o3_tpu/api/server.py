@@ -89,12 +89,31 @@ _JOBS_LOCK = threading.Lock()
 _REST_JOBS: list[Job] = []  # jobs created by REST routes (drain + queue bound)
 
 
+def _retry_after(fallback: str) -> str:
+    """Retry-After for a shed response: the overload plane's reservation-
+    queue estimate (mean measured hold time x queue depth — honest, not a
+    constant) when the plane is on; the historical hardcoded value under
+    ``H2O3_TPU_OVERLOAD=0`` (bit-for-bit pin)."""
+    from h2o3_tpu.utils import overload as _ov
+
+    if not _ov.enabled():
+        return fallback
+    return str(max(int(round(_ov.retry_after_estimate())), 1))
+
+
 def _admission_enter(method: str, route: str) -> bool:
     """Admission gate for mutating requests. Returns True when a bounded
     in-flight slot was taken (release with :func:`_admission_exit`); raises
     ``ApiError`` 429/503 + ``Retry-After`` when the request must be shed.
     GETs (health probes, job polls, metrics scrapes) always pass — an
-    overloaded or draining cloud must stay observable."""
+    overloaded or draining cloud must stay observable.
+
+    Beyond the request-count bounds, the ISSUE-19 **memory gate**: while
+    measured ``devmem.headroom()`` sits below
+    ``H2O3_TPU_ADMIT_MIN_HEADROOM_BYTES`` every mutating request is shed
+    503 (reason ``memory``) — requests, unlike the per-job footprint check
+    in ``build_model``, carry no size estimate, so the gate is a
+    whole-server pressure valve."""
     if method == "GET":
         return False
     if route in (r"/3/Shutdown", r"/3/Recover"):
@@ -105,9 +124,25 @@ def _admission_enter(method: str, route: str) -> bool:
             503, "server is draining: no new mutating work is admitted "
                  "(running jobs are flushing checkpoints; retry against "
                  "another coordinator or after restart)",
-            headers={"Retry-After": "5"})
+            headers={"Retry-After": _retry_after("5")}, reason="draining")
     from h2o3_tpu import config
 
+    min_head = config.get_int("H2O3_TPU_ADMIT_MIN_HEADROOM_BYTES")
+    if min_head > 0:
+        from h2o3_tpu.utils import devmem as _dm
+        from h2o3_tpu.utils import overload as _ov
+
+        if _ov.enabled():
+            head = _dm.headroom()
+            if head is not None and head < min_head:
+                _REST_REJECTED.inc(
+                    method=method, route=route or "/", reason="memory")
+                raise ApiError(
+                    503, f"insufficient device memory: measured headroom "
+                         f"{int(head)} B < H2O3_TPU_ADMIT_MIN_HEADROOM_"
+                         f"BYTES={min_head}; retry after reserved HBM frees",
+                    headers={"Retry-After": _retry_after("5")},
+                    reason="memory")
     cap = config.get_int("H2O3_TPU_MAX_INFLIGHT")
     if cap <= 0:
         return False
@@ -122,7 +157,7 @@ def _admission_enter(method: str, route: str) -> bool:
     raise ApiError(
         429, f"too many in-flight mutating requests ({full} >= "
              f"H2O3_TPU_MAX_INFLIGHT={cap}); retry with backoff",
-        headers={"Retry-After": "1"})
+        headers={"Retry-After": _retry_after("1")}, reason="inflight_full")
 
 
 def _admission_exit() -> None:
@@ -140,7 +175,8 @@ def _start_job(work, description: str, cancellable: bool = True) -> Job:
     if _DRAINING:
         _REST_REJECTED.inc(method="POST", route="<job>", reason="draining")
         raise ApiError(503, "server is draining: not accepting new jobs",
-                       headers={"Retry-After": "5"})
+                       headers={"Retry-After": _retry_after("5")},
+                       reason="draining")
     cap = config.get_int("H2O3_TPU_MAX_QUEUED_JOBS")
     job = Job(work, description)
     if not cancellable:
@@ -168,7 +204,8 @@ def _start_job(work, description: str, cancellable: bool = True) -> Job:
         raise ApiError(
             503, f"job queue full ({depth} live jobs >= "
                  f"H2O3_TPU_MAX_QUEUED_JOBS={cap}); retry with backoff",
-            headers={"Retry-After": "2"})
+            headers={"Retry-After": _retry_after("2")},
+            reason="job_queue_full")
     job.start()
     return job
 
@@ -288,10 +325,15 @@ def _json_default(o):
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, msg: str, headers: dict | None = None):
+    def __init__(self, status: int, msg: str, headers: dict | None = None,
+                 reason: str | None = None):
         super().__init__(msg)
         self.status = status
         self.headers = headers or {}
+        # machine-readable shed/reject reason ("memory", "draining", ...)
+        # surfaced in the error body so clients can branch without parsing
+        # the message text
+        self.reason = reason
 
 
 # ---------------------------------------------------------------------------
@@ -657,9 +699,31 @@ class Endpoints:
             raise ApiError(400, "training_frame is required")
         cls(**kwargs)  # validate params NOW so bad requests fail fast
         from h2o3_tpu.cluster import recovery, spmd
+        from h2o3_tpu.utils import overload as _ov
 
         dest = DKV.make_key(algo)  # coordinator-chosen, carried to followers
         ckdir = kwargs.get("export_checkpoints_dir")
+
+        # memory-aware admission (ISSUE 19): the build's estimated device
+        # footprint against measured headroom net of live reservations —
+        # fits resident (reservation for the full footprint), streams
+        # (reservation for the window share; ChunkStore.plan picks the
+        # geometry), or sheds 503 with the reservation-queue Retry-After
+        admitted = False
+        fr = DKV.get(train_key)
+        if fr is not None and hasattr(fr, "npad"):
+            try:
+                est = _ov.estimate_build_bytes(fr, algo)
+                mode = _ov.admit(dest, est, algo=algo)
+            except _ov.Shed as e:
+                _REST_REJECTED.inc(method="POST", route="<job>",
+                                   reason="memory")
+                raise ApiError(
+                    503, str(e),
+                    headers={"Retry-After":
+                             str(max(int(round(e.retry_after)), 1))},
+                    reason="memory") from None
+            admitted = mode != "off"
 
         def _work(j):
             # checkpointed builds run under the recovery supervisor: a cloud
@@ -675,11 +739,25 @@ class Endpoints:
                     train=train_key, valid=valid_key, dest=dest,
                 )
 
-            return recovery.run_supervised(
-                _launch, ckdir=ckdir, algo=algo,
-                description=f"{algo} build", job=j)
+            def _run():
+                return recovery.run_supervised(
+                    _launch, ckdir=ckdir, algo=algo,
+                    description=f"{algo} build", job=j)
 
-        job = _start_job(_work, f"{algo} build")
+            if not admitted:
+                return _run()
+            # job_scope: plan_window excludes this job's own reservation
+            # (a resident admission must not push itself to the streamed
+            # lane) and the reservation releases on exit either way
+            with _ov.job_scope(dest):
+                return _run()
+
+        try:
+            job = _start_job(_work, f"{algo} build")
+        except BaseException:
+            if admitted:
+                _ov.finish(dest)  # never started: return the reservation
+            raise
         return {"__meta": {"schema_type": "ModelBuilder"},
                 "job": _job_schema(job), "algo": algo,
                 "messages": [], "error_count": 0}
@@ -1995,7 +2073,8 @@ class _Handler(BaseHTTPRequestHandler):
                     status = e.status
                     body = {"__meta": {"schema_type": "Error"},
                             "error_url": path, "msg": str(e),
-                            "http_status": e.status}
+                            "http_status": e.status,
+                            **({"reason": e.reason} if e.reason else {})}
                     if idem_owned:
                         # deterministic 4xx outcomes get cached for replay;
                         # 5xx and transient shed statuses (429/503) release
@@ -2216,4 +2295,10 @@ def start_server(ip: str = "127.0.0.1", port: int | None = None) -> H2OServer:
         from h2o3_tpu.utils import devmem as _devmem
 
         _devmem.install()
+        # overload plane: the dispatch hang watchdog walks the flight-
+        # recorder ring for wedged dispatches (no-op per pass while
+        # H2O3_TPU_OVERLOAD=0, so installing is always safe)
+        from h2o3_tpu.utils import overload as _overload
+
+        _overload.install_watchdog()
     return _SERVER

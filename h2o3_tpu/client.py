@@ -22,14 +22,17 @@ from typing import Any
 
 # HTTP statuses the server's admission/idempotency layer hands back for
 # "try again shortly": 429 (in-flight gate full), 503 (job queue full /
-# draining), 409 (same Idempotency-Key still in flight). All three mean the
-# request did NOT run — retrying is always safe.
+# draining / memory shed), 409 (same Idempotency-Key still in flight). All
+# three mean the request did NOT run — retrying is always safe. Memory
+# sheds (body reason "memory") carry a COMPUTED Retry-After — the server's
+# reservation-queue estimate of when HBM frees — which _backoff_delay
+# honors as a floor like every other Retry-After.
 _RETRYABLE_STATUSES = (409, 429, 503)
 
 
 class H2OClientError(Exception):
     def __init__(self, status: int, msg: str, retry_after: float | None = None,
-                 recovery: dict | None = None):
+                 recovery: dict | None = None, reason: str | None = None):
         super().__init__(f"HTTP {status}: {msg}")
         self.status = status
         self.retry_after = retry_after
@@ -38,6 +41,9 @@ class H2OClientError(Exception):
         # resume with checkpoint=e.recovery["checkpoint_path"] without a
         # second /3/Jobs round-trip (docs/RECOVERY.md)
         self.recovery = recovery
+        # the server's machine-readable shed reason ("memory", "draining",
+        # "inflight_full", "job_queue_full") when the error body carried one
+        self.reason = reason
 
 
 class H2OConnection:
@@ -124,16 +130,19 @@ class H2OConnection:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
+            reason = None
             try:
                 body = json.loads(e.read())
                 msg = body.get("msg", str(e))
+                reason = body.get("reason")
             except Exception:
                 msg = str(e)
             try:
                 ra = float(e.headers.get("Retry-After"))
             except (TypeError, ValueError):
                 ra = None
-            raise H2OClientError(e.code, msg, retry_after=ra) from None
+            raise H2OClientError(e.code, msg, retry_after=ra,
+                                 reason=reason) from None
 
     def get(self, path: str) -> dict:
         return self._request("GET", path, None, False)

@@ -257,16 +257,75 @@ def run_supervised(launch, *, ckdir: str | None = None, algo: str | None = None,
     (or the previous checkpoint when no newer snapshot landed). Anything
     that is not a cloud failure — or any failure when recovery is disabled
     — propagates unchanged, preserving today's fail-stop semantics
-    bit-for-bit under ``H2O3_TPU_RECOVERY=0``."""
+    bit-for-bit under ``H2O3_TPU_RECOVERY=0``.
+
+    **OOM catch-and-degrade** (ISSUE 19): a ``RESOURCE_EXHAUSTED`` that the
+    overload plane classified at a dispatch site is NOT a cloud failure —
+    the formation is healthy, the job was just too big — so instead of a
+    reform the job relaunches exactly ONCE under ``overload.degrade_scope``
+    (``ChunkStore.plan`` streams the frame / halves the window) from its
+    latest snapshot. ``oom_degrades_total{site,outcome}`` counts retried /
+    recovered / exhausted; a second OOM while already degraded — and every
+    OOM with the plane or recovery disabled — surfaces unchanged, keeping
+    the deterministic-errors-never-retry contract."""
     if max_restarts is None:
         max_restarts = _max_restarts()
     attempt = 0
     ckpt: str | None = None
+    oom_degraded: str | None = None  # OOM site once the degraded retry armed
     while True:
         launched_at = time.monotonic()
         try:
+            from h2o3_tpu.utils import overload as _overload
+
+            if oom_degraded is not None:
+                with _overload.degrade_scope():
+                    out = launch(ckpt)
+                _overload.count_degrade(oom_degraded, "recovered")
+                return out
             return launch(ckpt)
         except BaseException as e:  # noqa: BLE001 — classified below
+            if enabled():
+                from h2o3_tpu.utils import overload as _overload
+
+                oom_at = _overload.oom_site(e)
+                if oom_at is not None and oom_degraded is None:
+                    # degrade ONCE: the cloud is healthy (no reform), the
+                    # job was too big — relaunch streamed/halved from the
+                    # latest snapshot. note_dispatch_error already froze
+                    # the incident bundle naming the OOM dispatch.
+                    oom_degraded = oom_at
+                    _overload.count_degrade(oom_at, "retried")
+                    snap = latest_snapshot(ckdir, algo)
+                    from h2o3_tpu.utils import flightrec
+
+                    flightrec.record(
+                        "oom_degrade", job=description, site=oom_at,
+                        error=type(e).__name__)
+                    bundle = flightrec.last_incident()
+                    if bundle is not None and job is not None:
+                        info = dict(getattr(job, "recovery", None) or {})
+                        info["incident_bundle"] = bundle
+                        info["oom_degrade"] = {"site": oom_at}
+                        if hasattr(job, "set_recovery"):
+                            job.set_recovery(info)
+                        else:
+                            job.recovery = info
+                    delay = backoff_delay(0, key=f"{description}-oom")
+                    Log.warn(
+                        f"recovery: {description} hit RESOURCE_EXHAUSTED at "
+                        f"dispatch site {oom_at!r}; retrying ONCE degraded "
+                        f"(streamed/halved window) in {delay:.2f}s"
+                        + (f" from snapshot {snap}" if snap
+                           else " from scratch"))
+                    time.sleep(delay)
+                    if snap is not None:
+                        ckpt = snap
+                    continue
+                if oom_at is not None:
+                    # second OOM while already degraded: out of degrade
+                    # moves — surface it like any deterministic failure
+                    _overload.count_degrade(oom_at, "exhausted")
             if not enabled() or not is_cloud_failure(e):
                 raise
             healthy = time.monotonic() - launched_at

@@ -346,7 +346,11 @@ _KNOBS: dict[str, tuple[str, str]] = {
             "wall-clock window of SECS from arming (storage-outage "
             "stand-in), 'stall:site:SECS' sleeps once at the site "
             "(wedged-collective stand-in), 'slow:site:SECS' sleeps at EVERY "
-            "call to the site (slow-handler injection). '' = off"),
+            "call to the site (slow-handler injection), 'oom:site' raises "
+            "one synthetic XlaRuntimeError RESOURCE_EXHAUSTED at the "
+            "dispatch site (the OOM-degrade drill), 'hang:site:SECS' "
+            "sleeps once INSIDE the dispatch at the site (wedged-dispatch "
+            "stand-in the hang watchdog trips on). '' = off"),
     "H2O3_TPU_RECOVERY": (
         "auto", "supervised auto-recovery (cluster/recovery.py): on a cloud "
                 "failure — degraded latch, watchdog trip, coordination-"
@@ -401,6 +405,48 @@ _KNOBS: dict[str, tuple[str, str]] = {
         "32", "REST admission gate: max live (pending+running) REST-created "
               "jobs; job-creating requests beyond it are shed with "
               "503 + Retry-After. 0 = unbounded"),
+    "H2O3_TPU_OVERLOAD": (
+        "1", "overload-survival plane (utils/overload.py): memory-aware "
+             "admission with per-job HBM reservations "
+             "(hbm_reserved_bytes{job}) and streamed-lane auto-routing, "
+             "RESOURCE_EXHAUSTED catch-and-degrade (one supervised retry "
+             "in streamed/halved-window mode, oom_degrades_total), the "
+             "dispatch hang watchdog (dispatch_hangs_total), and computed "
+             "Retry-After on shed responses. '0' disables the whole plane "
+             "and pins pre-overload behavior bit-for-bit (static-window "
+             "routing only, no reservations, no OOM retry, no watchdog, "
+             "historical Retry-After constants)"),
+    "H2O3_TPU_ADMIT_MIN_HEADROOM_BYTES": (
+        "0", "REST admission memory gate: mutating requests are shed with "
+             "503 + computed Retry-After (reason 'memory') while measured "
+             "devmem.headroom() is below this many bytes — the cheap "
+             "whole-server pressure valve in front of the per-job "
+             "footprint check. 0 = off; backends without memory_stats "
+             "(the CPU proxy) are never gated"),
+    "H2O3_TPU_ADMIT_HEADROOM_FRAC": (
+        "0.7", "share of measured device headroom the admission preflight "
+               "treats as usable by job data (the rest stays free for "
+               "compiled programs and temporaries — the capacity-model "
+               "USABLE_FRACTION). Footprints are admitted resident against "
+               "frac*headroom net of live reservations; larger jobs "
+               "auto-route to the streamed lane; jobs that fit nowhere "
+               "shed 503"),
+    "H2O3_TPU_HANG_FACTOR": (
+        "8", "dispatch hang watchdog trip multiplier: a dispatch open "
+             "longer than FACTOR x its site's rolling mean completed "
+             "duration (and past H2O3_TPU_HANG_MIN_SECS) is declared "
+             "wedged — dispatch_hangs_total ticks, an incident bundle "
+             "freezes the ring, the degraded latch trips and supervised "
+             "jobs resume from their latest snapshot"),
+    "H2O3_TPU_HANG_MIN_SECS": (
+        "120", "dispatch hang watchdog floor, seconds: no dispatch is "
+               "declared wedged before this age regardless of baseline — "
+               "sites with fewer than 3 completed dispatches use ONLY the "
+               "floor, so a legitimately long first compile never "
+               "false-trips"),
+    "H2O3_TPU_HANG_POLL_SECS": (
+        "2", "dispatch hang watchdog poll cadence, seconds (background "
+             "daemon installed by start_server/launch)"),
     "H2O3_TPU_REQUEST_READ_TIMEOUT": (
         "60", "REST per-connection socket read deadline, seconds — a client "
               "that stops sending mid-request cannot pin a handler thread "

@@ -171,17 +171,57 @@ class ChunkStore:
     @staticmethod
     def plan(npad: int, bytes_per_row: float) -> "ChunkStore | None":
         """The ONE policy gate every driver uses: None (stay resident) when
-        the plane is off, no window is set, or the frame's streamed lanes
-        fit the window whole — the resident path is bit-for-bit today's.
-        Otherwise a store whose block geometry fits the window."""
-        if not streaming_enabled():
+        the plane is off or the frame's streamed lanes fit the budget whole
+        — the resident path is bit-for-bit today's. Otherwise a store whose
+        block geometry fits the window.
+
+        The window comes from two places: the static operator knob
+        (``H2O3_TPU_HBM_WINDOW_BYTES``), or — when no knob is set and the
+        overload plane is on — ``overload.plan_window``'s measured-headroom
+        share (the ISSUE-19 auto-route: a frame too big for resident
+        streams instead of OOMing) and its degraded-retry halving. With the
+        plane off (``H2O3_TPU_OVERLOAD=0``) only the static knob routes,
+        exactly as before.
+
+        Boundary fix (ISSUE 19): a frame OVER the window whose geometry
+        rounded up to one block used to silently run fully resident —
+        ``block_rows`` is quantized upward to the mesh shard multiple, so a
+        frame a few rows past the window could land ``n_blocks == 1`` and
+        skip the window entirely. An over-window frame now always streams:
+        the geometry is re-clamped to at least two blocks (down to the
+        one-quantum floor — a frame of a single shard quantum cannot split,
+        but then its whole footprint IS one block and goes through the
+        store's accounted window rather than the unbounded resident path).
+        """
+        if not compress_on():
             return None
-        if npad * bytes_per_row <= window_bytes():
+        need = npad * bytes_per_row
+        static = window_bytes()
+        from h2o3_tpu.utils import overload as _ov
+
+        ov_win = _ov.plan_window(need, static)
+        if ov_win is not None:
+            store = ChunkStore(npad, bytes_per_row, window=ov_win)
+        elif static and need > static:
+            store = ChunkStore(npad, bytes_per_row)
+        else:
             return None
-        store = ChunkStore(npad, bytes_per_row)
         if store.n_blocks <= 1:
-            return None
+            if need <= store.window:
+                return None
+            store._force_stream_geometry()
         return store
+
+    def _force_stream_geometry(self) -> None:
+        """Re-clamp block geometry so an over-window frame streams: halve
+        the row budget until the frame splits into >= 2 blocks or the
+        quantum floor is hit (a one-quantum frame stays one block but still
+        runs through the store's accounted LRU window)."""
+        from h2o3_tpu.parallel.mesh import stream_block_rows
+
+        budget = max(self.npad // 2, 1)
+        self.block_rows = stream_block_rows(self.npad, budget)
+        self.n_blocks = -(-self.npad // self.block_rows)
 
     # -- lanes (host tier) --------------------------------------------------
     def add(self, name: str, arr: np.ndarray) -> np.ndarray:

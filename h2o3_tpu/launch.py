@@ -78,6 +78,14 @@ def main(argv=None) -> None:
         # the degraded latch is set with no supervised job attached (a
         # watchdog trip between jobs) — no-op under H2O3_TPU_RECOVERY=0
         recovery.install()
+        # overload plane: the dispatch hang watchdog (no-op per pass under
+        # H2O3_TPU_OVERLOAD=0); start_server installs it too, but followers
+        # route here without a server, and every rank watches its OWN ring
+        # — the federation scrape rank-labels dispatch_hung, so the
+        # coordinator reads which rank lags from /3/Metrics
+        from h2o3_tpu.utils import overload
+
+        overload.install_watchdog()
         srv = h2o3_tpu.start_server(ip=args.ip, port=args.port)
 
         def _graceful_term(signum, frame):
@@ -103,7 +111,12 @@ def main(argv=None) -> None:
         # followers execute the coordinator's replicated command stream (the
         # DTask successor) — every rank runs the same device programs
         from h2o3_tpu.cluster.spmd import follower_loop
+        from h2o3_tpu.utils import overload
 
+        # each rank watches its OWN flight-recorder ring: a dispatch wedged
+        # on one rank trips that rank's dispatch_hung{site} gauge, which
+        # the pod federation scrape rank-labels — the lagging-rank flag
+        overload.install_watchdog()
         follower_loop()
 
 

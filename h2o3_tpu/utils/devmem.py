@@ -65,6 +65,14 @@ _HEADROOM = _mx.gauge(
     "sum(limit) - sum(in_use) across local devices at the last poll — the "
     "measured budget the residency planes can consult instead of their "
     "static byte knobs (0 while the backend reports no stats)", always=True)
+_RESERVED = _mx.gauge(
+    "hbm_reserved_bytes",
+    "admission reservations by job key (utils/overload.py): bytes the "
+    "memory-aware admission gate has promised a live job — resident "
+    "admissions reserve their full estimated footprint, streamed "
+    "admissions their window share — so concurrent training + serving "
+    "cannot overcommit the measured headroom; the series is removed when "
+    "the job releases", always=True)
 
 _LOCK = threading.Lock()
 _owned: dict[str, float] = {}
@@ -114,6 +122,44 @@ def owned() -> dict[str, float]:
     """Current per-owner claims (a copy)."""
     with _LOCK:
         return dict(_owned)
+
+
+# -- the admission reservation ledger (utils/overload.py writes it) ----------
+
+_reservations: dict[str, float] = {}
+
+
+def reserve(job: str, nbytes: float) -> None:
+    """Record an admission promise of ``nbytes`` to ``job`` (re-reserving
+    a live key replaces its amount). ``hbm_reserved_bytes{job}`` publishes
+    it until :func:`release`."""
+    v = max(float(nbytes), 0.0)
+    with _LOCK:
+        _reservations[job] = v
+    _RESERVED.set(v, job=job)
+
+
+def release(job: str) -> None:
+    """Drop a job's reservation (idempotent) and remove its gauge series —
+    reservation sums must return to zero after every job, whatever its
+    outcome."""
+    with _LOCK:
+        had = _reservations.pop(job, None)
+    if had is not None:
+        _RESERVED.remove(job=job)
+
+
+def reservations() -> dict[str, float]:
+    """Live admission reservations by job key (a copy)."""
+    with _LOCK:
+        return dict(_reservations)
+
+
+def reserved_total() -> float:
+    """Σ live reservations — what the admission gate subtracts from the
+    usable headroom share before admitting the next job."""
+    with _LOCK:
+        return float(sum(_reservations.values()))
 
 
 def peaks() -> dict[str, float]:
@@ -186,6 +232,12 @@ def poll(force: bool = False) -> list[dict]:
             _OWNED.set(_unattributed, owner="unattributed")
             if _limit_total:
                 _HEADROOM.set(max(_limit_total - in_use, 0.0))
+        else:
+            # no device reported stats this poll: headroom is UNMEASURED,
+            # not frozen at the last reading — overload admission must not
+            # route on a stale total (and tests un-patching _stats_fn get
+            # the proxy's honest None back)
+            _in_use_total = _limit_total = _unattributed = None
         return list(devs)
 
 
@@ -223,11 +275,13 @@ def status() -> dict:
     the incident-bundle devmem section, and ``tpu_mem_analysis --live``'s
     table source."""
     with _LOCK:
-        own, pk = dict(_owned), dict(_peak)
+        own, pk, res = dict(_owned), dict(_peak), dict(_reservations)
     return {
         "owned_bytes": {k: int(v) for k, v in own.items()},
         "peak_owned_bytes": {k: int(v) for k, v in pk.items()},
         "owned_total_bytes": int(sum(own.values())),
+        "reserved_bytes": {k: int(v) for k, v in res.items()},
+        "reserved_total_bytes": int(sum(res.values())),
         "in_use_bytes": None if _in_use_total is None else int(_in_use_total),
         "limit_bytes": None if _limit_total is None else int(_limit_total),
         "unattributed_bytes": (
@@ -280,6 +334,9 @@ def _reset_for_tests() -> None:
     with _LOCK:
         _owned.clear()
         _peak.clear()
+        for job in _reservations:
+            _RESERVED.remove(job=job)
+        _reservations.clear()
     with _poll_lock:
         _last_poll = 0.0
         _devices = []

@@ -37,6 +37,16 @@ failure modes the chaos test suite (``pytest -m chaos``) drives:
   EVERY call while armed (slow-handler injection: makes a REST handler or a
   training interval slow enough for admission-control/drain tests to
   observe overload deterministically).
+- **device OOM** (ISSUE 19): ``oom_check(site)`` raises one synthetic
+  :class:`XlaRuntimeError` carrying the real ``RESOURCE_EXHAUSTED``
+  signature at a flightrec dispatch site (``oom:site``) — the
+  OOM-catch-and-degrade drills prove classify → incident → degraded
+  retry without actually exhausting HBM.
+- **dispatch hangs** (ISSUE 19): ``hang_check(site)`` sleeps the armed
+  seconds ONCE *inside* the dispatch span (``hang:site:SECS``) — unlike
+  ``stall:`` (which wedges a collective outside any dispatch), this leaves
+  an OPEN ``dispatch_start`` in the flight-recorder ring, which is exactly
+  what the overload hang watchdog walks for.
 
 Arming is explicit (context manager / ``configure``) or via the
 ``H2O3_TPU_FAULTS`` env knob (config.py), spec ``;``-separated:
@@ -47,7 +57,9 @@ one-shot TOPOLOGY CHANGE at the next collective boundary (the death error
 fires and the RxC target parks for ``recovery.reform`` to consume via
 :func:`take_reshape` — the elastic-recovery chaos primitive, ISSUE 17),
 ``blackout:SECS`` fails all persist IO for a SECS window,
-``stall:site:SECS`` sleeps once, ``slow:site:SECS`` sleeps every call.
+``stall:site:SECS`` sleeps once, ``slow:site:SECS`` sleeps every call,
+``oom:site`` raises one synthetic RESOURCE_EXHAUSTED at a dispatch site,
+``hang:site:SECS`` sleeps once inside the dispatch at the site.
 When nothing is armed every check is a single module-bool test — hot paths
 pay ~nothing.
 
@@ -91,6 +103,8 @@ _die: set[str] = set()          # collective-boundary sites (worker death)
 _blackout_until: float | None = None  # persist outage window end (monotonic)
 _stall: dict[str, float] = {}   # site -> one-shot sleep seconds (wedge)
 _slow: dict[str, float] = {}    # site -> per-call sleep seconds (slowdown)
+_oom: set[str] = set()          # dispatch sites raising one RESOURCE_EXHAUSTED
+_hang: dict[str, float] = {}    # site -> one-shot in-dispatch sleep seconds
 _counts: dict[str, int] = {}    # site -> observed check calls (tests assert)
 # elastic-recovery chaos (ISSUE 17): an induced TOPOLOGY CHANGE at the next
 # collective boundary. _reshape is the armed (rows, cols) target; when the
@@ -134,13 +148,17 @@ def _parse_spec(spec: str) -> None:
 
             secs = float(part[len("blackout:"):])
             _blackout_until = time.monotonic() + secs
-        elif part.startswith(("stall:", "slow:")):
+        elif part.startswith("oom:"):
+            _oom.add(part[len("oom:"):])
+        elif part.startswith(("stall:", "slow:", "hang:")):
             kind, rest = part.split(":", 1)
             site, _, secs = rest.rpartition(":")
             if not site:
                 raise ValueError(f"bad H2O3_TPU_FAULTS entry {part!r} "
-                                 "(want stall:site:SECS or slow:site:SECS)")
-            (_stall if kind == "stall" else _slow)[site] = float(secs)
+                                 "(want stall:site:SECS, slow:site:SECS "
+                                 "or hang:site:SECS)")
+            {"stall": _stall, "slow": _slow,
+             "hang": _hang}[kind][site] = float(secs)
         elif "@" in part:
             site, at = part.split("@", 1)
             _abort[site] = int(at)
@@ -151,9 +169,10 @@ def _parse_spec(spec: str) -> None:
             raise ValueError(
                 f"bad H2O3_TPU_FAULTS entry {part!r} (want site=N, site@K, "
                 "death:site, die:site, reshape:RxC, blackout:SECS, "
-                "stall:site:SECS or slow:site:SECS)")
+                "stall:site:SECS, slow:site:SECS, oom:site or "
+                "hang:site:SECS)")
     _armed = bool(_fail or _abort or _death or _die or _blackout_until
-                  or _stall or _slow or _reshape)
+                  or _stall or _slow or _oom or _hang or _reshape)
 
 
 def configure(fail: dict[str, int] | None = None,
@@ -163,6 +182,8 @@ def configure(fail: dict[str, int] | None = None,
               blackout: float | None = None,
               stall: dict[str, float] | None = None,
               slow: dict[str, float] | None = None,
+              oom: set[str] | frozenset[str] | None = None,
+              hang: dict[str, float] | None = None,
               reshape: tuple[int, int] | str | None = None) -> None:
     """Arm the harness programmatically (additive to whatever is armed)."""
     global _armed, _blackout_until, _reshape
@@ -177,11 +198,13 @@ def configure(fail: dict[str, int] | None = None,
             _blackout_until = time.monotonic() + float(blackout)
         _stall.update(stall or {})
         _slow.update(slow or {})
+        _oom.update(oom or ())
+        _hang.update(hang or {})
         if reshape is not None:
             _reshape = (_parse_reshape(reshape) if isinstance(reshape, str)
                         else (int(reshape[0]), int(reshape[1])))
         _armed = bool(_fail or _abort or _death or _die or _blackout_until
-                      or _stall or _slow or _reshape)
+                      or _stall or _slow or _oom or _hang or _reshape)
 
 
 def armed() -> bool:
@@ -203,6 +226,8 @@ def reset() -> None:
         _blackout_until = None
         _stall.clear()
         _slow.clear()
+        _oom.clear()
+        _hang.clear()
         _counts.clear()
         _reshape = None
         _reshape_pending = None
@@ -222,10 +247,13 @@ def inject(fail: dict[str, int] | None = None,
            blackout: float | None = None,
            stall: dict[str, float] | None = None,
            slow: dict[str, float] | None = None,
+           oom: set[str] | frozenset[str] | None = None,
+           hang: dict[str, float] | None = None,
            reshape: tuple[int, int] | str | None = None):
     """Scoped arming for tests: arms on entry, fully resets on exit."""
     configure(fail=fail, abort=abort, death=death, die=die,
-              blackout=blackout, stall=stall, slow=slow, reshape=reshape)
+              blackout=blackout, stall=stall, slow=slow, oom=oom,
+              hang=hang, reshape=reshape)
     try:
         yield
     finally:
@@ -311,6 +339,46 @@ def slow_check(site: str) -> None:
         return
     with _lock:
         secs = _slow.get(site)
+        if secs is None:
+            return
+        _counts[site] = _counts.get(site, 0) + 1
+    import time
+
+    time.sleep(secs)
+
+
+def oom_check(site: str) -> None:
+    """Raise one synthetic :class:`XlaRuntimeError` carrying the real
+    ``RESOURCE_EXHAUSTED`` signature at an armed dispatch site (one-shot:
+    the degraded retry of the same job must not OOM again). The overload
+    plane classifies it exactly like a real device OOM (text match on
+    RESOURCE_EXHAUSTED), so the catch-and-degrade path is drillable on
+    the CPU proxy."""
+    if not _armed:
+        return
+    with _lock:
+        if site not in _oom:
+            return
+        _oom.discard(site)
+        _counts[site] = _counts.get(site, 0) + 1
+    raise XlaRuntimeError(
+        f"RESOURCE_EXHAUSTED: injected out-of-memory while allocating "
+        f"device buffer at dispatch site {site!r} (synthetic: attempting "
+        "to allocate more than available HBM)")
+
+
+def hang_check(site: str) -> None:
+    """Sleep the armed seconds ONCE *inside* the dispatch span at the site
+    — the wedged-dispatch stand-in. The sleep happens after the flight
+    recorder stamps ``dispatch_start``, so the ring shows an open dispatch
+    the whole time: exactly the state the overload hang watchdog detects.
+    One-shot so the dispatch eventually unwedges — the watchdog's trip
+    (latch + incident + hung-span fail-stop), not the sleep, is what the
+    drills assert on."""
+    if not _armed:
+        return
+    with _lock:
+        secs = _hang.pop(site, None)
         if secs is None:
             return
         _counts[site] = _counts.get(site, 0) + 1

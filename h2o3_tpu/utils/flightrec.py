@@ -43,6 +43,7 @@ import threading
 import time
 
 from h2o3_tpu import config as _config
+from h2o3_tpu.utils import faults as _faults
 from h2o3_tpu.utils import jobacct as _jobacct
 from h2o3_tpu.utils import metrics as _mx
 
@@ -205,6 +206,21 @@ def reset() -> None:
 
 # -- per-dispatch device-time attribution ------------------------------------
 
+#: span ids the overload hang watchdog declared wedged (overload.py adds
+#: via :func:`mark_span_hung`): a dispatch that UNWEDGES after its trip
+#: fail-stops at its own exit — its result belongs to a formation the
+#: supervisor already gave up on, and raising there is what hands the job
+#: to recovery.run_supervised. Module-level set: the clean-exit check is
+#: one truthiness test when nothing is hung.
+_HUNG_SPANS: set = set()
+
+
+def mark_span_hung(span) -> None:
+    """Flag an open dispatch span as watchdog-tripped (overload.py)."""
+    if span is not None:
+        _HUNG_SPANS.add(span)
+
+
 class _Dispatch:
     """Context manager stamping dispatch start/end events into the ring and
     feeding ``dispatch_device_seconds{site}``. A class, not a
@@ -235,6 +251,20 @@ class _Dispatch:
                span=self._span, parent=self._parent, **self.meta)
         self._tok = _mx.push_span(self._span)
         self._t0 = time.perf_counter()
+        if _faults.armed():
+            # chaos hooks INSIDE the open span: hang_check sleeps while the
+            # ring shows an open dispatch_start (what the hang watchdog
+            # walks for); oom_check raises a synthetic RESOURCE_EXHAUSTED.
+            # A raise here must still stamp dispatch_end + classify, so
+            # route it through our own __exit__ before propagating.
+            try:
+                _faults.hang_check(self.site)
+                _faults.oom_check(self.site)
+            except BaseException:
+                import sys
+
+                self.__exit__(*sys.exc_info())
+                raise
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -249,6 +279,21 @@ class _Dispatch:
         from h2o3_tpu.utils import devmem
 
         devmem.on_dispatch()  # high-water marks sample at dispatch boundaries
+        if exc is not None:
+            from h2o3_tpu.utils import overload as _ov
+
+            _ov.note_dispatch_error(self.site, exc)
+        elif _HUNG_SPANS and self._span in _HUNG_SPANS:
+            # the hang watchdog tripped on this span and already latched the
+            # cloud degraded: a late result from a wedged dispatch must not
+            # be trusted — fail-stop so the supervisor's reform+resume owns
+            # the job from here.
+            _HUNG_SPANS.discard(self._span)
+            raise RuntimeError(
+                f"cloud is degraded (fail-stop): dispatch site "
+                f"{self.site!r} span {self._span} was declared wedged by "
+                "the hang watchdog and its late result is discarded; "
+                "supervised jobs resume from their latest snapshot")
         return False
 
 

@@ -1,0 +1,536 @@
+"""Overload-survival plane — memory-aware admission, OOM auto-degrade and
+the dispatch hang watchdog (the ISSUE-19 tentpole).
+
+After PR 10 (crash self-healing) and PR 17 (elastic resume) the stack only
+survives failures it *didn't cause*: ``devmem.headroom()`` publishes a
+measured HBM budget but nothing consults it, a real ``XlaRuntimeError
+RESOURCE_EXHAUSTED`` at a dispatch site is an unclassified fatal error, and
+a wedged dispatch hangs a job forever with no detection. This module is the
+policy layer that turns those signals into survival decisions — the
+multi-tenant prerequisite ROADMAP item 3 names ("one tenant's OOM or poison
+step cannot take the pod down"):
+
+- **Footprint model** (:func:`per_row_device_bytes`,
+  :func:`estimate_build_bytes`): the ``tools/tpu_mem_analysis.py`` capacity
+  math, shared so the admission preflight and the offline model agree —
+  resident tree builds cost ``C*4 + C + 24`` bytes/row (f32 columns +
+  bins_u8 + per-row f32 state lanes), compressed builds ``C + 24``, GLM
+  ``(P+3)*4``, DL ``(d+2)*4 + 8``.
+- **Memory-aware admission** (:func:`admit` / :func:`Shed` /
+  :func:`job_scope`): a job whose estimated footprint fits the usable share
+  of measured headroom takes a reservation in the devmem reserve/release
+  ledger (``hbm_reserved_bytes{job}``) and runs resident; one that doesn't
+  fit resident is routed to the streamed lane (``ChunkStore.plan`` consults
+  :func:`plan_window`); one that fits nowhere is shed with a Retry-After
+  computed from the reservation queue (:func:`retry_after_estimate`) —
+  never a hardcoded constant.
+- **OOM catch-and-degrade**: the flightrec-wrapped dispatch sites report
+  errors here (:func:`note_dispatch_error`) — a RESOURCE_EXHAUSTED is
+  classified (:func:`is_oom`), an incident bundle freezes the evidence, and
+  ``recovery.run_supervised`` retries the job ONCE under
+  :func:`degrade_scope` (streamed mode / a halved ChunkStore window —
+  :func:`plan_window` reads the scope). ``oom_degrades_total{site,outcome}``
+  counts retried/recovered/exhausted; deterministic errors never retry.
+- **Dispatch hang watchdog** (:func:`install_watchdog` /
+  :func:`watchdog_pass`): a background thread walks the flight-recorder
+  ring for dispatches open longer than ``H2O3_TPU_HANG_FACTOR`` × their
+  site's rolling duration baseline (floored at ``H2O3_TPU_HANG_MIN_SECS``
+  so a legitimately long first compile never false-trips), trips
+  ``dispatch_hangs_total{site}``, captures an incident, latches
+  ``cloud.mark_degraded`` so the PR-10 supervisor/fencing takes over, and
+  flags the site in the ``dispatch_hung{site}`` gauge — which the pod
+  federation scrape rank-labels, so the lagging rank of a multi-process
+  pod is readable from the coordinator. A tripped dispatch that later
+  unwedges fail-stops at its own exit (flightrec consults the hung-span
+  set): its result belongs to a formation the supervisor already gave up
+  on, and raising there is what hands the job to ``run_supervised``.
+
+``H2O3_TPU_OVERLOAD=0`` disables the whole plane and pins today's behavior
+bit-for-bit: no admission routing, no reservations, no OOM retry, no
+watchdog trips, and the REST shed responses keep their historical
+Retry-After constants. All metric families here are ``always=True``: shed
+and degrade decisions must stay observable under ``H2O3_TPU_METRICS=0``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from collections import deque
+
+from h2o3_tpu.utils import metrics as _mx
+
+_OOM_DEGRADES = _mx.counter(
+    "oom_degrades_total",
+    "RESOURCE_EXHAUSTED dispatches handled by the degrade-once supervisor "
+    "branch, by site/outcome: retried = the job relaunched once under the "
+    "degrade scope (streamed / halved window), recovered = that degraded "
+    "relaunch finished, exhausted = a second OOM while already degraded "
+    "surfaced to the caller", always=True)
+_HANGS = _mx.counter(
+    "dispatch_hangs_total",
+    "dispatches the hang watchdog declared wedged (open longer than "
+    "H2O3_TPU_HANG_FACTOR x the site's rolling duration baseline, floored "
+    "at H2O3_TPU_HANG_MIN_SECS), by site — each trip captures an incident "
+    "and latches the degraded fail-stop", always=True)
+_HUNG = _mx.gauge(
+    "dispatch_hung",
+    "seconds the oldest overdue open dispatch at a site has been wedged "
+    "(0 when the site has none) — on a federated pod scrape the gauge is "
+    "rank-labeled, so this series IS the lagging-rank flag", always=True)
+
+# -- capacity model (shared with tools/tpu_mem_analysis.py) ------------------
+
+#: per-row f32 state lanes of a tree build (w/y/F/wy/wh f32 + nid i32)
+STATE_BYTES = 24
+#: share of HBM the capacity model treats as usable by data (the rest is
+#: reserved for compiled programs/temporaries — the 10M-row OOM lesson)
+USABLE_FRACTION = 0.70
+
+_GLM_FAMILY = ("glm", "gam", "anovaglm", "modelselection", "coxph", "hglm")
+
+
+def per_row_device_bytes(ncols: int, algo: str = "gbm",
+                         compressed: bool | None = None) -> float:
+    """Estimated device bytes per padded row of a build's streamed lanes —
+    the ``tools/tpu_mem_analysis.py --oocore`` model, shared so the
+    admission preflight and the offline capacity table agree. ``compressed``
+    defaults to the live ``H2O3_TPU_FRAME_COMPRESS`` setting."""
+    if compressed is None:
+        from h2o3_tpu.frame import chunkstore as _cs
+
+        compressed = _cs.compress_on()
+    ncols = max(int(ncols), 1)
+    a = (algo or "gbm").lower()
+    if a in _GLM_FAMILY:
+        return (ncols + 3) * 4  # f32 design-matrix row + y/w/eta lanes
+    if a == "deeplearning":
+        return (ncols + 2) * 4 + 8  # f32 features + y/w + shuffle index
+    # tree family and default: bins_u8 codes + per-row f32 state; resident
+    # (uncompressed) keeps the f32 columns beside the binned matrix
+    return (ncols + STATE_BYTES) if compressed else (ncols * 5 + STATE_BYTES)
+
+
+def estimate_build_bytes(frame, algo: str = "gbm") -> int:
+    """Preflight device-footprint estimate of a build over ``frame``:
+    padded rows x the per-row lane model (the response column doesn't join
+    the feature lanes, hence ncols - 1)."""
+    ncols = max(len(frame.names) - 1, 1)
+    return int(frame.npad * per_row_device_bytes(ncols, algo))
+
+
+# -- gate --------------------------------------------------------------------
+
+def enabled() -> bool:
+    """H2O3_TPU_OVERLOAD: '0' disables the whole plane (admission routing,
+    reservations, OOM degrade, hang watchdog, computed Retry-After) and
+    pins pre-ISSUE-19 behavior bit-for-bit."""
+    from h2o3_tpu import config
+
+    return config.get_bool("H2O3_TPU_OVERLOAD")
+
+
+def _frac() -> float:
+    from h2o3_tpu import config
+
+    try:
+        v = config.get_float("H2O3_TPU_ADMIT_HEADROOM_FRAC")
+    except (TypeError, ValueError):
+        return USABLE_FRACTION
+    return min(max(v, 0.05), 1.0)
+
+
+# -- admission + per-job reservations ----------------------------------------
+
+class Shed(Exception):
+    """The job fits nowhere (neither resident nor streamed within the
+    usable headroom share): shed it. ``retry_after`` is the reservation-
+    queue estimate the REST layer surfaces as the Retry-After header."""
+
+    def __init__(self, msg: str, retry_after: float):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+_HOLD_LOCK = threading.Lock()
+_HOLDS: deque = deque(maxlen=32)      # completed reservation hold seconds
+_STARTED: dict[str, float] = {}        # live reservation -> monotonic start
+_SELF_RES: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "h2o3_overload_self_reservation", default=None)
+
+
+def retry_after_estimate() -> float:
+    """Honest Retry-After for a shed response: the mean measured
+    reservation hold time of recent jobs (5 s prior before any completes)
+    scaled by the live reservation-queue depth — a deeper queue means a
+    longer wait — clamped to [1, 120] seconds."""
+    from h2o3_tpu.utils import devmem as _dm
+
+    with _HOLD_LOCK:
+        avg = (sum(_HOLDS) / len(_HOLDS)) if _HOLDS else 5.0
+    depth = max(len(_dm.reservations()), 1)
+    return float(max(1.0, min(120.0, avg * depth)))
+
+
+def admit(key: str, need_bytes: int, algo: str = "") -> str:
+    """Admission decision for a job with an estimated device footprint:
+
+    - ``"resident"`` — fits the usable headroom share net of other jobs'
+      reservations; a reservation for the full footprint is taken.
+    - ``"streamed"`` — doesn't fit resident but a streamed window does;
+      the reservation covers the window share and ``ChunkStore.plan``
+      (via :func:`plan_window`) picks the matching geometry at build time.
+    - raises :class:`Shed` when it fits nowhere.
+    - ``"off"`` — plane disabled; no reservation, no routing.
+
+    On backends whose devices report no ``memory_stats`` (the CPU proxy)
+    headroom is unmeasured: the job is admitted resident but STILL takes
+    its reservation, so ``hbm_reserved_bytes{job}`` and the hold-time
+    estimator keep working everywhere. Release with :func:`finish` (the
+    :func:`job_scope` context does it for you)."""
+    if not enabled():
+        return "off"
+    from h2o3_tpu.frame import chunkstore as _cs
+    from h2o3_tpu.utils import devmem as _dm
+
+    need = max(int(need_bytes), 0)
+    head = _dm.headroom()
+    if head is None:
+        _reserve(key, need)
+        return "resident"
+    avail = max(head * _frac() - _dm.reserved_total(), 0.0)
+    if need <= avail:
+        _reserve(key, need)
+        return "resident"
+    if _cs.compress_on():
+        win = int(avail)
+        if win >= _min_window_bytes():
+            _reserve(key, win)
+            return "streamed"
+    raise Shed(
+        f"insufficient device memory: estimated footprint {need} B "
+        f"({algo or 'job'}) exceeds the usable headroom share "
+        f"({int(avail)} B of {int(head)} B measured headroom, "
+        f"H2O3_TPU_ADMIT_HEADROOM_FRAC={_frac()}) and no streamed window "
+        "fits; retry after reserved HBM frees",
+        retry_after_estimate())
+
+
+def _reserve(key: str, nbytes: int) -> None:
+    from h2o3_tpu.utils import devmem as _dm
+
+    _dm.reserve(key, nbytes)
+    with _HOLD_LOCK:
+        _STARTED[key] = time.monotonic()
+
+
+def finish(key: str) -> None:
+    """Release a job's reservation and feed its measured hold time into the
+    Retry-After estimator. Idempotent; safe for never-reserved keys."""
+    from h2o3_tpu.utils import devmem as _dm
+
+    _dm.release(key)
+    with _HOLD_LOCK:
+        t0 = _STARTED.pop(key, None)
+        if t0 is not None:
+            _HOLDS.append(time.monotonic() - t0)
+
+
+@contextlib.contextmanager
+def job_scope(key: str):
+    """Run a job's work under its reservation identity: ``plan_window``
+    excludes the job's OWN reservation from the headroom math (a resident
+    admission must not push itself to the streamed lane), and the
+    reservation releases on exit whatever the outcome."""
+    tok = _SELF_RES.set(key)
+    try:
+        yield
+    finally:
+        _SELF_RES.reset(tok)
+        finish(key)
+
+
+# -- streamed-lane routing (ChunkStore.plan consults this) -------------------
+
+_DEGRADE: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "h2o3_overload_degrade", default=False)
+
+
+@contextlib.contextmanager
+def degrade_scope():
+    """Scope a degraded relaunch: ``plan_window`` halves the streamed
+    window (or forces a previously-resident frame to stream through half
+    its footprint) for every ``ChunkStore.plan`` under the scope."""
+    tok = _DEGRADE.set(True)
+    try:
+        yield
+    finally:
+        _DEGRADE.reset(tok)
+
+
+def degrade_active() -> bool:
+    return bool(_DEGRADE.get())
+
+
+def _min_window_bytes() -> int:
+    """Smallest window worth streaming through: one quantum block of the
+    cheapest lane is meaningless — require a few MiB so block geometry has
+    room to double-buffer."""
+    return 4 << 20
+
+
+def plan_window(need_bytes: float, static_window: int) -> int | None:
+    """The overload plane's window override for ``ChunkStore.plan``:
+
+    - under :func:`degrade_scope`: half the static window when the frame
+      was already streaming, else half the frame's own footprint (forces
+      the streamed lane) — the OOM degrade-once geometry;
+    - otherwise, with NO static window configured: when the lanes exceed
+      the usable share of measured headroom (net of OTHER jobs'
+      reservations), a headroom-derived window — the auto-route that sends
+      too-big-for-resident jobs down the streamed lane;
+    - None everywhere else (plane disabled, operator window wins, frame
+      fits, headroom unmeasured): the legacy static-knob policy applies.
+    """
+    if not enabled():
+        return None
+    need = max(int(need_bytes), 1)
+    if _DEGRADE.get():
+        base = static_window if (static_window and need > static_window) \
+            else need
+        return max(int(base) // 2, 1)
+    if static_window:
+        return None
+    from h2o3_tpu.utils import devmem as _dm
+
+    head = _dm.headroom()
+    if head is None:
+        return None
+    own = _SELF_RES.get()
+    res = _dm.reservations()
+    others = sum(v for k, v in res.items() if k != own)
+    avail = max(head * _frac() - others, 0.0)
+    if need <= avail:
+        return None
+    win = int(avail)
+    return win if win >= _min_window_bytes() else _min_window_bytes()
+
+
+# -- OOM classification ------------------------------------------------------
+
+_OOM_MARKS = ("resource_exhausted", "out of memory")
+_OOM_LOCK = threading.Lock()
+_last_oom: tuple[float, str] | None = None  # (monotonic, site)
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True when the exception carries an XLA RESOURCE_EXHAUSTED signature
+    (matched on repr+str like the death signatures: Job.join re-wraps
+    worker exceptions with their traceback text)."""
+    msg = (repr(exc) + " " + str(exc)).lower()
+    return any(m in msg for m in _OOM_MARKS)
+
+
+def note_dispatch_error(site: str, exc: BaseException) -> None:
+    """Called by ``flightrec._Dispatch.__exit__`` on every failed dispatch:
+    a RESOURCE_EXHAUSTED is classified, stamped into the ring and frozen
+    into an incident bundle naming the OOM dispatch site — BEFORE any
+    retry/unwind discards the dying state. Never raises."""
+    global _last_oom
+    try:
+        if not enabled() or not is_oom(exc):
+            return
+        from h2o3_tpu.utils import flightrec as _fr
+
+        with _OOM_LOCK:
+            _last_oom = (time.monotonic(), site)
+        _fr.record("oom", site=site, error=type(exc).__name__)
+        _fr.capture_incident(
+            f"RESOURCE_EXHAUSTED at dispatch site {site!r}: "
+            f"{type(exc).__name__}: {exc}", trigger="oom")
+    except Exception:  # noqa: BLE001 — telemetry must never mask the OOM
+        pass
+
+
+def oom_site(exc: BaseException, max_age: float = 600.0) -> str | None:
+    """The dispatch site behind an OOM exception (None when ``exc`` is not
+    an OOM or the plane is disabled): the site the flight recorder noted
+    within ``max_age`` seconds, else ``"unknown"`` — an OOM raised outside
+    any instrumented dispatch still degrades."""
+    if not enabled() or not is_oom(exc):
+        return None
+    with _OOM_LOCK:
+        if _last_oom and time.monotonic() - _last_oom[0] <= max_age:
+            return _last_oom[1]
+    return "unknown"
+
+
+def count_degrade(site: str, outcome: str) -> None:
+    _OOM_DEGRADES.inc(site=site, outcome=outcome)
+
+
+# -- dispatch hang watchdog --------------------------------------------------
+
+def _hang_factor() -> float:
+    from h2o3_tpu import config
+
+    try:
+        return max(config.get_float("H2O3_TPU_HANG_FACTOR"), 1.0)
+    except (TypeError, ValueError):
+        return 8.0
+
+
+def _hang_min_secs() -> float:
+    from h2o3_tpu import config
+
+    try:
+        return max(config.get_float("H2O3_TPU_HANG_MIN_SECS"), 0.0)
+    except (TypeError, ValueError):
+        return 120.0
+
+
+def _hang_poll_secs() -> float:
+    from h2o3_tpu import config
+
+    try:
+        return max(config.get_float("H2O3_TPU_HANG_POLL_SECS"), 0.1)
+    except (TypeError, ValueError):
+        return 2.0
+
+
+#: minimum completed dispatches at a site before its rolling mean is
+#: trusted over the floor — the first dispatch of a program includes its
+#: compile, and Nx a tiny warm baseline would false-trip it
+_BASELINE_MIN_SAMPLES = 3
+
+_WD_LOCK = threading.Lock()
+_tripped_spans: set = set()
+_flagged_sites: set[str] = set()
+
+
+def watchdog_pass(now: float | None = None) -> list[dict]:
+    """One ring walk: find dispatches open longer than their budget
+    (``max(H2O3_TPU_HANG_FACTOR x site rolling mean, H2O3_TPU_HANG_MIN_SECS)``;
+    floor-only for sites with < 3 completed dispatches — the long-first-
+    compile guard) and trip each once: ``dispatch_hangs_total{site}``, an
+    incident bundle, the degraded latch, the ``dispatch_hung{site}`` gauge,
+    and the span lands in the flightrec hung-span set so the dispatch
+    fail-stops at its own exit if it ever unwedges. ``now`` is injectable
+    for tests. Returns the trips made this pass."""
+    if not enabled():
+        return []
+    from h2o3_tpu.utils import flightrec as _fr
+
+    evs = _fr.events()
+    if now is None:
+        now = time.time()
+    durs: dict[str, list[float]] = {}
+    open_spans: dict = {}
+    for e in evs:
+        kind = e["kind"]
+        if kind == "dispatch_start":
+            if e.get("span") is not None:
+                open_spans[e["span"]] = e
+        elif kind == "dispatch_end":
+            open_spans.pop(e.get("span"), None)
+            if "error" not in e:
+                durs.setdefault(e.get("site", "?"), []).append(
+                    float(e.get("dur_ms") or 0.0) / 1e3)
+    factor, floor = _hang_factor(), _hang_min_secs()
+    trips: list[dict] = []
+    overdue_sites: set[str] = set()
+    for span, e in open_spans.items():
+        site = e.get("site", "?")
+        age = now - float(e["ts"])
+        d = durs.get(site, ())
+        budget = floor if len(d) < _BASELINE_MIN_SAMPLES else max(
+            factor * (sum(d) / len(d)), floor)
+        if budget <= 0 or age <= budget:
+            continue
+        overdue_sites.add(site)
+        _HUNG.set(round(age, 3), site=site)
+        with _WD_LOCK:
+            if span in _tripped_spans:
+                continue
+            _tripped_spans.add(span)
+            # bound the trip memory to what the ring can still show
+            if len(_tripped_spans) > 4 * max(len(open_spans), 64):
+                _tripped_spans.intersection_update(open_spans)
+            _flagged_sites.add(site)
+        trips.append({"site": site, "span": span, "age_s": round(age, 3),
+                      "budget_s": round(budget, 3)})
+        _trip(site, span, age, budget)
+    with _WD_LOCK:
+        cleared = _flagged_sites - overdue_sites
+        _flagged_sites.intersection_update(overdue_sites)
+    for site in cleared:
+        _HUNG.set(0.0, site=site)
+    return trips
+
+
+def _trip(site: str, span, age: float, budget: float) -> None:
+    from h2o3_tpu.cluster import cloud
+    from h2o3_tpu.utils import flightrec as _fr
+    from h2o3_tpu.utils.log import Log
+
+    reason = (f"dispatch hang: site {site!r} open {age:.1f}s > budget "
+              f"{budget:.1f}s (H2O3_TPU_HANG_FACTOR x rolling baseline, "
+              f"floored at H2O3_TPU_HANG_MIN_SECS) — span {span} declared "
+              "wedged")
+    _HANGS.inc(site=site)
+    _fr.record("watchdog_trip", site=site, span=span,
+               age_s=round(age, 3), budget_s=round(budget, 3))
+    _fr.mark_span_hung(span)
+    Log.err(reason)
+    # incident first (dedups with the latch capture), then the latch: the
+    # ring still holds the wedged dispatch_start when the bundle freezes
+    _fr.capture_incident(reason, trigger="hang")
+    cloud.mark_degraded(reason)
+
+
+_WD_THREAD: threading.Thread | None = None
+_WD_STOP = threading.Event()
+
+
+def _wd_loop() -> None:
+    while not _WD_STOP.wait(_hang_poll_secs()):
+        try:
+            watchdog_pass()
+        except Exception:  # noqa: BLE001 — the watchdog must never die loud
+            pass
+
+
+def install_watchdog() -> None:
+    """Start the background hang watchdog (idempotent; daemon). start_server
+    and launch.py install it on the coordinator; each pass no-ops while the
+    plane is disabled, so installing is always safe."""
+    global _WD_THREAD
+    if _WD_THREAD is not None and _WD_THREAD.is_alive():
+        return
+    _WD_STOP.clear()
+    _WD_THREAD = threading.Thread(
+        target=_wd_loop, name="h2o3-hang-watchdog", daemon=True)
+    _WD_THREAD.start()
+
+
+def uninstall_watchdog() -> None:
+    """Stop the background watchdog (tests)."""
+    global _WD_THREAD
+    _WD_STOP.set()
+    if _WD_THREAD is not None:
+        _WD_THREAD.join(timeout=5)
+    _WD_THREAD = None
+
+
+def _reset_for_tests() -> None:
+    global _last_oom
+    with _WD_LOCK:
+        _tripped_spans.clear()
+        _flagged_sites.clear()
+    with _OOM_LOCK:
+        _last_oom = None
+    with _HOLD_LOCK:
+        _HOLDS.clear()
+        _STARTED.clear()

@@ -108,6 +108,25 @@ def test_plan_gates():
         assert st.block_rows % q == 0
 
 
+def test_plan_overwindow_single_block_still_streams():
+    # Boundary pin (ISSUE 19): block geometry is quantized to the shard
+    # multiple, so a one-quantum frame can never split into two blocks — a
+    # window smaller than its footprint used to silently fall back to the
+    # unbounded resident path. It must stream through the store's
+    # accounted window instead, as a single quantum-floor block.
+    q = pm.block_quantum()
+    bpr = 32
+    need = q * bpr
+    with _env(H2O3_TPU_HBM_WINDOW_BYTES=str(need // 4)):
+        st = cs.ChunkStore.plan(q, bpr)
+        assert st is not None
+        assert st.n_blocks == 1 and st.block_rows == q
+        assert st.window == need // 4  # the accounted LRU budget, not need
+    # the same geometry WITH room for the whole frame stays resident
+    with _env(H2O3_TPU_HBM_WINDOW_BYTES=str(need * 4)):
+        assert cs.ChunkStore.plan(q, bpr) is None
+
+
 def test_store_lru_eviction_updates_and_gauges():
     h0 = mx.counter_value("frame_bytes_resident", tier="host")
     d0 = mx.counter_value("frame_bytes_resident", tier="hbm")

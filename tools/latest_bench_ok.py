@@ -536,6 +536,101 @@ def _elastic_drill_ok(here: str, now: float):
         return False
 
 
+def _overload_drill_ok(here: str, now: float):
+    """Sanity-check the newest recent OVERLOAD_DRILL_*.json
+    (tools/overload_drill.py, the ISSUE-19 overload-survival drill).
+    Returns None when no recent artifact exists (no opinion), else
+    True/False. Checks the acceptance pins: the admission storm at 4x
+    capacity landed some requests AND shed the rest with only 429/503 and
+    an honest Retry-After >= 1 s while the server survived and the
+    reservation ledger returned to zero (memory gate shed reason=memory);
+    the induced OOM auto-degraded to a model within 1e-6 of the resident
+    control with an incident naming the dispatch and NO generation tick;
+    the induced hang tripped the watchdog past its budget, captured a
+    hang incident, and the supervisor re-formed and resumed to the 1e-6
+    pin."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "OVERLOAD_DRILL_*.json")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            d = json.load(f)  # indented JSON, same format as the drills
+        if not d.get("ok"):
+            print(f"{name}: ok flag not set")
+            return False
+        r = d.get("results") or {}
+        storm, oom, hang = r.get("storm"), r.get("oom"), r.get("hang")
+        if not (storm and oom and hang):
+            print(f"{name}: scenarios missing (have {sorted(r)})")
+            return False
+        if not (storm.get("ok", 0) >= 1 and storm.get("shed", 0) >= 1):
+            print(f"{name}: storm did not both admit and shed "
+                  f"(ok={storm.get('ok')} shed={storm.get('shed')})")
+            return False
+        if not set(storm.get("shed_statuses") or ()) <= {429, 503}:
+            print(f"{name}: storm shed with non-backpressure statuses "
+                  f"{storm.get('shed_statuses')}")
+            return False
+        if not float(storm.get("retry_after_min") or 0) >= 1:
+            print(f"{name}: dishonest Retry-After "
+                  f"({storm.get('retry_after_min')})")
+            return False
+        if not (storm.get("server_alive")
+                and storm.get("reservations_after") == 0):
+            print(f"{name}: storm killed the server or leaked reservations")
+            return False
+        if (storm.get("memory_shed") or {}).get("reason") != "memory":
+            print(f"{name}: memory gate never shed reason=memory "
+                  f"({storm.get('memory_shed')})")
+            return False
+        if not (0 <= float(oom.get("logloss_delta", 1)) <= 1e-6):
+            print(f"{name}: oom degrade parity pin violated "
+                  f"(logloss_delta={oom.get('logloss_delta')})")
+            return False
+        if oom.get("incident_trigger") != "oom" or not oom.get("incident"):
+            print(f"{name}: oom incident missing/mistriggered")
+            return False
+        if oom.get("generation_ticked") != 0:
+            print(f"{name}: oom degrade re-formed the cloud "
+                  f"(generation_ticked={oom.get('generation_ticked')})")
+            return False
+        trips = hang.get("trips") or []
+        if not trips or not all(
+                float(t.get("budget_s") or 0) > 0
+                and float(t.get("age_s") or 0) >= float(t["budget_s"])
+                for t in trips):
+            print(f"{name}: watchdog trips missing/under-budget ({trips})")
+            return False
+        if hang.get("incident_trigger") != "hang" or not hang.get("incident"):
+            print(f"{name}: hang incident missing/mistriggered")
+            return False
+        if not (hang.get("generations_ticked") or 0) >= 1:
+            print(f"{name}: hang never handed the job to the supervisor "
+                  f"(generations_ticked={hang.get('generations_ticked')})")
+            return False
+        if not (0 <= float(hang.get("logloss_delta", 1)) <= 1e-6):
+            print(f"{name}: hang resume parity pin violated "
+                  f"(logloss_delta={hang.get('logloss_delta')})")
+            return False
+        print(f"{name}: storm ok={storm['ok']}/shed={storm['shed']} "
+              f"oom-delta={oom['logloss_delta']:.1e} "
+              f"hang-trips={len(trips)} "
+              f"hang-delta={hang['logloss_delta']:.1e} ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def _ledger_sane(led: dict) -> bool:
     """One per-job ledger's totals: finite non-negative numbers, counts
     non-negative ints. Shared by the TRACE gate and the BENCH jobs block."""
@@ -666,6 +761,12 @@ def main() -> int:
     # carry a span per dispatched site and a wall-bounded ledger
     tr = _trace_ok(here, now)
     if tr is False:
+        return 1
+    # overload-survival gate (ISSUE 19): a recent overload drill must
+    # satisfy the shed-honesty + OOM-degrade + hang-watchdog pins or the
+    # window stands
+    ov = _overload_drill_ok(here, now)
+    if ov is False:
         return 1
     # ANY qualifying artifact from this window counts: the backlog writes
     # headline-only A/B controls (_adapt/_nbins127/_matmul) AFTER the full

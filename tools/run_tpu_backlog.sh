@@ -194,6 +194,18 @@ timeout 1800 python tools/recovery_drill.py \
   --out "RECOVERY_DRILL_${stamp}.json" > /dev/null
 save "RECOVERY_DRILL_${stamp}.json" "Recovery drill: worker death mid-train, supervised auto-resume + recovery_seconds"
 
+# overload-survival drill (ISSUE 19): admission storm at 4x capacity
+# (shed honesty: 429/503 + computed Retry-After, zero server deaths,
+# reservations back to zero), induced RESOURCE_EXHAUSTED auto-degrading to
+# the streamed lane within the 1e-6 pin, and a wedged dispatch tripping
+# the hang watchdog into a supervised snapshot resume. On TPU the OOM leg
+# uses the REAL allocator signature (not just the synthetic fault text)
+# and the interesting numbers are trip latency vs real compile baselines.
+# tools/latest_bench_ok.py gates on the artifact's pins.
+timeout 1800 python tools/overload_drill.py \
+  --out "OVERLOAD_DRILL_${stamp}.json" > /dev/null
+save "OVERLOAD_DRILL_${stamp}.json" "Overload drill: admission storm + OOM degrade + hang watchdog resume"
+
 # out-of-core streaming A/B (ISSUE 11): streamed vs resident GBM at rows
 # >= 10x a forced HBM window — wall time, AUC, peak frame device bytes
 # (the fixed-footprint claim) + the COMPRESS=0 kill-switch control inside

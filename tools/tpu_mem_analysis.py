@@ -56,20 +56,30 @@ def oocore_model(out_path: str | None = None) -> dict:
       (the f32 mirrors + lanes), not by HBM.
     ``usable`` reserves HBM for compiled programs/temporaries (the 10M-row
     RESOURCE_EXHAUSTED above is exactly what ignoring that costs).
+
+    The per-row math and the usable fraction live in
+    ``h2o3_tpu/utils/overload.py`` (ISSUE 19): the SAME model the runtime's
+    memory-aware admission preflight checks against measured
+    ``devmem.headroom()`` — this offline table and the live gate cannot
+    drift apart.
     """
     import json
 
+    from h2o3_tpu.utils import overload as _ov
+
     GiB = 1 << 30
     C = 28  # Higgs feature width
-    usable = 0.70
-    state = 24  # per-row f32 lanes + nid
+    usable = _ov.USABLE_FRACTION
+    state = _ov.STATE_BYTES  # per-row f32 lanes + nid
     brackets = [
         ("v5e-1", 1), ("v5e-4", 4), ("v5e-8", 8), ("v5e-16", 16),
         ("v5e-32", 32),
     ]
     hbm_per_chip = 16 * GiB
-    rows_resident = lambda hbm: int(usable * hbm // (C * 4 + C + state))
-    rows_compressed = lambda hbm: int(usable * hbm // (C + state))
+    per_row_res = _ov.per_row_device_bytes(C, "gbm", compressed=False)
+    per_row_cmp = _ov.per_row_device_bytes(C, "gbm", compressed=True)
+    rows_resident = lambda hbm: int(usable * hbm // per_row_res)
+    rows_compressed = lambda hbm: int(usable * hbm // per_row_cmp)
     out = {"phase": "oocore_mem_model", "cols": C, "usable_fraction": usable,
            "hbm_per_chip_gib": hbm_per_chip / GiB, "brackets": []}
     for name, chips in brackets:
