@@ -503,10 +503,22 @@ def _bench_cat_1m() -> dict:
         t0 = time.time()
         m = GBM(ntrees=5, **kw).train(y="label", training_frame=fr2)
         dt = time.time() - t0
+        # compiled group-by (frame/munge.py, ISSUE 20): all value columns'
+        # segment stats in ONE mesh-sharded dispatch over the 200-level enum
+        from h2o3_tpu.frame import ops
+
+        gb_spec = {"f0": ["sum", "mean"], "f1": ["min", "max"],
+                   "f2": ["count", "sd"]}
+        ops.group_by(fr2, "cat0").agg(gb_spec)  # warm compile
+        t0 = time.time()
+        ops.group_by(fr2, "cat0").agg(gb_spec)
+        gb_dt = time.time() - t0
         return {
             "rows": n, "num_cols": n_num, "cat_cols": n_cat,
             "cardinality": card, "trees_per_sec": round(5 / dt, 3),
             "auc": round(float(m.training_metrics.auc), 4),
+            "groupby_s": round(gb_dt, 3),
+            "groupby_rows_per_sec": round(n / max(gb_dt, 1e-9), 0),
         }
     finally:
         _drop_models(m0, m)

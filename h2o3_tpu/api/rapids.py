@@ -609,19 +609,39 @@ def _normalize_cols(fr: Frame, sel: list) -> list[int]:
 
 
 def rapids_eval(ast: str, session: str | None = None) -> dict:
-    """Evaluate a Rapids string; returns the wire-shaped result dict."""
+    """Evaluate a Rapids string; returns the wire-shaped result dict.
+
+    Elementwise/ifelse steps inside the AST walk come back DEFERRED
+    (frame/lazy.py LazyExprVec, ``H2O3_TPU_MUNGE_FUSE``): a whole chain
+    materializes as one fused program at first data access instead of one
+    eager kernel per node. The response carries the plane's dispatch
+    deltas (``munge_dispatches``) so clients — and the A/B harness — can
+    see what an AST actually cost in device programs.
+    """
+    from h2o3_tpu.utils import metrics as _mx
+
     sess = _SESSIONS.setdefault(session or "default", Session(session or "default"))
+    _disp_ops = ("elementwise", "expr_fuse", "expr_stream", "groupby",
+                 "groupby_stream", "join", "join_exchange", "sort")
+    d0 = {o: _mx.counter_value("munge_dispatches_total", op=o)
+          for o in _disp_ops}
     result = _eval(parse(ast), sess)
+
+    def _munge_disp() -> dict:
+        d = {o: _mx.counter_value("munge_dispatches_total", op=o) - d0[o]
+             for o in _disp_ops}
+        return {o: int(v) for o, v in d.items() if v}
     if isinstance(result, (Frame, Vec)):
         fr = _as_frame(result)
         key = getattr(fr, "key", None) or DKV.make_key("rapids")
         fr.key = key
         DKV.put(key, fr)  # results are always client-fetchable by key
-        return {"key": {"name": key}, "num_rows": fr.nrow, "num_cols": fr.ncol}
+        return {"key": {"name": key}, "num_rows": fr.nrow,
+                "num_cols": fr.ncol, "munge_dispatches": _munge_disp()}
     if result is None:
         return {"string": ""}
     if isinstance(result, str):
         return {"string": result}
     if isinstance(result, np.ndarray):
         return {"string": str(result.tolist())}
-    return {"scalar": float(result)}
+    return {"scalar": float(result), "munge_dispatches": _munge_disp()}

@@ -94,6 +94,26 @@ _KNOBS: dict[str, tuple[str, str]] = {
                 "(docs/MIGRATION.md): L_BFGS and out-of-core streamed fits "
                 "stay on their existing paths (glm_fuse_fallbacks_total "
                 "tallies)"),
+    "H2O3_TPU_MUNGE_FUSE": (
+        "1", "compiled sharded data-munging plane (frame/munge.py + "
+             "frame/lazy.py): group-by aggregation runs as ONE mesh-sharded "
+             "segment-reduce program per .agg() call (all value columns "
+             "stacked; sum lanes through the ops/collectives.py psum wrapper "
+             "so the quant lane and 2-D rows×cols hierarchy apply, min/max "
+             "through the exact pmax lane), merge expands (li, ri) ON DEVICE "
+             "instead of host np.repeat — single-key inner joins on >1-device "
+             "meshes additionally take the radix-partition all_to_all "
+             "exchange lane — sort compiles key prep + lexsort into one "
+             "cached program, and elementwise/ifelse chains build lazy "
+             "expression graphs (frame/lazy.py LazyExprVec) that fuse into "
+             "ONE jitted dispatch at first touch (munge_dispatches_total "
+             "proves the reduction; streamed block materialization through "
+             "the ChunkStore window when one is configured — the PR-11 "
+             "residency fix). Ineligible shapes (string ops, STR/TIME keys, "
+             "pivot, rank_within_group_by, host aggs like median/mode) stay "
+             "eager and tally munge_fuse_fallbacks_total{reason}; see the "
+             "docs/MIGRATION.md fallback matrix. '0' restores every eager "
+             "seed path bit-for-bit"),
     "H2O3_TPU_DL_EPOCH_CHUNK": (
         "auto", "DeepLearning epoch fusion: fold this many epochs into ONE "
                 "compiled program per dispatch with donated (params, "

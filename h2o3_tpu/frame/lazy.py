@@ -14,6 +14,15 @@ analogs:
 - :class:`WrappedCatVec` — a categorical remap view: shares the base vec's
   device codes and applies the (tiny) old→new code LUT lazily as one device
   gather on first touch, instead of rewriting the column eagerly.
+- :class:`LazyExprVec` (ISSUE 20) — a column DEFINED by an elementwise
+  expression graph instead of a loader: ``frame/ops.py`` binops/unops/
+  ``ifelse`` under ``H2O3_TPU_MUNGE_FUSE`` return one of these, composing
+  operand graphs, so a 10-op rapids chain materializes as ONE fused jitted
+  dispatch (``munge_dispatches_total{op=expr_fuse}``) instead of ten eager
+  kernels — the Rapids AST walk finally compiling the way H2O's hand-built
+  AST nodes fused MRTask passes. When a ChunkStore window is configured the
+  materialization streams leaf blocks through it (the PR-11 residency fix:
+  no full device columns are pulled) and the result parks host-resident.
 
 Construction: ``h2o3_tpu.import_file(path, lazy=True)`` (CSV/Parquet).
 """
@@ -243,3 +252,310 @@ def _series_values(s, kind: str) -> np.ndarray:
         vals = dt.astype("datetime64[ms]").astype("int64").to_numpy().astype(np.float64)
         return np.where(dt.isna().to_numpy(), np.nan, vals)
     return pd.to_numeric(s, errors="coerce").to_numpy(np.float64)
+
+# ---------------------------------------------------------------------------
+# Expression fusion (ISSUE 20): deferred elementwise graphs
+# ---------------------------------------------------------------------------
+#
+# Node grammar (hashable tuples — the tuple IS the fused-program cache key):
+#
+#   ("leaf", i, is_cat)   i-th entry of ``_leaves``; CAT leaves apply the
+#                         eager ``_codes_as_float`` NA cast inline
+#   ("const", ci)         ci-th scalar, passed as a TRACED f32 argument so
+#                         ``col + 1`` and ``col + 2`` share one compilation
+#   ("bin", op, l, r)     ``frame/ops._BINOPS[op]`` + the ``_PRESERVE_NAN``
+#                         NaN-reinsert rule + the trailing f32 cast
+#   ("un", op, a)         ``frame/ops._UNOPS[op]`` + the "not" NaN rule
+#   ("sel", t, y, n)      ifelse: where(t != 0, y, n), NaN where t is NaN
+#
+# Per-node evaluation calls the SAME jnp tables the eager kernels use and
+# keeps the per-op f32 cast, so a fused chain is bit-identical to running
+# the eager kernels back to back — tests/test_munge_fused.py pins it.
+
+_EXPR_PROGS: dict = {}
+_MAX_EXPR_NODES = 256  # beyond this, operands enter as materialized leaves
+
+
+def _node_count(node) -> int:
+    """Number of OPERATION nodes (bin/un/sel) in the graph."""
+    tag = node[0]
+    if tag == "bin":
+        return 1 + _node_count(node[2]) + _node_count(node[3])
+    if tag == "un":
+        return 1 + _node_count(node[2])
+    if tag == "sel":
+        return 1 + sum(_node_count(c) for c in node[1:])
+    return 0
+
+
+def _eval_node(node, leaves, consts, one):
+    import jax.numpy as jnp
+
+    from h2o3_tpu.frame import ops as _ops
+
+    tag = node[0]
+    if tag == "leaf":
+        x = leaves[node[1]]
+        if node[2]:  # enum codes → float with NA (-1 → NaN), as _as_device
+            return jnp.where(x < 0, jnp.nan, x.astype(jnp.float32))
+        return x
+    if tag == "const":
+        return consts[node[1]]
+    if tag == "bin":
+        a = _eval_node(node[2], leaves, consts, one)
+        b = _eval_node(node[3], leaves, consts, one)
+        out = _ops._BINOPS[node[1]](a, b)
+        if node[1] in _ops._PRESERVE_NAN:
+            out = jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, out)
+        out = out.astype(jnp.float32)
+        if node[1] == "*":
+            # ``one`` is a RUNTIME 1.0: multiplying by it is a bitwise
+            # identity the compiler cannot fold away, and its own FMA
+            # contraction fma(t, 1, c) == t + c exactly. Without it LLVM
+            # contracts this product into a consumer add (fused programs
+            # only — eager kernels have a program boundary there), and the
+            # fused chain would drift a ulp from the eager chain.
+            out = out * one
+        return out
+    if tag == "un":
+        a = _eval_node(node[2], leaves, consts, one)
+        out = _ops._UNOPS[node[1]](a)
+        if node[1] == "not":
+            out = jnp.where(jnp.isnan(a), jnp.nan, out)
+        return out.astype(jnp.float32)
+    # "sel"
+    t = _eval_node(node[1], leaves, consts, one)
+    y = _eval_node(node[2], leaves, consts, one)
+    n = _eval_node(node[3], leaves, consts, one)
+    out = jnp.where(t != 0, y, n)
+    return jnp.where(jnp.isnan(t), jnp.nan, out).astype(jnp.float32)
+
+
+def _expr_program(struct):
+    from h2o3_tpu.parallel.mesh import mesh_key
+
+    key = (struct, mesh_key())
+    prog = _EXPR_PROGS.get(key)
+    if prog is None:
+        import jax
+
+        def run(leaves, consts, one):
+            return _eval_node(struct, leaves, consts, one)
+
+        prog = jax.jit(run)
+        _EXPR_PROGS[key] = prog
+    return prog
+
+
+class LazyExprVec(Vec):
+    """Deferred elementwise expression column (``H2O3_TPU_MUNGE_FUSE=1``).
+
+    Holds the node graph plus references to its leaf Vecs; the fused jitted
+    program runs once on first touch (``munge_dispatches_total{op=expr_fuse}``)
+    — or streams leaf blocks through the ChunkStore window when one is
+    configured, parking the result host-resident (``op=expr_stream``).
+    """
+
+    def __init__(self, node, leaves, consts, nrow: int, name: str = ""):
+        # deliberately NOT calling Vec.__init__ (the LazyVec pattern):
+        # `data`/`_host` are forwarding properties here
+        self.kind = "real"
+        self.name = name
+        self.domain = None
+        self.nrow = int(nrow)
+        self._node = node
+        self._leaves = list(leaves)
+        self._consts = [float(c) for c in consts]
+        self._vec: Vec | None = None
+        self._stats = None
+
+    def _materialize(self) -> Vec:
+        if self._vec is None:
+            self._vec = _materialize_expr(self)
+            self._leaves = None  # release operand refs (may pin big columns)
+            self._stats = None
+        return self._vec
+
+    # -- deferred surfaces ---------------------------------------------------
+    @property
+    def data(self):
+        return self._materialize().data
+
+    @data.setter
+    def data(self, v) -> None:
+        self._materialize().data = v
+
+    @property
+    def _host(self):
+        return self._materialize()._host
+
+    @_host.setter
+    def _host(self, v) -> None:
+        self._materialize()._host = v
+
+    @property
+    def npad(self) -> int:
+        return pad_to_shards(self.nrow)
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._vec is not None
+
+    def to_numpy(self) -> np.ndarray:
+        return self._materialize().to_numpy()
+
+    def host_values(self) -> np.ndarray:
+        return self._materialize().host_values()
+
+    def release_device(self):
+        if self._vec is not None:
+            return self._vec.release_device()
+        return 0
+
+    def stats(self) -> dict:
+        self._materialize()
+        return super().stats()
+
+
+def _materialize_expr(lv: "LazyExprVec") -> Vec:
+    from h2o3_tpu.frame import chunkstore as _cs
+    from h2o3_tpu.frame import munge as _mg
+
+    if _cs.streaming_enabled():
+        out = _materialize_expr_streamed(lv)
+        if out is not None:
+            return out
+    prog = _expr_program(lv._node)
+    leaf_data = tuple(v.data for v in lv._leaves)
+    consts = tuple(np.float32(c) for c in lv._consts)
+    dev = _mg.run_munge(
+        "expr_fuse", prog, (leaf_data, consts, np.float32(1.0)),
+        ops=_node_count(lv._node), leaves=len(leaf_data),
+    )
+    return Vec(dev, "real", name=lv.name, nrow=lv.nrow)
+
+
+def _materialize_expr_streamed(lv: "LazyExprVec") -> Vec | None:
+    """Out-of-core materialization: leaf host mirrors stream through the
+    ChunkStore window block by block (the PR-11 residency fix — no full
+    device columns are pulled), transient result blocks are accounted to
+    ``hbm_owned_bytes{owner=munge}``, and the result parks host-resident.
+    Returns None when the planner says the frame fits resident."""
+    from h2o3_tpu.frame import chunkstore as _cs
+    from h2o3_tpu.frame import munge as _mg
+    from h2o3_tpu.utils import devmem as _dm
+    from h2o3_tpu.utils import jobacct as _ja
+    from h2o3_tpu.utils.metrics import current_trace
+
+    C = len(lv._leaves)
+    npad = pad_to_shards(lv.nrow)
+    store = _cs.ChunkStore.plan(npad, 4.0 * (C + 1))
+    if store is None:
+        return None
+    try:
+        names = []
+        for i, v in enumerate(lv._leaves):
+            buf = np.asarray(v.host_values())
+            if buf.shape[0] != npad:  # mesh changed under the mirror
+                return None
+            store.add(f"l{i}", buf)
+            names.append(f"l{i}")
+        prog = _expr_program(lv._node)
+        consts = tuple(np.float32(c) for c in lv._consts)
+        outbuf = np.empty(npad, np.float32)
+
+        def _run():
+            for bi, blk in store.stream(names):
+                lo, hi = store.span(bi)
+                part = prog(tuple(blk[f"l{i}"] for i in range(C)), consts,
+                            np.float32(1.0))
+                _dm.adjust("munge", float(part.nbytes))
+                try:
+                    outbuf[lo:hi] = np.asarray(part)
+                finally:
+                    _dm.adjust("munge", -float(part.nbytes))
+
+        _mg.run_munge("expr_stream", _run,
+                      ops=_node_count(lv._node), blocks=store.n_blocks)
+        _ja.on_window_bytes(current_trace(), store.peak_hbm)
+    finally:
+        store.close()
+    out = Vec(None, "real", name=lv.name, nrow=lv.nrow)
+    out._seed_host_mirror(outbuf)
+    return out
+
+
+# -- graph builders (called from frame/ops.py under fuse_on()) ---------------
+
+def fusible_operand(x) -> bool:
+    """Can ``x`` enter a fused graph? Mirrors ``_as_device``'s accepted
+    operand set minus strings (which raise there too) — Frames are
+    normalized to their single Vec by the caller."""
+    if isinstance(x, Vec):
+        return x.kind != STR
+    return isinstance(x, (bool, int, float, np.integer, np.floating, np.bool_))
+
+
+def _as_node(x, leaves, consts, leaf_ids, nrow):
+    if isinstance(x, Vec):
+        if (isinstance(x, LazyExprVec) and x._vec is None
+                and _node_count(x._node) < _MAX_EXPR_NODES):
+            assert x.nrow == nrow, "operand row counts differ"
+            return _splice(x._node, x, leaves, consts, leaf_ids)
+        assert x.nrow == nrow, "operand row counts differ"
+        key = id(x)
+        if key not in leaf_ids:
+            leaf_ids[key] = len(leaves)
+            leaves.append(x)
+        return ("leaf", leaf_ids[key], x.kind == CAT)
+    ci = len(consts)
+    consts.append(float(x))
+    return ("const", ci)
+
+
+def _splice(node, src, leaves, consts, leaf_ids):
+    """Graft ``src``'s graph into a new builder, remapping leaf/const slots
+    (leaves dedup by identity so a column shared across operands ships once)."""
+    tag = node[0]
+    if tag == "leaf":
+        v = src._leaves[node[1]]
+        key = id(v)
+        if key not in leaf_ids:
+            leaf_ids[key] = len(leaves)
+            leaves.append(v)
+        return ("leaf", leaf_ids[key], node[2])
+    if tag == "const":
+        consts.append(src._consts[node[1]])
+        return ("const", len(consts) - 1)
+    if tag == "bin":
+        return ("bin", node[1],
+                _splice(node[2], src, leaves, consts, leaf_ids),
+                _splice(node[3], src, leaves, consts, leaf_ids))
+    if tag == "un":
+        return ("un", node[1],
+                _splice(node[2], src, leaves, consts, leaf_ids))
+    return ("sel",) + tuple(_splice(c, src, leaves, consts, leaf_ids)
+                            for c in node[1:])
+
+
+def defer_binop(a: Vec, b, op: str, reflected: bool = False) -> LazyExprVec:
+    leaves, consts, lid = [], [], {}
+    na = _as_node(a, leaves, consts, lid, a.nrow)
+    nb = _as_node(b, leaves, consts, lid, a.nrow)
+    if reflected:
+        na, nb = nb, na
+    return LazyExprVec(("bin", op, na, nb), leaves, consts, a.nrow)
+
+
+def defer_unop(a: Vec, op: str) -> LazyExprVec:
+    leaves, consts, lid = [], [], {}
+    na = _as_node(a, leaves, consts, lid, a.nrow)
+    return LazyExprVec(("un", op, na), leaves, consts, a.nrow)
+
+
+def defer_ifelse(test: Vec, yes, no) -> LazyExprVec:
+    leaves, consts, lid = [], [], {}
+    nt = _as_node(test, leaves, consts, lid, test.nrow)
+    ny = _as_node(yes, leaves, consts, lid, test.nrow)
+    nn = _as_node(no, leaves, consts, lid, test.nrow)
+    return LazyExprVec(("sel", nt, ny, nn), leaves, consts, test.nrow)

@@ -1,0 +1,559 @@
+"""Compiled sharded data-munging plane (ISSUE 20) — the ETL half of the
+paper's platform, built the way the training lanes were built.
+
+H2O's munging ops are MRTask passes over the DKV's compressed chunks; the
+seed reproduced their SEMANTICS eagerly (frame/ops.py: one dispatch per
+elementwise op, a single-device segment reduce per group-by column, a host
+``np.repeat`` expansion inside ``merge``). This module is the compiled
+successor:
+
+- **group-by** runs as ONE mesh-sharded program per ``.agg()`` call: every
+  value column's segment stats accumulate per row shard and reduce through
+  the PR-9 collective wrappers (``ops/collectives.psum`` — the quant lane
+  and the 2-D rows×cols stage-1-exact hierarchy apply unchanged; min/max
+  ride the exact ``pmax``/``pmin`` lanes, extrema cannot quantize).
+- **join** keeps the device sort-merge statistics and replaces the host
+  ``np.repeat`` expansion with an on-device ``searchsorted`` expansion
+  program; single-key joins on >1-device meshes additionally assign their
+  dense key group-ids via a radix-partition ``all_to_all`` exchange
+  (``ASTMerge``'s distributed radix join, on the mesh) instead of one
+  global lexsort over both sides.
+- **sort** compiles key preparation + ``lexsort`` into one cached program.
+- **lazy expression fusion** (frame/lazy.py) dispatches through
+  :func:`run_munge` so its one-fused-program claim is counter-proven.
+
+Every dispatch lands in the flight recorder (``site=munge_*``), the per-job
+ledger (utils/jobacct.py) and ``munge_dispatches_total{op}``; collective
+bytes are captured at first trace and replayed per dispatch exactly like
+the tree builder's ``_run_counted``. Paths that stay eager under
+``H2O3_TPU_MUNGE_FUSE=1`` (string ops, STR/TIME join keys, pivot,
+rank_within_group_by, host aggs) tally
+``munge_fuse_fallbacks_total{reason}`` — the docs/MIGRATION.md fallback
+matrix. ``H2O3_TPU_MUNGE_FUSE=0`` routes nothing here: every seed path
+stays bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.ops import collectives as coll
+from h2o3_tpu.utils import flightrec as _fr
+from h2o3_tpu.utils import jobacct as _ja
+from h2o3_tpu.utils import metrics as _mx
+from h2o3_tpu.utils.metrics import current_trace
+
+DISPATCHES = _mx.counter(
+    "munge_dispatches_total",
+    "compiled munging-plane device dispatches by op (groupby / "
+    "groupby_stream / join / join_exchange / sort / expr_fuse) plus the "
+    "eager elementwise dispatches the fusion replaces (op=elementwise) — "
+    "the expression-chain A/B reads the ratio", always=True)
+FALLBACKS = _mx.counter(
+    "munge_fuse_fallbacks_total",
+    "munging calls that stayed on an eager/host path while the fused "
+    "plane was on, by reason (string_op / host_keys / host_agg / pivot / "
+    "rank_within_group_by / join_multikey / tiny_join / expr_ineligible)",
+    always=True)
+COLL_BYTES = _mx.counter(
+    "munge_collective_bytes_total",
+    "modeled cross-device payload bytes the compiled munging programs "
+    "move, by phase (munge_groupby / munge_join_exchange) and lane — "
+    "captured at first trace, replayed per dispatch like the tree "
+    "builder's tally", always=True)
+
+
+def fuse_on() -> bool:
+    """H2O3_TPU_MUNGE_FUSE: read per call (tests toggle the env)."""
+    from h2o3_tpu import config
+
+    return config.get_bool("H2O3_TPU_MUNGE_FUSE")
+
+
+def fallback(reason: str) -> None:
+    """Tally an eager/host path taken WHILE the fused plane is on."""
+    if fuse_on():
+        FALLBACKS.inc(reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# dispatch wrapper — the munging analog of shared_tree._run_counted: the
+# first dispatch of a program traces under the collective tally; later
+# dispatches replay the captured per-(phase, lane) bytes into the counter
+# and the per-job ledger.
+
+_PROG_COLL: dict = {}  # program cache key -> {(phase, lane): bytes}
+
+
+def run_munge(op: str, fn, args=(), *, coll_key=None, **meta):
+    DISPATCHES.inc(op=op)
+    first = coll_key is not None and coll_key not in _PROG_COLL
+    with _fr.dispatch(f"munge_{op}", **meta):
+        if first:
+            entries: list = []
+            with coll.collective_tally(entries):
+                out = fn(*args)
+            agg: dict = {}
+            for ph, lane, _grp, b in entries:
+                agg[(ph, lane)] = agg.get((ph, lane), 0.0) + b
+            _PROG_COLL[coll_key] = agg
+        else:
+            out = fn(*args)
+    if coll_key is not None:
+        job = current_trace()
+        for (ph, lane), b in _PROG_COLL[coll_key].items():
+            COLL_BYTES.inc(b, phase=ph)
+            COLL_BYTES.inc(b, phase=ph, lane=lane)
+            _ja.on_collective_bytes(job, b, lane=lane)
+    return out
+
+
+def _pow2(n: int, lo: int = 8) -> int:
+    """Power-of-two ladder for compile-key dimensions (group counts,
+    exchange bucket capacities, join output lengths) — unknown-cardinality
+    shapes must not mint one executable per value."""
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _shard_index(mesh):
+    """Global row-shard index of this device inside a shard_map body —
+    shard c*R + r sits on mesh.devices[r, c] (parallel/mesh.row_axes)."""
+    from h2o3_tpu.parallel.mesh import COLS_AXIS, ROWS_AXIS, is_2d
+
+    if is_2d(mesh):
+        r = jax.lax.axis_index(ROWS_AXIS)
+        c = jax.lax.axis_index(COLS_AXIS)
+        return c * mesh.shape[ROWS_AXIS] + r
+    return jax.lax.axis_index(ROWS_AXIS)
+
+
+def _row_axis_names(mesh):
+    from h2o3_tpu.parallel.mesh import row_axes
+
+    ax = row_axes(mesh)
+    return ax if len(ax) > 1 else ax[0]
+
+
+# ---------------------------------------------------------------------------
+# group-by: the sharded histogram machinery generalized to arbitrary
+# aggregates — per-shard segment stats for EVERY value column of one
+# ``.agg()`` call, reduced in one program.
+
+_GB_PROGS: dict = {}
+
+_STAT_ORDER = ("nrow", "sum", "sumsq", "nacnt", "min", "max")
+
+
+def _segment_stats_local(gid, x, gpad: int):
+    """One column's per-shard segment stats — the eager
+    ``ops._segment_aggregate`` body verbatim (parity is an op-for-op
+    argument, not a numeric accident): (4, gpad) sum lanes + (2, gpad)
+    extrema lanes."""
+    g = jnp.where(gid >= 0, gid, 0)
+    ok = (gid >= 0) & ~jnp.isnan(x)
+    xz = jnp.where(ok, x, 0.0)
+    # count/sum/sumsq/nacnt ride ONE 4-wide scatter-add pass (XLA CPU/TPU
+    # scatters are pass-bound, not payload-bound), extrema two more
+    pay = jnp.stack(
+        [ok.astype(jnp.float32), xz, xz * xz,
+         (jnp.isnan(x) & (gid >= 0)).astype(jnp.float32)], axis=1)
+    sums = jnp.zeros((gpad, 4), jnp.float32).at[g].add(pay)
+    mn = jnp.full(gpad, jnp.inf, jnp.float32).at[g].min(
+        jnp.where(ok, x, jnp.inf))
+    mx = jnp.full(gpad, -jnp.inf, jnp.float32).at[g].max(
+        jnp.where(ok, x, -jnp.inf))
+    return sums.T, jnp.stack([mn, mx])
+
+
+def _gb_program(npad: int, C: int, gpad: int):
+    from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.parallel.mesh import (
+        get_mesh, mesh_key, row_pspec, shard_map,
+    )
+
+    key = ("gb", mesh_key(), npad, C, gpad)
+    prog = _GB_PROGS.get(key)
+    if prog is not None:
+        return key, prog
+    mesh = get_mesh()
+    nd = int(mesh.devices.size)
+
+    def body(gid, *xs):
+        sums, exts = jax.vmap(
+            lambda x: _segment_stats_local(gid, x, gpad))(jnp.stack(xs))
+        if nd > 1:
+            sums = coll.psum(
+                sums, n_dev=nd, phase="munge_groupby", mesh=mesh)
+            mn = coll.exact_pmin(exts[:, 0], mesh, phase="munge_groupby")
+            mx = coll.exact_pmax(exts[:, 1], mesh, phase="munge_groupby")
+        else:
+            mn, mx = exts[:, 0], exts[:, 1]
+        return jnp.concatenate(
+            [sums, mn[:, None], mx[:, None]], axis=1)  # (C, 6, gpad)
+
+    spec = row_pspec(mesh)
+    f = shard_map(
+        body, mesh, in_specs=(spec,) * (C + 1), out_specs=P(),
+        check_vma=False,
+    )
+    prog = jax.jit(f)
+    _GB_PROGS[key] = prog
+    return key, prog
+
+
+def groupby_stats(gid: np.ndarray, xs_dev: list, ngroups: int) -> list:
+    """Sharded segment aggregation of every value column in ONE dispatch.
+
+    ``gid``: (nrow,) int32 host codes, -1 = NA key (dropped, matching the
+    eager path); ``xs_dev``: padded (npad,) f32 device columns. Returns one
+    eager-shaped stat dict per column (np arrays of length ``ngroups``)."""
+    from h2o3_tpu.parallel.mesh import shard_rows
+
+    npad = int(xs_dev[0].shape[0])
+    gpad = _pow2(max(int(ngroups), 1))
+    gp = np.full(npad, -1, np.int32)
+    gp[: len(gid)] = gid
+    gid_dev = shard_rows(gp)
+    key, prog = _gb_program(npad, len(xs_dev), gpad)
+    out = run_munge(
+        "groupby", prog, (gid_dev, *xs_dev), coll_key=key,
+        cols=len(xs_dev), groups=int(ngroups))
+    r = np.asarray(out)[:, :, :ngroups]
+    return [
+        {name: r[i, j] for j, name in enumerate(_STAT_ORDER)}
+        for i in range(r.shape[0])
+    ]
+
+
+# -- streamed variant: block-accumulate through the ChunkStore window so a
+# group-by over a frame past the HBM window runs out-of-core. Blocks arrive
+# row-sharded; the tiny (C, 6, gpad) accumulator stays device-resident.
+
+
+@partial(jax.jit, static_argnames=("gpad",))
+def _gb_block_kernel(gid, xs, gpad: int):
+    sums, exts = jax.vmap(
+        lambda x: _segment_stats_local(gid, x, gpad))(jnp.stack(xs))
+    return jnp.concatenate(
+        [sums, exts[:, 0][:, None], exts[:, 1][:, None]], axis=1)
+
+
+@jax.jit
+def _gb_merge(acc, part):
+    return jnp.concatenate(
+        [acc[:, :4] + part[:, :4],
+         jnp.minimum(acc[:, 4:5], part[:, 4:5]),
+         jnp.maximum(acc[:, 5:6], part[:, 5:6])], axis=1)
+
+
+def groupby_stats_streamed(gid: np.ndarray, host_cols: list, ngroups: int):
+    """Out-of-core group-by: stream (gid, value) row blocks through a
+    ChunkStore window, accumulating the small per-group stat tensor on
+    device. Returns eager-shaped stat dicts, or None when the planner says
+    the frame fits resident (callers then take :func:`groupby_stats`)."""
+    from h2o3_tpu.frame import chunkstore as _cs
+
+    C = len(host_cols)
+    npad = int(host_cols[0].shape[0])
+    store = _cs.ChunkStore.plan(npad, 4.0 * (C + 1))
+    if store is None:
+        return None
+    gpad = _pow2(max(int(ngroups), 1))
+    gp = np.full(npad, -1, np.int32)
+    gp[: len(gid)] = gid
+    store.add("gid", gp)
+    names = ["gid"]
+    for i, cb in enumerate(host_cols):
+        store.add(f"x{i}", np.asarray(cb, np.float32))
+        names.append(f"x{i}")
+
+    def _accumulate():
+        acc = None
+        for _bi, blk in store.stream(names):
+            part = _gb_block_kernel(
+                blk["gid"], tuple(blk[f"x{i}"] for i in range(C)), gpad)
+            acc = part if acc is None else _gb_merge(acc, part)
+        return acc
+
+    try:
+        out = run_munge(
+            "groupby_stream", _accumulate, cols=C, groups=int(ngroups),
+            blocks=store.n_blocks)
+        _ja.on_window_bytes(current_trace(), store.peak_hbm)
+    finally:
+        store.close()
+    r = np.asarray(out)[:, :, :ngroups]
+    return [
+        {name: r[i, j] for j, name in enumerate(_STAT_ORDER)}
+        for i in range(r.shape[0])
+    ]
+
+
+# ---------------------------------------------------------------------------
+# join: device expansion of the sort-merge statistics (replacing the host
+# np.repeat path) + the radix-partition all_to_all gid exchange.
+
+
+@jax.jit
+def _join_cum_kernel(lo, m, rorder, matched_r, all_x_flag):
+    m_out = jnp.where(all_x_flag, jnp.maximum(m, 1), m)
+    cum = jnp.cumsum(m_out.astype(jnp.int32))
+    return lo.astype(jnp.int32), m.astype(jnp.int32), cum, rorder, matched_r
+
+
+@partial(jax.jit, static_argnames=("mpad",))
+def _expand_kernel(lo, m, cum, rorder, mpad: int):
+    """(li, ri) output index vectors from per-left-row match ranges —
+    the eager path's five np.repeat passes as one device program."""
+    n_l = lo.shape[0]
+    n_r = rorder.shape[0]
+    total = cum[-1] if n_l else jnp.int32(0)
+    j = jnp.arange(mpad, dtype=jnp.int32)
+    valid = j < total
+    # searchsorted(cum, j, 'right') as scatter + prefix-sum: one mark per
+    # left row at its output offset, cumsum turns marks into row indices —
+    # O(n_l + mpad) vectorized vs the binary search's mpad*log(n_l) gathers
+    marks = jnp.zeros(mpad, jnp.int32).at[cum].add(1, mode="drop")
+    li = jnp.clip(jnp.cumsum(marks), 0, max(n_l - 1, 0)).astype(jnp.int32)
+    m_out_li = jnp.where(li > 0, cum[li] - cum[jnp.maximum(li - 1, 0)], cum[li])
+    start = cum[li] - m_out_li
+    within = j - start
+    has = m[li] > 0
+    rpos = lo[li] + within
+    ri = jnp.where(
+        valid & has,
+        rorder[jnp.clip(rpos, 0, max(n_r - 1, 0))].astype(jnp.int32)
+        if n_r else jnp.int32(-1),
+        -1,
+    )
+    li_out = jnp.where(valid, li, -1)
+    return li_out, ri, total
+
+
+def join_expand(lo_d, m_d, rorder_d, matched_d, all_x: bool, all_y: bool,
+                n_r: int):
+    """Device expansion lane of ``merge``: returns host (li, ri) int64
+    index vectors with the exact eager-path ordering contract (match
+    groups in left-frame order; unmatched right rows appended for
+    right/outer joins)."""
+    lo, m, cum, rorder, matched_r = _join_cum_kernel(
+        lo_d, m_d, rorder_d, matched_d, jnp.bool_(all_x))
+    total = int(np.asarray(cum[-1])) if int(lo.shape[0]) else 0
+    mpad = _pow2(max(total, 1), lo=1024)
+    li_d, ri_d, _ = run_munge(
+        "join", _expand_kernel, (lo, m, cum, rorder, mpad),
+        rows=total)
+    li = np.asarray(li_d, np.int64)[:total]
+    ri = np.asarray(ri_d, np.int64)[:total]
+    if all_y and n_r:
+        extra = np.nonzero(~np.asarray(matched_r, bool))[0].astype(np.int64)
+        li = np.concatenate([li, np.full(len(extra), -1, np.int64)])
+        ri = np.concatenate([ri, extra])
+    return li, ri
+
+
+# -- radix-partition gid exchange: dense key group-ids for single-key joins
+# assigned DISTRIBUTEDLY — each device owns one hash partition, both sides'
+# (key, row) pairs exchange over all_to_all, the owner ranks its partition's
+# distinct keys locally, and gids (partition offset + local rank) ride the
+# reverse exchange home. Replaces the global lexsort over the concatenated
+# key matrix for the meshes where that sort is the join's dominant cost.
+
+_JX_COUNT_PROGS: dict = {}
+_JX_PROGS: dict = {}
+
+def _jx_partition(key, valid, nd: int):
+    # murmur3 finalizer: float-bitcast key codes differ mostly in LOW
+    # mantissa bits, so the partition needs full avalanche (a bare
+    # multiplicative hash clumps small-integer-valued floats into two
+    # partitions and the skew guard then rejects every join)
+    h = key.astype(jnp.uint32)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return jnp.where(valid, (h % jnp.uint32(nd)).astype(jnp.int32), nd)
+
+
+def _jx_count_program(npad_l: int, npad_r: int):
+    from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.parallel.mesh import (
+        get_mesh, mesh_key, row_pspec, shard_map,
+    )
+
+    key = ("jxc", mesh_key(), npad_l, npad_r)
+    prog = _JX_COUNT_PROGS.get(key)
+    if prog is not None:
+        return prog
+    mesh = get_mesh()
+    nd = int(mesh.devices.size)
+    ax = _row_axis_names(mesh)
+
+    def body(kl, kr, n_l, n_r):
+        sh = _shard_index(mesh)
+
+        def side_max(k, n):
+            loc = k.shape[0]
+            gidx = sh * loc + jnp.arange(loc, dtype=jnp.int32)
+            p = _jx_partition(k, gidx < n, nd)
+            cnt = jnp.zeros(nd, jnp.int32).at[p].add(
+                1, mode="drop")
+            return jnp.max(cnt)
+
+        cap = jnp.maximum(side_max(kl, n_l), side_max(kr, n_r))
+        return jax.lax.pmax(cap, ax)
+
+    f = shard_map(
+        body, mesh, in_specs=(row_pspec(mesh), row_pspec(mesh), P(), P()),
+        out_specs=P(), check_vma=False)
+    prog = jax.jit(f)
+    _JX_COUNT_PROGS[key] = prog
+    return prog
+
+
+def _jx_program(npad_l: int, npad_r: int, cap: int):
+    from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.parallel.mesh import (
+        get_mesh, mesh_key, row_pspec, shard_map,
+    )
+
+    key = ("jx", mesh_key(), npad_l, npad_r, cap)
+    prog = _JX_PROGS.get(key)
+    if prog is not None:
+        return key, prog
+    mesh = get_mesh()
+    nd = int(mesh.devices.size)
+    ax = _row_axis_names(mesh)
+
+    # Unfilled bucket slots carry the canonical-NaN bit pattern instead of a
+    # separate validity plane: numeric NA keys already hold exactly those bits
+    # (``_key_codes_device`` canonicalises), so empty slots merge into the NA
+    # key group — gids only need EQUALITY consistency and an injective
+    # labeling, never density, so one phantom group per partition is free.
+    # This removes the two validity exchanges and the 2-key lexsort, and the
+    # arrival-rank bookkeeping below replaces the per-side stable argsort.
+    empty = jnp.int32(
+        np.float32(np.nan).view(np.int32))  # == the canonical NA key code
+
+    def scatter_side(k, n, sh):
+        """Local rows → (nd, cap) exchange buckets + the (partition, slot)
+        placement needed to route gids back. Slot = arrival rank within the
+        partition, computed by a one-hot running count (no sort)."""
+        loc = k.shape[0]
+        gidx = sh * loc + jnp.arange(loc, dtype=jnp.int32)
+        valid = gidx < n
+        p = _jx_partition(k, valid, nd)  # nd for padding rows
+        oh = (p[:, None] == jnp.arange(nd, dtype=jnp.int32)[None, :])
+        within = jnp.take_along_axis(
+            jnp.cumsum(oh.astype(jnp.int32), axis=0) - 1,
+            jnp.clip(p, 0, nd - 1)[:, None], axis=1)[:, 0]
+        keys_b = jnp.full((nd, cap), empty, jnp.int32).at[p, within].set(
+            k, mode="drop")  # p=nd (padding) rows drop
+        return keys_b, p, within
+
+    def body(kl, kr, n_l, n_r):
+        sh = _shard_index(mesh)
+        kb_l, p_l, wi_l = scatter_side(kl, n_l, sh)
+        kb_r, p_r, wi_r = scatter_side(kr, n_r, sh)
+        # ONE exchange forward (both sides packed), one back with the gids:
+        # partition p of every device lands on device p.
+        got = coll.all_to_all_exchange(
+            jnp.concatenate([kb_l, kb_r], axis=1), axis_name=ax,
+            phase="munge_join_exchange")
+        # local dense ranks over this partition's combined key set — raw
+        # int32 bit order (key ORDER is irrelevant, only equality groups)
+        bits = got.reshape(-1)
+        order = jnp.argsort(bits)
+        sb = bits[order]
+        bump = (sb[1:] != sb[:-1]).astype(jnp.int32)
+        rank_sorted = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(bump)])
+        ranks = jnp.zeros(bits.shape[0], jnp.int32).at[order].set(rank_sorted)
+        ucount = rank_sorted[-1] + 1
+        uc_all = jax.lax.all_gather(ucount, ax, axis=0, tiled=False)
+        uc_all = uc_all.reshape(-1)
+        offset = (jnp.cumsum(uc_all) - uc_all)[sh]
+        gb = coll.all_to_all_exchange(
+            (offset + ranks).reshape(got.shape), axis_name=ax,
+            phase="munge_join_exchange")
+        gl = gb[:, :cap][jnp.clip(p_l, 0, nd - 1), wi_l]
+        gr = gb[:, cap:][jnp.clip(p_r, 0, nd - 1), wi_r]
+        return gl, gr
+
+    spec = row_pspec(mesh)
+    f = shard_map(
+        body, mesh, in_specs=(spec, spec, P(), P()),
+        out_specs=(spec, spec), check_vma=False)
+    prog = jax.jit(f)
+    _JX_PROGS[key] = prog
+    return key, prog
+
+
+def tuple_gids_exchange(klp, krp, n_l: int, n_r: int):
+    """Distributed dense gid assignment for one int32 key column per side.
+
+    ``klp``/``krp`` are the PADDED row-sharded device code columns (padding
+    rows are masked by the row counts — numeric padding shares the NA code,
+    so masking is load-bearing). Returns (gl, gr) sliced to the true row
+    counts, or None when the mesh has one device (nothing to exchange)."""
+    from h2o3_tpu.parallel.mesh import get_mesh, n_shards
+
+    nd = n_shards()
+    if nd <= 1:
+        return None
+    mesh = get_mesh()
+    npad_l, npad_r = int(klp.shape[0]), int(krp.shape[0])
+    counter = _jx_count_program(npad_l, npad_r)
+    cap = int(np.asarray(counter(
+        klp, krp, jnp.int32(n_l), jnp.int32(n_r))))
+    cap = _pow2(max(cap, 1))
+    if cap * nd * nd > 4 * max(npad_l + npad_r, 1):
+        # degenerate skew: one partition holds ~everything — the exchange
+        # buffers would dwarf the data. The lexsort lane is the right tool.
+        fallback("join_skewed")
+        return None
+    key, prog = _jx_program(npad_l, npad_r, cap)
+    gl, gr = run_munge(
+        "join_exchange", prog,
+        (klp, krp, jnp.int32(n_l), jnp.int32(n_r)),
+        coll_key=key, rows_l=n_l, rows_r=n_r)
+    return gl[:n_l], gr[:n_r]
+
+
+# ---------------------------------------------------------------------------
+# sort: key prep + lexsort as one cached program.
+
+
+@partial(jax.jit, static_argnames=("kinds", "asc", "nrow"))
+def _sort_kernel(vs, kinds, asc, nrow: int):
+    keys = []
+    for v, kd, a in zip(vs, kinds, asc):
+        k = v[:nrow]
+        if kd == "enum":
+            k = k.astype(jnp.float32)
+        if not a:
+            k = -k  # NaN stays NaN → still sorts last, like pandas
+        keys.append(k)
+    return jnp.lexsort(tuple(reversed(keys)))
+
+
+def sort_order(vs_data, kinds, asc, nrow: int) -> np.ndarray:
+    """Row order of a multi-key sort in one compiled dispatch — key
+    negation for descending and the lexsort fused (the eager lane runs one
+    device op per descending key before its lexsort)."""
+    out = run_munge(
+        "sort", _sort_kernel,
+        (tuple(vs_data), tuple(kinds), tuple(bool(a) for a in asc), nrow),
+        keys=len(kinds), rows=nrow)
+    return np.asarray(out)

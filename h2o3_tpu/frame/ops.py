@@ -128,12 +128,23 @@ def _as_device(x, like: Vec):
 def _binop(a: Vec, b, op: str, reflected: bool = False) -> Vec:
     if isinstance(b, str):
         return _binop_str(a, b, op)
-    if (
+    cross_enum = (
         isinstance(b, Vec)
         and a.kind == CAT
         and b.kind == CAT
         and a.domain != b.domain
-    ):
+    )
+    if not cross_enum:
+        from h2o3_tpu.frame import lazy as _lz
+        from h2o3_tpu.frame import munge as _mg
+
+        bb = b.vec(0) if isinstance(b, Frame) and b.ncol == 1 else b
+        if _mg.fuse_on() and _lz.fusible_operand(a) and _lz.fusible_operand(bb):
+            # defer: the op joins a LazyExprVec graph and compiles with its
+            # whole chain on first touch (frame/lazy.py expression fusion)
+            return _lz.defer_binop(a, bb, op, reflected)
+        _mg.DISPATCHES.inc(op="elementwise")
+    if cross_enum:
         # enums with different domains compare by LABEL: remap b's codes into
         # a's domain space (labels absent from a get distinct no-match codes)
         if op not in ("==", "!="):
@@ -158,6 +169,12 @@ def _binop_str(a: Vec, s: str, op: str) -> Vec:
     resolves to its code (no match → all-0 indicator with NA passthrough)."""
     if op not in ("==", "!="):
         raise TypeError(f"operator {op!r} not supported between a column and a string")
+    from h2o3_tpu.frame import munge as _mg
+
+    if a.kind == STR:
+        _mg.fallback("string_op")  # host pass; stays eager under fusion
+    else:
+        _mg.DISPATCHES.inc(op="elementwise")
     if a.kind == CAT:
         try:
             code = (a.domain or ()).index(s)
@@ -178,11 +195,26 @@ def _binop_str(a: Vec, s: str, op: str) -> Vec:
 
 
 def _unop(a: Vec, op: str) -> Vec:
+    from h2o3_tpu.frame import lazy as _lz
+    from h2o3_tpu.frame import munge as _mg
+
+    if _mg.fuse_on() and _lz.fusible_operand(a):
+        return _lz.defer_unop(a, op)
+    _mg.DISPATCHES.inc(op="elementwise")
     return Vec(_unop_kernel(_as_device(a, a), op), NUM, nrow=a.nrow)
 
 
 def ifelse(test: Vec, yes, no) -> Vec:
     """``ASTIfElse`` successor: elementwise select, NA where test is NA."""
+    from h2o3_tpu.frame import lazy as _lz
+    from h2o3_tpu.frame import munge as _mg
+
+    yy = yes.vec(0) if isinstance(yes, Frame) and yes.ncol == 1 else yes
+    nn = no.vec(0) if isinstance(no, Frame) and no.ncol == 1 else no
+    if (_mg.fuse_on() and isinstance(test, Vec) and _lz.fusible_operand(test)
+            and _lz.fusible_operand(yy) and _lz.fusible_operand(nn)):
+        return _lz.defer_ifelse(test, yy, nn)
+    _mg.DISPATCHES.inc(op="elementwise")
     t = _as_device(test, test)
     y = _as_device(yes, test)
     n = _as_device(no, test)
@@ -304,9 +336,44 @@ class GroupBy:
         self._uniques = uniques
         self._ngroups = len(uniques)
 
+    _DEV_AGGS = ("count", "nrow", "sum", "mean", "min", "max", "var", "sd", "sumsq")
+
     def agg(self, spec: Mapping[str, Sequence[str] | str]) -> Frame:
+        from h2o3_tpu.frame import chunkstore as _cs
+        from h2o3_tpu.frame import munge as _mg
+
         ngroups = self._ngroups
-        gid_dev = Vec.from_numpy(self._gid, CAT, domain=[str(i) for i in range(max(1, ngroups))]).data
+        items = [(c, [a] if isinstance(a, str) else list(a))
+                 for c, a in spec.items()]
+        dev_cols = [c for c, aggs in items
+                    if any(a in self._DEV_AGGS for a in aggs)]
+        fused = _mg.fuse_on() and dev_cols and ngroups > 0
+        fused_stats: dict[str, dict] = {}
+        if fused:
+            # compiled lane: EVERY value column's segment stats in ONE
+            # mesh-sharded dispatch (frame/munge.py) — streamed through the
+            # ChunkStore window when one is configured, resident otherwise
+            stats_list = None
+            if _cs.streaming_enabled():
+                host_cols = []
+                for c in dev_cols:
+                    v = self.frame.vec(c)
+                    hv = np.asarray(v.host_values())
+                    if v.kind == CAT:
+                        hv = np.where(hv < 0, np.nan, hv.astype(np.float32))
+                    host_cols.append(np.asarray(hv, np.float32))
+                stats_list = _mg.groupby_stats_streamed(
+                    self._gid, host_cols, ngroups)
+            if stats_list is None:
+                xs = []
+                for c in dev_cols:
+                    v = self.frame.vec(c)
+                    xs.append(_codes_as_float(v.data) if v.kind == CAT
+                              else v.data)
+                stats_list = _mg.groupby_stats(self._gid, xs, ngroups)
+            fused_stats = dict(zip(dev_cols, stats_list))
+        else:
+            gid_dev = Vec.from_numpy(self._gid, CAT, domain=[str(i) for i in range(max(1, ngroups))]).data
         out_cols: dict[str, np.ndarray] = {}
         # key columns
         if len(self.by) == 1:
@@ -314,14 +381,18 @@ class GroupBy:
         else:
             for i, b in enumerate(self.by):
                 out_cols[b] = np.asarray(self._uniques.get_level_values(i))
-        for col, aggs in spec.items():
-            aggs = [aggs] if isinstance(aggs, str) else list(aggs)
+        for col, aggs in items:
             v = self.frame.vec(col)
-            need_device = any(a in ("count", "nrow", "sum", "mean", "min", "max", "var", "sd", "sumsq") for a in aggs)
+            need_device = any(a in self._DEV_AGGS for a in aggs)
             stats = None
             if need_device:
-                x = _codes_as_float(v.data) if v.kind == CAT else v.data
-                stats = {k: np.asarray(s) for k, s in _segment_aggregate(gid_dev, x, ngroups).items()}
+                if fused:
+                    stats = fused_stats[col]
+                else:
+                    x = _codes_as_float(v.data) if v.kind == CAT else v.data
+                    stats = {k: np.asarray(s) for k, s in _segment_aggregate(gid_dev, x, ngroups).items()}
+            if any(a in ("median", "mode", "first", "last") for a in aggs):
+                _mg.fallback("host_agg")
             for a in aggs:
                 name = f"{a}_{col}"
                 if a in ("count", "nrow"):
@@ -393,7 +464,7 @@ def _domain_union(dom_a, dom_b):
     return union
 
 
-def _key_codes_device(v, union_pos: dict | None = None):
+def _key_codes_device(v, union_pos: dict | None = None, padded: bool = False):
     """(nrow,) int32 device codes for one join/sort key column.
 
     Equal values get equal codes; NA is its own code (-1 for enums, the
@@ -401,10 +472,12 @@ def _key_codes_device(v, union_pos: dict | None = None):
     former pandas path behaved. int32 on purpose (JAX default x64-disabled
     mode truncates int64 anyway): group-id space caps at ~2^31 combined
     rows, beyond per-host frame sizes here. Returns None for kinds that
-    need the host path (STR / TIME)."""
+    need the host path (STR / TIME). ``padded=True`` keeps the full
+    row-sharded padded column (the radix-exchange lane masks padding by
+    row count instead of slicing)."""
     if v.kind in (STR, TIME):
         return None
-    x = v.data[: v.nrow]
+    x = v.data if padded else v.data[: v.nrow]
     if v.kind == CAT:
         if union_pos is None:
             return x.astype(jnp.int32)
@@ -480,6 +553,34 @@ def _merge_keys_device(left, right, bx, bby):
     return gl, gr
 
 
+def _exchange_gids(left, right, bx, bby):
+    """Radix-partition ``all_to_all`` gid lane (frame/munge.py) for
+    single-key joins on multi-device meshes. Returns (gl, gr) or None —
+    the caller then takes the global-lexsort lane. Any injective gid
+    relabeling yields the same join output (``_join_stats``'s stable
+    right argsort keys on gid EQUALITY only), so the two lanes agree
+    bit-for-bit on the merged frame."""
+    from h2o3_tpu.frame import munge as _mg
+    from h2o3_tpu.parallel.mesh import n_shards
+
+    if len(bx) != 1 or n_shards() <= 1 or not left.nrow or not right.nrow:
+        return None
+    vl, vr = left.vec(bx[0]), right.vec(bby[0])
+    if vl.kind in (STR, TIME) or vr.kind in (STR, TIME):
+        return None
+    if (vl.kind == CAT) != (vr.kind == CAT):
+        return None  # mixed enum/numeric key: host path decides
+    if vl.kind == CAT:
+        union = _domain_union(vl.domain, vr.domain)
+        pos = {d: i for i, d in enumerate(union)}
+        klp = _key_codes_device(vl, pos, padded=True)
+        krp = _key_codes_device(vr, pos, padded=True)
+    else:
+        klp = _key_codes_device(vl, padded=True)
+        krp = _key_codes_device(vr, padded=True)
+    return _mg.tuple_gids_exchange(klp, krp, left.nrow, right.nrow)
+
+
 def merge(
     left: Frame,
     right: Frame,
@@ -492,7 +593,19 @@ def merge(
     bx = list(by_x or by or [n for n in left.names if n in set(right.names)])
     bby = list(by_y or by or bx)
 
-    dev = _merge_keys_device(left, right, bx, bby)
+    from h2o3_tpu.frame import munge as _mg
+    from h2o3_tpu.parallel.mesh import n_shards
+
+    fused = _mg.fuse_on()
+    dev = None
+    if fused:
+        dev = _exchange_gids(left, right, bx, bby)
+        if dev is None and len(bx) > 1 and n_shards() > 1:
+            _mg.fallback("join_multikey")
+    if dev is None:
+        dev = _merge_keys_device(left, right, bx, bby)
+        if dev is None:
+            _mg.fallback("host_keys")
     if dev is not None:
         # Output row order (device path): match groups in LEFT-frame order
         # (within a group, right-frame order), then — for right/outer joins —
@@ -502,27 +615,36 @@ def merge(
         # fallback below keeps pandas' native ordering.
         gl, gr = dev
         lo_d, m_d, rorder_d, matched_d = _join_stats(gl, gr, need_matched=all_y)
-        lo, m, rorder, matched_r = (
-            np.asarray(lo_d, np.int64),
-            np.asarray(m_d, np.int64),
-            np.asarray(rorder_d, np.int64),
-            np.asarray(matched_d, bool),
-        )
-        nr = right.nrow
-        m_out = np.maximum(m, 1) if all_x else m
-        li = np.repeat(np.arange(left.nrow, dtype=np.int64), m_out)
-        off = np.repeat(np.cumsum(m_out) - m_out, m_out)
-        within = np.arange(len(li), dtype=np.int64) - off
-        has = np.repeat(m > 0, m_out)
-        rpos = np.repeat(lo, m_out) + within
-        ri = np.where(
-            has, rorder[np.minimum(rpos, max(nr - 1, 0))] if nr else -1, -1
-        ).astype(np.int64)
-        if all_y and nr:
-            extra = np.nonzero(~matched_r)[0].astype(np.int64)
-            li = np.concatenate([li, np.full(len(extra), -1, np.int64)])
-            ri = np.concatenate([ri, extra])
-        lvalid = li >= 0
+        if fused and left.nrow and right.nrow:
+            # compiled expansion: the five np.repeat passes below as one
+            # device searchsorted program (frame/munge.join_expand) —
+            # identical (li, ri) bits by construction
+            li, ri = _mg.join_expand(
+                lo_d, m_d, rorder_d, matched_d, all_x, all_y, right.nrow)
+            lvalid = li >= 0
+        else:
+            _mg.fallback("tiny_join")
+            lo, m, rorder, matched_r = (
+                np.asarray(lo_d, np.int64),
+                np.asarray(m_d, np.int64),
+                np.asarray(rorder_d, np.int64),
+                np.asarray(matched_d, bool),
+            )
+            nr = right.nrow
+            m_out = np.maximum(m, 1) if all_x else m
+            li = np.repeat(np.arange(left.nrow, dtype=np.int64), m_out)
+            off = np.repeat(np.cumsum(m_out) - m_out, m_out)
+            within = np.arange(len(li), dtype=np.int64) - off
+            has = np.repeat(m > 0, m_out)
+            rpos = np.repeat(lo, m_out) + within
+            ri = np.where(
+                has, rorder[np.minimum(rpos, max(nr - 1, 0))] if nr else -1, -1
+            ).astype(np.int64)
+            if all_y and nr:
+                extra = np.nonzero(~matched_r)[0].astype(np.int64)
+                li = np.concatenate([li, np.full(len(extra), -1, np.int64)])
+                ri = np.concatenate([ri, extra])
+            lvalid = li >= 0
     else:
         how = (
             "outer" if (all_x and all_y) else "left" if all_x else "right" if all_y else "inner"
@@ -606,7 +728,16 @@ def sort(frame: Frame, by: Sequence[str] | str, ascending: bool | Sequence[bool]
     by = [by] if isinstance(by, str) else list(by)
     asc = [ascending] * len(by) if isinstance(ascending, bool) else list(ascending)
     vs = [frame.vec(b) for b in by]
+    from h2o3_tpu.frame import munge as _mg
+
     if all(v.kind not in (STR, TIME) for v in vs):
+        if _mg.fuse_on():
+            # one cached program: key prep (enum cast, descending negation)
+            # + lexsort compiled together — same keys, same stable lexsort,
+            # same order bits as the eager lane below
+            order = _mg.sort_order(
+                [v.data for v in vs], [v.kind for v in vs], asc, frame.nrow)
+            return frame.gather_rows(order)
         # device multi-key stable lexsort (numerics sort NaN last either
         # direction, matching pandas na_position='last'; enums sort by code
         # with NA (-1) first ascending, exactly the former host behavior)
@@ -620,6 +751,7 @@ def sort(frame: Frame, by: Sequence[str] | str, ascending: bool | Sequence[bool]
             keys.append(k)
         order = jnp.lexsort(tuple(reversed(keys)))  # np.lexsort: last = primary
         return frame.gather_rows(np.asarray(order))
+    _mg.fallback("host_keys")
     df = pd.DataFrame({b: frame.vec(b).to_numpy() for b in by})
     order = df.sort_values(by=by, ascending=asc, kind="stable").index.to_numpy()
     return frame.gather_rows(order)
@@ -807,6 +939,9 @@ def rank_within_group_by(
     group run. NA sort-key rows keep rank NA like upstream. When
     ``sort_cols_sorted`` the output rows come back sorted by the group+sort
     order, else original row order."""
+    from h2o3_tpu.frame import munge as _mg
+
+    _mg.fallback("rank_within_group_by")  # eager lexsort lane for now
     gcols = list(group_by_cols)
     scols = list(sort_cols)
     asc = [ascending] * len(scols) if isinstance(ascending, bool) else list(ascending)
@@ -858,9 +993,12 @@ def pivot(frame: Frame, index: str, column: str, value: str) -> Frame:
     """``ASTPivot`` successor: long → wide. One output row per ``index``
     value, one output column per ``column`` enum level, cells = mean of
     ``value`` over the (index, level) pair (upstream averages duplicates)."""
+    from h2o3_tpu.frame import munge as _mg
+
     cv = frame.vec(column)
     if cv.kind != CAT:
         raise ValueError("pivot: 'column' must be categorical")
+    _mg.fallback("pivot")  # host long→wide reshape stays eager for now
     agg = group_by(frame, [index, column]).agg({value: "mean"})
     adf = agg.to_pandas()
     vcol = f"mean_{value}"  # group_by agg naming convention

@@ -427,3 +427,42 @@ def exact_psum(x, mesh=None):
     if is_2d(m):
         return jax.lax.psum(jax.lax.psum(x, ROWS_AXIS), COLS_AXIS)
     return jax.lax.psum(x, ROWS_AXIS)
+
+
+def exact_pmax(x, mesh=None, phase: str | None = None):
+    """Exact ``pmax`` over the full row-shard device set — the min/max lanes
+    of the sharded group-by segment reduce (extrema cannot ride the additive
+    quant lane; they are exact by construction in any order). Staged
+    rows-then-cols on a 2-D mesh like :func:`exact_psum`."""
+    from h2o3_tpu.parallel.mesh import COLS_AXIS, get_mesh, is_2d
+
+    m = mesh or get_mesh()
+    if phase:
+        record_collective(phase, x.size * 4.0, lane="exact")
+    if is_2d(m):
+        return jax.lax.pmax(jax.lax.pmax(x, ROWS_AXIS), COLS_AXIS)
+    return jax.lax.pmax(x, ROWS_AXIS)
+
+
+def exact_pmin(x, mesh=None, phase: str | None = None):
+    """Exact ``pmin`` counterpart of :func:`exact_pmax`."""
+    from h2o3_tpu.parallel.mesh import COLS_AXIS, get_mesh, is_2d
+
+    m = mesh or get_mesh()
+    if phase:
+        record_collective(phase, x.size * 4.0, lane="exact")
+    if is_2d(m):
+        return jax.lax.pmin(jax.lax.pmin(x, ROWS_AXIS), COLS_AXIS)
+    return jax.lax.pmin(x, ROWS_AXIS)
+
+
+def all_to_all_exchange(x, *, axis_name: str = ROWS_AXIS,
+                        phase: str | None = None):
+    """Tiled ``all_to_all`` over leading axis 0 (bucket ``d`` of every
+    device lands on device ``d``) with the trace-time byte tally — the
+    radix-partition exchange step of the distributed hash join. Payloads
+    stay exact (small int key codes + row indices; quantizing indices would
+    corrupt the join), so the whole tensor counts as exact wire bytes."""
+    if phase:
+        record_collective(phase, x.size * x.dtype.itemsize, lane="exact")
+    return jax.lax.all_to_all(x, axis_name, 0, 0, tiled=True)

@@ -33,6 +33,15 @@ barely matters — bin count and tile sizes are the levers.
         # (bit-identical controls, AUC/RMSE envelopes, shrink ratios),
         # then a {"wave2_ab": ...} summary line.
 
+    python tools/bench_kernel_sweep.py --munge-ab [--rows N]
+        # compiled-munging-plane A/B (H2O3_TPU_MUNGE_FUSE, ISSUE 20):
+        # group-by / join / sort each run the fused mesh-sharded lane vs
+        # the eager seed path on the SAME data, plus the 10-op expression
+        # chain's dispatch-count pin, then a {"munge_ab": ...} summary
+        # with the acceptance pins (fused wall <= 0.5x eager for group-by
+        # and join, sort no worse, chain dispatches cut >= 5x, joins /
+        # sort / chain bit-equal, group-by counts exact + sums allclose).
+
     python tools/bench_kernel_sweep.py --oocore-ab [--rows N]
         # streamed-vs-resident out-of-core A/B (ISSUE 11): forces an HBM
         # window of 1/10th the frame's training lanes, measures wall time,
@@ -1025,6 +1034,152 @@ def wave2_ab(rows: int = 8_000) -> None:
     }}), flush=True)
 
 
+def munge_ab(rows: int = 200_000) -> None:
+    """Compiled munging plane A/B (H2O3_TPU_MUNGE_FUSE, ISSUE 20) on the
+    SAME host data per mode: group-by (all value columns' segment stats in
+    one mesh-sharded dispatch vs one eager segment-reduce per column),
+    join (radix all_to_all gid exchange + device expansion vs global
+    lexsort + host np.repeat), sort (one cached key-prep+lexsort program
+    vs staged eager), and the 10-op rapids-style expression chain (ONE
+    fused program vs 10 eager kernels, counter-proven). One JSON line per
+    (case, mode), then a {"munge_ab": ...} summary carrying the acceptance
+    pins: fused wall <= 0.5x eager for group-by and join, sort no worse,
+    chain dispatches cut >= 5x, joins/sort/chain bit-equal, group-by
+    counts/extrema exact with float sums allclose (per-shard accumulation
+    + psum reorder f32 addition — bit-parity there is not the contract)."""
+    from h2o3_tpu.frame import ops as fops
+    from h2o3_tpu.frame.frame import CAT, NUM, Frame, Vec
+    from h2o3_tpu.parallel.mesh import get_mesh
+    from h2o3_tpu.utils import metrics as mx
+
+    n = rows
+    n_dev = int(get_mesh().devices.size)
+    rng = np.random.default_rng(0)
+
+    # one host copy of every input: both modes build their frames from the
+    # SAME bytes, so parity failures can only come from the compute lanes
+    gcard = max(64, n // 2000)
+    a = rng.normal(size=n)
+    a[::97] = np.nan
+    b = rng.normal(size=n)
+    c = rng.normal(size=n)
+    g = rng.integers(0, gcard, size=n).astype(np.int64)
+    # join geometry mirrors bench.py join_10m: right side unique keys
+    # (dimension-table shape), left random over them -> out rows == n
+    nr = max(n // 10, 8)
+    kl = rng.integers(0, nr, size=n).astype(np.float64)
+    kr = rng.permutation(nr).astype(np.float64)
+    yr = rng.normal(size=nr)
+
+    def gb_frame():
+        return Frame(
+            [Vec.from_numpy(a, NUM, name="a"),
+             Vec.from_numpy(b, NUM, name="b"),
+             Vec.from_numpy(c, NUM, name="c"),
+             Vec.from_numpy(g, CAT, name="g",
+                            domain=[str(i) for i in range(gcard)])],
+            ["a", "b", "c", "g"])
+
+    def join_frames():
+        L = Frame([Vec.from_numpy(kl, NUM, name="k"),
+                   Vec.from_numpy(a, NUM, name="x")], ["k", "x"])
+        R = Frame([Vec.from_numpy(kr, NUM, name="k"),
+                   Vec.from_numpy(yr, NUM, name="y")], ["k", "y"])
+        return L, R
+
+    GB_SPEC = {"a": ["sum", "mean", "min", "max", "count"],
+               "b": ["sum", "sd"], "c": ["max", "count"]}
+
+    def timed(fn):
+        fn()  # compile warmup
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
+
+    results, outs = {}, {}
+    for mode in ("fused", "eager"):
+        os.environ["H2O3_TPU_MUNGE_FUSE"] = "1" if mode == "fused" else "0"
+        fr = gb_frame()
+        gb, gb_s = timed(
+            lambda: fops.group_by(fr, "g").agg(GB_SPEC).to_pandas())
+        L, R = join_frames()
+        jn, join_s = timed(
+            lambda: fops.merge(L, R, by=["k"]).to_pandas())
+        so, sort_s = timed(
+            lambda: fops.sort(fr, ["g", "a"],
+                              ascending=[True, False]).to_pandas())
+
+        def chain():
+            va, vb = fr.vec("a"), fr.vec("b")
+            cx = (va * 2.0 + vb) / 3.0          # 3 ops
+            d = (cx > 0) & (vb < 1.0)           # 3 ops
+            e = fops.ifelse(d, cx, va - vb)     # 2 ops
+            return (e * e + 1.0).to_numpy()     # 2 ops
+        chain()  # compile warmup (outside the dispatch-count window)
+        d0 = {op: mx.counter_value("munge_dispatches_total", op=op)
+              for op in ("elementwise", "expr_fuse")}
+        t0 = time.perf_counter()
+        ch = chain()
+        chain_s = time.perf_counter() - t0
+        disp = sum(mx.counter_value("munge_dispatches_total", op=op) - d0[op]
+                   for op in ("elementwise", "expr_fuse"))
+
+        outs[mode] = {"gb": gb, "jn": jn, "so": so, "ch": ch}
+        rec = {"phase": "munge_ab", "mode": mode, "rows": n,
+               "n_devices": n_dev, "groupby_groups": gcard,
+               "join_out_rows": int(len(jn)),
+               "groupby_s": round(gb_s, 4), "join_s": round(join_s, 4),
+               "sort_s": round(sort_s, 4), "chain_s": round(chain_s, 4),
+               "chain_dispatches": int(disp)}
+        print(json.dumps(rec), flush=True)
+        results[mode] = rec
+    os.environ.pop("H2O3_TPU_MUNGE_FUSE", None)
+
+    def frames_equal(fa, fb, close=()):
+        if list(fa.columns) != list(fb.columns) or fa.shape != fb.shape:
+            return False
+        for col in fa.columns:
+            xa, xb = fa[col].to_numpy(), fb[col].to_numpy()
+            if xa.dtype == object:
+                ok = list(xa) == list(xb)
+            elif col in close:
+                ok = np.allclose(xa, xb, rtol=1e-5, atol=1e-4,
+                                 equal_nan=True)
+            else:
+                ok = np.array_equal(xa, xb, equal_nan=True)
+            if not ok:
+                return False
+        return True
+
+    f, e = results["fused"], results["eager"]
+    gb_close = ("sum_a", "mean_a", "sum_b", "sd_b")
+    parity = {
+        "groupby_parity_ok": frames_equal(
+            outs["fused"]["gb"], outs["eager"]["gb"], close=gb_close),
+        "join_bit_equal": frames_equal(outs["fused"]["jn"],
+                                       outs["eager"]["jn"]),
+        "sort_bit_equal": frames_equal(outs["fused"]["so"],
+                                       outs["eager"]["so"]),
+        "chain_bit_equal": bool(np.array_equal(
+            outs["fused"]["ch"], outs["eager"]["ch"], equal_nan=True)),
+    }
+    print(json.dumps({"munge_ab": {
+        "rows": n, "n_devices": n_dev,
+        "groupby_wall_ratio_fused_over_eager": round(
+            f["groupby_s"] / max(e["groupby_s"], 1e-9), 3),
+        "join_wall_ratio_fused_over_eager": round(
+            f["join_s"] / max(e["join_s"], 1e-9), 3),
+        "sort_wall_ratio_fused_over_eager": round(
+            f["sort_s"] / max(e["sort_s"], 1e-9), 3),
+        "chain_wall_ratio_fused_over_eager": round(
+            f["chain_s"] / max(e["chain_s"], 1e-9), 3),
+        "chain_dispatch_ratio": round(
+            e["chain_dispatches"] / max(f["chain_dispatches"], 1), 2),
+        **parity,
+        "parity_ok": all(parity.values()),
+    }}), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -1107,5 +1262,7 @@ if __name__ == "__main__":
         mesh2d_ab(**kw)
     elif "--wave2-ab" in sys.argv:
         wave2_ab(**kw)
+    elif "--munge-ab" in sys.argv:
+        munge_ab(**kw)
     else:
         main()

@@ -365,6 +365,72 @@ def _wave2_ab_ok(here: str, now: float):
         return False
 
 
+def _munge_ab_ok(here: str, now: float):
+    """Sanity-check the newest recent MUNGE_AB_*.jsonl (bench_kernel_sweep
+    --munge-ab, the ISSUE-20 compiled-munging-plane A/B). Returns None
+    when no recent artifact exists (no opinion), else True/False. Checks
+    the acceptance pins: fused wall <= 0.5x eager for group-by AND join,
+    sort no worse than ~1.1x, the 10-op expression chain's dispatch count
+    cut >= 5x, and every parity pin green (joins/sort/chain bit-equal,
+    group-by counts exact + float sums allclose)."""
+    recent = []
+    for p in glob.glob(os.path.join(here, "MUNGE_AB_*.jsonl")):
+        age = _stamp_age_s(p, now)
+        if age is not None and 0 <= age < RECENT_S:
+            recent.append((age, p))
+    if not recent:
+        return None
+    path = sorted(recent)[0][1]
+    name = os.path.basename(path)
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "munge_ab" in d:
+                    summary = d["munge_ab"]
+        if not summary:
+            print(f"{name}: NO munge_ab summary line")
+            return False
+        gb_r = float(summary.get("groupby_wall_ratio_fused_over_eager",
+                                 float("nan")))
+        if not gb_r <= 0.5:
+            print(f"{name}: group-by fused/eager wall {gb_r} > 0.5x")
+            return False
+        jn_r = float(summary.get("join_wall_ratio_fused_over_eager",
+                                 float("nan")))
+        if not jn_r <= 0.5:
+            print(f"{name}: join fused/eager wall {jn_r} > 0.5x")
+            return False
+        so_r = float(summary.get("sort_wall_ratio_fused_over_eager",
+                                 float("nan")))
+        if not so_r <= 1.1:
+            print(f"{name}: sort fused/eager wall {so_r} > 1.1x")
+            return False
+        disp_r = float(summary.get("chain_dispatch_ratio") or 0)
+        if not disp_r >= 5.0:
+            print(f"{name}: chain dispatch ratio {disp_r} < 5x")
+            return False
+        if summary.get("parity_ok") is not True:
+            bad = [k for k in ("groupby_parity_ok", "join_bit_equal",
+                               "sort_bit_equal", "chain_bit_equal")
+                   if summary.get(k) is not True]
+            print(f"{name}: parity pins failed: {bad}")
+            return False
+        print(f"{name}: groupby={gb_r}x join={jn_r}x sort={so_r}x "
+              f"chain-dispatches=1/{disp_r} parity=ok")
+        return True
+    except OSError as e:
+        print(f"{name}: unreadable ({e.strerror or e})")
+        return False
+    except Exception as e:  # torn/garbage JSON
+        print(f"{name}: unparseable ({type(e).__name__})")
+        return False
+
+
 def _mesh2d_ab_ok(here: str, now: float):
     """Sanity-check the newest recent MESH2D_AB_*.jsonl (bench_kernel_sweep
     --mesh2d-ab, the 1-D vs 2-D pod-mesh A/B, ISSUE 14). Returns None when
@@ -751,6 +817,12 @@ def main() -> int:
     # knob-off controls or the window stands
     w2 = _wave2_ab_ok(here, now)
     if w2 is False:
+        return 1
+    # compiled-munging-plane gate (ISSUE 20): a recent --munge-ab artifact
+    # must satisfy the wall-ratio + dispatch-cut + parity pins or the
+    # window stands
+    mu = _munge_ab_ok(here, now)
+    if mu is False:
         return 1
     # elastic-recovery gate (ISSUE 17): a recent --elastic drill artifact
     # must satisfy the shape-change parity pins or the window stands

@@ -110,6 +110,22 @@ timeout 1800 python tools/bench_kernel_sweep.py --wave2-ab --rows 1000000 \
   | tee "WAVE2_AB_${stamp}.jsonl"
 save "WAVE2_AB_${stamp}.jsonl" "Tree-kernel wave-2 A/B (GOSS / EFB / u8 cache / int16 lanes / lossguide, 1M rows)"
 
+# compiled-munging-plane A/B (ISSUE 20): fused vs eager group-by / join /
+# sort + the expression-chain dispatch pin at 10M rows. The CPU-proxy
+# artifact (MUNGE_AB_*_cpu8proxy.jsonl) pins parity and the dispatch cut;
+# the TPU number that matters here is the join exchange — all_to_all over
+# real ICI vs the CPU proxy's shared-memory copy decides whether the radix
+# lane stays default-on for multi-host meshes.
+timeout 1800 python tools/bench_kernel_sweep.py --munge-ab --rows 10000000 \
+  | tee "MUNGE_AB_${stamp}.jsonl"
+save "MUNGE_AB_${stamp}.jsonl" "Compiled munging plane A/B (group-by / join / sort / expr chain, 10M rows)"
+
+# munging headline control: the whole bench with the plane disabled —
+# cat_1m's group-by stage and join_10m pin the eager walls
+H2O3_TPU_MUNGE_FUSE=0 H2O3_TPU_BENCH_DEADLINE_S=1 timeout 1800 python bench.py \
+  | tee "BENCH_builder_${stamp}_mungeoff.json"
+save "BENCH_builder_${stamp}_mungeoff.json" "TPU bench MUNGE_FUSE=0 control (headline only)"
+
 # wave-2 bench headlines: the full-pipeline trees/sec under GOSS and under
 # the int16 lanes (one control each; EFB and the u8 cache show up in the
 # A/B's own counters, and the dense bench frame has nothing to bundle)

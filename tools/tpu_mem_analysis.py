@@ -105,6 +105,31 @@ def oocore_model(out_path: str | None = None) -> dict:
                 "only the LRU window; Higgs-1B streams through any bracket "
                 "whose hosts carry the spill tier",
     }
+    # compiled-munging exchange geometry (ISSUE 20): the radix join's
+    # all_to_all moves, per side, an i32 key lane + a bool validity lane
+    # out and an i32 gid lane back, through (nd, cap) bucket buffers whose
+    # cap the skew guard bounds at 4x the balanced share — so the exchange
+    # working set is the padding factor times the row bytes, NOT the raw
+    # frame. The sort lane moves no rows at all (one replicated order
+    # vector + the payload gather).
+    jx_bytes_per_row = 4 + 4  # key out + gid back (empty slots carry the
+    # canonical-NaN key code, so no validity plane rides the exchange)
+    skew_pad_max = 4.0            # tuple_gids_exchange's cap guard
+    per_row_join = int(2 * jx_bytes_per_row * skew_pad_max + 8)  # both
+    # sides' buckets live at once + the i64 staging codes
+    out["munge_exchange"] = {
+        "join_exchange_bytes_per_row_balanced": 2 * jx_bytes_per_row,
+        "join_exchange_bytes_per_row_skew_capped": per_row_join,
+        "sort_exchange_bytes_per_row": 4,  # replicated order i32 only
+        "brackets": [{
+            "bracket": name, "chips": chips,
+            "max_join_rows_per_side": int(
+                usable * chips * hbm_per_chip // per_row_join),
+        } for name, chips in brackets],
+        "note": "join capacity is exchange-buffer bound (cap*nd padding), "
+                "not key bound: the skew guard falls back to the lexsort "
+                "lane before the padded buckets can exceed 4x the data",
+    }
     print(json.dumps(out), flush=True)
     if out_path:
         with open(out_path, "w") as f:
